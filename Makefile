@@ -1,0 +1,13 @@
+BUILD_DIR := native/build
+
+.PHONY: native test clean
+
+native:
+	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
+	cmake --build $(BUILD_DIR)
+
+test: native
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -rf $(BUILD_DIR)

@@ -1,0 +1,27 @@
+"""brpc_tpu — a TPU-native RPC framework with the capabilities of Apache bRPC.
+
+Architecture (see SURVEY.md for the reference feature map):
+
+- ``native/`` (C++20, built as ``libbrpc_tpu.so``): the host runtime —
+  zero-copy IOBuf, lock-minimized resource pools, an M:N work-stealing fiber
+  scheduler, futex-bridged fiber/pthread synchronization, a wait-free socket
+  write path over epoll, the framed RPC protocol, Channel/Server/Controller.
+  Equivalent in capability to the reference's src/butil, src/bthread,
+  src/bvar, src/brpc (cited per-file in the native sources).
+
+- ``brpc_tpu.runtime``: ctypes bindings over the native C API.
+
+- ``brpc_tpu.parallel``: the ``tpu://`` data plane — pjit-compiled collective
+  transfer programs (ring ppermute point-to-point streaming, all_gather
+  fan-out, reduce_scatter merge) over a ``jax.sharding.Mesh``. This replaces
+  the reference's RDMA/ibverbs endpoint (src/brpc/rdma/) with XLA collectives
+  over ICI/DCN.
+
+- ``brpc_tpu.ops``: Pallas/JAX device kernels used by the data plane.
+
+- ``brpc_tpu.models``: flagship end-to-end workloads (tensor-streaming
+  parameter server, echo benchmarks) — the analogs of the reference's
+  example/ apps.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,139 @@
+// DoublyBufferedData: RCU-like double-buffered config holder — readers take a
+// near-free per-thread lock on the foreground copy; writers modify the
+// background copy, flip, wait for readers to drain off the old foreground,
+// then modify it too so both copies converge.
+//
+// Capability parity: reference src/butil/containers/doubly_buffered_data.h:
+// 39-68 — backs load-balancer server lists and SocketMap so SelectServer is
+// low-contention on the read path.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "tbutil/logging.h"
+
+namespace tbutil {
+
+template <typename T>
+class DoublyBufferedData {
+ public:
+  class ScopedPtr {
+   public:
+    ScopedPtr() : _data(nullptr), _lock(nullptr) {}
+    ~ScopedPtr() {
+      if (_lock != nullptr) _lock->unlock();
+    }
+    ScopedPtr(const ScopedPtr&) = delete;
+    ScopedPtr& operator=(const ScopedPtr&) = delete;
+    const T* get() const { return _data; }
+    const T& operator*() const { return *_data; }
+    const T* operator->() const { return _data; }
+
+   private:
+    friend class DoublyBufferedData;
+    const T* _data;
+    std::mutex* _lock;
+  };
+
+  DoublyBufferedData() : _index(0) {}
+
+  ~DoublyBufferedData() {
+    std::lock_guard<std::mutex> g(_wrappers_mutex);
+    for (Wrapper* w : _wrappers) w->detach();
+  }
+
+  // Read access to the foreground copy. Returns 0 on success.
+  int Read(ScopedPtr* ptr) {
+    Wrapper* w = local_wrapper();
+    w->mutex.lock();
+    ptr->_data = &_data[_index.load(std::memory_order_acquire)];
+    ptr->_lock = &w->mutex;
+    return 0;
+  }
+
+  // fn(T&) -> bool. Applied to background copy, flipped, then applied to the
+  // old foreground (after readers drain) so both copies stay in sync.
+  template <typename Fn>
+  size_t Modify(Fn&& fn) {
+    std::lock_guard<std::mutex> g(_modify_mutex);
+    int bg = 1 - _index.load(std::memory_order_relaxed);
+    if (!fn(_data[bg])) return 0;
+    // Flip: new readers see the modified copy.
+    _index.store(bg, std::memory_order_release);
+    // Wait for every reader thread to leave the old foreground by briefly
+    // taking each per-thread lock.
+    {
+      std::lock_guard<std::mutex> wg(_wrappers_mutex);
+      for (Wrapper* w : _wrappers) {
+        std::lock_guard<std::mutex> rl(w->mutex);
+      }
+    }
+    // Both copies must converge: a fn that succeeded on the background copy
+    // but fails here would leave readers seeing a lost update after the next
+    // flip. Treat as fatal (the reference CHECKs this too).
+    bool applied_twice = fn(_data[1 - bg]);
+    TB_CHECK(applied_twice) << "DoublyBufferedData::Modify fn failed on the "
+                               "second copy; copies have diverged";
+    return 1;
+  }
+
+  template <typename Fn, typename Arg>
+  size_t Modify(Fn&& fn, const Arg& arg) {
+    return Modify([&](T& t) { return fn(t, arg); });
+  }
+
+ private:
+  struct Wrapper {
+    std::mutex mutex;
+    DoublyBufferedData* owner = nullptr;
+    void detach() { owner = nullptr; }
+    ~Wrapper() {
+      if (owner != nullptr) owner->remove_wrapper(this);
+    }
+  };
+
+  Wrapper* local_wrapper() {
+    // Thread-local registry; unique_ptr so thread exit destroys wrappers,
+    // which de-registers them from their owner (unless the owner died first
+    // and detached). Instances are expected to outlive reader threads or be
+    // effectively static (LB tables, socket maps), as in the reference.
+    static thread_local std::vector<
+        std::pair<DoublyBufferedData*, std::unique_ptr<Wrapper>>>
+        tls_map;
+    for (auto& [key, w] : tls_map) {
+      // Guard against a new instance reusing a dead instance's address.
+      if (key == this && w->owner == this) return w.get();
+    }
+    auto w = std::make_unique<Wrapper>();
+    w->owner = this;
+    Wrapper* raw = w.get();
+    {
+      std::lock_guard<std::mutex> g(_wrappers_mutex);
+      _wrappers.push_back(raw);
+    }
+    tls_map.emplace_back(this, std::move(w));
+    return raw;
+  }
+
+  void remove_wrapper(Wrapper* w) {
+    std::lock_guard<std::mutex> g(_wrappers_mutex);
+    for (size_t i = 0; i < _wrappers.size(); ++i) {
+      if (_wrappers[i] == w) {
+        _wrappers[i] = _wrappers.back();
+        _wrappers.pop_back();
+        break;
+      }
+    }
+  }
+
+  T _data[2];
+  std::atomic<int> _index;
+  std::mutex _modify_mutex;
+  std::mutex _wrappers_mutex;
+  std::vector<Wrapper*> _wrappers;
+};
+
+}  // namespace tbutil

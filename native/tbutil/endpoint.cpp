@@ -1,0 +1,86 @@
+#include "tbutil/endpoint.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <stdio.h>
+#include <string.h>
+
+namespace tbutil {
+
+// Strict port parse: digits only, full consumption, 0-65535. Returns -1 on
+// malformed input ("" / "80abc" / "9x9") so typo'd configs fail loudly
+// instead of silently connecting to the wrong port.
+static int parse_port(const char* s) {
+  if (*s == '\0') return -1;
+  char* end = nullptr;
+  long v = strtol(s, &end, 10);
+  if (*end != '\0' || v < 0 || v > 65535) return -1;
+  return static_cast<int>(v);
+}
+
+int str2endpoint(const char* str, EndPoint* point) {
+  const char* colon = strrchr(str, ':');
+  if (colon == nullptr) return -1;
+  char ipbuf[64];
+  size_t iplen = static_cast<size_t>(colon - str);
+  if (iplen >= sizeof(ipbuf)) return -1;
+  memcpy(ipbuf, str, iplen);
+  ipbuf[iplen] = '\0';
+  int port = parse_port(colon + 1);
+  if (port < 0) return -1;
+  return str2endpoint(ipbuf, port, point);
+}
+
+int str2endpoint(const char* ip_str, int port, EndPoint* point) {
+  if (port < 0 || port > 65535) return -1;
+  in_addr ip;
+  if (inet_pton(AF_INET, ip_str, &ip) != 1) return -1;
+  point->ip = ip;
+  point->port = port;
+  return 0;
+}
+
+int hostname2endpoint(const char* str, EndPoint* point) {
+  const char* colon = strrchr(str, ':');
+  std::string host = colon ? std::string(str, colon - str) : std::string(str);
+  int port = colon ? parse_port(colon + 1) : 0;
+  if (port < 0) return -1;
+  // Fast path: already a numeric address.
+  in_addr ip;
+  if (inet_pton(AF_INET, host.c_str(), &ip) == 1) {
+    point->ip = ip;
+    point->port = port;
+    return 0;
+  }
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* result = nullptr;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &result) != 0 ||
+      result == nullptr) {
+    return -1;
+  }
+  point->ip = reinterpret_cast<sockaddr_in*>(result->ai_addr)->sin_addr;
+  point->port = port;
+  freeaddrinfo(result);
+  return 0;
+}
+
+std::string endpoint2str(const EndPoint& point) {
+  char buf[32];
+  char ipbuf[INET_ADDRSTRLEN];
+  inet_ntop(AF_INET, &point.ip, ipbuf, sizeof(ipbuf));
+  snprintf(buf, sizeof(buf), "%s:%d", ipbuf, point.port);
+  return buf;
+}
+
+uint64_t endpoint_hash(const EndPoint& point) {
+  uint64_t x = (static_cast<uint64_t>(point.ip.s_addr) << 16) |
+               static_cast<uint64_t>(point.port);
+  // splitmix64 finalizer
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+}  // namespace tbutil

@@ -1,0 +1,46 @@
+// EndPoint: ip:port value type with parsing and hostname resolution.
+// Capability parity: reference src/butil/endpoint.h:33-80 (ip_t/port pair,
+// str2endpoint, hostname2endpoint, endpoint2str). Extended with the tpu://
+// scheme used by the TPU transport (tpu://<mesh-coord> endpoints carry a
+// device ordinal instead of an IPv4 address — see trpc/tpu_transport.h).
+#pragma once
+
+#include <netinet/in.h>
+
+#include <cstdint>
+#include <string>
+
+namespace tbutil {
+
+struct EndPoint {
+  in_addr ip;    // network byte order
+  int port;
+
+  EndPoint() : port(0) { ip.s_addr = 0; }
+  EndPoint(in_addr i, int p) : ip(i), port(p) {}
+
+  bool operator==(const EndPoint& rhs) const {
+    return ip.s_addr == rhs.ip.s_addr && port == rhs.port;
+  }
+  bool operator<(const EndPoint& rhs) const {
+    return ip.s_addr != rhs.ip.s_addr ? ip.s_addr < rhs.ip.s_addr
+                                      : port < rhs.port;
+  }
+};
+
+// "1.2.3.4:80" -> EndPoint. Returns 0 on success.
+int str2endpoint(const char* str, EndPoint* point);
+int str2endpoint(const char* ip_str, int port, EndPoint* point);
+// Resolves hostnames via getaddrinfo ("localhost:80").
+int hostname2endpoint(const char* str, EndPoint* point);
+std::string endpoint2str(const EndPoint& point);
+
+uint64_t endpoint_hash(const EndPoint& point);
+
+struct EndPointHasher {
+  size_t operator()(const EndPoint& e) const {
+    return static_cast<size_t>(endpoint_hash(e));
+  }
+};
+
+}  // namespace tbutil

@@ -1,0 +1,35 @@
+#include "tbutil/fast_rand.h"
+
+#include <pthread.h>
+
+#include "tbutil/time.h"
+
+namespace tbutil {
+
+static thread_local FastRandState tls_rand_state;
+static thread_local bool tls_rand_seeded = false;
+
+uint64_t fast_rand() {
+  if (!tls_rand_seeded) {
+    fast_rand_seed(tls_rand_state,
+                   static_cast<uint64_t>(monotonic_time_ns()) ^
+                       (reinterpret_cast<uint64_t>(&tls_rand_state) << 1) ^
+                       static_cast<uint64_t>(pthread_self()));
+    tls_rand_seeded = true;
+  }
+  return fast_rand(tls_rand_state);
+}
+
+uint64_t fast_rand_less_than(uint64_t range) {
+  if (range == 0) return 0;
+  // Lemire's multiply-shift rejection-free mapping (slight bias acceptable
+  // for scheduling/LB uses).
+  return static_cast<uint64_t>(
+      (static_cast<__uint128_t>(fast_rand()) * range) >> 64);
+}
+
+double fast_rand_double() {
+  return (fast_rand() >> 11) * (1.0 / 9007199254740992.0);
+}
+
+}  // namespace tbutil

@@ -1,0 +1,48 @@
+// Thread-local xoshiro256** PRNG. Capability parity: reference
+// src/butil/fast_rand.h (per-thread seeded fast random for LB jitter, backoff,
+// reservoir sampling). Public-domain xoshiro algorithm (Blackman/Vigna).
+#pragma once
+
+#include <cstdint>
+
+namespace tbutil {
+
+struct FastRandState {
+  uint64_t s[4];
+};
+
+namespace detail {
+inline uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+inline uint64_t splitmix64(uint64_t& x) {
+  x += 0x9E3779B97f4A7C15ULL;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+}  // namespace detail
+
+inline void fast_rand_seed(FastRandState& st, uint64_t seed) {
+  for (auto& w : st.s) w = detail::splitmix64(seed);
+}
+
+inline uint64_t fast_rand(FastRandState& st) {
+  uint64_t* s = st.s;
+  const uint64_t result = detail::rotl(s[1] * 5, 7) * 9;
+  const uint64_t t = s[1] << 17;
+  s[2] ^= s[0];
+  s[3] ^= s[1];
+  s[1] ^= s[2];
+  s[0] ^= s[3];
+  s[2] ^= t;
+  s[3] = detail::rotl(s[3], 45);
+  return result;
+}
+
+// Thread-local convenience entry points.
+uint64_t fast_rand();
+// Uniform in [0, range); returns 0 if range == 0.
+uint64_t fast_rand_less_than(uint64_t range);
+double fast_rand_double();  // [0, 1)
+
+}  // namespace tbutil

@@ -1,0 +1,219 @@
+// Open-addressing hash map (linear probing, power-of-two buckets) used for
+// hot lookup tables: server method maps, socket maps, LB indexes.
+// Capability parity: reference src/butil/containers/flat_map.h:145 (their
+// variant chains within buckets; ours is tombstone-free robin-hood-lite —
+// same role: cache-friendly lookups without per-node allocation).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <utility>
+#include <vector>
+
+namespace tbutil {
+
+template <typename K, typename V, typename Hash = std::hash<K>,
+          typename Eq = std::equal_to<K>>
+class FlatMap {
+  enum SlotState : uint8_t { kEmpty = 0, kFull = 1, kDeleted = 2 };
+
+  struct Slot {
+    uint8_t state = kEmpty;
+    alignas(alignof(std::pair<K, V>)) unsigned char kv[sizeof(std::pair<K, V>)];
+    std::pair<K, V>* pair() { return reinterpret_cast<std::pair<K, V>*>(kv); }
+    const std::pair<K, V>* pair() const {
+      return reinterpret_cast<const std::pair<K, V>*>(kv);
+    }
+  };
+
+ public:
+  FlatMap() = default;
+  explicit FlatMap(size_t initial_cap) { reserve(initial_cap); }
+  ~FlatMap() { clear(); }
+
+  FlatMap(const FlatMap& rhs) { *this = rhs; }
+  FlatMap& operator=(const FlatMap& rhs) {
+    if (this == &rhs) return *this;
+    clear();
+    reserve(rhs._size * 2 + 8);
+    for (const auto& kv : rhs) insert(kv.first, kv.second);
+    return *this;
+  }
+  FlatMap(FlatMap&& rhs) noexcept
+      : _slots(std::move(rhs._slots)),
+        _size(rhs._size),
+        _num_deleted(rhs._num_deleted),
+        _mask(rhs._mask) {
+    rhs._size = 0;
+    rhs._num_deleted = 0;
+    rhs._mask = 0;
+  }
+  FlatMap& operator=(FlatMap&& rhs) noexcept {
+    if (this != &rhs) {
+      clear();
+      _slots = std::move(rhs._slots);
+      _size = rhs._size;
+      _num_deleted = rhs._num_deleted;
+      _mask = rhs._mask;
+      rhs._size = 0;
+      rhs._num_deleted = 0;
+      rhs._mask = 0;
+    }
+    return *this;
+  }
+
+  size_t size() const { return _size; }
+  bool empty() const { return _size == 0; }
+
+  void clear() {
+    for (auto& s : _slots) {
+      if (s.state == kFull) s.pair()->~pair();
+      s.state = kEmpty;
+    }
+    _size = 0;
+    _num_deleted = 0;
+  }
+
+  void reserve(size_t n) {
+    size_t want = 8;
+    while (want < n * 2) want <<= 1;
+    if (want > _slots.size()) rehash(want);
+  }
+
+  V* seek(const K& key) {
+    if (_slots.empty()) return nullptr;
+    size_t i = Hash()(key) & _mask;
+    for (size_t probe = 0; probe <= _mask; ++probe, i = (i + 1) & _mask) {
+      Slot& s = _slots[i];
+      if (s.state == kEmpty) return nullptr;
+      if (s.state == kFull && Eq()(s.pair()->first, key)) {
+        return &s.pair()->second;
+      }
+    }
+    return nullptr;
+  }
+  const V* seek(const K& key) const {
+    return const_cast<FlatMap*>(this)->seek(key);
+  }
+
+  V& operator[](const K& key) {
+    V* v = seek(key);
+    if (v != nullptr) return *v;
+    return *insert(key, V());
+  }
+
+  // Returns pointer to the stored value.
+  V* insert(const K& key, V value) {
+    // Load factor counts tombstones: a table saturated with kFull+kDeleted
+    // slots would otherwise make the probe loop non-terminating.
+    if (_slots.empty() || (_size + _num_deleted + 1) * 4 >= _slots.size() * 3) {
+      rehash(_slots.empty() ? 8 : ((_size + 1) * 4 >= _slots.size() * 3
+                                       ? _slots.size() * 2
+                                       : _slots.size()));
+    }
+    size_t i = Hash()(key) & _mask;
+    size_t first_deleted = SIZE_MAX;
+    for (;; i = (i + 1) & _mask) {
+      Slot& s = _slots[i];
+      if (s.state == kFull) {
+        if (Eq()(s.pair()->first, key)) {
+          s.pair()->second = std::move(value);
+          return &s.pair()->second;
+        }
+        continue;
+      }
+      if (s.state == kDeleted) {
+        if (first_deleted == SIZE_MAX) first_deleted = i;
+        continue;
+      }
+      // kEmpty: insert here or at the first tombstone seen.
+      size_t target = (first_deleted != SIZE_MAX) ? first_deleted : i;
+      Slot& t = _slots[target];
+      if (t.state == kDeleted) --_num_deleted;
+      new (t.kv) std::pair<K, V>(key, std::move(value));
+      t.state = kFull;
+      ++_size;
+      return &t.pair()->second;
+    }
+  }
+
+  // Returns number of erased elements (0 or 1).
+  size_t erase(const K& key) {
+    if (_slots.empty()) return 0;
+    size_t i = Hash()(key) & _mask;
+    for (size_t probe = 0; probe <= _mask; ++probe, i = (i + 1) & _mask) {
+      Slot& s = _slots[i];
+      if (s.state == kEmpty) return 0;
+      if (s.state == kFull && Eq()(s.pair()->first, key)) {
+        s.pair()->~pair();
+        s.state = kDeleted;
+        ++_num_deleted;
+        --_size;
+        return 1;
+      }
+    }
+    return 0;
+  }
+
+  class iterator {
+   public:
+    iterator(FlatMap* m, size_t i) : _m(m), _i(i) { advance(); }
+    std::pair<K, V>& operator*() { return *_m->_slots[_i].pair(); }
+    std::pair<K, V>* operator->() { return _m->_slots[_i].pair(); }
+    iterator& operator++() {
+      ++_i;
+      advance();
+      return *this;
+    }
+    bool operator!=(const iterator& rhs) const { return _i != rhs._i; }
+
+   private:
+    void advance() {
+      while (_i < _m->_slots.size() && _m->_slots[_i].state != kFull) ++_i;
+    }
+    FlatMap* _m;
+    size_t _i;
+  };
+
+  iterator begin() { return iterator(this, 0); }
+  iterator end() { return iterator(this, _slots.size()); }
+  iterator begin() const { return iterator(const_cast<FlatMap*>(this), 0); }
+  iterator end() const {
+    return iterator(const_cast<FlatMap*>(this), _slots.size());
+  }
+
+ private:
+  void rehash(size_t ncap) {
+    std::vector<Slot> old = std::move(_slots);
+    _slots.clear();
+    _slots.resize(ncap);
+    _mask = ncap - 1;
+    _size = 0;
+    _num_deleted = 0;
+    for (auto& s : old) {
+      if (s.state == kFull) {
+        insert_nogrow(std::move(s.pair()->first), std::move(s.pair()->second));
+        s.pair()->~pair();
+      }
+    }
+  }
+
+  void insert_nogrow(K key, V value) {
+    size_t i = Hash()(key) & _mask;
+    while (_slots[i].state == kFull) i = (i + 1) & _mask;
+    Slot& t = _slots[i];
+    new (t.kv) std::pair<K, V>(std::move(key), std::move(value));
+    t.state = kFull;
+    ++_size;
+  }
+
+  std::vector<Slot> _slots;
+  size_t _size = 0;
+  size_t _num_deleted = 0;
+  size_t _mask = 0;
+
+  friend class iterator;
+};
+
+}  // namespace tbutil

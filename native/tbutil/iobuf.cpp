@@ -1,0 +1,555 @@
+#include "tbutil/iobuf.h"
+
+#include <errno.h>
+#include <stdlib.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <vector>
+
+#include "tbutil/logging.h"
+
+namespace tbutil {
+
+// ---------------------------------------------------------------- Block
+
+struct IOBuf::Block {
+  std::atomic<int32_t> nshared;
+  uint32_t flags;  // 1 = user data
+  uint32_t size;   // bytes filled (append cursor for shared tail blocks)
+  uint32_t cap;
+  void (*user_deleter)(void*);
+  uint64_t meta;
+  char* data;  // into this allocation, or the user pointer
+
+  static constexpr uint32_t kUserData = 1;
+};
+
+IOBuf::Block* IOBuf::create_block(size_t cap) {
+  auto* b = static_cast<Block*>(malloc(sizeof(Block) + cap));
+  b->nshared.store(1, std::memory_order_relaxed);
+  b->flags = 0;
+  b->size = 0;
+  b->cap = static_cast<uint32_t>(cap);
+  b->user_deleter = nullptr;
+  b->meta = 0;
+  b->data = reinterpret_cast<char*>(b + 1);
+  return b;
+}
+
+void IOBuf::block_inc_ref(Block* b) {
+  b->nshared.fetch_add(1, std::memory_order_relaxed);
+}
+
+void IOBuf::block_dec_ref(Block* b) {
+  if (b->nshared.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    if (b->flags & Block::kUserData) {
+      if (b->user_deleter) b->user_deleter(b->data);
+    }
+    free(b);
+  }
+}
+
+char* IOBuf::block_data(Block* b) { return b->data; }
+uint32_t IOBuf::block_size(Block* b) { return b->size; }
+uint32_t IOBuf::block_cap(Block* b) { return b->cap; }
+void IOBuf::block_set_size(Block* b, uint32_t size) { b->size = size; }
+
+// Per-thread shared tail block. Multiple IOBufs on one thread append into the
+// same 8KB block (each holding refs to disjoint ranges) — no lock, no
+// per-message allocation. Reference keeps an equivalent tls block list
+// (butil/iobuf.cpp share_tls_block).
+static thread_local IOBuf::Block* tls_tail_block = nullptr;
+
+IOBuf::Block* IOBuf::share_tls_block() {
+  Block* b = tls_tail_block;
+  if (b != nullptr && b->size < b->cap) return b;
+  if (b != nullptr) block_dec_ref(b);
+  b = create_block();
+  tls_tail_block = b;
+  return b;
+}
+
+void IOBuf::release_tls_block() {
+  if (tls_tail_block != nullptr) {
+    block_dec_ref(tls_tail_block);
+    tls_tail_block = nullptr;
+  }
+}
+
+// ---------------------------------------------------------------- IOBuf
+
+IOBuf::IOBuf() : _refs(_sso), _start(0), _count(0), _cap(4), _size(0) {}
+
+IOBuf::IOBuf(const IOBuf& rhs) : IOBuf() { append(rhs); }
+
+IOBuf::IOBuf(IOBuf&& rhs) noexcept : IOBuf() { swap(rhs); }
+
+IOBuf& IOBuf::operator=(const IOBuf& rhs) {
+  if (this != &rhs) {
+    clear();
+    append(rhs);
+  }
+  return *this;
+}
+
+IOBuf& IOBuf::operator=(IOBuf&& rhs) noexcept {
+  if (this != &rhs) {
+    clear();
+    swap(rhs);
+  }
+  return *this;
+}
+
+void IOBuf::swap(IOBuf& rhs) {
+  // SSO-backed arrays can't just swap pointers.
+  IOBuf* a = this;
+  IOBuf* b = &rhs;
+  std::swap(a->_start, b->_start);
+  std::swap(a->_count, b->_count);
+  std::swap(a->_cap, b->_cap);
+  std::swap(a->_size, b->_size);
+  bool a_sso = (a->_refs == a->_sso);
+  bool b_sso = (b->_refs == b->_sso);
+  std::swap(a->_refs, b->_refs);
+  for (int i = 0; i < 4; ++i) std::swap(a->_sso[i], b->_sso[i]);
+  if (b_sso) a->_refs = a->_sso;
+  if (a_sso) b->_refs = b->_sso;
+}
+
+void IOBuf::clear() {
+  for (uint32_t i = 0; i < _count; ++i) {
+    block_dec_ref(_refs[_start + i].block);
+  }
+  if (_refs != _sso) free(_refs);
+  _refs = _sso;
+  _start = 0;
+  _count = 0;
+  _cap = 4;
+  _size = 0;
+}
+
+std::string_view IOBuf::backing_block(size_t i) const {
+  if (i >= _count) return {};
+  const BlockRef& r = _refs[_start + i];
+  return {r.block->data + r.offset, r.length};
+}
+
+void IOBuf::grow(uint32_t min_cap) {
+  uint32_t ncap = _cap * 2;
+  while (ncap < min_cap) ncap *= 2;
+  auto* nrefs = static_cast<BlockRef*>(malloc(ncap * sizeof(BlockRef)));
+  memcpy(nrefs, _refs + _start, _count * sizeof(BlockRef));
+  if (_refs != _sso) free(_refs);
+  _refs = nrefs;
+  _start = 0;
+  _cap = ncap;
+}
+
+void IOBuf::push_back_ref(const BlockRef& r) {
+  if (r.length == 0) {
+    block_dec_ref(r.block);
+    return;
+  }
+  // Merge with the previous ref when contiguous in the same block (common
+  // when successive appends land in the shared tail block).
+  if (_count > 0) {
+    BlockRef& last = _refs[_start + _count - 1];
+    if (last.block == r.block && last.offset + last.length == r.offset) {
+      last.length += r.length;
+      _size += r.length;
+      block_dec_ref(r.block);  // the merged ref already holds one
+      return;
+    }
+  }
+  if (_start + _count == _cap) {
+    if (_count < _cap / 2 && _start > 0) {
+      memmove(_refs, _refs + _start, _count * sizeof(BlockRef));
+      _start = 0;
+    } else {
+      grow(_count + 1);
+    }
+  }
+  _refs[_start + _count] = r;
+  ++_count;
+  _size += r.length;
+}
+
+void IOBuf::append(const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    Block* b = share_tls_block();
+    uint32_t take = static_cast<uint32_t>(
+        std::min<size_t>(n, b->cap - b->size));
+    memcpy(b->data + b->size, p, take);
+    BlockRef r{b, b->size, take};
+    block_inc_ref(b);
+    b->size += take;
+    push_back_ref(r);
+    p += take;
+    n -= take;
+  }
+}
+
+void IOBuf::append(const IOBuf& other) {
+  if (this == &other) {
+    // Self-append doubles the buffer; snapshot the refs first since
+    // push_back_ref mutates (and may reallocate) the array being read.
+    std::vector<BlockRef> snap(_refs + _start, _refs + _start + _count);
+    for (BlockRef& r : snap) {
+      block_inc_ref(r.block);
+      push_back_ref(r);
+    }
+    return;
+  }
+  for (uint32_t i = 0; i < other._count; ++i) {
+    BlockRef r = other._refs[other._start + i];
+    block_inc_ref(r.block);
+    push_back_ref(r);
+  }
+}
+
+void IOBuf::append(IOBuf&& other) {
+  if (this == &other) return;  // moving self into self: no-op
+  if (_count == 0) {
+    swap(other);
+    return;
+  }
+  for (uint32_t i = 0; i < other._count; ++i) {
+    push_back_ref(other._refs[other._start + i]);  // steal the ref
+  }
+  if (other._refs != other._sso) free(other._refs);
+  other._refs = other._sso;
+  other._start = 0;
+  other._count = 0;
+  other._cap = 4;
+  other._size = 0;
+}
+
+int IOBuf::append_user_data_with_meta(void* data, size_t size,
+                                      void (*deleter)(void*), uint64_t meta) {
+  if (size == 0 || size > 0xFFFFFFFFu) return -1;
+  auto* b = static_cast<Block*>(malloc(sizeof(Block)));
+  b->nshared.store(1, std::memory_order_relaxed);
+  b->flags = Block::kUserData;
+  b->size = static_cast<uint32_t>(size);
+  b->cap = static_cast<uint32_t>(size);
+  b->user_deleter = deleter ? deleter : [](void*) {};
+  b->meta = meta;
+  b->data = static_cast<char*>(data);
+  push_back_ref(BlockRef{b, 0, static_cast<uint32_t>(size)});
+  return 0;
+}
+
+int IOBuf::append_user_data(void* data, size_t size, void (*deleter)(void*)) {
+  return append_user_data_with_meta(data, size, deleter, 0);
+}
+
+uint64_t IOBuf::get_first_data_meta() const {
+  if (_count == 0) return 0;
+  return _refs[_start].block->meta;
+}
+
+size_t IOBuf::cutn(IOBuf* out, size_t n) {
+  n = std::min(n, _size);
+  size_t left = n;
+  while (left > 0 && _count > 0) {
+    BlockRef& r = _refs[_start];
+    if (r.length <= left) {
+      left -= r.length;
+      _size -= r.length;
+      out->push_back_ref(r);  // ownership moves
+      ++_start;
+      --_count;
+    } else {
+      BlockRef head{r.block, r.offset, static_cast<uint32_t>(left)};
+      block_inc_ref(r.block);
+      out->push_back_ref(head);
+      r.offset += static_cast<uint32_t>(left);
+      r.length -= static_cast<uint32_t>(left);
+      _size -= left;
+      left = 0;
+    }
+  }
+  if (_count == 0) _start = 0;
+  return n;
+}
+
+size_t IOBuf::cutn(void* out, size_t n) {
+  n = std::min(n, _size);
+  size_t copied = copy_to(out, n);
+  pop_front(n);
+  return copied;
+}
+
+size_t IOBuf::cutn(std::string* out, size_t n) {
+  n = std::min(n, _size);
+  size_t old = out->size();
+  out->resize(old + n);
+  return cutn(out->data() + old, n);
+}
+
+bool IOBuf::cut1(char* c) {
+  if (_size == 0) return false;
+  BlockRef& r = _refs[_start];
+  *c = r.block->data[r.offset];
+  ++r.offset;
+  --r.length;
+  --_size;
+  if (r.length == 0) {
+    block_dec_ref(r.block);
+    ++_start;
+    --_count;
+    if (_count == 0) _start = 0;
+  }
+  return true;
+}
+
+size_t IOBuf::pop_front(size_t n) {
+  n = std::min(n, _size);
+  size_t left = n;
+  while (left > 0) {
+    BlockRef& r = _refs[_start];
+    if (r.length <= left) {
+      left -= r.length;
+      _size -= r.length;
+      block_dec_ref(r.block);
+      ++_start;
+      --_count;
+    } else {
+      r.offset += static_cast<uint32_t>(left);
+      r.length -= static_cast<uint32_t>(left);
+      _size -= left;
+      left = 0;
+    }
+  }
+  if (_count == 0) _start = 0;
+  return n;
+}
+
+size_t IOBuf::pop_back(size_t n) {
+  n = std::min(n, _size);
+  size_t left = n;
+  while (left > 0) {
+    BlockRef& r = _refs[_start + _count - 1];
+    if (r.length <= left) {
+      left -= r.length;
+      _size -= r.length;
+      block_dec_ref(r.block);
+      --_count;
+    } else {
+      r.length -= static_cast<uint32_t>(left);
+      _size -= left;
+      left = 0;
+    }
+  }
+  if (_count == 0) _start = 0;
+  return n;
+}
+
+size_t IOBuf::copy_to(void* buf, size_t n, size_t pos) const {
+  if (pos >= _size) return 0;
+  n = std::min(n, _size - pos);
+  char* out = static_cast<char*>(buf);
+  size_t skipped = 0;
+  size_t copied = 0;
+  for (uint32_t i = 0; i < _count && copied < n; ++i) {
+    const BlockRef& r = _refs[_start + i];
+    size_t begin = 0;
+    if (skipped < pos) {
+      size_t skip = std::min<size_t>(pos - skipped, r.length);
+      skipped += skip;
+      begin = skip;
+      if (begin == r.length) continue;
+    }
+    size_t take = std::min<size_t>(r.length - begin, n - copied);
+    memcpy(out + copied, r.block->data + r.offset + begin, take);
+    copied += take;
+  }
+  return copied;
+}
+
+size_t IOBuf::copy_to(std::string* s, size_t n, size_t pos) const {
+  if (pos >= _size) {
+    s->clear();
+    return 0;
+  }
+  n = std::min(n, _size - pos);
+  s->resize(n);
+  return copy_to(s->data(), n, pos);
+}
+
+std::string IOBuf::to_string() const {
+  std::string s;
+  copy_to(&s, _size, 0);
+  return s;
+}
+
+const void* IOBuf::fetch(void* aux, size_t n) const {
+  if (n > _size) return nullptr;
+  if (_count > 0 && _refs[_start].length >= n) {
+    const BlockRef& r = _refs[_start];
+    return r.block->data + r.offset;
+  }
+  copy_to(aux, n);
+  return aux;
+}
+
+bool IOBuf::equals(std::string_view s) const {
+  if (s.size() != _size) return false;
+  size_t off = 0;
+  for (uint32_t i = 0; i < _count; ++i) {
+    const BlockRef& r = _refs[_start + i];
+    if (memcmp(s.data() + off, r.block->data + r.offset, r.length) != 0) {
+      return false;
+    }
+    off += r.length;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------- fd IO
+
+static constexpr int kMaxIov = 64;
+
+ssize_t IOBuf::cut_into_file_descriptor(int fd, size_t size_hint) {
+  if (_count == 0) return 0;
+  iovec iov[kMaxIov];
+  int niov = 0;
+  size_t total = 0;
+  for (uint32_t i = 0; i < _count && niov < kMaxIov && total < size_hint; ++i) {
+    const BlockRef& r = _refs[_start + i];
+    iov[niov].iov_base = r.block->data + r.offset;
+    iov[niov].iov_len = r.length;
+    total += r.length;
+    ++niov;
+  }
+  ssize_t nw = writev(fd, iov, niov);
+  if (nw > 0) pop_front(static_cast<size_t>(nw));
+  return nw;
+}
+
+ssize_t IOBuf::pcut_into_file_descriptor(int fd, off_t offset,
+                                         size_t size_hint) {
+  if (_count == 0) return 0;
+  iovec iov[kMaxIov];
+  int niov = 0;
+  size_t total = 0;
+  for (uint32_t i = 0; i < _count && niov < kMaxIov && total < size_hint; ++i) {
+    const BlockRef& r = _refs[_start + i];
+    iov[niov].iov_base = r.block->data + r.offset;
+    iov[niov].iov_len = r.length;
+    total += r.length;
+    ++niov;
+  }
+  ssize_t nw = pwritev(fd, iov, niov, offset);
+  if (nw > 0) pop_front(static_cast<size_t>(nw));
+  return nw;
+}
+
+ssize_t IOBuf::cut_multiple_into_file_descriptor(int fd, IOBuf* const* bufs,
+                                                 size_t nbuf) {
+  iovec iov[kMaxIov];
+  int niov = 0;
+  for (size_t bi = 0; bi < nbuf && niov < kMaxIov; ++bi) {
+    const IOBuf* b = bufs[bi];
+    for (uint32_t i = 0; i < b->_count && niov < kMaxIov; ++i) {
+      const BlockRef& r = b->_refs[b->_start + i];
+      iov[niov].iov_base = r.block->data + r.offset;
+      iov[niov].iov_len = r.length;
+      ++niov;
+    }
+  }
+  ssize_t nw = writev(fd, iov, niov);
+  if (nw > 0) {
+    size_t left = static_cast<size_t>(nw);
+    for (size_t bi = 0; bi < nbuf && left > 0; ++bi) {
+      size_t took = std::min(left, bufs[bi]->size());
+      bufs[bi]->pop_front(took);
+      left -= took;
+    }
+  }
+  return nw;
+}
+
+// ---------------------------------------------------------------- IOPortal
+
+ssize_t IOPortal::append_from_file_descriptor(int fd, size_t max_count) {
+  // readv into the shared tail block plus fresh blocks; only bytes actually
+  // read are ref'd into this buffer.
+  iovec iov[kMaxIov];
+  Block* blocks[kMaxIov];
+  int niov = 0;
+  size_t planned = 0;
+  Block* tail = share_tls_block();
+  if (tail->cap > tail->size) {
+    iov[niov].iov_base = tail->data + tail->size;
+    iov[niov].iov_len = std::min<size_t>(tail->cap - tail->size, max_count);
+    planned += iov[niov].iov_len;
+    blocks[niov] = tail;
+    ++niov;
+  }
+  while (planned < max_count && niov < 8) {
+    Block* b = create_block();
+    iov[niov].iov_base = b->data;
+    iov[niov].iov_len = std::min<size_t>(b->cap, max_count - planned);
+    planned += iov[niov].iov_len;
+    blocks[niov] = b;
+    ++niov;
+  }
+  ssize_t nr = readv(fd, iov, niov);
+  if (nr <= 0) {
+    for (int i = 0; i < niov; ++i) {
+      if (blocks[i] != tail) block_dec_ref(blocks[i]);
+    }
+    return nr;
+  }
+  size_t left = static_cast<size_t>(nr);
+  for (int i = 0; i < niov; ++i) {
+    Block* b = blocks[i];
+    if (left == 0) {
+      if (b != tail) block_dec_ref(b);
+      continue;
+    }
+    uint32_t off = (b == tail) ? b->size : 0;
+    uint32_t got = static_cast<uint32_t>(std::min<size_t>(left, iov[i].iov_len));
+    left -= got;
+    if (b == tail) {
+      BlockRef r{b, off, got};
+      block_inc_ref(b);
+      b->size += got;
+      push_back_ref(r);
+    } else {
+      b->size = got;
+      // First fresh block with room to spare becomes the new tls tail so the
+      // next read continues filling it.
+      if (got < b->cap && left == 0) {
+        BlockRef r{b, 0, got};
+        block_inc_ref(b);
+        push_back_ref(r);
+        block_dec_ref(tls_tail_block);
+        tls_tail_block = b;
+      } else {
+        push_back_ref(BlockRef{b, 0, got});  // full block: hand over our ref
+      }
+    }
+  }
+  return nr;
+}
+
+ssize_t IOPortal::pappend_from_file_descriptor(int fd, off_t offset,
+                                               size_t max_count) {
+  Block* b = create_block();
+  size_t want = std::min<size_t>(b->cap, max_count);
+  ssize_t nr = pread(fd, b->data, want, offset);
+  if (nr <= 0) {
+    block_dec_ref(b);
+    return nr;
+  }
+  b->size = static_cast<uint32_t>(nr);
+  push_back_ref(BlockRef{b, 0, static_cast<uint32_t>(nr)});
+  return nr;
+}
+
+}  // namespace tbutil

@@ -1,0 +1,79 @@
+// Minimal streaming logging + CHECK macros.
+// Capability parity: reference src/butil/logging.h (glog-like LOG(x)/CHECK
+// streams). Ours is deliberately small: severity levels, stderr sink with a
+// pluggable hook, CHECK aborts. Reference cite: butil/logging.h.
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <atomic>
+
+namespace tbutil {
+
+enum LogSeverity { LOG_TRACE = 0, LOG_DEBUG, LOG_INFO, LOG_WARNING, LOG_ERROR, LOG_FATAL };
+
+// Process-wide minimum severity actually emitted (hot-reloadable, see
+// trpc/flags.h). Default INFO.
+inline std::atomic<int> g_min_log_level{LOG_INFO};
+
+using LogSink = void (*)(int severity, const char* file, int line, const char* msg);
+inline std::atomic<LogSink> g_log_sink{nullptr};
+
+class LogMessage {
+ public:
+  LogMessage(int severity, const char* file, int line)
+      : _severity(severity), _file(file), _line(line) {}
+  ~LogMessage() {
+    const std::string s = _stream.str();
+    LogSink sink = g_log_sink.load(std::memory_order_acquire);
+    if (sink != nullptr) {
+      sink(_severity, _file, _line, s.c_str());
+    } else {
+      static const char* kNames = "TDIWEF";
+      const char* base = strrchr(_file, '/');
+      fprintf(stderr, "%c %s:%d] %s\n", kNames[_severity],
+              base ? base + 1 : _file, _line, s.c_str());
+    }
+    if (_severity == LOG_FATAL) {
+      abort();
+    }
+  }
+  std::ostringstream& stream() { return _stream; }
+
+ private:
+  int _severity;
+  const char* _file;
+  int _line;
+  std::ostringstream _stream;
+};
+
+// Swallows the stream when the level is filtered out.
+class LogVoidify {
+ public:
+  void operator&(std::ostream&) {}
+};
+
+}  // namespace tbutil
+
+#define TB_LOG_IS_ON(sev) ((sev) >= tbutil::g_min_log_level.load(std::memory_order_relaxed))
+
+#define TB_LOG(sev)                                        \
+  !TB_LOG_IS_ON(tbutil::LOG_##sev)                         \
+      ? (void)0                                            \
+      : tbutil::LogVoidify() &                             \
+            tbutil::LogMessage(tbutil::LOG_##sev, __FILE__, __LINE__).stream()
+
+#define TB_CHECK(cond)                                     \
+  (cond) ? (void)0                                         \
+         : tbutil::LogVoidify() &                          \
+               tbutil::LogMessage(tbutil::LOG_FATAL, __FILE__, __LINE__).stream() \
+                   << "Check failed: " #cond " "
+
+#define TB_CHECK_EQ(a, b) TB_CHECK((a) == (b)) << "(" << (a) << " vs " << (b) << ") "
+#define TB_CHECK_NE(a, b) TB_CHECK((a) != (b))
+#define TB_CHECK_LT(a, b) TB_CHECK((a) < (b))
+#define TB_CHECK_LE(a, b) TB_CHECK((a) <= (b))
+#define TB_CHECK_GT(a, b) TB_CHECK((a) > (b))
+#define TB_CHECK_GE(a, b) TB_CHECK((a) >= (b))

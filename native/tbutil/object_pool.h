@@ -1,0 +1,92 @@
+// Pointer-addressed freelist pool with per-thread caches.
+// Capability parity: reference src/butil/object_pool.h (backs socket
+// WriteRequests and fiber stacks). Unlike ResourcePool, objects here ARE
+// reusable raw allocations addressed by pointer; construction happens once
+// per underlying allocation and objects are handed back as-is, so types used
+// with it must tolerate reuse (or re-initialize in their getters).
+#pragma once
+
+#include <cstddef>
+#include <mutex>
+#include <vector>
+
+namespace tbutil {
+
+template <typename T>
+class ObjectPool {
+  static constexpr size_t kLocalFreeCap = 128;
+
+ public:
+  static ObjectPool* singleton() {
+    static ObjectPool pool;
+    return &pool;
+  }
+
+  T* get_object() {
+    LocalCache& lc = local_cache();
+    if (!lc.free_objs.empty()) {
+      T* p = lc.free_objs.back();
+      lc.free_objs.pop_back();
+      return p;
+    }
+    {
+      std::lock_guard<std::mutex> g(_mutex);
+      if (!_global_free.empty()) {
+        size_t take = std::min(_global_free.size(), kLocalFreeCap / 2);
+        lc.free_objs.assign(_global_free.end() - take, _global_free.end());
+        _global_free.resize(_global_free.size() - take);
+      }
+    }
+    if (!lc.free_objs.empty()) {
+      T* p = lc.free_objs.back();
+      lc.free_objs.pop_back();
+      return p;
+    }
+    return new T;
+  }
+
+  void return_object(T* p) {
+    LocalCache& lc = local_cache();
+    lc.free_objs.push_back(p);
+    if (lc.free_objs.size() > kLocalFreeCap) {
+      std::lock_guard<std::mutex> g(_mutex);
+      size_t spill = lc.free_objs.size() / 2;
+      _global_free.insert(_global_free.end(), lc.free_objs.end() - spill,
+                          lc.free_objs.end());
+      lc.free_objs.resize(lc.free_objs.size() - spill);
+    }
+  }
+
+ private:
+  struct LocalCache {
+    std::vector<T*> free_objs;
+    ObjectPool* owner = nullptr;
+    ~LocalCache() {
+      if (owner != nullptr && !free_objs.empty()) {
+        std::lock_guard<std::mutex> g(owner->_mutex);
+        owner->_global_free.insert(owner->_global_free.end(),
+                                   free_objs.begin(), free_objs.end());
+      }
+    }
+  };
+
+  LocalCache& local_cache() {
+    static thread_local LocalCache tls;
+    tls.owner = this;
+    return tls;
+  }
+
+  std::mutex _mutex;
+  std::vector<T*> _global_free;
+};
+
+template <typename T>
+inline T* get_object() {
+  return ObjectPool<T>::singleton()->get_object();
+}
+template <typename T>
+inline void return_object(T* p) {
+  ObjectPool<T>::singleton()->return_object(p);
+}
+
+}  // namespace tbutil

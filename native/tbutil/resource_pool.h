@@ -1,0 +1,168 @@
+// Lock-minimized slab allocator addressable by 32-bit ResourceId.
+// Capability parity: reference src/butil/resource_pool.h (get/return/address
+// by id; ~tens-of-ns get under contention). The 32-bit id is the foundation
+// of the versioned-reference trick used by Socket and fiber correlation ids:
+// a 64-bit handle = (32-bit pool slot | 32-bit version), and
+// address_resource(slot) is always safe because slots are never freed, only
+// recycled — see trpc/versioned_ref.h.
+//
+// Semantics (deliberately matching the reference):
+//  - T is default-constructed the first time a slot is carved out and is NOT
+//    destructed or re-constructed on return/get of a recycled slot. Objects
+//    carry persistent state (e.g. version counters) across reuses.
+//  - return_resource() only recycles the slot id.
+//  - Slots live forever; memory is never unmapped.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace tbutil {
+
+using ResourceId = uint32_t;
+inline constexpr ResourceId INVALID_RESOURCE_ID = 0xFFFFFFFFu;
+
+template <typename T>
+class ResourcePool {
+  // Geometry: blocks of 256 items, up to 1<<16 blocks => 16.7M live objects.
+  static constexpr uint32_t kItemsPerBlock = 256;
+  static constexpr uint32_t kMaxBlocks = 1u << 16;
+  // Per-thread free-list cache size before spilling to the global list.
+  static constexpr size_t kLocalFreeCap = 128;
+
+  struct Block {
+    alignas(T) unsigned char storage[kItemsPerBlock * sizeof(T)];
+    T* item(uint32_t i) { return reinterpret_cast<T*>(storage) + i; }
+  };
+
+ public:
+  static ResourcePool* singleton() {
+    static ResourcePool pool;
+    return &pool;
+  }
+
+  // Allocate a slot (possibly recycled). *id receives the slot id.
+  T* get_resource(ResourceId* id) {
+    LocalCache& lc = local_cache();
+    if (!lc.free_ids.empty()) {
+      ResourceId rid = lc.free_ids.back();
+      lc.free_ids.pop_back();
+      *id = rid;
+      return address_resource(rid);
+    }
+    // Refill from the global free list in a batch. The lock-free emptiness
+    // hint keeps the fresh-carve path (startup, connection storms) from
+    // serializing on _free_mutex when there is nothing to refill from.
+    if (_global_free_size.load(std::memory_order_relaxed) > 0) {
+      std::lock_guard<std::mutex> g(_free_mutex);
+      if (!_global_free.empty()) {
+        size_t take = std::min(_global_free.size(), kLocalFreeCap / 2);
+        lc.free_ids.assign(_global_free.end() - take, _global_free.end());
+        _global_free.resize(_global_free.size() - take);
+        _global_free_size.store(_global_free.size(),
+                                std::memory_order_relaxed);
+      }
+    }
+    if (!lc.free_ids.empty()) {
+      ResourceId rid = lc.free_ids.back();
+      lc.free_ids.pop_back();
+      *id = rid;
+      return address_resource(rid);
+    }
+    // Carve a brand-new slot.
+    ResourceId rid = _next_id.fetch_add(1, std::memory_order_relaxed);
+    uint32_t bi = rid / kItemsPerBlock;
+    if (bi >= kMaxBlocks) {
+      _next_id.fetch_sub(1, std::memory_order_relaxed);
+      *id = INVALID_RESOURCE_ID;
+      return nullptr;
+    }
+    Block* b = _blocks[bi].load(std::memory_order_acquire);
+    if (b == nullptr) {
+      std::lock_guard<std::mutex> g(_grow_mutex);
+      b = _blocks[bi].load(std::memory_order_relaxed);
+      if (b == nullptr) {
+        b = new Block;
+        _blocks[bi].store(b, std::memory_order_release);
+      }
+    }
+    T* p = b->item(rid % kItemsPerBlock);
+    new (p) T;  // constructed exactly once for the lifetime of the process
+    *id = rid;
+    return p;
+  }
+
+  void return_resource(ResourceId id) {
+    LocalCache& lc = local_cache();
+    lc.free_ids.push_back(id);
+    if (lc.free_ids.size() > kLocalFreeCap) {
+      std::lock_guard<std::mutex> g(_free_mutex);
+      size_t spill = lc.free_ids.size() / 2;
+      _global_free.insert(_global_free.end(), lc.free_ids.end() - spill,
+                          lc.free_ids.end());
+      lc.free_ids.resize(lc.free_ids.size() - spill);
+      _global_free_size.store(_global_free.size(), std::memory_order_relaxed);
+    }
+  }
+
+  // Always safe for any id < number of slots ever carved (slots are never
+  // unmapped). Returns nullptr for never-allocated ids.
+  T* address_resource(ResourceId id) {
+    uint32_t bi = id / kItemsPerBlock;
+    if (bi >= kMaxBlocks) return nullptr;
+    Block* b = _blocks[bi].load(std::memory_order_acquire);
+    if (b == nullptr) return nullptr;
+    return b->item(id % kItemsPerBlock);
+  }
+
+  // Number of slots ever carved (for introspection / tests).
+  uint32_t carved() const { return _next_id.load(std::memory_order_relaxed); }
+
+ private:
+  struct LocalCache {
+    std::vector<ResourceId> free_ids;
+    ResourcePool* owner = nullptr;
+    ~LocalCache() {
+      // Thread exit: spill everything back so ids aren't leaked.
+      if (owner != nullptr && !free_ids.empty()) {
+        std::lock_guard<std::mutex> g(owner->_free_mutex);
+        owner->_global_free.insert(owner->_global_free.end(), free_ids.begin(),
+                                   free_ids.end());
+        owner->_global_free_size.store(owner->_global_free.size(),
+                                       std::memory_order_relaxed);
+      }
+    }
+  };
+
+  LocalCache& local_cache() {
+    static thread_local LocalCache tls;
+    tls.owner = this;
+    return tls;
+  }
+
+  ResourcePool() : _blocks(kMaxBlocks) {}
+
+  std::vector<std::atomic<Block*>> _blocks;
+  std::atomic<ResourceId> _next_id{0};
+  std::mutex _grow_mutex;
+  std::mutex _free_mutex;
+  std::vector<ResourceId> _global_free;
+  std::atomic<size_t> _global_free_size{0};
+};
+
+template <typename T>
+inline T* get_resource(ResourceId* id) {
+  return ResourcePool<T>::singleton()->get_resource(id);
+}
+template <typename T>
+inline void return_resource(ResourceId id) {
+  ResourcePool<T>::singleton()->return_resource(id);
+}
+template <typename T>
+inline T* address_resource(ResourceId id) {
+  return ResourcePool<T>::singleton()->address_resource(id);
+}
+
+}  // namespace tbutil

@@ -1,0 +1,43 @@
+// Error-code + message value type. Capability parity: reference
+// src/butil/status.h (used as Controller error state).
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <string>
+
+namespace tbutil {
+
+class Status {
+ public:
+  Status() : _code(0) {}
+  Status(int code, std::string msg) : _code(code), _msg(std::move(msg)) {}
+
+  static Status OK() { return Status(); }
+
+  bool ok() const { return _code == 0; }
+  int error_code() const { return _code; }
+  const std::string& error_str() const { return _msg; }
+
+  void reset() {
+    _code = 0;
+    _msg.clear();
+  }
+
+  void set_error(int code, const char* fmt, ...)
+      __attribute__((format(printf, 3, 4))) {
+    _code = code;
+    char buf[512];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, ap);
+    va_end(ap);
+    _msg = buf;
+  }
+
+ private:
+  int _code;
+  std::string _msg;
+};
+
+}  // namespace tbutil

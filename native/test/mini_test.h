@@ -1,0 +1,78 @@
+// Tiny assert-based test harness for native tests (no gtest in the image).
+// Each native/test/test_*.cpp builds into its own binary; pytest runs them
+// via subprocess (tests/test_native.py) so `pytest tests/` covers native too.
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace mini_test {
+
+struct Case {
+  const char* name;
+  std::function<void()> fn;
+};
+
+inline std::vector<Case>& cases() {
+  static std::vector<Case> v;
+  return v;
+}
+
+struct Registrar {
+  Registrar(const char* name, std::function<void()> fn) {
+    cases().push_back({name, std::move(fn)});
+  }
+};
+
+inline int run_all(int argc, char** argv) {
+  const char* filter = argc > 1 ? argv[1] : nullptr;
+  int ran = 0;
+  for (auto& c : cases()) {
+    if (filter && strstr(c.name, filter) == nullptr) continue;
+    printf("[ RUN  ] %s\n", c.name);
+    fflush(stdout);
+    c.fn();
+    printf("[  OK  ] %s\n", c.name);
+    ++ran;
+  }
+  printf("%d test(s) passed.\n", ran);
+  return ran > 0 ? 0 : 1;
+}
+
+}  // namespace mini_test
+
+#define TEST_CASE(name)                                             \
+  static void test_fn_##name();                                     \
+  static mini_test::Registrar reg_##name(#name, test_fn_##name);    \
+  static void test_fn_##name()
+
+#define ASSERT_TRUE(c)                                                   \
+  do {                                                                   \
+    if (!(c)) {                                                          \
+      fprintf(stderr, "%s:%d: ASSERT_TRUE(%s) failed\n", __FILE__,       \
+              __LINE__, #c);                                             \
+      abort();                                                           \
+    }                                                                    \
+  } while (0)
+
+#define ASSERT_FALSE(c) ASSERT_TRUE(!(c))
+
+#define ASSERT_EQ(a, b)                                                  \
+  do {                                                                   \
+    auto va = (a);                                                       \
+    auto vb = (b);                                                       \
+    if (!(va == vb)) {                                                   \
+      fprintf(stderr, "%s:%d: ASSERT_EQ(%s, %s) failed\n", __FILE__,     \
+              __LINE__, #a, #b);                                         \
+      abort();                                                           \
+    }                                                                    \
+  } while (0)
+
+#define TEST_MAIN                                   \
+  int main(int argc, char** argv) {                 \
+    return mini_test::run_all(argc, argv);          \
+  }

@@ -1,0 +1,39 @@
+"""Runs the native C++ test binaries (assert-based, native/test/test_*.cpp).
+
+Builds the native tree on demand so `python -m pytest tests/` is the single
+entry point, mirroring how the reference's test/ drives all layers.
+"""
+
+import glob
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "native", "build")
+
+
+def _ensure_built():
+    subprocess.run(
+        ["cmake", "-S", "native", "-B", BUILD, "-G", "Ninja",
+         "-DCMAKE_BUILD_TYPE=RelWithDebInfo"],
+        cwd=REPO, check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", BUILD], cwd=REPO, check=True,
+                   capture_output=True)
+
+
+def _test_binaries():
+    _ensure_built()
+    sources = glob.glob(os.path.join(REPO, "native", "test", "test_*.cpp"))
+    return sorted(os.path.join(BUILD, os.path.splitext(os.path.basename(s))[0])
+                  for s in sources)
+
+
+@pytest.mark.parametrize("binary", _test_binaries(),
+                         ids=lambda b: os.path.basename(b))
+def test_native(binary):
+    proc = subprocess.run([binary], capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, (
+        f"{os.path.basename(binary)} failed:\n{proc.stdout}\n{proc.stderr}")
