@@ -1,0 +1,222 @@
+#include "tbthread/butex.h"
+
+#include <errno.h>
+
+#include "tbthread/sys_futex.h"
+#include "tbthread/task_control.h"
+#include "tbthread/task_group.h"
+#include "tbthread/timer_thread.h"
+#include "tbutil/time.h"
+
+namespace tbthread {
+
+namespace {
+
+inline void list_append(Butex* b, ButexWaiter* w) {
+  w->prev = b->waiters.prev;
+  w->next = &b->waiters;
+  b->waiters.prev->next = w;
+  b->waiters.prev = w;
+}
+
+inline bool list_linked(ButexWaiter* w) { return w->prev != nullptr; }
+
+inline void list_unlink(ButexWaiter* w) {
+  w->prev->next = w->next;
+  w->next->prev = w->prev;
+  w->prev = nullptr;
+  w->next = nullptr;
+}
+
+inline ButexWaiter* list_pop(Butex* b) {
+  ButexWaiter* w = b->waiters.next;
+  if (w == &b->waiters) return nullptr;
+  list_unlink(w);
+  return w;
+}
+
+// Fiber-waiter timeout path, runs on the timer pthread. The waiter node
+// lives on the waiting fiber's stack; it stays valid because the fiber
+// cannot leave butex_wait until it is unlinked AND (if this callback is
+// in flight) timer_cb_done is set — see the unschedule handshake below.
+void fiber_timeout_cb(void* wv) {
+  auto* w = static_cast<ButexWaiter*>(wv);
+  Butex* b = w->owner;
+  TaskMeta* to_wake = nullptr;
+  {
+    std::lock_guard<std::mutex> g(b->waiter_lock);
+    if (list_linked(w)) {
+      list_unlink(w);
+      w->timed_out = true;
+      to_wake = w->meta;
+    }
+  }
+  w->timer_cb_done.store(true, std::memory_order_release);
+  if (to_wake != nullptr) {
+    TaskControl::singleton()->ready_to_run_general(to_wake);
+  }
+}
+
+struct ParkArg {
+  Butex* butex;
+};
+
+// Remained callback: releases the waiter lock only after the fiber has fully
+// switched off its stack, closing the wake-before-parked race.
+void unlock_butex_after_park(void* pv) {
+  static_cast<ParkArg*>(pv)->butex->waiter_lock.unlock();
+}
+
+int wait_as_pthread(Butex* b, int expected, const timespec* abstime) {
+  ButexWaiter w;
+  w.type = ButexWaiter::PTHREAD;
+  w.owner = b;
+  {
+    std::lock_guard<std::mutex> g(b->waiter_lock);
+    if (b->value.load(std::memory_order_relaxed) != expected) {
+      errno = EWOULDBLOCK;
+      return -1;
+    }
+    list_append(b, &w);
+  }
+  bool timed_out = false;
+  while (w.pthread_wake.load(std::memory_order_acquire) == 0) {
+    timespec rel;
+    timespec* relp = nullptr;
+    if (abstime != nullptr) {
+      int64_t now_us = tbutil::gettimeofday_us();
+      int64_t dl_us =
+          abstime->tv_sec * 1000000LL + abstime->tv_nsec / 1000;
+      int64_t left = dl_us - now_us;
+      if (left <= 0) {
+        // Deadline passed: try to remove ourselves. If a waker already
+        // unlinked us, it WILL set pthread_wake — keep waiting for it so it
+        // never touches a dead node.
+        std::unique_lock<std::mutex> g(b->waiter_lock);
+        if (list_linked(&w)) {
+          list_unlink(&w);
+          timed_out = true;
+          break;
+        }
+        g.unlock();
+        abstime = nullptr;  // waker owns us now; wait for the flag
+        continue;
+      }
+      rel.tv_sec = left / 1000000;
+      rel.tv_nsec = (left % 1000000) * 1000;
+      relp = &rel;
+    }
+    futex_wait_private(&w.pthread_wake, 0, relp);
+  }
+  if (timed_out) {
+    errno = ETIMEDOUT;
+    return -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+Butex* butex_create() { return new Butex; }
+
+void butex_destroy(Butex* b) { delete b; }
+
+int butex_wait(Butex* b, int expected, const timespec* abstime) {
+  TaskGroup* g = TaskGroup::current();
+  if (g == nullptr || g->cur_meta() == nullptr) {
+    return wait_as_pthread(b, expected, abstime);
+  }
+  ButexWaiter w;
+  w.type = ButexWaiter::FIBER;
+  w.meta = g->cur_meta();
+  w.owner = b;
+
+  b->waiter_lock.lock();
+  if (b->value.load(std::memory_order_relaxed) != expected) {
+    b->waiter_lock.unlock();
+    errno = EWOULDBLOCK;
+    return -1;
+  }
+  list_append(b, &w);
+  // Arm the timeout only AFTER linking, while still holding waiter_lock: a
+  // callback firing instantly blocks on the lock until the park completes,
+  // so it always finds the waiter linked (an earlier ordering lost timeouts
+  // that fired in the schedule->link window, hanging near-deadline sleeps).
+  TimerThread::TaskId timer = TimerThread::INVALID_TASK_ID;
+  if (abstime != nullptr) {
+    int64_t dl_us = abstime->tv_sec * 1000000LL + abstime->tv_nsec / 1000;
+    timer = TimerThread::singleton()->schedule(fiber_timeout_cb, &w, dl_us);
+  }
+  ParkArg pa{b};
+  // The lock is released on the scheduler stack after the switch.
+  TaskGroup::park(unlock_butex_after_park, &pa);
+
+  // Resumed: we were unlinked by a waker or the timeout callback.
+  if (timer != TimerThread::INVALID_TASK_ID &&
+      TimerThread::singleton()->unschedule(timer) != 0) {
+    // Callback ran or is running; it dereferences w — wait it out before
+    // letting w (stack storage) die.
+    while (!w.timer_cb_done.load(std::memory_order_acquire)) {
+      std::this_thread::yield();
+    }
+  }
+  if (w.timed_out) {
+    errno = ETIMEDOUT;
+    return -1;
+  }
+  return 0;
+}
+
+static void wake_one_unlinked(ButexWaiter* w) {
+  if (w->type == ButexWaiter::FIBER) {
+    TaskControl::singleton()->ready_to_run_general(w->meta);
+  } else {
+    w->pthread_wake.store(1, std::memory_order_release);
+    futex_wake_private(&w->pthread_wake, 1);
+  }
+}
+
+int butex_wake(Butex* b) {
+  ButexWaiter* w;
+  {
+    std::lock_guard<std::mutex> g(b->waiter_lock);
+    w = list_pop(b);
+  }
+  if (w == nullptr) return 0;
+  wake_one_unlinked(w);
+  return 1;
+}
+
+int butex_wake_all(Butex* b) {
+  // Detach the whole list under one lock acquisition, wake outside it.
+  ButexWaiter* head = nullptr;
+  ButexWaiter* tail = nullptr;
+  {
+    std::lock_guard<std::mutex> g(b->waiter_lock);
+    while (ButexWaiter* w = list_pop(b)) {
+      w->next = nullptr;
+      if (tail == nullptr) {
+        head = tail = w;
+      } else {
+        tail->next = w;
+        tail = w;
+      }
+    }
+  }
+  int n = 0;
+  while (head != nullptr) {
+    ButexWaiter* w = head;
+    head = head->next;  // read before wake: w dies once its owner resumes
+    w->next = nullptr;
+    wake_one_unlinked(w);
+    ++n;
+  }
+  return n;
+}
+
+void butex_increment_and_wake_all(Butex* b) {
+  b->value.fetch_add(1, std::memory_order_release);
+  butex_wake_all(b);
+}
+
+}  // namespace tbthread

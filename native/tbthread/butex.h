@@ -1,0 +1,60 @@
+// Butex: a futex-like wait/wake word that both fibers and raw pthreads can
+// block on — the foundation of every blocking primitive in the framework
+// (join, mutex, condvar, RPC Join(), ExecutionQueue idle, Socket epollout).
+//
+// Capability parity: reference src/bthread/butex.h:41-84 (butex_create/wait/
+// wake/wake_all with mixed ButexBthreadWaiter/ButexPthreadWaiter) and the
+// race classes documented at butex.cpp:209-261. Our lost-wakeup protocol
+// differs by design: the waiter lock is held ACROSS the fiber's context
+// switch and released by the scheduler-stack "remained" callback
+// (task_group.h), so a waker can never observe a half-parked fiber.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <ctime>
+#include <mutex>
+
+namespace tbthread {
+
+struct TaskMeta;
+
+struct ButexWaiter {
+  ButexWaiter* prev = nullptr;
+  ButexWaiter* next = nullptr;
+  enum Type { FIBER, PTHREAD } type = FIBER;
+  TaskMeta* meta = nullptr;              // FIBER
+  std::atomic<int> pthread_wake{0};      // PTHREAD: 0 parked, 1 woken
+  bool timed_out = false;
+  std::atomic<bool> timer_cb_done{false};
+  struct Butex* owner = nullptr;
+};
+
+struct Butex {
+  std::atomic<int> value{0};
+  std::mutex waiter_lock;
+  ButexWaiter waiters;  // circular sentinel list
+
+  Butex() {
+    waiters.prev = &waiters;
+    waiters.next = &waiters;
+  }
+};
+
+Butex* butex_create();
+void butex_destroy(Butex* b);
+inline std::atomic<int>* butex_value(Butex* b) { return &b->value; }
+
+// Blocks the calling fiber (or pthread, off-worker) while b->value ==
+// expected. Returns 0 if woken; -1 with errno EWOULDBLOCK if the value
+// didn't match, ETIMEDOUT on deadline (abstime: gettimeofday_us clock,
+// nullptr = forever).
+int butex_wait(Butex* b, int expected, const timespec* abstime);
+
+int butex_wake(Butex* b);      // wake at most one; returns #woken
+int butex_wake_all(Butex* b);  // returns #woken
+
+// Atomically ++value then wake all (fiber-exit version bump; task_ends).
+void butex_increment_and_wake_all(Butex* b);
+
+}  // namespace tbthread

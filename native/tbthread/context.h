@@ -1,0 +1,27 @@
+// User-space stackful context switch, x86_64 SysV.
+// Capability parity: reference src/bthread/context.h:77-87
+// (bthread_make_fcontext / bthread_jump_fcontext, boost-context-derived asm).
+// Ours is an independent minimal implementation: jump saves the 6 callee-saved
+// GP registers on the current stack and swaps %rsp; make prepares a stack
+// whose first `ret` lands in a trampoline that calls fn(arg) with proper
+// 16-byte alignment. FP/SSE state is caller-saved under SysV so a function
+// call boundary needs no xmm/mxcsr/fcw spill for our (non-signal) switches.
+// ~10 instructions, no syscall.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// Saves the current context's callee-saved state on its stack, stores the
+// resulting stack pointer into *from_sp, switches to to_sp and returns `arg`
+// in the resumed context (as tb_jump_fcontext's own return value there).
+intptr_t tb_jump_fcontext(void** from_sp, void* to_sp, intptr_t arg);
+
+// Prepares a context on [stack_base, stack_base+size) that will invoke
+// fn(arg_from_first_jump) when first jumped to. fn must never return.
+// Returns the initial stack-pointer handle to pass as to_sp.
+void* tb_make_fcontext(void* stack_base, size_t size, void (*fn)(intptr_t));
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+#include "tbthread/fiber.h"
+
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#include <atomic>
+
+#include "tbthread/butex.h"
+#include "tbthread/context.h"
+#include "tbthread/task_control.h"
+#include "tbthread/task_group.h"
+#include "tbthread/timer_thread.h"
+#include "tbutil/time.h"
+
+namespace tbthread {
+
+static std::atomic<int> g_requested_concurrency{0};
+static std::atomic<bool> g_scheduler_started{false};
+
+int fiber_set_concurrency(int n) {
+  if (n <= 0 || n > 256) return EINVAL;
+  if (g_scheduler_started.load(std::memory_order_acquire)) return EPERM;
+  g_requested_concurrency.store(n, std::memory_order_release);
+  return 0;
+}
+
+namespace {
+TaskControl* control();
+}
+
+int fiber_get_concurrency() {
+  // Must go through control() so a prior fiber_set_concurrency takes effect
+  // even when this is the first scheduler touch.
+  return control()->concurrency();
+}
+
+namespace {
+TaskControl* control() {
+  // First use locks in the concurrency (fiber_set_concurrency is plumbed via
+  // the env var TaskControl::singleton reads).
+  if (!g_scheduler_started.exchange(true, std::memory_order_acq_rel)) {
+    int req = g_requested_concurrency.load(std::memory_order_acquire);
+    if (req > 0) {
+      char buf[16];
+      snprintf(buf, sizeof(buf), "%d", req);
+      setenv("TB_FIBER_CONCURRENCY", buf, 1);
+    }
+  }
+  return TaskControl::singleton();
+}
+
+int start_fiber(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
+                void* arg, bool urgent) {
+  TaskControl* c = control();
+  tbutil::ResourceId slot;
+  TaskMeta* m = tbutil::get_resource<TaskMeta>(&slot);
+  if (m == nullptr) return ENOMEM;
+  if (m->version_butex == nullptr) {
+    m->version_butex = butex_create();
+    m->version_butex->value.store(1, std::memory_order_relaxed);
+  }
+  m->slot = slot;
+  m->fn = fn;
+  m->arg = arg;
+  m->attr = attr != nullptr ? *attr : FiberAttr{};
+  m->key_table = nullptr;
+  m->stack = get_stack(m->attr.stack_type);
+  if (m->stack == nullptr) {
+    tbutil::return_resource<TaskMeta>(slot);
+    return ENOMEM;
+  }
+  m->ctx_sp = tb_make_fcontext(m->stack->stack_base, m->stack->stack_size,
+                               TaskGroup::task_entry);
+  uint32_t version = static_cast<uint32_t>(
+      m->version_butex->value.load(std::memory_order_relaxed));
+  if (tid != nullptr) *tid = make_tid(slot, version);
+  c->ready_to_run_general(m);
+  (void)urgent;
+  return 0;
+}
+}  // namespace
+
+int fiber_start_background(fiber_t* tid, const FiberAttr* attr,
+                           void* (*fn)(void*), void* arg) {
+  return start_fiber(tid, attr, fn, arg, false);
+}
+
+int fiber_start_urgent(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
+                       void* arg) {
+  return start_fiber(tid, attr, fn, arg, true);
+}
+
+int fiber_join(fiber_t tid, void** result) {
+  if (result != nullptr) *result = nullptr;
+  if (tid == INVALID_FIBER) return EINVAL;
+  if (tid == fiber_self()) return EINVAL;
+  TaskMeta* m = tbutil::address_resource<TaskMeta>(tid_slot(tid));
+  if (m == nullptr || m->version_butex == nullptr) return 0;  // long gone
+  Butex* b = m->version_butex;
+  const int expected = static_cast<int>(tid_version(tid));
+  while (b->value.load(std::memory_order_acquire) == expected) {
+    butex_wait(b, expected, nullptr);
+  }
+  return 0;
+}
+
+bool fiber_exists(fiber_t tid) {
+  if (tid == INVALID_FIBER) return false;
+  TaskMeta* m = tbutil::address_resource<TaskMeta>(tid_slot(tid));
+  if (m == nullptr || m->version_butex == nullptr) return false;
+  return m->version_butex->value.load(std::memory_order_acquire) ==
+         static_cast<int>(tid_version(tid));
+}
+
+fiber_t fiber_self() {
+  TaskGroup* g = TaskGroup::current();
+  return g != nullptr ? g->cur_tid() : INVALID_FIBER;
+}
+
+void fiber_yield() { TaskGroup::yield(); }
+
+int fiber_usleep(uint64_t us) {
+  TaskGroup* g = TaskGroup::current();
+  if (g == nullptr || g->cur_meta() == nullptr) {
+    timespec ts{static_cast<time_t>(us / 1000000),
+                static_cast<long>((us % 1000000) * 1000)};
+    nanosleep(&ts, nullptr);
+    return 0;
+  }
+  // Park on a never-signaled stack butex with a deadline.
+  Butex b;
+  int64_t dl = tbutil::gettimeofday_us() + static_cast<int64_t>(us);
+  timespec abst{static_cast<time_t>(dl / 1000000),
+                static_cast<long>((dl % 1000000) * 1000)};
+  butex_wait(&b, 0, &abst);  // returns ETIMEDOUT at deadline
+  return 0;
+}
+
+void fiber_stop_world() { TaskControl::singleton()->stop_and_join(); }
+
+}  // namespace tbthread

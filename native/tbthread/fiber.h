@@ -1,0 +1,34 @@
+// Public fiber API — the bthread.h equivalent.
+// Capability parity: reference src/bthread/bthread.h (bthread_start_urgent/
+// background, join, yield, usleep, attrs, concurrency).
+#pragma once
+
+#include "tbthread/task_meta.h"
+
+namespace tbthread {
+
+// Start a fiber running fn(arg). `urgent` hints latency-sensitive work
+// (request processing); both currently enqueue + signal. Returns 0 or errno.
+int fiber_start_background(fiber_t* tid, const FiberAttr* attr,
+                           void* (*fn)(void*), void* arg);
+int fiber_start_urgent(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
+                       void* arg);
+
+// Wait until the fiber ends. Safe against id reuse (versioned ids). Works
+// from fibers and plain pthreads.
+int fiber_join(fiber_t tid, void** result);
+
+bool fiber_exists(fiber_t tid);
+fiber_t fiber_self();  // INVALID_FIBER off-fiber
+void fiber_yield();
+int fiber_usleep(uint64_t us);  // parks the fiber; nanosleep off-fiber
+
+int fiber_get_concurrency();
+// Must be called before the scheduler starts (i.e. before any fiber API use);
+// otherwise returns EPERM.
+int fiber_set_concurrency(int n);
+
+// Test/shutdown hook: stops all workers. Irreversible within the process.
+void fiber_stop_world();
+
+}  // namespace tbthread

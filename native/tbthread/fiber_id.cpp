@@ -1,0 +1,200 @@
+#include "tbthread/fiber_id.h"
+
+#include <errno.h>
+
+#include <deque>
+#include <mutex>
+
+#include "tbthread/butex.h"
+#include "tbutil/resource_pool.h"
+
+namespace tbthread {
+
+namespace {
+
+struct IdInfo {
+  std::mutex small;  // guards all fields; never held across callbacks/parks
+  Butex* lock_btx = nullptr;  // value bumps on every release (wait token)
+  Butex* join_btx = nullptr;  // value bumps on destroy
+  uint32_t first_ver = 0;     // valid range [first_ver, last_ver); empty=dead
+  uint32_t last_ver = 0;
+  uint32_t next_ver = 1;      // per-slot monotonic version allocator
+  bool locked = false;
+  void* data = nullptr;
+  IdErrorFn on_error = nullptr;
+  std::deque<int> pending;  // errors queued while locked
+};
+
+inline fiber_id_t make_id(tbutil::ResourceId slot, uint32_t version) {
+  return ((static_cast<uint64_t>(slot) + 1) << 32) | version;
+}
+inline tbutil::ResourceId id_slot(fiber_id_t id) {
+  return static_cast<tbutil::ResourceId>((id >> 32) - 1);
+}
+inline uint32_t id_version(fiber_id_t id) { return static_cast<uint32_t>(id); }
+
+IdInfo* resolve(fiber_id_t id) {
+  if (id == INVALID_FIBER_ID) return nullptr;
+  return tbutil::address_resource<IdInfo>(id_slot(id));
+}
+
+inline bool valid_version(const IdInfo* info, uint32_t v) {
+  return v >= info->first_ver && v < info->last_ver;
+}
+
+}  // namespace
+
+int fiber_id_create_ranged(fiber_id_t* id, void* data, IdErrorFn on_error,
+                           int range) {
+  if (range < 1) return EINVAL;
+  tbutil::ResourceId slot;
+  IdInfo* info = tbutil::get_resource<IdInfo>(&slot);
+  if (info == nullptr) return ENOMEM;
+  std::lock_guard<std::mutex> g(info->small);
+  if (info->lock_btx == nullptr) {
+    info->lock_btx = butex_create();
+    info->join_btx = butex_create();
+  }
+  info->first_ver = info->next_ver;
+  info->last_ver = info->first_ver + static_cast<uint32_t>(range);
+  info->next_ver = info->last_ver;
+  info->locked = false;
+  info->data = data;
+  info->on_error = on_error;
+  info->pending.clear();
+  *id = make_id(slot, info->first_ver);
+  return 0;
+}
+
+int fiber_id_create(fiber_id_t* id, void* data, IdErrorFn on_error) {
+  return fiber_id_create_ranged(id, data, on_error, 1);
+}
+
+static int lock_impl(fiber_id_t id, void** pdata, bool try_only) {
+  IdInfo* info = resolve(id);
+  if (info == nullptr) return EINVAL;
+  std::unique_lock<std::mutex> lk(info->small);
+  if (!valid_version(info, id_version(id))) return EINVAL;
+  while (info->locked) {
+    if (try_only) return EBUSY;
+    const int seq = info->lock_btx->value.load(std::memory_order_relaxed);
+    lk.unlock();
+    butex_wait(info->lock_btx, seq, nullptr);
+    lk.lock();
+    if (!valid_version(info, id_version(id))) return EINVAL;
+  }
+  info->locked = true;
+  if (pdata != nullptr) *pdata = info->data;
+  return 0;
+}
+
+int fiber_id_lock(fiber_id_t id, void** pdata) {
+  return lock_impl(id, pdata, false);
+}
+
+int fiber_id_trylock(fiber_id_t id, void** pdata) {
+  return lock_impl(id, pdata, true);
+}
+
+int fiber_id_lock_and_reset_range(fiber_id_t id, void** pdata, int range) {
+  int rc = fiber_id_lock(id, pdata);
+  if (rc != 0) return rc;
+  IdInfo* info = resolve(id);
+  std::lock_guard<std::mutex> g(info->small);
+  // Keep the base version, extend the window.
+  info->last_ver = info->first_ver + static_cast<uint32_t>(range);
+  if (info->next_ver < info->last_ver) info->next_ver = info->last_ver;
+  return 0;
+}
+
+int fiber_id_unlock(fiber_id_t id) {
+  IdInfo* info = resolve(id);
+  if (info == nullptr) return EINVAL;
+  int err = 0;
+  IdErrorFn on_error = nullptr;
+  void* data = nullptr;
+  {
+    std::lock_guard<std::mutex> g(info->small);
+    if (!valid_version(info, id_version(id))) return EINVAL;
+    if (!info->locked) return EPERM;
+    if (!info->pending.empty()) {
+      err = info->pending.front();
+      info->pending.pop_front();
+      on_error = info->on_error;
+      data = info->data;
+      // Stay locked: on_error owns the lock now.
+    } else {
+      info->locked = false;
+      info->lock_btx->value.fetch_add(1, std::memory_order_release);
+    }
+  }
+  if (on_error != nullptr) {
+    return on_error(make_id(id_slot(id), info->first_ver), data, err);
+  }
+  butex_wake(info->lock_btx);
+  return 0;
+}
+
+int fiber_id_unlock_and_destroy(fiber_id_t id) {
+  IdInfo* info = resolve(id);
+  if (info == nullptr) return EINVAL;
+  {
+    std::lock_guard<std::mutex> g(info->small);
+    if (!valid_version(info, id_version(id))) return EINVAL;
+    if (!info->locked) return EPERM;
+    info->first_ver = info->last_ver;  // empty range = destroyed
+    info->locked = false;
+    info->pending.clear();
+    info->lock_btx->value.fetch_add(1, std::memory_order_release);
+    info->join_btx->value.fetch_add(1, std::memory_order_release);
+  }
+  butex_wake_all(info->lock_btx);
+  butex_wake_all(info->join_btx);
+  tbutil::return_resource<IdInfo>(id_slot(id));
+  return 0;
+}
+
+int fiber_id_error(fiber_id_t id, int error) {
+  IdInfo* info = resolve(id);
+  if (info == nullptr) return EINVAL;
+  IdErrorFn on_error = nullptr;
+  void* data = nullptr;
+  {
+    std::lock_guard<std::mutex> g(info->small);
+    if (!valid_version(info, id_version(id))) return EINVAL;
+    if (info->locked) {
+      info->pending.push_back(error);
+      return 0;
+    }
+    info->locked = true;
+    on_error = info->on_error;
+    data = info->data;
+  }
+  if (on_error == nullptr) {
+    return fiber_id_unlock_and_destroy(make_id(id_slot(id), id_version(id)));
+  }
+  return on_error(make_id(id_slot(id), info->first_ver), data, error);
+}
+
+int fiber_id_join(fiber_id_t id) {
+  IdInfo* info = resolve(id);
+  if (info == nullptr) return EINVAL;
+  while (true) {
+    int jv;
+    {
+      std::lock_guard<std::mutex> g(info->small);
+      if (!valid_version(info, id_version(id))) return 0;  // destroyed
+      jv = info->join_btx->value.load(std::memory_order_relaxed);
+    }
+    butex_wait(info->join_btx, jv, nullptr);
+  }
+}
+
+bool fiber_id_exists(fiber_id_t id) {
+  IdInfo* info = resolve(id);
+  if (info == nullptr) return false;
+  std::lock_guard<std::mutex> g(info->small);
+  return valid_version(info, id_version(id));
+}
+
+}  // namespace tbthread

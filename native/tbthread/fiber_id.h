@@ -1,0 +1,52 @@
+// Lockable, versioned 64-bit handles correlating in-flight RPCs with their
+// responses/timeouts/errors. One id covers a RANGE of versions so an RPC
+// with N retries owns N+2 correlated versions that all resolve to the same
+// handle but can be told apart (stale responses are rejected by version).
+//
+// Capability parity: reference src/bthread/id.h:46-84 (bthread_id_create[
+// _ranged], lock/unlock/join, bthread_id_error with pending-error queueing,
+// unlock_and_destroy, lock_and_reset_range).
+//
+// Semantics:
+//  - create(&id, data, on_error): id valid until unlock_and_destroy.
+//  - lock(id): fiber-aware mutual exclusion; EINVAL once destroyed.
+//  - error(id, err): if unlocked, locks and runs on_error(id, data, err)
+//    inline (on_error must unlock or destroy); if locked, queues err —
+//    unlock pops one queued error and re-runs on_error instead of releasing.
+//  - join(id): parks until destroyed; reuse-safe (versions are monotonic
+//    per slot).
+#pragma once
+
+#include <cstdint>
+
+namespace tbthread {
+
+using fiber_id_t = uint64_t;
+inline constexpr fiber_id_t INVALID_FIBER_ID = 0;
+
+// on_error returns 0 normally; it is responsible for unlocking/destroying.
+using IdErrorFn = int (*)(fiber_id_t id, void* data, int error);
+
+int fiber_id_create(fiber_id_t* id, void* data, IdErrorFn on_error);
+// Valid version range of size `range` (>=1): retries use distinct versions.
+int fiber_id_create_ranged(fiber_id_t* id, void* data, IdErrorFn on_error,
+                           int range);
+
+int fiber_id_lock(fiber_id_t id, void** pdata);
+int fiber_id_trylock(fiber_id_t id, void** pdata);
+// Re-arm the version range (next call cycle) while holding the lock.
+int fiber_id_lock_and_reset_range(fiber_id_t id, void** pdata, int range);
+int fiber_id_unlock(fiber_id_t id);
+int fiber_id_unlock_and_destroy(fiber_id_t id);
+int fiber_id_error(fiber_id_t id, int error);
+int fiber_id_join(fiber_id_t id);
+
+bool fiber_id_exists(fiber_id_t id);
+
+// The id value a retry attempt puts on the wire: base id + 1 + nretry, same
+// slot. Resolves to the same handle; lets the response path detect staleness.
+inline fiber_id_t fiber_id_for_attempt(fiber_id_t base, int nretry) {
+  return base + 1 + static_cast<fiber_id_t>(nretry);
+}
+
+}  // namespace tbthread

@@ -1,0 +1,104 @@
+#include "tbthread/key.h"
+
+#include <mutex>
+#include <vector>
+
+#include "tbthread/task_group.h"
+
+namespace tbthread {
+
+namespace {
+struct KeyInfo {
+  uint32_t version = 0;  // bumped on delete; odd = live
+  void (*dtor)(void*) = nullptr;
+};
+
+std::mutex g_key_mutex;
+std::vector<KeyInfo> g_keys;
+}  // namespace
+
+struct KeyTable {
+  struct Slot {
+    uint32_t version = 0;
+    void* data = nullptr;
+  };
+  std::vector<Slot> slots;
+};
+
+int fiber_key_create(FiberKey* key, void (*dtor)(void*)) {
+  std::lock_guard<std::mutex> g(g_key_mutex);
+  // Reuse a deleted index if any (even version = dead).
+  for (uint32_t i = 0; i < g_keys.size(); ++i) {
+    if ((g_keys[i].version & 1) == 0) {
+      g_keys[i].version += 1;  // now odd = live
+      g_keys[i].dtor = dtor;
+      key->index = i;
+      key->version = g_keys[i].version;
+      return 0;
+    }
+  }
+  g_keys.push_back(KeyInfo{1, dtor});
+  key->index = static_cast<uint32_t>(g_keys.size() - 1);
+  key->version = 1;
+  return 0;
+}
+
+int fiber_key_delete(FiberKey key) {
+  std::lock_guard<std::mutex> g(g_key_mutex);
+  if (key.index >= g_keys.size() || g_keys[key.index].version != key.version) {
+    return -1;
+  }
+  g_keys[key.index].version += 1;  // even = dead
+  g_keys[key.index].dtor = nullptr;
+  return 0;
+}
+
+static KeyTable*& current_table_slot() {
+  TaskGroup* g = TaskGroup::current();
+  if (g != nullptr && g->cur_meta() != nullptr) {
+    return g->cur_meta()->key_table;
+  }
+  static thread_local KeyTable* tls_table = nullptr;
+  return tls_table;
+}
+
+int fiber_setspecific(FiberKey key, void* data) {
+  {
+    std::lock_guard<std::mutex> g(g_key_mutex);
+    if (key.index >= g_keys.size() ||
+        g_keys[key.index].version != key.version) {
+      return -1;
+    }
+  }
+  KeyTable*& kt = current_table_slot();
+  if (kt == nullptr) kt = new KeyTable;
+  if (kt->slots.size() <= key.index) kt->slots.resize(key.index + 1);
+  kt->slots[key.index] = {key.version, data};
+  return 0;
+}
+
+void* fiber_getspecific(FiberKey key) {
+  KeyTable* kt = current_table_slot();
+  if (kt == nullptr || kt->slots.size() <= key.index) return nullptr;
+  const KeyTable::Slot& s = kt->slots[key.index];
+  return s.version == key.version ? s.data : nullptr;
+}
+
+void destroy_key_table(KeyTable* kt) {
+  if (kt == nullptr) return;
+  for (uint32_t i = 0; i < kt->slots.size(); ++i) {
+    KeyTable::Slot& s = kt->slots[i];
+    if (s.data == nullptr) continue;
+    void (*dtor)(void*) = nullptr;
+    {
+      std::lock_guard<std::mutex> g(g_key_mutex);
+      if (i < g_keys.size() && g_keys[i].version == s.version) {
+        dtor = g_keys[i].dtor;
+      }
+    }
+    if (dtor != nullptr) dtor(s.data);
+  }
+  delete kt;
+}
+
+}  // namespace tbthread

@@ -1,0 +1,26 @@
+// Fiber-local storage. Capability parity: reference src/bthread/key.cpp
+// (bthread_key_create/delete, get/setspecific; works from both fibers and
+// plain pthreads — pthread callers get a thread-local table).
+#pragma once
+
+#include <cstdint>
+
+namespace tbthread {
+
+struct FiberKey {
+  uint32_t index = 0;
+  uint32_t version = 0;
+};
+
+struct KeyTable;  // opaque
+
+int fiber_key_create(FiberKey* key, void (*dtor)(void*));
+// Existing values stop being returned; dtors no longer run for this key.
+int fiber_key_delete(FiberKey key);
+int fiber_setspecific(FiberKey key, void* data);
+void* fiber_getspecific(FiberKey key);
+
+// Internal: destroy a fiber's table (runs dtors). Called by task_ends.
+void destroy_key_table(KeyTable* kt);
+
+}  // namespace tbthread

@@ -1,0 +1,52 @@
+// Futex-based sleep/wake for idle worker threads.
+// Capability parity: reference src/bthread/parking_lot.h:52 — workers read
+// the lot state before searching for work, then park on that state; a missed
+// signal between the read and the park is caught because signal() bumps the
+// counter, making the parked-on value stale.
+#pragma once
+
+#include <atomic>
+
+#include "tbthread/sys_futex.h"
+
+namespace tbthread {
+
+class ParkingLot {
+ public:
+  class State {
+   public:
+    State() : _value(0) {}
+    bool stopped() const { return _value & 1; }
+
+   private:
+    friend class ParkingLot;
+    explicit State(int v) : _value(v) {}
+    int _value;
+  };
+
+  // Wake up to `num_task` waiters (every new task signals once).
+  void signal(int num_task) {
+    _pending_signal.fetch_add((num_task << 1), std::memory_order_release);
+    futex_wake_private(&_pending_signal, num_task);
+  }
+
+  State get_state() {
+    return State(_pending_signal.load(std::memory_order_acquire));
+  }
+
+  // Park until the lot's state changes from `expected`.
+  void wait(const State& expected) {
+    futex_wait_private(&_pending_signal, expected._value, nullptr);
+  }
+
+  void stop() {
+    _pending_signal.fetch_or(1, std::memory_order_release);
+    futex_wake_private(&_pending_signal, 1 << 30);
+  }
+
+ private:
+  // Bit 0: stopped flag. Upper bits: signal counter.
+  std::atomic<int> _pending_signal{0};
+};
+
+}  // namespace tbthread

@@ -1,0 +1,66 @@
+#include "tbthread/stack.h"
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <mutex>
+
+#include "tbutil/logging.h"
+
+namespace tbthread {
+
+size_t stack_size_of(int type) {
+  switch (type) {
+    case STACK_TYPE_SMALL:
+      return 32 * 1024;
+    case STACK_TYPE_LARGE:
+      return 8 * 1024 * 1024;
+    case STACK_TYPE_NORMAL:
+    default:
+      return 1024 * 1024;
+  }
+}
+
+namespace {
+struct StackPool {
+  std::mutex mutex;
+  StackContainer* free_list = nullptr;
+};
+StackPool g_pools[3];
+}  // namespace
+
+StackContainer* get_stack(int type) {
+  StackPool& pool = g_pools[type];
+  {
+    std::lock_guard<std::mutex> g(pool.mutex);
+    if (pool.free_list != nullptr) {
+      StackContainer* sc = pool.free_list;
+      pool.free_list = sc->next;
+      sc->next = nullptr;
+      return sc;
+    }
+  }
+  const size_t page = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+  const size_t size = stack_size_of(type);
+  void* base = mmap(nullptr, size + page, PROT_READ | PROT_WRITE,
+                    MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+  if (base == MAP_FAILED) return nullptr;
+  // Low page is the guard (stacks grow down toward it).
+  mprotect(base, page, PROT_NONE);
+  auto* sc = new StackContainer;
+  sc->base = base;
+  sc->stack_base = static_cast<char*>(base) + page;
+  sc->stack_size = size;
+  sc->type = type;
+  return sc;
+}
+
+void return_stack(StackContainer* sc) {
+  if (sc == nullptr) return;
+  StackPool& pool = g_pools[sc->type];
+  std::lock_guard<std::mutex> g(pool.mutex);
+  sc->next = pool.free_list;
+  pool.free_list = sc;
+}
+
+}  // namespace tbthread

@@ -1,0 +1,30 @@
+// Pooled mmap'd fiber stacks with guard pages.
+// Capability parity: reference src/bthread/stack.h:56-75 (SMALL/NORMAL/LARGE
+// stack classes pooled via ObjectPool, guard pages, get_stack/return_stack).
+#pragma once
+
+#include <cstddef>
+
+namespace tbthread {
+
+enum StackType {
+  STACK_TYPE_SMALL = 0,   // 32 KB
+  STACK_TYPE_NORMAL = 1,  // 1 MB (default)
+  STACK_TYPE_LARGE = 2,   // 8 MB
+};
+
+struct StackContainer {
+  void* base = nullptr;    // lowest mapped address (guard page)
+  void* stack_base = nullptr;  // usable range start
+  size_t stack_size = 0;
+  int type = STACK_TYPE_NORMAL;
+  StackContainer* next = nullptr;  // freelist linkage
+};
+
+size_t stack_size_of(int type);
+
+// Returns a pooled or freshly mmap'd stack; nullptr on mmap failure.
+StackContainer* get_stack(int type);
+void return_stack(StackContainer* sc);
+
+}  // namespace tbthread

@@ -1,0 +1,140 @@
+// Fiber-aware synchronization primitives built on butex: mutex, condition
+// variable, countdown event, semaphore. All of them block the calling FIBER
+// (the worker pthread keeps running other fibers) and also work from plain
+// pthreads (which block on a futex waiter).
+// Capability parity: reference src/bthread/{mutex,condition_variable,
+// countdown_event,semaphore}.cpp. Contention profiling hooks (mutex.cpp:122)
+// come with tbvar integration later.
+#pragma once
+
+#include <cerrno>
+#include <cstdint>
+
+#include "tbthread/butex.h"
+
+namespace tbthread {
+
+class FiberMutex {
+ public:
+  FiberMutex() : _b(butex_create()) {}
+  ~FiberMutex() { butex_destroy(_b); }
+  FiberMutex(const FiberMutex&) = delete;
+  FiberMutex& operator=(const FiberMutex&) = delete;
+
+  void lock() {
+    // 0 free, 1 locked no waiters, 2 locked with waiters.
+    int expected = 0;
+    if (_b->value.compare_exchange_strong(expected, 1,
+                                          std::memory_order_acquire,
+                                          std::memory_order_relaxed)) {
+      return;
+    }
+    do {
+      if (expected == 2 ||
+          _b->value.exchange(2, std::memory_order_acquire) != 0) {
+        butex_wait(_b, 2, nullptr);
+      }
+      expected = 0;
+    } while (!_b->value.compare_exchange_strong(expected, 2,
+                                                std::memory_order_acquire,
+                                                std::memory_order_relaxed));
+  }
+
+  bool try_lock() {
+    int expected = 0;
+    return _b->value.compare_exchange_strong(expected, 1,
+                                             std::memory_order_acquire,
+                                             std::memory_order_relaxed);
+  }
+
+  void unlock() {
+    if (_b->value.exchange(0, std::memory_order_release) == 2) {
+      butex_wake(_b);
+    }
+  }
+
+  Butex* internal_butex() { return _b; }
+
+ private:
+  Butex* _b;
+};
+
+class FiberCond {
+ public:
+  FiberCond() : _b(butex_create()) {}
+  ~FiberCond() { butex_destroy(_b); }
+  FiberCond(const FiberCond&) = delete;
+  FiberCond& operator=(const FiberCond&) = delete;
+
+  // mutex must be held; released while waiting, re-acquired before return.
+  void wait(FiberMutex& m) {
+    const int seq = _b->value.load(std::memory_order_relaxed);
+    m.unlock();
+    butex_wait(_b, seq, nullptr);
+    m.lock();
+  }
+
+  // Returns false on timeout (abstime on the gettimeofday_us clock).
+  bool wait_until(FiberMutex& m, const timespec& abstime) {
+    const int seq = _b->value.load(std::memory_order_relaxed);
+    m.unlock();
+    int rc = butex_wait(_b, seq, &abstime);
+    m.lock();
+    return !(rc != 0 && errno == ETIMEDOUT);
+  }
+
+  void notify_one() {
+    _b->value.fetch_add(1, std::memory_order_release);
+    butex_wake(_b);
+  }
+
+  void notify_all() {
+    _b->value.fetch_add(1, std::memory_order_release);
+    butex_wake_all(_b);
+  }
+
+ private:
+  Butex* _b;
+};
+
+// One-shot countdown: wait() blocks until the count reaches zero.
+// (reference countdown_event.cpp — used heavily by tests and ParallelChannel)
+class CountdownEvent {
+ public:
+  explicit CountdownEvent(int initial = 1) : _b(butex_create()) {
+    _b->value.store(initial, std::memory_order_relaxed);
+  }
+  ~CountdownEvent() { butex_destroy(_b); }
+
+  void signal(int by = 1) {
+    int prev = _b->value.fetch_sub(by, std::memory_order_acq_rel);
+    if (prev - by <= 0) butex_wake_all(_b);
+  }
+
+  void add_count(int by = 1) {
+    _b->value.fetch_add(by, std::memory_order_release);
+  }
+
+  void wait() {
+    int v;
+    while ((v = _b->value.load(std::memory_order_acquire)) > 0) {
+      butex_wait(_b, v, nullptr);
+    }
+  }
+
+  // false on timeout.
+  bool timed_wait(const timespec& abstime) {
+    int v;
+    while ((v = _b->value.load(std::memory_order_acquire)) > 0) {
+      if (butex_wait(_b, v, &abstime) != 0 && errno == ETIMEDOUT) {
+        return false;
+      }
+    }
+    return true;
+  }
+
+ private:
+  Butex* _b;
+};
+
+}  // namespace tbthread

@@ -1,0 +1,27 @@
+// Thin futex(2) wrappers. Capability parity: reference
+// src/bthread/sys_futex.h (ParkingLot sleep/wake, butex pthread waiters).
+#pragma once
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+
+namespace tbthread {
+
+inline long futex_wait_private(std::atomic<int>* addr, int expected,
+                               const timespec* timeout) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr),
+                 FUTEX_WAIT | FUTEX_PRIVATE_FLAG, expected, timeout, nullptr,
+                 0);
+}
+
+inline long futex_wake_private(std::atomic<int>* addr, int nwake) {
+  return syscall(SYS_futex, reinterpret_cast<int*>(addr),
+                 FUTEX_WAKE | FUTEX_PRIVATE_FLAG, nwake, nullptr, nullptr, 0);
+}
+
+}  // namespace tbthread

@@ -1,0 +1,83 @@
+#include "tbthread/task_control.h"
+
+#include <stdlib.h>
+
+#include <mutex>
+
+#include "tbthread/task_group.h"
+#include "tbutil/fast_rand.h"
+#include "tbutil/logging.h"
+
+namespace tbthread {
+
+int TaskControl::default_concurrency() {
+  const char* env = getenv("TB_FIBER_CONCURRENCY");
+  if (env != nullptr) {
+    int n = atoi(env);
+    if (n > 0 && n <= 256) return n;
+  }
+  return 4;
+}
+
+TaskControl* TaskControl::singleton() {
+  static TaskControl* c = []() {
+    auto* control = new TaskControl;
+    TB_CHECK_EQ(control->init(default_concurrency()), 0);
+    return control;
+  }();
+  return c;
+}
+
+int TaskControl::init(int concurrency) {
+  if (concurrency <= 0) return -1;
+  _groups.reserve(concurrency);
+  for (int i = 0; i < concurrency; ++i) {
+    _groups.push_back(new TaskGroup(this));
+  }
+  for (int i = 0; i < concurrency; ++i) {
+    TaskGroup* g = _groups[i];
+    _workers.emplace_back([g]() { g->run_main_task(); });
+  }
+  return 0;
+}
+
+void TaskControl::stop_and_join() {
+  _stopped.store(true, std::memory_order_release);
+  _pl.stop();
+  for (auto& w : _workers) {
+    if (w.joinable()) w.join();
+  }
+  _workers.clear();
+}
+
+TaskGroup* TaskControl::choose_one_group() {
+  uint32_t r = _round.fetch_add(1, std::memory_order_relaxed);
+  return _groups[r % _groups.size()];
+}
+
+void TaskControl::ready_to_run_general(TaskMeta* m, bool signal) {
+  TaskGroup* g = TaskGroup::current();
+  if (g != nullptr && g->control() == this) {
+    g->ready_to_run(m, signal);
+  } else {
+    choose_one_group()->push_remote(m, signal);
+  }
+}
+
+bool TaskControl::steal_task(TaskMeta** m, TaskGroup* thief, uint64_t* seed) {
+  const size_t n = _groups.size();
+  if (n <= 1) return false;
+  // Random start, then sweep — per-thief seed decorrelates victims.
+  size_t start = static_cast<size_t>((*seed = *seed * 6364136223846793005ULL +
+                                              1442695040888963407ULL) >>
+                                     33) %
+                 n;
+  for (size_t i = 0; i < n; ++i) {
+    TaskGroup* victim = _groups[(start + i) % n];
+    if (victim == thief) continue;
+    if (victim->steal_from(m)) return true;
+  }
+  return false;
+}
+
+}  // namespace tbthread

@@ -1,0 +1,50 @@
+// Global scheduler: owns the worker pthreads and their TaskGroups, routes
+// cross-thread wakeups, steals between groups, parks idle workers.
+// Capability parity: reference src/bthread/task_control.h (steal_task :64,
+// signal_task :67, worker_thread :128). Worker tags (per-tag groups for
+// pinning, task_control.h:61) are planned for the TPU feeder-core split;
+// single tag for now.
+#pragma once
+
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "tbthread/parking_lot.h"
+#include "tbthread/task_meta.h"
+
+namespace tbthread {
+
+class TaskGroup;
+
+class TaskControl {
+ public:
+  // Lazily initialized on first use with `default_concurrency()` workers
+  // (TB_FIBER_CONCURRENCY env var, else 4).
+  static TaskControl* singleton();
+  static int default_concurrency();
+
+  int init(int concurrency);
+  void stop_and_join();
+  bool stopped() const { return _stopped.load(std::memory_order_acquire); }
+
+  int concurrency() const { return static_cast<int>(_groups.size()); }
+
+  // Make a fiber runnable from any thread (worker or not).
+  void ready_to_run_general(TaskMeta* m, bool signal = true);
+
+  bool steal_task(TaskMeta** m, TaskGroup* thief, uint64_t* seed);
+  void signal_task(int num) { _pl.signal(num); }
+  ParkingLot* parking_lot() { return &_pl; }
+
+ private:
+  TaskGroup* choose_one_group();
+
+  std::vector<TaskGroup*> _groups;
+  std::vector<std::thread> _workers;
+  ParkingLot _pl;
+  std::atomic<bool> _stopped{false};
+  std::atomic<uint32_t> _round{0};
+};
+
+}  // namespace tbthread

@@ -1,0 +1,157 @@
+#include "tbthread/task_group.h"
+
+#include "tbthread/butex.h"
+#include "tbthread/context.h"
+#include "tbthread/key.h"
+#include "tbthread/task_control.h"
+#include "tbutil/fast_rand.h"
+#include "tbutil/logging.h"
+
+namespace tbthread {
+
+static thread_local TaskGroup* tls_task_group = nullptr;
+
+TaskGroup* TaskGroup::current() { return tls_task_group; }
+
+TaskGroup::TaskGroup(TaskControl* control)
+    : _control(control), _steal_seed(tbutil::fast_rand()) {
+  _rq.init(4096);
+}
+
+fiber_t TaskGroup::cur_tid() const {
+  if (_cur_meta == nullptr) return INVALID_FIBER;
+  return make_tid(_cur_meta->slot,
+                  static_cast<uint32_t>(
+                      butex_value(_cur_meta->version_butex)
+                          ->load(std::memory_order_relaxed)));
+}
+
+void TaskGroup::run_main_task() {
+  tls_task_group = this;
+  TaskMeta* meta = nullptr;
+  while (wait_task(&meta)) {
+    sched_to(meta);
+  }
+  tls_task_group = nullptr;
+}
+
+bool TaskGroup::wait_task(TaskMeta** m) {
+  ParkingLot* pl = _control->parking_lot();
+  while (true) {
+    if (_control->stopped()) return false;
+    // Read lot state BEFORE the final scan: a producer pushes then signals,
+    // so any task pushed after our scan bumps the counter and wait() returns
+    // immediately instead of sleeping on a stale state.
+    ParkingLot::State st = pl->get_state();
+    if (st.stopped()) return false;  // stop raced with our scan: don't park
+    if (_rq.pop(m)) return true;
+    if (pop_remote(m)) return true;
+    if (_control->steal_task(m, this, &_steal_seed)) return true;
+    pl->wait(st);
+  }
+}
+
+bool TaskGroup::pop_remote(TaskMeta** m) {
+  std::lock_guard<std::mutex> g(_remote_mutex);
+  if (_remote_rq.empty()) return false;
+  *m = _remote_rq.front();
+  _remote_rq.pop_front();
+  return true;
+}
+
+bool TaskGroup::steal_from(TaskMeta** m) {
+  if (_rq.steal(m)) return true;
+  return pop_remote(m);
+}
+
+void TaskGroup::sched_to(TaskMeta* next) {
+  _cur_meta = next;
+  tb_jump_fcontext(&_main_sp, next->ctx_sp, reinterpret_cast<intptr_t>(this));
+  // Back on the scheduler stack: the fiber parked, yielded, or exited.
+  _cur_meta = nullptr;
+  if (_remained_fn != nullptr) {
+    void (*fn)(void*) = _remained_fn;
+    _remained_fn = nullptr;
+    fn(_remained_arg);
+  }
+}
+
+void TaskGroup::park(void (*remained)(void*), void* arg) {
+  TaskGroup* g = tls_task_group;
+  TB_CHECK(g != nullptr && g->_cur_meta != nullptr)
+      << "park() called off-fiber";
+  TaskMeta* m = g->_cur_meta;
+  g->_remained_fn = remained;
+  g->_remained_arg = arg;
+  tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
+  // Resumed — possibly on a different worker; tls reads must be re-fetched
+  // by the caller.
+}
+
+void TaskGroup::yield() {
+  TaskGroup* g = tls_task_group;
+  if (g == nullptr || g->_cur_meta == nullptr) {
+    std::this_thread::yield();
+    return;
+  }
+  park(
+      [](void* mv) {
+        auto* m = static_cast<TaskMeta*>(mv);
+        TaskControl::singleton()->ready_to_run_general(m);
+      },
+      g->_cur_meta);
+}
+
+void TaskGroup::task_entry(intptr_t group_ptr) {
+  auto* g = reinterpret_cast<TaskGroup*>(group_ptr);
+  TaskMeta* m = g->_cur_meta;
+  m->fn(m->arg);
+  exit_current();
+}
+
+void TaskGroup::exit_current() {
+  TaskGroup* g = tls_task_group;  // re-fetch: fiber may have migrated
+  TaskMeta* m = g->_cur_meta;
+  g->_remained_fn = task_ends;
+  g->_remained_arg = m;
+  tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
+  __builtin_unreachable();  // never resumed
+}
+
+void TaskGroup::task_ends(void* meta) {
+  // Runs on the scheduler stack: the fiber's stack is quiescent and can be
+  // recycled; then the version bump publishes "dead" and wakes joiners.
+  auto* m = static_cast<TaskMeta*>(meta);
+  if (m->key_table != nullptr) {
+    destroy_key_table(m->key_table);
+    m->key_table = nullptr;
+  }
+  return_stack(m->stack);
+  m->stack = nullptr;
+  m->fn = nullptr;
+  m->arg = nullptr;
+  butex_increment_and_wake_all(m->version_butex);
+  tbutil::return_resource<TaskMeta>(m->slot);
+}
+
+void TaskGroup::ready_to_run(TaskMeta* m, bool signal) {
+  if (tls_task_group == this) {
+    if (!_rq.push(m)) {
+      push_remote(m, signal);
+      return;
+    }
+    if (signal) _control->signal_task(1);
+  } else {
+    push_remote(m, signal);
+  }
+}
+
+void TaskGroup::push_remote(TaskMeta* m, bool signal) {
+  {
+    std::lock_guard<std::mutex> g(_remote_mutex);
+    _remote_rq.push_back(m);
+  }
+  if (signal) _control->signal_task(1);
+}
+
+}  // namespace tbthread

@@ -1,0 +1,76 @@
+// Per-worker scheduler: local work-stealing run queue + remote (cross-thread)
+// queue + the context-switching machinery.
+//
+// Capability parity: reference src/bthread/task_group.h (run_main_task loop
+// :161, sched_to :114, _rq/_remote_rq :371-372, _last_pl_state :365).
+// Design difference (deliberate): the reference jumps fiber->fiber directly;
+// we always bounce through the worker's scheduler context. One extra jump
+// (~20ns) per reschedule buys a much simpler parking protocol: a parking
+// fiber's "remained" callback runs on the scheduler stack after the switch,
+// so locks can be held across the park (butex releases its waiter lock
+// there, making lost-wakeup races structurally impossible).
+#pragma once
+
+#include <deque>
+#include <mutex>
+
+#include "tbthread/parking_lot.h"
+#include "tbthread/task_meta.h"
+#include "tbthread/work_stealing_queue.h"
+
+namespace tbthread {
+
+class TaskControl;
+
+class TaskGroup {
+ public:
+  explicit TaskGroup(TaskControl* control);
+
+  // Worker pthread body: loop {wait_task; sched_to} until control stops.
+  void run_main_task();
+
+  // The group bound to the calling pthread (nullptr off-worker).
+  static TaskGroup* current();
+  TaskMeta* cur_meta() const { return _cur_meta; }
+  fiber_t cur_tid() const;
+
+  // ---- called from fiber context ----
+  // Requeue the calling fiber and give way.
+  static void yield();
+  // Park the calling fiber. `remained(arg)` runs on the scheduler stack
+  // after the fiber has fully switched out — release waiter locks there.
+  static void park(void (*remained)(void*), void* arg);
+  // Finish the calling fiber: recycles stack+slot, bumps version, wakes
+  // joiners. Does not return.
+  [[noreturn]] static void exit_current();
+
+  // ---- making fibers runnable ----
+  // Local push when called on this worker, else remote queue.
+  void ready_to_run(TaskMeta* m, bool signal = true);
+  void push_remote(TaskMeta* m, bool signal = true);
+  bool steal_from(TaskMeta** m);  // called by thief workers
+
+  TaskControl* control() const { return _control; }
+
+  static void task_entry(intptr_t group_ptr);  // first frame of every fiber
+
+ private:
+  friend class TaskControl;
+  bool wait_task(TaskMeta** m);
+  bool pop_remote(TaskMeta** m);
+  void sched_to(TaskMeta* next);
+  static void task_ends(void* meta);           // remained: cleanup on sched stack
+
+  TaskControl* _control;
+  TaskMeta* _cur_meta = nullptr;
+  void* _main_sp = nullptr;  // scheduler context while a fiber runs
+  void (*_remained_fn)(void*) = nullptr;
+  void* _remained_arg = nullptr;
+
+  WorkStealingQueue<TaskMeta*> _rq;
+  std::mutex _remote_mutex;
+  std::deque<TaskMeta*> _remote_rq;
+  uint64_t _steal_seed;
+};
+
+}  // namespace tbthread

@@ -1,0 +1,48 @@
+// Fiber control block, pooled in ResourcePool so a 64-bit fiber id can be
+// (slot+1)<<32 | version — address_resource(slot) is always safe and the
+// version check rejects stale ids after reuse.
+// Capability parity: reference src/bthread/task_meta.h (TaskMeta backed by
+// ResourcePool; version_butex doubles as the join wakeup word).
+#pragma once
+
+#include <cstdint>
+
+#include "tbthread/stack.h"
+#include "tbutil/resource_pool.h"
+
+namespace tbthread {
+
+struct Butex;     // butex.h
+struct KeyTable;  // key.cpp
+
+using fiber_t = uint64_t;
+inline constexpr fiber_t INVALID_FIBER = 0;
+
+struct FiberAttr {
+  int stack_type = STACK_TYPE_NORMAL;
+};
+
+struct TaskMeta {
+  void* (*fn)(void*) = nullptr;
+  void* arg = nullptr;
+  void* ctx_sp = nullptr;  // saved stack pointer while suspended
+  StackContainer* stack = nullptr;
+  FiberAttr attr;
+  tbutil::ResourceId slot = 0;
+  // Allocated on first use of the slot, never freed: join-after-reuse must
+  // still be able to read the version. Value = live version of this slot.
+  Butex* version_butex = nullptr;
+  KeyTable* key_table = nullptr;  // fiber-local storage, lazily created
+};
+
+inline fiber_t make_tid(tbutil::ResourceId slot, uint32_t version) {
+  return ((static_cast<uint64_t>(slot) + 1) << 32) | version;
+}
+inline tbutil::ResourceId tid_slot(fiber_t tid) {
+  return static_cast<tbutil::ResourceId>((tid >> 32) - 1);
+}
+inline uint32_t tid_version(fiber_t tid) {
+  return static_cast<uint32_t>(tid);
+}
+
+}  // namespace tbthread

@@ -1,0 +1,103 @@
+#include "tbthread/timer_thread.h"
+
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "tbutil/time.h"
+
+namespace tbthread {
+
+struct Entry {
+  void (*fn)(void*);
+  void* arg;
+};
+
+struct HeapItem {
+  int64_t when_us;
+  TimerThread::TaskId id;
+  bool operator>(const HeapItem& rhs) const { return when_us > rhs.when_us; }
+};
+
+struct TimerThread::Impl {
+  std::mutex mutex;
+  std::condition_variable cv;
+  std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<HeapItem>>
+      heap;
+  std::unordered_map<TaskId, Entry> live;  // ids not yet run/cancelled
+  TaskId next_id = 1;
+  bool stopped = false;
+  std::thread thread;
+};
+
+TimerThread::TimerThread() : _impl(new Impl) {
+  _impl->thread = std::thread([this]() { run(); });
+}
+
+TimerThread::~TimerThread() {
+  stop_and_join();
+  delete _impl;
+}
+
+TimerThread* TimerThread::singleton() {
+  static TimerThread* t = new TimerThread;  // leaked: lives until exit
+  return t;
+}
+
+TimerThread::TaskId TimerThread::schedule(void (*fn)(void*), void* arg,
+                                          int64_t abstime_us) {
+  std::unique_lock<std::mutex> lk(_impl->mutex);
+  if (_impl->stopped) return INVALID_TASK_ID;
+  TaskId id = _impl->next_id++;
+  _impl->live[id] = Entry{fn, arg};
+  bool earliest =
+      _impl->heap.empty() || abstime_us < _impl->heap.top().when_us;
+  _impl->heap.push(HeapItem{abstime_us, id});
+  lk.unlock();
+  if (earliest) _impl->cv.notify_one();
+  return id;
+}
+
+int TimerThread::unschedule(TaskId id) {
+  std::lock_guard<std::mutex> g(_impl->mutex);
+  return _impl->live.erase(id) > 0 ? 0 : 1;
+}
+
+void TimerThread::stop_and_join() {
+  {
+    std::lock_guard<std::mutex> g(_impl->mutex);
+    if (_impl->stopped) return;
+    _impl->stopped = true;
+  }
+  _impl->cv.notify_one();
+  if (_impl->thread.joinable()) _impl->thread.join();
+}
+
+void TimerThread::run() {
+  std::unique_lock<std::mutex> lk(_impl->mutex);
+  while (!_impl->stopped) {
+    if (_impl->heap.empty()) {
+      _impl->cv.wait(lk);
+      continue;
+    }
+    HeapItem top = _impl->heap.top();
+    int64_t now = tbutil::gettimeofday_us();
+    if (top.when_us > now) {
+      _impl->cv.wait_for(lk, std::chrono::microseconds(top.when_us - now));
+      continue;
+    }
+    _impl->heap.pop();
+    auto it = _impl->live.find(top.id);
+    if (it == _impl->live.end()) continue;  // unscheduled
+    Entry e = it->second;
+    _impl->live.erase(it);
+    lk.unlock();
+    e.fn(e.arg);  // outside the lock: fn may (un)schedule timers
+    lk.lock();
+  }
+}
+
+}  // namespace tbthread

@@ -1,0 +1,40 @@
+// Global timer pthread: schedules one-shot callbacks at absolute microsecond
+// deadlines; used for RPC timeouts, backup-request timers, fiber sleeps and
+// butex timed waits.
+// Capability parity: reference src/bthread/timer_thread.h:53 (single global
+// timer thread, schedule/unschedule). The reference shards into buckets to
+// cut lock contention; ours is a single mutex + min-heap — the consumer is
+// identical (RPC deadline arming), and contention on this host class is
+// negligible relative to the epoll/writev path. Revisit if profiling says so.
+#pragma once
+
+#include <cstdint>
+
+namespace tbthread {
+
+class TimerThread {
+ public:
+  using TaskId = uint64_t;
+  static constexpr TaskId INVALID_TASK_ID = 0;
+
+  // fn(arg) runs on the timer pthread at/after abstime_us (gettimeofday_us
+  // clock). Keep fn cheap and non-blocking: long work must be handed to a
+  // fiber (that is what RPC timeout handlers do).
+  TaskId schedule(void (*fn)(void*), void* arg, int64_t abstime_us);
+
+  // 0: cancelled before running. 1: already ran / running / unknown.
+  int unschedule(TaskId id);
+
+  void stop_and_join();
+
+  static TimerThread* singleton();
+
+ private:
+  TimerThread();
+  ~TimerThread();
+  void run();
+  struct Impl;
+  Impl* _impl;
+};
+
+}  // namespace tbthread

@@ -1,0 +1,88 @@
+// Chase-Lev lock-free work-stealing deque: the owner worker pushes/pops at
+// the bottom, thief workers steal from the top.
+// Capability parity: reference src/bthread/work_stealing_queue.h:72-117.
+// Implementation follows the canonical published algorithm (Chase & Lev 2005,
+// Le et al. 2013 C11 formulation) with a fixed-capacity ring.
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+
+#include "tbutil/logging.h"
+
+namespace tbthread {
+
+template <typename T>
+class WorkStealingQueue {
+ public:
+  WorkStealingQueue() : _buffer(nullptr), _cap(0) {}
+  ~WorkStealingQueue() { delete[] _buffer; }
+
+  int init(size_t cap) {
+    TB_CHECK(cap > 0 && (cap & (cap - 1)) == 0) << "cap must be power of 2";
+    _buffer = new std::atomic<T>[cap];
+    _cap = cap;
+    return 0;
+  }
+
+  size_t capacity() const { return _cap; }
+
+  size_t volatile_size() const {
+    const int64_t b = _bottom.load(std::memory_order_relaxed);
+    const int64_t t = _top.load(std::memory_order_relaxed);
+    return b > t ? static_cast<size_t>(b - t) : 0;
+  }
+
+  // Owner only. Returns false when full.
+  bool push(const T& item) {
+    const int64_t b = _bottom.load(std::memory_order_relaxed);
+    const int64_t t = _top.load(std::memory_order_acquire);
+    if (b - t >= static_cast<int64_t>(_cap)) return false;
+    _buffer[b & (_cap - 1)].store(item, std::memory_order_relaxed);
+    _bottom.store(b + 1, std::memory_order_release);
+    return true;
+  }
+
+  // Owner only. Returns false when empty.
+  bool pop(T* item) {
+    int64_t b = _bottom.load(std::memory_order_relaxed) - 1;
+    _bottom.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t t = _top.load(std::memory_order_relaxed);
+    if (t > b) {
+      // Empty: restore bottom.
+      _bottom.store(b + 1, std::memory_order_relaxed);
+      return false;
+    }
+    *item = _buffer[b & (_cap - 1)].load(std::memory_order_relaxed);
+    if (t == b) {
+      // Last element: race with stealers via CAS on top.
+      if (!_top.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                        std::memory_order_relaxed)) {
+        _bottom.store(b + 1, std::memory_order_relaxed);
+        return false;  // a thief won
+      }
+      _bottom.store(b + 1, std::memory_order_relaxed);
+    }
+    return true;
+  }
+
+  // Any thread. Returns false when empty or lost a race.
+  bool steal(T* item) {
+    int64_t t = _top.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    const int64_t b = _bottom.load(std::memory_order_acquire);
+    if (t >= b) return false;
+    *item = _buffer[t & (_cap - 1)].load(std::memory_order_relaxed);
+    return _top.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                        std::memory_order_relaxed);
+  }
+
+ private:
+  std::atomic<int64_t> _bottom{1};
+  std::atomic<int64_t> _top{1};
+  std::atomic<T>* _buffer;
+  size_t _cap;
+};
+
+}  // namespace tbthread

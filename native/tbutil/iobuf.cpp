@@ -60,21 +60,34 @@ void IOBuf::block_set_size(Block* b, uint32_t size) { b->size = size; }
 // same 8KB block (each holding refs to disjoint ranges) — no lock, no
 // per-message allocation. Reference keeps an equivalent tls block list
 // (butil/iobuf.cpp share_tls_block).
-static thread_local IOBuf::Block* tls_tail_block = nullptr;
+namespace {
+// Holder with a destructor so thread exit drops the block's reference —
+// otherwise every exited thread leaks one ~8KB block.
+struct TlsBlockHolder {
+  IOBuf::Block* block = nullptr;
+  ~TlsBlockHolder() {
+    if (block != nullptr) {
+      IOBuf::block_dec_ref(block);
+      block = nullptr;
+    }
+  }
+};
+thread_local TlsBlockHolder tls_tail_block;
+}  // namespace
 
 IOBuf::Block* IOBuf::share_tls_block() {
-  Block* b = tls_tail_block;
+  Block* b = tls_tail_block.block;
   if (b != nullptr && b->size < b->cap) return b;
   if (b != nullptr) block_dec_ref(b);
   b = create_block();
-  tls_tail_block = b;
+  tls_tail_block.block = b;
   return b;
 }
 
 void IOBuf::release_tls_block() {
-  if (tls_tail_block != nullptr) {
-    block_dec_ref(tls_tail_block);
-    tls_tail_block = nullptr;
+  if (tls_tail_block.block != nullptr) {
+    block_dec_ref(tls_tail_block.block);
+    tls_tail_block.block = nullptr;
   }
 }
 
@@ -528,8 +541,8 @@ ssize_t IOPortal::append_from_file_descriptor(int fd, size_t max_count) {
         BlockRef r{b, 0, got};
         block_inc_ref(b);
         push_back_ref(r);
-        block_dec_ref(tls_tail_block);
-        tls_tail_block = b;
+        block_dec_ref(tls_tail_block.block);
+        tls_tail_block.block = b;
       } else {
         push_back_ref(BlockRef{b, 0, got});  // full block: hand over our ref
       }

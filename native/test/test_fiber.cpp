@@ -1,0 +1,268 @@
+// Fiber runtime tests: scheduling, join, yield, sleep, butex, sync
+// primitives, keys. Mirrors the reference's bthread_*_unittest coverage.
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "mini_test.h"
+#include "tbthread/butex.h"
+#include "tbthread/fiber.h"
+#include "tbthread/key.h"
+#include "tbthread/sync.h"
+#include "tbthread/timer_thread.h"
+#include "tbutil/time.h"
+
+using namespace tbthread;
+
+TEST_CASE(fiber_start_join) {
+  std::atomic<int> ran{0};
+  fiber_t tid;
+  ASSERT_EQ(fiber_start_background(
+                &tid, nullptr,
+                [](void* a) -> void* {
+                  static_cast<std::atomic<int>*>(a)->store(1);
+                  return nullptr;
+                },
+                &ran),
+            0);
+  ASSERT_EQ(fiber_join(tid, nullptr), 0);
+  ASSERT_EQ(ran.load(), 1);
+  ASSERT_FALSE(fiber_exists(tid));
+}
+
+TEST_CASE(fiber_many_join_all) {
+  constexpr int N = 200;
+  std::atomic<int> count{0};
+  std::vector<fiber_t> tids(N);
+  for (int i = 0; i < N; ++i) {
+    ASSERT_EQ(fiber_start_background(
+                  &tids[i], nullptr,
+                  [](void* a) -> void* {
+                    static_cast<std::atomic<int>*>(a)->fetch_add(1);
+                    fiber_yield();
+                    return nullptr;
+                  },
+                  &count),
+              0);
+  }
+  for (int i = 0; i < N; ++i) ASSERT_EQ(fiber_join(tids[i], nullptr), 0);
+  ASSERT_EQ(count.load(), N);
+}
+
+TEST_CASE(fiber_nested_spawn) {
+  std::atomic<int> done{0};
+  struct Ctx {
+    std::atomic<int>* done;
+  } ctx{&done};
+  fiber_t tid;
+  fiber_start_background(
+      &tid, nullptr,
+      [](void* a) -> void* {
+        auto* c = static_cast<Ctx*>(a);
+        fiber_t inner;
+        fiber_start_background(
+            &inner, nullptr,
+            [](void* d) -> void* {
+              static_cast<std::atomic<int>*>(d)->fetch_add(1);
+              return nullptr;
+            },
+            c->done);
+        fiber_join(inner, nullptr);
+        c->done->fetch_add(10);
+        return nullptr;
+      },
+      &ctx);
+  ASSERT_EQ(fiber_join(tid, nullptr), 0);
+  ASSERT_EQ(done.load(), 11);
+}
+
+TEST_CASE(fiber_usleep_accuracy) {
+  fiber_t tid;
+  int64_t start = tbutil::monotonic_time_us();
+  fiber_start_background(
+      &tid, nullptr,
+      [](void*) -> void* {
+        fiber_usleep(50000);  // 50ms
+        return nullptr;
+      },
+      nullptr);
+  fiber_join(tid, nullptr);
+  int64_t elapsed = tbutil::monotonic_time_us() - start;
+  ASSERT_TRUE(elapsed >= 45000);   // slept at least ~deadline
+  ASSERT_TRUE(elapsed < 2000000);  // and didn't hang
+}
+
+TEST_CASE(butex_wake_from_pthread) {
+  Butex* b = butex_create();
+  std::atomic<int> stage{0};
+  struct Ctx {
+    Butex* b;
+    std::atomic<int>* stage;
+  } ctx{b, &stage};
+  fiber_t tid;
+  fiber_start_background(
+      &tid, nullptr,
+      [](void* a) -> void* {
+        auto* c = static_cast<Ctx*>(a);
+        c->stage->store(1);
+        while (c->b->value.load() == 0) {
+          butex_wait(c->b, 0, nullptr);
+        }
+        c->stage->store(2);
+        return nullptr;
+      },
+      &ctx);
+  while (stage.load() != 1) std::this_thread::yield();
+  usleep(10000);  // let it actually park
+  b->value.store(1);
+  butex_wake(b);
+  fiber_join(tid, nullptr);
+  ASSERT_EQ(stage.load(), 2);
+  butex_destroy(b);
+}
+
+TEST_CASE(butex_timed_wait) {
+  Butex* b = butex_create();
+  int64_t start = tbutil::monotonic_time_us();
+  int64_t dl = tbutil::gettimeofday_us() + 30000;
+  timespec abst{static_cast<time_t>(dl / 1000000),
+                static_cast<long>((dl % 1000000) * 1000)};
+  // From this (non-worker) pthread:
+  int rc = butex_wait(b, 0, &abst);
+  ASSERT_EQ(rc, -1);
+  ASSERT_EQ(errno, ETIMEDOUT);
+  ASSERT_TRUE(tbutil::monotonic_time_us() - start >= 25000);
+  // Wrong expected value:
+  rc = butex_wait(b, 42, nullptr);
+  ASSERT_EQ(rc, -1);
+  ASSERT_EQ(errno, EWOULDBLOCK);
+  butex_destroy(b);
+}
+
+TEST_CASE(fiber_mutex_contention) {
+  struct Shared {
+    FiberMutex mu;
+    int counter = 0;
+  } sh;
+  constexpr int N = 8, ITER = 1000;
+  std::vector<fiber_t> tids(N);
+  for (int i = 0; i < N; ++i) {
+    fiber_start_background(
+        &tids[i], nullptr,
+        [](void* a) -> void* {
+          auto* s = static_cast<Shared*>(a);
+          for (int j = 0; j < ITER; ++j) {
+            s->mu.lock();
+            ++s->counter;
+            if (j % 100 == 0) fiber_yield();  // hold across reschedule
+            s->mu.unlock();
+          }
+          return nullptr;
+        },
+        &sh);
+  }
+  for (auto t : tids) fiber_join(t, nullptr);
+  ASSERT_EQ(sh.counter, N * ITER);
+}
+
+TEST_CASE(fiber_cond_producer_consumer) {
+  struct Q {
+    FiberMutex mu;
+    FiberCond cv;
+    std::vector<int> items;
+    bool done = false;
+    long long sum = 0;
+  } q;
+  fiber_t consumer;
+  fiber_start_background(
+      &consumer, nullptr,
+      [](void* a) -> void* {
+        auto* q = static_cast<Q*>(a);
+        while (true) {
+          q->mu.lock();
+          while (q->items.empty() && !q->done) q->cv.wait(q->mu);
+          if (q->items.empty() && q->done) {
+            q->mu.unlock();
+            break;
+          }
+          int v = q->items.back();
+          q->items.pop_back();
+          q->mu.unlock();
+          q->sum += v;
+        }
+        return nullptr;
+      },
+      &q);
+  constexpr int N = 500;
+  for (int i = 1; i <= N; ++i) {
+    q.mu.lock();
+    q.items.push_back(i);
+    q.mu.unlock();
+    q.cv.notify_one();
+  }
+  q.mu.lock();
+  q.done = true;
+  q.mu.unlock();
+  q.cv.notify_all();
+  fiber_join(consumer, nullptr);
+  ASSERT_EQ(q.sum, static_cast<long long>(N) * (N + 1) / 2);
+}
+
+TEST_CASE(countdown_event) {
+  CountdownEvent ev(3);
+  for (int i = 0; i < 3; ++i) {
+    fiber_t t;
+    fiber_start_background(
+        &t, nullptr,
+        [](void* a) -> void* {
+          fiber_usleep(1000);
+          static_cast<CountdownEvent*>(a)->signal();
+          return nullptr;
+        },
+        &ev);
+  }
+  ev.wait();  // from pthread
+}
+
+TEST_CASE(fiber_keys) {
+  static FiberKey key;
+  static std::atomic<int> dtor_runs{0};
+  ASSERT_EQ(fiber_key_create(&key,
+                             [](void*) { dtor_runs.fetch_add(1); }),
+            0);
+  fiber_t tid;
+  fiber_start_background(
+      &tid, nullptr,
+      [](void*) -> void* {
+        ASSERT_TRUE(fiber_getspecific(key) == nullptr);
+        fiber_setspecific(key, reinterpret_cast<void*>(0x1234));
+        fiber_yield();
+        ASSERT_EQ(fiber_getspecific(key), reinterpret_cast<void*>(0x1234));
+        return nullptr;
+      },
+      nullptr);
+  fiber_join(tid, nullptr);
+  ASSERT_EQ(dtor_runs.load(), 1);  // dtor ran at fiber exit
+  // pthread-side storage is independent:
+  ASSERT_TRUE(fiber_getspecific(key) == nullptr);
+  fiber_key_delete(key);
+}
+
+TEST_CASE(timer_thread_schedule_unschedule) {
+  std::atomic<int> fired{0};
+  auto* tt = TimerThread::singleton();
+  int64_t now = tbutil::gettimeofday_us();
+  auto id1 = tt->schedule(
+      [](void* a) { static_cast<std::atomic<int>*>(a)->fetch_add(1); }, &fired,
+      now + 20000);
+  auto id2 = tt->schedule(
+      [](void* a) { static_cast<std::atomic<int>*>(a)->fetch_add(100); },
+      &fired, now + 500000);
+  ASSERT_TRUE(id1 != TimerThread::INVALID_TASK_ID);
+  ASSERT_EQ(tt->unschedule(id2), 0);  // cancelled before firing
+  usleep(100000);
+  ASSERT_EQ(fired.load(), 1);
+  ASSERT_EQ(tt->unschedule(id1), 1);  // already ran
+}
+
+TEST_MAIN

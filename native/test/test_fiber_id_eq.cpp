@@ -1,0 +1,156 @@
+// Tests for correlation ids (fiber_id) and ExecutionQueue — the RPC
+// bookkeeping primitives. Mirrors reference test/bthread_id_unittest.cpp and
+// bthread_execution_queue_unittest.cpp in spirit.
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "mini_test.h"
+#include "tbthread/execution_queue.h"
+#include "tbthread/fiber.h"
+#include "tbthread/fiber_id.h"
+
+using namespace tbthread;
+
+TEST_CASE(id_create_lock_unlock_destroy) {
+  fiber_id_t id;
+  int payload = 42;
+  ASSERT_EQ(fiber_id_create(&id, &payload, nullptr), 0);
+  ASSERT_TRUE(fiber_id_exists(id));
+  void* data = nullptr;
+  ASSERT_EQ(fiber_id_lock(id, &data), 0);
+  ASSERT_EQ(*static_cast<int*>(data), 42);
+  ASSERT_EQ(fiber_id_trylock(id, nullptr), EBUSY);
+  ASSERT_EQ(fiber_id_unlock(id), 0);
+  ASSERT_EQ(fiber_id_lock(id, nullptr), 0);
+  ASSERT_EQ(fiber_id_unlock_and_destroy(id), 0);
+  ASSERT_FALSE(fiber_id_exists(id));
+  ASSERT_EQ(fiber_id_lock(id, nullptr), EINVAL);
+}
+
+TEST_CASE(id_ranged_versions) {
+  fiber_id_t id;
+  ASSERT_EQ(fiber_id_create_ranged(&id, nullptr, nullptr, 5), 0);
+  // All versions in the range resolve to the same live id.
+  for (int k = 0; k < 4; ++k) {
+    ASSERT_TRUE(fiber_id_exists(fiber_id_for_attempt(id, k)));
+  }
+  ASSERT_FALSE(fiber_id_exists(id + 5));  // out of range
+  ASSERT_EQ(fiber_id_lock(fiber_id_for_attempt(id, 2), nullptr), 0);
+  ASSERT_EQ(fiber_id_unlock_and_destroy(id), 0);
+  ASSERT_FALSE(fiber_id_exists(fiber_id_for_attempt(id, 1)));
+}
+
+static std::atomic<int> g_error_seen{0};
+static int error_handler(fiber_id_t id, void* data, int error) {
+  g_error_seen.fetch_add(error);
+  return fiber_id_unlock_and_destroy(id);
+}
+
+TEST_CASE(id_error_unlocked_runs_handler) {
+  fiber_id_t id;
+  g_error_seen.store(0);
+  ASSERT_EQ(fiber_id_create(&id, nullptr, error_handler), 0);
+  ASSERT_EQ(fiber_id_error(id, 7), 0);
+  ASSERT_EQ(g_error_seen.load(), 7);
+  ASSERT_FALSE(fiber_id_exists(id));  // handler destroyed it
+}
+
+TEST_CASE(id_error_while_locked_queues) {
+  fiber_id_t id;
+  g_error_seen.store(0);
+  ASSERT_EQ(fiber_id_create(&id, nullptr, error_handler), 0);
+  ASSERT_EQ(fiber_id_lock(id, nullptr), 0);
+  ASSERT_EQ(fiber_id_error(id, 9), 0);   // queued
+  ASSERT_EQ(g_error_seen.load(), 0);     // not yet run
+  ASSERT_EQ(fiber_id_unlock(id), 0);     // pops queued error -> handler
+  ASSERT_EQ(g_error_seen.load(), 9);
+  ASSERT_FALSE(fiber_id_exists(id));
+}
+
+TEST_CASE(id_join_blocks_until_destroy) {
+  fiber_id_t id;
+  ASSERT_EQ(fiber_id_create(&id, nullptr, nullptr), 0);
+  std::atomic<bool> joined{false};
+  struct Ctx {
+    fiber_id_t id;
+    std::atomic<bool>* joined;
+  } ctx{id, &joined};
+  fiber_t tid;
+  fiber_start_background(
+      &tid, nullptr,
+      [](void* a) -> void* {
+        auto* c = static_cast<Ctx*>(a);
+        fiber_id_join(c->id);
+        c->joined->store(true);
+        return nullptr;
+      },
+      &ctx);
+  usleep(20000);
+  ASSERT_FALSE(joined.load());
+  ASSERT_EQ(fiber_id_lock(id, nullptr), 0);
+  ASSERT_EQ(fiber_id_unlock_and_destroy(id), 0);
+  fiber_join(tid, nullptr);
+  ASSERT_TRUE(joined.load());
+}
+
+TEST_CASE(execution_queue_ordered_drain) {
+  struct Sink {
+    std::vector<int> seen;
+    std::atomic<int> total{0};
+  };
+  static Sink sink;
+  sink.seen.clear();
+  sink.total.store(0);
+  ExecutionQueue<int> q;
+  q.start(
+      [](ExecutionQueue<int>::Iterator& it, void* arg) -> int {
+        auto* s = static_cast<Sink*>(arg);
+        int v;
+        while (it.next(&v)) {
+          s->seen.push_back(v);  // single consumer: no lock needed
+          s->total.fetch_add(1);
+        }
+        return 0;
+      },
+      &sink);
+  constexpr int N = 2000;
+  for (int i = 0; i < N; ++i) {
+    ASSERT_EQ(q.execute(i), 0);
+  }
+  while (sink.total.load() < N) usleep(1000);
+  q.stop_and_join();
+  ASSERT_EQ(sink.seen.size(), static_cast<size_t>(N));
+  for (int i = 0; i < N; ++i) ASSERT_EQ(sink.seen[i], i);  // FIFO order
+}
+
+TEST_CASE(execution_queue_multi_producer) {
+  static std::atomic<long long> sum{0};
+  static std::atomic<int> count{0};
+  sum.store(0);
+  count.store(0);
+  ExecutionQueue<int> q;
+  q.start(
+      [](ExecutionQueue<int>::Iterator& it, void*) -> int {
+        int v;
+        while (it.next(&v)) {
+          sum.fetch_add(v);
+          count.fetch_add(1);
+        }
+        return 0;
+      },
+      nullptr);
+  constexpr int T = 4, PER = 500;
+  std::vector<std::thread> producers;
+  for (int t = 0; t < T; ++t) {
+    producers.emplace_back([&q]() {
+      for (int i = 1; i <= PER; ++i) q.execute(i);
+    });
+  }
+  for (auto& p : producers) p.join();
+  while (count.load() < T * PER) usleep(1000);
+  q.stop_and_join();
+  ASSERT_EQ(sum.load(), static_cast<long long>(T) * PER * (PER + 1) / 2);
+}
+
+TEST_MAIN
