@@ -1,0 +1,51 @@
+#include "tbvar/combiner.h"
+
+namespace tbvar {
+namespace detail {
+
+std::mutex& lifecycle_mutex() {
+  static std::mutex* m = new std::mutex;
+  return *m;
+}
+
+namespace {
+struct SlotPool {
+  std::mutex mu;
+  std::vector<uint32_t> free_ids;
+  uint32_t next_id = 0;
+  std::atomic<uint64_t> seq{1};
+};
+SlotPool& slot_pool() {
+  static SlotPool* p = new SlotPool;
+  return *p;
+}
+}  // namespace
+
+uint32_t acquire_combiner_slot() {
+  SlotPool& p = slot_pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  if (!p.free_ids.empty()) {
+    uint32_t id = p.free_ids.back();
+    p.free_ids.pop_back();
+    return id;
+  }
+  return p.next_id++;
+}
+
+void release_combiner_slot(uint32_t id) {
+  SlotPool& p = slot_pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.free_ids.push_back(id);
+}
+
+uint64_t next_combiner_seq() {
+  return slot_pool().seq.fetch_add(1, std::memory_order_relaxed);
+}
+
+ThreadAgentDirectory& tls_agent_directory() {
+  thread_local ThreadAgentDirectory dir;
+  return dir;
+}
+
+}  // namespace detail
+}  // namespace tbvar

@@ -1,0 +1,178 @@
+// Per-thread-agent combiner: the write-mostly engine behind Adder/Maxer/etc.
+// Capability parity: reference src/bvar/detail/agent_group.h:114 +
+// src/bvar/detail/combiner.h (AgentCombiner): each writing thread owns a
+// cache-line-padded agent slot; writes touch only that slot (no shared
+// cacheline, no lock); reads walk all agents under a lock and combine.
+//
+// Lifecycle design (differs from the reference's AgentGroup id-reuse scheme,
+// same guarantees): one global lifecycle mutex serializes agent
+// creation, thread exit, combiner destruction, and combines. Agents are
+// heap-allocated and freed ONLY by their owning thread (on thread exit or on
+// tls-slot reuse), so a combiner dying under a concurrent writer can never
+// cause a use-after-free: the writer still owns valid memory; the dying
+// combiner merely detaches (agent->combiner = nullptr) and merges the value.
+// tls slots are keyed by a never-reused 64-bit sequence number, so a new
+// combiner reusing a freed small id can never alias a stale agent.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace tbvar {
+namespace detail {
+
+// One mutex for all combiner lifecycle ops across the process. Hot-path
+// writes never take it; only first-write-per-thread, reads (rare: 1/s sampler
+// + scrapes) and destruction do.
+std::mutex& lifecycle_mutex();
+
+// Reusable small ids indexing the per-thread agent slot vector.
+uint32_t acquire_combiner_slot();
+void release_combiner_slot(uint32_t id);
+uint64_t next_combiner_seq();
+
+class CombinerBase;
+
+struct AgentBase {
+  CombinerBase* combiner = nullptr;  // null once the combiner died (orphan)
+  AgentBase* next = nullptr;         // intrusive list inside the combiner
+  AgentBase* prev = nullptr;
+  virtual ~AgentBase() = default;
+};
+
+class CombinerBase {
+ public:
+  virtual ~CombinerBase() = default;
+
+  // Called with lifecycle_mutex held (thread exit or tls-slot reuse): merge
+  // the agent's value into the combiner's global term and unlink it.
+  virtual void commit_and_unlink(AgentBase* a) = 0;
+};
+
+// Per-thread directory of agents, indexed by combiner slot id. The
+// destructor (thread exit) commits every live agent and frees them all.
+struct ThreadAgentDirectory {
+  struct Slot {
+    uint64_t seq = 0;
+    AgentBase* agent = nullptr;
+  };
+  std::vector<Slot> slots;
+
+  ~ThreadAgentDirectory() {
+    std::lock_guard<std::mutex> lk(lifecycle_mutex());
+    for (Slot& s : slots) {
+      if (s.agent == nullptr) continue;
+      if (s.agent->combiner != nullptr) {
+        s.agent->combiner->commit_and_unlink(s.agent);
+      }
+      delete s.agent;
+      s.agent = nullptr;
+    }
+  }
+
+  Slot& slot_for(uint32_t id) {
+    if (id >= slots.size()) slots.resize(id + 1);
+    return slots[id];
+  }
+};
+
+ThreadAgentDirectory& tls_agent_directory();
+
+// Combiner<Element>: Element must provide
+//   void merge_into(Result&) const   (called under lifecycle mutex)
+//   plus whatever hot-path mutators the owner calls on get_or_create()'s
+//   return value.
+template <typename Element, typename Result>
+class Combiner : public CombinerBase {
+ public:
+  struct alignas(64) Agent : AgentBase {
+    Element element;
+  };
+
+  Combiner() : _seq(next_combiner_seq()), _slot_id(acquire_combiner_slot()) {}
+
+  ~Combiner() override {
+    std::lock_guard<std::mutex> lk(lifecycle_mutex());
+    for (AgentBase* a = _head; a != nullptr;) {
+      AgentBase* next = a->next;
+      a->combiner = nullptr;  // orphan: owning thread frees it later
+      a->next = a->prev = nullptr;
+      a = next;
+    }
+    _head = nullptr;
+    release_combiner_slot(_slot_id);
+  }
+
+  // Hot path: returns this thread's agent, creating it on first use.
+  Element* get_or_create_tls_element() {
+    ThreadAgentDirectory::Slot& s = tls_agent_directory().slot_for(_slot_id);
+    if (s.seq == _seq) {
+      return &static_cast<Agent*>(s.agent)->element;
+    }
+    std::lock_guard<std::mutex> lk(lifecycle_mutex());
+    if (s.agent != nullptr) {
+      // Slot belonged to a combiner that died (or a different live one after
+      // id reuse — commit it back first).
+      if (s.agent->combiner != nullptr) {
+        s.agent->combiner->commit_and_unlink(s.agent);
+      }
+      delete s.agent;
+    }
+    Agent* a = new Agent;
+    a->combiner = this;
+    a->next = _head;
+    if (_head != nullptr) _head->prev = a;
+    _head = a;
+    s.agent = a;
+    s.seq = _seq;
+    return &a->element;
+  }
+
+  // Read path: fold the global term plus every live agent through `fn`.
+  // fn(Result&, const Element&) merges one agent; the Result starts as a copy
+  // of the global (dead-thread) term.
+  template <typename Fn>
+  Result combine(Fn&& fn) const {
+    std::lock_guard<std::mutex> lk(lifecycle_mutex());
+    Result r = _global;
+    for (AgentBase* a = _head; a != nullptr; a = a->next) {
+      fn(r, static_cast<Agent*>(a)->element);
+    }
+    return r;
+  }
+
+  // Read-and-reset path (for windowed Maxer/Percentile): fold every live
+  // agent through `fn` which must also reset the agent; the global term is
+  // consumed and cleared.
+  template <typename Fn>
+  Result combine_and_reset(Fn&& fn, Result cleared_global) {
+    std::lock_guard<std::mutex> lk(lifecycle_mutex());
+    Result r = _global;
+    _global = cleared_global;
+    for (AgentBase* a = _head; a != nullptr; a = a->next) {
+      fn(r, static_cast<Agent*>(a)->element);
+    }
+    return r;
+  }
+
+ public:
+  void commit_and_unlink(AgentBase* a) override {
+    static_cast<Agent*>(a)->element.merge_into(_global);
+    if (a->prev != nullptr) a->prev->next = a->next;
+    if (a->next != nullptr) a->next->prev = a->prev;
+    if (_head == a) _head = a->next;
+    a->combiner = nullptr;
+    a->next = a->prev = nullptr;
+  }
+
+ private:
+  const uint64_t _seq;
+  const uint32_t _slot_id;
+  AgentBase* _head = nullptr;
+  Result _global{};
+};
+
+}  // namespace detail
+}  // namespace tbvar

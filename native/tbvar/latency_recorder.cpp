@@ -1,0 +1,64 @@
+#include "tbvar/latency_recorder.h"
+
+#include "tbvar/passive_status.h"
+
+namespace tbvar {
+
+LatencyRecorder::LatencyRecorder(int window_size)
+    : _window_size(window_size > 0 ? window_size : kDefaultWindowSize),
+      _sum_window(&_sum, _window_size),
+      _num_window(&_num, _window_size),
+      _max_window(&_max, _window_size) {}
+
+LatencyRecorder::LatencyRecorder(const std::string& prefix, int window_size)
+    : LatencyRecorder(window_size) {
+  expose(prefix);
+}
+
+LatencyRecorder::~LatencyRecorder() = default;
+
+LatencyRecorder& LatencyRecorder::operator<<(int64_t latency_us) {
+  _sum << latency_us;
+  _num << 1;
+  _max << latency_us;
+  _percentile << latency_us;
+  return *this;
+}
+
+int64_t LatencyRecorder::latency() const {
+  const int64_t n = _num_window.get_value();
+  return n > 0 ? _sum_window.get_value() / n : 0;
+}
+
+int64_t LatencyRecorder::latency_percentile(double fraction) const {
+  return _percentile.get_number(fraction, _window_size);
+}
+
+int64_t LatencyRecorder::max_latency() const {
+  const int64_t m = _max_window.get_value();
+  return m == Maxer<int64_t>::op_identity() ? 0 : m;
+}
+
+int64_t LatencyRecorder::count() const { return _num.get_value(); }
+
+int64_t LatencyRecorder::qps() const {
+  return _num_window.get_value() / _window_size;
+}
+
+int LatencyRecorder::expose(const std::string& prefix) {
+  _latency_var.reset(new PassiveStatus<int64_t>(
+      prefix + "_latency", [this] { return latency(); }));
+  _max_var.reset(new PassiveStatus<int64_t>(
+      prefix + "_max_latency", [this] { return max_latency(); }));
+  _qps_var.reset(
+      new PassiveStatus<int64_t>(prefix + "_qps", [this] { return qps(); }));
+  _count_var.reset(new PassiveStatus<int64_t>(prefix + "_count",
+                                              [this] { return count(); }));
+  _p99_var.reset(new PassiveStatus<int64_t>(prefix + "_latency_99",
+                                            [this] { return p99(); }));
+  _p999_var.reset(new PassiveStatus<int64_t>(prefix + "_latency_999",
+                                             [this] { return p999(); }));
+  return 0;
+}
+
+}  // namespace tbvar

@@ -1,0 +1,57 @@
+// LatencyRecorder: the one-liner bundle every RPC leg exposes — trailing
+// average latency, max, qps, count, and p50/p90/p99/p999 percentiles.
+// Capability parity: reference src/bvar/latency_recorder.h:49-75.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "tbvar/percentile.h"
+#include "tbvar/reducer.h"
+#include "tbvar/window.h"
+
+namespace tbvar {
+
+class LatencyRecorder {
+ public:
+  explicit LatencyRecorder(int window_size = kDefaultWindowSize);
+  explicit LatencyRecorder(const std::string& prefix,
+                           int window_size = kDefaultWindowSize);
+  ~LatencyRecorder();
+
+  LatencyRecorder& operator<<(int64_t latency_us);
+
+  // Average latency (us) over the window.
+  int64_t latency() const;
+  // Quantiles over the window.
+  int64_t latency_percentile(double fraction) const;
+  int64_t p50() const { return latency_percentile(0.5); }
+  int64_t p90() const { return latency_percentile(0.9); }
+  int64_t p99() const { return latency_percentile(0.99); }
+  int64_t p999() const { return latency_percentile(0.999); }
+  // Max latency (us) over the window.
+  int64_t max_latency() const;
+  // Total events since creation.
+  int64_t count() const;
+  // Events/second over the window.
+  int64_t qps() const;
+
+  // Expose {prefix}_latency, _max_latency, _qps, _count as variables.
+  int expose(const std::string& prefix);
+
+ private:
+  int _window_size;
+  Adder<int64_t> _sum;
+  Adder<int64_t> _num;
+  Maxer<int64_t> _max;
+  Percentile _percentile;
+  Window<Adder<int64_t>> _sum_window;
+  Window<Adder<int64_t>> _num_window;
+  Window<Maxer<int64_t>> _max_window;
+  // Exposed facade vars (created by expose()).
+  std::unique_ptr<Variable> _latency_var, _max_var, _qps_var, _count_var,
+      _p99_var, _p999_var;
+};
+
+}  // namespace tbvar

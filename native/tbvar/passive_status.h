@@ -1,0 +1,54 @@
+// PassiveStatus (value computed on read) and Status (stored value).
+// Capability parity: reference src/bvar/passive_status.h, src/bvar/status.h.
+#pragma once
+
+#include <functional>
+#include <mutex>
+#include <ostream>
+
+#include "tbvar/variable.h"
+
+namespace tbvar {
+
+template <typename T>
+class PassiveStatus : public Variable {
+ public:
+  using Getter = std::function<T()>;
+
+  explicit PassiveStatus(Getter getter) : _getter(std::move(getter)) {}
+  PassiveStatus(const std::string& name, Getter getter)
+      : _getter(std::move(getter)) {
+    expose(name);
+  }
+
+  T get_value() const { return _getter(); }
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  Getter _getter;
+};
+
+template <typename T>
+class Status : public Variable {
+ public:
+  Status() = default;
+  Status(const std::string& name, const T& value) : _value(value) {
+    expose(name);
+  }
+
+  T get_value() const {
+    std::lock_guard<std::mutex> lk(_mu);
+    return _value;
+  }
+  void set_value(const T& v) {
+    std::lock_guard<std::mutex> lk(_mu);
+    _value = v;
+  }
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  mutable std::mutex _mu;
+  T _value{};
+};
+
+}  // namespace tbvar

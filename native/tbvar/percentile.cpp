@@ -1,0 +1,105 @@
+#include "tbvar/percentile.h"
+
+#include <algorithm>
+
+#include "tbutil/fast_rand.h"
+
+namespace tbvar {
+namespace detail {
+
+void PercentileCell::add(int64_t value) {
+  while (lock.test_and_set(std::memory_order_acquire)) {
+  }
+  if (num_added < kReservoirSize) {
+    reservoir[num_added] = value;
+  } else {
+    // Classic reservoir sampling: keep each seen value with equal
+    // probability kReservoirSize / num_added.
+    uint64_t idx = tbutil::fast_rand_less_than(num_added + 1);
+    if (idx < kReservoirSize) reservoir[idx] = value;
+  }
+  ++num_added;
+  lock.clear(std::memory_order_release);
+}
+
+void PercentileCell::drain_into(IntervalSample& out) {
+  while (lock.test_and_set(std::memory_order_acquire)) {
+  }
+  const uint32_t kept = std::min<uint32_t>(num_added, kReservoirSize);
+  out.samples.insert(out.samples.end(), reservoir, reservoir + kept);
+  out.count += num_added;
+  num_added = 0;
+  lock.clear(std::memory_order_release);
+}
+
+PercentileSampler::PercentileSampler(Percentile* owner, size_t max_window)
+    : _owner(owner) {
+  _queue.max_size = max_window;
+  schedule();
+}
+
+void PercentileSampler::take_sample() {
+  IntervalSample interval = _owner->_combiner.combine_and_reset(
+      [](IntervalSample& r, PercentileCell& c) { c.drain_into(r); },
+      IntervalSample{});
+  std::lock_guard<std::mutex> lk(queue_mutex);
+  _queue.push(std::move(interval), sampler_now_us());
+}
+
+int64_t PercentileSampler::window_quantile(double fraction, int window_size) {
+  // Merge interval reservoirs, weighting each sampled value by
+  // interval.count / interval.samples.size() so that busy seconds dominate
+  // quiet ones the way the reference's GlobalPercentileSamples do.
+  struct Weighted {
+    int64_t value;
+    double weight;
+  };
+  std::vector<Weighted> all;
+  {
+    std::lock_guard<std::mutex> lk(queue_mutex);
+    size_t n = _queue.q.size();
+    size_t start = n > static_cast<size_t>(window_size)
+                       ? n - static_cast<size_t>(window_size)
+                       : 0;
+    for (size_t i = start; i < n; ++i) {
+      const IntervalSample& s = _queue.q[i].value;
+      if (s.samples.empty()) continue;
+      double w = static_cast<double>(s.count) / s.samples.size();
+      for (int64_t v : s.samples) all.push_back({v, w});
+    }
+  }
+  if (all.empty()) return 0;
+  std::sort(all.begin(), all.end(),
+            [](const Weighted& a, const Weighted& b) { return a.value < b.value; });
+  double total = 0;
+  for (const Weighted& w : all) total += w.weight;
+  double target = fraction * total;
+  double acc = 0;
+  for (const Weighted& w : all) {
+    acc += w.weight;
+    if (acc >= target) return w.value;
+  }
+  return all.back().value;
+}
+
+}  // namespace detail
+
+Percentile::Percentile()
+    : _sampler(new detail::PercentileSampler(this, 60)) {}
+
+Percentile::~Percentile() {
+  // Stop sampling before the combiner dies.
+  delete _sampler;
+  _sampler = nullptr;
+}
+
+Percentile& Percentile::operator<<(int64_t latency) {
+  _combiner.get_or_create_tls_element()->add(latency);
+  return *this;
+}
+
+int64_t Percentile::get_number(double fraction, int window_size) const {
+  return _sampler->window_quantile(fraction, window_size);
+}
+
+}  // namespace tbvar

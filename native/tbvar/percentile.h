@@ -1,0 +1,83 @@
+// Percentile: reservoir-sampled latency distribution with a trailing window.
+// Capability parity: reference src/bvar/detail/percentile.h:51-101
+// (thread-local PercentileSamples merged by the sampler thread into
+// per-second GlobalPercentileSamples; windowed quantile queries).
+//
+// Design: each writing thread owns a fixed reservoir (kReservoirSize samples
+// + a count) guarded by a per-agent spinlock (writer holds it for a few ns;
+// the sampler thread holds it while draining once per second). Every sampler
+// tick folds all thread reservoirs into one interval sample pushed into a
+// SampleQueue; a quantile query merges the interval samples in the window,
+// weighting each interval by its true count.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <vector>
+
+#include "tbvar/combiner.h"
+#include "tbvar/sampler.h"
+
+namespace tbvar {
+namespace detail {
+
+constexpr size_t kReservoirSize = 254;
+
+// One second's worth of merged samples: a reservoir + the true event count.
+struct IntervalSample {
+  std::vector<int64_t> samples;
+  uint64_t count = 0;  // true number of events the reservoir represents
+};
+
+struct PercentileCell {
+  std::atomic_flag lock = ATOMIC_FLAG_INIT;
+  uint32_t num_added = 0;  // events since last drain
+  int64_t reservoir[kReservoirSize];
+
+  void add(int64_t value);
+  // Drain into `out` (append) and reset. Called under the lifecycle mutex by
+  // the sampler thread.
+  void drain_into(IntervalSample& out);
+  // merge_into for combiner's dead-thread path.
+  void merge_into(IntervalSample& global) { drain_into(global); }
+};
+
+class PercentileSampler;
+
+}  // namespace detail
+
+class Percentile {
+ public:
+  Percentile();
+  ~Percentile();
+
+  Percentile(const Percentile&) = delete;
+  Percentile& operator=(const Percentile&) = delete;
+
+  Percentile& operator<<(int64_t latency);
+
+  // Quantile over the trailing `window_size` seconds, fraction in (0,1].
+  int64_t get_number(double fraction, int window_size) const;
+
+ private:
+  friend class detail::PercentileSampler;
+  mutable detail::Combiner<detail::PercentileCell, detail::IntervalSample>
+      _combiner;
+  detail::PercentileSampler* _sampler;
+};
+
+namespace detail {
+
+class PercentileSampler : public SamplerWithQueueBase {
+ public:
+  PercentileSampler(Percentile* owner, size_t max_window);
+  void take_sample() override;
+  int64_t window_quantile(double fraction, int window_size);
+
+ private:
+  Percentile* _owner;
+  SampleQueue<IntervalSample> _queue;
+};
+
+}  // namespace detail
+}  // namespace tbvar

@@ -1,0 +1,25 @@
+#include "tbvar/prometheus.h"
+
+#include <cstdlib>
+#include <map>
+
+#include "tbvar/variable.h"
+
+namespace tbvar {
+
+int dump_prometheus(std::string* out) {
+  std::map<std::string, std::string> vars;
+  Variable::dump_exposed(&vars);
+  int n = 0;
+  for (const auto& [name, value] : vars) {
+    char* end = nullptr;
+    (void)strtod(value.c_str(), &end);
+    if (end == value.c_str() || *end != '\0') continue;  // not numeric
+    out->append("# TYPE ").append(name).append(" gauge\n");
+    out->append(name).append(" ").append(value).append("\n");
+    ++n;
+  }
+  return n;
+}
+
+}  // namespace tbvar

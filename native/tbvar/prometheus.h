@@ -1,0 +1,15 @@
+// Prometheus text-format dump of every exposed variable.
+// Capability parity: reference src/brpc/builtin/prometheus_metrics_service.cpp
+// (/brpc_metrics endpoint). Numeric variables become gauges; non-numeric
+// descriptions are skipped (Prometheus only takes numbers).
+#pragma once
+
+#include <string>
+
+namespace tbvar {
+
+// Appends "# TYPE name gauge\nname value\n" for every exposed variable whose
+// description parses as a number. Returns the number of metrics dumped.
+int dump_prometheus(std::string* out);
+
+}  // namespace tbvar

@@ -1,0 +1,135 @@
+// Adder / Maxer / Miner: write-mostly counters combined on read.
+// Capability parity: reference src/bvar/reducer.h:193-493 (Reducer over
+// AgentCombiner; Adder :335, Maxer :391, Miner :493). Each thread's
+// operator<< touches only its own padded agent (relaxed atomics, single
+// writer); get_value() combines all agents under the lifecycle mutex.
+#pragma once
+
+#include <cstdint>
+#include <limits>
+#include <ostream>
+
+#include "tbvar/combiner.h"
+#include "tbvar/variable.h"
+
+namespace tbvar {
+
+namespace detail {
+
+template <typename T>
+struct AtomicCell {
+  std::atomic<T> value{};
+  // merge_into is only called for Adder-style cells via CellOps; Maxer/Miner
+  // specialize through their Reducer's Ops. The combiner requires the method
+  // on the element type, so each reducer wraps the cell with its op.
+};
+
+struct AddOp {
+  template <typename T>
+  static void apply(T& lhs, T rhs) { lhs += rhs; }
+  template <typename T>
+  static constexpr T identity() { return T(); }
+  static constexpr bool kHasInverse = true;
+  template <typename T>
+  static void inverse(T& lhs, T rhs) { lhs -= rhs; }
+};
+
+struct MaxOp {
+  template <typename T>
+  static void apply(T& lhs, T rhs) { if (rhs > lhs) lhs = rhs; }
+  template <typename T>
+  static constexpr T identity() { return std::numeric_limits<T>::lowest(); }
+  static constexpr bool kHasInverse = false;
+};
+
+struct MinOp {
+  template <typename T>
+  static void apply(T& lhs, T rhs) { if (rhs < lhs) lhs = rhs; }
+  template <typename T>
+  static constexpr T identity() { return std::numeric_limits<T>::max(); }
+  static constexpr bool kHasInverse = false;
+};
+
+template <typename T, typename Op>
+struct ReducerCell {
+  std::atomic<T> value{Op::template identity<T>()};
+
+  void merge_into(T& global) const {
+    Op::apply(global, value.load(std::memory_order_relaxed));
+  }
+};
+
+}  // namespace detail
+
+// Reducer<T, Op>: x << v folds v into this thread's cell with Op;
+// get_value() folds all cells plus the dead-thread global term.
+template <typename T, typename Op>
+class Reducer : public Variable {
+ public:
+  using Cell = detail::ReducerCell<T, Op>;
+
+  Reducer() = default;
+  explicit Reducer(const std::string& name) { expose(name); }
+
+  Reducer& operator<<(T v) {
+    Cell* c = _combiner.get_or_create_tls_element();
+    // Single writer per cell: plain load/modify/store is race-free with the
+    // reader's relaxed load (reader may see the previous value, never a torn
+    // one).
+    T cur = c->value.load(std::memory_order_relaxed);
+    Op::apply(cur, v);
+    c->value.store(cur, std::memory_order_relaxed);
+    return *this;
+  }
+
+  T get_value() const {
+    return _combiner.combine([](T& r, const Cell& c) {
+      Op::apply(r, c.value.load(std::memory_order_relaxed));
+    });
+  }
+
+  // Collect and zero every cell (used by windowed samplers of Maxer/Miner).
+  T get_and_reset() {
+    return _combiner.combine_and_reset(
+        [](T& r, Cell& c) {
+          Op::apply(r, c.value.exchange(Op::template identity<T>(),
+                                        std::memory_order_relaxed));
+        },
+        Op::template identity<T>());
+  }
+
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+  static constexpr bool op_has_inverse() { return Op::kHasInverse; }
+  static constexpr T op_identity() { return Op::template identity<T>(); }
+  static void op_apply(T& lhs, T rhs) { Op::apply(lhs, rhs); }
+  static void op_inverse(T& lhs, T rhs) {
+    if constexpr (Op::kHasInverse) Op::inverse(lhs, rhs);
+  }
+
+ private:
+  mutable detail::Combiner<Cell, T> _combiner;
+};
+
+template <typename T>
+class Adder : public Reducer<T, detail::AddOp> {
+ public:
+  Adder() = default;
+  explicit Adder(const std::string& name) : Reducer<T, detail::AddOp>(name) {}
+};
+
+template <typename T>
+class Maxer : public Reducer<T, detail::MaxOp> {
+ public:
+  Maxer() = default;
+  explicit Maxer(const std::string& name) : Reducer<T, detail::MaxOp>(name) {}
+};
+
+template <typename T>
+class Miner : public Reducer<T, detail::MinOp> {
+ public:
+  Miner() = default;
+  explicit Miner(const std::string& name) : Reducer<T, detail::MinOp>(name) {}
+};
+
+}  // namespace tbvar

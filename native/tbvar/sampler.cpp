@@ -1,0 +1,83 @@
+#include "tbvar/sampler.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <thread>
+#include <unordered_set>
+
+#include "tbutil/time.h"
+
+namespace tbvar {
+namespace detail {
+
+int64_t sampler_now_us() { return tbutil::monotonic_time_us(); }
+
+namespace {
+
+// The collector holds `mu` while calling take_sample(), so destroy() —
+// which also takes `mu` — cannot return while a sample of that sampler is in
+// flight. take_sample() implementations are O(#threads) at worst.
+struct Collector {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_set<Sampler*> samplers;
+  std::thread thread;
+  bool started = false;
+  bool stop = false;
+
+  void ensure_started() {
+    if (started) return;
+    started = true;
+    thread = std::thread([this] { run(); });
+    thread.detach();  // process-lifetime thread, like the reference's
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (!stop) {
+      cv.wait_for(lk, std::chrono::seconds(1));
+      if (stop) break;
+      for (Sampler* s : samplers) {
+        s->take_sample();
+      }
+    }
+  }
+};
+
+Collector& collector() {
+  static Collector* c = new Collector;
+  return *c;
+}
+
+}  // namespace
+
+Sampler::~Sampler() { destroy(); }
+
+void Sampler::schedule() {
+  Collector& c = collector();
+  std::lock_guard<std::mutex> lk(c.mu);
+  if (_scheduled) return;
+  c.samplers.insert(this);
+  _scheduled = true;
+  c.ensure_started();
+}
+
+void Sampler::destroy() {
+  Collector& c = collector();
+  std::lock_guard<std::mutex> lk(c.mu);
+  if (!_scheduled) return;
+  c.samplers.erase(this);
+  _scheduled = false;
+}
+
+}  // namespace detail
+
+// Test/bench hook: force one sampling tick synchronously instead of waiting
+// for the 1s cadence.
+void take_sample_now() {
+  auto& c = detail::collector();
+  std::lock_guard<std::mutex> lk(c.mu);
+  for (detail::Sampler* s : c.samplers) s->take_sample();
+}
+
+}  // namespace tbvar

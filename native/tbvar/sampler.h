@@ -1,0 +1,138 @@
+// Sampler thread: one background pthread ticks every second and walks all
+// registered samplers calling take_sample().
+// Capability parity: reference src/bvar/detail/sampler.cpp:52-109
+// (SamplerCollector). Windows, PerSecond, Percentile windows and
+// LatencyRecorder all hang off this.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+
+namespace tbvar {
+namespace detail {
+
+class Sampler {
+ public:
+  Sampler() = default;
+  virtual ~Sampler();
+
+  Sampler(const Sampler&) = delete;
+  Sampler& operator=(const Sampler&) = delete;
+
+  // Called from the collector thread once per second.
+  virtual void take_sample() = 0;
+
+  // Register with the collector thread (starts it on first use).
+  void schedule();
+  // Unregister; blocks until the collector is guaranteed not to be inside
+  // take_sample() of this sampler. Must be called before the subclass state
+  // that take_sample() touches is destroyed (destructor does it too).
+  void destroy();
+
+ private:
+  bool _scheduled = false;
+};
+
+// A bounded queue of (value, timestamp) pairs — the per-second history a
+// Window reads. Owned by ReducerSampler/PercentileSampler below.
+template <typename T>
+struct SampleQueue {
+  struct Sample {
+    T value{};
+    int64_t time_us = 0;
+  };
+  std::deque<Sample> q;
+  size_t max_size = 0;
+
+  void push(T v, int64_t now_us) {
+    q.push_back(Sample{std::move(v), now_us});
+    while (q.size() > max_size) q.pop_front();
+  }
+};
+
+// Guards every SampleQueue (samples are read rarely; one mutex per sampler).
+// Defined here so Window and LatencyRecorder can lock while reading.
+class SamplerWithQueueBase : public Sampler {
+ public:
+  std::mutex queue_mutex;
+};
+
+// Samples a Reducer every second.
+//  - Ops with an inverse (Adder): store the cumulative value; a window's
+//    value is newest - sample_before_window.
+//  - Ops without (Maxer/Miner): store get_and_reset(); a window's value is
+//    the op-combine of the samples inside it.
+// Mirrors reference src/bvar/detail/sampler.h ReducerSampler semantics.
+template <typename R, typename T>
+class ReducerSampler : public SamplerWithQueueBase {
+ public:
+  explicit ReducerSampler(R* reducer, size_t window_size)
+      : _reducer(reducer) {
+    _queue.max_size = window_size + 1;
+    schedule();
+  }
+  ~ReducerSampler() override { destroy(); }
+
+  void take_sample() override;
+
+  // Value over the trailing `window_size` seconds (<= configured max).
+  T window_value(size_t window_size);
+
+ private:
+  R* _reducer;
+  SampleQueue<T> _queue;
+};
+
+int64_t sampler_now_us();
+
+template <typename R, typename T>
+void ReducerSampler<R, T>::take_sample() {
+  T v;
+  if constexpr (R::op_has_inverse()) {
+    v = _reducer->get_value();
+  } else {
+    v = _reducer->get_and_reset();
+  }
+  std::lock_guard<std::mutex> lk(queue_mutex);
+  _queue.push(v, sampler_now_us());
+}
+
+template <typename R, typename T>
+T ReducerSampler<R, T>::window_value(size_t window_size) {
+  std::lock_guard<std::mutex> lk(queue_mutex);
+  if (_queue.q.empty()) {
+    if constexpr (R::op_has_inverse()) {
+      // No sample yet: the whole history is the window.
+      return _reducer->get_value();
+    } else {
+      return R::op_identity();
+    }
+  }
+  if constexpr (R::op_has_inverse()) {
+    T newest = _reducer->get_value();
+    // Sample window_size ticks back (or the oldest we kept).
+    size_t n = _queue.q.size();
+    size_t idx = n > window_size ? n - window_size - 1 : 0;
+    // When we have fewer samples than the window, fall back to "since
+    // start": subtract nothing (the oldest sample already includes
+    // pre-history, so use it only when it is a true window boundary).
+    if (n > window_size) {
+      T base = _queue.q[idx].value;
+      R::op_inverse(newest, base);
+      return newest;
+    }
+    return newest;
+  } else {
+    T r = R::op_identity();
+    size_t n = _queue.q.size();
+    size_t start = n > window_size ? n - window_size : 0;
+    for (size_t i = start; i < n; ++i) {
+      R::op_apply(r, _queue.q[i].value);
+    }
+    return r;
+  }
+}
+
+}  // namespace detail
+}  // namespace tbvar

@@ -1,0 +1,10 @@
+// Umbrella header for the metrics layer (reference src/bvar/bvar.h).
+#pragma once
+
+#include "tbvar/latency_recorder.h"
+#include "tbvar/passive_status.h"
+#include "tbvar/percentile.h"
+#include "tbvar/prometheus.h"
+#include "tbvar/reducer.h"
+#include "tbvar/variable.h"
+#include "tbvar/window.h"

@@ -1,0 +1,89 @@
+#include "tbvar/variable.h"
+
+#include <unordered_map>
+
+namespace tbvar {
+
+namespace {
+struct Registry {
+  std::mutex mu;
+  std::unordered_map<std::string, Variable*> vars;
+};
+Registry& registry() {
+  static Registry* r = new Registry;  // leaked: outlives static destructors
+  return *r;
+}
+}  // namespace
+
+std::string to_underscored_name(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (char c : in) {
+    if ((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+        (c >= '0' && c <= '9') || c == '_' || c == ':') {
+      out.push_back(c);
+    } else {
+      out.push_back('_');
+    }
+  }
+  return out;
+}
+
+Variable::~Variable() { hide(); }
+
+int Variable::expose(const std::string& name) {
+  hide();
+  std::string n = to_underscored_name(name);
+  if (n.empty()) return -1;
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.vars.find(n);
+  if (it != r.vars.end() && it->second != this) return -1;
+  r.vars[n] = this;
+  _name = std::move(n);
+  return 0;
+}
+
+bool Variable::hide() {
+  if (_name.empty()) return false;
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.vars.erase(_name);
+  _name.clear();
+  return true;
+}
+
+bool Variable::describe_exposed(const std::string& name, std::ostream& os) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.vars.find(name);
+  if (it == r.vars.end()) return false;
+  it->second->describe(os);
+  return true;
+}
+
+void Variable::list_exposed(std::vector<std::string>* names) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  names->clear();
+  names->reserve(r.vars.size());
+  for (const auto& kv : r.vars) names->push_back(kv.first);
+}
+
+size_t Variable::count_exposed() {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  return r.vars.size();
+}
+
+void Variable::dump_exposed(std::map<std::string, std::string>* out) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  for (const auto& kv : r.vars) {
+    std::ostringstream oss;
+    kv.second->describe(oss);
+    (*out)[kv.first] = oss.str();
+  }
+}
+
+}  // namespace tbvar

@@ -1,0 +1,59 @@
+// Variable: base class of all metrics + the global name registry.
+// Capability parity: reference src/bvar/variable.h:118-145 (expose/describe/
+// dump_exposed, global registry). Design difference: we keep a single
+// mutex-guarded registry (reads are rare: /vars page, Prometheus scrape);
+// the write-mostly hot path lives entirely in reducer.h per-thread agents.
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <ostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace tbvar {
+
+class Variable {
+ public:
+  Variable() = default;
+  virtual ~Variable();
+
+  Variable(const Variable&) = delete;
+  Variable& operator=(const Variable&) = delete;
+
+  // Print the current value. The only pure-virtual: everything else
+  // (registry, dumping) is shared machinery.
+  virtual void describe(std::ostream& os) const = 0;
+
+  std::string get_description() const {
+    std::ostringstream oss;
+    describe(oss);
+    return oss.str();
+  }
+
+  // Register under `name` (replaces '.', ' ', '-' with '_', like the
+  // reference's to_underscored_name). Returns 0 on success, -1 if the name is
+  // already taken by another variable.
+  int expose(const std::string& name);
+  // Remove from the registry. Returns true if it was exposed.
+  bool hide();
+
+  const std::string& name() const { return _name; }
+  bool is_hidden() const { return _name.empty(); }
+
+  // --- registry-wide operations ---
+  static bool describe_exposed(const std::string& name, std::ostream& os);
+  static void list_exposed(std::vector<std::string>* names);
+  static size_t count_exposed();
+  // name -> described value for every exposed variable.
+  static void dump_exposed(std::map<std::string, std::string>* out);
+
+ protected:
+  std::string _name;  // empty when hidden
+};
+
+// Normalizes a metric name: [a-zA-Z0-9_:] kept, everything else -> '_'.
+std::string to_underscored_name(const std::string& in);
+
+}  // namespace tbvar

@@ -1,0 +1,74 @@
+// Window / PerSecond: trailing-window views over a Reducer.
+// Capability parity: reference src/bvar/window.h:174 (Window), :197
+// (PerSecond), fed by the sampler thread (detail/sampler.cpp).
+#pragma once
+
+#include <memory>
+#include <ostream>
+
+#include "tbvar/sampler.h"
+#include "tbvar/variable.h"
+
+namespace tbvar {
+
+// Test/bench hook (defined in sampler.cpp): take one sample tick now.
+void take_sample_now();
+
+constexpr int kDefaultWindowSize = 10;  // seconds
+
+template <typename R>
+class Window : public Variable {
+ public:
+  using value_type = decltype(std::declval<R&>().get_value());
+
+  explicit Window(R* reducer, int window_size = kDefaultWindowSize)
+      : _reducer(reducer),
+        _window_size(window_size > 0 ? window_size : kDefaultWindowSize),
+        _sampler(new detail::ReducerSampler<R, value_type>(reducer,
+                                                           _window_size)) {}
+  Window(const std::string& name, R* reducer,
+         int window_size = kDefaultWindowSize)
+      : Window(reducer, window_size) {
+    expose(name);
+  }
+
+  value_type get_value() const {
+    return _sampler->window_value(_window_size);
+  }
+
+  int window_size() const { return _window_size; }
+
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  R* _reducer;
+  int _window_size;
+  std::unique_ptr<detail::ReducerSampler<R, value_type>> _sampler;
+};
+
+// PerSecond: Window divided by its length — only meaningful over Adder-like
+// reducers (reference src/bvar/window.h:197).
+template <typename R>
+class PerSecond : public Variable {
+ public:
+  using value_type = decltype(std::declval<R&>().get_value());
+
+  explicit PerSecond(R* reducer, int window_size = kDefaultWindowSize)
+      : _window(reducer, window_size) {}
+  PerSecond(const std::string& name, R* reducer,
+            int window_size = kDefaultWindowSize)
+      : _window(reducer, window_size) {
+    expose(name);
+  }
+
+  value_type get_value() const {
+    return _window.get_value() / _window.window_size();
+  }
+
+  void describe(std::ostream& os) const override { os << get_value(); }
+
+ private:
+  Window<R> _window;
+};
+
+}  // namespace tbvar
