@@ -1,0 +1,119 @@
+// Drives the transport layer as an external consumer: Acceptor + Socket +
+// InputMessenger over loopback TCP with a toy length-prefixed protocol.
+// The pre-RPC analog of the reference's example/echo_c++.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+
+#include "tbthread/sync.h"
+#include "tbutil/endpoint.h"
+#include "trpc/acceptor.h"
+#include "trpc/input_messenger.h"
+#include "trpc/socket.h"
+#include "trpc/socket_map.h"
+
+using namespace trpc;
+
+namespace {
+
+struct DemoMsg : InputMessageBase {
+  tbutil::IOBuf payload;
+};
+
+tbthread::CountdownEvent* g_done = nullptr;
+
+ParseResult demo_parse(tbutil::IOBuf* source, Socket*) {
+  ParseResult r;
+  char hdr[8];
+  if (source->size() < 8) { r.error = PARSE_ERROR_NOT_ENOUGH_DATA; return r; }
+  source->copy_to(hdr, 8);
+  if (memcmp(hdr, "DEMO", 4) != 0) { r.error = PARSE_ERROR_TRY_OTHERS; return r; }
+  uint32_t len;
+  memcpy(&len, hdr + 4, 4);
+  if (source->size() < 8 + len) { r.error = PARSE_ERROR_NOT_ENOUGH_DATA; return r; }
+  source->pop_front(8);
+  auto* m = new DemoMsg;
+  source->cutn(&m->payload, len);
+  r.error = PARSE_OK;
+  r.msg = m;
+  return r;
+}
+
+void frame(tbutil::IOBuf* out, const tbutil::IOBuf& payload) {
+  out->append("DEMO", 4);
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  out->append(&len, 4);
+  out->append(payload);
+}
+
+void serve(InputMessageBase* base) {
+  auto* m = static_cast<DemoMsg*>(base);
+  SocketUniquePtr s;
+  if (Socket::Address(m->socket_id, &s) == 0) {
+    tbutil::IOBuf out;
+    frame(&out, m->payload);
+    s->Write(&out);
+  }
+  delete m;
+}
+
+void on_response(InputMessageBase* base) {
+  auto* m = static_cast<DemoMsg*>(base);
+  printf("client got: %s\n", m->payload.to_string().c_str());
+  delete m;
+  g_done->signal();
+}
+
+}  // namespace
+
+int main() {
+  Protocol p;
+  p.parse = demo_parse;
+  p.pack_request = nullptr;
+  p.process_request = serve;
+  p.process_response = on_response;
+  p.name = "demo";
+  if (RegisterProtocol(0, p) != 0) { fprintf(stderr, "register failed\n"); return 1; }
+
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(lfd, 16) != 0) {
+    perror("listen");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  tbutil::EndPoint pt(addr.sin_addr, ntohs(addr.sin_port));
+  printf("serving on %s\n", tbutil::endpoint2str(pt).c_str());
+
+  Acceptor acceptor;
+  if (acceptor.StartAccept(lfd, nullptr) != 0) { fprintf(stderr, "accept failed\n"); return 1; }
+
+  tbthread::CountdownEvent done(3);
+  g_done = &done;
+  SocketUniquePtr sock;
+  if (SocketMap::global().GetOrCreate(pt, &sock) != 0 ||
+      sock->ConnectIfNot() != 0) {
+    fprintf(stderr, "connect failed\n");
+    return 1;
+  }
+  for (int i = 0; i < 3; ++i) {
+    tbutil::IOBuf req, payload;
+    char text[64];
+    snprintf(text, sizeof(text), "ping #%d over the wait-free write queue", i);
+    payload.append(text);
+    frame(&req, payload);
+    if (sock->Write(&req) != 0) { fprintf(stderr, "write failed\n"); return 1; }
+  }
+  done.wait();
+  acceptor.StopAccept();
+  printf("transport demo OK\n");
+  return 0;
+}

@@ -1,0 +1,260 @@
+// Transport-layer tests: Socket lifecycle (versioned refs), wait-free write,
+// epoll dispatch, Acceptor, InputMessenger parse pipeline — over real
+// loopback TCP, the same way the reference tests do
+// (test/brpc_socket_unittest.cpp; no mock network).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include "mini_test.h"
+#include "tbthread/sync.h"
+#include "tbutil/endpoint.h"
+#include "trpc/acceptor.h"
+#include "trpc/errno.h"
+#include "trpc/event_dispatcher.h"
+#include "trpc/input_messenger.h"
+#include "trpc/socket.h"
+#include "trpc/socket_map.h"
+
+using namespace trpc;
+
+// ---- a toy length-prefixed protocol: "ECHO" u32len payload ----
+
+namespace {
+
+struct EchoMsg : InputMessageBase {
+  tbutil::IOBuf payload;
+};
+
+std::atomic<int> g_server_got{0};
+std::atomic<int> g_client_got{0};
+tbthread::CountdownEvent* g_client_done = nullptr;
+std::string g_last_client_payload;
+std::mutex g_payload_mu;
+
+ParseResult echo_parse(tbutil::IOBuf* source, Socket*) {
+  ParseResult r;
+  if (source->size() < 8) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  char hdr[8];
+  source->copy_to(hdr, 8);
+  if (memcmp(hdr, "ECHO", 4) != 0) {
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  uint32_t len;
+  memcpy(&len, hdr + 4, 4);
+  if (source->size() < 8 + len) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  source->pop_front(8);
+  auto* msg = new EchoMsg;
+  source->cutn(&msg->payload, len);
+  r.error = PARSE_OK;
+  r.msg = msg;
+  return r;
+}
+
+void echo_frame(tbutil::IOBuf* out, const tbutil::IOBuf& payload) {
+  out->append("ECHO", 4);
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  out->append(&len, 4);
+  out->append(payload);
+}
+
+void echo_process_request(InputMessageBase* base) {
+  auto* msg = static_cast<EchoMsg*>(base);
+  g_server_got.fetch_add(1);
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) == 0) {
+    tbutil::IOBuf out;
+    echo_frame(&out, msg->payload);
+    s->Write(&out);
+  }
+  delete msg;
+}
+
+void echo_process_response(InputMessageBase* base) {
+  auto* msg = static_cast<EchoMsg*>(base);
+  g_client_got.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(g_payload_mu);
+    g_last_client_payload = msg->payload.to_string();
+  }
+  if (g_client_done != nullptr) g_client_done->signal();
+  delete msg;
+}
+
+void register_echo_protocol_once() {
+  static bool done = [] {
+    Protocol p;
+    p.parse = echo_parse;
+    p.pack_request = nullptr;
+    p.process_request = echo_process_request;
+    p.process_response = echo_process_response;
+    p.name = "echo-test";
+    return RegisterProtocol(0, p) == 0;
+  }();
+  ASSERT_TRUE(done);
+}
+
+int make_listen_socket(tbutil::EndPoint* pt) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;  // ephemeral
+  int rc = bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc != 0) return -1;
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  pt->ip = addr.sin_addr;
+  pt->port = ntohs(addr.sin_port);
+  if (listen(fd, 128) != 0) return -1;
+  return fd;
+}
+
+}  // namespace
+
+TEST_CASE(versioned_ref_lifecycle) {
+  Socket::Options opt;
+  opt.fd = -1;
+  SocketId sid;
+  ASSERT_EQ(Socket::Create(opt, &sid), 0);
+  SocketUniquePtr a, b;
+  ASSERT_EQ(Socket::Address(sid, &a), 0);
+  ASSERT_EQ(Socket::Address(sid, &b), 0);
+  ASSERT_TRUE(a.get() == b.get());
+  ASSERT_EQ(a->SetFailed(TRPC_EFAILEDSOCKET), 0);
+  // Address fails immediately after SetFailed.
+  SocketUniquePtr c;
+  ASSERT_TRUE(Socket::Address(sid, &c) != 0);
+  // Double SetFailed fails.
+  ASSERT_TRUE(a->SetFailed(TRPC_EFAILEDSOCKET) != 0);
+  a.reset();
+  b.reset();  // last ref: recycles
+  // Slot reuse must produce a DIFFERENT id.
+  SocketId sid2;
+  ASSERT_EQ(Socket::Create(opt, &sid2), 0);
+  ASSERT_TRUE(sid2 != sid);
+  SocketUniquePtr d;
+  ASSERT_EQ(Socket::Address(sid2, &d), 0);
+  d->SetFailed(TRPC_EFAILEDSOCKET);
+}
+
+TEST_CASE(echo_roundtrip_over_loopback) {
+  register_echo_protocol_once();
+  tbutil::EndPoint pt;
+  int lfd = make_listen_socket(&pt);
+  ASSERT_TRUE(lfd >= 0);
+  Acceptor acceptor;
+  ASSERT_EQ(acceptor.StartAccept(lfd, nullptr), 0);
+
+  g_client_got.store(0);
+  g_server_got.store(0);
+  tbthread::CountdownEvent done(1);
+  g_client_done = &done;
+
+  SocketUniquePtr sock;
+  ASSERT_EQ(SocketMap::global().GetOrCreate(pt, &sock), 0);
+  ASSERT_EQ(sock->ConnectIfNot(), 0);
+
+  tbutil::IOBuf req, payload;
+  payload.append("hello transport");
+  echo_frame(&req, payload);
+  ASSERT_EQ(sock->Write(&req), 0);
+
+  done.wait();
+  ASSERT_EQ(g_client_got.load(), 1);
+  ASSERT_EQ(g_server_got.load(), 1);
+  {
+    std::lock_guard<std::mutex> lk(g_payload_mu);
+    ASSERT_EQ(g_last_client_payload, std::string("hello transport"));
+  }
+  g_client_done = nullptr;
+  acceptor.StopAccept();
+}
+
+TEST_CASE(many_messages_pipelined) {
+  register_echo_protocol_once();
+  tbutil::EndPoint pt;
+  int lfd = make_listen_socket(&pt);
+  ASSERT_TRUE(lfd >= 0);
+  Acceptor acceptor;
+  ASSERT_EQ(acceptor.StartAccept(lfd, nullptr), 0);
+
+  constexpr int kMsgs = 2000;
+  g_client_got.store(0);
+  g_server_got.store(0);
+  tbthread::CountdownEvent done(kMsgs);
+  g_client_done = &done;
+
+  SocketUniquePtr sock;
+  ASSERT_EQ(SocketMap::global().GetOrCreate(pt, &sock), 0);
+  ASSERT_EQ(sock->ConnectIfNot(), 0);
+
+  // Hammer from multiple threads: exercises the wait-free write queue
+  // (producers chaining onto _write_head while KeepWrite drains).
+  std::vector<std::thread> writers;
+  for (int t = 0; t < 4; ++t) {
+    writers.emplace_back([&sock, t] {
+      for (int i = 0; i < kMsgs / 4; ++i) {
+        tbutil::IOBuf req, payload;
+        std::string body(128 + (i % 512), 'a' + (t % 26));
+        payload.append(body);
+        echo_frame(&req, payload);
+        ASSERT_EQ(sock->Write(&req), 0);
+      }
+    });
+  }
+  for (auto& w : writers) w.join();
+
+  done.wait();
+  ASSERT_EQ(g_client_got.load(), kMsgs);
+  ASSERT_EQ(g_server_got.load(), kMsgs);
+  g_client_done = nullptr;
+  acceptor.StopAccept();
+}
+
+TEST_CASE(connect_refused) {
+  tbutil::EndPoint pt;
+  tbutil::str2endpoint("127.0.0.1:1", &pt);  // nothing listens on port 1
+  Socket::Options opt;
+  opt.fd = -1;
+  opt.remote_side = pt;
+  opt.messenger = InputMessenger::client_messenger();
+  SocketId sid;
+  ASSERT_EQ(Socket::Create(opt, &sid), 0);
+  SocketUniquePtr s;
+  ASSERT_EQ(Socket::Address(sid, &s), 0);
+  ASSERT_TRUE(s->ConnectIfNot() != 0);
+  // A failed connect fails the socket itself (waking queued writers and
+  // erroring pending ids): the id must be dead without manual SetFailed.
+  ASSERT_TRUE(s->Failed());
+  SocketUniquePtr again;
+  ASSERT_TRUE(Socket::Address(sid, &again) != 0);
+}
+
+TEST_CASE(write_to_failed_socket_rejected) {
+  Socket::Options opt;
+  opt.fd = -1;
+  SocketId sid;
+  ASSERT_EQ(Socket::Create(opt, &sid), 0);
+  SocketUniquePtr s;
+  ASSERT_EQ(Socket::Address(sid, &s), 0);
+  s->SetFailed(TRPC_EFAILEDSOCKET);
+  tbutil::IOBuf b;
+  b.append("x");
+  ASSERT_TRUE(s->Write(&b) != 0);
+}
+
+TEST_MAIN

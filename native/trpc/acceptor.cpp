@@ -1,0 +1,113 @@
+#include "trpc/acceptor.h"
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <vector>
+
+#include "tbthread/fiber.h"
+#include "tbutil/logging.h"
+#include "trpc/errno.h"
+
+namespace trpc {
+
+void AcceptMessenger::OnNewMessages(Socket* listen_socket) {
+  while (true) {
+    sockaddr_in addr{};
+    socklen_t len = sizeof(addr);
+    int fd = accept4(listen_socket->fd(), reinterpret_cast<sockaddr*>(&addr),
+                     &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      if (errno == EMFILE || errno == ENFILE) {
+        // Out of fds. Sleep-and-retry instead of returning: under EPOLLET
+        // the backlog produces no further edges, so returning would strand
+        // connections already queued (reference acceptor does the same).
+        TB_LOG(ERROR) << "accept: out of fds, retrying";
+        tbthread::fiber_usleep(30000);
+        if (listen_socket->Failed()) return;
+        continue;
+      }
+      TB_LOG(ERROR) << "accept failed: " << strerror(errno);
+      return;
+    }
+    tbutil::EndPoint remote(addr.sin_addr, ntohs(addr.sin_port));
+    _owner->OnNewConnection(fd, remote);
+  }
+}
+
+Acceptor::~Acceptor() { StopAccept(); }
+
+int Acceptor::StartAccept(int listen_fd, void* user) {
+  _user = user;
+  Socket::Options opt;
+  opt.fd = listen_fd;
+  opt.messenger = &_accept_messenger;
+  opt.server_side = true;
+  opt.user = this;
+  return Socket::Create(opt, &_listen_sid);
+}
+
+void Acceptor::OnNewConnection(int fd, const tbutil::EndPoint& remote) {
+  Socket::Options opt;
+  opt.fd = fd;
+  opt.remote_side = remote;
+  opt.messenger = this;  // data parsing = the server-side pipeline
+  opt.server_side = true;
+  opt.user = _user;
+  SocketId sid;
+  if (Socket::Create(opt, &sid) != 0) {
+    close(fd);
+    return;
+  }
+  std::lock_guard<std::mutex> lk(_conn_mu);
+  _connections.insert(sid);
+  // Lazily shed dead entries so the set tracks live connections.
+  if (_connections.size() % 64 == 0) {
+    for (auto it = _connections.begin(); it != _connections.end();) {
+      SocketUniquePtr s;
+      if (Socket::Address(*it, &s) != 0) {
+        it = _connections.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+}
+
+void Acceptor::StopAccept() {
+  if (_listen_sid != INVALID_SOCKET_ID) {
+    SocketUniquePtr ls;
+    if (Socket::Address(_listen_sid, &ls) == 0) {
+      ls->SetFailed(TRPC_EFAILEDSOCKET);
+    }
+    _listen_sid = INVALID_SOCKET_ID;
+  }
+  std::vector<SocketId> conns;
+  {
+    std::lock_guard<std::mutex> lk(_conn_mu);
+    conns.assign(_connections.begin(), _connections.end());
+    _connections.clear();
+  }
+  for (SocketId sid : conns) {
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) == 0) {
+      s->SetFailed(TRPC_EFAILEDSOCKET);
+    }
+  }
+}
+
+size_t Acceptor::connection_count() const {
+  std::lock_guard<std::mutex> lk(_conn_mu);
+  size_t n = 0;
+  for (SocketId sid : _connections) {
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) == 0) ++n;
+  }
+  return n;
+}
+
+}  // namespace trpc

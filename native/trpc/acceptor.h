@@ -1,0 +1,58 @@
+// Acceptor: event-driven accept(2) feeding new connections into the
+// server's parse pipeline.
+// Capability parity: reference src/brpc/acceptor.h:34-84 (the Acceptor IS an
+// InputMessenger; StartAccept / OnNewConnectionsUntilEAGAIN; tracks accepted
+// sockets so Server::Stop can close them).
+//
+// Design: the listen fd is itself a Socket whose messenger is a private
+// AcceptMessenger — "readable" on it means "connections pending", so accepts
+// ride the same epoll/fiber machinery as data (no dedicated accept thread).
+#pragma once
+
+#include <mutex>
+#include <unordered_set>
+
+#include "trpc/input_messenger.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+class Acceptor;
+
+// Messenger of the LISTEN socket: OnNewMessages = accept until EAGAIN.
+class AcceptMessenger : public InputMessenger {
+ public:
+  explicit AcceptMessenger(Acceptor* owner)
+      : InputMessenger(true), _owner(owner) {}
+  void OnNewMessages(Socket* listen_socket) override;
+
+ private:
+  Acceptor* _owner;
+};
+
+class Acceptor : public InputMessenger {
+ public:
+  Acceptor() : InputMessenger(true), _accept_messenger(this) {}
+  ~Acceptor() override;
+
+  // Takes ownership of `listen_fd` (already bound + listening). `user` is
+  // attached to every accepted socket (the Server*).
+  int StartAccept(int listen_fd, void* user);
+  // Close the listen fd and fail every accepted connection.
+  void StopAccept();
+
+  size_t connection_count() const;
+
+ private:
+  friend class AcceptMessenger;
+  void OnNewConnection(int fd, const tbutil::EndPoint& remote);
+
+  AcceptMessenger _accept_messenger;
+  SocketId _listen_sid = INVALID_SOCKET_ID;
+  void* _user = nullptr;
+
+  mutable std::mutex _conn_mu;
+  std::unordered_set<SocketId> _connections;
+};
+
+}  // namespace trpc

@@ -1,0 +1,30 @@
+// Framework error codes, disjoint from system errnos.
+// Capability parity: reference src/brpc/errno.proto + errno.cpp
+// (ERPCTIMEDOUT=1008, EOVERCROWDED=2006, EFAILEDSOCKET etc.).
+#pragma once
+
+namespace trpc {
+
+enum RpcError {
+  // connection
+  TRPC_EEOF = 2001,            // peer closed the connection
+  TRPC_EFAILEDSOCKET = 2002,   // the socket was SetFailed while in use
+  TRPC_EOVERCROWDED = 2006,    // write queue over the in-flight cap
+  TRPC_ECONNECT = 2007,        // connect failed
+  // rpc
+  TRPC_ERPCTIMEDOUT = 1008,    // RPC deadline exceeded
+  TRPC_EBACKUPREQUEST = 1009,  // internal: backup-request timer fired
+  TRPC_ENOSERVICE = 1001,      // no such service
+  TRPC_ENOMETHOD = 1002,       // no such method
+  TRPC_EREQUEST = 1003,        // malformed request
+  TRPC_EINTERNAL = 2004,       // server internal error
+  TRPC_ERESPONSE = 1005,       // malformed response
+  TRPC_ELIMIT = 1011,          // concurrency limit rejected the request
+  TRPC_ECANCELED = 1012,       // RPC canceled by caller
+  TRPC_ENODATA = 1013,         // no server available from LB/naming
+};
+
+// Human-readable name for framework + system errors.
+const char* rpc_error_text(int error);
+
+}  // namespace trpc

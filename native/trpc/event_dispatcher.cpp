@@ -1,0 +1,103 @@
+#include "trpc/event_dispatcher.h"
+
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <thread>
+
+#include "tbutil/logging.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+EventDispatcher::EventDispatcher()
+    : _epfd(-1), _wakeup_fds{-1, -1}, _started(false), _thread(nullptr) {}
+
+EventDispatcher::~EventDispatcher() { Stop(); }
+
+int EventDispatcher::Start() {
+  if (_started) return 0;
+  _epfd = epoll_create1(EPOLL_CLOEXEC);
+  if (_epfd < 0) return -1;
+  if (pipe(_wakeup_fds) != 0) {
+    close(_epfd);
+    _epfd = -1;
+    return -1;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = ~uint64_t(0);  // wakeup marker
+  epoll_ctl(_epfd, EPOLL_CTL_ADD, _wakeup_fds[0], &ev);
+  _started = true;
+  _thread = new std::thread([this] { Run(); });
+  return 0;
+}
+
+void EventDispatcher::Stop() {
+  if (!_started) return;
+  _started = false;
+  ssize_t unused = write(_wakeup_fds[1], "q", 1);
+  (void)unused;
+  auto* t = static_cast<std::thread*>(_thread);
+  t->join();
+  delete t;
+  _thread = nullptr;
+  close(_epfd);
+  close(_wakeup_fds[0]);
+  close(_wakeup_fds[1]);
+  _epfd = -1;
+}
+
+int EventDispatcher::AddConsumer(SocketId sid, int fd) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLOUT | EPOLLET;
+  ev.data.u64 = sid;
+  return epoll_ctl(_epfd, EPOLL_CTL_ADD, fd, &ev);
+}
+
+int EventDispatcher::RemoveConsumer(int fd) {
+  return epoll_ctl(_epfd, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+void EventDispatcher::Run() {
+  constexpr int kMaxEvents = 64;
+  epoll_event evs[kMaxEvents];
+  while (true) {
+    int n = epoll_wait(_epfd, evs, kMaxEvents, -1);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      TB_LOG(ERROR) << "epoll_wait failed: " << strerror(errno);
+      return;
+    }
+    for (int i = 0; i < n; ++i) {
+      if (evs[i].data.u64 == ~uint64_t(0)) {
+        if (!_started) return;  // wakeup for shutdown
+        char buf[16];
+        ssize_t unused = read(_wakeup_fds[0], buf, sizeof(buf));
+        (void)unused;
+        continue;
+      }
+      const SocketId sid = evs[i].data.u64;
+      const uint32_t e = evs[i].events;
+      if (e & (EPOLLOUT | EPOLLERR | EPOLLHUP)) {
+        Socket::HandleEpollOut(sid);
+      }
+      if (e & (EPOLLIN | EPOLLERR | EPOLLHUP)) {
+        Socket::StartInputEvent(sid);
+      }
+    }
+  }
+}
+
+EventDispatcher& EventDispatcher::global() {
+  static EventDispatcher* d = []() {
+    auto* d = new EventDispatcher;
+    d->Start();
+    return d;
+  }();
+  return *d;
+}
+
+}  // namespace trpc

@@ -1,0 +1,43 @@
+// EventDispatcher: epoll pthread(s) translating fd readiness into fiber work.
+// Capability parity: reference src/brpc/event_dispatcher.h:122-241
+// (AddConsumer edge-triggered read events starting a ProcessEvent bthread;
+// RegisterEvent/UnregisterEvent for EPOLLOUT wakeups of connect/KeepWrite).
+//
+// Design difference: one permanent edge-triggered registration per fd with
+// EPOLLIN|EPOLLOUT. Under EPOLLET, EPOLLOUT fires only on not-writable →
+// writable transitions, so keeping it armed costs nothing in steady state and
+// removes the reference's add/remove-epollout churn entirely. data.u64
+// carries the SocketId: a stale event after socket death resolves to a failed
+// Address() — never a dangling pointer.
+#pragma once
+
+#include <cstdint>
+
+namespace trpc {
+
+using SocketId = uint64_t;
+
+class EventDispatcher {
+ public:
+  EventDispatcher();
+  ~EventDispatcher();
+
+  int Start();  // idempotent
+  void Stop();
+
+  // Register fd (EPOLLIN|EPOLLOUT|EPOLLET). Readable edges start the
+  // socket's input fiber; writable edges wake its epollout butex.
+  int AddConsumer(SocketId sid, int fd);
+  int RemoveConsumer(int fd);
+
+  static EventDispatcher& global();
+
+ private:
+  void Run();
+  int _epfd;
+  int _wakeup_fds[2];
+  bool _started;
+  void* _thread;  // std::thread*, opaque to keep the header light
+};
+
+}  // namespace trpc

@@ -1,0 +1,39 @@
+// InputMessenger: the protocol-multiplexing read pipeline. One instance for
+// all client sockets, one per Server (its Acceptor shares it).
+// Capability parity: reference src/brpc/input_messenger.h/.cpp:361
+// (OnNewMessages: DoRead loop -> CutInputMessage trying last-used protocol
+// then all -> per-message processing fiber, last message inline).
+#pragma once
+
+#include <cstddef>
+
+#include "trpc/protocol.h"
+
+namespace trpc {
+
+class Socket;
+
+class InputMessenger {
+ public:
+  // server_side: dispatch parsed messages to process_request (vs response).
+  explicit InputMessenger(bool server_side) : _server_side(server_side) {}
+  virtual ~InputMessenger() = default;
+
+  // Read everything available on `s` (until EAGAIN/EOF), cutting and
+  // dispatching complete messages. Runs in the socket's input fiber.
+  virtual void OnNewMessages(Socket* s);
+
+  bool server_side() const { return _server_side; }
+
+  // The process-wide messenger for client-created sockets.
+  static InputMessenger* client_messenger();
+
+ private:
+  // Try the socket's preferred protocol, then all registered. Returns
+  // PARSE_OK with a message, NOT_ENOUGH_DATA, or ABSOLUTELY_WRONG.
+  ParseResult CutInputMessage(Socket* s, int* protocol_index);
+
+  bool _server_side;
+};
+
+}  // namespace trpc

@@ -1,0 +1,39 @@
+#include "trpc/protocol.h"
+
+#include <atomic>
+#include <mutex>
+
+#include "tbutil/logging.h"
+
+namespace trpc {
+
+namespace {
+struct Registry {
+  std::mutex mu;
+  Protocol protocols[kMaxProtocols];
+  std::atomic<bool> present[kMaxProtocols];
+};
+Registry& registry() {
+  static Registry* r = new Registry;
+  return *r;
+}
+}  // namespace
+
+int RegisterProtocol(int index, const Protocol& proto) {
+  if (index < 0 || index >= kMaxProtocols) return -1;
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  if (r.present[index].load(std::memory_order_relaxed)) return -1;
+  r.protocols[index] = proto;
+  r.present[index].store(true, std::memory_order_release);
+  return 0;
+}
+
+const Protocol* GetProtocol(int index) {
+  if (index < 0 || index >= kMaxProtocols) return nullptr;
+  Registry& r = registry();
+  if (!r.present[index].load(std::memory_order_acquire)) return nullptr;
+  return &r.protocols[index];
+}
+
+}  // namespace trpc

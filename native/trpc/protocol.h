@@ -1,0 +1,63 @@
+// Protocol registry: each wire protocol is a struct of function pointers
+// plugged into the InputMessenger parse pipeline and the Channel pack path.
+// Capability parity: reference src/brpc/protocol.h:77-186 (struct Protocol
+// {parse, serialize_request, pack_request, process_request, process_response,
+// ...}; RegisterProtocol) — all protocols multiplex on one port: the parser
+// that recognizes the bytes wins (PARSE_ERROR_TRY_OTHERS).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+class Socket;
+class Controller;
+
+enum ParseError {
+  PARSE_OK = 0,
+  PARSE_ERROR_NOT_ENOUGH_DATA,  // wait for more bytes
+  PARSE_ERROR_TRY_OTHERS,       // magic mismatch: not this protocol
+  PARSE_ERROR_ABSOLUTELY_WRONG,  // recognized but corrupt: kill connection
+};
+
+// A parsed-but-not-yet-processed inbound message. Concrete protocols extend
+// this with their decoded fields (reference InputMessageBase).
+struct InputMessageBase {
+  uint64_t socket_id = 0;  // re-Address'ed by the process fn
+  int protocol_index = -1;
+  virtual ~InputMessageBase() = default;
+};
+
+struct ParseResult {
+  ParseError error = PARSE_OK;
+  InputMessageBase* msg = nullptr;
+};
+
+struct Protocol {
+  // Cut one message from *source (bytes already read from the socket).
+  // Must not consume bytes unless a full message is cut.
+  ParseResult (*parse)(tbutil::IOBuf* source, Socket* socket);
+  // Client side: frame a request. correlation_id goes on the wire.
+  void (*pack_request)(tbutil::IOBuf* out, Controller* cntl,
+                       uint64_t correlation_id,
+                       const std::string& service_method,
+                       const tbutil::IOBuf& payload);
+  // Server side: run the request (ends by writing a response). Takes
+  // ownership of msg.
+  void (*process_request)(InputMessageBase* msg);
+  // Client side: resolve the correlation id. Takes ownership of msg.
+  void (*process_response)(InputMessageBase* msg);
+  const char* name;
+};
+
+inline constexpr int kMaxProtocols = 16;
+
+// index: stable small int (also stored in Socket's preferred-protocol cache).
+// Returns 0, or -1 if the slot is taken.
+int RegisterProtocol(int index, const Protocol& proto);
+const Protocol* GetProtocol(int index);
+
+}  // namespace trpc

@@ -1,0 +1,498 @@
+#include "trpc/socket.h"
+
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tbthread/fiber.h"
+#include "tbutil/logging.h"
+#include "tbutil/object_pool.h"
+#include "tbutil/time.h"
+#include "trpc/errno.h"
+#include "trpc/event_dispatcher.h"
+#include "trpc/input_messenger.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr int64_t kMaxWriteQueueBytes = 256LL << 20;  // EOVERCROWDED cap
+constexpr int64_t kDefaultConnectTimeoutUs = 1000000;
+
+int make_non_blocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0) return -1;
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void set_no_delay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+struct KeepWriteArg {
+  Socket* sock;  // carries one ref, released by KeepWrite
+  WriteRequest* todo;
+  WriteRequest* last;
+};
+
+}  // namespace
+
+const char* rpc_error_text(int error) {
+  switch (error) {
+    case TRPC_EEOF: return "EOF";
+    case TRPC_EFAILEDSOCKET: return "socket failed";
+    case TRPC_EOVERCROWDED: return "write queue overcrowded";
+    case TRPC_ECONNECT: return "connect failed";
+    case TRPC_ERPCTIMEDOUT: return "RPC timed out";
+    case TRPC_EBACKUPREQUEST: return "backup request";
+    case TRPC_ENOSERVICE: return "no such service";
+    case TRPC_ENOMETHOD: return "no such method";
+    case TRPC_EREQUEST: return "malformed request";
+    case TRPC_EINTERNAL: return "server internal error";
+    case TRPC_ERESPONSE: return "malformed response";
+    case TRPC_ELIMIT: return "rejected by concurrency limit";
+    case TRPC_ECANCELED: return "RPC canceled";
+    case TRPC_ENODATA: return "no server available";
+    default: return strerror(error);
+  }
+}
+
+Socket::Socket() : _epollout_butex(tbthread::butex_create()) {}
+
+Socket::~Socket() { tbthread::butex_destroy(_epollout_butex); }
+
+int Socket::Create(const Options& opt, SocketId* id) {
+  SocketUniquePtr ptr;
+  VRefId vid;
+  if (VersionedRefWithId<Socket>::Create(&ptr, &vid) != 0) return -1;
+  Socket* s = ptr.get();
+  s->_remote_side = opt.remote_side;
+  s->_messenger = opt.messenger;
+  s->_server_side = opt.server_side;
+  s->_user = opt.user;
+  s->_error_code = 0;
+  s->_preferred_protocol = -1;
+  s->_nevent.store(0, std::memory_order_relaxed);
+  s->_write_queue_bytes.store(0, std::memory_order_relaxed);
+  s->_connecting.store(false, std::memory_order_relaxed);
+  s->_fd.store(opt.fd, std::memory_order_release);
+  if (opt.fd >= 0) {
+    make_non_blocking(opt.fd);
+    set_no_delay(opt.fd);
+    if (EventDispatcher::global().AddConsumer(vid, opt.fd) != 0) {
+      // On failure the CALLER keeps ownership of opt.fd: detach it before
+      // the recycle path (OnRecycle must not close a caller-owned fd).
+      s->_fd.store(-1, std::memory_order_release);
+      ptr->SetFailed(errno != 0 ? errno : TRPC_EFAILEDSOCKET);
+      return -1;
+    }
+  }
+  *id = vid;
+  return 0;
+}
+
+int Socket::Address(SocketId id, SocketUniquePtr* out) {
+  return VersionedRefWithId<Socket>::Address(id, out);
+}
+
+int Socket::SetFailed(int error) {
+  return VersionedRefWithId<Socket>::SetFailed(error);
+}
+
+void Socket::OnFailed(int error) {
+  _error_code = error;
+  // Wake connect/KeepWrite parkers: they re-check Failed() and bail.
+  tbthread::butex_increment_and_wake_all(_epollout_butex);
+  // Propagate to every in-flight RPC correlated with this connection.
+  std::vector<tbthread::fiber_id_t> ids;
+  {
+    std::lock_guard<std::mutex> lk(_pending_mu);
+    ids.swap(_pending_ids);
+  }
+  for (tbthread::fiber_id_t id : ids) {
+    tbthread::fiber_id_error(id, error);
+  }
+}
+
+void Socket::OnRecycle() {
+  int fd = _fd.exchange(-1, std::memory_order_acq_rel);
+  if (fd >= 0) {
+    EventDispatcher::global().RemoveConsumer(fd);
+    close(fd);
+  }
+  _read_buf.clear();
+  _messenger = nullptr;
+  _user = nullptr;
+  _nevent.store(0, std::memory_order_relaxed);
+  // The write queue is drained by the active writer before it drops its ref,
+  // so by the time the last ref dies the head is null (or was released by
+  // ReleaseAllWrites on failure).
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  _pending_ids.clear();
+}
+
+void Socket::AddPendingId(tbthread::fiber_id_t id) {
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  _pending_ids.push_back(id);
+}
+
+void Socket::RemovePendingId(tbthread::fiber_id_t id) {
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  for (size_t i = 0; i < _pending_ids.size(); ++i) {
+    if (_pending_ids[i] == id) {
+      _pending_ids[i] = _pending_ids.back();
+      _pending_ids.pop_back();
+      return;
+    }
+  }
+}
+
+// ---------------- write path ----------------
+
+int Socket::Write(tbutil::IOBuf* data, tbthread::fiber_id_t notify_id) {
+  if (Failed()) {
+    errno = TRPC_EFAILEDSOCKET;
+    return -1;
+  }
+  if (_write_queue_bytes.load(std::memory_order_relaxed) >
+      kMaxWriteQueueBytes) {
+    errno = TRPC_EOVERCROWDED;
+    return -1;
+  }
+  WriteRequest* req = tbutil::get_object<WriteRequest>();
+  req->data.clear();
+  req->data.swap(*data);
+  req->next.store(nullptr, std::memory_order_relaxed);
+  req->notify_id = notify_id;
+  _write_queue_bytes.fetch_add(static_cast<int64_t>(req->data.size()),
+                               std::memory_order_relaxed);
+  StartWrite(req);
+  return 0;
+}
+
+void Socket::StartWrite(WriteRequest* req) {
+  // Wait-free enqueue (reference socket.cpp:1696): producers that find a
+  // non-empty head just link behind it and return — only the producer that
+  // installed into an empty head becomes the writer.
+  WriteRequest* prev = _write_head.exchange(req, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    req->next.store(prev, std::memory_order_release);
+    return;
+  }
+  // We are the writer. Write inline once (the common small-message case
+  // finishes here without any context switch), then hand off leftovers.
+  int rc = WriteOnce(req);
+  if (rc < 0) {
+    int err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
+    SetFailed(err);
+    ReleaseAllWrites(req, req, err);
+    return;
+  }
+  if (rc == 1) {
+    WriteRequest* expected = req;
+    if (_write_head.compare_exchange_strong(expected, nullptr,
+                                            std::memory_order_acq_rel)) {
+      tbutil::return_object(req);
+      return;
+    }
+  }
+  // Leftover bytes or new requests arrived: continue in a KeepWrite fiber
+  // so the caller returns immediately (reference socket.cpp:1806).
+  auto* arg = new KeepWriteArg;
+  Ref();
+  arg->sock = this;
+  arg->todo = (rc == 1) ? nullptr : req;
+  arg->last = req;
+  tbthread::fiber_t tid;
+  if (tbthread::fiber_start_background(&tid, nullptr, KeepWriteThunk, arg) !=
+      0) {
+    KeepWriteThunk(arg);  // degrade: write in the caller
+  }
+}
+
+void* Socket::KeepWriteThunk(void* argv) {
+  auto* arg = static_cast<KeepWriteArg*>(argv);
+  Socket* s = arg->sock;
+  s->KeepWrite(arg->todo, arg->last);
+  delete arg;
+  s->Deref();
+  return nullptr;
+}
+
+// todo: FIFO chain of claimed-but-unwritten requests (next = newer, null
+// terminated). last: the newest claimed request — the detach point in
+// _write_head. `last` is only released after a successful detach CAS to
+// prevent pool-reuse ABA on the head pointer.
+void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
+  while (true) {
+    while (todo != nullptr) {
+      if (Failed()) {
+        ReleaseAllWrites(todo, last, _error_code);
+        return;
+      }
+      int rc = WriteOnce(todo);
+      if (rc < 0) {
+        int err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
+        SetFailed(err);
+        ReleaseAllWrites(todo, last, err);
+        return;
+      }
+      if (rc == 0) {
+        WaitEpollOut(0);
+        continue;
+      }
+      WriteRequest* written = todo;
+      todo = todo->next.load(std::memory_order_relaxed);
+      if (written != last) tbutil::return_object(written);
+    }
+    // Everything claimed is on the wire: try to retire the queue.
+    WriteRequest* expected = last;
+    if (_write_head.compare_exchange_strong(expected, nullptr,
+                                            std::memory_order_acq_rel)) {
+      tbutil::return_object(last);
+      return;
+    }
+    // New requests arrived while we wrote. expected = current head
+    // (newest). Walk newest -> older until `last`, reversing into a FIFO
+    // chain. A producer may have exchanged itself in but not yet linked
+    // next: spin for the link (it is two instructions away).
+    WriteRequest* fifo = nullptr;
+    WriteRequest* p = expected;
+    while (p != last) {
+      WriteRequest* older = p->next.load(std::memory_order_acquire);
+      while (older == nullptr) {
+        tbthread::fiber_yield();
+        older = p->next.load(std::memory_order_acquire);
+      }
+      p->next.store(fifo, std::memory_order_relaxed);
+      fifo = p;
+      p = older;
+    }
+    tbutil::return_object(last);
+    todo = fifo;
+    last = expected;
+  }
+}
+
+int Socket::WriteOnce(WriteRequest* req) {
+  const int fd = _fd.load(std::memory_order_acquire);
+  if (fd < 0) {
+    errno = ENOTCONN;
+    return -1;
+  }
+  while (!req->data.empty()) {
+    ssize_t nw = req->data.cut_into_file_descriptor(fd);
+    if (nw < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      return -1;
+    }
+    _write_queue_bytes.fetch_sub(nw, std::memory_order_relaxed);
+  }
+  return 1;
+}
+
+int Socket::WaitEpollOut(int64_t deadline_us) {
+  const int fd = _fd.load(std::memory_order_acquire);
+  if (fd < 0) return -1;
+  const int expected =
+      tbthread::butex_value(_epollout_butex)->load(std::memory_order_acquire);
+  // Close the missed-edge race: if the fd became writable before we
+  // snapshotted the butex, the edge (and its wake) already happened — check
+  // writability non-blockingly before parking.
+  pollfd pfd{fd, POLLOUT, 0};
+  if (poll(&pfd, 1, 0) > 0 && (pfd.revents & (POLLOUT | POLLERR | POLLHUP))) {
+    return 0;
+  }
+  timespec abstime;
+  timespec* pabs = nullptr;
+  if (deadline_us > 0) {
+    abstime.tv_sec = deadline_us / 1000000;
+    abstime.tv_nsec = (deadline_us % 1000000) * 1000;
+    pabs = &abstime;
+  }
+  int rc = tbthread::butex_wait(_epollout_butex, expected, pabs);
+  if (rc != 0 && errno == ETIMEDOUT) return -1;
+  return 0;
+}
+
+// Called only by the active writer, which owns the FIFO chain `todo`
+// terminating at `last` (the detach point in _write_head). Releases the
+// not-yet-claimed suffix hanging off _write_head FIRST — walking newest →
+// older with `last` as the terminator, spinning through producers that
+// exchanged-but-not-yet-linked — then the claimed chain. `last`'s pointer
+// value is needed as the walk terminator, hence this ordering.
+void Socket::ReleaseAllWrites(WriteRequest* todo, WriteRequest* last,
+                              int error) {
+  auto release_one = [this, error](WriteRequest* r) {
+    _write_queue_bytes.fetch_sub(static_cast<int64_t>(r->data.size()),
+                                 std::memory_order_relaxed);
+    if (r->notify_id != 0) {
+      tbthread::fiber_id_error(r->notify_id, error);
+    }
+    r->data.clear();
+    tbutil::return_object(r);
+  };
+  WriteRequest* p = _write_head.exchange(nullptr, std::memory_order_acq_rel);
+  while (p != nullptr && p != last) {
+    WriteRequest* older = p->next.load(std::memory_order_acquire);
+    while (older == nullptr) {
+      tbthread::fiber_yield();
+      older = p->next.load(std::memory_order_acquire);
+    }
+    release_one(p);
+    p = older;
+  }
+  // Claimed FIFO chain (includes `last` as its tail).
+  while (todo != nullptr) {
+    WriteRequest* next = todo->next.load(std::memory_order_relaxed);
+    release_one(todo);
+    todo = next;
+  }
+}
+
+// ---------------- connect path ----------------
+
+int Socket::ConnectIfNot(int64_t deadline_us) {
+  // Fast path only when the fd is published AND the connect that published
+  // it has completed (the _fd release-store orders the _connecting store
+  // before it, so seeing the fd implies seeing _connecting == true until
+  // success clears it).
+  if (_fd.load(std::memory_order_acquire) >= 0 &&
+      !_connecting.load(std::memory_order_acquire)) {
+    return 0;
+  }
+  if (Failed()) {
+    errno = TRPC_EFAILEDSOCKET;
+    return -1;
+  }
+  std::lock_guard<tbthread::FiberMutex> lk(_connect_mu);
+  if (Failed()) {
+    errno = TRPC_EFAILEDSOCKET;
+    return -1;
+  }
+  if (_fd.load(std::memory_order_acquire) >= 0) return 0;
+  if (deadline_us <= 0) {
+    deadline_us = tbutil::gettimeofday_us() + kDefaultConnectTimeoutUs;
+  }
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  set_no_delay(fd);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr = _remote_side.ip;
+  addr.sin_port = htons(static_cast<uint16_t>(_remote_side.port));
+  int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc != 0 && errno != EINPROGRESS) {
+    close(fd);
+    SetFailed(TRPC_ECONNECT);
+    errno = TRPC_ECONNECT;
+    return -1;
+  }
+  // Publish the fd and register before waiting: the EPOLLOUT edge of
+  // connect-completion is the wakeup. Writers racing in before completion
+  // just queue (WriteOnce returns EAGAIN on an in-progress fd and KeepWrite
+  // parks on the same epollout butex).
+  _connecting.store(true, std::memory_order_release);
+  _fd.store(fd, std::memory_order_release);
+  if (EventDispatcher::global().AddConsumer(id(), fd) != 0) {
+    SetFailed(TRPC_ECONNECT);  // OnRecycle closes the fd
+    errno = TRPC_ECONNECT;
+    return -1;
+  }
+  if (rc != 0) {
+    while (true) {
+      pollfd pfd{fd, POLLOUT, 0};
+      int pr = poll(&pfd, 1, 0);
+      if (pr > 0) break;  // writable (or error — SO_ERROR check below)
+      if (tbutil::gettimeofday_us() >= deadline_us) {
+        // SetFailed (not a quiet rollback): queued writers parked on the
+        // epollout butex get woken + errored, pending ids are notified.
+        SetFailed(TRPC_ERPCTIMEDOUT);
+        errno = TRPC_ERPCTIMEDOUT;
+        return -1;
+      }
+      if (Failed()) {
+        errno = TRPC_EFAILEDSOCKET;
+        return -1;
+      }
+      WaitEpollOut(deadline_us);
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if (err != 0) {
+      SetFailed(TRPC_ECONNECT);
+      errno = TRPC_ECONNECT;
+      return -1;
+    }
+  }
+  _connecting.store(false, std::memory_order_release);
+  return 0;
+}
+
+// ---------------- read path ----------------
+
+ssize_t Socket::DoRead(size_t size_hint) {
+  const int fd = _fd.load(std::memory_order_acquire);
+  if (fd < 0) {
+    errno = ENOTCONN;
+    return -1;
+  }
+  return _read_buf.append_from_file_descriptor(fd, size_hint);
+}
+
+void Socket::StartInputEvent(SocketId sid) {
+  SocketUniquePtr s;
+  if (Address(sid, &s) != 0) return;
+  if (s->_messenger == nullptr) return;
+  if (s->_nevent.fetch_add(1, std::memory_order_acq_rel) == 0) {
+    // First edge: this fiber owns input processing until the counter
+    // returns to 0. The ref moves into the fiber.
+    Socket* raw = s.release();
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_urgent(&tid, nullptr, ProcessEventThunk, raw) !=
+        0) {
+      ProcessEventThunk(raw);  // degrade: process on the dispatcher thread
+    }
+  }
+}
+
+void* Socket::ProcessEventThunk(void* argv) {
+  static_cast<Socket*>(argv)->ProcessEvent();
+  return nullptr;
+}
+
+void Socket::ProcessEvent() {
+  int n = _nevent.load(std::memory_order_acquire);
+  while (true) {
+    if (!Failed() && _messenger != nullptr) {
+      _messenger->OnNewMessages(this);
+    }
+    // If no new edges arrived while we processed, hand the baton back.
+    if (_nevent.compare_exchange_strong(n, 0, std::memory_order_acq_rel,
+                                        std::memory_order_acquire)) {
+      break;
+    }
+    if (Failed()) {  // stop spinning on a dead socket
+      _nevent.store(0, std::memory_order_release);
+      break;
+    }
+  }
+  Deref();
+}
+
+void Socket::HandleEpollOut(SocketId sid) {
+  SocketUniquePtr s;
+  if (Address(sid, &s) != 0) return;
+  tbthread::butex_increment_and_wake_all(s->_epollout_butex);
+}
+
+}  // namespace trpc

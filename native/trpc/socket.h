@@ -1,0 +1,151 @@
+// Socket: THE connection object — versioned-refcounted, wait-free write
+// queue, fiber-parked reads/connects.
+//
+// Capability parity: reference src/brpc/socket.h + socket.cpp:
+//  - versioned refcount lifecycle (socket_id.h:30-50): Address(id) fails
+//    after SetFailed, recycle on last deref
+//  - wait-free Write (socket.cpp:1696 StartWrite): producers exchange into
+//    _write_head and return; the producer that found it empty writes inline
+//    once and hands leftovers to a KeepWrite fiber (socket.cpp:1806) which
+//    parks on _epollout_butex (socket.cpp:1253 WaitEpollOut)
+//  - read events start one input fiber per socket via an event counter
+//    (socket.cpp:1183 StartInputEvent / ProcessEvent)
+//  - pending correlation-ids errored out on SetFailed (failure propagation
+//    to in-flight RPCs), health-check revival hook
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+#include "tbthread/butex.h"
+#include "tbthread/fiber_id.h"
+#include "tbthread/sync.h"
+#include "tbutil/endpoint.h"
+#include "tbutil/iobuf.h"
+#include "trpc/versioned_ref.h"
+
+namespace trpc {
+
+class Socket;
+class InputMessenger;
+using SocketId = uint64_t;
+inline constexpr SocketId INVALID_SOCKET_ID = INVALID_VREF_ID;
+using SocketUniquePtr = VersionedRefWithId<Socket>::Ptr;
+
+// One queued write. Pooled (tbutil::ObjectPool) — creation is pointer pops.
+struct WriteRequest {
+  tbutil::IOBuf data;
+  std::atomic<WriteRequest*> next{nullptr};
+  // Correlation id notified with the error if this write fails (0 = none).
+  tbthread::fiber_id_t notify_id = 0;
+};
+
+class Socket : public VersionedRefWithId<Socket> {
+ public:
+  struct Options {
+    int fd = -1;  // owned once passed; -1 = client socket, connect on demand
+    tbutil::EndPoint remote_side;
+    // Parses+dispatches inbound bytes (server: Acceptor's messenger;
+    // client: the client messenger). May be null (write-only socket).
+    InputMessenger* messenger = nullptr;
+    bool server_side = false;
+    void* user = nullptr;  // Server* on accepted sockets
+  };
+
+  // -- lifecycle (versioned_ref.h) --
+  static int Create(const Options& opt, SocketId* id);
+  static int Address(SocketId id, SocketUniquePtr* out);
+  // error: errno-style reason recorded for debugging/health-check.
+  int SetFailed(int error);
+  using VersionedRefWithId<Socket>::Failed;
+
+  // -- write path --
+  // Wait-free: ownership of *data is taken (swapped out) on success.
+  // Returns 0 on queue/success, -1 with errno on hard failure (failed
+  // socket). notify_id (optional) gets fiber_id_error on write failure.
+  int Write(tbutil::IOBuf* data, tbthread::fiber_id_t notify_id = 0);
+
+  // -- read path (called from the input fiber / messenger) --
+  ssize_t DoRead(size_t size_hint);
+  tbutil::IOPortal& read_buf() { return _read_buf; }
+
+  // Ensure the client socket is connected (fiber-blocking; parks on the
+  // epollout butex during a non-blocking connect). deadline_us on the
+  // gettimeofday clock, 0 = default 1s.
+  int ConnectIfNot(int64_t deadline_us = 0);
+
+  // -- event entry points (EventDispatcher thread) --
+  static void StartInputEvent(SocketId sid);
+  static void HandleEpollOut(SocketId sid);
+
+  // -- pending RPC correlation (errored on SetFailed) --
+  void AddPendingId(tbthread::fiber_id_t id);
+  void RemovePendingId(tbthread::fiber_id_t id);
+
+  // Parse-pipeline cache: index of the protocol that parsed the last
+  // message on this connection (input_messenger.cpp fast path).
+  int preferred_protocol() const { return _preferred_protocol; }
+  void set_preferred_protocol(int idx) { _preferred_protocol = idx; }
+
+  int fd() const { return _fd.load(std::memory_order_acquire); }
+  const tbutil::EndPoint& remote_side() const { return _remote_side; }
+  bool server_side() const { return _server_side; }
+  void* user() const { return _user; }
+  InputMessenger* messenger() const { return _messenger; }
+  int error_code() const { return _error_code; }
+
+  // Bytes in flight in the write queue (EOVERCROWDED guard; bvar-exposed).
+  int64_t write_queue_bytes() const {
+    return _write_queue_bytes.load(std::memory_order_relaxed);
+  }
+
+  // -- versioned_ref hooks --
+  void OnRecycle();
+  void OnFailed(int error);
+
+  Socket();
+  ~Socket();
+
+ private:
+  friend class VersionedRefWithId<Socket>;
+
+  // Writer-side machinery (see socket.cpp for the protocol).
+  void StartWrite(WriteRequest* req);
+  static void* KeepWriteThunk(void* arg);
+  void KeepWrite(WriteRequest* todo, WriteRequest* last);
+  // Write out req->data as far as the kernel accepts. 1 = fully written,
+  // 0 = EAGAIN with leftover, -1 = error.
+  int WriteOnce(WriteRequest* req);
+  int WaitEpollOut(int64_t deadline_us);
+  void ReleaseAllWrites(WriteRequest* todo, WriteRequest* last, int error);
+  static void* ProcessEventThunk(void* arg);
+  void ProcessEvent();
+
+  std::atomic<int> _fd{-1};
+  tbutil::EndPoint _remote_side;
+  InputMessenger* _messenger = nullptr;
+  bool _server_side = false;
+  void* _user = nullptr;
+  int _error_code = 0;
+  int _preferred_protocol = -1;
+
+  std::atomic<WriteRequest*> _write_head{nullptr};
+  std::atomic<int64_t> _write_queue_bytes{0};
+  tbthread::Butex* _epollout_butex;
+  std::atomic<int> _nevent{0};  // pending read edges; input fiber active while > 0
+  // True from fd-publication until the non-blocking connect completes —
+  // gates ConnectIfNot's lock-free fast path.
+  std::atomic<bool> _connecting{false};
+  // Serializes concurrent ConnectIfNot. Fiber mutex: it is held across the
+  // connect park, and a std::mutex held across a fiber switch can deadlock a
+  // single-worker scheduler.
+  tbthread::FiberMutex _connect_mu;
+  tbutil::IOPortal _read_buf;
+
+  std::mutex _pending_mu;
+  std::vector<tbthread::fiber_id_t> _pending_ids;
+};
+
+}  // namespace trpc

@@ -1,0 +1,48 @@
+#include "trpc/socket_map.h"
+
+#include "trpc/input_messenger.h"
+
+namespace trpc {
+
+int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out) {
+  {
+    std::lock_guard<std::mutex> lk(_mu);
+    auto it = _map.find(pt);
+    if (it != _map.end() && Socket::Address(it->second, out) == 0) {
+      return 0;
+    }
+  }
+  // Create outside the lock; resolve the create/create race below.
+  Socket::Options opt;
+  opt.fd = -1;  // connect on first use
+  opt.remote_side = pt;
+  opt.messenger = InputMessenger::client_messenger();
+  opt.server_side = false;
+  SocketId sid;
+  if (Socket::Create(opt, &sid) != 0) return -1;
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _map.find(pt);
+  if (it != _map.end() && Socket::Address(it->second, out) == 0) {
+    // Lost the race: keep the winner, discard ours.
+    SocketUniquePtr mine;
+    if (Socket::Address(sid, &mine) == 0) mine->SetFailed(ECANCELED);
+    return 0;
+  }
+  _map[pt] = sid;
+  return Socket::Address(sid, out);
+}
+
+void SocketMap::Remove(const tbutil::EndPoint& pt, SocketId expected) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _map.find(pt);
+  if (it != _map.end() && it->second == expected) {
+    _map.erase(it);
+  }
+}
+
+SocketMap& SocketMap::global() {
+  static SocketMap* m = new SocketMap;
+  return *m;
+}
+
+}  // namespace trpc

@@ -1,0 +1,33 @@
+// SocketMap: process-wide cache of client connections keyed by endpoint —
+// "single connection" semantics: all Channels to the same server share one
+// socket (the reference's default, controller.cpp:1148).
+// Capability parity: reference src/brpc/socket_map.h:82-150 (SocketMapInsert/
+// Find; dead sockets replaced on next acquire).
+#pragma once
+
+#include <mutex>
+#include <unordered_map>
+
+#include "tbutil/endpoint.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+class SocketMap {
+ public:
+  // Get (or lazily create) the shared socket to `pt`. The returned socket
+  // may be unconnected; callers run ConnectIfNot before writing. A cached
+  // socket that has died is replaced with a fresh one.
+  int GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out);
+
+  // Drop the cache entry (e.g. after SetFailed, to force a fresh connect).
+  void Remove(const tbutil::EndPoint& pt, SocketId expected);
+
+  static SocketMap& global();
+
+ private:
+  std::mutex _mu;
+  std::unordered_map<tbutil::EndPoint, SocketId, tbutil::EndPointHasher> _map;
+};
+
+}  // namespace trpc

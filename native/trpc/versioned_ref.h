@@ -1,0 +1,162 @@
+// VersionedRefWithId: 64-bit handles (32-bit pool slot | 32-bit version) that
+// make use-after-free structurally impossible: Address(id) fails once the
+// object was SetFailed/recycled, because the version in the id no longer
+// matches the live version in the slot — and slots are never unmapped
+// (ResourcePool), so the version check itself is always a safe load.
+//
+// Capability parity: reference src/brpc/versioned_ref_with_id.h:31-60 +
+// socket_id.h:30-50. Encoding: _versioned_ref packs (version << 32 | nref).
+// Live versions are EVEN; SetFailed bumps version to odd (Address starts
+// failing immediately); when the last ref drops on an odd version the slot
+// recycles: version bumps to the next even, OnRecycle() runs, slot returns to
+// the pool for the next Create.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+#include "tbutil/resource_pool.h"
+
+namespace trpc {
+
+using VRefId = uint64_t;
+inline constexpr VRefId INVALID_VREF_ID = ~VRefId(0);
+
+inline constexpr uint32_t vref_version(uint64_t vr) {
+  return static_cast<uint32_t>(vr >> 32);
+}
+inline constexpr uint32_t vref_nref(uint64_t vr) {
+  return static_cast<uint32_t>(vr);
+}
+inline constexpr uint64_t make_vref(uint32_t version, uint32_t nref) {
+  return (static_cast<uint64_t>(version) << 32) | nref;
+}
+inline constexpr uint32_t id_slot(VRefId id) {
+  return static_cast<uint32_t>(id >> 32);
+}
+inline constexpr uint32_t id_version(VRefId id) {
+  return static_cast<uint32_t>(id);
+}
+inline constexpr VRefId make_vref_id(uint32_t slot, uint32_t version) {
+  return (static_cast<uint64_t>(slot) << 32) | version;
+}
+
+// T must derive from VersionedRefWithId<T> and define:
+//   void OnRecycle();          // last ref of a failed object dropped
+//   void OnFailed(int error);  // ran once per SetFailed, before derefing
+template <typename T>
+class VersionedRefWithId {
+ public:
+  // Unique-ptr-ish guard releasing one ref.
+  class Ptr {
+   public:
+    Ptr() : _p(nullptr) {}
+    explicit Ptr(T* p) : _p(p) {}  // takes ownership of one ref
+    ~Ptr() { reset(); }
+    Ptr(const Ptr&) = delete;
+    Ptr& operator=(const Ptr&) = delete;
+    Ptr(Ptr&& rhs) noexcept : _p(rhs._p) { rhs._p = nullptr; }
+    Ptr& operator=(Ptr&& rhs) noexcept {
+      if (this != &rhs) {
+        reset();
+        _p = rhs._p;
+        rhs._p = nullptr;
+      }
+      return *this;
+    }
+    void reset(T* p = nullptr) {
+      if (_p != nullptr) _p->Deref();
+      _p = p;
+    }
+    T* release() {
+      T* p = _p;
+      _p = nullptr;
+      return p;
+    }
+    T* get() const { return _p; }
+    T* operator->() const { return _p; }
+    T& operator*() const { return *_p; }
+    explicit operator bool() const { return _p != nullptr; }
+
+   private:
+    T* _p;
+  };
+
+  // Allocate (or recycle) a slot; object starts with nref == 1 — the
+  // object's self-reference, released by SetFailed. *out receives a SECOND
+  // ref for the caller.
+  static int Create(Ptr* out, VRefId* id) {
+    tbutil::ResourceId slot;
+    T* obj = tbutil::ResourcePool<T>::singleton()->get_resource(&slot);
+    if (obj == nullptr) return -1;
+    uint32_t ver = vref_version(obj->_versioned_ref.load(std::memory_order_relaxed));
+    // Slot fresh from pool: nref must be 0 and version even.
+    obj->_slot = slot;
+    obj->_this_id = make_vref_id(slot, ver);
+    obj->_versioned_ref.store(make_vref(ver, 2), std::memory_order_release);
+    *id = obj->_this_id;
+    out->reset(obj);
+    return 0;
+  }
+
+  // Take a ref if `id` still names a live object.
+  static int Address(VRefId id, Ptr* out) {
+    T* obj = tbutil::ResourcePool<T>::singleton()->address_resource(id_slot(id));
+    if (obj == nullptr) return -1;
+    uint64_t vr = obj->_versioned_ref.load(std::memory_order_acquire);
+    while (true) {
+      if (vref_version(vr) != id_version(id)) return -1;
+      if (obj->_versioned_ref.compare_exchange_weak(
+              vr, vr + 1, std::memory_order_acquire,
+              std::memory_order_acquire)) {
+        out->reset(obj);
+        return 0;
+      }
+    }
+  }
+
+  void Ref() { _versioned_ref.fetch_add(1, std::memory_order_acquire); }
+
+  void Deref() {
+    uint64_t prev = _versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
+    if (vref_nref(prev) == 1 && (vref_version(prev) & 1) != 0) {
+      // Last ref of a failed object: recycle. Bump to the next even version
+      // BEFORE returning the slot so concurrent Address on the stale id
+      // fails rather than racing with the next Create.
+      _versioned_ref.store(make_vref(vref_version(prev) + 1, 0),
+                           std::memory_order_release);
+      static_cast<T*>(this)->OnRecycle();
+      tbutil::ResourcePool<T>::singleton()->return_resource(_slot);
+    }
+  }
+
+  // Mark failed: Address(id) fails from now on; the self-ref is released.
+  // Returns -1 if already failed.
+  int SetFailed(int error) {
+    uint64_t vr = _versioned_ref.load(std::memory_order_acquire);
+    while (true) {
+      if ((vref_version(vr) & 1) != 0) return -1;  // already failed
+      if (_versioned_ref.compare_exchange_weak(
+              vr, make_vref(vref_version(vr) + 1, vref_nref(vr)),
+              std::memory_order_acq_rel, std::memory_order_acquire)) {
+        static_cast<T*>(this)->OnFailed(error);
+        Deref();  // release the self-reference
+        return 0;
+      }
+    }
+  }
+
+  bool Failed() const {
+    return (vref_version(_versioned_ref.load(std::memory_order_acquire)) & 1) !=
+           0;
+  }
+
+  VRefId id() const { return _this_id; }
+
+ protected:
+  std::atomic<uint64_t> _versioned_ref{0};
+  tbutil::ResourceId _slot = 0;
+  VRefId _this_id = INVALID_VREF_ID;
+};
+
+}  // namespace trpc
