@@ -22,7 +22,10 @@ struct IdInfo {
   bool locked = false;
   void* data = nullptr;
   IdErrorFn on_error = nullptr;
-  std::deque<int> pending;  // errors queued while locked
+  // Errors queued while locked: (error, version of the id the error was
+  // reported against) — on_error receives the exact versioned id so callers
+  // can tell WHICH attempt failed (stale-attempt filtering).
+  std::deque<std::pair<int, uint32_t>> pending;
 };
 
 inline fiber_id_t make_id(tbutil::ResourceId slot, uint32_t version) {
@@ -110,7 +113,7 @@ int fiber_id_lock_and_reset_range(fiber_id_t id, void** pdata, int range) {
 int fiber_id_unlock(fiber_id_t id) {
   IdInfo* info = resolve(id);
   if (info == nullptr) return EINVAL;
-  int err = 0;
+  std::pair<int, uint32_t> err{0, 0};
   IdErrorFn on_error = nullptr;
   void* data = nullptr;
   {
@@ -129,7 +132,7 @@ int fiber_id_unlock(fiber_id_t id) {
     }
   }
   if (on_error != nullptr) {
-    return on_error(make_id(id_slot(id), info->first_ver), data, err);
+    return on_error(make_id(id_slot(id), err.second), data, err.first);
   }
   butex_wake(info->lock_btx);
   return 0;
@@ -163,7 +166,7 @@ int fiber_id_error(fiber_id_t id, int error) {
     std::lock_guard<std::mutex> g(info->small);
     if (!valid_version(info, id_version(id))) return EINVAL;
     if (info->locked) {
-      info->pending.push_back(error);
+      info->pending.emplace_back(error, id_version(id));
       return 0;
     }
     info->locked = true;
@@ -173,7 +176,9 @@ int fiber_id_error(fiber_id_t id, int error) {
   if (on_error == nullptr) {
     return fiber_id_unlock_and_destroy(make_id(id_slot(id), id_version(id)));
   }
-  return on_error(make_id(id_slot(id), info->first_ver), data, error);
+  // Hand the EXACT versioned id to on_error (reference id.h semantics):
+  // retry logic distinguishes current-attempt failures from stale ones.
+  return on_error(make_id(id_slot(id), id_version(id)), data, error);
 }
 
 int fiber_id_join(fiber_id_t id) {

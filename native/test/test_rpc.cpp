@@ -1,0 +1,264 @@
+// End-to-end RPC tests: real Server + Channel over loopback, the way the
+// reference tests do (test/brpc_channel_unittest.cpp builds servers on
+// 127.0.0.1 and calls through real sockets — no mock network).
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mini_test.h"
+#include "tbthread/fiber.h"
+#include "tbutil/time.h"
+#include "tbthread/sync.h"
+#include "trpc/channel.h"
+#include "trpc/errno.h"
+#include "trpc/server.h"
+#include "trpc/tstd_protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  std::string_view service_name() const override { return "EchoService"; }
+
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    _calls.fetch_add(1);
+    if (method == "Echo") {
+      response->append(request);
+      // Attachment round-trips independently of the payload.
+      cntl->response_attachment().append(cntl->request_attachment());
+      done->Run();
+      return;
+    }
+    if (method == "Fail") {
+      cntl->SetFailed(TRPC_EINTERNAL, "deliberate failure");
+      done->Run();
+      return;
+    }
+    if (method == "Sleep") {
+      // Park the handler fiber well past the client deadline.
+      tbthread::fiber_usleep(300000);
+      response->append("late");
+      done->Run();
+      return;
+    }
+    if (method == "AsyncEcho") {
+      // Complete from another fiber: `done` outlives CallMethod.
+      std::string body = request.to_string();
+      auto* ctx = new std::pair<tbutil::IOBuf*, Closure*>(response, done);
+      auto* body_copy = new std::string(std::move(body));
+      tbthread::fiber_t tid;
+      struct Arg {
+        std::pair<tbutil::IOBuf*, Closure*>* ctx;
+        std::string* body;
+      };
+      auto* arg = new Arg{ctx, body_copy};
+      tbthread::fiber_start_background(
+          &tid, nullptr,
+          +[](void* p) -> void* {
+            auto* a = static_cast<Arg*>(p);
+            tbthread::fiber_usleep(5000);
+            a->ctx->first->append(*a->body);
+            a->ctx->second->Run();
+            delete a->body;
+            delete a->ctx;
+            delete a;
+            return nullptr;
+          },
+          arg);
+      return;
+    }
+    cntl->SetFailed(TRPC_ENOMETHOD, "no such method: " + method);
+    done->Run();
+  }
+
+  int calls() const { return _calls.load(); }
+
+ private:
+  std::atomic<int> _calls{0};
+};
+
+}  // namespace
+
+TEST_CASE(sync_echo) {
+  Server server;
+  EchoService svc;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ASSERT_EQ(server.Start(0), 0);
+
+  Channel channel;
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  ASSERT_EQ(channel.Init(addr, nullptr), 0);
+
+  Controller cntl;
+  tbutil::IOBuf request, response;
+  request.append("hello rpc");
+  cntl.request_attachment().append("attached-bytes");
+  channel.CallMethod("EchoService/Echo", &cntl, request, &response, nullptr);
+  ASSERT_FALSE(cntl.Failed());
+  ASSERT_TRUE(response.equals("hello rpc"));
+  ASSERT_TRUE(cntl.response_attachment().equals("attached-bytes"));
+  ASSERT_TRUE(cntl.latency_us() >= 0);
+  server.Stop();
+}
+
+TEST_CASE(error_propagation) {
+  Server server;
+  EchoService svc;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  Channel channel;
+  ASSERT_EQ(channel.Init(server.listen_address(), nullptr), 0);
+
+  Controller cntl;
+  tbutil::IOBuf request, response;
+  request.append("x");
+  channel.CallMethod("EchoService/Fail", &cntl, request, &response, nullptr);
+  ASSERT_TRUE(cntl.Failed());
+  ASSERT_EQ(cntl.ErrorCode(), (int)TRPC_EINTERNAL);
+  ASSERT_EQ(cntl.ErrorText(), std::string("deliberate failure"));
+
+  Controller c2;
+  channel.CallMethod("EchoService/Nope", &c2, request, &response, nullptr);
+  ASSERT_EQ(c2.ErrorCode(), (int)TRPC_ENOMETHOD);
+
+  Controller c3;
+  channel.CallMethod("NoService/Echo", &c3, request, &response, nullptr);
+  ASSERT_EQ(c3.ErrorCode(), (int)TRPC_ENOSERVICE);
+  server.Stop();
+}
+
+TEST_CASE(timeout_fires) {
+  Server server;
+  EchoService svc;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  Channel channel;
+  ChannelOptions opts;
+  opts.timeout_ms = 50;
+  opts.max_retry = 0;
+  ASSERT_EQ(channel.Init(server.listen_address(), &opts), 0);
+
+  Controller cntl;
+  tbutil::IOBuf request, response;
+  request.append("x");
+  int64_t t0 = tbutil::gettimeofday_us();
+  channel.CallMethod("EchoService/Sleep", &cntl, request, &response, nullptr);
+  int64_t elapsed = tbutil::gettimeofday_us() - t0;
+  ASSERT_TRUE(cntl.Failed());
+  ASSERT_EQ(cntl.ErrorCode(), (int)TRPC_ERPCTIMEDOUT);
+  ASSERT_TRUE(elapsed < 250000);  // returned at the deadline, not at 300ms
+  server.Stop();
+}
+
+TEST_CASE(async_done_callback) {
+  Server server;
+  EchoService svc;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  Channel channel;
+  ASSERT_EQ(channel.Init(server.listen_address(), nullptr), 0);
+
+  tbthread::CountdownEvent all_done(8);
+  std::vector<Controller> cntls(8);
+  std::vector<tbutil::IOBuf> responses(8);
+  for (int i = 0; i < 8; ++i) {
+    tbutil::IOBuf request;
+    request.append("async-" + std::to_string(i));
+    channel.CallMethod("EchoService/AsyncEcho", &cntls[i], request,
+                       &responses[i],
+                       NewCallback([&all_done] { all_done.signal(); }));
+  }
+  all_done.wait();
+  for (int i = 0; i < 8; ++i) {
+    ASSERT_FALSE(cntls[i].Failed());
+    ASSERT_TRUE(responses[i].equals("async-" + std::to_string(i)));
+  }
+  server.Stop();
+}
+
+TEST_CASE(connect_failure_fails_rpc) {
+  Channel channel;
+  ChannelOptions opts;
+  opts.timeout_ms = 200;
+  opts.max_retry = 1;
+  ASSERT_EQ(channel.Init("127.0.0.1:1", &opts), 0);  // nothing listening
+  Controller cntl;
+  tbutil::IOBuf request, response;
+  request.append("x");
+  channel.CallMethod("EchoService/Echo", &cntl, request, &response, nullptr);
+  ASSERT_TRUE(cntl.Failed());
+}
+
+TEST_CASE(concurrent_calls_multi_thread) {
+  Server server;
+  EchoService svc;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  Channel channel;
+  ASSERT_EQ(channel.Init(server.listen_address(), nullptr), 0);
+
+  constexpr int kThreads = 8;
+  constexpr int kCallsPerThread = 50;
+  std::atomic<int> failures{0};
+  std::vector<std::thread> ths;
+  for (int t = 0; t < kThreads; ++t) {
+    ths.emplace_back([&channel, &failures, t] {
+      for (int i = 0; i < kCallsPerThread; ++i) {
+        Controller cntl;
+        tbutil::IOBuf request, response;
+        std::string body =
+            "t" + std::to_string(t) + "-i" + std::to_string(i) +
+            std::string(1 + (i * 37) % 2048, 'p');
+        request.append(body);
+        channel.CallMethod("EchoService/Echo", &cntl, request, &response,
+                           nullptr);
+        if (cntl.Failed() || !response.equals(body)) failures.fetch_add(1);
+      }
+    });
+  }
+  for (auto& t : ths) t.join();
+  ASSERT_EQ(failures.load(), 0);
+  ASSERT_EQ(svc.calls(), kThreads * kCallsPerThread);
+  server.Stop();
+}
+
+TEST_CASE(server_concurrency_limit) {
+  Server server;
+  EchoService svc;
+  server.AddService(&svc);
+  ServerOptions sopts;
+  sopts.max_concurrency = 1;
+  ASSERT_EQ(server.Start(0, &sopts), 0);
+  Channel channel;
+  ChannelOptions copts;
+  copts.timeout_ms = 2000;
+  copts.max_retry = 0;
+  ASSERT_EQ(channel.Init(server.listen_address(), &copts), 0);
+
+  // One slow call occupies the only slot; a second call must be shed.
+  tbthread::CountdownEvent done(1);
+  Controller slow;
+  tbutil::IOBuf req1, resp1;
+  req1.append("x");
+  channel.CallMethod("EchoService/Sleep", &slow, req1, &resp1,
+                     NewCallback([&done] { done.signal(); }));
+  tbthread::fiber_usleep(50000);  // let it reach the handler
+
+  Controller fast;
+  tbutil::IOBuf req2, resp2;
+  req2.append("y");
+  channel.CallMethod("EchoService/Echo", &fast, req2, &resp2, nullptr);
+  ASSERT_TRUE(fast.Failed());
+  ASSERT_EQ(fast.ErrorCode(), (int)TRPC_ELIMIT);
+  done.wait();
+  ASSERT_FALSE(slow.Failed());
+  server.Stop();
+}
+
+TEST_MAIN

@@ -13,14 +13,14 @@
 
 namespace trpc {
 
-void AcceptMessenger::OnNewMessages(Socket* listen_socket) {
+InputMessageBase* AcceptMessenger::OnNewMessages(Socket* listen_socket) {
   while (true) {
     sockaddr_in addr{};
     socklen_t len = sizeof(addr);
     int fd = accept4(listen_socket->fd(), reinterpret_cast<sockaddr*>(&addr),
                      &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return nullptr;
       if (errno == EINTR || errno == ECONNABORTED) continue;
       if (errno == EMFILE || errno == ENFILE) {
         // Out of fds. Sleep-and-retry instead of returning: under EPOLLET
@@ -28,11 +28,11 @@ void AcceptMessenger::OnNewMessages(Socket* listen_socket) {
         // connections already queued (reference acceptor does the same).
         TB_LOG(ERROR) << "accept: out of fds, retrying";
         tbthread::fiber_usleep(30000);
-        if (listen_socket->Failed()) return;
+        if (listen_socket->Failed()) return nullptr;
         continue;
       }
       TB_LOG(ERROR) << "accept failed: " << strerror(errno);
-      return;
+      return nullptr;
     }
     tbutil::EndPoint remote(addr.sin_addr, ntohs(addr.sin_port));
     _owner->OnNewConnection(fd, remote);

@@ -24,7 +24,9 @@ class AcceptMessenger : public InputMessenger {
  public:
   explicit AcceptMessenger(Acceptor* owner)
       : InputMessenger(true), _owner(owner) {}
-  void OnNewMessages(Socket* listen_socket) override;
+  // "Readable" on the listen socket = connections pending; never returns a
+  // message.
+  InputMessageBase* OnNewMessages(Socket* listen_socket) override;
 
  private:
   Acceptor* _owner;

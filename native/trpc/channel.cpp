@@ -1,0 +1,77 @@
+#include "trpc/channel.h"
+
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "trpc/errno.h"
+#include "trpc/tstd_protocol.h"
+
+namespace trpc {
+
+int Channel::Init(const tbutil::EndPoint& server,
+                  const ChannelOptions* options) {
+  GlobalInitializeOrDie();
+  _server = server;
+  if (options != nullptr) _options = *options;
+  return 0;
+}
+
+int Channel::Init(const char* server_addr, const ChannelOptions* options) {
+  tbutil::EndPoint pt;
+  if (tbutil::str2endpoint(server_addr, &pt) != 0 &&
+      tbutil::hostname2endpoint(server_addr, &pt) != 0) {
+    TB_LOG(ERROR) << "bad server address: " << server_addr;
+    return -1;
+  }
+  return Init(pt, options);
+}
+
+// Reference flow (channel.cpp:433): lock a ranged correlation id covering
+// all retries, serialize once, arm the deadline timer, issue attempt 0,
+// then Join (sync) or return (async).
+void Channel::CallMethod(const std::string& service_method, Controller* cntl,
+                         const tbutil::IOBuf& request,
+                         tbutil::IOBuf* response, Closure* done) {
+  cntl->_begin_time_us = tbutil::gettimeofday_us();
+  if (cntl->_timeout_ms == -1) cntl->_timeout_ms = _options.timeout_ms;
+  if (cntl->_max_retry == -1) cntl->_max_retry = _options.max_retry;
+  cntl->_protocol = _options.protocol;
+  cntl->_service_method = service_method;
+  cntl->_remote_side = _server;
+  cntl->_request_payload = request;  // zero-copy block share
+  cntl->_response_payload = response;
+  cntl->_done = done;
+  if (cntl->_timeout_ms > 0) {
+    cntl->_deadline_us = cntl->_begin_time_us + cntl->_timeout_ms * 1000;
+  }
+
+  tbthread::fiber_id_t cid;
+  const int range = 2 + cntl->_max_retry;
+  if (tbthread::fiber_id_create_ranged(&cid, cntl, Controller::OnError,
+                                       range) != 0) {
+    cntl->SetFailed(TRPC_EINTERNAL, "failed to create correlation id");
+    cntl->_end_time_us = tbutil::gettimeofday_us();
+    if (done != nullptr) done->Run();
+    return;
+  }
+  cntl->_correlation_id = cid;
+  void* unused;
+  TB_CHECK(tbthread::fiber_id_lock(cid, &unused) == 0);
+
+  if (cntl->_deadline_us > 0) {
+    cntl->_timer_id = tbthread::TimerThread::singleton()->schedule(
+        Controller::TimeoutThunk, reinterpret_cast<void*>(cid),
+        cntl->_deadline_us);
+  }
+
+  cntl->IssueRPC();
+  // IssueRPC either finished the RPC (id destroyed) or left it in flight
+  // with the id still locked by us: release so response/errors can lock.
+  if (tbthread::fiber_id_exists(cid)) {
+    tbthread::fiber_id_unlock(cid);
+  }
+  if (done == nullptr) {
+    tbthread::fiber_id_join(cid);
+  }
+}
+
+}  // namespace trpc

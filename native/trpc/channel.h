@@ -1,0 +1,45 @@
+// Channel: the client stub to one server (naming/LB channels layer on top).
+// Capability parity: reference src/brpc/channel.h:43-200 (ChannelOptions with
+// timeout/retry/protocol; Init(endpoint); CallMethod serializes once, arms
+// the deadline timer, issues versioned attempts, sync-joins or returns for
+// async).
+#pragma once
+
+#include <string>
+
+#include "tbutil/endpoint.h"
+#include "tbutil/iobuf.h"
+#include "trpc/closure.h"
+#include "trpc/controller.h"
+
+namespace trpc {
+
+struct ChannelOptions {
+  int64_t timeout_ms = 1000;    // -1 = no deadline
+  int max_retry = 3;
+  int protocol = 0;             // kTstdProtocolIndex
+};
+
+class Channel {
+ public:
+  Channel() = default;
+
+  int Init(const tbutil::EndPoint& server, const ChannelOptions* options);
+  // "ip:port" or "host:port".
+  int Init(const char* server_addr, const ChannelOptions* options);
+
+  // service_method: "EchoService/Echo". `request` is the serialized payload
+  // (the native core is payload-agnostic — pb/json/tensor framing lives in
+  // the bindings). done == nullptr → synchronous (parks the calling fiber).
+  void CallMethod(const std::string& service_method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done);
+
+  const tbutil::EndPoint& server() const { return _server; }
+
+ private:
+  tbutil::EndPoint _server;
+  ChannelOptions _options;
+};
+
+}  // namespace trpc

@@ -1,0 +1,36 @@
+// Minimal closure type for async completion (reference: google::protobuf::
+// Closure as used by brpc::Channel::CallMethod and service done callbacks).
+#pragma once
+
+#include <utility>
+
+namespace trpc {
+
+class Closure {
+ public:
+  virtual ~Closure() = default;
+  // Self-deleting: Run() must be called exactly once.
+  virtual void Run() = 0;
+};
+
+namespace detail {
+template <typename F>
+class FunctionClosure : public Closure {
+ public:
+  explicit FunctionClosure(F&& f) : _f(std::move(f)) {}
+  void Run() override {
+    _f();
+    delete this;
+  }
+
+ private:
+  F _f;
+};
+}  // namespace detail
+
+template <typename F>
+Closure* NewCallback(F&& f) {
+  return new detail::FunctionClosure<F>(std::forward<F>(f));
+}
+
+}  // namespace trpc

@@ -1,0 +1,204 @@
+#include "trpc/controller.h"
+
+#include "tbthread/fiber.h"
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "trpc/errno.h"
+#include "trpc/socket_map.h"
+#include "trpc/tstd_protocol.h"
+
+namespace trpc {
+
+Controller::~Controller() { Reset(); }
+
+void Controller::Reset() {
+  // Client-side ids are destroyed by EndRPC; a Controller being reset while
+  // an RPC is in flight is a caller bug (same contract as the reference).
+  _service_method.clear();
+  _request_payload.clear();
+  _response_payload = nullptr;
+  _request_attachment.clear();
+  _response_attachment.clear();
+  _done = nullptr;
+  _correlation_id = tbthread::INVALID_FIBER_ID;
+  _nretry = 0;
+  _attempt_socket = INVALID_SOCKET_ID;
+  _timer_id = 0;
+  _begin_time_us = 0;
+  _end_time_us = 0;
+  _deadline_us = 0;
+  _error_code = 0;
+  _error_text.clear();
+  _server_side = false;
+}
+
+void Controller::SetFailed(int code, const std::string& reason) {
+  _error_code = code != 0 ? code : TRPC_EINTERNAL;
+  _error_text = reason;
+}
+
+// Runs with the correlation id LOCKED. Issues the current attempt; on a
+// synchronous failure, falls through to the retry/finish decision directly
+// (no fiber_id_error: we already hold the lock).
+void Controller::IssueRPC() {
+  while (true) {
+    const Protocol* proto = GetProtocol(_protocol);
+    if (proto == nullptr || proto->pack_request == nullptr) {
+      EndRPC(TRPC_EINTERNAL, "protocol not registered");
+      return;
+    }
+    SocketUniquePtr sock;
+    int err = 0;
+    std::string err_text;
+    if (SocketMap::global().GetOrCreate(_remote_side, &sock) != 0) {
+      err = TRPC_ECONNECT;
+      err_text = "failed to create socket";
+    } else if (sock->ConnectIfNot(_deadline_us) != 0) {
+      err = errno != 0 ? errno : TRPC_ECONNECT;
+      err_text = "failed to connect to " + tbutil::endpoint2str(_remote_side);
+      SocketMap::global().Remove(_remote_side, sock->id());
+    }
+    if (err == 0) {
+      const tbthread::fiber_id_t attempt = current_attempt_id();
+      _attempt_socket = sock->id();
+      sock->AddPendingId(attempt);
+      tbutil::IOBuf packed;
+      proto->pack_request(&packed, this, attempt, _service_method,
+                          _request_payload);
+      if (sock->Write(&packed, attempt) == 0) {
+        return;  // in flight; response/timeout/socket-failure takes over
+      }
+      err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
+      err_text = "write failed";
+      sock->RemovePendingId(attempt);
+    }
+    // Synchronous attempt failure: retry here if budget remains.
+    if (_nretry < _max_retry &&
+        (_deadline_us == 0 || tbutil::gettimeofday_us() < _deadline_us)) {
+      ++_nretry;
+      continue;
+    }
+    EndRPC(err, err_text);
+    return;
+  }
+}
+
+// fiber_id on_error: invoked with the id LOCKED, from socket failures
+// (fiber_id_error via pending ids / write notify) and the timeout timer.
+int Controller::OnError(tbthread::fiber_id_t id, void* data, int error) {
+  auto* cntl = static_cast<Controller*>(data);
+  if (error == TRPC_ERPCTIMEDOUT || error == TRPC_ECANCELED) {
+    cntl->EndRPC(error, error == TRPC_ERPCTIMEDOUT ? "deadline exceeded"
+                                                   : "canceled");
+    return 0;
+  }
+  if (error == 0) error = TRPC_EFAILEDSOCKET;  // never report "success" here
+  // `id` is the exact versioned id the error was raised against. An attempt
+  // can fail through TWO channels (the socket's pending-id list on
+  // SetFailed, and the write queue's notify on release): the first one
+  // advances _nretry, making the second — and any error from a pre-retry
+  // attempt — STALE. Ignore stale errors or they would double-retry or kill
+  // a healthy in-flight attempt (reference controller.cpp:1058-1066).
+  if (id != cntl->current_attempt_id() && id != cntl->_correlation_id) {
+    tbthread::fiber_id_unlock(id);
+    return 0;
+  }
+  // Transport failure: detach from the dead socket and retry on a fresh
+  // connection if the budget allows.
+  SocketUniquePtr old_sock;
+  if (cntl->_attempt_socket != INVALID_SOCKET_ID &&
+      Socket::Address(cntl->_attempt_socket, &old_sock) == 0) {
+    old_sock->RemovePendingId(cntl->current_attempt_id());
+  }
+  SocketMap::global().Remove(cntl->_remote_side, cntl->_attempt_socket);
+  if (cntl->_nretry < cntl->_max_retry &&
+      (cntl->_deadline_us == 0 ||
+       tbutil::gettimeofday_us() < cntl->_deadline_us)) {
+    ++cntl->_nretry;
+    cntl->IssueRPC();  // EndRPC (destroying id) or leaves id locked...
+    // IssueRPC returning with the RPC in flight leaves the id locked by us:
+    // release it so the response can lock.
+    if (tbthread::fiber_id_exists(id)) {
+      tbthread::fiber_id_unlock(id);
+    }
+    return 0;
+  }
+  cntl->EndRPC(error, "transport failure: " +
+                          std::string(rpc_error_text(error)));
+  return 0;
+}
+
+void Controller::TimeoutThunk(void* arg) {
+  // Runs on the timer pthread: hop to a fiber, the error path parks/locks.
+  auto cid = reinterpret_cast<tbthread::fiber_id_t>(arg);
+  tbthread::fiber_t tid;
+  auto* boxed = new tbthread::fiber_id_t(cid);
+  auto fn = +[](void* p) -> void* {
+    auto* idp = static_cast<tbthread::fiber_id_t*>(p);
+    tbthread::fiber_id_error(*idp, TRPC_ERPCTIMEDOUT);
+    delete idp;
+    return nullptr;
+  };
+  if (tbthread::fiber_start_background(&tid, nullptr, fn, boxed) != 0) {
+    fn(boxed);
+  }
+}
+
+// Runs with the id LOCKED; finishes the RPC: records the result, stops the
+// timer, destroys the id (waking Join) and runs the async done.
+void Controller::EndRPC(int error, const std::string& error_text) {
+  if (error != 0) {
+    _error_code = error;
+    _error_text = error_text;
+  }
+  _end_time_us = tbutil::gettimeofday_us();
+  if (_timer_id != 0) {
+    tbthread::TimerThread::singleton()->unschedule(_timer_id);
+    _timer_id = 0;
+  }
+  SocketUniquePtr sock;
+  if (_attempt_socket != INVALID_SOCKET_ID &&
+      Socket::Address(_attempt_socket, &sock) == 0) {
+    sock->RemovePendingId(current_attempt_id());
+  }
+  Closure* done = _done;
+  const tbthread::fiber_id_t cid = _correlation_id;
+  // All result fields are written: publish by destroying the id. After this
+  // line a sync caller's Join returns and may free the Controller — no
+  // member access past here.
+  tbthread::fiber_id_unlock_and_destroy(cid);
+  if (done != nullptr) {
+    done->Run();
+  }
+}
+
+// Client response path (kept here, not in tstd_protocol.cpp, because the
+// staleness/locking rules are the controller's: reference
+// controller.cpp:598 OnVersionedRPCReturned).
+void TstdHandleResponse(TstdInputMessage* msg) {
+  const tbthread::fiber_id_t attempt_id = msg->meta.correlation_id;
+  void* data = nullptr;
+  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) {
+    delete msg;  // RPC already finished (timeout/retry won) — stale
+    return;
+  }
+  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
+  if (attempt_id != acc.current_attempt_id()) {
+    // Response of a superseded attempt (a retry is already in flight):
+    // drop it; the live attempt's response will resolve the id.
+    tbthread::fiber_id_unlock(attempt_id);
+    delete msg;
+    return;
+  }
+  if (acc.response_payload() != nullptr) {
+    acc.response_payload()->clear();
+    acc.response_payload()->append(std::move(msg->payload));
+  }
+  acc.set_response_attachment(std::move(msg->attachment));
+  int err = msg->meta.code_or_timeout;
+  std::string err_text = std::move(msg->meta.error_text);
+  delete msg;
+  acc.EndRPC(err, err_text);
+}
+
+}  // namespace trpc

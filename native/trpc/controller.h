@@ -1,0 +1,131 @@
+// Controller: the per-RPC state machine shared by client and server sides.
+// Capability parity: reference src/brpc/controller.h:114 + controller.cpp:
+//  - versioned correlation scheme: one ranged fiber-id covers 2+max_retry
+//    attempt versions; attempt N puts base+1+N on the wire; stale responses
+//    (from a pre-retry attempt) are detected and dropped
+//    (controller.cpp:1048-1066)
+//  - IssueRPC: acquire socket, pack, wait-free Write (controller.cpp:1048)
+//  - OnError (bthread_id on_error): retry on transport failures, finish on
+//    timeout (controller.cpp:593-638 HandleTimeout, :598 OnVersionedRPC…)
+//  - attachments, latency accounting, deadline propagation to the server
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "tbthread/fiber_id.h"
+#include "tbthread/timer_thread.h"
+#include "tbutil/endpoint.h"
+#include "tbutil/iobuf.h"
+#include "trpc/closure.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+class Channel;
+
+class Controller {
+ public:
+  Controller() = default;
+  ~Controller();
+  Controller(const Controller&) = delete;
+  Controller& operator=(const Controller&) = delete;
+
+  // Re-arm for another RPC (sync usage pattern: one Controller per call).
+  void Reset();
+
+  // ---- config (client side, defaults inherited from ChannelOptions) ----
+  void set_timeout_ms(int64_t ms) { _timeout_ms = ms; }
+  int64_t timeout_ms() const { return _timeout_ms; }
+  void set_max_retry(int n) { _max_retry = n; }
+  int max_retry() const { return _max_retry; }
+
+  // ---- results ----
+  bool Failed() const { return _error_code != 0; }
+  int ErrorCode() const { return _error_code; }
+  const std::string& ErrorText() const { return _error_text; }
+  void SetFailed(int code, const std::string& reason);
+  int64_t latency_us() const { return _end_time_us - _begin_time_us; }
+  int retried_count() const { return _nretry; }
+
+  tbutil::IOBuf& request_attachment() { return _request_attachment; }
+  tbutil::IOBuf& response_attachment() { return _response_attachment; }
+
+  const tbutil::EndPoint& remote_side() const { return _remote_side; }
+  tbthread::fiber_id_t call_id() const { return _correlation_id; }
+
+  // Server side: absolute deadline propagated from the client (0 = none);
+  // handlers may shed work when it has passed.
+  int64_t deadline_us() const { return _deadline_us; }
+  bool server_side() const { return _server_side; }
+
+ private:
+  friend class Channel;
+  friend class ControllerPrivateAccessor;
+
+  // -- client call engine (runs under the locked correlation id) --
+  void IssueRPC();
+  void EndRPC(int error, const std::string& error_text);
+  static int OnError(tbthread::fiber_id_t id, void* data, int error);
+  static void TimeoutThunk(void* arg);
+  tbthread::fiber_id_t current_attempt_id() const {
+    return tbthread::fiber_id_for_attempt(_correlation_id, _nretry);
+  }
+
+  // config
+  int64_t _timeout_ms = -1;
+  int _max_retry = -1;
+  int _protocol = 0;
+
+  // call state
+  std::string _service_method;
+  tbutil::EndPoint _remote_side;
+  tbutil::IOBuf _request_payload;
+  tbutil::IOBuf* _response_payload = nullptr;
+  tbutil::IOBuf _request_attachment;
+  tbutil::IOBuf _response_attachment;
+  Closure* _done = nullptr;
+  tbthread::fiber_id_t _correlation_id = tbthread::INVALID_FIBER_ID;
+  int _nretry = 0;
+  SocketId _attempt_socket = INVALID_SOCKET_ID;
+  tbthread::TimerThread::TaskId _timer_id = 0;
+  int64_t _begin_time_us = 0;
+  int64_t _end_time_us = 0;
+  int64_t _deadline_us = 0;  // abs, gettimeofday clock
+
+  // results
+  int _error_code = 0;
+  std::string _error_text;
+
+  bool _server_side = false;
+};
+
+// Protocol implementations poke controller internals through this, keeping
+// the Controller API clean for users (reference: ControllerPrivateAccessor,
+// brpc/details/controller_private_accessor.h).
+class ControllerPrivateAccessor {
+ public:
+  explicit ControllerPrivateAccessor(Controller* c) : _c(c) {}
+
+  void set_server_side(const tbutil::EndPoint& remote, int64_t deadline_us) {
+    _c->_server_side = true;
+    _c->_remote_side = remote;
+    _c->_deadline_us = deadline_us;
+  }
+  void set_request_attachment(tbutil::IOBuf&& a) {
+    _c->_request_attachment = std::move(a);
+  }
+  void set_response_attachment(tbutil::IOBuf&& a) {
+    _c->_response_attachment = std::move(a);
+  }
+  tbutil::IOBuf* response_payload() { return _c->_response_payload; }
+  tbthread::fiber_id_t current_attempt_id() const {
+    return _c->current_attempt_id();
+  }
+  void EndRPC(int error, const std::string& text) { _c->EndRPC(error, text); }
+
+ private:
+  Controller* _c;
+};
+
+}  // namespace trpc

@@ -11,12 +11,7 @@ namespace trpc {
 
 namespace {
 
-struct ProcessArg {
-  InputMessageBase* msg;
-  bool server_side;
-};
-
-void ProcessOne(InputMessageBase* msg, bool server_side) {
+void DispatchMessage(InputMessageBase* msg, bool server_side) {
   const Protocol* proto = GetProtocol(msg->protocol_index);
   if (proto == nullptr) {
     delete msg;
@@ -29,14 +24,31 @@ void ProcessOne(InputMessageBase* msg, bool server_side) {
   }
 }
 
+struct ProcessArg {
+  InputMessageBase* msg;
+  bool server_side;
+};
+
 void* ProcessThunk(void* argv) {
   auto* arg = static_cast<ProcessArg*>(argv);
-  ProcessOne(arg->msg, arg->server_side);
+  DispatchMessage(arg->msg, arg->server_side);
   delete arg;
   return nullptr;
 }
 
 }  // namespace
+
+void InputMessenger::ProcessInline(InputMessageBase* msg) {
+  DispatchMessage(msg, _server_side);
+}
+
+void InputMessenger::ProcessInFiber(InputMessageBase* msg) {
+  auto* arg = new ProcessArg{msg, _server_side};
+  tbthread::fiber_t tid;
+  if (tbthread::fiber_start_urgent(&tid, nullptr, ProcessThunk, arg) != 0) {
+    ProcessThunk(arg);
+  }
+}
 
 ParseResult InputMessenger::CutInputMessage(Socket* s, int* protocol_index) {
   tbutil::IOBuf& buf = s->read_buf();
@@ -74,12 +86,10 @@ ParseResult InputMessenger::CutInputMessage(Socket* s, int* protocol_index) {
   return r;
 }
 
-void InputMessenger::OnNewMessages(Socket* s) {
-  // Batch: parse as many complete messages as the buffer holds; spawn a
-  // fiber per message except the LAST, which is processed inline — the
-  // common single-message case costs zero extra switches
-  // (reference input_messenger.cpp:182-223).
-  InputMessageBase* pending = nullptr;  // deferred by one to detect "last"
+InputMessageBase* InputMessenger::OnNewMessages(Socket* s) {
+  // Keep only the newest complete message as the inline candidate; older
+  // ones go to their own fibers immediately.
+  InputMessageBase* pending = nullptr;
   while (true) {
     ssize_t nr = s->DoRead(1 << 19);
     if (nr < 0) {
@@ -101,29 +111,17 @@ void InputMessenger::OnNewMessages(Socket* s) {
                         << tbutil::endpoint2str(s->remote_side())
                         << ", closing";
         s->SetFailed(TRPC_EREQUEST);
-        if (pending != nullptr) {
-          ProcessOne(pending, _server_side);
-          pending = nullptr;
-        }
-        return;
+        return pending;
       }
       r.msg->socket_id = s->id();
       r.msg->protocol_index = proto_index;
       if (pending != nullptr) {
-        // Not the last: hand to its own fiber for parallelism.
-        auto* arg = new ProcessArg{pending, _server_side};
-        tbthread::fiber_t tid;
-        if (tbthread::fiber_start_urgent(&tid, nullptr, ProcessThunk, arg) !=
-            0) {
-          ProcessThunk(arg);
-        }
+        ProcessInFiber(pending);
       }
       pending = r.msg;
     }
   }
-  if (pending != nullptr) {
-    ProcessOne(pending, _server_side);
-  }
+  return pending;
 }
 
 InputMessenger* InputMessenger::client_messenger() {

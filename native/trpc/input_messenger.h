@@ -19,9 +19,17 @@ class InputMessenger {
   explicit InputMessenger(bool server_side) : _server_side(server_side) {}
   virtual ~InputMessenger() = default;
 
-  // Read everything available on `s` (until EAGAIN/EOF), cutting and
-  // dispatching complete messages. Runs in the socket's input fiber.
-  virtual void OnNewMessages(Socket* s);
+  // Read everything available on `s` (until EAGAIN/EOF), cutting complete
+  // messages. All but the LAST are dispatched to their own fibers; the last
+  // is RETURNED so the caller (Socket::ProcessEvent) can run it inline
+  // AFTER releasing the input-fiber claim — a handler that parks must not
+  // head-of-line-block later requests on the connection (reference
+  // input_messenger.cpp:182-223).
+  virtual InputMessageBase* OnNewMessages(Socket* s);
+
+  // Dispatch a parsed message (request or response per _server_side).
+  void ProcessInline(InputMessageBase* msg);
+  void ProcessInFiber(InputMessageBase* msg);
 
   bool server_side() const { return _server_side; }
 

@@ -1,0 +1,105 @@
+#include "trpc/server.h"
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tbutil/logging.h"
+#include "trpc/tstd_protocol.h"
+
+namespace trpc {
+
+Server::~Server() {
+  Stop();
+  if (_stop_butex != nullptr) {
+    tbthread::butex_destroy(_stop_butex);
+  }
+}
+
+int Server::AddService(Service* service) {
+  if (service == nullptr) return -1;
+  if (_running.load(std::memory_order_acquire)) {
+    TB_LOG(ERROR) << "AddService after Start";
+    return -1;
+  }
+  std::string name(service->service_name());
+  if (_services.seek(name) != nullptr) {
+    TB_LOG(ERROR) << "duplicate service: " << name;
+    return -1;
+  }
+  _services.insert(std::move(name), service);
+  return 0;
+}
+
+int Server::Start(int port, const ServerOptions* options) {
+  char addr[32];
+  snprintf(addr, sizeof(addr), "0.0.0.0:%d", port);
+  return Start(addr, options);
+}
+
+int Server::Start(const char* addr, const ServerOptions* options) {
+  if (_running.load(std::memory_order_acquire)) return -1;
+  GlobalInitializeOrDie();
+  if (options != nullptr) _options = *options;
+  if (_stop_butex == nullptr) _stop_butex = tbthread::butex_create();
+
+  tbutil::EndPoint pt;
+  if (tbutil::str2endpoint(addr, &pt) != 0) {
+    TB_LOG(ERROR) << "bad listen address: " << addr;
+    return -1;
+  }
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sin{};
+  sin.sin_family = AF_INET;
+  sin.sin_addr = pt.ip;
+  sin.sin_port = htons(static_cast<uint16_t>(pt.port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&sin), sizeof(sin)) != 0 ||
+      listen(fd, 1024) != 0) {
+    TB_LOG(ERROR) << "bind/listen " << addr << " failed: " << strerror(errno);
+    close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(sin);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&sin), &len);
+  _listen_address = tbutil::EndPoint(sin.sin_addr, ntohs(sin.sin_port));
+
+  if (_acceptor.StartAccept(fd, this) != 0) {
+    close(fd);
+    return -1;
+  }
+  _running.store(true, std::memory_order_release);
+  TB_LOG(INFO) << "server started on "
+               << tbutil::endpoint2str(_listen_address);
+  return 0;
+}
+
+int Server::Stop() {
+  if (!_running.exchange(false, std::memory_order_acq_rel)) return -1;
+  _acceptor.StopAccept();
+  tbthread::butex_increment_and_wake_all(_stop_butex);
+  return 0;
+}
+
+int Server::Join() {
+  if (_stop_butex == nullptr) return -1;
+  while (_running.load(std::memory_order_acquire)) {
+    const int v =
+        tbthread::butex_value(_stop_butex)->load(std::memory_order_acquire);
+    if (!_running.load(std::memory_order_acquire)) break;
+    tbthread::butex_wait(_stop_butex, v, nullptr);
+  }
+  return 0;
+}
+
+Service* Server::FindService(std::string_view name) const {
+  Service* const* p = _services.seek(std::string(name));
+  return p != nullptr ? *p : nullptr;
+}
+
+}  // namespace trpc

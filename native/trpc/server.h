@@ -1,0 +1,91 @@
+// Server: service registry + listener + request dispatch.
+// Capability parity: reference src/brpc/server.h:62-488 (AddService with
+// method maps, Start/Stop/Join, ServerOptions.max_concurrency gate,
+// session-local data via user services) and the canonical request path
+// policy/baidu_rpc_protocol.cpp:565 ProcessRpcRequest (concurrency gate ->
+// find method -> CallMethod(done=SendResponse)).
+#pragma once
+
+#include <atomic>
+#include <string>
+#include <string_view>
+
+#include "tbutil/endpoint.h"
+#include "tbutil/flat_map.h"
+#include "tbutil/iobuf.h"
+#include "trpc/acceptor.h"
+#include "trpc/closure.h"
+#include "trpc/controller.h"
+
+namespace trpc {
+
+// A service handles named methods on serialized payloads. The native core
+// is payload-agnostic (IOBuf in/out); typed layers (pb, json, tensors) wrap
+// this in the bindings.
+class Service {
+ public:
+  virtual ~Service() = default;
+  virtual std::string_view service_name() const = 0;
+  // Fill *response / cntl fields, then call done->Run() exactly once
+  // (possibly from another fiber later — async handlers just keep `done`).
+  virtual void CallMethod(const std::string& method, Controller* cntl,
+                          const tbutil::IOBuf& request,
+                          tbutil::IOBuf* response, Closure* done) = 0;
+};
+
+struct ServerOptions {
+  // 0 = unlimited. Requests over the cap are rejected with TRPC_ELIMIT
+  // (reference ServerOptions.max_concurrency server.h:132).
+  int32_t max_concurrency = 0;
+};
+
+class Server {
+ public:
+  Server() = default;
+  ~Server();
+
+  // Not owned; must outlive the server.
+  int AddService(Service* service);
+
+  // port only ("0.0.0.0:port"); or addr "ip:port". port 0 = ephemeral.
+  int Start(int port, const ServerOptions* options = nullptr);
+  int Start(const char* addr, const ServerOptions* options = nullptr);
+  int Stop();
+  // Blocks until Stop() is called (from a signal handler or another fiber).
+  int Join();
+
+  Service* FindService(std::string_view name) const;
+  const tbutil::EndPoint& listen_address() const { return _listen_address; }
+  size_t connection_count() const { return _acceptor.connection_count(); }
+  bool running() const { return _running.load(std::memory_order_acquire); }
+
+  // Request-level concurrency gate.
+  bool BeginRequest() {
+    if (_options.max_concurrency > 0 &&
+        _concurrency.fetch_add(1, std::memory_order_relaxed) >=
+            _options.max_concurrency) {
+      _concurrency.fetch_sub(1, std::memory_order_relaxed);
+      return false;
+    }
+    return true;
+  }
+  void EndRequest() {
+    if (_options.max_concurrency > 0) {
+      _concurrency.fetch_sub(1, std::memory_order_relaxed);
+    }
+  }
+  int32_t concurrency() const {
+    return _concurrency.load(std::memory_order_relaxed);
+  }
+
+ private:
+  tbutil::FlatMap<std::string, Service*> _services;
+  ServerOptions _options;
+  Acceptor _acceptor;
+  tbutil::EndPoint _listen_address;
+  std::atomic<bool> _running{false};
+  std::atomic<int32_t> _concurrency{0};
+  tbthread::Butex* _stop_butex = nullptr;
+};
+
+}  // namespace trpc

@@ -234,7 +234,10 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
   while (true) {
     while (todo != nullptr) {
       if (Failed()) {
-        ReleaseAllWrites(todo, last, _error_code);
+        // _error_code may not be published yet (SetFailed bumps the version
+        // before OnFailed stores the code): never propagate 0 as an error.
+        const int err = _error_code != 0 ? _error_code : TRPC_EFAILEDSOCKET;
+        ReleaseAllWrites(todo, last, err);
         return;
       }
       int rc = WriteOnce(todo);
@@ -471,12 +474,18 @@ void* Socket::ProcessEventThunk(void* argv) {
 }
 
 void Socket::ProcessEvent() {
+  InputMessenger* messenger = _messenger;
+  InputMessageBase* tail = nullptr;
   int n = _nevent.load(std::memory_order_acquire);
   while (true) {
-    if (!Failed() && _messenger != nullptr) {
-      _messenger->OnNewMessages(this);
+    if (!Failed() && messenger != nullptr) {
+      InputMessageBase* m = messenger->OnNewMessages(this);
+      if (m != nullptr) {
+        if (tail != nullptr) messenger->ProcessInFiber(tail);
+        tail = m;
+      }
     }
-    // If no new edges arrived while we processed, hand the baton back.
+    // If no new edges arrived while we read, hand the read claim back.
     if (_nevent.compare_exchange_strong(n, 0, std::memory_order_acq_rel,
                                         std::memory_order_acquire)) {
       break;
@@ -485,6 +494,12 @@ void Socket::ProcessEvent() {
       _nevent.store(0, std::memory_order_release);
       break;
     }
+  }
+  // The claim is released: new data starts a fresh input fiber. Only now
+  // run the trailing handler inline — if it parks (slow service method), it
+  // blocks just this fiber, not the connection (no head-of-line blocking).
+  if (tail != nullptr && messenger != nullptr) {
+    messenger->ProcessInline(tail);
   }
   Deref();
 }

@@ -1,0 +1,275 @@
+#include "trpc/tstd_protocol.h"
+
+#include <cstring>
+#include <mutex>
+
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/server.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr char kMagic[4] = {'T', 'R', 'P', 'C'};
+constexpr size_t kHeaderSize = 12;
+constexpr size_t kFixedMetaSize = 44;
+constexpr size_t kMaxMetaSize = 64 * 1024;
+constexpr size_t kMaxBodySize = 2ULL * 1024 * 1024 * 1024;  // 2 GB sanity cap
+
+template <typename T>
+void put(std::string* s, T v) {
+  s->append(reinterpret_cast<const char*>(&v), sizeof(T));
+}
+
+template <typename T>
+T get(const char*& p) {
+  T v;
+  memcpy(&v, p, sizeof(T));
+  p += sizeof(T);
+  return v;
+}
+
+}  // namespace
+
+void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
+                         size_t body_size) {
+  std::string m;
+  m.reserve(kFixedMetaSize + meta.service.size() + meta.method.size() +
+            meta.error_text.size() + 8);
+  put<uint8_t>(&m, meta.msg_type);
+  put<uint8_t>(&m, meta.compress_type);
+  put<uint16_t>(&m, meta.flags);
+  put<uint64_t>(&m, meta.correlation_id);
+  put<uint32_t>(&m, meta.attachment_size);
+  put<int32_t>(&m, meta.code_or_timeout);
+  put<uint64_t>(&m, meta.trace_id);
+  put<uint64_t>(&m, meta.span_id);
+  put<uint64_t>(&m, meta.parent_span_id);
+  if (meta.msg_type == 0) {
+    put<uint16_t>(&m, static_cast<uint16_t>(meta.service.size()));
+    m.append(meta.service);
+    put<uint16_t>(&m, static_cast<uint16_t>(meta.method.size()));
+    m.append(meta.method);
+  } else {
+    put<uint16_t>(&m, static_cast<uint16_t>(meta.error_text.size()));
+    m.append(meta.error_text);
+  }
+  char header[kHeaderSize];
+  memcpy(header, kMagic, 4);
+  uint32_t meta_size = static_cast<uint32_t>(m.size());
+  uint32_t bsz = static_cast<uint32_t>(body_size);
+  memcpy(header + 4, &meta_size, 4);
+  memcpy(header + 8, &bsz, 4);
+  out->append(header, kHeaderSize);
+  out->append(m);
+}
+
+static bool parse_meta(const std::string& raw, TstdMeta* meta) {
+  if (raw.size() < kFixedMetaSize) return false;
+  const char* p = raw.data();
+  const char* end = raw.data() + raw.size();
+  meta->msg_type = get<uint8_t>(p);
+  meta->compress_type = get<uint8_t>(p);
+  meta->flags = get<uint16_t>(p);
+  meta->correlation_id = get<uint64_t>(p);
+  meta->attachment_size = get<uint32_t>(p);
+  meta->code_or_timeout = get<int32_t>(p);
+  meta->trace_id = get<uint64_t>(p);
+  meta->span_id = get<uint64_t>(p);
+  meta->parent_span_id = get<uint64_t>(p);
+  auto get_str = [&p, end](std::string* out) {
+    if (p + 2 > end) return false;
+    uint16_t len = get<uint16_t>(p);
+    if (p + len > end) return false;
+    out->assign(p, len);
+    p += len;
+    return true;
+  };
+  if (meta->msg_type == 0) {
+    if (!get_str(&meta->service) || !get_str(&meta->method)) return false;
+  } else {
+    if (!get_str(&meta->error_text)) return false;
+  }
+  return true;
+}
+
+ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
+  ParseResult r;
+  if (source->size() < kHeaderSize) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  char header[kHeaderSize];
+  source->copy_to(header, kHeaderSize);
+  if (memcmp(header, kMagic, 4) != 0) {
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  uint32_t meta_size, body_size;
+  memcpy(&meta_size, header + 4, 4);
+  memcpy(&body_size, header + 8, 4);
+  if (meta_size < kFixedMetaSize || meta_size > kMaxMetaSize ||
+      body_size > kMaxBodySize) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  if (source->size() < kHeaderSize + meta_size + body_size) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  source->pop_front(kHeaderSize);
+  std::string raw_meta;
+  source->cutn(&raw_meta, meta_size);
+  auto* msg = new TstdInputMessage;
+  if (!parse_meta(raw_meta, &msg->meta) ||
+      msg->meta.attachment_size > body_size) {
+    delete msg;
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  source->cutn(&msg->payload, body_size - msg->meta.attachment_size);
+  source->cutn(&msg->attachment, msg->meta.attachment_size);
+  r.error = PARSE_OK;
+  r.msg = msg;
+  return r;
+}
+
+// ---------------- client side: pack + response dispatch ----------------
+
+static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
+                              uint64_t correlation_id,
+                              const std::string& service_method,
+                              const tbutil::IOBuf& payload) {
+  TstdMeta meta;
+  meta.msg_type = 0;
+  meta.correlation_id = correlation_id;
+  meta.attachment_size =
+      static_cast<uint32_t>(cntl->request_attachment().size());
+  if (cntl->deadline_us() > 0) {
+    int64_t remaining_ms =
+        (cntl->deadline_us() - tbutil::gettimeofday_us()) / 1000;
+    meta.code_or_timeout =
+        static_cast<int32_t>(remaining_ms > 0 ? remaining_ms : 1);
+  }
+  size_t slash = service_method.find('/');
+  if (slash == std::string::npos) {
+    meta.service = service_method;
+  } else {
+    meta.service = service_method.substr(0, slash);
+    meta.method = service_method.substr(slash + 1);
+  }
+  tstd_serialize_meta(out, meta,
+                      payload.size() + cntl->request_attachment().size());
+  out->append(payload);
+  out->append(cntl->request_attachment());
+}
+
+// Defined in controller.cpp — hands the parsed response to the controller
+// under its locked correlation id.
+void TstdHandleResponse(TstdInputMessage* msg);
+
+static void tstd_process_response(InputMessageBase* base) {
+  TstdHandleResponse(static_cast<TstdInputMessage*>(base));
+}
+
+// ---------------- server side: request dispatch ----------------
+
+static void tstd_send_response(SocketId sid, uint64_t correlation_id,
+                               Controller* cntl, tbutil::IOBuf* payload) {
+  SocketUniquePtr s;
+  if (Socket::Address(sid, &s) != 0) return;  // peer is gone
+  TstdMeta meta;
+  meta.msg_type = 1;
+  meta.correlation_id = correlation_id;
+  meta.code_or_timeout = cntl->ErrorCode();
+  meta.error_text = cntl->ErrorText();
+  meta.attachment_size =
+      static_cast<uint32_t>(cntl->response_attachment().size());
+  tbutil::IOBuf out;
+  tstd_serialize_meta(&out, meta,
+                      payload->size() + cntl->response_attachment().size());
+  out.append(std::move(*payload));
+  out.append(cntl->response_attachment());
+  s->Write(&out);
+}
+
+static void tstd_process_request(InputMessageBase* base) {
+  auto* msg = static_cast<TstdInputMessage*>(base);
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) {
+    delete msg;
+    return;
+  }
+  auto* server = static_cast<Server*>(s->user());
+  const SocketId sid = msg->socket_id;
+  const uint64_t cid = msg->meta.correlation_id;
+
+  // Controller + response live until done->Run(): handlers may be async.
+  auto* cntl = new Controller;
+  auto* response = new tbutil::IOBuf;
+  ControllerPrivateAccessor acc(cntl);
+  int64_t deadline_us = 0;
+  if (msg->meta.code_or_timeout > 0) {
+    deadline_us =
+        tbutil::gettimeofday_us() + int64_t(msg->meta.code_or_timeout) * 1000;
+  }
+  acc.set_server_side(s->remote_side(), deadline_us);
+  acc.set_request_attachment(std::move(msg->attachment));
+  auto fail_without_gate = [&](int code, const std::string& text) {
+    cntl->SetFailed(code, text);
+    delete msg;
+    tstd_send_response(sid, cid, cntl, response);
+    delete cntl;
+    delete response;
+  };
+  if (server == nullptr) {
+    fail_without_gate(TRPC_EINTERNAL, "socket has no server");
+    return;
+  }
+  if (!server->BeginRequest()) {
+    fail_without_gate(TRPC_ELIMIT, "server concurrency limit reached");
+    return;
+  }
+  // From here the gate is released exactly once — by done.
+  Closure* done = NewCallback([sid, cid, cntl, response, server]() {
+    tstd_send_response(sid, cid, cntl, response);
+    server->EndRequest();
+    delete cntl;
+    delete response;
+  });
+
+  Service* svc = server->FindService(msg->meta.service);
+  if (svc == nullptr) {
+    cntl->SetFailed(TRPC_ENOSERVICE,
+                    "no such service: " + msg->meta.service);
+    delete msg;
+    done->Run();
+    return;
+  }
+  tbutil::IOBuf request = std::move(msg->payload);
+  std::string method = std::move(msg->meta.method);
+  delete msg;
+  svc->CallMethod(method, cntl, request, response, done);
+}
+
+// ---------------- registration ----------------
+
+void GlobalInitializeOrDie() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.parse = tstd_parse;
+    p.pack_request = tstd_pack_request;
+    p.process_request = tstd_process_request;
+    p.process_response = tstd_process_response;
+    p.name = "tstd";
+    TB_CHECK(RegisterProtocol(kTstdProtocolIndex, p) == 0)
+        << "tstd protocol slot taken";
+  });
+}
+
+}  // namespace trpc

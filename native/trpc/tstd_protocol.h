@@ -1,0 +1,58 @@
+// tstd: the framework's default framed RPC protocol (wire format is our
+// own design; capability parity with the reference's default baidu_std,
+// policy/baidu_rpc_protocol.cpp + baidu_rpc_meta.proto: 12-byte magic
+// header, meta with correlation id / service / method / error / attachment,
+// payload + attachment body, deadline propagation, trace ids).
+//
+// Frame:
+//   "TRPC" (4) | meta_size u32le | body_size u32le         [12-byte header]
+//   meta (44-byte fixed part + length-prefixed strings, see tstd_protocol.cpp)
+//   body = payload bytes then attachment bytes (attachment_size in meta)
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "tbutil/iobuf.h"
+#include "trpc/protocol.h"
+
+namespace trpc {
+
+inline constexpr int kTstdProtocolIndex = 0;
+
+struct TstdMeta {
+  uint8_t msg_type = 0;  // 0 request, 1 response
+  uint8_t compress_type = 0;
+  uint16_t flags = 0;
+  uint64_t correlation_id = 0;
+  uint32_t attachment_size = 0;
+  // Request: relative timeout budget in ms (deadline propagation).
+  // Response: error code (0 = OK).
+  int32_t code_or_timeout = 0;
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  std::string service;     // request
+  std::string method;      // request
+  std::string error_text;  // response
+};
+
+// Registers tstd into the protocol registry (idempotent, thread-safe) and
+// everything else process-wide the RPC layer needs. Reference: global.cpp:326
+// GlobalInitializeOrDieImpl.
+void GlobalInitializeOrDie();
+
+// Exposed for tests / alternate transports.
+void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
+                         size_t body_size);
+// Parses one complete frame from `source` into meta+payload+attachment.
+// Does not consume unless a whole frame is present.
+ParseResult tstd_parse(tbutil::IOBuf* source, Socket* socket);
+
+struct TstdInputMessage : InputMessageBase {
+  TstdMeta meta;
+  tbutil::IOBuf payload;
+  tbutil::IOBuf attachment;
+};
+
+}  // namespace trpc
