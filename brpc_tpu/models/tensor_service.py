@@ -1,0 +1,191 @@
+"""TensorService — the flagship workload: a sharded parameter server whose
+traffic is the RPC framework's reason to exist on TPU.
+
+Reference mapping (SURVEY.md §2.11, §7 stage 8): bRPC's headline deployment
+is parameter-server style fan-out/fan-in (ParallelChannel merging sub-call
+responses, PartitionChannel sharding state "N/M"). Here that exact traffic
+pattern is compiled onto the device mesh:
+
+- served state (MLP parameters) is tensor-sharded over the ``shard`` axis
+  (= PartitionChannel partitions),
+- request batches are data-sharded over the ``client`` axis (= concurrent
+  client connections),
+- gradient fan-in is a psum over ``client`` (= ResponseMerger),
+- partial-activation fan-in is a psum over ``shard`` (= merged partitions),
+- a ppermute ring relays running stats (= Streaming RPC's relay path).
+
+Single-chip entry() serves the driver's compile check; dryrun_multichip jits
+the FULL sharded step over an n-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from brpc_tpu.ops.fused_update import (fused_momentum_update,
+                                       momentum_update_reference)
+from brpc_tpu.parallel.mesh import CLIENT_AXIS, SHARD_AXIS, make_mesh
+
+
+class PSState(NamedTuple):
+    w1: jax.Array  # (din, dh)   sharded on columns (shard axis)
+    b1: jax.Array  # (dh,)
+    w2: jax.Array  # (dh, dout)  sharded on rows (shard axis)
+    b2: jax.Array  # (dout,)
+    m_w1: jax.Array
+    m_w2: jax.Array
+    stats: jax.Array  # (dout,) running output stats, relayed on the ring
+
+
+def init_state(rng: jax.Array, din: int, dh: int, dout: int) -> PSState:
+    k1, k2 = jax.random.split(rng)
+    scale1 = 1.0 / np.sqrt(din)
+    scale2 = 1.0 / np.sqrt(dh)
+    w1 = jax.random.normal(k1, (din, dh), jnp.float32) * scale1
+    w2 = jax.random.normal(k2, (dh, dout), jnp.float32) * scale2
+    return PSState(
+        w1=w1, b1=jnp.zeros((dh,), jnp.float32),
+        w2=w2, b2=jnp.zeros((dout,), jnp.float32),
+        m_w1=jnp.zeros_like(w1), m_w2=jnp.zeros_like(w2),
+        stats=jnp.zeros((dout,), jnp.float32))
+
+
+def _forward(state: PSState, x: jax.Array) -> jax.Array:
+    # bf16 matmuls (MXU), fp32 accumulation/output.
+    h = jnp.dot(x.astype(jnp.bfloat16), state.w1.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32) + state.b1
+    h = jax.nn.relu(h)
+    y = jnp.dot(h.astype(jnp.bfloat16), state.w2.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32) + state.b2
+    return y
+
+
+def _loss(state: PSState, x: jax.Array, target: jax.Array) -> jax.Array:
+    y = _forward(state, x)
+    return jnp.mean(jnp.square(y - target))
+
+
+@jax.jit
+def train_step(state: PSState, x: jax.Array, target: jax.Array):
+    """Single-chip step: forward, grads, fused Pallas momentum update."""
+    loss, grads = jax.value_and_grad(_loss)(state, x, target)
+    w1, m_w1 = fused_momentum_update(state.w1, state.m_w1, grads.w1)
+    w2, m_w2 = fused_momentum_update(state.w2, state.m_w2, grads.w2)
+    new_stats = 0.9 * state.stats + 0.1 * jnp.mean(
+        _forward(state, x), axis=0)
+    new_state = PSState(w1=w1, b1=state.b1 - 0.01 * grads.b1,
+                        w2=w2, b2=state.b2 - 0.01 * grads.b2,
+                        m_w1=m_w1, m_w2=m_w2, stats=new_stats)
+    return new_state, loss
+
+
+def flagship_entry(batch: int = 64, din: int = 256, dh: int = 512,
+                   dout: int = 256):
+    """(jittable fn, example_args) — the driver's single-chip compile check."""
+    rng = jax.random.PRNGKey(0)
+    state = init_state(rng, din, dh, dout)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, din), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(2), (batch, dout), jnp.float32)
+    return train_step, (state, x, t)
+
+
+# ---------------------------------------------------------------------------
+# Sharded step: client (dp) × shard (tp) mesh + ring relay.
+# ---------------------------------------------------------------------------
+
+def make_sharded_train_step(mesh: Mesh):
+    """The full distributed step, shard_map'ed over (client, shard).
+
+    Inside the body everything is per-device blocks; the collectives XLA
+    lowers to ICI traffic are explicit: psum over SHARD for partial
+    activations, psum over CLIENT for gradient fan-in, ppermute ring for the
+    stats relay.
+    """
+    n_shard = mesh.shape[SHARD_AXIS]
+    ring = [(i, (i + 1) % n_shard) for i in range(n_shard)]
+
+    def body(state: PSState, x: jax.Array, target: jax.Array):
+        # Per-device blocks: x (B/C, din), w1 (din, dh/S), w2 (dh/S, dout).
+        def local_loss(w1, b1, w2, b2):
+            h = jnp.dot(x.astype(jnp.bfloat16), w1.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+            # b1 is sharded like w1's columns: local slice applies locally.
+            h = jax.nn.relu(h + b1)
+            y_part = jnp.dot(h.astype(jnp.bfloat16),
+                             w2.astype(jnp.bfloat16),
+                             preferred_element_type=jnp.float32)
+            # Merge the partition partials (PartitionChannel fan-in).
+            y = jax.lax.psum(y_part, SHARD_AXIS) + b2
+            return jnp.mean(jnp.square(y - target)), y
+
+        (loss, y), grads = jax.value_and_grad(
+            local_loss, argnums=(0, 1, 2, 3), has_aux=True)(
+                state.w1, state.b1, state.w2, state.b2)
+        g_w1, g_b1, g_w2, g_b2 = grads
+        # Gradient fan-in over clients (ResponseMerger = sum/avg).
+        nc = mesh.shape[CLIENT_AXIS]
+        g_w1 = jax.lax.psum(g_w1, CLIENT_AXIS) / nc
+        g_b1 = jax.lax.psum(g_b1, CLIENT_AXIS) / nc
+        g_w2 = jax.lax.psum(g_w2, CLIENT_AXIS) / nc
+        g_b2 = jax.lax.psum(g_b2, CLIENT_AXIS) / nc
+        w1, m_w1 = momentum_update_reference(state.w1, state.m_w1, g_w1)
+        w2, m_w2 = momentum_update_reference(state.w2, state.m_w2, g_w2)
+        # Streaming relay: push running stats one hop around the shard ring
+        # (the tensor-streaming path of SURVEY §5).
+        stats = 0.9 * state.stats + 0.1 * jnp.mean(y, axis=0)
+        stats = jax.lax.ppermute(stats, SHARD_AXIS, ring)
+        loss = jax.lax.pmean(loss, CLIENT_AXIS)
+        new_state = PSState(w1=w1, b1=state.b1 - 0.01 * g_b1,
+                            w2=w2, b2=state.b2 - 0.01 * g_b2,
+                            m_w1=m_w1, m_w2=m_w2, stats=stats)
+        return new_state, loss
+
+    state_specs = PSState(
+        w1=P(None, SHARD_AXIS), b1=P(SHARD_AXIS),
+        w2=P(SHARD_AXIS, None), b2=P(),
+        m_w1=P(None, SHARD_AXIS), m_w2=P(SHARD_AXIS, None),
+        stats=P())
+    sharded = shard_map(
+        body, mesh=mesh,
+        in_specs=(state_specs, P(CLIENT_AXIS, None), P(CLIENT_AXIS, None)),
+        out_specs=(state_specs, P()),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def dryrun_multichip(n_devices: int) -> None:
+    """Compile + run ONE sharded step on tiny shapes over an n-device mesh
+    (the driver validates multi-chip sharding on a virtual CPU mesh)."""
+    devs = jax.devices()[:n_devices]
+    mesh = make_mesh(devs)
+    n_shard = mesh.shape[SHARD_AXIS]
+    n_client = mesh.shape[CLIENT_AXIS]
+    # Tiny but shard-divisible shapes.
+    din, dh, dout = 16, 8 * n_shard, 8
+    batch = 4 * n_client
+    state = init_state(jax.random.PRNGKey(0), din, dh, dout)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, din), jnp.float32)
+    t = jax.random.normal(jax.random.PRNGKey(2), (batch, dout), jnp.float32)
+
+    state_specs = PSState(
+        w1=P(None, SHARD_AXIS), b1=P(SHARD_AXIS),
+        w2=P(SHARD_AXIS, None), b2=P(),
+        m_w1=P(None, SHARD_AXIS), m_w2=P(SHARD_AXIS, None),
+        stats=P())
+    state = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        state, state_specs)
+    x = jax.device_put(x, NamedSharding(mesh, P(CLIENT_AXIS, None)))
+    t = jax.device_put(t, NamedSharding(mesh, P(CLIENT_AXIS, None)))
+
+    step = make_sharded_train_step(mesh)
+    new_state, loss = step(state, x, t)
+    jax.block_until_ready((new_state, loss))
+    assert np.isfinite(float(loss)), "sharded step produced non-finite loss"

@@ -1,0 +1,74 @@
+"""Pallas kernels for the data plane's hot elementwise ops.
+
+The reference keeps its hot path in hand-tuned C++ (wait-free queues,
+zero-copy cuts); on TPU the analogous control we take is a fused Pallas
+kernel for the parameter-server update — one HBM round-trip for
+(param, momentum, grad) -> (param', momentum') instead of the 2-3 XLA might
+emit unfused. See /opt/skills/guides/pallas_guide.md; tile (8, 128) to match
+the VPU lane layout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_TILE_ROWS = 8
+_TILE_COLS = 128
+
+
+def _momentum_kernel(p_ref, m_ref, g_ref, out_p_ref, out_m_ref, *, lr, beta):
+    m = beta * m_ref[...] + g_ref[...]
+    out_m_ref[...] = m
+    out_p_ref[...] = p_ref[...] - lr * m
+
+
+def _pad2(x, rows, cols):
+    pr = (-x.shape[0]) % rows
+    pc = (-x.shape[1]) % cols
+    if pr or pc:
+        x = jnp.pad(x, ((0, pr), (0, pc)))
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("lr", "beta", "interpret"))
+def fused_momentum_update(p, m, g, *, lr: float = 0.01, beta: float = 0.9,
+                          interpret: bool | None = None):
+    """SGD-with-momentum on a 2D tensor: returns (p', m').
+
+    interpret=None auto-selects: compiled on TPU, interpreter elsewhere
+    (CPU CI / the virtual mesh).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    orig_shape = p.shape
+    if p.ndim == 1:
+        p, m, g = (x[None, :] for x in (p, m, g))
+    rows, cols = p.shape
+    pp, mp, gp = (_pad2(x, _TILE_ROWS, _TILE_COLS) for x in (p, m, g))
+    grid = (pp.shape[0] // _TILE_ROWS, pp.shape[1] // _TILE_COLS)
+    spec = pl.BlockSpec((_TILE_ROWS, _TILE_COLS), lambda i, j: (i, j))
+    out_p, out_m = pl.pallas_call(
+        functools.partial(_momentum_kernel, lr=lr, beta=beta),
+        grid=grid,
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(pp.shape, pp.dtype),
+            jax.ShapeDtypeStruct(mp.shape, mp.dtype),
+        ],
+        interpret=interpret,
+    )(pp, mp, gp)
+    out_p = out_p[:rows, :cols].reshape(orig_shape)
+    out_m = out_m[:rows, :cols].reshape(orig_shape)
+    return out_p, out_m
+
+
+def momentum_update_reference(p, m, g, *, lr: float = 0.01,
+                              beta: float = 0.9):
+    """Plain-jnp reference used in tests and inside shard_map bodies."""
+    m2 = beta * m + g
+    return p - lr * m2, m2

@@ -1,0 +1,70 @@
+"""Device mesh construction for the ICI data plane.
+
+The reference scales over connections/partitions (SocketMap pools,
+PartitionChannel "N/M" shards — partition_channel.h:46); the TPU-native
+equivalent is a jax.sharding.Mesh whose axes carry those roles:
+
+- ``client`` axis — data-parallel fan-in of request shards (the analog of
+  many client connections / ParallelChannel sub-calls).
+- ``shard`` axis — tensor-parallel partitioning of the served state (the
+  analog of PartitionChannel's N/M server groups).
+
+Collectives ride ICI within a pod slice and DCN across slices, exactly where
+the reference splits RDMA vs TCP (SURVEY.md §5 "distributed communication
+backend").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "client"
+SHARD_AXIS = "shard"
+
+
+def _factor(n: int, max_shard: int = 8) -> tuple[int, int]:
+    """Splits n devices into (client, shard): shard is the smallest
+    power-of-two divisor of n that is >= sqrt(n) (square-ish, MXU-friendly),
+    capped at max_shard; falls back to the largest power-of-two divisor."""
+    root = math.sqrt(n)
+    shard = 1
+    while shard < min(n, max_shard) and n % (shard * 2) == 0:
+        shard *= 2
+        if shard >= root:
+            break
+    return (n // shard, shard)
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              client: Optional[int] = None,
+              shard: Optional[int] = None) -> Mesh:
+    """A 2D (client × shard) mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if client is None or shard is None:
+        client, shard = _factor(n)
+    if client * shard != n:
+        raise ValueError(f"{client}x{shard} != {n} devices")
+    arr = np.array(devs).reshape(client, shard)
+    return Mesh(arr, (CLIENT_AXIS, SHARD_AXIS))
+
+
+def ring_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1D mesh over all devices — the streaming/ppermute ring."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_on(mesh: Mesh, axis: str, dim: int = 0) -> NamedSharding:
+    spec = [None] * (dim + 1)
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,211 @@
+"""ctypes bindings over the native C API (native/capi/capi.h).
+
+The host RPC fabric (fiber scheduler, wait-free sockets, tstd protocol) is
+C++; this module is the Python doorway: Server/Channel objects, Python
+service handlers (run inside fibers; ctypes re-acquires the GIL), and the
+bench harness entry points whose hot loops stay in C.
+
+Reference parity note: the reference's python/ tree is an empty "TBD" stub —
+bindings here are first-class because the TPU data plane (JAX) is Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO, "native", "build", "libbrpc_tpu.so")
+
+_HANDLER_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,                    # ctx
+    ctypes.c_char_p,                    # method
+    ctypes.c_void_p, ctypes.c_size_t,   # req
+    ctypes.c_void_p, ctypes.c_size_t,   # attach
+    ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),  # resp
+    ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),  # resp_attach
+    ctypes.POINTER(ctypes.c_int),       # error_code
+)
+
+_lib = None
+
+
+def _build_native() -> None:
+    build = os.path.join(_REPO, "native", "build")
+    subprocess.run(
+        ["cmake", "-S", "native", "-B", build, "-G", "Ninja",
+         "-DCMAKE_BUILD_TYPE=RelWithDebInfo"],
+        cwd=_REPO, check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", build], cwd=_REPO, check=True,
+                   capture_output=True)
+
+
+def lib() -> ctypes.CDLL:
+    """Loads (building on demand) the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        _build_native()
+    L = ctypes.CDLL(_LIB_PATH)
+    L.tbrpc_server_create.restype = ctypes.c_void_p
+    L.tbrpc_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    L.tbrpc_server_stop.argtypes = [ctypes.c_void_p]
+    L.tbrpc_server_destroy.argtypes = [ctypes.c_void_p]
+    L.tbrpc_server_add_echo_service.argtypes = [ctypes.c_void_p]
+    L.tbrpc_server_add_callback_service.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _HANDLER_CB, ctypes.c_void_p]
+    L.tbrpc_channel_create.restype = ctypes.c_void_p
+    L.tbrpc_channel_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    L.tbrpc_channel_destroy.argtypes = [ctypes.c_void_p]
+    L.tbrpc_call.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_alloc.restype = ctypes.c_void_p
+    L.tbrpc_alloc.argtypes = [ctypes.c_size_t]
+    L.tbrpc_free.argtypes = [ctypes.c_void_p]
+    L.tbrpc_bench_echo_throughput.restype = ctypes.c_double
+    L.tbrpc_bench_echo_throughput.argtypes = [
+        ctypes.c_size_t, ctypes.c_int, ctypes.c_int]
+    L.tbrpc_bench_echo_qps.restype = ctypes.c_double
+    L.tbrpc_bench_echo_qps.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+    _lib = L
+    return L
+
+
+# Handler signature: (method: str, request: bytes, attachment: bytes)
+#   -> (response: bytes, response_attachment: bytes) — raise RpcError to fail.
+Handler = Callable[[str, bytes, bytes], Tuple[bytes, bytes]]
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, text: str = ""):
+        super().__init__(f"rpc error {code}: {text}")
+        self.code = code
+        self.text = text
+
+
+class Server:
+    """A native RPC server hosting Python (and native) services."""
+
+    def __init__(self):
+        self._L = lib()
+        self._h = self._L.tbrpc_server_create()
+        self._cbs = []  # keep CFUNCTYPE objects alive
+        self.port: Optional[int] = None
+
+    def add_echo_service(self) -> None:
+        if self._L.tbrpc_server_add_echo_service(self._h) != 0:
+            raise RuntimeError("add_echo_service failed")
+
+    def add_service(self, name: str, handler: Handler) -> None:
+        L = self._L
+
+        def trampoline(ctx, method, req, req_len, att, att_len,
+                       resp, resp_len, resp_att, resp_att_len, error_code):
+            try:
+                request = ctypes.string_at(req, req_len) if req_len else b""
+                attachment = ctypes.string_at(att, att_len) if att_len else b""
+                r, ra = handler(method.decode(), request, attachment)
+                for data, pp, pl in ((r, resp, resp_len),
+                                     (ra, resp_att, resp_att_len)):
+                    if data:
+                        buf = L.tbrpc_alloc(len(data))
+                        ctypes.memmove(buf, data, len(data))
+                        pp[0] = buf
+                        pl[0] = len(data)
+            except RpcError as e:
+                error_code[0] = e.code if e.code != 0 else 2004
+            except Exception:  # noqa: BLE001 — handler bug => EINTERNAL
+                error_code[0] = 2004
+
+        cb = _HANDLER_CB(trampoline)
+        self._cbs.append(cb)
+        if L.tbrpc_server_add_callback_service(
+                self._h, name.encode(), cb, None) != 0:
+            raise RuntimeError(f"add_service({name}) failed")
+
+    def start(self, addr: str = "127.0.0.1:0") -> int:
+        port = self._L.tbrpc_server_start(self._h, addr.encode())
+        if port < 0:
+            raise RuntimeError(f"server start on {addr} failed")
+        self.port = port
+        return port
+
+    def stop(self) -> None:
+        self._L.tbrpc_server_stop(self._h)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._L.tbrpc_server_destroy(self._h)
+                self._h = None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class Channel:
+    """Client stub to one server ("ip:port")."""
+
+    def __init__(self, addr: str, timeout_ms: int = 1000, max_retry: int = 3):
+        self._L = lib()
+        self._h = self._L.tbrpc_channel_create(
+            addr.encode(), timeout_ms, max_retry)
+        if not self._h:
+            raise RuntimeError(f"channel init to {addr} failed")
+
+    def call(self, service_method: str, request: bytes = b"",
+             attachment: bytes = b"") -> Tuple[bytes, bytes]:
+        L = self._L
+        resp = ctypes.c_void_p()
+        resp_len = ctypes.c_size_t()
+        resp_att = ctypes.c_void_p()
+        resp_att_len = ctypes.c_size_t()
+        errbuf = ctypes.create_string_buffer(256)
+        rc = L.tbrpc_call(
+            self._h, service_method.encode(),
+            request, len(request), attachment, len(attachment),
+            ctypes.byref(resp), ctypes.byref(resp_len),
+            ctypes.byref(resp_att), ctypes.byref(resp_att_len),
+            errbuf, len(errbuf))
+        if rc != 0:
+            raise RpcError(rc, errbuf.value.decode(errors="replace"))
+        try:
+            r = ctypes.string_at(resp, resp_len.value) if resp_len.value else b""
+            ra = (ctypes.string_at(resp_att, resp_att_len.value)
+                  if resp_att_len.value else b"")
+        finally:
+            L.tbrpc_free(resp)
+            L.tbrpc_free(resp_att)
+        return r, ra
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._L.tbrpc_channel_destroy(self._h)
+                self._h = None
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def bench_echo_throughput(payload_size: int, seconds: int = 2,
+                          concurrency: int = 4) -> float:
+    """One-way payload bytes/sec through a loopback echo server."""
+    return lib().tbrpc_bench_echo_throughput(payload_size, seconds,
+                                             concurrency)
+
+
+def bench_echo_qps(seconds: int = 2, concurrency: int = 8):
+    """(calls/sec, p99_us) for small-payload loopback echo."""
+    p99 = ctypes.c_double()
+    qps = lib().tbrpc_bench_echo_qps(seconds, concurrency, ctypes.byref(p99))
+    return qps, p99.value

@@ -1,0 +1,278 @@
+#include "capi/capi.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tbutil/time.h"
+#include "trpc/channel.h"
+#include "trpc/errno.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+namespace {
+
+class NativeEchoService : public Service {
+ public:
+  std::string_view service_name() const override { return "EchoService"; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    if (method == "Echo") {
+      response->append(request);
+      cntl->response_attachment().append(cntl->request_attachment());
+    } else {
+      cntl->SetFailed(TRPC_ENOMETHOD, "no such method: " + method);
+    }
+    done->Run();
+  }
+};
+
+class CallbackService : public Service {
+ public:
+  CallbackService(std::string name, tbrpc_handler_cb cb, void* ctx)
+      : _name(std::move(name)), _cb(cb), _ctx(ctx) {}
+  std::string_view service_name() const override { return _name; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    const std::string req = request.to_string();
+    const std::string att = cntl->request_attachment().to_string();
+    void* resp = nullptr;
+    size_t resp_len = 0;
+    void* resp_att = nullptr;
+    size_t resp_att_len = 0;
+    int error_code = 0;
+    _cb(_ctx, method.c_str(), req.data(), req.size(), att.data(), att.size(),
+        &resp, &resp_len, &resp_att, &resp_att_len, &error_code);
+    if (error_code != 0) {
+      cntl->SetFailed(error_code, "service callback failed");
+    } else {
+      if (resp != nullptr && resp_len > 0) {
+        response->append(resp, resp_len);
+      }
+      if (resp_att != nullptr && resp_att_len > 0) {
+        cntl->response_attachment().append(resp_att, resp_att_len);
+      }
+    }
+    free(resp);
+    free(resp_att);
+    done->Run();
+  }
+
+ private:
+  std::string _name;
+  tbrpc_handler_cb _cb;
+  void* _ctx;
+};
+
+struct ServerBox {
+  Server server;
+  NativeEchoService echo;
+  bool echo_added = false;
+  std::vector<CallbackService*> services;
+  ~ServerBox() {
+    for (auto* s : services) delete s;
+  }
+};
+
+struct ChannelBox {
+  Channel channel;
+};
+
+}  // namespace
+
+void* tbrpc_server_create() { return new ServerBox; }
+
+int tbrpc_server_start(void* server, const char* addr) {
+  auto* box = static_cast<ServerBox*>(server);
+  if (box->server.Start(addr, nullptr) != 0) return -1;
+  return box->server.listen_address().port;
+}
+
+int tbrpc_server_stop(void* server) {
+  return static_cast<ServerBox*>(server)->server.Stop();
+}
+
+void tbrpc_server_destroy(void* server) {
+  delete static_cast<ServerBox*>(server);
+}
+
+int tbrpc_server_add_echo_service(void* server) {
+  auto* box = static_cast<ServerBox*>(server);
+  if (box->echo_added) return 0;
+  box->echo_added = true;
+  return box->server.AddService(&box->echo);
+}
+
+int tbrpc_server_add_callback_service(void* server, const char* name,
+                                      tbrpc_handler_cb cb, void* ctx) {
+  auto* box = static_cast<ServerBox*>(server);
+  auto* svc = new CallbackService(name, cb, ctx);
+  if (box->server.AddService(svc) != 0) {
+    delete svc;
+    return -1;
+  }
+  box->services.push_back(svc);
+  return 0;
+}
+
+void* tbrpc_channel_create(const char* addr, int64_t timeout_ms,
+                           int max_retry) {
+  auto* box = new ChannelBox;
+  ChannelOptions opts;
+  opts.timeout_ms = timeout_ms;
+  opts.max_retry = max_retry;
+  if (box->channel.Init(addr, &opts) != 0) {
+    delete box;
+    return nullptr;
+  }
+  return box;
+}
+
+void tbrpc_channel_destroy(void* channel) {
+  delete static_cast<ChannelBox*>(channel);
+}
+
+void* tbrpc_alloc(size_t n) { return malloc(n); }
+void tbrpc_free(void* p) { free(p); }
+
+int tbrpc_call(void* channel, const char* service_method, const void* req,
+               size_t req_len, const void* attach, size_t attach_len,
+               void** resp, size_t* resp_len, void** resp_attach,
+               size_t* resp_attach_len, char* errbuf, size_t errbuf_len) {
+  auto* box = static_cast<ChannelBox*>(channel);
+  Controller cntl;
+  tbutil::IOBuf request, response;
+  if (req_len > 0) request.append(req, req_len);
+  if (attach_len > 0) cntl.request_attachment().append(attach, attach_len);
+  box->channel.CallMethod(service_method, &cntl, request, &response, nullptr);
+  if (cntl.Failed()) {
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s", cntl.ErrorText().c_str());
+    }
+    return cntl.ErrorCode() != 0 ? cntl.ErrorCode() : -1;
+  }
+  auto out = [](const tbutil::IOBuf& buf, void** p, size_t* n) {
+    *n = buf.size();
+    *p = malloc(buf.size() > 0 ? buf.size() : 1);
+    buf.copy_to(*p, buf.size());
+  };
+  if (resp != nullptr) out(response, resp, resp_len);
+  if (resp_attach != nullptr) {
+    out(cntl.response_attachment(), resp_attach, resp_attach_len);
+  }
+  return 0;
+}
+
+// ---------------- bench harness ----------------
+
+namespace {
+
+struct BenchEnv {
+  ServerBox* server;
+  ChannelBox* channel;
+  bool ok = false;
+
+  BenchEnv() {
+    server = new ServerBox;
+    tbrpc_server_add_echo_service(server);
+    int port = tbrpc_server_start(server, "127.0.0.1:0");
+    if (port <= 0) return;
+    char addr[32];
+    snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+    channel =
+        static_cast<ChannelBox*>(tbrpc_channel_create(addr, 5000, 0));
+    ok = channel != nullptr;
+  }
+  ~BenchEnv() {
+    if (channel != nullptr) tbrpc_channel_destroy(channel);
+    tbrpc_server_stop(server);
+    tbrpc_server_destroy(server);
+  }
+};
+
+}  // namespace
+
+double tbrpc_bench_echo_throughput(size_t payload_size, int seconds,
+                                   int concurrency) {
+  BenchEnv env;
+  if (!env.ok) return -1;
+  if (concurrency < 1) concurrency = 1;
+  std::atomic<int64_t> total_bytes{0};
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+  std::string payload(payload_size, 'b');
+  for (int t = 0; t < concurrency; ++t) {
+    workers.emplace_back([&] {
+      while (!stop.load(std::memory_order_relaxed)) {
+        Controller cntl;
+        tbutil::IOBuf request, response;
+        request.append("x");
+        cntl.request_attachment().append(payload);
+        env.channel->channel.CallMethod("EchoService/Echo", &cntl, request,
+                                        &response, nullptr);
+        if (!cntl.Failed()) {
+          total_bytes.fetch_add(
+              static_cast<int64_t>(cntl.response_attachment().size()),
+              std::memory_order_relaxed);
+        }
+      }
+    });
+  }
+  const int64_t t0 = tbutil::monotonic_time_us();
+  std::this_thread::sleep_for(std::chrono::seconds(seconds));
+  stop.store(true);
+  for (auto& w : workers) w.join();
+  const double elapsed_s = (tbutil::monotonic_time_us() - t0) / 1e6;
+  return static_cast<double>(total_bytes.load()) / elapsed_s;
+}
+
+double tbrpc_bench_echo_qps(int seconds, int concurrency, double* p99_us_out) {
+  BenchEnv env;
+  if (!env.ok) return -1;
+  if (concurrency < 1) concurrency = 1;
+  std::atomic<int64_t> total_calls{0};
+  std::atomic<bool> stop{false};
+  std::mutex lat_mu;
+  std::vector<int64_t> latencies;
+  std::vector<std::thread> workers;
+  for (int t = 0; t < concurrency; ++t) {
+    workers.emplace_back([&] {
+      std::vector<int64_t> local;
+      local.reserve(1 << 16);
+      while (!stop.load(std::memory_order_relaxed)) {
+        Controller cntl;
+        tbutil::IOBuf request, response;
+        request.append("ping");
+        env.channel->channel.CallMethod("EchoService/Echo", &cntl, request,
+                                        &response, nullptr);
+        if (!cntl.Failed()) {
+          total_calls.fetch_add(1, std::memory_order_relaxed);
+          local.push_back(cntl.latency_us());
+        }
+      }
+      std::lock_guard<std::mutex> lk(lat_mu);
+      latencies.insert(latencies.end(), local.begin(), local.end());
+    });
+  }
+  const int64_t t0 = tbutil::monotonic_time_us();
+  std::this_thread::sleep_for(std::chrono::seconds(seconds));
+  stop.store(true);
+  for (auto& w : workers) w.join();
+  const double elapsed_s = (tbutil::monotonic_time_us() - t0) / 1e6;
+  if (p99_us_out != nullptr) {
+    *p99_us_out = 0;
+    if (!latencies.empty()) {
+      std::sort(latencies.begin(), latencies.end());
+      *p99_us_out = static_cast<double>(
+          latencies[static_cast<size_t>(latencies.size() * 0.99)]);
+    }
+  }
+  return static_cast<double>(total_calls.load()) / elapsed_s;
+}

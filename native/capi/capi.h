@@ -1,0 +1,58 @@
+// C API over the native RPC stack — the ctypes boundary for the Python
+// bindings (brpc_tpu.runtime). The reference keeps python/ as a stub
+// (python/README.md "TBD"); our bindings are first-class because the TPU
+// data plane (JAX) lives in Python and needs the host RPC fabric.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+extern "C" {
+
+// ---- server ----
+void* tbrpc_server_create();
+// addr: "0.0.0.0:0" for ephemeral. Returns the bound port, or -1.
+int tbrpc_server_start(void* server, const char* addr);
+int tbrpc_server_stop(void* server);
+void tbrpc_server_destroy(void* server);
+// Built-in native echo service "EchoService" (methods: Echo) — payload and
+// attachment are echoed back untouched. Used by benchmarks and smoke tests.
+int tbrpc_server_add_echo_service(void* server);
+
+// Python-backed service: the callback runs in a fiber (ctypes acquires the
+// GIL). It must fill *resp/resp_len via tbrpc_alloc (ownership passes back).
+typedef void (*tbrpc_handler_cb)(void* ctx, const char* method,
+                                 const void* req, size_t req_len,
+                                 const void* attach, size_t attach_len,
+                                 void** resp, size_t* resp_len,
+                                 void** resp_attach, size_t* resp_attach_len,
+                                 int* error_code);
+int tbrpc_server_add_callback_service(void* server, const char* name,
+                                      tbrpc_handler_cb cb, void* ctx);
+
+// ---- channel ----
+void* tbrpc_channel_create(const char* addr, int64_t timeout_ms,
+                           int max_retry);
+void tbrpc_channel_destroy(void* channel);
+
+// Synchronous call. On success (return 0) *resp/*resp_attach are
+// tbrpc_alloc'd buffers the caller frees with tbrpc_free. On failure
+// returns the error code and fills errbuf.
+int tbrpc_call(void* channel, const char* service_method, const void* req,
+               size_t req_len, const void* attach, size_t attach_len,
+               void** resp, size_t* resp_len, void** resp_attach,
+               size_t* resp_attach_len, char* errbuf, size_t errbuf_len);
+
+void* tbrpc_alloc(size_t n);
+void tbrpc_free(void* p);
+
+// ---- bench harness (loops in C so Python overhead is out of the path) ----
+// Echo round-trips of `payload_size`-byte attachments for ~`seconds`, with
+// `concurrency` concurrent callers. Returns one-way payload bytes/sec.
+double tbrpc_bench_echo_throughput(size_t payload_size, int seconds,
+                                   int concurrency);
+// Small-payload echo QPS (latency-bound): returns calls/sec; if p99_us_out
+// is non-null, stores the p99 latency in microseconds.
+double tbrpc_bench_echo_qps(int seconds, int concurrency, double* p99_us_out);
+
+}  // extern "C"

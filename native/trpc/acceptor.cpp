@@ -13,8 +13,10 @@
 
 namespace trpc {
 
-InputMessageBase* AcceptMessenger::OnNewMessages(Socket* listen_socket) {
+InputMessageBase* AcceptMessenger::OnNewMessages(Socket* listen_socket,
+                                                 int* defer_error) {
   while (true) {
+    if (listen_socket->Failed()) return nullptr;  // StopAccept cut us off
     sockaddr_in addr{};
     socklen_t len = sizeof(addr);
     int fd = accept4(listen_socket->fd(), reinterpret_cast<sockaddr*>(&addr),
@@ -43,6 +45,10 @@ Acceptor::~Acceptor() { StopAccept(); }
 
 int Acceptor::StartAccept(int listen_fd, void* user) {
   _user = user;
+  {
+    std::lock_guard<std::mutex> lk(_conn_mu);
+    _stopped = false;
+  }
   Socket::Options opt;
   opt.fd = listen_fd;
   opt.messenger = &_accept_messenger;
@@ -64,6 +70,13 @@ void Acceptor::OnNewConnection(int fd, const tbutil::EndPoint& remote) {
     return;
   }
   std::lock_guard<std::mutex> lk(_conn_mu);
+  if (_stopped) {
+    // Raced with StopAccept's snapshot: this connection would leak past
+    // Server shutdown with a dangling user pointer — kill it here.
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) == 0) s->SetFailed(TRPC_EFAILEDSOCKET);
+    return;
+  }
   _connections.insert(sid);
   // Lazily shed dead entries so the set tracks live connections.
   if (_connections.size() % 64 == 0) {
@@ -89,6 +102,7 @@ void Acceptor::StopAccept() {
   std::vector<SocketId> conns;
   {
     std::lock_guard<std::mutex> lk(_conn_mu);
+    _stopped = true;
     conns.assign(_connections.begin(), _connections.end());
     _connections.clear();
   }

@@ -26,7 +26,8 @@ class AcceptMessenger : public InputMessenger {
       : InputMessenger(true), _owner(owner) {}
   // "Readable" on the listen socket = connections pending; never returns a
   // message.
-  InputMessageBase* OnNewMessages(Socket* listen_socket) override;
+  InputMessageBase* OnNewMessages(Socket* listen_socket,
+                                  int* defer_error) override;
 
  private:
   Acceptor* _owner;
@@ -54,6 +55,7 @@ class Acceptor : public InputMessenger {
   void* _user = nullptr;
 
   mutable std::mutex _conn_mu;
+  bool _stopped = false;  // guarded by _conn_mu; set by StopAccept
   std::unordered_set<SocketId> _connections;
 };
 

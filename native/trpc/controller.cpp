@@ -37,6 +37,11 @@ void Controller::SetFailed(int code, const std::string& reason) {
   _error_text = reason;
 }
 
+bool Controller::HasRetryBudget() const {
+  return _nretry < _max_retry &&
+         (_deadline_us == 0 || tbutil::gettimeofday_us() < _deadline_us);
+}
+
 // Runs with the correlation id LOCKED. Issues the current attempt; on a
 // synchronous failure, falls through to the retry/finish decision directly
 // (no fiber_id_error: we already hold the lock).
@@ -73,8 +78,7 @@ void Controller::IssueRPC() {
       sock->RemovePendingId(attempt);
     }
     // Synchronous attempt failure: retry here if budget remains.
-    if (_nretry < _max_retry &&
-        (_deadline_us == 0 || tbutil::gettimeofday_us() < _deadline_us)) {
+    if (HasRetryBudget()) {
       ++_nretry;
       continue;
     }
@@ -111,9 +115,7 @@ int Controller::OnError(tbthread::fiber_id_t id, void* data, int error) {
     old_sock->RemovePendingId(cntl->current_attempt_id());
   }
   SocketMap::global().Remove(cntl->_remote_side, cntl->_attempt_socket);
-  if (cntl->_nretry < cntl->_max_retry &&
-      (cntl->_deadline_us == 0 ||
-       tbutil::gettimeofday_us() < cntl->_deadline_us)) {
+  if (cntl->HasRetryBudget()) {
     ++cntl->_nretry;
     cntl->IssueRPC();  // EndRPC (destroying id) or leaves id locked...
     // IssueRPC returning with the RPC in flight leaves the id locked by us:

@@ -71,6 +71,9 @@ class Controller {
   tbthread::fiber_id_t current_attempt_id() const {
     return tbthread::fiber_id_for_attempt(_correlation_id, _nretry);
   }
+  // Retries left AND the deadline hasn't passed (single source of truth for
+  // the sync- and async-failure retry decisions).
+  bool HasRetryBudget() const;
 
   // config
   int64_t _timeout_ms = -1;

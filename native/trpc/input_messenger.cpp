@@ -86,7 +86,7 @@ ParseResult InputMessenger::CutInputMessage(Socket* s, int* protocol_index) {
   return r;
 }
 
-InputMessageBase* InputMessenger::OnNewMessages(Socket* s) {
+InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
   // Keep only the newest complete message as the inline candidate; older
   // ones go to their own fibers immediately.
   InputMessageBase* pending = nullptr;
@@ -95,11 +95,11 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s) {
     if (nr < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
-      s->SetFailed(errno);
+      *defer_error = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
       break;
     }
     if (nr == 0) {
-      s->SetFailed(TRPC_EEOF);
+      *defer_error = TRPC_EEOF;
       break;
     }
     while (true) {
@@ -110,7 +110,7 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s) {
         TB_LOG(WARNING) << "unparsable bytes from "
                         << tbutil::endpoint2str(s->remote_side())
                         << ", closing";
-        s->SetFailed(TRPC_EREQUEST);
+        *defer_error = TRPC_EREQUEST;
         return pending;
       }
       r.msg->socket_id = s->id();

@@ -25,7 +25,14 @@ class InputMessenger {
   // AFTER releasing the input-fiber claim — a handler that parks must not
   // head-of-line-block later requests on the connection (reference
   // input_messenger.cpp:182-223).
-  virtual InputMessageBase* OnNewMessages(Socket* s);
+  //
+  // EOF / read errors are NOT SetFailed here: they are reported through
+  // *defer_error and applied by the caller AFTER the returned message is
+  // dispatched. A peer that responds-then-closes must have its response
+  // delivered before the failure errors the in-flight correlation ids —
+  // otherwise a received response gets dropped and the RPC spuriously
+  // retried.
+  virtual InputMessageBase* OnNewMessages(Socket* s, int* defer_error);
 
   // Dispatch a parsed message (request or response per _server_side).
   void ProcessInline(InputMessageBase* msg);

@@ -17,6 +17,16 @@ Server::~Server() {
   if (_stop_butex != nullptr) {
     tbthread::butex_destroy(_stop_butex);
   }
+  if (_drain_butex != nullptr) {
+    tbthread::butex_destroy(_drain_butex);
+  }
+}
+
+void Server::EndRequest() {
+  if (_concurrency.fetch_sub(1, std::memory_order_release) == 1 &&
+      _drain_butex != nullptr) {
+    tbthread::butex_increment_and_wake_all(_drain_butex);
+  }
 }
 
 int Server::AddService(Service* service) {
@@ -45,6 +55,7 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   GlobalInitializeOrDie();
   if (options != nullptr) _options = *options;
   if (_stop_butex == nullptr) _stop_butex = tbthread::butex_create();
+  if (_drain_butex == nullptr) _drain_butex = tbthread::butex_create();
 
   tbutil::EndPoint pt;
   if (tbutil::str2endpoint(addr, &pt) != 0) {
@@ -82,6 +93,15 @@ int Server::Start(const char* addr, const ServerOptions* options) {
 int Server::Stop() {
   if (!_running.exchange(false, std::memory_order_acq_rel)) return -1;
   _acceptor.StopAccept();
+  // Drain: in-flight handlers may park well past their connection's death;
+  // their done closures call EndRequest() on this Server, so it must not be
+  // destroyed under them. (Do not call Stop from inside a handler.)
+  while (_concurrency.load(std::memory_order_acquire) > 0) {
+    const int v =
+        tbthread::butex_value(_drain_butex)->load(std::memory_order_acquire);
+    if (_concurrency.load(std::memory_order_acquire) == 0) break;
+    tbthread::butex_wait(_drain_butex, v, nullptr);
+  }
   tbthread::butex_increment_and_wake_all(_stop_butex);
   return 0;
 }

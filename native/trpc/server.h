@@ -59,21 +59,19 @@ class Server {
   size_t connection_count() const { return _acceptor.connection_count(); }
   bool running() const { return _running.load(std::memory_order_acquire); }
 
-  // Request-level concurrency gate.
+  // Request-level concurrency gate. Always counts in-flight requests (not
+  // only when capped): Stop() drains to zero before returning, so a done
+  // closure can never touch a destroyed Server (handlers may outlive their
+  // connection).
   bool BeginRequest() {
-    if (_options.max_concurrency > 0 &&
-        _concurrency.fetch_add(1, std::memory_order_relaxed) >=
-            _options.max_concurrency) {
-      _concurrency.fetch_sub(1, std::memory_order_relaxed);
+    int32_t prev = _concurrency.fetch_add(1, std::memory_order_acquire);
+    if (_options.max_concurrency > 0 && prev >= _options.max_concurrency) {
+      EndRequest();
       return false;
     }
     return true;
   }
-  void EndRequest() {
-    if (_options.max_concurrency > 0) {
-      _concurrency.fetch_sub(1, std::memory_order_relaxed);
-    }
-  }
+  void EndRequest();
   int32_t concurrency() const {
     return _concurrency.load(std::memory_order_relaxed);
   }
@@ -86,6 +84,7 @@ class Server {
   std::atomic<bool> _running{false};
   std::atomic<int32_t> _concurrency{0};
   tbthread::Butex* _stop_butex = nullptr;
+  tbthread::Butex* _drain_butex = nullptr;  // woken when concurrency hits 0
 };
 
 }  // namespace trpc

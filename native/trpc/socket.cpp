@@ -411,10 +411,11 @@ int Socket::ConnectIfNot(int64_t deadline_us) {
     return -1;
   }
   if (rc != 0) {
+    pollfd pfd{fd, POLLOUT, 0};
     while (true) {
-      pollfd pfd{fd, POLLOUT, 0};
+      pfd.revents = 0;
       int pr = poll(&pfd, 1, 0);
-      if (pr > 0) break;  // writable (or error — SO_ERROR check below)
+      if (pr > 0) break;  // writable or error (revents checked below)
       if (tbutil::gettimeofday_us() >= deadline_us) {
         // SetFailed (not a quiet rollback): queued writers parked on the
         // epollout butex get woken + errored, pending ids are notified.
@@ -431,7 +432,10 @@ int Socket::ConnectIfNot(int64_t deadline_us) {
     int err = 0;
     socklen_t len = sizeof(err);
     getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
-    if (err != 0) {
+    // SO_ERROR alone is not enough: the input fiber's read may have already
+    // CONSUMED the pending error (readv on a refused connect clears it), so
+    // also trust the poll revents.
+    if (err != 0 || (pfd.revents & (POLLERR | POLLHUP)) != 0) {
       SetFailed(TRPC_ECONNECT);
       errno = TRPC_ECONNECT;
       return -1;
@@ -476,10 +480,11 @@ void* Socket::ProcessEventThunk(void* argv) {
 void Socket::ProcessEvent() {
   InputMessenger* messenger = _messenger;
   InputMessageBase* tail = nullptr;
+  int defer_error = 0;
   int n = _nevent.load(std::memory_order_acquire);
   while (true) {
-    if (!Failed() && messenger != nullptr) {
-      InputMessageBase* m = messenger->OnNewMessages(this);
+    if (!Failed() && defer_error == 0 && messenger != nullptr) {
+      InputMessageBase* m = messenger->OnNewMessages(this, &defer_error);
       if (m != nullptr) {
         if (tail != nullptr) messenger->ProcessInFiber(tail);
         tail = m;
@@ -490,7 +495,7 @@ void Socket::ProcessEvent() {
                                         std::memory_order_acquire)) {
       break;
     }
-    if (Failed()) {  // stop spinning on a dead socket
+    if (Failed() || defer_error != 0) {  // stop spinning on a dead socket
       _nevent.store(0, std::memory_order_release);
       break;
     }
@@ -500,6 +505,11 @@ void Socket::ProcessEvent() {
   // blocks just this fiber, not the connection (no head-of-line blocking).
   if (tail != nullptr && messenger != nullptr) {
     messenger->ProcessInline(tail);
+  }
+  // EOF/read errors fail the socket only AFTER the response that rode in
+  // with them was delivered (respond-then-close peers).
+  if (defer_error != 0) {
+    SetFailed(defer_error);
   }
   Deref();
 }

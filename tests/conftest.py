@@ -2,13 +2,17 @@
 
 JAX tests run on a virtual 8-device CPU mesh (no real TPU pod in CI), the
 same way the reference fakes multi-node with many loopback servers + list://
-naming (SURVEY.md §4). Environment must be set before jax is imported.
+naming (SURVEY.md §4).
+
+NOTE: this image's sitecustomize registers the axon TPU plugin at
+interpreter start and forces JAX_PLATFORMS=axon, so env vars alone don't
+stick — jax.config.update('jax_platforms', 'cpu') before first backend use
+is the reliable override (backend init is lazy).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +20,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
