@@ -1,0 +1,245 @@
+// Load balancer + naming service + circuit breaker tests. Mirrors the
+// reference's pattern (test/brpc_load_balancer_unittest.cpp,
+// brpc_naming_service_unittest.cpp): many real servers in one process on
+// loopback ports, fed to the LB via list:// naming — no mock network.
+#include <atomic>
+#include <cstdio>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mini_test.h"
+#include "tbthread/fiber.h"
+#include "tbutil/time.h"
+#include "trpc/channel.h"
+#include "trpc/circuit_breaker.h"
+#include "trpc/errno.h"
+#include "trpc/load_balancer.h"
+#include "trpc/naming_service.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+namespace {
+
+// Echo service that reports which server instance handled the call.
+class TaggedEcho : public Service {
+ public:
+  explicit TaggedEcho(std::string tag) : _tag(std::move(tag)) {}
+  std::string_view service_name() const override { return "EchoService"; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    _calls.fetch_add(1);
+    response->append(_tag);
+    done->Run();
+  }
+  int calls() const { return _calls.load(); }
+
+ private:
+  std::string _tag;
+  std::atomic<int> _calls{0};
+};
+
+struct Cluster {
+  std::vector<Server*> servers;
+  std::vector<TaggedEcho*> services;
+  std::string list_url;
+
+  explicit Cluster(int n) {
+    list_url = "list://";
+    for (int i = 0; i < n; ++i) {
+      auto* svc = new TaggedEcho("server-" + std::to_string(i));
+      auto* srv = new Server;
+      srv->AddService(svc);
+      TB_CHECK(srv->Start("127.0.0.1:0") == 0);
+      if (i > 0) list_url += ",";
+      list_url += "127.0.0.1:" + std::to_string(srv->listen_address().port);
+      servers.push_back(srv);
+      services.push_back(svc);
+    }
+  }
+  ~Cluster() {
+    for (auto* s : servers) {
+      s->Stop();
+      delete s;
+    }
+    for (auto* s : services) delete s;
+  }
+  int total_calls() const {
+    int t = 0;
+    for (auto* s : services) t += s->calls();
+    return t;
+  }
+};
+
+std::string call_once(Channel& ch) {
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("x");
+  ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+  if (cntl.Failed()) return "FAILED:" + cntl.ErrorText();
+  return resp.to_string();
+}
+
+}  // namespace
+
+TEST_CASE(naming_parsers) {
+  std::vector<ServerNode> nodes;
+  ASSERT_EQ(NamingServiceThread::ParseList(
+                "127.0.0.1:100,127.0.0.1:200 w=3", &nodes), 0);
+  ASSERT_EQ(nodes.size(), 2u);
+  ASSERT_EQ(nodes[0].addr.port, 100);
+  ASSERT_EQ(nodes[1].addr.port, 200);
+  ASSERT_EQ(nodes[1].tag, std::string("w=3"));
+
+  const char* path = "/tmp/test_ns_servers.txt";
+  FILE* fp = fopen(path, "w");
+  fprintf(fp, "# comment\n127.0.0.1:300\n127.0.0.1:400 0/2\n\n");
+  fclose(fp);
+  ASSERT_EQ(NamingServiceThread::ParseFile(path, &nodes), 0);
+  ASSERT_EQ(nodes.size(), 2u);
+  ASSERT_EQ(nodes[1].tag, std::string("0/2"));
+  remove(path);
+}
+
+TEST_CASE(round_robin_spreads_evenly) {
+  Cluster cluster(3);
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  ASSERT_EQ(ch.Init(cluster.list_url.c_str(), "rr", &opts), 0);
+  std::map<std::string, int> hits;
+  for (int i = 0; i < 30; ++i) hits[call_once(ch)]++;
+  ASSERT_EQ(hits.size(), 3u);
+  for (auto& [tag, n] : hits) {
+    ASSERT_EQ(n, 10);  // perfect rotation
+  }
+}
+
+TEST_CASE(random_hits_all) {
+  Cluster cluster(3);
+  Channel ch;
+  ASSERT_EQ(ch.Init(cluster.list_url.c_str(), "random", nullptr), 0);
+  std::map<std::string, int> hits;
+  for (int i = 0; i < 60; ++i) hits[call_once(ch)]++;
+  ASSERT_EQ(hits.size(), 3u);
+}
+
+TEST_CASE(consistent_hash_sticky) {
+  Cluster cluster(4);
+  Channel ch;
+  ASSERT_EQ(ch.Init(cluster.list_url.c_str(), "c_murmurhash", nullptr), 0);
+  // Same request code -> same server, always.
+  std::string first;
+  for (int i = 0; i < 10; ++i) {
+    Controller cntl;
+    cntl.set_request_code(0xDEADBEEF);
+    tbutil::IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    if (first.empty()) first = resp.to_string();
+    ASSERT_EQ(resp.to_string(), first);
+  }
+  // Different codes spread over multiple servers.
+  std::map<std::string, int> hits;
+  for (uint64_t code = 0; code < 64; ++code) {
+    Controller cntl;
+    cntl.set_request_code(code * 0x9E3779B97F4A7C15ULL);
+    tbutil::IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    hits[resp.to_string()]++;
+  }
+  ASSERT_TRUE(hits.size() >= 3);
+}
+
+TEST_CASE(dead_server_failover) {
+  // 2 live + 1 dead endpoint: retries must fail over, every call succeeds.
+  Cluster cluster(2);
+  std::string url = cluster.list_url + ",127.0.0.1:1";
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 3;
+  ASSERT_EQ(ch.Init(url.c_str(), "rr", &opts), 0);
+  int failures = 0;
+  for (int i = 0; i < 30; ++i) {
+    if (call_once(ch).rfind("server-", 0) != 0) failures++;
+  }
+  ASSERT_EQ(failures, 0);
+  ASSERT_EQ(cluster.total_calls(), 30);
+}
+
+TEST_CASE(circuit_breaker_isolates_flaky_node) {
+  NodeHealth h;
+  int64_t now = tbutil::gettimeofday_us();
+  ASSERT_FALSE(h.IsIsolated(now));
+  // A streak of failures trips it.
+  for (int i = 0; i < 10; ++i) h.OnCallEnd(true, now);
+  ASSERT_TRUE(h.IsIsolated(now));
+  ASSERT_TRUE(h.isolation_count() == 1);
+  // Still isolated shortly after; expires by 100ms (base isolation).
+  ASSERT_TRUE(h.IsIsolated(now + 50 * 1000));
+  ASSERT_FALSE(h.IsIsolated(now + 150 * 1000));
+  // Successful probes after expiry keep it healthy.
+  for (int i = 0; i < 20; ++i) h.OnCallEnd(false, now + 200 * 1000);
+  ASSERT_FALSE(h.IsIsolated(now + 200 * 1000));
+}
+
+TEST_CASE(lb_skips_isolated_nodes) {
+  Cluster cluster(2);
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 1;
+  ASSERT_EQ(ch.Init(cluster.list_url.c_str(), "rr", &opts), 0);
+  // Trip server-0's breaker directly through the health registry.
+  tbutil::EndPoint pt0 = cluster.servers[0]->listen_address();
+  tbutil::str2endpoint(
+      ("127.0.0.1:" + std::to_string(pt0.port)).c_str(), &pt0);
+  NodeHealth* h = GetNodeHealth(pt0);
+  int64_t now = tbutil::gettimeofday_us();
+  for (int i = 0; i < 10; ++i) h->OnCallEnd(true, now);
+  ASSERT_TRUE(h->IsIsolated(now));
+  // All traffic lands on server-1 while 0 is isolated.
+  int before1 = cluster.services[1]->calls();
+  for (int i = 0; i < 10; ++i) {
+    ASSERT_EQ(call_once(ch), std::string("server-1"));
+  }
+  ASSERT_EQ(cluster.services[1]->calls(), before1 + 10);
+}
+
+TEST_CASE(file_naming_service_reload) {
+  Cluster cluster(2);
+  const char* path = "/tmp/test_ns_reload.txt";
+  FILE* fp = fopen(path, "w");
+  fprintf(fp, "127.0.0.1:%d\n", cluster.servers[0]->listen_address().port);
+  fclose(fp);
+
+  Channel ch;
+  std::string url = std::string("file://") + path;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  ASSERT_EQ(ch.Init(url.c_str(), "rr", &opts), 0);
+  ASSERT_EQ(call_once(ch), std::string("server-0"));
+
+  // Rewrite the file to point at server 1; the watcher polls mtime at 1s.
+  // (Sleep past a full poll cycle; mtime granularity can be 1s.)
+  tbutil::Timer t;
+  fp = fopen(path, "w");
+  fprintf(fp, "127.0.0.1:%d\n", cluster.servers[1]->listen_address().port);
+  fclose(fp);
+  std::string got;
+  for (int i = 0; i < 40; ++i) {  // up to 4s
+    tbthread::fiber_usleep(100 * 1000);
+    got = call_once(ch);
+    if (got == "server-1") break;
+  }
+  ASSERT_EQ(got, std::string("server-1"));
+  remove(path);
+}
+
+TEST_MAIN

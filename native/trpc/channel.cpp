@@ -25,6 +25,29 @@ int Channel::Init(const char* server_addr, const ChannelOptions* options) {
   return Init(pt, options);
 }
 
+int Channel::Init(const char* naming_url, const char* lb_name,
+                  const ChannelOptions* options) {
+  if (naming_url == nullptr) {
+    TB_LOG(ERROR) << "naming_url is null";
+    return -1;
+  }
+  GlobalInitializeOrDie();
+  if (options != nullptr) _options = *options;
+  _lb.reset(LoadBalancer::CreateByName(lb_name != nullptr ? lb_name : "rr"));
+  if (_lb == nullptr) {
+    TB_LOG(ERROR) << "unknown load balancer: " << lb_name;
+    return -1;
+  }
+  _ns.reset(new NamingServiceThread);
+  if (_ns->Start(naming_url, _lb.get()) != 0) {
+    TB_LOG(ERROR) << "naming service failed for " << naming_url;
+    _ns.reset();
+    _lb.reset();
+    return -1;
+  }
+  return 0;
+}
+
 // Reference flow (channel.cpp:433): lock a ranged correlation id covering
 // all retries, serialize once, arm the deadline timer, issue attempt 0,
 // then Join (sync) or return (async).
@@ -37,6 +60,7 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   cntl->_protocol = _options.protocol;
   cntl->_service_method = service_method;
   cntl->_remote_side = _server;
+  cntl->_lb = _lb;
   cntl->_request_payload = request;  // zero-copy block share
   cntl->_response_payload = response;
   cntl->_done = done;

@@ -5,12 +5,15 @@
 // async).
 #pragma once
 
+#include <memory>
 #include <string>
 
 #include "tbutil/endpoint.h"
 #include "tbutil/iobuf.h"
 #include "trpc/closure.h"
 #include "trpc/controller.h"
+#include "trpc/load_balancer.h"
+#include "trpc/naming_service.h"
 
 namespace trpc {
 
@@ -27,6 +30,12 @@ class Channel {
   int Init(const tbutil::EndPoint& server, const ChannelOptions* options);
   // "ip:port" or "host:port".
   int Init(const char* server_addr, const ChannelOptions* options);
+  // Naming + load balancing: Init("list://h1:p,h2:p", "rr", &opts).
+  // Schemes: list://, file://, dns:// (naming_service.h); balancers:
+  // rr/random/wr/c_murmurhash/la (load_balancer.h). Reference
+  // channel.h:177-200 Init(naming_url, lb, options).
+  int Init(const char* naming_url, const char* lb_name,
+           const ChannelOptions* options);
 
   // service_method: "EchoService/Echo". `request` is the serialized payload
   // (the native core is payload-agnostic — pb/json/tensor framing lives in
@@ -40,6 +49,10 @@ class Channel {
  private:
   tbutil::EndPoint _server;
   ChannelOptions _options;
+  // Shared: every in-flight Controller holds a ref, so destroying the
+  // Channel mid-async-RPC cannot free the LB under the retry/feedback path.
+  std::shared_ptr<LoadBalancer> _lb;
+  std::unique_ptr<NamingServiceThread> _ns;
 };
 
 }  // namespace trpc

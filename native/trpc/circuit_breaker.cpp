@@ -1,0 +1,54 @@
+#include "trpc/circuit_breaker.h"
+
+#include <mutex>
+#include <unordered_map>
+
+#include "tbutil/time.h"
+
+namespace trpc {
+
+void NodeHealth::OnCallEnd(bool failed, int64_t now_us) {
+  // Healing: a successful call after isolation expiry decays the backoff.
+  double ema = _error_ema.load(std::memory_order_relaxed);
+  double next = ema * (1.0 - kAlpha) + (failed ? kAlpha : 0.0);
+  _error_ema.store(next, std::memory_order_relaxed);
+  int32_t n = _samples.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (!failed) {
+    // Streak of successes after revival shrinks the penalty level.
+    if (next < kIsolateThreshold / 2) {
+      int64_t c = _isolation_count.load(std::memory_order_relaxed);
+      if (c > 0 && next < 0.05) {
+        _isolation_count.store(c - 1, std::memory_order_relaxed);
+      }
+    }
+    return;
+  }
+  if (n >= kMinSamples && next >= kIsolateThreshold &&
+      !IsIsolated(now_us)) {
+    int64_t c = _isolation_count.fetch_add(1, std::memory_order_relaxed);
+    int64_t dur = kBaseIsolationUs << (c > 8 ? 8 : c);
+    if (dur > kMaxIsolationUs) dur = kMaxIsolationUs;
+    _isolated_until_us.store(now_us + dur, std::memory_order_relaxed);
+    // Half-open: drop the EMA below the trip point so the post-expiry probe
+    // call's outcome decides quickly instead of re-tripping on history.
+    _error_ema.store(kIsolateThreshold / 2, std::memory_order_relaxed);
+    _samples.store(0, std::memory_order_relaxed);
+  }
+}
+
+NodeHealth* GetNodeHealth(const tbutil::EndPoint& addr) {
+  struct Registry {
+    std::mutex mu;
+    std::unordered_map<tbutil::EndPoint, NodeHealth*,
+                       tbutil::EndPointHasher> map;
+  };
+  static Registry* reg = new Registry;
+  std::lock_guard<std::mutex> lk(reg->mu);
+  auto it = reg->map.find(addr);
+  if (it != reg->map.end()) return it->second;
+  auto* h = new NodeHealth;  // immortal by design
+  reg->map[addr] = h;
+  return h;
+}
+
+}  // namespace trpc

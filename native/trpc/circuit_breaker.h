@@ -1,0 +1,52 @@
+// Per-node circuit breaker + health registry.
+// Capability parity: reference src/brpc/circuit_breaker.h:25-84 (per-Socket
+// EMA error recorder with long+short windows, OnCallEnd, isolation with
+// doubling duration) + details/health_check.h (periodic revival).
+//
+// Design: health state lives in a process-wide registry keyed by endpoint
+// (never freed — load balancers cache raw NodeHealth* in their server lists,
+// so the hot feedback path is a few atomics, no lookup). Isolation is
+// time-based with exponential backoff; expiry is the half-open probe: the
+// next selection is allowed through and its outcome re-isolates or heals.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+#include "tbutil/endpoint.h"
+
+namespace trpc {
+
+class NodeHealth {
+ public:
+  // Called on every RPC completion against this node.
+  void OnCallEnd(bool failed, int64_t now_us);
+  // True while isolated (selection must skip the node).
+  bool IsIsolated(int64_t now_us) const {
+    return now_us < _isolated_until_us.load(std::memory_order_relaxed);
+  }
+
+  int64_t isolation_count() const {
+    return _isolation_count.load(std::memory_order_relaxed);
+  }
+  double error_ema() const { return _error_ema.load(std::memory_order_relaxed); }
+
+ private:
+  static constexpr double kAlpha = 0.1;          // EMA step per call
+  static constexpr double kIsolateThreshold = 0.5;
+  static constexpr int kMinSamples = 5;          // don't trip on 1-2 errors
+  static constexpr int64_t kBaseIsolationUs = 100 * 1000;   // 100ms
+  static constexpr int64_t kMaxIsolationUs = 30LL * 1000 * 1000;  // 30s
+
+  std::atomic<double> _error_ema{0.0};
+  std::atomic<int32_t> _samples{0};
+  std::atomic<int64_t> _isolated_until_us{0};
+  std::atomic<int64_t> _last_isolation_end_us{0};
+  std::atomic<int64_t> _isolation_count{0};
+};
+
+// Process-wide endpoint -> NodeHealth (entries are immortal; pointers are
+// safe to cache anywhere).
+NodeHealth* GetNodeHealth(const tbutil::EndPoint& addr);
+
+}  // namespace trpc

@@ -4,6 +4,7 @@
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/errno.h"
+#include "trpc/load_balancer.h"
 #include "trpc/socket_map.h"
 #include "trpc/tstd_protocol.h"
 
@@ -30,6 +31,12 @@ void Controller::Reset() {
   _error_code = 0;
   _error_text.clear();
   _server_side = false;
+  _lb.reset();
+  _tried.clear();
+  _request_code = 0;
+  _has_request_code = false;
+  _attempt_begin_us = 0;
+  _response_received = false;
 }
 
 void Controller::SetFailed(int code, const std::string& reason) {
@@ -51,6 +58,21 @@ void Controller::IssueRPC() {
     if (proto == nullptr || proto->pack_request == nullptr) {
       EndRPC(TRPC_EINTERNAL, "protocol not registered");
       return;
+    }
+    _attempt_begin_us = tbutil::gettimeofday_us();
+    if (_lb != nullptr) {
+      LoadBalancer::SelectIn in;
+      in.request_code = _request_code;
+      in.has_request_code = _has_request_code;
+      in.excluded = &_tried;
+      if (_lb->SelectServer(in, &_remote_side) != 0) {
+        // No node was selected for this attempt: EndRPC must not feed back
+        // the previous attempt's node again.
+        _tried.clear();
+        EndRPC(TRPC_ENODATA, "no server available");
+        return;
+      }
+      _tried.push_back(_remote_side);
     }
     SocketUniquePtr sock;
     int err = 0;
@@ -77,8 +99,12 @@ void Controller::IssueRPC() {
       err_text = "write failed";
       sock->RemovePendingId(attempt);
     }
-    // Synchronous attempt failure: retry here if budget remains.
+    // Synchronous attempt failure: retry here if budget remains. Feedback
+    // only for superseded attempts — EndRPC feeds back the final one.
     if (HasRetryBudget()) {
+      if (_lb != nullptr) {
+        _lb->Feedback(_remote_side, 0, /*failed=*/true);
+      }
       ++_nretry;
       continue;
     }
@@ -116,6 +142,9 @@ int Controller::OnError(tbthread::fiber_id_t id, void* data, int error) {
   }
   SocketMap::global().Remove(cntl->_remote_side, cntl->_attempt_socket);
   if (cntl->HasRetryBudget()) {
+    if (cntl->_lb != nullptr) {
+      cntl->_lb->Feedback(cntl->_remote_side, 0, /*failed=*/true);
+    }
     ++cntl->_nretry;
     cntl->IssueRPC();  // EndRPC (destroying id) or leaves id locked...
     // IssueRPC returning with the RPC in flight leaves the id locked by us:
@@ -154,6 +183,18 @@ void Controller::EndRPC(int error, const std::string& error_text) {
     _error_text = error_text;
   }
   _end_time_us = tbutil::gettimeofday_us();
+  // LB feedback for the FINAL attempt (earlier failed attempts fed back at
+  // their retry sites). Node health is about TRANSPORT: if any server
+  // response arrived, the node is reachable — application errors in the
+  // response don't count against it. Classifying by error code is wrong
+  // (codes mix server-sent values and raw errnos); the received flag is
+  // exact. Latency is per-attempt, not whole-RPC (earlier attempts' burn
+  // must not poison the final node's EWMA).
+  if (_lb != nullptr && !_tried.empty()) {
+    const bool transport_failure = error != 0 && !_response_received;
+    _lb->Feedback(_remote_side, _end_time_us - _attempt_begin_us,
+                  transport_failure);
+  }
   if (_timer_id != 0) {
     tbthread::TimerThread::singleton()->unschedule(_timer_id);
     _timer_id = 0;
@@ -192,6 +233,7 @@ void TstdHandleResponse(TstdInputMessage* msg) {
     delete msg;
     return;
   }
+  acc.mark_response_received();
   if (acc.response_payload() != nullptr) {
     acc.response_payload()->clear();
     acc.response_payload()->append(std::move(msg->payload));

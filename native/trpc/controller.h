@@ -13,6 +13,9 @@
 #include <cstdint>
 #include <string>
 
+#include <memory>
+#include <vector>
+
 #include "tbthread/fiber_id.h"
 #include "tbthread/timer_thread.h"
 #include "tbutil/endpoint.h"
@@ -23,6 +26,7 @@
 namespace trpc {
 
 class Channel;
+class LoadBalancer;
 
 class Controller {
  public:
@@ -54,6 +58,13 @@ class Controller {
   const tbutil::EndPoint& remote_side() const { return _remote_side; }
   tbthread::fiber_id_t call_id() const { return _correlation_id; }
 
+  // Consistent-hashing key for "c_murmurhash" balancers (reference
+  // Controller::set_request_code).
+  void set_request_code(uint64_t code) {
+    _request_code = code;
+    _has_request_code = true;
+  }
+
   // Server side: absolute deadline propagated from the client (0 = none);
   // handlers may shed work when it has passed.
   int64_t deadline_us() const { return _deadline_us; }
@@ -83,6 +94,13 @@ class Controller {
   // call state
   std::string _service_method;
   tbutil::EndPoint _remote_side;
+  // Shared with the Channel: keeps the LB alive across async completion.
+  std::shared_ptr<LoadBalancer> _lb;
+  std::vector<tbutil::EndPoint> _tried;    // excluded on retry
+  uint64_t _request_code = 0;
+  bool _has_request_code = false;
+  int64_t _attempt_begin_us = 0;           // start of the CURRENT attempt
+  bool _response_received = false;         // any server response arrived
   tbutil::IOBuf _request_payload;
   tbutil::IOBuf* _response_payload = nullptr;
   tbutil::IOBuf _request_attachment;
@@ -122,6 +140,7 @@ class ControllerPrivateAccessor {
     _c->_response_attachment = std::move(a);
   }
   tbutil::IOBuf* response_payload() { return _c->_response_payload; }
+  void mark_response_received() { _c->_response_received = true; }
   tbthread::fiber_id_t current_attempt_id() const {
     return _c->current_attempt_id();
   }

@@ -1,0 +1,252 @@
+#include "trpc/load_balancer.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cstring>
+#include <map>
+
+#include "tbutil/fast_rand.h"
+#include "tbutil/time.h"
+#include "trpc/errno.h"
+
+namespace trpc {
+
+void LoadBalancer::Feedback(const tbutil::EndPoint& addr, int64_t latency_us,
+                            bool failed) {
+  GetNodeHealth(addr)->OnCallEnd(failed, tbutil::gettimeofday_us());
+}
+
+namespace lb_detail {
+
+namespace {
+uint32_t parse_weight(const std::string& tag) {
+  // "w=N" anywhere in the tag; default 1.
+  size_t pos = tag.find("w=");
+  if (pos == std::string::npos) return 1;
+  long w = strtol(tag.c_str() + pos + 2, nullptr, 10);
+  return w > 0 ? static_cast<uint32_t>(w) : 1;
+}
+
+bool excluded(const LoadBalancer::SelectIn& in, const tbutil::EndPoint& pt) {
+  if (in.excluded == nullptr) return false;
+  for (const auto& e : *in.excluded) {
+    if (e == pt) return true;
+  }
+  return false;
+}
+}  // namespace
+
+void ListLoadBalancer::ResetServers(const std::vector<ServerNode>& servers) {
+  _list.Modify([&servers](ServerList& list) {
+    list.nodes.clear();
+    list.nodes.reserve(servers.size());
+    for (const ServerNode& s : servers) {
+      Node n;
+      n.server = s;
+      n.weight = parse_weight(s.tag);
+      n.health = GetNodeHealth(s.addr);
+      list.nodes.push_back(n);
+    }
+    return 1;
+  });
+}
+
+int ListLoadBalancer::SelectServer(const SelectIn& in, tbutil::EndPoint* out) {
+  tbutil::DoublyBufferedData<ServerList>::ScopedPtr ptr;
+  if (_list.Read(&ptr) != 0 || ptr->nodes.empty()) {
+    errno = TRPC_ENODATA;
+    return -1;
+  }
+  const ServerList& list = *ptr;
+  const size_t n = list.nodes.size();
+  const int64_t now = tbutil::gettimeofday_us();
+  // Health+exclusion-aware pass: probe up to 2n picks.
+  for (size_t attempt = 0; attempt < 2 * n; ++attempt) {
+    const Node& node = list.nodes[Pick(list, in, attempt) % n];
+    if (node.health->IsIsolated(now)) continue;
+    if (excluded(in, node.server.addr)) continue;
+    *out = node.server.addr;
+    return 0;
+  }
+  // Safety valve: every node tripped/excluded — ignore isolation rather
+  // than failing the whole cluster (reference cluster_recover_policy.h).
+  for (size_t attempt = 0; attempt < n; ++attempt) {
+    const Node& node = list.nodes[Pick(list, in, attempt) % n];
+    if (excluded(in, node.server.addr)) continue;
+    *out = node.server.addr;
+    return 0;
+  }
+  *out = list.nodes[Pick(list, in, 0) % n].server.addr;
+  return 0;
+}
+
+namespace {
+
+// ---- rr ----
+class RoundRobinLB : public ListLoadBalancer {
+ protected:
+  size_t Pick(const ServerList&, const SelectIn&, size_t) override {
+    return _seq.fetch_add(1, std::memory_order_relaxed);
+  }
+  std::atomic<size_t> _seq{0};
+};
+
+// ---- random ----
+class RandomLB : public ListLoadBalancer {
+ protected:
+  size_t Pick(const ServerList&, const SelectIn&, size_t) override {
+    return static_cast<size_t>(tbutil::fast_rand());
+  }
+};
+
+// ---- wr: weight-proportional random ----
+class WeightedRandomLB : public ListLoadBalancer {
+ protected:
+  size_t Pick(const ServerList& list, const SelectIn&, size_t) override {
+    uint64_t total = 0;
+    for (const Node& n : list.nodes) total += n.weight;
+    if (total == 0) return 0;
+    uint64_t r = tbutil::fast_rand_less_than(total);
+    for (size_t i = 0; i < list.nodes.size(); ++i) {
+      if (r < list.nodes[i].weight) return i;
+      r -= list.nodes[i].weight;
+    }
+    return 0;
+  }
+};
+
+// ---- c_murmurhash: ketama-style consistent hashing ----
+// 64-bit avalanche hash (splitmix-style) over (endpoint, vnode).
+uint64_t mix64(uint64_t x) {
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ULL;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBULL;
+  x ^= x >> 31;
+  return x;
+}
+
+class ConsistentHashLB : public LoadBalancer {
+  static constexpr int kVNodes = 100;
+
+ public:
+  void ResetServers(const std::vector<ServerNode>& servers) override {
+    _list.Modify([&servers](Ring& ring) {
+      ring.points.clear();
+      ring.nodes.clear();
+      ring.nodes.reserve(servers.size());
+      for (const ServerNode& s : servers) {
+        lb_detail::Node n;
+        n.server = s;
+        n.health = GetNodeHealth(s.addr);
+        ring.nodes.push_back(n);
+      }
+      for (size_t i = 0; i < ring.nodes.size(); ++i) {
+        uint64_t base = tbutil::endpoint_hash(ring.nodes[i].server.addr);
+        for (int v = 0; v < kVNodes; ++v) {
+          ring.points.emplace_back(mix64(base + v * 0x9E3779B97F4A7C15ULL),
+                                   i);
+        }
+      }
+      std::sort(ring.points.begin(), ring.points.end());
+      return 1;
+    });
+  }
+
+  int SelectServer(const SelectIn& in, tbutil::EndPoint* out) override {
+    tbutil::DoublyBufferedData<Ring>::ScopedPtr ptr;
+    if (_list.Read(&ptr) != 0 || ptr->points.empty()) {
+      errno = TRPC_ENODATA;
+      return -1;
+    }
+    const Ring& ring = *ptr;
+    uint64_t key = in.has_request_code ? in.request_code : tbutil::fast_rand();
+    auto it = std::lower_bound(ring.points.begin(), ring.points.end(),
+                               std::make_pair(mix64(key), size_t(0)));
+    if (it == ring.points.end()) it = ring.points.begin();
+    const int64_t now = tbutil::gettimeofday_us();
+    // Walk the ring from the hash point until a healthy node.
+    for (size_t step = 0; step < ring.points.size(); ++step, ++it) {
+      if (it == ring.points.end()) it = ring.points.begin();
+      const lb_detail::Node& node = ring.nodes[it->second];
+      if (node.health->IsIsolated(now)) continue;
+      if (in.excluded != nullptr) {
+        bool skip = false;
+        for (const auto& e : *in.excluded) {
+          if (e == node.server.addr) { skip = true; break; }
+        }
+        if (skip) continue;
+      }
+      *out = node.server.addr;
+      return 0;
+    }
+    *out = ring.nodes[ring.points.front().second].server.addr;
+    return 0;
+  }
+
+ private:
+  struct Ring {
+    std::vector<std::pair<uint64_t, size_t>> points;  // (hash, node index)
+    std::vector<lb_detail::Node> nodes;
+  };
+  tbutil::DoublyBufferedData<Ring> _list;
+};
+
+// ---- la: locality-aware (inverse-EWMA-latency weighted random) ----
+// Reference policy/locality_aware_load_balancer.cpp weights nodes by
+// inverse latency with error punishment; this is the same signal with a
+// simpler estimator (per-node EWMA updated by Feedback).
+class LocalityAwareLB : public ListLoadBalancer {
+ public:
+  void Feedback(const tbutil::EndPoint& addr, int64_t latency_us,
+                bool failed) override {
+    LoadBalancer::Feedback(addr, latency_us, failed);
+    std::lock_guard<std::mutex> lk(_mu);
+    double& ewma = _latency_ewma[tbutil::endpoint_hash(addr)];
+    double sample = failed ? 1e6 : static_cast<double>(latency_us);
+    ewma = ewma <= 0 ? sample : ewma * 0.9 + sample * 0.1;
+  }
+
+ protected:
+  size_t Pick(const ServerList& list, const SelectIn&, size_t) override {
+    std::lock_guard<std::mutex> lk(_mu);
+    double total = 0;
+    _w.resize(list.nodes.size());
+    for (size_t i = 0; i < list.nodes.size(); ++i) {
+      auto it = _latency_ewma.find(
+          tbutil::endpoint_hash(list.nodes[i].server.addr));
+      double lat = (it != _latency_ewma.end() && it->second > 0)
+                       ? it->second
+                       : 1000.0;  // optimistic prior: 1ms
+      _w[i] = 1.0 / lat;
+      total += _w[i];
+    }
+    double r = tbutil::fast_rand_double() * total;
+    for (size_t i = 0; i < _w.size(); ++i) {
+      if (r < _w[i]) return i;
+      r -= _w[i];
+    }
+    return 0;
+  }
+
+ private:
+  std::mutex _mu;
+  std::map<uint64_t, double> _latency_ewma;
+  std::vector<double> _w;
+};
+
+}  // namespace
+}  // namespace lb_detail
+
+LoadBalancer* LoadBalancer::CreateByName(const std::string& name) {
+  if (name == "rr" || name.empty()) return new lb_detail::RoundRobinLB;
+  if (name == "random") return new lb_detail::RandomLB;
+  if (name == "wr") return new lb_detail::WeightedRandomLB;
+  if (name == "c_murmurhash" || name == "c_hash") {
+    return new lb_detail::ConsistentHashLB;
+  }
+  if (name == "la") return new lb_detail::LocalityAwareLB;
+  return nullptr;
+}
+
+}  // namespace trpc

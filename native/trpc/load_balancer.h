@@ -1,0 +1,85 @@
+// Load balancers over a DoublyBufferedData server list.
+// Capability parity: reference src/brpc/load_balancer.h:35-97 (AddServer/
+// RemoveServer/SelectServer/Feedback; "DoublyBufferedData makes SelectServer
+// low-contended" :72) and the policy/ implementations registered in
+// global.cpp:383-391: rr, random, wr (weighted random), c_murmurhash
+// (consistent hashing), la (locality-aware, latency-weighted).
+//
+// Node health (circuit breaker) is consulted inline: isolated nodes are
+// skipped at selection, with a single fallback pass that ignores isolation
+// when every node is tripped (cluster_recover_policy.h's safety valve).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tbutil/doubly_buffered_data.h"
+#include "tbutil/endpoint.h"
+#include "trpc/circuit_breaker.h"
+
+namespace trpc {
+
+struct ServerNode {
+  tbutil::EndPoint addr;
+  std::string tag;  // "w=3" weight / "0/3" partition, naming-service-specific
+
+  bool operator==(const ServerNode& rhs) const {
+    return addr == rhs.addr && tag == rhs.tag;
+  }
+};
+
+class LoadBalancer {
+ public:
+  virtual ~LoadBalancer() = default;
+
+  // Full replacement push from the naming service (reference
+  // NamingServiceActions::ResetServers).
+  virtual void ResetServers(const std::vector<ServerNode>& servers) = 0;
+
+  struct SelectIn {
+    uint64_t request_code = 0;     // consistent-hash key
+    bool has_request_code = false;
+    // Endpoints already tried by this RPC (excluded on retry).
+    const std::vector<tbutil::EndPoint>* excluded = nullptr;
+  };
+  // 0 on success; TRPC_ENODATA when no (healthy) server exists.
+  virtual int SelectServer(const SelectIn& in, tbutil::EndPoint* out) = 0;
+
+  // RPC completion feedback (latency drives `la`, errors drive breakers).
+  virtual void Feedback(const tbutil::EndPoint& addr, int64_t latency_us,
+                        bool failed);
+
+  // "rr" | "random" | "wr" | "c_murmurhash" | "la". nullptr for unknown.
+  static LoadBalancer* CreateByName(const std::string& name);
+};
+
+namespace lb_detail {
+
+struct Node {
+  ServerNode server;
+  uint32_t weight = 1;
+  NodeHealth* health = nullptr;  // immortal registry pointer
+};
+
+struct ServerList {
+  std::vector<Node> nodes;
+};
+
+// Shared machinery: DBD-backed list + health-aware pick loop.
+class ListLoadBalancer : public LoadBalancer {
+ public:
+  void ResetServers(const std::vector<ServerNode>& servers) override;
+  int SelectServer(const SelectIn& in, tbutil::EndPoint* out) override;
+
+ protected:
+  // Pick an index in [0, n) for this attempt; `attempt` increments on
+  // health/exclusion rejection so implementations can probe alternatives.
+  virtual size_t Pick(const ServerList& list, const SelectIn& in,
+                      size_t attempt) = 0;
+  // Hook for Feedback-driven balancers (la).
+  tbutil::DoublyBufferedData<ServerList> _list;
+};
+
+}  // namespace lb_detail
+}  // namespace trpc

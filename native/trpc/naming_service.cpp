@@ -1,0 +1,141 @@
+#include "trpc/naming_service.h"
+
+#include <sys/stat.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+#include "tbutil/logging.h"
+
+namespace trpc {
+
+namespace {
+
+// "ip:port" or "ip:port tag" -> node.
+int parse_node(const std::string& token, ServerNode* node) {
+  std::string addr = token;
+  std::string tag;
+  size_t sp = token.find_first_of(" \t");
+  if (sp != std::string::npos) {
+    addr = token.substr(0, sp);
+    size_t tag_start = token.find_first_not_of(" \t", sp);
+    if (tag_start != std::string::npos) tag = token.substr(tag_start);
+  }
+  if (tbutil::str2endpoint(addr.c_str(), &node->addr) != 0 &&
+      tbutil::hostname2endpoint(addr.c_str(), &node->addr) != 0) {
+    return -1;
+  }
+  node->tag = std::move(tag);
+  return 0;
+}
+
+}  // namespace
+
+int NamingServiceThread::ParseList(const std::string& payload,
+                                   std::vector<ServerNode>* out) {
+  out->clear();
+  size_t start = 0;
+  while (start <= payload.size()) {
+    size_t comma = payload.find(',', start);
+    std::string token = payload.substr(
+        start, comma == std::string::npos ? std::string::npos : comma - start);
+    if (!token.empty()) {
+      ServerNode node;
+      if (parse_node(token, &node) == 0) {
+        out->push_back(std::move(node));
+      } else {
+        TB_LOG(WARNING) << "list:// skipping bad entry: " << token;
+      }
+    }
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return out->empty() ? -1 : 0;
+}
+
+int NamingServiceThread::ParseFile(const std::string& path,
+                                   std::vector<ServerNode>* out) {
+  out->clear();
+  FILE* fp = fopen(path.c_str(), "r");
+  if (fp == nullptr) return -1;
+  char line[512];
+  while (fgets(line, sizeof(line), fp) != nullptr) {
+    size_t len = strlen(line);
+    while (len > 0 && (line[len - 1] == '\n' || line[len - 1] == '\r')) {
+      line[--len] = '\0';
+    }
+    if (len == 0 || line[0] == '#') continue;
+    ServerNode node;
+    if (parse_node(line, &node) == 0) {
+      out->push_back(std::move(node));
+    }
+  }
+  fclose(fp);
+  return 0;
+}
+
+int NamingServiceThread::ResolveDns(const std::string& hostport,
+                                    std::vector<ServerNode>* out) {
+  out->clear();
+  ServerNode node;
+  if (tbutil::hostname2endpoint(hostport.c_str(), &node.addr) != 0) {
+    return -1;
+  }
+  out->push_back(std::move(node));
+  return 0;
+}
+
+NamingServiceThread::~NamingServiceThread() { Stop(); }
+
+int NamingServiceThread::Start(const std::string& url, LoadBalancer* lb) {
+  size_t sep = url.find("://");
+  if (sep == std::string::npos) return -1;
+  _scheme = url.substr(0, sep);
+  _payload = url.substr(sep + 3);
+  _lb = lb;
+  if (_scheme != "list" && _scheme != "file" && _scheme != "dns") {
+    TB_LOG(ERROR) << "unknown naming scheme: " << _scheme;
+    return -1;
+  }
+  // First resolution inline so the channel is usable on return
+  // (list:// especially must not race the first call).
+  std::vector<ServerNode> servers;
+  int rc = -1;
+  if (_scheme == "list") rc = ParseList(_payload, &servers);
+  else if (_scheme == "file") rc = ParseFile(_payload, &servers);
+  else rc = ResolveDns(_payload, &servers);
+  if (rc == 0) _lb->ResetServers(servers);
+  if (_scheme == "list") return rc;  // static: no thread needed
+  _stop.store(false);
+  _thread = std::thread([this] { Run(); });
+  return 0;
+}
+
+void NamingServiceThread::Stop() {
+  _stop.store(true);
+  if (_thread.joinable()) _thread.join();
+}
+
+void NamingServiceThread::Run() {
+  time_t last_mtime = 0;
+  while (!_stop.load(std::memory_order_relaxed)) {
+    const int sleep_ms = _scheme == "file" ? 1000 : 5000;
+    for (int i = 0; i < sleep_ms / 50 && !_stop.load(); ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    if (_stop.load()) break;
+    std::vector<ServerNode> servers;
+    if (_scheme == "file") {
+      struct stat st;
+      if (stat(_payload.c_str(), &st) != 0) continue;
+      if (st.st_mtime == last_mtime) continue;
+      last_mtime = st.st_mtime;
+      if (ParseFile(_payload, &servers) == 0) _lb->ResetServers(servers);
+    } else {  // dns
+      if (ResolveDns(_payload, &servers) == 0) _lb->ResetServers(servers);
+    }
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,53 @@
+// Naming services: resolve a "scheme://payload" url into a live server list
+// pushed to the load balancer.
+// Capability parity: reference src/brpc/naming_service.h:36-61
+// (RunNamingService pushing ResetServers into NamingServiceActions;
+// PeriodicNamingService base) and policy/ registrations global.cpp:369-380:
+// list:// (inline), file:// (watched file), dns:// via http:// (resolve).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "trpc/load_balancer.h"
+
+namespace trpc {
+
+// Parses "scheme://payload" and runs the matching resolver on a background
+// thread, pushing full server lists into `lb` (which it does not own).
+// Supported:
+//   list://ip:port,ip:port[ tag],...   static list, resolved once
+//   file:///path/to/file               one "ip:port [tag]" per line,
+//                                      re-read when mtime changes (1s poll)
+//   dns://host:port                    getaddrinfo, re-resolved every 5s
+//   (bare "ip:port" handled by Channel directly, not here)
+class NamingServiceThread {
+ public:
+  NamingServiceThread() = default;
+  ~NamingServiceThread();
+
+  int Start(const std::string& url, LoadBalancer* lb);
+  void Stop();
+
+  // Parse helpers (exposed for tests).
+  static int ParseList(const std::string& payload,
+                       std::vector<ServerNode>* out);
+  static int ParseFile(const std::string& path,
+                       std::vector<ServerNode>* out);
+  static int ResolveDns(const std::string& hostport,
+                        std::vector<ServerNode>* out);
+
+ private:
+  void Run();
+
+  std::string _scheme;
+  std::string _payload;
+  LoadBalancer* _lb = nullptr;
+  std::thread _thread;
+  std::atomic<bool> _stop{false};
+};
+
+}  // namespace trpc
