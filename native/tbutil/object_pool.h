@@ -18,8 +18,10 @@ class ObjectPool {
 
  public:
   static ObjectPool* singleton() {
-    static ObjectPool pool;
-    return &pool;
+    // Leaked deliberately (see ResourcePool::singleton): background threads
+    // may still allocate/release during process teardown.
+    static ObjectPool* pool = new ObjectPool;
+    return pool;
   }
 
   T* get_object() {

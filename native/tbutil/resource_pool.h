@@ -39,8 +39,11 @@ class ResourcePool {
 
  public:
   static ResourcePool* singleton() {
-    static ResourcePool pool;
-    return &pool;
+    // Leaked deliberately: background threads (epoll dispatcher, timer,
+    // fiber workers) may address_resource() during process teardown; a
+    // by-value static would be destructed under them (exit-time segfault).
+    static ResourcePool* pool = new ResourcePool;
+    return pool;
   }
 
   // Allocate a slot (possibly recycled). *id receives the slot id.

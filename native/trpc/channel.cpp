@@ -25,6 +25,15 @@ int Channel::Init(const char* server_addr, const ChannelOptions* options) {
   return Init(pt, options);
 }
 
+int Channel::Init(std::shared_ptr<LoadBalancer> lb,
+                  const ChannelOptions* options) {
+  if (lb == nullptr) return -1;
+  GlobalInitializeOrDie();
+  if (options != nullptr) _options = *options;
+  _lb = std::move(lb);
+  return 0;
+}
+
 int Channel::Init(const char* naming_url, const char* lb_name,
                   const ChannelOptions* options) {
   if (naming_url == nullptr) {

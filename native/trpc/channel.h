@@ -36,6 +36,10 @@ class Channel {
   // channel.h:177-200 Init(naming_url, lb, options).
   int Init(const char* naming_url, const char* lb_name,
            const ChannelOptions* options);
+  // LB channel over an externally-fed balancer (no naming thread): used by
+  // PartitionChannel, which owns one naming service and splits its list
+  // across partition balancers.
+  int Init(std::shared_ptr<LoadBalancer> lb, const ChannelOptions* options);
 
   // service_method: "EchoService/Echo". `request` is the serialized payload
   // (the native core is payload-agnostic — pb/json/tensor framing lives in

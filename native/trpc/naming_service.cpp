@@ -88,12 +88,12 @@ int NamingServiceThread::ResolveDns(const std::string& hostport,
 
 NamingServiceThread::~NamingServiceThread() { Stop(); }
 
-int NamingServiceThread::Start(const std::string& url, LoadBalancer* lb) {
+int NamingServiceThread::Start(const std::string& url, Listener listener) {
   size_t sep = url.find("://");
   if (sep == std::string::npos) return -1;
   _scheme = url.substr(0, sep);
   _payload = url.substr(sep + 3);
-  _lb = lb;
+  _listener = std::move(listener);
   if (_scheme != "list" && _scheme != "file" && _scheme != "dns") {
     TB_LOG(ERROR) << "unknown naming scheme: " << _scheme;
     return -1;
@@ -105,7 +105,7 @@ int NamingServiceThread::Start(const std::string& url, LoadBalancer* lb) {
   if (_scheme == "list") rc = ParseList(_payload, &servers);
   else if (_scheme == "file") rc = ParseFile(_payload, &servers);
   else rc = ResolveDns(_payload, &servers);
-  if (rc == 0) _lb->ResetServers(servers);
+  if (rc == 0) _listener(servers);
   if (_scheme == "list") return rc;  // static: no thread needed
   _stop.store(false);
   _thread = std::thread([this] { Run(); });
@@ -131,9 +131,9 @@ void NamingServiceThread::Run() {
       if (stat(_payload.c_str(), &st) != 0) continue;
       if (st.st_mtime == last_mtime) continue;
       last_mtime = st.st_mtime;
-      if (ParseFile(_payload, &servers) == 0) _lb->ResetServers(servers);
+      if (ParseFile(_payload, &servers) == 0) _listener(servers);
     } else {  // dns
-      if (ResolveDns(_payload, &servers) == 0) _lb->ResetServers(servers);
+      if (ResolveDns(_payload, &servers) == 0) _listener(servers);
     }
   }
 }

@@ -17,7 +17,7 @@
 namespace trpc {
 
 // Parses "scheme://payload" and runs the matching resolver on a background
-// thread, pushing full server lists into `lb` (which it does not own).
+// thread, pushing full server lists into the listener callback.
 // Supported:
 //   list://ip:port,ip:port[ tag],...   static list, resolved once
 //   file:///path/to/file               one "ip:port [tag]" per line,
@@ -26,10 +26,20 @@ namespace trpc {
 //   (bare "ip:port" handled by Channel directly, not here)
 class NamingServiceThread {
  public:
+  using Listener = std::function<void(const std::vector<ServerNode>&)>;
+
   NamingServiceThread() = default;
   ~NamingServiceThread();
 
-  int Start(const std::string& url, LoadBalancer* lb);
+  // The listener runs on the naming thread (and once inline at Start);
+  // PartitionChannel uses it to split the list by partition tag before the
+  // per-partition balancers see it.
+  int Start(const std::string& url, Listener listener);
+  int Start(const std::string& url, LoadBalancer* lb) {
+    return Start(url, [lb](const std::vector<ServerNode>& servers) {
+      lb->ResetServers(servers);
+    });
+  }
   void Stop();
 
   // Parse helpers (exposed for tests).
@@ -45,7 +55,7 @@ class NamingServiceThread {
 
   std::string _scheme;
   std::string _payload;
-  LoadBalancer* _lb = nullptr;
+  Listener _listener;
   std::thread _thread;
   std::atomic<bool> _stop{false};
 };

@@ -1,0 +1,223 @@
+#include "trpc/parallel_channel.h"
+
+#include <atomic>
+
+#include "tbthread/fiber.h"
+#include "tbthread/sync.h"
+#include "tbutil/logging.h"
+#include "trpc/errno.h"
+
+namespace trpc {
+
+SubCall CallMapper::Map(int, int, const std::string&,
+                        const tbutil::IOBuf& request) {
+  SubCall sc;
+  sc.request = request;  // zero-copy block share
+  return sc;
+}
+
+int ResponseMerger::Merge(tbutil::IOBuf* response,
+                          const tbutil::IOBuf& sub_response, int) {
+  response->append(sub_response);
+  return 0;
+}
+
+int ParallelChannel::AddChannel(Channel* sub, CallMapper* mapper,
+                                ResponseMerger* merger) {
+  if (sub == nullptr) return -1;
+  Sub s;
+  s.channel = sub;
+  s.mapper.reset(mapper);
+  s.merger.reset(merger);
+  _subs.push_back(std::move(s));
+  return 0;
+}
+
+namespace {
+
+// Shared by the N sub-call done-closures. The last completion (or the sync
+// caller) frees it — sub-Controllers live here, so it must outlive every
+// straggler even after early finalize.
+struct ParallelCallContext {
+  Controller* parent_cntl = nullptr;
+  tbutil::IOBuf* parent_response = nullptr;
+  Closure* parent_done = nullptr;  // nullptr = sync (caller waits all_done)
+
+  int nch = 0;
+  std::unique_ptr<Controller[]> cntls;
+  std::unique_ptr<tbutil::IOBuf[]> responses;
+  std::unique_ptr<std::atomic<bool>[]> completed;
+  std::vector<ResponseMerger*> mergers;  // borrowed from the channel
+  std::vector<bool> fired;
+
+  std::atomic<int> successes{0};
+  std::atomic<int> failures{0};
+  std::atomic<int> remaining{0};
+  std::atomic<bool> finalized{false};
+  int fail_limit = 0;
+  int success_limit = 0;
+
+  explicit ParallelCallContext(int n)
+      : nch(n),
+        cntls(new Controller[n]),
+        responses(new tbutil::IOBuf[n]),
+        completed(new std::atomic<bool>[n]),
+        mergers(n, nullptr),
+        fired(n, false) {
+    for (int i = 0; i < n; ++i) completed[i].store(false);
+  }
+
+  // Parent outcome: success as soon as success_limit sub-calls succeeded,
+  // failure as soon as fail_limit failed; when all complete, success iff
+  // the success quota was met.
+  void TryFinalize(bool all_done) {
+    const int s = successes.load(std::memory_order_acquire);
+    const int f = failures.load(std::memory_order_acquire);
+    const bool success = s >= success_limit;
+    if (!all_done && !success && f < fail_limit) return;
+    if (finalized.exchange(true, std::memory_order_acq_rel)) return;
+
+    if (success) {
+      for (int i = 0; i < nch; ++i) {
+        if (!fired[i] || !completed[i].load(std::memory_order_acquire)) {
+          continue;
+        }
+        if (cntls[i].Failed()) continue;
+        ResponseMerger* m = mergers[i];
+        if (m != nullptr &&
+            m->Merge(parent_response, responses[i], i) < 0) {
+          parent_cntl->SetFailed(TRPC_EINTERNAL, "response merge failed");
+          break;
+        }
+        if (m == nullptr) {
+          parent_response->append(responses[i]);
+        }
+      }
+    } else {
+      for (int i = 0; i < nch; ++i) {
+        if (fired[i] && completed[i].load(std::memory_order_acquire) &&
+            cntls[i].Failed()) {
+          parent_cntl->SetFailed(cntls[i].ErrorCode(), cntls[i].ErrorText());
+          break;
+        }
+      }
+      if (!parent_cntl->Failed()) {
+        parent_cntl->SetFailed(TRPC_EINTERNAL,
+                               "insufficient successful sub-calls");
+      }
+    }
+    if (parent_done != nullptr) {
+      parent_done->Run();
+    }
+    // Sync callers observe the result after all_done_latch: nothing to do.
+  }
+
+  // `remaining` starts at live+1: the fire loop holds one token, so neither
+  // all_done finalization nor cleanup can happen while sub-calls are still
+  // being fired. Whoever decrements to 0 is the context's sole owner.
+  tbthread::CountdownEvent all_done_latch{1};
+
+  void OnSubDone(int index) {
+    completed[index].store(true, std::memory_order_release);
+    if (cntls[index].Failed()) {
+      failures.fetch_add(1, std::memory_order_acq_rel);
+    } else {
+      successes.fetch_add(1, std::memory_order_acq_rel);
+    }
+    TryFinalize(/*all_done=*/false);
+    const int left = remaining.fetch_sub(1, std::memory_order_acq_rel) - 1;
+    if (left == 0) {
+      TryFinalize(/*all_done=*/true);
+      if (parent_done != nullptr) {
+        delete this;  // async: the 0-owner frees the context
+      } else {
+        all_done_latch.signal();  // sync: the caller's frame frees it
+      }
+    }
+  }
+};
+
+}  // namespace
+
+void ParallelChannel::CallMethod(const std::string& service_method,
+                                 Controller* cntl,
+                                 const tbutil::IOBuf& request,
+                                 tbutil::IOBuf* response, Closure* done) {
+  const int nch = static_cast<int>(_subs.size());
+  if (nch == 0) {
+    cntl->SetFailed(TRPC_EINTERNAL, "no sub-channels");
+    if (done != nullptr) done->Run();
+    return;
+  }
+  // Map first (some sub-calls may be skipped), then compute limits, then
+  // fire — limits depend on the live count.
+  std::vector<SubCall> calls(nch);
+  int live = 0;
+  for (int i = 0; i < nch; ++i) {
+    CallMapper* mapper = _subs[i].mapper.get();
+    if (mapper != nullptr) {
+      calls[i] = mapper->Map(i, nch, service_method, request);
+    } else {
+      calls[i].request = request;
+    }
+    if (!(calls[i].flags & SubCall::kSkip)) ++live;
+  }
+  if (live == 0) {
+    cntl->SetFailed(TRPC_EINTERNAL, "all sub-calls skipped");
+    if (done != nullptr) done->Run();
+    return;
+  }
+
+  auto* ctx = new ParallelCallContext(nch);
+  ctx->parent_cntl = cntl;
+  ctx->parent_response = response;
+  ctx->parent_done = done;
+  // +1 = the fire loop's token (no all_done/cleanup until firing ends).
+  ctx->remaining.store(live + 1, std::memory_order_relaxed);
+  ctx->success_limit =
+      (_options.success_limit > 0 && _options.success_limit <= live)
+          ? _options.success_limit
+          : live;
+  ctx->fail_limit = (_options.fail_limit > 0 && _options.fail_limit <= live)
+                        ? _options.fail_limit
+                        : live - ctx->success_limit + 1;
+  // Everything TryFinalize reads — fired, mergers, sub timeouts — is fully
+  // written BEFORE the first sub-call fires: an early finalize (fail_limit
+  // hit by an inline-failing sub-call) may run parent_done->Run() while
+  // this loop is still firing, and parent_done may free the caller's
+  // Controller. Nothing below reads `cntl` after the first fire.
+  const int64_t sub_timeout_ms = cntl->timeout_ms();
+  for (int i = 0; i < nch; ++i) {
+    ctx->mergers[i] = _subs[i].merger.get();
+    ctx->fired[i] = !(calls[i].flags & SubCall::kSkip);
+    if (ctx->fired[i] && sub_timeout_ms >= 0) {
+      ctx->cntls[i].set_timeout_ms(sub_timeout_ms);
+    }
+  }
+  const bool sync = done == nullptr;
+  for (int i = 0; i < nch; ++i) {
+    if (!ctx->fired[i]) continue;
+    const std::string& method = calls[i].service_method.empty()
+                                    ? service_method
+                                    : calls[i].service_method;
+    _subs[i].channel->CallMethod(
+        method, &ctx->cntls[i], calls[i].request, &ctx->responses[i],
+        NewCallback([ctx, i] { ctx->OnSubDone(i); }));
+  }
+  // Release the fire-loop token.
+  const bool last =
+      ctx->remaining.fetch_sub(1, std::memory_order_acq_rel) - 1 == 0;
+  if (sync) {
+    if (last) {
+      ctx->TryFinalize(/*all_done=*/true);
+    } else {
+      ctx->all_done_latch.wait();
+    }
+    delete ctx;
+  } else if (last) {
+    ctx->TryFinalize(/*all_done=*/true);
+    delete ctx;
+  }
+}
+
+}  // namespace trpc

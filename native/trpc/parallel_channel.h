@@ -1,0 +1,82 @@
+// ParallelChannel: one CallMethod fans out to N sub-channels concurrently;
+// responses are merged.
+// Capability parity: reference src/brpc/parallel_channel.h:33-218
+// (AddChannel(sub, ownership, CallMapper, ResponseMerger); CallMapper::Map
+// may SKIP a sub-channel :94-110; ResponseMerger folds sub-responses :127;
+// fail_limit/success_limit early termination :167-173).
+//
+// This is the host-side fan-out half of the framework's parallelism layer —
+// the device-side equivalent is brpc_tpu.parallel.collectives.fanout_gather
+// (SURVEY.md §2.11: ParallelChannel ≈ all_gather + merge).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "trpc/channel.h"
+
+namespace trpc {
+
+struct SubCall {
+  static constexpr int kSkip = 1;  // don't call this sub-channel
+  std::string service_method;     // empty = inherit the parent's
+  tbutil::IOBuf request;
+  int flags = 0;
+};
+
+class CallMapper {
+ public:
+  virtual ~CallMapper() = default;
+  // Default: broadcast the parent request to every sub-channel.
+  virtual SubCall Map(int channel_index, int channel_count,
+                      const std::string& service_method,
+                      const tbutil::IOBuf& request);
+};
+
+class ResponseMerger {
+ public:
+  virtual ~ResponseMerger() = default;
+  // Fold one successful sub-response into *response (called in sub-channel
+  // order at completion). Default: concatenate. Return <0 to fail the RPC.
+  virtual int Merge(tbutil::IOBuf* response,
+                    const tbutil::IOBuf& sub_response, int sub_index);
+};
+
+struct ParallelChannelOptions {
+  // Parent fails as soon as this many sub-calls failed (-1: only if all
+  // required calls can no longer satisfy success_limit).
+  int fail_limit = -1;
+  // Parent succeeds as soon as this many sub-calls succeeded (-1: all
+  // non-skipped must succeed).
+  int success_limit = -1;
+};
+
+class ParallelChannel {
+ public:
+  explicit ParallelChannel(const ParallelChannelOptions& opts = {})
+      : _options(opts) {}
+
+  // The channel must outlive this ParallelChannel; mapper/merger may be
+  // nullptr (defaults used) and are owned by the ParallelChannel.
+  int AddChannel(Channel* sub, CallMapper* mapper = nullptr,
+                 ResponseMerger* merger = nullptr);
+  size_t channel_count() const { return _subs.size(); }
+
+  // Same contract as Channel::CallMethod. Early termination on limits does
+  // NOT cancel stragglers; they complete and are discarded.
+  void CallMethod(const std::string& service_method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done);
+
+ private:
+  struct Sub {
+    Channel* channel;
+    std::unique_ptr<CallMapper> mapper;
+    std::unique_ptr<ResponseMerger> merger;
+  };
+  std::vector<Sub> _subs;
+  ParallelChannelOptions _options;
+};
+
+}  // namespace trpc

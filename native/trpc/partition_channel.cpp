@@ -1,0 +1,98 @@
+#include "trpc/partition_channel.h"
+
+#include <cstdlib>
+
+#include "tbutil/logging.h"
+#include "trpc/errno.h"
+
+namespace trpc {
+
+bool PartitionParser::ParseFromTag(const std::string& tag, int* index,
+                                   int* count) {
+  // "N/M"
+  const char* p = tag.c_str();
+  char* end = nullptr;
+  long n = strtol(p, &end, 10);
+  if (end == p || *end != '/') return false;
+  const char* q = end + 1;
+  long m = strtol(q, &end, 10);
+  if (end == q || m <= 0 || n < 0 || n >= m) return false;
+  *index = static_cast<int>(n);
+  *count = static_cast<int>(m);
+  return true;
+}
+
+PartitionChannel::~PartitionChannel() {
+  // Stop the naming thread before the balancers it feeds die.
+  _ns.reset();
+}
+
+int PartitionChannel::Init(int num_partitions, const char* naming_url,
+                           const char* lb_name,
+                           const ChannelOptions* options,
+                           PartitionParser* parser,
+                           const ParallelChannelOptions* pc_options) {
+  if (num_partitions <= 0 || naming_url == nullptr) return -1;
+  _parser.reset(parser != nullptr ? parser : new PartitionParser);
+
+  for (int i = 0; i < num_partitions; ++i) {
+    std::shared_ptr<LoadBalancer> lb(
+        LoadBalancer::CreateByName(lb_name != nullptr ? lb_name : "rr"));
+    if (lb == nullptr) return -1;
+    auto ch = std::make_unique<Channel>();
+    if (ch->Init(lb, options) != 0) return -1;
+    _lbs.push_back(std::move(lb));
+    _channels.push_back(std::move(ch));
+  }
+
+  _parallel.reset(new ParallelChannel(
+      pc_options != nullptr ? *pc_options : ParallelChannelOptions{}));
+  for (auto& ch : _channels) {
+    _parallel->AddChannel(ch.get());
+  }
+
+  // One naming service; its pushes are split by partition tag.
+  _ns.reset(new NamingServiceThread);
+  const int n = num_partitions;
+  PartitionParser* prs = _parser.get();
+  std::vector<std::shared_ptr<LoadBalancer>> lbs = _lbs;  // capture copy
+  int rc = _ns->Start(
+      naming_url, [n, prs, lbs](const std::vector<ServerNode>& servers) {
+        std::vector<std::vector<ServerNode>> parts(n);
+        for (const ServerNode& s : servers) {
+          int index = 0, count = 0;
+          if (!prs->ParseFromTag(s.tag, &index, &count)) {
+            TB_LOG(WARNING) << "partition tag unparsable: '" << s.tag << "'";
+            continue;
+          }
+          if (count != n) {
+            TB_LOG(WARNING) << "partition count mismatch: tag says " << count
+                            << ", channel has " << n;
+            continue;
+          }
+          parts[index].push_back(s);
+        }
+        for (int i = 0; i < n; ++i) {
+          lbs[i]->ResetServers(parts[i]);
+        }
+      });
+  if (rc != 0) {
+    _ns.reset();
+    return -1;
+  }
+  return 0;
+}
+
+void PartitionChannel::CallMethod(const std::string& service_method,
+                                  Controller* cntl,
+                                  const tbutil::IOBuf& request,
+                                  tbutil::IOBuf* response, Closure* done) {
+  if (_parallel == nullptr) {
+    cntl->SetFailed(TRPC_EINTERNAL, "PartitionChannel not initialized");
+    if (done != nullptr) done->Run();
+    return;
+  }
+  _parallel->CallMethod(service_method, cntl, request, response, done);
+}
+
+}  // namespace trpc

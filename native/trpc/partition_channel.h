@@ -1,0 +1,59 @@
+// PartitionChannel: one logical call fans out to M partitions of a sharded
+// service; each partition has its own load-balanced server group selected
+// by naming-service tags like "0/3", "1/3", "2/3".
+// Capability parity: reference src/brpc/partition_channel.h:46-136
+// (PartitionParser parsing "N/M" tags :46; one naming service feeding M
+// partition sub-channels; fan-out + merge like ParallelChannel).
+//
+// Device-side analog: brpc_tpu.parallel tensor sharding over the `shard`
+// mesh axis (SURVEY.md §2.11: PartitionChannel ≈ sharded state + psum).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "trpc/channel.h"
+#include "trpc/parallel_channel.h"
+
+namespace trpc {
+
+class PartitionParser {
+ public:
+  virtual ~PartitionParser() = default;
+  // Extract (index, count) from a server tag. Default parses "N/M".
+  virtual bool ParseFromTag(const std::string& tag, int* index, int* count);
+};
+
+class PartitionChannel {
+ public:
+  PartitionChannel() = default;
+  ~PartitionChannel();
+
+  // num_partitions server groups resolved from one naming url; servers
+  // whose tag parses to partition i feed sub-channel i's balancer.
+  // parser may be nullptr (default "N/M"); owned.
+  int Init(int num_partitions, const char* naming_url, const char* lb_name,
+           const ChannelOptions* options,
+           PartitionParser* parser = nullptr,
+           const ParallelChannelOptions* pc_options = nullptr);
+
+  // Fan out to ALL partitions; merger semantics are ParallelChannel's
+  // (default: responses concatenated in partition order).
+  void CallMethod(const std::string& service_method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done);
+
+  int partition_count() const { return static_cast<int>(_channels.size()); }
+  // Per-partition direct access (single-partition calls).
+  Channel* partition_channel(int i) { return _channels[i].get(); }
+
+ private:
+  std::vector<std::unique_ptr<Channel>> _channels;
+  std::vector<std::shared_ptr<LoadBalancer>> _lbs;
+  std::unique_ptr<ParallelChannel> _parallel;
+  std::unique_ptr<PartitionParser> _parser;
+  std::unique_ptr<NamingServiceThread> _ns;
+};
+
+}  // namespace trpc

@@ -1,0 +1,112 @@
+#include "trpc/selective_channel.h"
+
+#include <algorithm>
+#include <functional>
+
+#include "tbthread/fiber.h"
+#include "tbutil/time.h"
+#include "trpc/errno.h"
+
+namespace trpc {
+
+int SelectiveChannel::AddChannel(Channel* sub) {
+  if (sub == nullptr) return -1;
+  Sub s;
+  s.channel = sub;
+  s.health.reset(new NodeHealth);
+  _subs.push_back(std::move(s));
+  return static_cast<int>(_subs.size()) - 1;
+}
+
+void SelectiveChannel::CallMethod(const std::string& service_method,
+                                  Controller* cntl,
+                                  const tbutil::IOBuf& request,
+                                  tbutil::IOBuf* response, Closure* done) {
+  if (_subs.empty()) {
+    cntl->SetFailed(TRPC_EINTERNAL, "no sub-channels");
+    if (done != nullptr) done->Run();
+    return;
+  }
+  // Synchronous attempts across sub-channels. (Async callers get a fiber
+  // running the same loop so `done` semantics hold.) The request is
+  // captured by value — a zero-copy block share — because the async path
+  // outlives the caller's frame.
+  auto run = [this, service_method, cntl, request, response]() {
+    const int attempts =
+        std::min(static_cast<int>(_subs.size()), _max_retry + 1);
+    // One OVERALL deadline across all attempts — same contract as
+    // Channel::CallMethod, not timeout-per-attempt.
+    const int64_t deadline_us =
+        cntl->timeout_ms() > 0
+            ? tbutil::gettimeofday_us() + cntl->timeout_ms() * 1000
+            : 0;
+    for (int a = 0; a < attempts; ++a) {
+      int64_t remaining_ms = -1;
+      if (deadline_us > 0) {
+        remaining_ms = (deadline_us - tbutil::gettimeofday_us()) / 1000;
+        if (remaining_ms <= 0) {
+          cntl->SetFailed(TRPC_ERPCTIMEDOUT, "deadline exceeded");
+          return;
+        }
+      }
+      // Pick: next healthy sub-channel.
+      Sub* chosen = nullptr;
+      const int64_t now = tbutil::gettimeofday_us();
+      for (size_t probe = 0; probe < _subs.size(); ++probe) {
+        Sub& cand =
+            _subs[_seq.fetch_add(1, std::memory_order_relaxed) % _subs.size()];
+        if (!cand.health->IsIsolated(now)) {
+          chosen = &cand;
+          break;
+        }
+      }
+      if (chosen == nullptr) chosen = &_subs[0];  // all tripped: safety valve
+      Controller sub_cntl;
+      if (remaining_ms > 0) sub_cntl.set_timeout_ms(remaining_ms);
+      tbutil::IOBuf sub_resp;
+      chosen->channel->CallMethod(service_method, &sub_cntl, request,
+                                  &sub_resp, nullptr);
+      const bool transport_failure =
+          sub_cntl.Failed() && (sub_cntl.ErrorCode() == TRPC_ERPCTIMEDOUT ||
+                                sub_cntl.ErrorCode() == TRPC_EFAILEDSOCKET ||
+                                sub_cntl.ErrorCode() == TRPC_ECONNECT ||
+                                sub_cntl.ErrorCode() == TRPC_EEOF ||
+                                sub_cntl.ErrorCode() == TRPC_ENODATA);
+      chosen->health->OnCallEnd(transport_failure,
+                                tbutil::gettimeofday_us());
+      if (!transport_failure || a + 1 >= attempts) {
+        if (sub_cntl.Failed()) {
+          cntl->SetFailed(sub_cntl.ErrorCode(), sub_cntl.ErrorText());
+        } else {
+          response->swap(sub_resp);
+          cntl->response_attachment().append(
+              sub_cntl.response_attachment());
+        }
+        return;
+      }
+    }
+  };
+  if (done == nullptr) {
+    run();
+    return;
+  }
+  // Async: hop to a fiber (the retry loop blocks).
+  struct Arg {
+    std::function<void()> fn;
+    Closure* done;
+  };
+  auto* arg = new Arg{run, done};
+  tbthread::fiber_t tid;
+  auto thunk = +[](void* p) -> void* {
+    auto* a = static_cast<Arg*>(p);
+    a->fn();
+    a->done->Run();
+    delete a;
+    return nullptr;
+  };
+  if (tbthread::fiber_start_background(&tid, nullptr, thunk, arg) != 0) {
+    thunk(arg);
+  }
+}
+
+}  // namespace trpc

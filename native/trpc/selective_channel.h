@@ -1,0 +1,41 @@
+// SelectiveChannel: load-balances across heterogeneous sub-channels (each
+// may itself be a combo channel to a different cluster).
+// Capability parity: reference src/brpc/selective_channel.h:52-72 (AddChannel
+// returns a handle; failed sub-channels are retried-around via the wrapped
+// LB; health tracked per sub-channel).
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <vector>
+
+#include "trpc/channel.h"
+#include "trpc/circuit_breaker.h"
+
+namespace trpc {
+
+class SelectiveChannel {
+ public:
+  explicit SelectiveChannel(int max_retry = 1) : _max_retry(max_retry) {}
+
+  // `sub` must outlive this channel. Returns the channel's handle (index).
+  int AddChannel(Channel* sub);
+  size_t channel_count() const { return _subs.size(); }
+
+  // Picks a healthy sub-channel (round-robin, skipping ones whose recent
+  // calls failed), forwards, retries on another for transport failures.
+  void CallMethod(const std::string& service_method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done);
+
+ private:
+  struct Sub {
+    Channel* channel;
+    std::unique_ptr<NodeHealth> health;  // per-sub-channel breaker
+  };
+  std::vector<Sub> _subs;
+  std::atomic<size_t> _seq{0};
+  int _max_retry;
+};
+
+}  // namespace trpc
