@@ -6,6 +6,7 @@
 #include "trpc/errno.h"
 #include "trpc/load_balancer.h"
 #include "trpc/socket_map.h"
+#include "trpc/stream_internal.h"
 #include "trpc/tstd_protocol.h"
 
 namespace trpc {
@@ -37,6 +38,11 @@ void Controller::Reset() {
   _has_request_code = false;
   _attempt_begin_us = 0;
   _response_received = false;
+  _request_stream = 0;
+  _response_stream = 0;
+  _remote_stream_id = 0;
+  _remote_stream_window = 0;
+  _server_socket = 0;
 }
 
 void Controller::SetFailed(int code, const std::string& reason) {
@@ -204,6 +210,11 @@ void Controller::EndRPC(int error, const std::string& error_text) {
       Socket::Address(_attempt_socket, &sock) == 0) {
     sock->RemovePendingId(current_attempt_id());
   }
+  // A failed RPC never connects its request stream: close it so writers
+  // parked on the window wake with an error.
+  if (_error_code != 0 && _request_stream != 0) {
+    stream_internal::OnRpcFailed(_request_stream, _error_code);
+  }
   Closure* done = _done;
   const tbthread::fiber_id_t cid = _correlation_id;
   // All result fields are written: publish by destroying the id. After this
@@ -241,6 +252,19 @@ void TstdHandleResponse(TstdInputMessage* msg) {
   acc.set_response_attachment(std::move(msg->attachment));
   int err = msg->meta.code_or_timeout;
   std::string err_text = std::move(msg->meta.error_text);
+  // Streaming handshake completion: the server accepted and announced its
+  // stream id + window; connect our half to this RPC's socket. A SUCCESS
+  // response WITHOUT a stream id means the handler never StreamAccept'ed —
+  // close the request stream or its writers would park forever.
+  if (acc.request_stream() != 0) {
+    if (err == 0 && msg->meta.stream_id != 0) {
+      stream_internal::ConnectClientStream(
+          acc.request_stream(), msg->meta.stream_id, msg->meta.stream_window,
+          acc.attempt_socket());
+    } else if (err == 0) {
+      stream_internal::OnRpcFailed(acc.request_stream(), EINVAL);
+    }
+  }
   delete msg;
   acc.EndRPC(err, err_text);
 }

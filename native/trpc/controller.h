@@ -119,6 +119,13 @@ class Controller {
   std::string _error_text;
 
   bool _server_side = false;
+
+  // Streaming RPC handshake state (stream.h / stream_internal.h).
+  uint64_t _request_stream = 0;        // client: local stream id
+  uint64_t _response_stream = 0;       // server: local stream id (accepted)
+  uint64_t _remote_stream_id = 0;      // peer's stream id from the meta
+  int64_t _remote_stream_window = 0;   // peer's advertised window
+  uint64_t _server_socket = 0;         // server side: the request's socket
 };
 
 // Protocol implementations poke controller internals through this, keeping
@@ -141,6 +148,21 @@ class ControllerPrivateAccessor {
   }
   tbutil::IOBuf* response_payload() { return _c->_response_payload; }
   void mark_response_received() { _c->_response_received = true; }
+
+  // Streaming handshake plumbing.
+  void set_request_stream(uint64_t id) { _c->_request_stream = id; }
+  uint64_t request_stream() const { return _c->_request_stream; }
+  void set_response_stream(uint64_t id) { _c->_response_stream = id; }
+  uint64_t response_stream() const { return _c->_response_stream; }
+  void set_remote_stream(uint64_t id, int64_t window) {
+    _c->_remote_stream_id = id;
+    _c->_remote_stream_window = window;
+  }
+  uint64_t remote_stream_id() const { return _c->_remote_stream_id; }
+  int64_t remote_stream_window() const { return _c->_remote_stream_window; }
+  void set_server_socket(uint64_t sid) { _c->_server_socket = sid; }
+  uint64_t server_socket() const { return _c->_server_socket; }
+  uint64_t attempt_socket() const { return _c->_attempt_socket; }
   tbthread::fiber_id_t current_attempt_id() const {
     return _c->current_attempt_id();
   }

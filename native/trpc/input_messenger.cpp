@@ -115,6 +115,11 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
       }
       r.msg->socket_id = s->id();
       r.msg->protocol_index = proto_index;
+      if (r.msg->process_in_place) {
+        // Order-sensitive (stream frames): handle now, in parse order.
+        ProcessInline(r.msg);
+        continue;
+      }
       if (pending != nullptr) {
         ProcessInFiber(pending);
       }

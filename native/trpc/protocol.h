@@ -28,6 +28,11 @@ enum ParseError {
 struct InputMessageBase {
   uint64_t socket_id = 0;  // re-Address'ed by the process fn
   int protocol_index = -1;
+  // Process in PARSE ORDER on the input fiber instead of a per-message
+  // fiber. Set by parse() for order-sensitive cheap messages — stream
+  // frames, whose handling is an enqueue (reference: streaming frames go
+  // straight to Stream::OnReceived from the parse context).
+  bool process_in_place = false;
   virtual ~InputMessageBase() = default;
 };
 

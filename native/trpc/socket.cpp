@@ -106,18 +106,48 @@ int Socket::SetFailed(int error) {
   return VersionedRefWithId<Socket>::SetFailed(error);
 }
 
+namespace {
+std::atomic<Socket::StreamFailCallback> g_stream_fail_cb{nullptr};
+}  // namespace
+
+void Socket::SetStreamFailCallback(StreamFailCallback cb) {
+  g_stream_fail_cb.store(cb, std::memory_order_release);
+}
+
+void Socket::AddPendingStream(uint64_t stream_id) {
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  _pending_streams.push_back(stream_id);
+}
+
+void Socket::RemovePendingStream(uint64_t stream_id) {
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  for (size_t i = 0; i < _pending_streams.size(); ++i) {
+    if (_pending_streams[i] == stream_id) {
+      _pending_streams[i] = _pending_streams.back();
+      _pending_streams.pop_back();
+      return;
+    }
+  }
+}
+
 void Socket::OnFailed(int error) {
   _error_code = error;
   // Wake connect/KeepWrite parkers: they re-check Failed() and bail.
   tbthread::butex_increment_and_wake_all(_epollout_butex);
-  // Propagate to every in-flight RPC correlated with this connection.
+  // Propagate to every in-flight RPC and stream on this connection.
   std::vector<tbthread::fiber_id_t> ids;
+  std::vector<uint64_t> streams;
   {
     std::lock_guard<std::mutex> lk(_pending_mu);
     ids.swap(_pending_ids);
+    streams.swap(_pending_streams);
   }
   for (tbthread::fiber_id_t id : ids) {
     tbthread::fiber_id_error(id, error);
+  }
+  StreamFailCallback cb = g_stream_fail_cb.load(std::memory_order_acquire);
+  if (cb != nullptr) {
+    for (uint64_t sid : streams) cb(sid, error);
   }
 }
 
@@ -136,6 +166,7 @@ void Socket::OnRecycle() {
   // ReleaseAllWrites on failure).
   std::lock_guard<std::mutex> lk(_pending_mu);
   _pending_ids.clear();
+  _pending_streams.clear();
 }
 
 void Socket::AddPendingId(tbthread::fiber_id_t id) {

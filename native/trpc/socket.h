@@ -84,6 +84,12 @@ class Socket : public VersionedRefWithId<Socket> {
   void AddPendingId(tbthread::fiber_id_t id);
   void RemovePendingId(tbthread::fiber_id_t id);
 
+  // -- streams multiplexed on this connection (closed on SetFailed) --
+  using StreamFailCallback = void (*)(uint64_t stream_id, int error);
+  static void SetStreamFailCallback(StreamFailCallback cb);
+  void AddPendingStream(uint64_t stream_id);
+  void RemovePendingStream(uint64_t stream_id);
+
   // Parse-pipeline cache: index of the protocol that parsed the last
   // message on this connection (input_messenger.cpp fast path).
   int preferred_protocol() const { return _preferred_protocol; }
@@ -146,6 +152,7 @@ class Socket : public VersionedRefWithId<Socket> {
 
   std::mutex _pending_mu;
   std::vector<tbthread::fiber_id_t> _pending_ids;
+  std::vector<uint64_t> _pending_streams;
 };
 
 }  // namespace trpc

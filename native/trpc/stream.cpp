@@ -1,0 +1,377 @@
+#include "trpc/stream.h"
+
+#include <cerrno>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+
+#include "tbthread/butex.h"
+#include "tbthread/execution_queue.h"
+#include "tbutil/logging.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/socket.h"
+#include "trpc/stream_internal.h"
+
+namespace trpc {
+
+namespace {
+
+struct Stream {
+  StreamId id = INVALID_STREAM_ID;
+  StreamOptions options;
+  std::atomic<uint64_t> peer_id{0};
+  std::atomic<uint64_t> socket_id{INVALID_SOCKET_ID};
+  std::atomic<bool> connected{false};
+  std::atomic<bool> closed{false};
+  int close_error = 0;
+
+  // Writer half: parked on wbtx while out of credit.
+  tbthread::Butex* wbtx;
+  std::atomic<int64_t> remote_window{0};
+  std::atomic<int64_t> sent{0};
+  std::atomic<int64_t> acked{0};
+
+  // Reader half: ordered consumer fiber + feedback bookkeeping.
+  tbthread::ExecutionQueue<tbutil::IOBuf> incoming;
+  std::atomic<int64_t> consumed{0};
+  std::atomic<int64_t> last_feedback{0};
+
+  tbthread::Butex* close_btx;  // StreamWait
+  // Consumer fiber liveness: close_stream must not free the stream while a
+  // consumer is mid-batch (its `raw` pointer would dangle).
+  std::atomic<int> consumers_active{0};
+
+  Stream() : wbtx(tbthread::butex_create()),
+             close_btx(tbthread::butex_create()) {}
+  ~Stream() {
+    tbthread::butex_destroy(wbtx);
+    tbthread::butex_destroy(close_btx);
+  }
+};
+
+using StreamPtr = std::shared_ptr<Stream>;
+
+struct Registry {
+  std::mutex mu;
+  std::unordered_map<StreamId, StreamPtr> map;
+  uint64_t next_id = 1;
+};
+Registry& registry() {
+  static Registry* r = new Registry;
+  return *r;
+}
+
+StreamPtr find_stream(StreamId id) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.map.find(id);
+  return it != r.map.end() ? it->second : nullptr;
+}
+
+void erase_stream(StreamId id) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.map.erase(id);
+}
+
+bool send_stream_frame(uint64_t socket_id, uint8_t msg_type,
+                       uint64_t peer_stream_id, uint64_t trace_value,
+                       const tbutil::IOBuf* body) {
+  SocketUniquePtr s;
+  if (Socket::Address(socket_id, &s) != 0) return false;
+  TstdMeta meta;
+  meta.msg_type = msg_type;
+  meta.correlation_id = peer_stream_id;
+  meta.trace_id = trace_value;
+  tbutil::IOBuf out;
+  tstd_serialize_meta(&out, meta, body != nullptr ? body->size() : 0);
+  if (body != nullptr) out.append(*body);
+  return s->Write(&out) == 0;
+}
+
+// Set while the calling fiber is inside a stream's consumer batch loop —
+// a handler that calls StreamClose must not deadlock waiting for itself.
+thread_local StreamId t_consuming_stream = INVALID_STREAM_ID;
+
+// Close the local half: drain queued data to the handler, wake
+// writers/waiters, notify the handler, drop the registry entry and the
+// socket registration. Ordering matters: queued DATA that arrived before
+// the close must be DELIVERED before on_closed fires, and the consumer
+// fiber must have fully exited before the stream can be freed.
+void close_stream(const StreamPtr& s, int error, bool notify_peer) {
+  if (s->closed.exchange(true, std::memory_order_acq_rel)) return;
+  s->close_error = error;
+  if (notify_peer && s->connected.load(std::memory_order_acquire)) {
+    send_stream_frame(s->socket_id.load(std::memory_order_acquire), 3,
+                      s->peer_id.load(std::memory_order_acquire), 0, nullptr);
+  }
+  SocketUniquePtr sock;
+  if (Socket::Address(s->socket_id.load(std::memory_order_acquire), &sock) ==
+      0) {
+    sock->RemovePendingStream(s->id);
+  }
+  tbthread::butex_increment_and_wake_all(s->wbtx);
+  // Drain-and-join the consumer — unless WE are the consumer (a handler
+  // calling StreamClose), in which case the queue is already being drained
+  // by this very fiber.
+  if (t_consuming_stream != s->id) {
+    s->incoming.stop_and_join();
+    while (s->consumers_active.load(std::memory_order_acquire) > 0) {
+      tbthread::fiber_usleep(500);
+    }
+  }
+  if (s->options.handler != nullptr) {
+    s->options.handler->on_closed(s->id);
+  }
+  tbthread::butex_increment_and_wake_all(s->close_btx);
+  erase_stream(s->id);
+}
+
+// Consumer fiber: ordered batches -> handler -> consumption feedback.
+int consume_incoming(tbthread::ExecutionQueue<tbutil::IOBuf>::Iterator& iter,
+                     void* arg) {
+  auto* raw = static_cast<Stream*>(arg);
+  raw->consumers_active.fetch_add(1, std::memory_order_acq_rel);
+  t_consuming_stream = raw->id;
+  constexpr size_t kBatch = 32;
+  tbutil::IOBuf bufs[kBatch];
+  tbutil::IOBuf* ptrs[kBatch];
+  while (true) {
+    size_t n = 0;
+    int64_t batch_bytes = 0;
+    while (n < kBatch && iter.next(&bufs[n])) {
+      batch_bytes += static_cast<int64_t>(bufs[n].size());
+      ptrs[n] = &bufs[n];
+      ++n;
+    }
+    if (n == 0) break;
+    // Deliver even mid-close: queued data that preceded a CLOSE frame must
+    // reach the handler before on_closed.
+    if (raw->options.handler != nullptr) {
+      raw->options.handler->on_received_messages(raw->id, ptrs, n);
+    }
+    const int64_t consumed =
+        raw->consumed.fetch_add(batch_bytes, std::memory_order_acq_rel) +
+        batch_bytes;
+    // Replenish the peer once half the window has been consumed since the
+    // last feedback (reference stream_impl.h:80 SetRemoteConsumed).
+    // last_feedback advances only on a SUCCESSFUL send: data can arrive
+    // before the stream's socket is connected (server writes ahead of the
+    // RPC response landing), and a dropped feedback must be retried by the
+    // next batch — or by ConnectClientStream's sync-up.
+    const int64_t since =
+        consumed - raw->last_feedback.load(std::memory_order_acquire);
+    if (since >= raw->options.max_buf_size / 2 &&
+        !raw->closed.load(std::memory_order_acquire)) {
+      if (send_stream_frame(raw->socket_id.load(std::memory_order_acquire),
+                            4, raw->peer_id.load(std::memory_order_acquire),
+                            static_cast<uint64_t>(consumed), nullptr)) {
+        raw->last_feedback.store(consumed, std::memory_order_release);
+      }
+    }
+    for (size_t i = 0; i < n; ++i) bufs[i].clear();
+  }
+  t_consuming_stream = INVALID_STREAM_ID;
+  raw->consumers_active.fetch_sub(1, std::memory_order_acq_rel);
+  return 0;
+}
+
+StreamPtr new_stream(const StreamOptions* options) {
+  auto s = std::make_shared<Stream>();
+  if (options != nullptr) s->options = *options;
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  s->id = r.next_id++;
+  s->incoming.start(consume_incoming, s.get());
+  r.map[s->id] = s;
+  return s;
+}
+
+struct StreamHookInstaller {
+  StreamHookInstaller() {
+    Socket::SetStreamFailCallback(stream_internal::OnSocketFailed);
+  }
+};
+
+}  // namespace
+
+// ---------------- public API ----------------
+
+int StreamCreate(StreamId* request_stream, Controller& cntl,
+                 const StreamOptions* options) {
+  static StreamHookInstaller install_once;
+  StreamPtr s = new_stream(options);
+  *request_stream = s->id;
+  ControllerPrivateAccessor(&cntl).set_request_stream(s->id);
+  return 0;
+}
+
+int StreamAccept(StreamId* response_stream, Controller& cntl,
+                 const StreamOptions* options) {
+  static StreamHookInstaller install_once;
+  ControllerPrivateAccessor acc(&cntl);
+  if (acc.remote_stream_id() == 0) return EINVAL;  // client didn't stream
+  StreamPtr s = new_stream(options);
+  s->peer_id.store(acc.remote_stream_id(), std::memory_order_release);
+  s->remote_window.store(acc.remote_stream_window(),
+                         std::memory_order_release);
+  s->socket_id.store(acc.server_socket(), std::memory_order_release);
+  s->connected.store(true, std::memory_order_release);
+  SocketUniquePtr sock;
+  if (Socket::Address(acc.server_socket(), &sock) == 0) {
+    sock->AddPendingStream(s->id);
+    // Registration/failure race: OnFailed may have drained the pending list
+    // just before our insert — self-notify so the stream can't outlive a
+    // dead connection silently.
+    if (sock->Failed()) close_stream(s, TRPC_EFAILEDSOCKET, false);
+  } else {
+    close_stream(s, TRPC_EFAILEDSOCKET, false);
+  }
+  acc.set_response_stream(s->id);
+  *response_stream = s->id;
+  return 0;
+}
+
+int StreamWrite(StreamId stream, const tbutil::IOBuf& message) {
+  StreamPtr s = find_stream(stream);
+  if (s == nullptr) return EINVAL;
+  const int64_t size = static_cast<int64_t>(message.size());
+  while (true) {
+    if (s->closed.load(std::memory_order_acquire)) {
+      return s->close_error != 0 ? s->close_error : ECONNRESET;
+    }
+    const int seq =
+        tbthread::butex_value(s->wbtx)->load(std::memory_order_acquire);
+    if (s->connected.load(std::memory_order_acquire)) {
+      const int64_t window = s->remote_window.load(std::memory_order_acquire);
+      const int64_t inflight = s->sent.load(std::memory_order_acquire) -
+                               s->acked.load(std::memory_order_acquire);
+      // Oversize messages (> window) are allowed alone on an idle window —
+      // otherwise they could never be sent.
+      if (inflight + size <= window || (inflight == 0 && size > window)) {
+        break;
+      }
+    }
+    tbthread::butex_wait(s->wbtx, seq, nullptr);
+  }
+  s->sent.fetch_add(size, std::memory_order_acq_rel);
+  SocketUniquePtr sock;
+  if (Socket::Address(s->socket_id.load(std::memory_order_acquire), &sock) !=
+      0) {
+    close_stream(s, TRPC_EFAILEDSOCKET, false);
+    return TRPC_EFAILEDSOCKET;
+  }
+  TstdMeta meta;
+  meta.msg_type = 2;
+  meta.correlation_id = s->peer_id.load(std::memory_order_acquire);
+  tbutil::IOBuf out;
+  tstd_serialize_meta(&out, meta, message.size());
+  out.append(message);
+  if (sock->Write(&out) != 0) {
+    close_stream(s, errno, false);
+    return errno;
+  }
+  return 0;
+}
+
+int StreamClose(StreamId stream) {
+  StreamPtr s = find_stream(stream);
+  if (s == nullptr) return EINVAL;
+  close_stream(s, 0, /*notify_peer=*/true);
+  return 0;
+}
+
+int StreamWait(StreamId stream) {
+  while (true) {
+    StreamPtr s = find_stream(stream);
+    if (s == nullptr) return 0;  // closed + erased
+    const int seq =
+        tbthread::butex_value(s->close_btx)->load(std::memory_order_acquire);
+    if (s->closed.load(std::memory_order_acquire)) return 0;
+    tbthread::butex_wait(s->close_btx, seq, nullptr);
+  }
+}
+
+// ---------------- internal seams ----------------
+
+namespace stream_internal {
+
+void OnStreamFrame(TstdInputMessage* msg) {
+  const StreamId local = msg->meta.correlation_id;
+  StreamPtr s = find_stream(local);
+  if (s == nullptr) {
+    delete msg;
+    return;
+  }
+  switch (msg->meta.msg_type) {
+    case 2: {  // DATA
+      tbutil::IOBuf chunk;
+      chunk.append(std::move(msg->payload));
+      chunk.append(std::move(msg->attachment));
+      s->incoming.execute(std::move(chunk));
+      break;
+    }
+    case 3:  // CLOSE from peer
+      close_stream(s, 0, /*notify_peer=*/false);
+      break;
+    case 4: {  // FEEDBACK: consumed-total from the peer
+      s->acked.store(static_cast<int64_t>(msg->meta.trace_id),
+                     std::memory_order_release);
+      tbthread::butex_increment_and_wake_all(s->wbtx);
+      break;
+    }
+    default:
+      break;
+  }
+  delete msg;
+}
+
+void ConnectClientStream(StreamId local, uint64_t peer_id,
+                         int64_t peer_window, uint64_t socket_id) {
+  StreamPtr s = find_stream(local);
+  if (s == nullptr) return;
+  s->peer_id.store(peer_id, std::memory_order_release);
+  s->remote_window.store(peer_window, std::memory_order_release);
+  s->socket_id.store(socket_id, std::memory_order_release);
+  s->connected.store(true, std::memory_order_release);
+  SocketUniquePtr sock;
+  if (Socket::Address(socket_id, &sock) == 0) {
+    sock->AddPendingStream(local);
+    if (sock->Failed()) {
+      close_stream(s, TRPC_EFAILEDSOCKET, false);
+      return;
+    }
+  } else {
+    close_stream(s, TRPC_EFAILEDSOCKET, false);
+    return;
+  }
+  // Sync up consumption feedback that couldn't be sent pre-connect (the
+  // server may have streamed a full window before its response landed).
+  const int64_t consumed = s->consumed.load(std::memory_order_acquire);
+  if (consumed > s->last_feedback.load(std::memory_order_acquire)) {
+    if (send_stream_frame(socket_id, 4, peer_id,
+                          static_cast<uint64_t>(consumed), nullptr)) {
+      s->last_feedback.store(consumed, std::memory_order_release);
+    }
+  }
+  tbthread::butex_increment_and_wake_all(s->wbtx);
+}
+
+void OnRpcFailed(StreamId local, int error) {
+  StreamPtr s = find_stream(local);
+  if (s != nullptr) close_stream(s, error, false);
+}
+
+void OnSocketFailed(uint64_t stream_id, int error) {
+  StreamPtr s = find_stream(stream_id);
+  if (s != nullptr) close_stream(s, error, false);
+}
+
+int64_t AdvertisedWindow(StreamId id) {
+  StreamPtr s = find_stream(id);
+  return s != nullptr ? s->options.max_buf_size : 0;
+}
+
+}  // namespace stream_internal
+}  // namespace trpc

@@ -1,0 +1,69 @@
+// Streaming RPC: an ordered, credit-flow-controlled, full-duplex message
+// stream established by an RPC and multiplexed on its connection.
+// Capability parity: reference src/brpc/stream.h:41-123 + stream_impl.h +
+// policy/streaming_rpc_protocol.cpp:
+//  - StreamCreate (client, before the RPC) / StreamAccept (server, inside
+//    the handler) attach stream settings to the RPC meta (stream.h:106)
+//  - ordered delivery through a per-stream ExecutionQueue consumer
+//    (stream_impl.h:90,133)
+//  - credit-based flow control: receiver advertises its buffer, consumption
+//    feedback replenishes the writer (stream_impl.h:80 SetRemoteConsumed,
+//    buf limits stream.h:55-72); writers PARK (fiber) when out of credit
+//  - abrupt connection death closes the stream (on_closed)
+//
+// This is the host half of the tensor-streaming path (SURVEY.md §5): IOBuf
+// chunks -> socket today; the same window machinery meters HBM ring buffers
+// over ICI in the tpu:// transport.
+#pragma once
+
+#include <cstdint>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+class Controller;
+
+using StreamId = uint64_t;
+inline constexpr StreamId INVALID_STREAM_ID = 0;
+
+class StreamInputHandler {
+ public:
+  virtual ~StreamInputHandler() = default;
+  // Ordered batch delivery (one consumer fiber per stream). Return 0.
+  virtual int on_received_messages(StreamId id,
+                                   tbutil::IOBuf* const messages[],
+                                   size_t size) = 0;
+  virtual void on_closed(StreamId id) = 0;
+};
+
+struct StreamOptions {
+  // Receive-buffer budget advertised to the peer (its write window).
+  int64_t max_buf_size = 2 * 1024 * 1024;
+  // Required to RECEIVE; a pure writer may leave it null.
+  StreamInputHandler* handler = nullptr;
+};
+
+// Client: call BEFORE Channel::CallMethod on the same Controller; the RPC
+// carries the stream handshake. On RPC success the stream is connected.
+int StreamCreate(StreamId* request_stream, Controller& cntl,
+                 const StreamOptions* options);
+
+// Server: call inside the service method BEFORE done->Run(); the response
+// carries the acceptance.
+int StreamAccept(StreamId* response_stream, Controller& cntl,
+                 const StreamOptions* options);
+
+// Ordered write. Parks the calling fiber while the peer's window is
+// exhausted. Returns 0, or EINVAL (unknown/closed stream) / the socket
+// write error.
+int StreamWrite(StreamId stream, const tbutil::IOBuf& message);
+
+// Graceful close: flushes queued credit state, notifies the peer
+// (on_closed fires there), destroys the local half.
+int StreamClose(StreamId stream);
+
+// Blocks until the peer closes (or the connection dies). Test helper.
+int StreamWait(StreamId stream);
+
+}  // namespace trpc

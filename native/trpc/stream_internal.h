@@ -1,0 +1,31 @@
+// Internal seams between the stream module, the tstd protocol and the
+// Controller (reference: stream_impl.h — not part of the public surface).
+#pragma once
+
+#include <cstdint>
+
+#include "trpc/stream.h"
+#include "trpc/tstd_protocol.h"
+
+namespace trpc {
+namespace stream_internal {
+
+// Dispatch of msg_type 2/3/4 frames (takes ownership of msg).
+void OnStreamFrame(TstdInputMessage* msg);
+
+// Client response path: connect the request stream to the peer announced in
+// the response meta (peer id + advertised window + the RPC's socket).
+void ConnectClientStream(StreamId local, uint64_t peer_id,
+                         int64_t peer_window, uint64_t socket_id);
+
+// The RPC carrying this stream failed before connecting it.
+void OnRpcFailed(StreamId local, int error);
+
+// Socket failure fan-out (registered once as Socket's stream-fail hook).
+void OnSocketFailed(uint64_t stream_id, int error);
+
+// The advertised receive window of a local stream (pack_request).
+int64_t AdvertisedWindow(StreamId id);
+
+}  // namespace stream_internal
+}  // namespace trpc

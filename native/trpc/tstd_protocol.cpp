@@ -9,6 +9,7 @@
 #include "trpc/errno.h"
 #include "trpc/server.h"
 #include "trpc/socket.h"
+#include "trpc/stream_internal.h"
 
 namespace trpc {
 
@@ -39,16 +40,22 @@ void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
                          size_t body_size) {
   std::string m;
   m.reserve(kFixedMetaSize + meta.service.size() + meta.method.size() +
-            meta.error_text.size() + 8);
+            meta.error_text.size() + 24);
+  uint16_t flags = meta.flags;
+  if (meta.stream_id != 0) flags |= kTstdFlagHasStream;
   put<uint8_t>(&m, meta.msg_type);
   put<uint8_t>(&m, meta.compress_type);
-  put<uint16_t>(&m, meta.flags);
+  put<uint16_t>(&m, flags);
   put<uint64_t>(&m, meta.correlation_id);
   put<uint32_t>(&m, meta.attachment_size);
   put<int32_t>(&m, meta.code_or_timeout);
   put<uint64_t>(&m, meta.trace_id);
   put<uint64_t>(&m, meta.span_id);
   put<uint64_t>(&m, meta.parent_span_id);
+  if (meta.stream_id != 0) {
+    put<uint64_t>(&m, meta.stream_id);
+    put<int64_t>(&m, meta.stream_window);
+  }
   if (meta.msg_type == 0) {
     put<uint16_t>(&m, static_cast<uint16_t>(meta.service.size()));
     m.append(meta.service);
@@ -81,6 +88,11 @@ static bool parse_meta(const std::string& raw, TstdMeta* meta) {
   meta->trace_id = get<uint64_t>(p);
   meta->span_id = get<uint64_t>(p);
   meta->parent_span_id = get<uint64_t>(p);
+  if (meta->flags & kTstdFlagHasStream) {
+    if (p + 16 > end) return false;
+    meta->stream_id = get<uint64_t>(p);
+    meta->stream_window = get<int64_t>(p);
+  }
   auto get_str = [&p, end](std::string* out) {
     if (p + 2 > end) return false;
     uint16_t len = get<uint16_t>(p);
@@ -133,6 +145,7 @@ ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
   }
   source->cutn(&msg->payload, body_size - msg->meta.attachment_size);
   source->cutn(&msg->attachment, msg->meta.attachment_size);
+  msg->process_in_place = msg->meta.msg_type >= 2;  // stream frames: ordered
   r.error = PARSE_OK;
   r.msg = msg;
   return r;
@@ -149,6 +162,11 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
   meta.correlation_id = correlation_id;
   meta.attachment_size =
       static_cast<uint32_t>(cntl->request_attachment().size());
+  ControllerPrivateAccessor acc0(cntl);
+  if (acc0.request_stream() != 0) {
+    meta.stream_id = acc0.request_stream();
+    meta.stream_window = stream_internal::AdvertisedWindow(meta.stream_id);
+  }
   if (cntl->deadline_us() > 0) {
     int64_t remaining_ms =
         (cntl->deadline_us() - tbutil::gettimeofday_us()) / 1000;
@@ -173,7 +191,12 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
 void TstdHandleResponse(TstdInputMessage* msg);
 
 static void tstd_process_response(InputMessageBase* base) {
-  TstdHandleResponse(static_cast<TstdInputMessage*>(base));
+  auto* msg = static_cast<TstdInputMessage*>(base);
+  if (msg->meta.msg_type >= 2) {  // stream frame, either side
+    stream_internal::OnStreamFrame(msg);
+    return;
+  }
+  TstdHandleResponse(msg);
 }
 
 // ---------------- server side: request dispatch ----------------
@@ -189,6 +212,11 @@ static void tstd_send_response(SocketId sid, uint64_t correlation_id,
   meta.error_text = cntl->ErrorText();
   meta.attachment_size =
       static_cast<uint32_t>(cntl->response_attachment().size());
+  ControllerPrivateAccessor acc1(cntl);
+  if (acc1.response_stream() != 0) {
+    meta.stream_id = acc1.response_stream();
+    meta.stream_window = stream_internal::AdvertisedWindow(meta.stream_id);
+  }
   tbutil::IOBuf out;
   tstd_serialize_meta(&out, meta,
                       payload->size() + cntl->response_attachment().size());
@@ -199,6 +227,10 @@ static void tstd_send_response(SocketId sid, uint64_t correlation_id,
 
 static void tstd_process_request(InputMessageBase* base) {
   auto* msg = static_cast<TstdInputMessage*>(base);
+  if (msg->meta.msg_type >= 2) {  // stream frame, either side
+    stream_internal::OnStreamFrame(msg);
+    return;
+  }
   SocketUniquePtr s;
   if (Socket::Address(msg->socket_id, &s) != 0) {
     delete msg;
@@ -219,6 +251,10 @@ static void tstd_process_request(InputMessageBase* base) {
   }
   acc.set_server_side(s->remote_side(), deadline_us);
   acc.set_request_attachment(std::move(msg->attachment));
+  acc.set_server_socket(sid);
+  if (msg->meta.stream_id != 0) {
+    acc.set_remote_stream(msg->meta.stream_id, msg->meta.stream_window);
+  }
   auto fail_without_gate = [&](int code, const std::string& text) {
     cntl->SetFailed(code, text);
     delete msg;

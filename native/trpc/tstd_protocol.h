@@ -20,8 +20,13 @@ namespace trpc {
 
 inline constexpr int kTstdProtocolIndex = 0;
 
+inline constexpr uint16_t kTstdFlagHasStream = 1;
+
 struct TstdMeta {
-  uint8_t msg_type = 0;  // 0 request, 1 response
+  // 0 request, 1 response, 2 stream-data, 3 stream-close, 4 stream-feedback
+  // (stream frames use correlation_id as the RECEIVER's stream id and
+  // trace_id as the consumed-counter for feedback — stream.cpp).
+  uint8_t msg_type = 0;
   uint8_t compress_type = 0;
   uint16_t flags = 0;
   uint64_t correlation_id = 0;
@@ -32,6 +37,10 @@ struct TstdMeta {
   uint64_t trace_id = 0;
   uint64_t span_id = 0;
   uint64_t parent_span_id = 0;
+  // Streaming handshake (present iff flags & kTstdFlagHasStream): the
+  // sender's stream id + its advertised receive window.
+  uint64_t stream_id = 0;
+  int64_t stream_window = 0;
   std::string service;     // request
   std::string method;      // request
   std::string error_text;  // response
