@@ -1,0 +1,58 @@
+#include "trpc/flags.h"
+
+#include <cstdlib>
+
+namespace trpc {
+
+std::atomic<int64_t>* FlagRegistry::DefineInt(const std::string& name,
+                                              int64_t default_value,
+                                              const std::string& help,
+                                              Validator validator) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _flags.find(name);
+  if (it != _flags.end()) return it->second.value;
+  Entry e;
+  e.value = new std::atomic<int64_t>(default_value);  // immortal
+  e.default_value = default_value;
+  e.help = help;
+  e.validator = std::move(validator);
+  _flags[name] = e;
+  return e.value;
+}
+
+bool FlagRegistry::Get(const std::string& name, std::string* value) const {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _flags.find(name);
+  if (it == _flags.end()) return false;
+  *value = std::to_string(it->second.value->load(std::memory_order_relaxed));
+  return true;
+}
+
+bool FlagRegistry::Set(const std::string& name, const std::string& value) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _flags.find(name);
+  if (it == _flags.end()) return false;
+  char* end = nullptr;
+  long long v = strtoll(value.c_str(), &end, 10);
+  if (end == value.c_str() || *end != '\0') return false;
+  if (it->second.validator != nullptr && !it->second.validator(v)) {
+    return false;
+  }
+  it->second.value->store(v, std::memory_order_relaxed);
+  return true;
+}
+
+void FlagRegistry::List(std::map<std::string, Info>* out) const {
+  std::lock_guard<std::mutex> lk(_mu);
+  for (const auto& [name, e] : _flags) {
+    (*out)[name] = Info{e.value->load(std::memory_order_relaxed),
+                        e.default_value, e.help};
+  }
+}
+
+FlagRegistry& FlagRegistry::global() {
+  static FlagRegistry* r = new FlagRegistry;
+  return *r;
+}
+
+}  // namespace trpc

@@ -1,0 +1,58 @@
+// Hot-reloadable named flags.
+// Capability parity: reference gflags + BRPC_VALIDATE_GFLAG
+// (butil/reloadable_flags.h:24) + the /flags builtin page with live editing
+// (builtin/flags_service). Values are atomics readable on hot paths;
+// validators gate writes.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <map>
+#include <string>
+
+namespace trpc {
+
+class FlagRegistry {
+ public:
+  using Validator = std::function<bool(int64_t)>;
+
+  // Register (or look up) an int64 flag. The returned atomic is stable for
+  // the process lifetime — cache it for hot-path reads.
+  std::atomic<int64_t>* DefineInt(const std::string& name,
+                                  int64_t default_value,
+                                  const std::string& help,
+                                  Validator validator = nullptr);
+
+  // "name" -> current value as string; returns false if unknown.
+  bool Get(const std::string& name, std::string* value) const;
+  // Set from string; false on unknown flag / parse error / validator veto.
+  bool Set(const std::string& name, const std::string& value);
+
+  struct Info {
+    int64_t value;
+    int64_t default_value;
+    std::string help;
+  };
+  void List(std::map<std::string, Info>* out) const;
+
+  static FlagRegistry& global();
+
+ private:
+  struct Entry {
+    std::atomic<int64_t>* value;
+    int64_t default_value;
+    std::string help;
+    Validator validator;
+  };
+  mutable std::mutex _mu;
+  std::map<std::string, Entry> _flags;
+};
+
+// DEFINE + cache in one line at namespace scope:
+//   static auto* g_my_flag = TRPC_DEFINE_FLAG(my_flag, 64, "what it does");
+#define TRPC_DEFINE_FLAG(name, default_value, help) \
+  ::trpc::FlagRegistry::global().DefineInt(#name, (default_value), (help))
+
+}  // namespace trpc

@@ -1,0 +1,41 @@
+#include "trpc/rpc_metrics.h"
+
+#include "tbvar/variable.h"
+
+namespace trpc {
+
+MethodStatus::MethodStatus(const std::string& full_name) {
+  const std::string base = "rpc_server_" + tbvar::to_underscored_name(full_name);
+  _concurrency.expose(base + "_concurrency");
+  _errors.expose(base + "_errors");
+  _latency.expose(base);
+}
+
+MethodStatus* GetMethodStatus(const std::string& service_method) {
+  struct Registry {
+    std::mutex mu;
+    std::unordered_map<std::string, MethodStatus*> map;
+  };
+  static Registry* reg = new Registry;
+  std::lock_guard<std::mutex> lk(reg->mu);
+  auto it = reg->map.find(service_method);
+  if (it != reg->map.end()) return it->second;
+  auto* ms = new MethodStatus(service_method);  // immortal
+  reg->map[service_method] = ms;
+  return ms;
+}
+
+GlobalRpcMetrics::GlobalRpcMetrics() {
+  client_latency.expose("rpc_client");
+  client_errors.expose("rpc_client_errors");
+  bytes_in.expose("rpc_socket_bytes_in");
+  bytes_out.expose("rpc_socket_bytes_out");
+  connections_accepted.expose("rpc_connections_accepted");
+}
+
+GlobalRpcMetrics& GlobalRpcMetrics::instance() {
+  static GlobalRpcMetrics* m = new GlobalRpcMetrics;
+  return *m;
+}
+
+}  // namespace trpc
