@@ -138,8 +138,12 @@ def make_sharded_train_step(mesh: Mesh):
         w1, m_w1 = momentum_update_reference(state.w1, state.m_w1, g_w1)
         w2, m_w2 = momentum_update_reference(state.w2, state.m_w2, g_w2)
         # Streaming relay: push running stats one hop around the shard ring
-        # (the tensor-streaming path of SURVEY §5).
-        stats = 0.9 * state.stats + 0.1 * jnp.mean(y, axis=0)
+        # (the tensor-streaming path of SURVEY §5). The batch mean is over
+        # the CLIENT-sharded local batch, so pmean over CLIENT first —
+        # out_specs declares stats replicated (P()) and without the pmean
+        # the replicas would silently diverge across the client axis.
+        batch_mean = jax.lax.pmean(jnp.mean(y, axis=0), CLIENT_AXIS)
+        stats = 0.9 * state.stats + 0.1 * batch_mean
         stats = jax.lax.ppermute(stats, SHARD_AXIS, ring)
         loss = jax.lax.pmean(loss, CLIENT_AXIS)
         new_state = PSState(w1=w1, b1=state.b1 - 0.01 * g_b1,

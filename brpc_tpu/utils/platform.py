@@ -1,0 +1,33 @@
+"""Virtual-device platform forcing shared by tests and driver entry points.
+
+This image's sitecustomize registers the axon TPU plugin at interpreter
+start and forces JAX_PLATFORMS=axon, so env vars alone don't stick —
+jax.config.update('jax_platforms', 'cpu') before first backend use is the
+reliable override (backend init is lazy).
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu_devices(n: int):
+    """Force the CPU platform with >= n virtual devices. Must run before
+    the first JAX backend touch; the platform choice is process-global.
+    Returns the list of CPU devices (asserting there are at least n)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags[:m.start(1)] + str(n) + flags[m.end(1):]
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices("cpu")
+    assert len(devices) >= n, (
+        f"need {n} virtual CPU devices, got {len(devices)} "
+        "(was the JAX backend initialized before this call?)")
+    return devices

@@ -2,25 +2,16 @@
 
 JAX tests run on a virtual 8-device CPU mesh (no real TPU pod in CI), the
 same way the reference fakes multi-node with many loopback servers + list://
-naming (SURVEY.md §4).
-
-NOTE: this image's sitecustomize registers the axon TPU plugin at
-interpreter start and forces JAX_PLATFORMS=axon, so env vars alone don't
-stick — jax.config.update('jax_platforms', 'cpu') before first backend use
-is the reliable override (backend init is lazy).
+naming (SURVEY.md §4). Platform forcing lives in
+brpc_tpu.utils.platform.force_virtual_cpu_devices (shared with the driver
+entry points).
 """
 
 import os
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from brpc_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_devices(8)
