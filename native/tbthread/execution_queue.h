@@ -54,35 +54,62 @@ class ExecutionQueue {
     return 0;
   }
 
-  // Producer side: wait-free (one exchange + one store).
+  // Producer side: wait-free (one exchange + one store). The producer epoch
+  // (_producers) plus seq_cst on the _stopped check makes stop_and_join a
+  // true barrier: either the producer sees _stopped and aborts, or the
+  // joiner sees the producer's epoch and waits for its enqueue+spawn.
   int execute(T value) {
-    if (_stopped.load(std::memory_order_acquire)) return -1;
+    _producers.fetch_add(1, std::memory_order_seq_cst);
+    if (_stopped.load(std::memory_order_seq_cst)) {
+      _producers.fetch_sub(1, std::memory_order_release);
+      return -1;
+    }
     Node* n = tbutil::get_object<Node>();
     n->value = std::move(value);
     n->next.store(nullptr, std::memory_order_relaxed);
-    Node* prev = _tail.exchange(n, std::memory_order_acq_rel);
+    Node* prev = _tail.exchange(n, std::memory_order_seq_cst);
     if (prev != nullptr) {
-      // Another node is in flight; link after it. The consumer is already
-      // running (or scheduled) because the list was non-empty.
       prev->next.store(n, std::memory_order_release);
-      return 0;
+    } else {
+      _head.store(n, std::memory_order_release);
     }
-    // List was empty: we own consumer startup.
-    _head.store(n, std::memory_order_release);
-    fiber_t tid;
-    int rc = fiber_start_background(&tid, nullptr, consume_thunk, this);
-    if (rc != 0) {
-      // Degrade: consume inline (still serialized: we are the only starter).
-      consume_thunk(this);
+    // Consumer startup is gated on _consumer_running, NOT on list emptiness:
+    // a consumer releases the queue's tail (take_one's CAS) before it hands
+    // its final batch to _fn, so "list became empty" does not mean "the
+    // consumer is done delivering". Spawning on emptiness alone would let a
+    // successor run _fn concurrently with the predecessor's last batch —
+    // breaking the serialized, ordered-delivery contract.
+    bool expected = false;
+    if (_consumer_running.compare_exchange_strong(
+            expected, true, std::memory_order_seq_cst)) {
+      // Account the tenure BEFORE spawning so a joiner never observes
+      // (no producers, no tenures) while a consumer fiber is pending.
+      _active_tenures.fetch_add(1, std::memory_order_acq_rel);
+      fiber_t tid;
+      int rc = fiber_start_background(&tid, nullptr, consume_thunk, this);
+      if (rc != 0) {
+        // Degrade: consume inline (still serialized: we hold the flag).
+        consume_thunk(this);
+      }
     }
+    _producers.fetch_sub(1, std::memory_order_release);
     return 0;
   }
 
-  // Stop accepting new tasks and wait for the consumer to drain.
+  // Stop accepting new tasks and wait until no producer is mid-enqueue, the
+  // queue is drained, and every consumer tenure has fully exited — after
+  // this returns it is safe to destroy the queue (and whatever owns it).
   int stop_and_join() {
-    _stopped.store(true, std::memory_order_release);
-    while (_tail.load(std::memory_order_acquire) != nullptr) {
-      fiber_usleep(1000);
+    _stopped.store(true, std::memory_order_seq_cst);
+    // seq_cst load: pairs with the producer's seq_cst fetch_add so the
+    // Dekker pattern is sound — either the producer sees _stopped, or we
+    // see its epoch and wait (an acquire load could legally miss it).
+    while (_producers.load(std::memory_order_seq_cst) > 0) {
+      fiber_usleep(200);
+    }
+    while (_tail.load(std::memory_order_acquire) != nullptr ||
+           _active_tenures.load(std::memory_order_acquire) > 0) {
+      fiber_usleep(500);
     }
     return 0;
   }
@@ -126,8 +153,23 @@ class ExecutionQueue {
 
   static void* consume_thunk(void* qv) {
     auto* q = static_cast<ExecutionQueue*>(qv);
-    Iterator it(q);
-    q->_fn(it, q->_arg);
+    while (true) {
+      Iterator it(q);
+      q->_fn(it, q->_arg);
+      // Release the consumer role, then re-check for items enqueued while
+      // we were delivering our final batch (their producers saw the flag
+      // held and did not spawn). seq_cst on both sides guarantees either we
+      // see the node here or the producer's CAS sees our cleared flag.
+      q->_consumer_running.store(false, std::memory_order_seq_cst);
+      if (q->_tail.load(std::memory_order_seq_cst) == nullptr) break;
+      bool expected = false;
+      if (!q->_consumer_running.compare_exchange_strong(
+              expected, true, std::memory_order_seq_cst)) {
+        break;  // a producer (or successor) took over
+      }
+    }
+    // Last touch of the queue: joiners may free it once this hits zero.
+    q->_active_tenures.fetch_sub(1, std::memory_order_release);
     return nullptr;
   }
 
@@ -136,6 +178,9 @@ class ExecutionQueue {
   std::atomic<Node*> _head{nullptr};
   std::atomic<Node*> _tail{nullptr};
   std::atomic<bool> _stopped{true};
+  std::atomic<bool> _consumer_running{false};
+  std::atomic<int> _producers{0};
+  std::atomic<int> _active_tenures{0};
 };
 
 }  // namespace tbthread

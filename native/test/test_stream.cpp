@@ -188,6 +188,83 @@ TEST_CASE(stream_close_propagates) {
   server.Stop();
 }
 
+namespace {
+
+// Handler that closes its own stream from INSIDE on_received_messages —
+// the explicitly supported self-close path (regression: round-1 freed the
+// Stream and its ExecutionQueue while the consumer fiber was mid-loop).
+class SelfCloser : public StreamInputHandler {
+ public:
+  int on_received_messages(StreamId id, tbutil::IOBuf* const[],
+                           size_t) override {
+    ++_batches;
+    StreamClose(id);      // close ourselves mid-tenure
+    StreamClose(id);      // idempotent: second close is a no-op
+    return 0;
+  }
+  void on_closed(StreamId) override { _closed.store(true); }
+  bool closed() const { return _closed.load(); }
+  int batches() const { return _batches; }
+
+ private:
+  std::atomic<bool> _closed{false};
+  int _batches = 0;
+};
+
+class SelfCloseService : public Service {
+ public:
+  explicit SelfCloseService(SelfCloser* h) : _h(h) {}
+  std::string_view service_name() const override { return "SelfClose"; }
+  void CallMethod(const std::string&, Controller* cntl, const tbutil::IOBuf&,
+                  tbutil::IOBuf* response, Closure* done) override {
+    StreamOptions opts;
+    opts.handler = _h;
+    StreamId sid;
+    StreamAccept(&sid, *cntl, &opts);
+    response->append("ok");
+    done->Run();
+  }
+
+ private:
+  SelfCloser* _h;
+};
+
+}  // namespace
+
+TEST_CASE(stream_self_close_from_handler) {
+  SelfCloser handler;
+  SelfCloseService svc(&handler);
+  Server server;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  Channel channel;
+  ASSERT_EQ(channel.Init(server.listen_address(), nullptr), 0);
+
+  Controller cntl;
+  StreamId stream;
+  ASSERT_EQ(StreamCreate(&stream, cntl, nullptr), 0);
+  tbutil::IOBuf req, resp;
+  req.append("open");
+  channel.CallMethod("SelfClose/Open", &cntl, req, &resp, nullptr);
+  ASSERT_FALSE(cntl.Failed());
+
+  tbutil::IOBuf chunk;
+  chunk.append("trigger");
+  ASSERT_EQ(StreamWrite(stream, chunk), 0);
+  // The server handler self-closes; our half must observe the peer CLOSE.
+  ASSERT_EQ(StreamWait(stream), 0);
+  for (int i = 0; i < 300 && !handler.closed(); ++i) {
+    tbthread::fiber_usleep(10 * 1000);
+  }
+  ASSERT_TRUE(handler.closed());
+  ASSERT_EQ(handler.batches(), 1);
+  // Stream is gone locally: further writes fail fast.
+  tbutil::IOBuf chunk2;
+  chunk2.append("x");
+  ASSERT_TRUE(StreamWrite(stream, chunk2) != 0);
+  server.Stop();
+}
+
 TEST_CASE(stream_rpc_failure_closes_stream) {
   // RPC to a dead endpoint: the stream must close (writers don't hang).
   Channel channel;

@@ -3,10 +3,12 @@
 #include <cerrno>
 #include <memory>
 #include <mutex>
+#include <thread>
 #include <unordered_map>
 
 #include "tbthread/butex.h"
 #include "tbthread/execution_queue.h"
+#include "tbthread/key.h"
 #include "tbutil/logging.h"
 #include "trpc/controller.h"
 #include "trpc/errno.h"
@@ -23,8 +25,13 @@ struct Stream {
   std::atomic<uint64_t> peer_id{0};
   std::atomic<uint64_t> socket_id{INVALID_SOCKET_ID};
   std::atomic<bool> connected{false};
+  // close_started is the internal claim (exactly one closer wins); closed is
+  // the published state, stored AFTER close_error so any reader that
+  // acquire-loads closed==true also sees the error (ADVICE r1: a racing
+  // writer could observe closed==true with a stale close_error of 0).
+  std::atomic<bool> close_started{false};
   std::atomic<bool> closed{false};
-  int close_error = 0;
+  std::atomic<int> close_error{0};
 
   // Writer half: parked on wbtx while out of credit.
   tbthread::Butex* wbtx;
@@ -38,9 +45,6 @@ struct Stream {
   std::atomic<int64_t> last_feedback{0};
 
   tbthread::Butex* close_btx;  // StreamWait
-  // Consumer fiber liveness: close_stream must not free the stream while a
-  // consumer is mid-batch (its `raw` pointer would dangle).
-  std::atomic<int> consumers_active{0};
 
   Stream() : wbtx(tbthread::butex_create()),
              close_btx(tbthread::butex_create()) {}
@@ -90,18 +94,58 @@ bool send_stream_frame(uint64_t socket_id, uint8_t msg_type,
   return s->Write(&out) == 0;
 }
 
-// Set while the calling fiber is inside a stream's consumer batch loop —
-// a handler that calls StreamClose must not deadlock waiting for itself.
-thread_local StreamId t_consuming_stream = INVALID_STREAM_ID;
+// Self-close detection: fiber-local storage marks "this fiber is inside a
+// consumer tenure of stream X". Fiber-local (not thread_local — a parked
+// fiber resumes on a different worker pthread) and per-fiber (consumer
+// tenures can OVERLAP: the old consumer may still be delivering its final
+// batch while a producer has already spawned the next consumer fiber, so a
+// single per-stream slot would misclassify one of them).
+tbthread::FiberKey consuming_key() {
+  static tbthread::FiberKey key = [] {
+    tbthread::FiberKey k;
+    tbthread::fiber_key_create(&k, nullptr);
+    return k;
+  }();
+  return key;
+}
 
-// Close the local half: drain queued data to the handler, wake
-// writers/waiters, notify the handler, drop the registry entry and the
-// socket registration. Ordering matters: queued DATA that arrived before
-// the close must be DELIVERED before on_closed fires, and the consumer
-// fiber must have fully exited before the stream can be freed.
+bool self_is_consumer(StreamId id) {
+  return reinterpret_cast<uintptr_t>(
+             tbthread::fiber_getspecific(consuming_key())) ==
+         static_cast<uintptr_t>(id);
+}
+
+// Second half of close: join the consumer (safe once no producer can
+// enqueue), deliver on_closed, wake StreamWait-ers, drop the registry ref.
+// Runs inline for an external closer, or in a detached closer fiber (which
+// owns a strong StreamPtr) when the consumer closes itself — joining our
+// own tenure would deadlock, and returning without a keepalive would let
+// the Stream (and the ExecutionQueue the consumer is still iterating) be
+// freed under the consumer's feet (ADVICE r1 use-after-free).
+void finish_close(const StreamPtr& s) {
+  s->incoming.stop_and_join();
+  if (s->options.handler != nullptr) {
+    s->options.handler->on_closed(s->id);
+  }
+  tbthread::butex_increment_and_wake_all(s->close_btx);
+  erase_stream(s->id);
+}
+
+void* closer_thunk(void* arg) {
+  auto* owner = static_cast<StreamPtr*>(arg);
+  finish_close(*owner);
+  delete owner;
+  return nullptr;
+}
+
+// Close the local half: publish the close, wake writers, then finish (see
+// finish_close). Ordering matters: queued DATA that arrived before the
+// close must be DELIVERED to the handler before on_closed fires, and the
+// consumer fiber must have fully exited before the stream can be freed.
 void close_stream(const StreamPtr& s, int error, bool notify_peer) {
-  if (s->closed.exchange(true, std::memory_order_acq_rel)) return;
-  s->close_error = error;
+  if (s->close_started.exchange(true, std::memory_order_acq_rel)) return;
+  s->close_error.store(error, std::memory_order_release);
+  s->closed.store(true, std::memory_order_release);
   if (notify_peer && s->connected.load(std::memory_order_acquire)) {
     send_stream_frame(s->socket_id.load(std::memory_order_acquire), 3,
                       s->peer_id.load(std::memory_order_acquire), 0, nullptr);
@@ -112,28 +156,29 @@ void close_stream(const StreamPtr& s, int error, bool notify_peer) {
     sock->RemovePendingStream(s->id);
   }
   tbthread::butex_increment_and_wake_all(s->wbtx);
-  // Drain-and-join the consumer — unless WE are the consumer (a handler
-  // calling StreamClose), in which case the queue is already being drained
-  // by this very fiber.
-  if (t_consuming_stream != s->id) {
-    s->incoming.stop_and_join();
-    while (s->consumers_active.load(std::memory_order_acquire) > 0) {
-      tbthread::fiber_usleep(500);
+  if (self_is_consumer(s->id)) {
+    auto* owner = new StreamPtr(s);
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_background(&tid, nullptr, closer_thunk,
+                                         owner) != 0) {
+      // Fiber pool exhausted: fall back to a plain thread — finish_close
+      // must not run on THIS fiber (it would join itself).
+      std::thread(closer_thunk, owner).detach();
     }
+  } else {
+    finish_close(s);
   }
-  if (s->options.handler != nullptr) {
-    s->options.handler->on_closed(s->id);
-  }
-  tbthread::butex_increment_and_wake_all(s->close_btx);
-  erase_stream(s->id);
 }
 
 // Consumer fiber: ordered batches -> handler -> consumption feedback.
 int consume_incoming(tbthread::ExecutionQueue<tbutil::IOBuf>::Iterator& iter,
                      void* arg) {
+  // `raw` stays valid for the whole tenure: the registry holds a strong ref
+  // until finish_close, which joins all tenures before erasing.
   auto* raw = static_cast<Stream*>(arg);
-  raw->consumers_active.fetch_add(1, std::memory_order_acq_rel);
-  t_consuming_stream = raw->id;
+  tbthread::fiber_setspecific(
+      consuming_key(),
+      reinterpret_cast<void*>(static_cast<uintptr_t>(raw->id)));
   constexpr size_t kBatch = 32;
   tbutil::IOBuf bufs[kBatch];
   tbutil::IOBuf* ptrs[kBatch];
@@ -172,8 +217,7 @@ int consume_incoming(tbthread::ExecutionQueue<tbutil::IOBuf>::Iterator& iter,
     }
     for (size_t i = 0; i < n; ++i) bufs[i].clear();
   }
-  t_consuming_stream = INVALID_STREAM_ID;
-  raw->consumers_active.fetch_sub(1, std::memory_order_acq_rel);
+  tbthread::fiber_setspecific(consuming_key(), nullptr);
   return 0;
 }
 
@@ -239,7 +283,8 @@ int StreamWrite(StreamId stream, const tbutil::IOBuf& message) {
   const int64_t size = static_cast<int64_t>(message.size());
   while (true) {
     if (s->closed.load(std::memory_order_acquire)) {
-      return s->close_error != 0 ? s->close_error : ECONNRESET;
+      const int e = s->close_error.load(std::memory_order_acquire);
+      return e != 0 ? e : ECONNRESET;
     }
     const int seq =
         tbthread::butex_value(s->wbtx)->load(std::memory_order_acquire);
@@ -269,8 +314,12 @@ int StreamWrite(StreamId stream, const tbutil::IOBuf& message) {
   tstd_serialize_meta(&out, meta, message.size());
   out.append(message);
   if (sock->Write(&out) != 0) {
-    close_stream(s, errno, false);
-    return errno;
+    // Capture errno BEFORE close_stream: its body (socket lookups, butex
+    // wakes, the consumer join) clobbers errno, which could turn a failed
+    // write into a bogus success return.
+    const int werr = errno != 0 ? errno : ECONNRESET;
+    close_stream(s, werr, false);
+    return werr;
   }
   return 0;
 }

@@ -1,5 +1,6 @@
 #include "trpc/tstd_protocol.h"
 
+#include <bit>
 #include <cstring>
 #include <mutex>
 
@@ -21,8 +22,13 @@ constexpr size_t kFixedMetaSize = 44;
 constexpr size_t kMaxMetaSize = 64 * 1024;
 constexpr size_t kMaxBodySize = 2ULL * 1024 * 1024 * 1024;  // 2 GB sanity cap
 
+// Wire byte order is LITTLE-ENDIAN by definition: header/meta integers are
+// memcpy'd raw. All supported deployment targets (x86_64, aarch64 TPU VMs)
+// are little-endian; a big-endian peer would need byte-swapping shims here.
 template <typename T>
 void put(std::string* s, T v) {
+  static_assert(std::endian::native == std::endian::little,
+                "tstd wire format requires a little-endian host");
   s->append(reinterpret_cast<const char*>(&v), sizeof(T));
 }
 
