@@ -1,3 +1,4 @@
+#include "trpc/rpc_metrics.h"
 #include "trpc/acceptor.h"
 
 #include <netinet/in.h>
@@ -37,6 +38,7 @@ InputMessageBase* AcceptMessenger::OnNewMessages(Socket* listen_socket,
       return nullptr;
     }
     tbutil::EndPoint remote(addr.sin_addr, ntohs(addr.sin_port));
+    GlobalRpcMetrics::instance().connections_accepted << 1;
     _owner->OnNewConnection(fd, remote);
   }
 }

@@ -5,6 +5,7 @@
 #include "tbutil/time.h"
 #include "trpc/errno.h"
 #include "trpc/load_balancer.h"
+#include "trpc/rpc_metrics.h"
 #include "trpc/socket_map.h"
 #include "trpc/stream_internal.h"
 #include "trpc/tstd_protocol.h"
@@ -214,6 +215,13 @@ void Controller::EndRPC(int error, const std::string& error_text) {
   // parked on the window wake with an error.
   if (_error_code != 0 && _request_stream != 0) {
     stream_internal::OnRpcFailed(_request_stream, _error_code);
+  }
+  // Client-side metrics (reference client LatencyRecorders feeding /vars).
+  if (_error_code == 0) {
+    GlobalRpcMetrics::instance().client_latency
+        << (_end_time_us - _begin_time_us);
+  } else {
+    GlobalRpcMetrics::instance().client_errors << 1;
   }
   Closure* done = _done;
   const tbthread::fiber_id_t cid = _correlation_id;

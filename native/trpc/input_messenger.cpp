@@ -1,3 +1,4 @@
+#include "trpc/rpc_metrics.h"
 #include "trpc/input_messenger.h"
 
 #include <cerrno>
@@ -102,6 +103,7 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
       *defer_error = TRPC_EEOF;
       break;
     }
+    GlobalRpcMetrics::instance().bytes_in << nr;
     while (true) {
       int proto_index = -1;
       ParseResult r = CutInputMessage(s, &proto_index);

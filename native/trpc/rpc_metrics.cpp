@@ -12,6 +12,12 @@ MethodStatus::MethodStatus(const std::string& full_name) {
 }
 
 MethodStatus* GetMethodStatus(const std::string& service_method) {
+  // Per-thread cache in front of the locked registry: the request hot path
+  // hits the global mutex only on each thread's first sighting of a method.
+  thread_local std::unordered_map<std::string, MethodStatus*> tls_cache;
+  auto cached = tls_cache.find(service_method);
+  if (cached != tls_cache.end()) return cached->second;
+
   struct Registry {
     std::mutex mu;
     std::unordered_map<std::string, MethodStatus*> map;
@@ -19,9 +25,20 @@ MethodStatus* GetMethodStatus(const std::string& service_method) {
   static Registry* reg = new Registry;
   std::lock_guard<std::mutex> lk(reg->mu);
   auto it = reg->map.find(service_method);
-  if (it != reg->map.end()) return it->second;
+  if (it != reg->map.end()) {
+    tls_cache[service_method] = it->second;
+    return it->second;
+  }
+  // Entries are immortal and method names arrive off the wire: cap the map
+  // so a peer cycling bogus method names can't grow it without bound.
+  constexpr size_t kMaxEntries = 4096;
+  if (reg->map.size() >= kMaxEntries) {
+    static MethodStatus* overflow = new MethodStatus("overflow");
+    return overflow;
+  }
   auto* ms = new MethodStatus(service_method);  // immortal
   reg->map[service_method] = ms;
+  tls_cache[service_method] = ms;
   return ms;
 }
 

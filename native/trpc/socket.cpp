@@ -1,3 +1,4 @@
+#include "trpc/rpc_metrics.h"
 #include "trpc/socket.h"
 
 #include <fcntl.h>
@@ -16,13 +17,18 @@
 #include "tbutil/time.h"
 #include "trpc/errno.h"
 #include "trpc/event_dispatcher.h"
+#include "trpc/flags.h"
 #include "trpc/input_messenger.h"
 
 namespace trpc {
 
 namespace {
 
-constexpr int64_t kMaxWriteQueueBytes = 256LL << 20;  // EOVERCROWDED cap
+// EOVERCROWDED cap, hot-reloadable via /flags (reference
+// FLAGS_socket_max_unwritten_bytes).
+std::atomic<int64_t>* g_max_write_queue_bytes = TRPC_DEFINE_FLAG(
+    socket_max_write_queue_bytes, 256LL << 20,
+    "Max bytes queued on one socket before Write fails with EOVERCROWDED");
 constexpr int64_t kDefaultConnectTimeoutUs = 1000000;
 
 int make_non_blocking(int fd) {
@@ -193,7 +199,7 @@ int Socket::Write(tbutil::IOBuf* data, tbthread::fiber_id_t notify_id) {
     return -1;
   }
   if (_write_queue_bytes.load(std::memory_order_relaxed) >
-      kMaxWriteQueueBytes) {
+      g_max_write_queue_bytes->load(std::memory_order_relaxed)) {
     errno = TRPC_EOVERCROWDED;
     return -1;
   }
@@ -329,6 +335,7 @@ int Socket::WriteOnce(WriteRequest* req) {
       return -1;
     }
     _write_queue_bytes.fetch_sub(nw, std::memory_order_relaxed);
+    GlobalRpcMetrics::instance().bytes_out << nw;
   }
   return 1;
 }

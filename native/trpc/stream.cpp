@@ -95,11 +95,11 @@ bool send_stream_frame(uint64_t socket_id, uint8_t msg_type,
 }
 
 // Self-close detection: fiber-local storage marks "this fiber is inside a
-// consumer tenure of stream X". Fiber-local (not thread_local — a parked
-// fiber resumes on a different worker pthread) and per-fiber (consumer
-// tenures can OVERLAP: the old consumer may still be delivering its final
-// batch while a producer has already spawned the next consumer fiber, so a
-// single per-stream slot would misclassify one of them).
+// consumer tenure of stream X". Fiber-local, NOT thread_local: a fiber that
+// parks inside the handler (e.g. StreamWrite waiting for credit) resumes on
+// a different worker pthread, where a thread_local marker would be stale on
+// both threads. Per-fiber state also stays correct by construction if the
+// queue ever allows tenures to overlap again.
 tbthread::FiberKey consuming_key() {
   static tbthread::FiberKey key = [] {
     tbthread::FiberKey k;

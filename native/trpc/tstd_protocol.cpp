@@ -8,6 +8,8 @@
 #include "tbutil/time.h"
 #include "trpc/controller.h"
 #include "trpc/errno.h"
+#include "trpc/flags.h"
+#include "trpc/rpc_metrics.h"
 #include "trpc/server.h"
 #include "trpc/socket.h"
 #include "trpc/stream_internal.h"
@@ -20,7 +22,11 @@ constexpr char kMagic[4] = {'T', 'R', 'P', 'C'};
 constexpr size_t kHeaderSize = 12;
 constexpr size_t kFixedMetaSize = 44;
 constexpr size_t kMaxMetaSize = 64 * 1024;
-constexpr size_t kMaxBodySize = 2ULL * 1024 * 1024 * 1024;  // 2 GB sanity cap
+// Body-size sanity cap, hot-reloadable via /flags (reference
+// FLAGS_max_body_size).
+std::atomic<int64_t>* g_max_body_size = TRPC_DEFINE_FLAG(
+    tstd_max_body_size, 2LL * 1024 * 1024 * 1024,
+    "Max tstd frame body size accepted by the parser");
 
 // Wire byte order is LITTLE-ENDIAN by definition: header/meta integers are
 // memcpy'd raw. All supported deployment targets (x86_64, aarch64 TPU VMs)
@@ -131,7 +137,8 @@ ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
   memcpy(&meta_size, header + 4, 4);
   memcpy(&body_size, header + 8, 4);
   if (meta_size < kFixedMetaSize || meta_size > kMaxMetaSize ||
-      body_size > kMaxBodySize) {
+      static_cast<int64_t>(body_size) >
+          g_max_body_size->load(std::memory_order_relaxed)) {
     r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
     return r;
   }
@@ -276,15 +283,28 @@ static void tstd_process_request(InputMessageBase* base) {
     fail_without_gate(TRPC_ELIMIT, "server concurrency limit reached");
     return;
   }
-  // From here the gate is released exactly once — by done.
-  Closure* done = NewCallback([sid, cid, cntl, response, server]() {
-    tstd_send_response(sid, cid, cntl, response);
-    server->EndRequest();
-    delete cntl;
-    delete response;
-  });
-
   Service* svc = server->FindService(msg->meta.service);
+  // Per-method stats (reference details/method_status.h): looked up only
+  // for REGISTERED services so junk service names can't mint entries.
+  MethodStatus* ms = nullptr;
+  if (svc != nullptr) {
+    ms = GetMethodStatus(msg->meta.service + "/" + msg->meta.method);
+    ms->OnRequested();
+  }
+  const int64_t received_us = tbutil::gettimeofday_us();
+  // From here the gate is released exactly once — by done (the single
+  // teardown path for both the error and success branches).
+  Closure* done =
+      NewCallback([sid, cid, cntl, response, server, ms, received_us]() {
+        if (ms != nullptr) {
+          ms->OnResponded(cntl->ErrorCode(),
+                          tbutil::gettimeofday_us() - received_us);
+        }
+        tstd_send_response(sid, cid, cntl, response);
+        server->EndRequest();
+        delete cntl;
+        delete response;
+      });
   if (svc == nullptr) {
     cntl->SetFailed(TRPC_ENOSERVICE,
                     "no such service: " + msg->meta.service);
