@@ -1,0 +1,266 @@
+// HTTP/1.x protocol tests: client+server RPC over HTTP, same-port
+// multi-protocol serving (tstd + HTTP, PARSE_ERROR_TRY_OTHERS), builtin
+// console pages, raw-socket interop (what curl would send), chunked bodies.
+// Mirrors reference test/brpc_http_rpc_protocol_unittest.cpp.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <string>
+
+#include "mini_test.h"
+#include "tbthread/fiber.h"
+#include "trpc/channel.h"
+#include "trpc/errno.h"
+#include "trpc/http_protocol.h"
+#include "trpc/server.h"
+#include "trpc/tstd_protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  std::string_view service_name() const override { return "EchoService"; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    if (method == "Echo") {
+      response->append(request);
+    } else {
+      cntl->SetFailed(TRPC_ENOMETHOD, "no such method: " + method);
+    }
+    done->Run();
+  }
+};
+
+// Blocking raw HTTP exchange over a plain TCP socket (what curl does).
+// read_to_eof: drain the whole connection (multi-response exchanges whose
+// last request carries Connection: close).
+std::string raw_http(const tbutil::EndPoint& ep, const std::string& request,
+                     bool read_to_eof = false) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sin{};
+  sin.sin_family = AF_INET;
+  sin.sin_addr = ep.ip;
+  sin.sin_port = htons(static_cast<uint16_t>(ep.port));
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&sin), sizeof(sin)) != 0) {
+    ::close(fd);
+    return "";
+  }
+  size_t off = 0;
+  while (off < request.size()) {
+    ssize_t n = ::write(fd, request.data() + off, request.size() - off);
+    if (n <= 0) break;
+    off += static_cast<size_t>(n);
+  }
+  std::string out;
+  char buf[4096];
+  while (true) {
+    ssize_t n = ::read(fd, buf, sizeof(buf));
+    if (n <= 0) break;
+    out.append(buf, static_cast<size_t>(n));
+    if (read_to_eof) continue;
+    // Headers + Content-Length tell us when the response is complete
+    // (keep-alive responses don't close the connection).
+    size_t he = out.find("\r\n\r\n");
+    if (he != std::string::npos) {
+      size_t cl = out.find("Content-Length: ");
+      if (cl != std::string::npos && cl < he) {
+        size_t len = strtoul(out.c_str() + cl + 16, nullptr, 10);
+        if (out.size() >= he + 4 + len) break;
+      }
+    }
+  }
+  ::close(fd);
+  return out;
+}
+
+}  // namespace
+
+TEST_CASE(http_echo_rpc) {
+  EchoService svc;
+  Server server;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+
+  Channel channel;
+  ChannelOptions opts;
+  opts.protocol = kHttpProtocolIndex;
+  ASSERT_EQ(channel.Init(server.listen_address(), &opts), 0);
+
+  for (int i = 0; i < 3; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("http-body-" + std::to_string(i));
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(resp.equals("http-body-" + std::to_string(i)));
+  }
+  // Error mapping: framework code rides x-trpc-error-code over 404.
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("x");
+  channel.CallMethod("EchoService/Nope", &cntl, req, &resp, nullptr);
+  ASSERT_TRUE(cntl.Failed());
+  ASSERT_EQ(cntl.ErrorCode(), (int)TRPC_ENOMETHOD);
+  server.Stop();
+}
+
+TEST_CASE(http_and_tstd_same_port) {
+  // The headline multi-protocol capability: one port, both wire formats,
+  // exercising PARSE_ERROR_TRY_OTHERS in both directions.
+  EchoService svc;
+  Server server;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+
+  Channel tstd_ch, http_ch;
+  ChannelOptions hopts;
+  hopts.protocol = kHttpProtocolIndex;
+  ASSERT_EQ(tstd_ch.Init(server.listen_address(), nullptr), 0);
+  ASSERT_EQ(http_ch.Init(server.listen_address(), &hopts), 0);
+
+  for (int i = 0; i < 4; ++i) {
+    Channel& ch = (i % 2 == 0) ? tstd_ch : http_ch;
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("mixed-" + std::to_string(i));
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(resp.equals("mixed-" + std::to_string(i)));
+  }
+  server.Stop();
+}
+
+TEST_CASE(http_console_pages) {
+  EchoService svc;
+  Server server;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+
+  Channel channel;
+  ChannelOptions opts;
+  opts.protocol = kHttpProtocolIndex;
+  ASSERT_EQ(channel.Init(server.listen_address(), &opts), 0);
+
+  auto fetch = [&](const std::string& page, std::string* out) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    channel.CallMethod(page, &cntl, req, &resp, nullptr);
+    *out = resp.to_string();
+    return !cntl.Failed();
+  };
+
+  std::string body;
+  ASSERT_TRUE(fetch("status", &body));
+  ASSERT_TRUE(body.find("EchoService") != std::string::npos);
+  ASSERT_TRUE(body.find("running: true") != std::string::npos);
+
+  ASSERT_TRUE(fetch("vars", &body));
+  ASSERT_TRUE(body.find("rpc_client_count") != std::string::npos);
+
+  ASSERT_TRUE(fetch("flags", &body));
+  ASSERT_TRUE(body.find("tstd_max_body_size") != std::string::npos);
+
+  ASSERT_TRUE(fetch("metrics", &body));
+  ASSERT_TRUE(body.find("# TYPE") != std::string::npos);
+
+  ASSERT_TRUE(fetch("connections", &body));
+  ASSERT_TRUE(body.find("count:") != std::string::npos);
+
+  ASSERT_TRUE(fetch("health", &body));
+  ASSERT_EQ(body, "OK\n");
+
+  // Live flag editing through the console.
+  ASSERT_TRUE(fetch("flags/socket_max_write_queue_bytes?setvalue=123456789",
+                    &body));
+  ASSERT_TRUE(fetch("flags/socket_max_write_queue_bytes", &body));
+  ASSERT_TRUE(body.find("123456789") != std::string::npos);
+  ASSERT_TRUE(
+      fetch("flags/socket_max_write_queue_bytes?setvalue=268435456", &body));
+  server.Stop();
+}
+
+TEST_CASE(http_raw_socket_interop) {
+  // A generic client (curl-style bytes): GET keep-alive, two requests on
+  // one connection, then Connection: close.
+  EchoService svc;
+  Server server;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  tbutil::EndPoint ep;
+  ASSERT_EQ(tbutil::str2endpoint(
+                ("127.0.0.1:" + std::to_string(server.listen_address().port))
+                    .c_str(),
+                &ep),
+            0);
+
+  std::string resp = raw_http(
+      ep, "GET /health HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 200 OK", 0) == 0);
+  ASSERT_TRUE(resp.find("OK\n") != std::string::npos);
+  ASSERT_TRUE(resp.find("Connection: close") != std::string::npos);
+
+  // POST with a body to a real service method.
+  resp = raw_http(ep,
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Content-Length: 5\r\nConnection: close\r\n\r\nhello");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 200 OK", 0) == 0);
+  ASSERT_TRUE(resp.find("\r\n\r\nhello") != std::string::npos);
+
+  // Chunked request body.
+  resp = raw_http(ep,
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+                  "5\r\nhello\r\n6\r\n-world\r\n0\r\n\r\n");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 200 OK", 0) == 0);
+  ASSERT_TRUE(resp.find("\r\n\r\nhello-world") != std::string::npos);
+
+  // 404 for unknown paths.
+  resp = raw_http(
+      ep, "GET /no/such/page HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 404", 0) == 0);
+
+  // Chunked with trailer headers after the last chunk.
+  resp = raw_http(ep,
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+                  "3\r\nabc\r\n0\r\nX-Trailer: v\r\n\r\n");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 200 OK", 0) == 0);
+  ASSERT_TRUE(resp.find("\r\n\r\nabc") != std::string::npos);
+
+  // HEAD: headers only, no body, connection stays usable.
+  resp = raw_http(ep,
+                  "HEAD /health HTTP/1.1\r\nHost: x\r\n\r\n"
+                  "GET /health HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+                  "\r\n",
+                  /*read_to_eof=*/true);
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 200 OK", 0) == 0);
+  // The HEAD response's Content-Length: 3 is followed directly by the
+  // SECOND response's status line, not by a body.
+  size_t first_end = resp.find("\r\n\r\n");
+  ASSERT_TRUE(first_end != std::string::npos);
+  ASSERT_TRUE(resp.compare(first_end + 4, 8, "HTTP/1.1") == 0);
+  ASSERT_TRUE(resp.find("OK\n") != std::string::npos);  // GET's body
+
+  // Batched keep-alive + close pair in ONE write: responses must come back
+  // in order and both arrive (regression: the close used to fire first).
+  resp = raw_http(ep,
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Content-Length: 5\r\n\r\nfirst"
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Content-Length: 6\r\nConnection: close\r\n\r\nsecond",
+                  /*read_to_eof=*/true);
+  size_t p1 = resp.find("\r\n\r\nfirst");
+  size_t p2 = resp.find("\r\n\r\nsecond");
+  ASSERT_TRUE(p1 != std::string::npos);
+  ASSERT_TRUE(p2 != std::string::npos);
+  ASSERT_TRUE(p1 < p2);
+  server.Stop();
+}
+
+TEST_MAIN

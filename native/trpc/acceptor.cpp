@@ -126,4 +126,13 @@ size_t Acceptor::connection_count() const {
   return n;
 }
 
+void Acceptor::ListConnections(std::vector<SocketId>* out) const {
+  out->clear();
+  std::lock_guard<std::mutex> lk(_conn_mu);
+  for (SocketId sid : _connections) {
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) == 0) out->push_back(sid);
+  }
+}
+
 }  // namespace trpc

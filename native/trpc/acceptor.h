@@ -11,6 +11,7 @@
 
 #include <mutex>
 #include <unordered_set>
+#include <vector>
 
 #include "trpc/input_messenger.h"
 #include "trpc/socket.h"
@@ -45,6 +46,8 @@ class Acceptor : public InputMessenger {
   void StopAccept();
 
   size_t connection_count() const;
+  // Snapshot of live accepted connections (console /connections page).
+  void ListConnections(std::vector<SocketId>* out) const;
 
  private:
   friend class AcceptMessenger;

@@ -1,0 +1,186 @@
+#include "trpc/builtin_console.h"
+
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tbutil/time.h"
+#include "tbvar/prometheus.h"
+#include "tbvar/variable.h"
+#include "trpc/flags.h"
+#include "trpc/http_protocol.h"
+#include "trpc/server.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+void index_page(const HttpRequest&, HttpResponse* resp) {
+  resp->content_type = "text/html";
+  resp->body =
+      "<html><head><title>brpc_tpu</title></head><body>"
+      "<h2>brpc_tpu server console</h2><ul>"
+      "<li><a href=\"/status\">/status</a> — server state</li>"
+      "<li><a href=\"/vars\">/vars</a> — all exposed variables</li>"
+      "<li><a href=\"/flags\">/flags</a> — reloadable flags "
+      "(set: /flags/NAME?setvalue=V)</li>"
+      "<li><a href=\"/connections\">/connections</a> — live sockets</li>"
+      "<li><a href=\"/metrics\">/metrics</a> — Prometheus text format</li>"
+      "<li><a href=\"/health\">/health</a></li>"
+      "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
+      "</ul></body></html>";
+}
+
+void status_page(const HttpRequest& req, HttpResponse* resp) {
+  std::string& b = resp->body;
+  if (req.server == nullptr) {
+    resp->status = 500;
+    b = "no server attached to this connection";
+    return;
+  }
+  Server* s = req.server;
+  b += "server: ";
+  b += tbutil::endpoint2str(s->listen_address());
+  b += "\nrunning: ";
+  b += s->running() ? "true" : "false";
+  b += "\nuptime_s: ";
+  b += std::to_string((tbutil::gettimeofday_us() - s->start_time_us()) /
+                      1000000);
+  b += "\nconnections: ";
+  b += std::to_string(s->connection_count());
+  b += "\ninflight_requests: ";
+  b += std::to_string(s->concurrency());
+  b += "\nservices:\n";
+  std::vector<std::string> names;
+  s->ListServices(&names);
+  for (const auto& n : names) {
+    b += "  ";
+    b += n;
+    b += '\n';
+  }
+}
+
+void vars_page(const HttpRequest& req, HttpResponse* resp) {
+  // /vars -> all; /vars/PREFIX -> filtered.
+  std::string prefix;
+  if (req.path.size() > 6 && req.path.rfind("/vars/", 0) == 0) {
+    prefix = req.path.substr(6);
+  }
+  std::map<std::string, std::string> vars;
+  tbvar::Variable::dump_exposed(&vars);
+  for (const auto& [name, value] : vars) {
+    if (!prefix.empty() && name.compare(0, prefix.size(), prefix) != 0) {
+      continue;
+    }
+    resp->body += name;
+    resp->body += " : ";
+    resp->body += value;
+    resp->body += '\n';
+  }
+  if (!prefix.empty() && resp->body.empty()) {
+    resp->status = 404;
+    resp->body = "no variable matches \"" + prefix + "\"\n";
+  }
+}
+
+void flags_page(const HttpRequest& req, HttpResponse* resp) {
+  auto& reg = FlagRegistry::global();
+  // /flags/NAME?setvalue=V -> live set (reference reloadable gflags /flags).
+  if (req.path.size() > 7 && req.path.rfind("/flags/", 0) == 0) {
+    const std::string name = req.path.substr(7);
+    const std::string setvalue = req.query_param("setvalue");
+    if (!setvalue.empty()) {
+      if (reg.Set(name, setvalue)) {
+        resp->body = name + " = " + setvalue + "\n";
+      } else {
+        resp->status = 400;
+        resp->body = "cannot set " + name + " to \"" + setvalue +
+                     "\" (unknown flag, parse error, or validator veto)\n";
+      }
+      return;
+    }
+    std::string value;
+    if (reg.Get(name, &value)) {
+      resp->body = name + " = " + value + "\n";
+    } else {
+      resp->status = 404;
+      resp->body = "unknown flag: " + name + "\n";
+    }
+    return;
+  }
+  std::map<std::string, FlagRegistry::Info> all;
+  reg.List(&all);
+  for (const auto& [name, info] : all) {
+    resp->body += name;
+    resp->body += " = ";
+    resp->body += std::to_string(info.value);
+    if (info.value != info.default_value) {
+      resp->body += " (default ";
+      resp->body += std::to_string(info.default_value);
+      resp->body += ")";
+    }
+    resp->body += "  # ";
+    resp->body += info.help;
+    resp->body += '\n';
+  }
+}
+
+void connections_page(const HttpRequest& req, HttpResponse* resp) {
+  if (req.server == nullptr) {
+    resp->status = 500;
+    resp->body = "no server attached to this connection";
+    return;
+  }
+  std::vector<SocketId> ids;
+  req.server->ListConnections(&ids);
+  resp->body = "count: " + std::to_string(ids.size()) + "\n";
+  for (SocketId sid : ids) {
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) != 0) continue;
+    resp->body += "  remote=";
+    resp->body += tbutil::endpoint2str(s->remote_side());
+    resp->body += " fd=";
+    resp->body += std::to_string(s->fd());
+    resp->body += " unwritten_bytes=";
+    resp->body += std::to_string(s->write_queue_bytes());
+    resp->body += '\n';
+  }
+}
+
+void metrics_page(const HttpRequest&, HttpResponse* resp) {
+  resp->content_type = "text/plain; version=0.0.4";
+  tbvar::dump_prometheus(&resp->body);
+}
+
+void health_page(const HttpRequest&, HttpResponse* resp) {
+  resp->body = "OK\n";
+}
+
+// Replaced by the span-backed page once rpcz sampling lands; registering a
+// stub keeps the index link honest.
+void rpcz_page(const HttpRequest&, HttpResponse* resp) {
+  resp->body = "rpcz: no spans sampled yet\n";
+}
+
+}  // namespace
+
+void RegisterBuiltinConsole() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterHttpHandler("/", index_page);
+    RegisterHttpHandler("/index", index_page);
+    RegisterHttpHandler("/status", status_page);
+    RegisterHttpHandler("/vars", vars_page);
+    RegisterHttpHandler("/vars/", vars_page);
+    RegisterHttpHandler("/flags", flags_page);
+    RegisterHttpHandler("/flags/", flags_page);
+    RegisterHttpHandler("/connections", connections_page);
+    RegisterHttpHandler("/metrics", metrics_page);
+    RegisterHttpHandler("/health", health_page);
+    RegisterHttpHandler("/rpcz", rpcz_page);
+  });
+}
+
+}  // namespace trpc

@@ -1,0 +1,15 @@
+// Builtin HTTP console: every Server self-reports over its own port.
+// Capability parity: reference src/brpc/server.cpp:499-521
+// AddBuiltinServices + src/brpc/builtin/ — /status, /vars, /flags (live
+// editing via reloadable flags), /connections, /metrics (Prometheus text,
+// builtin/prometheus_metrics_service.cpp), /health, and an index at /.
+#pragma once
+
+namespace trpc {
+
+// Idempotent; called from GlobalInitializeOrDie. Pages are served by the
+// HTTP protocol on every Server port (multi-protocol: the same port also
+// speaks tstd).
+void RegisterBuiltinConsole();
+
+}  // namespace trpc

@@ -4,6 +4,7 @@
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/errno.h"
+#include "trpc/input_messenger.h"
 #include "trpc/load_balancer.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/socket_map.h"
@@ -84,7 +85,27 @@ void Controller::IssueRPC() {
     SocketUniquePtr sock;
     int err = 0;
     std::string err_text;
-    if (SocketMap::global().GetOrCreate(_remote_side, &sock) != 0) {
+    if (proto->short_connection) {
+      // Dedicated one-RPC connection (reference CONNECTION_TYPE_SHORT):
+      // required by protocols whose wire carries no correlation id (HTTP) —
+      // the socket's single pending id IS the response match. Reclaimed by
+      // EndRPC.
+      Socket::Options opt;
+      opt.fd = -1;
+      opt.remote_side = _remote_side;
+      opt.messenger = InputMessenger::client_messenger();
+      SocketId sid;
+      if (Socket::Create(opt, &sid) != 0 ||
+          Socket::Address(sid, &sock) != 0) {
+        err = TRPC_ECONNECT;
+        err_text = "failed to create socket";
+      } else if (sock->ConnectIfNot(_deadline_us) != 0) {
+        err = errno != 0 ? errno : TRPC_ECONNECT;
+        err_text =
+            "failed to connect to " + tbutil::endpoint2str(_remote_side);
+        sock->SetFailed(err);
+      }
+    } else if (SocketMap::global().GetOrCreate(_remote_side, &sock) != 0) {
       err = TRPC_ECONNECT;
       err_text = "failed to create socket";
     } else if (sock->ConnectIfNot(_deadline_us) != 0) {
@@ -210,6 +231,11 @@ void Controller::EndRPC(int error, const std::string& error_text) {
   if (_attempt_socket != INVALID_SOCKET_ID &&
       Socket::Address(_attempt_socket, &sock) == 0) {
     sock->RemovePendingId(current_attempt_id());
+    // A short connection belongs to this one RPC: reclaim the fd now.
+    const Protocol* proto = GetProtocol(_protocol);
+    if (proto != nullptr && proto->short_connection) {
+      sock->SetFailed(ECANCELED);
+    }
   }
   // A failed RPC never connects its request stream: close it so writers
   // parked on the window wake with an error.

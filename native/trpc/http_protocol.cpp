@@ -1,0 +1,587 @@
+#include "trpc/http_protocol.h"
+
+#include <cctype>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/input_messenger.h"
+#include "trpc/rpc_metrics.h"
+#include "trpc/server.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr size_t kMaxBodyBytes = 256ULL * 1024 * 1024;
+
+// ---------------- small string helpers ----------------
+
+int lower(int c) { return std::tolower(static_cast<unsigned char>(c)); }
+
+bool iequals(const std::string& a, const char* b) {
+  size_t n = strlen(b);
+  if (a.size() != n) return false;
+  for (size_t i = 0; i < n; ++i) {
+    if (lower(a[i]) != lower(b[i])) return false;
+  }
+  return true;
+}
+
+std::string url_decode(std::string_view in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size() && isxdigit((unsigned char)in[i + 1]) &&
+        isxdigit((unsigned char)in[i + 2])) {
+      out.push_back(static_cast<char>(
+          std::stoi(std::string(in.substr(i + 1, 2)), nullptr, 16)));
+      i += 2;
+    } else if (in[i] == '+') {
+      out.push_back(' ');
+    } else {
+      out.push_back(in[i]);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+bool CaseLess::operator()(const std::string& a, const std::string& b) const {
+  return std::lexicographical_compare(
+      a.begin(), a.end(), b.begin(), b.end(),
+      [](char x, char y) { return lower(x) < lower(y); });
+}
+
+std::string HttpRequest::query_param(const std::string& key) const {
+  size_t pos = 0;
+  while (pos < query.size()) {
+    size_t amp = query.find('&', pos);
+    if (amp == std::string::npos) amp = query.size();
+    std::string_view kv(query.data() + pos, amp - pos);
+    size_t eq = kv.find('=');
+    std::string k = url_decode(eq == std::string_view::npos ? kv
+                                                            : kv.substr(0, eq));
+    if (k == key) {
+      return eq == std::string_view::npos
+                 ? std::string()
+                 : url_decode(kv.substr(eq + 1));
+    }
+    pos = amp + 1;
+  }
+  return std::string();
+}
+
+namespace {
+
+// ---------------- parsing ----------------
+
+struct HttpInputMessage : public InputMessageBase {
+  bool is_response = false;
+  // request fields
+  std::string method, path, query;
+  // response fields
+  int status = 0;
+  std::map<std::string, std::string, CaseLess> headers;
+  tbutil::IOBuf body;
+  bool keep_alive = true;
+};
+
+const char* const kVerbs[] = {"GET ",     "POST ",  "PUT ",  "DELETE ",
+                              "HEAD ",    "OPTIONS ", "PATCH "};
+
+// Does the (possibly short) prefix look like HTTP at all? Drives the
+// TRY_OTHERS vs NOT_ENOUGH_DATA decision for multi-protocol ports.
+bool plausible_http_prefix(const char* p, size_t n) {
+  auto prefix_of = [&](const char* lit) {
+    size_t ln = strlen(lit);
+    return memcmp(p, lit, n < ln ? n : ln) == 0;
+  };
+  if (prefix_of("HTTP/1.")) return true;
+  for (const char* v : kVerbs) {
+    if (prefix_of(v)) return true;
+  }
+  return false;
+}
+
+// Parse "k1=v1\r\nk2: v2..." header block [begin,end) into msg->headers.
+bool parse_header_lines(const char* begin, const char* end,
+                        std::map<std::string, std::string, CaseLess>* out) {
+  const char* p = begin;
+  while (p < end) {
+    const char* eol = static_cast<const char*>(memchr(p, '\r', end - p));
+    if (eol == nullptr || eol + 1 >= end || eol[1] != '\n') return false;
+    const char* colon = static_cast<const char*>(memchr(p, ':', eol - p));
+    if (colon == nullptr) return false;
+    std::string key(p, colon - p);
+    const char* v = colon + 1;
+    while (v < eol && (*v == ' ' || *v == '\t')) ++v;
+    (*out)[key] = std::string(v, eol - v);
+    p = eol + 2;
+  }
+  return true;
+}
+
+// Chunked body: returns bytes consumed from `data` and fills *out, or 0 if
+// incomplete, or SIZE_MAX on framing error.
+size_t parse_chunked(const std::string& data, size_t pos, std::string* out) {
+  const size_t start = pos;
+  while (true) {
+    size_t eol = data.find("\r\n", pos);
+    if (eol == std::string::npos) return 0;
+    size_t len = 0;
+    // chunk-size [;extensions]
+    size_t i = pos;
+    for (; i < eol; ++i) {
+      char c = data[i];
+      if (c == ';') break;
+      int d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+      else return SIZE_MAX;
+      len = len * 16 + d;
+      if (len > kMaxBodyBytes) return SIZE_MAX;
+    }
+    if (i == pos) return SIZE_MAX;  // empty size
+    pos = eol + 2;
+    if (len == 0) {
+      // last-chunk; consume trailer lines (each CRLF-terminated) up to and
+      // including the empty line that ends the trailer section.
+      while (true) {
+        size_t fin = data.find("\r\n", pos);
+        if (fin == std::string::npos) return 0;
+        if (fin == pos) return fin + 2 - start;  // empty line: done
+        pos = fin + 2;  // a trailer header line: skip it
+      }
+    }
+    if (data.size() < pos + len + 2) return 0;
+    out->append(data, pos, len);
+    if (data[pos + len] != '\r' || data[pos + len + 1] != '\n') {
+      return SIZE_MAX;
+    }
+    pos += len + 2;
+  }
+}
+
+ParseResult http_parse(tbutil::IOBuf* source, Socket*) {
+  ParseResult r;
+  const size_t avail = source->size();
+  if (avail == 0) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  char head[8];
+  const size_t nhead = source->copy_to(head, sizeof(head));
+  if (!plausible_http_prefix(head, nhead)) {
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  if (nhead < sizeof(head)) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;  // plausible prefix, need more
+    return r;
+  }
+  // Copy the candidate header block (bounded) to contiguous memory.
+  std::string buf;
+  source->copy_to(&buf, std::min(avail, kMaxHeaderBytes + 4));
+  size_t hdr_end = buf.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    r.error = avail > kMaxHeaderBytes ? PARSE_ERROR_ABSOLUTELY_WRONG
+                                      : PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  size_t line_end = buf.find("\r\n");
+  auto msg = std::make_unique<HttpInputMessage>();
+  // ---- start line ----
+  std::string line = buf.substr(0, line_end);
+  int http_minor = 1;
+  if (line.rfind("HTTP/1.", 0) == 0) {
+    // response: HTTP/1.x NNN reason
+    msg->is_response = true;
+    if (line.size() < 12) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    http_minor = line[7] - '0';
+    msg->status = atoi(line.c_str() + 9);
+    if (msg->status < 100 || msg->status > 599) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+  } else {
+    // request: VERB SP path SP HTTP/1.x
+    size_t sp1 = line.find(' ');
+    size_t sp2 = line.rfind(' ');
+    if (sp1 == std::string::npos || sp2 == sp1 ||
+        line.compare(sp2 + 1, 7, "HTTP/1.") != 0) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    http_minor = line.size() > sp2 + 8 ? line[sp2 + 8] - '0' : 1;
+    msg->method = line.substr(0, sp1);
+    std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t q = target.find('?');
+    if (q == std::string::npos) {
+      msg->path = url_decode(target);
+    } else {
+      msg->path = url_decode(target.substr(0, q));
+      msg->query = target.substr(q + 1);
+    }
+  }
+  if (!parse_header_lines(buf.data() + line_end + 2, buf.data() + hdr_end + 2,
+                          &msg->headers)) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  // ---- connection semantics ----
+  auto conn = msg->headers.find("Connection");
+  if (conn != msg->headers.end()) {
+    msg->keep_alive = !iequals(conn->second, "close");
+  } else {
+    msg->keep_alive = http_minor >= 1;
+  }
+  // ---- body ----
+  const size_t header_total = hdr_end + 4;
+  auto te = msg->headers.find("Transfer-Encoding");
+  if (te != msg->headers.end() && iequals(te->second, "chunked")) {
+    // Chunked needs the full frame contiguous: extend the copy if the
+    // header copy was truncated. NOTE: until the frame completes, every
+    // read edge re-copies and re-walks the buffered bytes (O(n^2) for a
+    // large chunked body arriving in small reads). Acceptable for the
+    // console/config plane this protocol serves; bulk tensor traffic rides
+    // tstd/tpu, never chunked HTTP.
+    if (buf.size() < avail) source->copy_to(&buf, avail);
+    std::string body;
+    size_t consumed = parse_chunked(buf, header_total, &body);
+    if (consumed == SIZE_MAX) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    if (consumed == 0) {
+      if (avail > kMaxBodyBytes) {
+        r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+        return r;
+      }
+      r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+      return r;
+    }
+    source->pop_front(header_total + consumed);
+    msg->body.append(body);
+  } else {
+    size_t content_length = 0;
+    auto cl = msg->headers.find("Content-Length");
+    if (cl != msg->headers.end()) {
+      char* endp = nullptr;
+      unsigned long long v = strtoull(cl->second.c_str(), &endp, 10);
+      if (endp == cl->second.c_str() || *endp != '\0' || v > kMaxBodyBytes) {
+        r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+        return r;
+      }
+      content_length = static_cast<size_t>(v);
+    } else if (msg->is_response && msg->status != 204 && msg->status != 304 &&
+               msg->status >= 200) {
+      // A response with neither Content-Length nor chunked framing is
+      // EOF-delimited (RFC 9112 §6.3). We cannot complete it from here;
+      // never-complete makes the RPC fail honestly at connection EOF
+      // instead of silently succeeding with a truncated/empty body.
+      r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+      return r;
+    }
+    if (avail < header_total + content_length) {
+      r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+      return r;
+    }
+    source->pop_front(header_total);
+    source->cutn(&msg->body, content_length);
+  }
+  // Server requests process IN PARSE ORDER on the connection's input fiber:
+  // HTTP/1.1 requires in-order responses, and concurrent per-request fibers
+  // would interleave them (a batched keep-alive+close pair would even drop
+  // the first response when the close fires early). Sync handlers — every
+  // builtin page and typical services — thus serialize correctly; an async
+  // handler that parks `done` past the next request forfeits ordering,
+  // which is the classic "no pipelining" stance of mainstream servers.
+  msg->process_in_place = !msg->is_response;
+  r.error = PARSE_OK;
+  r.msg = msg.release();
+  return r;
+}
+
+// ---------------- response serialization ----------------
+
+const char* status_reason(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 500: return "Internal Server Error";
+    case 503: return "Service Unavailable";
+    default: return "Unknown";
+  }
+}
+
+void serialize_response(tbutil::IOBuf* out, const HttpResponse& resp,
+                        bool keep_alive, bool head_request = false) {
+  std::string h;
+  h.reserve(256 + resp.body.size());
+  h += "HTTP/1.1 ";
+  h += std::to_string(resp.status);
+  h += ' ';
+  h += status_reason(resp.status);
+  h += "\r\nContent-Type: ";
+  h += resp.content_type;
+  h += "\r\nContent-Length: ";
+  h += std::to_string(resp.body.size());
+  h += keep_alive ? "\r\nConnection: keep-alive" : "\r\nConnection: close";
+  for (const auto& [k, v] : resp.headers) {
+    h += "\r\n";
+    h += k;
+    h += ": ";
+    h += v;
+  }
+  h += "\r\n\r\n";
+  // HEAD: headers only — Content-Length still describes the body a GET
+  // would return (RFC 9110 §9.3.2).
+  if (!head_request) h += resp.body;
+  out->append(h);
+}
+
+// ---------------- builtin handler registry ----------------
+
+struct HandlerRegistry {
+  std::mutex mu;
+  std::unordered_map<std::string, HttpHandler> exact;
+  std::vector<std::pair<std::string, HttpHandler>> prefixes;  // end with '/'
+};
+HandlerRegistry& handlers() {
+  static HandlerRegistry* h = new HandlerRegistry;
+  return *h;
+}
+
+const HttpHandler* find_handler(const std::string& path,
+                                HttpHandler* storage) {
+  HandlerRegistry& reg = handlers();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  auto it = reg.exact.find(path);
+  if (it != reg.exact.end()) {
+    *storage = it->second;
+    return storage;
+  }
+  for (const auto& [prefix, h] : reg.prefixes) {
+    if (path.size() >= prefix.size() &&
+        path.compare(0, prefix.size(), prefix) == 0) {
+      *storage = h;
+      return storage;
+    }
+  }
+  return nullptr;
+}
+
+// ---------------- server side ----------------
+
+void send_http_response(SocketId sid, const HttpResponse& resp,
+                        bool keep_alive, bool head_request = false) {
+  SocketUniquePtr s;
+  if (Socket::Address(sid, &s) != 0) return;
+  tbutil::IOBuf out;
+  serialize_response(&out, resp, keep_alive, head_request);
+  if (!keep_alive) s->MarkCloseAfterLastWrite();
+  s->Write(&out);
+}
+
+int http_status_for_error(int code) {
+  switch (code) {
+    case 0: return 200;
+    case TRPC_ENOSERVICE:
+    case TRPC_ENOMETHOD: return 404;
+    case TRPC_ELIMIT: return 503;
+    case TRPC_EREQUEST: return 400;
+    default: return 500;
+  }
+}
+
+void http_process_request(InputMessageBase* base) {
+  std::unique_ptr<HttpInputMessage> msg(static_cast<HttpInputMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  auto* server = static_cast<Server*>(s->user());
+  const SocketId sid = msg->socket_id;
+  const bool keep_alive = msg->keep_alive;
+
+  const bool is_head = msg->method == "HEAD";
+
+  // 1) Builtin console pages.
+  HttpHandler storage;
+  if (const HttpHandler* h = find_handler(msg->path, &storage)) {
+    HttpRequest req;
+    req.method = std::move(msg->method);
+    req.path = std::move(msg->path);
+    req.query = std::move(msg->query);
+    req.headers = std::move(msg->headers);
+    req.body = std::move(msg->body);
+    req.server = server;
+    HttpResponse resp;
+    (*h)(req, &resp);
+    send_http_response(sid, resp, keep_alive, is_head);
+    return;
+  }
+
+  // 2) /ServiceName/MethodName -> the same Service objects tstd dispatches.
+  HttpResponse err_resp;
+  auto fail = [&](int code, const std::string& text) {
+    err_resp.status = http_status_for_error(code);
+    err_resp.headers["x-trpc-error-code"] = std::to_string(code);
+    err_resp.body = text;
+    send_http_response(sid, err_resp, keep_alive, is_head);
+  };
+  if (server == nullptr) {
+    fail(TRPC_EINTERNAL, "socket has no server");
+    return;
+  }
+  size_t slash = msg->path.find('/', 1);
+  if (msg->path.empty() || msg->path[0] != '/' ||
+      slash == std::string::npos || slash + 1 >= msg->path.size()) {
+    fail(TRPC_ENOSERVICE, "no handler for " + msg->path);
+    return;
+  }
+  std::string service_name = msg->path.substr(1, slash - 1);
+  std::string method = msg->path.substr(slash + 1);
+  Service* svc = server->FindService(service_name);
+  if (svc == nullptr) {
+    fail(TRPC_ENOSERVICE, "no such service: " + service_name);
+    return;
+  }
+  if (!server->BeginRequest()) {
+    fail(TRPC_ELIMIT, "server concurrency limit reached");
+    return;
+  }
+  MethodStatus* ms = GetMethodStatus(service_name + "/" + method);
+  ms->OnRequested();
+  const int64_t received_us = tbutil::gettimeofday_us();
+
+  auto* cntl = new Controller;
+  auto* response = new tbutil::IOBuf;
+  ControllerPrivateAccessor acc(cntl);
+  acc.set_server_side(s->remote_side(), 0);
+  acc.set_server_socket(sid);
+  Closure* done = NewCallback(
+      [sid, cntl, response, server, ms, received_us, keep_alive, is_head]() {
+        ms->OnResponded(cntl->ErrorCode(),
+                        tbutil::gettimeofday_us() - received_us);
+        HttpResponse resp;
+        resp.status = http_status_for_error(cntl->ErrorCode());
+        if (cntl->Failed()) {
+          resp.headers["x-trpc-error-code"] =
+              std::to_string(cntl->ErrorCode());
+          resp.body = cntl->ErrorText();
+        } else {
+          resp.content_type = "application/octet-stream";
+          resp.body = response->to_string();
+        }
+        send_http_response(sid, resp, keep_alive, is_head);
+        server->EndRequest();
+        delete cntl;
+        delete response;
+      });
+  tbutil::IOBuf request = std::move(msg->body);
+  msg.reset();
+  svc->CallMethod(method, cntl, request, response, done);
+}
+
+// ---------------- client side ----------------
+
+void http_pack_request(tbutil::IOBuf* out, Controller* cntl,
+                       uint64_t /*correlation_id*/,
+                       const std::string& service_method,
+                       const tbutil::IOBuf& payload) {
+  // Correlation rides the socket, not the wire: HTTP client RPCs use a
+  // dedicated short connection whose single pending id IS the match
+  // (reference CONNECTION_TYPE_SHORT, controller.cpp:1148-1160).
+  std::string h;
+  h.reserve(256);
+  h += payload.empty() ? "GET /" : "POST /";
+  h += service_method;
+  h += " HTTP/1.1\r\nHost: ";
+  h += tbutil::endpoint2str(cntl->remote_side());
+  h += "\r\nContent-Length: ";
+  h += std::to_string(payload.size());
+  h += "\r\nConnection: close\r\nAccept: */*\r\n\r\n";
+  out->append(h);
+  out->append(payload);
+}
+
+// Defined in controller.cpp's spirit: resolve the socket's single pending
+// RPC with the parsed response.
+void http_process_response(InputMessageBase* base) {
+  std::unique_ptr<HttpInputMessage> msg(static_cast<HttpInputMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  const tbthread::fiber_id_t attempt_id = s->FirstPendingId();
+  if (attempt_id == 0) return;  // RPC already finished (timeout won)
+  void* data = nullptr;
+  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
+  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
+  if (attempt_id != acc.current_attempt_id()) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  acc.mark_response_received();
+  int err = 0;
+  std::string err_text;
+  if (msg->status != 200) {
+    auto it = msg->headers.find("x-trpc-error-code");
+    err = it != msg->headers.end() ? atoi(it->second.c_str())
+                                   : TRPC_EINTERNAL;
+    if (err == 0) err = TRPC_EINTERNAL;
+    err_text = msg->body.to_string();
+  } else if (acc.response_payload() != nullptr) {
+    acc.response_payload()->clear();
+    acc.response_payload()->append(std::move(msg->body));
+  }
+  msg.reset();
+  acc.EndRPC(err, err_text);
+}
+
+}  // namespace
+
+int RegisterHttpHandler(const std::string& path, HttpHandler handler) {
+  HandlerRegistry& reg = handlers();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  // "/" itself is the index page, an exact match — only longer paths
+  // ending in '/' register as prefixes.
+  if (path.size() > 1 && path.back() == '/') {
+    for (const auto& [p, h] : reg.prefixes) {
+      if (p == path) return -1;
+    }
+    reg.prefixes.emplace_back(path, std::move(handler));
+    return 0;
+  }
+  if (reg.exact.count(path) != 0) return -1;
+  reg.exact[path] = std::move(handler);
+  return 0;
+}
+
+void RegisterHttpProtocol() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Protocol p;
+    p.parse = http_parse;
+    p.pack_request = http_pack_request;
+    p.process_request = http_process_request;
+    p.process_response = http_process_response;
+    p.short_connection = true;
+    p.name = "http";
+    TB_CHECK(RegisterProtocol(kHttpProtocolIndex, p) == 0)
+        << "http protocol slot taken";
+  });
+}
+
+}  // namespace trpc

@@ -1,0 +1,61 @@
+// HTTP/1.x protocol: client + server on the shared protocol registry, so
+// HTTP and tstd multiplex on ONE server port (the parser that recognizes
+// the bytes wins — PARSE_ERROR_TRY_OTHERS).
+//
+// Capability parity: reference src/brpc/policy/http_rpc_protocol.cpp +
+// details/http_parser.cpp + details/http_message.cpp:
+//  - server: keep-alive + Connection: close, Content-Length and chunked
+//    bodies, /ServiceName/MethodName dispatch onto the same Service
+//    objects tstd serves, builtin console pages via RegisterHttpHandler
+//  - client: short-connection requests (reference CONNECTION_TYPE_SHORT,
+//    the standard type for HTTP), response matched to the socket's single
+//    in-flight RPC
+//  - error mapping: framework error codes ride an x-trpc-error-code header
+//    over canonical HTTP statuses (reference brpc-status-code / grpc.cpp)
+#pragma once
+
+#include <functional>
+#include <map>
+#include <string>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+class Server;
+
+inline constexpr int kHttpProtocolIndex = 1;
+
+struct CaseLess {
+  bool operator()(const std::string& a, const std::string& b) const;
+};
+
+struct HttpRequest {
+  std::string method;  // GET, POST, ...
+  std::string path;    // without the query string
+  std::string query;   // raw bytes after '?'
+  std::map<std::string, std::string, CaseLess> headers;
+  tbutil::IOBuf body;
+  Server* server = nullptr;  // the serving Server (console pages introspect)
+
+  // "a=1&b=2" lookup with %XX decoding; "" when absent.
+  std::string query_param(const std::string& key) const;
+};
+
+struct HttpResponse {
+  int status = 200;
+  std::string content_type = "text/plain";
+  std::map<std::string, std::string> headers;  // extra headers
+  std::string body;
+};
+
+// Builtin page handlers (the console, reference src/brpc/builtin/). Exact
+// path match, or prefix match when the registered path ends with '/'
+// ("/vars/" also serves "/vars/some_counter"). Returns 0, -1 if taken.
+using HttpHandler = std::function<void(const HttpRequest&, HttpResponse*)>;
+int RegisterHttpHandler(const std::string& path, HttpHandler handler);
+
+// Idempotent; called from GlobalInitializeOrDie.
+void RegisterHttpProtocol();
+
+}  // namespace trpc

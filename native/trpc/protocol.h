@@ -55,6 +55,10 @@ struct Protocol {
   void (*process_request)(InputMessageBase* msg);
   // Client side: resolve the correlation id. Takes ownership of msg.
   void (*process_response)(InputMessageBase* msg);
+  // Client RPCs use a dedicated connection per call instead of the shared
+  // SocketMap connection (reference CONNECTION_TYPE_SHORT; the standard
+  // type for HTTP, whose wire carries no correlation id).
+  bool short_connection = false;
   const char* name;
 };
 

@@ -8,6 +8,7 @@
 #include <cstring>
 
 #include "tbutil/logging.h"
+#include "tbutil/time.h"
 #include "trpc/tstd_protocol.h"
 
 namespace trpc {
@@ -84,10 +85,19 @@ int Server::Start(const char* addr, const ServerOptions* options) {
     close(fd);
     return -1;
   }
+  _start_time_us = tbutil::gettimeofday_us();
   _running.store(true, std::memory_order_release);
   TB_LOG(INFO) << "server started on "
                << tbutil::endpoint2str(_listen_address);
   return 0;
+}
+
+void Server::ListServices(std::vector<std::string>* out) const {
+  out->clear();
+  for (const auto& [name, svc] : _services) {
+    (void)svc;
+    out->push_back(name);
+  }
 }
 
 int Server::Stop() {

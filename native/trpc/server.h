@@ -9,6 +9,7 @@
 #include <atomic>
 #include <string>
 #include <string_view>
+#include <vector>
 
 #include "tbutil/endpoint.h"
 #include "tbutil/flat_map.h"
@@ -55,9 +56,14 @@ class Server {
   int Join();
 
   Service* FindService(std::string_view name) const;
+  void ListServices(std::vector<std::string>* out) const;
   const tbutil::EndPoint& listen_address() const { return _listen_address; }
   size_t connection_count() const { return _acceptor.connection_count(); }
+  void ListConnections(std::vector<SocketId>* out) const {
+    _acceptor.ListConnections(out);
+  }
   bool running() const { return _running.load(std::memory_order_acquire); }
+  int64_t start_time_us() const { return _start_time_us; }
 
   // Request-level concurrency gate. Always counts in-flight requests (not
   // only when capped): Stop() drains to zero before returning, so a done
@@ -83,6 +89,7 @@ class Server {
   tbutil::EndPoint _listen_address;
   std::atomic<bool> _running{false};
   std::atomic<int32_t> _concurrency{0};
+  int64_t _start_time_us = 0;
   tbthread::Butex* _stop_butex = nullptr;
   tbthread::Butex* _drain_butex = nullptr;  // woken when concurrency hits 0
 };

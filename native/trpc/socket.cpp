@@ -87,6 +87,7 @@ int Socket::Create(const Options& opt, SocketId* id) {
   s->_preferred_protocol = -1;
   s->_nevent.store(0, std::memory_order_relaxed);
   s->_write_queue_bytes.store(0, std::memory_order_relaxed);
+  s->_close_after_write.store(false, std::memory_order_relaxed);
   s->_connecting.store(false, std::memory_order_relaxed);
   s->_fd.store(opt.fd, std::memory_order_release);
   if (opt.fd >= 0) {
@@ -191,6 +192,11 @@ void Socket::RemovePendingId(tbthread::fiber_id_t id) {
   }
 }
 
+tbthread::fiber_id_t Socket::FirstPendingId() {
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  return _pending_ids.empty() ? 0 : _pending_ids.front();
+}
+
 // ---------------- write path ----------------
 
 int Socket::Write(tbutil::IOBuf* data, tbthread::fiber_id_t notify_id) {
@@ -237,6 +243,9 @@ void Socket::StartWrite(WriteRequest* req) {
     if (_write_head.compare_exchange_strong(expected, nullptr,
                                             std::memory_order_acq_rel)) {
       tbutil::return_object(req);
+      if (_close_after_write.load(std::memory_order_acquire)) {
+        SetFailed(TRPC_EEOF);  // graceful Connection: close
+      }
       return;
     }
   }
@@ -297,6 +306,9 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
     if (_write_head.compare_exchange_strong(expected, nullptr,
                                             std::memory_order_acq_rel)) {
       tbutil::return_object(last);
+      if (_close_after_write.load(std::memory_order_acquire)) {
+        SetFailed(TRPC_EEOF);  // graceful Connection: close
+      }
       return;
     }
     // New requests arrived while we wrote. expected = current head

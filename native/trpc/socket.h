@@ -83,6 +83,15 @@ class Socket : public VersionedRefWithId<Socket> {
   // -- pending RPC correlation (errored on SetFailed) --
   void AddPendingId(tbthread::fiber_id_t id);
   void RemovePendingId(tbthread::fiber_id_t id);
+  // Oldest pending id (0 when none) — correlation for protocols whose wire
+  // carries no id (HTTP): the short connection has one in-flight RPC.
+  tbthread::fiber_id_t FirstPendingId();
+
+  // After the write queue fully drains, fail the socket (graceful
+  // "Connection: close" semantics). One-way.
+  void MarkCloseAfterLastWrite() {
+    _close_after_write.store(true, std::memory_order_release);
+  }
 
   // -- streams multiplexed on this connection (closed on SetFailed) --
   using StreamFailCallback = void (*)(uint64_t stream_id, int error);
@@ -139,6 +148,7 @@ class Socket : public VersionedRefWithId<Socket> {
 
   std::atomic<WriteRequest*> _write_head{nullptr};
   std::atomic<int64_t> _write_queue_bytes{0};
+  std::atomic<bool> _close_after_write{false};
   tbthread::Butex* _epollout_butex;
   std::atomic<int> _nevent{0};  // pending read edges; input fiber active while > 0
   // True from fd-publication until the non-blocking connect completes —

@@ -6,7 +6,9 @@
 
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "trpc/builtin_console.h"
 #include "trpc/controller.h"
+#include "trpc/http_protocol.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
@@ -331,6 +333,8 @@ void GlobalInitializeOrDie() {
     p.name = "tstd";
     TB_CHECK(RegisterProtocol(kTstdProtocolIndex, p) == 0)
         << "tstd protocol slot taken";
+    RegisterHttpProtocol();  // same-port multi-protocol serving
+    RegisterBuiltinConsole();
   });
 }
 
