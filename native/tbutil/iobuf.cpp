@@ -264,6 +264,17 @@ uint64_t IOBuf::get_first_data_meta() const {
   return _refs[_start].block->meta;
 }
 
+void IOBuf::for_each_ref(void (*fn)(void* ctx, const void* data, size_t len,
+                                    uint64_t meta),
+                         void* ctx) const {
+  for (uint32_t i = 0; i < _count; ++i) {
+    const BlockRef& r = ref_at(i);
+    const uint64_t meta =
+        (r.block->flags & Block::kUserData) ? r.block->meta : 0;
+    fn(ctx, r.block->data + r.offset, r.length, meta);
+  }
+}
+
 size_t IOBuf::cutn(IOBuf* out, size_t n) {
   n = std::min(n, _size);
   size_t left = n;

@@ -65,6 +65,13 @@ class IOBuf {
   int append_user_data_with_meta(void* data, size_t size,
                                  void (*deleter)(void*), uint64_t meta);
   uint64_t get_first_data_meta() const;  // 0 if none
+  // Visit each backing ref in order: fn(ctx, data, len, meta); meta is the
+  // user-data tag (0 for ordinary blocks). Transport glue: lets the tpu://
+  // send path recognize pool-owned device blocks and ship them by reference
+  // instead of copying (reference socket.cpp:1754-1766 CutFromIOBufList).
+  void for_each_ref(void (*fn)(void* ctx, const void* data, size_t len,
+                               uint64_t meta),
+                    void* ctx) const;
 
   // ---- cutting (zero-copy removal from the front) ----
   size_t cutn(IOBuf* out, size_t n);

@@ -205,7 +205,7 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
 // under its locked correlation id.
 void TstdHandleResponse(TstdInputMessage* msg);
 
-static void tstd_process_response(InputMessageBase* base) {
+void tstd_process_response(InputMessageBase* base) {
   auto* msg = static_cast<TstdInputMessage*>(base);
   if (msg->meta.msg_type >= 2) {  // stream frame, either side
     stream_internal::OnStreamFrame(msg);
@@ -240,7 +240,7 @@ static void tstd_send_response(SocketId sid, uint64_t correlation_id,
   s->Write(&out);
 }
 
-static void tstd_process_request(InputMessageBase* base) {
+void tstd_process_request(InputMessageBase* base) {
   auto* msg = static_cast<TstdInputMessage*>(base);
   if (msg->meta.msg_type >= 2) {  // stream frame, either side
     stream_internal::OnStreamFrame(msg);

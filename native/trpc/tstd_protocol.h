@@ -57,6 +57,10 @@ void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
 // Parses one complete frame from `source` into meta+payload+attachment.
 // Does not consume unless a whole frame is present.
 ParseResult tstd_parse(tbutil::IOBuf* source, Socket* socket);
+// Dispatch entry points, exported so wrapper transports (tpu:// doorbells
+// carrying whole tstd frames) can reuse the exact same processing.
+void tstd_process_request(InputMessageBase* msg);
+void tstd_process_response(InputMessageBase* msg);
 
 struct TstdInputMessage : InputMessageBase {
   TstdMeta meta;

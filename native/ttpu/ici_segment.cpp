@@ -1,0 +1,214 @@
+#include "ttpu/ici_segment.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <map>
+
+#include "tbutil/logging.h"
+#include "ttpu/ici_endpoint.h"
+
+namespace ttpu {
+
+namespace {
+constexpr uint8_t kHeld = 1;
+constexpr uint8_t kInflight = 2;
+
+std::string next_segment_name() {
+  static std::atomic<uint64_t> counter{0};
+  return "/brpctpu_" + std::to_string(getpid()) + "_" +
+         std::to_string(counter.fetch_add(1));
+}
+}  // namespace
+
+std::shared_ptr<IciSegment> IciSegment::CreateOwner(uint32_t block_size,
+                                                    uint32_t n_blocks) {
+  auto seg = std::shared_ptr<IciSegment>(new IciSegment);
+  seg->_name = next_segment_name();
+  seg->_block_size = block_size;
+  seg->_n_blocks = n_blocks;
+  seg->_owner = true;
+  const size_t total = size_t(block_size) * n_blocks;
+  int fd = shm_open(seg->_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    TB_LOG(ERROR) << "shm_open " << seg->_name
+                  << " failed: " << strerror(errno);
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(seg->_name.c_str());
+    return nullptr;
+  }
+  seg->_base = static_cast<char*>(
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0));
+  close(fd);
+  if (seg->_base == MAP_FAILED) {
+    seg->_base = nullptr;
+    shm_unlink(seg->_name.c_str());
+    return nullptr;
+  }
+  seg->_state.assign(n_blocks, 0);
+  seg->_free_list.reserve(n_blocks);
+  for (uint32_t i = n_blocks; i > 0; --i) seg->_free_list.push_back(i - 1);
+  return seg;
+}
+
+std::shared_ptr<IciSegment> IciSegment::MapPeer(const std::string& name,
+                                                uint32_t block_size,
+                                                uint32_t n_blocks) {
+  if (block_size == 0 || n_blocks == 0 ||
+      size_t(block_size) * n_blocks > (1ULL << 34)) {
+    return nullptr;  // refuse absurd handshake values
+  }
+  auto seg = std::shared_ptr<IciSegment>(new IciSegment);
+  seg->_name = name;
+  seg->_block_size = block_size;
+  seg->_n_blocks = n_blocks;
+  seg->_owner = false;
+  const size_t total = size_t(block_size) * n_blocks;
+  int fd = shm_open(name.c_str(), O_RDWR, 0600);
+  if (fd < 0) {
+    TB_LOG(ERROR) << "shm_open peer " << name
+                  << " failed: " << strerror(errno);
+    return nullptr;
+  }
+  seg->_base = static_cast<char*>(
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0));
+  close(fd);
+  if (seg->_base == MAP_FAILED) {
+    seg->_base = nullptr;
+    return nullptr;
+  }
+  return seg;
+}
+
+IciSegment::~IciSegment() {
+  if (_base != nullptr) {
+    munmap(_base, size_t(_block_size) * _n_blocks);
+  }
+  if (_owner) {
+    shm_unlink(_name.c_str());
+  }
+}
+
+int IciSegment::Alloc() {
+  std::lock_guard<std::mutex> lk(_mu);
+  if (_free_list.empty()) return -1;
+  uint32_t idx = _free_list.back();
+  _free_list.pop_back();
+  _state[idx] = kHeld;
+  return static_cast<int>(idx);
+}
+
+void IciSegment::Release(uint32_t idx) {
+  std::lock_guard<std::mutex> lk(_mu);
+  _state[idx] &= ~kHeld;
+  if (_state[idx] == 0) _free_list.push_back(idx);
+}
+
+void IciSegment::MarkInflight(uint32_t idx) {
+  std::lock_guard<std::mutex> lk(_mu);
+  _state[idx] |= kInflight;
+}
+
+void IciSegment::OnCreditReturned(uint32_t idx) {
+  std::lock_guard<std::mutex> lk(_mu);
+  if (idx >= _n_blocks || (_state[idx] & kInflight) == 0) return;
+  _state[idx] &= ~kInflight;
+  if (_state[idx] == 0) _free_list.push_back(idx);
+}
+
+uint32_t IciSegment::free_blocks() const {
+  std::lock_guard<std::mutex> lk(_mu);
+  return static_cast<uint32_t>(_free_list.size());
+}
+
+// ---------------- peer registry ----------------
+
+namespace {
+
+struct RegEntry {
+  std::shared_ptr<IciSegment> seg;
+  uint64_t socket_id = 0;
+  int64_t outstanding = 0;  // materialized blocks still held by IOBufs
+  bool endpoint_gone = false;
+};
+
+struct Registry {
+  std::mutex mu;
+  // base address -> entry; lookup by containing range.
+  std::map<const char*, RegEntry> map;
+};
+Registry& registry() {
+  static Registry* r = new Registry;
+  return *r;
+}
+
+// Find the entry whose [base, end) contains ptr. Caller holds mu.
+std::map<const char*, RegEntry>::iterator find_containing(Registry& r,
+                                                          const void* ptr) {
+  auto it = r.map.upper_bound(static_cast<const char*>(ptr));
+  if (it == r.map.begin()) return r.map.end();
+  --it;
+  if (!it->second.seg->contains(ptr)) return r.map.end();
+  return it;
+}
+
+}  // namespace
+
+void PeerSegmentRegistry::Register(std::shared_ptr<IciSegment> seg,
+                                   uint64_t socket_id) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  RegEntry e;
+  const char* base = seg->base();
+  e.seg = std::move(seg);
+  e.socket_id = socket_id;
+  r.map[base] = std::move(e);
+}
+
+void PeerSegmentRegistry::OnMaterialize(const IciSegment* seg) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.map.find(seg->base());
+  if (it != r.map.end()) ++it->second.outstanding;
+}
+
+void PeerSegmentRegistry::OnRelease(void* ptr) {
+  uint64_t socket_id = 0;
+  uint32_t idx = 0;
+  {
+    Registry& r = registry();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = find_containing(r, ptr);
+    if (it == r.map.end()) return;
+    RegEntry& e = it->second;
+    socket_id = e.socket_id;
+    idx = e.seg->index_of(ptr);
+    if (--e.outstanding == 0 && e.endpoint_gone) {
+      r.map.erase(it);  // drops the last shared_ptr: unmap
+      socket_id = 0;    // peer is gone too; no credit to send
+    }
+  }
+  if (socket_id != 0) {
+    ici_internal::SendCreditFrame(socket_id, idx);
+  }
+}
+
+void PeerSegmentRegistry::OnEndpointGone(const IciSegment* seg) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.map.find(seg->base());
+  if (it == r.map.end()) return;
+  if (it->second.outstanding == 0) {
+    r.map.erase(it);
+  } else {
+    it->second.endpoint_gone = true;
+  }
+}
+
+}  // namespace ttpu

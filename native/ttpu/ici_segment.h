@@ -1,0 +1,93 @@
+// IciSegment: the registered-memory region of the tpu:// transport — a
+// page-aligned block array that payloads live in while crossing the
+// interconnect, plus the process-wide registry that routes block releases
+// back to credits.
+//
+// TPU mapping: on a real pod this region is the pinned-host staging area a
+// libtpu DMA reads from / lands into (jax ingests it zero-copy via dlpack —
+// see brpc_tpu/transport/ici.py); the FAKE-ICI CI backend (SURVEY §7 stage
+// 8) instead backs it with POSIX shared memory mapped by both endpoints, so
+// the peer's "DMA engine" is a memcpy into the same physical pages and the
+// whole path runs clusterless.
+//
+// Capability parity: reference src/brpc/rdma/block_pool.h:88-96 (registered
+// block allocator feeding IOBuf user-data blocks), rdma_helper.h:48
+// (RegisterMemoryForRdma).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace ttpu {
+
+class IciSegment {
+ public:
+  // Owner side: create + map a fresh shared segment (the local TX pool).
+  static std::shared_ptr<IciSegment> CreateOwner(uint32_t block_size,
+                                                 uint32_t n_blocks);
+  // Peer side: map an existing segment by handshake-announced name.
+  static std::shared_ptr<IciSegment> MapPeer(const std::string& name,
+                                             uint32_t block_size,
+                                             uint32_t n_blocks);
+  ~IciSegment();
+
+  const std::string& name() const { return _name; }
+  uint32_t block_size() const { return _block_size; }
+  uint32_t n_blocks() const { return _n_blocks; }
+  char* block(uint32_t idx) const { return _base + size_t(idx) * _block_size; }
+  char* base() const { return _base; }
+  bool contains(const void* p) const {
+    return p >= _base && p < _base + size_t(_block_size) * _n_blocks;
+  }
+  uint32_t index_of(const void* p) const {
+    return static_cast<uint32_t>((static_cast<const char*>(p) - _base) /
+                                 _block_size);
+  }
+
+  // ---- owner-side allocator (sender's TX blocks) ----
+  // Block lifecycle bits: HELD (allocated, not yet released by its local
+  // owner) and INFLIGHT (referenced by the peer until a credit returns).
+  // A block re-enters the free list only when BOTH clear — the sender must
+  // not recycle memory the receiver's handler may still be reading
+  // (reference rdma_endpoint.h:256-261 window bookkeeping).
+  int Alloc();                      // block index, or -1 when exhausted
+  void Release(uint32_t idx);       // local owner drops its hold
+  void MarkInflight(uint32_t idx);  // sent to the peer
+  void OnCreditReturned(uint32_t idx);
+  uint32_t free_blocks() const;
+
+ private:
+  IciSegment() = default;
+  std::string _name;
+  char* _base = nullptr;
+  uint32_t _block_size = 0;
+  uint32_t _n_blocks = 0;
+  bool _owner = false;
+
+  mutable std::mutex _mu;
+  std::vector<uint8_t> _state;       // HELD|INFLIGHT bits
+  std::vector<uint32_t> _free_list;  // owner side only
+};
+
+// Process-wide registry of PEER segments we materialized zero-copy blocks
+// from. The IOBuf user-data deleter is a plain function pointer, so the
+// release path finds its segment by address range here and turns the drop
+// into a CREDIT frame to the sender (completion -> credit, the fake-ICI
+// analog of RDMA's CQE path). Entries unmap once the endpoint is gone AND
+// no materialized block is still referenced by a live IOBuf.
+class PeerSegmentRegistry {
+ public:
+  static void Register(std::shared_ptr<IciSegment> seg, uint64_t socket_id);
+  // A zero-copy block was handed to an IOBuf.
+  static void OnMaterialize(const IciSegment* seg);
+  // The IOBuf released `ptr` — send the credit. THE user-data deleter.
+  static void OnRelease(void* ptr);
+  // The endpoint died; unmap when outstanding refs hit zero.
+  static void OnEndpointGone(const IciSegment* seg);
+};
+
+}  // namespace ttpu
