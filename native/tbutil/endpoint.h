@@ -1,8 +1,8 @@
 // EndPoint: ip:port value type with parsing and hostname resolution.
 // Capability parity: reference src/butil/endpoint.h:33-80 (ip_t/port pair,
-// str2endpoint, hostname2endpoint, endpoint2str). Extended with the tpu://
-// scheme used by the TPU transport (tpu://<mesh-coord> endpoints carry a
-// device ordinal instead of an IPv4 address — see trpc/tpu_transport.h).
+// str2endpoint, hostname2endpoint, endpoint2str). The tpu:// scheme maps to
+// an ordinary ip:port control endpoint whose connection upgrades to the ICI
+// transport via the HELLO/ACK handshake (ttpu/ici_endpoint.h).
 #pragma once
 
 #include <netinet/in.h>

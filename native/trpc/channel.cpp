@@ -1,5 +1,7 @@
 #include "trpc/channel.h"
 
+#include <cstring>
+
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/errno.h"
@@ -16,13 +18,21 @@ int Channel::Init(const tbutil::EndPoint& server,
 }
 
 int Channel::Init(const char* server_addr, const ChannelOptions* options) {
+  // "tpu://host:port" = same control endpoint, ICI transport upgrade.
+  bool tpu = false;
+  if (strncmp(server_addr, "tpu://", 6) == 0) {
+    server_addr += 6;
+    tpu = true;
+  }
   tbutil::EndPoint pt;
   if (tbutil::str2endpoint(server_addr, &pt) != 0 &&
       tbutil::hostname2endpoint(server_addr, &pt) != 0) {
     TB_LOG(ERROR) << "bad server address: " << server_addr;
     return -1;
   }
-  return Init(pt, options);
+  int rc = Init(pt, options);
+  if (rc == 0 && tpu) _options.tpu_transport = true;
+  return rc;
 }
 
 int Channel::Init(std::shared_ptr<LoadBalancer> lb,
@@ -67,6 +77,7 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   if (cntl->_timeout_ms == -1) cntl->_timeout_ms = _options.timeout_ms;
   if (cntl->_max_retry == -1) cntl->_max_retry = _options.max_retry;
   cntl->_protocol = _options.protocol;
+  cntl->_tpu_transport = _options.tpu_transport;
   cntl->_service_method = service_method;
   cntl->_remote_side = _server;
   cntl->_lb = _lb;

@@ -21,6 +21,9 @@ struct ChannelOptions {
   int64_t timeout_ms = 1000;    // -1 = no deadline
   int max_retry = 3;
   int protocol = 0;             // kTstdProtocolIndex
+  // Upgrade connections to the tpu:// ICI transport (ttpu/ici_endpoint.h).
+  // Set automatically when Init is given a "tpu://host:port" address.
+  bool tpu_transport = false;
 };
 
 class Channel {

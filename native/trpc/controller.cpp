@@ -34,6 +34,7 @@ void Controller::Reset() {
   _error_code = 0;
   _error_text.clear();
   _server_side = false;
+  _tpu_transport = false;
   _lb.reset();
   _tried.clear();
   _request_code = 0;
@@ -105,7 +106,8 @@ void Controller::IssueRPC() {
             "failed to connect to " + tbutil::endpoint2str(_remote_side);
         sock->SetFailed(err);
       }
-    } else if (SocketMap::global().GetOrCreate(_remote_side, &sock) != 0) {
+    } else if (SocketMap::global().GetOrCreate(_remote_side, &sock,
+                                               _tpu_transport) != 0) {
       err = TRPC_ECONNECT;
       err_text = "failed to create socket";
     } else if (sock->ConnectIfNot(_deadline_us) != 0) {

@@ -90,6 +90,7 @@ class Controller {
   int64_t _timeout_ms = -1;
   int _max_retry = -1;
   int _protocol = 0;
+  bool _tpu_transport = false;
 
   // call state
   std::string _service_method;

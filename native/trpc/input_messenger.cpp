@@ -109,9 +109,14 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
       ParseResult r = CutInputMessage(s, &proto_index);
       if (r.error == PARSE_ERROR_NOT_ENOUGH_DATA) break;
       if (r.error != PARSE_OK) {
+        char dbg[17] = {0};
+        s->read_buf().copy_to(dbg, 16);
+        for (int i = 0; i < 16; ++i) if (dbg[i] && !isprint((unsigned char)dbg[i])) dbg[i] = '.';
         TB_LOG(WARNING) << "unparsable bytes from "
                         << tbutil::endpoint2str(s->remote_side())
-                        << ", closing";
+                        << ", closing; err=" << (int)r.error
+                        << " size=" << s->read_buf().size()
+                        << " head=" << dbg;
         *defer_error = TRPC_EREQUEST;
         return pending;
       }

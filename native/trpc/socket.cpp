@@ -19,6 +19,7 @@
 #include "trpc/event_dispatcher.h"
 #include "trpc/flags.h"
 #include "trpc/input_messenger.h"
+#include "ttpu/ici_endpoint.h"
 
 namespace trpc {
 
@@ -82,7 +83,9 @@ int Socket::Create(const Options& opt, SocketId* id) {
   s->_remote_side = opt.remote_side;
   s->_messenger = opt.messenger;
   s->_server_side = opt.server_side;
+  s->_tpu_requested = opt.tpu_transport;
   s->_user = opt.user;
+  s->_ici.store(nullptr, std::memory_order_relaxed);
   s->_error_code = 0;
   s->_preferred_protocol = -1;
   s->_nevent.store(0, std::memory_order_relaxed);
@@ -141,6 +144,10 @@ void Socket::OnFailed(int error) {
   _error_code = error;
   // Wake connect/KeepWrite parkers: they re-check Failed() and bail.
   tbthread::butex_increment_and_wake_all(_epollout_butex);
+  ttpu::IciEndpoint* ici = _ici.load(std::memory_order_acquire);
+  if (ici != nullptr) {
+    ici->OnSocketFailed();  // wake handshake/credit parkers
+  }
   // Propagate to every in-flight RPC and stream on this connection.
   std::vector<tbthread::fiber_id_t> ids;
   std::vector<uint64_t> streams;
@@ -164,6 +171,9 @@ void Socket::OnRecycle() {
     EventDispatcher::global().RemoveConsumer(fd);
     close(fd);
   }
+  // Last ref: no input fiber or writer can be touching the endpoint.
+  delete _ici.exchange(nullptr, std::memory_order_acq_rel);
+  _tpu_requested = false;
   _read_buf.clear();
   _messenger = nullptr;
   _user = nullptr;
@@ -294,7 +304,14 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
         return;
       }
       if (rc == 0) {
-        WaitEpollOut(0);
+        // Two park reasons: TCP backpressure (epollout) or an exhausted
+        // tpu:// credit window (the peer still holds our TX blocks).
+        ttpu::IciEndpoint* ici = _ici.load(std::memory_order_acquire);
+        if (ici != nullptr && ici->credit_starved()) {
+          ici->WaitCredit();
+        } else {
+          WaitEpollOut(0);
+        }
         continue;
       }
       WriteRequest* written = todo;
@@ -338,6 +355,19 @@ int Socket::WriteOnce(WriteRequest* req) {
   if (fd < 0) {
     errno = ENOTCONN;
     return -1;
+  }
+  ttpu::IciEndpoint* ici = _ici.load(std::memory_order_acquire);
+  if (ici != nullptr && ici->active()) {
+    // tpu:// path: payload bytes move into TX segment blocks (the fake-ICI
+    // "DMA"), doorbells/credits ride the TCP fd. The reference's zero-copy
+    // send branch (socket.cpp:1754-1766) plays the same role.
+    const size_t before = req->data.size();
+    const int rc = ici->WriteMessage(&req->data, fd);
+    _write_queue_bytes.fetch_sub(
+        static_cast<int64_t>(before - req->data.size()),
+        std::memory_order_relaxed);
+    if (rc < 0 && errno == 0) errno = TRPC_EFAILEDSOCKET;
+    return rc;
   }
   while (!req->data.empty()) {
     ssize_t nw = req->data.cut_into_file_descriptor(fd);
@@ -486,6 +516,17 @@ int Socket::ConnectIfNot(int64_t deadline_us) {
     // CONSUMED the pending error (readv on a refused connect clears it), so
     // also trust the poll revents.
     if (err != 0 || (pfd.revents & (POLLERR | POLLHUP)) != 0) {
+      SetFailed(TRPC_ECONNECT);
+      errno = TRPC_ECONNECT;
+      return -1;
+    }
+  }
+  // tpu:// upgrade (the reference's app_connect seam): send HELLO, park
+  // until the ACK arrives on the input fiber. _connecting stays true so no
+  // caller takes the fast path until the transport is ready.
+  if (_tpu_requested && _ici.load(std::memory_order_acquire) == nullptr) {
+    ttpu::IciEndpoint* ep = ttpu::IciEndpoint::StartClient(this);
+    if (ep == nullptr || ep->WaitActive(deadline_us) != 0) {
       SetFailed(TRPC_ECONNECT);
       errno = TRPC_ECONNECT;
       return -1;

@@ -26,6 +26,10 @@
 #include "tbutil/iobuf.h"
 #include "trpc/versioned_ref.h"
 
+namespace ttpu {
+class IciEndpoint;
+}  // namespace ttpu
+
 namespace trpc {
 
 class Socket;
@@ -52,6 +56,11 @@ class Socket : public VersionedRefWithId<Socket> {
     InputMessenger* messenger = nullptr;
     bool server_side = false;
     void* user = nullptr;  // Server* on accepted sockets
+    // Client side: upgrade to the tpu:// ICI transport after the TCP
+    // connect (HELLO/ACK handshake inside ConnectIfNot — the reference's
+    // app_connect seam, socket.h RdmaConnect). Servers need no flag: a
+    // HELLO arriving on any connection upgrades it.
+    bool tpu_transport = false;
   };
 
   // -- lifecycle (versioned_ref.h) --
@@ -99,6 +108,17 @@ class Socket : public VersionedRefWithId<Socket> {
   void AddPendingStream(uint64_t stream_id);
   void RemovePendingStream(uint64_t stream_id);
 
+  // -- tpu:// transport (ttpu/ici_endpoint.h) --
+  // The endpoint is owned by the socket: installed during the handshake,
+  // deleted on recycle. While non-null and active, WriteOnce routes
+  // payloads through TX segment blocks instead of the TCP fd.
+  ttpu::IciEndpoint* ici_endpoint() const {
+    return _ici.load(std::memory_order_acquire);
+  }
+  void set_ici_endpoint(ttpu::IciEndpoint* ep) {
+    _ici.store(ep, std::memory_order_release);
+  }
+
   // Parse-pipeline cache: index of the protocol that parsed the last
   // message on this connection (input_messenger.cpp fast path).
   int preferred_protocol() const { return _preferred_protocol; }
@@ -141,6 +161,8 @@ class Socket : public VersionedRefWithId<Socket> {
   std::atomic<int> _fd{-1};
   tbutil::EndPoint _remote_side;
   InputMessenger* _messenger = nullptr;
+  std::atomic<ttpu::IciEndpoint*> _ici{nullptr};
+  bool _tpu_requested = false;
   bool _server_side = false;
   void* _user = nullptr;
   int _error_code = 0;

@@ -4,10 +4,12 @@
 
 namespace trpc {
 
-int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out) {
+int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
+                           bool tpu) {
+  const Key key{pt, tpu};
   {
     std::lock_guard<std::mutex> lk(_mu);
-    auto it = _map.find(pt);
+    auto it = _map.find(key);
     if (it != _map.end() && Socket::Address(it->second, out) == 0) {
       return 0;
     }
@@ -18,25 +20,29 @@ int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out) {
   opt.remote_side = pt;
   opt.messenger = InputMessenger::client_messenger();
   opt.server_side = false;
+  opt.tpu_transport = tpu;
   SocketId sid;
   if (Socket::Create(opt, &sid) != 0) return -1;
   std::lock_guard<std::mutex> lk(_mu);
-  auto it = _map.find(pt);
+  auto it = _map.find(key);
   if (it != _map.end() && Socket::Address(it->second, out) == 0) {
     // Lost the race: keep the winner, discard ours.
     SocketUniquePtr mine;
     if (Socket::Address(sid, &mine) == 0) mine->SetFailed(ECANCELED);
     return 0;
   }
-  _map[pt] = sid;
+  _map[key] = sid;
   return Socket::Address(sid, out);
 }
 
 void SocketMap::Remove(const tbutil::EndPoint& pt, SocketId expected) {
   std::lock_guard<std::mutex> lk(_mu);
-  auto it = _map.find(pt);
-  if (it != _map.end() && it->second == expected) {
-    _map.erase(it);
+  for (bool tpu : {false, true}) {
+    auto it = _map.find(Key{pt, tpu});
+    if (it != _map.end() && it->second == expected) {
+      _map.erase(it);
+      return;
+    }
   }
 }
 

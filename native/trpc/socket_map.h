@@ -17,8 +17,11 @@ class SocketMap {
  public:
   // Get (or lazily create) the shared socket to `pt`. The returned socket
   // may be unconnected; callers run ConnectIfNot before writing. A cached
-  // socket that has died is replaced with a fresh one.
-  int GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out);
+  // socket that has died is replaced with a fresh one. `tpu` selects the
+  // tpu:// ICI transport — tpu and plain connections to one endpoint are
+  // distinct cache entries (a process may use both, e.g. A/B benches).
+  int GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
+                  bool tpu = false);
 
   // Drop the cache entry (e.g. after SetFailed, to force a fresh connect).
   void Remove(const tbutil::EndPoint& pt, SocketId expected);
@@ -26,8 +29,20 @@ class SocketMap {
   static SocketMap& global();
 
  private:
+  struct Key {
+    tbutil::EndPoint pt;
+    bool tpu;
+    bool operator==(const Key& o) const {
+      return pt == o.pt && tpu == o.tpu;
+    }
+  };
+  struct KeyHasher {
+    size_t operator()(const Key& k) const {
+      return tbutil::EndPointHasher()(k.pt) * 2 + (k.tpu ? 1 : 0);
+    }
+  };
   std::mutex _mu;
-  std::unordered_map<tbutil::EndPoint, SocketId, tbutil::EndPointHasher> _map;
+  std::unordered_map<Key, SocketId, KeyHasher> _map;
 };
 
 }  // namespace trpc

@@ -15,6 +15,7 @@
 #include "trpc/server.h"
 #include "trpc/socket.h"
 #include "trpc/stream_internal.h"
+#include "ttpu/ici_endpoint.h"
 
 namespace trpc {
 
@@ -334,6 +335,7 @@ void GlobalInitializeOrDie() {
     TB_CHECK(RegisterProtocol(kTstdProtocolIndex, p) == 0)
         << "tstd protocol slot taken";
     RegisterHttpProtocol();  // same-port multi-protocol serving
+    ttpu::ici_internal::RegisterTiciProtocol();  // tpu:// control frames
     RegisterBuiltinConsole();
   });
 }

@@ -14,9 +14,14 @@
 //   - releases of those blocks return CREDIT frames; the sender's blocks
 //     re-enter its pool only then (credit window = pool capacity), writers
 //     park on a credit butex meanwhile
-//   - messages that don't fit the window fall back to plain TCP bytes on
-//     the same connection — the multi-protocol parse registry makes this
-//     transparent
+//   - small messages ride the control channel as plain TCP bytes on the
+//     same connection — the multi-protocol parse registry makes this
+//     transparent (they parse as ordinary tstd)
+//   - a message larger than one doorbell batch is delivered across several
+//     batches; the receiver COMPACTS partial-message bytes into heap memory
+//     so credits return immediately (otherwise a message bigger than the
+//     window would hold its own head hostage: blocks only free when the
+//     full message parses, but the tail can't arrive without free blocks)
 //
 // Capability parity: reference rdma/rdma_endpoint.h:44-59 (AppConnect
 // handshake over TCP), :195 (BringUpQp = our HELLO/ACK segment exchange),
@@ -26,6 +31,7 @@
 #include <atomic>
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <string>
 
 #include "tbthread/butex.h"
@@ -39,55 +45,67 @@ struct ParseResult;
 
 namespace ttpu {
 
-inline constexpr uint32_t kDefaultBlockSize = 64 * 1024;
-inline constexpr uint32_t kDefaultBlocks = 64;  // 4 MB window / direction
-
 class IciEndpoint {
  public:
   enum class State { kClientPending, kActive };
 
-  // CLIENT: create the TX segment + queue the HELLO frame; caller then
-  // parks in WaitActive until the ACK (parsed on the input fiber) arrives.
+  // CLIENT: create the TX segment, install on the socket, queue the HELLO
+  // frame; caller then parks in WaitActive until the ACK (parsed on the
+  // input fiber) arrives. Returns null if the segment can't be created.
   static IciEndpoint* StartClient(trpc::Socket* s);
   int WaitActive(int64_t deadline_us);
 
   // SERVER: HELLO arrived — map the client's segment, create our TX
-  // segment, queue the ACK. Returns null on mapping failure.
+  // segment, install on the socket, queue the ACK. Null on failure.
   static IciEndpoint* StartServer(trpc::Socket* s,
                                   const std::string& peer_name,
                                   uint32_t peer_block_size,
                                   uint32_t peer_blocks);
-  // CLIENT: ACK arrived on the input fiber.
+  // CLIENT: ACK arrived on the input fiber. 0 on success.
   int CompleteClient(const std::string& peer_name, uint32_t peer_block_size,
                      uint32_t peer_blocks);
 
   ~IciEndpoint();
 
-  bool active() const { return _state.load(std::memory_order_acquire) ==
-                               State::kActive; }
+  bool active() const {
+    return _state.load(std::memory_order_acquire) == State::kActive;
+  }
 
   // ---- sender half (called by Socket::WriteOnce, single active writer) --
-  // Move *msg into TX blocks + pending doorbell, then flush control bytes
-  // to fd. Returns 1 = fully handed off, 0 = out of credit or TCP
-  // backpressure (caller parks; see credit_starved), -1 = hard error.
+  // Move *msg into TX blocks + a DATA doorbell (small messages: raw control
+  // bytes), then flush control bytes to fd. Returns 1 = fully handed off,
+  // 0 = out of credit or TCP backpressure (caller parks; see
+  // credit_starved), -1 = hard error. Consumed bytes are removed from *msg.
   int WriteMessage(tbutil::IOBuf* msg, int fd);
-  // Park until a credit arrives (or 50ms safety timeout).
+  // Park until a credit arrives (bounded safety timeout; caller re-checks).
   void WaitCredit();
   bool credit_starved() const {
     return _credit_starved.load(std::memory_order_acquire);
   }
 
   // ---- receiver half (called from the tici parse on the input fiber) ----
-  // Build the zero-copy IOBuf for a DATA doorbell's refs. 0 on success.
-  int MaterializeData(const uint8_t* refs, uint32_t n_refs,
-                      tbutil::IOBuf* out);
+  // Build zero-copy IOBuf refs for a DATA doorbell into the rx accumulator.
+  // 0 on success, -1 on malformed refs.
+  int MaterializeData(const uint8_t* refs, uint32_t n_refs);
   void OnCreditFrame(uint32_t block_idx);
+  // Queue a CREDIT frame for the peer. Thread-safe (called from whatever
+  // fiber drops the last zero-copy ref). Credits must BYPASS the data
+  // write queue: a writer parked for ITS credits would otherwise block the
+  // very frames that un-park the peer — a cross-connection deadlock cycle.
+  void QueueCredit(uint32_t block_idx);
+  // Next complete inner message accumulated from doorbells, if any.
+  // Implements the zero-copy fast path + partial-message compaction.
+  trpc::ParseResult ParseInner(trpc::Socket* s);
+
+  // Socket failure: wake handshake/credit parkers so they observe Failed().
+  void OnSocketFailed();
 
   IciSegment* tx() const { return _tx.get(); }
   IciSegment* rx() const { return _rx.get(); }
 
  private:
   explicit IciEndpoint(trpc::Socket* s);
+  void CompactRxNew();
 
   trpc::Socket* _socket;  // back-pointer; endpoint is owned by the socket
   uint64_t _socket_id = 0;
@@ -97,7 +115,20 @@ class IciEndpoint {
   tbthread::Butex* _hs_btx;      // client handshake completion
   tbthread::Butex* _credit_btx;  // writers parked for credit
   std::atomic<bool> _credit_starved{false};
-  tbutil::IOBuf _pending_ctrl;   // partially-flushed control bytes
+  tbutil::IOBuf _pending_ctrl;  // partially-flushed control bytes (writer)
+  // Out-of-band control frames (credits) from arbitrary fibers; drained
+  // into _pending_ctrl by the active writer ahead of data.
+  std::mutex _outbox_mu;
+  tbutil::IOBuf _outbox;
+  std::atomic<bool> _outbox_nonempty{false};
+  // Single-writer state: true while a block-path message is partially sent
+  // (its remaining tail must keep using blocks, never the inline path).
+  bool _tx_mid_message = false;
+  // Receiver accumulators (input fiber only). _rx_new holds the newest
+  // doorbell's zero-copy refs; _rx_done holds heap-compacted bytes of a
+  // message that spans doorbells (each byte copied at most once).
+  tbutil::IOBuf _rx_new;
+  tbutil::IOBuf _rx_done;
 };
 
 // ---- wire frames (control channel) ----

@@ -2,6 +2,7 @@
 
 #include <fcntl.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -64,6 +65,13 @@ std::shared_ptr<IciSegment> IciSegment::MapPeer(const std::string& name,
       size_t(block_size) * n_blocks > (1ULL << 34)) {
     return nullptr;  // refuse absurd handshake values
   }
+  // The name is fully peer-controlled: constrain it to the framework's own
+  // namespace so a handshake can't map an unrelated shm object.
+  if (name.rfind("/brpctpu_", 0) != 0 ||
+      name.find('/', 1) != std::string::npos) {
+    TB_LOG(ERROR) << "rejecting peer segment name " << name;
+    return nullptr;
+  }
   auto seg = std::shared_ptr<IciSegment>(new IciSegment);
   seg->_name = name;
   seg->_block_size = block_size;
@@ -74,6 +82,17 @@ std::shared_ptr<IciSegment> IciSegment::MapPeer(const std::string& name,
   if (fd < 0) {
     TB_LOG(ERROR) << "shm_open peer " << name
                   << " failed: " << strerror(errno);
+    return nullptr;
+  }
+  // A peer that lies about the size in HELLO would make us map short and
+  // SIGBUS on first access past the real size: trust the object, not the
+  // handshake.
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(total)) {
+    TB_LOG(ERROR) << "peer segment " << name << " is smaller ("
+                  << (long long)st.st_size << ") than announced (" << total
+                  << ")";
+    close(fd);
     return nullptr;
   }
   seg->_base = static_cast<char*>(
@@ -102,6 +121,16 @@ int IciSegment::Alloc() {
   _free_list.pop_back();
   _state[idx] = kHeld;
   return static_cast<int>(idx);
+}
+
+void IciSegment::AllocBatch(uint32_t max, std::vector<uint32_t>* out) {
+  std::lock_guard<std::mutex> lk(_mu);
+  while (max-- > 0 && !_free_list.empty()) {
+    uint32_t idx = _free_list.back();
+    _free_list.pop_back();
+    _state[idx] = kHeld;
+    out->push_back(idx);
+  }
 }
 
 void IciSegment::Release(uint32_t idx) {

@@ -55,6 +55,9 @@ class IciSegment {
   // not recycle memory the receiver's handler may still be reading
   // (reference rdma_endpoint.h:256-261 window bookkeeping).
   int Alloc();                      // block index, or -1 when exhausted
+  // Pop up to `max` free blocks in one lock acquisition (the bulk-send
+  // path: a 1MB message needs 16 blocks, not 16 lock round-trips).
+  void AllocBatch(uint32_t max, std::vector<uint32_t>* out);
   void Release(uint32_t idx);       // local owner drops its hold
   void MarkInflight(uint32_t idx);  // sent to the peer
   void OnCreditReturned(uint32_t idx);
