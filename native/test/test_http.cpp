@@ -263,4 +263,52 @@ TEST_CASE(http_raw_socket_interop) {
   server.Stop();
 }
 
+TEST_CASE(http_framing_hardening) {
+  // RFC 9112 framing edges: transfer-coding lists, smuggling vectors, and
+  // encoded-slash routing.
+  EchoService svc;
+  Server server;
+  server.AddService(&svc);
+  ASSERT_EQ(server.Start(0), 0);
+  tbutil::EndPoint ep;
+  ASSERT_EQ(tbutil::str2endpoint(
+                ("127.0.0.1:" + std::to_string(server.listen_address().port))
+                    .c_str(),
+                &ep),
+            0);
+
+  // A TE list whose FINAL coding is chunked frames as chunked.
+  std::string resp =
+      raw_http(ep,
+               "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+               "Transfer-Encoding: gzip, chunked\r\nConnection: close\r\n\r\n"
+               "5\r\nhello\r\n0\r\n\r\n");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 200 OK", 0) == 0);
+  ASSERT_TRUE(resp.find("\r\n\r\nhello") != std::string::npos);
+
+  // Unrecognized final coding: cannot be framed — connection must be
+  // rejected, never fall through to Content-Length/EOF framing.
+  resp = raw_http(ep,
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Transfer-Encoding: gzip\r\nConnection: close\r\n\r\nxxxx",
+                  /*read_to_eof=*/true);
+  ASSERT_TRUE(resp.find("200 OK") == std::string::npos);
+
+  // Transfer-Encoding + Content-Length together: smuggling vector, reject.
+  resp = raw_http(ep,
+                  "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Transfer-Encoding: chunked\r\nContent-Length: 5\r\n"
+                  "Connection: close\r\n\r\n5\r\nhello\r\n0\r\n\r\n",
+                  /*read_to_eof=*/true);
+  ASSERT_TRUE(resp.find("200 OK") == std::string::npos);
+
+  // %2F must not create a path-segment boundary: /EchoService%2FEvil is one
+  // segment, not service "EchoService".
+  resp = raw_http(ep,
+                  "POST /EchoService%2FEvil/Echo HTTP/1.1\r\nHost: x\r\n"
+                  "Content-Length: 2\r\nConnection: close\r\n\r\nhi");
+  ASSERT_TRUE(resp.rfind("HTTP/1.1 404", 0) == 0);
+  server.Stop();
+}
+
 TEST_MAIN

@@ -8,6 +8,7 @@
 #include <thread>
 
 #include "tbutil/logging.h"
+#include "trpc/flags.h"
 #include "trpc/socket.h"
 
 namespace trpc {
@@ -91,13 +92,26 @@ void EventDispatcher::Run() {
   }
 }
 
-EventDispatcher& EventDispatcher::global() {
-  static EventDispatcher* d = []() {
-    auto* d = new EventDispatcher;
-    d->Start();
-    return d;
+static auto* g_event_dispatcher_num = TRPC_DEFINE_FLAG(
+    event_dispatcher_num, 2,
+    "number of epoll threads (latched at first socket creation)");
+
+EventDispatcher& EventDispatcher::shard(SocketId sid) {
+  struct Pool {
+    EventDispatcher* d;
+    size_t n;
+  };
+  static Pool pool = []() {
+    int64_t n = g_event_dispatcher_num->load(std::memory_order_relaxed);
+    if (n < 1) n = 1;
+    if (n > 64) n = 64;
+    auto* d = new EventDispatcher[n];
+    for (int64_t i = 0; i < n; ++i) d[i].Start();
+    return Pool{d, static_cast<size_t>(n)};
   }();
-  return *d;
+  // SocketIds are ResourcePool slots in the low 32 bits — consecutive for
+  // consecutive sockets, so modulo spreads them evenly.
+  return pool.d[(sid & 0xffffffffu) % pool.n];
 }
 
 }  // namespace trpc

@@ -30,7 +30,12 @@ class EventDispatcher {
   int AddConsumer(SocketId sid, int fd);
   int RemoveConsumer(int fd);
 
-  static EventDispatcher& global();
+  // The dispatcher owning `sid`. N dispatcher threads (flag
+  // `event_dispatcher_num`, latched at first use — reference
+  // FLAGS_event_dispatcher_num, src/brpc/event_dispatcher.cpp:32) share the
+  // socket population by id hash, so one hot connection cannot starve the
+  // read path of every other connection.
+  static EventDispatcher& shard(SocketId sid);
 
  private:
   void Run();

@@ -26,7 +26,7 @@ constexpr size_t kMaxBodyBytes = 256ULL * 1024 * 1024;
 
 int lower(int c) { return std::tolower(static_cast<unsigned char>(c)); }
 
-bool iequals(const std::string& a, const char* b) {
+bool iequals(std::string_view a, const char* b) {
   size_t n = strlen(b);
   if (a.size() != n) return false;
   for (size_t i = 0; i < n; ++i) {
@@ -35,14 +35,23 @@ bool iequals(const std::string& a, const char* b) {
   return true;
 }
 
-std::string url_decode(std::string_view in) {
+std::string url_decode(std::string_view in, bool keep_encoded_slash = false) {
   std::string out;
   out.reserve(in.size());
   for (size_t i = 0; i < in.size(); ++i) {
     if (in[i] == '%' && i + 2 < in.size() && isxdigit((unsigned char)in[i + 1]) &&
         isxdigit((unsigned char)in[i + 2])) {
-      out.push_back(static_cast<char>(
-          std::stoi(std::string(in.substr(i + 1, 2)), nullptr, 16)));
+      char c = static_cast<char>(
+          std::stoi(std::string(in.substr(i + 1, 2)), nullptr, 16));
+      // Paths decode %2F AFTER routing conceptually — i.e. an encoded slash
+      // must not create a new path-segment boundary (/Svc%2FEvil/M routing
+      // as service "Svc"). Keeping the escape literal matches the
+      // reference's split-then-decode behavior.
+      if (keep_encoded_slash && c == '/') {
+        out.append(in.substr(i, 3));
+      } else {
+        out.push_back(c);
+      }
       i += 2;
     } else if (in[i] == '+') {
       out.push_back(' ');
@@ -230,9 +239,9 @@ ParseResult http_parse(tbutil::IOBuf* source, Socket*) {
     std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
     size_t q = target.find('?');
     if (q == std::string::npos) {
-      msg->path = url_decode(target);
+      msg->path = url_decode(target, /*keep_encoded_slash=*/true);
     } else {
-      msg->path = url_decode(target.substr(0, q));
+      msg->path = url_decode(target.substr(0, q), /*keep_encoded_slash=*/true);
       msg->query = target.substr(q + 1);
     }
   }
@@ -251,7 +260,29 @@ ParseResult http_parse(tbutil::IOBuf* source, Socket*) {
   // ---- body ----
   const size_t header_total = hdr_end + 4;
   auto te = msg->headers.find("Transfer-Encoding");
-  if (te != msg->headers.end() && iequals(te->second, "chunked")) {
+  bool chunked = false;
+  if (te != msg->headers.end()) {
+    // RFC 9112 §6.1: chunked must be the FINAL transfer coding; a message
+    // with an unrecognized final coding cannot be framed and must be
+    // rejected, and Transfer-Encoding + Content-Length together is a
+    // request-smuggling vector — reject that outright.
+    std::string_view v = te->second;
+    size_t comma = v.rfind(',');
+    std::string_view last = comma == std::string_view::npos
+                                ? v
+                                : v.substr(comma + 1);
+    while (!last.empty() && (last.front() == ' ' || last.front() == '\t'))
+      last.remove_prefix(1);
+    while (!last.empty() && (last.back() == ' ' || last.back() == '\t'))
+      last.remove_suffix(1);
+    if (!iequals(last, "chunked") ||
+        msg->headers.find("Content-Length") != msg->headers.end()) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    chunked = true;
+  }
+  if (chunked) {
     // Chunked needs the full frame contiguous: extend the copy if the
     // header copy was truncated. NOTE: until the frame completes, every
     // read edge re-copies and re-walks the buffered bytes (O(n^2) for a
@@ -394,7 +425,12 @@ void send_http_response(SocketId sid, const HttpResponse& resp,
   tbutil::IOBuf out;
   serialize_response(&out, resp, keep_alive, head_request);
   if (!keep_alive) s->MarkCloseAfterLastWrite();
-  s->Write(&out);
+  if (s->Write(&out) != 0 && !keep_alive) {
+    // The close-after-last-write mark only fires when a write drains; if
+    // this write never enters the queue the Connection: close socket would
+    // idle forever. Fail it now.
+    s->SetFailed(TRPC_EFAILEDSOCKET);
+  }
 }
 
 int http_status_for_error(int code) {

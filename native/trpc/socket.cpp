@@ -96,7 +96,7 @@ int Socket::Create(const Options& opt, SocketId* id) {
   if (opt.fd >= 0) {
     make_non_blocking(opt.fd);
     set_no_delay(opt.fd);
-    if (EventDispatcher::global().AddConsumer(vid, opt.fd) != 0) {
+    if (EventDispatcher::shard(vid).AddConsumer(vid, opt.fd) != 0) {
       // On failure the CALLER keeps ownership of opt.fd: detach it before
       // the recycle path (OnRecycle must not close a caller-owned fd).
       s->_fd.store(-1, std::memory_order_release);
@@ -168,7 +168,7 @@ void Socket::OnFailed(int error) {
 void Socket::OnRecycle() {
   int fd = _fd.exchange(-1, std::memory_order_acq_rel);
   if (fd >= 0) {
-    EventDispatcher::global().RemoveConsumer(fd);
+    EventDispatcher::shard(id()).RemoveConsumer(fd);
     close(fd);
   }
   // Last ref: no input fiber or writer can be touching the endpoint.
@@ -485,7 +485,7 @@ int Socket::ConnectIfNot(int64_t deadline_us) {
   // parks on the same epollout butex).
   _connecting.store(true, std::memory_order_release);
   _fd.store(fd, std::memory_order_release);
-  if (EventDispatcher::global().AddConsumer(id(), fd) != 0) {
+  if (EventDispatcher::shard(id()).AddConsumer(id(), fd) != 0) {
     SetFailed(TRPC_ECONNECT);  // OnRecycle closes the fd
     errno = TRPC_ECONNECT;
     return -1;
