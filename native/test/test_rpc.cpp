@@ -13,6 +13,7 @@
 #include "trpc/channel.h"
 #include "trpc/errno.h"
 #include "trpc/server.h"
+#include "trpc/socket_map.h"
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/tstd_protocol.h"
@@ -50,6 +51,18 @@ class EchoService : public Service {
       done->Run();
       return;
     }
+    if (method == "SlowFirst") {
+      // First call stalls (a "slow replica"); subsequent calls answer
+      // immediately — the shape hedged requests are built to beat.
+      if (_slow_first_calls.fetch_add(1) == 0) {
+        tbthread::fiber_usleep(400000);
+        response->append("slow");
+      } else {
+        response->append("fast");
+      }
+      done->Run();
+      return;
+    }
     if (method == "AsyncEcho") {
       // Complete from another fiber: `done` outlives CallMethod.
       std::string body = request.to_string();
@@ -84,6 +97,7 @@ class EchoService : public Service {
 
  private:
   std::atomic<int> _calls{0};
+  std::atomic<int> _slow_first_calls{0};
 };
 
 }  // namespace
@@ -335,6 +349,134 @@ TEST_CASE(metrics_and_flags_wired) {
     channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
     ASSERT_FALSE(cntl.Failed());
   }
+  server.Stop();
+}
+
+// kPooled: concurrent RPCs fan out over multiple exclusive sockets; sequential
+// RPCs reuse one parked socket instead of growing the pool (reference
+// CONNECTION_TYPE_POOLED, socket_map.h:82).
+TEST_CASE(pooled_connections_reuse_and_scale) {
+  Server server;
+  EchoService svc;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ASSERT_EQ(server.Start(0), 0);
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  tbutil::EndPoint pt;
+  ASSERT_EQ(tbutil::str2endpoint(addr, &pt), 0);
+
+  Channel channel;
+  ChannelOptions opts;
+  opts.timeout_ms = 3000;
+  opts.connection_type = ConnectionType::kPooled;
+  ASSERT_EQ(channel.Init(addr, &opts), 0);
+
+  // Sequential calls: one socket, parked and re-borrowed every time.
+  for (int i = 0; i < 5; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("seq");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_EQ(SocketMap::global().PooledIdleCount(pt), size_t{1});
+  }
+
+  // 6 concurrent slow calls overlap, so each needs its own socket; once all
+  // return, every borrowed socket is parked in the free-list.
+  std::vector<std::thread> threads;
+  std::atomic<int> failures{0};
+  for (int i = 0; i < 6; ++i) {
+    threads.emplace_back([&] {
+      Controller cntl;
+      tbutil::IOBuf req, resp;
+      req.append("x");
+      channel.CallMethod("EchoService/Sleep", &cntl, req, &resp, nullptr);
+      if (cntl.Failed()) failures.fetch_add(1);
+    });
+  }
+  for (auto& t : threads) t.join();
+  ASSERT_EQ(failures.load(), 0);
+  const size_t idle = SocketMap::global().PooledIdleCount(pt);
+  ASSERT_TRUE(idle >= 2 && idle <= 6);
+  server.Stop();
+}
+
+// Hedging: with backup_request_ms armed, a stalled first attempt loses to
+// the backup attempt issued alongside it — the RPC completes at hedge
+// latency, not the straggler's (reference channel.cpp:566-575).
+TEST_CASE(backup_request_beats_stalled_server) {
+  Server server;
+  EchoService svc;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ASSERT_EQ(server.Start(0), 0);
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+
+  Channel channel;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 1;
+  opts.backup_request_ms = 50;
+  ASSERT_EQ(channel.Init(addr, &opts), 0);
+
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("x");
+  const int64_t t0 = tbutil::monotonic_time_us();
+  channel.CallMethod("EchoService/SlowFirst", &cntl, req, &resp, nullptr);
+  const int64_t elapsed_us = tbutil::monotonic_time_us() - t0;
+  ASSERT_FALSE(cntl.Failed());
+  // The hedge (second call, fast) answered; the 400ms straggler lost.
+  ASSERT_TRUE(resp.equals("fast"));
+  ASSERT_TRUE(elapsed_us < 300000);
+  server.Stop();
+
+  // Control: without hedging the same shape rides out the full stall.
+  Server server2;
+  EchoService svc2;
+  ASSERT_EQ(server2.AddService(&svc2), 0);
+  ASSERT_EQ(server2.Start(0), 0);
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server2.listen_address().port);
+  Channel plain;
+  ChannelOptions plain_opts;
+  plain_opts.timeout_ms = 2000;
+  ASSERT_EQ(plain.Init(addr, &plain_opts), 0);
+  Controller c2;
+  tbutil::IOBuf req2, resp2;
+  req2.append("x");
+  const int64_t t1 = tbutil::monotonic_time_us();
+  plain.CallMethod("EchoService/SlowFirst", &c2, req2, &resp2, nullptr);
+  ASSERT_FALSE(c2.Failed());
+  ASSERT_TRUE(resp2.equals("slow"));
+  ASSERT_TRUE(tbutil::monotonic_time_us() - t1 >= 390000);
+  server2.Stop();
+}
+
+// kShort over tstd: a fresh connection per RPC, closed on completion —
+// nothing accumulates in the pooled free-list.
+TEST_CASE(short_connection_type) {
+  Server server;
+  EchoService svc;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ASSERT_EQ(server.Start(0), 0);
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  tbutil::EndPoint pt;
+  ASSERT_EQ(tbutil::str2endpoint(addr, &pt), 0);
+
+  Channel channel;
+  ChannelOptions opts;
+  opts.connection_type = ConnectionType::kShort;
+  ASSERT_EQ(channel.Init(addr, &opts), 0);
+  for (int i = 0; i < 3; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("short");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(resp.equals("short"));
+  }
+  ASSERT_EQ(SocketMap::global().PooledIdleCount(pt), size_t{0});
   server.Stop();
 }
 

@@ -78,6 +78,10 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   if (cntl->_max_retry == -1) cntl->_max_retry = _options.max_retry;
   cntl->_protocol = _options.protocol;
   cntl->_tpu_transport = _options.tpu_transport;
+  cntl->_connection_type = static_cast<uint8_t>(_options.connection_type);
+  if (cntl->_backup_request_ms == -1) {
+    cntl->_backup_request_ms = _options.backup_request_ms;
+  }
   cntl->_service_method = service_method;
   cntl->_remote_side = _server;
   cntl->_lb = _lb;
@@ -105,6 +109,15 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
     cntl->_timer_id = tbthread::TimerThread::singleton()->schedule(
         Controller::TimeoutThunk, reinterpret_cast<void*>(cid),
         cntl->_deadline_us);
+  }
+  // Hedging: arm the backup timer when it would fire before the deadline
+  // and a retry attempt exists to spend on the hedge.
+  if (cntl->_backup_request_ms > 0 && cntl->_max_retry > 0 &&
+      (cntl->_timeout_ms <= 0 ||
+       cntl->_backup_request_ms < cntl->_timeout_ms)) {
+    cntl->_backup_timer_id = tbthread::TimerThread::singleton()->schedule(
+        Controller::BackupThunk, reinterpret_cast<void*>(cid),
+        cntl->_begin_time_us + cntl->_backup_request_ms * 1000);
   }
 
   cntl->IssueRPC();

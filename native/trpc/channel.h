@@ -14,6 +14,7 @@
 #include "trpc/controller.h"
 #include "trpc/load_balancer.h"
 #include "trpc/naming_service.h"
+#include "trpc/socket_map.h"
 
 namespace trpc {
 
@@ -21,6 +22,11 @@ struct ChannelOptions {
   int64_t timeout_ms = 1000;    // -1 = no deadline
   int max_retry = 3;
   int protocol = 0;             // kTstdProtocolIndex
+  ConnectionType connection_type = ConnectionType::kSingle;
+  // Hedging: if > 0 and no response arrived within this budget, issue the
+  // next attempt WITHOUT canceling the current one — first response wins
+  // (reference channel.cpp:566-575 backup_request_ms).
+  int64_t backup_request_ms = -1;
   // Upgrade connections to the tpu:// ICI transport (ttpu/ici_endpoint.h).
   // Set automatically when Init is given a "tpu://host:port" address.
   bool tpu_transport = false;

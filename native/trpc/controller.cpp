@@ -3,6 +3,7 @@
 #include "tbthread/fiber.h"
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "trpc/channel.h"
 #include "trpc/errno.h"
 #include "trpc/input_messenger.h"
 #include "trpc/load_balancer.h"
@@ -35,12 +36,16 @@ void Controller::Reset() {
   _error_text.clear();
   _server_side = false;
   _tpu_transport = false;
+  _connection_type = 0;
   _lb.reset();
   _tried.clear();
   _request_code = 0;
   _has_request_code = false;
   _attempt_begin_us = 0;
   _response_received = false;
+  _live.clear();
+  _backup_request_ms = -1;
+  _backup_timer_id = 0;
   _request_stream = 0;
   _response_stream = 0;
   _remote_stream_id = 0;
@@ -86,34 +91,22 @@ void Controller::IssueRPC() {
     SocketUniquePtr sock;
     int err = 0;
     std::string err_text;
-    if (proto->short_connection) {
-      // Dedicated one-RPC connection (reference CONNECTION_TYPE_SHORT):
-      // required by protocols whose wire carries no correlation id (HTTP) —
-      // the socket's single pending id IS the response match. Reclaimed by
-      // EndRPC.
-      Socket::Options opt;
-      opt.fd = -1;
-      opt.remote_side = _remote_side;
-      opt.messenger = InputMessenger::client_messenger();
-      SocketId sid;
-      if (Socket::Create(opt, &sid) != 0 ||
-          Socket::Address(sid, &sock) != 0) {
-        err = TRPC_ECONNECT;
-        err_text = "failed to create socket";
-      } else if (sock->ConnectIfNot(_deadline_us) != 0) {
-        err = errno != 0 ? errno : TRPC_ECONNECT;
-        err_text =
-            "failed to connect to " + tbutil::endpoint2str(_remote_side);
-        sock->SetFailed(err);
-      }
-    } else if (SocketMap::global().GetOrCreate(_remote_side, &sock,
-                                               _tpu_transport) != 0) {
-      err = TRPC_ECONNECT;
-      err_text = "failed to create socket";
-    } else if (sock->ConnectIfNot(_deadline_us) != 0) {
+    // Streams outlive the RPC and pin their socket, so they always ride the
+    // shared single connection regardless of the channel's type. Connection
+    // type semantics (single/pooled/short) live in AcquireClientSocket.
+    const bool short_conn =
+        proto->short_connection ||
+        _connection_type == static_cast<uint8_t>(ConnectionType::kShort);
+    const ConnectionType ctype =
+        short_conn ? ConnectionType::kShort
+        : (_request_stream == 0 &&
+           _connection_type == static_cast<uint8_t>(ConnectionType::kPooled))
+            ? ConnectionType::kPooled
+            : ConnectionType::kSingle;
+    if (AcquireClientSocket(ctype, _remote_side, _tpu_transport,
+                            _deadline_us, &sock) != 0) {
       err = errno != 0 ? errno : TRPC_ECONNECT;
       err_text = "failed to connect to " + tbutil::endpoint2str(_remote_side);
-      SocketMap::global().Remove(_remote_side, sock->id());
     }
     if (err == 0) {
       const tbthread::fiber_id_t attempt = current_attempt_id();
@@ -123,6 +116,8 @@ void Controller::IssueRPC() {
       proto->pack_request(&packed, this, attempt, _service_method,
                           _request_payload);
       if (sock->Write(&packed, attempt) == 0) {
+        _live.push_back({_nretry, sock->id(), _remote_side,
+                         _attempt_begin_us});
         return;  // in flight; response/timeout/socket-failure takes over
       }
       err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
@@ -156,24 +151,42 @@ int Controller::OnError(tbthread::fiber_id_t id, void* data, int error) {
   // `id` is the exact versioned id the error was raised against. An attempt
   // can fail through TWO channels (the socket's pending-id list on
   // SetFailed, and the write queue's notify on release): the first one
-  // advances _nretry, making the second — and any error from a pre-retry
-  // attempt — STALE. Ignore stale errors or they would double-retry or kill
-  // a healthy in-flight attempt (reference controller.cpp:1058-1066).
-  if (id != cntl->current_attempt_id() && id != cntl->_correlation_id) {
+  // removes the attempt from _live, making the second — and any error from
+  // a pre-retry attempt — STALE. Ignore stale errors or they would
+  // double-retry or kill a healthy in-flight attempt (reference
+  // controller.cpp:1058-1066).
+  bool found = false;
+  tbutil::EndPoint failed_node = cntl->_remote_side;
+  for (auto it = cntl->_live.begin(); it != cntl->_live.end(); ++it) {
+    if (tbthread::fiber_id_for_attempt(cntl->_correlation_id, it->idx) ==
+        id) {
+      found = true;
+      failed_node = it->node;
+      SocketUniquePtr dead;
+      if (Socket::Address(it->sock, &dead) == 0) {
+        dead->RemovePendingId(id);
+      }
+      SocketMap::global().Remove(it->node, it->sock);
+      cntl->_live.erase(it);
+      break;
+    }
+  }
+  if (!found) {
     tbthread::fiber_id_unlock(id);
     return 0;
   }
-  // Transport failure: detach from the dead socket and retry on a fresh
-  // connection if the budget allows.
-  SocketUniquePtr old_sock;
-  if (cntl->_attempt_socket != INVALID_SOCKET_ID &&
-      Socket::Address(cntl->_attempt_socket, &old_sock) == 0) {
-    old_sock->RemovePendingId(cntl->current_attempt_id());
+  // With hedging, the sibling attempt may still be in flight: the RPC
+  // continues on it, no retry here.
+  if (!cntl->_live.empty()) {
+    if (cntl->_lb != nullptr) {
+      cntl->_lb->Feedback(failed_node, 0, /*failed=*/true);
+    }
+    tbthread::fiber_id_unlock(id);
+    return 0;
   }
-  SocketMap::global().Remove(cntl->_remote_side, cntl->_attempt_socket);
   if (cntl->HasRetryBudget()) {
     if (cntl->_lb != nullptr) {
-      cntl->_lb->Feedback(cntl->_remote_side, 0, /*failed=*/true);
+      cntl->_lb->Feedback(failed_node, 0, /*failed=*/true);
     }
     ++cntl->_nretry;
     cntl->IssueRPC();  // EndRPC (destroying id) or leaves id locked...
@@ -205,6 +218,152 @@ void Controller::TimeoutThunk(void* arg) {
   }
 }
 
+bool Controller::AcceptResponseFor(tbthread::fiber_id_t id) {
+  for (const LiveAttempt& a : _live) {
+    if (tbthread::fiber_id_for_attempt(_correlation_id, a.idx) == id) {
+      // Rebind the result bookkeeping to the attempt that actually answered
+      // — with hedging the winner may be the PREDECESSOR of the current
+      // attempt, and feedback/latency/pool-return must target its node.
+      _remote_side = a.node;
+      _attempt_begin_us = a.begin_us;
+      _attempt_socket = a.sock;
+      return true;
+    }
+  }
+  return false;
+}
+
+namespace {
+
+// Reclaim a hedge socket that never carried (or never completed) the hedge.
+// An exclusive borrowed socket with no pending traffic can go back to the
+// pool; a short one is closed; the shared single connection is left alone.
+void ReclaimHedgeSocket(SocketUniquePtr& sock, const tbutil::EndPoint& node,
+                        uint8_t ctype, bool tpu, bool used) {
+  if (!sock) return;
+  if (ctype == static_cast<uint8_t>(ConnectionType::kShort)) {
+    sock->SetFailed(ECANCELED);
+  } else if (ctype == static_cast<uint8_t>(ConnectionType::kPooled)) {
+    if (!used && !sock->Failed()) {
+      SocketMap::global().ReturnPooled(node, sock->id(), tpu);
+    } else {
+      sock->SetFailed(ECANCELED);
+    }
+  }
+}
+
+}  // namespace
+
+// Timer thunk for backup (hedged) requests: fires backup_request_ms after
+// CallMethod with the RPC still unanswered. Issues the next versioned
+// attempt WITHOUT canceling the in-flight one; the first response to arrive
+// wins (reference channel.cpp:566-575 HandleBackupRequest).
+//
+// Three phases, because the id lock serializes response delivery: (1) under
+// the lock, consume a retry attempt, pick the hedge node and pack; (2) WITH
+// THE LOCK RELEASED, create and connect the hedge socket — the slow, possibly
+// deadline-long part, during which the original attempt's response must stay
+// free to complete the RPC; (3) re-lock, and only if the RPC still lives,
+// place the write and record the live attempt.
+void Controller::BackupThunk(void* arg) {
+  auto cid = reinterpret_cast<tbthread::fiber_id_t>(arg);
+  auto* boxed = new tbthread::fiber_id_t(cid);
+  auto fn = +[](void* p) -> void* {
+    auto* idp = static_cast<tbthread::fiber_id_t*>(p);
+    const tbthread::fiber_id_t cid = *idp;
+    delete idp;
+
+    // ---- phase 1: locked — validate, reserve the attempt, pack ----
+    void* data = nullptr;
+    if (tbthread::fiber_id_lock(cid, &data) != 0) {
+      return nullptr;  // RPC already finished
+    }
+    auto* cntl = static_cast<Controller*>(data);
+    cntl->_backup_timer_id = 0;
+    const Protocol* proto = GetProtocol(cntl->_protocol);
+    if (cntl->_response_received || !cntl->HasRetryBudget() ||
+        cntl->_live.empty() || cntl->_request_stream != 0 ||
+        proto == nullptr || proto->pack_request == nullptr) {
+      tbthread::fiber_id_unlock(cid);
+      return nullptr;
+    }
+    // Pick the hedge node BEFORE spending anything: an unplaceable hedge
+    // (e.g. the only node is already tried) must leave the retry budget and
+    // the metric untouched.
+    tbutil::EndPoint node = cntl->_remote_side;
+    if (cntl->_lb != nullptr) {
+      LoadBalancer::SelectIn in;
+      in.request_code = cntl->_request_code;
+      in.has_request_code = cntl->_has_request_code;
+      in.excluded = &cntl->_tried;
+      if (cntl->_lb->SelectServer(in, &node) != 0) {
+        tbthread::fiber_id_unlock(cid);  // hedge unplaceable; original lives
+        return nullptr;
+      }
+      cntl->_tried.push_back(node);
+    }
+    GlobalRpcMetrics::instance().client_backup_requests << 1;
+    ++cntl->_nretry;
+    const int attempt_idx = cntl->_nretry;
+    const tbthread::fiber_id_t attempt =
+        tbthread::fiber_id_for_attempt(cid, attempt_idx);
+    const bool short_conn =
+        proto->short_connection ||
+        cntl->_connection_type ==
+            static_cast<uint8_t>(ConnectionType::kShort);
+    const uint8_t ctype =
+        short_conn ? static_cast<uint8_t>(ConnectionType::kShort)
+                   : cntl->_connection_type;
+    const bool tpu = cntl->_tpu_transport;
+    const int64_t deadline_us = cntl->_deadline_us;
+    const int64_t attempt_begin_us = tbutil::gettimeofday_us();
+    std::shared_ptr<LoadBalancer> lb = cntl->_lb;
+    tbutil::IOBuf packed;
+    proto->pack_request(&packed, cntl, attempt, cntl->_service_method,
+                        cntl->_request_payload);
+    tbthread::fiber_id_unlock(cid);
+
+    // ---- phase 2: unlocked — acquire + connect (may take a while) ----
+    SocketUniquePtr sock;
+    if (AcquireClientSocket(static_cast<ConnectionType>(ctype), node, tpu,
+                            deadline_us, &sock) != 0) {
+      if (lb != nullptr) lb->Feedback(node, 0, /*failed=*/true);
+      return nullptr;  // hedge lost before starting; original lives on
+    }
+
+    // ---- phase 3: locked — place the hedge if the RPC still wants it ----
+    if (tbthread::fiber_id_lock(cid, &data) != 0) {
+      // RPC finished while we connected.
+      ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/false);
+      return nullptr;
+    }
+    cntl = static_cast<Controller*>(data);
+    if (cntl->_response_received) {
+      ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/false);
+      tbthread::fiber_id_unlock(cid);
+      return nullptr;
+    }
+    sock->AddPendingId(attempt);
+    if (sock->Write(&packed, attempt) == 0) {
+      cntl->_live.push_back({attempt_idx, sock->id(), node,
+                             attempt_begin_us});
+      cntl->_attempt_socket = sock->id();
+    } else {
+      sock->RemovePendingId(attempt);
+      ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/true);
+      if (lb != nullptr) lb->Feedback(node, 0, /*failed=*/true);
+    }
+    if (tbthread::fiber_id_exists(cid)) {
+      tbthread::fiber_id_unlock(cid);
+    }
+    return nullptr;
+  };
+  tbthread::fiber_t tid;
+  if (tbthread::fiber_start_background(&tid, nullptr, fn, boxed) != 0) {
+    fn(boxed);
+  }
+}
+
 // Runs with the id LOCKED; finishes the RPC: records the result, stops the
 // timer, destroys the id (waking Join) and runs the async done.
 void Controller::EndRPC(int error, const std::string& error_text) {
@@ -222,23 +381,66 @@ void Controller::EndRPC(int error, const std::string& error_text) {
   // must not poison the final node's EWMA).
   if (_lb != nullptr && !_tried.empty()) {
     const bool transport_failure = error != 0 && !_response_received;
-    _lb->Feedback(_remote_side, _end_time_us - _attempt_begin_us,
-                  transport_failure);
+    if (transport_failure && !_live.empty()) {
+      // Nobody answered: charge EVERY still-unanswered attempt's node (with
+      // hedging there can be two), each with its own elapsed time — not
+      // just whichever node the last attempt happened to target.
+      for (const LiveAttempt& a : _live) {
+        _lb->Feedback(a.node, _end_time_us - a.begin_us, /*failed=*/true);
+      }
+    } else {
+      _lb->Feedback(_remote_side, _end_time_us - _attempt_begin_us,
+                    transport_failure);
+    }
   }
   if (_timer_id != 0) {
     tbthread::TimerThread::singleton()->unschedule(_timer_id);
     _timer_id = 0;
   }
-  SocketUniquePtr sock;
-  if (_attempt_socket != INVALID_SOCKET_ID &&
-      Socket::Address(_attempt_socket, &sock) == 0) {
-    sock->RemovePendingId(current_attempt_id());
-    // A short connection belongs to this one RPC: reclaim the fd now.
-    const Protocol* proto = GetProtocol(_protocol);
-    if (proto != nullptr && proto->short_connection) {
+  if (_backup_timer_id != 0) {
+    tbthread::TimerThread::singleton()->unschedule(_backup_timer_id);
+    _backup_timer_id = 0;
+  }
+  const Protocol* proto = GetProtocol(_protocol);
+  const bool short_conn =
+      (proto != nullptr && proto->short_connection) ||
+      _connection_type == static_cast<uint8_t>(ConnectionType::kShort);
+  const bool pooled_conn =
+      !short_conn &&
+      _connection_type == static_cast<uint8_t>(ConnectionType::kPooled) &&
+      _request_stream == 0 && _response_stream == 0;
+  // Sweep every in-flight attempt. The winner (the attempt that answered —
+  // AcceptResponseFor pointed _attempt_socket at it) may be returned to the
+  // pool; a hedge loser still has a response in flight, so exclusive
+  // (short/pooled) losers are closed, while a shared single connection is
+  // left alone — the late response fails to lock the finished id and drops.
+  if (_live.empty() && _attempt_socket != INVALID_SOCKET_ID) {
+    // Sync placement failure: no live entry was recorded, but the socket
+    // may still carry the pending id.
+    _live.push_back({_nretry, _attempt_socket, _remote_side, 0});
+  }
+  for (const LiveAttempt& a : _live) {
+    SocketUniquePtr sock;
+    if (Socket::Address(a.sock, &sock) != 0) continue;
+    sock->RemovePendingId(tbthread::fiber_id_for_attempt(_correlation_id,
+                                                         a.idx));
+    const bool winner = a.sock == _attempt_socket;
+    if (short_conn) {
+      // A short connection belongs to this one RPC: reclaim the fd now.
       sock->SetFailed(ECANCELED);
+    } else if (pooled_conn) {
+      // Borrowed pooled connection: hand it back if the server actually
+      // answered on it; a socket whose RPC died without a response may
+      // still deliver that response later — close it rather than risk
+      // handing a next borrower a connection mid-delivery.
+      if (winner && _response_received && !sock->Failed()) {
+        SocketMap::global().ReturnPooled(a.node, a.sock, _tpu_transport);
+      } else {
+        sock->SetFailed(ECANCELED);
+      }
     }
   }
+  _live.clear();
   // A failed RPC never connects its request stream: close it so writers
   // parked on the window wake with an error.
   if (_error_code != 0 && _request_stream != 0) {
@@ -273,9 +475,10 @@ void TstdHandleResponse(TstdInputMessage* msg) {
     return;
   }
   ControllerPrivateAccessor acc(static_cast<Controller*>(data));
-  if (attempt_id != acc.current_attempt_id()) {
+  if (!acc.AcceptResponseFor(attempt_id)) {
     // Response of a superseded attempt (a retry is already in flight):
-    // drop it; the live attempt's response will resolve the id.
+    // drop it; a live attempt's response will resolve the id. (A hedge
+    // sibling IS live — AcceptResponseFor admits it.)
     tbthread::fiber_id_unlock(attempt_id);
     delete msg;
     return;

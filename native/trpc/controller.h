@@ -43,6 +43,9 @@ class Controller {
   int64_t timeout_ms() const { return _timeout_ms; }
   void set_max_retry(int n) { _max_retry = n; }
   int max_retry() const { return _max_retry; }
+  // Hedging override for this call (see ChannelOptions::backup_request_ms).
+  void set_backup_request_ms(int64_t ms) { _backup_request_ms = ms; }
+  int64_t backup_request_ms() const { return _backup_request_ms; }
 
   // ---- results ----
   bool Failed() const { return _error_code != 0; }
@@ -79,18 +82,25 @@ class Controller {
   void EndRPC(int error, const std::string& error_text);
   static int OnError(tbthread::fiber_id_t id, void* data, int error);
   static void TimeoutThunk(void* arg);
+  static void BackupThunk(void* arg);
   tbthread::fiber_id_t current_attempt_id() const {
     return tbthread::fiber_id_for_attempt(_correlation_id, _nretry);
   }
   // Retries left AND the deadline hasn't passed (single source of truth for
   // the sync- and async-failure retry decisions).
   bool HasRetryBudget() const;
+  // Response arrived for `id`: true if `id` is a live in-flight attempt
+  // (with hedging there can be two); records the winner's socket/node so
+  // EndRPC feeds back and cleans up against the attempt that actually
+  // answered.
+  bool AcceptResponseFor(tbthread::fiber_id_t id);
 
   // config
   int64_t _timeout_ms = -1;
   int _max_retry = -1;
   int _protocol = 0;
   bool _tpu_transport = false;
+  uint8_t _connection_type = 0;  // ConnectionType (channel.h)
 
   // call state
   std::string _service_method;
@@ -102,6 +112,18 @@ class Controller {
   bool _has_request_code = false;
   int64_t _attempt_begin_us = 0;           // start of the CURRENT attempt
   bool _response_received = false;         // any server response arrived
+  // In-flight attempts. Exactly one normally; a backup (hedged) request adds
+  // a second — the predecessor stays live and the first response wins
+  // (reference channel.cpp:566-575, controller.cpp backup_request path).
+  struct LiveAttempt {
+    int idx;                  // attempt number (fiber_id_for_attempt)
+    SocketId sock;
+    tbutil::EndPoint node;    // LB node this attempt went to
+    int64_t begin_us;
+  };
+  std::vector<LiveAttempt> _live;
+  int64_t _backup_request_ms = -1;
+  tbthread::TimerThread::TaskId _backup_timer_id = 0;
   tbutil::IOBuf _request_payload;
   tbutil::IOBuf* _response_payload = nullptr;
   tbutil::IOBuf _request_attachment;
@@ -164,8 +186,8 @@ class ControllerPrivateAccessor {
   void set_server_socket(uint64_t sid) { _c->_server_socket = sid; }
   uint64_t server_socket() const { return _c->_server_socket; }
   uint64_t attempt_socket() const { return _c->_attempt_socket; }
-  tbthread::fiber_id_t current_attempt_id() const {
-    return _c->current_attempt_id();
+  bool AcceptResponseFor(tbthread::fiber_id_t id) {
+    return _c->AcceptResponseFor(id);
   }
   void EndRPC(int error, const std::string& text) { _c->EndRPC(error, text); }
 

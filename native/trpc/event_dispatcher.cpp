@@ -109,9 +109,11 @@ EventDispatcher& EventDispatcher::shard(SocketId sid) {
     for (int64_t i = 0; i < n; ++i) d[i].Start();
     return Pool{d, static_cast<size_t>(n)};
   }();
-  // SocketIds are ResourcePool slots in the low 32 bits — consecutive for
-  // consecutive sockets, so modulo spreads them evenly.
-  return pool.d[(sid & 0xffffffffu) % pool.n];
+  // SocketIds pack (slot << 32 | version); the slot is consecutive for
+  // consecutive sockets, so modulo spreads them evenly. (The low 32 bits are
+  // the version — always even for live sockets, so using them would pin
+  // every socket to shard 0 whenever the pool size is even.)
+  return pool.d[(sid >> 32) % pool.n];
 }
 
 }  // namespace trpc

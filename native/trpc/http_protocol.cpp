@@ -35,7 +35,8 @@ bool iequals(std::string_view a, const char* b) {
   return true;
 }
 
-std::string url_decode(std::string_view in, bool keep_encoded_slash = false) {
+std::string url_decode(std::string_view in, bool keep_encoded_slash = false,
+                       bool plus_to_space = false) {
   std::string out;
   out.reserve(in.size());
   for (size_t i = 0; i < in.size(); ++i) {
@@ -53,7 +54,9 @@ std::string url_decode(std::string_view in, bool keep_encoded_slash = false) {
         out.push_back(c);
       }
       i += 2;
-    } else if (in[i] == '+') {
+    } else if (in[i] == '+' && plus_to_space) {
+      // '+' means space only in form-encoded query components; in a path
+      // it is a literal character (RFC 3986).
       out.push_back(' ');
     } else {
       out.push_back(in[i]);
@@ -77,12 +80,14 @@ std::string HttpRequest::query_param(const std::string& key) const {
     if (amp == std::string::npos) amp = query.size();
     std::string_view kv(query.data() + pos, amp - pos);
     size_t eq = kv.find('=');
-    std::string k = url_decode(eq == std::string_view::npos ? kv
-                                                            : kv.substr(0, eq));
+    std::string k = url_decode(
+        eq == std::string_view::npos ? kv : kv.substr(0, eq),
+        /*keep_encoded_slash=*/false, /*plus_to_space=*/true);
     if (k == key) {
       return eq == std::string_view::npos
                  ? std::string()
-                 : url_decode(kv.substr(eq + 1));
+                 : url_decode(kv.substr(eq + 1), /*keep_encoded_slash=*/false,
+                              /*plus_to_space=*/true);
     }
     pos = amp + 1;
   }
@@ -261,11 +266,15 @@ ParseResult http_parse(tbutil::IOBuf* source, Socket*) {
   const size_t header_total = hdr_end + 4;
   auto te = msg->headers.find("Transfer-Encoding");
   bool chunked = false;
+  // Response body delimited by connection close (RFC 9112 §6.3 fallback).
+  bool response_eof_body = false;
   if (te != msg->headers.end()) {
-    // RFC 9112 §6.1: chunked must be the FINAL transfer coding; a message
+    // RFC 9112 §6.1: chunked must be the FINAL transfer coding. A REQUEST
     // with an unrecognized final coding cannot be framed and must be
     // rejected, and Transfer-Encoding + Content-Length together is a
-    // request-smuggling vector — reject that outright.
+    // request-smuggling vector — reject that outright. A RESPONSE with a
+    // non-chunked final coding is legal: its body runs to connection close,
+    // and any Content-Length is ignored (Transfer-Encoding wins).
     std::string_view v = te->second;
     size_t comma = v.rfind(',');
     std::string_view last = comma == std::string_view::npos
@@ -275,12 +284,20 @@ ParseResult http_parse(tbutil::IOBuf* source, Socket*) {
       last.remove_prefix(1);
     while (!last.empty() && (last.back() == ' ' || last.back() == '\t'))
       last.remove_suffix(1);
-    if (!iequals(last, "chunked") ||
-        msg->headers.find("Content-Length") != msg->headers.end()) {
+    const bool has_cl =
+        msg->headers.find("Content-Length") != msg->headers.end();
+    if (iequals(last, "chunked")) {
+      if (has_cl && !msg->is_response) {
+        r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+        return r;
+      }
+      chunked = true;
+    } else if (!msg->is_response) {
       r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
       return r;
+    } else {
+      response_eof_body = true;
     }
-    chunked = true;
   }
   if (chunked) {
     // Chunked needs the full frame contiguous: extend the copy if the
@@ -306,6 +323,13 @@ ParseResult http_parse(tbutil::IOBuf* source, Socket*) {
     }
     source->pop_front(header_total + consumed);
     msg->body.append(body);
+  } else if (response_eof_body) {
+    // Never-complete: the RPC fails honestly at connection EOF instead of
+    // delivering a truncated body (same stance as the no-framing response
+    // case below) — but never buffer past the body cap.
+    r.error = avail > kMaxBodyBytes ? PARSE_ERROR_ABSOLUTELY_WRONG
+                                    : PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
   } else {
     size_t content_length = 0;
     auto cl = msg->headers.find("Content-Length");
@@ -425,10 +449,12 @@ void send_http_response(SocketId sid, const HttpResponse& resp,
   tbutil::IOBuf out;
   serialize_response(&out, resp, keep_alive, head_request);
   if (!keep_alive) s->MarkCloseAfterLastWrite();
-  if (s->Write(&out) != 0 && !keep_alive) {
-    // The close-after-last-write mark only fires when a write drains; if
-    // this write never enters the queue the Connection: close socket would
-    // idle forever. Fail it now.
+  if (s->Write(&out) != 0) {
+    // A response that never entered the queue desynchronizes the
+    // connection: a keep-alive client would wait forever (or read the NEXT
+    // response as this one), and a Connection: close socket would idle
+    // because the close-after-last-write mark only fires when a write
+    // drains. Fail the socket either way.
     s->SetFailed(TRPC_EFAILEDSOCKET);
   }
 }
@@ -565,7 +591,7 @@ void http_process_response(InputMessageBase* base) {
   void* data = nullptr;
   if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
   ControllerPrivateAccessor acc(static_cast<Controller*>(data));
-  if (attempt_id != acc.current_attempt_id()) {
+  if (!acc.AcceptResponseFor(attempt_id)) {
     tbthread::fiber_id_unlock(attempt_id);
     return;
   }

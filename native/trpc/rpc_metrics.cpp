@@ -45,6 +45,7 @@ MethodStatus* GetMethodStatus(const std::string& service_method) {
 GlobalRpcMetrics::GlobalRpcMetrics() {
   client_latency.expose("rpc_client");
   client_errors.expose("rpc_client_errors");
+  client_backup_requests.expose("rpc_client_backup_requests");
   bytes_in.expose("rpc_socket_bytes_in");
   bytes_out.expose("rpc_socket_bytes_out");
   connections_accepted.expose("rpc_connections_accepted");

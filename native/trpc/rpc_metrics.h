@@ -46,6 +46,7 @@ MethodStatus* GetMethodStatus(const std::string& service_method);
 struct GlobalRpcMetrics {
   tbvar::LatencyRecorder client_latency{60};
   tbvar::Adder<int64_t> client_errors;
+  tbvar::Adder<int64_t> client_backup_requests;
   tbvar::Adder<int64_t> bytes_in;
   tbvar::Adder<int64_t> bytes_out;
   tbvar::Adder<int64_t> connections_accepted;

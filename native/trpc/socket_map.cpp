@@ -1,8 +1,15 @@
 #include "trpc/socket_map.h"
 
+#include "trpc/flags.h"
 #include "trpc/input_messenger.h"
 
 namespace trpc {
+
+// Reference flag of the same name (socket_map.cpp): idle sockets kept per
+// endpoint; returns past the cap close the connection instead.
+static auto* g_max_pool = TRPC_DEFINE_FLAG(
+    max_connection_pool_size, 128,
+    "max idle pooled connections kept per endpoint");
 
 int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
                            bool tpu) {
@@ -15,14 +22,8 @@ int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
     }
   }
   // Create outside the lock; resolve the create/create race below.
-  Socket::Options opt;
-  opt.fd = -1;  // connect on first use
-  opt.remote_side = pt;
-  opt.messenger = InputMessenger::client_messenger();
-  opt.server_side = false;
-  opt.tpu_transport = tpu;
   SocketId sid;
-  if (Socket::Create(opt, &sid) != 0) return -1;
+  if (CreateClientSocket(pt, tpu, &sid) != 0) return -1;
   std::lock_guard<std::mutex> lk(_mu);
   auto it = _map.find(key);
   if (it != _map.end() && Socket::Address(it->second, out) == 0) {
@@ -44,6 +45,94 @@ void SocketMap::Remove(const tbutil::EndPoint& pt, SocketId expected) {
       return;
     }
   }
+}
+
+int SocketMap::GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
+                         bool tpu) {
+  const Key key{pt, tpu};
+  {
+    std::lock_guard<std::mutex> lk(_mu);
+    auto it = _pools.find(key);
+    if (it != _pools.end()) {
+      auto& free_list = it->second;
+      // Pop from the back (most recently used — warmest socket buffers);
+      // skip entries that died while parked.
+      while (!free_list.empty()) {
+        const SocketId sid = free_list.back();
+        free_list.pop_back();
+        if (Socket::Address(sid, out) == 0) return 0;
+      }
+    }
+  }
+  SocketId sid;
+  if (CreateClientSocket(pt, tpu, &sid) != 0) return -1;
+  return Socket::Address(sid, out);
+}
+
+int CreateClientSocket(const tbutil::EndPoint& pt, bool tpu, SocketId* sid) {
+  Socket::Options opt;
+  opt.fd = -1;  // connect on first use
+  opt.remote_side = pt;
+  opt.messenger = InputMessenger::client_messenger();
+  opt.server_side = false;
+  opt.tpu_transport = tpu;
+  return Socket::Create(opt, sid);
+}
+
+int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
+                        bool tpu, int64_t deadline_us,
+                        SocketUniquePtr* out) {
+  int rc;
+  if (ctype == ConnectionType::kShort) {
+    SocketId sid;
+    rc = CreateClientSocket(pt, tpu, &sid) == 0 &&
+                 Socket::Address(sid, out) == 0
+             ? 0
+             : -1;
+  } else if (ctype == ConnectionType::kPooled) {
+    rc = SocketMap::global().GetPooled(pt, out, tpu);
+  } else {
+    rc = SocketMap::global().GetOrCreate(pt, out, tpu);
+  }
+  if (rc != 0) {
+    errno = ENOMEM;
+    return -1;
+  }
+  if ((*out)->ConnectIfNot(deadline_us) != 0) {
+    const int err = errno != 0 ? errno : ECONNREFUSED;
+    if (ctype == ConnectionType::kSingle) {
+      // Shared socket: evict so the next RPC makes a fresh one. Never
+      // SetFailed here — concurrent RPCs may hold pending ids on it and
+      // must fail (or not) through their own connect attempts.
+      SocketMap::global().Remove(pt, (*out)->id());
+    } else {
+      (*out)->SetFailed(err);
+    }
+    errno = err;
+    return -1;
+  }
+  return 0;
+}
+
+void SocketMap::ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
+                             bool tpu) {
+  SocketUniquePtr sock;
+  if (Socket::Address(sid, &sock) != 0) return;  // died in flight
+  std::unique_lock<std::mutex> lk(_mu);
+  auto& free_list = _pools[Key{pt, tpu}];
+  if (static_cast<int64_t>(free_list.size()) <
+      g_max_pool->load(std::memory_order_relaxed)) {
+    free_list.push_back(sid);
+    return;
+  }
+  lk.unlock();
+  sock->SetFailed(ECANCELED);  // pool full: close instead of park
+}
+
+size_t SocketMap::PooledIdleCount(const tbutil::EndPoint& pt, bool tpu) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _pools.find(Key{pt, tpu});
+  return it != _pools.end() ? it->second.size() : 0;
 }
 
 SocketMap& SocketMap::global() {

@@ -1,17 +1,45 @@
 // SocketMap: process-wide cache of client connections keyed by endpoint —
 // "single connection" semantics: all Channels to the same server share one
-// socket (the reference's default, controller.cpp:1148).
+// socket (the reference's default, controller.cpp:1148) — plus per-endpoint
+// free-lists backing ConnectionType::kPooled (reference socket_map.h:82
+// SocketPool: each RPC borrows an exclusive socket, returns it on success).
 // Capability parity: reference src/brpc/socket_map.h:82-150 (SocketMapInsert/
 // Find; dead sockets replaced on next acquire).
 #pragma once
 
 #include <mutex>
 #include <unordered_map>
+#include <vector>
 
 #include "tbutil/endpoint.h"
 #include "trpc/socket.h"
 
 namespace trpc {
+
+// How a Channel maps RPCs onto connections (reference socket_map.h:82,
+// controller.cpp:1148-1160 CONNECTION_TYPE_{SINGLE,POOLED,SHORT}):
+//  - kSingle: every Channel to one endpoint multiplexes one shared socket
+//    (wait-free write queue + correlation ids make this safe) — lowest fd
+//    cost, but one kernel socket serializes the read path.
+//  - kPooled: each RPC borrows an exclusive socket from a per-endpoint
+//    free-list and returns it on success — N in-flight RPCs ride N sockets,
+//    scaling the read path across EventDispatcher threads.
+//  - kShort: a fresh connection per RPC, closed at the end — required by
+//    protocols whose wire has no correlation id (HTTP/1.x w/o pipelining).
+enum class ConnectionType : uint8_t { kSingle = 0, kPooled = 1, kShort = 2 };
+
+// The one way client sockets are made (shared by the single/pooled/short
+// paths): fd = -1 (connect on first use), client messenger, optional tpu://
+// transport upgrade.
+int CreateClientSocket(const tbutil::EndPoint& pt, bool tpu, SocketId* sid);
+
+// Acquire a CONNECTED client socket per the connection type (the one
+// acquisition path shared by IssueRPC and the backup-request hedge). On
+// failure returns -1 with errno set; a failed short/pooled socket is closed,
+// a failed shared (single) socket is evicted from the map but NOT SetFailed —
+// other RPCs may hold pending ids on it.
+int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
+                        bool tpu, int64_t deadline_us, SocketUniquePtr* out);
 
 class SocketMap {
  public:
@@ -25,6 +53,20 @@ class SocketMap {
 
   // Drop the cache entry (e.g. after SetFailed, to force a fresh connect).
   void Remove(const tbutil::EndPoint& pt, SocketId expected);
+
+  // Borrow an exclusive socket from the (pt, tpu) pool, creating a fresh one
+  // when the free-list is empty. The caller owns it for one RPC; hand it
+  // back with ReturnPooled on clean completion or SetFailed it otherwise.
+  int GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
+                bool tpu = false);
+
+  // Return a healthy borrowed socket for reuse. Failed sockets and overflow
+  // past max_connection_pool_size are dropped (closed).
+  void ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
+                    bool tpu = false);
+
+  // Idle sockets currently parked in the (pt, tpu) free-list (tests/vars).
+  size_t PooledIdleCount(const tbutil::EndPoint& pt, bool tpu = false);
 
   static SocketMap& global();
 
@@ -43,6 +85,10 @@ class SocketMap {
   };
   std::mutex _mu;
   std::unordered_map<Key, SocketId, KeyHasher> _map;
+  // kPooled free-lists: sockets not currently carrying an RPC. Entries are
+  // bare ids — a pooled socket's liveness is its self-ref; Address() on
+  // acquire filters any that died while parked.
+  std::unordered_map<Key, std::vector<SocketId>, KeyHasher> _pools;
 };
 
 }  // namespace trpc
