@@ -14,6 +14,7 @@
 #include "trpc/errno.h"
 #include "trpc/server.h"
 #include "trpc/socket_map.h"
+#include "trpc/health_check.h"
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/tstd_protocol.h"
@@ -450,6 +451,209 @@ TEST_CASE(backup_request_beats_stalled_server) {
   ASSERT_TRUE(resp2.equals("slow"));
   ASSERT_TRUE(tbutil::monotonic_time_us() - t1 >= 390000);
   server2.Stop();
+}
+
+// A killed-then-restarted server receives traffic again on the SAME channel:
+// the dial failure marks the endpoint down (fail-fast), revival probes
+// detect the restart, and the next RPC reconnects (reference
+// details/health_check.h:32).
+TEST_CASE(health_check_revival) {
+  auto& flags = FlagRegistry::global();
+  ASSERT_TRUE(flags.Set("health_check_interval_ms", "30"));
+  int port;
+  Channel channel;
+  {
+    Server server;
+    EchoService svc;
+    ASSERT_EQ(server.AddService(&svc), 0);
+    ASSERT_EQ(server.Start(0), 0);
+    port = server.listen_address().port;
+    char addr[32];
+    snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+    ChannelOptions opts;
+    opts.timeout_ms = 1000;
+    opts.max_retry = 0;
+    ASSERT_EQ(channel.Init(addr, &opts), 0);
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("up");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    server.Stop();
+  }
+  // Server gone: the first failure may arrive via EOF on the cached
+  // connection; the following call dials fresh, fails, and marks the
+  // endpoint down.
+  tbutil::EndPoint pt;
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+  ASSERT_EQ(tbutil::str2endpoint(addr, &pt), 0);
+  bool down = false;
+  for (int i = 0; i < 50 && !down; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("down");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_TRUE(cntl.Failed());
+    down = HealthChecker::global().IsDown(pt);
+  }
+  ASSERT_TRUE(down);
+  // ...and while down, RPCs fail fast (no connect-timeout burn).
+  {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("fast-fail");
+    const int64_t t0 = tbutil::monotonic_time_us();
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_TRUE(cntl.Failed());
+    ASSERT_TRUE(tbutil::monotonic_time_us() - t0 < 100000);
+  }
+  // Restart on the SAME port; probes revive the endpoint.
+  Server server2;
+  EchoService svc2;
+  ASSERT_EQ(server2.AddService(&svc2), 0);
+  ASSERT_EQ(server2.Start(addr), 0);
+  bool revived = false;
+  for (int i = 0; i < 100 && !revived; ++i) {
+    tbthread::fiber_usleep(20000);
+    revived = !HealthChecker::global().IsDown(pt);
+  }
+  ASSERT_TRUE(revived);
+  {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("back");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(resp.equals("back"));
+  }
+  ASSERT_TRUE(flags.Set("health_check_interval_ms", "100"));
+  server2.Stop();
+}
+
+namespace {
+
+// Latency grows linearly with in-flight requests — the queueing shape an
+// adaptive limiter exists to tame. Records the queueing depth each request
+// observed, which (unlike client-side latency) is immune to CPU-contention
+// noise on a small host.
+class QueueingService : public Service {
+ public:
+  std::string_view service_name() const override { return "QueueSvc"; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    const int n = _inflight.fetch_add(1) + 1;
+    tbthread::fiber_usleep(2000 * n);
+    _inflight.fetch_sub(1);
+    {
+      std::lock_guard<std::mutex> lk(_mu);
+      _depths.push_back(n);
+    }
+    response->append("q");
+    done->Run();
+  }
+
+  // Median queueing depth over the SECOND half of the run (the limiter
+  // needs the first half to converge).
+  int median_settled_depth() {
+    std::lock_guard<std::mutex> lk(_mu);
+    if (_depths.empty()) return 0;
+    std::vector<int> tail(_depths.begin() + _depths.size() / 2,
+                          _depths.end());
+    std::sort(tail.begin(), tail.end());
+    return tail[tail.size() / 2];
+  }
+
+ private:
+  std::atomic<int> _inflight{0};
+  std::mutex _mu;
+  std::vector<int> _depths;
+};
+
+struct OverloadResult {
+  int64_t p50_us = 0;
+  int median_depth = 0;
+  int ok = 0;
+  int shed = 0;
+  int32_t final_limit = 0;
+};
+
+OverloadResult run_overload(bool auto_limit) {
+  Server server;
+  QueueingService svc;
+  server.AddService(&svc);
+  ServerOptions sopts;
+  sopts.auto_concurrency = auto_limit;
+  if (server.Start(0, &sopts) != 0) return {};
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  Channel channel;
+  ChannelOptions copts;
+  copts.timeout_ms = 5000;
+  copts.max_retry = 0;
+  copts.connection_type = ConnectionType::kPooled;
+  channel.Init(addr, &copts);
+
+  std::mutex mu;
+  std::vector<int64_t> latencies;
+  std::atomic<int> ok{0}, shed{0};
+  std::vector<std::thread> threads;
+  const int64_t stop_at = tbutil::monotonic_time_us() + 2000000;
+  for (int t = 0; t < 24; ++t) {
+    threads.emplace_back([&] {
+      std::vector<int64_t> local;
+      while (tbutil::monotonic_time_us() < stop_at) {
+        Controller cntl;
+        tbutil::IOBuf req, resp;
+        req.append("x");
+        channel.CallMethod("QueueSvc/Q", &cntl, req, &resp, nullptr);
+        if (!cntl.Failed()) {
+          ok.fetch_add(1);
+          local.push_back(cntl.latency_us());
+        } else if (cntl.ErrorCode() == TRPC_ELIMIT) {
+          shed.fetch_add(1);
+          tbthread::fiber_usleep(5000);  // client backoff on shed
+        }
+      }
+      std::lock_guard<std::mutex> lk(mu);
+      latencies.insert(latencies.end(), local.begin(), local.end());
+    });
+  }
+  for (auto& th : threads) th.join();
+  OverloadResult r;
+  r.ok = ok.load();
+  r.shed = shed.load();
+  r.final_limit = server.current_max_concurrency();
+  r.median_depth = svc.median_settled_depth();
+  if (!latencies.empty()) {
+    std::sort(latencies.begin(), latencies.end());
+    r.p50_us = latencies[latencies.size() / 2];
+  }
+  server.Stop();
+  return r;
+}
+
+}  // namespace
+
+// The gradient auto limiter converges under overload: latency of admitted
+// requests stays near the no-load baseline while excess load is shed; the
+// unlimited control run queues up and its latency inflates with the client
+// count (reference policy/auto_concurrency_limiter.cpp).
+TEST_CASE(auto_concurrency_limiter_converges) {
+  OverloadResult unlimited = run_overload(false);
+  OverloadResult adaptive = run_overload(true);
+  ASSERT_TRUE(unlimited.ok > 0);
+  ASSERT_TRUE(adaptive.ok > 0);
+  // Control: all 24 clients pile in — requests observe ~full queueing
+  // depth and median latency ~24 x 2ms.
+  ASSERT_TRUE(unlimited.median_depth >= 20);
+  ASSERT_TRUE(unlimited.p50_us >= 25000);
+  // Adaptive: the gate converged below the offered load, admitted requests
+  // observe a much shallower queue, and the excess was shed.
+  ASSERT_TRUE(adaptive.final_limit < 24);
+  ASSERT_TRUE(adaptive.median_depth <= unlimited.median_depth / 2);
+  ASSERT_TRUE(adaptive.shed > 0);
 }
 
 // kShort over tstd: a fresh connection per RPC, closed on completion —

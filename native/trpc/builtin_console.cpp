@@ -52,6 +52,9 @@ void status_page(const HttpRequest& req, HttpResponse* resp) {
   b += std::to_string(s->connection_count());
   b += "\ninflight_requests: ";
   b += std::to_string(s->concurrency());
+  b += "\nmax_concurrency: ";
+  const int32_t gate = s->current_max_concurrency();
+  b += gate > 0 ? std::to_string(gate) : "unlimited";
   b += "\nservices:\n";
   std::vector<std::string> names;
   s->ListServices(&names);

@@ -26,6 +26,15 @@ class NodeHealth {
     return now_us < _isolated_until_us.load(std::memory_order_relaxed);
   }
 
+  // External evidence the node is reachable again (health-check revival):
+  // lift isolation and forget the error history + backoff doubling.
+  void Heal() {
+    _isolated_until_us.store(0, std::memory_order_relaxed);
+    _error_ema.store(0.0, std::memory_order_relaxed);
+    _samples.store(0, std::memory_order_relaxed);
+    _last_isolation_end_us.store(0, std::memory_order_relaxed);
+  }
+
   int64_t isolation_count() const {
     return _isolation_count.load(std::memory_order_relaxed);
   }

@@ -1,0 +1,164 @@
+#include "trpc/concurrency_limiter.h"
+
+#include <algorithm>
+#include <cmath>
+
+#include "tbutil/time.h"
+#include "trpc/flags.h"
+
+namespace trpc {
+
+static auto* g_sample_window_ms = TRPC_DEFINE_FLAG(
+    auto_cl_sample_window_ms, 100,
+    "auto concurrency limiter: sampling window length");
+static auto* g_min_samples = TRPC_DEFINE_FLAG(
+    auto_cl_min_samples, 20,
+    "auto concurrency limiter: min finished requests per window");
+static auto* g_max_limit = TRPC_DEFINE_FLAG(
+    auto_cl_max_concurrency, 10000,
+    "auto concurrency limiter: hard ceiling of the adaptive gate");
+
+namespace {
+
+class ConstantLimiter final : public ConcurrencyLimiter {
+ public:
+  explicit ConstantLimiter(int32_t max) : _max(max) {}
+  bool OnRequestBegin() override {
+    if (_max <= 0) return true;
+    int32_t prev = _inflight.fetch_add(1, std::memory_order_acquire);
+    if (prev >= _max) {
+      _inflight.fetch_sub(1, std::memory_order_release);
+      return false;
+    }
+    return true;
+  }
+  void OnRequestEnd(int64_t) override {
+    if (_max > 0) _inflight.fetch_sub(1, std::memory_order_release);
+  }
+  int32_t max_concurrency() const override { return _max; }
+
+ private:
+  const int32_t _max;
+  std::atomic<int32_t> _inflight{0};
+};
+
+class AutoLimiter final : public ConcurrencyLimiter {
+ public:
+  AutoLimiter() : _win_start_us(tbutil::monotonic_time_us()) {}
+
+  bool OnRequestBegin() override {
+    const int32_t limit = _limit.load(std::memory_order_relaxed);
+    int32_t prev = _inflight.fetch_add(1, std::memory_order_acquire);
+    if (prev >= limit) {
+      _inflight.fetch_sub(1, std::memory_order_release);
+      return false;
+    }
+    return true;
+  }
+
+  void OnRequestEnd(int64_t latency_us) override {
+    _inflight.fetch_sub(1, std::memory_order_release);
+    if (latency_us < 0) return;
+    _win_total_us.fetch_add(latency_us, std::memory_order_relaxed);
+    const int64_t n = _win_count.fetch_add(1, std::memory_order_relaxed) + 1;
+    const int64_t now = tbutil::monotonic_time_us();
+    const int64_t win_start = _win_start_us.load(std::memory_order_relaxed);
+    if (now - win_start <
+            g_sample_window_ms->load(std::memory_order_relaxed) * 1000 ||
+        n < g_min_samples->load(std::memory_order_relaxed)) {
+      return;
+    }
+    // One updater folds the window; others keep accumulating into the next.
+    if (!_update_mu.try_lock()) return;
+    if (_win_start_us.load(std::memory_order_relaxed) != win_start) {
+      _update_mu.unlock();  // someone else just folded this window
+      return;
+    }
+    const int64_t count = _win_count.exchange(0, std::memory_order_relaxed);
+    const int64_t total = _win_total_us.exchange(0, std::memory_order_relaxed);
+    _win_start_us.store(now, std::memory_order_relaxed);
+    if (count > 0) Update(total / count);
+    _update_mu.unlock();
+  }
+
+  int32_t max_concurrency() const override {
+    return _limit.load(std::memory_order_relaxed);
+  }
+
+ private:
+  void Update(int64_t win_latency_us) {
+    if (win_latency_us <= 0) win_latency_us = 1;
+    int32_t limit = _limit.load(std::memory_order_relaxed);
+    if (_probing) {
+      // This window ran with the gate pinched — its latency is the closest
+      // thing to a no-load measurement we can get without stopping traffic.
+      // Baseline on it unconditionally: if the load was ALWAYS queueing
+      // (the bootstrap trap: the very first windows were already
+      // overloaded, so "fastest seen" is still inflated), this is the
+      // moment the real service time shows.
+      _noload_latency_us = win_latency_us;
+      _probing = false;
+      limit = _saved_limit;  // gradient below re-derives from the real gate
+    } else {
+      // Track the no-load latency: adopt faster windows immediately; creep
+      // upward slowly otherwise so a genuine service-time shift (not
+      // queueing) re-baselines within ~64 windows instead of pinning the
+      // gate down forever.
+      if (_noload_latency_us == 0 || win_latency_us < _noload_latency_us) {
+        _noload_latency_us = win_latency_us;
+      } else {
+        _noload_latency_us += std::max<int64_t>(1, _noload_latency_us / 64);
+      }
+      if (++_folds % kProbeEvery == 0) {
+        // Re-measure window: pinch the gate hard for one window
+        // (reference auto_concurrency_limiter.cpp's periodic min-latency
+        // sampling) and fold the NEXT window against it.
+        _saved_limit = limit;
+        _probing = true;
+        _limit.store(std::max(kMinLimit, limit / 4),
+                     std::memory_order_relaxed);
+        return;
+      }
+    }
+    // Gradient: <1 means requests spent time queueing beyond the no-load
+    // baseline — shrink proportionally. Headroom keeps probing upward; it
+    // must stay SMALL relative to the shrink force or the equilibrium
+    // parks well above the no-queueing point.
+    double g = static_cast<double>(_noload_latency_us) / win_latency_us;
+    g = std::clamp(g, 0.25, 1.0);
+    const double headroom = std::sqrt(static_cast<double>(limit)) / 2;
+    int32_t next = static_cast<int32_t>(limit * g + headroom);
+    next = std::clamp<int32_t>(
+        next, kMinLimit,
+        static_cast<int32_t>(g_max_limit->load(std::memory_order_relaxed)));
+    _limit.store(next, std::memory_order_relaxed);
+  }
+
+  static constexpr int32_t kMinLimit = 4;
+  static constexpr int32_t kInitialLimit = 32;
+  static constexpr int kProbeEvery = 5;  // windows between re-measures
+
+  std::atomic<int32_t> _limit{kInitialLimit};
+  std::atomic<int32_t> _inflight{0};
+  std::atomic<int64_t> _win_total_us{0};
+  std::atomic<int64_t> _win_count{0};
+  std::atomic<int64_t> _win_start_us;
+  std::mutex _update_mu;
+  // Guarded by _update_mu:
+  int64_t _noload_latency_us = 0;
+  int _folds = 0;
+  bool _probing = false;
+  int32_t _saved_limit = kInitialLimit;
+};
+
+}  // namespace
+
+std::unique_ptr<ConcurrencyLimiter> NewConstantLimiter(int32_t max) {
+  return std::make_unique<ConstantLimiter>(max);
+}
+
+std::unique_ptr<ConcurrencyLimiter> NewAutoLimiter() {
+  return std::make_unique<AutoLimiter>();
+}
+
+}  // namespace trpc

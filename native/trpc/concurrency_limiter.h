@@ -1,0 +1,36 @@
+// Server-side concurrency limiting: constant gate or adaptive "auto".
+// Capability parity: reference src/brpc/concurrency_limiter.h +
+// policy/auto_concurrency_limiter.cpp (gradient limiter re-estimating the
+// no-load latency and shrinking the gate when latency inflates past it).
+//
+// The auto policy here is a gradient design (Netflix gradient2-family, not a
+// translation of the reference's): per sampling window it compares the
+// window's average latency against a tracked no-load latency; the ratio
+// scales the limit down under queueing, and a sqrt(limit) headroom term
+// keeps probing upward when the server is healthy.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+
+namespace trpc {
+
+class ConcurrencyLimiter {
+ public:
+  virtual ~ConcurrencyLimiter() = default;
+  // Admission decision for one request. False = shed (caller replies
+  // TRPC_ELIMIT without running the handler).
+  virtual bool OnRequestBegin() = 0;
+  // One admitted request finished; latency_us is handler wall time.
+  virtual void OnRequestEnd(int64_t latency_us) = 0;
+  // Current gate (0 = unlimited), for /status and tests.
+  virtual int32_t max_concurrency() const = 0;
+};
+
+// max <= 0: unlimited (every request admitted).
+std::unique_ptr<ConcurrencyLimiter> NewConstantLimiter(int32_t max);
+std::unique_ptr<ConcurrencyLimiter> NewAutoLimiter();
+
+}  // namespace trpc

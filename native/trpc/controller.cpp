@@ -46,6 +46,7 @@ void Controller::Reset() {
   _live.clear();
   _backup_request_ms = -1;
   _backup_timer_id = 0;
+  _pending_hedges = 0;
   _request_stream = 0;
   _response_stream = 0;
   _remote_stream_id = 0;
@@ -175,9 +176,10 @@ int Controller::OnError(tbthread::fiber_id_t id, void* data, int error) {
     tbthread::fiber_id_unlock(id);
     return 0;
   }
-  // With hedging, the sibling attempt may still be in flight: the RPC
-  // continues on it, no retry here.
-  if (!cntl->_live.empty()) {
+  // With hedging, the sibling attempt may still be in flight — or still
+  // CONNECTING (reserved but not yet in _live): either way the RPC
+  // continues without us, no retry and no EndRPC here.
+  if (!cntl->_live.empty() || cntl->_pending_hedges > 0) {
     if (cntl->_lb != nullptr) {
       cntl->_lb->Feedback(failed_node, 0, /*failed=*/true);
     }
@@ -304,6 +306,7 @@ void Controller::BackupThunk(void* arg) {
     }
     GlobalRpcMetrics::instance().client_backup_requests << 1;
     ++cntl->_nretry;
+    ++cntl->_pending_hedges;
     const int attempt_idx = cntl->_nretry;
     const tbthread::fiber_id_t attempt =
         tbthread::fiber_id_for_attempt(cid, attempt_idx);
@@ -323,12 +326,39 @@ void Controller::BackupThunk(void* arg) {
                         cntl->_request_payload);
     tbthread::fiber_id_unlock(cid);
 
+    // The hedge failed to launch AND every other attempt died while it was
+    // connecting: completion is ours now. Runs under the lock.
+    auto settle_orphaned = [](Controller* c, tbthread::fiber_id_t id,
+                              int err) {
+      if (c->HasRetryBudget()) {
+        ++c->_nretry;
+        c->IssueRPC();  // EndRPC (id destroyed) or leaves the id locked
+        if (tbthread::fiber_id_exists(id)) {
+          tbthread::fiber_id_unlock(id);
+        }
+      } else {
+        c->EndRPC(err, "transport failure: " +
+                           std::string(rpc_error_text(err)));
+      }
+    };
+
     // ---- phase 2: unlocked — acquire + connect (may take a while) ----
     SocketUniquePtr sock;
     if (AcquireClientSocket(static_cast<ConnectionType>(ctype), node, tpu,
                             deadline_us, &sock) != 0) {
+      const int err = errno != 0 ? errno : TRPC_ECONNECT;
       if (lb != nullptr) lb->Feedback(node, 0, /*failed=*/true);
-      return nullptr;  // hedge lost before starting; original lives on
+      if (tbthread::fiber_id_lock(cid, &data) != 0) {
+        return nullptr;  // RPC finished without us
+      }
+      cntl = static_cast<Controller*>(data);
+      --cntl->_pending_hedges;
+      if (cntl->_live.empty() && cntl->_pending_hedges == 0) {
+        settle_orphaned(cntl, cid, err);
+      } else {
+        tbthread::fiber_id_unlock(cid);
+      }
+      return nullptr;
     }
 
     // ---- phase 3: locked — place the hedge if the RPC still wants it ----
@@ -338,6 +368,7 @@ void Controller::BackupThunk(void* arg) {
       return nullptr;
     }
     cntl = static_cast<Controller*>(data);
+    --cntl->_pending_hedges;
     if (cntl->_response_received) {
       ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/false);
       tbthread::fiber_id_unlock(cid);
@@ -349,9 +380,14 @@ void Controller::BackupThunk(void* arg) {
                              attempt_begin_us});
       cntl->_attempt_socket = sock->id();
     } else {
+      const int err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
       sock->RemovePendingId(attempt);
       ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/true);
       if (lb != nullptr) lb->Feedback(node, 0, /*failed=*/true);
+      if (cntl->_live.empty() && cntl->_pending_hedges == 0) {
+        settle_orphaned(cntl, cid, err);
+        return nullptr;
+      }
     }
     if (tbthread::fiber_id_exists(cid)) {
       tbthread::fiber_id_unlock(cid);

@@ -51,6 +51,10 @@ class Controller {
   bool Failed() const { return _error_code != 0; }
   int ErrorCode() const { return _error_code; }
   const std::string& ErrorText() const { return _error_text; }
+  // True when any server response arrived — the exact transport-vs-
+  // application failure discriminator (a failed RPC with a response is an
+  // app error; without one, the transport/peer is suspect).
+  bool response_received() const { return _response_received; }
   void SetFailed(int code, const std::string& reason);
   int64_t latency_us() const { return _end_time_us - _begin_time_us; }
   int retried_count() const { return _nretry; }
@@ -124,6 +128,10 @@ class Controller {
   std::vector<LiveAttempt> _live;
   int64_t _backup_request_ms = -1;
   tbthread::TimerThread::TaskId _backup_timer_id = 0;
+  // Hedges between reservation (BackupThunk phase 1) and placement (phase
+  // 3). While > 0, an empty _live does NOT mean the RPC is dead — the
+  // connecting hedge owns completion if everything else fails first.
+  int _pending_hedges = 0;
   tbutil::IOBuf _request_payload;
   tbutil::IOBuf* _response_payload = nullptr;
   tbutil::IOBuf _request_attachment;

@@ -1,0 +1,181 @@
+#include "trpc/health_check.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "tbvar/tbvar.h"
+#include "trpc/circuit_breaker.h"
+#include "trpc/errno.h"
+#include "trpc/flags.h"
+
+namespace trpc {
+
+static auto* g_interval_ms = TRPC_DEFINE_FLAG(
+    health_check_interval_ms, 100,
+    "delay between revival probes of a down endpoint");
+static auto* g_probe_timeout_ms = TRPC_DEFINE_FLAG(
+    health_check_probe_timeout_ms, 500, "connect timeout of one probe");
+static auto* g_expiry_s = TRPC_DEFINE_FLAG(
+    health_check_expiry_s, 300,
+    "give up probing an endpoint that has stayed down this long "
+    "(decommissioned hosts must not be dialed forever)");
+
+namespace {
+
+// One non-blocking TCP dial; true when the endpoint accepts.
+bool ProbeOnce(const tbutil::EndPoint& pt, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return false;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr = pt.ip;
+  addr.sin_port = htons(static_cast<uint16_t>(pt.port));
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc != 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    if (::poll(&pfd, 1, timeout_ms) == 1) {
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      rc = err == 0 ? 0 : -1;
+    } else {
+      rc = -1;
+    }
+  }
+  ::close(fd);
+  return rc == 0;
+}
+
+}  // namespace
+
+struct HealthChecker::Impl {
+  struct DownState {
+    bool expensive = false;  // timeout-class dial: gate acquisitions
+    int64_t since_us = 0;
+  };
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<tbutil::EndPoint, DownState, tbutil::EndPointHasher>
+      down;
+  bool thread_running = false;
+  tbvar::Adder<int64_t> revived;  // exposed as rpc_endpoints_revived
+
+  Impl() { revived.expose("rpc_endpoints_revived"); }
+
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (!down.empty()) {
+      const auto interval = std::chrono::milliseconds(
+          g_interval_ms->load(std::memory_order_relaxed));
+      cv.wait_for(lk, interval);
+      // Snapshot and probe without the lock — probes block up to the probe
+      // timeout each and must not stall IsDown on the hot path.
+      std::vector<tbutil::EndPoint> candidates;
+      candidates.reserve(down.size());
+      const int64_t now = tbutil::monotonic_time_us();
+      const int64_t expiry_us =
+          g_expiry_s->load(std::memory_order_relaxed) * 1000000;
+      std::vector<tbutil::EndPoint> expired;
+      for (const auto& [pt, st] : down) {
+        if (now - st.since_us > expiry_us) {
+          expired.push_back(pt);
+        } else {
+          candidates.push_back(pt);
+        }
+      }
+      for (const auto& pt : expired) {
+        down.erase(pt);  // decommissioned: stop dialing it forever
+        TB_LOG(WARNING) << "endpoint " << tbutil::endpoint2str(pt)
+                        << " still down after "
+                        << g_expiry_s->load(std::memory_order_relaxed)
+                        << "s; abandoning revival probes";
+      }
+      lk.unlock();
+      const int timeout_ms = static_cast<int>(
+          g_probe_timeout_ms->load(std::memory_order_relaxed));
+      // Concurrent probes: one blackholed endpoint burning its full
+      // connect timeout must not delay the revival of the others.
+      std::vector<char> probe_up(candidates.size(), 0);
+      {
+        std::vector<std::thread> probers;
+        probers.reserve(candidates.size());
+        for (size_t i = 0; i < candidates.size(); ++i) {
+          probers.emplace_back([&, i] {
+            probe_up[i] = ProbeOnce(candidates[i], timeout_ms) ? 1 : 0;
+          });
+        }
+        for (auto& t : probers) t.join();
+      }
+      lk.lock();
+      for (size_t i = 0; i < candidates.size(); ++i) {
+        if (probe_up[i] == 0) continue;
+        const auto& pt = candidates[i];
+        if (down.erase(pt) > 0) {
+          revived << 1;
+          // Lift circuit-breaker isolation: the prober has fresher evidence
+          // than the backoff window.
+          GetNodeHealth(pt)->Heal();
+          TB_LOG(INFO) << "endpoint " << tbutil::endpoint2str(pt)
+                       << " revived by health check";
+        }
+      }
+    }
+    thread_running = false;
+  }
+};
+
+HealthChecker::HealthChecker() : _impl(new Impl) {}
+
+void HealthChecker::ScheduleCheck(const tbutil::EndPoint& pt,
+                                  int dial_errno) {
+  // Timeout-class failures (blackholed peer: every dial burns the full
+  // connect deadline). Refused/reset dials are instant — never gate those.
+  const bool expensive = dial_errno == ETIMEDOUT ||
+                         dial_errno == EHOSTUNREACH ||
+                         dial_errno == ENETUNREACH ||
+                         dial_errno == TRPC_ERPCTIMEDOUT;
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  auto& st = _impl->down[pt];
+  if (st.since_us == 0) st.since_us = tbutil::monotonic_time_us();
+  st.expensive = st.expensive || expensive;
+  if (!_impl->thread_running) {
+    _impl->thread_running = true;
+    std::thread([impl = _impl] { impl->Loop(); }).detach();
+  }
+}
+
+bool HealthChecker::IsDown(const tbutil::EndPoint& pt) {
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  return _impl->down.count(pt) > 0;
+}
+
+bool HealthChecker::ShouldFailFast(const tbutil::EndPoint& pt) {
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  auto it = _impl->down.find(pt);
+  return it != _impl->down.end() && it->second.expensive;
+}
+
+size_t HealthChecker::down_count() {
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  return _impl->down.size();
+}
+
+HealthChecker& HealthChecker::global() {
+  static HealthChecker* c = new HealthChecker;
+  return *c;
+}
+
+}  // namespace trpc

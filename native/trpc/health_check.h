@@ -1,0 +1,47 @@
+// HealthChecker: periodic revival probes for endpoints whose connections
+// failed — the counterpart of lazy reconnect-on-next-use. While an endpoint
+// is known-down, client socket acquisition fails fast (no connect-timeout
+// burn per RPC); a background prober re-dials it every
+// health_check_interval_ms and, on success, clears the down mark and heals
+// the endpoint's circuit-breaker isolation so traffic resumes immediately.
+//
+// Capability parity: reference src/brpc/details/health_check.h:32
+// (StartHealthCheck: periodic reconnect of SetFailed sockets, revival
+// returning the node to load balancers). Design differs deliberately:
+// versioned socket ids cannot be revived in place (SetFailed bumps the
+// version forever), so health is endpoint-keyed and a revived endpoint gets
+// a FRESH socket on next acquire.
+#pragma once
+
+#include "tbutil/endpoint.h"
+
+namespace trpc {
+
+class HealthChecker {
+ public:
+  // Mark `pt` down and begin probing it (idempotent while already probing).
+  // Called on dial failures; `dial_errno` is the connect error.
+  void ScheduleCheck(const tbutil::EndPoint& pt, int dial_errno);
+
+  // True while `pt` is marked down (probes still failing).
+  bool IsDown(const tbutil::EndPoint& pt);
+
+  // Fail-fast gate for socket acquisition: true only when the endpoint is
+  // down AND dialing it is EXPENSIVE (connect timed out / host unreachable —
+  // a blackhole). A refused dial is cheap and self-correcting the instant
+  // the server returns, so it never gates — otherwise a restarted server
+  // would bounce fresh RPCs until the next probe cycle.
+  bool ShouldFailFast(const tbutil::EndPoint& pt);
+
+  // Tests/console: number of endpoints currently marked down.
+  size_t down_count();
+
+  static HealthChecker& global();
+
+ private:
+  struct Impl;
+  Impl* _impl;
+  HealthChecker();
+};
+
+}  // namespace trpc

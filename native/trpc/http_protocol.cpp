@@ -1,5 +1,6 @@
 #include "trpc/http_protocol.h"
 
+#include <algorithm>
 #include <cctype>
 #include <cstring>
 #include <mutex>
@@ -536,8 +537,11 @@ void http_process_request(InputMessageBase* base) {
   acc.set_server_socket(sid);
   Closure* done = NewCallback(
       [sid, cntl, response, server, ms, received_us, keep_alive, is_head]() {
-        ms->OnResponded(cntl->ErrorCode(),
-                        tbutil::gettimeofday_us() - received_us);
+        // Clamped: a backward wall-clock step must not read as the shed
+        // sentinel in EndRequest (would leak a limiter slot).
+        const int64_t latency_us =
+            std::max<int64_t>(0, tbutil::gettimeofday_us() - received_us);
+        ms->OnResponded(cntl->ErrorCode(), latency_us);
         HttpResponse resp;
         resp.status = http_status_for_error(cntl->ErrorCode());
         if (cntl->Failed()) {
@@ -549,7 +553,7 @@ void http_process_request(InputMessageBase* base) {
           resp.body = response->to_string();
         }
         send_http_response(sid, resp, keep_alive, is_head);
-        server->EndRequest();
+        server->EndRequest(latency_us);
         delete cntl;
         delete response;
       });

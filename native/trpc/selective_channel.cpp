@@ -66,12 +66,13 @@ void SelectiveChannel::CallMethod(const std::string& service_method,
       tbutil::IOBuf sub_resp;
       chosen->channel->CallMethod(service_method, &sub_cntl, request,
                                   &sub_resp, nullptr);
+      // Transport vs application failure: if ANY server response arrived
+      // the node is reachable (an error in it is the app's business); a
+      // failure with no response — timeout, refused dial, EHOSTDOWN
+      // fail-fast, EOF — is the transport's. (Error-code whitelists break
+      // every time the socket layer grows a new failure mode.)
       const bool transport_failure =
-          sub_cntl.Failed() && (sub_cntl.ErrorCode() == TRPC_ERPCTIMEDOUT ||
-                                sub_cntl.ErrorCode() == TRPC_EFAILEDSOCKET ||
-                                sub_cntl.ErrorCode() == TRPC_ECONNECT ||
-                                sub_cntl.ErrorCode() == TRPC_EEOF ||
-                                sub_cntl.ErrorCode() == TRPC_ENODATA);
+          sub_cntl.Failed() && !sub_cntl.response_received();
       chosen->health->OnCallEnd(transport_failure,
                                 tbutil::gettimeofday_us());
       if (!transport_failure || a + 1 >= attempts) {

@@ -23,11 +23,18 @@ Server::~Server() {
   }
 }
 
-void Server::EndRequest() {
+void Server::EndRequest(int64_t latency_us) {
+  if (_limiter != nullptr && latency_us >= 0) {
+    _limiter->OnRequestEnd(latency_us);
+  }
   if (_concurrency.fetch_sub(1, std::memory_order_release) == 1 &&
       _drain_butex != nullptr) {
     tbthread::butex_increment_and_wake_all(_drain_butex);
   }
+}
+
+int32_t Server::current_max_concurrency() const {
+  return _limiter != nullptr ? _limiter->max_concurrency() : 0;
 }
 
 int Server::AddService(Service* service) {
@@ -55,6 +62,9 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   if (_running.load(std::memory_order_acquire)) return -1;
   GlobalInitializeOrDie();
   if (options != nullptr) _options = *options;
+  _limiter = _options.auto_concurrency
+                 ? NewAutoLimiter()
+                 : NewConstantLimiter(_options.max_concurrency);
   if (_stop_butex == nullptr) _stop_butex = tbthread::butex_create();
   if (_drain_butex == nullptr) _drain_butex = tbthread::butex_create();
 

@@ -16,6 +16,7 @@
 #include "tbutil/iobuf.h"
 #include "trpc/acceptor.h"
 #include "trpc/closure.h"
+#include "trpc/concurrency_limiter.h"
 #include "trpc/controller.h"
 
 namespace trpc {
@@ -38,6 +39,11 @@ struct ServerOptions {
   // 0 = unlimited. Requests over the cap are rejected with TRPC_ELIMIT
   // (reference ServerOptions.max_concurrency server.h:132).
   int32_t max_concurrency = 0;
+  // Adaptive gate (overrides max_concurrency): a gradient limiter tracks
+  // the no-load latency and sheds load when latency inflates past it
+  // (reference max_concurrency = "auto",
+  // policy/auto_concurrency_limiter.cpp). See concurrency_limiter.h.
+  bool auto_concurrency = false;
 };
 
 class Server {
@@ -68,23 +74,28 @@ class Server {
   // Request-level concurrency gate. Always counts in-flight requests (not
   // only when capped): Stop() drains to zero before returning, so a done
   // closure can never touch a destroyed Server (handlers may outlive their
-  // connection).
+  // connection). Admission itself is the limiter's call (constant or auto).
   bool BeginRequest() {
-    int32_t prev = _concurrency.fetch_add(1, std::memory_order_acquire);
-    if (_options.max_concurrency > 0 && prev >= _options.max_concurrency) {
-      EndRequest();
+    _concurrency.fetch_add(1, std::memory_order_acquire);
+    if (_limiter != nullptr && !_limiter->OnRequestBegin()) {
+      EndRequest(-1);
       return false;
     }
     return true;
   }
-  void EndRequest();
+  // latency_us: handler wall time for admitted+finished requests; -1 from
+  // the shed path (never reached the limiter's accounting).
+  void EndRequest(int64_t latency_us);
   int32_t concurrency() const {
     return _concurrency.load(std::memory_order_relaxed);
   }
+  // Current admission gate (0 = unlimited); live for the auto policy.
+  int32_t current_max_concurrency() const;
 
  private:
   tbutil::FlatMap<std::string, Service*> _services;
   ServerOptions _options;
+  std::unique_ptr<ConcurrencyLimiter> _limiter;
   Acceptor _acceptor;
   tbutil::EndPoint _listen_address;
   std::atomic<bool> _running{false};

@@ -1,6 +1,7 @@
 #include "trpc/socket_map.h"
 
 #include "trpc/flags.h"
+#include "trpc/health_check.h"
 #include "trpc/input_messenger.h"
 
 namespace trpc {
@@ -82,6 +83,12 @@ int CreateClientSocket(const tbutil::EndPoint& pt, bool tpu, SocketId* sid) {
 int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
                         bool tpu, int64_t deadline_us,
                         SocketUniquePtr* out) {
+  // Known-blackholed endpoint (prior connect TIMED OUT, revival probes
+  // still failing): fail fast instead of burning a connect timeout per RPC.
+  if (HealthChecker::global().ShouldFailFast(pt)) {
+    errno = EHOSTDOWN;
+    return -1;
+  }
   int rc;
   if (ctype == ConnectionType::kShort) {
     SocketId sid;
@@ -108,6 +115,9 @@ int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
     } else {
       (*out)->SetFailed(err);
     }
+    // The dial itself failed: mark the endpoint down and start revival
+    // probes (reference details/health_check.h StartHealthCheck).
+    HealthChecker::global().ScheduleCheck(pt, err);
     errno = err;
     return -1;
   }

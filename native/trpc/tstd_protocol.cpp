@@ -1,5 +1,6 @@
 #include "trpc/tstd_protocol.h"
 
+#include <algorithm>
 #include <bit>
 #include <cstring>
 #include <mutex>
@@ -299,12 +300,16 @@ void tstd_process_request(InputMessageBase* base) {
   // teardown path for both the error and success branches).
   Closure* done =
       NewCallback([sid, cid, cntl, response, server, ms, received_us]() {
+        // Clamped: gettimeofday can step backward (NTP), and a negative
+        // value here would read as the shed sentinel in EndRequest,
+        // leaking a limiter slot.
+        const int64_t latency_us =
+            std::max<int64_t>(0, tbutil::gettimeofday_us() - received_us);
         if (ms != nullptr) {
-          ms->OnResponded(cntl->ErrorCode(),
-                          tbutil::gettimeofday_us() - received_us);
+          ms->OnResponded(cntl->ErrorCode(), latency_us);
         }
         tstd_send_response(sid, cid, cntl, response);
-        server->EndRequest();
+        server->EndRequest(latency_us);
         delete cntl;
         delete response;
       });
