@@ -176,19 +176,28 @@ namespace {
 
 struct BenchEnv {
   ServerBox* server;
-  ChannelBox* channel;
+  ChannelBox* channel = nullptr;
   bool ok = false;
 
-  BenchEnv() {
+  explicit BenchEnv(bool tpu = false, int conn_type = 0) {
     server = new ServerBox;
     tbrpc_server_add_echo_service(server);
     int port = tbrpc_server_start(server, "127.0.0.1:0");
     if (port <= 0) return;
-    char addr[32];
-    snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
-    channel =
-        static_cast<ChannelBox*>(tbrpc_channel_create(addr, 5000, 0));
-    ok = channel != nullptr;
+    char addr[48];
+    snprintf(addr, sizeof(addr), "%s127.0.0.1:%d", tpu ? "tpu://" : "",
+             port);
+    auto* box = new ChannelBox;
+    ChannelOptions opts;
+    opts.timeout_ms = 20000;
+    opts.max_retry = 0;
+    opts.connection_type = static_cast<ConnectionType>(conn_type);
+    if (box->channel.Init(addr, &opts) != 0) {
+      delete box;
+      return;
+    }
+    channel = box;
+    ok = true;
   }
   ~BenchEnv() {
     if (channel != nullptr) tbrpc_channel_destroy(channel);
@@ -230,6 +239,61 @@ double tbrpc_bench_echo_throughput(size_t payload_size, int seconds,
   stop.store(true);
   for (auto& w : workers) w.join();
   const double elapsed_s = (tbutil::monotonic_time_us() - t0) / 1e6;
+  return static_cast<double>(total_bytes.load()) / elapsed_s;
+}
+
+double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
+                           int transport, int conn_type, double* qps_out,
+                           double* p99_us_out) {
+  BenchEnv env(transport == 1, conn_type);
+  if (!env.ok) return -1;
+  if (concurrency < 1) concurrency = 1;
+  std::atomic<int64_t> total_bytes{0};
+  std::atomic<int64_t> total_calls{0};
+  std::atomic<bool> stop{false};
+  std::mutex lat_mu;
+  std::vector<int64_t> latencies;
+  std::vector<std::thread> workers;
+  std::string payload(payload_size, 'b');
+  for (int t = 0; t < concurrency; ++t) {
+    workers.emplace_back([&] {
+      std::vector<int64_t> local;
+      local.reserve(1 << 14);
+      while (!stop.load(std::memory_order_relaxed)) {
+        Controller cntl;
+        tbutil::IOBuf request, response;
+        request.append("x");
+        cntl.request_attachment().append(payload);
+        env.channel->channel.CallMethod("EchoService/Echo", &cntl, request,
+                                        &response, nullptr);
+        if (!cntl.Failed()) {
+          total_bytes.fetch_add(
+              static_cast<int64_t>(cntl.response_attachment().size()),
+              std::memory_order_relaxed);
+          total_calls.fetch_add(1, std::memory_order_relaxed);
+          local.push_back(cntl.latency_us());
+        }
+      }
+      std::lock_guard<std::mutex> lk(lat_mu);
+      latencies.insert(latencies.end(), local.begin(), local.end());
+    });
+  }
+  const int64_t t0 = tbutil::monotonic_time_us();
+  std::this_thread::sleep_for(std::chrono::seconds(seconds));
+  stop.store(true);
+  for (auto& w : workers) w.join();
+  const double elapsed_s = (tbutil::monotonic_time_us() - t0) / 1e6;
+  if (qps_out != nullptr) {
+    *qps_out = static_cast<double>(total_calls.load()) / elapsed_s;
+  }
+  if (p99_us_out != nullptr) {
+    *p99_us_out = 0;
+    if (!latencies.empty()) {
+      std::sort(latencies.begin(), latencies.end());
+      *p99_us_out = static_cast<double>(
+          latencies[static_cast<size_t>(latencies.size() * 0.99)]);
+    }
+  }
   return static_cast<double>(total_bytes.load()) / elapsed_s;
 }
 

@@ -55,4 +55,14 @@ double tbrpc_bench_echo_throughput(size_t payload_size, int seconds,
 // is non-null, stores the p99 latency in microseconds.
 double tbrpc_bench_echo_qps(int seconds, int concurrency, double* p99_us_out);
 
+// Full-control bench point: echo round-trips of `payload_size`-byte
+// attachments for ~`seconds` with `concurrency` callers.
+//   transport: 0 = plain TCP loopback, 1 = tpu:// (shm ICI transport).
+//   conn_type: 0 = single shared socket, 1 = pooled, 2 = short.
+// Returns one-way payload bytes/sec; optionally stores calls/sec and the
+// p99 round-trip latency (microseconds).
+double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
+                           int transport, int conn_type, double* qps_out,
+                           double* p99_us_out);
+
 }  // extern "C"

@@ -70,10 +70,25 @@ struct HealthChecker::Impl {
   std::condition_variable cv;
   std::unordered_map<tbutil::EndPoint, DownState, tbutil::EndPointHasher>
       down;
+  // Lock-free fast-path gate for ShouldFailFast: number of down endpoints
+  // whose dial was timeout-class. 0 (the overwhelmingly common case) means
+  // every acquisition skips the mutex entirely.
+  std::atomic<int64_t> expensive_count{0};
   bool thread_running = false;
   tbvar::Adder<int64_t> revived;  // exposed as rpc_endpoints_revived
 
   Impl() { revived.expose("rpc_endpoints_revived"); }
+
+  // Remove one entry (mu held), keeping expensive_count in sync.
+  bool Erase(const tbutil::EndPoint& pt) {
+    auto it = down.find(pt);
+    if (it == down.end()) return false;
+    if (it->second.expensive) {
+      expensive_count.fetch_sub(1, std::memory_order_relaxed);
+    }
+    down.erase(it);
+    return true;
+  }
 
   void Loop() {
     std::unique_lock<std::mutex> lk(mu);
@@ -97,7 +112,7 @@ struct HealthChecker::Impl {
         }
       }
       for (const auto& pt : expired) {
-        down.erase(pt);  // decommissioned: stop dialing it forever
+        Erase(pt);  // decommissioned: stop dialing it forever
         TB_LOG(WARNING) << "endpoint " << tbutil::endpoint2str(pt)
                         << " still down after "
                         << g_expiry_s->load(std::memory_order_relaxed)
@@ -106,15 +121,24 @@ struct HealthChecker::Impl {
       lk.unlock();
       const int timeout_ms = static_cast<int>(
           g_probe_timeout_ms->load(std::memory_order_relaxed));
-      // Concurrent probes: one blackholed endpoint burning its full
-      // connect timeout must not delay the revival of the others.
+      // Concurrent probes so one blackholed endpoint burning its full
+      // connect timeout does not delay the revival of the others — but
+      // bounded: during a mass outage, thread count must not scale with
+      // the number of down endpoints.
+      constexpr size_t kMaxProbers = 8;
       std::vector<char> probe_up(candidates.size(), 0);
       {
+        std::atomic<size_t> next{0};
+        const size_t n_threads = std::min(kMaxProbers, candidates.size());
         std::vector<std::thread> probers;
-        probers.reserve(candidates.size());
-        for (size_t i = 0; i < candidates.size(); ++i) {
-          probers.emplace_back([&, i] {
-            probe_up[i] = ProbeOnce(candidates[i], timeout_ms) ? 1 : 0;
+        probers.reserve(n_threads);
+        for (size_t t = 0; t < n_threads; ++t) {
+          probers.emplace_back([&] {
+            size_t i;
+            while ((i = next.fetch_add(1, std::memory_order_relaxed)) <
+                   candidates.size()) {
+              probe_up[i] = ProbeOnce(candidates[i], timeout_ms) ? 1 : 0;
+            }
           });
         }
         for (auto& t : probers) t.join();
@@ -123,7 +147,7 @@ struct HealthChecker::Impl {
       for (size_t i = 0; i < candidates.size(); ++i) {
         if (probe_up[i] == 0) continue;
         const auto& pt = candidates[i];
-        if (down.erase(pt) > 0) {
+        if (Erase(pt)) {
           revived << 1;
           // Lift circuit-breaker isolation: the prober has fresher evidence
           // than the backoff window.
@@ -150,7 +174,10 @@ void HealthChecker::ScheduleCheck(const tbutil::EndPoint& pt,
   std::lock_guard<std::mutex> lk(_impl->mu);
   auto& st = _impl->down[pt];
   if (st.since_us == 0) st.since_us = tbutil::monotonic_time_us();
-  st.expensive = st.expensive || expensive;
+  if (expensive && !st.expensive) {
+    st.expensive = true;
+    _impl->expensive_count.fetch_add(1, std::memory_order_relaxed);
+  }
   if (!_impl->thread_running) {
     _impl->thread_running = true;
     std::thread([impl = _impl] { impl->Loop(); }).detach();
@@ -163,6 +190,10 @@ bool HealthChecker::IsDown(const tbutil::EndPoint& pt) {
 }
 
 bool HealthChecker::ShouldFailFast(const tbutil::EndPoint& pt) {
+  // Per-RPC hot path: no lock unless some endpoint is actually blackholed.
+  if (_impl->expensive_count.load(std::memory_order_relaxed) == 0) {
+    return false;
+  }
   std::lock_guard<std::mutex> lk(_impl->mu);
   auto it = _impl->down.find(pt);
   return it != _impl->down.end() && it->second.expensive;

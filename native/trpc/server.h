@@ -86,6 +86,16 @@ class Server {
   // latency_us: handler wall time for admitted+finished requests; -1 from
   // the shed path (never reached the limiter's accounting).
   void EndRequest(int64_t latency_us);
+
+  // Server-side streams (StreamAccept) hold the server exactly like an
+  // in-flight request: Stop() must not return while a stream's consumer
+  // fiber or its handler's on_closed can still run — the handler is
+  // typically user memory that dies right after Stop(). Balanced by
+  // finish_close (stream.cpp).
+  void AddStreamHold() {
+    _concurrency.fetch_add(1, std::memory_order_acquire);
+  }
+  void ReleaseStreamHold() { EndRequest(-1); }
   int32_t concurrency() const {
     return _concurrency.load(std::memory_order_relaxed);
   }

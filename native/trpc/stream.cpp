@@ -12,6 +12,7 @@
 #include "tbutil/logging.h"
 #include "trpc/controller.h"
 #include "trpc/errno.h"
+#include "trpc/server.h"
 #include "trpc/socket.h"
 #include "trpc/stream_internal.h"
 
@@ -45,6 +46,10 @@ struct Stream {
   std::atomic<int64_t> last_feedback{0};
 
   tbthread::Butex* close_btx;  // StreamWait
+
+  // Server-side streams pin their Server (drain barrier) until close
+  // completes — see Server::AddStreamHold. Cleared exactly once.
+  std::atomic<void*> hold_server{nullptr};
 
   Stream() : wbtx(tbthread::butex_create()),
              close_btx(tbthread::butex_create()) {}
@@ -123,12 +128,31 @@ bool self_is_consumer(StreamId id) {
 // the Stream (and the ExecutionQueue the consumer is still iterating) be
 // freed under the consumer's feet (ADVICE r1 use-after-free).
 void finish_close(const StreamPtr& s) {
+  // Mark this context (fiber OR pthread — the key falls back to a
+  // thread-local table off-fiber) as the stream's closing context:
+  // StreamWait from inside on_closed must return instead of parking on a
+  // wake that only this function can deliver.
+  void* const prev_mark = tbthread::fiber_getspecific(consuming_key());
+  tbthread::fiber_setspecific(
+      consuming_key(), reinterpret_cast<void*>(static_cast<uintptr_t>(s->id)));
   s->incoming.stop_and_join();
   if (s->options.handler != nullptr) {
     s->options.handler->on_closed(s->id);
   }
-  tbthread::butex_increment_and_wake_all(s->close_btx);
+  tbthread::fiber_setspecific(consuming_key(), prev_mark);
+  // Erase BEFORE waking: StreamWait treats "gone from the registry" as the
+  // close-complete signal, so a woken waiter that still finds the stream
+  // can safely re-park (another wake always follows the erase... because
+  // this wake IS after the erase). Waiters hold a StreamPtr, so the butex
+  // outlives the registry entry.
   erase_stream(s->id);
+  tbthread::butex_increment_and_wake_all(s->close_btx);
+  // AFTER the handler's last callback: Server::Stop may now return (and
+  // the user may free the handler).
+  void* srv = s->hold_server.exchange(nullptr, std::memory_order_acq_rel);
+  if (srv != nullptr) {
+    static_cast<Server*>(srv)->ReleaseStreamHold();
+  }
 }
 
 void* closer_thunk(void* arg) {
@@ -264,6 +288,13 @@ int StreamAccept(StreamId* response_stream, Controller& cntl,
   s->connected.store(true, std::memory_order_release);
   SocketUniquePtr sock;
   if (Socket::Address(acc.server_socket(), &sock) == 0) {
+    // Pin the server BEFORE the stream becomes failure-reachable: its
+    // handler (user memory) must stay valid until our on_closed, and
+    // Server::Stop guarantees that by draining stream holds.
+    if (sock->user() != nullptr) {
+      static_cast<Server*>(sock->user())->AddStreamHold();
+      s->hold_server.store(sock->user(), std::memory_order_release);
+    }
     sock->AddPendingStream(s->id);
     // Registration/failure race: OnFailed may have drained the pending list
     // just before our insert — self-notify so the stream can't outlive a
@@ -332,12 +363,23 @@ int StreamClose(StreamId stream) {
 }
 
 int StreamWait(StreamId stream) {
+  // Returns only when the close has fully COMPLETED (consumer joined,
+  // on_closed delivered, registry entry gone) — not merely started. After
+  // this, the caller may free its StreamInputHandler.
   while (true) {
     StreamPtr s = find_stream(stream);
     if (s == nullptr) return 0;  // closed + erased
+    // Called from this stream's own consumer tenure or close context (a
+    // handler callback): the wake we'd park for can only be delivered by
+    // the very context we're in — return instead of self-deadlocking.
+    if (self_is_consumer(stream)) return 0;
     const int seq =
         tbthread::butex_value(s->close_btx)->load(std::memory_order_acquire);
-    if (s->closed.load(std::memory_order_acquire)) return 0;
+    // Re-check AFTER the seq snapshot: a close that completed in between
+    // already bumped the value (erase happens before the wake), so either
+    // this lookup misses, or any later wake makes butex_wait return on the
+    // seq mismatch — a lost-wake park is impossible.
+    if (find_stream(stream) == nullptr) return 0;
     tbthread::butex_wait(s->close_btx, seq, nullptr);
   }
 }

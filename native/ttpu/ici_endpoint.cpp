@@ -25,10 +25,16 @@ namespace {
 
 // Segment geometry, hot-reloadable (read at endpoint creation; reference
 // FLAGS_rdma_memory_pool_* knobs).
+// Defaults sized for tensor traffic: 1MB blocks cut doorbell/credit
+// round-trips per large message ~16x vs 64KB (measured: 16MB echo 1.6 ->
+// 4.2 GB/s, 1MB echo 3.0 -> 3.8 GB/s single-core), and 64 of them give a
+// 64MB window — four 16MB messages in flight. Small-RPC QPS is unaffected
+// (<= ici_inline_max rides the control channel). Memory cost is per
+// tpu:// connection, which exist at device-mesh scale, not fleet scale.
 std::atomic<int64_t>* g_ici_block_size = TRPC_DEFINE_FLAG(
-    ici_block_size, 64 * 1024, "tpu:// transport TX block size in bytes");
+    ici_block_size, 1024 * 1024, "tpu:// transport TX block size in bytes");
 std::atomic<int64_t>* g_ici_blocks = TRPC_DEFINE_FLAG(
-    ici_blocks, 128, "tpu:// transport TX blocks per connection direction");
+    ici_blocks, 64, "tpu:// transport TX blocks per connection direction");
 // Messages at or below this ride the control channel as plain bytes — a
 // 64KB block per tiny RPC would cap in-flight QPS at the window size.
 std::atomic<int64_t>* g_ici_inline_max = TRPC_DEFINE_FLAG(
@@ -159,6 +165,9 @@ int IciEndpoint::CompleteClient(const std::string& peer_name,
   _rx = IciSegment::MapPeer(peer_name, peer_block_size, peer_blocks);
   if (_rx == nullptr) return -1;
   PeerSegmentRegistry::Register(_rx, _socket_id);
+  // The ACK proves the server mapped our TX segment (StartServer maps
+  // before ACKing): its /dev/shm name can disappear now.
+  _tx->UnlinkEarly();
   _state.store(State::kActive, std::memory_order_release);
   tbthread::butex_increment_and_wake_all(_hs_btx);
   return 0;
@@ -456,6 +465,9 @@ trpc::ParseResult tici_parse(tbutil::IOBuf* source, trpc::Socket* socket) {
           r.error = trpc::PARSE_ERROR_ABSOLUTELY_WRONG;
           return r;
         }
+        // Any frame from an active peer proves it finished CompleteClient
+        // (clients only send after WaitActive) — our TX name can go.
+        ep->tx()->UnlinkEarly();
         if (source->size() < kPrefix + 4) {
           r.error = trpc::PARSE_ERROR_NOT_ENOUGH_DATA;
           return r;

@@ -110,6 +110,16 @@ IciSegment::~IciSegment() {
     munmap(_base, size_t(_block_size) * _n_blocks);
   }
   if (_owner) {
+    shm_unlink(_name.c_str());  // no-op (ENOENT) after UnlinkEarly
+  }
+}
+
+void IciSegment::UnlinkEarly() {
+  // _owner doubles as the once-guard: this is called from the data-frame
+  // hot path, and a repeat would pay a failing shm_unlink syscall per
+  // message. (The destructor's unlink keys off _owner too — already done.)
+  if (_owner) {
+    _owner = false;
     shm_unlink(_name.c_str());
   }
 }

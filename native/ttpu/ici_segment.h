@@ -36,6 +36,10 @@ class IciSegment {
   ~IciSegment();
 
   const std::string& name() const { return _name; }
+  // Owner side, once the peer confirmed its mapping: remove the /dev/shm
+  // name NOW (mappings live on). After this, a hard-killed process can no
+  // longer leak the segment file. Idempotent.
+  void UnlinkEarly();
   uint32_t block_size() const { return _block_size; }
   uint32_t n_blocks() const { return _n_blocks; }
   char* block(uint32_t idx) const { return _base + size_t(idx) * _block_size; }
