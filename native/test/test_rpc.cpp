@@ -15,6 +15,7 @@
 #include "trpc/server.h"
 #include "trpc/socket_map.h"
 #include "trpc/health_check.h"
+#include "trpc/span.h"
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/tstd_protocol.h"
@@ -654,6 +655,105 @@ TEST_CASE(auto_concurrency_limiter_converges) {
   ASSERT_TRUE(adaptive.final_limit < 24);
   ASSERT_TRUE(adaptive.median_depth <= unlimited.median_depth / 2);
   ASSERT_TRUE(adaptive.shed > 0);
+}
+
+namespace {
+
+// A -> B relay: the nested call must inherit A's server span as parent.
+class RelayService : public Service {
+ public:
+  explicit RelayService(const std::string& target) {
+    ChannelOptions o;
+    o.timeout_ms = 2000;
+    _ch.Init(target.c_str(), &o);
+  }
+  std::string_view service_name() const override { return "RelayService"; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    Controller sub;
+    tbutil::IOBuf resp2;
+    _ch.CallMethod("EchoService/Echo", &sub, request, &resp2, nullptr);
+    if (sub.Failed()) {
+      cntl->SetFailed(sub.ErrorCode(), "relay failed: " + sub.ErrorText());
+    } else {
+      response->append(resp2);
+    }
+    done->Run();
+  }
+
+ private:
+  Channel _ch;
+};
+
+}  // namespace
+
+// rpcz: a client -> A -> B chain produces four spans linked into ONE trace:
+// outer client (root), A's server span (parent = outer client), A's nested
+// client span (parent = A's server span), B's server span (parent = the
+// nested client span). Reference span.h:47-69 + builtin/rpcz_service.cpp.
+TEST_CASE(rpcz_nested_trace_links) {
+  auto& flags = FlagRegistry::global();
+  ASSERT_TRUE(flags.Set("rpcz_enabled", "1"));
+
+  Server server_b;
+  EchoService echo;
+  ASSERT_EQ(server_b.AddService(&echo), 0);
+  ASSERT_EQ(server_b.Start(0), 0);
+  char addr_b[32];
+  snprintf(addr_b, sizeof(addr_b), "127.0.0.1:%d",
+           server_b.listen_address().port);
+
+  Server server_a;
+  RelayService relay(addr_b);
+  ASSERT_EQ(server_a.AddService(&relay), 0);
+  ASSERT_EQ(server_a.Start(0), 0);
+  char addr_a[32];
+  snprintf(addr_a, sizeof(addr_a), "127.0.0.1:%d",
+           server_a.listen_address().port);
+
+  Channel ch;
+  ASSERT_EQ(ch.Init(addr_a, nullptr), 0);
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("traced");
+  ch.CallMethod("RelayService/Go", &cntl, req, &resp, nullptr);
+  ASSERT_FALSE(cntl.Failed());
+  ASSERT_TRUE(resp.equals("traced"));
+  ASSERT_TRUE(flags.Set("rpcz_enabled", "0"));
+
+  // Root = most recent client span with no parent (the outer call).
+  std::vector<Span> spans;
+  SpanStore::global().Dump(&spans);
+  const Span* root = nullptr;
+  for (const Span& s : spans) {
+    if (!s.server_side && s.parent_span_id == 0 &&
+        s.service_method == "RelayService/Go") {
+      root = &s;
+      break;
+    }
+  }
+  ASSERT_TRUE(root != nullptr);
+  std::vector<Span> trace;
+  SpanStore::global().Dump(&trace, root->trace_id);
+  ASSERT_EQ(trace.size(), size_t{4});
+  auto find_child = [&](uint64_t parent) -> const Span* {
+    for (const Span& s : trace) {
+      if (s.parent_span_id == parent) return &s;
+    }
+    return nullptr;
+  };
+  const Span* a_server = find_child(root->span_id);
+  ASSERT_TRUE(a_server != nullptr && a_server->server_side);
+  ASSERT_EQ(a_server->service_method, std::string("RelayService/Go"));
+  const Span* nested_client = find_child(a_server->span_id);
+  ASSERT_TRUE(nested_client != nullptr && !nested_client->server_side);
+  ASSERT_EQ(nested_client->service_method, std::string("EchoService/Echo"));
+  const Span* b_server = find_child(nested_client->span_id);
+  ASSERT_TRUE(b_server != nullptr && b_server->server_side);
+
+  server_a.Stop();
+  server_b.Stop();
 }
 
 // kShort over tstd: a fresh connection per RPC, closed on completion —

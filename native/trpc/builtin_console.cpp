@@ -1,5 +1,6 @@
 #include "trpc/builtin_console.h"
 
+#include <algorithm>
 #include <map>
 #include <mutex>
 #include <string>
@@ -12,6 +13,7 @@
 #include "trpc/http_protocol.h"
 #include "trpc/server.h"
 #include "trpc/socket.h"
+#include "trpc/span.h"
 
 namespace trpc {
 
@@ -161,10 +163,58 @@ void health_page(const HttpRequest&, HttpResponse* resp) {
   resp->body = "OK\n";
 }
 
-// Replaced by the span-backed page once rpcz sampling lands; registering a
-// stub keeps the index link honest.
-void rpcz_page(const HttpRequest&, HttpResponse* resp) {
-  resp->body = "rpcz: no spans sampled yet\n";
+// /rpcz: recent spans, most recent first; /rpcz?trace=HEX narrows to one
+// trace rendered oldest-first with parent links (reference
+// builtin/rpcz_service.cpp).
+void rpcz_page(const HttpRequest& req, HttpResponse* resp) {
+  std::string& b = resp->body;
+  if (!rpcz_enabled()) {
+    b = "rpcz is off. Enable span collection live:\n"
+        "  GET /flags/rpcz_enabled?setvalue=1\n";
+    // Still fall through and show whatever was collected while it was on.
+  }
+  uint64_t want_trace = 0;
+  const std::string t = req.query_param("trace");
+  if (!t.empty()) {
+    want_trace = strtoull(t.c_str(), nullptr, 16);
+  }
+  std::vector<Span> spans;
+  SpanStore::global().Dump(&spans, want_trace);
+  if (spans.empty()) {
+    b += "no spans collected\n";
+    return;
+  }
+  char line[256];
+  if (want_trace != 0) {
+    // One trace, oldest first, with indent by parent depth (2 legs deep is
+    // the common case; deeper chains still read fine flat).
+    std::reverse(spans.begin(), spans.end());
+    snprintf(line, sizeof(line), "trace %016llx — %zu span(s)\n",
+             static_cast<unsigned long long>(want_trace), spans.size());
+    b += line;
+    for (const Span& s : spans) {
+      snprintf(line, sizeof(line),
+               "  [%c] %-32s peer=%-21s %8lldus err=%d span=%016llx "
+               "parent=%016llx\n",
+               s.server_side ? 'S' : 'C', s.service_method.c_str(),
+               tbutil::endpoint2str(s.remote_side).c_str(),
+               static_cast<long long>(s.end_us - s.start_us), s.error_code,
+               static_cast<unsigned long long>(s.span_id),
+               static_cast<unsigned long long>(s.parent_span_id));
+      b += line;
+    }
+    return;
+  }
+  b += "recent spans (newest first); drill down with /rpcz?trace=HEX\n";
+  for (const Span& s : spans) {
+    snprintf(line, sizeof(line),
+             "trace=%016llx [%c] %-32s peer=%-21s %8lldus err=%d\n",
+             static_cast<unsigned long long>(s.trace_id),
+             s.server_side ? 'S' : 'C', s.service_method.c_str(),
+             tbutil::endpoint2str(s.remote_side).c_str(),
+             static_cast<long long>(s.end_us - s.start_us), s.error_code);
+    b += line;
+  }
 }
 
 }  // namespace

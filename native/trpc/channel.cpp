@@ -5,6 +5,7 @@
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/errno.h"
+#include "trpc/span.h"
 #include "trpc/tstd_protocol.h"
 
 namespace trpc {
@@ -81,6 +82,15 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   cntl->_connection_type = static_cast<uint8_t>(_options.connection_type);
   if (cntl->_backup_request_ms == -1) {
     cntl->_backup_request_ms = _options.backup_request_ms;
+  }
+  // rpcz: mint this leg's span, inheriting the fiber's trace context (set
+  // while a traced server handler runs) so nested calls link up.
+  if (rpcz_enabled()) {
+    const TraceContext parent = current_trace_context();
+    cntl->_trace_id =
+        parent.trace_id != 0 ? parent.trace_id : new_trace_or_span_id();
+    cntl->_parent_span_id = parent.span_id;
+    cntl->_span_id = new_trace_or_span_id();
   }
   cntl->_service_method = service_method;
   cntl->_remote_side = _server;

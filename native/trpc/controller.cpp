@@ -9,6 +9,7 @@
 #include "trpc/load_balancer.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/socket_map.h"
+#include "trpc/span.h"
 #include "trpc/stream_internal.h"
 #include "trpc/tstd_protocol.h"
 
@@ -47,6 +48,9 @@ void Controller::Reset() {
   _backup_request_ms = -1;
   _backup_timer_id = 0;
   _pending_hedges = 0;
+  _trace_id = 0;
+  _span_id = 0;
+  _parent_span_id = 0;
   _request_stream = 0;
   _response_stream = 0;
   _remote_stream_id = 0;
@@ -488,6 +492,20 @@ void Controller::EndRPC(int error, const std::string& error_text) {
         << (_end_time_us - _begin_time_us);
   } else {
     GlobalRpcMetrics::instance().client_errors << 1;
+  }
+  // rpcz: record this client leg (reference span.cpp EndAsParent).
+  if (_trace_id != 0) {
+    Span sp;
+    sp.trace_id = _trace_id;
+    sp.span_id = _span_id;
+    sp.parent_span_id = _parent_span_id;
+    sp.server_side = false;
+    sp.start_us = _begin_time_us;
+    sp.end_us = _end_time_us;
+    sp.error_code = _error_code;
+    sp.service_method = _service_method;
+    sp.remote_side = _remote_side;
+    SpanStore::global().Record(std::move(sp));
   }
   Closure* done = _done;
   const tbthread::fiber_id_t cid = _correlation_id;

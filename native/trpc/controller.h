@@ -151,6 +151,13 @@ class Controller {
 
   bool _server_side = false;
 
+  // rpcz span identity of this RPC leg (0 = untraced). Client side: minted
+  // in CallMethod, inheriting the fiber's trace context; server side: read
+  // from the request meta (span.h).
+  uint64_t _trace_id = 0;
+  uint64_t _span_id = 0;
+  uint64_t _parent_span_id = 0;
+
   // Streaming RPC handshake state (stream.h / stream_internal.h).
   uint64_t _request_stream = 0;        // client: local stream id
   uint64_t _response_stream = 0;       // server: local stream id (accepted)
@@ -197,6 +204,14 @@ class ControllerPrivateAccessor {
   bool AcceptResponseFor(tbthread::fiber_id_t id) {
     return _c->AcceptResponseFor(id);
   }
+  void set_trace(uint64_t trace_id, uint64_t span_id, uint64_t parent) {
+    _c->_trace_id = trace_id;
+    _c->_span_id = span_id;
+    _c->_parent_span_id = parent;
+  }
+  uint64_t trace_id() const { return _c->_trace_id; }
+  uint64_t span_id() const { return _c->_span_id; }
+  uint64_t parent_span_id() const { return _c->_parent_span_id; }
   void EndRPC(int error, const std::string& text) { _c->EndRPC(error, text); }
 
  private:

@@ -1,0 +1,113 @@
+#include "trpc/span.h"
+
+#include <mutex>
+
+#include "tbthread/key.h"
+#include "tbutil/fast_rand.h"
+#include "trpc/flags.h"
+
+namespace trpc {
+
+static auto* g_rpcz_enabled = TRPC_DEFINE_FLAG(
+    rpcz_enabled, 0, "collect per-RPC spans for /rpcz (1 = on)");
+static auto* g_rpcz_max_spans = TRPC_DEFINE_FLAG(
+    rpcz_max_spans, 2048, "span ring capacity (applied at first record)");
+
+bool rpcz_enabled() {
+  return g_rpcz_enabled->load(std::memory_order_relaxed) != 0;
+}
+
+uint64_t new_trace_or_span_id() {
+  uint64_t id;
+  do {
+    id = tbutil::fast_rand();
+  } while (id == 0);
+  return id;
+}
+
+// ---------------- ring store ----------------
+
+struct SpanStore::Impl {
+  std::mutex mu;
+  std::vector<Span> ring;  // sized lazily from the flag
+  size_t next = 0;         // ring cursor
+  uint64_t seq = 0;        // total recorded (recency ordering)
+  std::vector<uint64_t> seqs;
+};
+
+SpanStore::SpanStore() : _impl(new Impl) {}
+
+void SpanStore::Record(Span&& span) {
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  if (_impl->ring.empty()) {
+    size_t cap = static_cast<size_t>(
+        g_rpcz_max_spans->load(std::memory_order_relaxed));
+    if (cap < 16) cap = 16;
+    _impl->ring.resize(cap);
+    _impl->seqs.assign(cap, 0);
+  }
+  _impl->ring[_impl->next] = std::move(span);
+  _impl->seqs[_impl->next] = ++_impl->seq;
+  _impl->next = (_impl->next + 1) % _impl->ring.size();
+}
+
+void SpanStore::Dump(std::vector<Span>* out, uint64_t trace_id) {
+  out->clear();
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  const size_t n = _impl->ring.size();
+  if (n == 0) return;
+  // Walk backward from the cursor: most recent first.
+  for (size_t i = 0; i < n; ++i) {
+    const size_t idx = (_impl->next + n - 1 - i) % n;
+    if (_impl->seqs[idx] == 0) break;  // never filled
+    const Span& s = _impl->ring[idx];
+    if (trace_id != 0 && s.trace_id != trace_id) continue;
+    out->push_back(s);
+  }
+}
+
+SpanStore& SpanStore::global() {
+  static SpanStore* s = new SpanStore;
+  return *s;
+}
+
+// ---------------- fiber-local context ----------------
+
+namespace {
+
+void trace_ctx_dtor(void* p) { delete static_cast<TraceContext*>(p); }
+
+tbthread::FiberKey trace_key() {
+  static tbthread::FiberKey key = [] {
+    tbthread::FiberKey k;
+    tbthread::fiber_key_create(&k, trace_ctx_dtor);
+    return k;
+  }();
+  return key;
+}
+
+}  // namespace
+
+TraceContext current_trace_context() {
+  auto* ctx =
+      static_cast<TraceContext*>(tbthread::fiber_getspecific(trace_key()));
+  return ctx != nullptr ? *ctx : TraceContext{};
+}
+
+void set_current_trace_context(const TraceContext& ctx) {
+  auto* cur =
+      static_cast<TraceContext*>(tbthread::fiber_getspecific(trace_key()));
+  if (cur == nullptr) {
+    cur = new TraceContext;
+    tbthread::fiber_setspecific(trace_key(), cur);
+  }
+  *cur = ctx;
+}
+
+void clear_current_trace_context() {
+  auto* cur =
+      static_cast<TraceContext*>(tbthread::fiber_getspecific(trace_key()));
+  if (cur != nullptr) *cur = TraceContext{};  // keep the allocation
+}
+
+}  // namespace trpc

@@ -1,0 +1,64 @@
+// rpcz: per-RPC span collection with trace propagation.
+// Capability parity: reference src/brpc/span.h:47-69 (Span with
+// trace/span/parent ids riding the RpcMeta; collected per leg) +
+// builtin/rpcz_service.cpp (the /rpcz page). Differences by design: spans
+// land in a fixed ring (no disk spill), and the cross-call context rides a
+// fiber-local slot (the reference uses bthread-local storage the same way).
+//
+// Propagation: a server handler's fiber carries {trace_id, span_id} while
+// the handler runs; any Channel::CallMethod issued from it stamps
+// parent_span_id = the server span, same trace_id — so a client -> A -> B
+// chain renders as one linked trace at /rpcz.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tbutil/endpoint.h"
+
+namespace trpc {
+
+struct Span {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  bool server_side = false;
+  int64_t start_us = 0;   // gettimeofday clock
+  int64_t end_us = 0;
+  int error_code = 0;
+  std::string service_method;
+  tbutil::EndPoint remote_side;
+};
+
+// Fixed ring of the most recent spans (rpcz_max_spans flag). Recording is
+// gated on the rpcz_enabled flag at the CALL SITES, not here.
+class SpanStore {
+ public:
+  void Record(Span&& span);
+  // Most-recent-first. trace_id != 0 filters to one trace.
+  void Dump(std::vector<Span>* out, uint64_t trace_id = 0);
+  static SpanStore& global();
+
+ private:
+  struct Impl;
+  Impl* _impl;
+  SpanStore();
+};
+
+// True when spans should be collected (rpcz_enabled flag, hot-path cached).
+bool rpcz_enabled();
+
+// Fiber-local trace context (valid while a traced handler runs).
+struct TraceContext {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+};
+TraceContext current_trace_context();
+void set_current_trace_context(const TraceContext& ctx);
+void clear_current_trace_context();
+
+// Non-zero random id (fast_rand based).
+uint64_t new_trace_or_span_id();
+
+}  // namespace trpc

@@ -1,6 +1,7 @@
 #include "trpc/tstd_protocol.h"
 
 #include <algorithm>
+#include <csignal>
 #include <bit>
 #include <cstring>
 #include <mutex>
@@ -15,6 +16,7 @@
 #include "trpc/rpc_metrics.h"
 #include "trpc/server.h"
 #include "trpc/socket.h"
+#include "trpc/span.h"
 #include "trpc/stream_internal.h"
 #include "ttpu/ici_endpoint.h"
 
@@ -180,6 +182,10 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
   meta.attachment_size =
       static_cast<uint32_t>(cntl->request_attachment().size());
   ControllerPrivateAccessor acc0(cntl);
+  // rpcz propagation: the server's span will parent on OUR span id.
+  meta.trace_id = acc0.trace_id();
+  meta.span_id = acc0.span_id();
+  meta.parent_span_id = acc0.parent_span_id();
   if (acc0.request_stream() != 0) {
     meta.stream_id = acc0.request_stream();
     meta.stream_window = stream_internal::AdvertisedWindow(meta.stream_id);
@@ -290,16 +296,35 @@ void tstd_process_request(InputMessageBase* base) {
   Service* svc = server->FindService(msg->meta.service);
   // Per-method stats (reference details/method_status.h): looked up only
   // for REGISTERED services so junk service names can't mint entries.
+  std::string full_method = msg->meta.service + "/" + msg->meta.method;
   MethodStatus* ms = nullptr;
   if (svc != nullptr) {
-    ms = GetMethodStatus(msg->meta.service + "/" + msg->meta.method);
+    ms = GetMethodStatus(full_method);
     ms->OnRequested();
   }
   const int64_t received_us = tbutil::gettimeofday_us();
+  // rpcz: with collection on, every request gets a server span — parenting
+  // on the client's span when the request carries one, or starting a fresh
+  // self-sampled trace otherwise (a server debugged in isolation must see
+  // its own traffic). The handler fiber carries the context so nested
+  // calls link up.
+  uint64_t server_span_id = 0;
+  uint64_t span_trace_id = msg->meta.trace_id;
+  if (rpcz_enabled()) {
+    server_span_id = new_trace_or_span_id();
+    if (span_trace_id == 0) span_trace_id = new_trace_or_span_id();
+    acc.set_trace(span_trace_id, server_span_id, msg->meta.span_id);
+  }
+  const uint64_t span_parent = msg->meta.span_id;
+  // Untraced requests carry an empty string into the closure, not a copy.
+  const std::string span_method =
+      server_span_id != 0 ? full_method : std::string();
+  const tbutil::EndPoint span_remote = s->remote_side();
   // From here the gate is released exactly once — by done (the single
   // teardown path for both the error and success branches).
-  Closure* done =
-      NewCallback([sid, cid, cntl, response, server, ms, received_us]() {
+  Closure* done = NewCallback(
+      [sid, cid, cntl, response, server, ms, received_us, server_span_id,
+       span_trace_id, span_parent, span_method, span_remote]() {
         // Clamped: gettimeofday can step backward (NTP), and a negative
         // value here would read as the shed sentinel in EndRequest,
         // leaking a limiter slot.
@@ -307,6 +332,19 @@ void tstd_process_request(InputMessageBase* base) {
             std::max<int64_t>(0, tbutil::gettimeofday_us() - received_us);
         if (ms != nullptr) {
           ms->OnResponded(cntl->ErrorCode(), latency_us);
+        }
+        if (server_span_id != 0) {
+          Span sp;
+          sp.trace_id = span_trace_id;
+          sp.span_id = server_span_id;
+          sp.parent_span_id = span_parent;
+          sp.server_side = true;
+          sp.start_us = received_us;
+          sp.end_us = received_us + latency_us;
+          sp.error_code = cntl->ErrorCode();
+          sp.service_method = span_method;
+          sp.remote_side = span_remote;
+          SpanStore::global().Record(std::move(sp));
         }
         tstd_send_response(sid, cid, cntl, response);
         server->EndRequest(latency_us);
@@ -323,6 +361,16 @@ void tstd_process_request(InputMessageBase* base) {
   tbutil::IOBuf request = std::move(msg->payload);
   std::string method = std::move(msg->meta.method);
   delete msg;
+  if (server_span_id != 0) {
+    // The context lives for the synchronous part of the handler — where
+    // nested client calls are issued. (An async handler that parks `done`
+    // on another fiber makes nested calls untraced, same as the reference's
+    // bthread-local scope.)
+    set_current_trace_context({span_trace_id, server_span_id});
+    svc->CallMethod(method, cntl, request, response, done);
+    clear_current_trace_context();
+    return;
+  }
   svc->CallMethod(method, cntl, request, response, done);
 }
 
@@ -331,6 +379,10 @@ void tstd_process_request(InputMessageBase* base) {
 void GlobalInitializeOrDie() {
   static std::once_flag once;
   std::call_once(once, [] {
+    // A peer closing mid-write must surface as EPIPE from the write call,
+    // never as a process-killing signal (reference: brpc ignores SIGPIPE
+    // the same way; every network daemon does).
+    signal(SIGPIPE, SIG_IGN);
     Protocol p;
     p.parse = tstd_parse;
     p.pack_request = tstd_pack_request;
