@@ -36,6 +36,11 @@ int fiber_get_concurrency() {
   return control()->concurrency();
 }
 
+int fiber_add_worker_group(int tag, int nworkers,
+                           const std::vector<int>& cpus) {
+  return control()->add_worker_group(tag, nworkers, cpus);
+}
+
 namespace {
 TaskControl* control() {
   // First use locks in the concurrency (fiber_set_concurrency is plumbed via

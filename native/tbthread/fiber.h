@@ -3,6 +3,8 @@
 // background, join, yield, usleep, attrs, concurrency).
 #pragma once
 
+#include <vector>
+
 #include "tbthread/task_meta.h"
 
 namespace tbthread {
@@ -27,6 +29,14 @@ int fiber_get_concurrency();
 // Must be called before the scheduler starts (i.e. before any fiber API use);
 // otherwise returns EPERM.
 int fiber_set_concurrency(int n);
+
+// Create an isolated worker pool for `tag` (1..7) with `nworkers` pthreads,
+// optionally pinned to `cpus` (core ids). Fibers started with
+// FiberAttr{.tag = tag} run ONLY on this pool (no cross-tag stealing) —
+// e.g. dedicated cores feeding a libtpu stream. One-shot per tag; 0 on
+// success. Reference: bthread tagged task groups (task_control.h:61).
+int fiber_add_worker_group(int tag, int nworkers,
+                           const std::vector<int>& cpus = {});
 
 // Test/shutdown hook: stops all workers. Irreversible within the process.
 void fiber_stop_world();

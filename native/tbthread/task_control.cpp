@@ -1,5 +1,7 @@
 #include "tbthread/task_control.h"
 
+#include <pthread.h>
+#include <sched.h>
 #include <stdlib.h>
 
 #include <mutex>
@@ -28,44 +30,125 @@ TaskControl* TaskControl::singleton() {
   return c;
 }
 
+namespace {
+// Serializes tag creation against stop_and_join (both mutate TagData
+// vectors; tags created after stop would otherwise never be joined).
+std::mutex g_tag_mu;
+}  // namespace
+
+TaskControl::TagData* TaskControl::make_tag(int tag, int nworkers,
+                                            const std::vector<int>& cpus,
+                                            bool* pin_ok) {
+  auto* td = new TagData;
+  td->groups.reserve(nworkers);
+  for (int i = 0; i < nworkers; ++i) {
+    td->groups.push_back(new TaskGroup(this, tag));
+  }
+  // Publish BEFORE the workers start: run_main_task reads the tag's lot.
+  // (Pinning is safe against this ordering because each worker pins ITSELF
+  // before entering the run loop — no fiber executes unpinned.)
+  _tags[tag].store(td, std::memory_order_release);
+  std::atomic<int> pin_failures{0};
+  std::atomic<int> started{0};
+  for (int i = 0; i < nworkers; ++i) {
+    TaskGroup* g = td->groups[i];
+    td->workers.emplace_back([g, &cpus, &pin_failures, &started, tag, i]() {
+      if (!cpus.empty()) {
+        cpu_set_t set;
+        CPU_ZERO(&set);
+        for (int cpu : cpus) CPU_SET(cpu, &set);
+        if (pthread_setaffinity_np(pthread_self(), sizeof(set), &set) != 0) {
+          pin_failures.fetch_add(1, std::memory_order_relaxed);
+          TB_LOG(WARNING) << "failed to pin tag " << tag << " worker " << i;
+        }
+      }
+      started.fetch_add(1, std::memory_order_release);
+      g->run_main_task();
+    });
+  }
+  // Wait for every worker to pass its pinning step: cpus/pin_failures are
+  // this frame's, and the caller needs the verdict.
+  while (started.load(std::memory_order_acquire) < nworkers) {
+    std::this_thread::yield();
+  }
+  if (pin_ok != nullptr) *pin_ok = pin_failures.load() == 0;
+  return td;
+}
+
 int TaskControl::init(int concurrency) {
   if (concurrency <= 0) return -1;
-  _groups.reserve(concurrency);
-  for (int i = 0; i < concurrency; ++i) {
-    _groups.push_back(new TaskGroup(this));
-  }
-  for (int i = 0; i < concurrency; ++i) {
-    TaskGroup* g = _groups[i];
-    _workers.emplace_back([g]() { g->run_main_task(); });
-  }
+  std::lock_guard<std::mutex> lk(g_tag_mu);
+  make_tag(0, concurrency, {}, nullptr);
   return 0;
 }
 
-void TaskControl::stop_and_join() {
-  _stopped.store(true, std::memory_order_release);
-  _pl.stop();
-  for (auto& w : _workers) {
-    if (w.joinable()) w.join();
+int TaskControl::add_worker_group(int tag, int nworkers,
+                                  const std::vector<int>& cpus) {
+  if (tag <= 0 || tag >= kMaxTags || nworkers <= 0 || nworkers > 256) {
+    return -1;
   }
-  _workers.clear();
+  std::lock_guard<std::mutex> lk(g_tag_mu);
+  if (stopped()) return -1;
+  if (_tags[tag].load(std::memory_order_acquire) != nullptr) return -1;
+  bool pin_ok = true;
+  make_tag(tag, nworkers, cpus, &pin_ok);
+  // Workers run either way (they cannot be unwound safely), but a caller
+  // that asked for pinning must learn it did not happen.
+  return pin_ok ? 0 : -1;
 }
 
-TaskGroup* TaskControl::choose_one_group() {
-  uint32_t r = _round.fetch_add(1, std::memory_order_relaxed);
-  return _groups[r % _groups.size()];
+bool TaskControl::has_tag(int tag) const {
+  return tag >= 0 && tag < kMaxTags &&
+         _tags[tag].load(std::memory_order_acquire) != nullptr;
+}
+
+int TaskControl::concurrency() const {
+  const TagData* td = _tags[0].load(std::memory_order_acquire);
+  return td != nullptr ? static_cast<int>(td->groups.size()) : 0;
+}
+
+void TaskControl::stop_and_join() {
+  std::lock_guard<std::mutex> lk(g_tag_mu);
+  _stopped.store(true, std::memory_order_release);
+  for (int t = 0; t < kMaxTags; ++t) {
+    TagData* td = _tags[t].load(std::memory_order_acquire);
+    if (td == nullptr) continue;
+    td->pl.stop();
+    for (auto& w : td->workers) {
+      if (w.joinable()) w.join();
+    }
+    td->workers.clear();
+  }
+}
+
+ParkingLot* TaskControl::parking_lot(int tag) { return &tag_data(tag)->pl; }
+
+void TaskControl::signal_task(int num, int tag) {
+  tag_data(tag)->pl.signal(num);
+}
+
+TaskGroup* TaskControl::choose_one_group(int tag) {
+  TagData* td = tag_data(tag);
+  uint32_t r = td->round.fetch_add(1, std::memory_order_relaxed);
+  return td->groups[r % td->groups.size()];
 }
 
 void TaskControl::ready_to_run_general(TaskMeta* m, bool signal) {
+  int tag = m->attr.tag;
+  if (!has_tag(tag)) tag = 0;  // unconfigured tag: default pool
   TaskGroup* g = TaskGroup::current();
-  if (g != nullptr && g->control() == this) {
+  if (g != nullptr && g->control() == this && g->tag() == tag) {
     g->ready_to_run(m, signal);
   } else {
-    choose_one_group()->push_remote(m, signal);
+    choose_one_group(tag)->push_remote(m, signal);
   }
 }
 
 bool TaskControl::steal_task(TaskMeta** m, TaskGroup* thief, uint64_t* seed) {
-  const size_t n = _groups.size();
+  // Stealing never crosses tags: a pinned feeder pool must not pick up (or
+  // lose work to) the general pool.
+  TagData* td = tag_data(thief->tag());
+  const size_t n = td->groups.size();
   if (n <= 1) return false;
   // Random start, then sweep — per-thief seed decorrelates victims.
   size_t start = static_cast<size_t>((*seed = *seed * 6364136223846793005ULL +
@@ -73,7 +156,7 @@ bool TaskControl::steal_task(TaskMeta** m, TaskGroup* thief, uint64_t* seed) {
                                      33) %
                  n;
   for (size_t i = 0; i < n; ++i) {
-    TaskGroup* victim = _groups[(start + i) % n];
+    TaskGroup* victim = td->groups[(start + i) % n];
     if (victim == thief) continue;
     if (victim->steal_from(m)) return true;
   }

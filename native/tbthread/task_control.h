@@ -1,9 +1,12 @@
 // Global scheduler: owns the worker pthreads and their TaskGroups, routes
 // cross-thread wakeups, steals between groups, parks idle workers.
 // Capability parity: reference src/bthread/task_control.h (steal_task :64,
-// signal_task :67, worker_thread :128). Worker tags (per-tag groups for
-// pinning, task_control.h:61) are planned for the TPU feeder-core split;
-// single tag for now.
+// signal_task :67, worker_thread :128) including worker TAGS
+// (task_control.h:61): each tag is an isolated worker pool with its own
+// parking lot; fibers run only on their tag's workers and stealing never
+// crosses tags. Tag 0 is the default pool; higher tags are created with
+// add_worker_group (optionally pinned to a cpuset) — the TPU feeder-core
+// split the north star calls for.
 #pragma once
 
 #include <atomic>
@@ -19,6 +22,8 @@ class TaskGroup;
 
 class TaskControl {
  public:
+  static constexpr int kMaxTags = 8;
+
   // Lazily initialized on first use with `default_concurrency()` workers
   // (TB_FIBER_CONCURRENCY env var, else 4).
   static TaskControl* singleton();
@@ -28,23 +33,45 @@ class TaskControl {
   void stop_and_join();
   bool stopped() const { return _stopped.load(std::memory_order_acquire); }
 
-  int concurrency() const { return static_cast<int>(_groups.size()); }
+  int concurrency() const;
 
-  // Make a fiber runnable from any thread (worker or not).
+  // Create the worker pool for `tag` (1..kMaxTags-1) with `nworkers`
+  // pthreads, optionally pinned to `cpus` (core ids). One-shot per tag:
+  // repeat calls return -1. Thread-safe; may be called any time.
+  int add_worker_group(int tag, int nworkers,
+                       const std::vector<int>& cpus = {});
+
+  // True when `tag` has a live worker pool (tag 0 always does).
+  bool has_tag(int tag) const;
+
+  // Make a fiber runnable from any thread (worker or not); routes to the
+  // fiber's tag pool (a missing tag falls back to tag 0).
   void ready_to_run_general(TaskMeta* m, bool signal = true);
 
   bool steal_task(TaskMeta** m, TaskGroup* thief, uint64_t* seed);
-  void signal_task(int num) { _pl.signal(num); }
-  ParkingLot* parking_lot() { return &_pl; }
+  void signal_task(int num, int tag);
+  ParkingLot* parking_lot(int tag);
 
  private:
-  TaskGroup* choose_one_group();
+  // One isolated worker pool. Immortal once published.
+  struct TagData {
+    std::vector<TaskGroup*> groups;
+    std::vector<std::thread> workers;
+    ParkingLot pl;
+    std::atomic<uint32_t> round{0};
+  };
 
-  std::vector<TaskGroup*> _groups;
-  std::vector<std::thread> _workers;
-  ParkingLot _pl;
+  TagData* tag_data(int tag) const {
+    if (tag < 0 || tag >= kMaxTags) tag = 0;
+    TagData* td = _tags[tag].load(std::memory_order_acquire);
+    return td != nullptr ? td : _tags[0].load(std::memory_order_acquire);
+  }
+  TaskGroup* choose_one_group(int tag);
+  TagData* make_tag(int tag, int nworkers, const std::vector<int>& cpus,
+                    bool* pin_ok);
+
+  std::atomic<TagData*> _tags[kMaxTags] = {};
   std::atomic<bool> _stopped{false};
-  std::atomic<uint32_t> _round{0};
 };
 
 }  // namespace tbthread

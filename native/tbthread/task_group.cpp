@@ -13,8 +13,8 @@ static thread_local TaskGroup* tls_task_group = nullptr;
 
 TaskGroup* TaskGroup::current() { return tls_task_group; }
 
-TaskGroup::TaskGroup(TaskControl* control)
-    : _control(control), _steal_seed(tbutil::fast_rand()) {
+TaskGroup::TaskGroup(TaskControl* control, int tag)
+    : _control(control), _tag(tag), _steal_seed(tbutil::fast_rand()) {
   _rq.init(4096);
 }
 
@@ -36,7 +36,7 @@ void TaskGroup::run_main_task() {
 }
 
 bool TaskGroup::wait_task(TaskMeta** m) {
-  ParkingLot* pl = _control->parking_lot();
+  ParkingLot* pl = _control->parking_lot(_tag);
   while (true) {
     if (_control->stopped()) return false;
     // Read lot state BEFORE the final scan: a producer pushes then signals,
@@ -140,7 +140,7 @@ void TaskGroup::ready_to_run(TaskMeta* m, bool signal) {
       push_remote(m, signal);
       return;
     }
-    if (signal) _control->signal_task(1);
+    if (signal) _control->signal_task(1, _tag);
   } else {
     push_remote(m, signal);
   }
@@ -151,7 +151,7 @@ void TaskGroup::push_remote(TaskMeta* m, bool signal) {
     std::lock_guard<std::mutex> g(_remote_mutex);
     _remote_rq.push_back(m);
   }
-  if (signal) _control->signal_task(1);
+  if (signal) _control->signal_task(1, _tag);
 }
 
 }  // namespace tbthread

@@ -24,7 +24,7 @@ class TaskControl;
 
 class TaskGroup {
  public:
-  explicit TaskGroup(TaskControl* control);
+  explicit TaskGroup(TaskControl* control, int tag = 0);
 
   // Worker pthread body: loop {wait_task; sched_to} until control stops.
   void run_main_task();
@@ -51,6 +51,7 @@ class TaskGroup {
   bool steal_from(TaskMeta** m);  // called by thief workers
 
   TaskControl* control() const { return _control; }
+  int tag() const { return _tag; }
 
   static void task_entry(intptr_t group_ptr);  // first frame of every fiber
 
@@ -62,6 +63,7 @@ class TaskGroup {
   static void task_ends(void* meta);           // remained: cleanup on sched stack
 
   TaskControl* _control;
+  int _tag = 0;
   TaskMeta* _cur_meta = nullptr;
   void* _main_sp = nullptr;  // scheduler context while a fiber runs
   void (*_remained_fn)(void*) = nullptr;

@@ -20,6 +20,12 @@ inline constexpr fiber_t INVALID_FIBER = 0;
 
 struct FiberAttr {
   int stack_type = STACK_TYPE_NORMAL;
+  // Worker tag: fibers run ONLY on the tag's worker group (reference
+  // bthread tagged task groups, task_control.h:61). Tag 0 is the default
+  // pool; other tags exist after fiber_add_worker_group — e.g. dedicated
+  // pinned cores feeding a libtpu stream that must never be starved by
+  // general RPC work.
+  int tag = 0;
 };
 
 struct TaskMeta {

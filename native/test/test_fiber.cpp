@@ -1,8 +1,14 @@
 // Fiber runtime tests: scheduling, join, yield, sleep, butex, sync
 // primitives, keys. Mirrors the reference's bthread_*_unittest coverage.
 #include <atomic>
+#include <mutex>
+#include <set>
 #include <thread>
 #include <vector>
+
+#include <sched.h>
+#include <sys/syscall.h>
+#include <unistd.h>
 
 #include "mini_test.h"
 #include "tbthread/butex.h"
@@ -263,6 +269,89 @@ TEST_CASE(timer_thread_schedule_unschedule) {
   usleep(100000);
   ASSERT_EQ(fired.load(), 1);
   ASSERT_EQ(tt->unschedule(id1), 1);  // already ran
+}
+
+namespace {
+
+struct TidCollector {
+  std::mutex mu;
+  std::set<pid_t> tids;
+  void record() {
+    const pid_t tid = static_cast<pid_t>(syscall(SYS_gettid));
+    std::lock_guard<std::mutex> lk(mu);
+    tids.insert(tid);
+  }
+};
+
+}  // namespace
+
+// Worker tags: tagged fibers run ONLY on their tag's workers (disjoint from
+// the default pool), and a tag's workers honor the requested cpuset
+// (reference bthread tagged task groups, task_control.h:61).
+TEST_CASE(worker_tags_isolate_and_pin) {
+  ASSERT_EQ(fiber_add_worker_group(1, 2), 0);
+  ASSERT_EQ(fiber_add_worker_group(1, 2), -1);  // one-shot per tag
+  ASSERT_EQ(fiber_add_worker_group(0, 1), -1);  // tag 0 is built-in
+
+  TidCollector tagged, untagged;
+  CountdownEvent done(32);
+  struct Arg {
+    TidCollector* out;
+    CountdownEvent* done;
+  };
+  auto fn = +[](void* p) -> void* {
+    auto* a = static_cast<Arg*>(p);
+    a->out->record();
+    fiber_usleep(2000);  // force interleaving across workers
+    a->out->record();
+    a->done->signal();
+    delete a;
+    return nullptr;
+  };
+  FiberAttr tag1_attr;
+  tag1_attr.tag = 1;
+  for (int i = 0; i < 16; ++i) {
+    fiber_t tid;
+    ASSERT_EQ(fiber_start_background(&tid, &tag1_attr, fn,
+                                     new Arg{&tagged, &done}), 0);
+    ASSERT_EQ(fiber_start_background(&tid, nullptr, fn,
+                                     new Arg{&untagged, &done}), 0);
+  }
+  done.wait();
+  ASSERT_TRUE(!tagged.tids.empty());
+  ASSERT_TRUE(!untagged.tids.empty());
+  ASSERT_TRUE(tagged.tids.size() <= 2);  // exactly the tag-1 workers
+  for (pid_t t : tagged.tids) {
+    ASSERT_TRUE(untagged.tids.count(t) == 0);  // pools are disjoint
+  }
+
+  // Pinned tag: its worker's affinity mask is exactly {cpu0}.
+  ASSERT_EQ(fiber_add_worker_group(2, 1, std::vector<int>{0}), 0);
+  std::atomic<int> affinity_ok{-1};
+  CountdownEvent pin_done(1);
+  struct PinArg {
+    std::atomic<int>* ok;
+    CountdownEvent* done;
+  };
+  PinArg pin_arg{&affinity_ok, &pin_done};
+  FiberAttr tag2_attr;
+  tag2_attr.tag = 2;
+  fiber_t tid;
+  ASSERT_EQ(fiber_start_background(
+                &tid, &tag2_attr,
+                +[](void* p) -> void* {
+                  auto* a = static_cast<PinArg*>(p);
+                  cpu_set_t set;
+                  CPU_ZERO(&set);
+                  sched_getaffinity(0, sizeof(set), &set);
+                  a->ok->store(CPU_ISSET(0, &set) && CPU_COUNT(&set) == 1);
+                  a->done->signal();
+                  return nullptr;
+                },
+                &pin_arg),
+            0);
+  pin_done.wait();
+  ASSERT_EQ(affinity_ok.load(), 1);
 }
 
 TEST_MAIN

@@ -94,6 +94,7 @@ void Acceptor::OnNewConnection(int fd, const tbutil::EndPoint& remote) {
 }
 
 void Acceptor::StopAccept() {
+  const SocketId listen_sid = _listen_sid;
   if (_listen_sid != INVALID_SOCKET_ID) {
     SocketUniquePtr ls;
     if (Socket::Address(_listen_sid, &ls) == 0) {
@@ -114,6 +115,27 @@ void Acceptor::StopAccept() {
       s->SetFailed(TRPC_EFAILEDSOCKET);
     }
   }
+  // Wait until every socket that can call back INTO this Acceptor (the
+  // listen socket's accept loop, accepted sockets' parse pipeline) has
+  // fully recycled. SetFailed alone is not a barrier: an input fiber that
+  // passed its !Failed() check may still be about to enter our
+  // OnNewMessages/OnNewConnection when the Server (and this Acceptor) is
+  // destroyed right after Stop() — the UAF this wait exists to prevent.
+  // Recycle means the last ref dropped, and every callback path holds a
+  // ref for its whole duration.
+  auto wait_recycled = [](SocketId sid) {
+    if (sid == INVALID_SOCKET_ID) return;
+    int spins = 0;
+    while (!Socket::HasRecycled(sid)) {
+      usleep(100);
+      if (++spins % 10000 == 0) {
+        TB_LOG(WARNING) << "StopAccept still waiting on socket " << sid
+                        << " to recycle (possible ref leak)";
+      }
+    }
+  };
+  wait_recycled(listen_sid);
+  for (SocketId sid : conns) wait_recycled(sid);
 }
 
 size_t Acceptor::connection_count() const {

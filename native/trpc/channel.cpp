@@ -80,6 +80,9 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   cntl->_protocol = _options.protocol;
   cntl->_tpu_transport = _options.tpu_transport;
   cntl->_connection_type = static_cast<uint8_t>(_options.connection_type);
+  if (cntl->_compress_type < 0) {
+    cntl->_compress_type = _options.request_compress_type;
+  }
   if (cntl->_backup_request_ms == -1) {
     cntl->_backup_request_ms = _options.backup_request_ms;
   }

@@ -27,6 +27,10 @@ struct ChannelOptions {
   // next attempt WITHOUT canceling the current one — first response wins
   // (reference channel.cpp:566-575 backup_request_ms).
   int64_t backup_request_ms = -1;
+  // Compress request payloads with this codec (compress.h, kCompressGzip);
+  // the server answers in kind. Skipped automatically when compression
+  // does not shrink the payload.
+  uint8_t request_compress_type = 0;
   // Upgrade connections to the tpu:// ICI transport (ttpu/ici_endpoint.h).
   // Set automatically when Init is given a "tpu://host:port" address.
   bool tpu_transport = false;

@@ -1,0 +1,105 @@
+#include "trpc/compress.h"
+
+#include <zlib.h>
+
+#include <atomic>
+#include <cstring>
+#include <string>
+
+#include "tbutil/logging.h"
+
+namespace trpc {
+
+namespace {
+
+std::atomic<const Compressor*> g_compressors[256] = {};
+
+// ---- gzip via zlib (reference policy/gzip_compress.cpp uses zlib too;
+// the streaming loop below is the standard zlib usage pattern) ----
+
+constexpr int kGzipWindowBits = 15 + 16;  // 16 selects the gzip wrapper
+constexpr size_t kChunk = 64 * 1024;
+
+bool gzip_compress(const tbutil::IOBuf& in, tbutil::IOBuf* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, kGzipWindowBits,
+                   8, Z_DEFAULT_STRATEGY) != Z_OK) {
+    return false;
+  }
+  const std::string flat = in.to_string();
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(flat.data()));
+  zs.avail_in = static_cast<uInt>(flat.size());
+  char buf[kChunk];
+  int rc;
+  do {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = kChunk;
+    rc = deflate(&zs, Z_FINISH);
+    if (rc == Z_STREAM_ERROR) {
+      deflateEnd(&zs);
+      return false;
+    }
+    out->append(buf, kChunk - zs.avail_out);
+  } while (rc != Z_STREAM_END);
+  deflateEnd(&zs);
+  return true;
+}
+
+bool gzip_decompress(const tbutil::IOBuf& in, tbutil::IOBuf* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, kGzipWindowBits) != Z_OK) return false;
+  const std::string flat = in.to_string();
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(flat.data()));
+  zs.avail_in = static_cast<uInt>(flat.size());
+  char buf[kChunk];
+  int rc = Z_OK;
+  do {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = kChunk;
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+    out->append(buf, kChunk - zs.avail_out);
+  } while (rc != Z_STREAM_END && zs.avail_in > 0);
+  inflateEnd(&zs);
+  return rc == Z_STREAM_END;
+}
+
+}  // namespace
+
+int RegisterCompressor(uint8_t type, const Compressor& c) {
+  if (type == kCompressNone) return -1;
+  auto* heap = new Compressor(c);
+  const Compressor* expected = nullptr;
+  if (!g_compressors[type].compare_exchange_strong(
+          expected, heap, std::memory_order_acq_rel)) {
+    delete heap;
+    return -1;
+  }
+  return 0;
+}
+
+const Compressor* GetCompressor(uint8_t type) {
+  return g_compressors[type].load(std::memory_order_acquire);
+}
+
+bool MaybeCompress(uint8_t type, const tbutil::IOBuf& in,
+                   tbutil::IOBuf* out) {
+  if (type == kCompressNone || in.empty()) return false;
+  const Compressor* c = GetCompressor(type);
+  return c != nullptr && c->compress(in, out) && out->size() < in.size();
+}
+
+void RegisterBuiltinCompressors() {
+  Compressor gz;
+  gz.name = "gzip";
+  gz.compress = gzip_compress;
+  gz.decompress = gzip_decompress;
+  TB_CHECK(RegisterCompressor(kCompressGzip, gz) == 0);
+}
+
+}  // namespace trpc

@@ -1,0 +1,40 @@
+// Compression codec registry wired to the tstd meta's compress_type byte.
+// Capability parity: reference src/brpc/compress.h (CompressHandler registry
+// keyed by CompressType) + policy/gzip_compress.cpp (zlib-backed gzip).
+// Payloads compress; attachments intentionally do NOT (they carry
+// tensor/binary data where recompression burns CPU for nothing — same
+// stance as the reference, which compresses the message, not the
+// attachment).
+#pragma once
+
+#include <cstdint>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+inline constexpr uint8_t kCompressNone = 0;
+inline constexpr uint8_t kCompressGzip = 1;
+
+struct Compressor {
+  const char* name = nullptr;
+  // Both return false on failure; *out is appended to.
+  bool (*compress)(const tbutil::IOBuf& in, tbutil::IOBuf* out) = nullptr;
+  bool (*decompress)(const tbutil::IOBuf& in, tbutil::IOBuf* out) = nullptr;
+};
+
+// type 1..255 (0 = none, reserved). Returns -1 if the slot is taken.
+int RegisterCompressor(uint8_t type, const Compressor& c);
+// nullptr for kCompressNone/unknown.
+const Compressor* GetCompressor(uint8_t type);
+
+// The send-side policy, shared by request pack and response send: compress
+// `in` with `type` only when the codec exists, `in` is non-empty, AND the
+// result actually shrinks. True = *out should ride the wire (caller stamps
+// meta.compress_type); false = send the plain bytes with type none.
+bool MaybeCompress(uint8_t type, const tbutil::IOBuf& in, tbutil::IOBuf* out);
+
+// Built-ins (gzip); called by GlobalInitializeOrDie.
+void RegisterBuiltinCompressors();
+
+}  // namespace trpc

@@ -4,6 +4,7 @@
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/channel.h"
+#include "trpc/compress.h"
 #include "trpc/errno.h"
 #include "trpc/input_messenger.h"
 #include "trpc/load_balancer.h"
@@ -38,6 +39,7 @@ void Controller::Reset() {
   _server_side = false;
   _tpu_transport = false;
   _connection_type = 0;
+  _compress_type = -1;
   _lb.reset();
   _tried.clear();
   _request_code = 0;
@@ -538,13 +540,27 @@ void TstdHandleResponse(TstdInputMessage* msg) {
     return;
   }
   acc.mark_response_received();
+  int err = msg->meta.code_or_timeout;
+  std::string err_text = std::move(msg->meta.error_text);
+  if (msg->meta.compress_type != kCompressNone) {
+    const Compressor* c = GetCompressor(msg->meta.compress_type);
+    tbutil::IOBuf plain;
+    if (c != nullptr && c->decompress(msg->payload, &plain)) {
+      msg->payload.swap(plain);
+    } else {
+      // Never hand compressed garbage to the caller as application bytes.
+      msg->payload.clear();
+      if (err == 0) {
+        err = TRPC_ERESPONSE;
+        err_text = "cannot decompress response payload";
+      }
+    }
+  }
   if (acc.response_payload() != nullptr) {
     acc.response_payload()->clear();
     acc.response_payload()->append(std::move(msg->payload));
   }
   acc.set_response_attachment(std::move(msg->attachment));
-  int err = msg->meta.code_or_timeout;
-  std::string err_text = std::move(msg->meta.error_text);
   // Streaming handshake completion: the server accepted and announced its
   // stream id + window; connect our half to this RPC's socket. A SUCCESS
   // response WITHOUT a stream id means the handler never StreamAccept'ed —

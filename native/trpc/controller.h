@@ -46,6 +46,13 @@ class Controller {
   // Hedging override for this call (see ChannelOptions::backup_request_ms).
   void set_backup_request_ms(int64_t ms) { _backup_request_ms = ms; }
   int64_t backup_request_ms() const { return _backup_request_ms; }
+  // Compress the request payload with this codec (compress.h;
+  // kCompressNone disables even when the channel sets a default). Server
+  // side: set from the request, so the response answers in kind.
+  void set_compress_type(uint8_t t) { _compress_type = t; }
+  uint8_t compress_type() const {
+    return _compress_type < 0 ? 0 : static_cast<uint8_t>(_compress_type);
+  }
 
   // ---- results ----
   bool Failed() const { return _error_code != 0; }
@@ -105,6 +112,10 @@ class Controller {
   int _protocol = 0;
   bool _tpu_transport = false;
   uint8_t _connection_type = 0;  // ConnectionType (channel.h)
+  // compress.h codec for payloads; -1 = unset (inherit the channel's
+  // default) so an explicit set_compress_type(kCompressNone) can DISABLE a
+  // channel-level default.
+  int16_t _compress_type = -1;
 
   // call state
   std::string _service_method;

@@ -9,6 +9,7 @@
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/builtin_console.h"
+#include "trpc/compress.h"
 #include "trpc/controller.h"
 #include "trpc/http_protocol.h"
 #include "trpc/errno.h"
@@ -203,9 +204,16 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
     meta.service = service_method.substr(0, slash);
     meta.method = service_method.substr(slash + 1);
   }
+  // Payload compression (attachments ride raw — compress.h).
+  const tbutil::IOBuf* body = &payload;
+  tbutil::IOBuf compressed;
+  if (MaybeCompress(cntl->compress_type(), payload, &compressed)) {
+    body = &compressed;
+    meta.compress_type = cntl->compress_type();
+  }
   tstd_serialize_meta(out, meta,
-                      payload.size() + cntl->request_attachment().size());
-  out->append(payload);
+                      body->size() + cntl->request_attachment().size());
+  out->append(*body);
   out->append(cntl->request_attachment());
 }
 
@@ -239,6 +247,14 @@ static void tstd_send_response(SocketId sid, uint64_t correlation_id,
   if (acc1.response_stream() != 0) {
     meta.stream_id = acc1.response_stream();
     meta.stream_window = stream_internal::AdvertisedWindow(meta.stream_id);
+  }
+  // Answer in the request's codec when it shrinks the response.
+  {
+    tbutil::IOBuf compressed;
+    if (MaybeCompress(cntl->compress_type(), *payload, &compressed)) {
+      meta.compress_type = cntl->compress_type();
+      payload->swap(compressed);
+    }
   }
   tbutil::IOBuf out;
   tstd_serialize_meta(&out, meta,
@@ -360,6 +376,19 @@ void tstd_process_request(InputMessageBase* base) {
   }
   tbutil::IOBuf request = std::move(msg->payload);
   std::string method = std::move(msg->meta.method);
+  if (msg->meta.compress_type != kCompressNone) {
+    const Compressor* c = GetCompressor(msg->meta.compress_type);
+    tbutil::IOBuf plain;
+    if (c == nullptr || !c->decompress(request, &plain)) {
+      cntl->SetFailed(TRPC_EREQUEST, "cannot decompress request payload");
+      delete msg;
+      done->Run();
+      return;
+    }
+    request.swap(plain);
+    // The response answers in the request's codec (tstd_send_response).
+    cntl->set_compress_type(msg->meta.compress_type);
+  }
   delete msg;
   if (server_span_id != 0) {
     // The context lives for the synchronous part of the handler — where
@@ -383,6 +412,7 @@ void GlobalInitializeOrDie() {
     // never as a process-killing signal (reference: brpc ignores SIGPIPE
     // the same way; every network daemon does).
     signal(SIGPIPE, SIG_IGN);
+    RegisterBuiltinCompressors();
     Protocol p;
     p.parse = tstd_parse;
     p.pack_request = tstd_pack_request;

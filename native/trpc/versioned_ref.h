@@ -151,6 +151,18 @@ class VersionedRefWithId {
            0;
   }
 
+  // ONLY meaningful after SetFailed(id) was issued: true once the last ref
+  // dropped and OnRecycle completed — i.e. no thread can still be running
+  // code that holds this object. (Before SetFailed the version check here
+  // would misread a live object as recycled.)
+  static bool HasRecycled(VRefId id) {
+    T* obj = tbutil::ResourcePool<T>::singleton()->address_resource(
+        id_slot(id));
+    if (obj == nullptr) return true;
+    return vref_version(obj->_versioned_ref.load(std::memory_order_acquire)) !=
+           id_version(id) + 1;
+  }
+
   VRefId id() const { return _this_id; }
 
  protected:
