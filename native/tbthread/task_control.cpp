@@ -108,11 +108,20 @@ int TaskControl::concurrency() const {
 }
 
 void TaskControl::stop_and_join() {
-  std::lock_guard<std::mutex> lk(g_tag_mu);
-  _stopped.store(true, std::memory_order_release);
-  for (int t = 0; t < kMaxTags; ++t) {
-    TagData* td = _tags[t].load(std::memory_order_acquire);
-    if (td == nullptr) continue;
+  // Collect pools under the lock, JOIN OUTSIDE it: a fiber calling
+  // fiber_add_worker_group blocks its worker pthread on g_tag_mu, and
+  // joining that worker while holding the mutex would deadlock. After
+  // _stopped is set no new tag can be created (add_worker_group checks).
+  std::vector<TagData*> tds;
+  {
+    std::lock_guard<std::mutex> lk(g_tag_mu);
+    _stopped.store(true, std::memory_order_release);
+    for (int t = 0; t < kMaxTags; ++t) {
+      TagData* td = _tags[t].load(std::memory_order_acquire);
+      if (td != nullptr) tds.push_back(td);
+    }
+  }
+  for (TagData* td : tds) {
     td->pl.stop();
     for (auto& w : td->workers) {
       if (w.joinable()) w.join();

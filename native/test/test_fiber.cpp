@@ -325,15 +325,26 @@ TEST_CASE(worker_tags_isolate_and_pin) {
     ASSERT_TRUE(untagged.tids.count(t) == 0);  // pools are disjoint
   }
 
-  // Pinned tag: its worker's affinity mask is exactly {cpu0}.
-  ASSERT_EQ(fiber_add_worker_group(2, 1, std::vector<int>{0}), 0);
+  // Pinned tag: its worker's affinity mask is exactly the one cpu we chose
+  // — a cpu from OUR allowed set, not a hardcoded 0 (cgroup cpusets may
+  // exclude core 0).
+  cpu_set_t allowed;
+  CPU_ZERO(&allowed);
+  ASSERT_EQ(sched_getaffinity(0, sizeof(allowed), &allowed), 0);
+  int pin_cpu = -1;
+  for (int c = 0; c < CPU_SETSIZE && pin_cpu < 0; ++c) {
+    if (CPU_ISSET(c, &allowed)) pin_cpu = c;
+  }
+  ASSERT_TRUE(pin_cpu >= 0);
+  ASSERT_EQ(fiber_add_worker_group(2, 1, std::vector<int>{pin_cpu}), 0);
   std::atomic<int> affinity_ok{-1};
   CountdownEvent pin_done(1);
   struct PinArg {
+    int cpu;
     std::atomic<int>* ok;
     CountdownEvent* done;
   };
-  PinArg pin_arg{&affinity_ok, &pin_done};
+  PinArg pin_arg{pin_cpu, &affinity_ok, &pin_done};
   FiberAttr tag2_attr;
   tag2_attr.tag = 2;
   fiber_t tid;
@@ -344,7 +355,8 @@ TEST_CASE(worker_tags_isolate_and_pin) {
                   cpu_set_t set;
                   CPU_ZERO(&set);
                   sched_getaffinity(0, sizeof(set), &set);
-                  a->ok->store(CPU_ISSET(0, &set) && CPU_COUNT(&set) == 1);
+                  a->ok->store(CPU_ISSET(a->cpu, &set) &&
+                               CPU_COUNT(&set) == 1);
                   a->done->signal();
                   return nullptr;
                 },

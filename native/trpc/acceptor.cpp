@@ -74,9 +74,12 @@ void Acceptor::OnNewConnection(int fd, const tbutil::EndPoint& remote) {
   std::lock_guard<std::mutex> lk(_conn_mu);
   if (_stopped) {
     // Raced with StopAccept's snapshot: this connection would leak past
-    // Server shutdown with a dangling user pointer — kill it here.
+    // Server shutdown with a dangling user pointer — kill it here, and
+    // record it so StopAccept's recycle-wait covers it too (it is in
+    // neither the snapshot nor _connections).
     SocketUniquePtr s;
     if (Socket::Address(sid, &s) == 0) s->SetFailed(TRPC_EFAILEDSOCKET);
+    _raced.push_back(sid);
     return;
   }
   _connections.insert(sid);
@@ -134,7 +137,15 @@ void Acceptor::StopAccept() {
       }
     }
   };
+  // Listen socket FIRST: OnNewConnection only runs inside its accept
+  // fiber, so its recycle is the barrier after which no new connection —
+  // including ones that raced the snapshot above — can appear.
   wait_recycled(listen_sid);
+  {
+    std::lock_guard<std::mutex> lk(_conn_mu);
+    conns.insert(conns.end(), _raced.begin(), _raced.end());
+    _raced.clear();
+  }
   for (SocketId sid : conns) wait_recycled(sid);
 }
 

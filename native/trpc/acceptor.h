@@ -60,6 +60,9 @@ class Acceptor : public InputMessenger {
   mutable std::mutex _conn_mu;
   bool _stopped = false;  // guarded by _conn_mu; set by StopAccept
   std::unordered_set<SocketId> _connections;
+  // Connections that lost the OnNewConnection/StopAccept race (created
+  // after the stop snapshot): StopAccept must wait these out too.
+  std::vector<SocketId> _raced;
 };
 
 }  // namespace trpc

@@ -20,6 +20,9 @@ std::atomic<const Compressor*> g_compressors[256] = {};
 constexpr int kGzipWindowBits = 15 + 16;  // 16 selects the gzip wrapper
 constexpr size_t kChunk = 64 * 1024;
 
+// Both codecs feed zlib straight from the IOBuf's backing blocks — no
+// flatten: compressing a 1GB payload must not allocate a second 1GB copy.
+
 bool gzip_compress(const tbutil::IOBuf& in, tbutil::IOBuf* out) {
   z_stream zs;
   memset(&zs, 0, sizeof(zs));
@@ -27,44 +30,65 @@ bool gzip_compress(const tbutil::IOBuf& in, tbutil::IOBuf* out) {
                    8, Z_DEFAULT_STRATEGY) != Z_OK) {
     return false;
   }
-  const std::string flat = in.to_string();
-  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(flat.data()));
-  zs.avail_in = static_cast<uInt>(flat.size());
   char buf[kChunk];
-  int rc;
-  do {
-    zs.next_out = reinterpret_cast<Bytef*>(buf);
-    zs.avail_out = kChunk;
-    rc = deflate(&zs, Z_FINISH);
-    if (rc == Z_STREAM_ERROR) {
-      deflateEnd(&zs);
-      return false;
-    }
-    out->append(buf, kChunk - zs.avail_out);
-  } while (rc != Z_STREAM_END);
+  const size_t nblocks = in.backing_block_num();
+  for (size_t b = 0; b < nblocks; ++b) {
+    const std::string_view block = in.backing_block(b);
+    const int flush = b + 1 == nblocks ? Z_FINISH : Z_NO_FLUSH;
+    if (block.empty() && flush == Z_NO_FLUSH) continue;
+    zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(block.data()));
+    zs.avail_in = static_cast<uInt>(block.size());
+    int rc;
+    do {
+      zs.next_out = reinterpret_cast<Bytef*>(buf);
+      zs.avail_out = kChunk;
+      rc = deflate(&zs, flush);
+      if (rc == Z_STREAM_ERROR) {
+        deflateEnd(&zs);
+        return false;
+      }
+      out->append(buf, kChunk - zs.avail_out);
+    } while (zs.avail_out == 0 || (flush == Z_FINISH && rc != Z_STREAM_END));
+  }
   deflateEnd(&zs);
   return true;
 }
 
-bool gzip_decompress(const tbutil::IOBuf& in, tbutil::IOBuf* out) {
+bool gzip_decompress(const tbutil::IOBuf& in, tbutil::IOBuf* out,
+                     size_t max_out) {
   z_stream zs;
   memset(&zs, 0, sizeof(zs));
   if (inflateInit2(&zs, kGzipWindowBits) != Z_OK) return false;
-  const std::string flat = in.to_string();
-  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(flat.data()));
-  zs.avail_in = static_cast<uInt>(flat.size());
   char buf[kChunk];
+  size_t total_out = 0;
+  const size_t nblocks = in.backing_block_num();
   int rc = Z_OK;
-  do {
-    zs.next_out = reinterpret_cast<Bytef*>(buf);
-    zs.avail_out = kChunk;
-    rc = inflate(&zs, Z_NO_FLUSH);
-    if (rc != Z_OK && rc != Z_STREAM_END) {
-      inflateEnd(&zs);
-      return false;
+  for (size_t b = 0; b < nblocks && rc != Z_STREAM_END; ++b) {
+    const std::string_view block = in.backing_block(b);
+    if (block.empty()) continue;  // zlib reports BUF_ERROR on empty input
+    zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(block.data()));
+    zs.avail_in = static_cast<uInt>(block.size());
+    // Drain ALL output for this input slice: exiting while avail_out == 0
+    // (output chunk exactly full) would truncate valid streams.
+    while (true) {
+      zs.next_out = reinterpret_cast<Bytef*>(buf);
+      zs.avail_out = kChunk;
+      rc = inflate(&zs, Z_NO_FLUSH);
+      if (rc != Z_OK && rc != Z_STREAM_END) {
+        inflateEnd(&zs);
+        return false;
+      }
+      const size_t produced = kChunk - zs.avail_out;
+      total_out += produced;
+      if (total_out > max_out) {  // decompression bomb guard
+        inflateEnd(&zs);
+        return false;
+      }
+      out->append(buf, produced);
+      if (rc == Z_STREAM_END) break;
+      if (zs.avail_out > 0) break;  // this slice fully consumed
     }
-    out->append(buf, kChunk - zs.avail_out);
-  } while (rc != Z_STREAM_END && zs.avail_in > 0);
+  }
   inflateEnd(&zs);
   return rc == Z_STREAM_END;
 }

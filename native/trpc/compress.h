@@ -18,9 +18,12 @@ inline constexpr uint8_t kCompressGzip = 1;
 
 struct Compressor {
   const char* name = nullptr;
-  // Both return false on failure; *out is appended to.
+  // Both return false on failure; *out is appended to. decompress MUST
+  // refuse past max_out bytes of output — the decompression-bomb guard
+  // (wire sizes are capped, decompressed sizes must be too).
   bool (*compress)(const tbutil::IOBuf& in, tbutil::IOBuf* out) = nullptr;
-  bool (*decompress)(const tbutil::IOBuf& in, tbutil::IOBuf* out) = nullptr;
+  bool (*decompress)(const tbutil::IOBuf& in, tbutil::IOBuf* out,
+                     size_t max_out) = nullptr;
 };
 
 // type 1..255 (0 = none, reserved). Returns -1 if the slot is taken.

@@ -520,6 +520,10 @@ void Controller::EndRPC(int error, const std::string& error_text) {
   }
 }
 
+// Decompressed responses may legitimately exceed the compressed wire size
+// many-fold, but never unboundedly: cap at 2GB, the tstd body cap's default.
+static constexpr size_t kMaxDecompressedResponse = 2ULL << 30;
+
 // Client response path (kept here, not in tstd_protocol.cpp, because the
 // staleness/locking rules are the controller's: reference
 // controller.cpp:598 OnVersionedRPCReturned).
@@ -545,7 +549,9 @@ void TstdHandleResponse(TstdInputMessage* msg) {
   if (msg->meta.compress_type != kCompressNone) {
     const Compressor* c = GetCompressor(msg->meta.compress_type);
     tbutil::IOBuf plain;
-    if (c != nullptr && c->decompress(msg->payload, &plain)) {
+    // Same inflation cap as the parser's wire cap (bomb guard).
+    if (c != nullptr &&
+        c->decompress(msg->payload, &plain, kMaxDecompressedResponse)) {
       msg->payload.swap(plain);
     } else {
       // Never hand compressed garbage to the caller as application bytes.

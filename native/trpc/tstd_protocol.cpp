@@ -379,7 +379,9 @@ void tstd_process_request(InputMessageBase* base) {
   if (msg->meta.compress_type != kCompressNone) {
     const Compressor* c = GetCompressor(msg->meta.compress_type);
     tbutil::IOBuf plain;
-    if (c == nullptr || !c->decompress(request, &plain)) {
+    const size_t max_out = static_cast<size_t>(
+        g_max_body_size->load(std::memory_order_relaxed));
+    if (c == nullptr || !c->decompress(request, &plain, max_out)) {
       cntl->SetFailed(TRPC_EREQUEST, "cannot decompress request payload");
       delete msg;
       done->Run();

@@ -120,12 +120,16 @@ class VersionedRefWithId {
   void Deref() {
     uint64_t prev = _versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
     if (vref_nref(prev) == 1 && (vref_version(prev) & 1) != 0) {
-      // Last ref of a failed object: recycle. Bump to the next even version
-      // BEFORE returning the slot so concurrent Address on the stale id
-      // fails rather than racing with the next Create.
+      // Last ref of a failed object: recycle. OnRecycle runs BEFORE the
+      // version bump — HasRecycled()'s contract is "no thread is still
+      // running this object's code", which must include the recycle hook
+      // itself (it closes fds, detaches from the dispatcher). Address on
+      // the stale id keeps failing throughout: the version is still odd.
+      // The bump happens before returning the slot so a stale Address
+      // never races the next Create on this slot.
+      static_cast<T*>(this)->OnRecycle();
       _versioned_ref.store(make_vref(vref_version(prev) + 1, 0),
                            std::memory_order_release);
-      static_cast<T*>(this)->OnRecycle();
       tbutil::ResourcePool<T>::singleton()->return_resource(_slot);
     }
   }
