@@ -1,0 +1,110 @@
+// 64 concurrent fibers echoing over the tpu:// transport — the analog of
+// reference example/multi_threaded_echo_c++ run over the ICI socket
+// (BASELINE config 2: "64-bthread Echo over tpu:// Socket"). Every caller
+// is a FIBER (not a pthread): CallMethod parks the fiber, so 64 in-flight
+// RPCs cost 64 stacks, not 64 kernel threads.
+// Usage: multi_threaded_echo_demo [--transport=tcp|tpu] [--fibers=N]
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "tbthread/fiber.h"
+#include "tbthread/sync.h"
+#include "tbutil/time.h"
+#include "trpc/channel.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  std::string_view service_name() const override { return "EchoService"; }
+  void CallMethod(const std::string&, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    response->append(request);
+    cntl->response_attachment().append(cntl->request_attachment());
+    done->Run();
+  }
+};
+
+struct WorkerCtx {
+  Channel* channel;
+  tbthread::CountdownEvent* done;
+  std::atomic<int64_t>* calls;
+  std::atomic<int64_t>* failures;
+  int64_t stop_at_us;
+  size_t payload_size;
+};
+
+void* echo_worker(void* arg) {
+  auto* ctx = static_cast<WorkerCtx*>(arg);
+  const std::string payload(ctx->payload_size, 'm');
+  while (tbutil::monotonic_time_us() < ctx->stop_at_us) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("ping");
+    cntl.request_attachment().append(payload);
+    ctx->channel->CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    if (cntl.Failed()) {
+      ctx->failures->fetch_add(1);
+    } else {
+      ctx->calls->fetch_add(1);
+    }
+  }
+  ctx->done->signal();
+  return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool tpu = true;
+  int fibers = 64;
+  for (int i = 1; i < argc; ++i) {
+    if (strcmp(argv[i], "--transport=tcp") == 0) tpu = false;
+    if (strcmp(argv[i], "--transport=tpu") == 0) tpu = true;
+    if (strncmp(argv[i], "--fibers=", 9) == 0) fibers = atoi(argv[i] + 9);
+  }
+  EchoService svc;
+  Server server;
+  server.AddService(&svc);
+  if (server.Start(0) != 0) return 1;
+  char addr[48];
+  snprintf(addr, sizeof(addr), "%s127.0.0.1:%d", tpu ? "tpu://" : "",
+           server.listen_address().port);
+
+  Channel channel;
+  ChannelOptions opts;
+  opts.timeout_ms = 10000;
+  if (channel.Init(addr, &opts) != 0) return 1;
+
+  std::atomic<int64_t> calls{0}, failures{0};
+  tbthread::CountdownEvent all_done(fibers);
+  constexpr int kSeconds = 3;
+  constexpr size_t kPayload = 16 * 1024;
+  std::vector<WorkerCtx> ctxs(
+      fibers, WorkerCtx{&channel, &all_done, &calls, &failures,
+                        tbutil::monotonic_time_us() + kSeconds * 1000000,
+                        kPayload});
+  for (int i = 0; i < fibers; ++i) {
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_background(&tid, nullptr, echo_worker,
+                                         &ctxs[i]) != 0) {
+      fprintf(stderr, "fiber start failed\n");
+      return 1;
+    }
+  }
+  all_done.wait();
+  const double qps = static_cast<double>(calls.load()) / kSeconds;
+  printf("%d fibers over %s: %lld echoes (%lld failed) in %ds = %.0f qps, "
+         "%.1f MB/s one-way\n",
+         fibers, tpu ? "tpu://" : "tcp", static_cast<long long>(calls.load()),
+         static_cast<long long>(failures.load()), kSeconds, qps,
+         qps * kPayload / 1e6);
+  server.Stop();
+  return failures.load() == 0 && calls.load() > 0 ? 0 : 1;
+}

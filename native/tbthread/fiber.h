@@ -38,6 +38,14 @@ int fiber_set_concurrency(int n);
 int fiber_add_worker_group(int tag, int nworkers,
                            const std::vector<int>& cpus = {});
 
+// Park the calling fiber until `fd` has one of `epoll_events` (EPOLLIN /
+// EPOLLOUT / ...). deadline_us on the gettimeofday clock, 0 = forever.
+// 0 on event; -1 with errno = ETIMEDOUT on deadline, EBUSY if another
+// fiber already waits on this fd. Reference: bthread/fd.cpp
+// bthread_fd_wait — user code (pipes, eventfds, device fds) gets
+// fiber-blocking IO without owning a Socket.
+int fiber_fd_wait(int fd, unsigned int epoll_events, int64_t deadline_us = 0);
+
 // Test/shutdown hook: stops all workers. Irreversible within the process.
 void fiber_stop_world();
 

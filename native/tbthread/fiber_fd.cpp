@@ -1,0 +1,147 @@
+// fiber_fd_wait: park the calling fiber until an arbitrary fd is readable/
+// writable — the general-purpose version of the Socket-internal epoll wait
+// (reference bthread/fd.cpp bthread_fd_wait): user code doing its own IO
+// (pipes, eventfds, device fds feeding a TPU runtime) gets fiber-blocking
+// semantics without owning a Socket.
+//
+// One shared epoll instance + one waker thread. Registrations are keyed by
+// fd AND a generation stamp carried in the epoll event payload: a stale
+// queued event from a withdrawn registration can never wake (or
+// deregister) a successor waiter on the same fd. All epoll_ctl calls run
+// under the registry mutex so ADD can never observe a half-removed
+// predecessor (EEXIST). One waiter per fd at a time.
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "tbthread/butex.h"
+#include "tbthread/fiber.h"
+#include "tbutil/time.h"
+
+namespace tbthread {
+
+namespace {
+
+struct FdWaiter {
+  Butex* btx;
+  std::atomic<int> revents{0};
+};
+
+struct FdWaitService {
+  int epfd = -1;
+  std::mutex mu;
+  struct Reg {
+    FdWaiter* w;
+    uint32_t gen;
+  };
+  std::unordered_map<int, Reg> waiters;  // guarded by mu
+  uint32_t next_gen = 1;                 // guarded by mu
+
+  FdWaitService() {
+    epfd = epoll_create1(EPOLL_CLOEXEC);
+    std::thread([this] { Run(); }).detach();
+  }
+
+  void Run() {
+    epoll_event evs[32];
+    while (true) {
+      int n = epoll_wait(epfd, evs, 32, -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return;
+      }
+      for (int i = 0; i < n; ++i) {
+        const int fd = static_cast<int>(evs[i].data.u64 >> 32);
+        const uint32_t gen = static_cast<uint32_t>(evs[i].data.u64);
+        FdWaiter* w = nullptr;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = waiters.find(fd);
+          if (it == waiters.end() || it->second.gen != gen) {
+            continue;  // stale event of a withdrawn registration: ignore
+          }
+          w = it->second.w;
+          waiters.erase(it);
+          epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+        }
+        w->revents.store(static_cast<int>(evs[i].events),
+                         std::memory_order_release);
+        butex_increment_and_wake_all(w->btx);
+      }
+    }
+  }
+
+  static FdWaitService& global() {
+    static FdWaitService* s = new FdWaitService;
+    return *s;
+  }
+};
+
+}  // namespace
+
+int fiber_fd_wait(int fd, unsigned int epoll_events, int64_t deadline_us) {
+  if (fd < 0) {
+    errno = EINVAL;
+    return -1;
+  }
+  FdWaitService& svc = FdWaitService::global();
+  FdWaiter w;
+  w.btx = butex_create();
+  const int seq = butex_value(w.btx)->load(std::memory_order_acquire);
+  {
+    std::lock_guard<std::mutex> lk(svc.mu);
+    const uint32_t gen = svc.next_gen++;
+    if (!svc.waiters.emplace(fd, FdWaitService::Reg{&w, gen}).second) {
+      butex_destroy(w.btx);
+      errno = EBUSY;  // one waiter per fd
+      return -1;
+    }
+    epoll_event ev{};
+    ev.events = epoll_events | EPOLLONESHOT;
+    ev.data.u64 = (static_cast<uint64_t>(static_cast<uint32_t>(fd)) << 32) |
+                  gen;
+    if (epoll_ctl(svc.epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+      const int err = errno;
+      svc.waiters.erase(fd);
+      butex_destroy(w.btx);
+      errno = err;
+      return -1;
+    }
+  }
+  timespec abst;
+  timespec* abstp = nullptr;
+  if (deadline_us > 0) {
+    abst.tv_sec = static_cast<time_t>(deadline_us / 1000000);
+    abst.tv_nsec = static_cast<long>((deadline_us % 1000000) * 1000);
+    abstp = &abst;
+  }
+  int rc = 0;
+  while (w.revents.load(std::memory_order_acquire) == 0) {
+    if (butex_wait(w.btx, seq, abstp) != 0 && errno == ETIMEDOUT) {
+      // Deadline: try to withdraw. If the waker already took us, it WILL
+      // wake — wait for that instead so `w` never dies under it.
+      std::unique_lock<std::mutex> lk(svc.mu);
+      auto it = svc.waiters.find(fd);
+      if (it != svc.waiters.end() && it->second.w == &w) {
+        svc.waiters.erase(it);
+        epoll_ctl(svc.epfd, EPOLL_CTL_DEL, fd, nullptr);
+        lk.unlock();
+        rc = -1;
+        errno = ETIMEDOUT;
+        break;
+      }
+      lk.unlock();
+      abstp = nullptr;  // the waker owns us: it will signal promptly
+      continue;
+    }
+  }
+  butex_destroy(w.btx);
+  return rc;
+}
+
+}  // namespace tbthread

@@ -137,4 +137,99 @@ class CountdownEvent {
   Butex* _b;
 };
 
+// Counting semaphore (reference bthread/semaphore).
+class FiberSemaphore {
+ public:
+  explicit FiberSemaphore(int initial = 0) : _b(butex_create()) {
+    _b->value.store(initial, std::memory_order_relaxed);
+  }
+  ~FiberSemaphore() { butex_destroy(_b); }
+  FiberSemaphore(const FiberSemaphore&) = delete;
+  FiberSemaphore& operator=(const FiberSemaphore&) = delete;
+
+  void post(int n = 1) {
+    _b->value.fetch_add(n, std::memory_order_release);
+    if (n == 1) {
+      butex_wake(_b);
+    } else {
+      butex_wake_all(_b);
+    }
+  }
+
+  void wait() {
+    while (true) {
+      int v = _b->value.load(std::memory_order_acquire);
+      if (v > 0) {
+        if (_b->value.compare_exchange_weak(v, v - 1,
+                                            std::memory_order_acq_rel,
+                                            std::memory_order_acquire)) {
+          return;
+        }
+        continue;
+      }
+      butex_wait(_b, v, nullptr);
+    }
+  }
+
+  bool try_wait() {
+    int v = _b->value.load(std::memory_order_acquire);
+    while (v > 0) {
+      if (_b->value.compare_exchange_weak(v, v - 1,
+                                          std::memory_order_acq_rel,
+                                          std::memory_order_acquire)) {
+        return true;
+      }
+    }
+    return false;
+  }
+
+ private:
+  Butex* _b;
+};
+
+// Reader/writer lock, writer-preferring: once a writer queues, new readers
+// wait — a steady reader stream cannot starve writers (reference
+// bthread/rwlock). Built on FiberMutex+FiberCond: the hot uncontended path
+// is one fiber-mutex lock/unlock pair; contended paths park fibers.
+class FiberRWLock {
+ public:
+  void rdlock() {
+    _mu.lock();
+    while (_writer || _writers_waiting > 0) _rcond.wait(_mu);
+    ++_readers;
+    _mu.unlock();
+  }
+  void rdunlock() {
+    _mu.lock();
+    if (--_readers == 0 && _writers_waiting > 0) _wcond.notify_one();
+    _mu.unlock();
+  }
+  void wrlock() {
+    _mu.lock();
+    ++_writers_waiting;
+    while (_writer || _readers > 0) _wcond.wait(_mu);
+    --_writers_waiting;
+    _writer = true;
+    _mu.unlock();
+  }
+  void wrunlock() {
+    _mu.lock();
+    _writer = false;
+    if (_writers_waiting > 0) {
+      _wcond.notify_one();
+    } else {
+      _rcond.notify_all();
+    }
+    _mu.unlock();
+  }
+
+ private:
+  FiberMutex _mu;
+  FiberCond _rcond;  // readers wait here while writers own/queue
+  FiberCond _wcond;  // writers wait here for exclusivity
+  int _readers = 0;
+  int _writers_waiting = 0;
+  bool _writer = false;
+};
+
 }  // namespace tbthread

@@ -1,0 +1,128 @@
+// Process-level default variables: rss / cpu / fds / threads / uptime,
+// computed on read from /proc/self. These answer "is this host sick" from
+// /vars, /status and /metrics without any app code.
+// Capability parity: reference src/bvar/default_variables.cpp:230-761
+// (process_memory_resident, process_cpu_usage, process_fd_count, ...).
+#include <dirent.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+
+#include "tbutil/time.h"
+#include "tbvar/passive_status.h"
+
+namespace tbvar {
+
+namespace {
+
+// VmRSS from /proc/self/status, in bytes (0 on failure).
+int64_t read_rss_bytes() {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (f == nullptr) return 0;
+  char line[256];
+  int64_t kb = 0;
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    if (strncmp(line, "VmRSS:", 6) == 0) {
+      sscanf(line + 6, "%ld", &kb);
+      break;
+    }
+  }
+  fclose(f);
+  return kb * 1024;
+}
+
+// (utime + stime) of the whole process, in clock ticks.
+int64_t read_cpu_ticks() {
+  FILE* f = fopen("/proc/self/stat", "r");
+  if (f == nullptr) return 0;
+  // pid (comm) state ppid ... utime(14) stime(15); comm may contain spaces
+  // so skip to the closing paren first.
+  char buf[1024];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = '\0';
+  const char* p = strrchr(buf, ')');
+  if (p == nullptr) return 0;
+  long utime = 0, stime = 0;
+  // after ')': field 3 onwards; utime is field 14, stime 15.
+  if (sscanf(p + 1,
+             " %*c %*d %*d %*d %*d %*d %*u %*u %*u %*u %*u %ld %ld",
+             &utime, &stime) != 2) {
+    return 0;
+  }
+  return utime + stime;
+}
+
+int64_t count_fds() {
+  DIR* d = opendir("/proc/self/fd");
+  if (d == nullptr) return 0;
+  int64_t n = 0;
+  while (readdir(d) != nullptr) ++n;
+  closedir(d);
+  return n > 2 ? n - 2 : 0;  // drop . and ..
+}
+
+int64_t read_thread_count() {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (f == nullptr) return 0;
+  char line[256];
+  int64_t n = 0;
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    if (strncmp(line, "Threads:", 8) == 0) {
+      sscanf(line + 8, "%ld", &n);
+      break;
+    }
+  }
+  fclose(f);
+  return n;
+}
+
+// CPU usage over the interval between reads: cores busy (x1000 so the
+// integer var carries milli-cores, e.g. 1500 = 1.5 cores).
+int64_t cpu_millicores() {
+  static std::mutex mu;
+  static int64_t last_ticks = 0;
+  static int64_t last_time_us = 0;
+  std::lock_guard<std::mutex> lk(mu);
+  const int64_t now_us = tbutil::monotonic_time_us();
+  const int64_t ticks = read_cpu_ticks();
+  if (last_time_us == 0 || now_us <= last_time_us) {
+    last_ticks = ticks;
+    last_time_us = now_us;
+    return 0;
+  }
+  const double tick_hz = static_cast<double>(sysconf(_SC_CLK_TCK));
+  const double cpu_s = (ticks - last_ticks) / tick_hz;
+  const double wall_s = (now_us - last_time_us) / 1e6;
+  last_ticks = ticks;
+  last_time_us = now_us;
+  return static_cast<int64_t>(cpu_s / wall_s * 1000.0);
+}
+
+const int64_t g_start_us = tbutil::gettimeofday_us();
+
+struct DefaultVariables {
+  PassiveStatus<int64_t> rss{"process_memory_resident_bytes",
+                             read_rss_bytes};
+  PassiveStatus<int64_t> cpu{"process_cpu_millicores", cpu_millicores};
+  PassiveStatus<int64_t> fds{"process_fd_count", count_fds};
+  PassiveStatus<int64_t> threads{"process_thread_count", read_thread_count};
+  PassiveStatus<int64_t> uptime{"process_uptime_seconds", [] {
+    return (tbutil::gettimeofday_us() - g_start_us) / 1000000;
+  }};
+  PassiveStatus<int64_t> pid{"process_pid", [] {
+    return static_cast<int64_t>(getpid());
+  }};
+};
+
+}  // namespace
+
+// Called from trpc::GlobalInitializeOrDie so every server exposes them.
+void ExposeDefaultVariables() {
+  static DefaultVariables* v = new DefaultVariables;
+  (void)v;
+}
+
+}  // namespace tbvar

@@ -8,3 +8,9 @@
 #include "tbvar/reducer.h"
 #include "tbvar/variable.h"
 #include "tbvar/window.h"
+
+namespace tbvar {
+// Expose the process-level defaults (rss/cpu/fds/threads/uptime) —
+// default_variables.cpp; idempotent. Called by trpc global init.
+void ExposeDefaultVariables();
+}  // namespace tbvar

@@ -7,6 +7,7 @@
 #include <vector>
 
 #include <sched.h>
+#include <sys/epoll.h>
 #include <sys/syscall.h>
 #include <unistd.h>
 
@@ -284,6 +285,119 @@ struct TidCollector {
 };
 
 }  // namespace
+
+TEST_CASE(fiber_semaphore) {
+  FiberSemaphore sem(2);
+  ASSERT_TRUE(sem.try_wait());
+  ASSERT_TRUE(sem.try_wait());
+  ASSERT_FALSE(sem.try_wait());
+  // A fiber parks on the drained semaphore; post releases it.
+  std::atomic<int> got{0};
+  struct Ctx {
+    FiberSemaphore* sem;
+    std::atomic<int>* got;
+  } ctx{&sem, &got};
+  fiber_t tid;
+  fiber_start_background(
+      &tid, nullptr,
+      [](void* p) -> void* {
+        auto* c = static_cast<Ctx*>(p);
+        c->sem->wait();
+        c->got->store(1);
+        return nullptr;
+      },
+      &ctx);
+  usleep(20000);
+  ASSERT_EQ(got.load(), 0);  // still parked
+  sem.post();
+  fiber_join(tid, nullptr);
+  ASSERT_EQ(got.load(), 1);
+}
+
+TEST_CASE(fiber_rwlock) {
+  struct Shared {
+    FiberRWLock rw;
+    int value = 0;
+  } sh;
+  // Many concurrent readers + a few writers; writers see consistent totals.
+  constexpr int kReaders = 6, kWriters = 2, kIter = 500;
+  std::atomic<int64_t> read_sum{0};
+  std::vector<fiber_t> tids;
+  for (int i = 0; i < kWriters; ++i) {
+    fiber_t t;
+    struct W {
+      Shared* sh;
+    };
+    fiber_start_background(
+        &t, nullptr,
+        [](void* p) -> void* {
+          auto* sh = static_cast<Shared*>(p);
+          for (int j = 0; j < kIter; ++j) {
+            sh->rw.wrlock();
+            // Non-atomic RMW: only safe if writers truly exclude everyone.
+            int v = sh->value;
+            if (j % 50 == 0) fiber_yield();
+            sh->value = v + 1;
+            sh->rw.wrunlock();
+          }
+          return nullptr;
+        },
+        &sh);
+    tids.push_back(t);
+  }
+  struct R {
+    Shared* sh;
+    std::atomic<int64_t>* sum;
+  } rctx{&sh, &read_sum};
+  for (int i = 0; i < kReaders; ++i) {
+    fiber_t t;
+    fiber_start_background(
+        &t, nullptr,
+        [](void* p) -> void* {
+          auto* c = static_cast<R*>(p);
+          for (int j = 0; j < kIter; ++j) {
+            c->sh->rw.rdlock();
+            c->sum->fetch_add(c->sh->value);
+            c->sh->rw.rdunlock();
+          }
+          return nullptr;
+        },
+        &rctx);
+    tids.push_back(t);
+  }
+  for (fiber_t t : tids) fiber_join(t, nullptr);
+  ASSERT_EQ(sh.value, kWriters * kIter);  // no lost writer updates
+}
+
+TEST_CASE(fiber_fd_wait_pipe) {
+  int fds[2];
+  ASSERT_EQ(pipe(fds), 0);
+  // Not readable yet: a short deadline times out.
+  int64_t dl = tbutil::gettimeofday_us() + 30000;
+  ASSERT_EQ(fiber_fd_wait(fds[0], EPOLLIN, dl), -1);
+  ASSERT_EQ(errno, ETIMEDOUT);
+  // A writer from another fiber wakes the wait.
+  struct Ctx {
+    int wfd;
+  } ctx{fds[1]};
+  fiber_t tid;
+  fiber_start_background(
+      &tid, nullptr,
+      [](void* p) -> void* {
+        fiber_usleep(20000);
+        auto* c = static_cast<Ctx*>(p);
+        ssize_t unused = write(c->wfd, "x", 1);
+        (void)unused;
+        return nullptr;
+      },
+      &ctx);
+  ASSERT_EQ(fiber_fd_wait(fds[0], EPOLLIN, 0), 0);
+  char b;
+  ASSERT_EQ(read(fds[0], &b, 1), 1);
+  fiber_join(tid, nullptr);
+  close(fds[0]);
+  close(fds[1]);
+}
 
 // Worker tags: tagged fibers run ONLY on their tag's workers (disjoint from
 // the default pool), and a tag's workers honor the requested cpuset

@@ -17,6 +17,7 @@
 #include "trpc/health_check.h"
 #include "trpc/span.h"
 #include "trpc/compress.h"
+#include "trpc/http_protocol.h"
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/tstd_protocol.h"
@@ -755,6 +756,86 @@ TEST_CASE(rpcz_nested_trace_links) {
 
   server_a.Stop();
   server_b.Stop();
+}
+
+namespace {
+
+// Token-checking interceptor: requests must carry the magic prefix — the
+// Authenticator shape (reference server.h authenticator/interceptor seam).
+class TokenGate : public Interceptor {
+ public:
+  int OnRequest(Controller* cntl, const std::string& service_method,
+                const tbutil::IOBuf& request,
+                std::string* error_text) override {
+    _seen.fetch_add(1);
+    if (service_method == "EchoService/Echo" &&
+        request.to_string().rfind("tok:", 0) != 0) {
+      *error_text = "missing credential";
+      return TRPC_EREQUEST;
+    }
+    return 0;
+  }
+  int seen() const { return _seen.load(); }
+
+ private:
+  std::atomic<int> _seen{0};
+};
+
+}  // namespace
+
+TEST_CASE(interceptor_gates_requests) {
+  Server server;
+  EchoService svc;
+  TokenGate gate;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ServerOptions sopts;
+  sopts.interceptor = &gate;
+  ASSERT_EQ(server.Start(0, &sopts), 0);
+  Channel channel;
+  ASSERT_EQ(channel.Init(server.listen_address(), nullptr), 0);
+
+  {  // credentialed: passes through to the service
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("tok:hello");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(resp.equals("tok:hello"));
+  }
+  {  // uncredentialed: rejected BEFORE the handler, client sees the code
+    const int calls_before = svc.calls();
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("anonymous");
+    channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_TRUE(cntl.Failed());
+    ASSERT_EQ(cntl.ErrorCode(), (int)TRPC_EREQUEST);
+    ASSERT_EQ(cntl.ErrorText(), std::string("missing credential"));
+    ASSERT_EQ(svc.calls(), calls_before);  // handler never ran
+  }
+  // The SAME gate guards the HTTP path: a service reachable on two
+  // protocols must not have a one-protocol guard.
+  {
+    Channel http;
+    ChannelOptions hopts;
+    hopts.protocol = kHttpProtocolIndex;
+    ASSERT_EQ(http.Init(server.listen_address(), &hopts), 0);
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("anonymous");
+    http.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_TRUE(cntl.Failed());
+    ASSERT_EQ(cntl.ErrorCode(), (int)TRPC_EREQUEST);
+
+    Controller c2;
+    tbutil::IOBuf req2, resp2;
+    req2.append("tok:http");
+    http.CallMethod("EchoService/Echo", &c2, req2, &resp2, nullptr);
+    ASSERT_FALSE(c2.Failed());
+    ASSERT_TRUE(resp2.equals("tok:http"));
+  }
+  ASSERT_TRUE(gate.seen() >= 4);
+  server.Stop();
 }
 
 // Compression: gzip payloads round-trip transparently, the wire carries far

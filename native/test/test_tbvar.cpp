@@ -2,6 +2,8 @@
 // (test/bvar_reducer_unittest.cpp, bvar_percentile_unittest.cpp,
 // bvar_variable_unittest.cpp, bvar_recorder_unittest.cpp) in spirit.
 #include <cstdint>
+#include <cstdlib>
+#include <map>
 #include <string>
 #include <thread>
 #include <vector>
@@ -181,6 +183,24 @@ TEST_CASE(adder_write_throughput_smoke) {
   }
   for (auto& t : ths) t.join();
   ASSERT_EQ(a.get_value(), 4000000);
+}
+
+// Process defaults: rss/cpu/fds/threads answer "is this host sick" with no
+// app code (reference bvar/default_variables.cpp).
+TEST_CASE(default_process_variables) {
+  ExposeDefaultVariables();
+  std::map<std::string, std::string> vars;
+  Variable::dump_exposed(&vars);
+  ASSERT_TRUE(vars.count("process_memory_resident_bytes") == 1);
+  ASSERT_TRUE(vars.count("process_cpu_millicores") == 1);
+  ASSERT_TRUE(vars.count("process_fd_count") == 1);
+  ASSERT_TRUE(vars.count("process_thread_count") == 1);
+  ASSERT_TRUE(vars.count("process_uptime_seconds") == 1);
+  // Sanity: a live process has >1MB resident, >=1 thread, >=3 fds.
+  ASSERT_TRUE(atoll(vars["process_memory_resident_bytes"].c_str()) >
+              1 << 20);
+  ASSERT_TRUE(atoll(vars["process_thread_count"].c_str()) >= 1);
+  ASSERT_TRUE(atoll(vars["process_fd_count"].c_str()) >= 3);
 }
 
 TEST_MAIN

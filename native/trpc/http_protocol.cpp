@@ -559,6 +559,20 @@ void http_process_request(InputMessageBase* base) {
       });
   tbutil::IOBuf request = std::move(msg->body);
   msg.reset();
+  // Pre-dispatch interception: the same auth/quota gate as the tstd path —
+  // a service reachable on two protocols must not have a one-protocol
+  // guard (server.h Interceptor).
+  if (Interceptor* icept = server->interceptor()) {
+    std::string reject_text;
+    const int rc = icept->OnRequest(cntl, service_name + "/" + method,
+                                    request, &reject_text);
+    if (rc != 0) {
+      cntl->SetFailed(rc, reject_text.empty() ? "rejected by interceptor"
+                                              : reject_text);
+      done->Run();
+      return;
+    }
+  }
   svc->CallMethod(method, cntl, request, response, done);
 }
 

@@ -35,10 +35,26 @@ class Service {
                           tbutil::IOBuf* response, Closure* done) = 0;
 };
 
+// Pre-dispatch hook: runs after admission, before the service method.
+// Reject by returning a nonzero error code (sent to the client verbatim).
+// Covers the reference's Interceptor AND the Authenticator use case —
+// cntl->remote_side() identifies the peer; the request bytes are available
+// for credential extraction (reference server.h interceptor +
+// authenticator, details/method_status pre-dispatch path).
+class Interceptor {
+ public:
+  virtual ~Interceptor() = default;
+  virtual int OnRequest(Controller* cntl, const std::string& service_method,
+                        const tbutil::IOBuf& request,
+                        std::string* error_text) = 0;
+};
+
 struct ServerOptions {
   // 0 = unlimited. Requests over the cap are rejected with TRPC_ELIMIT
   // (reference ServerOptions.max_concurrency server.h:132).
   int32_t max_concurrency = 0;
+  // Not owned; must outlive the server. nullptr = no interception.
+  Interceptor* interceptor = nullptr;
   // Adaptive gate (overrides max_concurrency): a gradient limiter tracks
   // the no-load latency and sheds load when latency inflates past it
   // (reference max_concurrency = "auto",
@@ -101,6 +117,7 @@ class Server {
   }
   // Current admission gate (0 = unlimited); live for the auto policy.
   int32_t current_max_concurrency() const;
+  Interceptor* interceptor() const { return _options.interceptor; }
 
  private:
   tbutil::FlatMap<std::string, Service*> _services;

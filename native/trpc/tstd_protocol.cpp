@@ -377,6 +377,7 @@ void tstd_process_request(InputMessageBase* base) {
   tbutil::IOBuf request = std::move(msg->payload);
   std::string method = std::move(msg->meta.method);
   if (msg->meta.compress_type != kCompressNone) {
+    // (decompressed below; the interceptor sees plain bytes)
     const Compressor* c = GetCompressor(msg->meta.compress_type);
     tbutil::IOBuf plain;
     const size_t max_out = static_cast<size_t>(
@@ -392,6 +393,19 @@ void tstd_process_request(InputMessageBase* base) {
     cntl->set_compress_type(msg->meta.compress_type);
   }
   delete msg;
+  // Pre-dispatch interception (auth, quota, audit — reference server
+  // interceptor/authenticator seam).
+  if (Interceptor* icept = server->interceptor()) {
+    std::string reject_text;
+    const int rc =
+        icept->OnRequest(cntl, full_method, request, &reject_text);
+    if (rc != 0) {
+      cntl->SetFailed(rc, reject_text.empty() ? "rejected by interceptor"
+                                              : reject_text);
+      done->Run();
+      return;
+    }
+  }
   if (server_span_id != 0) {
     // The context lives for the synchronous part of the handler — where
     // nested client calls are issued. (An async handler that parks `done`
@@ -414,6 +428,7 @@ void GlobalInitializeOrDie() {
     // never as a process-killing signal (reference: brpc ignores SIGPIPE
     // the same way; every network daemon does).
     signal(SIGPIPE, SIG_IGN);
+    tbvar::ExposeDefaultVariables();
     RegisterBuiltinCompressors();
     Protocol p;
     p.parse = tstd_parse;
