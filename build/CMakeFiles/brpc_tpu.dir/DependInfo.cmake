@@ -1,0 +1,82 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  "ASM"
+  )
+# The set of files for implicit dependencies of each language:
+set(CMAKE_DEPENDS_CHECK_ASM
+  "/root/repo/native/tbthread/context.S" "/root/repo/build/CMakeFiles/brpc_tpu.dir/tbthread/context.S.o"
+  )
+set(CMAKE_ASM_COMPILER_ID "GNU")
+
+# Preprocessor definitions for this target.
+set(CMAKE_TARGET_DEFINITIONS_ASM
+  "brpc_tpu_EXPORTS"
+  )
+
+# The include file search paths:
+set(CMAKE_ASM_TARGET_INCLUDE_PATH
+  "/root/repo/native"
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/native/capi/capi.cpp" "CMakeFiles/brpc_tpu.dir/capi/capi.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/capi/capi.cpp.o.d"
+  "/root/repo/native/tbthread/butex.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/butex.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/butex.cpp.o.d"
+  "/root/repo/native/tbthread/fiber.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/fiber.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/fiber.cpp.o.d"
+  "/root/repo/native/tbthread/fiber_fd.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/fiber_fd.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/fiber_fd.cpp.o.d"
+  "/root/repo/native/tbthread/fiber_id.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/fiber_id.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/fiber_id.cpp.o.d"
+  "/root/repo/native/tbthread/key.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/key.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/key.cpp.o.d"
+  "/root/repo/native/tbthread/stack.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/stack.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/stack.cpp.o.d"
+  "/root/repo/native/tbthread/task_control.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/task_control.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/task_control.cpp.o.d"
+  "/root/repo/native/tbthread/task_group.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/task_group.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/task_group.cpp.o.d"
+  "/root/repo/native/tbthread/timer_thread.cpp" "CMakeFiles/brpc_tpu.dir/tbthread/timer_thread.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbthread/timer_thread.cpp.o.d"
+  "/root/repo/native/tbutil/endpoint.cpp" "CMakeFiles/brpc_tpu.dir/tbutil/endpoint.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbutil/endpoint.cpp.o.d"
+  "/root/repo/native/tbutil/fast_rand.cpp" "CMakeFiles/brpc_tpu.dir/tbutil/fast_rand.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbutil/fast_rand.cpp.o.d"
+  "/root/repo/native/tbutil/iobuf.cpp" "CMakeFiles/brpc_tpu.dir/tbutil/iobuf.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbutil/iobuf.cpp.o.d"
+  "/root/repo/native/tbvar/combiner.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/combiner.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/combiner.cpp.o.d"
+  "/root/repo/native/tbvar/default_variables.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/default_variables.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/default_variables.cpp.o.d"
+  "/root/repo/native/tbvar/latency_recorder.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/latency_recorder.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/latency_recorder.cpp.o.d"
+  "/root/repo/native/tbvar/percentile.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/percentile.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/percentile.cpp.o.d"
+  "/root/repo/native/tbvar/prometheus.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/prometheus.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/prometheus.cpp.o.d"
+  "/root/repo/native/tbvar/sampler.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/sampler.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/sampler.cpp.o.d"
+  "/root/repo/native/tbvar/variable.cpp" "CMakeFiles/brpc_tpu.dir/tbvar/variable.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/tbvar/variable.cpp.o.d"
+  "/root/repo/native/trpc/acceptor.cpp" "CMakeFiles/brpc_tpu.dir/trpc/acceptor.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/acceptor.cpp.o.d"
+  "/root/repo/native/trpc/builtin_console.cpp" "CMakeFiles/brpc_tpu.dir/trpc/builtin_console.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/builtin_console.cpp.o.d"
+  "/root/repo/native/trpc/channel.cpp" "CMakeFiles/brpc_tpu.dir/trpc/channel.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/channel.cpp.o.d"
+  "/root/repo/native/trpc/circuit_breaker.cpp" "CMakeFiles/brpc_tpu.dir/trpc/circuit_breaker.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/circuit_breaker.cpp.o.d"
+  "/root/repo/native/trpc/compress.cpp" "CMakeFiles/brpc_tpu.dir/trpc/compress.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/compress.cpp.o.d"
+  "/root/repo/native/trpc/concurrency_limiter.cpp" "CMakeFiles/brpc_tpu.dir/trpc/concurrency_limiter.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/concurrency_limiter.cpp.o.d"
+  "/root/repo/native/trpc/controller.cpp" "CMakeFiles/brpc_tpu.dir/trpc/controller.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/controller.cpp.o.d"
+  "/root/repo/native/trpc/event_dispatcher.cpp" "CMakeFiles/brpc_tpu.dir/trpc/event_dispatcher.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/event_dispatcher.cpp.o.d"
+  "/root/repo/native/trpc/flags.cpp" "CMakeFiles/brpc_tpu.dir/trpc/flags.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/flags.cpp.o.d"
+  "/root/repo/native/trpc/health_check.cpp" "CMakeFiles/brpc_tpu.dir/trpc/health_check.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/health_check.cpp.o.d"
+  "/root/repo/native/trpc/http_protocol.cpp" "CMakeFiles/brpc_tpu.dir/trpc/http_protocol.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/http_protocol.cpp.o.d"
+  "/root/repo/native/trpc/input_messenger.cpp" "CMakeFiles/brpc_tpu.dir/trpc/input_messenger.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/input_messenger.cpp.o.d"
+  "/root/repo/native/trpc/load_balancer.cpp" "CMakeFiles/brpc_tpu.dir/trpc/load_balancer.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/load_balancer.cpp.o.d"
+  "/root/repo/native/trpc/naming_service.cpp" "CMakeFiles/brpc_tpu.dir/trpc/naming_service.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/naming_service.cpp.o.d"
+  "/root/repo/native/trpc/parallel_channel.cpp" "CMakeFiles/brpc_tpu.dir/trpc/parallel_channel.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/parallel_channel.cpp.o.d"
+  "/root/repo/native/trpc/partition_channel.cpp" "CMakeFiles/brpc_tpu.dir/trpc/partition_channel.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/partition_channel.cpp.o.d"
+  "/root/repo/native/trpc/protocol.cpp" "CMakeFiles/brpc_tpu.dir/trpc/protocol.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/protocol.cpp.o.d"
+  "/root/repo/native/trpc/rpc_dump.cpp" "CMakeFiles/brpc_tpu.dir/trpc/rpc_dump.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/rpc_dump.cpp.o.d"
+  "/root/repo/native/trpc/rpc_metrics.cpp" "CMakeFiles/brpc_tpu.dir/trpc/rpc_metrics.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/rpc_metrics.cpp.o.d"
+  "/root/repo/native/trpc/selective_channel.cpp" "CMakeFiles/brpc_tpu.dir/trpc/selective_channel.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/selective_channel.cpp.o.d"
+  "/root/repo/native/trpc/server.cpp" "CMakeFiles/brpc_tpu.dir/trpc/server.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/server.cpp.o.d"
+  "/root/repo/native/trpc/socket.cpp" "CMakeFiles/brpc_tpu.dir/trpc/socket.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/socket.cpp.o.d"
+  "/root/repo/native/trpc/socket_map.cpp" "CMakeFiles/brpc_tpu.dir/trpc/socket_map.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/socket_map.cpp.o.d"
+  "/root/repo/native/trpc/span.cpp" "CMakeFiles/brpc_tpu.dir/trpc/span.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/span.cpp.o.d"
+  "/root/repo/native/trpc/stream.cpp" "CMakeFiles/brpc_tpu.dir/trpc/stream.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/stream.cpp.o.d"
+  "/root/repo/native/trpc/tstd_protocol.cpp" "CMakeFiles/brpc_tpu.dir/trpc/tstd_protocol.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/trpc/tstd_protocol.cpp.o.d"
+  "/root/repo/native/ttpu/ici_endpoint.cpp" "CMakeFiles/brpc_tpu.dir/ttpu/ici_endpoint.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/ttpu/ici_endpoint.cpp.o.d"
+  "/root/repo/native/ttpu/ici_segment.cpp" "CMakeFiles/brpc_tpu.dir/ttpu/ici_segment.cpp.o" "gcc" "CMakeFiles/brpc_tpu.dir/ttpu/ici_segment.cpp.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for brpc_tpu.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/echo_rpc_demo.dir/root/repo/examples/echo_rpc_demo.cpp.o"
+  "CMakeFiles/echo_rpc_demo.dir/root/repo/examples/echo_rpc_demo.cpp.o.d"
+  "echo_rpc_demo"
+  "echo_rpc_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/echo_rpc_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

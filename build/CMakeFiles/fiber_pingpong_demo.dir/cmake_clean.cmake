@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/fiber_pingpong_demo.dir/root/repo/examples/fiber_pingpong_demo.cpp.o"
+  "CMakeFiles/fiber_pingpong_demo.dir/root/repo/examples/fiber_pingpong_demo.cpp.o.d"
+  "fiber_pingpong_demo"
+  "fiber_pingpong_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/fiber_pingpong_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/iobuf_pipe_demo.dir/root/repo/examples/iobuf_pipe_demo.cpp.o"
+  "CMakeFiles/iobuf_pipe_demo.dir/root/repo/examples/iobuf_pipe_demo.cpp.o.d"
+  "iobuf_pipe_demo"
+  "iobuf_pipe_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/iobuf_pipe_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/multi_threaded_echo_demo.dir/root/repo/examples/multi_threaded_echo_demo.cpp.o"
+  "CMakeFiles/multi_threaded_echo_demo.dir/root/repo/examples/multi_threaded_echo_demo.cpp.o.d"
+  "multi_threaded_echo_demo"
+  "multi_threaded_echo_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/multi_threaded_echo_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for multi_threaded_echo_demo.
+# This may be replaced when dependencies are built.

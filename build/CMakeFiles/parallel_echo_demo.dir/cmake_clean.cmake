@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/parallel_echo_demo.dir/root/repo/examples/parallel_echo_demo.cpp.o"
+  "CMakeFiles/parallel_echo_demo.dir/root/repo/examples/parallel_echo_demo.cpp.o.d"
+  "parallel_echo_demo"
+  "parallel_echo_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/parallel_echo_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

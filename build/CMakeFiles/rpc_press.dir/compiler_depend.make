@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for rpc_press.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/streaming_echo_demo.dir/root/repo/examples/streaming_echo_demo.cpp.o"
+  "CMakeFiles/streaming_echo_demo.dir/root/repo/examples/streaming_echo_demo.cpp.o.d"
+  "streaming_echo_demo"
+  "streaming_echo_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/streaming_echo_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_combo.dir/test/test_combo.cpp.o"
+  "CMakeFiles/test_combo.dir/test/test_combo.cpp.o.d"
+  "test_combo"
+  "test_combo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_combo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_fiber.dir/test/test_fiber.cpp.o"
+  "CMakeFiles/test_fiber.dir/test/test_fiber.cpp.o.d"
+  "test_fiber"
+  "test_fiber.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_fiber.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_fiber_id_eq.dir/test/test_fiber_id_eq.cpp.o"
+  "CMakeFiles/test_fiber_id_eq.dir/test/test_fiber_id_eq.cpp.o.d"
+  "test_fiber_id_eq"
+  "test_fiber_id_eq.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_fiber_id_eq.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for test_fiber_id_eq.
+# This may be replaced when dependencies are built.

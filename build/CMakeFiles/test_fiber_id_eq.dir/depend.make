@@ -1,0 +1,2 @@
+# Empty dependencies file for test_fiber_id_eq.
+# This may be replaced when dependencies are built.

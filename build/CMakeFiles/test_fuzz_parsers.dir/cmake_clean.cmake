@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_fuzz_parsers.dir/test/test_fuzz_parsers.cpp.o"
+  "CMakeFiles/test_fuzz_parsers.dir/test/test_fuzz_parsers.cpp.o.d"
+  "test_fuzz_parsers"
+  "test_fuzz_parsers.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_fuzz_parsers.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

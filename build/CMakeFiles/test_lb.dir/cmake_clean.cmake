@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_lb.dir/test/test_lb.cpp.o"
+  "CMakeFiles/test_lb.dir/test/test_lb.cpp.o.d"
+  "test_lb"
+  "test_lb.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_lb.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

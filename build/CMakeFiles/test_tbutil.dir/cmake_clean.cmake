@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_tbutil.dir/test/test_tbutil.cpp.o"
+  "CMakeFiles/test_tbutil.dir/test/test_tbutil.cpp.o.d"
+  "test_tbutil"
+  "test_tbutil.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_tbutil.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_tbvar.dir/test/test_tbvar.cpp.o"
+  "CMakeFiles/test_tbvar.dir/test/test_tbvar.cpp.o.d"
+  "test_tbvar"
+  "test_tbvar.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_tbvar.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,2 @@
+# Empty dependencies file for test_transport.
+# This may be replaced when dependencies are built.

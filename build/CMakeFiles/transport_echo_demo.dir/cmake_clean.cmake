@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/transport_echo_demo.dir/root/repo/examples/transport_echo_demo.cpp.o"
+  "CMakeFiles/transport_echo_demo.dir/root/repo/examples/transport_echo_demo.cpp.o.d"
+  "transport_echo_demo"
+  "transport_echo_demo.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/transport_echo_demo.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,30 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test([=[test_combo]=] "/root/repo/build/test_combo")
+set_tests_properties([=[test_combo]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_fiber]=] "/root/repo/build/test_fiber")
+set_tests_properties([=[test_fiber]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_fiber_id_eq]=] "/root/repo/build/test_fiber_id_eq")
+set_tests_properties([=[test_fiber_id_eq]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_fuzz_parsers]=] "/root/repo/build/test_fuzz_parsers")
+set_tests_properties([=[test_fuzz_parsers]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_http]=] "/root/repo/build/test_http")
+set_tests_properties([=[test_http]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_lb]=] "/root/repo/build/test_lb")
+set_tests_properties([=[test_lb]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_rpc]=] "/root/repo/build/test_rpc")
+set_tests_properties([=[test_rpc]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_stream]=] "/root/repo/build/test_stream")
+set_tests_properties([=[test_stream]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_tbutil]=] "/root/repo/build/test_tbutil")
+set_tests_properties([=[test_tbutil]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_tbvar]=] "/root/repo/build/test_tbvar")
+set_tests_properties([=[test_tbvar]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_tpu_transport]=] "/root/repo/build/test_tpu_transport")
+set_tests_properties([=[test_tpu_transport]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[test_transport]=] "/root/repo/build/test_transport")
+set_tests_properties([=[test_transport]=] PROPERTIES  TIMEOUT "300" _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;36;add_test;/root/repo/native/CMakeLists.txt;0;")

@@ -30,6 +30,10 @@ namespace {
 struct FdWaiter {
   Butex* btx;
   std::atomic<int> revents{0};
+  // Set by the waker AFTER its last touch of this struct: the waiter may
+  // only destroy the butex/struct once true (or when the waker provably
+  // never saw the registration).
+  std::atomic<bool> waker_done{false};
 };
 
 struct FdWaitService {
@@ -72,6 +76,7 @@ struct FdWaitService {
         w->revents.store(static_cast<int>(evs[i].events),
                          std::memory_order_release);
         butex_increment_and_wake_all(w->btx);
+        w->waker_done.store(true, std::memory_order_release);  // last touch
       }
     }
   }
@@ -121,16 +126,19 @@ int fiber_fd_wait(int fd, unsigned int epoll_events, int64_t deadline_us) {
     abstp = &abst;
   }
   int rc = 0;
+  bool waker_involved = true;
   while (w.revents.load(std::memory_order_acquire) == 0) {
     if (butex_wait(w.btx, seq, abstp) != 0 && errno == ETIMEDOUT) {
       // Deadline: try to withdraw. If the waker already took us, it WILL
-      // wake — wait for that instead so `w` never dies under it.
+      // signal waker_done — wait for that instead so `w` never dies while
+      // the waker still holds the pointer.
       std::unique_lock<std::mutex> lk(svc.mu);
       auto it = svc.waiters.find(fd);
       if (it != svc.waiters.end() && it->second.w == &w) {
         svc.waiters.erase(it);
         epoll_ctl(svc.epfd, EPOLL_CTL_DEL, fd, nullptr);
         lk.unlock();
+        waker_involved = false;  // we withdrew: the waker never saw us
         rc = -1;
         errno = ETIMEDOUT;
         break;
@@ -138,6 +146,14 @@ int fiber_fd_wait(int fd, unsigned int epoll_events, int64_t deadline_us) {
       lk.unlock();
       abstp = nullptr;  // the waker owns us: it will signal promptly
       continue;
+    }
+  }
+  // An exit via revents means the waker touched `w`; it may still be
+  // between its revents store / wake and its final waker_done store. Spin
+  // those few instructions out before freeing stack memory it points at.
+  if (waker_involved) {
+    while (!w.waker_done.load(std::memory_order_acquire)) {
+      fiber_yield();
     }
   }
   butex_destroy(w.btx);

@@ -79,14 +79,22 @@ int64_t read_thread_count() {
   return n;
 }
 
-// CPU usage over the interval between reads: cores busy (x1000 so the
-// integer var carries milli-cores, e.g. 1500 = 1.5 cores).
+// CPU usage over the interval between samples: cores busy (x1000 so the
+// integer var carries milli-cores, e.g. 1500 = 1.5 cores). The measurement
+// window is refreshed at most twice a second and the value is CACHED in
+// between — concurrent scrapers (/metrics + /vars + console) must not
+// shred each other's window into sub-tick slivers.
 int64_t cpu_millicores() {
   static std::mutex mu;
   static int64_t last_ticks = 0;
   static int64_t last_time_us = 0;
+  static int64_t cached = 0;
+  constexpr int64_t kMinWindowUs = 500000;
   std::lock_guard<std::mutex> lk(mu);
   const int64_t now_us = tbutil::monotonic_time_us();
+  if (last_time_us != 0 && now_us - last_time_us < kMinWindowUs) {
+    return cached;
+  }
   const int64_t ticks = read_cpu_ticks();
   if (last_time_us == 0 || now_us <= last_time_us) {
     last_ticks = ticks;
@@ -98,7 +106,8 @@ int64_t cpu_millicores() {
   const double wall_s = (now_us - last_time_us) / 1e6;
   last_ticks = ticks;
   last_time_us = now_us;
-  return static_cast<int64_t>(cpu_s / wall_s * 1000.0);
+  cached = static_cast<int64_t>(cpu_s / wall_s * 1000.0);
+  return cached;
 }
 
 const int64_t g_start_us = tbutil::gettimeofday_us();

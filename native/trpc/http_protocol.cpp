@@ -559,6 +559,12 @@ void http_process_request(InputMessageBase* base) {
       });
   tbutil::IOBuf request = std::move(msg->body);
   msg.reset();
+  // rpc_dump sampling — both protocols feed one dump file, like the
+  // interceptor below guards both.
+  if (RpcDumper* d = server->dumper()) {
+    d->MaybeSample(service_name + "/" + method, request,
+                   cntl->request_attachment());
+  }
   // Pre-dispatch interception: the same auth/quota gate as the tstd path —
   // a service reachable on two protocols must not have a one-protocol
   // guard (server.h Interceptor).

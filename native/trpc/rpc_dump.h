@@ -1,0 +1,49 @@
+// rpc_dump: sample inbound requests to a file for offline replay.
+// Capability parity: reference src/brpc/rpc_dump.h:67 (SampledRequest pool +
+// background writer, gated by -rpc_dump flags) + tools/rpc_replay. Format is
+// our own length-prefixed recordio:
+//   [u32 record_len][u16 m_len][service/method][u32 body_len][body]
+//   [u32 att_len][attachment]
+// record_len counts everything after itself. Little-endian, same as tstd.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+struct DumpedRequest {
+  std::string service_method;
+  tbutil::IOBuf body;
+  tbutil::IOBuf attachment;
+};
+
+class RpcDumper {
+ public:
+  // Appends to `path` (created if absent). Returns nullptr on open failure.
+  static RpcDumper* Open(const std::string& path);
+  ~RpcDumper();
+
+  // Sampling honors the rpc_dump_sample_every flag (record every Nth call).
+  void MaybeSample(const std::string& service_method,
+                   const tbutil::IOBuf& body,
+                   const tbutil::IOBuf& attachment);
+  // Writes are buffered (flushed every 64 records and at destruction);
+  // call before reading the file from a live process.
+  void Flush();
+  int64_t recorded() const;
+
+  // Load a dump file (replay tools + tests). Returns 0 on success.
+  static int ReadAll(const std::string& path,
+                     std::vector<DumpedRequest>* out);
+
+ private:
+  struct Impl;
+  Impl* _impl;
+  explicit RpcDumper(Impl* impl) : _impl(impl) {}
+};
+
+}  // namespace trpc

@@ -65,6 +65,9 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   _limiter = _options.auto_concurrency
                  ? NewAutoLimiter()
                  : NewConstantLimiter(_options.max_concurrency);
+  if (!_options.rpc_dump_path.empty()) {
+    _dumper.reset(RpcDumper::Open(_options.rpc_dump_path));
+  }
   if (_stop_butex == nullptr) _stop_butex = tbthread::butex_create();
   if (_drain_butex == nullptr) _drain_butex = tbthread::butex_create();
 

@@ -18,6 +18,7 @@
 #include "trpc/closure.h"
 #include "trpc/concurrency_limiter.h"
 #include "trpc/controller.h"
+#include "trpc/rpc_dump.h"
 
 namespace trpc {
 
@@ -55,6 +56,10 @@ struct ServerOptions {
   int32_t max_concurrency = 0;
   // Not owned; must outlive the server. nullptr = no interception.
   Interceptor* interceptor = nullptr;
+  // Sample inbound requests (post-decompression) to this file for offline
+  // replay with rpc_replay/rpc_press (reference rpc_dump.h:67; sampling
+  // rate via the rpc_dump_sample_every flag). Empty = off.
+  std::string rpc_dump_path;
   // Adaptive gate (overrides max_concurrency): a gradient limiter tracks
   // the no-load latency and sheds load when latency inflates past it
   // (reference max_concurrency = "auto",
@@ -118,11 +123,13 @@ class Server {
   // Current admission gate (0 = unlimited); live for the auto policy.
   int32_t current_max_concurrency() const;
   Interceptor* interceptor() const { return _options.interceptor; }
+  RpcDumper* dumper() const { return _dumper.get(); }
 
  private:
   tbutil::FlatMap<std::string, Service*> _services;
   ServerOptions _options;
   std::unique_ptr<ConcurrencyLimiter> _limiter;
+  std::unique_ptr<RpcDumper> _dumper;
   Acceptor _acceptor;
   tbutil::EndPoint _listen_address;
   std::atomic<bool> _running{false};

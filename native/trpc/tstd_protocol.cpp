@@ -393,6 +393,10 @@ void tstd_process_request(InputMessageBase* base) {
     cntl->set_compress_type(msg->meta.compress_type);
   }
   delete msg;
+  // rpc_dump sampling (post-decompression: replay feeds plain bytes).
+  if (RpcDumper* d = server->dumper()) {
+    d->MaybeSample(full_method, request, cntl->request_attachment());
+  }
   // Pre-dispatch interception (auth, quota, audit — reference server
   // interceptor/authenticator seam).
   if (Interceptor* icept = server->interceptor()) {

@@ -1,0 +1,210 @@
+// rpc_press: load generator for tstd servers — the analog of reference
+// tools/rpc_press (synthetic load) and tools/rpc_replay (replaying an
+// rpc_dump file when --input is given). Fiber-based callers report
+// qps + latency avg/p50/p99/max once per second and a final summary.
+//
+// Usage:
+//   rpc_press --server=HOST:PORT [--method=Svc/Method] [--payload=BYTES]
+//             [--input=DUMPFILE] [--concurrency=N] [--duration=SECONDS]
+//             [--qps=N (0 = unthrottled)] [--transport=tcp|tpu]
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tbthread/fiber.h"
+#include "tbthread/sync.h"
+#include "tbutil/time.h"
+#include "trpc/channel.h"
+#include "trpc/rpc_dump.h"
+
+using namespace trpc;
+
+namespace {
+
+struct Options {
+  std::string server;
+  std::string method = "EchoService/Echo";
+  std::string input;
+  size_t payload = 1024;
+  int concurrency = 8;
+  int duration_s = 10;
+  int64_t qps = 0;
+  bool tpu = false;
+};
+
+struct Stats {
+  std::mutex mu;
+  std::vector<int64_t> latencies;
+  std::atomic<int64_t> ok{0};
+  std::atomic<int64_t> failed{0};
+
+  void add(int64_t us) {
+    std::lock_guard<std::mutex> lk(mu);
+    latencies.push_back(us);
+  }
+};
+
+struct WorkerArg {
+  Options* opts;
+  Channel* channel;
+  Stats* stats;
+  const std::vector<DumpedRequest>* replay;  // nullptr = synthetic
+  std::atomic<int64_t>* next_send_us;        // qps pacing (shared)
+  std::atomic<size_t>* replay_cursor;
+  int64_t stop_at_us;
+  tbthread::CountdownEvent* done;
+};
+
+void* press_worker(void* argv) {
+  auto* a = static_cast<WorkerArg*>(argv);
+  const std::string synthetic(a->opts->payload, 'p');
+  const int64_t gap_us =
+      a->opts->qps > 0 ? 1000000 / a->opts->qps : 0;
+  while (tbutil::monotonic_time_us() < a->stop_at_us) {
+    if (gap_us > 0) {
+      // Shared pacing: claim the next send slot; sleep until it.
+      const int64_t slot =
+          a->next_send_us->fetch_add(gap_us, std::memory_order_relaxed);
+      const int64_t now = tbutil::monotonic_time_us();
+      if (slot > now) tbthread::fiber_usleep(uint64_t(slot - now));
+    }
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    std::string method = a->opts->method;
+    if (a->replay != nullptr) {
+      const DumpedRequest& r =
+          (*a->replay)[a->replay_cursor->fetch_add(
+                           1, std::memory_order_relaxed) %
+                       a->replay->size()];
+      method = r.service_method;
+      req.append(r.body);
+      cntl.request_attachment().append(r.attachment);
+    } else {
+      req.append(synthetic);
+    }
+    a->channel->CallMethod(method, &cntl, req, &resp, nullptr);
+    if (cntl.Failed()) {
+      a->stats->failed.fetch_add(1);
+    } else {
+      a->stats->ok.fetch_add(1);
+      a->stats->add(cntl.latency_us());
+    }
+  }
+  a->done->signal();
+  return nullptr;
+}
+
+void print_percentiles(Stats& stats, double secs) {
+  std::lock_guard<std::mutex> lk(stats.mu);
+  auto& v = stats.latencies;
+  if (v.empty()) {
+    printf("no successful calls\n");
+    return;
+  }
+  std::sort(v.begin(), v.end());
+  int64_t sum = 0;
+  for (int64_t x : v) sum += x;
+  printf("calls=%lld ok, %lld failed | qps=%.0f | latency us: avg=%lld "
+         "p50=%lld p99=%lld max=%lld\n",
+         static_cast<long long>(stats.ok.load()),
+         static_cast<long long>(stats.failed.load()),
+         stats.ok.load() / secs, static_cast<long long>(sum / int64_t(v.size())),
+         static_cast<long long>(v[v.size() / 2]),
+         static_cast<long long>(v[size_t(v.size() * 0.99)]),
+         static_cast<long long>(v.back()));
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opts;
+  for (int i = 1; i < argc; ++i) {
+    const char* arg = argv[i];
+    if (strncmp(arg, "--server=", 9) == 0) opts.server = arg + 9;
+    else if (strncmp(arg, "--method=", 9) == 0) opts.method = arg + 9;
+    else if (strncmp(arg, "--input=", 8) == 0) opts.input = arg + 8;
+    else if (strncmp(arg, "--payload=", 10) == 0) opts.payload = atol(arg + 10);
+    else if (strncmp(arg, "--concurrency=", 14) == 0)
+      opts.concurrency = atoi(arg + 14);
+    else if (strncmp(arg, "--duration=", 11) == 0)
+      opts.duration_s = atoi(arg + 11);
+    else if (strncmp(arg, "--qps=", 6) == 0) opts.qps = atoll(arg + 6);
+    else if (strcmp(arg, "--transport=tpu") == 0) opts.tpu = true;
+    else if (strcmp(arg, "--transport=tcp") == 0) opts.tpu = false;
+    else {
+      fprintf(stderr, "unknown arg: %s\n", arg);
+      return 2;
+    }
+  }
+  if (opts.server.empty()) {
+    fprintf(stderr,
+            "usage: rpc_press --server=HOST:PORT [--method=Svc/M] "
+            "[--payload=N] [--input=DUMP] [--concurrency=N] "
+            "[--duration=S] [--qps=N] [--transport=tcp|tpu]\n");
+    return 2;
+  }
+  std::vector<DumpedRequest> replay;
+  if (!opts.input.empty()) {
+    if (RpcDumper::ReadAll(opts.input, &replay) != 0 || replay.empty()) {
+      fprintf(stderr, "cannot load dump file %s\n", opts.input.c_str());
+      return 1;
+    }
+    printf("replaying %zu dumped requests from %s\n", replay.size(),
+           opts.input.c_str());
+  }
+
+  Channel channel;
+  ChannelOptions copts;
+  copts.timeout_ms = 10000;
+  copts.connection_type = ConnectionType::kPooled;
+  const std::string addr =
+      (opts.tpu ? std::string("tpu://") : std::string()) + opts.server;
+  if (channel.Init(addr.c_str(), &copts) != 0) {
+    fprintf(stderr, "cannot init channel to %s\n", addr.c_str());
+    return 1;
+  }
+
+  Stats stats;
+  std::atomic<int64_t> next_send_us{tbutil::monotonic_time_us()};
+  std::atomic<size_t> replay_cursor{0};
+  tbthread::CountdownEvent done(opts.concurrency);
+  const int64_t stop_at =
+      tbutil::monotonic_time_us() + int64_t(opts.duration_s) * 1000000;
+  std::vector<WorkerArg> args(
+      opts.concurrency,
+      WorkerArg{&opts, &channel, &stats,
+                replay.empty() ? nullptr : &replay, &next_send_us,
+                &replay_cursor, stop_at, &done});
+  const int64_t t0 = tbutil::monotonic_time_us();
+  for (int i = 0; i < opts.concurrency; ++i) {
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_background(&tid, nullptr, press_worker,
+                                         &args[i]) != 0) {
+      fprintf(stderr, "fiber start failed\n");
+      return 1;
+    }
+  }
+  // Progress line once per second while workers run.
+  int64_t last_ok = 0, last_failed = 0;
+  while (true) {
+    const int64_t dl = tbutil::gettimeofday_us() + 1000000;
+    timespec abst{static_cast<time_t>(dl / 1000000),
+                  static_cast<long>((dl % 1000000) * 1000)};
+    if (done.timed_wait(abst)) break;  // all workers finished
+    const int64_t ok = stats.ok.load(), failed = stats.failed.load();
+    printf("[t+%2.0fs] qps=%lld failed=%lld\n",
+           (tbutil::monotonic_time_us() - t0) / 1e6,
+           static_cast<long long>(ok - last_ok),
+           static_cast<long long>(failed - last_failed));
+    fflush(stdout);
+    last_ok = ok;
+    last_failed = failed;
+  }
+  const double secs = (tbutil::monotonic_time_us() - t0) / 1e6;
+  print_percentiles(stats, secs);
+  return stats.ok.load() > 0 ? 0 : 1;
+}
