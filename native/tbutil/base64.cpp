@@ -1,0 +1,92 @@
+#include "tbutil/base64.h"
+
+#include <cstdint>
+
+namespace tbutil {
+
+namespace {
+constexpr char kAlphabet[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+struct ReverseTable {
+  int8_t r[256];
+  ReverseTable() {
+    for (int i = 0; i < 256; ++i) r[i] = -1;
+    for (int i = 0; i < 64; ++i) {
+      r[static_cast<uint8_t>(kAlphabet[i])] = static_cast<int8_t>(i);
+    }
+  }
+};
+const ReverseTable& rev() {
+  static const ReverseTable t;
+  return t;
+}
+}  // namespace
+
+std::string base64_encode(std::string_view in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= in.size(); i += 3) {
+    const uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                       (static_cast<uint8_t>(in[i + 1]) << 8) |
+                       static_cast<uint8_t>(in[i + 2]);
+    out.push_back(kAlphabet[v >> 18]);
+    out.push_back(kAlphabet[(v >> 12) & 63]);
+    out.push_back(kAlphabet[(v >> 6) & 63]);
+    out.push_back(kAlphabet[v & 63]);
+  }
+  const size_t rem = in.size() - i;
+  if (rem == 1) {
+    const uint32_t v = static_cast<uint8_t>(in[i]) << 16;
+    out.push_back(kAlphabet[v >> 18]);
+    out.push_back(kAlphabet[(v >> 12) & 63]);
+    out.append("==");
+  } else if (rem == 2) {
+    const uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                       (static_cast<uint8_t>(in[i + 1]) << 8);
+    out.push_back(kAlphabet[v >> 18]);
+    out.push_back(kAlphabet[(v >> 12) & 63]);
+    out.push_back(kAlphabet[(v >> 6) & 63]);
+    out.push_back('=');
+  }
+  return out;
+}
+
+bool base64_decode(std::string_view in, std::string* out) {
+  out->clear();
+  if (in.empty()) return true;
+  if (in.size() % 4 != 0) return false;
+  size_t pad = 0;
+  if (in.back() == '=') {
+    ++pad;
+    if (in.size() >= 2 && in[in.size() - 2] == '=') ++pad;
+  }
+  out->reserve(in.size() / 4 * 3);
+  const ReverseTable& t = rev();
+  for (size_t i = 0; i < in.size(); i += 4) {
+    int8_t a = t.r[static_cast<uint8_t>(in[i])];
+    int8_t b = t.r[static_cast<uint8_t>(in[i + 1])];
+    const bool last = i + 4 == in.size();
+    const char c3 = in[i + 2];
+    const char c4 = in[i + 3];
+    int8_t c = (last && pad >= 2 && c3 == '=')
+                   ? 0
+                   : t.r[static_cast<uint8_t>(c3)];
+    int8_t d = (last && pad >= 1 && c4 == '=')
+                   ? 0
+                   : t.r[static_cast<uint8_t>(c4)];
+    if (a < 0 || b < 0 || c < 0 || d < 0) return false;
+    if (!last && (c3 == '=' || c4 == '=')) return false;  // mid-string pad
+    const uint32_t v = (static_cast<uint32_t>(a) << 18) |
+                       (static_cast<uint32_t>(b) << 12) |
+                       (static_cast<uint32_t>(c) << 6) |
+                       static_cast<uint32_t>(d);
+    out->push_back(static_cast<char>(v >> 16));
+    if (!(last && pad >= 2)) out->push_back(static_cast<char>((v >> 8) & 0xff));
+    if (!(last && pad >= 1)) out->push_back(static_cast<char>(v & 0xff));
+  }
+  return true;
+}
+
+}  // namespace tbutil

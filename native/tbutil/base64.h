@@ -1,0 +1,14 @@
+// Standard base64 (RFC 4648, with padding).
+// Capability parity: reference src/butil/base64.h (Base64Encode/Decode).
+#pragma once
+
+#include <string>
+#include <string_view>
+
+namespace tbutil {
+
+std::string base64_encode(std::string_view in);
+// False on invalid input (bad characters / bad length / bad padding).
+bool base64_decode(std::string_view in, std::string* out);
+
+}  // namespace tbutil

@@ -1,0 +1,75 @@
+#include "tbutil/crc32c.h"
+
+#ifdef __SSE4_2__
+#include <nmmintrin.h>
+#endif
+
+namespace tbutil {
+
+namespace {
+
+// Tables for slicing-by-8 over the reflected Castagnoli polynomial.
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    constexpr uint32_t kPoly = 0x82f63b78;  // reflected 0x1EDC6F41
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k) {
+        crc = (crc >> 1) ^ ((crc & 1) ? kPoly : 0);
+      }
+      t[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i) {
+      for (int s = 1; s < 8; ++s) {
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xff];
+      }
+    }
+  }
+};
+const Tables& tables() {
+  static const Tables tbl;
+  return tbl;
+}
+
+}  // namespace
+
+uint32_t crc32c_extend(uint32_t init_crc, const void* data, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(data);
+  uint32_t crc = ~init_crc;
+#ifdef __SSE4_2__
+  while (n >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
+    p += 8;
+    n -= 8;
+  }
+  while (n > 0) {
+    crc = _mm_crc32_u8(crc, *p++);
+    --n;
+  }
+#else
+  const Tables& tbl = tables();
+  while (n >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    __builtin_memcpy(&lo, p, 4);
+    __builtin_memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = tbl.t[7][lo & 0xff] ^ tbl.t[6][(lo >> 8) & 0xff] ^
+          tbl.t[5][(lo >> 16) & 0xff] ^ tbl.t[4][lo >> 24] ^
+          tbl.t[3][hi & 0xff] ^ tbl.t[2][(hi >> 8) & 0xff] ^
+          tbl.t[1][(hi >> 16) & 0xff] ^ tbl.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n > 0) {
+    crc = (crc >> 8) ^ tbl.t[0][(crc ^ *p++) & 0xff];
+    --n;
+  }
+#endif
+  return ~crc;
+}
+
+}  // namespace tbutil

@@ -1,0 +1,21 @@
+// CRC32-C (Castagnoli, the iSCSI/storage polynomial 0x1EDC6F41) — checksum
+// for framing/records (rpc_dump integrity, future snapshot formats).
+// Capability parity: reference src/butil/crc32c.h (Extend/Value API).
+// Implementation: slicing-by-8 table lookup; uses the SSE4.2 CRC32
+// instruction when the build enables it.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tbutil {
+
+// CRC of data[0..n), continuing from `init_crc` (the running-crc form:
+// crc32c_extend(crc32c_extend(0, a, n1), b, n2) == crc of a||b).
+uint32_t crc32c_extend(uint32_t init_crc, const void* data, size_t n);
+
+inline uint32_t crc32c(const void* data, size_t n) {
+  return crc32c_extend(0, data, n);
+}
+
+}  // namespace tbutil

@@ -1,0 +1,103 @@
+// MultiDimension<Var>: one metric name fanned out over label values —
+// rpc_latency{method="Echo",peer="10.0.0.2"} — each combination backed by
+// its own full Var (Adder/Maxer/LatencyRecorder-style), created lazily and
+// immortal so hot paths cache the pointer.
+// Capability parity: reference src/bvar/multi_dimension.h (get_stats by
+// label list, labeled /brpc_metrics output).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tbvar/variable.h"
+
+namespace tbvar {
+
+template <typename Var>
+class MultiDimension : public Variable {
+ public:
+  MultiDimension(const std::string& name,
+                 std::vector<std::string> label_names)
+      : _label_names(std::move(label_names)) {
+    expose(name);
+  }
+
+  size_t label_count() const { return _label_names.size(); }
+  size_t count_stats() const {
+    std::lock_guard<std::mutex> lk(_mu);
+    return _stats.size();
+  }
+
+  // The Var for this label-value combination (created on first use; the
+  // returned pointer is stable for the process lifetime — cache it).
+  // nullptr when the value count does not match the label count.
+  Var* get_stats(const std::vector<std::string>& label_values) {
+    if (label_values.size() != _label_names.size()) return nullptr;
+    std::lock_guard<std::mutex> lk(_mu);
+    auto it = _stats.find(label_values);
+    if (it == _stats.end()) {
+      it = _stats.emplace(label_values, std::make_unique<Var>()).first;
+    }
+    return it->second.get();
+  }
+
+  // /vars rendering: one "name{l1=\"v1\",...} : value" line per combo.
+  void describe(std::ostream& os) const override {
+    std::lock_guard<std::mutex> lk(_mu);
+    bool first = true;
+    for (const auto& [values, var] : _stats) {
+      if (!first) os << '\n';
+      first = false;
+      os << name() << label_string(values) << " : "
+         << var->get_description();
+    }
+  }
+
+  // Prometheus rendering with real label syntax.
+  bool dump_prometheus_lines(std::string* out) const override {
+    std::lock_guard<std::mutex> lk(_mu);
+    if (_stats.empty()) return true;  // exposed but empty: emit nothing
+    out->append("# TYPE ").append(name()).append(" gauge\n");
+    for (const auto& [values, var] : _stats) {
+      out->append(name())
+          .append(label_string(values))
+          .append(" ")
+          .append(var->get_description())
+          .append("\n");
+    }
+    return true;
+  }
+
+ private:
+  std::string label_string(const std::vector<std::string>& values) const {
+    std::string s = "{";
+    for (size_t i = 0; i < values.size(); ++i) {
+      if (i > 0) s += ',';
+      s += _label_names[i];
+      s += "=\"";
+      // Prometheus exposition format: one unescaped quote/backslash/newline
+      // in a (often request-derived) label value would corrupt the whole
+      // scrape, losing every metric.
+      for (char c : values[i]) {
+        switch (c) {
+          case '\\': s += "\\\\"; break;
+          case '"': s += "\\\""; break;
+          case '\n': s += "\\n"; break;
+          default: s += c;
+        }
+      }
+      s += '"';
+    }
+    s += '}';
+    return s;
+  }
+
+  const std::vector<std::string> _label_names;
+  mutable std::mutex _mu;
+  std::map<std::vector<std::string>, std::unique_ptr<Var>> _stats;
+};
+
+}  // namespace tbvar

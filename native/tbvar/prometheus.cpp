@@ -9,7 +9,7 @@ namespace tbvar {
 
 int dump_prometheus(std::string* out) {
   std::map<std::string, std::string> vars;
-  Variable::dump_exposed(&vars);
+  Variable::dump_prometheus_exposed(out, &vars);
   int n = 0;
   for (const auto& [name, value] : vars) {
     char* end = nullptr;

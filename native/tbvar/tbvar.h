@@ -4,6 +4,7 @@
 #include "tbvar/latency_recorder.h"
 #include "tbvar/passive_status.h"
 #include "tbvar/percentile.h"
+#include "tbvar/multi_dimension.h"
 #include "tbvar/prometheus.h"
 #include "tbvar/reducer.h"
 #include "tbvar/variable.h"

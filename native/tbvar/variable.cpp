@@ -76,6 +76,19 @@ size_t Variable::count_exposed() {
   return r.vars.size();
 }
 
+void Variable::dump_prometheus_exposed(
+    std::string* structured, std::map<std::string, std::string>* plain) {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  // Sorted for stable scrape output.
+  std::map<std::string, Variable*> sorted(r.vars.begin(), r.vars.end());
+  for (const auto& [name, var] : sorted) {
+    if (!var->dump_prometheus_lines(structured)) {
+      (*plain)[name] = var->get_description();
+    }
+  }
+}
+
 void Variable::dump_exposed(std::map<std::string, std::string>* out) {
   Registry& r = registry();
   std::lock_guard<std::mutex> lk(r.mu);

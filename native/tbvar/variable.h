@@ -26,6 +26,15 @@ class Variable {
   // (registry, dumping) is shared machinery.
   virtual void describe(std::ostream& os) const = 0;
 
+  // Exporter hook: variables with structured (labeled / multi-sample)
+  // output append complete Prometheus lines and return true; the default
+  // false lets dump_prometheus fall back to "name <describe()>" for plain
+  // numeric variables.
+  virtual bool dump_prometheus_lines(std::string* out) const {
+    (void)out;
+    return false;
+  }
+
   std::string get_description() const {
     std::ostringstream oss;
     describe(oss);
@@ -48,6 +57,12 @@ class Variable {
   static size_t count_exposed();
   // name -> described value for every exposed variable.
   static void dump_exposed(std::map<std::string, std::string>* out);
+  // Exporter walk: calls dump_prometheus_lines on every exposed variable
+  // in name order; for those returning false, appends the fallback
+  // "name <describe()>" pair to `plain`. (Runs under the registry lock.)
+  static void dump_prometheus_exposed(
+      std::string* structured,
+      std::map<std::string, std::string>* plain);
 
  protected:
   std::string _name;  // empty when hidden

@@ -232,4 +232,45 @@ TEST_CASE(partition_channel_fanout) {
   ASSERT_TRUE(p1b.svc._calls.load() > 0);
 }
 
+// DynamicPartitionChannel: a 1-partition scheme and a 2-partition scheme
+// coexist (mid-resharding); every call fans out within exactly one scheme,
+// traffic reaches both, and capacity weighting holds (reference
+// partition_channel.h:139 DynamicPartitionChannel).
+TEST_CASE(dynamic_partition_mixed_schemes) {
+  Backend whole("w"), p0("p0"), p1("p1");
+  const std::string url = "list://" + whole.addr + " 0/1," + p0.addr +
+                          " 0/2," + p1.addr + " 1/2";
+  DynamicPartitionChannel dc;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  ASSERT_EQ(dc.Init(url.c_str(), "rr", &opts), 0);
+  ASSERT_EQ(dc.scheme_counts().size(), size_t{2});
+
+  int whole_hits = 0, split_hits = 0;
+  for (int i = 0; i < 60; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("d" + std::to_string(i));
+    dc.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    const std::string merged = resp.to_string();
+    const bool has_whole = merged.find("[w:") != std::string::npos;
+    const bool has_p0 = merged.find("[p0:") != std::string::npos;
+    const bool has_p1 = merged.find("[p1:") != std::string::npos;
+    if (has_whole) {
+      // Scheme 1: exactly the whole-service response, no mixing.
+      ASSERT_FALSE(has_p0 || has_p1);
+      ++whole_hits;
+    } else {
+      // Scheme 2: BOTH partitions answered this call.
+      ASSERT_TRUE(has_p0 && has_p1);
+      ++split_hits;
+    }
+  }
+  // 1 server vs 2 servers: expect roughly 1/3 vs 2/3 — both must appear.
+  ASSERT_TRUE(whole_hits > 0);
+  ASSERT_TRUE(split_hits > 0);
+  ASSERT_TRUE(split_hits > whole_hits / 2);
+}
+
 TEST_MAIN

@@ -7,6 +7,8 @@
 #include <vector>
 
 #include "mini_test.h"
+#include "tbutil/base64.h"
+#include "tbutil/crc32c.h"
 #include "tbutil/doubly_buffered_data.h"
 #include "tbutil/endpoint.h"
 #include "tbutil/fast_rand.h"
@@ -270,6 +272,45 @@ TEST_CASE(fast_rand_sanity) {
   }
   double d = fast_rand_double();
   ASSERT_TRUE(d >= 0.0 && d < 1.0);
+}
+
+TEST_CASE(crc32c_known_vectors) {
+  // RFC 3720 / published Castagnoli test vectors.
+  ASSERT_EQ(tbutil::crc32c("", 0), 0u);
+  ASSERT_EQ(tbutil::crc32c("123456789", 9), 0xe3069283u);
+  const std::string zeros(32, '\0');
+  ASSERT_EQ(tbutil::crc32c(zeros.data(), 32), 0x8a9136aau);
+  // Extend form composes: crc(a||b) == extend(crc(a), b).
+  const std::string s = "hello, crc32c world! 0123456789abcdef";
+  for (size_t split = 0; split <= s.size(); ++split) {
+    uint32_t part = tbutil::crc32c(s.data(), split);
+    uint32_t whole =
+        tbutil::crc32c_extend(part, s.data() + split, s.size() - split);
+    ASSERT_EQ(whole, tbutil::crc32c(s.data(), s.size()));
+  }
+}
+
+TEST_CASE(base64_roundtrip_and_vectors) {
+  // RFC 4648 vectors.
+  ASSERT_EQ(tbutil::base64_encode(""), std::string(""));
+  ASSERT_EQ(tbutil::base64_encode("f"), std::string("Zg=="));
+  ASSERT_EQ(tbutil::base64_encode("fo"), std::string("Zm8="));
+  ASSERT_EQ(tbutil::base64_encode("foo"), std::string("Zm9v"));
+  ASSERT_EQ(tbutil::base64_encode("foob"), std::string("Zm9vYg=="));
+  ASSERT_EQ(tbutil::base64_encode("fooba"), std::string("Zm9vYmE="));
+  ASSERT_EQ(tbutil::base64_encode("foobar"), std::string("Zm9vYmFy"));
+  std::string out;
+  ASSERT_TRUE(tbutil::base64_decode("Zm9vYmFy", &out));
+  ASSERT_EQ(out, std::string("foobar"));
+  // Binary round-trip incl. all byte values.
+  std::string bin;
+  for (int i = 0; i < 256; ++i) bin.push_back(static_cast<char>(i));
+  ASSERT_TRUE(tbutil::base64_decode(tbutil::base64_encode(bin), &out));
+  ASSERT_EQ(out, bin);
+  // Rejections: bad length, bad chars, interior padding.
+  ASSERT_FALSE(tbutil::base64_decode("abc", &out));
+  ASSERT_FALSE(tbutil::base64_decode("a!c=", &out));
+  ASSERT_FALSE(tbutil::base64_decode("Zg==Zm8=", &out));
 }
 
 TEST_MAIN

@@ -185,6 +185,30 @@ TEST_CASE(adder_write_throughput_smoke) {
   ASSERT_EQ(a.get_value(), 4000000);
 }
 
+// Labeled metrics: one name, per-label-combination Vars, real Prometheus
+// label syntax (reference bvar/multi_dimension.h).
+TEST_CASE(multi_dimension_labeled) {
+  MultiDimension<Adder<int64_t>> md("test_md_requests", {"method", "code"});
+  *md.get_stats({"Echo", "0"}) << 3;
+  *md.get_stats({"Echo", "0"}) << 2;  // same combination: same Var
+  *md.get_stats({"Write", "1"}) << 7;
+  ASSERT_EQ(md.count_stats(), size_t{2});
+  ASSERT_TRUE(md.get_stats({"wrong_arity"}) == nullptr);
+
+  std::string prom;
+  dump_prometheus(&prom);
+  ASSERT_TRUE(prom.find("test_md_requests{method=\"Echo\",code=\"0\"} 5") !=
+              std::string::npos);
+  ASSERT_TRUE(prom.find("test_md_requests{method=\"Write\",code=\"1\"} 7") !=
+              std::string::npos);
+
+  std::ostringstream oss;
+  ASSERT_TRUE(Variable::describe_exposed("test_md_requests", oss));
+  ASSERT_TRUE(oss.str().find("{method=\"Echo\",code=\"0\"} : 5") !=
+              std::string::npos);
+  md.hide();
+}
+
 // Process defaults: rss/cpu/fds/threads answer "is this host sick" with no
 // app code (reference bvar/default_variables.cpp).
 TEST_CASE(default_process_variables) {

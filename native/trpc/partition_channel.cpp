@@ -1,7 +1,9 @@
 #include "trpc/partition_channel.h"
 
+#include <atomic>
 #include <cstdlib>
 
+#include "tbutil/fast_rand.h"
 #include "tbutil/logging.h"
 #include "trpc/errno.h"
 
@@ -81,6 +83,157 @@ int PartitionChannel::Init(int num_partitions, const char* naming_url,
     return -1;
   }
   return 0;
+}
+
+// ---------------- DynamicPartitionChannel ----------------
+
+struct DynamicPartitionChannel::Scheme {
+  int num_partitions = 0;
+  std::vector<std::shared_ptr<LoadBalancer>> lbs;
+  std::vector<std::unique_ptr<Channel>> channels;
+  std::unique_ptr<ParallelChannel> parallel;
+  std::atomic<int64_t> weight{0};  // live servers announcing this scheme
+};
+
+DynamicPartitionChannel::DynamicPartitionChannel() = default;
+
+DynamicPartitionChannel::~DynamicPartitionChannel() {
+  _ns.reset();  // stop pushes before the schemes they feed die
+}
+
+DynamicPartitionChannel::Scheme* DynamicPartitionChannel::get_or_create_scheme(
+    int num_partitions) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _schemes.find(num_partitions);
+  if (it != _schemes.end()) return it->second.get();
+  auto scheme = std::make_unique<Scheme>();
+  scheme->num_partitions = num_partitions;
+  for (int i = 0; i < num_partitions; ++i) {
+    std::shared_ptr<LoadBalancer> lb(LoadBalancer::CreateByName(_lb_name));
+    if (lb == nullptr) return nullptr;
+    auto ch = std::make_unique<Channel>();
+    if (ch->Init(lb, &_options) != 0) return nullptr;
+    scheme->lbs.push_back(std::move(lb));
+    scheme->channels.push_back(std::move(ch));
+  }
+  scheme->parallel.reset(new ParallelChannel(_pc_options));
+  for (auto& ch : scheme->channels) {
+    scheme->parallel->AddChannel(ch.get());
+  }
+  Scheme* raw = scheme.get();
+  _schemes.emplace(num_partitions, std::move(scheme));
+  return raw;
+}
+
+int DynamicPartitionChannel::Init(const char* naming_url, const char* lb_name,
+                                  const ChannelOptions* options,
+                                  PartitionParser* parser,
+                                  const ParallelChannelOptions* pc_options) {
+  if (naming_url == nullptr) return -1;
+  if (options != nullptr) _options = *options;
+  if (pc_options != nullptr) _pc_options = *pc_options;
+  _lb_name = lb_name != nullptr ? lb_name : "rr";
+  _parser.reset(parser != nullptr ? parser : new PartitionParser);
+
+  _ns.reset(new NamingServiceThread);
+  PartitionParser* prs = _parser.get();
+  int rc = _ns->Start(
+      naming_url, [this, prs](const std::vector<ServerNode>& servers) {
+        // Group the push by announced partition count.
+        std::map<int, std::vector<std::vector<ServerNode>>> grouped;
+        for (const ServerNode& s : servers) {
+          int index = 0, count = 0;
+          if (!prs->ParseFromTag(s.tag, &index, &count)) {
+            TB_LOG(WARNING) << "partition tag unparsable: '" << s.tag << "'";
+            continue;
+          }
+          auto& parts = grouped[count];
+          if (parts.empty()) parts.resize(count);
+          parts[index].push_back(s);
+        }
+        // Feed every known scheme: present counts get their servers, absent
+        // counts drain to weight 0 (never selected, never destroyed — calls
+        // in flight may still hold the scheme).
+        for (auto& [count, parts] : grouped) {
+          Scheme* sch = get_or_create_scheme(count);
+          if (sch == nullptr) continue;
+          int64_t total = 0;
+          for (int i = 0; i < count; ++i) {
+            sch->lbs[i]->ResetServers(parts[i]);
+            total += static_cast<int64_t>(parts[i].size());
+          }
+          sch->weight.store(total, std::memory_order_release);
+        }
+        std::lock_guard<std::mutex> lk(_mu);
+        for (auto& [count, sch] : _schemes) {
+          if (grouped.find(count) == grouped.end()) {
+            sch->weight.store(0, std::memory_order_release);
+            for (auto& lb : sch->lbs) lb->ResetServers({});
+          }
+        }
+      });
+  if (rc != 0) {
+    _ns.reset();
+    return -1;
+  }
+  return 0;
+}
+
+std::vector<int> DynamicPartitionChannel::scheme_counts() const {
+  std::vector<int> out;
+  std::lock_guard<std::mutex> lk(_mu);
+  for (const auto& [count, sch] : _schemes) {
+    if (sch->weight.load(std::memory_order_acquire) > 0) {
+      out.push_back(count);
+    }
+  }
+  return out;
+}
+
+void DynamicPartitionChannel::CallMethod(const std::string& service_method,
+                                         Controller* cntl,
+                                         const tbutil::IOBuf& request,
+                                         tbutil::IOBuf* response,
+                                         Closure* done) {
+  // Weighted scheme pick: traffic proportional to each scheme's live
+  // capacity (reference DynamicPartitionChannel semantics). Weights are
+  // SNAPSHOTTED once — the naming thread stores them without _mu, and a
+  // second read during the pick could shrink the range under the drawn r,
+  // spuriously selecting nothing. The brief lock walks a map of a handful
+  // of schemes; the call itself is a multi-ms fan-out RPC.
+  Scheme* chosen = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(_mu);
+    int64_t total = 0;
+    std::vector<std::pair<Scheme*, int64_t>> snap;
+    snap.reserve(_schemes.size());
+    for (const auto& [count, sch] : _schemes) {
+      const int64_t w = sch->weight.load(std::memory_order_acquire);
+      if (w > 0) {
+        snap.emplace_back(sch.get(), w);
+        total += w;
+      }
+    }
+    if (total > 0) {
+      int64_t r =
+          static_cast<int64_t>(tbutil::fast_rand_less_than(
+              static_cast<uint64_t>(total)));
+      for (const auto& [sch, w] : snap) {
+        r -= w;
+        if (r < 0) {
+          chosen = sch;
+          break;
+        }
+      }
+    }
+  }
+  if (chosen == nullptr) {
+    cntl->SetFailed(TRPC_ENODATA, "no partition scheme has servers");
+    if (done != nullptr) done->Run();
+    return;
+  }
+  chosen->parallel->CallMethod(service_method, cntl, request, response,
+                               done);
 }
 
 void PartitionChannel::CallMethod(const std::string& service_method,

@@ -9,7 +9,9 @@
 // mesh axis (SURVEY.md §2.11: PartitionChannel ≈ sharded state + psum).
 #pragma once
 
+#include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -53,6 +55,47 @@ class PartitionChannel {
   std::vector<std::shared_ptr<LoadBalancer>> _lbs;
   std::unique_ptr<ParallelChannel> _parallel;
   std::unique_ptr<PartitionParser> _parser;
+  std::unique_ptr<NamingServiceThread> _ns;
+};
+
+// DynamicPartitionChannel: like PartitionChannel, but the partition count is
+// read from the server tags instead of fixed at Init — servers announcing
+// DIFFERENT schemes (e.g. "0/3".."2/3" next to "0/4".."3/4" during a
+// resharding migration) coexist, and each call picks ONE scheme weighted by
+// its live server count, then fans out to that scheme's partitions.
+// Capability parity: reference src/brpc/partition_channel.h:139-183
+// (DynamicPartitionChannel: sub-channels per partition count, traffic
+// proportional to capacity).
+class DynamicPartitionChannel {
+ public:
+  // Out-of-line: members reference the incomplete Scheme (pimpl-style).
+  DynamicPartitionChannel();
+  ~DynamicPartitionChannel();
+
+  int Init(const char* naming_url, const char* lb_name,
+           const ChannelOptions* options, PartitionParser* parser = nullptr,
+           const ParallelChannelOptions* pc_options = nullptr);
+
+  // Fans out to every partition of ONE scheme (picked per call, weighted by
+  // server count). Merger semantics are ParallelChannel's.
+  void CallMethod(const std::string& service_method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done);
+
+  // Live schemes (partition counts with >= 1 server) — tests/console.
+  std::vector<int> scheme_counts() const;
+
+ private:
+  struct Scheme;
+  Scheme* get_or_create_scheme(int num_partitions);
+
+  ChannelOptions _options;
+  ParallelChannelOptions _pc_options;
+  std::string _lb_name;
+  std::unique_ptr<PartitionParser> _parser;
+  mutable std::mutex _mu;
+  // Schemes are immortal while the channel lives (calls hold raw pointers).
+  std::map<int, std::unique_ptr<Scheme>> _schemes;
   std::unique_ptr<NamingServiceThread> _ns;
 };
 
