@@ -7,6 +7,7 @@
 #pragma once
 
 #include <map>
+#include <cstdlib>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -56,16 +57,25 @@ class MultiDimension : public Variable {
     }
   }
 
-  // Prometheus rendering with real label syntax.
+  // Prometheus rendering with real label syntax. Non-numeric sample values
+  // are skipped — one bad line voids the whole scrape (the plain path's
+  // strtod filter, applied here per sample).
   bool dump_prometheus_lines(std::string* out) const override {
     std::lock_guard<std::mutex> lk(_mu);
-    if (_stats.empty()) return true;  // exposed but empty: emit nothing
-    out->append("# TYPE ").append(name()).append(" gauge\n");
+    bool typed = false;
     for (const auto& [values, var] : _stats) {
+      const std::string v = var->get_description();
+      char* end = nullptr;
+      (void)strtod(v.c_str(), &end);
+      if (end == v.c_str() || *end != '\0') continue;
+      if (!typed) {
+        out->append("# TYPE ").append(name()).append(" gauge\n");
+        typed = true;
+      }
       out->append(name())
           .append(label_string(values))
           .append(" ")
-          .append(var->get_description())
+          .append(v)
           .append("\n");
     }
     return true;

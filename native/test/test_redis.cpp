@@ -1,0 +1,206 @@
+// redis client protocol end-to-end: a mini RESP server (GET/SET/INCR/DEL
+// over a map) on a raw TCP socket, driven through the Channel machinery —
+// the reference's redis_protocol_unittest shape without a real redis.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mini_test.h"
+#include "trpc/channel.h"
+#include "trpc/redis_protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+// Parse one RESP command (array of bulk strings) from data[pos..); returns
+// consumed bytes, 0 if incomplete, -1 malformed.
+ssize_t parse_command(const std::string& d, size_t pos,
+                      std::vector<std::string>* args) {
+  args->clear();
+  auto line_end = [&](size_t p) { return d.find("\r\n", p); };
+  if (pos >= d.size() || d[pos] != '*') return d.empty() ? 0 : -1;
+  size_t le = line_end(pos);
+  if (le == std::string::npos) return 0;
+  const int n = atoi(d.c_str() + pos + 1);
+  if (n <= 0) return -1;
+  size_t p = le + 2;
+  for (int i = 0; i < n; ++i) {
+    if (p >= d.size()) return 0;
+    if (d[p] != '$') return -1;
+    le = line_end(p);
+    if (le == std::string::npos) return 0;
+    const long len = atol(d.c_str() + p + 1);
+    if (len < 0) return -1;
+    p = le + 2;
+    if (d.size() < p + static_cast<size_t>(len) + 2) return 0;
+    args->push_back(d.substr(p, static_cast<size_t>(len)));
+    p += static_cast<size_t>(len) + 2;
+  }
+  return static_cast<ssize_t>(p - pos);
+}
+
+class MiniRedis {
+ public:
+  MiniRedis() {
+    _listen = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(_listen, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    ASSERT_TRUE(bind(_listen, reinterpret_cast<sockaddr*>(&addr),
+                     sizeof(addr)) == 0);
+    socklen_t len = sizeof(addr);
+    getsockname(_listen, reinterpret_cast<sockaddr*>(&addr), &len);
+    _port = ntohs(addr.sin_port);
+    ASSERT_TRUE(listen(_listen, 16) == 0);
+    _thread = std::thread([this] { Run(); });
+  }
+  ~MiniRedis() {
+    _stop.store(true);
+    ::shutdown(_listen, SHUT_RDWR);
+    ::close(_listen);
+    _thread.join();
+  }
+  int port() const { return _port; }
+
+ private:
+  void Run() {
+    while (!_stop.load()) {
+      int fd = accept(_listen, nullptr, nullptr);
+      if (fd < 0) return;
+      // Short connections: one client conn at a time is fine for the test.
+      ServeConn(fd);
+      ::close(fd);
+    }
+  }
+
+  void ServeConn(int fd) {
+    std::string buf;
+    char tmp[4096];
+    while (true) {
+      // Drain complete commands already buffered.
+      while (true) {
+        std::vector<std::string> args;
+        ssize_t used = parse_command(buf, 0, &args);
+        if (used <= 0) break;
+        buf.erase(0, static_cast<size_t>(used));
+        std::string reply = Execute(args);
+        if (::send(fd, reply.data(), reply.size(), MSG_NOSIGNAL) < 0) {
+          return;
+        }
+      }
+      ssize_t n = ::read(fd, tmp, sizeof(tmp));
+      if (n <= 0) return;
+      buf.append(tmp, static_cast<size_t>(n));
+    }
+  }
+
+  std::string Execute(const std::vector<std::string>& args) {
+    const std::string& cmd = args[0];
+    if (cmd == "SET" && args.size() == 3) {
+      _kv[args[1]] = args[2];
+      return "+OK\r\n";
+    }
+    if (cmd == "GET" && args.size() == 2) {
+      auto it = _kv.find(args[1]);
+      if (it == _kv.end()) return "$-1\r\n";
+      return "$" + std::to_string(it->second.size()) + "\r\n" + it->second +
+             "\r\n";
+    }
+    if (cmd == "INCR" && args.size() == 2) {
+      long v = atol(_kv[args[1]].c_str()) + 1;
+      _kv[args[1]] = std::to_string(v);
+      return ":" + std::to_string(v) + "\r\n";
+    }
+    if (cmd == "DEL" && args.size() == 2) {
+      return ":" + std::to_string(_kv.erase(args[1])) + "\r\n";
+    }
+    if (cmd == "KEYS") {
+      std::string out = "*" + std::to_string(_kv.size()) + "\r\n";
+      for (const auto& [k, v] : _kv) {
+        out += "$" + std::to_string(k.size()) + "\r\n" + k + "\r\n";
+      }
+      return out;
+    }
+    return "-ERR unknown command '" + cmd + "'\r\n";
+  }
+
+  int _listen = -1;
+  int _port = 0;
+  std::atomic<bool> _stop{false};
+  std::thread _thread;
+  std::map<std::string, std::string> _kv;
+};
+
+}  // namespace
+
+TEST_CASE(redis_pipeline_end_to_end) {
+  MiniRedis server;
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kRedisProtocolIndex;
+  opts.timeout_ms = 2000;
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.port());
+  ASSERT_EQ(ch.Init(addr, &opts), 0);
+
+  RedisRequest req;
+  ASSERT_TRUE(req.AddCommand({"SET", "lang", "tpu native"}));  // binary-safe
+  ASSERT_TRUE(req.AddCommand("GET lang"));
+  ASSERT_TRUE(req.AddCommand("INCR counter"));
+  ASSERT_TRUE(req.AddCommand("INCR counter"));
+  ASSERT_TRUE(req.AddCommand("GET missing"));
+  ASSERT_TRUE(req.AddCommand("BOGUS x"));
+  ASSERT_EQ(req.command_count(), size_t{6});
+
+  RedisResponse resp;
+  Controller cntl;
+  ASSERT_EQ(RedisExecute(ch, &cntl, req, &resp), 0);
+  ASSERT_EQ(resp.reply_count(), size_t{6});
+  ASSERT_TRUE(resp.reply(0).type == RedisReply::Type::kStatus);
+  ASSERT_EQ(resp.reply(0).str, std::string("OK"));
+  ASSERT_TRUE(resp.reply(1).type == RedisReply::Type::kString);
+  ASSERT_EQ(resp.reply(1).str, std::string("tpu native"));
+  ASSERT_TRUE(resp.reply(2).type == RedisReply::Type::kInteger);
+  ASSERT_EQ(resp.reply(2).integer, 1);
+  ASSERT_EQ(resp.reply(3).integer, 2);
+  ASSERT_TRUE(resp.reply(4).is_nil());
+  ASSERT_TRUE(resp.reply(5).is_error());
+
+  // Arrays: KEYS returns a multi-bulk reply.
+  RedisRequest req2;
+  req2.AddCommand("KEYS");
+  RedisResponse resp2;
+  Controller c2;
+  ASSERT_EQ(RedisExecute(ch, &c2, req2, &resp2), 0);
+  ASSERT_TRUE(resp2.reply(0).type == RedisReply::Type::kArray);
+  ASSERT_EQ(resp2.reply(0).elements.size(), size_t{2});  // lang + counter
+}
+
+TEST_CASE(redis_timeout_on_dead_server) {
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kRedisProtocolIndex;
+  opts.timeout_ms = 200;
+  opts.max_retry = 0;
+  ASSERT_EQ(ch.Init("127.0.0.1:1", &opts), 0);
+  RedisRequest req;
+  req.AddCommand("PING");
+  RedisResponse resp;
+  Controller cntl;
+  ASSERT_TRUE(RedisExecute(ch, &cntl, req, &resp) != 0);
+  ASSERT_TRUE(cntl.Failed());
+}
+
+TEST_MAIN

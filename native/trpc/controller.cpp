@@ -44,6 +44,7 @@ void Controller::Reset() {
   _tried.clear();
   _request_code = 0;
   _has_request_code = false;
+  _expected_responses = 1;
   _attempt_begin_us = 0;
   _response_received = false;
   _live.clear();

@@ -125,6 +125,7 @@ class Controller {
   std::vector<tbutil::EndPoint> _tried;    // excluded on retry
   uint64_t _request_code = 0;
   bool _has_request_code = false;
+  uint64_t _expected_responses = 1;  // multi-reply protocols override
   int64_t _attempt_begin_us = 0;           // start of the CURRENT attempt
   bool _response_received = false;         // any server response arrived
   // In-flight attempts. Exactly one normally; a backup (hedged) request adds
@@ -197,6 +198,11 @@ class ControllerPrivateAccessor {
   }
   tbutil::IOBuf* response_payload() { return _c->_response_payload; }
   void mark_response_received() { _c->_response_received = true; }
+  uint64_t request_code() const { return _c->_request_code; }
+  // Multi-reply protocols (redis pipelines): how many wire replies complete
+  // this RPC. Dedicated field — request_code is the user's LB routing key.
+  void set_expected_responses(uint64_t n) { _c->_expected_responses = n; }
+  uint64_t expected_responses() const { return _c->_expected_responses; }
 
   // Streaming handshake plumbing.
   void set_request_stream(uint64_t id) { _c->_request_stream = id; }

@@ -158,11 +158,18 @@ int DynamicPartitionChannel::Init(const char* naming_url, const char* lb_name,
           Scheme* sch = get_or_create_scheme(count);
           if (sch == nullptr) continue;
           int64_t total = 0;
+          size_t min_part = SIZE_MAX;
           for (int i = 0; i < count; ++i) {
             sch->lbs[i]->ResetServers(parts[i]);
             total += static_cast<int64_t>(parts[i].size());
+            min_part = std::min(min_part, parts[i].size());
           }
-          sch->weight.store(total, std::memory_order_release);
+          // A scheme missing ANY partition cannot serve a fan-out: keep it
+          // unselectable until every partition has at least one server
+          // (mid-resharding, the first "0/4" server must not attract
+          // traffic into a 3/4-empty fan-out).
+          sch->weight.store(min_part == 0 ? 0 : total,
+                            std::memory_order_release);
         }
         std::lock_guard<std::mutex> lk(_mu);
         for (auto& [count, sch] : _schemes) {

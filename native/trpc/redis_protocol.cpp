@@ -1,0 +1,361 @@
+#include "trpc/redis_protocol.h"
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+#include "tbutil/logging.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/input_messenger.h"
+#include "trpc/protocol.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxBulkLen = 512u << 20;  // redis's own proto-max-bulk-len
+constexpr int kMaxDepth = 32;
+
+// ---- RESP reply parser ----
+// Consumed byte count for ONE complete reply at d[0..n), 0 when incomplete,
+// -1 when malformed.
+ssize_t parse_reply(const char* d, size_t n, RedisReply* out, int depth) {
+  if (depth > kMaxDepth) return -1;
+  if (n < 3) return 0;  // shortest reply: "+\r\n"... type + \r\n
+  const char type = d[0];
+  // Find the first CRLF (caps the scan so huge garbage fails fast).
+  const char* crlf = nullptr;
+  const size_t scan = n < 64 * 1024 ? n : 64 * 1024;
+  for (size_t i = 1; i + 1 < scan; ++i) {
+    if (d[i] == '\r' && d[i + 1] == '\n') {
+      crlf = d + i;
+      break;
+    }
+  }
+  if (crlf == nullptr) return n >= 64 * 1024 ? -1 : 0;
+  const std::string line(d + 1, crlf - (d + 1));
+  const size_t line_total = static_cast<size_t>(crlf - d) + 2;
+  switch (type) {
+    case '+':
+      out->type = RedisReply::Type::kStatus;
+      out->str = line;
+      return static_cast<ssize_t>(line_total);
+    case '-':
+      out->type = RedisReply::Type::kError;
+      out->str = line;
+      return static_cast<ssize_t>(line_total);
+    case ':': {
+      out->type = RedisReply::Type::kInteger;
+      char* end = nullptr;
+      out->integer = strtoll(line.c_str(), &end, 10);
+      if (end == line.c_str() || *end != '\0') return -1;
+      return static_cast<ssize_t>(line_total);
+    }
+    case '$': {
+      char* end = nullptr;
+      const long long len = strtoll(line.c_str(), &end, 10);
+      if (end == line.c_str() || *end != '\0' || len < -1 ||
+          len > static_cast<long long>(kMaxBulkLen)) {
+        return -1;
+      }
+      if (len == -1) {
+        out->type = RedisReply::Type::kNil;
+        return static_cast<ssize_t>(line_total);
+      }
+      const size_t need = line_total + static_cast<size_t>(len) + 2;
+      if (n < need) return 0;
+      if (d[need - 2] != '\r' || d[need - 1] != '\n') return -1;
+      out->type = RedisReply::Type::kString;
+      out->str.assign(d + line_total, static_cast<size_t>(len));
+      return static_cast<ssize_t>(need);
+    }
+    case '*': {
+      char* end = nullptr;
+      const long long count = strtoll(line.c_str(), &end, 10);
+      if (end == line.c_str() || *end != '\0' || count < -1 ||
+          count > 1 << 20) {
+        return -1;
+      }
+      if (count == -1) {
+        out->type = RedisReply::Type::kNil;
+        return static_cast<ssize_t>(line_total);
+      }
+      out->type = RedisReply::Type::kArray;
+      out->elements.clear();
+      size_t pos = line_total;
+      for (long long i = 0; i < count; ++i) {
+        RedisReply elem;
+        ssize_t used = parse_reply(d + pos, n - pos, &elem, depth + 1);
+        if (used <= 0) return used;  // incomplete or malformed
+        out->elements.push_back(std::move(elem));
+        pos += static_cast<size_t>(used);
+      }
+      return static_cast<ssize_t>(pos);
+    }
+    default:
+      return -1;
+  }
+}
+
+// Offset of the CRLF terminating the line starting at `from` (relative to
+// `from`), scanning at most `max_scan` bytes in small chunks — no flatten.
+// SIZE_MAX-1 when not found within max_scan (malformed for our purposes),
+// SIZE_MAX when more bytes are needed.
+size_t find_crlf(const tbutil::IOBuf& buf, size_t from, size_t max_scan) {
+  char chunk[256];
+  size_t scanned = 0;
+  char carry = 0;
+  while (scanned < max_scan) {
+    const size_t want =
+        std::min(sizeof(chunk), max_scan - scanned);
+    const size_t got = buf.copy_to(chunk, want, from + scanned);
+    if (got == 0) return SIZE_MAX;
+    if (carry == '\r' && chunk[0] == '\n') return scanned - 1;
+    for (size_t i = 0; i + 1 < got; ++i) {
+      if (chunk[i] == '\r' && chunk[i + 1] == '\n') return scanned + i;
+    }
+    carry = chunk[got - 1];
+    scanned += got;
+    if (got < want) return SIZE_MAX;  // ran out of buffered bytes
+  }
+  return SIZE_MAX - 1;
+}
+
+// Measures one complete reply at offset `pos` using only small header
+// copies — bulk payload bytes are never materialized, so a 100MB GET reply
+// arriving in 64KB reads costs O(n) total, not O(n^2) flattens.
+// Returns the frame's byte count when fully buffered, 0 when more bytes
+// are needed, -1 when malformed.
+ssize_t measure_reply(const tbutil::IOBuf& buf, size_t pos, int depth) {
+  if (depth > kMaxDepth) return -1;
+  if (buf.size() < pos + 3) return 0;
+  char type;
+  if (buf.copy_to(&type, 1, pos) != 1) return 0;
+  const size_t line_rel = find_crlf(buf, pos + 1, 64 * 1024);
+  if (line_rel == SIZE_MAX) return 0;
+  if (line_rel == SIZE_MAX - 1) return -1;
+  const size_t line_total = 1 + line_rel + 2;  // type + line + CRLF
+  switch (type) {
+    case '+':
+    case '-':
+      return static_cast<ssize_t>(line_total);
+    case ':':
+    case '$':
+    case '*': {
+      char num[32];
+      if (line_rel >= sizeof(num)) return -1;  // numeric lines are short
+      buf.copy_to(num, line_rel, pos + 1);
+      num[line_rel] = '\0';
+      char* end = nullptr;
+      const long long v = strtoll(num, &end, 10);
+      if (end == num || *end != '\0') return -1;
+      if (type == ':') return static_cast<ssize_t>(line_total);
+      if (v == -1) return static_cast<ssize_t>(line_total);  // nil
+      if (v < 0) return -1;
+      if (type == '$') {
+        if (v > static_cast<long long>(kMaxBulkLen)) return -1;
+        const size_t total = line_total + static_cast<size_t>(v) + 2;
+        if (buf.size() < pos + total) return 0;
+        char crlf[2];
+        buf.copy_to(crlf, 2, pos + total - 2);
+        if (crlf[0] != '\r' || crlf[1] != '\n') return -1;
+        return static_cast<ssize_t>(total);
+      }
+      // '*' array
+      if (v > 1 << 20) return -1;
+      size_t off = line_total;
+      for (long long i = 0; i < v; ++i) {
+        const ssize_t used = measure_reply(buf, pos + off, depth + 1);
+        if (used <= 0) return used;
+        off += static_cast<size_t>(used);
+      }
+      return static_cast<ssize_t>(off);
+    }
+    default:
+      return -1;
+  }
+}
+
+struct RedisInputMessage : public InputMessageBase {
+  tbutil::IOBuf bytes;  // one complete reply, raw
+};
+
+// ---- protocol fns ----
+
+ParseResult redis_parse(tbutil::IOBuf* source, Socket* socket) {
+  ParseResult r;
+  if (socket->server_side()) {
+    // Client-only protocol: never claim inbound server traffic.
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  if (source->empty()) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  char first;
+  source->copy_to(&first, 1);
+  if (first != '+' && first != '-' && first != ':' && first != '$' &&
+      first != '*') {
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  const ssize_t used = measure_reply(*source, 0, 0);
+  if (used < 0) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  if (used == 0) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  auto* msg = new RedisInputMessage;
+  source->cutn(&msg->bytes, static_cast<size_t>(used));
+  // Replies map to commands BY POSITION: they must be delivered in parse
+  // order on the input fiber — per-message fibers would interleave the
+  // pipeline (the same stance as stream frames).
+  msg->process_in_place = true;
+  r.error = PARSE_OK;
+  r.msg = msg;
+  return r;
+}
+
+void redis_process_response(InputMessageBase* base) {
+  std::unique_ptr<RedisInputMessage> msg(
+      static_cast<RedisInputMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  // Exclusive short connection: the one pending RPC is the match.
+  const tbthread::fiber_id_t attempt_id = s->FirstPendingId();
+  if (attempt_id == 0) return;  // RPC finished (timeout won); drop
+  void* data = nullptr;
+  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
+  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
+  if (!acc.AcceptResponseFor(attempt_id)) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  tbutil::IOBuf* payload = acc.response_payload();
+  if (payload == nullptr) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  payload->append(std::move(msg->bytes));
+  // Once expected_responses complete replies accumulated, the RPC is done.
+  // Counting measures headers only — never materializes bulk payloads.
+  const uint64_t expected = acc.expected_responses();
+  size_t pos = 0;
+  uint64_t complete = 0;
+  while (pos < payload->size()) {
+    const ssize_t used = measure_reply(*payload, pos, 0);
+    if (used <= 0) break;
+    pos += static_cast<size_t>(used);
+    ++complete;
+  }
+  if (complete >= expected) {
+    acc.mark_response_received();
+    acc.EndRPC(0, "");
+    return;  // EndRPC consumed the lock
+  }
+  tbthread::fiber_id_unlock(attempt_id);
+}
+
+void redis_pack_request(tbutil::IOBuf* out, Controller* cntl,
+                        uint64_t /*correlation_id*/,
+                        const std::string& /*service_method*/,
+                        const tbutil::IOBuf& payload) {
+  (void)cntl;
+  out->append(payload);  // already RESP bytes (RedisRequest::SerializeTo)
+}
+
+}  // namespace
+
+// ---- RedisRequest / RedisResponse ----
+
+bool RedisRequest::AddCommand(const std::vector<std::string>& args) {
+  if (args.empty()) return false;
+  _wire += "*" + std::to_string(args.size()) + "\r\n";
+  for (const std::string& a : args) {
+    _wire += "$" + std::to_string(a.size()) + "\r\n";
+    _wire += a;
+    _wire += "\r\n";
+  }
+  ++_count;
+  return true;
+}
+
+bool RedisRequest::AddCommand(const std::string& line) {
+  std::vector<std::string> args;
+  size_t pos = 0;
+  while (pos < line.size()) {
+    while (pos < line.size() && line[pos] == ' ') ++pos;
+    size_t end = line.find(' ', pos);
+    if (end == std::string::npos) end = line.size();
+    if (end > pos) args.emplace_back(line, pos, end - pos);
+    pos = end;
+  }
+  return AddCommand(args);
+}
+
+void RedisRequest::SerializeTo(tbutil::IOBuf* out) const {
+  out->append(_wire);
+}
+
+void RedisRequest::Clear() {
+  _wire.clear();
+  _count = 0;
+}
+
+bool RedisResponse::ConsumePartial(tbutil::IOBuf* in) {
+  const std::string all = in->to_string();
+  size_t pos = 0;
+  while (pos < all.size()) {
+    RedisReply reply;
+    const ssize_t used =
+        parse_reply(all.data() + pos, all.size() - pos, &reply, 0);
+    if (used < 0) return false;
+    if (used == 0) break;
+    _replies.push_back(std::move(reply));
+    pos += static_cast<size_t>(used);
+  }
+  in->pop_front(pos);
+  return true;
+}
+
+int RedisExecute(Channel& channel, Controller* cntl,
+                 const RedisRequest& request, RedisResponse* resp) {
+  if (request.command_count() == 0) {
+    cntl->SetFailed(TRPC_EREQUEST, "empty redis request");
+    return TRPC_EREQUEST;
+  }
+  tbutil::IOBuf wire, raw;
+  request.SerializeTo(&wire);
+  ControllerPrivateAccessor(cntl).set_expected_responses(
+      request.command_count());
+  channel.CallMethod("redis/pipeline", cntl, wire, &raw, nullptr);
+  if (cntl->Failed()) return cntl->ErrorCode();
+  resp->Clear();
+  if (!resp->ConsumePartial(&raw) ||
+      resp->reply_count() != request.command_count()) {
+    cntl->SetFailed(TRPC_ERESPONSE, "malformed redis reply stream");
+    return TRPC_ERESPONSE;
+  }
+  return 0;
+}
+
+void RegisterRedisProtocol() {
+  Protocol p;
+  p.parse = redis_parse;
+  p.pack_request = redis_pack_request;
+  p.process_request = nullptr;  // client-only
+  p.process_response = redis_process_response;
+  p.short_connection = true;  // no correlation id on the wire (like HTTP)
+  p.name = "redis";
+  TB_CHECK(RegisterProtocol(kRedisProtocolIndex, p) == 0)
+      << "redis protocol slot taken";
+}
+
+}  // namespace trpc

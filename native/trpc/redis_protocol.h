@@ -1,0 +1,85 @@
+// redis client protocol: RESP2 over the Channel/Controller machinery —
+// pipelined commands in one RPC, replies parsed into a typed tree.
+// Capability parity: reference src/brpc/redis.h (RedisRequest::AddCommand,
+// RedisResponse::reply(i)) + policy/redis_protocol.cpp. Like HTTP, the wire
+// carries no correlation id, so redis RPCs ride an exclusive short
+// connection and replies match the socket's single pending call.
+//
+// Usage:
+//   Channel ch; ChannelOptions o; o.protocol = kRedisProtocolIndex;
+//   ch.Init("127.0.0.1:6379", &o);
+//   RedisRequest req;
+//   req.AddCommand({"SET", "k", "v"});
+//   req.AddCommand({"GET", "k"});
+//   RedisResponse resp;
+//   Controller cntl;
+//   RedisExecute(ch, &cntl, req, &resp);   // sync
+//   resp.reply(1).str == "v"
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+class Channel;
+class Controller;
+
+inline constexpr int kRedisProtocolIndex = 3;
+
+class RedisRequest {
+ public:
+  // One command as explicit args (binary-safe — values may contain
+  // anything). False on empty args.
+  bool AddCommand(const std::vector<std::string>& args);
+  // Convenience: space-separated command line (no quoting rules).
+  bool AddCommand(const std::string& line);
+
+  size_t command_count() const { return _count; }
+  void SerializeTo(tbutil::IOBuf* out) const;
+  void Clear();
+
+ private:
+  size_t _count = 0;
+  std::string _wire;  // RESP arrays, ready to send
+};
+
+struct RedisReply {
+  enum class Type { kNil, kStatus, kError, kInteger, kString, kArray };
+  Type type = Type::kNil;
+  int64_t integer = 0;
+  std::string str;  // status text / error text / bulk string
+  std::vector<RedisReply> elements;
+
+  bool is_nil() const { return type == Type::kNil; }
+  bool is_error() const { return type == Type::kError; }
+};
+
+class RedisResponse {
+ public:
+  size_t reply_count() const { return _replies.size(); }
+  const RedisReply& reply(size_t i) const { return _replies[i]; }
+
+  // Parse every complete reply at the front of `in` (consumed). Returns
+  // false on malformed bytes.
+  bool ConsumePartial(tbutil::IOBuf* in);
+  void Clear() { _replies.clear(); }
+
+ private:
+  std::vector<RedisReply> _replies;
+};
+
+// Synchronous execute: sends the pipelined commands, fills `resp` with one
+// reply per command. Returns 0 on success (check individual replies for
+// -ERR results); nonzero = transport/protocol failure (cntl has details).
+int RedisExecute(Channel& channel, Controller* cntl,
+                 const RedisRequest& request, RedisResponse* resp);
+
+// Registry hookup (GlobalInitializeOrDie).
+void RegisterRedisProtocol();
+
+}  // namespace trpc

@@ -12,6 +12,7 @@
 #include "trpc/compress.h"
 #include "trpc/controller.h"
 #include "trpc/http_protocol.h"
+#include "trpc/redis_protocol.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
@@ -444,6 +445,7 @@ void GlobalInitializeOrDie() {
         << "tstd protocol slot taken";
     RegisterHttpProtocol();  // same-port multi-protocol serving
     ttpu::ici_internal::RegisterTiciProtocol();  // tpu:// control frames
+    RegisterRedisProtocol();
     RegisterBuiltinConsole();
   });
 }
