@@ -1,0 +1,168 @@
+// memcache client protocol end-to-end against a mini text-protocol
+// memcached (set/add/get/delete/incr over a map).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mini_test.h"
+#include "trpc/channel.h"
+#include "trpc/memcache_protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+class MiniMemcached {
+ public:
+  MiniMemcached() {
+    _listen = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(_listen, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    ASSERT_TRUE(bind(_listen, reinterpret_cast<sockaddr*>(&addr),
+                     sizeof(addr)) == 0);
+    socklen_t len = sizeof(addr);
+    getsockname(_listen, reinterpret_cast<sockaddr*>(&addr), &len);
+    _port = ntohs(addr.sin_port);
+    ASSERT_TRUE(listen(_listen, 16) == 0);
+    _thread = std::thread([this] { Run(); });
+  }
+  ~MiniMemcached() {
+    ::shutdown(_listen, SHUT_RDWR);
+    ::close(_listen);
+    _thread.join();
+  }
+  int port() const { return _port; }
+
+ private:
+  void Run() {
+    while (true) {
+      int fd = accept(_listen, nullptr, nullptr);
+      if (fd < 0) return;
+      ServeConn(fd);
+      ::close(fd);
+    }
+  }
+
+  void ServeConn(int fd) {
+    std::string buf;
+    char tmp[4096];
+    while (true) {
+      while (true) {
+        size_t eol = buf.find("\r\n");
+        if (eol == std::string::npos) break;
+        std::string line = buf.substr(0, eol);
+        std::vector<std::string> w;
+        size_t p = 0;
+        while (p < line.size()) {
+          size_t sp = line.find(' ', p);
+          if (sp == std::string::npos) sp = line.size();
+          if (sp > p) w.push_back(line.substr(p, sp - p));
+          p = sp + 1;
+        }
+        std::string reply;
+        if (!w.empty() && (w[0] == "set" || w[0] == "add") &&
+            w.size() == 5) {
+          const size_t need = static_cast<size_t>(atol(w[4].c_str()));
+          if (buf.size() < eol + 2 + need + 2) break;  // data incomplete
+          const std::string value = buf.substr(eol + 2, need);
+          buf.erase(0, eol + 2 + need + 2);
+          if (w[0] == "add" && _kv.count(w[1])) {
+            reply = "NOT_STORED\r\n";
+          } else {
+            _kv[w[1]] = value;
+            reply = "STORED\r\n";
+          }
+        } else {
+          buf.erase(0, eol + 2);
+          if (!w.empty() && w[0] == "get" && w.size() == 2) {
+            auto it = _kv.find(w[1]);
+            if (it == _kv.end()) {
+              reply = "END\r\n";
+            } else {
+              reply = "VALUE " + w[1] + " 7 " +
+                      std::to_string(it->second.size()) + "\r\n" +
+                      it->second + "\r\nEND\r\n";
+            }
+          } else if (!w.empty() && w[0] == "delete" && w.size() == 2) {
+            reply = _kv.erase(w[1]) ? "DELETED\r\n" : "NOT_FOUND\r\n";
+          } else if (!w.empty() && w[0] == "incr" && w.size() == 3) {
+            auto it = _kv.find(w[1]);
+            if (it == _kv.end()) {
+              reply = "NOT_FOUND\r\n";
+            } else {
+              uint64_t v = strtoull(it->second.c_str(), nullptr, 10) +
+                           strtoull(w[2].c_str(), nullptr, 10);
+              it->second = std::to_string(v);
+              reply = it->second + "\r\n";
+            }
+          } else {
+            reply = "ERROR\r\n";
+          }
+        }
+        if (::send(fd, reply.data(), reply.size(), MSG_NOSIGNAL) < 0) {
+          return;
+        }
+      }
+      ssize_t n = ::read(fd, tmp, sizeof(tmp));
+      if (n <= 0) return;
+      buf.append(tmp, static_cast<size_t>(n));
+    }
+  }
+
+  int _listen = -1;
+  int _port = 0;
+  std::thread _thread;
+  std::map<std::string, std::string> _kv;
+};
+
+}  // namespace
+
+TEST_CASE(memcache_pipeline_end_to_end) {
+  MiniMemcached server;
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kMemcacheProtocolIndex;
+  opts.timeout_ms = 2000;
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.port());
+  ASSERT_EQ(ch.Init(addr, &opts), 0);
+
+  MemcacheRequest req;
+  ASSERT_TRUE(req.Set("k1", "value one"));
+  ASSERT_TRUE(req.Add("k1", "shadow"));  // exists -> NOT_STORED
+  ASSERT_TRUE(req.Get("k1"));
+  ASSERT_TRUE(req.Get("nope"));
+  ASSERT_TRUE(req.Set("n", "41"));
+  ASSERT_TRUE(req.Incr("n", 1));
+  ASSERT_TRUE(req.Delete("k1"));
+  ASSERT_FALSE(req.Get("bad key"));  // space in key rejected locally
+  ASSERT_EQ(req.op_count(), size_t{7});
+
+  MemcacheResponse resp;
+  Controller cntl;
+  ASSERT_EQ(MemcacheExecute(ch, &cntl, req, &resp), 0);
+  ASSERT_EQ(resp.reply_count(), size_t{7});
+  ASSERT_TRUE(resp.reply(0).type == MemcacheReply::Type::kStored);
+  ASSERT_TRUE(resp.reply(1).type == MemcacheReply::Type::kNotStored);
+  ASSERT_TRUE(resp.reply(2).type == MemcacheReply::Type::kValue);
+  ASSERT_EQ(resp.reply(2).value, std::string("value one"));
+  ASSERT_EQ(resp.reply(2).flags, 7u);
+  ASSERT_TRUE(resp.reply(3).type == MemcacheReply::Type::kMiss);
+  ASSERT_TRUE(resp.reply(4).type == MemcacheReply::Type::kStored);
+  ASSERT_TRUE(resp.reply(5).type == MemcacheReply::Type::kInteger);
+  ASSERT_EQ(resp.reply(5).integer, 42u);
+  ASSERT_TRUE(resp.reply(6).type == MemcacheReply::Type::kDeleted);
+}
+
+TEST_MAIN

@@ -1,0 +1,327 @@
+#include "trpc/memcache_protocol.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "tbutil/logging.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/input_messenger.h"
+#include "trpc/protocol.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxValueLen = 64u << 20;
+constexpr size_t kMaxLine = 8 * 1024;
+
+// Offset of the CRLF ending the line at `from` (relative), SIZE_MAX when
+// more bytes are needed, SIZE_MAX-1 when none within kMaxLine (malformed).
+size_t find_crlf(const tbutil::IOBuf& buf, size_t from) {
+  char chunk[256];
+  size_t scanned = 0;
+  char carry = 0;
+  while (scanned < kMaxLine) {
+    const size_t want = std::min(sizeof(chunk), kMaxLine - scanned);
+    const size_t got = buf.copy_to(chunk, want, from + scanned);
+    if (got == 0) return SIZE_MAX;
+    if (carry == '\r' && chunk[0] == '\n') return scanned - 1;
+    for (size_t i = 0; i + 1 < got; ++i) {
+      if (chunk[i] == '\r' && chunk[i + 1] == '\n') return scanned + i;
+    }
+    carry = chunk[got - 1];
+    scanned += got;
+    if (got < want) return SIZE_MAX;
+  }
+  return SIZE_MAX - 1;
+}
+
+// One complete text reply starting at `pos`: a single line (STORED /
+// NOT_STORED / DELETED / NOT_FOUND / ERROR... / number), or a get result —
+// zero or more "VALUE <key> <flags> <len>\r\n<data>\r\n" blocks terminated
+// by "END\r\n". Returns total bytes, 0 incomplete, -1 malformed.
+ssize_t measure_mc_reply(const tbutil::IOBuf& buf, size_t pos) {
+  size_t off = 0;
+  for (int blocks = 0; blocks < 1024; ++blocks) {
+    const size_t line_rel = find_crlf(buf, pos + off);
+    if (line_rel == SIZE_MAX) return 0;
+    if (line_rel == SIZE_MAX - 1) return -1;
+    char head[16] = {};
+    buf.copy_to(head, std::min<size_t>(sizeof(head) - 1, line_rel),
+                pos + off);
+    if (strncmp(head, "VALUE ", 6) == 0) {
+      // VALUE key flags len — len is the last space-separated field.
+      std::string line(line_rel, '\0');
+      buf.copy_to(line.data(), line_rel, pos + off);
+      const size_t sp = line.rfind(' ');
+      if (sp == std::string::npos) return -1;
+      char* end = nullptr;
+      const long long len = strtoll(line.c_str() + sp + 1, &end, 10);
+      if (end == line.c_str() + sp + 1 || len < 0 ||
+          len > static_cast<long long>(kMaxValueLen)) {
+        return -1;
+      }
+      const size_t block =
+          line_rel + 2 + static_cast<size_t>(len) + 2;  // line + data CRLF
+      if (buf.size() < pos + off + block) return 0;
+      off += block;
+      continue;  // more VALUE blocks or END follow
+    }
+    off += line_rel + 2;
+    return static_cast<ssize_t>(off);  // single-line reply (incl. "END")
+  }
+  return -1;
+}
+
+struct McInputMessage : public InputMessageBase {
+  tbutil::IOBuf bytes;
+};
+
+ParseResult mc_parse(tbutil::IOBuf* source, Socket* socket) {
+  ParseResult r;
+  if (socket->server_side()) {
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  if (source->empty()) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  // Plausibility: replies start with an ASCII letter or digit.
+  char first;
+  source->copy_to(&first, 1);
+  if (!isalnum(static_cast<unsigned char>(first))) {
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  const ssize_t used = measure_mc_reply(*source, 0);
+  if (used < 0) {
+    r.error = PARSE_ERROR_TRY_OTHERS;  // not memcache after all
+    return r;
+  }
+  if (used == 0) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  auto* msg = new McInputMessage;
+  source->cutn(&msg->bytes, static_cast<size_t>(used));
+  msg->process_in_place = true;  // replies match commands by position
+  r.error = PARSE_OK;
+  r.msg = msg;
+  return r;
+}
+
+void mc_process_response(InputMessageBase* base) {
+  std::unique_ptr<McInputMessage> msg(static_cast<McInputMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  const tbthread::fiber_id_t attempt_id = s->FirstPendingId();
+  if (attempt_id == 0) return;
+  void* data = nullptr;
+  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
+  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
+  if (!acc.AcceptResponseFor(attempt_id)) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  tbutil::IOBuf* payload = acc.response_payload();
+  if (payload == nullptr) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  payload->append(std::move(msg->bytes));
+  const uint64_t expected = acc.expected_responses();
+  size_t pos = 0;
+  uint64_t complete = 0;
+  while (pos < payload->size()) {
+    const ssize_t used = measure_mc_reply(*payload, pos);
+    if (used <= 0) break;
+    pos += static_cast<size_t>(used);
+    ++complete;
+  }
+  if (complete >= expected) {
+    acc.mark_response_received();
+    acc.EndRPC(0, "");
+    return;
+  }
+  tbthread::fiber_id_unlock(attempt_id);
+}
+
+void mc_pack_request(tbutil::IOBuf* out, Controller* /*cntl*/,
+                     uint64_t /*correlation_id*/,
+                     const std::string& /*service_method*/,
+                     const tbutil::IOBuf& payload) {
+  out->append(payload);
+}
+
+}  // namespace
+
+// ---- request building ----
+
+bool MemcacheRequest::valid_key(const std::string& key) const {
+  if (key.empty() || key.size() > 250) return false;
+  for (char c : key) {
+    if (c <= ' ' || c == 0x7f) return false;
+  }
+  return true;
+}
+
+bool MemcacheRequest::Get(const std::string& key) {
+  if (!valid_key(key)) return false;
+  _wire += "get " + key + "\r\n";
+  ++_count;
+  return true;
+}
+
+bool MemcacheRequest::store_op(const char* verb, const std::string& key,
+                               const std::string& value, uint32_t flags,
+                               uint32_t exptime) {
+  if (!valid_key(key) || value.size() > kMaxValueLen) return false;
+  _wire += std::string(verb) + " " + key + " " + std::to_string(flags) +
+           " " + std::to_string(exptime) + " " +
+           std::to_string(value.size()) + "\r\n";
+  _wire += value;
+  _wire += "\r\n";
+  ++_count;
+  return true;
+}
+
+bool MemcacheRequest::Set(const std::string& key, const std::string& value,
+                          uint32_t flags, uint32_t exptime) {
+  return store_op("set", key, value, flags, exptime);
+}
+bool MemcacheRequest::Add(const std::string& key, const std::string& value,
+                          uint32_t flags, uint32_t exptime) {
+  return store_op("add", key, value, flags, exptime);
+}
+bool MemcacheRequest::Replace(const std::string& key,
+                              const std::string& value, uint32_t flags,
+                              uint32_t exptime) {
+  return store_op("replace", key, value, flags, exptime);
+}
+
+bool MemcacheRequest::Delete(const std::string& key) {
+  if (!valid_key(key)) return false;
+  _wire += "delete " + key + "\r\n";
+  ++_count;
+  return true;
+}
+
+bool MemcacheRequest::Incr(const std::string& key, uint64_t delta) {
+  if (!valid_key(key)) return false;
+  _wire += "incr " + key + " " + std::to_string(delta) + "\r\n";
+  ++_count;
+  return true;
+}
+
+bool MemcacheRequest::Decr(const std::string& key, uint64_t delta) {
+  if (!valid_key(key)) return false;
+  _wire += "decr " + key + " " + std::to_string(delta) + "\r\n";
+  ++_count;
+  return true;
+}
+
+void MemcacheRequest::SerializeTo(tbutil::IOBuf* out) const {
+  out->append(_wire);
+}
+
+void MemcacheRequest::Clear() {
+  _wire.clear();
+  _count = 0;
+}
+
+// ---- response parsing (flat, called once on complete data) ----
+
+bool MemcacheResponse::ConsumePartial(tbutil::IOBuf* in) {
+  const std::string all = in->to_string();
+  size_t pos = 0;
+  while (pos < all.size()) {
+    size_t eol = all.find("\r\n", pos);
+    if (eol == std::string::npos) break;
+    const std::string line = all.substr(pos, eol - pos);
+    MemcacheReply r;
+    if (line.rfind("VALUE ", 0) == 0) {
+      // VALUE key flags len
+      const size_t sp1 = line.find(' ', 6);
+      if (sp1 == std::string::npos) return false;
+      const size_t sp2 = line.find(' ', sp1 + 1);
+      if (sp2 == std::string::npos) return false;
+      r.type = MemcacheReply::Type::kValue;
+      r.flags = static_cast<uint32_t>(atoll(line.c_str() + sp1 + 1));
+      const long long len = atoll(line.c_str() + sp2 + 1);
+      if (len < 0 || all.size() < eol + 2 + static_cast<size_t>(len) + 2) {
+        break;  // incomplete
+      }
+      r.value = all.substr(eol + 2, static_cast<size_t>(len));
+      pos = eol + 2 + static_cast<size_t>(len) + 2;
+      // The END line closing this get.
+      size_t end_eol = all.find("\r\n", pos);
+      if (end_eol == std::string::npos ||
+          all.compare(pos, end_eol - pos, "END") != 0) {
+        return false;
+      }
+      pos = end_eol + 2;
+      _replies.push_back(std::move(r));
+      continue;
+    }
+    pos = eol + 2;
+    if (line == "END") {
+      r.type = MemcacheReply::Type::kMiss;
+    } else if (line == "STORED") {
+      r.type = MemcacheReply::Type::kStored;
+    } else if (line == "NOT_STORED") {
+      r.type = MemcacheReply::Type::kNotStored;
+    } else if (line == "DELETED") {
+      r.type = MemcacheReply::Type::kDeleted;
+    } else if (line == "NOT_FOUND") {
+      r.type = MemcacheReply::Type::kMiss;
+    } else if (!line.empty() &&
+               line.find_first_not_of("0123456789") == std::string::npos) {
+      r.type = MemcacheReply::Type::kInteger;
+      r.integer = strtoull(line.c_str(), nullptr, 10);
+    } else {
+      r.type = MemcacheReply::Type::kError;
+      r.value = line;
+    }
+    _replies.push_back(std::move(r));
+  }
+  in->pop_front(pos);
+  return true;
+}
+
+int MemcacheExecute(Channel& channel, Controller* cntl,
+                    const MemcacheRequest& request, MemcacheResponse* resp) {
+  if (request.op_count() == 0) {
+    cntl->SetFailed(TRPC_EREQUEST, "empty memcache request");
+    return TRPC_EREQUEST;
+  }
+  tbutil::IOBuf wire, raw;
+  request.SerializeTo(&wire);
+  ControllerPrivateAccessor(cntl).set_expected_responses(request.op_count());
+  channel.CallMethod("memcache/pipeline", cntl, wire, &raw, nullptr);
+  if (cntl->Failed()) return cntl->ErrorCode();
+  resp->Clear();
+  if (!resp->ConsumePartial(&raw) ||
+      resp->reply_count() != request.op_count()) {
+    cntl->SetFailed(TRPC_ERESPONSE, "malformed memcache reply stream");
+    return TRPC_ERESPONSE;
+  }
+  return 0;
+}
+
+void RegisterMemcacheProtocol() {
+  Protocol p;
+  p.parse = mc_parse;
+  p.pack_request = mc_pack_request;
+  p.process_request = nullptr;  // client-only
+  p.process_response = mc_process_response;
+  p.short_connection = true;
+  p.name = "memcache";
+  TB_CHECK(RegisterProtocol(kMemcacheProtocolIndex, p) == 0)
+      << "memcache protocol slot taken";
+}
+
+}  // namespace trpc

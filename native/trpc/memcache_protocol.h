@@ -1,0 +1,83 @@
+// memcache client protocol (text flavor): get/set/add/replace/delete/
+// incr/decr pipelined over the Channel machinery.
+// Capability parity: reference src/brpc/memcache.h (MemcacheRequest::Get/
+// Set..., MemcacheResponse::PopGet) + policy/memcache_binary_protocol.cpp
+// (the reference speaks the binary protocol; the text protocol carries the
+// same operations and interops with every memcached).
+// Like redis/HTTP, the wire has no correlation id: RPCs ride an exclusive
+// short connection and replies match by position.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+class Channel;
+class Controller;
+
+inline constexpr int kMemcacheProtocolIndex = 4;
+
+class MemcacheRequest {
+ public:
+  // Keys must be <= 250 bytes, no spaces/control chars (validated).
+  bool Get(const std::string& key);
+  bool Set(const std::string& key, const std::string& value,
+           uint32_t flags = 0, uint32_t exptime = 0);
+  bool Add(const std::string& key, const std::string& value,
+           uint32_t flags = 0, uint32_t exptime = 0);
+  bool Replace(const std::string& key, const std::string& value,
+               uint32_t flags = 0, uint32_t exptime = 0);
+  bool Delete(const std::string& key);
+  bool Incr(const std::string& key, uint64_t delta);
+  bool Decr(const std::string& key, uint64_t delta);
+
+  size_t op_count() const { return _count; }
+  void SerializeTo(tbutil::IOBuf* out) const;
+  void Clear();
+
+ private:
+  bool valid_key(const std::string& key) const;
+  bool store_op(const char* verb, const std::string& key,
+                const std::string& value, uint32_t flags, uint32_t exptime);
+  size_t _count = 0;
+  std::string _wire;
+};
+
+struct MemcacheReply {
+  enum class Type {
+    kStored,     // set/add/replace succeeded
+    kNotStored,  // add/replace condition failed
+    kValue,      // get hit: value/flags filled
+    kMiss,       // get miss / NOT_FOUND
+    kDeleted,
+    kInteger,    // incr/decr result
+    kError,      // ERROR / CLIENT_ERROR / SERVER_ERROR
+  };
+  Type type = Type::kMiss;
+  std::string value;  // get payload or error text
+  uint32_t flags = 0;
+  uint64_t integer = 0;
+};
+
+class MemcacheResponse {
+ public:
+  size_t reply_count() const { return _replies.size(); }
+  const MemcacheReply& reply(size_t i) const { return _replies[i]; }
+  bool ConsumePartial(tbutil::IOBuf* in);
+  void Clear() { _replies.clear(); }
+
+ private:
+  std::vector<MemcacheReply> _replies;
+};
+
+// Synchronous execute: one reply per operation, by position. 0 on success.
+int MemcacheExecute(Channel& channel, Controller* cntl,
+                    const MemcacheRequest& request, MemcacheResponse* resp);
+
+void RegisterMemcacheProtocol();
+
+}  // namespace trpc

@@ -12,6 +12,7 @@
 #include "trpc/compress.h"
 #include "trpc/controller.h"
 #include "trpc/http_protocol.h"
+#include "trpc/memcache_protocol.h"
 #include "trpc/redis_protocol.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
@@ -446,6 +447,7 @@ void GlobalInitializeOrDie() {
     RegisterHttpProtocol();  // same-port multi-protocol serving
     ttpu::ici_internal::RegisterTiciProtocol();  // tpu:// control frames
     RegisterRedisProtocol();
+    RegisterMemcacheProtocol();
     RegisterBuiltinConsole();
   });
 }
