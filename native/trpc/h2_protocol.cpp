@@ -1,0 +1,594 @@
+#include "trpc/h2_protocol.h"
+
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/hpack.h"
+#include "trpc/input_messenger.h"
+#include "trpc/protocol.h"
+#include "trpc/rpc_metrics.h"
+#include "trpc/server.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr size_t kFrameHeader = 9;
+
+enum FrameType : uint8_t {
+  kData = 0,
+  kHeaders = 1,
+  kPriority = 2,
+  kRstStream = 3,
+  kSettings = 4,
+  kPushPromise = 5,
+  kPing = 6,
+  kGoaway = 7,
+  kWindowUpdate = 8,
+  kContinuation = 9,
+};
+
+enum Flags : uint8_t {
+  kFlagEndStream = 0x1,
+  kFlagAck = 0x1,
+  kFlagEndHeaders = 0x4,
+  kFlagPadded = 0x8,
+  kFlagPriority = 0x20,
+};
+
+struct H2Stream {
+  HeaderList headers;
+  tbutil::IOBuf body;
+  std::string header_block;  // HEADERS (+CONTINUATION) fragments
+  bool headers_done = false;
+  bool end_stream = false;
+};
+
+struct H2Connection {
+  HpackDecoder decoder;
+  std::unordered_map<uint32_t, H2Stream> streams;
+  uint32_t continuation_stream = 0;  // expecting CONTINUATION for this id
+
+  // Peer settings.
+  uint32_t peer_max_frame = 16384;
+  int64_t peer_initial_window = 65535;
+
+  // Send-side flow control (guarded by write_mu).
+  std::mutex write_mu;
+  int64_t conn_send_window = 65535;
+  std::unordered_map<uint32_t, int64_t> stream_send_window;
+  // DATA blocked on window: (stream, remaining bytes, end_stream trailers
+  // to follow flag handled by caller keeping order) — flushed on
+  // WINDOW_UPDATE.
+  struct Pending {
+    uint32_t stream_id;
+    tbutil::IOBuf data;
+    std::string trailers_frame;  // sent after data drains (may be empty)
+  };
+  std::deque<Pending> pending;
+};
+
+void h2_conn_dtor(void* p) { delete static_cast<H2Connection*>(p); }
+
+// ---- frame serialization helpers ----
+
+void put_frame_header(std::string* out, size_t len, uint8_t type,
+                      uint8_t flags, uint32_t stream_id) {
+  out->push_back(static_cast<char>((len >> 16) & 0xff));
+  out->push_back(static_cast<char>((len >> 8) & 0xff));
+  out->push_back(static_cast<char>(len & 0xff));
+  out->push_back(static_cast<char>(type));
+  out->push_back(static_cast<char>(flags));
+  out->push_back(static_cast<char>((stream_id >> 24) & 0x7f));
+  out->push_back(static_cast<char>((stream_id >> 16) & 0xff));
+  out->push_back(static_cast<char>((stream_id >> 8) & 0xff));
+  out->push_back(static_cast<char>(stream_id & 0xff));
+}
+
+int write_raw(Socket* s, const std::string& bytes) {
+  tbutil::IOBuf buf;
+  buf.append(bytes);
+  return s->Write(&buf);
+}
+
+// HEADERS frame with END_HEADERS (header blocks here are small).
+std::string make_headers_frame(const HeaderList& headers, uint32_t stream_id,
+                               bool end_stream) {
+  std::string block;
+  for (const auto& [n, v] : headers) {
+    HpackEncodeHeader(&block, n, v);
+  }
+  std::string out;
+  put_frame_header(&out, block.size(), kHeaders,
+                   kFlagEndHeaders | (end_stream ? kFlagEndStream : 0),
+                   stream_id);
+  out += block;
+  return out;
+}
+
+// Sends as much of `pending` DATA as the windows allow; keeps order.
+// Called with write_mu held.
+void flush_pending_locked(H2Connection* conn, Socket* s) {
+  while (!conn->pending.empty()) {
+    H2Connection::Pending& p = conn->pending.front();
+    int64_t& swin = conn->stream_send_window[p.stream_id];
+    while (!p.data.empty()) {
+      const int64_t allowed =
+          std::min<int64_t>({static_cast<int64_t>(conn->peer_max_frame),
+                             conn->conn_send_window, swin,
+                             static_cast<int64_t>(p.data.size())});
+      if (allowed <= 0) return;  // blocked: wait for WINDOW_UPDATE
+      std::string hdr;
+      put_frame_header(&hdr, static_cast<size_t>(allowed), kData, 0,
+                       p.stream_id);
+      tbutil::IOBuf frame;
+      frame.append(hdr);
+      tbutil::IOBuf chunk;
+      p.data.cutn(&chunk, static_cast<size_t>(allowed));
+      frame.append(std::move(chunk));
+      conn->conn_send_window -= allowed;
+      swin -= allowed;
+      if (s->Write(&frame) != 0) {
+        conn->pending.clear();
+        return;
+      }
+    }
+    if (!p.trailers_frame.empty()) {
+      write_raw(s, p.trailers_frame);
+    }
+    // Response complete: the stream is closed on both sides — drop its
+    // send-window entry or a long-lived connection accretes one per call.
+    conn->stream_send_window.erase(p.stream_id);
+    conn->pending.pop_front();
+  }
+}
+
+// ---- inbound message ----
+
+struct H2RequestMessage : public InputMessageBase {
+  uint32_t stream_id = 0;
+  HeaderList headers;
+  tbutil::IOBuf body;
+};
+
+const std::string* find_header(const HeaderList& h, const char* name) {
+  for (const auto& [n, v] : h) {
+    if (n == name) return &v;
+  }
+  return nullptr;
+}
+
+// ---- parse ----
+
+ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
+  ParseResult r;
+  if (!socket->server_side()) {
+    r.error = PARSE_ERROR_TRY_OTHERS;  // server-side protocol only
+    return r;
+  }
+  auto* conn = static_cast<H2Connection*>(socket->protocol_data());
+  if (conn == nullptr) {
+    // Client connection preface.
+    const size_t have = std::min(source->size(), kPrefaceLen);
+    char buf[kPrefaceLen];
+    source->copy_to(buf, have);
+    if (memcmp(buf, kPreface, have) != 0) {
+      r.error = PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
+    if (have < kPrefaceLen) {
+      r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+      return r;
+    }
+    source->pop_front(kPrefaceLen);
+    conn = new H2Connection;
+    socket->set_protocol_data(conn, h2_conn_dtor);
+    // Server preface: our SETTINGS (all defaults).
+    std::string settings;
+    put_frame_header(&settings, 0, kSettings, 0, 0);
+    write_raw(socket, settings);
+  }
+
+  while (true) {
+    // Deliver any stream that became complete. The entry is erased HERE,
+    // on the parse path: conn->streams is single-threaded input-fiber
+    // state; the dispatch fiber must never touch it.
+    for (auto it = conn->streams.begin(); it != conn->streams.end(); ++it) {
+      H2Stream& st = it->second;
+      if (st.headers_done && st.end_stream) {
+        auto* msg = new H2RequestMessage;
+        msg->stream_id = it->first;
+        msg->headers = std::move(st.headers);
+        msg->body = std::move(st.body);
+        conn->streams.erase(it);
+        r.error = PARSE_OK;
+        r.msg = msg;
+        return r;
+      }
+    }
+    if (source->size() < kFrameHeader) {
+      r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+      return r;
+    }
+    uint8_t h[kFrameHeader];
+    source->copy_to(h, kFrameHeader);
+    const size_t len = (size_t(h[0]) << 16) | (size_t(h[1]) << 8) | h[2];
+    const uint8_t type = h[3];
+    const uint8_t flags = h[4];
+    const uint32_t stream_id =
+        ((uint32_t(h[5]) & 0x7f) << 24) | (uint32_t(h[6]) << 16) |
+        (uint32_t(h[7]) << 8) | h[8];
+    // We never raise SETTINGS_MAX_FRAME_SIZE, so legal peers stay <=16384.
+    if (len > 1u << 20) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    if (source->size() < kFrameHeader + len) {
+      r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+      return r;
+    }
+    source->pop_front(kFrameHeader);
+    std::string payload;
+    payload.resize(len);
+    source->cutn(payload.data(), len);
+
+    // RFC 9113 §4.3: an open CONTINUATION sequence admits ONLY
+    // CONTINUATION frames for the same stream — anything else must kill
+    // the connection, or interleaved header blocks would desync the
+    // shared HPACK decoder into silently wrong headers.
+    if (conn->continuation_stream != 0 &&
+        (type != kContinuation || stream_id != conn->continuation_stream)) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    if (type == kContinuation && conn->continuation_stream == 0) {
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+
+    switch (type) {
+      case kSettings: {
+        if (flags & kFlagAck) break;
+        if (len % 6 != 0) {
+          r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+          return r;
+        }
+        for (size_t off = 0; off + 6 <= len; off += 6) {
+          const uint16_t id = (uint8_t(payload[off]) << 8) |
+                              uint8_t(payload[off + 1]);
+          const uint32_t value = (uint32_t(uint8_t(payload[off + 2])) << 24) |
+                                 (uint32_t(uint8_t(payload[off + 3])) << 16) |
+                                 (uint32_t(uint8_t(payload[off + 4])) << 8) |
+                                 uint8_t(payload[off + 5]);
+          if (id == 1) {
+            conn->decoder.set_max_dynamic_size(value);
+          } else if (id == 4) {
+            std::lock_guard<std::mutex> lk(conn->write_mu);
+            const int64_t delta =
+                int64_t(value) - conn->peer_initial_window;
+            conn->peer_initial_window = value;
+            for (auto& [sid, w] : conn->stream_send_window) w += delta;
+          } else if (id == 5) {
+            if (value >= 16384) {
+              // write_mu: flush_pending_locked reads this from done fibers.
+              std::lock_guard<std::mutex> lk(conn->write_mu);
+              conn->peer_max_frame = value;
+            }
+          }
+        }
+        std::string ack;
+        put_frame_header(&ack, 0, kSettings, kFlagAck, 0);
+        write_raw(socket, ack);
+        break;
+      }
+      case kPing: {
+        if (!(flags & kFlagAck) && len == 8) {
+          std::string pong;
+          put_frame_header(&pong, 8, kPing, kFlagAck, 0);
+          pong += payload;
+          write_raw(socket, pong);
+        }
+        break;
+      }
+      case kWindowUpdate: {
+        if (len != 4) break;
+        const uint32_t inc = ((uint32_t(uint8_t(payload[0])) & 0x7f) << 24) |
+                             (uint32_t(uint8_t(payload[1])) << 16) |
+                             (uint32_t(uint8_t(payload[2])) << 8) |
+                             uint8_t(payload[3]);
+        std::lock_guard<std::mutex> lk(conn->write_mu);
+        if (stream_id == 0) {
+          conn->conn_send_window += inc;
+        } else {
+          // Only known streams: updates for arbitrary ids must not mint
+          // map entries (a spray would grow the heap unboundedly).
+          auto wit = conn->stream_send_window.find(stream_id);
+          if (wit != conn->stream_send_window.end()) wit->second += inc;
+        }
+        flush_pending_locked(conn, socket);
+        break;
+      }
+      case kHeaders:
+      case kContinuation: {
+        if (stream_id == 0) {
+          r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+          return r;
+        }
+        size_t off = 0;
+        size_t frag_len = len;
+        if (type == kHeaders) {
+          if (flags & kFlagPadded) {
+            const size_t pad = uint8_t(payload[0]);
+            off += 1;
+            if (pad + off > frag_len) {
+              r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+              return r;
+            }
+            frag_len -= pad;
+          }
+          if (flags & kFlagPriority) off += 5;
+          if (off > frag_len) {
+            r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+            return r;
+          }
+        }
+        H2Stream& st = conn->streams[stream_id];
+        st.header_block.append(payload, off, frag_len - off);
+        if (type == kHeaders && (flags & kFlagEndStream)) {
+          st.end_stream = true;
+        }
+        if (flags & kFlagEndHeaders) {
+          conn->continuation_stream = 0;
+          if (!conn->decoder.Decode(
+                  reinterpret_cast<const uint8_t*>(st.header_block.data()),
+                  st.header_block.size(), &st.headers)) {
+            r.error = PARSE_ERROR_ABSOLUTELY_WRONG;  // HPACK error: fatal
+            return r;
+          }
+          st.header_block.clear();
+          st.headers_done = true;
+          {
+            std::lock_guard<std::mutex> lk(conn->write_mu);
+            conn->stream_send_window.emplace(stream_id,
+                                             conn->peer_initial_window);
+          }
+        } else {
+          conn->continuation_stream = stream_id;
+        }
+        break;
+      }
+      case kData: {
+        // Replenish the receive windows FIRST, even for unknown/reset
+        // streams: bytes the peer charged against the connection window
+        // must always be returned or the connection slowly strangles
+        // (64KB of post-RST DATA would freeze every stream for good).
+        if (len > 0) {
+          std::string wu;
+          auto add_wu = [&wu](uint32_t sid, uint32_t n) {
+            put_frame_header(&wu, 4, kWindowUpdate, 0, sid);
+            wu.push_back(static_cast<char>((n >> 24) & 0x7f));
+            wu.push_back(static_cast<char>((n >> 16) & 0xff));
+            wu.push_back(static_cast<char>((n >> 8) & 0xff));
+            wu.push_back(static_cast<char>(n & 0xff));
+          };
+          add_wu(0, static_cast<uint32_t>(len));
+          add_wu(stream_id, static_cast<uint32_t>(len));
+          write_raw(socket, wu);
+        }
+        auto it = conn->streams.find(stream_id);
+        if (it == conn->streams.end()) break;  // unknown/reset stream
+        size_t off = 0;
+        size_t data_len = len;
+        if (flags & kFlagPadded) {
+          const size_t pad = uint8_t(payload[0]);
+          off += 1;
+          if (pad + off > data_len) {
+            r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+            return r;
+          }
+          data_len -= pad;
+        }
+        it->second.body.append(payload.data() + off, data_len - off);
+        if (flags & kFlagEndStream) it->second.end_stream = true;
+        break;
+      }
+      case kRstStream:
+        conn->streams.erase(stream_id);
+        break;
+      case kPriority:
+      case kGoaway:
+      case kPushPromise:
+      default:
+        break;  // tolerated / ignored
+    }
+  }
+}
+
+// ---- request dispatch (server) ----
+
+void send_h2_error(Socket* s, H2Connection* conn, uint32_t stream_id,
+                   bool grpc, int http_status, int grpc_status,
+                   const std::string& message) {
+  std::lock_guard<std::mutex> lk(conn->write_mu);
+  HeaderList h;
+  if (grpc) {
+    h.emplace_back(":status", "200");
+    h.emplace_back("content-type", "application/grpc");
+    h.emplace_back("grpc-status", std::to_string(grpc_status));
+    h.emplace_back("grpc-message", message);
+  } else {
+    h.emplace_back(":status", std::to_string(http_status));
+  }
+  write_raw(s, make_headers_frame(h, stream_id, /*end_stream=*/true));
+}
+
+void h2_process_request(InputMessageBase* base) {
+  std::unique_ptr<H2RequestMessage> msg(
+      static_cast<H2RequestMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  auto* conn = static_cast<H2Connection*>(s->protocol_data());
+  auto* server = static_cast<Server*>(s->user());
+  if (conn == nullptr || server == nullptr) return;
+  const uint32_t stream_id = msg->stream_id;
+  // NOTE: conn->streams belongs to the input fiber (the parse path erased
+  // this stream when it emitted the message) — never touch it here.
+
+  const std::string* path = find_header(msg->headers, ":path");
+  const std::string* ctype = find_header(msg->headers, "content-type");
+  const bool grpc =
+      ctype != nullptr && ctype->rfind("application/grpc", 0) == 0;
+  if (path == nullptr || path->empty() || (*path)[0] != '/') {
+    send_h2_error(s.get(), conn, stream_id, grpc, 400, 3, "bad :path");
+    return;
+  }
+  // "/Service/Method"
+  const size_t slash = path->find('/', 1);
+  std::string service_name, method;
+  if (slash != std::string::npos) {
+    service_name = path->substr(1, slash - 1);
+    const size_t q = path->find('?', slash);
+    method = path->substr(slash + 1, q == std::string::npos
+                                         ? std::string::npos
+                                         : q - slash - 1);
+  }
+  Service* svc = server->FindService(service_name);
+  if (svc == nullptr) {
+    send_h2_error(s.get(), conn, stream_id, grpc, 404, 12,
+                  "no such service: " + service_name);
+    return;
+  }
+  tbutil::IOBuf request = std::move(msg->body);
+  if (grpc) {
+    // Length-prefixed message framing (gRPC over HTTP/2 spec): 1-byte
+    // compressed flag + u32 length + message.
+    if (request.size() < 5) {
+      send_h2_error(s.get(), conn, stream_id, grpc, 400, 13,
+                    "truncated grpc frame");
+      return;
+    }
+    uint8_t prefix[5];
+    request.copy_to(prefix, 5);
+    if (prefix[0] != 0) {
+      send_h2_error(s.get(), conn, stream_id, grpc, 400, 12,
+                    "compressed grpc messages not supported");
+      return;
+    }
+    const uint32_t mlen = (uint32_t(prefix[1]) << 24) |
+                          (uint32_t(prefix[2]) << 16) |
+                          (uint32_t(prefix[3]) << 8) | prefix[4];
+    if (request.size() < 5u + mlen) {
+      send_h2_error(s.get(), conn, stream_id, grpc, 400, 13,
+                    "grpc frame length mismatch");
+      return;
+    }
+    request.pop_front(5);
+    tbutil::IOBuf message;
+    request.cutn(&message, mlen);
+    request = std::move(message);
+  }
+  if (!server->BeginRequest()) {
+    send_h2_error(s.get(), conn, stream_id, grpc, 503, 8,
+                  "server concurrency limit reached");
+    return;
+  }
+  MethodStatus* ms = GetMethodStatus(service_name + "/" + method);
+  ms->OnRequested();
+  const int64_t received_us = tbutil::gettimeofday_us();
+
+  auto* cntl = new Controller;
+  auto* response = new tbutil::IOBuf;
+  ControllerPrivateAccessor acc(cntl);
+  acc.set_server_side(s->remote_side(), 0);
+  acc.set_server_socket(msg->socket_id);
+  const SocketId sid = msg->socket_id;
+  Closure* done = NewCallback([sid, stream_id, cntl, response, server, ms,
+                               received_us, grpc]() {
+    const int64_t latency_us =
+        std::max<int64_t>(0, tbutil::gettimeofday_us() - received_us);
+    ms->OnResponded(cntl->ErrorCode(), latency_us);
+    SocketUniquePtr sock;
+    if (Socket::Address(sid, &sock) == 0) {
+      auto* conn = static_cast<H2Connection*>(sock->protocol_data());
+      if (conn != nullptr) {
+        std::lock_guard<std::mutex> lk(conn->write_mu);
+        if (grpc) {
+          HeaderList h;
+          h.emplace_back(":status", "200");
+          h.emplace_back("content-type", "application/grpc");
+          write_raw(sock.get(),
+                    make_headers_frame(h, stream_id, /*end_stream=*/false));
+          // DATA: 5-byte message prefix + payload, queued through the
+          // flow-control path.
+          H2Connection::Pending p;
+          p.stream_id = stream_id;
+          char prefix[5] = {0};
+          const uint32_t mlen = static_cast<uint32_t>(response->size());
+          prefix[1] = static_cast<char>((mlen >> 24) & 0xff);
+          prefix[2] = static_cast<char>((mlen >> 16) & 0xff);
+          prefix[3] = static_cast<char>((mlen >> 8) & 0xff);
+          prefix[4] = static_cast<char>(mlen & 0xff);
+          p.data.append(prefix, 5);
+          p.data.append(std::move(*response));
+          HeaderList trailers;
+          trailers.emplace_back("grpc-status",
+                                std::to_string(cntl->Failed() ? 2 : 0));
+          if (cntl->Failed()) {
+            trailers.emplace_back("grpc-message", cntl->ErrorText());
+          }
+          p.trailers_frame =
+              make_headers_frame(trailers, stream_id, /*end_stream=*/true);
+          conn->pending.push_back(std::move(p));
+          flush_pending_locked(conn, sock.get());
+        } else {
+          HeaderList h;
+          h.emplace_back(":status", cntl->Failed() ? "500" : "200");
+          write_raw(sock.get(),
+                    make_headers_frame(h, stream_id, /*end_stream=*/false));
+          H2Connection::Pending p;
+          p.stream_id = stream_id;
+          if (cntl->Failed()) {
+            p.data.append(cntl->ErrorText());
+          } else {
+            p.data.append(std::move(*response));
+          }
+          // END_STREAM via an empty trailing DATA frame keeps one code
+          // path; a trailers-less h2 response may end on DATA.
+          std::string fin;
+          put_frame_header(&fin, 0, kData, kFlagEndStream, stream_id);
+          p.trailers_frame = fin;
+          conn->pending.push_back(std::move(p));
+          flush_pending_locked(conn, sock.get());
+        }
+      }
+    }
+    server->EndRequest(latency_us);
+    delete cntl;
+    delete response;
+  });
+  svc->CallMethod(method, cntl, request, response, done);
+}
+
+}  // namespace
+
+void RegisterH2Protocol() {
+  Protocol p;
+  p.parse = h2_parse;
+  p.pack_request = nullptr;  // server-side support (clients use tstd/tpu)
+  p.process_request = h2_process_request;
+  p.process_response = nullptr;
+  p.name = "h2";
+  TB_CHECK(RegisterProtocol(kH2ProtocolIndex, p) == 0)
+      << "h2 protocol slot taken";
+}
+
+}  // namespace trpc

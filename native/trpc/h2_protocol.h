@@ -1,0 +1,17 @@
+// HTTP/2 (h2c, RFC 9113) server-side protocol + gRPC (unary) semantics on
+// top — real gRPC clients (grpc-python/C-core) call brpc_tpu services over
+// cleartext prior-knowledge HTTP/2 on the same multiplexed port as tstd /
+// HTTP/1 / tpu://.
+// Capability parity: reference src/brpc/policy/http2_rpc_protocol.cpp +
+// details/hpack.cpp (HPACK in hpack.{h,cpp} here). Scope: server side,
+// unary gRPC + plain h2 requests; streams multiplex one connection with
+// flow-control bookkeeping on both directions.
+#pragma once
+
+namespace trpc {
+
+inline constexpr int kH2ProtocolIndex = 5;
+
+void RegisterH2Protocol();
+
+}  // namespace trpc

@@ -1,0 +1,267 @@
+#include "trpc/hpack.h"
+
+#include "tbutil/logging.h"
+#include "trpc/hpack_constants.h"
+
+namespace trpc {
+
+namespace {
+
+// ---- Huffman decoding: flat state machine built once from the code
+// table. State = node index in an array of 256-way... too big; use the
+// classic bit-tree: each node has two children, leaves carry the symbol.
+struct HuffNode {
+  int16_t child[2] = {-1, -1};
+  int16_t symbol = -1;  // >= 0 at leaves (256 = EOS)
+};
+
+struct HuffTree {
+  std::vector<HuffNode> nodes;
+
+  HuffTree() {
+    nodes.emplace_back();
+    for (int sym = 0; sym < 257; ++sym) {
+      const uint32_t code = hpack::huffman_code(sym);
+      const uint32_t bits = hpack::huffman_bits(sym);
+      int cur = 0;
+      for (int b = static_cast<int>(bits) - 1; b >= 0; --b) {
+        const int bit = (code >> b) & 1;
+        if (nodes[cur].child[bit] < 0) {
+          nodes[cur].child[bit] = static_cast<int16_t>(nodes.size());
+          nodes.emplace_back();
+        }
+        cur = nodes[cur].child[bit];
+      }
+      nodes[cur].symbol = static_cast<int16_t>(sym);
+    }
+  }
+};
+
+const HuffTree& huff_tree() {
+  static const HuffTree t;
+  return t;
+}
+
+// ---- primitive decoders ----
+
+// RFC 7541 §5.1 integer with an N-bit prefix. Returns bytes consumed from
+// d (>=1), 0 if incomplete, -1 malformed/overflow.
+ssize_t decode_int(const uint8_t* d, size_t n, int prefix_bits,
+                   uint64_t* out) {
+  if (n == 0) return 0;
+  const uint64_t mask = (1u << prefix_bits) - 1;
+  uint64_t v = d[0] & mask;
+  if (v < mask) {
+    *out = v;
+    return 1;
+  }
+  uint64_t m = 0;
+  size_t i = 1;
+  while (true) {
+    if (i >= n) return 0;
+    if (i > 10) return -1;  // > 64-bit varint: hostile
+    const uint8_t b = d[i];
+    v += static_cast<uint64_t>(b & 0x7f) << m;
+    m += 7;
+    ++i;
+    if ((b & 0x80) == 0) break;
+  }
+  *out = v;
+  return static_cast<ssize_t>(i);
+}
+
+// RFC 7541 §5.2 string literal. Same return contract.
+ssize_t decode_string(const uint8_t* d, size_t n, std::string* out) {
+  if (n == 0) return 0;
+  const bool huffman = (d[0] & 0x80) != 0;
+  uint64_t len;
+  const ssize_t hdr = decode_int(d, n, 7, &len);
+  if (hdr <= 0) return hdr;
+  if (len > 64 * 1024) return -1;  // single header field cap
+  if (n < static_cast<size_t>(hdr) + len) return 0;
+  if (huffman) {
+    if (!HuffmanDecode(d + hdr, static_cast<size_t>(len), out)) return -1;
+  } else {
+    out->assign(reinterpret_cast<const char*>(d + hdr),
+                static_cast<size_t>(len));
+  }
+  return hdr + static_cast<ssize_t>(len);
+}
+
+}  // namespace
+
+bool HuffmanDecode(const uint8_t* data, size_t n, std::string* out) {
+  const HuffTree& tree = huff_tree();
+  out->clear();
+  int cur = 0;
+  int depth = 0;  // bits since the last emitted symbol
+  for (size_t i = 0; i < n; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      const int bit = (data[i] >> b) & 1;
+      const int next = tree.nodes[cur].child[bit];
+      if (next < 0) return false;
+      cur = next;
+      ++depth;
+      const int sym = tree.nodes[cur].symbol;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // explicit EOS in stream: error
+        out->push_back(static_cast<char>(sym));
+        cur = 0;
+        depth = 0;
+      }
+    }
+  }
+  // Padding must be the EOS prefix (all 1 bits) and < 8 bits. Any partial
+  // code we're inside must be on the all-ones path — verified by checking
+  // the remaining path is child[1] chains only, which the depth<8 check
+  // plus the walk already guarantees iff every consumed padding bit was 1.
+  // Track instead: padding validity = we only followed 1-bits since the
+  // last symbol. Re-walk is overkill; the RFC check is depth <= 7 and the
+  // bits were all ones — enforce by testing that continuing with 1-bits
+  // reaches EOS.
+  if (depth > 7) return false;
+  int probe = cur;
+  while (probe >= 0 && tree.nodes[probe].symbol < 0) {
+    probe = tree.nodes[probe].child[1];
+  }
+  return probe >= 0 && tree.nodes[probe].symbol == 256;
+}
+
+void HpackDecoder::set_max_dynamic_size(size_t n) {
+  _settings_cap = n;
+  if (_dynamic_cap > _settings_cap) {
+    _dynamic_cap = _settings_cap;
+    evict_to(_dynamic_cap);
+  }
+}
+
+void HpackDecoder::evict_to(size_t cap) {
+  while (_dynamic_size > cap && !_dynamic.empty()) {
+    const auto& [n, v] = _dynamic.back();
+    _dynamic_size -= n.size() + v.size() + 32;
+    _dynamic.pop_back();
+  }
+}
+
+void HpackDecoder::insert_dynamic(const std::string& name,
+                                  const std::string& value) {
+  const size_t entry = name.size() + value.size() + 32;
+  if (entry > _dynamic_cap) {
+    // Larger than the whole table: clears it (RFC 7541 §4.4).
+    evict_to(0);
+    return;
+  }
+  evict_to(_dynamic_cap - entry);
+  _dynamic.emplace_front(name, value);
+  _dynamic_size += entry;
+}
+
+bool HpackDecoder::lookup(uint64_t index, std::string* name,
+                          std::string* value) const {
+  if (index == 0) return false;
+  if (index <= static_cast<uint64_t>(hpack::kStaticTableSize)) {
+    name->assign(hpack::kStaticTable[index].name);
+    value->assign(hpack::kStaticTable[index].value);
+    return true;
+  }
+  const uint64_t dyn = index - hpack::kStaticTableSize - 1;
+  if (dyn >= _dynamic.size()) return false;
+  *name = _dynamic[dyn].first;
+  *value = _dynamic[dyn].second;
+  return true;
+}
+
+bool HpackDecoder::Decode(const uint8_t* d, size_t n, HeaderList* out) {
+  size_t pos = 0;
+  while (pos < n) {
+    const uint8_t b = d[pos];
+    if (b & 0x80) {
+      // Indexed field.
+      uint64_t index;
+      const ssize_t used = decode_int(d + pos, n - pos, 7, &index);
+      if (used <= 0) return false;
+      pos += static_cast<size_t>(used);
+      std::string name, value;
+      if (!lookup(index, &name, &value)) return false;
+      out->emplace_back(std::move(name), std::move(value));
+      continue;
+    }
+    if ((b & 0xe0) == 0x20) {
+      // Dynamic table size update.
+      uint64_t cap;
+      const ssize_t used = decode_int(d + pos, n - pos, 5, &cap);
+      if (used <= 0) return false;
+      pos += static_cast<size_t>(used);
+      if (cap > _settings_cap) return false;
+      _dynamic_cap = static_cast<size_t>(cap);
+      evict_to(_dynamic_cap);
+      continue;
+    }
+    // Literal field: with incremental indexing (01), without (0000), or
+    // never indexed (0001) — same wire shape, different prefix width.
+    const bool incremental = (b & 0xc0) == 0x40;
+    const int prefix = incremental ? 6 : 4;
+    uint64_t name_index;
+    ssize_t used = decode_int(d + pos, n - pos, prefix, &name_index);
+    if (used <= 0) return false;
+    pos += static_cast<size_t>(used);
+    std::string name;
+    if (name_index == 0) {
+      used = decode_string(d + pos, n - pos, &name);
+      if (used <= 0) return false;
+      pos += static_cast<size_t>(used);
+    } else {
+      std::string ignored;
+      if (!lookup(name_index, &name, &ignored)) return false;
+    }
+    std::string value;
+    used = decode_string(d + pos, n - pos, &value);
+    if (used <= 0) return false;
+    pos += static_cast<size_t>(used);
+    if (incremental) insert_dynamic(name, value);
+    out->emplace_back(std::move(name), std::move(value));
+  }
+  return true;
+}
+
+// ---- encoder ----
+
+namespace {
+
+void encode_int(std::string* out, uint64_t v, int prefix_bits,
+                uint8_t first_byte_flags) {
+  const uint64_t mask = (1u << prefix_bits) - 1;
+  if (v < mask) {
+    out->push_back(static_cast<char>(first_byte_flags | v));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | mask));
+  v -= mask;
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+}  // namespace
+
+void HpackEncodeHeader(std::string* out, const std::string& name,
+                       const std::string& value) {
+  // Exact static hit -> one-or-two-byte indexed field.
+  for (int i = 1; i <= hpack::kStaticTableSize; ++i) {
+    if (hpack::kStaticTable[i].name == name &&
+        hpack::kStaticTable[i].value == value) {
+      encode_int(out, static_cast<uint64_t>(i), 7, 0x80);
+      return;
+    }
+  }
+  // Literal without indexing, name + value as plain strings.
+  encode_int(out, 0, 4, 0x00);
+  encode_int(out, name.size(), 7, 0x00);
+  out->append(name);
+  encode_int(out, value.size(), 7, 0x00);
+  out->append(value);
+}
+
+}  // namespace trpc

@@ -1,0 +1,49 @@
+// HPACK (RFC 7541) header compression for the HTTP/2 protocol.
+// Capability parity: reference src/brpc/details/hpack.{h,cpp}. Original
+// implementation over the spec's constant tables (hpack_constants.h):
+// decoder supports every representation (indexed, literal with/without/
+// never indexing, dynamic table size update) plus Huffman-coded strings —
+// real gRPC clients Huffman-encode and index aggressively. The encoder
+// emits indexed fields for exact static-table hits and literal-without-
+// indexing otherwise (no Huffman, no dynamic insertions): always legal,
+// slightly larger, zero encoder state to corrupt.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace trpc {
+
+using HeaderList = std::vector<std::pair<std::string, std::string>>;
+
+class HpackDecoder {
+ public:
+  // Decode one complete header block. False = connection-fatal HPACK error
+  // (RFC 7541 §5.3: the whole connection dies, not just the stream).
+  bool Decode(const uint8_t* data, size_t n, HeaderList* out);
+
+  // SETTINGS_HEADER_TABLE_SIZE from the peer's settings.
+  void set_max_dynamic_size(size_t n);
+
+ private:
+  bool lookup(uint64_t index, std::string* name, std::string* value) const;
+  void insert_dynamic(const std::string& name, const std::string& value);
+  void evict_to(size_t cap);
+
+  std::deque<std::pair<std::string, std::string>> _dynamic;  // newest front
+  size_t _dynamic_size = 0;                                  // RFC size
+  size_t _dynamic_cap = 4096;
+  size_t _settings_cap = 4096;
+};
+
+// Appends one header field (literal without indexing / indexed static hit).
+void HpackEncodeHeader(std::string* out, const std::string& name,
+                       const std::string& value);
+
+// Huffman-decode `n` bytes into *out; false on bad padding/EOS in stream.
+bool HuffmanDecode(const uint8_t* data, size_t n, std::string* out);
+
+}  // namespace trpc

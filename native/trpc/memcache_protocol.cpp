@@ -8,6 +8,7 @@
 #include "trpc/controller.h"
 #include "trpc/errno.h"
 #include "trpc/input_messenger.h"
+#include "trpc/pipelined_protocol.h"
 #include "trpc/protocol.h"
 #include "trpc/socket.h"
 
@@ -18,27 +19,6 @@ namespace {
 constexpr size_t kMaxValueLen = 64u << 20;
 constexpr size_t kMaxLine = 8 * 1024;
 
-// Offset of the CRLF ending the line at `from` (relative), SIZE_MAX when
-// more bytes are needed, SIZE_MAX-1 when none within kMaxLine (malformed).
-size_t find_crlf(const tbutil::IOBuf& buf, size_t from) {
-  char chunk[256];
-  size_t scanned = 0;
-  char carry = 0;
-  while (scanned < kMaxLine) {
-    const size_t want = std::min(sizeof(chunk), kMaxLine - scanned);
-    const size_t got = buf.copy_to(chunk, want, from + scanned);
-    if (got == 0) return SIZE_MAX;
-    if (carry == '\r' && chunk[0] == '\n') return scanned - 1;
-    for (size_t i = 0; i + 1 < got; ++i) {
-      if (chunk[i] == '\r' && chunk[i + 1] == '\n') return scanned + i;
-    }
-    carry = chunk[got - 1];
-    scanned += got;
-    if (got < want) return SIZE_MAX;
-  }
-  return SIZE_MAX - 1;
-}
-
 // One complete text reply starting at `pos`: a single line (STORED /
 // NOT_STORED / DELETED / NOT_FOUND / ERROR... / number), or a get result —
 // zero or more "VALUE <key> <flags> <len>\r\n<data>\r\n" blocks terminated
@@ -46,7 +26,7 @@ size_t find_crlf(const tbutil::IOBuf& buf, size_t from) {
 ssize_t measure_mc_reply(const tbutil::IOBuf& buf, size_t pos) {
   size_t off = 0;
   for (int blocks = 0; blocks < 1024; ++blocks) {
-    const size_t line_rel = find_crlf(buf, pos + off);
+    const size_t line_rel = PipelinedFindCrlf(buf, pos + off, kMaxLine);
     if (line_rel == SIZE_MAX) return 0;
     if (line_rel == SIZE_MAX - 1) return -1;
     char head[16] = {};
@@ -116,38 +96,8 @@ ParseResult mc_parse(tbutil::IOBuf* source, Socket* socket) {
 
 void mc_process_response(InputMessageBase* base) {
   std::unique_ptr<McInputMessage> msg(static_cast<McInputMessage*>(base));
-  SocketUniquePtr s;
-  if (Socket::Address(msg->socket_id, &s) != 0) return;
-  const tbthread::fiber_id_t attempt_id = s->FirstPendingId();
-  if (attempt_id == 0) return;
-  void* data = nullptr;
-  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
-  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
-  if (!acc.AcceptResponseFor(attempt_id)) {
-    tbthread::fiber_id_unlock(attempt_id);
-    return;
-  }
-  tbutil::IOBuf* payload = acc.response_payload();
-  if (payload == nullptr) {
-    tbthread::fiber_id_unlock(attempt_id);
-    return;
-  }
-  payload->append(std::move(msg->bytes));
-  const uint64_t expected = acc.expected_responses();
-  size_t pos = 0;
-  uint64_t complete = 0;
-  while (pos < payload->size()) {
-    const ssize_t used = measure_mc_reply(*payload, pos);
-    if (used <= 0) break;
-    pos += static_cast<size_t>(used);
-    ++complete;
-  }
-  if (complete >= expected) {
-    acc.mark_response_received();
-    acc.EndRPC(0, "");
-    return;
-  }
-  tbthread::fiber_id_unlock(attempt_id);
+  DeliverPipelinedReply(msg->socket_id, std::move(msg->bytes),
+                        measure_mc_reply);
 }
 
 void mc_pack_request(tbutil::IOBuf* out, Controller* /*cntl*/,

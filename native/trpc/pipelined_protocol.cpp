@@ -1,0 +1,68 @@
+#include "trpc/pipelined_protocol.h"
+
+#include <algorithm>
+#include <cstdint>
+
+#include "trpc/controller.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+size_t PipelinedFindCrlf(const tbutil::IOBuf& buf, size_t from,
+                         size_t max_scan) {
+  char chunk[256];
+  size_t scanned = 0;
+  char carry = 0;
+  while (scanned < max_scan) {
+    const size_t want = std::min(sizeof(chunk), max_scan - scanned);
+    const size_t got = buf.copy_to(chunk, want, from + scanned);
+    if (got == 0) return SIZE_MAX;
+    if (carry == '\r' && chunk[0] == '\n') return scanned - 1;
+    for (size_t i = 0; i + 1 < got; ++i) {
+      if (chunk[i] == '\r' && chunk[i + 1] == '\n') return scanned + i;
+    }
+    carry = chunk[got - 1];
+    scanned += got;
+    if (got < want) return SIZE_MAX;
+  }
+  return SIZE_MAX - 1;
+}
+
+void DeliverPipelinedReply(uint64_t socket_id, tbutil::IOBuf&& reply,
+                           MeasureReplyFn measure) {
+  SocketUniquePtr s;
+  if (Socket::Address(socket_id, &s) != 0) return;
+  // Exclusive short connection: the one pending RPC is the match.
+  const tbthread::fiber_id_t attempt_id = s->FirstPendingId();
+  if (attempt_id == 0) return;  // RPC finished (timeout won); drop
+  void* data = nullptr;
+  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
+  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
+  if (!acc.AcceptResponseFor(attempt_id)) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  tbutil::IOBuf* payload = acc.response_payload();
+  if (payload == nullptr) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  payload->append(std::move(reply));
+  const uint64_t expected = acc.expected_responses();
+  size_t pos = 0;
+  uint64_t complete = 0;
+  while (pos < payload->size()) {
+    const ssize_t used = measure(*payload, pos);
+    if (used <= 0) break;
+    pos += static_cast<size_t>(used);
+    ++complete;
+  }
+  if (complete >= expected) {
+    acc.mark_response_received();
+    acc.EndRPC(0, "");  // EndRPC consumed the lock
+    return;
+  }
+  tbthread::fiber_id_unlock(attempt_id);
+}
+
+}  // namespace trpc

@@ -1,0 +1,33 @@
+// Shared machinery for pipelined client protocols whose wire carries no
+// correlation id (redis, memcache): replies ride an exclusive short
+// connection, match commands BY POSITION, and the RPC completes when
+// expected_responses whole replies have accumulated.
+#pragma once
+
+#include <cstddef>
+#include <sys/types.h>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+// Offset (relative to `from`) of the CRLF ending the line starting at
+// `from`, scanning at most `max_scan` bytes via small chunked copies — no
+// flatten. SIZE_MAX when more bytes are needed; SIZE_MAX-1 when no CRLF
+// exists within max_scan (malformed for line-oriented protocols).
+size_t PipelinedFindCrlf(const tbutil::IOBuf& buf, size_t from,
+                         size_t max_scan);
+
+// One complete reply's byte count at `pos` (0 = incomplete, -1 =
+// malformed). Must use only cheap header reads — bulk payloads are counted,
+// not materialized.
+using MeasureReplyFn = ssize_t (*)(const tbutil::IOBuf& buf, size_t pos);
+
+// The exclusive-connection completion sequence: look up the socket's single
+// pending RPC, append `reply` to its response payload, and EndRPC(0) once
+// `expected_responses` whole replies (per `measure`) are buffered. Consumes
+// nothing on stale/finished RPCs.
+void DeliverPipelinedReply(uint64_t socket_id, tbutil::IOBuf&& reply,
+                           MeasureReplyFn measure);
+
+}  // namespace trpc

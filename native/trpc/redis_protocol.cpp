@@ -9,6 +9,7 @@
 #include "trpc/controller.h"
 #include "trpc/errno.h"
 #include "trpc/input_messenger.h"
+#include "trpc/pipelined_protocol.h"
 #include "trpc/protocol.h"
 #include "trpc/socket.h"
 
@@ -100,30 +101,6 @@ ssize_t parse_reply(const char* d, size_t n, RedisReply* out, int depth) {
   }
 }
 
-// Offset of the CRLF terminating the line starting at `from` (relative to
-// `from`), scanning at most `max_scan` bytes in small chunks — no flatten.
-// SIZE_MAX-1 when not found within max_scan (malformed for our purposes),
-// SIZE_MAX when more bytes are needed.
-size_t find_crlf(const tbutil::IOBuf& buf, size_t from, size_t max_scan) {
-  char chunk[256];
-  size_t scanned = 0;
-  char carry = 0;
-  while (scanned < max_scan) {
-    const size_t want =
-        std::min(sizeof(chunk), max_scan - scanned);
-    const size_t got = buf.copy_to(chunk, want, from + scanned);
-    if (got == 0) return SIZE_MAX;
-    if (carry == '\r' && chunk[0] == '\n') return scanned - 1;
-    for (size_t i = 0; i + 1 < got; ++i) {
-      if (chunk[i] == '\r' && chunk[i + 1] == '\n') return scanned + i;
-    }
-    carry = chunk[got - 1];
-    scanned += got;
-    if (got < want) return SIZE_MAX;  // ran out of buffered bytes
-  }
-  return SIZE_MAX - 1;
-}
-
 // Measures one complete reply at offset `pos` using only small header
 // copies — bulk payload bytes are never materialized, so a 100MB GET reply
 // arriving in 64KB reads costs O(n) total, not O(n^2) flattens.
@@ -134,7 +111,7 @@ ssize_t measure_reply(const tbutil::IOBuf& buf, size_t pos, int depth) {
   if (buf.size() < pos + 3) return 0;
   char type;
   if (buf.copy_to(&type, 1, pos) != 1) return 0;
-  const size_t line_rel = find_crlf(buf, pos + 1, 64 * 1024);
+  const size_t line_rel = PipelinedFindCrlf(buf, pos + 1, 64 * 1024);
   if (line_rel == SIZE_MAX) return 0;
   if (line_rel == SIZE_MAX - 1) return -1;
   const size_t line_total = 1 + line_rel + 2;  // type + line + CRLF
@@ -226,41 +203,10 @@ ParseResult redis_parse(tbutil::IOBuf* source, Socket* socket) {
 void redis_process_response(InputMessageBase* base) {
   std::unique_ptr<RedisInputMessage> msg(
       static_cast<RedisInputMessage*>(base));
-  SocketUniquePtr s;
-  if (Socket::Address(msg->socket_id, &s) != 0) return;
-  // Exclusive short connection: the one pending RPC is the match.
-  const tbthread::fiber_id_t attempt_id = s->FirstPendingId();
-  if (attempt_id == 0) return;  // RPC finished (timeout won); drop
-  void* data = nullptr;
-  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) return;
-  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
-  if (!acc.AcceptResponseFor(attempt_id)) {
-    tbthread::fiber_id_unlock(attempt_id);
-    return;
-  }
-  tbutil::IOBuf* payload = acc.response_payload();
-  if (payload == nullptr) {
-    tbthread::fiber_id_unlock(attempt_id);
-    return;
-  }
-  payload->append(std::move(msg->bytes));
-  // Once expected_responses complete replies accumulated, the RPC is done.
-  // Counting measures headers only — never materializes bulk payloads.
-  const uint64_t expected = acc.expected_responses();
-  size_t pos = 0;
-  uint64_t complete = 0;
-  while (pos < payload->size()) {
-    const ssize_t used = measure_reply(*payload, pos, 0);
-    if (used <= 0) break;
-    pos += static_cast<size_t>(used);
-    ++complete;
-  }
-  if (complete >= expected) {
-    acc.mark_response_received();
-    acc.EndRPC(0, "");
-    return;  // EndRPC consumed the lock
-  }
-  tbthread::fiber_id_unlock(attempt_id);
+  DeliverPipelinedReply(msg->socket_id, std::move(msg->bytes),
+                        [](const tbutil::IOBuf& buf, size_t pos) {
+                          return measure_reply(buf, pos, 0);
+                        });
 }
 
 void redis_pack_request(tbutil::IOBuf* out, Controller* cntl,

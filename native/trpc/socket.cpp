@@ -173,6 +173,10 @@ void Socket::OnRecycle() {
   }
   // Last ref: no input fiber or writer can be touching the endpoint.
   delete _ici.exchange(nullptr, std::memory_order_acq_rel);
+  if (void* pd = _protocol_data.exchange(nullptr, std::memory_order_acq_rel)) {
+    if (_protocol_data_dtor != nullptr) _protocol_data_dtor(pd);
+  }
+  _protocol_data_dtor = nullptr;
   _tpu_requested = false;
   _read_buf.clear();
   _messenger = nullptr;

@@ -124,6 +124,18 @@ class Socket : public VersionedRefWithId<Socket> {
   int preferred_protocol() const { return _preferred_protocol; }
   void set_preferred_protocol(int idx) { _preferred_protocol = idx; }
 
+  // Per-connection protocol state (e.g. the HTTP/2 connection context:
+  // HPACK tables, stream map, windows). Owned by the socket: `dtor` runs
+  // at recycle, when no parser or writer can still touch it. Set once,
+  // from the input fiber.
+  void* protocol_data() const {
+    return _protocol_data.load(std::memory_order_acquire);
+  }
+  void set_protocol_data(void* data, void (*dtor)(void*)) {
+    _protocol_data_dtor = dtor;
+    _protocol_data.store(data, std::memory_order_release);
+  }
+
   int fd() const { return _fd.load(std::memory_order_acquire); }
   const tbutil::EndPoint& remote_side() const { return _remote_side; }
   bool server_side() const { return _server_side; }
@@ -159,6 +171,8 @@ class Socket : public VersionedRefWithId<Socket> {
   void ProcessEvent();
 
   std::atomic<int> _fd{-1};
+  std::atomic<void*> _protocol_data{nullptr};
+  void (*_protocol_data_dtor)(void*) = nullptr;
   tbutil::EndPoint _remote_side;
   InputMessenger* _messenger = nullptr;
   std::atomic<ttpu::IciEndpoint*> _ici{nullptr};

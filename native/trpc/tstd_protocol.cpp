@@ -11,6 +11,7 @@
 #include "trpc/builtin_console.h"
 #include "trpc/compress.h"
 #include "trpc/controller.h"
+#include "trpc/h2_protocol.h"
 #include "trpc/http_protocol.h"
 #include "trpc/memcache_protocol.h"
 #include "trpc/redis_protocol.h"
@@ -448,6 +449,7 @@ void GlobalInitializeOrDie() {
     ttpu::ici_internal::RegisterTiciProtocol();  // tpu:// control frames
     RegisterRedisProtocol();
     RegisterMemcacheProtocol();
+    RegisterH2Protocol();
     RegisterBuiltinConsole();
   });
 }
