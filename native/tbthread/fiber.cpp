@@ -10,6 +10,7 @@
 #include "tbthread/butex.h"
 #include "tbthread/context.h"
 #include "tbthread/task_control.h"
+#include "tbthread/tracer.h"
 #include "tbthread/task_group.h"
 #include "tbthread/timer_thread.h"
 #include "tbutil/time.h"
@@ -81,6 +82,9 @@ int start_fiber(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
   uint32_t version = static_cast<uint32_t>(
       m->version_butex->value.load(std::memory_order_relaxed));
   if (tid != nullptr) *tid = make_tid(slot, version);
+  // Tracer registry BEFORE the fiber can run (and thus exit): task_ends
+  // unregisters, and an unregistered-then-registered ghost would leak.
+  tracer_internal::Register(static_cast<uint32_t>(slot));
   c->ready_to_run_general(m);
   (void)urgent;
   return 0;

@@ -153,6 +153,18 @@ void TaskControl::ready_to_run_general(TaskMeta* m, bool signal) {
   }
 }
 
+void TaskControl::collect_running(std::vector<const TaskMeta*>* out) const {
+  out->clear();
+  for (int t = 0; t < kMaxTags; ++t) {
+    TagData* td = _tags[t].load(std::memory_order_acquire);
+    if (td == nullptr) continue;
+    for (TaskGroup* g : td->groups) {
+      const TaskMeta* m = g->cur_meta();
+      if (m != nullptr) out->push_back(m);
+    }
+  }
+}
+
 bool TaskControl::steal_task(TaskMeta** m, TaskGroup* thief, uint64_t* seed) {
   // Stealing never crosses tags: a pinned feeder pool must not pick up (or
   // lose work to) the general pool.

@@ -52,6 +52,9 @@ class TaskControl {
   void signal_task(int num, int tag);
   ParkingLot* parking_lot(int tag);
 
+  // TaskTracer: the metas currently executing on a worker (racy snapshot).
+  void collect_running(std::vector<const TaskMeta*>* out) const;
+
  private:
   // One isolated worker pool. Immortal once published.
   struct TagData {

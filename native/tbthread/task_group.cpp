@@ -3,6 +3,7 @@
 #include "tbthread/butex.h"
 #include "tbthread/context.h"
 #include "tbthread/key.h"
+#include "tbthread/tracer.h"
 #include "tbthread/task_control.h"
 #include "tbutil/fast_rand.h"
 #include "tbutil/logging.h"
@@ -19,10 +20,11 @@ TaskGroup::TaskGroup(TaskControl* control, int tag)
 }
 
 fiber_t TaskGroup::cur_tid() const {
-  if (_cur_meta == nullptr) return INVALID_FIBER;
-  return make_tid(_cur_meta->slot,
+  TaskMeta* m = cur_meta();
+  if (m == nullptr) return INVALID_FIBER;
+  return make_tid(m->slot,
                   static_cast<uint32_t>(
-                      butex_value(_cur_meta->version_butex)
+                      butex_value(m->version_butex)
                           ->load(std::memory_order_relaxed)));
 }
 
@@ -65,10 +67,10 @@ bool TaskGroup::steal_from(TaskMeta** m) {
 }
 
 void TaskGroup::sched_to(TaskMeta* next) {
-  _cur_meta = next;
+  _cur_meta.store(next, std::memory_order_relaxed);
   tb_jump_fcontext(&_main_sp, next->ctx_sp, reinterpret_cast<intptr_t>(this));
   // Back on the scheduler stack: the fiber parked, yielded, or exited.
-  _cur_meta = nullptr;
+  _cur_meta.store(nullptr, std::memory_order_relaxed);
   if (_remained_fn != nullptr) {
     void (*fn)(void*) = _remained_fn;
     _remained_fn = nullptr;
@@ -78,9 +80,9 @@ void TaskGroup::sched_to(TaskMeta* next) {
 
 void TaskGroup::park(void (*remained)(void*), void* arg) {
   TaskGroup* g = tls_task_group;
-  TB_CHECK(g != nullptr && g->_cur_meta != nullptr)
+  TB_CHECK(g != nullptr && g->cur_meta() != nullptr)
       << "park() called off-fiber";
-  TaskMeta* m = g->_cur_meta;
+  TaskMeta* m = g->cur_meta();
   g->_remained_fn = remained;
   g->_remained_arg = arg;
   tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
@@ -90,7 +92,7 @@ void TaskGroup::park(void (*remained)(void*), void* arg) {
 
 void TaskGroup::yield() {
   TaskGroup* g = tls_task_group;
-  if (g == nullptr || g->_cur_meta == nullptr) {
+  if (g == nullptr || g->cur_meta() == nullptr) {
     std::this_thread::yield();
     return;
   }
@@ -99,19 +101,19 @@ void TaskGroup::yield() {
         auto* m = static_cast<TaskMeta*>(mv);
         TaskControl::singleton()->ready_to_run_general(m);
       },
-      g->_cur_meta);
+      g->cur_meta());
 }
 
 void TaskGroup::task_entry(intptr_t group_ptr) {
   auto* g = reinterpret_cast<TaskGroup*>(group_ptr);
-  TaskMeta* m = g->_cur_meta;
+  TaskMeta* m = g->cur_meta();
   m->fn(m->arg);
   exit_current();
 }
 
 void TaskGroup::exit_current() {
   TaskGroup* g = tls_task_group;  // re-fetch: fiber may have migrated
-  TaskMeta* m = g->_cur_meta;
+  TaskMeta* m = g->cur_meta();
   g->_remained_fn = task_ends;
   g->_remained_arg = m;
   tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
@@ -130,6 +132,7 @@ void TaskGroup::task_ends(void* meta) {
   m->stack = nullptr;
   m->fn = nullptr;
   m->arg = nullptr;
+  tracer_internal::Unregister(static_cast<uint32_t>(m->slot));
   butex_increment_and_wake_all(m->version_butex);
   tbutil::return_resource<TaskMeta>(m->slot);
 }

@@ -14,6 +14,8 @@
 #include <deque>
 #include <mutex>
 
+#include <atomic>
+
 #include "tbthread/parking_lot.h"
 #include "tbthread/task_meta.h"
 #include "tbthread/work_stealing_queue.h"
@@ -31,7 +33,10 @@ class TaskGroup {
 
   // The group bound to the calling pthread (nullptr off-worker).
   static TaskGroup* current();
-  TaskMeta* cur_meta() const { return _cur_meta; }
+  // Relaxed: foreign readers (TaskTracer) take a racy snapshot by design.
+  TaskMeta* cur_meta() const {
+    return _cur_meta.load(std::memory_order_relaxed);
+  }
   fiber_t cur_tid() const;
 
   // ---- called from fiber context ----
@@ -64,7 +69,7 @@ class TaskGroup {
 
   TaskControl* _control;
   int _tag = 0;
-  TaskMeta* _cur_meta = nullptr;
+  std::atomic<TaskMeta*> _cur_meta{nullptr};
   void* _main_sp = nullptr;  // scheduler context while a fiber runs
   void (*_remained_fn)(void*) = nullptr;
   void* _remained_arg = nullptr;

@@ -1,0 +1,129 @@
+#include "tbthread/tracer.h"
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <mutex>
+#include <unordered_set>
+
+#include "tbthread/butex.h"
+#include "tbthread/task_control.h"
+#include "tbthread/task_group.h"
+#include "tbutil/resource_pool.h"
+
+namespace tbthread {
+
+namespace {
+
+// Sharded registry of live fiber slots: two tiny critical sections per
+// fiber lifetime, spread over 8 locks so request-rate fiber churn doesn't
+// serialize on one line.
+constexpr int kShards = 8;
+struct Shard {
+  std::mutex mu;
+  std::unordered_set<uint32_t> slots;
+};
+Shard g_shards[kShards];
+
+// Saved-context frame layout (context.S): [sp+0] fp control words,
+// [sp+8] r15 ... [sp+48] rbp, [sp+56] return address.
+constexpr size_t kSavedRbpOffset = 48;
+constexpr size_t kSavedRipOffset = 56;
+
+bool in_stack(const StackContainer* sc, uintptr_t p) {
+  const uintptr_t lo = reinterpret_cast<uintptr_t>(sc->stack_base);
+  return p >= lo && p + 16 <= lo + sc->stack_size && (p & 7) == 0;
+}
+
+void walk_parked(const TaskMeta* m, FiberTrace* out) {
+  const StackContainer* sc = m->stack;
+  void* const sp = m->ctx_sp;
+  if (sc == nullptr || sp == nullptr) return;
+  const uintptr_t spv = reinterpret_cast<uintptr_t>(sp);
+  if (!in_stack(sc, spv) || !in_stack(sc, spv + kSavedRipOffset)) return;
+  out->frames.push_back(
+      *reinterpret_cast<void* const*>(spv + kSavedRipOffset));
+  uintptr_t rbp = *reinterpret_cast<const uintptr_t*>(spv + kSavedRbpOffset);
+  for (int depth = 0; depth < 64 && in_stack(sc, rbp); ++depth) {
+    void* ret = *reinterpret_cast<void* const*>(rbp + 8);
+    if (ret == nullptr) break;
+    out->frames.push_back(ret);
+    const uintptr_t next = *reinterpret_cast<const uintptr_t*>(rbp);
+    if (next <= rbp) break;  // frame pointers must grow upward
+    rbp = next;
+  }
+}
+
+void symbolize(FiberTrace* t) {
+  char buf[256];
+  for (void* f : t->frames) {
+    Dl_info info;
+    if (dladdr(f, &info) != 0 && info.dli_sname != nullptr) {
+      snprintf(buf, sizeof(buf), "%s+0x%zx", info.dli_sname,
+               reinterpret_cast<uintptr_t>(f) -
+                   reinterpret_cast<uintptr_t>(info.dli_saddr));
+    } else if (dladdr(f, &info) != 0 && info.dli_fname != nullptr) {
+      snprintf(buf, sizeof(buf), "%s@%p", info.dli_fname, f);
+    } else {
+      snprintf(buf, sizeof(buf), "%p", f);
+    }
+    t->symbols.emplace_back(buf);
+  }
+}
+
+}  // namespace
+
+namespace tracer_internal {
+
+void Register(uint32_t slot) {
+  Shard& s = g_shards[slot % kShards];
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.slots.insert(slot);
+}
+
+void Unregister(uint32_t slot) {
+  Shard& s = g_shards[slot % kShards];
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.slots.erase(slot);
+}
+
+}  // namespace tracer_internal
+
+size_t fiber_trace_all(std::vector<FiberTrace>* out) {
+  out->clear();
+  // Metas currently executing on a worker: their stacks are live — report
+  // presence, skip the walk.
+  std::vector<const TaskMeta*> running;
+  TaskControl::singleton()->collect_running(&running);
+  auto is_running = [&running](const TaskMeta* m) {
+    for (const TaskMeta* r : running) {
+      if (r == m) return true;
+    }
+    return false;
+  };
+  for (Shard& shard : g_shards) {
+    std::vector<uint32_t> slots;
+    {
+      std::lock_guard<std::mutex> lk(shard.mu);
+      slots.assign(shard.slots.begin(), shard.slots.end());
+    }
+    for (uint32_t slot : slots) {
+      const TaskMeta* m = tbutil::address_resource<TaskMeta>(slot);
+      if (m == nullptr || m->version_butex == nullptr) continue;
+      FiberTrace t;
+      t.tid = make_tid(slot, static_cast<uint32_t>(
+                                 m->version_butex->value.load(
+                                     std::memory_order_acquire)));
+      if (is_running(m)) {
+        t.running = true;
+      } else {
+        walk_parked(m, &t);
+        symbolize(&t);
+      }
+      out->push_back(std::move(t));
+    }
+  }
+  return out->size();
+}
+
+}  // namespace tbthread

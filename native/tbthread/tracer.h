@@ -1,0 +1,38 @@
+// TaskTracer: enumerate live fibers and capture parked fibers' call stacks
+// — "what is every fiber doing right now" for the /fibers console page and
+// stuck-state debugging.
+// Capability parity: reference src/bthread/task_tracer.h (brpc's bthread
+// tracer samples a bthread's stack). Design: a sharded slot registry tracks
+// live fibers; parked fibers are walked over their SAVED frame-pointer
+// chain (the build keeps -fno-omit-frame-pointer) with every dereference
+// bounds-checked against the fiber's own stack — a fiber resuming mid-walk
+// yields a truncated trace, never a fault. Running fibers report frames
+// empty (their stack is live on another core).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tbthread/task_meta.h"
+
+namespace tbthread {
+
+struct FiberTrace {
+  fiber_t tid = INVALID_FIBER;
+  bool running = false;           // on a worker right now: no stack walk
+  std::vector<void*> frames;      // return addresses, innermost first
+  std::vector<std::string> symbols;  // resolved via dladdr (best effort)
+};
+
+// Snapshot every live fiber. Best-effort and non-quiescent: fibers may
+// start/exit during the walk. Returns the number captured.
+size_t fiber_trace_all(std::vector<FiberTrace>* out);
+
+// Registry hooks (fiber.cpp / task_group.cpp internal).
+namespace tracer_internal {
+void Register(uint32_t slot);
+void Unregister(uint32_t slot);
+}  // namespace tracer_internal
+
+}  // namespace tbthread

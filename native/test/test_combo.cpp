@@ -232,6 +232,28 @@ TEST_CASE(partition_channel_fanout) {
   ASSERT_TRUE(p1b.svc._calls.load() > 0);
 }
 
+// ns_filter: rejected nodes never reach the balancer — every call lands on
+// the kept subset (reference NamingServiceFilter).
+TEST_CASE(naming_filter_drops_nodes) {
+  Backend good("good"), bad("bad");
+  const std::string url =
+      "list://" + good.addr + " keep," + bad.addr + " drop";
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 2000;
+  opts.ns_filter = [](const ServerNode& n) { return n.tag == "keep"; };
+  ASSERT_EQ(ch.Init(url.c_str(), "rr", &opts), 0);
+  for (int i = 0; i < 10; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("f");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(resp.to_string().find("[good:") != std::string::npos);
+  }
+  ASSERT_EQ(bad.svc._calls.load(), 0);  // filtered node never called
+}
+
 // DynamicPartitionChannel: a 1-partition scheme and a 2-partition scheme
 // coexist (mid-resharding); every call fans out within exactly one scheme,
 // traffic reaches both, and capacity weighting holds (reference

@@ -17,6 +17,7 @@
 #include "tbthread/key.h"
 #include "tbthread/sync.h"
 #include "tbthread/timer_thread.h"
+#include "tbthread/tracer.h"
 #include "tbutil/time.h"
 
 using namespace tbthread;
@@ -397,6 +398,55 @@ TEST_CASE(fiber_fd_wait_pipe) {
   fiber_join(tid, nullptr);
   close(fds[0]);
   close(fds[1]);
+}
+
+// TaskTracer: parked fibers' stacks resolve down into butex_wait; running/
+// recently-exited fibers never fault the walker.
+TEST_CASE(fiber_tracer_stacks) {
+  Butex* b = butex_create();
+  constexpr int kParked = 3;
+  CountdownEvent entered(kParked);
+  struct Ctx {
+    Butex* b;
+    CountdownEvent* entered;
+  } ctx{b, &entered};
+  std::vector<fiber_t> tids(kParked);
+  for (int i = 0; i < kParked; ++i) {
+    fiber_start_background(
+        &tids[i], nullptr,
+        [](void* p) -> void* {
+          auto* c = static_cast<Ctx*>(p);
+          c->entered->signal();
+          while (c->b->value.load() == 0) {
+            butex_wait(c->b, 0, nullptr);
+          }
+          return nullptr;
+        },
+        &ctx);
+  }
+  entered.wait();
+  usleep(30000);  // let all three actually park
+
+  std::vector<FiberTrace> traces;
+  ASSERT_TRUE(fiber_trace_all(&traces) >= kParked);
+  int parked_in_butex = 0;
+  for (const FiberTrace& t : traces) {
+    for (const std::string& sym : t.symbols) {
+      if (sym.find("butex_wait") != std::string::npos) {
+        ++parked_in_butex;
+        break;
+      }
+    }
+  }
+  ASSERT_TRUE(parked_in_butex >= kParked);
+
+  b->value.store(1);
+  butex_wake_all(b);
+  for (fiber_t t : tids) fiber_join(t, nullptr);
+  butex_destroy(b);
+  // After exit the registry drained those fibers (other tests' fibers may
+  // still live; just confirm tracing still works post-churn).
+  fiber_trace_all(&traces);
 }
 
 // Worker tags: tagged fibers run ONLY on their tag's workers (disjoint from

@@ -6,6 +6,7 @@
 #include <string>
 #include <vector>
 
+#include "tbthread/tracer.h"
 #include "tbutil/time.h"
 #include "tbvar/prometheus.h"
 #include "tbvar/variable.h"
@@ -32,6 +33,7 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/metrics\">/metrics</a> — Prometheus text format</li>"
       "<li><a href=\"/health\">/health</a></li>"
       "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
+      "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
       "</ul></body></html>";
 }
 
@@ -163,6 +165,27 @@ void health_page(const HttpRequest&, HttpResponse* resp) {
   resp->body = "OK\n";
 }
 
+// /fibers: every live fiber with the parked ones' call stacks — the
+// TaskTracer page (reference bthread tracer / /bthreads).
+void fibers_page(const HttpRequest&, HttpResponse* resp) {
+  std::vector<tbthread::FiberTrace> traces;
+  tbthread::fiber_trace_all(&traces);
+  std::string& b = resp->body;
+  b = std::to_string(traces.size()) + " live fiber(s)\n";
+  for (const tbthread::FiberTrace& t : traces) {
+    char line[64];
+    snprintf(line, sizeof(line), "fiber %016llx %s\n",
+             static_cast<unsigned long long>(t.tid),
+             t.running ? "RUNNING" : "parked");
+    b += line;
+    for (const std::string& sym : t.symbols) {
+      b += "    ";
+      b += sym;
+      b += '\n';
+    }
+  }
+}
+
 // /rpcz: recent spans, most recent first; /rpcz?trace=HEX narrows to one
 // trace rendered oldest-first with parent links (reference
 // builtin/rpcz_service.cpp).
@@ -233,6 +256,7 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/metrics", metrics_page);
     RegisterHttpHandler("/health", health_page);
     RegisterHttpHandler("/rpcz", rpcz_page);
+    RegisterHttpHandler("/fibers", fibers_page);
   });
 }
 

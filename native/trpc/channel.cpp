@@ -59,7 +59,22 @@ int Channel::Init(const char* naming_url, const char* lb_name,
     return -1;
   }
   _ns.reset(new NamingServiceThread);
-  if (_ns->Start(naming_url, _lb.get()) != 0) {
+  std::shared_ptr<LoadBalancer> lb = _lb;
+  auto filter = _options.ns_filter;
+  NamingServiceThread::Listener listener =
+      [lb, filter](const std::vector<ServerNode>& servers) {
+        if (filter == nullptr) {
+          lb->ResetServers(servers);
+          return;
+        }
+        std::vector<ServerNode> kept;
+        kept.reserve(servers.size());
+        for (const ServerNode& s : servers) {
+          if (filter(s)) kept.push_back(s);
+        }
+        lb->ResetServers(kept);
+      };
+  if (_ns->Start(naming_url, std::move(listener)) != 0) {
     TB_LOG(ERROR) << "naming service failed for " << naming_url;
     _ns.reset();
     _lb.reset();

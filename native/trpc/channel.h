@@ -5,6 +5,7 @@
 // async).
 #pragma once
 
+#include <functional>
 #include <memory>
 #include <string>
 
@@ -34,6 +35,10 @@ struct ChannelOptions {
   // Upgrade connections to the tpu:// ICI transport (ttpu/ici_endpoint.h).
   // Set automatically when Init is given a "tpu://host:port" address.
   bool tpu_transport = false;
+  // Naming filter (reference NamingServiceFilter, naming_service_filter.h):
+  // nodes the filter rejects never reach the balancer — e.g. keep only
+  // same-zone replicas or a tag-matched subset. nullptr = keep all.
+  std::function<bool(const ServerNode&)> ns_filter;
 };
 
 class Channel {

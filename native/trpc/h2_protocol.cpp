@@ -23,6 +23,7 @@ namespace {
 constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 constexpr size_t kPrefaceLen = 24;
 constexpr size_t kFrameHeader = 9;
+constexpr size_t kMaxH2Body = 256u << 20;  // per-request inbound cap
 
 enum FrameType : uint8_t {
   kData = 0,
@@ -341,8 +342,17 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
             return r;
           }
         }
+        if (conn->streams.size() >= 1024 &&
+            conn->streams.find(stream_id) == conn->streams.end()) {
+          r.error = PARSE_ERROR_ABSOLUTELY_WRONG;  // stream-flood guard
+          return r;
+        }
         H2Stream& st = conn->streams[stream_id];
         st.header_block.append(payload, off, frag_len - off);
+        if (st.header_block.size() > 1u << 20) {
+          r.error = PARSE_ERROR_ABSOLUTELY_WRONG;  // header bomb
+          return r;
+        }
         if (type == kHeaders && (flags & kFlagEndStream)) {
           st.end_stream = true;
         }
@@ -398,12 +408,31 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
           data_len -= pad;
         }
         it->second.body.append(payload.data() + off, data_len - off);
+        if (it->second.body.size() > kMaxH2Body) {
+          r.error = PARSE_ERROR_ABSOLUTELY_WRONG;  // body bomb: the
+          // unconditional window refund above means flow control never
+          // applies backpressure, so the cap is the defense.
+          return r;
+        }
         if (flags & kFlagEndStream) it->second.end_stream = true;
         break;
       }
-      case kRstStream:
+      case kRstStream: {
         conn->streams.erase(stream_id);
+        // A cancelled stream's queued response must leave the FIFO flush
+        // queue: its window will never be replenished, and a blocked front
+        // entry would wedge every later response on the connection.
+        std::lock_guard<std::mutex> lk(conn->write_mu);
+        conn->stream_send_window.erase(stream_id);
+        for (auto it = conn->pending.begin(); it != conn->pending.end();) {
+          if (it->stream_id == stream_id) {
+            it = conn->pending.erase(it);
+          } else {
+            ++it;
+          }
+        }
         break;
+      }
       case kPriority:
       case kGoaway:
       case kPushPromise:
@@ -419,6 +448,9 @@ void send_h2_error(Socket* s, H2Connection* conn, uint32_t stream_id,
                    bool grpc, int http_status, int grpc_status,
                    const std::string& message) {
   std::lock_guard<std::mutex> lk(conn->write_mu);
+  // Error responses bypass the Pending queue, so drop the window entry
+  // here (the success path drops it in flush_pending_locked).
+  conn->stream_send_window.erase(stream_id);
   HeaderList h;
   if (grpc) {
     h.emplace_back(":status", "200");
@@ -486,7 +518,7 @@ void h2_process_request(InputMessageBase* base) {
     const uint32_t mlen = (uint32_t(prefix[1]) << 24) |
                           (uint32_t(prefix[2]) << 16) |
                           (uint32_t(prefix[3]) << 8) | prefix[4];
-    if (request.size() < 5u + mlen) {
+    if (request.size() - 5 < mlen) {  // size>=5 checked above; size_t math
       send_h2_error(s.get(), conn, stream_id, grpc, 400, 13,
                     "grpc frame length mismatch");
       return;
