@@ -72,7 +72,33 @@ inline int run_all(int argc, char** argv) {
     }                                                                    \
   } while (0)
 
+// _Exit, not return: the runtime's detached threads (fiber workers, timer,
+// health probers, fd-wait service) run for the process lifetime by design —
+// the same contract as the reference's bthread workers. Returning from main
+// races them against __run_exit_handlers' static destruction (observed as a
+// glibc tpp_change_priority abort on a destroyed mutex, ~1/3 full-suite
+// runs under pytest). Tests assert while running; exit skips teardown —
+// but the ASan build's leak check is atexit-registered, so run it
+// explicitly first or _Exit would silently disable leak coverage.
+#ifdef __SANITIZE_ADDRESS__
+#define MINI_TEST_HAS_ASAN 1
+#elif defined(__has_feature)
+#if __has_feature(address_sanitizer)
+#define MINI_TEST_HAS_ASAN 1
+#endif
+#endif
+#ifdef MINI_TEST_HAS_ASAN
+#include <sanitizer/lsan_interface.h>
+#define MINI_TEST_LEAK_CHECK() __lsan_do_leak_check()
+#else
+#define MINI_TEST_LEAK_CHECK() ((void)0)
+#endif
+
 #define TEST_MAIN                                   \
   int main(int argc, char** argv) {                 \
-    return mini_test::run_all(argc, argv);          \
+    const int rc = mini_test::run_all(argc, argv);  \
+    MINI_TEST_LEAK_CHECK();                         \
+    fflush(stdout);                                 \
+    fflush(stderr);                                 \
+    std::_Exit(rc);                                 \
   }
