@@ -15,6 +15,7 @@
 #include "trpc/rpc_metrics.h"
 #include "trpc/server.h"
 #include "trpc/socket.h"
+#include "trpc/span.h"
 
 namespace trpc {
 
@@ -533,21 +534,36 @@ void h2_process_request(InputMessageBase* base) {
                   "server concurrency limit reached");
     return;
   }
-  MethodStatus* ms = GetMethodStatus(service_name + "/" + method);
+  const std::string full_method = service_name + "/" + method;
+  MethodStatus* ms = GetMethodStatus(full_method);
   ms->OnRequested();
   const int64_t received_us = tbutil::gettimeofday_us();
+  // rpcz: gRPC/h2 inbound carries no tstd trace fields — self-sample a
+  // root span, same policy as the other server protocols.
+  uint64_t span_id = 0, span_trace = 0;
+  if (rpcz_enabled()) {
+    span_id = new_trace_or_span_id();
+    span_trace = new_trace_or_span_id();
+  }
+  // Untraced requests carry an empty string into the closure, not a copy.
+  const std::string span_method = span_id != 0 ? full_method : std::string();
 
   auto* cntl = new Controller;
   auto* response = new tbutil::IOBuf;
   ControllerPrivateAccessor acc(cntl);
   acc.set_server_side(s->remote_side(), 0);
   acc.set_server_socket(msg->socket_id);
+  if (span_id != 0) acc.set_trace(span_trace, span_id, 0);
+  const tbutil::EndPoint span_remote = s->remote_side();
   const SocketId sid = msg->socket_id;
   Closure* done = NewCallback([sid, stream_id, cntl, response, server, ms,
-                               received_us, grpc]() {
+                               received_us, grpc, span_id, span_trace,
+                               span_method, span_remote]() {
     const int64_t latency_us =
         std::max<int64_t>(0, tbutil::gettimeofday_us() - received_us);
     ms->OnResponded(cntl->ErrorCode(), latency_us);
+    RecordServerSpan(span_trace, span_id, 0, received_us, latency_us,
+                     cntl->ErrorCode(), span_method, span_remote);
     SocketUniquePtr sock;
     if (Socket::Address(sid, &sock) == 0) {
       auto* conn = static_cast<H2Connection*>(sock->protocol_data());
@@ -607,6 +623,7 @@ void h2_process_request(InputMessageBase* base) {
     delete cntl;
     delete response;
   });
+  ScopedTraceContext trace_scope(span_trace, span_id);
   svc->CallMethod(method, cntl, request, response, done);
 }
 

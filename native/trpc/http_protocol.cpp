@@ -15,6 +15,7 @@
 #include "trpc/rpc_metrics.h"
 #include "trpc/server.h"
 #include "trpc/socket.h"
+#include "trpc/span.h"
 
 namespace trpc {
 
@@ -526,22 +527,37 @@ void http_process_request(InputMessageBase* base) {
     fail(TRPC_ELIMIT, "server concurrency limit reached");
     return;
   }
-  MethodStatus* ms = GetMethodStatus(service_name + "/" + method);
+  const std::string full_method = service_name + "/" + method;
+  MethodStatus* ms = GetMethodStatus(full_method);
   ms->OnRequested();
   const int64_t received_us = tbutil::gettimeofday_us();
+  // rpcz: HTTP carries no inbound trace fields — self-sample a root span
+  // (same policy as tstd's untraced-inbound case).
+  uint64_t span_id = 0, span_trace = 0;
+  if (rpcz_enabled()) {
+    span_id = new_trace_or_span_id();
+    span_trace = new_trace_or_span_id();
+  }
+  // Untraced requests carry an empty string into the closure, not a copy.
+  const std::string span_method = span_id != 0 ? full_method : std::string();
+  const tbutil::EndPoint span_remote = s->remote_side();
 
   auto* cntl = new Controller;
   auto* response = new tbutil::IOBuf;
   ControllerPrivateAccessor acc(cntl);
   acc.set_server_side(s->remote_side(), 0);
   acc.set_server_socket(sid);
+  if (span_id != 0) acc.set_trace(span_trace, span_id, 0);
   Closure* done = NewCallback(
-      [sid, cntl, response, server, ms, received_us, keep_alive, is_head]() {
+      [sid, cntl, response, server, ms, received_us, keep_alive, is_head,
+       span_id, span_trace, span_method, span_remote]() {
         // Clamped: a backward wall-clock step must not read as the shed
         // sentinel in EndRequest (would leak a limiter slot).
         const int64_t latency_us =
             std::max<int64_t>(0, tbutil::gettimeofday_us() - received_us);
         ms->OnResponded(cntl->ErrorCode(), latency_us);
+        RecordServerSpan(span_trace, span_id, 0, received_us, latency_us,
+                         cntl->ErrorCode(), span_method, span_remote);
         HttpResponse resp;
         resp.status = http_status_for_error(cntl->ErrorCode());
         if (cntl->Failed()) {
@@ -562,16 +578,15 @@ void http_process_request(InputMessageBase* base) {
   // rpc_dump sampling — both protocols feed one dump file, like the
   // interceptor below guards both.
   if (RpcDumper* d = server->dumper()) {
-    d->MaybeSample(service_name + "/" + method, request,
-                   cntl->request_attachment());
+    d->MaybeSample(full_method, request, cntl->request_attachment());
   }
   // Pre-dispatch interception: the same auth/quota gate as the tstd path —
   // a service reachable on two protocols must not have a one-protocol
   // guard (server.h Interceptor).
   if (Interceptor* icept = server->interceptor()) {
     std::string reject_text;
-    const int rc = icept->OnRequest(cntl, service_name + "/" + method,
-                                    request, &reject_text);
+    const int rc =
+        icept->OnRequest(cntl, full_method, request, &reject_text);
     if (rc != 0) {
       cntl->SetFailed(rc, reject_text.empty() ? "rejected by interceptor"
                                               : reject_text);
@@ -579,6 +594,8 @@ void http_process_request(InputMessageBase* base) {
       return;
     }
   }
+  // Nested client calls from the handler link under this span.
+  ScopedTraceContext trace_scope(span_trace, span_id);
   svc->CallMethod(method, cntl, request, response, done);
 }
 

@@ -71,6 +71,25 @@ SpanStore& SpanStore::global() {
   return *s;
 }
 
+void RecordServerSpan(uint64_t trace_id, uint64_t span_id,
+                      uint64_t parent_span_id, int64_t start_us,
+                      int64_t latency_us, int error_code,
+                      const std::string& service_method,
+                      const tbutil::EndPoint& remote) {
+  if (span_id == 0) return;
+  Span sp;
+  sp.trace_id = trace_id;
+  sp.span_id = span_id;
+  sp.parent_span_id = parent_span_id;
+  sp.server_side = true;
+  sp.start_us = start_us;
+  sp.end_us = start_us + latency_us;
+  sp.error_code = error_code;
+  sp.service_method = service_method;
+  sp.remote_side = remote;
+  SpanStore::global().Record(std::move(sp));
+}
+
 // ---------------- fiber-local context ----------------
 
 namespace {

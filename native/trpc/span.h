@@ -61,4 +61,30 @@ void clear_current_trace_context();
 // Non-zero random id (fast_rand based).
 uint64_t new_trace_or_span_id();
 
+// One server leg, shared by every server protocol (tstd/HTTP/h2): no-op
+// when span_id == 0.
+void RecordServerSpan(uint64_t trace_id, uint64_t span_id,
+                      uint64_t parent_span_id, int64_t start_us,
+                      int64_t latency_us, int error_code,
+                      const std::string& service_method,
+                      const tbutil::EndPoint& remote);
+
+// RAII fiber trace context for the synchronous part of a traced handler;
+// no-op when span_id == 0.
+class ScopedTraceContext {
+ public:
+  ScopedTraceContext(uint64_t trace_id, uint64_t span_id)
+      : _active(span_id != 0) {
+    if (_active) set_current_trace_context({trace_id, span_id});
+  }
+  ~ScopedTraceContext() {
+    if (_active) clear_current_trace_context();
+  }
+  ScopedTraceContext(const ScopedTraceContext&) = delete;
+  ScopedTraceContext& operator=(const ScopedTraceContext&) = delete;
+
+ private:
+  bool _active;
+};
+
 }  // namespace trpc

@@ -352,19 +352,9 @@ void tstd_process_request(InputMessageBase* base) {
         if (ms != nullptr) {
           ms->OnResponded(cntl->ErrorCode(), latency_us);
         }
-        if (server_span_id != 0) {
-          Span sp;
-          sp.trace_id = span_trace_id;
-          sp.span_id = server_span_id;
-          sp.parent_span_id = span_parent;
-          sp.server_side = true;
-          sp.start_us = received_us;
-          sp.end_us = received_us + latency_us;
-          sp.error_code = cntl->ErrorCode();
-          sp.service_method = span_method;
-          sp.remote_side = span_remote;
-          SpanStore::global().Record(std::move(sp));
-        }
+        RecordServerSpan(span_trace_id, server_span_id, span_parent,
+                         received_us, latency_us, cntl->ErrorCode(),
+                         span_method, span_remote);
         tstd_send_response(sid, cid, cntl, response);
         server->EndRequest(latency_us);
         delete cntl;
@@ -413,16 +403,11 @@ void tstd_process_request(InputMessageBase* base) {
       return;
     }
   }
-  if (server_span_id != 0) {
-    // The context lives for the synchronous part of the handler — where
-    // nested client calls are issued. (An async handler that parks `done`
-    // on another fiber makes nested calls untraced, same as the reference's
-    // bthread-local scope.)
-    set_current_trace_context({span_trace_id, server_span_id});
-    svc->CallMethod(method, cntl, request, response, done);
-    clear_current_trace_context();
-    return;
-  }
+  // The context lives for the synchronous part of the handler — where
+  // nested client calls are issued. (An async handler that parks `done` on
+  // another fiber makes nested calls untraced, same as the reference's
+  // bthread-local scope.)
+  ScopedTraceContext trace_scope(span_trace_id, server_span_id);
   svc->CallMethod(method, cntl, request, response, done);
 }
 
