@@ -3,12 +3,23 @@
 // via subprocess (tests/test_native.py) so `pytest tests/` covers native too.
 #pragma once
 
+#include <dirent.h>
+#include <execinfo.h>
+#include <signal.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <string>
+#include <thread>
+#include <type_traits>
 #include <vector>
+
+#include "tbthread/tracer.h"
 
 namespace mini_test {
 
@@ -28,14 +39,119 @@ struct Registrar {
   }
 };
 
+// Failed-assert diagnostics: print integral operands (error codes, sizes);
+// other types stay silent rather than requiring streamability.
+template <typename T>
+inline void print_value(const char* tag, const T& v) {
+  if constexpr (std::is_integral_v<T> && std::is_signed_v<T>) {
+    fprintf(stderr, "%s%lld", tag, static_cast<long long>(v));
+  } else if constexpr (std::is_integral_v<T>) {
+    fprintf(stderr, "%s%llu", tag, static_cast<unsigned long long>(v));
+  }
+}
+
+// ---- hang forensics (no debugger in the image) ----
+// MINI_TEST_WATCHDOG_SEC=N: a monitor thread aborts any single test that
+// runs longer than N seconds — after dumping (a) every parked fiber's stack
+// via the TaskTracer and (b) every pthread's stack via SIGUSR2 + backtrace.
+// Raw addresses resolve offline with addr2line -e <binary>.
+
+inline std::atomic<int64_t>& watchdog_epoch() {
+  static std::atomic<int64_t> e{0};
+  return e;
+}
+// Optional per-test diagnostic hook: runs before the stack dumps so a test
+// can print subsystem internals (stream windows, transport credits, ...).
+inline std::atomic<void (*)()>& watchdog_hook() {
+  static std::atomic<void (*)()> h{nullptr};
+  return h;
+}
+inline std::atomic<const char*>& watchdog_test_name() {
+  static std::atomic<const char*> n{nullptr};
+  return n;
+}
+
+inline void watchdog_thread_dump_handler(int) {
+  void* frames[64];
+  const int n = backtrace(frames, 64);
+  dprintf(2, "--- pthread %ld stack ---\n",
+          static_cast<long>(syscall(SYS_gettid)));
+  backtrace_symbols_fd(frames, n, 2);
+}
+
+inline void watchdog_dump_all() {
+  // Parked fibers first (the interesting ones in a hang).
+  std::vector<tbthread::FiberTrace> traces;
+  tbthread::fiber_trace_all(&traces);
+  for (const auto& t : traces) {
+    dprintf(2, "--- fiber %llu %s ---\n",
+            static_cast<unsigned long long>(t.tid),
+            t.running ? "(running)" : "(parked)");
+    for (size_t i = 0; i < t.frames.size(); ++i) {
+      dprintf(2, "  %p %s\n", t.frames[i],
+              i < t.symbols.size() ? t.symbols[i].c_str() : "");
+    }
+  }
+  // Then every pthread, via signal-delivered backtraces.
+  struct sigaction sa{};
+  sa.sa_handler = watchdog_thread_dump_handler;
+  sigaction(SIGUSR2, &sa, nullptr);
+  const long self = static_cast<long>(syscall(SYS_gettid));
+  if (DIR* d = opendir("/proc/self/task")) {
+    while (dirent* e = readdir(d)) {
+      const long tid = atol(e->d_name);
+      if (tid <= 0 || tid == self) continue;
+      syscall(SYS_tgkill, getpid(), tid, SIGUSR2);
+      usleep(20000);  // serialize the dumps a bit
+    }
+    closedir(d);
+  }
+  usleep(200000);
+}
+
+inline void start_watchdog(int64_t limit_sec) {
+  std::thread([limit_sec] {
+    int64_t seen = watchdog_epoch().load();
+    int64_t elapsed = 0;
+    while (true) {
+      sleep(1);
+      const int64_t now = watchdog_epoch().load();
+      if (now != seen) {
+        seen = now;
+        elapsed = 0;
+        continue;
+      }
+      if (watchdog_test_name().load() == nullptr) continue;  // idle
+      if (++elapsed >= limit_sec) {
+        const char* name = watchdog_test_name().load();
+        dprintf(2, "\nWATCHDOG: test %s exceeded %lld s — dumping stacks\n",
+                name != nullptr ? name : "?",
+                static_cast<long long>(limit_sec));
+        if (auto* hook = watchdog_hook().load()) hook();
+        watchdog_dump_all();
+        fflush(nullptr);
+        abort();
+      }
+    }
+  }).detach();
+}
+
 inline int run_all(int argc, char** argv) {
   const char* filter = argc > 1 ? argv[1] : nullptr;
+  if (const char* wd = getenv("MINI_TEST_WATCHDOG_SEC")) {
+    const long sec = atol(wd);
+    if (sec > 0) start_watchdog(sec);
+  }
   int ran = 0;
   for (auto& c : cases()) {
     if (filter && strstr(c.name, filter) == nullptr) continue;
     printf("[ RUN  ] %s\n", c.name);
     fflush(stdout);
+    watchdog_test_name().store(c.name);
+    watchdog_epoch().fetch_add(1);
     c.fn();
+    watchdog_test_name().store(nullptr);
+    watchdog_epoch().fetch_add(1);
     printf("[  OK  ] %s\n", c.name);
     ++ran;
   }
@@ -66,8 +182,11 @@ inline int run_all(int argc, char** argv) {
     auto va = (a);                                                       \
     auto vb = (b);                                                       \
     if (!(va == vb)) {                                                   \
-      fprintf(stderr, "%s:%d: ASSERT_EQ(%s, %s) failed\n", __FILE__,     \
+      fprintf(stderr, "%s:%d: ASSERT_EQ(%s, %s) failed", __FILE__,       \
               __LINE__, #a, #b);                                         \
+      mini_test::print_value(" lhs=", va);                               \
+      mini_test::print_value(" rhs=", vb);                               \
+      fprintf(stderr, "\n");                                             \
       abort();                                                           \
     }                                                                    \
   } while (0)

@@ -18,6 +18,7 @@
 #include "trpc/flags.h"
 #include "trpc/server.h"
 #include "trpc/stream.h"
+#include "trpc/stream_internal.h"
 #include "tbthread/fiber.h"
 #include "trpc/socket_map.h"
 #include "ttpu/ici_endpoint.h"
@@ -25,6 +26,16 @@
 using namespace trpc;
 
 namespace {
+
+// Flake forensics: transport + stream flow-control state, printed by the
+// harness watchdog on a hang and by the tests on an unexpected RPC error.
+void dump_transport_state() {
+  fputs(stream_internal::DebugDump().c_str(), stderr);
+  fputs(ttpu::DebugDumpEndpoints().c_str(), stderr);
+}
+struct HookInit {
+  HookInit() { mini_test::watchdog_hook().store(&dump_transport_state); }
+} g_hook_init;
 
 // Echo handler that also reports whether the request arrived as zero-copy
 // segment-backed blocks (user-data meta = block_idx + 1) or heap bytes.
@@ -169,7 +180,12 @@ TEST_CASE(tpu_many_small_messages) {
     const size_t n = (i % 5 == 0) ? (256 << 10) : 64;
     const std::string payload = pattern_payload(n, char('a' + i % 26));
     std::string out;
-    ASSERT_EQ(echo_once(&env.channel, payload, &out), 0);
+    const int rc = echo_once(&env.channel, payload, &out);
+    if (rc != 0) {
+      fprintf(stderr, "iter %d payload=%zu rc=%d\n", i, n, rc);
+      dump_transport_state();
+    }
+    ASSERT_EQ(rc, 0);
     ASSERT_TRUE(out == payload);
   }
 }

@@ -45,6 +45,8 @@ void Controller::Reset() {
   _request_code = 0;
   _has_request_code = false;
   _expected_responses = 1;
+  _measured_prefix = 0;
+  _measured_count = 0;
   _attempt_begin_us = 0;
   _response_received = false;
   _live.clear();

@@ -126,6 +126,11 @@ class Controller {
   uint64_t _request_code = 0;
   bool _has_request_code = false;
   uint64_t _expected_responses = 1;  // multi-reply protocols override
+  // Pipelined-reply measuring resumes here: byte offset + count of the
+  // already-measured complete-reply prefix of the response payload
+  // (ADVICE r3: re-measuring from 0 per delivery was O(N^2)).
+  size_t _measured_prefix = 0;
+  uint64_t _measured_count = 0;
   int64_t _attempt_begin_us = 0;           // start of the CURRENT attempt
   bool _response_received = false;         // any server response arrived
   // In-flight attempts. Exactly one normally; a backup (hedged) request adds
@@ -203,6 +208,8 @@ class ControllerPrivateAccessor {
   // this RPC. Dedicated field — request_code is the user's LB routing key.
   void set_expected_responses(uint64_t n) { _c->_expected_responses = n; }
   uint64_t expected_responses() const { return _c->_expected_responses; }
+  size_t* measured_prefix() { return &_c->_measured_prefix; }
+  uint64_t* measured_count() { return &_c->_measured_count; }
 
   // Streaming handshake plumbing.
   void set_request_stream(uint64_t id) { _c->_request_stream = id; }

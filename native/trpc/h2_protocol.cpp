@@ -272,13 +272,21 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
                                  (uint32_t(uint8_t(payload[off + 4])) << 8) |
                                  uint8_t(payload[off + 5]);
           if (id == 1) {
-            conn->decoder.set_max_dynamic_size(value);
+            // SETTINGS_HEADER_TABLE_SIZE constrains the peer-facing ENCODER
+            // (RFC 7541 §4.2 / RFC 9113 §6.5.2); our DECODER's cap is the
+            // size WE advertised (4096). Our encoder is stateless (never
+            // indexes into the dynamic table), so the peer's value needs no
+            // tracking at all — applying it to the decoder would evict
+            // entries the peer still indexes against. (ADVICE r3.)
           } else if (id == 4) {
             std::lock_guard<std::mutex> lk(conn->write_mu);
             const int64_t delta =
                 int64_t(value) - conn->peer_initial_window;
             conn->peer_initial_window = value;
             for (auto& [sid, w] : conn->stream_send_window) w += delta;
+            // A grown window can unblock queued response bodies now, not at
+            // the next unrelated WINDOW_UPDATE (RFC 9113 §6.9.2).
+            if (delta > 0) flush_pending_locked(conn, socket);
           } else if (id == 5) {
             if (value >= 16384) {
               // write_mu: flush_pending_locked reads this from done fibers.

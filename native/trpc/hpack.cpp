@@ -127,14 +127,6 @@ bool HuffmanDecode(const uint8_t* data, size_t n, std::string* out) {
   return probe >= 0 && tree.nodes[probe].symbol == 256;
 }
 
-void HpackDecoder::set_max_dynamic_size(size_t n) {
-  _settings_cap = n;
-  if (_dynamic_cap > _settings_cap) {
-    _dynamic_cap = _settings_cap;
-    evict_to(_dynamic_cap);
-  }
-}
-
 void HpackDecoder::evict_to(size_t cap) {
   while (_dynamic_size > cap && !_dynamic.empty()) {
     const auto& [n, v] = _dynamic.back();

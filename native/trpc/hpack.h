@@ -25,9 +25,6 @@ class HpackDecoder {
   // (RFC 7541 §5.3: the whole connection dies, not just the stream).
   bool Decode(const uint8_t* data, size_t n, HeaderList* out);
 
-  // SETTINGS_HEADER_TABLE_SIZE from the peer's settings.
-  void set_max_dynamic_size(size_t n);
-
  private:
   bool lookup(uint64_t index, std::string* name, std::string* value) const;
   void insert_dynamic(const std::string& name, const std::string& value);

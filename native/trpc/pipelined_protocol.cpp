@@ -49,14 +49,18 @@ void DeliverPipelinedReply(uint64_t socket_id, tbutil::IOBuf&& reply,
   }
   payload->append(std::move(reply));
   const uint64_t expected = acc.expected_responses();
-  size_t pos = 0;
-  uint64_t complete = 0;
+  // Resume from the measured-complete prefix of earlier deliveries; only
+  // the new tail gets scanned.
+  size_t pos = *acc.measured_prefix();
+  uint64_t complete = *acc.measured_count();
   while (pos < payload->size()) {
     const ssize_t used = measure(*payload, pos);
     if (used <= 0) break;
     pos += static_cast<size_t>(used);
     ++complete;
   }
+  *acc.measured_prefix() = pos;
+  *acc.measured_count() = complete;
   if (complete >= expected) {
     acc.mark_response_received();
     acc.EndRPC(0, "");  // EndRPC consumed the lock

@@ -237,6 +237,10 @@ int consume_incoming(tbthread::ExecutionQueue<tbutil::IOBuf>::Iterator& iter,
                             4, raw->peer_id.load(std::memory_order_acquire),
                             static_cast<uint64_t>(consumed), nullptr)) {
         raw->last_feedback.store(consumed, std::memory_order_release);
+      } else {
+        TB_LOG(WARNING) << "stream " << raw->id
+                        << ": consumption feedback send failed (consumed="
+                        << consumed << ")";
       }
     }
     for (size_t i = 0; i < n; ++i) bufs[i].clear();
@@ -462,6 +466,29 @@ void OnSocketFailed(uint64_t stream_id, int error) {
 int64_t AdvertisedWindow(StreamId id) {
   StreamPtr s = find_stream(id);
   return s != nullptr ? s->options.max_buf_size : 0;
+}
+
+std::string DebugDump() {
+  Registry& r = registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  std::string out;
+  char line[256];
+  for (const auto& [id, s] : r.map) {
+    snprintf(line, sizeof(line),
+             "stream %llu peer=%llu sock=%llu connected=%d closed=%d "
+             "window=%lld sent=%lld acked=%lld consumed=%lld feedback=%lld\n",
+             static_cast<unsigned long long>(id),
+             static_cast<unsigned long long>(s->peer_id.load()),
+             static_cast<unsigned long long>(s->socket_id.load()),
+             int(s->connected.load()), int(s->closed.load()),
+             static_cast<long long>(s->remote_window.load()),
+             static_cast<long long>(s->sent.load()),
+             static_cast<long long>(s->acked.load()),
+             static_cast<long long>(s->consumed.load()),
+             static_cast<long long>(s->last_feedback.load()));
+    out += line;
+  }
+  return out;
 }
 
 }  // namespace stream_internal

@@ -27,5 +27,9 @@ void OnSocketFailed(uint64_t stream_id, int error);
 // The advertised receive window of a local stream (pack_request).
 int64_t AdvertisedWindow(StreamId id);
 
+// Diagnostic snapshot of every live stream's flow-control state (hang
+// forensics + the /streams console page).
+std::string DebugDump();
+
 }  // namespace stream_internal
 }  // namespace trpc

@@ -173,6 +173,20 @@ int IciEndpoint::CompleteClient(const std::string& peer_name,
   return 0;
 }
 
+std::string IciEndpoint::DebugString() const {
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "ici sock=%llu active=%d free_tx=%u pending_ctrl=%zu outbox=%d "
+           "mid_msg=%d starved=%d rx_new=%zu rx_done=%zu",
+           static_cast<unsigned long long>(_socket_id), int(active()),
+           _tx != nullptr ? _tx->free_blocks() : 0, _pending_ctrl.size(),
+           int(_outbox_nonempty.load(std::memory_order_acquire)),
+           int(_tx_mid_message),
+           int(_credit_starved.load(std::memory_order_acquire)),
+           _rx_new.size(), _rx_done.size());
+  return buf;
+}
+
 void IciEndpoint::OnSocketFailed() {
   tbthread::butex_increment_and_wake_all(_hs_btx);
   tbthread::butex_increment_and_wake_all(_credit_btx);

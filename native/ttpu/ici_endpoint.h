@@ -103,6 +103,10 @@ class IciEndpoint {
   IciSegment* tx() const { return _tx.get(); }
   IciSegment* rx() const { return _rx.get(); }
 
+  // Racy-but-safe-enough state snapshot for diagnostics (quiescent in the
+  // hang states it exists to debug).
+  std::string DebugString() const;
+
  private:
   explicit IciEndpoint(trpc::Socket* s);
   void CompactRxNew();

@@ -10,6 +10,7 @@
 #include <map>
 
 #include "tbutil/logging.h"
+#include "trpc/socket.h"
 #include "ttpu/ici_endpoint.h"
 
 namespace ttpu {
@@ -236,6 +237,32 @@ void PeerSegmentRegistry::OnRelease(void* ptr) {
   if (socket_id != 0) {
     ici_internal::SendCreditFrame(socket_id, idx);
   }
+}
+
+std::string DebugDumpEndpoints() {
+  std::vector<uint64_t> ids;
+  std::vector<int64_t> outstanding;
+  {
+    Registry& r = registry();
+    std::lock_guard<std::mutex> lk(r.mu);
+    for (const auto& [base, e] : r.map) {
+      ids.push_back(e.socket_id);
+      outstanding.push_back(e.outstanding);
+    }
+  }
+  std::string out;
+  for (size_t i = 0; i < ids.size(); ++i) {
+    trpc::SocketUniquePtr s;
+    if (trpc::Socket::Address(ids[i], &s) != 0) {
+      out += "ici sock=" + std::to_string(ids[i]) + " (socket gone)";
+    } else if (s->ici_endpoint() != nullptr) {
+      out += s->ici_endpoint()->DebugString();
+    } else {
+      out += "ici sock=" + std::to_string(ids[i]) + " (no endpoint)";
+    }
+    out += " rx_outstanding=" + std::to_string(outstanding[i]) + "\n";
+  }
+  return out;
 }
 
 void PeerSegmentRegistry::OnEndpointGone(const IciSegment* seg) {

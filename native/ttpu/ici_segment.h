@@ -97,4 +97,8 @@ class PeerSegmentRegistry {
   static void OnEndpointGone(const IciSegment* seg);
 };
 
+// Diagnostic snapshot of every live tpu:// endpoint's sender/receiver state
+// (hang forensics + the /ici console page): walks the registry's socket ids.
+std::string DebugDumpEndpoints();
+
 }  // namespace ttpu
