@@ -11,6 +11,7 @@
 #include "tbutil/time.h"
 #include "tbthread/sync.h"
 #include "trpc/channel.h"
+#include "trpc/concurrency_limiter.h"
 #include "trpc/errno.h"
 #include "trpc/server.h"
 #include "trpc/socket_map.h"
@@ -659,6 +660,27 @@ TEST_CASE(auto_concurrency_limiter_converges) {
   ASSERT_TRUE(adaptive.shed > 0);
 }
 
+// The timeout policy derives its gate from deadline / EMA-latency: with a
+// 10ms budget and 5ms requests only 2 fit; when the service speeds up the
+// gate widens on its own (reference policy/timeout_concurrency_limiter.cpp).
+TEST_CASE(timeout_concurrency_limiter_policy) {
+  auto lim = NewTimeoutLimiter(10000);  // 10ms queue budget
+  ASSERT_EQ(lim->max_concurrency(), 0);  // no samples: unlimited
+  ASSERT_TRUE(lim->OnRequestBegin());
+  lim->OnRequestEnd(5000);
+  ASSERT_EQ(lim->max_concurrency(), 2);
+  ASSERT_TRUE(lim->OnRequestBegin());   // floor admission (1st slot)
+  ASSERT_TRUE(lim->OnRequestBegin());   // floor admission (2nd slot)
+  ASSERT_FALSE(lim->OnRequestBegin());  // 3 x 5ms > 10ms: shed
+  lim->OnRequestEnd(5000);
+  lim->OnRequestEnd(5000);
+  for (int i = 0; i < 100; ++i) {  // service gets fast: EMA -> ~100us
+    ASSERT_TRUE(lim->OnRequestBegin());
+    lim->OnRequestEnd(100);
+  }
+  ASSERT_TRUE(lim->max_concurrency() > 50);
+}
+
 namespace {
 
 // A -> B relay: the nested call must inherit A's server span as parent.
@@ -881,6 +903,29 @@ TEST_CASE(rpc_dump_and_replay) {
     ASSERT_TRUE(resp.to_string() == r.body.to_string());
   }
   server.Stop();
+
+  // Corruption recovery: flip bytes inside record 1 and truncate the tail
+  // mid-record (a crash's torn write). Replay must resync on the per-record
+  // magic+crc and recover every intact record instead of failing outright
+  // or misreading everything after the damage.
+  FILE* f = fopen(dump_path.c_str(), "rb");
+  ASSERT_TRUE(f != nullptr);
+  std::string raw;
+  char c;
+  while (fread(&c, 1, 1, f) == 1) raw.push_back(c);
+  fclose(f);
+  std::string damaged = raw;
+  damaged[70] ^= 0x5a;  // inside record 1's frame (each frame is 54 bytes)
+  damaged.resize(damaged.size() - 7);  // torn final record
+  f = fopen(dump_path.c_str(), "wb");
+  fwrite(damaged.data(), 1, damaged.size(), f);
+  fclose(f);
+  std::vector<DumpedRequest> recovered;
+  ASSERT_EQ(RpcDumper::ReadAll(dump_path, &recovered), 0);
+  ASSERT_EQ(recovered.size(), size_t{3});  // lost the damaged + torn records
+  ASSERT_TRUE(recovered[0].body.equals("dump-body-0"));
+  ASSERT_TRUE(recovered[1].body.equals("dump-body-2"));
+  ASSERT_TRUE(recovered[2].body.equals("dump-body-3"));
   remove(dump_path.c_str());
 }
 

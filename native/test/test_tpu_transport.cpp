@@ -28,13 +28,21 @@ using namespace trpc;
 namespace {
 
 // Flake forensics: transport + stream flow-control state, printed by the
-// harness watchdog on a hang and by the tests on an unexpected RPC error.
+// harness watchdog on a hang (with read_buf heads: the process is wedged,
+// so the unsynchronized walk is safe) and by the tests on an unexpected RPC
+// error (without heads: other connections are still live).
 void dump_transport_state() {
   fputs(stream_internal::DebugDump().c_str(), stderr);
-  fputs(ttpu::DebugDumpEndpoints().c_str(), stderr);
+  fputs(ttpu::DebugDumpEndpoints(/*include_read_heads=*/false).c_str(),
+        stderr);
+}
+void dump_transport_state_hung() {
+  fputs(stream_internal::DebugDump().c_str(), stderr);
+  fputs(ttpu::DebugDumpEndpoints(/*include_read_heads=*/true).c_str(),
+        stderr);
 }
 struct HookInit {
-  HookInit() { mini_test::watchdog_hook().store(&dump_transport_state); }
+  HookInit() { mini_test::watchdog_hook().store(&dump_transport_state_hung); }
 } g_hook_init;
 
 // Echo handler that also reports whether the request arrived as zero-copy

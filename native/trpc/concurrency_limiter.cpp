@@ -151,6 +151,53 @@ class AutoLimiter final : public ConcurrencyLimiter {
   int32_t _saved_limit = kInitialLimit;
 };
 
+// Timeout policy: admit while (queue ahead) x (EMA latency) fits the
+// timeout budget. Unlike the gradient limiter there is no probing — the
+// gate derives directly from the deadline the operator configured, which is
+// the semantic the reference's timeout_concurrency_limiter.cpp implements
+// (requests that would wait past their deadline are shed instead of served
+// dead-on-arrival).
+class TimeoutLimiter final : public ConcurrencyLimiter {
+ public:
+  explicit TimeoutLimiter(int64_t timeout_us) : _timeout_us(timeout_us) {}
+
+  bool OnRequestBegin() override {
+    const int64_t ema = _ema_latency_us.load(std::memory_order_relaxed);
+    const int32_t prev = _inflight.fetch_add(1, std::memory_order_acquire);
+    // A minimum admission floor keeps the estimate alive: if everything
+    // were shed, no latency samples would ever lower the EMA again.
+    if (prev >= kMinConcurrency && ema > 0 &&
+        (prev + 1) * ema > _timeout_us) {
+      _inflight.fetch_sub(1, std::memory_order_release);
+      return false;
+    }
+    return true;
+  }
+
+  void OnRequestEnd(int64_t latency_us) override {
+    _inflight.fetch_sub(1, std::memory_order_release);
+    if (latency_us <= 0) return;
+    // Lossy racy EMA (alpha 1/8): precision is irrelevant next to the
+    // order-of-magnitude question "does the queue fit the deadline".
+    const int64_t cur = _ema_latency_us.load(std::memory_order_relaxed);
+    _ema_latency_us.store(cur == 0 ? latency_us : cur + (latency_us - cur) / 8,
+                          std::memory_order_relaxed);
+  }
+
+  int32_t max_concurrency() const override {
+    const int64_t ema = _ema_latency_us.load(std::memory_order_relaxed);
+    if (ema <= 0) return 0;  // no samples yet: unlimited
+    return std::max<int32_t>(kMinConcurrency,
+                             static_cast<int32_t>(_timeout_us / ema));
+  }
+
+ private:
+  static constexpr int32_t kMinConcurrency = 2;
+  const int64_t _timeout_us;
+  std::atomic<int32_t> _inflight{0};
+  std::atomic<int64_t> _ema_latency_us{0};
+};
+
 }  // namespace
 
 std::unique_ptr<ConcurrencyLimiter> NewConstantLimiter(int32_t max) {
@@ -159,6 +206,10 @@ std::unique_ptr<ConcurrencyLimiter> NewConstantLimiter(int32_t max) {
 
 std::unique_ptr<ConcurrencyLimiter> NewAutoLimiter() {
   return std::make_unique<AutoLimiter>();
+}
+
+std::unique_ptr<ConcurrencyLimiter> NewTimeoutLimiter(int64_t timeout_us) {
+  return std::make_unique<TimeoutLimiter>(timeout_us);
 }
 
 }  // namespace trpc

@@ -32,5 +32,9 @@ class ConcurrencyLimiter {
 // max <= 0: unlimited (every request admitted).
 std::unique_ptr<ConcurrencyLimiter> NewConstantLimiter(int32_t max);
 std::unique_ptr<ConcurrencyLimiter> NewAutoLimiter();
+// Sheds a request when the queue ahead of it cannot drain within
+// timeout_us at the observed EMA latency (reference
+// policy/timeout_concurrency_limiter.cpp).
+std::unique_ptr<ConcurrencyLimiter> NewTimeoutLimiter(int64_t timeout_us);
 
 }  // namespace trpc

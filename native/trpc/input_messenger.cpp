@@ -53,38 +53,71 @@ void InputMessenger::ProcessInFiber(InputMessageBase* msg) {
 
 ParseResult InputMessenger::CutInputMessage(Socket* s, int* protocol_index) {
   tbutil::IOBuf& buf = s->read_buf();
-  // Fast path: the protocol that parsed the last message on this connection
-  // almost always parses the next (reference input_messenger.cpp:84).
-  const int preferred = s->preferred_protocol();
-  if (preferred >= 0) {
-    const Protocol* proto = GetProtocol(preferred);
-    if (proto != nullptr) {
+  // A parser may CONSUME bytes yet return TRY_OTHERS: the tici transport
+  // eats credit/doorbell frames and defers when the next bytes are inline
+  // tstd. The scan must then RESTART from the top — the new head may belong
+  // to an already-visited (or the skipped preferred) protocol. Without the
+  // restart, a weak-magic protocol later in the order can claim the exposed
+  // frame with NOT_ENOUGH_DATA, get cached as preferred, and wedge the
+  // connection permanently (the r3 tpu:// flake: memcache claimed "TRPC"
+  // bytes after tici consumed the credits ahead of them).
+  while (true) {
+    const size_t size_at_entry = buf.size();
+    // Fast path: the protocol that parsed the last message on this
+    // connection almost always parses the next (reference
+    // input_messenger.cpp:84).
+    const int preferred = s->preferred_protocol();
+    if (preferred >= 0) {
+      const Protocol* proto = GetProtocol(preferred);
+      if (proto != nullptr) {
+        ParseResult r = proto->parse(&buf, s);
+        if (r.error == PARSE_OK || r.error == PARSE_ERROR_NOT_ENOUGH_DATA) {
+          *protocol_index = preferred;
+          return r;
+        }
+        if (r.error == PARSE_ERROR_ABSOLUTELY_WRONG) return r;
+        if (buf.size() != size_at_entry) continue;  // consumed: rescan all
+      }
+    }
+    bool restart = false;
+    for (int i = 0; i < kMaxProtocols; ++i) {
+      if (i == preferred) continue;
+      const Protocol* proto = GetProtocol(i);
+      if (proto == nullptr) continue;
+      const size_t before = buf.size();
       ParseResult r = proto->parse(&buf, s);
       if (r.error == PARSE_OK || r.error == PARSE_ERROR_NOT_ENOUGH_DATA) {
-        *protocol_index = preferred;
+        if (proto->weak_magic && r.error == PARSE_ERROR_NOT_ENOUGH_DATA) {
+          // A weak-magic protocol claiming an unparsed buffer is how a
+          // preferred-cache lock-in starts; keep it visible.
+          char head[16] = {0};
+          const size_t n = buf.copy_to(head, sizeof(head));
+          char hex[40];
+          for (size_t k = 0; k < n && k < 16; ++k) {
+            snprintf(hex + 2 * k, 4, "%02x", (unsigned char)head[k]);
+          }
+          TB_LOG(WARNING) << "protocol " << i << " (" << proto->name
+                          << ") claimed " << buf.size()
+                          << " unparsed bytes on sock " << s->id()
+                          << " head=" << hex;
+        }
+        *protocol_index = i;
+        s->set_preferred_protocol(i);
         return r;
       }
       if (r.error == PARSE_ERROR_ABSOLUTELY_WRONG) return r;
-      // TRY_OTHERS: fall through to the full scan.
+      if (buf.size() != before) {
+        restart = true;  // consumed then deferred: rescan from the top
+        break;
+      }
     }
+    if (restart) continue;
+    // Nobody recognizes the bytes. If the buffer is non-trivial, it is junk.
+    ParseResult r;
+    r.error = buf.empty() ? PARSE_ERROR_NOT_ENOUGH_DATA
+                          : PARSE_ERROR_TRY_OTHERS;
+    return r;
   }
-  for (int i = 0; i < kMaxProtocols; ++i) {
-    if (i == preferred) continue;
-    const Protocol* proto = GetProtocol(i);
-    if (proto == nullptr) continue;
-    ParseResult r = proto->parse(&buf, s);
-    if (r.error == PARSE_OK || r.error == PARSE_ERROR_NOT_ENOUGH_DATA) {
-      *protocol_index = i;
-      s->set_preferred_protocol(i);
-      return r;
-    }
-    if (r.error == PARSE_ERROR_ABSOLUTELY_WRONG) return r;
-  }
-  // Nobody recognizes the bytes. If the buffer is non-trivial, it is junk.
-  ParseResult r;
-  r.error = buf.empty() ? PARSE_ERROR_NOT_ENOUGH_DATA
-                        : PARSE_ERROR_TRY_OTHERS;
-  return r;
 }
 
 InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {

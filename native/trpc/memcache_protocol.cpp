@@ -70,12 +70,42 @@ ParseResult mc_parse(tbutil::IOBuf* source, Socket* socket) {
     r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
     return r;
   }
-  // Plausibility: replies start with an ASCII letter or digit.
-  char first;
-  source->copy_to(&first, 1);
-  if (!isalnum(static_cast<unsigned char>(first))) {
-    r.error = PARSE_ERROR_TRY_OTHERS;
-    return r;
+  // Plausibility: the text protocol's replies open with a CLOSED set of
+  // words (or a bare number for incr/decr). A loose gate here once claimed
+  // "TRPC..." frames on a tpu:// socket via isalnum('T') and wedged the
+  // connection behind the preferred-protocol cache — a multi-protocol
+  // parser must only claim bytes it is CONFIDENT about.
+  {
+    static const char* kReplyWords[] = {
+        "STORED", "NOT_STORED", "EXISTS",       "NOT_FOUND",    "DELETED",
+        "TOUCHED", "OK",        "END",          "ERROR",        "CLIENT_ERROR",
+        "SERVER_ERROR", "VALUE", "STAT",        "VERSION"};
+    char head[13] = {};  // longest word: SERVER_ERROR (12)
+    const size_t n = source->copy_to(head, 12);
+    bool plausible = false;
+    for (const char* w : kReplyWords) {
+      if (memcmp(head, w, std::min(n, strlen(w))) == 0) {
+        plausible = true;
+        break;
+      }
+    }
+    if (!plausible) {  // bare decimal (incr/decr result)?
+      plausible = true;
+      for (size_t i = 0; i < n; ++i) {
+        if (head[i] == '\r') {
+          plausible = i > 0;
+          break;
+        }
+        if (!isdigit(static_cast<unsigned char>(head[i]))) {
+          plausible = false;
+          break;
+        }
+      }
+    }
+    if (!plausible) {
+      r.error = PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
   }
   const ssize_t used = measure_mc_reply(*source, 0);
   if (used < 0) {
@@ -269,6 +299,7 @@ void RegisterMemcacheProtocol() {
   p.process_request = nullptr;  // client-only
   p.process_response = mc_process_response;
   p.short_connection = true;
+  p.weak_magic = true;  // text replies: plausibility words, no magic
   p.name = "memcache";
   TB_CHECK(RegisterProtocol(kMemcacheProtocolIndex, p) == 0)
       << "memcache protocol slot taken";

@@ -43,7 +43,10 @@ struct ParseResult {
 
 struct Protocol {
   // Cut one message from *source (bytes already read from the socket).
-  // Must not consume bytes unless a full message is cut.
+  // Consuming bytes without returning a message is allowed only for
+  // transport-control frames (tici credits/doorbells); the messenger
+  // rescans all protocols whenever a parse consumed bytes and deferred,
+  // since the new head may belong to a different protocol.
   ParseResult (*parse)(tbutil::IOBuf* source, Socket* socket);
   // Client side: frame a request. correlation_id goes on the wire.
   void (*pack_request)(tbutil::IOBuf* out, Controller* cntl,
@@ -59,6 +62,11 @@ struct Protocol {
   // SocketMap connection (reference CONNECTION_TYPE_SHORT; the standard
   // type for HTTP, whose wire carries no correlation id).
   bool short_connection = false;
+  // Text protocols without a magic number (redis, memcache) can only gate
+  // on plausibility, so a NOT_ENOUGH_DATA claim from them during the
+  // multi-protocol scan is logged — a wrong claim poisons the
+  // preferred-protocol cache and wedges the connection (the r3 tpu flake).
+  bool weak_magic = false;
   const char* name;
 };
 

@@ -299,6 +299,7 @@ void RegisterRedisProtocol() {
   p.process_request = nullptr;  // client-only
   p.process_response = redis_process_response;
   p.short_connection = true;  // no correlation id on the wire (like HTTP)
+  p.weak_magic = true;        // RESP has type chars, not a magic number
   p.name = "redis";
   TB_CHECK(RegisterProtocol(kRedisProtocolIndex, p) == 0)
       << "redis protocol slot taken";

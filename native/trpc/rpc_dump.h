@@ -1,10 +1,12 @@
 // rpc_dump: sample inbound requests to a file for offline replay.
 // Capability parity: reference src/brpc/rpc_dump.h:67 (SampledRequest pool +
 // background writer, gated by -rpc_dump flags) + tools/rpc_replay. Format is
-// our own length-prefixed recordio:
-//   [u32 record_len][u16 m_len][service/method][u32 body_len][body]
-//   [u32 att_len][attachment]
-// record_len counts everything after itself. Little-endian, same as tstd.
+// our own magic-framed recordio (reference butil/recordio.h class):
+//   [u32 magic "RDMP"][u32 record_len][u32 crc32c][u16 m_len]
+//   [service/method][u32 body_len][body][u32 att_len][attachment]
+// record_len counts everything after the crc; the crc covers the same
+// bytes. Little-endian, same as tstd. A torn or corrupted region is skipped
+// by scanning to the next magic on replay.
 #pragma once
 
 #include <cstdint>
@@ -36,9 +38,14 @@ class RpcDumper {
   void Flush();
   int64_t recorded() const;
 
-  // Load a dump file (replay tools + tests). Returns 0 on success.
-  static int ReadAll(const std::string& path,
-                     std::vector<DumpedRequest>* out);
+  // Load a dump file (replay tools + tests), resyncing past corrupt
+  // regions. Returns 0 on success (possibly with skipped bytes — see
+  // *skipped_bytes); -1 when the file is unreadable OR is non-empty but
+  // yielded no records (total corruption / not a dump file must not look
+  // like a clean empty dump). Memory stays bounded by the largest record,
+  // not the file.
+  static int ReadAll(const std::string& path, std::vector<DumpedRequest>* out,
+                     size_t* skipped_bytes = nullptr);
 
  private:
   struct Impl;

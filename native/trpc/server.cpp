@@ -62,9 +62,13 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   if (_running.load(std::memory_order_acquire)) return -1;
   GlobalInitializeOrDie();
   if (options != nullptr) _options = *options;
-  _limiter = _options.auto_concurrency
-                 ? NewAutoLimiter()
-                 : NewConstantLimiter(_options.max_concurrency);
+  if (_options.timeout_concurrency_ms > 0) {
+    _limiter = NewTimeoutLimiter(_options.timeout_concurrency_ms * 1000);
+  } else if (_options.auto_concurrency) {
+    _limiter = NewAutoLimiter();
+  } else {
+    _limiter = NewConstantLimiter(_options.max_concurrency);
+  }
   if (!_options.rpc_dump_path.empty()) {
     _dumper.reset(RpcDumper::Open(_options.rpc_dump_path));
   }

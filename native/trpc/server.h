@@ -65,6 +65,11 @@ struct ServerOptions {
   // (reference max_concurrency = "auto",
   // policy/auto_concurrency_limiter.cpp). See concurrency_limiter.h.
   bool auto_concurrency = false;
+  // Timeout-aware gate (overrides both of the above when > 0): sheds a
+  // request when the queue ahead of it cannot drain within this budget at
+  // the observed average latency (reference max_concurrency = "timeout",
+  // policy/timeout_concurrency_limiter.cpp).
+  int64_t timeout_concurrency_ms = 0;
 };
 
 class Server {

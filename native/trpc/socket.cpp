@@ -609,6 +609,44 @@ void Socket::ProcessEvent() {
   Deref();
 }
 
+std::string Socket::DebugString() const {
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "sock=%llu fd=%d failed=%d nevent=%d read_buf=%zu wq_bytes=%lld "
+           "write_head=%d preferred=%d",
+           static_cast<unsigned long long>(id()),
+           _fd.load(std::memory_order_acquire), int(Failed()),
+           _nevent.load(std::memory_order_acquire), _read_buf.size(),
+           static_cast<long long>(
+               _write_queue_bytes.load(std::memory_order_relaxed)),
+           int(_write_head.load(std::memory_order_acquire) != nullptr),
+           preferred_protocol());
+  return buf;
+}
+
+std::string Socket::DebugReadBufHead() const {
+  // _read_buf is a non-atomic multi-word structure owned by the input
+  // fiber; walking it concurrently is a use-after-free, not just a torn
+  // read. Only touch it when no input processing is active — which is
+  // exactly the stuck state this forensics call exists for.
+  if (_nevent.load(std::memory_order_acquire) != 0) {
+    return "(input fiber active: head withheld)";
+  }
+  std::string out;
+  const size_t n = std::min<size_t>(_read_buf.size(), 96);
+  if (n > 0) {
+    uint8_t head[96];
+    _read_buf.copy_to(head, n);
+    out += "head=";
+    char hex[4];
+    for (size_t i = 0; i < n; ++i) {
+      snprintf(hex, sizeof(hex), "%02x", head[i]);
+      out += hex;
+    }
+  }
+  return out;
+}
+
 void Socket::HandleEpollOut(SocketId sid) {
   SocketUniquePtr s;
   if (Address(sid, &s) != 0) return;

@@ -89,6 +89,13 @@ class Socket : public VersionedRefWithId<Socket> {
   static void StartInputEvent(SocketId sid);
   static void HandleEpollOut(SocketId sid);
 
+  // Diagnostic snapshot (racy atomic reads only; safe anytime).
+  std::string DebugString() const;
+  // Hex of read_buf's first bytes. ONLY safe on a quiescent connection (the
+  // hang state it exists to debug); returns a placeholder if input
+  // processing is active.
+  std::string DebugReadBufHead() const;
+
   // -- pending RPC correlation (errored on SetFailed) --
   void AddPendingId(tbthread::fiber_id_t id);
   void RemovePendingId(tbthread::fiber_id_t id);

@@ -1,0 +1,359 @@
+#include "trpc/ssl.h"
+
+#include <dlfcn.h>
+#include <poll.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tbthread/fiber.h"
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+
+namespace trpc {
+
+namespace {
+
+// ---- hand-declared OpenSSL ABI (no dev headers in the image) ----
+// All opaque pointers; constants are stable ABI values (openssl/ssl.h).
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorSyscall = 5;
+constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslFiletypePem = 1;
+constexpr long kSslCtrlSetTlsextHostname = 55;
+constexpr long kTlsextNametypeHostName = 0;
+constexpr int kSslTlsextErrOk = 0;
+constexpr int kSslTlsextErrNoack = 3;
+
+struct SslLib {
+  void* ssl_handle = nullptr;
+  void* crypto_handle = nullptr;
+
+  int (*OPENSSL_init_ssl)(uint64_t, const void*) = nullptr;
+  const void* (*TLS_server_method)() = nullptr;
+  const void* (*TLS_client_method)() = nullptr;
+  void* (*SSL_CTX_new)(const void*) = nullptr;
+  void (*SSL_CTX_free)(void*) = nullptr;
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*) = nullptr;
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int) = nullptr;
+  int (*SSL_CTX_check_private_key)(const void*) = nullptr;
+  void (*SSL_CTX_set_alpn_select_cb)(
+      void*,
+      int (*)(void*, const unsigned char**, unsigned char*,
+              const unsigned char*, unsigned int, void*),
+      void*) = nullptr;
+  int (*SSL_set_alpn_protos)(void*, const unsigned char*,
+                             unsigned int) = nullptr;
+  void (*SSL_get0_alpn_selected)(const void*, const unsigned char**,
+                                 unsigned int*) = nullptr;
+  void* (*SSL_new)(void*) = nullptr;
+  void (*SSL_free)(void*) = nullptr;
+  int (*SSL_set_fd)(void*, int) = nullptr;
+  void (*SSL_set_accept_state)(void*) = nullptr;
+  void (*SSL_set_connect_state)(void*) = nullptr;
+  int (*SSL_do_handshake)(void*) = nullptr;
+  int (*SSL_read)(void*, void*, int) = nullptr;
+  int (*SSL_write)(void*, const void*, int) = nullptr;
+  int (*SSL_get_error)(const void*, int) = nullptr;
+  int (*SSL_shutdown)(void*) = nullptr;
+  long (*SSL_ctrl)(void*, int, long, void*) = nullptr;
+  unsigned long (*ERR_get_error)() = nullptr;
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+  void (*ERR_clear_error)() = nullptr;
+
+  bool ok = false;
+};
+
+SslLib& lib() {
+  static SslLib* l = [] {
+    auto* s = new SslLib;
+    s->ssl_handle = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (s->ssl_handle == nullptr) {
+      s->ssl_handle = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    }
+    s->crypto_handle = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (s->crypto_handle == nullptr) {
+      s->crypto_handle = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    }
+    if (s->ssl_handle == nullptr || s->crypto_handle == nullptr) {
+      TB_LOG(WARNING) << "libssl/libcrypto unavailable: TLS disabled";
+      return s;
+    }
+    bool all = true;
+    auto load = [&](auto& fn, const char* name, void* from) {
+      fn = reinterpret_cast<std::decay_t<decltype(fn)>>(dlsym(from, name));
+      if (fn == nullptr) {
+        TB_LOG(ERROR) << "libssl symbol missing: " << name;
+        all = false;
+      }
+    };
+    void* sh = s->ssl_handle;
+    void* ch = s->crypto_handle;
+    load(s->OPENSSL_init_ssl, "OPENSSL_init_ssl", sh);
+    load(s->TLS_server_method, "TLS_server_method", sh);
+    load(s->TLS_client_method, "TLS_client_method", sh);
+    load(s->SSL_CTX_new, "SSL_CTX_new", sh);
+    load(s->SSL_CTX_free, "SSL_CTX_free", sh);
+    load(s->SSL_CTX_use_certificate_chain_file,
+         "SSL_CTX_use_certificate_chain_file", sh);
+    load(s->SSL_CTX_use_PrivateKey_file, "SSL_CTX_use_PrivateKey_file", sh);
+    load(s->SSL_CTX_check_private_key, "SSL_CTX_check_private_key", sh);
+    load(s->SSL_CTX_set_alpn_select_cb, "SSL_CTX_set_alpn_select_cb", sh);
+    load(s->SSL_set_alpn_protos, "SSL_set_alpn_protos", sh);
+    load(s->SSL_get0_alpn_selected, "SSL_get0_alpn_selected", sh);
+    load(s->SSL_new, "SSL_new", sh);
+    load(s->SSL_free, "SSL_free", sh);
+    load(s->SSL_set_fd, "SSL_set_fd", sh);
+    load(s->SSL_set_accept_state, "SSL_set_accept_state", sh);
+    load(s->SSL_set_connect_state, "SSL_set_connect_state", sh);
+    load(s->SSL_do_handshake, "SSL_do_handshake", sh);
+    load(s->SSL_read, "SSL_read", sh);
+    load(s->SSL_write, "SSL_write", sh);
+    load(s->SSL_get_error, "SSL_get_error", sh);
+    load(s->SSL_shutdown, "SSL_shutdown", sh);
+    load(s->SSL_ctrl, "SSL_ctrl", sh);
+    load(s->ERR_get_error, "ERR_get_error", ch);
+    load(s->ERR_error_string_n, "ERR_error_string_n", ch);
+    load(s->ERR_clear_error, "ERR_clear_error", ch);
+    if (all) {
+      s->OPENSSL_init_ssl(0, nullptr);
+      s->ok = true;
+    }
+    return s;
+  }();
+  return *l;
+}
+
+std::string last_ssl_error() {
+  SslLib& L = lib();
+  if (!L.ok) return "libssl unavailable";
+  char buf[256] = "unknown";
+  unsigned long e = L.ERR_get_error();
+  if (e != 0) L.ERR_error_string_n(e, buf, sizeof(buf));
+  return buf;
+}
+
+// Wire format for ALPN: each protocol as [len][bytes], concatenated.
+std::string alpn_wire(const std::vector<std::string>& alpn) {
+  std::string w;
+  for (const std::string& p : alpn) {
+    if (p.empty() || p.size() > 255) continue;
+    w.push_back(static_cast<char>(p.size()));
+    w += p;
+  }
+  return w;
+}
+
+// Server ALPN selection: first of OUR configured list that the client
+// offered (server-preference order, same policy as the reference).
+int alpn_select_cb(void*, const unsigned char** out, unsigned char* outlen,
+                   const unsigned char* in, unsigned int inlen, void* arg) {
+  auto* wire = static_cast<const std::string*>(arg);
+  const unsigned char* w = reinterpret_cast<const unsigned char*>(
+      wire->data());
+  size_t wn = wire->size();
+  for (size_t i = 0; i < wn;) {
+    const unsigned char ln = w[i];
+    for (unsigned int j = 0; j < inlen;) {
+      const unsigned char cn = in[j];
+      if (cn == ln && memcmp(w + i + 1, in + j + 1, ln) == 0) {
+        *out = w + i + 1;
+        *outlen = ln;
+        return kSslTlsextErrOk;
+      }
+      j += 1 + cn;
+    }
+    i += 1 + ln;
+  }
+  return kSslTlsextErrNoack;  // no overlap: proceed without ALPN
+}
+
+bool looks_like_ip_literal(const std::string& host) {
+  for (char c : host) {
+    if (!(isdigit(static_cast<unsigned char>(c)) || c == '.' || c == ':')) {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+bool SslAvailable() { return lib().ok; }
+
+std::shared_ptr<SslContext> SslContext::NewServer(
+    const SslServerOptions& opts) {
+  SslLib& L = lib();
+  if (!L.ok) {
+    TB_LOG(ERROR) << "TLS requested but libssl is unavailable";
+    return nullptr;
+  }
+  auto ctx = std::shared_ptr<SslContext>(new SslContext);
+  ctx->_ctx = L.SSL_CTX_new(L.TLS_server_method());
+  if (ctx->_ctx == nullptr) return nullptr;
+  if (L.SSL_CTX_use_certificate_chain_file(ctx->_ctx,
+                                           opts.cert_file.c_str()) != 1 ||
+      L.SSL_CTX_use_PrivateKey_file(ctx->_ctx, opts.key_file.c_str(),
+                                    kSslFiletypePem) != 1 ||
+      L.SSL_CTX_check_private_key(ctx->_ctx) != 1) {
+    TB_LOG(ERROR) << "TLS cert/key load failed (" << opts.cert_file << ", "
+                  << opts.key_file << "): " << last_ssl_error();
+    return nullptr;
+  }
+  ctx->_alpn = opts.alpn;
+  ctx->_alpn_wire = alpn_wire(opts.alpn);
+  if (!ctx->_alpn_wire.empty()) {
+    L.SSL_CTX_set_alpn_select_cb(ctx->_ctx, alpn_select_cb,
+                                 &ctx->_alpn_wire);
+  }
+  return ctx;
+}
+
+std::shared_ptr<SslContext> SslContext::NewClient(
+    const std::vector<std::string>& alpn) {
+  SslLib& L = lib();
+  if (!L.ok) {
+    TB_LOG(ERROR) << "TLS requested but libssl is unavailable";
+    return nullptr;
+  }
+  auto ctx = std::shared_ptr<SslContext>(new SslContext);
+  ctx->_ctx = L.SSL_CTX_new(L.TLS_client_method());
+  if (ctx->_ctx == nullptr) return nullptr;
+  ctx->_alpn = alpn;
+  ctx->_alpn_wire = alpn_wire(alpn);
+  // Note: no CA verification wired yet — parity with the reference's
+  // default VerifyOptions{verify_depth=0} (verification off). Channels to
+  // untrusted networks should not rely on this until verify lands.
+  return ctx;
+}
+
+SslContext::~SslContext() {
+  if (_ctx != nullptr) lib().SSL_CTX_free(_ctx);
+}
+
+SslConn::SslConn(SslContext* ctx, int fd, bool server,
+                 const std::string& sni_host)
+    : _fd(fd) {
+  SslLib& L = lib();
+  if (!L.ok || ctx == nullptr || ctx->raw() == nullptr) return;
+  _ssl = L.SSL_new(ctx->raw());
+  if (_ssl == nullptr) return;
+  if (L.SSL_set_fd(_ssl, fd) != 1) {
+    L.SSL_free(_ssl);
+    _ssl = nullptr;
+    return;
+  }
+  if (server) {
+    L.SSL_set_accept_state(_ssl);
+  } else {
+    L.SSL_set_connect_state(_ssl);
+    if (!sni_host.empty() && !looks_like_ip_literal(sni_host)) {
+      L.SSL_ctrl(_ssl, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+                 const_cast<char*>(sni_host.c_str()));
+    }
+    const std::string& wire = alpn_wire(ctx->alpn());
+    if (!wire.empty()) {
+      L.SSL_set_alpn_protos(
+          _ssl, reinterpret_cast<const unsigned char*>(wire.data()),
+          static_cast<unsigned int>(wire.size()));
+    }
+  }
+}
+
+SslConn::~SslConn() {
+  if (_ssl != nullptr) {
+    lib().SSL_shutdown(_ssl);  // best-effort close_notify (nonblocking)
+    lib().SSL_free(_ssl);
+  }
+}
+
+int SslConn::Handshake(int64_t deadline_us) {
+  SslLib& L = lib();
+  if (_ssl == nullptr) {
+    errno = ENOTSUP;
+    return -1;
+  }
+  while (true) {
+    int rc, err;
+    {
+      std::lock_guard<std::mutex> lk(_mu);
+      L.ERR_clear_error();
+      rc = L.SSL_do_handshake(_ssl);
+      if (rc == 1) return 0;
+      err = L.SSL_get_error(_ssl, rc);
+    }
+    unsigned int want;
+    if (err == kSslErrorWantRead) {
+      want = POLLIN;
+    } else if (err == kSslErrorWantWrite) {
+      want = POLLOUT;
+    } else {
+      TB_LOG(WARNING) << "TLS handshake failed: " << last_ssl_error();
+      errno = EPROTO;
+      return -1;
+    }
+    if (deadline_us > 0 && tbutil::gettimeofday_us() >= deadline_us) {
+      errno = ETIMEDOUT;
+      return -1;
+    }
+    // Any wait failure is fatal: retrying without parking would spin a
+    // worker hot (EBUSY/EBADF/EINVAL never self-heal here).
+    if (tbthread::fiber_fd_wait(_fd, want, deadline_us) != 0) {
+      if (errno == 0) errno = EPROTO;
+      return -1;
+    }
+  }
+}
+
+ssize_t SslConn::Read(void* buf, size_t n) {
+  SslLib& L = lib();
+  if (_ssl == nullptr) {
+    errno = ENOTSUP;
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(_mu);
+  L.ERR_clear_error();
+  const int rc = L.SSL_read(_ssl, buf, static_cast<int>(n));
+  if (rc > 0) return rc;
+  const int err = L.SSL_get_error(_ssl, rc);
+  if (err == kSslErrorZeroReturn) return 0;  // clean TLS shutdown
+  if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+    errno = EAGAIN;
+    return -1;
+  }
+  if (err == kSslErrorSyscall && rc == 0) return 0;  // abrupt EOF
+  if (errno == 0) errno = EPROTO;
+  return -1;
+}
+
+ssize_t SslConn::Write(const void* buf, size_t n) {
+  SslLib& L = lib();
+  if (_ssl == nullptr) {
+    errno = ENOTSUP;
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(_mu);
+  L.ERR_clear_error();
+  const int rc = L.SSL_write(_ssl, buf, static_cast<int>(n));
+  if (rc > 0) return rc;
+  const int err = L.SSL_get_error(_ssl, rc);
+  if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+    errno = EAGAIN;
+    return -1;
+  }
+  if (errno == 0) errno = EPROTO;
+  return -1;
+}
+
+std::string SslConn::alpn_selected() const {
+  SslLib& L = lib();
+  if (_ssl == nullptr) return "";
+  const unsigned char* p = nullptr;
+  unsigned int n = 0;
+  L.SSL_get0_alpn_selected(_ssl, &p, &n);
+  return p != nullptr ? std::string(reinterpret_cast<const char*>(p), n)
+                      : std::string();
+}
+
+}  // namespace trpc

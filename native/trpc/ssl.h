@@ -1,0 +1,80 @@
+// TLS for trpc sockets, bound to the system libssl.so.3/libcrypto.so.3 at
+// RUNTIME via dlopen: this image ships the OpenSSL 3 runtime without
+// development headers, so the needed ABI surface (~25 functions, all
+// pointer/int signatures, stable since OpenSSL 1.1) is declared by hand in
+// ssl.cpp. If the libraries are absent, SslAvailable() is false and every
+// TLS entry point fails cleanly — the rest of the stack is unaffected.
+//
+// Capability parity: reference src/brpc/details/ssl_helper.cpp:939 (ctx
+// setup, ALPN, SNI) + server.h ssl_options + the same-port TLS sniffing the
+// reference does in its InputMessenger. Handshakes are fiber-blocking
+// (fiber_fd_wait on WANT_READ/WANT_WRITE), never thread-blocking.
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+// True once libssl/libcrypto loaded and the symbol table resolved.
+bool SslAvailable();
+
+struct SslServerOptions {
+  std::string cert_file;            // PEM certificate chain
+  std::string key_file;             // PEM private key
+  std::vector<std::string> alpn;    // offered protocols, preference order
+                                    // (e.g. {"h2", "http/1.1"}); empty = off
+};
+
+// Wraps one SSL_CTX. Shared by every connection of a Server or Channel.
+class SslContext {
+ public:
+  // nullptr on failure (bad cert/key, libssl absent); reason logged.
+  static std::shared_ptr<SslContext> NewServer(const SslServerOptions& opts);
+  static std::shared_ptr<SslContext> NewClient(
+      const std::vector<std::string>& alpn);
+  ~SslContext();
+
+  void* raw() const { return _ctx; }
+  const std::vector<std::string>& alpn() const { return _alpn; }
+
+ private:
+  SslContext() = default;
+  void* _ctx = nullptr;
+  std::vector<std::string> _alpn;
+  std::string _alpn_wire;  // length-prefixed ALPN protocol list
+  friend int alpn_select_thunk_access(SslContext*, const unsigned char**,
+                                      unsigned char*, const unsigned char*,
+                                      unsigned int);
+};
+
+// One TLS connection over an existing nonblocking fd.
+class SslConn {
+ public:
+  // server=false: SNI sent when sni_host is a DNS name (not an IP literal).
+  SslConn(SslContext* ctx, int fd, bool server, const std::string& sni_host);
+  ~SslConn();
+  bool valid() const { return _ssl != nullptr; }
+
+  // Drives SSL_do_handshake on the nonblocking fd, parking the CALLING
+  // FIBER (fiber_fd_wait) on WANT_READ/WANT_WRITE. 0 ok; -1 sets errno.
+  int Handshake(int64_t deadline_us);
+
+  // Nonblocking, fiber-safe (internal lock: one SSL* is not safe for
+  // concurrent read+write). Return >0 bytes; 0 = clean TLS shutdown/EOF;
+  // -1 with errno EAGAIN (retry on next event) or a fatal error.
+  ssize_t Read(void* buf, size_t n);
+  ssize_t Write(const void* buf, size_t n);
+
+  // After handshake: negotiated ALPN protocol ("" = none).
+  std::string alpn_selected() const;
+
+ private:
+  void* _ssl = nullptr;
+  int _fd = -1;
+  std::mutex _mu;
+};
+
+}  // namespace trpc

@@ -270,6 +270,13 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd) {
 }
 
 void IciEndpoint::WaitCredit() {
+  // Lost-wakeup-free park: snapshot the butex BEFORE checking the exit
+  // conditions. Every producer of progress (OnCreditFrame, QueueCredit,
+  // OnSocketFailed) bumps the butex AFTER publishing its state, so a wake
+  // landing between our check and the park makes butex_wait return on the
+  // value mismatch. Unbounded by design — the r3 100ms safety timeout
+  // masked a parse-stall bug (memcache preferred-cache lock-in, fixed in
+  // input_messenger.cpp) and is not needed by this protocol.
   const int expected =
       tbthread::butex_value(_credit_btx)->load(std::memory_order_acquire);
   if (_tx->free_blocks() > 0 ||
@@ -280,13 +287,7 @@ void IciEndpoint::WaitCredit() {
     _credit_starved.store(false, std::memory_order_release);
     return;
   }
-  // Bounded park: a lost credit (peer bug) degrades to a periodic re-check
-  // instead of a hang; the caller loops.
-  timespec abstime;
-  const int64_t deadline = tbutil::gettimeofday_us() + 100 * 1000;
-  abstime.tv_sec = deadline / 1000000;
-  abstime.tv_nsec = (deadline % 1000000) * 1000;
-  tbthread::butex_wait(_credit_btx, expected, &abstime);
+  tbthread::butex_wait(_credit_btx, expected, nullptr);
   _credit_starved.store(false, std::memory_order_release);
 }
 

@@ -1,9 +1,13 @@
 #include "ttpu/ici_segment.h"
 
+#include <dirent.h>
 #include <fcntl.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+
+#include <cstdio>
 
 #include <atomic>
 #include <cstring>
@@ -26,8 +30,33 @@ std::string next_segment_name() {
 }
 }  // namespace
 
+// Crash debris: a hard-killed process (no destructors) leaks its segment
+// files; a later process reusing the pid then collides on O_EXCL. Names
+// embed the creator's pid, so any file named with OUR pid belongs to a dead
+// process — unlink and retry. A startup sweep also clears other dead pids'
+// debris so /dev/shm can't fill up across crash loops.
+void sweep_dead_segments() {
+  DIR* d = opendir("/dev/shm");
+  if (d == nullptr) return;
+  while (dirent* e = readdir(d)) {
+    long pid = 0;
+    if (sscanf(e->d_name, "brpctpu_%ld_", &pid) != 1) continue;
+    if (pid > 0 && kill(static_cast<pid_t>(pid), 0) != 0 && errno == ESRCH) {
+      std::string name = "/";
+      name += e->d_name;
+      shm_unlink(name.c_str());
+    }
+  }
+  closedir(d);
+}
+
 std::shared_ptr<IciSegment> IciSegment::CreateOwner(uint32_t block_size,
                                                     uint32_t n_blocks) {
+  static const bool swept = [] {
+    sweep_dead_segments();
+    return true;
+  }();
+  (void)swept;
   auto seg = std::shared_ptr<IciSegment>(new IciSegment);
   seg->_name = next_segment_name();
   seg->_block_size = block_size;
@@ -35,6 +64,11 @@ std::shared_ptr<IciSegment> IciSegment::CreateOwner(uint32_t block_size,
   seg->_owner = true;
   const size_t total = size_t(block_size) * n_blocks;
   int fd = shm_open(seg->_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0 && errno == EEXIST) {
+    // Same-pid debris from a dead predecessor: reclaim the name.
+    shm_unlink(seg->_name.c_str());
+    fd = shm_open(seg->_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  }
   if (fd < 0) {
     TB_LOG(ERROR) << "shm_open " << seg->_name
                   << " failed: " << strerror(errno);
@@ -239,7 +273,7 @@ void PeerSegmentRegistry::OnRelease(void* ptr) {
   }
 }
 
-std::string DebugDumpEndpoints() {
+std::string DebugDumpEndpoints(bool include_read_heads) {
   std::vector<uint64_t> ids;
   std::vector<int64_t> outstanding;
   {
@@ -256,6 +290,12 @@ std::string DebugDumpEndpoints() {
     if (trpc::Socket::Address(ids[i], &s) != 0) {
       out += "ici sock=" + std::to_string(ids[i]) + " (socket gone)";
     } else if (s->ici_endpoint() != nullptr) {
+      out += s->DebugString();
+      if (include_read_heads) {
+        out += " ";
+        out += s->DebugReadBufHead();
+      }
+      out += "\n  ";
       out += s->ici_endpoint()->DebugString();
     } else {
       out += "ici sock=" + std::to_string(ids[i]) + " (no endpoint)";

@@ -99,6 +99,10 @@ class PeerSegmentRegistry {
 
 // Diagnostic snapshot of every live tpu:// endpoint's sender/receiver state
 // (hang forensics + the /ici console page): walks the registry's socket ids.
-std::string DebugDumpEndpoints();
+// include_read_heads=true additionally hex-dumps each socket's unparsed
+// read_buf head — ONLY pass it from a context where the process is known
+// quiescent (a hang watchdog): the walk is unsynchronized against live
+// input fibers.
+std::string DebugDumpEndpoints(bool include_read_heads = false);
 
 }  // namespace ttpu
