@@ -53,6 +53,8 @@ def lib() -> ctypes.CDLL:
     L = ctypes.CDLL(_LIB_PATH)
     L.tbrpc_server_create.restype = ctypes.c_void_p
     L.tbrpc_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    L.tbrpc_server_start_tls.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
     L.tbrpc_server_stop.argtypes = [ctypes.c_void_p]
     L.tbrpc_server_destroy.argtypes = [ctypes.c_void_p]
     L.tbrpc_server_add_echo_service.argtypes = [ctypes.c_void_p]
@@ -139,8 +141,15 @@ class Server:
                 self._h, name.encode(), cb, None) != 0:
             raise RuntimeError(f"add_service({name}) failed")
 
-    def start(self, addr: str = "127.0.0.1:0") -> int:
-        port = self._L.tbrpc_server_start(self._h, addr.encode())
+    def start(self, addr: str = "127.0.0.1:0", *, ssl_cert: str = "",
+              ssl_key: str = "") -> int:
+        """ssl_cert+ssl_key make the port ALSO accept TLS (sniffed, so
+        plaintext clients keep working; ALPN offers h2 for gRPC-over-TLS)."""
+        if ssl_cert or ssl_key:
+            port = self._L.tbrpc_server_start_tls(
+                self._h, addr.encode(), ssl_cert.encode(), ssl_key.encode())
+        else:
+            port = self._L.tbrpc_server_start(self._h, addr.encode())
         if port < 0:
             raise RuntimeError(f"server start on {addr} failed")
         self.port = port

@@ -95,6 +95,18 @@ int tbrpc_server_start(void* server, const char* addr) {
   return box->server.listen_address().port;
 }
 
+// cert/key non-empty => the port also accepts TLS (same-port sniffing;
+// ALPN h2 + http/1.1 — gRPC-over-TLS peers negotiate h2).
+int tbrpc_server_start_tls(void* server, const char* addr, const char* cert,
+                           const char* key) {
+  auto* box = static_cast<ServerBox*>(server);
+  ServerOptions opts;
+  if (cert != nullptr) opts.ssl_cert_file = cert;
+  if (key != nullptr) opts.ssl_key_file = key;
+  if (box->server.Start(addr, &opts) != 0) return -1;
+  return box->server.listen_address().port;
+}
+
 int tbrpc_server_stop(void* server) {
   return static_cast<ServerBox*>(server)->server.Stop();
 }

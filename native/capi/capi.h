@@ -13,6 +13,10 @@ extern "C" {
 void* tbrpc_server_create();
 // addr: "0.0.0.0:0" for ephemeral. Returns the bound port, or -1.
 int tbrpc_server_start(void* server, const char* addr);
+// Same, with TLS: cert/key (PEM paths) non-empty makes the port ALSO accept
+// TLS (first-byte sniffing; plaintext clients unaffected; ALPN h2+http/1.1).
+int tbrpc_server_start_tls(void* server, const char* addr, const char* cert,
+                           const char* key);
 int tbrpc_server_stop(void* server);
 void tbrpc_server_destroy(void* server);
 // Built-in native echo service "EchoService" (methods: Echo) — payload and

@@ -66,6 +66,7 @@ void Acceptor::OnNewConnection(int fd, const tbutil::EndPoint& remote) {
   opt.messenger = this;  // data parsing = the server-side pipeline
   opt.server_side = true;
   opt.user = _user;
+  opt.ssl_ctx = _ssl_ctx;  // enables same-port TLS sniffing when set
   SocketId sid;
   if (Socket::Create(opt, &sid) != 0) {
     close(fd);

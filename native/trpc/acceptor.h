@@ -42,6 +42,11 @@ class Acceptor : public InputMessenger {
   // Takes ownership of `listen_fd` (already bound + listening). `user` is
   // attached to every accepted socket (the Server*).
   int StartAccept(int listen_fd, void* user);
+  // Non-null BEFORE StartAccept: accepted connections sniff for TLS on the
+  // same port (0x16 first byte upgrades; plaintext stays plaintext).
+  void set_ssl_ctx(std::shared_ptr<SslContext> ctx) {
+    _ssl_ctx = std::move(ctx);
+  }
   // Close the listen fd and fail every accepted connection.
   void StopAccept();
 
@@ -56,6 +61,7 @@ class Acceptor : public InputMessenger {
   AcceptMessenger _accept_messenger;
   SocketId _listen_sid = INVALID_SOCKET_ID;
   void* _user = nullptr;
+  std::shared_ptr<SslContext> _ssl_ctx;
 
   mutable std::mutex _conn_mu;
   bool _stopped = false;  // guarded by _conn_mu; set by StopAccept

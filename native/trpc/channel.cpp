@@ -20,10 +20,15 @@ int Channel::Init(const tbutil::EndPoint& server,
 
 int Channel::Init(const char* server_addr, const ChannelOptions* options) {
   // "tpu://host:port" = same control endpoint, ICI transport upgrade.
+  // "tls://host:port" = TLS to the server (hostname kept for SNI).
   bool tpu = false;
+  bool tls = false;
   if (strncmp(server_addr, "tpu://", 6) == 0) {
     server_addr += 6;
     tpu = true;
+  } else if (strncmp(server_addr, "tls://", 6) == 0) {
+    server_addr += 6;
+    tls = true;
   }
   tbutil::EndPoint pt;
   if (tbutil::str2endpoint(server_addr, &pt) != 0 &&
@@ -33,6 +38,15 @@ int Channel::Init(const char* server_addr, const ChannelOptions* options) {
   }
   int rc = Init(pt, options);
   if (rc == 0 && tpu) _options.tpu_transport = true;
+  if (rc == 0 && tls) {
+    _options.tls = true;
+    if (_options.sni_host.empty()) {
+      std::string host(server_addr);
+      const size_t colon = host.rfind(':');
+      if (colon != std::string::npos) host.resize(colon);
+      _options.sni_host = host;
+    }
+  }
   return rc;
 }
 
@@ -94,6 +108,8 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   if (cntl->_max_retry == -1) cntl->_max_retry = _options.max_retry;
   cntl->_protocol = _options.protocol;
   cntl->_tpu_transport = _options.tpu_transport;
+  cntl->_tls = _options.tls;
+  cntl->_sni_host = _options.sni_host;
   cntl->_connection_type = static_cast<uint8_t>(_options.connection_type);
   if (cntl->_compress_type < 0) {
     cntl->_compress_type = _options.request_compress_type;

@@ -35,6 +35,11 @@ struct ChannelOptions {
   // Upgrade connections to the tpu:// ICI transport (ttpu/ici_endpoint.h).
   // Set automatically when Init is given a "tpu://host:port" address.
   bool tpu_transport = false;
+  // TLS to the server (reference ChannelOptions.ssl_options). Set
+  // automatically when Init is given a "tls://host:port" address, which
+  // also records the hostname for SNI.
+  bool tls = false;
+  std::string sni_host;
   // Naming filter (reference NamingServiceFilter, naming_service_filter.h):
   // nodes the filter rejects never reach the balancer — e.g. keep only
   // same-zone replicas or a tag-matched subset. nullptr = keep all.

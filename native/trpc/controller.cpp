@@ -38,6 +38,8 @@ void Controller::Reset() {
   _error_text.clear();
   _server_side = false;
   _tpu_transport = false;
+  _tls = false;
+  _sni_host.clear();
   _connection_type = 0;
   _compress_type = -1;
   _lb.reset();
@@ -113,7 +115,7 @@ void Controller::IssueRPC() {
            _connection_type == static_cast<uint8_t>(ConnectionType::kPooled))
             ? ConnectionType::kPooled
             : ConnectionType::kSingle;
-    if (AcquireClientSocket(ctype, _remote_side, _tpu_transport,
+    if (AcquireClientSocket(ctype, _remote_side, transport(),
                             _deadline_us, &sock) != 0) {
       err = errno != 0 ? errno : TRPC_ECONNECT;
       err_text = "failed to connect to " + tbutil::endpoint2str(_remote_side);
@@ -250,13 +252,13 @@ namespace {
 // An exclusive borrowed socket with no pending traffic can go back to the
 // pool; a short one is closed; the shared single connection is left alone.
 void ReclaimHedgeSocket(SocketUniquePtr& sock, const tbutil::EndPoint& node,
-                        uint8_t ctype, bool tpu, bool used) {
+                        uint8_t ctype, const ClientTransport& tr, bool used) {
   if (!sock) return;
   if (ctype == static_cast<uint8_t>(ConnectionType::kShort)) {
     sock->SetFailed(ECANCELED);
   } else if (ctype == static_cast<uint8_t>(ConnectionType::kPooled)) {
     if (!used && !sock->Failed()) {
-      SocketMap::global().ReturnPooled(node, sock->id(), tpu);
+      SocketMap::global().ReturnPooled(node, sock->id(), tr);
     } else {
       sock->SetFailed(ECANCELED);
     }
@@ -326,7 +328,7 @@ void Controller::BackupThunk(void* arg) {
     const uint8_t ctype =
         short_conn ? static_cast<uint8_t>(ConnectionType::kShort)
                    : cntl->_connection_type;
-    const bool tpu = cntl->_tpu_transport;
+    const ClientTransport tr = cntl->transport();
     const int64_t deadline_us = cntl->_deadline_us;
     const int64_t attempt_begin_us = tbutil::gettimeofday_us();
     std::shared_ptr<LoadBalancer> lb = cntl->_lb;
@@ -353,7 +355,7 @@ void Controller::BackupThunk(void* arg) {
 
     // ---- phase 2: unlocked — acquire + connect (may take a while) ----
     SocketUniquePtr sock;
-    if (AcquireClientSocket(static_cast<ConnectionType>(ctype), node, tpu,
+    if (AcquireClientSocket(static_cast<ConnectionType>(ctype), node, tr,
                             deadline_us, &sock) != 0) {
       const int err = errno != 0 ? errno : TRPC_ECONNECT;
       if (lb != nullptr) lb->Feedback(node, 0, /*failed=*/true);
@@ -373,13 +375,13 @@ void Controller::BackupThunk(void* arg) {
     // ---- phase 3: locked — place the hedge if the RPC still wants it ----
     if (tbthread::fiber_id_lock(cid, &data) != 0) {
       // RPC finished while we connected.
-      ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/false);
+      ReclaimHedgeSocket(sock, node, ctype, tr, /*used=*/false);
       return nullptr;
     }
     cntl = static_cast<Controller*>(data);
     --cntl->_pending_hedges;
     if (cntl->_response_received) {
-      ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/false);
+      ReclaimHedgeSocket(sock, node, ctype, tr, /*used=*/false);
       tbthread::fiber_id_unlock(cid);
       return nullptr;
     }
@@ -391,7 +393,7 @@ void Controller::BackupThunk(void* arg) {
     } else {
       const int err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
       sock->RemovePendingId(attempt);
-      ReclaimHedgeSocket(sock, node, ctype, tpu, /*used=*/true);
+      ReclaimHedgeSocket(sock, node, ctype, tr, /*used=*/true);
       if (lb != nullptr) lb->Feedback(node, 0, /*failed=*/true);
       if (cntl->_live.empty() && cntl->_pending_hedges == 0) {
         settle_orphaned(cntl, cid, err);
@@ -479,7 +481,7 @@ void Controller::EndRPC(int error, const std::string& error_text) {
       // still deliver that response later — close it rather than risk
       // handing a next borrower a connection mid-delivery.
       if (winner && _response_received && !sock->Failed()) {
-        SocketMap::global().ReturnPooled(a.node, a.sock, _tpu_transport);
+        SocketMap::global().ReturnPooled(a.node, a.sock, transport());
       } else {
         sock->SetFailed(ECANCELED);
       }

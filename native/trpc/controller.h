@@ -22,6 +22,7 @@
 #include "tbutil/iobuf.h"
 #include "trpc/closure.h"
 #include "trpc/socket.h"
+#include "trpc/socket_map.h"
 
 namespace trpc {
 
@@ -111,6 +112,15 @@ class Controller {
   int _max_retry = -1;
   int _protocol = 0;
   bool _tpu_transport = false;
+  bool _tls = false;
+  std::string _sni_host;
+  ClientTransport transport() const {
+    ClientTransport tr;
+    tr.tpu = _tpu_transport;
+    tr.tls = _tls;
+    tr.sni_host = _sni_host;
+    return tr;
+  }
   uint8_t _connection_type = 0;  // ConnectionType (channel.h)
   // compress.h codec for payloads; -1 = unset (inherit the channel's
   // default) so an explicit set_compress_type(kCompressNone) can DISABLE a

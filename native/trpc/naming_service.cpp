@@ -7,6 +7,7 @@
 #include <cstdio>
 #include <cstring>
 
+#include "tbutil/fast_rand.h"
 #include "tbutil/logging.h"
 
 namespace trpc {
@@ -125,18 +126,12 @@ void NamingServiceThread::Run() {
   // backoff (capped at 16x) while resolution fails so a dead DNS server
   // isn't hammered at full rate (reference periodic_naming_service.cpp
   // behavior class; VERDICT r3 weak #7).
-  uint64_t jitter_state = 0x9e3779b97f4a7c15ULL ^
-                          reinterpret_cast<uintptr_t>(this);
   int failure_backoff = 1;
   while (!_stop.load(std::memory_order_relaxed)) {
     const int base_ms = (_scheme == "file" ? 1000 : 5000) * failure_backoff;
-    // xorshift for the jitter: libc rand() would share seed state with user
-    // code, and cryptographic quality is irrelevant here.
-    jitter_state ^= jitter_state << 13;
-    jitter_state ^= jitter_state >> 7;
-    jitter_state ^= jitter_state << 17;
     const int jitter_ms =
-        static_cast<int>(jitter_state % (base_ms / 2 + 1)) - base_ms / 4;
+        static_cast<int>(tbutil::fast_rand_less_than(base_ms / 2 + 1)) -
+        base_ms / 4;
     const int sleep_ms = base_ms + jitter_ms;
     for (int i = 0; i < sleep_ms / 50 && !_stop.load(); ++i) {
       std::this_thread::sleep_for(std::chrono::milliseconds(50));

@@ -72,6 +72,22 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   if (!_options.rpc_dump_path.empty()) {
     _dumper.reset(RpcDumper::Open(_options.rpc_dump_path));
   }
+  if (!_options.ssl_cert_file.empty() || !_options.ssl_key_file.empty()) {
+    SslServerOptions sopts;
+    sopts.cert_file = _options.ssl_cert_file;
+    sopts.key_file = _options.ssl_key_file;
+    sopts.alpn = {"h2", "http/1.1"};  // gRPC-over-TLS negotiates h2
+    auto ctx = SslContext::NewServer(sopts);
+    if (ctx == nullptr) {
+      TB_LOG(ERROR) << "TLS configuration failed; refusing to start";
+      return -1;
+    }
+    _acceptor.set_ssl_ctx(std::move(ctx));
+  } else {
+    // Restart without TLS options must not keep a previous run's ctx (and
+    // its possibly rotated-out cert) alive on the acceptor.
+    _acceptor.set_ssl_ctx(nullptr);
+  }
   if (_stop_butex == nullptr) _stop_butex = tbthread::butex_create();
   if (_drain_butex == nullptr) _drain_butex = tbthread::butex_create();
 

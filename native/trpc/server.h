@@ -60,6 +60,11 @@ struct ServerOptions {
   // replay with rpc_replay/rpc_press (reference rpc_dump.h:67; sampling
   // rate via the rpc_dump_sample_every flag). Empty = off.
   std::string rpc_dump_path;
+  // TLS (reference ServerOptions.ssl_options / ssl_helper.cpp): both set =
+  // the port ALSO accepts TLS — the first byte is sniffed, so plaintext and
+  // TLS clients share the listener. ALPN advertises h2 + http/1.1.
+  std::string ssl_cert_file;
+  std::string ssl_key_file;
   // Adaptive gate (overrides max_concurrency): a gradient limiter tracks
   // the no-load latency and sheds load when latency inflates past it
   // (reference max_concurrency = "auto",

@@ -84,6 +84,12 @@ int Socket::Create(const Options& opt, SocketId* id) {
   s->_messenger = opt.messenger;
   s->_server_side = opt.server_side;
   s->_tpu_requested = opt.tpu_transport;
+  s->_ssl_ctx = opt.ssl_ctx;
+  s->_sni_host = opt.sni_host;
+  s->_ssl_state.store(opt.ssl_ctx == nullptr ? kSslOff
+                      : opt.server_side      ? kSslSniff
+                                             : kSslHandshaking,
+                      std::memory_order_relaxed);
   s->_user = opt.user;
   s->_ici.store(nullptr, std::memory_order_relaxed);
   s->_error_code = 0;
@@ -166,6 +172,10 @@ void Socket::OnFailed(int error) {
 }
 
 void Socket::OnRecycle() {
+  // SslConn's destructor sends a best-effort close_notify through the fd:
+  // it must run BEFORE close() — after close the number may already belong
+  // to an unrelated descriptor and the TLS record would corrupt it.
+  delete _ssl.exchange(nullptr, std::memory_order_acq_rel);
   int fd = _fd.exchange(-1, std::memory_order_acq_rel);
   if (fd >= 0) {
     EventDispatcher::shard(id()).RemoveConsumer(fd);
@@ -173,6 +183,9 @@ void Socket::OnRecycle() {
   }
   // Last ref: no input fiber or writer can be touching the endpoint.
   delete _ici.exchange(nullptr, std::memory_order_acq_rel);
+  _ssl_ctx.reset();
+  _sni_host.clear();
+  _ssl_state.store(kSslOff, std::memory_order_relaxed);
   if (void* pd = _protocol_data.exchange(nullptr, std::memory_order_acq_rel)) {
     if (_protocol_data_dtor != nullptr) _protocol_data_dtor(pd);
   }
@@ -308,11 +321,15 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
         return;
       }
       if (rc == 0) {
-        // Two park reasons: TCP backpressure (epollout) or an exhausted
-        // tpu:// credit window (the peer still holds our TX blocks).
+        // Three park reasons: TCP backpressure (epollout), an exhausted
+        // tpu:// credit window (the peer still holds our TX blocks), or a
+        // TLS handshake still in flight.
         ttpu::IciEndpoint* ici = _ici.load(std::memory_order_acquire);
+        const int sstate = _ssl_state.load(std::memory_order_acquire);
         if (ici != nullptr && ici->credit_starved()) {
           ici->WaitCredit();
+        } else if (sstate == kSslSniff || sstate == kSslHandshaking) {
+          WaitSslReady();
         } else {
           WaitEpollOut(0);
         }
@@ -373,6 +390,27 @@ int Socket::WriteOnce(WriteRequest* req) {
     if (rc < 0 && errno == 0) errno = TRPC_EFAILEDSOCKET;
     return rc;
   }
+  const int sstate = _ssl_state.load(std::memory_order_acquire);
+  if (sstate == kSslSniff || sstate == kSslHandshaking) {
+    return 0;  // TLS not up: KeepWrite parks in WaitSslReady
+  }
+  if (sstate == kSslOn) {
+    SslConn* conn = _ssl.load(std::memory_order_acquire);
+    while (!req->data.empty()) {
+      // Retry-stable: after EAGAIN the SAME block head is offered again
+      // (OpenSSL without ENABLE_PARTIAL_WRITE requires the same buffer).
+      const std::string_view blk = req->data.backing_block(0);
+      const ssize_t nw = conn->Write(blk.data(), blk.size());
+      if (nw < 0) {
+        if (errno == EAGAIN) return 0;
+        return -1;
+      }
+      req->data.pop_front(static_cast<size_t>(nw));
+      _write_queue_bytes.fetch_sub(nw, std::memory_order_relaxed);
+      GlobalRpcMetrics::instance().bytes_out << nw;
+    }
+    return 1;
+  }
   while (!req->data.empty()) {
     ssize_t nw = req->data.cut_into_file_descriptor(fd);
     if (nw < 0) {
@@ -384,6 +422,19 @@ int Socket::WriteOnce(WriteRequest* req) {
     GlobalRpcMetrics::instance().bytes_out << nw;
   }
   return 1;
+}
+
+// Park until the TLS handshake completes (or the socket fails). Cannot use
+// WaitEpollOut: its poll() fast path sees a WRITABLE fd and returns
+// immediately, which would busy-spin the writer while the handshake runs.
+// Completion paths (DoRead server sniff, ConnectIfNot client, OnFailed)
+// bump the epollout butex after publishing the state change.
+void Socket::WaitSslReady() {
+  const int expected =
+      tbthread::butex_value(_epollout_butex)->load(std::memory_order_acquire);
+  const int sstate = _ssl_state.load(std::memory_order_acquire);
+  if (sstate == kSslOn || sstate == kSslOff || Failed()) return;
+  tbthread::butex_wait(_epollout_butex, expected, nullptr);
 }
 
 int Socket::WaitEpollOut(int64_t deadline_us) {
@@ -525,6 +576,27 @@ int Socket::ConnectIfNot(int64_t deadline_us) {
       return -1;
     }
   }
+  // TLS upgrade: handshake right after the TCP connect, inside the connect
+  // lock (the reference's SSLConnect seam). Input events back off while
+  // _ssl_state is kSslHandshaking; the handshake's own fiber_fd_wait
+  // consumes readability.
+  if (_ssl_ctx != nullptr && !_server_side &&
+      _ssl.load(std::memory_order_acquire) == nullptr) {
+    auto* conn = new SslConn(_ssl_ctx.get(), fd, /*server=*/false, _sni_host);
+    if (!conn->valid() || conn->Handshake(deadline_us) != 0) {
+      delete conn;
+      SetFailed(TRPC_ECONNECT);
+      errno = TRPC_ECONNECT;
+      return -1;
+    }
+    _ssl.store(conn, std::memory_order_release);
+    _ssl_state.store(kSslOn, std::memory_order_release);
+    tbthread::butex_increment_and_wake_all(_epollout_butex);
+    // App data may already sit decrypted inside the SSL object (it rode in
+    // with the final handshake flight); the edge that delivered it was
+    // consumed by the handshake — drain explicitly.
+    StartInputEvent(id());
+  }
   // tpu:// upgrade (the reference's app_connect seam): send HELLO, park
   // until the ACK arrives on the input fiber. _connecting stays true so no
   // caller takes the fast path until the transport is ready.
@@ -547,6 +619,64 @@ ssize_t Socket::DoRead(size_t size_hint) {
   if (fd < 0) {
     errno = ENOTCONN;
     return -1;
+  }
+  int sstate = _ssl_state.load(std::memory_order_acquire);
+  if (sstate == kSslSniff) {
+    // Same-port TLS sniffing (reference ssl_helper): a TLS ClientHello
+    // starts with content-type 0x16; anything else stays plaintext on the
+    // same listener. Runs on the input fiber, which owns the read side.
+    unsigned char first;
+    const ssize_t np = recv(fd, &first, 1, MSG_PEEK);
+    if (np == 0) return 0;  // EOF before any byte
+    if (np < 0) return -1;  // EAGAIN et al
+    if (first != 0x16) {
+      _ssl_state.store(kSslOff, std::memory_order_release);
+      sstate = kSslOff;
+      tbthread::butex_increment_and_wake_all(_epollout_butex);
+    } else {
+      auto* conn = new SslConn(_ssl_ctx.get(), fd, /*server=*/true, "");
+      if (!conn->valid()) {
+        delete conn;
+        errno = EPROTO;
+        return -1;
+      }
+      _ssl_state.store(kSslHandshaking, std::memory_order_release);
+      const int64_t hs_deadline = tbutil::gettimeofday_us() + 10 * 1000000;
+      if (conn->Handshake(hs_deadline) != 0) {
+        delete conn;
+        if (errno == 0) errno = EPROTO;
+        return -1;  // fails the socket via the read-error path
+      }
+      _ssl.store(conn, std::memory_order_release);
+      _ssl_state.store(kSslOn, std::memory_order_release);
+      sstate = kSslOn;
+      // Writers that queued during the handshake park on epollout.
+      tbthread::butex_increment_and_wake_all(_epollout_butex);
+    }
+  } else if (sstate == kSslHandshaking) {
+    // Client handshake in flight (ConnectIfNot drives it): the input
+    // event backs off; the handshake's own fd-wait consumes readability.
+    errno = EAGAIN;
+    return -1;
+  }
+  if (sstate == kSslOn) {
+    SslConn* conn = _ssl.load(std::memory_order_acquire);
+    // TLS records decrypt through a bounce buffer (TLS copies internally
+    // anyway); semantics mirror append_from_file_descriptor.
+    char buf[16 * 1024];
+    ssize_t total = 0;
+    while (static_cast<size_t>(total) < size_hint) {
+      const ssize_t n = conn->Read(buf, sizeof(buf));
+      if (n > 0) {
+        _read_buf.append(buf, static_cast<size_t>(n));
+        total += n;
+        continue;
+      }
+      if (n == 0) return total > 0 ? total : 0;           // EOF
+      if (errno == EAGAIN) return total > 0 ? total : -1;  // drained
+      return -1;  // fatal
+    }
+    return total;
   }
   return _read_buf.append_from_file_descriptor(fd, size_hint);
 }

@@ -19,11 +19,14 @@
 #include <mutex>
 #include <vector>
 
+#include <memory>
+
 #include "tbthread/butex.h"
 #include "tbthread/fiber_id.h"
 #include "tbthread/sync.h"
 #include "tbutil/endpoint.h"
 #include "tbutil/iobuf.h"
+#include "trpc/ssl.h"
 #include "trpc/versioned_ref.h"
 
 namespace ttpu {
@@ -61,6 +64,13 @@ class Socket : public VersionedRefWithId<Socket> {
     // app_connect seam, socket.h RdmaConnect). Servers need no flag: a
     // HELLO arriving on any connection upgrades it.
     bool tpu_transport = false;
+    // TLS. Server side: non-null enables same-port sniffing (a 0x16
+    // handshake byte upgrades the accepted connection; anything else stays
+    // plain — the reference's ssl sniffing). Client side: non-null makes
+    // ConnectIfNot run a TLS handshake right after the TCP connect;
+    // sni_host carries the pre-resolution hostname for SNI.
+    std::shared_ptr<SslContext> ssl_ctx;
+    std::string sni_host;
   };
 
   // -- lifecycle (versioned_ref.h) --
@@ -146,6 +156,9 @@ class Socket : public VersionedRefWithId<Socket> {
   int fd() const { return _fd.load(std::memory_order_acquire); }
   const tbutil::EndPoint& remote_side() const { return _remote_side; }
   bool server_side() const { return _server_side; }
+  // TLS state: established iff non-null (reads/writes then route through
+  // it). ALPN result is on the conn.
+  SslConn* ssl_conn() const { return _ssl.load(std::memory_order_acquire); }
   void* user() const { return _user; }
   InputMessenger* messenger() const { return _messenger; }
   int error_code() const { return _error_code; }
@@ -173,6 +186,7 @@ class Socket : public VersionedRefWithId<Socket> {
   // 0 = EAGAIN with leftover, -1 = error.
   int WriteOnce(WriteRequest* req);
   int WaitEpollOut(int64_t deadline_us);
+  void WaitSslReady();
   void ReleaseAllWrites(WriteRequest* todo, WriteRequest* last, int error);
   static void* ProcessEventThunk(void* arg);
   void ProcessEvent();
@@ -185,6 +199,13 @@ class Socket : public VersionedRefWithId<Socket> {
   std::atomic<ttpu::IciEndpoint*> _ici{nullptr};
   bool _tpu_requested = false;
   bool _server_side = false;
+  // TLS plumbing. _ssl_state: 0 = plain, 1 = server sniff pending, 2 =
+  // handshaking (reads back off), 3 = established (_ssl non-null).
+  enum : int { kSslOff = 0, kSslSniff = 1, kSslHandshaking = 2, kSslOn = 3 };
+  std::shared_ptr<SslContext> _ssl_ctx;
+  std::string _sni_host;
+  std::atomic<int> _ssl_state{kSslOff};
+  std::atomic<SslConn*> _ssl{nullptr};  // owned; freed in OnRecycle
   void* _user = nullptr;
   int _error_code = 0;
   int _preferred_protocol = -1;

@@ -13,8 +13,8 @@ static auto* g_max_pool = TRPC_DEFINE_FLAG(
     "max idle pooled connections kept per endpoint");
 
 int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
-                           bool tpu) {
-  const Key key{pt, tpu};
+                           const ClientTransport& tr) {
+  const Key key{pt, tr.tpu, tr.tls};
   {
     std::lock_guard<std::mutex> lk(_mu);
     auto it = _map.find(key);
@@ -24,7 +24,7 @@ int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
   }
   // Create outside the lock; resolve the create/create race below.
   SocketId sid;
-  if (CreateClientSocket(pt, tpu, &sid) != 0) return -1;
+  if (CreateClientSocket(pt, tr, &sid) != 0) return -1;
   std::lock_guard<std::mutex> lk(_mu);
   auto it = _map.find(key);
   if (it != _map.end() && Socket::Address(it->second, out) == 0) {
@@ -40,17 +40,19 @@ int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
 void SocketMap::Remove(const tbutil::EndPoint& pt, SocketId expected) {
   std::lock_guard<std::mutex> lk(_mu);
   for (bool tpu : {false, true}) {
-    auto it = _map.find(Key{pt, tpu});
-    if (it != _map.end() && it->second == expected) {
-      _map.erase(it);
-      return;
+    for (bool tls : {false, true}) {
+      auto it = _map.find(Key{pt, tpu, tls});
+      if (it != _map.end() && it->second == expected) {
+        _map.erase(it);
+        return;
+      }
     }
   }
 }
 
 int SocketMap::GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
-                         bool tpu) {
-  const Key key{pt, tpu};
+                         const ClientTransport& tr) {
+  const Key key{pt, tr.tpu, tr.tls};
   {
     std::lock_guard<std::mutex> lk(_mu);
     auto it = _pools.find(key);
@@ -66,22 +68,41 @@ int SocketMap::GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
     }
   }
   SocketId sid;
-  if (CreateClientSocket(pt, tpu, &sid) != 0) return -1;
+  if (CreateClientSocket(pt, tr, &sid) != 0) return -1;
   return Socket::Address(sid, out);
 }
 
-int CreateClientSocket(const tbutil::EndPoint& pt, bool tpu, SocketId* sid) {
+namespace {
+// One process-wide client SSL_CTX (no client certs / CA verification yet —
+// matches the reference's default VerifyOptions off).
+std::shared_ptr<SslContext> client_ssl_ctx() {
+  static std::shared_ptr<SslContext>* ctx =
+      new std::shared_ptr<SslContext>(SslContext::NewClient({}));
+  return *ctx;
+}
+}  // namespace
+
+int CreateClientSocket(const tbutil::EndPoint& pt, const ClientTransport& tr,
+                       SocketId* sid) {
   Socket::Options opt;
   opt.fd = -1;  // connect on first use
   opt.remote_side = pt;
   opt.messenger = InputMessenger::client_messenger();
   opt.server_side = false;
-  opt.tpu_transport = tpu;
+  opt.tpu_transport = tr.tpu;
+  if (tr.tls) {
+    opt.ssl_ctx = client_ssl_ctx();
+    if (opt.ssl_ctx == nullptr) {
+      errno = ENOTSUP;  // libssl unavailable
+      return -1;
+    }
+    opt.sni_host = tr.sni_host;
+  }
   return Socket::Create(opt, sid);
 }
 
 int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
-                        bool tpu, int64_t deadline_us,
+                        const ClientTransport& tr, int64_t deadline_us,
                         SocketUniquePtr* out) {
   // Known-blackholed endpoint (prior connect TIMED OUT, revival probes
   // still failing): fail fast instead of burning a connect timeout per RPC.
@@ -92,14 +113,14 @@ int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
   int rc;
   if (ctype == ConnectionType::kShort) {
     SocketId sid;
-    rc = CreateClientSocket(pt, tpu, &sid) == 0 &&
+    rc = CreateClientSocket(pt, tr, &sid) == 0 &&
                  Socket::Address(sid, out) == 0
              ? 0
              : -1;
   } else if (ctype == ConnectionType::kPooled) {
-    rc = SocketMap::global().GetPooled(pt, out, tpu);
+    rc = SocketMap::global().GetPooled(pt, out, tr);
   } else {
-    rc = SocketMap::global().GetOrCreate(pt, out, tpu);
+    rc = SocketMap::global().GetOrCreate(pt, out, tr);
   }
   if (rc != 0) {
     errno = ENOMEM;
@@ -125,11 +146,11 @@ int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
 }
 
 void SocketMap::ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
-                             bool tpu) {
+                             const ClientTransport& tr) {
   SocketUniquePtr sock;
   if (Socket::Address(sid, &sock) != 0) return;  // died in flight
   std::unique_lock<std::mutex> lk(_mu);
-  auto& free_list = _pools[Key{pt, tpu}];
+  auto& free_list = _pools[Key{pt, tr.tpu, tr.tls}];
   if (static_cast<int64_t>(free_list.size()) <
       g_max_pool->load(std::memory_order_relaxed)) {
     free_list.push_back(sid);
@@ -139,9 +160,10 @@ void SocketMap::ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
   sock->SetFailed(ECANCELED);  // pool full: close instead of park
 }
 
-size_t SocketMap::PooledIdleCount(const tbutil::EndPoint& pt, bool tpu) {
+size_t SocketMap::PooledIdleCount(const tbutil::EndPoint& pt,
+                                  const ClientTransport& tr) {
   std::lock_guard<std::mutex> lk(_mu);
-  auto it = _pools.find(Key{pt, tpu});
+  auto it = _pools.find(Key{pt, tr.tpu, tr.tls});
   return it != _pools.end() ? it->second.size() : 0;
 }
 

@@ -28,10 +28,24 @@ namespace trpc {
 //    protocols whose wire has no correlation id (HTTP/1.x w/o pipelining).
 enum class ConnectionType : uint8_t { kSingle = 0, kPooled = 1, kShort = 2 };
 
+// Client transport selection: tpu:// upgrade, TLS, and the SNI hostname.
+// tpu/tls are part of the connection-cache key (plain, tpu and tls
+// connections to one endpoint are distinct sockets); sni_host is carried to
+// the socket but keyed by endpoint. The bool constructor keeps legacy
+// call sites (`GetOrCreate(pt, &s, /*tpu=*/true)`) working.
+struct ClientTransport {
+  bool tpu = false;
+  bool tls = false;
+  std::string sni_host;
+  ClientTransport() = default;
+  ClientTransport(bool tpu_) : tpu(tpu_) {}  // NOLINT: legacy bool-tpu sites
+};
+
 // The one way client sockets are made (shared by the single/pooled/short
 // paths): fd = -1 (connect on first use), client messenger, optional tpu://
-// transport upgrade.
-int CreateClientSocket(const tbutil::EndPoint& pt, bool tpu, SocketId* sid);
+// or TLS transport.
+int CreateClientSocket(const tbutil::EndPoint& pt, const ClientTransport& tr,
+                       SocketId* sid);
 
 // Acquire a CONNECTED client socket per the connection type (the one
 // acquisition path shared by IssueRPC and the backup-request hedge). On
@@ -39,7 +53,8 @@ int CreateClientSocket(const tbutil::EndPoint& pt, bool tpu, SocketId* sid);
 // a failed shared (single) socket is evicted from the map but NOT SetFailed —
 // other RPCs may hold pending ids on it.
 int AcquireClientSocket(ConnectionType ctype, const tbutil::EndPoint& pt,
-                        bool tpu, int64_t deadline_us, SocketUniquePtr* out);
+                        const ClientTransport& tr, int64_t deadline_us,
+                        SocketUniquePtr* out);
 
 class SocketMap {
  public:
@@ -49,24 +64,26 @@ class SocketMap {
   // tpu:// ICI transport — tpu and plain connections to one endpoint are
   // distinct cache entries (a process may use both, e.g. A/B benches).
   int GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
-                  bool tpu = false);
+                  const ClientTransport& tr = {});
 
   // Drop the cache entry (e.g. after SetFailed, to force a fresh connect).
   void Remove(const tbutil::EndPoint& pt, SocketId expected);
 
-  // Borrow an exclusive socket from the (pt, tpu) pool, creating a fresh one
-  // when the free-list is empty. The caller owns it for one RPC; hand it
-  // back with ReturnPooled on clean completion or SetFailed it otherwise.
+  // Borrow an exclusive socket from the (pt, transport) pool, creating a
+  // fresh one when the free-list is empty. The caller owns it for one RPC;
+  // hand it back with ReturnPooled on clean completion or SetFailed it
+  // otherwise.
   int GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
-                bool tpu = false);
+                const ClientTransport& tr = {});
 
   // Return a healthy borrowed socket for reuse. Failed sockets and overflow
   // past max_connection_pool_size are dropped (closed).
   void ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
-                    bool tpu = false);
+                    const ClientTransport& tr = {});
 
-  // Idle sockets currently parked in the (pt, tpu) free-list (tests/vars).
-  size_t PooledIdleCount(const tbutil::EndPoint& pt, bool tpu = false);
+  // Idle sockets parked in the (pt, transport) free-list (tests/vars).
+  size_t PooledIdleCount(const tbutil::EndPoint& pt,
+                         const ClientTransport& tr = {});
 
   static SocketMap& global();
 
@@ -74,13 +91,15 @@ class SocketMap {
   struct Key {
     tbutil::EndPoint pt;
     bool tpu;
+    bool tls;
     bool operator==(const Key& o) const {
-      return pt == o.pt && tpu == o.tpu;
+      return pt == o.pt && tpu == o.tpu && tls == o.tls;
     }
   };
   struct KeyHasher {
     size_t operator()(const Key& k) const {
-      return tbutil::EndPointHasher()(k.pt) * 2 + (k.tpu ? 1 : 0);
+      return tbutil::EndPointHasher()(k.pt) * 4 + (k.tpu ? 1 : 0) +
+             (k.tls ? 2 : 0);
     }
   };
   std::mutex _mu;

@@ -74,3 +74,101 @@ def test_grpc_unknown_service(echo_server):
         with pytest.raises(grpc.RpcError) as err:
             call(b"x", timeout=10)
         assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+# ---- gRPC over TLS (ALPN h2 + same-port sniffing) ----
+
+
+@pytest.fixture(scope="module")
+def tls_material(tmp_path_factory):
+    """Self-signed localhost cert generated on the fly."""
+    from cryptography import x509
+    from cryptography.x509.oid import NameOID
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    import datetime
+    import ipaddress
+
+    d = tmp_path_factory.mktemp("tls")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"),
+                 x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+            critical=False)
+        .sign(key, hashes.SHA256()))
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    cert_path = d / "cert.pem"
+    key_path = d / "key.pem"
+    cert_path.write_bytes(cert_pem)
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path), cert_pem
+
+
+@pytest.fixture(scope="module")
+def tls_echo_server(tls_material):
+    from brpc_tpu.runtime import native
+
+    cert_path, key_path, _ = tls_material
+    server = native.Server()
+    server.add_echo_service()
+    port = server.start("127.0.0.1:0", ssl_cert=cert_path, ssl_key=key_path)
+    assert port > 0
+    yield f"127.0.0.1:{port}"
+    server.stop()
+
+
+def test_grpc_over_tls(tls_echo_server, tls_material):
+    _, _, cert_pem = tls_material
+    creds = grpc.ssl_channel_credentials(root_certificates=cert_pem)
+    opts = (("grpc.ssl_target_name_override", "localhost"),)
+    with grpc.secure_channel(tls_echo_server, creds, options=opts) as channel:
+        call = channel.unary_unary(
+            "/EchoService/Echo",
+            request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        for i in range(10):
+            payload = (f"tls-{i}-" + "y" * (i * 531 % 3000)).encode()
+            assert call(payload, timeout=10) == payload
+
+
+def test_grpc_plaintext_on_tls_port(tls_echo_server):
+    # The sniffing listener still answers insecure h2c on the same port.
+    with grpc.insecure_channel(tls_echo_server) as channel:
+        call = channel.unary_unary(
+            "/EchoService/Echo",
+            request_serializer=_ident,
+            response_deserializer=_ident,
+        )
+        assert call(b"plaintext-on-tls-port", timeout=10) == \
+            b"plaintext-on-tls-port"
+
+
+def test_https_console(tls_echo_server, tls_material):
+    """The builtin console is reachable via https on the same port."""
+    import ssl
+    import urllib.request
+
+    cert_path, _, _ = tls_material
+    ctx = ssl.create_default_context(cafile=cert_path)
+    ctx.check_hostname = False  # IP target; cert has the SAN anyway
+    host, port = tls_echo_server.split(":")
+    with urllib.request.urlopen(
+            f"https://{host}:{port}/health", context=ctx, timeout=10) as r:
+        assert r.status == 200
+        assert b"ok" in r.read().lower()
