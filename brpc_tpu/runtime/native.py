@@ -84,7 +84,7 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_bench_echo_ex.argtypes = [
         ctypes.c_size_t, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_double),
-        ctypes.POINTER(ctypes.c_double)]
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
     _lib = L
     return L
 
@@ -229,15 +229,16 @@ def bench_echo_ex(payload_size: int, seconds: int = 2, concurrency: int = 4,
                   transport: str = "tcp", conn_type: str = "single"):
     """One bench point with full control.
 
-    Returns (oneway_bytes_per_sec, calls_per_sec, p99_us).
+    Returns (oneway_bytes_per_sec, calls_per_sec, p50_us, p99_us).
     transport: "tcp" | "tpu" (shm ICI transport over the loopback control
     channel). conn_type: "single" | "pooled" | "short".
     """
     qps = ctypes.c_double()
+    p50 = ctypes.c_double()
     p99 = ctypes.c_double()
     bps = lib().tbrpc_bench_echo_ex(
         payload_size, seconds, concurrency,
         {"tcp": 0, "tpu": 1}[transport],
         {"single": 0, "pooled": 1, "short": 2}[conn_type],
-        ctypes.byref(qps), ctypes.byref(p99))
-    return bps, qps.value, p99.value
+        ctypes.byref(qps), ctypes.byref(p50), ctypes.byref(p99))
+    return bps, qps.value, p50.value, p99.value

@@ -256,7 +256,7 @@ double tbrpc_bench_echo_throughput(size_t payload_size, int seconds,
 
 double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
                            int transport, int conn_type, double* qps_out,
-                           double* p99_us_out) {
+                           double* p50_us_out, double* p99_us_out) {
   BenchEnv env(transport == 1, conn_type);
   if (!env.ok) return -1;
   if (concurrency < 1) concurrency = 1;
@@ -298,10 +298,14 @@ double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
   if (qps_out != nullptr) {
     *qps_out = static_cast<double>(total_calls.load()) / elapsed_s;
   }
-  if (p99_us_out != nullptr) {
-    *p99_us_out = 0;
-    if (!latencies.empty()) {
-      std::sort(latencies.begin(), latencies.end());
+  if (p50_us_out != nullptr) *p50_us_out = 0;
+  if (p99_us_out != nullptr) *p99_us_out = 0;
+  if (!latencies.empty()) {
+    std::sort(latencies.begin(), latencies.end());
+    if (p50_us_out != nullptr) {
+      *p50_us_out = static_cast<double>(latencies[latencies.size() / 2]);
+    }
+    if (p99_us_out != nullptr) {
       *p99_us_out = static_cast<double>(
           latencies[static_cast<size_t>(latencies.size() * 0.99)]);
     }

@@ -67,6 +67,6 @@ double tbrpc_bench_echo_qps(int seconds, int concurrency, double* p99_us_out);
 // p99 round-trip latency (microseconds).
 double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
                            int transport, int conn_type, double* qps_out,
-                           double* p99_us_out);
+                           double* p50_us_out, double* p99_us_out);
 
 }  // extern "C"

@@ -1,0 +1,201 @@
+#include "tbutil/cpu_profiler.h"
+
+#include <dlfcn.h>
+#include <signal.h>
+#include <sys/time.h>
+#include <ucontext.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <vector>
+
+#include "tbthread/task_group.h"
+#include "tbthread/task_meta.h"
+
+namespace tbutil {
+
+namespace {
+
+constexpr size_t kMaxDepth = 32;
+constexpr size_t kMaxSamples = 65536;
+
+struct Sample {
+  uint32_t depth;
+  void* pcs[kMaxDepth];
+};
+
+// Preallocated flat ring; slots are claimed with a fetch_add so concurrent
+// SIGPROF deliveries on different threads never collide. No reuse within a
+// run: past the cap, samples are dropped (counted).
+Sample* g_samples = nullptr;
+std::atomic<size_t> g_head{0};
+std::atomic<size_t> g_dropped{0};
+std::atomic<bool> g_running{false};
+
+// Signal-safe rbp-chain walk bounded to [lo, hi).
+uint32_t walk(uintptr_t rip, uintptr_t rbp, uintptr_t lo, uintptr_t hi,
+              void** out) {
+  uint32_t n = 0;
+  out[n++] = reinterpret_cast<void*>(rip);
+  while (n < kMaxDepth) {
+    if (rbp < lo || rbp + 16 > hi || (rbp & 7) != 0) break;
+    void* ret = *reinterpret_cast<void**>(rbp + 8);
+    if (ret == nullptr) break;
+    out[n++] = ret;
+    const uintptr_t next = *reinterpret_cast<uintptr_t*>(rbp);
+    if (next <= rbp) break;  // frames must grow upward
+    rbp = next;
+  }
+  return n;
+}
+
+void sigprof_handler(int, siginfo_t*, void* ucv) {
+  if (!g_running.load(std::memory_order_relaxed)) return;
+  const size_t slot = g_head.fetch_add(1, std::memory_order_relaxed);
+  if (slot >= kMaxSamples) {
+    g_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  const auto* uc = static_cast<const ucontext_t*>(ucv);
+  const uintptr_t rip = uc->uc_mcontext.gregs[REG_RIP];
+  const uintptr_t rbp = uc->uc_mcontext.gregs[REG_RBP];
+  const uintptr_t rsp = uc->uc_mcontext.gregs[REG_RSP];
+  // Stack bounds must be EXACT before any dereference: a garbage rbp (code
+  // without frame pointers — libc, vdso) that lands inside a heuristic
+  // window would fault in the handler and kill the process. Fibers have
+  // known bounds (TLS meta -> StackContainer); everything else records the
+  // PC only — which is where the flat profile comes from anyway, and RPC
+  // work runs on fibers.
+  uintptr_t lo = 1;
+  uintptr_t hi = 0;  // empty window: PC-only by default
+  if (tbthread::TaskGroup* g = tbthread::TaskGroup::current()) {
+    if (tbthread::TaskMeta* m = g->cur_meta()) {
+      if (m->stack != nullptr && m->stack->stack_base != nullptr) {
+        const uintptr_t base =
+            reinterpret_cast<uintptr_t>(m->stack->stack_base);
+        if (rsp >= base && rsp < base + m->stack->stack_size) {
+          lo = base;
+          hi = base + m->stack->stack_size;
+        }
+      }
+    }
+  }
+  Sample& s = g_samples[slot];
+  s.depth = walk(rip, rbp, lo, hi, s.pcs);
+}
+
+std::string symbolize(void* pc) {
+  Dl_info info;
+  char buf[256];
+  if (dladdr(pc, &info) != 0 && info.dli_sname != nullptr) {
+    return info.dli_sname;
+  }
+  if (dladdr(pc, &info) != 0 && info.dli_fname != nullptr) {
+    const char* base = strrchr(info.dli_fname, '/');
+    snprintf(buf, sizeof(buf), "%s@%p", base != nullptr ? base + 1
+                                                        : info.dli_fname,
+             pc);
+    return buf;
+  }
+  snprintf(buf, sizeof(buf), "%p", pc);
+  return buf;
+}
+
+}  // namespace
+
+bool CpuProfiler::Start(int hz) {
+  bool expected = false;
+  if (!g_running.compare_exchange_strong(expected, true)) return false;
+  if (g_samples == nullptr) {
+    g_samples = new Sample[kMaxSamples];
+  }
+  g_head.store(0, std::memory_order_relaxed);
+  g_dropped.store(0, std::memory_order_relaxed);
+  struct sigaction sa{};
+  sa.sa_sigaction = sigprof_handler;
+  sa.sa_flags = SA_SIGINFO | SA_RESTART;
+  sigemptyset(&sa.sa_mask);
+  sigaction(SIGPROF, &sa, nullptr);
+  itimerval tv{};
+  if (hz <= 0) hz = 100;
+  tv.it_interval.tv_usec = 1000000 / hz;
+  tv.it_value = tv.it_interval;
+  setitimer(ITIMER_PROF, &tv, nullptr);
+  return true;
+}
+
+void CpuProfiler::Stop() {
+  itimerval tv{};
+  setitimer(ITIMER_PROF, &tv, nullptr);
+  g_running.store(false, std::memory_order_release);
+}
+
+bool CpuProfiler::running() { return g_running.load(); }
+
+size_t CpuProfiler::sample_count() {
+  const size_t n = g_head.load(std::memory_order_acquire);
+  return n < kMaxSamples ? n : kMaxSamples;
+}
+
+size_t CpuProfiler::dropped_count() { return g_dropped.load(); }
+
+std::string CpuProfiler::Collapsed() {
+  const size_t n = sample_count();
+  // Key stacks by their PC sequence, outermost first (collapsed format).
+  std::map<std::vector<void*>, size_t> agg;
+  for (size_t i = 0; i < n; ++i) {
+    const Sample& s = g_samples[i];
+    std::vector<void*> key(s.depth);
+    for (uint32_t d = 0; d < s.depth; ++d) {
+      key[d] = s.pcs[s.depth - 1 - d];  // reverse: outer ... inner
+    }
+    ++agg[key];
+  }
+  std::string out;
+  for (const auto& [stack, count] : agg) {
+    std::string line;
+    for (size_t i = 0; i < stack.size(); ++i) {
+      if (i != 0) line += ';';
+      line += symbolize(stack[i]);
+    }
+    char tail[32];
+    snprintf(tail, sizeof(tail), " %zu\n", count);
+    out += line;
+    out += tail;
+  }
+  return out;
+}
+
+std::string CpuProfiler::FlatText(size_t topn) {
+  const size_t n = sample_count();
+  std::map<void*, size_t> self;  // leaf pc -> count
+  for (size_t i = 0; i < n; ++i) {
+    if (g_samples[i].depth > 0) ++self[g_samples[i].pcs[0]];
+  }
+  // Merge by symbol (a function has many sample PCs).
+  std::map<std::string, size_t> by_sym;
+  for (const auto& [pc, count] : self) {
+    by_sym[symbolize(pc)] += count;
+  }
+  std::vector<std::pair<size_t, std::string>> ranked;
+  ranked.reserve(by_sym.size());
+  for (auto& [sym, count] : by_sym) ranked.emplace_back(count, sym);
+  std::sort(ranked.rbegin(), ranked.rend());
+  std::string out;
+  char line[512];
+  snprintf(line, sizeof(line), "%zu samples (%zu dropped)\n", n,
+           dropped_count());
+  out += line;
+  for (size_t i = 0; i < ranked.size() && i < topn; ++i) {
+    snprintf(line, sizeof(line), "%6zu  %5.1f%%  %s\n", ranked[i].first,
+             n > 0 ? 100.0 * ranked[i].first / n : 0.0,
+             ranked[i].second.c_str());
+    out += line;
+  }
+  return out;
+}
+
+}  // namespace tbutil

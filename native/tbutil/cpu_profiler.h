@@ -1,0 +1,40 @@
+// Sampling CPU profiler: SIGPROF (ITIMER_PROF, CPU-time driven) +
+// a signal-safe frame-pointer stack walk into a preallocated ring.
+// Answers "where is the CPU going" on a live server — the role the
+// reference fills with gperftools' ProfilerStart (builtin/hotspots_service
+// .cpp:36 weak-links it); this one is self-contained: no tcmalloc, no
+// dependencies, render as collapsed stacks (flamegraph.pl-compatible) or a
+// flat top-N.
+//
+// The build keeps -fno-omit-frame-pointer, so walking rbp chains is valid;
+// every dereference is bounds-checked against the sampled thread's stack
+// to survive races with frames being torn down.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace tbutil {
+
+class CpuProfiler {
+ public:
+  // Starts sampling every thread that burns CPU (SIGPROF is delivered to a
+  // running thread, which is exactly the distribution we want). hz: sample
+  // frequency in CPU-seconds (default 100). False if already running.
+  static bool Start(int hz = 100);
+  // Stops sampling. Safe to call when not running.
+  static void Stop();
+  static bool running();
+
+  // Aggregated results since Start (callable after Stop or live).
+  // Collapsed stacks, one per line: "outer;...;inner <count>".
+  static std::string Collapsed();
+  // Human-readable flat profile: top `n` frames by inclusive sample count,
+  // leaf-attributed ("self") first.
+  static std::string FlatText(size_t n = 40);
+  static size_t sample_count();
+  static size_t dropped_count();  // ring overflows (sampling too fast)
+};
+
+}  // namespace tbutil

@@ -1,0 +1,47 @@
+// CPU profiler: a deliberately hot function must dominate the profile —
+// the reference proves its hotspots service the same way
+// (test: profile a busy loop, check attribution).
+#include <string>
+
+#include "mini_test.h"
+#include "tbutil/cpu_profiler.h"
+#include "tbutil/time.h"
+
+// noinline + C linkage: a stable symbol the assertion can look for.
+extern "C" __attribute__((noinline)) uint64_t profiler_test_busy_loop(
+    int64_t until_us) {
+  volatile uint64_t acc = 1;
+  while (tbutil::monotonic_time_us() < until_us) {
+    for (int i = 0; i < 4096; ++i) acc = acc * 2862933555777941757ULL + 3037;
+  }
+  return acc;
+}
+
+TEST_CASE(cpu_profiler_attributes_busy_loop) {
+  using tbutil::CpuProfiler;
+  ASSERT_TRUE(CpuProfiler::Start(250));
+  profiler_test_busy_loop(tbutil::monotonic_time_us() + 1200 * 1000);
+  CpuProfiler::Stop();
+  ASSERT_TRUE(CpuProfiler::sample_count() > 50);
+  const std::string flat = CpuProfiler::FlatText(5);
+  fprintf(stderr, "%s", flat.c_str());
+  // The busy loop must be the top line (>= 80% of samples). FlatText is
+  // ranked, so parse the first entry.
+  const size_t nl = flat.find('\n');
+  ASSERT_TRUE(nl != std::string::npos);
+  const std::string top = flat.substr(nl + 1, flat.find('\n', nl + 1) - nl - 1);
+  ASSERT_TRUE(top.find("profiler_test_busy_loop") != std::string::npos);
+  // Extract the percent column ("%5.1f%%").
+  const size_t pct_end = top.find('%');
+  ASSERT_TRUE(pct_end != std::string::npos);
+  size_t pct_start = top.rfind(' ', pct_end);
+  // The percent field is right-aligned; scan back over the number.
+  pct_start = top.find_last_of(' ', pct_end - 1) + 1;
+  const double pct = atof(top.substr(pct_start, pct_end - pct_start).c_str());
+  ASSERT_TRUE(pct >= 80.0);
+  // Restartable.
+  ASSERT_TRUE(CpuProfiler::Start(100));
+  CpuProfiler::Stop();
+}
+
+TEST_MAIN

@@ -6,7 +6,9 @@
 #include <string>
 #include <vector>
 
+#include "tbthread/fiber.h"
 #include "tbthread/tracer.h"
+#include "tbutil/cpu_profiler.h"
 #include "tbutil/time.h"
 #include "tbvar/prometheus.h"
 #include "tbvar/variable.h"
@@ -34,6 +36,7 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/health\">/health</a></li>"
       "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
       "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
+      "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
       "</ul></body></html>";
 }
 
@@ -240,6 +243,46 @@ void rpcz_page(const HttpRequest& req, HttpResponse* resp) {
   }
 }
 
+// /hotspots: sampling CPU profile (reference builtin/hotspots_service.cpp,
+// backed by our own SIGPROF profiler instead of gperftools).
+//   /hotspots?seconds=N   profile N s (default 5, max 60), flat top-40
+//   &view=collapsed       flamegraph.pl-compatible collapsed stacks
+void hotspots_page(const HttpRequest& req, HttpResponse* resp) {
+  int seconds = 5;
+  const std::string s = req.query_param("seconds");
+  if (!s.empty()) seconds = atoi(s.c_str());
+  if (seconds < 1) seconds = 1;
+  if (seconds > 60) seconds = 60;
+  // One profile at a time, held through RENDERING too: a second Start()
+  // would reset and rewrite the sample buffer under the first render.
+  // try_lock (never block): a fiber parking while holding a std::mutex
+  // could wedge a single-worker scheduler.
+  static std::mutex profile_mu;
+  if (!profile_mu.try_lock()) {
+    resp->status = 503;
+    resp->body = "a profile is already running; retry shortly\n";
+    return;
+  }
+  std::lock_guard<std::mutex> lk(profile_mu, std::adopt_lock);
+  if (!tbutil::CpuProfiler::Start()) {
+    resp->status = 503;
+    resp->body = "profiler busy\n";
+    return;
+  }
+  // Parks only this handler's fiber; the server keeps serving (and thereby
+  // generates the very samples being collected).
+  tbthread::fiber_usleep(static_cast<uint64_t>(seconds) * 1000000);
+  tbutil::CpuProfiler::Stop();
+  if (req.query_param("view") == "collapsed") {
+    resp->body = tbutil::CpuProfiler::Collapsed();
+  } else {
+    resp->body = tbutil::CpuProfiler::FlatText();
+    resp->body +=
+        "\n(collapsed stacks for flamegraphs: /hotspots?seconds=N"
+        "&view=collapsed)\n";
+  }
+}
+
 }  // namespace
 
 void RegisterBuiltinConsole() {
@@ -257,6 +300,7 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/health", health_page);
     RegisterHttpHandler("/rpcz", rpcz_page);
     RegisterHttpHandler("/fibers", fibers_page);
+    RegisterHttpHandler("/hotspots", hotspots_page);
   });
 }
 

@@ -5,6 +5,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sys/uio.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -313,13 +314,14 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
         ReleaseAllWrites(todo, last, err);
         return;
       }
-      int rc = WriteOnce(todo);
+      int rc = WriteBatch(&todo, last);
       if (rc < 0) {
         int err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
         SetFailed(err);
         ReleaseAllWrites(todo, last, err);
         return;
       }
+      if (rc == 1) break;  // chain drained; try to retire the queue
       if (rc == 0) {
         // Three park reasons: TCP backpressure (epollout), an exhausted
         // tpu:// credit window (the peer still holds our TX blocks), or a
@@ -335,9 +337,6 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
         }
         continue;
       }
-      WriteRequest* written = todo;
-      todo = todo->next.load(std::memory_order_relaxed);
-      if (written != last) tbutil::return_object(written);
     }
     // Everything claimed is on the wire: try to retire the queue.
     WriteRequest* expected = last;
@@ -369,6 +368,111 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
     todo = fifo;
     last = expected;
   }
+}
+
+int Socket::WriteBatch(WriteRequest** todo, WriteRequest* last) {
+  WriteRequest* head = *todo;
+  if (head == nullptr) return 1;
+  // tpu:// path: move the WHOLE chain into blocks/inline control bytes,
+  // one flush syscall at the chain's end (WriteMessage flush_now=false
+  // batches; starvation/backpressure force the flush before any park).
+  ttpu::IciEndpoint* ici = _ici.load(std::memory_order_acquire);
+  if (ici != nullptr && ici->active()) {
+    const int ifd = _fd.load(std::memory_order_acquire);
+    if (ifd < 0) {
+      errno = ENOTCONN;
+      return -1;
+    }
+    WriteRequest* r = head;
+    while (r != nullptr) {
+      WriteRequest* next = r->next.load(std::memory_order_relaxed);
+      const size_t before = r->data.size();
+      const int rc = ici->WriteMessage(&r->data, ifd,
+                                       /*flush_now=*/next == nullptr);
+      _write_queue_bytes.fetch_sub(
+          static_cast<int64_t>(before - r->data.size()),
+          std::memory_order_relaxed);
+      if (rc < 0) {
+        if (errno == 0) errno = TRPC_EFAILEDSOCKET;
+        *todo = r;
+        return -1;
+      }
+      if (rc == 0) {
+        *todo = r;  // park; the flush already ran inside WriteMessage
+        return 0;
+      }
+      if (r != last) tbutil::return_object(r);
+      r = next;
+    }
+    *todo = nullptr;
+    return 1;
+  }
+  // TLS records: delegate one request at a time (SSL_write batches records
+  // internally anyway).
+  if (_ssl_state.load(std::memory_order_acquire) != kSslOff) {
+    const int rc = WriteOnce(head);
+    if (rc <= 0) return rc;
+    *todo = head->next.load(std::memory_order_relaxed);
+    if (head != last) tbutil::return_object(head);
+    return *todo == nullptr ? 1 : 2;  // 2 = progress, keep going
+  }
+  const int fd = _fd.load(std::memory_order_acquire);
+  if (fd < 0) {
+    errno = ENOTCONN;
+    return -1;
+  }
+  constexpr int kMaxIov = 64;
+  iovec iov[kMaxIov];
+  int niov = 0;
+  for (WriteRequest* r = head; r != nullptr && niov < kMaxIov;
+       r = r->next.load(std::memory_order_relaxed)) {
+    const size_t nblocks = r->data.backing_block_num();
+    for (size_t b = 0; b < nblocks && niov < kMaxIov; ++b) {
+      const std::string_view blk = r->data.backing_block(b);
+      if (blk.empty()) continue;
+      iov[niov].iov_base = const_cast<char*>(blk.data());
+      iov[niov].iov_len = blk.size();
+      ++niov;
+    }
+  }
+  size_t total_iov = 0;
+  for (int i = 0; i < niov; ++i) total_iov += iov[i].iov_len;
+  ssize_t nw = 0;
+  if (niov > 0) {
+    do {
+      nw = writev(fd, iov, niov);
+    } while (nw < 0 && errno == EINTR);
+    if (nw < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+      return -1;
+    }
+    _write_queue_bytes.fetch_sub(nw, std::memory_order_relaxed);
+    GlobalRpcMetrics::instance().bytes_out << nw;
+  }
+  const bool kernel_full = static_cast<size_t>(nw) < total_iov;
+  // Distribute the written bytes over the chain; release fully-drained
+  // requests (keep `last`: it is the retire-CAS detach point).
+  size_t remaining = static_cast<size_t>(nw);
+  WriteRequest* r = head;
+  while (r != nullptr) {
+    const size_t sz = r->data.size();
+    if (sz > remaining) {
+      r->data.pop_front(remaining);
+      *todo = r;
+      // Park only on kernel backpressure; a chain cut by the iov cap has
+      // more writable bytes right now.
+      return kernel_full ? 0 : 2;
+    }
+    remaining -= r->data.pop_front(sz);
+    WriteRequest* next = r->next.load(std::memory_order_relaxed);
+    if (r != last) {
+      tbutil::return_object(r);
+    }
+    r = next;
+  }
+  *todo = r;
+  if (r == nullptr) return 1;
+  return kernel_full ? 0 : 2;  // beyond-cap requests still pending
 }
 
 int Socket::WriteOnce(WriteRequest* req) {
@@ -674,7 +778,10 @@ ssize_t Socket::DoRead(size_t size_hint) {
       }
       if (n == 0) return total > 0 ? total : 0;           // EOF
       if (errno == EAGAIN) return total > 0 ? total : -1;  // drained
-      return -1;  // fatal
+      // Fatal TLS error AFTER decrypted bytes landed this call: surface
+      // the bytes first (a complete response may be among them — the
+      // respond-then-close pattern); the error re-raises on the next call.
+      return total > 0 ? total : -1;
     }
     return total;
   }

@@ -185,6 +185,13 @@ class Socket : public VersionedRefWithId<Socket> {
   // Write out req->data as far as the kernel accepts. 1 = fully written,
   // 0 = EAGAIN with leftover, -1 = error.
   int WriteOnce(WriteRequest* req);
+  // Plain-TCP fast path: gather the claimed chain [*todo ..] into ONE
+  // writev (small pipelined RPCs collapse into a single syscall — 38% of
+  // the 64B-echo profile was per-request writev calls). Fully-written
+  // requests other than `last` are released and *todo advances past them.
+  // Returns like WriteOnce, where 1 = chain empty. Falls back to
+  // WriteOnce(head) for tpu:///TLS sockets.
+  int WriteBatch(WriteRequest** todo, WriteRequest* last);
   int WaitEpollOut(int64_t deadline_us);
   void WaitSslReady();
   void ReleaseAllWrites(WriteRequest* todo, WriteRequest* last, int error);

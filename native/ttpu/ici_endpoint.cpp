@@ -194,7 +194,7 @@ void IciEndpoint::OnSocketFailed() {
 
 // ---------------- sender half ----------------
 
-int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd) {
+int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now) {
   const size_t inline_max =
       static_cast<size_t>(g_ici_inline_max->load(std::memory_order_relaxed));
   // Out-of-band control first (credits queued by releasing fibers): they
@@ -248,6 +248,10 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd) {
       if (!msg->empty()) starved = true;  // out of blocks mid-message
     }
   }
+  // Batched pass with progress and no park pending: defer the flush to the
+  // caller's later flushing call (starvation falls through — the caller is
+  // about to park and the doorbell must be on the wire first).
+  if (!flush_now && !starved && msg->empty()) return 1;
   // Flush control bytes (doorbells + inline messages) to the TCP fd.
   while (!_pending_ctrl.empty()) {
     ssize_t nw = _pending_ctrl.cut_into_file_descriptor(fd);

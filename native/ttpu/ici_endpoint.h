@@ -76,7 +76,13 @@ class IciEndpoint {
   // bytes), then flush control bytes to fd. Returns 1 = fully handed off,
   // 0 = out of credit or TCP backpressure (caller parks; see
   // credit_starved), -1 = hard error. Consumed bytes are removed from *msg.
-  int WriteMessage(tbutil::IOBuf* msg, int fd);
+  // flush_now=false batches: control bytes accumulate in _pending_ctrl and
+  // the CALLER promises a later flushing call on this same writer pass
+  // (socket WriteBatch flushes on the chain's last request) — one syscall
+  // carries many small messages' doorbells/inline bytes. Starvation or
+  // backpressure still forces the flush (a parked writer must never sit on
+  // an unflushed doorbell).
+  int WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now = true);
   // Park until a credit arrives (bounded safety timeout; caller re-checks).
   void WaitCredit();
   bool credit_starved() const {
