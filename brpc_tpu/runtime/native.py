@@ -63,6 +63,9 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_channel_create.restype = ctypes.c_void_p
     L.tbrpc_channel_create.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    L.tbrpc_channel_create_ex.restype = ctypes.c_void_p
+    L.tbrpc_channel_create_ex.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
     L.tbrpc_channel_destroy.argtypes = [ctypes.c_void_p]
     L.tbrpc_call.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
@@ -170,10 +173,18 @@ class Server:
 class Channel:
     """Client stub to one server ("ip:port")."""
 
-    def __init__(self, addr: str, timeout_ms: int = 1000, max_retry: int = 3):
+    def __init__(self, addr: str, timeout_ms: int = 1000, max_retry: int = 3,
+                 protocol: str = "tstd"):
+        """protocol: "tstd" (native framing) or "grpc" (gRPC over HTTP/2 —
+        dials any standard gRPC server)."""
         self._L = lib()
-        self._h = self._L.tbrpc_channel_create(
-            addr.encode(), timeout_ms, max_retry)
+        protos = {"tstd": 0, "grpc": 5}
+        if protocol not in protos:
+            raise ValueError(
+                f"unknown protocol {protocol!r}; choose from {sorted(protos)}")
+        proto = protos[protocol]
+        self._h = self._L.tbrpc_channel_create_ex(
+            addr.encode(), timeout_ms, max_retry, proto)
         if not self._h:
             raise RuntimeError(f"channel init to {addr} failed")
 

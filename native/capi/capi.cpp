@@ -147,6 +147,21 @@ void* tbrpc_channel_create(const char* addr, int64_t timeout_ms,
   return box;
 }
 
+// protocol: 0 = tstd (default), 5 = gRPC over HTTP/2 (kH2ProtocolIndex).
+void* tbrpc_channel_create_ex(const char* addr, int64_t timeout_ms,
+                              int max_retry, int protocol) {
+  auto* box = new ChannelBox;
+  ChannelOptions opts;
+  opts.timeout_ms = timeout_ms;
+  opts.max_retry = max_retry;
+  opts.protocol = protocol;
+  if (box->channel.Init(addr, &opts) != 0) {
+    delete box;
+    return nullptr;
+  }
+  return box;
+}
+
 void tbrpc_channel_destroy(void* channel) {
   delete static_cast<ChannelBox*>(channel);
 }

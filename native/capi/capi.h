@@ -35,6 +35,9 @@ int tbrpc_server_add_callback_service(void* server, const char* name,
                                       tbrpc_handler_cb cb, void* ctx);
 
 // ---- channel ----
+// protocol: 0 = tstd (default), 5 = gRPC over HTTP/2.
+void* tbrpc_channel_create_ex(const char* addr, int64_t timeout_ms,
+                              int max_retry, int protocol);
 void* tbrpc_channel_create(const char* addr, int64_t timeout_ms,
                            int max_retry);
 void tbrpc_channel_destroy(void* channel);

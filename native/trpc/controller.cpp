@@ -126,8 +126,15 @@ void Controller::IssueRPC() {
       sock->AddPendingId(attempt);
       tbutil::IOBuf packed;
       proto->pack_request(&packed, this, attempt, _service_method,
-                          _request_payload);
-      if (sock->Write(&packed, attempt) == 0) {
+                          _request_payload, sock.get());
+      if (Failed()) {
+        // Stateful pack (h2) refused — same handling as a write failure.
+        err = _error_code;
+        err_text = _error_text;
+        _error_code = 0;
+        _error_text.clear();
+        sock->RemovePendingId(attempt);
+      } else if (sock->Write(&packed, attempt) == 0) {
         _live.push_back({_nretry, sock->id(), _remote_side,
                          _attempt_begin_us});
         return;  // in flight; response/timeout/socket-failure takes over
@@ -332,9 +339,6 @@ void Controller::BackupThunk(void* arg) {
     const int64_t deadline_us = cntl->_deadline_us;
     const int64_t attempt_begin_us = tbutil::gettimeofday_us();
     std::shared_ptr<LoadBalancer> lb = cntl->_lb;
-    tbutil::IOBuf packed;
-    proto->pack_request(&packed, cntl, attempt, cntl->_service_method,
-                        cntl->_request_payload);
     tbthread::fiber_id_unlock(cid);
 
     // The hedge failed to launch AND every other attempt died while it was
@@ -386,7 +390,18 @@ void Controller::BackupThunk(void* arg) {
       return nullptr;
     }
     sock->AddPendingId(attempt);
-    if (sock->Write(&packed, attempt) == 0) {
+    // Packing happens here, under the lock with the socket in hand:
+    // stateful protocols (h2) frame against per-connection state.
+    tbutil::IOBuf packed;
+    proto->pack_request(&packed, cntl, attempt, cntl->_service_method,
+                        cntl->_request_payload, sock.get());
+    bool pack_failed = cntl->Failed();
+    if (pack_failed) {
+      cntl->_error_code = 0;
+      cntl->_error_text.clear();
+      errno = TRPC_EOVERCROWDED;
+    }
+    if (!pack_failed && sock->Write(&packed, attempt) == 0) {
       cntl->_live.push_back({attempt_idx, sock->id(), node,
                              attempt_begin_us});
       cntl->_attempt_socket = sock->id();

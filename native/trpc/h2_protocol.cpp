@@ -60,6 +60,15 @@ struct H2Connection {
   std::unordered_map<uint32_t, H2Stream> streams;
   uint32_t continuation_stream = 0;  // expecting CONTINUATION for this id
 
+  // Client half (reference policy/http2_rpc_protocol.cpp client side):
+  // created by the first h2_pack_request on the socket. Stream ids are
+  // odd and allocated under write_mu; responses match back to RPCs via
+  // stream_to_correlation.
+  bool client = false;
+  bool preface_sent = false;  // write_mu; first locker writes the preface
+  uint32_t next_stream_id = 1;
+  std::unordered_map<uint32_t, uint64_t> stream_to_correlation;  // write_mu
+
   // Peer settings.
   uint32_t peer_max_frame = 16384;
   int64_t peer_initial_window = 65535;
@@ -80,6 +89,10 @@ struct H2Connection {
 };
 
 void h2_conn_dtor(void* p) { delete static_cast<H2Connection*>(p); }
+
+H2Connection::Pending make_grpc_pending(uint32_t stream_id,
+                                        tbutil::IOBuf&& message,
+                                        std::string closing_frame);
 
 // ---- frame serialization helpers ----
 
@@ -162,6 +175,15 @@ struct H2RequestMessage : public InputMessageBase {
   tbutil::IOBuf body;
 };
 
+// Client inbound: one complete response stream (headers + body + trailers
+// merged — trailers decode-append into the same HeaderList).
+struct H2ResponseMessage : public InputMessageBase {
+  uint32_t stream_id = 0;
+  uint64_t correlation_id = 0;
+  HeaderList headers;
+  tbutil::IOBuf body;
+};
+
 const std::string* find_header(const HeaderList& h, const char* name) {
   for (const auto& [n, v] : h) {
     if (n == name) return &v;
@@ -173,11 +195,15 @@ const std::string* find_header(const HeaderList& h, const char* name) {
 
 ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
   ParseResult r;
-  if (!socket->server_side()) {
-    r.error = PARSE_ERROR_TRY_OTHERS;  // server-side protocol only
-    return r;
-  }
   auto* conn = static_cast<H2Connection*>(socket->protocol_data());
+  if (!socket->server_side()) {
+    // Client side: we only speak h2 on sockets where h2_pack_request
+    // already installed the connection state (we initiated the preface).
+    if (conn == nullptr || !conn->client) {
+      r.error = PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
+  }
   if (conn == nullptr) {
     // Client connection preface.
     const size_t have = std::min(source->size(), kPrefaceLen);
@@ -207,6 +233,24 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
     for (auto it = conn->streams.begin(); it != conn->streams.end(); ++it) {
       H2Stream& st = it->second;
       if (st.headers_done && st.end_stream) {
+        if (conn->client) {
+          auto* msg = new H2ResponseMessage;
+          msg->stream_id = it->first;
+          msg->headers = std::move(st.headers);
+          msg->body = std::move(st.body);
+          {
+            std::lock_guard<std::mutex> lk(conn->write_mu);
+            auto cit = conn->stream_to_correlation.find(it->first);
+            if (cit != conn->stream_to_correlation.end()) {
+              msg->correlation_id = cit->second;
+              conn->stream_to_correlation.erase(cit);
+            }
+          }
+          conn->streams.erase(it);
+          r.error = PARSE_OK;
+          r.msg = msg;
+          return r;
+        }
         auto* msg = new H2RequestMessage;
         msg->stream_id = it->first;
         msg->headers = std::move(st.headers);
@@ -375,7 +419,10 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
           }
           st.header_block.clear();
           st.headers_done = true;
-          {
+          if (!conn->client) {
+            // Server: a response will be sent on this stream. (The client
+            // emplaced ITS entry at pack time; re-emplacing here after
+            // flush_pending_locked erased it would leak one per RPC.)
             std::lock_guard<std::mutex> lk(conn->write_mu);
             conn->stream_send_window.emplace(stream_id,
                                              conn->peer_initial_window);
@@ -431,19 +478,42 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
         // A cancelled stream's queued response must leave the FIFO flush
         // queue: its window will never be replenished, and a blocked front
         // entry would wedge every later response on the connection.
-        std::lock_guard<std::mutex> lk(conn->write_mu);
-        conn->stream_send_window.erase(stream_id);
-        for (auto it = conn->pending.begin(); it != conn->pending.end();) {
-          if (it->stream_id == stream_id) {
-            it = conn->pending.erase(it);
-          } else {
-            ++it;
+        uint64_t dead_correlation = 0;
+        {
+          std::lock_guard<std::mutex> lk(conn->write_mu);
+          conn->stream_send_window.erase(stream_id);
+          for (auto it = conn->pending.begin(); it != conn->pending.end();) {
+            if (it->stream_id == stream_id) {
+              it = conn->pending.erase(it);
+            } else {
+              ++it;
+            }
           }
+          auto cit = conn->stream_to_correlation.find(stream_id);
+          if (cit != conn->stream_to_correlation.end()) {
+            dead_correlation = cit->second;
+            conn->stream_to_correlation.erase(cit);
+          }
+        }
+        if (dead_correlation != 0) {
+          // Client: this stream's response will never come — error the RPC
+          // now (retry policy decides what happens next) instead of letting
+          // it ride to its deadline.
+          tbthread::fiber_id_error(dead_correlation, TRPC_EFAILEDSOCKET);
+        }
+        break;
+      }
+      case kGoaway: {
+        if (conn->client) {
+          // Remaining responses may never arrive; failing the connection
+          // errors every pending RPC (they retry on a fresh one). The
+          // graceful last-stream-id dance is future work.
+          r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+          return r;
         }
         break;
       }
       case kPriority:
-      case kGoaway:
       case kPushPromise:
       default:
         break;  // tolerated / ignored
@@ -585,25 +655,15 @@ void h2_process_request(InputMessageBase* base) {
                     make_headers_frame(h, stream_id, /*end_stream=*/false));
           // DATA: 5-byte message prefix + payload, queued through the
           // flow-control path.
-          H2Connection::Pending p;
-          p.stream_id = stream_id;
-          char prefix[5] = {0};
-          const uint32_t mlen = static_cast<uint32_t>(response->size());
-          prefix[1] = static_cast<char>((mlen >> 24) & 0xff);
-          prefix[2] = static_cast<char>((mlen >> 16) & 0xff);
-          prefix[3] = static_cast<char>((mlen >> 8) & 0xff);
-          prefix[4] = static_cast<char>(mlen & 0xff);
-          p.data.append(prefix, 5);
-          p.data.append(std::move(*response));
           HeaderList trailers;
           trailers.emplace_back("grpc-status",
                                 std::to_string(cntl->Failed() ? 2 : 0));
           if (cntl->Failed()) {
             trailers.emplace_back("grpc-message", cntl->ErrorText());
           }
-          p.trailers_frame =
-              make_headers_frame(trailers, stream_id, /*end_stream=*/true);
-          conn->pending.push_back(std::move(p));
+          conn->pending.push_back(make_grpc_pending(
+              stream_id, std::move(*response),
+              make_headers_frame(trailers, stream_id, /*end_stream=*/true)));
           flush_pending_locked(conn, sock.get());
         } else {
           HeaderList h;
@@ -635,14 +695,196 @@ void h2_process_request(InputMessageBase* base) {
   svc->CallMethod(method, cntl, request, response, done);
 }
 
+// ---- client side: gRPC-over-h2 pack + response matching ----
+// Reference policy/http2_rpc_protocol.cpp client half + grpc.cpp status
+// mapping. Channels opt in with ChannelOptions.protocol =
+// kH2ProtocolIndex; requests frame as unary gRPC (path /Service/Method,
+// application/grpc content type, 5-byte length prefix).
+
+int grpc_status_to_errno(int grpc_status) {
+  switch (grpc_status) {
+    case 0: return 0;                            // OK
+    case 1: return TRPC_ECANCELED;               // CANCELLED
+    case 4: return TRPC_ERPCTIMEDOUT;            // DEADLINE_EXCEEDED
+    case 5: return TRPC_ENOMETHOD;               // NOT_FOUND
+    case 7: return EACCES;                       // PERMISSION_DENIED
+    case 8: return TRPC_ELIMIT;                  // RESOURCE_EXHAUSTED
+    case 12: return TRPC_ENOMETHOD;              // UNIMPLEMENTED
+    case 14: return TRPC_EFAILEDSOCKET;          // UNAVAILABLE
+    case 16: return EACCES;                      // UNAUTHENTICATED
+    default: return TRPC_EINTERNAL;
+  }
+}
+
+// gRPC-framed DATA (5-byte prefix + message) as a flow-controlled Pending
+// entry followed by `closing_frame` — shared by the client request path
+// and the server response closure.
+H2Connection::Pending make_grpc_pending(uint32_t stream_id,
+                                        tbutil::IOBuf&& message,
+                                        std::string closing_frame) {
+  H2Connection::Pending p;
+  p.stream_id = stream_id;
+  char prefix[5] = {0};
+  const uint32_t mlen = static_cast<uint32_t>(message.size());
+  prefix[1] = static_cast<char>((mlen >> 24) & 0xff);
+  prefix[2] = static_cast<char>((mlen >> 16) & 0xff);
+  prefix[3] = static_cast<char>((mlen >> 8) & 0xff);
+  prefix[4] = static_cast<char>(mlen & 0xff);
+  p.data.append(prefix, 5);
+  p.data.append(std::move(message));
+  p.trailers_frame = std::move(closing_frame);
+  return p;
+}
+
+void h2_pack_request(tbutil::IOBuf* out, Controller* cntl,
+                     uint64_t correlation_id,
+                     const std::string& service_method,
+                     const tbutil::IOBuf& payload, Socket* socket) {
+  auto* conn = static_cast<H2Connection*>(socket->protocol_data());
+  if (conn == nullptr) {
+    // First request on this socket: serialize creation so exactly one
+    // fiber installs the connection. The conn must be PUBLISHED before any
+    // preface byte hits the wire — the server answers the preface with
+    // SETTINGS, and the input fiber needs protocol_data set to route them
+    // to h2_parse. The preface itself is written below, by whichever
+    // packer takes write_mu first, so no racer's HEADERS can precede it.
+    static std::mutex create_mu;
+    std::lock_guard<std::mutex> lk(create_mu);
+    conn = static_cast<H2Connection*>(socket->protocol_data());
+    if (conn == nullptr) {
+      auto* fresh = new H2Connection;
+      fresh->client = true;
+      socket->set_protocol_data(fresh, h2_conn_dtor);
+      conn = fresh;
+    }
+  }
+  std::lock_guard<std::mutex> lk(conn->write_mu);
+  if (!conn->preface_sent) {
+    std::string first_flight(kPreface, kPrefaceLen);
+    put_frame_header(&first_flight, 0, kSettings, 0, 0);
+    if (write_raw(socket, first_flight) != 0) {
+      cntl->SetFailed(errno != 0 ? errno : TRPC_EOVERCROWDED,
+                      "h2 preface write failed");
+      return;
+    }
+    conn->preface_sent = true;
+  }
+  if (conn->next_stream_id > 0x7fffffff - 2) {
+    // Stream ids exhausted (RFC 9113 §5.1.1): this connection is done;
+    // failing it makes the SocketMap hand the next RPC a fresh one.
+    cntl->SetFailed(TRPC_EFAILEDSOCKET, "h2 stream ids exhausted");
+    socket->SetFailed(TRPC_EFAILEDSOCKET);
+    return;
+  }
+  const uint32_t sid = conn->next_stream_id;
+  conn->next_stream_id += 2;
+
+  HeaderList h;
+  h.emplace_back(":method", "POST");
+  h.emplace_back(":scheme", "http");
+  h.emplace_back(":path", "/" + service_method);
+  h.emplace_back(":authority", tbutil::endpoint2str(socket->remote_side()));
+  h.emplace_back("content-type", "application/grpc");
+  h.emplace_back("te", "trailers");
+  if (cntl->deadline_us() > 0) {
+    const int64_t remain_ms =
+        (cntl->deadline_us() - tbutil::gettimeofday_us()) / 1000;
+    h.emplace_back("grpc-timeout",
+                   std::to_string(remain_ms > 0 ? remain_ms : 1) + "m");
+  }
+  // Frames write DIRECTLY here, under write_mu, so per-stream order
+  // (HEADERS -> DATA) holds even with concurrent packers; *out stays empty
+  // and IssueRPC's Write(empty) is a no-op. DATA rides the window-governed
+  // Pending queue so a large request respects the peer's windows.
+  (void)out;
+  if (write_raw(socket, make_headers_frame(h, sid, /*end_stream=*/false)) !=
+      0) {
+    // Transient rejection (e.g. EOVERCROWDED): fail THIS RPC without
+    // queuing DATA for a stream that never opened.
+    cntl->SetFailed(errno != 0 ? errno : TRPC_EOVERCROWDED,
+                    "h2 HEADERS write failed");
+    return;
+  }
+  conn->stream_to_correlation[sid] = correlation_id;
+  conn->stream_send_window.emplace(sid, conn->peer_initial_window);
+  // END_STREAM: an empty DATA frame after the payload drains (same
+  // one-code-path trick as the server's trailers-less responses).
+  std::string fin;
+  put_frame_header(&fin, 0, kData, kFlagEndStream, sid);
+  tbutil::IOBuf msg_copy = payload;  // zero-copy block share
+  conn->pending.push_back(
+      make_grpc_pending(sid, std::move(msg_copy), std::move(fin)));
+  flush_pending_locked(conn, socket);
+}
+
+void h2_process_response(InputMessageBase* base) {
+  std::unique_ptr<H2ResponseMessage> msg(
+      static_cast<H2ResponseMessage*>(base));
+  const tbthread::fiber_id_t attempt_id = msg->correlation_id;
+  if (attempt_id == 0) return;  // stale stream (RPC finished first)
+  void* data = nullptr;
+  if (tbthread::fiber_id_lock(attempt_id, &data) != 0) {
+    return;  // RPC already finished (timeout/retry won)
+  }
+  ControllerPrivateAccessor acc(static_cast<Controller*>(data));
+  if (!acc.AcceptResponseFor(attempt_id)) {
+    tbthread::fiber_id_unlock(attempt_id);
+    return;
+  }
+  acc.mark_response_received();
+  int err = 0;
+  std::string err_text;
+  const std::string* status = find_header(msg->headers, ":status");
+  const std::string* grpc_status = find_header(msg->headers, "grpc-status");
+  if (grpc_status != nullptr) {
+    char* end = nullptr;
+    const long gs = strtol(grpc_status->c_str(), &end, 10);
+    if (end == grpc_status->c_str() || *end != '\0' || gs < 0 || gs > 16) {
+      err = TRPC_ERESPONSE;
+      err_text = "malformed grpc-status: " + *grpc_status;
+    } else {
+      err = grpc_status_to_errno(static_cast<int>(gs));
+    }
+    if (err != 0 && err_text.empty()) {
+      const std::string* gm = find_header(msg->headers, "grpc-message");
+      err_text = gm != nullptr ? *gm : ("grpc-status " + *grpc_status);
+    }
+  } else if (status == nullptr || *status != "200") {
+    err = TRPC_ERESPONSE;
+    err_text = "http status " + (status != nullptr ? *status : "(none)");
+  }
+  tbutil::IOBuf body = std::move(msg->body);
+  if (err == 0) {
+    // Strip the gRPC length prefix.
+    if (body.size() >= 5) {
+      uint8_t prefix[5];
+      body.copy_to(prefix, 5);
+      if (prefix[0] != 0) {
+        err = TRPC_ERESPONSE;
+        err_text = "compressed grpc response not supported";
+      } else {
+        body.pop_front(5);
+      }
+    } else if (!body.empty()) {
+      err = TRPC_ERESPONSE;
+      err_text = "truncated grpc frame";
+    }
+  }
+  if (err == 0 && acc.response_payload() != nullptr) {
+    acc.response_payload()->clear();
+    acc.response_payload()->append(std::move(body));
+  }
+  acc.EndRPC(err, err_text);
+}
+
 }  // namespace
 
 void RegisterH2Protocol() {
   Protocol p;
   p.parse = h2_parse;
-  p.pack_request = nullptr;  // server-side support (clients use tstd/tpu)
+  p.pack_request = h2_pack_request;
   p.process_request = h2_process_request;
-  p.process_response = nullptr;
+  p.process_response = h2_process_response;
   p.name = "h2";
   TB_CHECK(RegisterProtocol(kH2ProtocolIndex, p) == 0)
       << "h2 protocol slot taken";

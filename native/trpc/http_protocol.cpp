@@ -604,7 +604,7 @@ void http_process_request(InputMessageBase* base) {
 void http_pack_request(tbutil::IOBuf* out, Controller* cntl,
                        uint64_t /*correlation_id*/,
                        const std::string& service_method,
-                       const tbutil::IOBuf& payload) {
+                       const tbutil::IOBuf& payload, Socket*) {
   // Correlation rides the socket, not the wire: HTTP client RPCs use a
   // dedicated short connection whose single pending id IS the match
   // (reference CONNECTION_TYPE_SHORT, controller.cpp:1148-1160).

@@ -133,7 +133,7 @@ void mc_process_response(InputMessageBase* base) {
 void mc_pack_request(tbutil::IOBuf* out, Controller* /*cntl*/,
                      uint64_t /*correlation_id*/,
                      const std::string& /*service_method*/,
-                     const tbutil::IOBuf& payload) {
+                     const tbutil::IOBuf& payload, Socket*) {
   out->append(payload);
 }
 

@@ -49,10 +49,13 @@ struct Protocol {
   // since the new head may belong to a different protocol.
   ParseResult (*parse)(tbutil::IOBuf* source, Socket* socket);
   // Client side: frame a request. correlation_id goes on the wire.
+  // `socket` is the acquired connection — stateful protocols (h2) keep
+  // per-connection context (stream ids, HPACK, windows) on it and may
+  // write flow-controlled frames directly, returning an empty *out.
   void (*pack_request)(tbutil::IOBuf* out, Controller* cntl,
                        uint64_t correlation_id,
                        const std::string& service_method,
-                       const tbutil::IOBuf& payload);
+                       const tbutil::IOBuf& payload, Socket* socket);
   // Server side: run the request (ends by writing a response). Takes
   // ownership of msg.
   void (*process_request)(InputMessageBase* msg);

@@ -212,7 +212,7 @@ void redis_process_response(InputMessageBase* base) {
 void redis_pack_request(tbutil::IOBuf* out, Controller* cntl,
                         uint64_t /*correlation_id*/,
                         const std::string& /*service_method*/,
-                        const tbutil::IOBuf& payload) {
+                        const tbutil::IOBuf& payload, Socket*) {
   (void)cntl;
   out->append(payload);  // already RESP bytes (RedisRequest::SerializeTo)
 }

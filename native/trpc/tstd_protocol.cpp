@@ -179,7 +179,7 @@ ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
 static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
                               uint64_t correlation_id,
                               const std::string& service_method,
-                              const tbutil::IOBuf& payload) {
+                              const tbutil::IOBuf& payload, Socket*) {
   TstdMeta meta;
   meta.msg_type = 0;
   meta.correlation_id = correlation_id;

@@ -1,0 +1,78 @@
+"""Our gRPC-over-h2 CLIENT calling a real grpcio (C-core) SERVER — the
+other half of the interop story (tests/test_grpc_interop.py proves the
+server side). Identity serializers keep protoc out of the test."""
+
+import os
+import sys
+from concurrent import futures
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture(scope="module")
+def grpcio_server():
+    """A real grpcio server with an identity-echo unary method."""
+
+    def echo(request, context):
+        return request
+
+    def fail(request, context):
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "boom")
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    handlers = grpc.method_handlers_generic_handler(
+        "EchoService",
+        {
+            "Echo": grpc.unary_unary_rpc_method_handler(
+                echo, request_deserializer=None, response_serializer=None),
+            "Fail": grpc.unary_unary_rpc_method_handler(
+                fail, request_deserializer=None, response_serializer=None),
+        },
+    )
+    server.add_generic_rpc_handlers((handlers,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield f"127.0.0.1:{port}"
+    server.stop(grace=None)
+
+
+def test_h2_client_calls_grpcio_server(grpcio_server):
+    from brpc_tpu.runtime import native
+
+    ch = native.Channel(grpcio_server, timeout_ms=10000, protocol="grpc")
+    resp, _att = ch.call("EchoService/Echo", b"hello-real-grpc-server")
+    assert resp == b"hello-real-grpc-server"
+
+
+def test_h2_client_many_calls_multiplexed(grpcio_server):
+    from brpc_tpu.runtime import native
+
+    ch = native.Channel(grpcio_server, timeout_ms=10000, protocol="grpc")
+    for i in range(40):
+        payload = (f"m{i}-" + "x" * (i * 131 % 3000)).encode()
+        resp, _ = ch.call("EchoService/Echo", payload)
+        assert resp == payload
+
+
+def test_h2_client_large_message(grpcio_server):
+    from brpc_tpu.runtime import native
+
+    ch = native.Channel(grpcio_server, timeout_ms=30000, protocol="grpc")
+    payload = os.urandom(1 << 20)  # 1MB crosses both flow-control windows
+    resp, _ = ch.call("EchoService/Echo", payload)
+    assert resp == payload
+
+
+def test_h2_client_grpc_error_mapping(grpcio_server):
+    from brpc_tpu.runtime import native
+
+    ch = native.Channel(grpcio_server, timeout_ms=10000, protocol="grpc")
+    with pytest.raises(native.RpcError) as err:
+        ch.call("EchoService/Fail", b"x")
+    # RESOURCE_EXHAUSTED maps to the concurrency-limit errno (1011 ELIMIT).
+    assert err.value.code == 1011
+    assert "boom" in err.value.text
