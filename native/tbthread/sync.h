@@ -3,14 +3,18 @@
 // (the worker pthread keeps running other fibers) and also work from plain
 // pthreads (which block on a futex waiter).
 // Capability parity: reference src/bthread/{mutex,condition_variable,
-// countdown_event,semaphore}.cpp. Contention profiling hooks (mutex.cpp:122)
-// come with tbvar integration later.
+// countdown_event,semaphore}.cpp incl. the contention-profiling hook
+// (mutex.cpp:122 ContentionProfiler): the contended slow path reports its
+// wait time to tbthread/contention_profiler.h when profiling is on — the
+// uncontended fast path stays a single CAS.
 #pragma once
 
 #include <cerrno>
 #include <cstdint>
 
 #include "tbthread/butex.h"
+#include "tbthread/contention_profiler.h"
+#include "tbutil/time.h"
 
 namespace tbthread {
 
@@ -29,6 +33,8 @@ class FiberMutex {
                                           std::memory_order_relaxed)) {
       return;
     }
+    const bool profile = contention_profiling_enabled();
+    const int64_t t0 = profile ? tbutil::monotonic_time_us() : 0;
     do {
       if (expected == 2 ||
           _b->value.exchange(2, std::memory_order_acquire) != 0) {
@@ -38,6 +44,9 @@ class FiberMutex {
     } while (!_b->value.compare_exchange_strong(expected, 2,
                                                 std::memory_order_acquire,
                                                 std::memory_order_relaxed));
+    if (profile) {
+      contention_internal::Record(tbutil::monotonic_time_us() - t0);
+    }
   }
 
   bool try_lock() {

@@ -1,0 +1,325 @@
+#include "tbutil/json.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tbutil {
+
+namespace {
+
+constexpr int kMaxDepth = 64;
+
+void dump_string(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  bool fail(size_t* pos, const char* base) {
+    if (pos != nullptr) *pos = static_cast<size_t>(p - base);
+    return false;
+  }
+
+  void skip_ws() {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+      ++p;
+    }
+  }
+
+  bool literal(const char* lit) {
+    const size_t n = strlen(lit);
+    if (static_cast<size_t>(end - p) < n || memcmp(p, lit, n) != 0) {
+      return false;
+    }
+    p += n;
+    return true;
+  }
+
+  // Appends one UTF-8 encoded code point.
+  static void put_utf8(uint32_t cp, std::string* out) {
+    if (cp < 0x80) {
+      out->push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out->push_back(static_cast<char>(0xc0 | (cp >> 6)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3f)));
+    } else if (cp < 0x10000) {
+      out->push_back(static_cast<char>(0xe0 | (cp >> 12)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3f)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3f)));
+    } else {
+      out->push_back(static_cast<char>(0xf0 | (cp >> 18)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3f)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3f)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3f)));
+    }
+  }
+
+  bool hex4(uint32_t* v) {
+    if (end - p < 4) return false;
+    *v = 0;
+    for (int i = 0; i < 4; ++i) {
+      const char c = p[i];
+      *v <<= 4;
+      if (c >= '0' && c <= '9') *v |= static_cast<uint32_t>(c - '0');
+      else if (c >= 'a' && c <= 'f') *v |= static_cast<uint32_t>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') *v |= static_cast<uint32_t>(c - 'A' + 10);
+      else return false;
+    }
+    p += 4;
+    return true;
+  }
+
+  bool parse_string(std::string* out) {
+    if (p >= end || *p != '"') return false;
+    ++p;
+    while (p < end) {
+      const unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"') {
+        ++p;
+        return true;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return false;
+        switch (*p) {
+          case '"': out->push_back('"'); ++p; break;
+          case '\\': out->push_back('\\'); ++p; break;
+          case '/': out->push_back('/'); ++p; break;
+          case 'b': out->push_back('\b'); ++p; break;
+          case 'f': out->push_back('\f'); ++p; break;
+          case 'n': out->push_back('\n'); ++p; break;
+          case 'r': out->push_back('\r'); ++p; break;
+          case 't': out->push_back('\t'); ++p; break;
+          case 'u': {
+            ++p;
+            uint32_t cp;
+            if (!hex4(&cp)) return false;
+            if (cp >= 0xd800 && cp <= 0xdbff) {  // high surrogate
+              if (end - p < 6 || p[0] != '\\' || p[1] != 'u') return false;
+              p += 2;
+              uint32_t lo;
+              if (!hex4(&lo) || lo < 0xdc00 || lo > 0xdfff) return false;
+              cp = 0x10000 + ((cp - 0xd800) << 10) + (lo - 0xdc00);
+            } else if (cp >= 0xdc00 && cp <= 0xdfff) {
+              return false;  // unpaired low surrogate
+            }
+            put_utf8(cp, out);
+            break;
+          }
+          default:
+            return false;
+        }
+        continue;
+      }
+      if (c < 0x20) return false;  // raw control char
+      out->push_back(static_cast<char>(c));
+      ++p;
+    }
+    return false;  // unterminated
+  }
+
+  bool parse_number(JsonValue* out) {
+    const char* start = p;
+    if (p < end && *p == '-') ++p;
+    if (p >= end || !isdigit(static_cast<unsigned char>(*p))) return false;
+    if (*p == '0') {
+      ++p;
+    } else {
+      while (p < end && isdigit(static_cast<unsigned char>(*p))) ++p;
+    }
+    bool is_double = false;
+    if (p < end && *p == '.') {
+      is_double = true;
+      ++p;
+      if (p >= end || !isdigit(static_cast<unsigned char>(*p))) return false;
+      while (p < end && isdigit(static_cast<unsigned char>(*p))) ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      is_double = true;
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) ++p;
+      if (p >= end || !isdigit(static_cast<unsigned char>(*p))) return false;
+      while (p < end && isdigit(static_cast<unsigned char>(*p))) ++p;
+    }
+    const std::string text(start, p);
+    if (!is_double) {
+      errno = 0;
+      char* numend = nullptr;
+      const long long v = strtoll(text.c_str(), &numend, 10);
+      if (errno == 0 && numend == text.c_str() + text.size()) {
+        *out = JsonValue(static_cast<int64_t>(v));
+        return true;
+      }
+      // Integer overflow: fall through to double (RFC allows precision loss).
+    }
+    char* numend = nullptr;
+    const double d = strtod(text.c_str(), &numend);
+    if (numend != text.c_str() + text.size() || !std::isfinite(d)) {
+      return false;
+    }
+    *out = JsonValue(d);
+    return true;
+  }
+
+  bool parse_value(JsonValue* out, int depth) {
+    if (depth > kMaxDepth) return false;
+    skip_ws();
+    if (p >= end) return false;
+    switch (*p) {
+      case 'n': return literal("null") ? (*out = JsonValue(), true) : false;
+      case 't': return literal("true") ? (*out = JsonValue(true), true)
+                                       : false;
+      case 'f': return literal("false") ? (*out = JsonValue(false), true)
+                                        : false;
+      case '"': {
+        std::string s;
+        if (!parse_string(&s)) return false;
+        *out = JsonValue(std::move(s));
+        return true;
+      }
+      case '[': {
+        ++p;
+        *out = JsonValue::Array();
+        skip_ws();
+        if (p < end && *p == ']') {
+          ++p;
+          return true;
+        }
+        while (true) {
+          JsonValue elem;
+          if (!parse_value(&elem, depth + 1)) return false;
+          out->push_back(std::move(elem));
+          skip_ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            ++p;
+            return true;
+          }
+          return false;
+        }
+      }
+      case '{': {
+        ++p;
+        *out = JsonValue::Object();
+        skip_ws();
+        if (p < end && *p == '}') {
+          ++p;
+          return true;
+        }
+        while (true) {
+          skip_ws();
+          std::string key;
+          if (!parse_string(&key)) return false;
+          skip_ws();
+          if (p >= end || *p != ':') return false;
+          ++p;
+          JsonValue val;
+          if (!parse_value(&val, depth + 1)) return false;
+          out->set(std::move(key), std::move(val));
+          skip_ws();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            ++p;
+            return true;
+          }
+          return false;
+        }
+      }
+      default:
+        return parse_number(out);
+    }
+  }
+};
+
+}  // namespace
+
+void JsonValue::DumpTo(std::string* out) const {
+  switch (_type) {
+    case Type::kNull: *out += "null"; break;
+    case Type::kBool: *out += _bool ? "true" : "false"; break;
+    case Type::kInt: *out += std::to_string(_int); break;
+    case Type::kDouble: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%.17g", _double);
+      *out += buf;
+      break;
+    }
+    case Type::kString: dump_string(_str, out); break;
+    case Type::kArray: {
+      out->push_back('[');
+      for (size_t i = 0; i < _array.size(); ++i) {
+        if (i != 0) out->push_back(',');
+        _array[i].DumpTo(out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& [k, v] : _members) {
+        if (!first) out->push_back(',');
+        first = false;
+        dump_string(k, out);
+        out->push_back(':');
+        v.DumpTo(out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+std::string JsonValue::Dump() const {
+  std::string out;
+  DumpTo(&out);
+  return out;
+}
+
+std::optional<JsonValue> JsonValue::Parse(std::string_view text,
+                                          size_t* error_pos) {
+  Parser parser{text.data(), text.data() + text.size()};
+  JsonValue v;
+  if (!parser.parse_value(&v, 0)) {
+    parser.fail(error_pos, text.data());
+    return std::nullopt;
+  }
+  parser.skip_ws();
+  if (parser.p != parser.end) {
+    parser.fail(error_pos, text.data());
+    return std::nullopt;
+  }
+  return v;
+}
+
+}  // namespace tbutil

@@ -1,0 +1,119 @@
+// Minimal JSON value + parser + writer (RFC 8259), self-contained — the
+// role the reference fills by vendoring rapidjson (butil/third_party).
+// Backs the json2pb-class HTTP<->RPC bridge (trpc/json_service.h), console
+// pages and config parsing.
+//
+// Scope: full RFC syntax (nested containers, string escapes incl. \uXXXX
+// with surrogate pairs, exponents), DOM-style tree, ordered objects.
+// Non-goals: SAX streaming, >64-deep nesting (rejected: stack safety).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace tbutil {
+
+class JsonValue {
+ public:
+  enum class Type { kNull, kBool, kInt, kDouble, kString, kArray, kObject };
+
+  JsonValue() = default;  // null
+  JsonValue(bool b) : _type(Type::kBool), _bool(b) {}
+  JsonValue(int v) : _type(Type::kInt), _int(v) {}
+  JsonValue(int64_t v) : _type(Type::kInt), _int(v) {}
+  JsonValue(double v) : _type(Type::kDouble), _double(v) {}
+  JsonValue(const char* s) : _type(Type::kString), _str(s) {}
+  JsonValue(std::string s) : _type(Type::kString), _str(std::move(s)) {}
+
+  static JsonValue Array() {
+    JsonValue v;
+    v._type = Type::kArray;
+    return v;
+  }
+  static JsonValue Object() {
+    JsonValue v;
+    v._type = Type::kObject;
+    return v;
+  }
+
+  Type type() const { return _type; }
+  bool is_null() const { return _type == Type::kNull; }
+  bool is_bool() const { return _type == Type::kBool; }
+  bool is_number() const {
+    return _type == Type::kInt || _type == Type::kDouble;
+  }
+  bool is_string() const { return _type == Type::kString; }
+  bool is_array() const { return _type == Type::kArray; }
+  bool is_object() const { return _type == Type::kObject; }
+
+  bool as_bool(bool dflt = false) const {
+    return _type == Type::kBool ? _bool : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (_type == Type::kInt) return _int;
+    if (_type == Type::kDouble) return static_cast<int64_t>(_double);
+    return dflt;
+  }
+  double as_double(double dflt = 0) const {
+    if (_type == Type::kDouble) return _double;
+    if (_type == Type::kInt) return static_cast<double>(_int);
+    return dflt;
+  }
+  const std::string& as_string() const { return _str; }
+
+  // Arrays.
+  size_t size() const { return _array.size(); }
+  const JsonValue& operator[](size_t i) const { return _array[i]; }
+  void push_back(JsonValue v) {
+    _type = Type::kArray;
+    _array.push_back(std::move(v));
+  }
+  const std::vector<JsonValue>& items() const { return _array; }
+
+  // Objects (insertion-ordered).
+  const JsonValue* find(std::string_view key) const {
+    for (const auto& [k, v] : _members) {
+      if (k == key) return &v;
+    }
+    return nullptr;
+  }
+  JsonValue& set(std::string key, JsonValue v) {
+    _type = Type::kObject;
+    for (auto& [k, existing] : _members) {
+      if (k == key) {
+        existing = std::move(v);
+        return existing;
+      }
+    }
+    _members.emplace_back(std::move(key), std::move(v));
+    return _members.back().second;
+  }
+  const std::vector<std::pair<std::string, JsonValue>>& members() const {
+    return _members;
+  }
+
+  // Compact RFC 8259 text.
+  std::string Dump() const;
+  void DumpTo(std::string* out) const;
+
+  // Whole-input parse (trailing non-space bytes fail). nullopt on error;
+  // *error_pos (optional) gets the byte offset of the failure.
+  static std::optional<JsonValue> Parse(std::string_view text,
+                                        size_t* error_pos = nullptr);
+
+ private:
+  Type _type = Type::kNull;
+  bool _bool = false;
+  int64_t _int = 0;
+  double _double = 0;
+  std::string _str;
+  std::vector<JsonValue> _array;
+  std::vector<std::pair<std::string, JsonValue>> _members;
+};
+
+}  // namespace tbutil

@@ -1,0 +1,57 @@
+#include "tbvar/collector.h"
+
+#include <algorithm>
+
+#include "tbutil/time.h"
+
+namespace tbvar {
+
+bool SampleCollector::Admit() {
+  const int64_t now = tbutil::monotonic_time_us();
+  int64_t window = _window_start_us.load(std::memory_order_relaxed);
+  if (now - window >= 1000000) {
+    // New 1s window. One winner resets the count; losers just count into
+    // the fresh window (mild over-admission on the boundary is fine —
+    // this is a speed limit, not an invariant).
+    if (_window_start_us.compare_exchange_strong(window, now,
+                                                 std::memory_order_relaxed)) {
+      _window_count.store(0, std::memory_order_relaxed);
+    }
+  }
+  if (_window_count.fetch_add(1, std::memory_order_relaxed) >= _rate) {
+    _rejected.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  _admitted.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+void SampleCollector::Add(const std::vector<void*>& stack, int64_t value) {
+  std::lock_guard<std::mutex> lk(_mu);
+  Entry& e = _agg[stack];
+  if (e.stack.empty()) e.stack = stack;
+  ++e.count;
+  e.total += value;
+}
+
+std::vector<SampleCollector::Entry> SampleCollector::Snapshot() const {
+  std::vector<Entry> out;
+  {
+    std::lock_guard<std::mutex> lk(_mu);
+    out.reserve(_agg.size());
+    for (const auto& [stack, e] : _agg) out.push_back(e);
+  }
+  std::sort(out.begin(), out.end(), [](const Entry& a, const Entry& b) {
+    return a.total > b.total;
+  });
+  return out;
+}
+
+void SampleCollector::Reset() {
+  std::lock_guard<std::mutex> lk(_mu);
+  _agg.clear();
+  _admitted.store(0);
+  _rejected.store(0);
+}
+
+}  // namespace tbvar

@@ -1,0 +1,54 @@
+// SampleCollector: the bounded-cost sampling substrate (reference
+// bvar/collector.h:38-119 — sampled-object collection under a global speed
+// limit, shared by rpcz / rpc_dump / contention profiling). Redesign:
+// instead of the reference's background combiner thread, admission is a
+// token bucket (two atomics on the hot path) and admitted samples
+// aggregate under a plain mutex keyed by call stack — per-sample cost is
+// bounded by the speed limit no matter the event rate.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tbvar {
+
+class SampleCollector {
+ public:
+  // max_samples_per_second: admission cap (the "speed limit").
+  explicit SampleCollector(int64_t max_samples_per_second = 1000)
+      : _rate(max_samples_per_second) {}
+
+  // Cheap admission gate — call BEFORE doing any expensive capture work
+  // (stack walk, copying). Two relaxed atomics when the bucket is dry.
+  bool Admit();
+
+  // Record one admitted sample: a call-stack key and a value (wait time,
+  // bytes, ...). Aggregates {count, total} per unique stack.
+  void Add(const std::vector<void*>& stack, int64_t value);
+
+  struct Entry {
+    std::vector<void*> stack;
+    int64_t count = 0;
+    int64_t total = 0;  // sum of values
+  };
+  // Aggregated entries, largest total first.
+  std::vector<Entry> Snapshot() const;
+  void Reset();
+  int64_t admitted() const { return _admitted.load(); }
+  int64_t rejected() const { return _rejected.load(); }
+
+ private:
+  const int64_t _rate;
+  std::atomic<int64_t> _window_start_us{0};
+  std::atomic<int64_t> _window_count{0};
+  std::atomic<int64_t> _admitted{0};
+  std::atomic<int64_t> _rejected{0};
+  mutable std::mutex _mu;
+  std::map<std::vector<void*>, Entry> _agg;
+};
+
+}  // namespace tbvar

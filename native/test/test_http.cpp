@@ -8,6 +8,9 @@
 #include <unistd.h>
 
 #include <cstring>
+#include <chrono>
+#include <memory>
+#include <thread>
 #include <string>
 
 #include "mini_test.h"
@@ -308,6 +311,75 @@ TEST_CASE(http_framing_hardening) {
                   "POST /EchoService%2FEvil/Echo HTTP/1.1\r\nHost: x\r\n"
                   "Content-Length: 2\r\nConnection: close\r\n\r\nhi");
   ASSERT_TRUE(resp.rfind("HTTP/1.1 404", 0) == 0);
+  server.Stop();
+}
+
+// ProgressiveAttachment: chunks keep flowing AFTER the response went out,
+// until Close() terminates the chunked body and the connection
+// (reference progressive_attachment.h — the log-tail/event-stream shape).
+TEST_CASE(http_progressive_attachment_streams) {
+  static std::shared_ptr<ProgressiveAttachment> g_pa;
+  RegisterHttpHandler("/tail", [](const HttpRequest&, HttpResponse* resp) {
+    resp->content_type = "text/plain";
+    resp->body = "line-0\n";  // first chunk rides with the headers
+    resp->progressive = std::make_shared<ProgressiveAttachment>();
+    g_pa = resp->progressive;
+  });
+  Server server;
+  ASSERT_EQ(server.Start("127.0.0.1:0", nullptr), 0);
+
+  // Raw client: GET then read everything until the server closes.
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(server.listen_address().port));
+  ASSERT_EQ(::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)),
+            0);
+  const char req[] = "GET /tail HTTP/1.1\r\nHost: x\r\n\r\n";
+  ASSERT_EQ(::send(fd, req, sizeof(req) - 1, 0),
+            static_cast<ssize_t>(sizeof(req) - 1));
+
+  // Writer fiber: more lines after the response, then Close.
+  std::thread pusher([&] {
+    while (g_pa == nullptr) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+    for (int i = 1; i <= 5; ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+      ASSERT_EQ(g_pa->Write("line-" + std::to_string(i) + "\n"), 0);
+    }
+    g_pa->Close();
+  });
+
+  std::string wire;
+  char buf[4096];
+  while (true) {
+    const ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) break;  // server closed after the terminal chunk
+    wire.append(buf, static_cast<size_t>(n));
+  }
+  pusher.join();
+  ::close(fd);
+  ASSERT_TRUE(wire.find("Transfer-Encoding: chunked") != std::string::npos);
+  ASSERT_TRUE(wire.find("Connection: close") != std::string::npos);
+  // Decode the chunked body.
+  const size_t hdr_end = wire.find("\r\n\r\n");
+  ASSERT_TRUE(hdr_end != std::string::npos);
+  std::string body;
+  size_t pos = hdr_end + 4;
+  while (pos < wire.size()) {
+    const size_t le = wire.find("\r\n", pos);
+    ASSERT_TRUE(le != std::string::npos);
+    const long len = strtol(wire.c_str() + pos, nullptr, 16);
+    if (len == 0) break;  // terminal chunk
+    body += wire.substr(le + 2, static_cast<size_t>(len));
+    pos = le + 2 + static_cast<size_t>(len) + 2;
+  }
+  ASSERT_EQ(body, std::string("line-0\nline-1\nline-2\nline-3\nline-4\n"
+                              "line-5\n"));
+  // Peer-death: writing after the client vanished reports closed.
+  g_pa.reset();
   server.Stop();
 }
 

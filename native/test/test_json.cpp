@@ -1,0 +1,165 @@
+// tbutil::JsonValue (parser/writer) + the JsonService bridge: one method
+// body answering binary tstd RPC AND raw HTTP+JSON (the curl-ability the
+// reference gets from json2pb).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <string>
+
+#include "mini_test.h"
+#include "tbutil/json.h"
+#include "trpc/channel.h"
+#include "trpc/errno.h"
+#include "trpc/json_service.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+using tbutil::JsonValue;
+
+TEST_CASE(json_parse_roundtrip) {
+  const char* cases[] = {
+      "null",
+      "true",
+      "-42",
+      "3.5",
+      "1e3",
+      "\"hi\"",
+      "[]",
+      "{}",
+      "[1,2,[3,{\"k\":null}]]",
+      "{\"a\":1,\"b\":[true,false],\"c\":{\"d\":\"e\"}}",
+  };
+  for (const char* c : cases) {
+    auto v = JsonValue::Parse(c);
+    ASSERT_TRUE(v.has_value());
+    auto v2 = JsonValue::Parse(v->Dump());
+    ASSERT_TRUE(v2.has_value());
+    ASSERT_EQ(v2->Dump(), v->Dump());
+  }
+  // Escapes + unicode (incl. a surrogate pair -> 4-byte UTF-8).
+  auto v = JsonValue::Parse(R"("a\"b\\c\nd\u00e9\ud83d\ude00")");
+  ASSERT_TRUE(v.has_value());
+  ASSERT_EQ(v->as_string(),
+            std::string("a\"b\\c\nd\xc3\xa9\xf0\x9f\x98\x80"));
+  auto round = JsonValue::Parse(v->Dump());
+  ASSERT_TRUE(round.has_value());
+  ASSERT_TRUE(round->as_string() == v->as_string());
+  // Object order is preserved; lookups work.
+  auto obj = JsonValue::Parse("{\"z\":1,\"a\":2}");
+  ASSERT_TRUE(obj.has_value());
+  ASSERT_EQ(obj->members()[0].first, std::string("z"));
+  ASSERT_TRUE(obj->find("a") != nullptr);
+  ASSERT_EQ(obj->find("a")->as_int(), 2);
+  // int64 precision survives (not squashed through double).
+  auto big = JsonValue::Parse("9007199254740993");
+  ASSERT_TRUE(big.has_value());
+  ASSERT_EQ(big->as_int(), 9007199254740993LL);
+}
+
+TEST_CASE(json_parse_rejects_malformed) {
+  const char* bad[] = {
+      "",            "tru",          "[1,",      "{\"a\"1}",
+      "\"unterminated", "{1:2}",     "[1 2]",    "nul",
+      "\"\\ud800\"",  // unpaired surrogate
+      "01",           "1.",          "- 1",      "[]]",
+      "\x01",
+  };
+  for (const char* c : bad) {
+    ASSERT_FALSE(JsonValue::Parse(c).has_value());
+  }
+  // Depth bomb rejected, not stack-overflowed.
+  std::string deep(200, '[');
+  ASSERT_FALSE(JsonValue::Parse(deep).has_value());
+}
+
+namespace {
+
+// One structured method: {"values":[...]} -> {"sum":N,"count":N}.
+JsonService* make_math_service() {
+  auto* svc = new JsonService("Math");
+  svc->AddMethod("Sum", [](const JsonValue& req, JsonValue* resp,
+                           Controller* cntl) {
+    const JsonValue* values = req.find("values");
+    if (values == nullptr || !values->is_array()) {
+      cntl->SetFailed(TRPC_EREQUEST, "expected {\"values\": [...]}");
+      return;
+    }
+    int64_t sum = 0;
+    for (const JsonValue& v : values->items()) sum += v.as_int();
+    *resp = JsonValue::Object();
+    resp->set("sum", JsonValue(sum));
+    resp->set("count", JsonValue(static_cast<int64_t>(values->size())));
+  });
+  return svc;
+}
+
+}  // namespace
+
+TEST_CASE(json_service_over_tstd_and_http) {
+  JsonService* math = make_math_service();
+  Server server;
+  ASSERT_EQ(server.AddService(math), 0);
+  ASSERT_EQ(server.Start("127.0.0.1:0", nullptr), 0);
+  char addr[64];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+
+  // 1) Binary tstd RPC carrying JSON bytes.
+  Channel ch;
+  ChannelOptions opts;
+  opts.timeout_ms = 5000;
+  ASSERT_EQ(ch.Init(addr, &opts), 0);
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("{\"values\":[1,2,3,40]}");
+  ch.CallMethod("Math/Sum", &cntl, req, &resp, nullptr);
+  ASSERT_FALSE(cntl.Failed());
+  auto parsed = JsonValue::Parse(resp.to_string());
+  ASSERT_TRUE(parsed.has_value());
+  ASSERT_EQ(parsed->find("sum")->as_int(), 46);
+  ASSERT_EQ(parsed->find("count")->as_int(), 4);
+
+  // Malformed JSON fails BEFORE the handler, with EREQUEST.
+  Controller cntl2;
+  tbutil::IOBuf bad, unused;
+  bad.append("{nope");
+  ch.CallMethod("Math/Sum", &cntl2, bad, &unused, nullptr);
+  ASSERT_TRUE(cntl2.Failed());
+  ASSERT_EQ(cntl2.ErrorCode(), TRPC_EREQUEST);
+
+  // 2) The SAME method over raw HTTP 'curl -d': POST /Math/Sum.
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sin{};
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sin.sin_port = htons(static_cast<uint16_t>(server.listen_address().port));
+  ASSERT_EQ(::connect(fd, reinterpret_cast<sockaddr*>(&sin), sizeof(sin)), 0);
+  const char body[] = "{\"values\":[5,6]}";
+  char http_req[256];
+  const int n = snprintf(http_req, sizeof(http_req),
+                         "POST /Math/Sum HTTP/1.1\r\nHost: x\r\n"
+                         "Content-Type: application/json\r\n"
+                         "Content-Length: %zu\r\nConnection: close\r\n\r\n%s",
+                         sizeof(body) - 1, body);
+  ASSERT_EQ(::send(fd, http_req, n, 0), static_cast<ssize_t>(n));
+  std::string wire;
+  char buf[4096];
+  while (true) {
+    const ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+    if (r <= 0) break;
+    wire.append(buf, static_cast<size_t>(r));
+  }
+  ::close(fd);
+  ASSERT_TRUE(wire.find("200") != std::string::npos);
+  const size_t hdr_end = wire.find("\r\n\r\n");
+  ASSERT_TRUE(hdr_end != std::string::npos);
+  auto http_parsed = JsonValue::Parse(wire.substr(hdr_end + 4));
+  ASSERT_TRUE(http_parsed.has_value());
+  ASSERT_EQ(http_parsed->find("sum")->as_int(), 11);
+  server.Stop();
+  delete math;
+}
+
+TEST_MAIN

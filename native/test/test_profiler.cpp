@@ -4,6 +4,10 @@
 #include <string>
 
 #include "mini_test.h"
+#include "tbthread/contention_profiler.h"
+#include "tbthread/fiber.h"
+#include "tbthread/sync.h"
+#include <vector>
 #include "tbutil/cpu_profiler.h"
 #include "tbutil/time.h"
 
@@ -42,6 +46,48 @@ TEST_CASE(cpu_profiler_attributes_busy_loop) {
   // Restartable.
   ASSERT_TRUE(CpuProfiler::Start(100));
   CpuProfiler::Stop();
+}
+
+// Contention profiler: a deliberately fought-over FiberMutex must show up
+// with the contending function's stack and its wait time (reference
+// bthread/mutex.cpp ContentionProfiler proof).
+extern "C" __attribute__((noinline)) void contention_test_fight(
+    tbthread::FiberMutex* mu, int iters) {
+  for (int i = 0; i < iters; ++i) {
+    mu->lock();
+    volatile uint64_t spin = 0;
+    for (int k = 0; k < 20000; ++k) spin += k;
+    mu->unlock();
+  }
+}
+
+TEST_CASE(contention_profiler_attributes_hot_lock) {
+  using namespace tbthread;
+  contention_profiling_reset();
+  contention_profiling_start();
+  FiberMutex mu;
+  std::vector<fiber_t> fibers(4);
+  struct Arg {
+    FiberMutex* mu;
+    int iters;
+  } arg{&mu, 300};
+  for (auto& f : fibers) {
+    fiber_start_background(
+        &f, nullptr,
+        [](void* a) -> void* {
+          auto* ar = static_cast<Arg*>(a);
+          contention_test_fight(ar->mu, ar->iters);
+          return nullptr;
+        },
+        &arg);
+  }
+  for (auto& f : fibers) fiber_join(f, nullptr);
+  contention_profiling_stop();
+  const std::string report = contention_report();
+  fprintf(stderr, "%s", report.c_str());
+  ASSERT_TRUE(report.find("contention_test_fight") != std::string::npos);
+  ASSERT_TRUE(report.find("waited") != std::string::npos);
+  contention_profiling_reset();
 }
 
 TEST_MAIN

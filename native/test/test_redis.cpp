@@ -16,6 +16,7 @@
 #include "mini_test.h"
 #include "trpc/channel.h"
 #include "trpc/redis_protocol.h"
+#include "trpc/server.h"
 
 using namespace trpc;
 
@@ -156,7 +157,7 @@ TEST_CASE(redis_pipeline_end_to_end) {
   ASSERT_EQ(ch.Init(addr, &opts), 0);
 
   RedisRequest req;
-  ASSERT_TRUE(req.AddCommand({"SET", "lang", "tpu native"}));  // binary-safe
+  ASSERT_TRUE(req.AddCommand(std::vector<std::string>{"SET", "lang", "tpu native"}));  // binary-safe
   ASSERT_TRUE(req.AddCommand("GET lang"));
   ASSERT_TRUE(req.AddCommand("INCR counter"));
   ASSERT_TRUE(req.AddCommand("INCR counter"));
@@ -201,6 +202,119 @@ TEST_CASE(redis_timeout_on_dead_server) {
   Controller cntl;
   ASSERT_TRUE(RedisExecute(ch, &cntl, req, &resp) != 0);
   ASSERT_TRUE(cntl.Failed());
+}
+
+namespace {
+
+// In-memory KV RedisService — the server half of the protocol, attached to
+// an ordinary trpc::Server (the port also keeps speaking tstd/HTTP/...).
+class KvRedisService : public RedisService {
+ public:
+  void OnCommand(const std::vector<std::string>& args,
+                 RedisReply* reply) override {
+    std::lock_guard<std::mutex> lk(_mu);
+    const std::string& cmd = args[0];
+    if (cmd == "PING") {
+      reply->type = RedisReply::Type::kStatus;
+      reply->str = "PONG";
+    } else if (cmd == "SET" && args.size() == 3) {
+      _kv[args[1]] = args[2];
+      reply->type = RedisReply::Type::kStatus;
+      reply->str = "OK";
+    } else if (cmd == "GET" && args.size() == 2) {
+      auto it = _kv.find(args[1]);
+      if (it == _kv.end()) {
+        reply->type = RedisReply::Type::kNil;
+      } else {
+        reply->type = RedisReply::Type::kString;
+        reply->str = it->second;
+      }
+    } else if (cmd == "DEL" && args.size() == 2) {
+      reply->type = RedisReply::Type::kInteger;
+      reply->integer = _kv.erase(args[1]);
+    } else if (cmd == "INCR" && args.size() == 2) {
+      long long v = atoll(_kv[args[1]].c_str()) + 1;
+      _kv[args[1]] = std::to_string(v);
+      reply->type = RedisReply::Type::kInteger;
+      reply->integer = v;
+    } else {
+      reply->type = RedisReply::Type::kError;
+      reply->str = "ERR unknown command '" + cmd + "'";
+    }
+  }
+
+ private:
+  std::mutex _mu;
+  std::map<std::string, std::string> _kv;
+};
+
+}  // namespace
+
+// Server side: our RedisService behind a trpc::Server answers a pipelined
+// RESP session from our own redis CLIENT — both halves of the protocol in
+// one round trip (reference redis_protocol.cpp serves too; RedisService in
+// redis.h).
+TEST_CASE(redis_server_side_end_to_end) {
+  KvRedisService kv;
+  Server server;
+  ServerOptions opts;
+  opts.redis_service = &kv;
+  ASSERT_EQ(server.Start("127.0.0.1:0", &opts), 0);
+  char addr[64];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 5000;
+  copts.protocol = kRedisProtocolIndex;
+  ASSERT_EQ(ch.Init(addr, &copts), 0);
+
+  RedisRequest req;
+  req.AddCommand(std::vector<std::string>{"PING"});
+  req.AddCommand(std::vector<std::string>{"SET", "answer", "42"});
+  req.AddCommand(std::vector<std::string>{"GET", "answer"});
+  req.AddCommand(std::vector<std::string>{"INCR", "answer"});
+  req.AddCommand(std::vector<std::string>{"GET", "missing"});
+  req.AddCommand(std::vector<std::string>{"DEL", "answer"});
+  req.AddCommand(std::vector<std::string>{"BOGUS"});
+  RedisResponse resp;
+  Controller cntl;
+  ASSERT_EQ(RedisExecute(ch, &cntl, req, &resp), 0);
+  ASSERT_EQ(resp.reply_count(), size_t{7});
+  ASSERT_TRUE(resp.reply(0).type == RedisReply::Type::kStatus);
+  ASSERT_EQ(resp.reply(0).str, std::string("PONG"));
+  ASSERT_EQ(resp.reply(1).str, std::string("OK"));
+  ASSERT_EQ(resp.reply(2).str, std::string("42"));
+  ASSERT_TRUE(resp.reply(3).type == RedisReply::Type::kInteger);
+  ASSERT_EQ(resp.reply(3).integer, 43);
+  ASSERT_TRUE(resp.reply(4).is_nil());
+  ASSERT_EQ(resp.reply(5).integer, 1);
+  ASSERT_TRUE(resp.reply(6).is_error());
+
+  // Binary-safe values round-trip (embedded CRLF + NULs).
+  RedisRequest req2;
+  std::string blob("a\r\nb", 4);
+  blob.push_back('\0');
+  blob += "tail";
+  req2.AddCommand(std::vector<std::string>{"SET", "bin", blob});
+  req2.AddCommand(std::vector<std::string>{"GET", "bin"});
+  RedisResponse resp2;
+  Controller cntl2;
+  ASSERT_EQ(RedisExecute(ch, &cntl2, req2, &resp2), 0);
+  ASSERT_TRUE(resp2.reply(1).str == blob);
+
+  // The SAME port still answers tstd (multi-protocol listener intact).
+  // (No tstd service registered: expect ENOSERVICE, not a parse kill.)
+  Channel plain;
+  ChannelOptions popts;
+  popts.timeout_ms = 3000;
+  popts.max_retry = 0;
+  ASSERT_EQ(plain.Init(addr, &popts), 0);
+  Controller c3;
+  tbutil::IOBuf breq, bresp;
+  breq.append("x");
+  plain.CallMethod("NoSvc/None", &c3, breq, &bresp, nullptr);
+  ASSERT_TRUE(c3.Failed());
+  server.Stop();
 }
 
 TEST_MAIN

@@ -6,6 +6,7 @@
 #include <string>
 #include <vector>
 
+#include "tbthread/contention_profiler.h"
 #include "tbthread/fiber.h"
 #include "tbthread/tracer.h"
 #include "tbutil/cpu_profiler.h"
@@ -37,6 +38,7 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
       "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
       "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
+      "<li><a href=\"/contention\">/contention</a> — mutex wait profile</li>"
       "</ul></body></html>";
 }
 
@@ -243,20 +245,20 @@ void rpcz_page(const HttpRequest& req, HttpResponse* resp) {
   }
 }
 
-// /hotspots: sampling CPU profile (reference builtin/hotspots_service.cpp,
-// backed by our own SIGPROF profiler instead of gperftools).
-//   /hotspots?seconds=N   profile N s (default 5, max 60), flat top-40
-//   &view=collapsed       flamegraph.pl-compatible collapsed stacks
-void hotspots_page(const HttpRequest& req, HttpResponse* resp) {
+// Shared scaffolding for profile-window pages (/hotspots, /contention):
+// parse+clamp ?seconds, serialize concurrent profiles (try_lock + 503 —
+// never block: a fiber parking while holding a std::mutex could wedge a
+// single-worker scheduler; the window itself parks only this handler's
+// fiber, and the lock is held through RENDERING so a second run cannot
+// reset the sample state mid-read), run start/stop around the window.
+template <typename StartFn, typename StopFn, typename RenderFn>
+void run_profile_window(const HttpRequest& req, HttpResponse* resp,
+                        StartFn start, StopFn stop, RenderFn render) {
   int seconds = 5;
   const std::string s = req.query_param("seconds");
   if (!s.empty()) seconds = atoi(s.c_str());
   if (seconds < 1) seconds = 1;
   if (seconds > 60) seconds = 60;
-  // One profile at a time, held through RENDERING too: a second Start()
-  // would reset and rewrite the sample buffer under the first render.
-  // try_lock (never block): a fiber parking while holding a std::mutex
-  // could wedge a single-worker scheduler.
   static std::mutex profile_mu;
   if (!profile_mu.try_lock()) {
     resp->status = 503;
@@ -264,23 +266,49 @@ void hotspots_page(const HttpRequest& req, HttpResponse* resp) {
     return;
   }
   std::lock_guard<std::mutex> lk(profile_mu, std::adopt_lock);
-  if (!tbutil::CpuProfiler::Start()) {
+  if (!start()) {
     resp->status = 503;
     resp->body = "profiler busy\n";
     return;
   }
-  // Parks only this handler's fiber; the server keeps serving (and thereby
-  // generates the very samples being collected).
   tbthread::fiber_usleep(static_cast<uint64_t>(seconds) * 1000000);
-  tbutil::CpuProfiler::Stop();
-  if (req.query_param("view") == "collapsed") {
-    resp->body = tbutil::CpuProfiler::Collapsed();
-  } else {
-    resp->body = tbutil::CpuProfiler::FlatText();
-    resp->body +=
-        "\n(collapsed stacks for flamegraphs: /hotspots?seconds=N"
-        "&view=collapsed)\n";
-  }
+  stop();
+  render();
+}
+
+// /hotspots: sampling CPU profile (reference builtin/hotspots_service.cpp,
+// backed by our own SIGPROF profiler instead of gperftools).
+//   /hotspots?seconds=N   profile N s (default 5, max 60), flat top-40
+//   &view=collapsed       flamegraph.pl-compatible collapsed stacks
+void hotspots_page(const HttpRequest& req, HttpResponse* resp) {
+  run_profile_window(
+      req, resp, [] { return tbutil::CpuProfiler::Start(); },
+      [] { tbutil::CpuProfiler::Stop(); },
+      [&req, resp] {
+        if (req.query_param("view") == "collapsed") {
+          resp->body = tbutil::CpuProfiler::Collapsed();
+        } else {
+          resp->body = tbutil::CpuProfiler::FlatText();
+          resp->body +=
+              "\n(collapsed stacks for flamegraphs: /hotspots?seconds=N"
+              "&view=collapsed)\n";
+        }
+      });
+}
+
+// /contention: FiberMutex wait-time profile (reference
+// bthread/mutex.cpp ContentionProfiler + /contention page).
+//   /contention?seconds=N   profile N s (default 5, max 60)
+void contention_page(const HttpRequest& req, HttpResponse* resp) {
+  run_profile_window(
+      req, resp,
+      [] {
+        tbthread::contention_profiling_reset();
+        tbthread::contention_profiling_start();
+        return true;
+      },
+      [] { tbthread::contention_profiling_stop(); },
+      [resp] { resp->body = tbthread::contention_report(); });
 }
 
 }  // namespace
@@ -301,6 +329,7 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/rpcz", rpcz_page);
     RegisterHttpHandler("/fibers", fibers_page);
     RegisterHttpHandler("/hotspots", hotspots_page);
+    RegisterHttpHandler("/contention", contention_page);
   });
 }
 

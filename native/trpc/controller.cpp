@@ -138,10 +138,11 @@ void Controller::IssueRPC() {
         _live.push_back({_nretry, sock->id(), _remote_side,
                          _attempt_begin_us});
         return;  // in flight; response/timeout/socket-failure takes over
+      } else {
+        err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
+        err_text = "write failed";
+        sock->RemovePendingId(attempt);
       }
-      err = errno != 0 ? errno : TRPC_EFAILEDSOCKET;
-      err_text = "write failed";
-      sock->RemovePendingId(attempt);
     }
     // Synchronous attempt failure: retry here if budget remains. Feedback
     // only for superseded attempts — EndRPC feeds back the final one.

@@ -245,6 +245,19 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
               msg->correlation_id = cit->second;
               conn->stream_to_correlation.erase(cit);
             }
+            // A stream the server completed early (trailers-only error
+            // before reading our DATA) may still have window-blocked DATA
+            // queued; strictly-FIFO flushing would wedge every later RPC
+            // behind it. Same cleanup as the RST_STREAM path.
+            conn->stream_send_window.erase(it->first);
+            for (auto pit = conn->pending.begin();
+                 pit != conn->pending.end();) {
+              if (pit->stream_id == it->first) {
+                pit = conn->pending.erase(pit);
+              } else {
+                ++pit;
+              }
+            }
           }
           conn->streams.erase(it);
           r.error = PARSE_OK;
@@ -855,13 +868,21 @@ void h2_process_response(InputMessageBase* base) {
   }
   tbutil::IOBuf body = std::move(msg->body);
   if (err == 0) {
-    // Strip the gRPC length prefix.
+    // Strip the gRPC length prefix, validating the declared length (same
+    // checks as the server request path — a short body or trailing second
+    // message must fail, not corrupt the payload).
     if (body.size() >= 5) {
       uint8_t prefix[5];
       body.copy_to(prefix, 5);
+      const uint32_t mlen = (uint32_t(prefix[1]) << 24) |
+                            (uint32_t(prefix[2]) << 16) |
+                            (uint32_t(prefix[3]) << 8) | prefix[4];
       if (prefix[0] != 0) {
         err = TRPC_ERESPONSE;
         err_text = "compressed grpc response not supported";
+      } else if (body.size() - 5 != mlen) {
+        err = TRPC_ERESPONSE;
+        err_text = "grpc frame length mismatch";
       } else {
         body.pop_front(5);
       }

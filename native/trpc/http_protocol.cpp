@@ -442,12 +442,59 @@ const HttpHandler* find_handler(const std::string& path,
   return nullptr;
 }
 
+// ---------------- progressive attachment ----------------
+
+namespace progressive {
+
+void append_chunk(tbutil::IOBuf* out, const tbutil::IOBuf& data) {
+  if (data.empty()) return;  // a 0-length chunk would terminate the body
+  char head[24];
+  snprintf(head, sizeof(head), "%zx\r\n", data.size());
+  out->append(head, strlen(head));
+  out->append(data);
+  out->append("\r\n", 2);
+}
+
+}  // namespace progressive
+
 // ---------------- server side ----------------
 
 void send_http_response(SocketId sid, const HttpResponse& resp,
                         bool keep_alive, bool head_request = false) {
   SocketUniquePtr s;
   if (Socket::Address(sid, &s) != 0) return;
+  if (resp.progressive != nullptr && head_request) {
+    // HEAD: no body will follow — the attachment must report closed or a
+    // pusher would buffer into it forever.
+    resp.progressive->Abandon();
+  }
+  if (resp.progressive != nullptr && !head_request) {
+    // Headers with chunked framing; `body` is the first chunk; the
+    // attachment owns the connection from here (no keep-alive reuse).
+    std::string h;
+    h += "HTTP/1.1 " + std::to_string(resp.status) + " ";
+    h += status_reason(resp.status);
+    h += "\r\nContent-Type: " + resp.content_type;
+    h += "\r\nTransfer-Encoding: chunked\r\nConnection: close";
+    for (const auto& [k, v] : resp.headers) {
+      h += "\r\n" + k + ": " + v;
+    }
+    h += "\r\n\r\n";
+    tbutil::IOBuf out;
+    out.append(h);
+    if (!resp.body.empty()) {
+      tbutil::IOBuf first;
+      first.append(resp.body);
+      progressive::append_chunk(&out, first);
+    }
+    if (s->Write(&out) != 0) {
+      s->SetFailed(TRPC_EFAILEDSOCKET);
+      resp.progressive->Abandon();
+      return;
+    }
+    resp.progressive->BindSocket(sid);
+    return;
+  }
   tbutil::IOBuf out;
   serialize_response(&out, resp, keep_alive, head_request);
   if (!keep_alive) s->MarkCloseAfterLastWrite();
@@ -670,6 +717,88 @@ int RegisterHttpHandler(const std::string& path, HttpHandler handler) {
   if (reg.exact.count(path) != 0) return -1;
   reg.exact[path] = std::move(handler);
   return 0;
+}
+
+// ---------------- ProgressiveAttachment ----------------
+
+ProgressiveAttachment::~ProgressiveAttachment() { Close(); }
+
+int ProgressiveAttachment::Write(const tbutil::IOBuf& data) {
+  std::lock_guard<std::mutex> lk(_mu);
+  if (_closed) {
+    errno = ECONNRESET;
+    return -1;
+  }
+  if (_socket_id == 0) {
+    _prebound.append(data);  // response not sent yet: buffer
+    return 0;
+  }
+  SocketUniquePtr s;
+  if (Socket::Address(_socket_id, &s) != 0 || s->Failed()) {
+    _closed = true;  // peer disconnected
+    errno = ECONNRESET;
+    return -1;
+  }
+  tbutil::IOBuf out;
+  progressive::append_chunk(&out, data);
+  if (out.empty()) return 0;
+  return s->Write(&out);  // EOVERCROWDED surfaces as -1 (try again later)
+}
+
+int ProgressiveAttachment::Write(const std::string& data) {
+  tbutil::IOBuf buf;
+  buf.append(data);
+  return Write(buf);
+}
+
+void ProgressiveAttachment::Abandon() {
+  std::lock_guard<std::mutex> lk(_mu);
+  _closed = true;  // Write() now fails instead of buffering forever
+  _prebound.clear();
+}
+
+void ProgressiveAttachment::Close() {
+  std::lock_guard<std::mutex> lk(_mu);
+  if (_closed) return;
+  _closed = true;
+  if (_socket_id == 0) return;  // BindSocket sends the terminal chunk
+  SocketUniquePtr s;
+  if (Socket::Address(_socket_id, &s) != 0) return;
+  tbutil::IOBuf fin;
+  fin.append("0\r\n\r\n", 5);
+  s->MarkCloseAfterLastWrite();
+  s->Write(&fin);
+}
+
+bool ProgressiveAttachment::closed() const {
+  std::lock_guard<std::mutex> lk(_mu);
+  if (_closed) return true;
+  if (_socket_id == 0) return false;
+  SocketUniquePtr s;
+  return Socket::Address(_socket_id, &s) != 0 || s->Failed();
+}
+
+void ProgressiveAttachment::BindSocket(uint64_t socket_id) {
+  std::lock_guard<std::mutex> lk(_mu);
+  const bool close_pending = _closed;
+  _socket_id = socket_id;
+  SocketUniquePtr s;
+  if (Socket::Address(socket_id, &s) != 0) {
+    _closed = true;
+    return;
+  }
+  if (!_prebound.empty()) {
+    tbutil::IOBuf out;
+    progressive::append_chunk(&out, _prebound);
+    _prebound.clear();
+    s->Write(&out);
+  }
+  if (close_pending) {  // Close() raced ahead of the response send
+    tbutil::IOBuf fin;
+    fin.append("0\r\n\r\n", 5);
+    s->MarkCloseAfterLastWrite();
+    s->Write(&fin);
+  }
 }
 
 void RegisterHttpProtocol() {

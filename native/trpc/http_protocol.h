@@ -16,6 +16,8 @@
 
 #include <functional>
 #include <map>
+#include <memory>
+#include <mutex>
 #include <string>
 
 #include "tbutil/iobuf.h"
@@ -42,11 +44,49 @@ struct HttpRequest {
   std::string query_param(const std::string& key) const;
 };
 
+// Server push: an unbounded chunked body that continues AFTER the response
+// headers went out (reference progressive_attachment.h — log tailing,
+// event streams). A handler creates one, stores it in
+// HttpResponse::progressive, keeps the shared_ptr (e.g. in a background
+// fiber) and Write()s chunks until Close() or the peer disconnects.
+// Writes before the response is sent are buffered; afterwards each Write
+// is a chunked-transfer frame on the wire, backpressured by the socket
+// write queue (EOVERCROWDED when the peer stops reading).
+class ProgressiveAttachment {
+ public:
+  ProgressiveAttachment() = default;
+  ~ProgressiveAttachment();  // implies Close()
+
+  // 0 on success; -1 once the peer is gone or Close() was called.
+  int Write(const tbutil::IOBuf& data);
+  int Write(const std::string& data);
+  // Terminal chunk; the connection closes after it drains.
+  void Close();
+  bool closed() const;
+  // Internal: the response could not carry a progressive body (write
+  // failure, HEAD request) — fail future Write()s instead of buffering.
+  void Abandon();
+
+  // Internal (http_protocol.cpp): attach to the connection at
+  // response-send time and flush anything buffered.
+  void BindSocket(uint64_t socket_id);
+
+ private:
+  mutable std::mutex _mu;
+  uint64_t _socket_id = 0;  // 0 = not yet bound
+  tbutil::IOBuf _prebound;  // chunks written before the response went out
+  bool _closed = false;
+};
+
 struct HttpResponse {
   int status = 200;
   std::string content_type = "text/plain";
   std::map<std::string, std::string> headers;  // extra headers
   std::string body;
+  // Non-null: `body` becomes the first chunk of an unbounded chunked
+  // response and the attachment keeps the connection (no keep-alive reuse;
+  // it closes when the attachment does).
+  std::shared_ptr<ProgressiveAttachment> progressive;
 };
 
 // Builtin page handlers (the console, reference src/brpc/builtin/). Exact

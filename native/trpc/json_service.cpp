@@ -1,0 +1,40 @@
+#include "trpc/json_service.h"
+
+#include "trpc/errno.h"
+
+namespace trpc {
+
+void JsonService::CallMethod(const std::string& method, Controller* cntl,
+                             const tbutil::IOBuf& request,
+                             tbutil::IOBuf* response, Closure* done) {
+  auto it = _methods.find(method);
+  if (it == _methods.end()) {
+    cntl->SetFailed(TRPC_ENOMETHOD, "no such method: " + _name + "/" + method);
+    done->Run();
+    return;
+  }
+  // Empty body = null value (curl without -d works for no-arg methods).
+  tbutil::JsonValue req;
+  if (!request.empty()) {
+    const std::string text = request.to_string();
+    size_t err_pos = 0;
+    auto parsed = tbutil::JsonValue::Parse(text, &err_pos);
+    if (!parsed.has_value()) {
+      cntl->SetFailed(TRPC_EREQUEST, "malformed request JSON at byte " +
+                                         std::to_string(err_pos));
+      done->Run();
+      return;
+    }
+    req = std::move(*parsed);
+  }
+  tbutil::JsonValue resp;
+  it->second(req, &resp, cntl);
+  if (!cntl->Failed()) {
+    std::string out;
+    resp.DumpTo(&out);
+    response->append(out);
+  }
+  done->Run();
+}
+
+}  // namespace trpc

@@ -1,0 +1,50 @@
+// JsonService: the json2pb-class bridge (reference src/json2pb/pb_to_json.h
+// + json_to_pb.h), redesigned for this framework's payload-agnostic core.
+//
+// The reference converts JSON<->protobuf so one pb service answers both
+// binary RPC and HTTP+JSON. Here the typed layer IS JSON: a JsonService
+// method receives a parsed tbutil::JsonValue and returns one, and because
+// it registers as an ordinary Service the SAME method body answers
+//   - tstd binary RPC   (payload = JSON bytes)
+//   - HTTP/1             curl -d '{"x":1}' host:port/Service/Method
+//   - gRPC / h2          5-byte-framed JSON payloads
+//   - tpu://             JSON over the ICI transport
+// Malformed request JSON fails the RPC with TRPC_EREQUEST before the
+// handler runs; responses serialize compactly.
+#pragma once
+
+#include <functional>
+#include <string>
+#include <string_view>
+
+#include "tbutil/json.h"
+#include "trpc/server.h"
+
+namespace trpc {
+
+class JsonService : public Service {
+ public:
+  // method handler: fill *resp (or fail via cntl->SetFailed).
+  using Handler = std::function<void(const tbutil::JsonValue& req,
+                                     tbutil::JsonValue* resp,
+                                     Controller* cntl)>;
+
+  explicit JsonService(std::string name) : _name(std::move(name)) {}
+
+  JsonService& AddMethod(const std::string& method, Handler h) {
+    _methods[method] = std::move(h);
+    return *this;
+  }
+
+  std::string_view service_name() const override { return _name; }
+
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override;
+
+ private:
+  std::string _name;
+  std::map<std::string, Handler> _methods;
+};
+
+}  // namespace trpc

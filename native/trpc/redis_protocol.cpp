@@ -11,6 +11,7 @@
 #include "trpc/input_messenger.h"
 #include "trpc/pipelined_protocol.h"
 #include "trpc/protocol.h"
+#include "trpc/server.h"
 #include "trpc/socket.h"
 
 namespace trpc {
@@ -162,12 +163,89 @@ struct RedisInputMessage : public InputMessageBase {
 
 // ---- protocol fns ----
 
+// Inbound command on a server connection: one complete RESP array.
+struct RedisCommandMessage : public InputMessageBase {
+  std::vector<std::string> args;
+};
+
+// Parses one array-of-bulk-strings command. Reuses the reply grammar
+// (commands ARE arrays of bulk strings on the wire).
+ParseResult parse_server_command(tbutil::IOBuf* source) {
+  ParseResult r;
+  char first;
+  source->copy_to(&first, 1);
+  if (first != '*') {
+    // Real redis clients always send arrays; inline commands ("GET k")
+    // would collide with HTTP verbs on this multi-protocol port.
+    r.error = PARSE_ERROR_TRY_OTHERS;
+    return r;
+  }
+  const ssize_t used = measure_reply(*source, 0, 0);
+  if (used < 0) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  if (used == 0) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  std::string flat;
+  flat.resize(static_cast<size_t>(used));
+  source->copy_to(flat.data(), flat.size());
+  RedisReply cmd;
+  if (parse_reply(flat.data(), flat.size(), &cmd, 0) !=
+          static_cast<ssize_t>(used) ||
+      cmd.type != RedisReply::Type::kArray || cmd.elements.empty()) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  auto* msg = new RedisCommandMessage;
+  msg->args.reserve(cmd.elements.size());
+  for (RedisReply& e : cmd.elements) {
+    if (e.type != RedisReply::Type::kString &&
+        e.type != RedisReply::Type::kStatus) {
+      delete msg;
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+    msg->args.push_back(std::move(e.str));
+  }
+  source->pop_front(static_cast<size_t>(used));
+  msg->process_in_place = true;  // replies answer in pipeline order
+  r.error = PARSE_OK;
+  r.msg = msg;
+  return r;
+}
+
+void redis_process_request(InputMessageBase* base) {
+  std::unique_ptr<RedisCommandMessage> msg(
+      static_cast<RedisCommandMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  auto* server = static_cast<Server*>(s->user());
+  if (server == nullptr || server->redis_service() == nullptr) return;
+  RedisReply reply;
+  server->redis_service()->OnCommand(msg->args, &reply);
+  std::string wire;
+  SerializeRedisReply(reply, &wire);
+  tbutil::IOBuf out;
+  out.append(wire);
+  s->Write(&out);
+}
+
 ParseResult redis_parse(tbutil::IOBuf* source, Socket* socket) {
   ParseResult r;
   if (socket->server_side()) {
-    // Client-only protocol: never claim inbound server traffic.
-    r.error = PARSE_ERROR_TRY_OTHERS;
-    return r;
+    // Server half only exists where a RedisService is attached.
+    auto* server = static_cast<Server*>(socket->user());
+    if (server == nullptr || server->redis_service() == nullptr ||
+        source->empty()) {
+      r.error = server != nullptr && server->redis_service() != nullptr
+                    ? PARSE_ERROR_NOT_ENOUGH_DATA
+                    : PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
+    return parse_server_command(source);
   }
   if (source->empty()) {
     r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
@@ -218,6 +296,34 @@ void redis_pack_request(tbutil::IOBuf* out, Controller* cntl,
 }
 
 }  // namespace
+
+void SerializeRedisReply(const RedisReply& r, std::string* out) {
+  switch (r.type) {
+    case RedisReply::Type::kStatus:
+      *out += "+" + r.str + "\r\n";
+      break;
+    case RedisReply::Type::kError:
+      *out += "-" + r.str + "\r\n";
+      break;
+    case RedisReply::Type::kInteger:
+      *out += ":" + std::to_string(r.integer) + "\r\n";
+      break;
+    case RedisReply::Type::kNil:
+      *out += "$-1\r\n";
+      break;
+    case RedisReply::Type::kString:
+      *out += "$" + std::to_string(r.str.size()) + "\r\n";
+      *out += r.str;
+      *out += "\r\n";
+      break;
+    case RedisReply::Type::kArray:
+      *out += "*" + std::to_string(r.elements.size()) + "\r\n";
+      for (const RedisReply& e : r.elements) {
+        SerializeRedisReply(e, out);
+      }
+      break;
+  }
+}
 
 // ---- RedisRequest / RedisResponse ----
 
@@ -296,7 +402,7 @@ void RegisterRedisProtocol() {
   Protocol p;
   p.parse = redis_parse;
   p.pack_request = redis_pack_request;
-  p.process_request = nullptr;  // client-only
+  p.process_request = redis_process_request;
   p.process_response = redis_process_response;
   p.short_connection = true;  // no correlation id on the wire (like HTTP)
   p.weak_magic = true;        // RESP has type chars, not a magic number

@@ -79,6 +79,25 @@ class RedisResponse {
 int RedisExecute(Channel& channel, Controller* cntl,
                  const RedisRequest& request, RedisResponse* resp);
 
+// ---- server side (reference redis.h RedisService + the server half of
+// policy/redis_protocol.cpp) ----
+// Subclass and attach via ServerOptions.redis_service: the server then ALSO
+// answers RESP on its port (multi-protocol, like everything else). Only
+// array-form commands are accepted (what every real redis client sends);
+// inline commands would collide with HTTP verbs on a shared port.
+class RedisService {
+ public:
+  virtual ~RedisService() = default;
+  // args[0] is the command name, original case. Runs on the connection's
+  // input fiber in PIPELINE ORDER (replies match commands by position) —
+  // keep handlers non-blocking; fill *reply (error => kError + message).
+  virtual void OnCommand(const std::vector<std::string>& args,
+                         RedisReply* reply) = 0;
+};
+
+// RESP2 wire form of a reply tree (server responses; also useful in tests).
+void SerializeRedisReply(const RedisReply& r, std::string* out);
+
 // Registry hookup (GlobalInitializeOrDie).
 void RegisterRedisProtocol();
 

@@ -50,6 +50,8 @@ class Interceptor {
                         std::string* error_text) = 0;
 };
 
+class RedisService;
+
 struct ServerOptions {
   // 0 = unlimited. Requests over the cap are rejected with TRPC_ELIMIT
   // (reference ServerOptions.max_concurrency server.h:132).
@@ -75,6 +77,9 @@ struct ServerOptions {
   // the observed average latency (reference max_concurrency = "timeout",
   // policy/timeout_concurrency_limiter.cpp).
   int64_t timeout_concurrency_ms = 0;
+  // Non-null = this port ALSO speaks RESP (reference
+  // ServerOptions.redis_service). Not owned; must outlive the server.
+  class RedisService* redis_service = nullptr;
 };
 
 class Server {
@@ -134,6 +139,7 @@ class Server {
   int32_t current_max_concurrency() const;
   Interceptor* interceptor() const { return _options.interceptor; }
   RpcDumper* dumper() const { return _dumper.get(); }
+  RedisService* redis_service() const { return _options.redis_service; }
 
  private:
   tbutil::FlatMap<std::string, Service*> _services;
