@@ -1,0 +1,99 @@
+#include "tbvar/series.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <thread>
+
+#include "tbvar/variable.h"
+
+namespace tbvar {
+
+namespace {
+
+template <size_t N>
+struct Ring {
+  double v[N] = {0};
+  size_t n = 0;      // filled count (<= N)
+  size_t next = 0;   // write position
+  void push(double x) {
+    v[next] = x;
+    next = (next + 1) % N;
+    if (n < N) ++n;
+  }
+  void dump(std::vector<double>* out) const {
+    out->clear();
+    out->reserve(n);
+    // Oldest first: start at `next` when full, else at 0.
+    const size_t start = n == N ? next : 0;
+    for (size_t i = 0; i < n; ++i) {
+      out->push_back(v[(start + i) % N]);
+    }
+  }
+};
+
+struct VarSeries {
+  Ring<60> seconds;
+  Ring<60> minutes;
+  Ring<24> hours;
+  int64_t ticks = 0;
+  void push(double x) {
+    seconds.push(x);
+    ++ticks;
+    if (ticks % 60 == 0) minutes.push(x);
+    if (ticks % 3600 == 0) hours.push(x);
+  }
+};
+
+struct Store {
+  std::mutex mu;
+  std::map<std::string, VarSeries> map;
+};
+Store& store() {
+  static auto* s = new Store;
+  return *s;
+}
+
+std::atomic<bool> g_active{false};
+
+void sampler_loop() {
+  while (true) {
+    std::this_thread::sleep_for(std::chrono::seconds(1));
+    std::map<std::string, std::string> vars;
+    Variable::dump_exposed(&vars);
+    std::lock_guard<std::mutex> lk(store().mu);
+    for (const auto& [name, value] : vars) {
+      // Numeric-only: a full-string parse must succeed.
+      char* end = nullptr;
+      const double d = strtod(value.c_str(), &end);
+      if (end == value.c_str() || end != value.c_str() + value.size()) {
+        continue;
+      }
+      store().map[name].push(d);
+    }
+  }
+}
+
+}  // namespace
+
+void series_sampling_start() {
+  bool expected = false;
+  if (!g_active.compare_exchange_strong(expected, true)) return;
+  std::thread(sampler_loop).detach();
+}
+
+bool series_sampling_active() { return g_active.load(); }
+
+bool series_get(const std::string& name, SeriesData* out) {
+  std::lock_guard<std::mutex> lk(store().mu);
+  auto it = store().map.find(name);
+  if (it == store().map.end()) return false;
+  it->second.seconds.dump(&out->seconds);
+  it->second.minutes.dump(&out->minutes);
+  it->second.hours.dump(&out->hours);
+  return !out->seconds.empty();
+}
+
+}  // namespace tbvar

@@ -1,0 +1,33 @@
+// Time-series sampling of exposed variables (reference bvar/variable.h
+// series support + the console's trend plots): a background thread samples
+// every NUMERIC exposed variable once per second into fixed rings —
+// last 60 seconds, last 60 minutes, last 24 hours — so a human can see a
+// leak or a spike instead of one instantaneous number.
+//
+// Zero cost until started; the console's /vars?series view starts it
+// lazily. Values parse from describe() output (only variables whose
+// description is a plain number participate — counters, gauges,
+// PassiveStatus; structured variables are skipped).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tbvar {
+
+// Starts the 1Hz sampler thread (idempotent).
+void series_sampling_start();
+bool series_sampling_active();
+
+struct SeriesData {
+  // Newest LAST. Missing history = shorter vectors.
+  std::vector<double> seconds;  // up to 60, 1s apart
+  std::vector<double> minutes;  // up to 60, 1m apart (value at minute edge)
+  std::vector<double> hours;    // up to 24, 1h apart
+};
+
+// False if the variable is unknown or has no samples yet.
+bool series_get(const std::string& name, SeriesData* out);
+
+}  // namespace tbvar

@@ -118,6 +118,24 @@ std::vector<std::string> build_seeds() {
     uint32_t idx = 7;
     tici_seed(3, std::string(reinterpret_cast<char*>(&idx), 4));
   }
+  // -- thrift framed REPLY (version word 0x80010002, method, seqid) --
+  {
+    auto be32 = [](std::string* o, uint32_t v) {
+      o->push_back(char((v >> 24) & 0xff));
+      o->push_back(char((v >> 16) & 0xff));
+      o->push_back(char((v >> 8) & 0xff));
+      o->push_back(char(v & 0xff));
+    };
+    std::string body;
+    be32(&body, 0x80010002u);
+    be32(&body, 4);
+    body += "Echo";
+    be32(&body, 1);
+    body += std::string(24, 't');  // struct bytes
+    std::string framed;
+    be32(&framed, static_cast<uint32_t>(body.size()));
+    seeds.push_back(framed + body);
+  }
   return seeds;
 }
 

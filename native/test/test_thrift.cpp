@@ -1,0 +1,98 @@
+// Thrift framed protocol: our client against our ThriftFramedService — the
+// full envelope round trip (frame, version word, method, seqid, exception
+// path), struct bytes passed through untouched (reference
+// thrift_protocol.cpp pass-through mode).
+#include <string>
+
+#include "mini_test.h"
+#include "trpc/channel.h"
+#include "trpc/errno.h"
+#include "trpc/server.h"
+#include "trpc/thrift_protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+class EchoThrift : public ThriftFramedService {
+ public:
+  void OnThriftCall(const std::string& method,
+                    const tbutil::IOBuf& args_struct,
+                    tbutil::IOBuf* result_struct, Controller* cntl) override {
+    if (method == "Boom") {
+      cntl->SetFailed(TRPC_EINTERNAL, "boom happened");
+      return;
+    }
+    last_method = method;
+    result_struct->append(args_struct);
+  }
+  std::string last_method;
+};
+
+}  // namespace
+
+TEST_CASE(thrift_framed_round_trip) {
+  EchoThrift svc;
+  Server server;
+  ServerOptions opts;
+  opts.thrift_service = &svc;
+  ASSERT_EQ(server.Start("127.0.0.1:0", &opts), 0);
+  char addr[64];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 5000;
+  copts.protocol = kThriftProtocolIndex;
+  ASSERT_EQ(ch.Init(addr, &copts), 0);
+
+  // "Struct bytes" are opaque to the framework — any payload round-trips.
+  for (int i = 0; i < 5; ++i) {
+    Controller cntl;
+    tbutil::IOBuf args, result;
+    std::string blob = "thrift-struct-" + std::to_string(i) +
+                       std::string(size_t(i) * 500, 's');
+    blob.push_back('\0');  // binary-safe
+    blob += "tail";
+    args.append(blob);
+    ch.CallMethod("Echo", &cntl, args, &result, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_TRUE(result.to_string() == blob);
+    ASSERT_EQ(svc.last_method, std::string("Echo"));
+  }
+
+  // Handler failure -> TApplicationException on the wire; the client sees
+  // the exception struct bytes (message field first) as the reply.
+  Controller cntl;
+  tbutil::IOBuf args, result;
+  args.append("x");
+  ch.CallMethod("Boom", &cntl, args, &result, nullptr);
+  ASSERT_FALSE(cntl.Failed());  // envelope-level delivery succeeded
+  ASSERT_TRUE(result.to_string().find("boom happened") != std::string::npos);
+  server.Stop();
+}
+
+TEST_CASE(thrift_and_tstd_same_port) {
+  EchoThrift svc;
+  Server server;
+  ServerOptions opts;
+  opts.thrift_service = &svc;
+  ASSERT_EQ(server.Start("127.0.0.1:0", &opts), 0);
+  char addr[64];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  // The same port still answers tstd traffic (ENOSERVICE, not a parse
+  // kill), proving the thrift parser does not over-claim.
+  Channel plain;
+  ChannelOptions popts;
+  popts.timeout_ms = 3000;
+  popts.max_retry = 0;
+  ASSERT_EQ(plain.Init(addr, &popts), 0);
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("y");
+  plain.CallMethod("NoSvc/None", &cntl, req, &resp, nullptr);
+  ASSERT_TRUE(cntl.Failed());
+  ASSERT_EQ(cntl.ErrorCode(), TRPC_ENOSERVICE);
+  server.Stop();
+}
+
+TEST_MAIN

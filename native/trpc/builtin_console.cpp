@@ -12,6 +12,7 @@
 #include "tbutil/cpu_profiler.h"
 #include "tbutil/time.h"
 #include "tbvar/prometheus.h"
+#include "tbvar/series.h"
 #include "tbvar/variable.h"
 #include "trpc/flags.h"
 #include "trpc/http_protocol.h"
@@ -74,11 +75,51 @@ void status_page(const HttpRequest& req, HttpResponse* resp) {
   }
 }
 
+// Text sparkline of a sample vector (min..max scaled to 8 levels).
+void render_series_row(const char* label, const std::vector<double>& v,
+                       std::string* out) {
+  if (v.empty()) return;
+  double lo = v[0], hi = v[0];
+  for (double x : v) {
+    if (x < lo) lo = x;
+    if (x > hi) hi = x;
+  }
+  char line[64];
+  snprintf(line, sizeof(line), "%-8s [%zu] min=%g max=%g\n  ", label,
+           v.size(), lo, hi);
+  *out += line;
+  static const char* kBars[] = {"_", "▁", "▂", "▃", "▄", "▅", "▆", "▇"};
+  for (double x : v) {
+    const int level =
+        hi > lo ? static_cast<int>((x - lo) / (hi - lo) * 7.999) : 0;
+    *out += kBars[level];
+  }
+  *out += "\n  latest: ";
+  snprintf(line, sizeof(line), "%g\n", v.back());
+  *out += line;
+}
+
 void vars_page(const HttpRequest& req, HttpResponse* resp) {
-  // /vars -> all; /vars/PREFIX -> filtered.
+  // /vars -> all; /vars/PREFIX -> filtered; /vars/NAME?series=1 -> trend
+  // rings (reference: bvar series + the console plots).
   std::string prefix;
   if (req.path.size() > 6 && req.path.rfind("/vars/", 0) == 0) {
     prefix = req.path.substr(6);
+  }
+  if (!prefix.empty() && req.query_param("series") == "1") {
+    tbvar::series_sampling_start();
+    tbvar::SeriesData data;
+    if (!tbvar::series_get(prefix, &data)) {
+      resp->body = "no samples yet for \"" + prefix +
+                   "\" (sampling just started or the variable is not "
+                   "numeric); refresh in a second\n";
+      return;
+    }
+    resp->body = prefix + "\n";
+    render_series_row("seconds", data.seconds, &resp->body);
+    render_series_row("minutes", data.minutes, &resp->body);
+    render_series_row("hours", data.hours, &resp->body);
+    return;
   }
   std::map<std::string, std::string> vars;
   tbvar::Variable::dump_exposed(&vars);

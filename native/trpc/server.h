@@ -51,6 +51,7 @@ class Interceptor {
 };
 
 class RedisService;
+class ThriftFramedService;
 
 struct ServerOptions {
   // 0 = unlimited. Requests over the cap are rejected with TRPC_ELIMIT
@@ -80,6 +81,9 @@ struct ServerOptions {
   // Non-null = this port ALSO speaks RESP (reference
   // ServerOptions.redis_service). Not owned; must outlive the server.
   class RedisService* redis_service = nullptr;
+  // Non-null = this port ALSO answers thrift framed calls (reference
+  // ServerOptions.thrift_service). Not owned; must outlive the server.
+  class ThriftFramedService* thrift_service = nullptr;
 };
 
 class Server {
@@ -140,6 +144,9 @@ class Server {
   Interceptor* interceptor() const { return _options.interceptor; }
   RpcDumper* dumper() const { return _dumper.get(); }
   RedisService* redis_service() const { return _options.redis_service; }
+  ThriftFramedService* thrift_service() const {
+    return _options.thrift_service;
+  }
 
  private:
   tbutil::FlatMap<std::string, Service*> _services;

@@ -1,0 +1,238 @@
+#include "trpc/thrift_protocol.h"
+
+#include <cstring>
+
+#include "tbutil/logging.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/input_messenger.h"
+#include "trpc/pipelined_protocol.h"
+#include "trpc/protocol.h"
+#include "trpc/server.h"
+#include "trpc/socket.h"
+
+namespace trpc {
+
+namespace {
+
+// TBinaryProtocol strict version word: high bits 0x8001, low 8 bits = type.
+constexpr uint32_t kThriftVersionMask = 0xffff0000;
+constexpr uint32_t kThriftVersion1 = 0x80010000;
+constexpr size_t kMaxThriftFrame = 64u << 20;
+
+enum ThriftMessageType : uint8_t {
+  kCall = 1,
+  kReply = 2,
+  kException = 3,
+  kOneway = 4,
+};
+
+uint32_t get_u32be(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | p[3];
+}
+void put_u32be(std::string* s, uint32_t v) {
+  s->push_back(static_cast<char>((v >> 24) & 0xff));
+  s->push_back(static_cast<char>((v >> 16) & 0xff));
+  s->push_back(static_cast<char>((v >> 8) & 0xff));
+  s->push_back(static_cast<char>(v & 0xff));
+}
+
+// Frame: u32 len | u32 version|type | u32 name_len | name | u32 seqid |
+// struct bytes. Builds everything after the length prefix.
+void build_message(std::string* out, uint8_t type, const std::string& method,
+                   uint32_t seqid, const tbutil::IOBuf& body) {
+  std::string payload;
+  put_u32be(&payload, kThriftVersion1 | type);
+  put_u32be(&payload, static_cast<uint32_t>(method.size()));
+  payload += method;
+  put_u32be(&payload, seqid);
+  put_u32be(out, static_cast<uint32_t>(payload.size() + body.size()));
+  *out += payload;
+}
+
+// Minimal TApplicationException result struct: field 1 (string message),
+// field 2 (i32 type=6 INTERNAL_ERROR), stop.
+void build_exception_struct(std::string* out, const std::string& message) {
+  out->push_back(11);  // TType::STRING
+  out->push_back(0);
+  out->push_back(1);  // field id 1
+  put_u32be(out, static_cast<uint32_t>(message.size()));
+  *out += message;
+  out->push_back(8);  // TType::I32
+  out->push_back(0);
+  out->push_back(2);  // field id 2
+  put_u32be(out, 6);  // INTERNAL_ERROR
+  out->push_back(0);  // TType::STOP
+}
+
+struct ThriftMessage {
+  uint8_t msg_type = 0;
+  std::string method;
+  uint32_t seqid = 0;
+  tbutil::IOBuf body;  // raw struct bytes
+};
+
+// One complete framed message at the head of `source`. Returns 1 and fills
+// *out on success; 0 incomplete; -1 not thrift / malformed.
+int cut_message(tbutil::IOBuf* source, ThriftMessage* out) {
+  if (source->size() < 8) return 0;
+  uint8_t head[16];
+  source->copy_to(head, 16);
+  const uint32_t frame_len = get_u32be(head);
+  if (frame_len < 12 || frame_len > kMaxThriftFrame) return -1;
+  const uint32_t version = get_u32be(head + 4);
+  if ((version & kThriftVersionMask) != kThriftVersion1) return -1;
+  const uint8_t type = version & 0xff;
+  if (type < kCall || type > kOneway) return -1;
+  if (source->size() < 12) return 0;
+  const uint32_t name_len = get_u32be(head + 8);
+  if (name_len > 1024 || 12 + name_len > frame_len) return -1;
+  if (source->size() < 4 + size_t(frame_len)) return 0;
+  source->pop_front(12);
+  std::string method(name_len, '\0');
+  source->cutn(method.data(), name_len);
+  uint8_t seq[4];
+  source->cutn(seq, 4);
+  out->msg_type = type;
+  out->method = std::move(method);
+  out->seqid = get_u32be(seq);
+  source->cutn(&out->body, frame_len - 12 - name_len);
+  return 1;
+}
+
+struct ThriftInputMessage : public InputMessageBase {
+  ThriftMessage msg;
+};
+
+ParseResult thrift_parse(tbutil::IOBuf* source, Socket* socket) {
+  ParseResult r;
+  if (socket->server_side()) {
+    // Only claim inbound calls when the server has a thrift hook.
+    auto* server = static_cast<Server*>(socket->user());
+    if (server == nullptr || server->thrift_service() == nullptr) {
+      r.error = PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
+  }
+  // Cheap plausibility before claiming: the version word must be present
+  // and match (bytes 4..7). With < 8 bytes buffered, defer only if the
+  // length prefix looks sane for thrift (first byte <= 0x03 — frames up
+  // to kMaxThriftFrame, 64MB; anything larger is rejected by cut_message
+  // anyway, so the two gates agree regardless of read fragmentation).
+  if (source->size() < 8) {
+    uint8_t b0;
+    if (source->copy_to(&b0, 1) == 1 && b0 > 0x03) {
+      r.error = PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
+    r.error = source->empty() ? PARSE_ERROR_TRY_OTHERS
+                              : PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  {
+    uint8_t head[8];
+    source->copy_to(head, 8);
+    if ((get_u32be(head + 4) & kThriftVersionMask) != kThriftVersion1) {
+      r.error = PARSE_ERROR_TRY_OTHERS;
+      return r;
+    }
+  }
+  auto msg = std::make_unique<ThriftInputMessage>();
+  const int rc = cut_message(source, &msg->msg);
+  if (rc == 0) {
+    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    return r;
+  }
+  if (rc < 0) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  msg->process_in_place = true;  // call order == reply order
+  r.error = PARSE_OK;
+  r.msg = msg.release();
+  return r;
+}
+
+void thrift_process_request(InputMessageBase* base) {
+  std::unique_ptr<ThriftInputMessage> msg(
+      static_cast<ThriftInputMessage*>(base));
+  SocketUniquePtr s;
+  if (Socket::Address(msg->socket_id, &s) != 0) return;
+  auto* server = static_cast<Server*>(s->user());
+  if (server == nullptr || server->thrift_service() == nullptr) return;
+  if (msg->msg.msg_type != kCall && msg->msg.msg_type != kOneway) return;
+  Controller cntl;
+  ControllerPrivateAccessor(&cntl).set_server_side(s->remote_side(), 0);
+  tbutil::IOBuf result;
+  server->thrift_service()->OnThriftCall(msg->msg.method, msg->msg.body,
+                                         &result, &cntl);
+  if (msg->msg.msg_type == kOneway) return;  // fire and forget
+  std::string wire;
+  if (cntl.Failed()) {
+    std::string exc;
+    build_exception_struct(&exc, cntl.ErrorText());
+    tbutil::IOBuf exc_body;
+    exc_body.append(exc);
+    build_message(&wire, kException, msg->msg.method, msg->msg.seqid,
+                  exc_body);
+    tbutil::IOBuf out;
+    out.append(wire);
+    out.append(std::move(exc_body));
+    s->Write(&out);
+    return;
+  }
+  build_message(&wire, kReply, msg->msg.method, msg->msg.seqid, result);
+  tbutil::IOBuf out;
+  out.append(wire);
+  out.append(std::move(result));
+  s->Write(&out);
+}
+
+void thrift_process_response(InputMessageBase* base) {
+  std::unique_ptr<ThriftInputMessage> owned(
+      static_cast<ThriftInputMessage*>(base));
+  // Exclusive short connection: the single pending RPC is the match —
+  // correlation rides the connection, not the seqid (which is always 1 on
+  // the fresh connection each call uses; a wrong-seqid reply from a
+  // broken server is indistinguishable by design, same as HTTP/redis).
+  tbutil::IOBuf reply = std::move(owned->msg.body);
+  const bool is_exception = owned->msg.msg_type == kException;
+  DeliverPipelinedReply(
+      owned->socket_id, std::move(reply),
+      // The whole buffered reply is one complete "unit" per RPC.
+      [](const tbutil::IOBuf& buf, size_t pos) -> ssize_t {
+        return pos < buf.size() ? static_cast<ssize_t>(buf.size() - pos) : 0;
+      });
+  (void)is_exception;  // struct-level success/exception stays app-visible
+}
+
+void thrift_pack_request(tbutil::IOBuf* out, Controller* /*cntl*/,
+                         uint64_t /*correlation_id*/,
+                         const std::string& service_method,
+                         const tbutil::IOBuf& payload, Socket*) {
+  // method = service_method (thrift has no service prefix on the wire).
+  std::string wire;
+  build_message(&wire, kCall, service_method, /*seqid=*/1, payload);
+  out->append(wire);
+  out->append(payload);
+}
+
+}  // namespace
+
+void RegisterThriftProtocol() {
+  static bool done = [] {
+    Protocol p;
+    p.parse = thrift_parse;
+    p.pack_request = thrift_pack_request;
+    p.process_request = thrift_process_request;
+    p.process_response = thrift_process_response;
+    p.short_connection = true;  // reply matches by position, like redis
+    p.name = "thrift";
+    return RegisterProtocol(kThriftProtocolIndex, p) == 0;
+  }();
+  TB_CHECK(done) << "thrift protocol slot taken";
+}
+
+}  // namespace trpc

@@ -1,0 +1,49 @@
+// Thrift framed-transport protocol (TFramedTransport + TBinaryProtocol
+// message envelope), client + server on the shared registry.
+// Capability parity: reference src/brpc/policy/thrift_protocol.cpp +
+// thrift_service.h: the framework carries the MESSAGE envelope (frame
+// length, version word, method name, seqid, message type) and hands the
+// raw struct bytes to the application — struct (de)serialization stays
+// with the caller's thrift-generated code, exactly like the reference's
+// ThriftFramedMessage pass-through mode.
+//
+// Client usage (short connection, replies match the socket's single
+// in-flight call — same stance as HTTP/redis):
+//   ChannelOptions o; o.protocol = kThriftProtocolIndex;
+//   ch.Init("host:9090", &o);
+//   Controller cntl; IOBuf args_struct = <thrift-serialized args>;
+//   ch.CallMethod("Echo", &cntl, args_struct, &result_struct, nullptr);
+// Server usage:
+//   class MyThrift : public ThriftFramedService {
+//     void OnThriftCall(const std::string& method, const tbutil::IOBuf& in,
+//                       tbutil::IOBuf* out, Controller* cntl) override;
+//   };
+//   ServerOptions o; o.thrift_service = &my;  // port also answers thrift
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+class Controller;
+
+inline constexpr int kThriftProtocolIndex = 6;
+
+// Server hook: raw args struct in, raw result struct out. Runs on the
+// connection's input fiber in call order. Fail via cntl->SetFailed — the
+// peer receives a TApplicationException with the error text.
+class ThriftFramedService {
+ public:
+  virtual ~ThriftFramedService() = default;
+  virtual void OnThriftCall(const std::string& method,
+                            const tbutil::IOBuf& args_struct,
+                            tbutil::IOBuf* result_struct,
+                            Controller* cntl) = 0;
+};
+
+void RegisterThriftProtocol();  // idempotent (GlobalInitializeOrDie)
+
+}  // namespace trpc

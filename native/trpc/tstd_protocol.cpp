@@ -1,5 +1,7 @@
 #include "trpc/tstd_protocol.h"
 
+#include "trpc/thrift_protocol.h"
+
 #include <algorithm>
 #include <csignal>
 #include <bit>
@@ -435,6 +437,7 @@ void GlobalInitializeOrDie() {
     RegisterRedisProtocol();
     RegisterMemcacheProtocol();
     RegisterH2Protocol();
+    RegisterThriftProtocol();
     RegisterBuiltinConsole();
   });
 }

@@ -1,0 +1,100 @@
+// rpc_view: proxy another server's builtin console through a local HTTP
+// port (reference tools/rpc_view — view a server that only speaks the RPC
+// port from a browser elsewhere).
+//
+// Usage:
+//   rpc_view --target=HOST:PORT [--port=8888]
+//
+// Every path under /tgt/... is fetched from the target verbatim
+// (/tgt/vars -> target's /vars, /tgt/rpcz?trace=X -> target's /rpcz?...).
+// Top-level paths are the VIEWER's own console (its /vars, /health, ...);
+// always use the /tgt/ prefix to reach the target.
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "trpc/channel.h"
+#include "trpc/http_protocol.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+namespace {
+
+Channel g_target;
+std::string g_target_addr;
+
+void proxy(const std::string& path_and_query, HttpResponse* resp) {
+  Controller cntl;
+  cntl.set_timeout_ms(65000);  // profile pages park up to 60s
+  tbutil::IOBuf req, body;
+  // Empty request body = GET on the http client path (which prepends "/").
+  std::string target_path = path_and_query;
+  if (!target_path.empty() && target_path[0] == '/') {
+    target_path.erase(0, 1);
+  }
+  g_target.CallMethod(target_path, &cntl, req, &body, nullptr);
+  if (cntl.Failed()) {
+    resp->status = 502;
+    resp->body = "rpc_view: " + g_target_addr + path_and_query + " failed: " +
+                 cntl.ErrorText() + "\n";
+    return;
+  }
+  resp->body = body.to_string();
+  // Console pages are text or html; sniff the html ones so links render.
+  if (resp->body.rfind("<html>", 0) == 0 ||
+      resp->body.rfind("<!", 0) == 0) {
+    resp->content_type = "text/html";
+  }
+}
+
+void view_handler(const HttpRequest& req, HttpResponse* resp) {
+  std::string path = req.path;
+  if (path.rfind("/tgt", 0) == 0) {
+    path = path.substr(4);
+    if (path.empty()) path = "/";
+  }
+  if (!req.query.empty()) path += "?" + req.query;
+  proxy(path, resp);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string target;
+  int port = 8888;
+  for (int i = 1; i < argc; ++i) {
+    if (strncmp(argv[i], "--target=", 9) == 0) target = argv[i] + 9;
+    else if (strncmp(argv[i], "--port=", 7) == 0) port = atoi(argv[i] + 7);
+    else {
+      fprintf(stderr, "unknown arg: %s\n", argv[i]);
+      return 1;
+    }
+  }
+  if (target.empty()) {
+    fprintf(stderr, "usage: rpc_view --target=HOST:PORT [--port=8888]\n");
+    return 1;
+  }
+  g_target_addr = target;
+  ChannelOptions copts;
+  copts.timeout_ms = 65000;
+  copts.protocol = kHttpProtocolIndex;
+  if (g_target.Init(target.c_str(), &copts) != 0) {
+    fprintf(stderr, "cannot reach target %s\n", target.c_str());
+    return 1;
+  }
+  RegisterHttpHandler("/tgt/", view_handler);
+  RegisterHttpHandler("/tgt", view_handler);
+  Server server;
+  char addr[64];
+  snprintf(addr, sizeof(addr), "0.0.0.0:%d", port);
+  if (server.Start(addr, nullptr) != 0) {
+    fprintf(stderr, "cannot listen on %s\n", addr);
+    return 1;
+  }
+  printf("rpc_view: http://127.0.0.1:%d/tgt/ -> %s\n",
+         server.listen_address().port, target.c_str());
+  fflush(stdout);
+  server.Join();
+  return 0;
+}
