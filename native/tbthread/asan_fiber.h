@@ -1,0 +1,45 @@
+// AddressSanitizer fiber-switch annotations.
+// ASan tracks one stack (and one fake-stack for use-after-return) per
+// thread; jumping to a fiber stack behind its back corrupts the allocator's
+// per-thread state (observed: SEGV inside asan_allocator.cpp on the first
+// free after a switch). The fix is the documented protocol — tell ASan
+// about every switch with __sanitizer_start_switch_fiber (before the jump,
+// with the DESTINATION stack) and __sanitizer_finish_switch_fiber (first
+// thing on the new stack, with the fake-stack saved when that context last
+// left). The reference does the same for its bthread context switches when
+// built under sanitizers. No-ops in non-ASan builds.
+#pragma once
+
+#include <cstddef>
+
+// GCC defines __SANITIZE_ADDRESS__; Clang only exposes __has_feature.
+#if !defined(__SANITIZE_ADDRESS__) && defined(__has_feature)
+#if __has_feature(address_sanitizer)
+#define __SANITIZE_ADDRESS__ 1
+#endif
+#endif
+
+#if defined(__SANITIZE_ADDRESS__)
+#include <sanitizer/common_interface_defs.h>
+#endif
+
+namespace tbthread {
+
+#if defined(__SANITIZE_ADDRESS__)
+// fake_stack_save: where to stash the departing context's fake stack;
+// nullptr means the departing context is dying (ASan frees its fake stack).
+inline void asan_start_switch(void** fake_stack_save, const void* dest_bottom,
+                              size_t dest_size) {
+  __sanitizer_start_switch_fiber(fake_stack_save, dest_bottom, dest_size);
+}
+// fake_stack: the value stashed when this context last departed (nullptr on
+// a context's first entry).
+inline void asan_finish_switch(void* fake_stack) {
+  __sanitizer_finish_switch_fiber(fake_stack, nullptr, nullptr);
+}
+#else
+inline void asan_start_switch(void**, const void*, size_t) {}
+inline void asan_finish_switch(void*) {}
+#endif
+
+}  // namespace tbthread

@@ -1,5 +1,8 @@
 #include "tbthread/task_group.h"
 
+#include <pthread.h>
+
+#include "tbthread/asan_fiber.h"
 #include "tbthread/butex.h"
 #include "tbthread/context.h"
 #include "tbthread/key.h"
@@ -30,6 +33,15 @@ fiber_t TaskGroup::cur_tid() const {
 
 void TaskGroup::run_main_task() {
   tls_task_group = this;
+  // Capture this worker pthread's stack bounds once: every fiber->scheduler
+  // switch must describe this stack to ASan (asan_fiber.h).
+  {
+    pthread_attr_t attr;
+    if (pthread_getattr_np(pthread_self(), &attr) == 0) {
+      pthread_attr_getstack(&attr, &_sched_stack_bottom, &_sched_stack_size);
+      pthread_attr_destroy(&attr);
+    }
+  }
   TaskMeta* meta = nullptr;
   while (wait_task(&meta)) {
     sched_to(meta);
@@ -68,8 +80,11 @@ bool TaskGroup::steal_from(TaskMeta** m) {
 
 void TaskGroup::sched_to(TaskMeta* next) {
   _cur_meta.store(next, std::memory_order_relaxed);
+  asan_start_switch(&_sched_fake_stack, next->stack->stack_base,
+                    next->stack->stack_size);
   tb_jump_fcontext(&_main_sp, next->ctx_sp, reinterpret_cast<intptr_t>(this));
   // Back on the scheduler stack: the fiber parked, yielded, or exited.
+  asan_finish_switch(_sched_fake_stack);
   _cur_meta.store(nullptr, std::memory_order_relaxed);
   if (_remained_fn != nullptr) {
     void (*fn)(void*) = _remained_fn;
@@ -85,9 +100,12 @@ void TaskGroup::park(void (*remained)(void*), void* arg) {
   TaskMeta* m = g->cur_meta();
   g->_remained_fn = remained;
   g->_remained_arg = arg;
+  asan_start_switch(&m->asan_fake_stack, g->_sched_stack_bottom,
+                    g->_sched_stack_size);
   tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
   // Resumed — possibly on a different worker; tls reads must be re-fetched
   // by the caller.
+  asan_finish_switch(m->asan_fake_stack);
 }
 
 void TaskGroup::yield() {
@@ -106,6 +124,7 @@ void TaskGroup::yield() {
 
 void TaskGroup::task_entry(intptr_t group_ptr) {
   auto* g = reinterpret_cast<TaskGroup*>(group_ptr);
+  asan_finish_switch(nullptr);  // first entry: no saved fake stack yet
   TaskMeta* m = g->cur_meta();
   m->fn(m->arg);
   exit_current();
@@ -116,6 +135,8 @@ void TaskGroup::exit_current() {
   TaskMeta* m = g->cur_meta();
   g->_remained_fn = task_ends;
   g->_remained_arg = m;
+  // nullptr save slot = context is dying; ASan frees its fake stack.
+  asan_start_switch(nullptr, g->_sched_stack_bottom, g->_sched_stack_size);
   tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
   __builtin_unreachable();  // never resumed
 }

@@ -33,6 +33,9 @@ struct TaskMeta {
   void* arg = nullptr;
   void* ctx_sp = nullptr;  // saved stack pointer while suspended
   StackContainer* stack = nullptr;
+  // ASan fake-stack handle saved at each switch-out (asan_fiber.h); unused
+  // (always nullptr) outside -fsanitize=address builds.
+  void* asan_fake_stack = nullptr;
   FiberAttr attr;
   tbutil::ResourceId slot = 0;
   // Allocated on first use of the slot, never freed: join-after-reuse must

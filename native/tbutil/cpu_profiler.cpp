@@ -1,6 +1,5 @@
 #include "tbutil/cpu_profiler.h"
 
-#include <dlfcn.h>
 #include <signal.h>
 #include <sys/time.h>
 #include <ucontext.h>
@@ -14,12 +13,16 @@
 
 #include "tbthread/task_group.h"
 #include "tbthread/task_meta.h"
+#include "tbutil/stack_walk.h"
 
 namespace tbutil {
 
 namespace {
 
-constexpr size_t kMaxDepth = 32;
+using stack_walk::kMaxDepth;
+using stack_walk::symbolize;
+using stack_walk::walk;
+
 constexpr size_t kMaxSamples = 65536;
 
 struct Sample {
@@ -34,23 +37,6 @@ Sample* g_samples = nullptr;
 std::atomic<size_t> g_head{0};
 std::atomic<size_t> g_dropped{0};
 std::atomic<bool> g_running{false};
-
-// Signal-safe rbp-chain walk bounded to [lo, hi).
-uint32_t walk(uintptr_t rip, uintptr_t rbp, uintptr_t lo, uintptr_t hi,
-              void** out) {
-  uint32_t n = 0;
-  out[n++] = reinterpret_cast<void*>(rip);
-  while (n < kMaxDepth) {
-    if (rbp < lo || rbp + 16 > hi || (rbp & 7) != 0) break;
-    void* ret = *reinterpret_cast<void**>(rbp + 8);
-    if (ret == nullptr) break;
-    out[n++] = ret;
-    const uintptr_t next = *reinterpret_cast<uintptr_t*>(rbp);
-    if (next <= rbp) break;  // frames must grow upward
-    rbp = next;
-  }
-  return n;
-}
 
 void sigprof_handler(int, siginfo_t*, void* ucv) {
   if (!g_running.load(std::memory_order_relaxed)) return;
@@ -85,23 +71,6 @@ void sigprof_handler(int, siginfo_t*, void* ucv) {
   }
   Sample& s = g_samples[slot];
   s.depth = walk(rip, rbp, lo, hi, s.pcs);
-}
-
-std::string symbolize(void* pc) {
-  Dl_info info;
-  char buf[256];
-  if (dladdr(pc, &info) != 0 && info.dli_sname != nullptr) {
-    return info.dli_sname;
-  }
-  if (dladdr(pc, &info) != 0 && info.dli_fname != nullptr) {
-    const char* base = strrchr(info.dli_fname, '/');
-    snprintf(buf, sizeof(buf), "%s@%p", base != nullptr ? base + 1
-                                                        : info.dli_fname,
-             pc);
-    return buf;
-  }
-  snprintf(buf, sizeof(buf), "%p", pc);
-  return buf;
 }
 
 }  // namespace

@@ -1,0 +1,136 @@
+// Logging backend: default stderr emitter, sink chaining, file rotation.
+// Capability parity: reference src/butil/logging.cc (SetLogSink, glog-format
+// prefix, PLOG errno text) and its rotating file destination.
+#include "logging.h"
+
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <mutex>
+
+namespace tbutil {
+
+namespace {
+
+std::atomic<LogSinkIf*> g_class_sink{nullptr};
+
+int CachedTid() {
+  static thread_local int tid = static_cast<int>(syscall(SYS_gettid));
+  return tid;
+}
+
+}  // namespace
+
+LogSinkIf* SetLogSink(LogSinkIf* sink) {
+  return g_class_sink.exchange(sink, std::memory_order_acq_rel);
+}
+
+size_t FormatLogPrefix(char* buf, size_t cap, int severity, const char* file,
+                       int line) {
+  static const char kNames[] = "TDIWEF";
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  struct tm tm_buf;
+  localtime_r(&ts.tv_sec, &tm_buf);
+  const char* base = strrchr(file, '/');
+  int n = snprintf(buf, cap, "%c%02d%02d %02d:%02d:%02d.%06ld %5d %s:%d] ",
+                   kNames[severity >= 0 && severity <= LOG_FATAL ? severity : LOG_INFO],
+                   tm_buf.tm_mon + 1, tm_buf.tm_mday, tm_buf.tm_hour,
+                   tm_buf.tm_min, tm_buf.tm_sec, ts.tv_nsec / 1000,
+                   CachedTid(), base ? base + 1 : file, line);
+  return n < 0 ? 0 : (static_cast<size_t>(n) >= cap ? cap - 1 : static_cast<size_t>(n));
+}
+
+LogMessage::~LogMessage() {
+  if (_with_errno) {
+    _stream << ": " << strerror(_errno) << " [" << _errno << "]";
+  }
+  const std::string s = _stream.str();
+  bool consumed = false;
+  LogSink fn = g_log_sink.load(std::memory_order_acquire);
+  if (fn != nullptr) {
+    fn(_severity, _file, _line, s.c_str());
+    consumed = true;
+  } else if (LogSinkIf* sink = g_class_sink.load(std::memory_order_acquire)) {
+    consumed = sink->OnLogMessage(_severity, _file, _line, s.c_str(), s.size());
+  }
+  if (!consumed) {
+    char prefix[192];
+    size_t n = FormatLogPrefix(prefix, sizeof(prefix), _severity, _file, _line);
+    // One write per line so concurrent threads don't interleave mid-line.
+    fprintf(stderr, "%.*s%s\n", static_cast<int>(n), prefix, s.c_str());
+  }
+  if (_severity == LOG_FATAL) {
+    fflush(nullptr);
+    abort();
+  }
+}
+
+FileSink::FileSink(const std::string& path, size_t max_size_bytes, int max_files)
+    : _path(path), _max_size(max_size_bytes),
+      _max_files(max_files < 2 ? 2 : max_files), _mu(new std::mutex) {
+  _fp = fopen(path.c_str(), "a");
+  if (_fp != nullptr) {
+    setvbuf(_fp, nullptr, _IOFBF, 64 << 10);
+    struct stat st;
+    if (fstat(fileno(_fp), &st) == 0) {
+      _written = static_cast<size_t>(st.st_size);
+    }
+  }
+}
+
+FileSink::~FileSink() {
+  if (_fp != nullptr) {
+    fclose(_fp);
+  }
+  delete static_cast<std::mutex*>(_mu);
+}
+
+void FileSink::RotateLocked() {
+  fclose(_fp);
+  _fp = nullptr;
+  // Shift path.(k) -> path.(k+1), oldest falls off the end.
+  for (int k = _max_files - 2; k >= 1; --k) {
+    std::string from = _path + "." + std::to_string(k);
+    std::string to = _path + "." + std::to_string(k + 1);
+    rename(from.c_str(), to.c_str());  // ENOENT is fine
+  }
+  std::string first = _path + ".1";
+  rename(_path.c_str(), first.c_str());
+  _fp = fopen(_path.c_str(), "a");
+  if (_fp != nullptr) {
+    setvbuf(_fp, nullptr, _IOFBF, 64 << 10);
+  }
+  _written = 0;
+}
+
+bool FileSink::OnLogMessage(int severity, const char* file, int line,
+                            const char* msg, size_t msg_len) {
+  char prefix[192];
+  size_t pn = FormatLogPrefix(prefix, sizeof(prefix), severity, file, line);
+  std::lock_guard<std::mutex> lock(*static_cast<std::mutex*>(_mu));
+  if (_fp == nullptr) {
+    return false;  // fall through to stderr rather than dropping
+  }
+  fwrite(prefix, 1, pn, _fp);
+  fwrite(msg, 1, msg_len, _fp);
+  fputc('\n', _fp);
+  _written += pn + msg_len + 1;
+  if (severity >= LOG_WARNING) {
+    fflush(_fp);
+  }
+  if (_written >= _max_size) {
+    RotateLocked();
+  }
+  return true;
+}
+
+void FileSink::Flush() {
+  std::lock_guard<std::mutex> lock(*static_cast<std::mutex*>(_mu));
+  if (_fp != nullptr) {
+    fflush(_fp);
+  }
+}
+
+}  // namespace tbutil

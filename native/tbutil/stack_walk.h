@@ -1,0 +1,56 @@
+// Shared frame-pointer stack walk + symbolization for the sampling
+// profilers (cpu_profiler.cpp SIGPROF handler, heap_profiler.cpp allocation
+// hook). The walk is signal-safe: no allocation, every dereference bounds-
+// checked against the sampled thread's stack window.
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace tbutil {
+namespace stack_walk {
+
+constexpr size_t kMaxDepth = 32;
+
+// rbp-chain walk bounded to [lo, hi); records rip then each return address.
+// An empty window (lo > hi) yields the PC only.
+inline uint32_t walk(uintptr_t rip, uintptr_t rbp, uintptr_t lo, uintptr_t hi,
+                     void** out) {
+  uint32_t n = 0;
+  out[n++] = reinterpret_cast<void*>(rip);
+  while (n < kMaxDepth) {
+    if (rbp < lo || rbp + 16 > hi || (rbp & 7) != 0) break;
+    void* ret = *reinterpret_cast<void**>(rbp + 8);
+    if (ret == nullptr) break;
+    out[n++] = ret;
+    const uintptr_t next = *reinterpret_cast<uintptr_t*>(rbp);
+    if (next <= rbp) break;  // frames must grow upward
+    rbp = next;
+  }
+  return n;
+}
+
+inline std::string symbolize(void* pc) {
+  Dl_info info;
+  char buf[256];
+  if (dladdr(pc, &info) != 0) {
+    if (info.dli_sname != nullptr) {
+      return info.dli_sname;
+    }
+    if (info.dli_fname != nullptr) {
+      const char* base = strrchr(info.dli_fname, '/');
+      snprintf(buf, sizeof(buf), "%s@%p",
+               base != nullptr ? base + 1 : info.dli_fname, pc);
+      return buf;
+    }
+  }
+  snprintf(buf, sizeof(buf), "%p", pc);
+  return buf;
+}
+
+}  // namespace stack_walk
+}  // namespace tbutil

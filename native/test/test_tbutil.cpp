@@ -1,12 +1,15 @@
 // Base-layer tests. Mirrors the reference's butil unit coverage
 // (test/iobuf_unittest.cpp, resource_pool_unittest, flat_map_unittest,
 // endpoint_unittest) in spirit: in-process, no network.
+#include <sys/stat.h>
+
 #include <atomic>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "mini_test.h"
+#include "tbutil/logging.h"
 #include "tbutil/base64.h"
 #include "tbutil/crc32c.h"
 #include "tbutil/doubly_buffered_data.h"
@@ -311,6 +314,111 @@ TEST_CASE(base64_roundtrip_and_vectors) {
   ASSERT_FALSE(tbutil::base64_decode("abc", &out));
   ASSERT_FALSE(tbutil::base64_decode("a!c=", &out));
   ASSERT_FALSE(tbutil::base64_decode("Zg==Zm8=", &out));
+}
+
+// ---- logging subsystem (reference butil/logging.cc coverage) ----
+
+namespace {
+struct CaptureSink : tbutil::LogSinkIf {
+  std::vector<std::string> lines;
+  std::atomic<int> count{0};
+  bool OnLogMessage(int severity, const char* file, int line, const char* msg,
+                    size_t msg_len) override {
+    (void)severity; (void)file; (void)line;
+    lines.emplace_back(msg, msg_len);
+    count.fetch_add(1);
+    return true;
+  }
+};
+}  // namespace
+
+TEST_CASE(logging_severity_filter_and_sink) {
+  CaptureSink cap;
+  tbutil::LogSinkIf* old = tbutil::SetLogSink(&cap);
+  int old_level = tbutil::g_min_log_level.load();
+  tbutil::g_min_log_level.store(tbutil::LOG_WARNING);
+  TB_LOG(INFO) << "filtered out";
+  TB_LOG(WARNING) << "kept " << 42;
+  TB_LOG(ERROR) << "also kept";
+  tbutil::g_min_log_level.store(old_level);
+  tbutil::SetLogSink(old);
+  ASSERT_EQ(cap.lines.size(), 2u);
+  ASSERT_EQ(cap.lines[0], std::string("kept 42"));
+  ASSERT_EQ(cap.lines[1], std::string("also kept"));
+}
+
+TEST_CASE(logging_vlog_every_n_plog) {
+  CaptureSink cap;
+  tbutil::LogSinkIf* old = tbutil::SetLogSink(&cap);
+  // VLOG gating.
+  tbutil::g_vlog_level.store(1);
+  TB_VLOG(1) << "v1";
+  TB_VLOG(2) << "v2 hidden";
+  tbutil::g_vlog_level.store(0);
+  // EVERY_N: 5 hits at n=2 -> hits 0,2,4 emit.
+  for (int i = 0; i < 5; ++i) {
+    TB_LOG_EVERY_N(INFO, 2) << "en" << i;
+  }
+  TB_LOG_ONCE(INFO) << "once";
+  TB_LOG_ONCE(INFO) << "once";  // distinct site, emits once as well
+  // PLOG appends errno text.
+  errno = ENOENT;
+  TB_PLOG(ERROR) << "open failed";
+  tbutil::SetLogSink(old);
+  ASSERT_EQ(cap.lines[0], std::string("v1"));
+  ASSERT_EQ(cap.lines[1], std::string("en0"));
+  ASSERT_EQ(cap.lines[2], std::string("en2"));
+  ASSERT_EQ(cap.lines[3], std::string("en4"));
+  ASSERT_EQ(cap.lines[4], std::string("once"));
+  ASSERT_EQ(cap.lines[5], std::string("once"));
+  ASSERT_EQ(cap.lines.size(), 7u);
+  ASSERT_TRUE(cap.lines[6].find("open failed: ") == 0);
+  ASSERT_TRUE(cap.lines[6].find("[2]") != std::string::npos);
+}
+
+TEST_CASE(logging_file_sink_rotation) {
+  char tmpl[] = "/tmp/tblog_XXXXXX";
+  ASSERT_TRUE(mkdtemp(tmpl) != nullptr);
+  std::string path = std::string(tmpl) + "/app.log";
+  {
+    // Tiny max size so a few lines force rotation; keep 3 files.
+    tbutil::FileSink sink(path, /*max_size_bytes=*/256, /*max_files=*/3);
+    ASSERT_TRUE(sink.ok());
+    tbutil::LogSinkIf* old = tbutil::SetLogSink(&sink);
+    for (int i = 0; i < 40; ++i) {
+      TB_LOG(INFO) << "line number " << i << " padded to make bytes";
+    }
+    tbutil::SetLogSink(old);
+    sink.Flush();
+  }
+  // Current + .1 + .2 exist; .3 must not (dropped past max_files-1).
+  struct stat st;
+  ASSERT_EQ(stat(path.c_str(), &st), 0);
+  ASSERT_EQ(stat((path + ".1").c_str(), &st), 0);
+  ASSERT_EQ(stat((path + ".2").c_str(), &st), 0);
+  ASSERT_TRUE(stat((path + ".3").c_str(), &st) != 0);
+  // Lines are whole (prefix + message) in the current file.
+  FILE* fp = fopen((path + ".1").c_str(), "r");
+  ASSERT_TRUE(fp != nullptr);
+  char line[512];
+  int whole = 0;
+  while (fgets(line, sizeof(line), fp) != nullptr) {
+    ASSERT_TRUE(strstr(line, "line number ") != nullptr);
+    ++whole;
+  }
+  fclose(fp);
+  ASSERT_TRUE(whole >= 1);
+}
+
+TEST_CASE(logging_prefix_format) {
+  char buf[192];
+  size_t n = tbutil::FormatLogPrefix(buf, sizeof(buf), tbutil::LOG_WARNING,
+                                     "/a/b/file.cpp", 77);
+  ASSERT_TRUE(n > 0);
+  std::string p(buf, n);
+  ASSERT_EQ(p[0], 'W');
+  ASSERT_TRUE(p.find("file.cpp:77] ") != std::string::npos);
+  ASSERT_TRUE(p.find('/') == std::string::npos);  // path stripped
 }
 
 TEST_MAIN

@@ -60,14 +60,16 @@ TEST_CASE(thrift_framed_round_trip) {
     ASSERT_EQ(svc.last_method, std::string("Echo"));
   }
 
-  // Handler failure -> TApplicationException on the wire; the client sees
-  // the exception struct bytes (message field first) as the reply.
+  // Handler failure -> TApplicationException on the wire; the client fails
+  // the RPC with the decoded exception message (a success here would hand
+  // the exception struct to the caller's result deserializer as garbage).
   Controller cntl;
   tbutil::IOBuf args, result;
   args.append("x");
   ch.CallMethod("Boom", &cntl, args, &result, nullptr);
-  ASSERT_FALSE(cntl.Failed());  // envelope-level delivery succeeded
-  ASSERT_TRUE(result.to_string().find("boom happened") != std::string::npos);
+  ASSERT_TRUE(cntl.Failed());
+  ASSERT_EQ(cntl.ErrorCode(), TRPC_EINTERNAL);
+  ASSERT_TRUE(cntl.ErrorText().find("boom happened") != std::string::npos);
   server.Stop();
 }
 

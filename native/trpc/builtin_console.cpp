@@ -1,6 +1,7 @@
 #include "trpc/builtin_console.h"
 
 #include <algorithm>
+#include <cmath>
 #include <map>
 #include <mutex>
 #include <string>
@@ -79,10 +80,16 @@ void status_page(const HttpRequest& req, HttpResponse* resp) {
 void render_series_row(const char* label, const std::vector<double>& v,
                        std::string* out) {
   if (v.empty()) return;
-  double lo = v[0], hi = v[0];
+  // Range over FINITE samples only: one inf/nan (e.g. a ratio PassiveStatus
+  // with zero denominator) must not poison the scale — casting a NaN level
+  // to int is UB and indexed kBars out of bounds.
+  bool any_finite = false;
+  double lo = 0, hi = 0;
   for (double x : v) {
-    if (x < lo) lo = x;
-    if (x > hi) hi = x;
+    if (!std::isfinite(x)) continue;
+    if (!any_finite || x < lo) lo = x;
+    if (!any_finite || x > hi) hi = x;
+    any_finite = true;
   }
   char line[64];
   snprintf(line, sizeof(line), "%-8s [%zu] min=%g max=%g\n  ", label,
@@ -90,8 +97,13 @@ void render_series_row(const char* label, const std::vector<double>& v,
   *out += line;
   static const char* kBars[] = {"_", "▁", "▂", "▃", "▄", "▅", "▆", "▇"};
   for (double x : v) {
-    const int level =
-        hi > lo ? static_cast<int>((x - lo) / (hi - lo) * 7.999) : 0;
+    if (!std::isfinite(x)) {
+      *out += '?';
+      continue;
+    }
+    int level = hi > lo ? static_cast<int>((x - lo) / (hi - lo) * 7.999) : 0;
+    if (level < 0) level = 0;
+    if (level > 7) level = 7;
     *out += kBars[level];
   }
   *out += "\n  latest: ";

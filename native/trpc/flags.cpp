@@ -2,7 +2,31 @@
 
 #include <cstdlib>
 
+#include "tbutil/logging.h"
+
 namespace trpc {
+
+// Logging knobs exposed as hot-reloadable flags (/flags live edit). The
+// validators mirror accepted values into the tbutil atomics the TB_LOG /
+// TB_VLOG macros actually read, so a /flags POST takes effect instantly.
+// Reference: butil/logging.h min_log_level + vlog gflags.
+static const auto* g_flag_min_log_level = FlagRegistry::global().DefineInt(
+    "min_log_level", tbutil::LOG_INFO,
+    "minimum severity emitted: 0=TRACE 1=DEBUG 2=INFO 3=WARNING 4=ERROR",
+    [](int64_t v) {
+      if (v < tbutil::LOG_TRACE || v > tbutil::LOG_ERROR) return false;
+      tbutil::g_min_log_level.store(static_cast<int>(v),
+                                    std::memory_order_relaxed);
+      return true;
+    });
+static const auto* g_flag_vlog_level = FlagRegistry::global().DefineInt(
+    "vlog_level", 0, "TB_VLOG(n) emits when n <= vlog_level",
+    [](int64_t v) {
+      if (v < 0 || v > 99) return false;
+      tbutil::g_vlog_level.store(static_cast<int>(v),
+                                 std::memory_order_relaxed);
+      return true;
+    });
 
 std::atomic<int64_t>* FlagRegistry::DefineInt(const std::string& name,
                                               int64_t default_value,

@@ -29,7 +29,8 @@ size_t PipelinedFindCrlf(const tbutil::IOBuf& buf, size_t from,
 }
 
 void DeliverPipelinedReply(uint64_t socket_id, tbutil::IOBuf&& reply,
-                           MeasureReplyFn measure) {
+                           MeasureReplyFn measure, int fail_error,
+                           const char* fail_reason) {
   SocketUniquePtr s;
   if (Socket::Address(socket_id, &s) != 0) return;
   // Exclusive short connection: the one pending RPC is the match.
@@ -63,7 +64,7 @@ void DeliverPipelinedReply(uint64_t socket_id, tbutil::IOBuf&& reply,
   *acc.measured_count() = complete;
   if (complete >= expected) {
     acc.mark_response_received();
-    acc.EndRPC(0, "");  // EndRPC consumed the lock
+    acc.EndRPC(fail_error, fail_reason);  // EndRPC consumed the lock
     return;
   }
   tbthread::fiber_id_unlock(attempt_id);

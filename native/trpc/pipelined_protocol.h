@@ -24,10 +24,14 @@ size_t PipelinedFindCrlf(const tbutil::IOBuf& buf, size_t from,
 using MeasureReplyFn = ssize_t (*)(const tbutil::IOBuf& buf, size_t pos);
 
 // The exclusive-connection completion sequence: look up the socket's single
-// pending RPC, append `reply` to its response payload, and EndRPC(0) once
+// pending RPC, append `reply` to its response payload, and EndRPC once
 // `expected_responses` whole replies (per `measure`) are buffered. Consumes
-// nothing on stale/finished RPCs.
+// nothing on stale/finished RPCs. A non-zero `fail_error` makes the
+// completion EndRPC(fail_error, fail_reason) instead of success — the wire
+// carried a protocol-level error (e.g. a thrift TApplicationException);
+// the reply bytes stay appended for callers that want to inspect them.
 void DeliverPipelinedReply(uint64_t socket_id, tbutil::IOBuf&& reply,
-                           MeasureReplyFn measure);
+                           MeasureReplyFn measure, int fail_error = 0,
+                           const char* fail_reason = "");
 
 }  // namespace trpc

@@ -67,6 +67,22 @@ void build_exception_struct(std::string* out, const std::string& message) {
   out->push_back(0);  // TType::STOP
 }
 
+// Inverse of build_exception_struct: field 1 (string) is the message.
+// Tolerant — any shape mismatch yields a generic label rather than a parse
+// failure (the RPC is failing either way).
+std::string parse_exception_message(const tbutil::IOBuf& body) {
+  uint8_t h[7];
+  if (body.copy_to(h, 7) == 7 && h[0] == 11 && h[1] == 0 && h[2] == 1) {
+    const uint32_t len = get_u32be(h + 3);
+    if (len <= 4096 && 7 + size_t(len) <= body.size()) {
+      std::string msg(len, '\0');
+      body.copy_to(msg.data(), len, 7);
+      return msg;
+    }
+  }
+  return "TApplicationException";
+}
+
 struct ThriftMessage {
   uint8_t msg_type = 0;
   std::string method;
@@ -81,7 +97,10 @@ int cut_message(tbutil::IOBuf* source, ThriftMessage* out) {
   uint8_t head[16];
   source->copy_to(head, 16);
   const uint32_t frame_len = get_u32be(head);
-  if (frame_len < 12 || frame_len > kMaxThriftFrame) return -1;
+  // >= (not >): the pre-claim sniff accepts first byte 0x00..0x03, i.e.
+  // frames strictly below 0x04000000 — the two gates must agree no matter
+  // how the bytes fragment across reads.
+  if (frame_len < 12 || frame_len >= kMaxThriftFrame) return -1;
   const uint32_t version = get_u32be(head + 4);
   if ((version & kThriftVersionMask) != kThriftVersion1) return -1;
   const uint8_t type = version & 0xff;
@@ -118,9 +137,9 @@ ParseResult thrift_parse(tbutil::IOBuf* source, Socket* socket) {
   }
   // Cheap plausibility before claiming: the version word must be present
   // and match (bytes 4..7). With < 8 bytes buffered, defer only if the
-  // length prefix looks sane for thrift (first byte <= 0x03 — frames up
-  // to kMaxThriftFrame, 64MB; anything larger is rejected by cut_message
-  // anyway, so the two gates agree regardless of read fragmentation).
+  // length prefix looks sane for thrift (first byte <= 0x03 — frames
+  // strictly below kMaxThriftFrame, 64MB; cut_message rejects >= the same
+  // bound, so the two gates agree regardless of read fragmentation).
   if (source->size() < 8) {
     uint8_t b0;
     if (source->copy_to(&b0, 1) == 1 && b0 > 0x03) {
@@ -146,6 +165,19 @@ ParseResult thrift_parse(tbutil::IOBuf* source, Socket* socket) {
     return r;
   }
   if (rc < 0) {
+    r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+    return r;
+  }
+  // Direction check: a server must only see CALL/ONEWAY (a REPLY/EXCEPTION
+  // here would be silently dropped downstream, leaving the peer hanging
+  // until its timeout) and a client only REPLY/EXCEPTION. Kill the
+  // connection so the bogus traffic is visible instead of swallowed.
+  const bool is_call =
+      msg->msg.msg_type == kCall || msg->msg.msg_type == kOneway;
+  if (socket->server_side() != is_call) {
+    TB_LOG(WARNING) << "thrift message type " << int(msg->msg.msg_type)
+                    << " on the wrong direction (server_side="
+                    << socket->server_side() << ")";
     r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
     return r;
   }
@@ -199,13 +231,20 @@ void thrift_process_response(InputMessageBase* base) {
   // broken server is indistinguishable by design, same as HTTP/redis).
   tbutil::IOBuf reply = std::move(owned->msg.body);
   const bool is_exception = owned->msg.msg_type == kException;
+  // A kException reply fails the RPC (decoded TApplicationException message
+  // as the error text) — otherwise the caller's result deserializer would
+  // misparse the exception struct as a garbled success.
+  std::string exc_msg;
+  if (is_exception) {
+    exc_msg = parse_exception_message(reply);
+  }
   DeliverPipelinedReply(
       owned->socket_id, std::move(reply),
       // The whole buffered reply is one complete "unit" per RPC.
       [](const tbutil::IOBuf& buf, size_t pos) -> ssize_t {
         return pos < buf.size() ? static_cast<ssize_t>(buf.size() - pos) : 0;
-      });
-  (void)is_exception;  // struct-level success/exception stays app-visible
+      },
+      is_exception ? TRPC_EINTERNAL : 0, exc_msg.c_str());
 }
 
 void thrift_pack_request(tbutil::IOBuf* out, Controller* /*cntl*/,
