@@ -1,0 +1,44 @@
+// Sampling heap profiler: answers "who holds the memory" on a live server.
+// Capability parity: reference heap profiling via tcmalloc
+// (details/tcmalloc_extension.cpp + builtin/heap_profiler pages). Ours is
+// self-contained: global operator new/delete overrides (heap_profiler.cpp)
+// sample ~1 allocation per `sample_period` bytes, record the allocation
+// stack (frame-pointer walk, stack_walk.h), and track sampled pointers so
+// frees during the window cancel out — the rendered profile is IN-USE
+// space, scaled back up by the sampling period. Framework-owned malloc
+// pools (IOBuf blocks) report in through RecordAlloc/RecordFree.
+//
+// Off cost: one relaxed atomic load per new/delete. On cost: a TLS byte
+// countdown per alloc; lock + map update only on the sampled ~0.2%.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace tbutil {
+
+class HeapProfiler {
+ public:
+  // Begin a profile window: clears previous samples. sample_period: average
+  // bytes of allocation between samples (default 512KB). False if running.
+  static bool Start(size_t sample_period = 512 << 10);
+  // Freeze the profile (frees stop being applied; samples keep rendering).
+  static void Stop();
+  static bool running();
+
+  // Explicit hooks for allocators that bypass operator new (IOBuf blocks).
+  // No-ops (one relaxed load) while not running.
+  static void RecordAlloc(void* ptr, size_t size);
+  static void RecordFree(void* ptr);
+
+  // In-use space by allocation site. Collapsed stacks ("outer;...;inner
+  // <bytes>", flamegraph.pl-compatible) / flat top-N by estimated bytes.
+  static std::string Collapsed();
+  static std::string FlatText(size_t topn = 40);
+
+  static size_t sampled_live_bytes();   // estimated in-use bytes (scaled)
+  static size_t sample_count();         // live sampled allocations
+};
+
+}  // namespace tbutil

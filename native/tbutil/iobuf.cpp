@@ -8,6 +8,7 @@
 #include <algorithm>
 #include <vector>
 
+#include "tbutil/heap_profiler.h"
 #include "tbutil/logging.h"
 
 namespace tbutil {
@@ -28,6 +29,9 @@ struct IOBuf::Block {
 
 IOBuf::Block* IOBuf::create_block(size_t cap) {
   auto* b = static_cast<Block*>(malloc(sizeof(Block) + cap));
+  // Blocks bypass operator new; report into the sampling heap profiler so
+  // buffered payload shows up on /heap like every other allocation.
+  HeapProfiler::RecordAlloc(b, sizeof(Block) + cap);
   b->nshared.store(1, std::memory_order_relaxed);
   b->flags = 0;
   b->size = 0;
@@ -47,6 +51,7 @@ void IOBuf::block_dec_ref(Block* b) {
     if (b->flags & Block::kUserData) {
       if (b->user_deleter) b->user_deleter(b->data);
     }
+    HeapProfiler::RecordFree(b);
     free(b);
   }
 }

@@ -11,6 +11,7 @@
 #include "tbthread/fiber.h"
 #include "tbthread/tracer.h"
 #include "tbutil/cpu_profiler.h"
+#include "tbutil/heap_profiler.h"
 #include "tbutil/time.h"
 #include "tbvar/prometheus.h"
 #include "tbvar/series.h"
@@ -40,6 +41,7 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
       "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
       "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
+      "<li><a href=\"/heap\">/heap</a> — sampling heap profile (in-use)</li>"
       "<li><a href=\"/contention\">/contention</a> — mutex wait profile</li>"
       "</ul></body></html>";
 }
@@ -349,6 +351,27 @@ void hotspots_page(const HttpRequest& req, HttpResponse* resp) {
       });
 }
 
+// /heap: sampling allocation profile, rendered as in-use space by
+// allocation site (reference heap profiler pages backed by tcmalloc; ours
+// samples the global operator new/delete overrides + IOBuf blocks).
+//   /heap?seconds=N       profile N s (default 5, max 60), flat top-40
+//   &view=collapsed       flamegraph.pl-compatible collapsed stacks
+void heap_page(const HttpRequest& req, HttpResponse* resp) {
+  run_profile_window(
+      req, resp, [] { return tbutil::HeapProfiler::Start(); },
+      [] { tbutil::HeapProfiler::Stop(); },
+      [&req, resp] {
+        if (req.query_param("view") == "collapsed") {
+          resp->body = tbutil::HeapProfiler::Collapsed();
+        } else {
+          resp->body = tbutil::HeapProfiler::FlatText();
+          resp->body +=
+              "\n(collapsed stacks for flamegraphs: /heap?seconds=N"
+              "&view=collapsed)\n";
+        }
+      });
+}
+
 // /contention: FiberMutex wait-time profile (reference
 // bthread/mutex.cpp ContentionProfiler + /contention page).
 //   /contention?seconds=N   profile N s (default 5, max 60)
@@ -382,6 +405,7 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/rpcz", rpcz_page);
     RegisterHttpHandler("/fibers", fibers_page);
     RegisterHttpHandler("/hotspots", hotspots_page);
+    RegisterHttpHandler("/heap", heap_page);
     RegisterHttpHandler("/contention", contention_page);
   });
 }

@@ -10,23 +10,28 @@ namespace trpc {
 // validators mirror accepted values into the tbutil atomics the TB_LOG /
 // TB_VLOG macros actually read, so a /flags POST takes effect instantly.
 // Reference: butil/logging.h min_log_level + vlog gflags.
-static const auto* g_flag_min_log_level = FlagRegistry::global().DefineInt(
-    "min_log_level", tbutil::LOG_INFO,
-    "minimum severity emitted: 0=TRACE 1=DEBUG 2=INFO 3=WARNING 4=ERROR",
-    [](int64_t v) {
-      if (v < tbutil::LOG_TRACE || v > tbutil::LOG_ERROR) return false;
-      tbutil::g_min_log_level.store(static_cast<int>(v),
-                                    std::memory_order_relaxed);
-      return true;
-    });
-static const auto* g_flag_vlog_level = FlagRegistry::global().DefineInt(
-    "vlog_level", 0, "TB_VLOG(n) emits when n <= vlog_level",
-    [](int64_t v) {
-      if (v < 0 || v > 99) return false;
-      tbutil::g_vlog_level.store(static_cast<int>(v),
-                                 std::memory_order_relaxed);
-      return true;
-    });
+static const bool g_logging_flags_registered = [] {
+  FlagRegistry::global().DefineLinked(
+      "min_log_level", tbutil::LOG_INFO,
+      "minimum severity emitted: 0=TRACE 1=DEBUG 2=INFO 3=WARNING 4=ERROR",
+      [] { return int64_t{tbutil::g_min_log_level.load(std::memory_order_relaxed)}; },
+      [](int64_t v) {
+        if (v < tbutil::LOG_TRACE || v > tbutil::LOG_ERROR) return false;
+        tbutil::g_min_log_level.store(static_cast<int>(v),
+                                      std::memory_order_relaxed);
+        return true;
+      });
+  FlagRegistry::global().DefineLinked(
+      "vlog_level", 0, "TB_VLOG(n) emits when n <= vlog_level",
+      [] { return int64_t{tbutil::g_vlog_level.load(std::memory_order_relaxed)}; },
+      [](int64_t v) {
+        if (v < 0 || v > 99) return false;
+        tbutil::g_vlog_level.store(static_cast<int>(v),
+                                   std::memory_order_relaxed);
+        return true;
+      });
+  return true;
+}();
 
 std::atomic<int64_t>* FlagRegistry::DefineInt(const std::string& name,
                                               int64_t default_value,
@@ -44,11 +49,27 @@ std::atomic<int64_t>* FlagRegistry::DefineInt(const std::string& name,
   return e.value;
 }
 
+void FlagRegistry::DefineLinked(const std::string& name, int64_t default_value,
+                                const std::string& help, Getter getter,
+                                Validator set_and_validate) {
+  std::lock_guard<std::mutex> lk(_mu);
+  if (_flags.count(name) != 0) return;
+  Entry e;
+  e.value = new std::atomic<int64_t>(default_value);  // unused shadow
+  e.default_value = default_value;
+  e.help = help;
+  e.validator = std::move(set_and_validate);
+  e.getter = std::move(getter);
+  _flags[name] = e;
+}
+
 bool FlagRegistry::Get(const std::string& name, std::string* value) const {
   std::lock_guard<std::mutex> lk(_mu);
   auto it = _flags.find(name);
   if (it == _flags.end()) return false;
-  *value = std::to_string(it->second.value->load(std::memory_order_relaxed));
+  const Entry& e = it->second;
+  *value = std::to_string(e.getter ? e.getter()
+                                   : e.value->load(std::memory_order_relaxed));
   return true;
 }
 
@@ -69,8 +90,9 @@ bool FlagRegistry::Set(const std::string& name, const std::string& value) {
 void FlagRegistry::List(std::map<std::string, Info>* out) const {
   std::lock_guard<std::mutex> lk(_mu);
   for (const auto& [name, e] : _flags) {
-    (*out)[name] = Info{e.value->load(std::memory_order_relaxed),
-                        e.default_value, e.help};
+    (*out)[name] =
+        Info{e.getter ? e.getter() : e.value->load(std::memory_order_relaxed),
+             e.default_value, e.help};
   }
 }
 

@@ -25,6 +25,15 @@ class FlagRegistry {
                                   const std::string& help,
                                   Validator validator = nullptr);
 
+  // A flag whose true storage lives elsewhere (e.g. tbutil's logging
+  // atomics): `getter` is the single source of truth for Get/List, and the
+  // validator both vets and applies writes. Prevents the registry showing a
+  // stale shadow when code stores to the backing atomic directly.
+  using Getter = std::function<int64_t()>;
+  void DefineLinked(const std::string& name, int64_t default_value,
+                    const std::string& help, Getter getter,
+                    Validator set_and_validate);
+
   // "name" -> current value as string; returns false if unknown.
   bool Get(const std::string& name, std::string* value) const;
   // Set from string; false on unknown flag / parse error / validator veto.
@@ -45,6 +54,7 @@ class FlagRegistry {
     int64_t default_value;
     std::string help;
     Validator validator;
+    Getter getter;  // non-null: external storage is the source of truth
   };
   mutable std::mutex _mu;
   std::map<std::string, Entry> _flags;
