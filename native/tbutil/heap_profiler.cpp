@@ -46,6 +46,42 @@ std::atomic<size_t> g_live_count{0};
 // Leaked on purpose: frees can arrive during static destruction.
 std::mutex* g_mu = new std::mutex;
 auto* g_live = new std::unordered_map<void*, SampledAlloc>;
+// Serializes Start/Stop lifecycle transitions.
+std::mutex* g_lifecycle_mu = new std::mutex;
+
+// Approximate membership of sampled pointers (a Bloom filter: set-only
+// during a window, cleared at Start). Lets the free path skip g_mu for the
+// ~99.8% of deletes that were never sampled — without it every delete in
+// the process serializes on one mutex while a window is open. False
+// positives just pay the lock.
+constexpr size_t kBloomWords = 1024;  // 64Kbit
+std::atomic<uint64_t> g_bloom[kBloomWords];
+
+inline uint64_t mix_ptr(void* p, uint64_t salt) {
+  uint64_t x = reinterpret_cast<uintptr_t>(p) + salt;
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  return x;
+}
+
+inline void bloom_add(void* p) {
+  const uint64_t h1 = mix_ptr(p, 0x9e3779b97f4a7c15ULL);
+  const uint64_t h2 = mix_ptr(p, 0xc2b2ae3d27d4eb4fULL);
+  g_bloom[(h1 >> 6) % kBloomWords].fetch_or(1ULL << (h1 & 63),
+                                            std::memory_order_relaxed);
+  g_bloom[(h2 >> 6) % kBloomWords].fetch_or(1ULL << (h2 & 63),
+                                            std::memory_order_relaxed);
+}
+
+inline bool bloom_maybe_contains(void* p) {
+  const uint64_t h1 = mix_ptr(p, 0x9e3779b97f4a7c15ULL);
+  const uint64_t h2 = mix_ptr(p, 0xc2b2ae3d27d4eb4fULL);
+  return (g_bloom[(h1 >> 6) % kBloomWords].load(std::memory_order_relaxed) &
+          (1ULL << (h1 & 63))) != 0 &&
+         (g_bloom[(h2 >> 6) % kBloomWords].load(std::memory_order_relaxed) &
+          (1ULL << (h2 & 63))) != 0;
+}
 
 // Re-entrancy guard: the live map's own rehash/insert allocates, and any
 // public entry point that mutates/reads the map under g_mu allocates too
@@ -125,6 +161,7 @@ __attribute__((noinline)) void sample_alloc(void* ptr, size_t size,
     memmove(&s.pcs[1], &s.pcs[2], (s.depth - 2) * sizeof(void*));
     --s.depth;
   }
+  bloom_add(ptr);
   std::lock_guard<std::mutex> lk(*g_mu);
   if ((*g_live).emplace(ptr, s).second) {
     g_live_count.fetch_add(1, std::memory_order_relaxed);
@@ -155,6 +192,7 @@ inline void on_free(void* ptr) {
   // profile is a frozen snapshot until the next Start clears it.
   if (!g_running.load(std::memory_order_relaxed)) return;
   if (tls_in_hook) return;
+  if (!bloom_maybe_contains(ptr)) return;  // definitely never sampled
   HookGuard guard;
   std::lock_guard<std::mutex> lk(*g_mu);
   if ((*g_live).erase(ptr) != 0) {
@@ -165,16 +203,23 @@ inline void on_free(void* ptr) {
 }  // namespace
 
 bool HeapProfiler::Start(size_t sample_period) {
-  bool expected = false;
-  if (!g_running.compare_exchange_strong(expected, true)) return false;
+  // Reset everything BEFORE flipping g_running: a racing allocation must
+  // not sample against the previous window's period or land between the
+  // map clear and the counter reset.
+  std::lock_guard<std::mutex> lifecycle(*g_lifecycle_mu);
+  if (g_running.load(std::memory_order_relaxed)) return false;
   if (sample_period < 4096) sample_period = 4096;
   {
     HookGuard guard;  // clear() frees nodes -> operator delete -> on_free
     std::lock_guard<std::mutex> lk(*g_mu);
     g_live->clear();
   }
+  for (size_t i = 0; i < kBloomWords; ++i) {
+    g_bloom[i].store(0, std::memory_order_relaxed);
+  }
   g_live_count.store(0, std::memory_order_relaxed);
   g_period.store(sample_period, std::memory_order_relaxed);
+  g_running.store(true, std::memory_order_release);
   return true;
 }
 

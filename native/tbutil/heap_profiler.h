@@ -9,7 +9,9 @@
 // pools (IOBuf blocks) report in through RecordAlloc/RecordFree.
 //
 // Off cost: one relaxed atomic load per new/delete. On cost: a TLS byte
-// countdown per alloc; lock + map update only on the sampled ~0.2%.
+// countdown per alloc; frees consult a Bloom filter of sampled pointers
+// first, so the global lock is paid only by the sampled ~0.2% (plus rare
+// Bloom false positives).
 #pragma once
 
 #include <cstddef>

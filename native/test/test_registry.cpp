@@ -1,0 +1,204 @@
+// Service registry end-to-end: a server IS the registry
+// (RegistryService::Install), two echo servers register themselves into it
+// (RegistryClient heartbeats), and a Channel resolves them through the
+// http:// naming scheme — the reference proves discovery/consul naming the
+// same way (test/brpc_naming_service_unittest.cpp against local mocks; ours
+// uses the real wire end to end).
+#include <string>
+#include <vector>
+
+#include "mini_test.h"
+#include "tbthread/fiber.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/flags.h"
+#include "trpc/http_protocol.h"
+#include "trpc/naming_service.h"
+#include "trpc/registry.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+namespace {
+
+class EchoService : public Service {
+ public:
+  explicit EchoService(std::string id) : _id(std::move(id)) {}
+  std::string_view service_name() const override { return "EchoService"; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    (void)method;
+    (void)cntl;
+    (void)request;
+    response->append(_id);
+    done->Run();
+  }
+
+ private:
+  std::string _id;
+};
+
+std::string http_call(Channel* ch, const std::string& path,
+                      const std::string& body, int* status = nullptr) {
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append(body);
+  ch->CallMethod(path, &cntl, req, &resp, nullptr);
+  if (status != nullptr) *status = cntl.Failed() ? -1 : 0;
+  return resp.to_string();
+}
+
+}  // namespace
+
+TEST_CASE(registry_parse_http_body_forms) {
+  std::vector<ServerNode> nodes;
+  // JSON object form (the registry's own output).
+  ASSERT_EQ(NamingServiceThread::ParseHttpBody(
+                "{\"servers\":[{\"addr\":\"127.0.0.1:8001\"},"
+                "{\"addr\":\"127.0.0.1:8002\",\"tag\":\"grp\"}]}",
+                &nodes),
+            0);
+  ASSERT_EQ(nodes.size(), 2u);
+  ASSERT_EQ(nodes[0].addr.port, 8001);
+  ASSERT_EQ(nodes[1].tag, std::string("grp"));
+  // Bare JSON array of strings.
+  ASSERT_EQ(NamingServiceThread::ParseHttpBody(
+                "[\"127.0.0.1:8003\",\"127.0.0.1:8004\"]", &nodes),
+            0);
+  ASSERT_EQ(nodes.size(), 2u);
+  ASSERT_EQ(nodes[1].addr.port, 8004);
+  // Text lines with comment + tag.
+  ASSERT_EQ(NamingServiceThread::ParseHttpBody(
+                "# fleet\n127.0.0.1:8005 blue\n127.0.0.1:8006\n", &nodes),
+            0);
+  ASSERT_EQ(nodes.size(), 2u);
+  ASSERT_EQ(nodes[0].tag, std::string("blue"));
+  // Empty JSON list is a valid empty fleet; junk is an error.
+  ASSERT_EQ(NamingServiceThread::ParseHttpBody("{\"servers\":[]}", &nodes), 0);
+  ASSERT_TRUE(nodes.empty());
+  ASSERT_TRUE(NamingServiceThread::ParseHttpBody("%%%", &nodes) != 0);
+}
+
+TEST_CASE(registry_register_list_expire) {
+  RegistryService::clear();
+  RegistryService::Install();
+  Server registry;
+  ASSERT_EQ(registry.Start("127.0.0.1:0", nullptr), 0);
+  const int port = registry.listen_address().port;
+
+  Channel http;
+  ChannelOptions copts;
+  copts.protocol = kHttpProtocolIndex;
+  char addr[64];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+  ASSERT_EQ(http.Init(addr, &copts), 0);
+
+  // Register two entries, one with a short TTL.
+  int rc = 0;
+  http_call(&http, "registry/register",
+            "{\"addr\":\"127.0.0.1:9001\",\"ttl_s\":30}", &rc);
+  ASSERT_EQ(rc, 0);
+  http_call(&http, "registry/register",
+            "{\"addr\":\"127.0.0.1:9002\",\"tag\":\"grp\",\"ttl_s\":1}", &rc);
+  ASSERT_EQ(rc, 0);
+  ASSERT_EQ(RegistryService::live_count(), 2u);
+
+  // List: both there; tag filter narrows.
+  std::string body = http_call(&http, "registry/list", "");
+  ASSERT_TRUE(body.find("9001") != std::string::npos);
+  ASSERT_TRUE(body.find("9002") != std::string::npos);
+  body = http_call(&http, "registry/list?tag=grp", "");
+  ASSERT_TRUE(body.find("9001") == std::string::npos);
+  ASSERT_TRUE(body.find("9002") != std::string::npos);
+
+  // Bad requests are 4xx'd not crashed.
+  http_call(&http, "registry/register", "not json", &rc);
+  http_call(&http, "registry/register", "{\"tag\":\"no-addr\"}", &rc);
+  ASSERT_EQ(RegistryService::live_count(), 2u);
+
+  // TTL expiry: the 1s entry ages out; the 30s one stays.
+  tbthread::fiber_usleep(1200 * 1000);
+  ASSERT_EQ(RegistryService::live_count(), 1u);
+  body = http_call(&http, "registry/list", "");
+  ASSERT_TRUE(body.find("9001") != std::string::npos);
+  ASSERT_TRUE(body.find("9002") == std::string::npos);
+
+  // Deregister removes the survivor.
+  http_call(&http, "registry/deregister", "{\"addr\":\"127.0.0.1:9001\"}",
+            &rc);
+  ASSERT_EQ(rc, 0);
+  ASSERT_EQ(RegistryService::live_count(), 0u);
+
+  registry.Stop();
+  RegistryService::clear();
+}
+
+TEST_CASE(registry_end_to_end_naming) {
+  // Fast refresh so fleet changes land within the test budget.
+  FlagRegistry::global().Set("naming_refresh_ms", "200");
+  RegistryService::clear();
+  RegistryService::Install();
+  Server registry;
+  ASSERT_EQ(registry.Start("127.0.0.1:0", nullptr), 0);
+  char registry_addr[64];
+  snprintf(registry_addr, sizeof(registry_addr), "127.0.0.1:%d",
+           registry.listen_address().port);
+
+  // Two echo servers that advertise themselves.
+  Server s1, s2;
+  EchoService e1("one"), e2("two");
+  ASSERT_EQ(s1.AddService(&e1), 0);
+  ASSERT_EQ(s2.AddService(&e2), 0);
+  ASSERT_EQ(s1.Start("127.0.0.1:0", nullptr), 0);
+  ASSERT_EQ(s2.Start("127.0.0.1:0", nullptr), 0);
+  char a1[64], a2[64];
+  snprintf(a1, sizeof(a1), "127.0.0.1:%d", s1.listen_address().port);
+  snprintf(a2, sizeof(a2), "127.0.0.1:%d", s2.listen_address().port);
+  RegistryClient c1, c2;
+  ASSERT_EQ(c1.Start(registry_addr, a1, "", 5), 0);
+  ASSERT_EQ(c2.Start(registry_addr, a2, "", 5), 0);
+  ASSERT_EQ(RegistryService::live_count(), 2u);
+
+  // A channel resolving through the registry reaches BOTH backends.
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 2000;
+  std::string url = std::string("http://") + registry_addr + "/registry/list";
+  ASSERT_EQ(ch.Init(url.c_str(), "rr", &copts), 0);
+  std::string seen;
+  for (int i = 0; i < 8; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    const std::string who = resp.to_string();
+    if (seen.find(who) == std::string::npos) seen += who + ",";
+  }
+  ASSERT_TRUE(seen.find("one") != std::string::npos);
+  ASSERT_TRUE(seen.find("two") != std::string::npos);
+
+  // One backend deregisters (clean shutdown): after a refresh, traffic
+  // only reaches the survivor.
+  c2.Stop();
+  s2.Stop();
+  ASSERT_EQ(RegistryService::live_count(), 1u);
+  tbthread::fiber_usleep(700 * 1000);  // > one 200ms refresh + jitter
+  for (int i = 0; i < 6; ++i) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_EQ(resp.to_string(), std::string("one"));
+  }
+
+  c1.Stop();
+  s1.Stop();
+  registry.Stop();
+  RegistryService::clear();
+  FlagRegistry::global().Set("naming_refresh_ms", "0");
+}
+
+TEST_MAIN

@@ -55,7 +55,7 @@ void FlagRegistry::DefineLinked(const std::string& name, int64_t default_value,
   std::lock_guard<std::mutex> lk(_mu);
   if (_flags.count(name) != 0) return;
   Entry e;
-  e.value = new std::atomic<int64_t>(default_value);  // unused shadow
+  e.value = nullptr;  // the getter/validator own the storage
   e.default_value = default_value;
   e.help = help;
   e.validator = std::move(set_and_validate);
@@ -83,7 +83,9 @@ bool FlagRegistry::Set(const std::string& name, const std::string& value) {
   if (it->second.validator != nullptr && !it->second.validator(v)) {
     return false;
   }
-  it->second.value->store(v, std::memory_order_relaxed);
+  if (it->second.value != nullptr) {  // linked flags store via the validator
+    it->second.value->store(v, std::memory_order_relaxed);
+  }
   return true;
 }
 

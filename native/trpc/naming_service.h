@@ -23,6 +23,11 @@ namespace trpc {
 //   file:///path/to/file               one "ip:port [tag]" per line,
 //                                      re-read when mtime changes (1s poll)
 //   dns://host:port                    getaddrinfo, re-resolved every 5s
+//   http://host:port/path              registry endpoint (trpc/registry.h
+//                                      /registry/list or any server list
+//                                      URL), re-fetched every 5s; body is
+//                                      JSON {"servers":[{"addr":..},..]},
+//                                      a JSON array, or text lines
 //   (bare "ip:port" handled by Channel directly, not here)
 class NamingServiceThread {
  public:
@@ -49,6 +54,12 @@ class NamingServiceThread {
                        std::vector<ServerNode>* out);
   static int ResolveDns(const std::string& hostport,
                         std::vector<ServerNode>* out);
+  // payload = "host:port/path?query"; fetches over the framework's own
+  // HTTP client and parses the body (exposed for tests).
+  static int FetchHttp(const std::string& payload,
+                       std::vector<ServerNode>* out);
+  static int ParseHttpBody(const std::string& body,
+                           std::vector<ServerNode>* out);
 
  private:
   void Run();

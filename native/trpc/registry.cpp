@@ -1,0 +1,191 @@
+#include "trpc/registry.h"
+
+#include <chrono>
+
+#include "tbutil/fast_rand.h"
+#include "tbutil/json.h"
+#include "tbutil/logging.h"
+#include "tbutil/time.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/http_protocol.h"
+
+namespace trpc {
+
+namespace {
+
+struct Entry {
+  std::string tag;
+  int64_t expire_us = 0;
+};
+
+std::mutex g_mu;
+std::map<std::string, Entry> g_table;  // addr -> entry
+
+void prune_locked(int64_t now_us) {
+  for (auto it = g_table.begin(); it != g_table.end();) {
+    if (it->second.expire_us <= now_us) {
+      it = g_table.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void register_handler(const HttpRequest& req, HttpResponse* resp) {
+  auto parsed = tbutil::JsonValue::Parse(req.body.to_string());
+  if (!parsed || !parsed->is_object()) {
+    resp->status = 400;
+    resp->body = "expected JSON object {addr, tag?, ttl_s?}\n";
+    return;
+  }
+  const tbutil::JsonValue* addr_v = parsed->find("addr");
+  const std::string addr = addr_v != nullptr ? addr_v->as_string() : "";
+  if (addr.empty()) {
+    resp->status = 400;
+    resp->body = "missing addr\n";
+    return;
+  }
+  const tbutil::JsonValue* ttl_v = parsed->find("ttl_s");
+  int64_t ttl_s = ttl_v != nullptr ? ttl_v->as_int(10) : 10;
+  if (ttl_s < 1) ttl_s = 1;
+  if (ttl_s > 3600) ttl_s = 3600;
+  Entry e;
+  const tbutil::JsonValue* tag_v = parsed->find("tag");
+  if (tag_v != nullptr) e.tag = tag_v->as_string();
+  e.expire_us = tbutil::gettimeofday_us() + ttl_s * 1000000;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_table[addr] = std::move(e);
+  }
+  resp->body = "ok\n";
+}
+
+void deregister_handler(const HttpRequest& req, HttpResponse* resp) {
+  auto parsed = tbutil::JsonValue::Parse(req.body.to_string());
+  if (!parsed || !parsed->is_object()) {
+    resp->status = 400;
+    resp->body = "expected JSON object {addr}\n";
+    return;
+  }
+  const tbutil::JsonValue* addr_v = parsed->find("addr");
+  const std::string addr = addr_v != nullptr ? addr_v->as_string() : "";
+  size_t erased = 0;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    erased = g_table.erase(addr);
+  }
+  resp->body = erased != 0 ? "ok\n" : "not registered\n";
+}
+
+void list_handler(const HttpRequest& req, HttpResponse* resp) {
+  const std::string want_tag = req.query_param("tag");
+  tbutil::JsonValue servers = tbutil::JsonValue::Array();
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    prune_locked(tbutil::gettimeofday_us());
+    for (const auto& [addr, e] : g_table) {
+      if (!want_tag.empty() && e.tag != want_tag) continue;
+      tbutil::JsonValue node = tbutil::JsonValue::Object();
+      node.set("addr", addr);
+      if (!e.tag.empty()) node.set("tag", e.tag);
+      servers.push_back(std::move(node));
+    }
+  }
+  tbutil::JsonValue root = tbutil::JsonValue::Object();
+  root.set("servers", std::move(servers));
+  resp->content_type = "application/json";
+  resp->body = root.Dump();
+}
+
+}  // namespace
+
+void RegistryService::Install() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    RegisterHttpHandler("/registry/register", register_handler);
+    RegisterHttpHandler("/registry/deregister", deregister_handler);
+    RegisterHttpHandler("/registry/list", list_handler);
+  });
+}
+
+size_t RegistryService::live_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  prune_locked(tbutil::gettimeofday_us());
+  return g_table.size();
+}
+
+void RegistryService::clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_table.clear();
+}
+
+// ---------------- client ----------------
+
+RegistryClient::~RegistryClient() { Stop(); }
+
+int RegistryClient::SendOnce(const char* op) {
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kHttpProtocolIndex;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 0;  // the heartbeat loop IS the retry policy
+  if (ch.Init(_registry.c_str(), &opts) != 0) return -1;
+  tbutil::JsonValue body = tbutil::JsonValue::Object();
+  body.set("addr", _addr);
+  if (!_tag.empty()) body.set("tag", _tag);
+  body.set("ttl_s", int64_t{_ttl_s});
+  tbutil::IOBuf req, respb;
+  req.append(body.Dump());
+  Controller cntl;
+  ch.CallMethod(std::string("registry/") + op, &cntl, req, &respb, nullptr);
+  return cntl.Failed() ? -1 : 0;
+}
+
+int RegistryClient::Start(const std::string& registry_hostport,
+                          const std::string& addr, const std::string& tag,
+                          int ttl_s) {
+  if (ttl_s < 1) ttl_s = 1;
+  _registry = registry_hostport;
+  _addr = addr;
+  _tag = tag;
+  _ttl_s = ttl_s;
+  if (SendOnce("register") != 0) {
+    // Keep trying in the background — the registry may come up after us
+    // (the reference's discovery registration retries the same way).
+    TB_LOG(WARNING) << "registry " << _registry
+                    << " unreachable; will keep heartbeating";
+  } else {
+    _beats.fetch_add(1, std::memory_order_relaxed);
+  }
+  _stop.store(false);
+  _thread = std::thread([this] { Run(); });
+  return 0;
+}
+
+void RegistryClient::Run() {
+  // Heartbeat at ttl/3 so two consecutive losses still leave the entry
+  // alive; ±25% jitter decorrelates a fleet.
+  while (!_stop.load(std::memory_order_relaxed)) {
+    const int base_ms = _ttl_s * 1000 / 3 + 1;
+    const int sleep_ms =
+        base_ms * 3 / 4 + static_cast<int>(tbutil::fast_rand_less_than(
+                              static_cast<uint64_t>(base_ms) / 2 + 1));
+    for (int waited = 0; waited < sleep_ms && !_stop.load(); waited += 50) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    if (_stop.load()) break;
+    if (SendOnce("register") == 0) {
+      _beats.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+}
+
+void RegistryClient::Stop() {
+  if (!_thread.joinable()) return;
+  _stop.store(true);
+  _thread.join();
+  SendOnce("deregister");
+}
+
+}  // namespace trpc

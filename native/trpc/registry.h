@@ -1,0 +1,68 @@
+// Service registry: consul/discovery-class registration + resolution over
+// plain HTTP, self-contained (no external registry daemon needed).
+// Capability parity: reference policy/discovery_naming_service.cpp
+// (register/fetch/renew against a JSON-over-HTTP registry) and
+// policy/consul_naming_service.cpp (catalog polling). Ours ships BOTH
+// halves: any server can BE the registry (RegistryService::Install), and
+// any server can register itself into one (RegistryClient heartbeats).
+// Resolution is the "http://" naming scheme (naming_service.h), which
+// GETs the list endpoint and feeds the load balancer.
+//
+// Wire API (JSON over the builtin HTTP port):
+//   POST /registry/register    {"addr":"ip:port","tag":"...","ttl_s":N}
+//   POST /registry/deregister  {"addr":"ip:port"}
+//   GET  /registry/list[?tag=t] -> {"servers":[{"addr":...,"tag":...},...]}
+// Entries expire ttl_s seconds after the last register (heartbeats renew).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <map>
+
+namespace trpc {
+
+// Server side: an in-process registry table exposed through the builtin
+// HTTP console handlers. Install() is idempotent and process-global.
+class RegistryService {
+ public:
+  static void Install();
+
+  // Exposed for tests and pruning: number of live (unexpired) entries.
+  static size_t live_count();
+  // Drop everything (tests).
+  static void clear();
+};
+
+// Client side: keep one address registered with heartbeats at ttl/3.
+// Deregisters on Stop()/destruction.
+class RegistryClient {
+ public:
+  RegistryClient() = default;
+  ~RegistryClient();
+
+  // registry_hostport: "ip:port" of the server running RegistryService.
+  // addr: the address to advertise (usually this server's listen address).
+  int Start(const std::string& registry_hostport, const std::string& addr,
+            const std::string& tag = "", int ttl_s = 10);
+  void Stop();
+
+  // Heartbeats sent so far (tests).
+  int64_t beats() const { return _beats.load(std::memory_order_relaxed); }
+
+ private:
+  void Run();
+  int SendOnce(const char* op);
+
+  std::string _registry;
+  std::string _addr;
+  std::string _tag;
+  int _ttl_s = 10;
+  std::thread _thread;
+  std::atomic<bool> _stop{false};
+  std::atomic<int64_t> _beats{0};
+};
+
+}  // namespace trpc
