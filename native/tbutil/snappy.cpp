@@ -1,0 +1,246 @@
+// Snappy block format (see snappy.h). Element grammar, from the public
+// format description:
+//   preamble: uncompressed length, little-endian varint
+//   tag & 3 == 0: literal. len-1 in tag>>2 when < 60; 60..63 mean 1..4
+//                 little-endian extension bytes hold len-1.
+//   tag & 3 == 1: copy1 — len 4..11 in bits 2..4, offset 1..2047 from
+//                 bits 5..7 (high) + one byte (low).
+//   tag & 3 == 2: copy2 — len 1..64 in tag>>2 plus one, offset u16le.
+//   tag & 3 == 3: copy4 — len as copy2, offset u32le.
+#include "tbutil/snappy.h"
+
+#include <cstdint>
+#include <cstring>
+
+namespace tbutil {
+
+namespace {
+
+constexpr size_t kFragment = 64 << 10;  // match window: offsets fit copy2
+constexpr int kHashBits = 14;
+
+inline uint32_t load32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash32(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - kHashBits);
+}
+
+// Emits a literal element for in[0..len).
+char* emit_literal(char* op, const char* in, size_t len) {
+  const size_t n = len - 1;
+  if (n < 60) {
+    *op++ = static_cast<char>(n << 2);
+  } else if (n < (1u << 8)) {
+    *op++ = static_cast<char>(60 << 2);
+    *op++ = static_cast<char>(n);
+  } else if (n < (1u << 16)) {
+    *op++ = static_cast<char>(61 << 2);
+    *op++ = static_cast<char>(n);
+    *op++ = static_cast<char>(n >> 8);
+  } else if (n < (1u << 24)) {
+    *op++ = static_cast<char>(62 << 2);
+    *op++ = static_cast<char>(n);
+    *op++ = static_cast<char>(n >> 8);
+    *op++ = static_cast<char>(n >> 16);
+  } else {
+    *op++ = static_cast<char>(63 << 2);
+    *op++ = static_cast<char>(n);
+    *op++ = static_cast<char>(n >> 8);
+    *op++ = static_cast<char>(n >> 16);
+    *op++ = static_cast<char>(n >> 24);
+  }
+  memcpy(op, in, len);
+  return op + len;
+}
+
+// One copy element, 4 <= len <= 64, offset <= 65535.
+char* emit_copy_one(char* op, size_t offset, size_t len) {
+  if (len >= 4 && len <= 11 && offset < 2048) {
+    *op++ = static_cast<char>(1 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *op++ = static_cast<char>(offset & 0xff);
+  } else {
+    *op++ = static_cast<char>(2 | ((len - 1) << 2));
+    *op++ = static_cast<char>(offset & 0xff);
+    *op++ = static_cast<char>(offset >> 8);
+  }
+  return op;
+}
+
+// A match of arbitrary length as a copy sequence (snappy caps one element
+// at 64 bytes; the 68/64-60 split keeps every tail chunk >= 4 so copy1/2
+// length encodings stay legal).
+char* emit_copy(char* op, size_t offset, size_t len) {
+  while (len >= 68) {
+    op = emit_copy_one(op, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    op = emit_copy_one(op, offset, 60);
+    len -= 60;
+  }
+  return emit_copy_one(op, offset, len);
+}
+
+}  // namespace
+
+size_t snappy_max_compressed_length(size_t n) { return 32 + n + n / 6; }
+
+size_t snappy_compress(const char* in, size_t n, char* out) {
+  char* op = out;
+  // Preamble varint.
+  size_t v = n;
+  while (v >= 0x80) {
+    *op++ = static_cast<char>(v | 0x80);
+    v >>= 7;
+  }
+  *op++ = static_cast<char>(v);
+
+  static thread_local uint16_t table[1 << kHashBits];
+  size_t done = 0;
+  while (done < n) {
+    const char* base = in + done;
+    const size_t frag = n - done < kFragment ? n - done : kFragment;
+    memset(table, 0, sizeof(table));
+    size_t anchor = 0;  // start of pending literal, fragment-relative
+    size_t ip = 0;
+    if (frag >= 8) {
+      // Stop early enough that every 4-byte load below stays in bounds.
+      const size_t ip_limit = frag - 4;
+      ip = 1;  // position 0 stays the table's "empty" sentinel
+      while (ip < ip_limit) {
+        const uint32_t h = hash32(load32(base + ip));
+        const size_t cand = table[h];
+        table[h] = static_cast<uint16_t>(ip);
+        if (cand != 0 && load32(base + cand) == load32(base + ip)) {
+          // Extend the match forward.
+          size_t len = 4;
+          while (ip + len < frag && base[cand + len] == base[ip + len]) {
+            ++len;
+          }
+          if (ip > anchor) {
+            op = emit_literal(op, base + anchor, ip - anchor);
+          }
+          op = emit_copy(op, ip - cand, len);
+          ip += len;
+          anchor = ip;
+          continue;
+        }
+        ++ip;
+      }
+    }
+    if (anchor < frag) {
+      op = emit_literal(op, base + anchor, frag - anchor);
+    }
+    done += frag;
+  }
+  return static_cast<size_t>(op - out);
+}
+
+bool snappy_uncompressed_length(const char* in, size_t n, size_t* result) {
+  size_t value = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && shift <= 63; ++i, shift += 7) {
+    const uint8_t b = static_cast<uint8_t>(in[i]);
+    value |= static_cast<size_t>(b & 0x7f) << shift;
+    if ((b & 0x80) == 0) {
+      *result = value;
+      return true;
+    }
+  }
+  return false;
+}
+
+bool snappy_uncompress(const char* in, size_t n, char* out, size_t out_cap) {
+  // Re-parse the preamble to find where elements start.
+  size_t expect = 0;
+  size_t ip = 0;
+  {
+    int shift = 0;
+    while (true) {
+      if (ip >= n || shift > 63) return false;
+      const uint8_t b = static_cast<uint8_t>(in[ip++]);
+      expect |= static_cast<size_t>(b & 0x7f) << shift;
+      shift += 7;
+      if ((b & 0x80) == 0) break;
+    }
+  }
+  if (expect > out_cap) return false;
+  size_t op = 0;
+  while (ip < n) {
+    const uint8_t tag = static_cast<uint8_t>(in[ip++]);
+    if ((tag & 3) == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        const size_t ext = len - 60;  // 1..4 length bytes
+        if (ip + ext > n) return false;
+        len = 0;
+        for (size_t k = 0; k < ext; ++k) {
+          len |= static_cast<size_t>(static_cast<uint8_t>(in[ip + k]))
+                 << (8 * k);
+        }
+        len += 1;
+        ip += ext;
+      }
+      if (ip + len > n || op + len > expect) return false;
+      memcpy(out + op, in + ip, len);
+      ip += len;
+      op += len;
+      continue;
+    }
+    size_t len = 0, offset = 0;
+    if ((tag & 3) == 1) {  // copy1
+      len = ((tag >> 2) & 0x7) + 4;
+      if (ip >= n) return false;
+      offset = (static_cast<size_t>(tag >> 5) << 8) |
+               static_cast<uint8_t>(in[ip++]);
+    } else if ((tag & 3) == 2) {  // copy2
+      len = (tag >> 2) + 1;
+      if (ip + 2 > n) return false;
+      offset = static_cast<uint8_t>(in[ip]) |
+               (static_cast<size_t>(static_cast<uint8_t>(in[ip + 1])) << 8);
+      ip += 2;
+    } else {  // copy4
+      len = (tag >> 2) + 1;
+      if (ip + 4 > n) return false;
+      offset = static_cast<uint8_t>(in[ip]) |
+               (static_cast<size_t>(static_cast<uint8_t>(in[ip + 1])) << 8) |
+               (static_cast<size_t>(static_cast<uint8_t>(in[ip + 2])) << 16) |
+               (static_cast<size_t>(static_cast<uint8_t>(in[ip + 3])) << 24);
+      ip += 4;
+    }
+    if (offset == 0 || offset > op || op + len > expect) return false;
+    // Overlapping copies are legal (offset < len): byte-wise replication.
+    const char* src = out + op - offset;
+    char* dst = out + op;
+    for (size_t k = 0; k < len; ++k) dst[k] = src[k];
+    op += len;
+  }
+  return op == expect;
+}
+
+void snappy_compress(const std::string& in, std::string* out) {
+  out->resize(snappy_max_compressed_length(in.size()));
+  const size_t n = snappy_compress(in.data(), in.size(), out->data());
+  out->resize(n);
+}
+
+bool snappy_uncompress(const std::string& in, std::string* out,
+                       size_t max_out) {
+  size_t expect = 0;
+  if (!snappy_uncompressed_length(in.data(), in.size(), &expect)) {
+    return false;
+  }
+  if (expect > max_out) return false;
+  out->resize(expect);
+  if (!snappy_uncompress(in.data(), in.size(), out->data(), expect)) {
+    out->clear();
+    return false;
+  }
+  return true;
+}
+
+}  // namespace tbutil

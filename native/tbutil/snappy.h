@@ -1,0 +1,38 @@
+// Snappy block-format codec, implemented from the public format description
+// (google/snappy format_description.txt) — no external library.
+// Capability parity: the reference links the snappy library for
+// COMPRESS_TYPE_SNAPPY (policy/snappy_compress.cpp); ours is a
+// self-contained encoder/decoder producing interoperable bytes.
+//
+// Encoder: greedy 4-byte-hash matcher within 64KB fragments (offsets fit
+// the 2-byte copy form), literals with extension lengths. Decoder: fully
+// bounds-checked (fuzzed), handles overlapping copies, refuses output
+// beyond the caller's cap — the decompression-bomb guard.
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+namespace tbutil {
+
+// Worst-case output size for n input bytes (spec: 32 + n + n/6).
+size_t snappy_max_compressed_length(size_t n);
+
+// Compresses in[0..n) into out (capacity >= snappy_max_compressed_length).
+// Returns bytes written. Never fails.
+size_t snappy_compress(const char* in, size_t n, char* out);
+
+// Parses the uncompressed-length preamble. False on malformed varint.
+bool snappy_uncompressed_length(const char* in, size_t n, size_t* result);
+
+// Decompresses in[0..n) into out (capacity out_cap, which must be >= the
+// preamble length). False on any malformed input or if output would
+// exceed out_cap.
+bool snappy_uncompress(const char* in, size_t n, char* out, size_t out_cap);
+
+// std::string conveniences used by tests and the compress registry glue.
+void snappy_compress(const std::string& in, std::string* out);
+bool snappy_uncompress(const std::string& in, std::string* out,
+                       size_t max_out);
+
+}  // namespace tbutil

@@ -22,6 +22,8 @@
 #include "trpc/flags.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/tstd_protocol.h"
+#include "tbutil/crc32c.h"
+#include "trpc/protocol.h"
 #include "tbvar/variable.h"
 #include <map>
 
@@ -975,6 +977,93 @@ TEST_CASE(gzip_compression_roundtrip) {
   channel.CallMethod("EchoService/Echo", &c2, req2, &resp2, nullptr);
   ASSERT_FALSE(c2.Failed());
   ASSERT_TRUE(resp2.equals(noise));
+  server.Stop();
+}
+
+// Snappy: same transparency contract as gzip, cheaper CPU (reference
+// policy/snappy_compress.cpp; codec is tbutil/snappy.cpp from the spec).
+TEST_CASE(snappy_compression_roundtrip) {
+  Server server;
+  EchoService svc;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ASSERT_EQ(server.Start(0), 0);
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  Channel channel;
+  ChannelOptions opts;
+  opts.request_compress_type = kCompressSnappy;
+  ASSERT_EQ(channel.Init(addr, &opts), 0);
+  std::string text;
+  for (int i = 0; i < 4096; ++i) {
+    text += "tensor shard 0123456789 tensor shard 0123456789 ";
+  }
+  const int64_t out_before =
+      GlobalRpcMetrics::instance().bytes_out.get_value();
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append(text);
+  channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+  ASSERT_FALSE(cntl.Failed());
+  ASSERT_TRUE(resp.equals(text));
+  const int64_t wire_bytes =
+      GlobalRpcMetrics::instance().bytes_out.get_value() - out_before;
+  ASSERT_TRUE(wire_bytes > 0);
+  ASSERT_TRUE(wire_bytes < static_cast<int64_t>(text.size() / 2));
+  server.Stop();
+}
+
+// tstd body checksum: crc32c stamped when the tstd_checksum flag is on,
+// verified on receive; a corrupted body kills the parse instead of
+// delivering garbage bytes to the application.
+TEST_CASE(tstd_body_checksum) {
+  // Unit level: serialize a checksummed frame, corrupt one body byte,
+  // and watch the parser reject it.
+  const Protocol* tstd = GetProtocol(kTstdProtocolIndex);
+  ASSERT_TRUE(tstd != nullptr && tstd->parse != nullptr);
+  {
+    TstdMeta meta;
+    meta.msg_type = 0;
+    meta.service = "S";
+    meta.method = "M";
+    meta.correlation_id = 7;
+    meta.flags |= kTstdFlagHasChecksum;
+    const std::string body = "hello checksummed world";
+    meta.body_crc = tbutil::crc32c(body.data(), body.size());
+    tbutil::IOBuf wire;
+    tstd_serialize_meta(&wire, meta, body.size());
+    wire.append(body);
+    // Pristine frame parses.
+    tbutil::IOBuf copy = wire;
+    ParseResult ok = tstd->parse(&copy, nullptr);
+    ASSERT_EQ(ok.error, PARSE_OK);
+    delete static_cast<TstdInputMessage*>(ok.msg);
+    // Flip one byte of the body (the LAST byte of the frame).
+    std::string flat = wire.to_string();
+    flat.back() ^= 0x01;
+    tbutil::IOBuf bad;
+    bad.append(flat);
+    ParseResult rej = tstd->parse(&bad, nullptr);
+    ASSERT_EQ(rej.error, PARSE_ERROR_ABSOLUTELY_WRONG);
+  }
+  // End to end: flag on, echo round-trips (both directions stamped).
+  FlagRegistry::global().Set("tstd_checksum", "1");
+  Server server;
+  EchoService svc;
+  ASSERT_EQ(server.AddService(&svc), 0);
+  ASSERT_EQ(server.Start(0), 0);
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+  Channel channel;
+  ASSERT_EQ(channel.Init(addr, nullptr), 0);
+  Controller cntl;
+  tbutil::IOBuf req, resp;
+  req.append("integrity matters");
+  cntl.request_attachment().append("attached too");
+  channel.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+  ASSERT_FALSE(cntl.Failed());
+  ASSERT_TRUE(resp.equals("integrity matters"));
+  ASSERT_TRUE(cntl.response_attachment().equals("attached too"));
+  FlagRegistry::global().Set("tstd_checksum", "0");
   server.Stop();
 }
 

@@ -19,6 +19,7 @@
 #include "tbutil/iobuf.h"
 #include "tbutil/object_pool.h"
 #include "tbutil/resource_pool.h"
+#include "tbutil/snappy.h"
 
 using namespace tbutil;
 
@@ -314,6 +315,96 @@ TEST_CASE(base64_roundtrip_and_vectors) {
   ASSERT_FALSE(tbutil::base64_decode("abc", &out));
   ASSERT_FALSE(tbutil::base64_decode("a!c=", &out));
   ASSERT_FALSE(tbutil::base64_decode("Zg==Zm8=", &out));
+}
+
+// ---- snappy codec (tbutil/snappy.cpp, public block format) ----
+
+TEST_CASE(snappy_hand_vectors) {
+  // Literal-only: "abc" -> varint(3), tag (3-1)<<2, bytes.
+  std::string out;
+  tbutil::snappy_compress(std::string("abc"), &out);
+  ASSERT_EQ(out.size(), 5u);
+  ASSERT_EQ(out[0], 3);
+  ASSERT_EQ(static_cast<uint8_t>(out[1]), (3u - 1) << 2);
+  ASSERT_EQ(out.substr(2), std::string("abc"));
+  // Empty input: just the varint 0.
+  tbutil::snappy_compress(std::string(), &out);
+  ASSERT_EQ(out, std::string(1, '\0'));
+  std::string plain;
+  ASSERT_TRUE(tbutil::snappy_uncompress(out, &plain, 1024));
+  ASSERT_TRUE(plain.empty());
+  // Hand-built copy form decodes: varint(8), literal "ab", copy1
+  // (len 6, offset 2) replicating "ababab" — the overlapping-copy case.
+  std::string wire;
+  wire.push_back(8);
+  wire.push_back((2 - 1) << 2);  // literal len 2
+  wire += "ab";
+  wire.push_back(static_cast<char>(1 | ((6 - 4) << 2)));  // copy1 len 6
+  wire.push_back(2);                                      // offset 2
+  ASSERT_TRUE(tbutil::snappy_uncompress(wire, &plain, 1024));
+  ASSERT_EQ(plain, std::string("abababab"));
+}
+
+TEST_CASE(snappy_roundtrip_and_ratio) {
+  // Repetitive text must round-trip AND shrink hard.
+  std::string text;
+  for (int i = 0; i < 4096; ++i) {
+    text += "the quick brown fox jumps over the lazy dog 0123456789 ";
+  }
+  std::string compressed, plain;
+  tbutil::snappy_compress(text, &compressed);
+  ASSERT_TRUE(compressed.size() < text.size() / 4);
+  ASSERT_TRUE(tbutil::snappy_uncompress(compressed, &plain, text.size()));
+  ASSERT_EQ(plain, text);
+  // Random binary (incompressible) round-trips too, incl. >64KB inputs
+  // spanning multiple fragments.
+  std::string noise(200 * 1024, 0);
+  uint64_t x = 88172645463325252ULL;
+  for (size_t i = 0; i < noise.size(); ++i) {
+    x ^= x << 13; x ^= x >> 7; x ^= x << 17;
+    noise[i] = static_cast<char>(x);
+  }
+  tbutil::snappy_compress(noise, &compressed);
+  ASSERT_TRUE(tbutil::snappy_uncompress(compressed, &plain, noise.size()));
+  ASSERT_EQ(plain, noise);
+  // All byte values, short lengths 0..300 (fragment/tag edge coverage).
+  std::string all;
+  for (int len = 0; len <= 300; ++len) {
+    all.assign(len, static_cast<char>(len * 7));
+    tbutil::snappy_compress(all, &compressed);
+    ASSERT_TRUE(tbutil::snappy_uncompress(compressed, &plain, 4096));
+    if (plain != all) {
+      fprintf(stderr, "mismatch at len %d\n", len);
+      ASSERT_TRUE(false);
+    }
+  }
+}
+
+TEST_CASE(snappy_rejects_malformed) {
+  std::string plain;
+  // Truncated varint.
+  ASSERT_FALSE(tbutil::snappy_uncompress(std::string("\xff", 1), &plain, 64));
+  // Preamble larger than cap.
+  std::string big(1, '\x20');  // claims 32 bytes
+  ASSERT_FALSE(tbutil::snappy_uncompress(big, &plain, 8));
+  // Copy before any output (offset > op).
+  std::string bad;
+  bad.push_back(4);
+  bad.push_back(static_cast<char>(1));  // copy1 len 4
+  bad.push_back(9);                     // offset 9 into nothing
+  ASSERT_FALSE(tbutil::snappy_uncompress(bad, &plain, 64));
+  // Literal running past the input.
+  bad.clear();
+  bad.push_back(10);
+  bad.push_back((10 - 1) << 2);
+  bad += "ab";  // promises 10, delivers 2
+  ASSERT_FALSE(tbutil::snappy_uncompress(bad, &plain, 64));
+  // Output short of the preamble's promise.
+  bad.clear();
+  bad.push_back(5);
+  bad.push_back((2 - 1) << 2);
+  bad += "ab";
+  ASSERT_FALSE(tbutil::snappy_uncompress(bad, &plain, 64));
 }
 
 // ---- logging subsystem (reference butil/logging.cc coverage) ----

@@ -7,6 +7,7 @@
 #include <string>
 
 #include "tbutil/logging.h"
+#include "tbutil/snappy.h"
 
 namespace trpc {
 
@@ -118,12 +119,43 @@ bool MaybeCompress(uint8_t type, const tbutil::IOBuf& in,
   return c != nullptr && c->compress(in, out) && out->size() < in.size();
 }
 
+namespace {
+
+// ---- snappy (tbutil/snappy.cpp, block format from the public spec).
+// Block-oriented: snappy needs contiguous input/output, so unlike the
+// zlib streaming path this flattens — snappy is the "cheap CPU" choice
+// for small/medium RPC payloads; gzip remains the pick for huge bodies.
+
+bool snappy_compress_iobuf(const tbutil::IOBuf& in, tbutil::IOBuf* out) {
+  const std::string flat = in.to_string();
+  std::string compressed;
+  tbutil::snappy_compress(flat, &compressed);
+  out->append(compressed);
+  return true;
+}
+
+bool snappy_decompress_iobuf(const tbutil::IOBuf& in, tbutil::IOBuf* out,
+                             size_t max_out) {
+  const std::string flat = in.to_string();
+  std::string plain;
+  if (!tbutil::snappy_uncompress(flat, &plain, max_out)) return false;
+  out->append(plain);
+  return true;
+}
+
+}  // namespace
+
 void RegisterBuiltinCompressors() {
   Compressor gz;
   gz.name = "gzip";
   gz.compress = gzip_compress;
   gz.decompress = gzip_decompress;
   TB_CHECK(RegisterCompressor(kCompressGzip, gz) == 0);
+  Compressor sn;
+  sn.name = "snappy";
+  sn.compress = snappy_compress_iobuf;
+  sn.decompress = snappy_decompress_iobuf;
+  TB_CHECK(RegisterCompressor(kCompressSnappy, sn) == 0);
 }
 
 }  // namespace trpc

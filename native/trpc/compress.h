@@ -15,6 +15,7 @@ namespace trpc {
 
 inline constexpr uint8_t kCompressNone = 0;
 inline constexpr uint8_t kCompressGzip = 1;
+inline constexpr uint8_t kCompressSnappy = 2;
 
 struct Compressor {
   const char* name = nullptr;
@@ -37,7 +38,7 @@ const Compressor* GetCompressor(uint8_t type);
 // meta.compress_type); false = send the plain bytes with type none.
 bool MaybeCompress(uint8_t type, const tbutil::IOBuf& in, tbutil::IOBuf* out);
 
-// Built-ins (gzip); called by GlobalInitializeOrDie.
+// Built-ins (gzip, snappy); called by GlobalInitializeOrDie.
 void RegisterBuiltinCompressors();
 
 }  // namespace trpc

@@ -5,6 +5,7 @@
 #include "tbutil/fast_rand.h"
 #include "tbutil/json.h"
 #include "tbutil/logging.h"
+#include "tbutil/endpoint.h"
 #include "tbutil/time.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
@@ -41,9 +42,14 @@ void register_handler(const HttpRequest& req, HttpResponse* resp) {
   }
   const tbutil::JsonValue* addr_v = parsed->find("addr");
   const std::string addr = addr_v != nullptr ? addr_v->as_string() : "";
-  if (addr.empty()) {
+  // Validate before serving to every resolver: a garbage addr would fail
+  // node parsing in every client on every refresh, and unbounded strings /
+  // entries are a memory hole on an open port.
+  tbutil::EndPoint ep;
+  if (addr.empty() || addr.size() > 256 ||
+      tbutil::str2endpoint(addr.c_str(), &ep) != 0) {
     resp->status = 400;
-    resp->body = "missing addr\n";
+    resp->body = "addr must be a valid ip:port\n";
     return;
   }
   const tbutil::JsonValue* ttl_v = parsed->find("ttl_s");
@@ -53,9 +59,25 @@ void register_handler(const HttpRequest& req, HttpResponse* resp) {
   Entry e;
   const tbutil::JsonValue* tag_v = parsed->find("tag");
   if (tag_v != nullptr) e.tag = tag_v->as_string();
+  if (e.tag.size() > 128) {
+    resp->status = 400;
+    resp->body = "tag too long\n";
+    return;
+  }
   e.expire_us = tbutil::gettimeofday_us() + ttl_s * 1000000;
   {
     std::lock_guard<std::mutex> lk(g_mu);
+    // Renewals always land; new entries respect the cap (prune first so a
+    // full table of stale entries doesn't lock out live servers).
+    constexpr size_t kMaxEntries = 10000;
+    if (g_table.count(addr) == 0 && g_table.size() >= kMaxEntries) {
+      prune_locked(tbutil::gettimeofday_us());
+      if (g_table.size() >= kMaxEntries) {
+        resp->status = 503;
+        resp->body = "registry full\n";
+        return;
+      }
+    }
     g_table[addr] = std::move(e);
   }
   resp->body = "ok\n";
@@ -145,6 +167,10 @@ int RegistryClient::SendOnce(const char* op) {
 int RegistryClient::Start(const std::string& registry_hostport,
                           const std::string& addr, const std::string& tag,
                           int ttl_s) {
+  if (_thread.joinable()) {
+    TB_LOG(ERROR) << "RegistryClient already started; Stop() first";
+    return -1;
+  }
   if (ttl_s < 1) ttl_s = 1;
   _registry = registry_hostport;
   _addr = addr;

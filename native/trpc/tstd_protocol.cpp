@@ -1,5 +1,7 @@
 #include "trpc/tstd_protocol.h"
 
+#include "tbutil/crc32c.h"
+
 #include "trpc/thrift_protocol.h"
 
 #include <algorithm>
@@ -58,6 +60,27 @@ T get(const char*& p) {
   return v;
 }
 
+// 0/1: stamp crc32c of the body into outgoing tstd frames. Costs one pass
+// over the payload; worth it on links without end-to-end integrity
+// (reference baidu_std has no body checksum — this is a deliberate
+// improvement for tensor payloads riding tpu:// shm segments).
+const auto* g_tstd_checksum = trpc::FlagRegistry::global().DefineInt(
+    "tstd_checksum", 0, "stamp+verify crc32c on tstd bodies (0/1)",
+    [](int64_t v) { return v == 0 || v == 1; });
+
+uint32_t crc_of_iobuf(uint32_t crc, const tbutil::IOBuf& buf) {
+  const size_t nblocks = buf.backing_block_num();
+  for (size_t b = 0; b < nblocks; ++b) {
+    const std::string_view blk = buf.backing_block(b);
+    crc = tbutil::crc32c_extend(crc, blk.data(), blk.size());
+  }
+  return crc;
+}
+
+bool checksum_enabled() {
+  return g_tstd_checksum->load(std::memory_order_relaxed) != 0;
+}
+
 }  // namespace
 
 void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
@@ -79,6 +102,9 @@ void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
   if (meta.stream_id != 0) {
     put<uint64_t>(&m, meta.stream_id);
     put<int64_t>(&m, meta.stream_window);
+  }
+  if (flags & kTstdFlagHasChecksum) {
+    put<uint32_t>(&m, meta.body_crc);
   }
   if (meta.msg_type == 0) {
     put<uint16_t>(&m, static_cast<uint16_t>(meta.service.size()));
@@ -116,6 +142,10 @@ static bool parse_meta(const std::string& raw, TstdMeta* meta) {
     if (p + 16 > end) return false;
     meta->stream_id = get<uint64_t>(p);
     meta->stream_window = get<int64_t>(p);
+  }
+  if (meta->flags & kTstdFlagHasChecksum) {
+    if (p + 4 > end) return false;
+    meta->body_crc = get<uint32_t>(p);
   }
   auto get_str = [&p, end](std::string* out) {
     if (p + 2 > end) return false;
@@ -170,6 +200,19 @@ ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
   }
   source->cutn(&msg->payload, body_size - msg->meta.attachment_size);
   source->cutn(&msg->attachment, msg->meta.attachment_size);
+  if (msg->meta.flags & kTstdFlagHasChecksum) {
+    const uint32_t got =
+        crc_of_iobuf(crc_of_iobuf(0, msg->payload), msg->attachment);
+    if (got != msg->meta.body_crc) {
+      // Bytes corrupted in flight (or a buggy peer): nothing later on this
+      // connection can be trusted — kill it loudly.
+      TB_LOG(ERROR) << "tstd body crc mismatch: got " << got << " want "
+                    << msg->meta.body_crc << " (" << body_size << "B body)";
+      delete msg;
+      r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
+      return r;
+    }
+  }
   msg->process_in_place = msg->meta.msg_type >= 2;  // stream frames: ordered
   r.error = PARSE_OK;
   r.msg = msg;
@@ -216,6 +259,11 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
     body = &compressed;
     meta.compress_type = cntl->compress_type();
   }
+  if (checksum_enabled()) {
+    meta.flags |= kTstdFlagHasChecksum;
+    meta.body_crc =
+        crc_of_iobuf(crc_of_iobuf(0, *body), cntl->request_attachment());
+  }
   tstd_serialize_meta(out, meta,
                       body->size() + cntl->request_attachment().size());
   out->append(*body);
@@ -260,6 +308,11 @@ static void tstd_send_response(SocketId sid, uint64_t correlation_id,
       meta.compress_type = cntl->compress_type();
       payload->swap(compressed);
     }
+  }
+  if (checksum_enabled()) {
+    meta.flags |= kTstdFlagHasChecksum;
+    meta.body_crc =
+        crc_of_iobuf(crc_of_iobuf(0, *payload), cntl->response_attachment());
   }
   tbutil::IOBuf out;
   tstd_serialize_meta(&out, meta,

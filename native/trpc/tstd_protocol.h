@@ -21,6 +21,10 @@ namespace trpc {
 inline constexpr int kTstdProtocolIndex = 0;
 
 inline constexpr uint16_t kTstdFlagHasStream = 1;
+// Body integrity: meta carries crc32c(payload||attachment as framed).
+// Senders set it when the tstd_checksum flag is on; receivers ALWAYS
+// verify when present.
+inline constexpr uint16_t kTstdFlagHasChecksum = 2;
 
 struct TstdMeta {
   // 0 request, 1 response, 2 stream-data, 3 stream-close, 4 stream-feedback
@@ -41,6 +45,8 @@ struct TstdMeta {
   // sender's stream id + its advertised receive window.
   uint64_t stream_id = 0;
   int64_t stream_window = 0;
+  // Present iff flags & kTstdFlagHasChecksum.
+  uint32_t body_crc = 0;
   std::string service;     // request
   std::string method;      // request
   std::string error_text;  // response
