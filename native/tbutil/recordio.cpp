@@ -1,0 +1,67 @@
+#include "tbutil/recordio.h"
+
+#include <cstring>
+
+#include "tbutil/crc32c.h"
+
+namespace tbutil {
+
+bool RecordWriter::Write(const void* payload, size_t n) {
+  if (n > _max_record) return false;  // would be unreadable — reject whole
+  const uint32_t len = static_cast<uint32_t>(n);
+  const uint32_t crc = crc32c(payload, n);
+  if (fwrite(&_magic, 4, 1, _f) != 1) return false;
+  if (fwrite(&len, 4, 1, _f) != 1) return false;
+  if (fwrite(&crc, 4, 1, _f) != 1) return false;
+  return n == 0 || fwrite(payload, 1, n, _f) == n;
+}
+
+bool RecordReader::Ensure(size_t need) {
+  while (!_eof && _buf.size() - _pos < need) {
+    if (_pos > (1u << 20)) {  // compact the consumed prefix
+      _buf.erase(0, _pos);
+      _pos = 0;
+    }
+    char chunk[64 << 10];
+    const size_t got = fread(chunk, 1, sizeof(chunk), _f);
+    if (got == 0) {
+      _eof = true;
+      break;
+    }
+    _read_anything = true;
+    _buf.append(chunk, got);
+  }
+  return _buf.size() - _pos >= need;
+}
+
+bool RecordReader::Next(std::string* out) {
+  while (Ensure(12) || _buf.size() - _pos >= 1) {
+    if (_buf.size() - _pos < 12) {  // tail too short for any frame
+      _skipped += _buf.size() - _pos;
+      _pos = _buf.size();
+      return false;
+    }
+    uint32_t magic;
+    memcpy(&magic, _buf.data() + _pos, 4);
+    if (magic != _magic) {
+      ++_pos;
+      ++_skipped;
+      continue;
+    }
+    uint32_t len, crc;
+    memcpy(&len, _buf.data() + _pos + 4, 4);
+    memcpy(&crc, _buf.data() + _pos + 8, 4);
+    if (len > _max_record || !Ensure(12 + size_t(len)) ||
+        crc32c(_buf.data() + _pos + 12, len) != crc) {
+      ++_pos;
+      ++_skipped;
+      continue;
+    }
+    out->assign(_buf.data() + _pos + 12, len);
+    _pos += 12 + size_t(len);
+    return true;
+  }
+  return false;
+}
+
+}  // namespace tbutil

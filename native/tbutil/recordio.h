@@ -1,0 +1,71 @@
+// RecordIO: length-delimited records with magic + crc32c framing and
+// byte-level resync on corruption.
+// Capability parity: reference src/butil/recordio.h (Writer/Reader over
+// framed records that survive torn tails). On-disk layout per record:
+//   u32le magic | u32le payload_len | u32le crc32c(payload) | payload
+// A reader scanning a damaged region advances one byte at a time until the
+// next frame whose magic, length bound, AND crc all hold — a crash mid-
+// write or a corrupted span costs only the records it covers.
+// Backs rpc_dump (trpc/rpc_dump.cpp) and any future snapshot format.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+namespace tbutil {
+
+inline constexpr uint32_t kRecordIODefaultMagic = 0x4f494452;  // "RDIO"
+
+// Appends framed records to a FILE* it does NOT own. Not thread-safe —
+// callers serialize (rpc_dump holds its own lock).
+class RecordWriter {
+ public:
+  explicit RecordWriter(FILE* f, uint32_t magic = kRecordIODefaultMagic,
+                        size_t max_record = 256u << 20)
+      : _f(f), _magic(magic), _max_record(max_record) {}
+
+  // False when n exceeds max_record (nothing is written — an oversized
+  // frame would be unreadable: the reader skips anything past ITS cap) or
+  // when any fwrite comes up short (disk full; the torn frame is absorbed
+  // by the reader's resync).
+  bool Write(const void* payload, size_t n);
+  void Flush() { fflush(_f); }
+
+ private:
+  FILE* _f;
+  uint32_t _magic;
+  size_t _max_record;
+};
+
+// Streaming reader over a FILE* it does NOT own. The window holds at most
+// one max-size record plus a read chunk — never the whole file.
+class RecordReader {
+ public:
+  explicit RecordReader(FILE* f, uint32_t magic = kRecordIODefaultMagic,
+                        size_t max_record = 256u << 20)
+      : _f(f), _magic(magic), _max_record(max_record) {}
+
+  // Next valid record into *out. False at end of input.
+  bool Next(std::string* out);
+
+  // Bytes skipped across damaged regions so far.
+  size_t skipped_bytes() const { return _skipped; }
+  // True once any byte was consumed from the file (distinguishes "empty
+  // file" from "nothing survived corruption").
+  bool read_anything() const { return _read_anything; }
+
+ private:
+  bool Ensure(size_t need);
+
+  FILE* _f;
+  uint32_t _magic;
+  size_t _max_record;
+  std::string _buf;
+  size_t _pos = 0;
+  size_t _skipped = 0;
+  bool _eof = false;
+  bool _read_anything = false;
+};
+
+}  // namespace tbutil

@@ -1,0 +1,122 @@
+#include "tbutil/string_utils.h"
+
+#include <cstdio>
+
+namespace tbutil {
+
+void string_vappendf(std::string* out, const char* fmt, va_list ap) {
+  va_list ap2;
+  va_copy(ap2, ap);
+  char small[256];
+  const int need = vsnprintf(small, sizeof(small), fmt, ap);
+  if (need < 0) {
+    va_end(ap2);
+    return;
+  }
+  if (static_cast<size_t>(need) < sizeof(small)) {
+    out->append(small, need);
+  } else {
+    const size_t old = out->size();
+    out->resize(old + need + 1);
+    vsnprintf(out->data() + old, need + 1, fmt, ap2);
+    out->resize(old + need);  // drop the NUL
+  }
+  va_end(ap2);
+}
+
+std::string string_printf(const char* fmt, ...) {
+  std::string out;
+  va_list ap;
+  va_start(ap, fmt);
+  string_vappendf(&out, fmt, ap);
+  va_end(ap);
+  return out;
+}
+
+void string_appendf(std::string* out, const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  string_vappendf(out, fmt, ap);
+  va_end(ap);
+}
+
+void StringSplitter::advance() {
+  if (_done) {
+    _valid = false;
+    return;
+  }
+  while (true) {
+    const size_t sep = _rest.find(_sep);
+    if (sep == std::string_view::npos) {
+      // Final segment (possibly empty). _done stops a trailing empty field
+      // from repeating forever in keep_empty mode.
+      _field = _rest;
+      _rest = {};
+      _done = true;
+      _valid = !_field.empty() || _keep_empty;
+      return;
+    }
+    _field = _rest.substr(0, sep);
+    _rest.remove_prefix(sep + 1);
+    if (!_field.empty() || _keep_empty) {
+      _valid = true;
+      return;
+    }
+  }
+}
+
+std::string_view trim_whitespace(std::string_view s) {
+  const char* ws = " \t\r\n\f\v";
+  const size_t b = s.find_first_not_of(ws);
+  if (b == std::string_view::npos) return {};
+  const size_t e = s.find_last_not_of(ws);
+  return s.substr(b, e - b + 1);
+}
+
+std::string to_lower_ascii(std::string_view s) {
+  std::string out(s);
+  for (char& c : out) {
+    if (c >= 'A' && c <= 'Z') c += 'a' - 'A';
+  }
+  return out;
+}
+
+std::string to_upper_ascii(std::string_view s) {
+  std::string out(s);
+  for (char& c : out) {
+    if (c >= 'a' && c <= 'z') c -= 'a' - 'A';
+  }
+  return out;
+}
+
+std::string hex_encode(std::string_view bytes) {
+  static const char kHex[] = "0123456789abcdef";
+  std::string out;
+  out.reserve(bytes.size() * 2);
+  for (unsigned char c : bytes) {
+    out.push_back(kHex[c >> 4]);
+    out.push_back(kHex[c & 0xf]);
+  }
+  return out;
+}
+
+bool hex_decode(std::string_view hex, std::string* out) {
+  if (hex.size() % 2 != 0) return false;
+  out->clear();
+  out->reserve(hex.size() / 2);
+  auto nibble = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  for (size_t i = 0; i < hex.size(); i += 2) {
+    const int hi = nibble(hex[i]);
+    const int lo = nibble(hex[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out->push_back(static_cast<char>((hi << 4) | lo));
+  }
+  return true;
+}
+
+}  // namespace tbutil

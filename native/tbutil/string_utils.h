@@ -1,0 +1,64 @@
+// String helpers rounding out the base layer.
+// Capability parity: reference src/butil/string_printf.h (printf into
+// std::string), butil/string_splitter.h (allocation-free tokenizer), plus
+// the trim/case/hex utilities scattered through butil/strings/. All
+// operate on std::string/string_view — no custom string type.
+#pragma once
+
+#include <cstdarg>
+#include <cstdint>
+#include <string>
+#include <string_view>
+
+namespace tbutil {
+
+// printf into a fresh string / append to an existing one.
+std::string string_printf(const char* fmt, ...)
+    __attribute__((format(printf, 1, 2)));
+void string_appendf(std::string* out, const char* fmt, ...)
+    __attribute__((format(printf, 2, 3)));
+void string_vappendf(std::string* out, const char* fmt, va_list ap);
+
+// Allocation-free tokenizer over a view (reference StringSplitter):
+//   for (StringSplitter sp(line, ','); sp; ++sp) use(sp.field());
+// Empty fields are skipped by default (",a,,b," -> a, b); pass
+// keep_empty=true to yield them.
+class StringSplitter {
+ public:
+  StringSplitter(std::string_view input, char sep, bool keep_empty = false)
+      : _rest(input), _sep(sep), _keep_empty(keep_empty) {
+    advance();
+  }
+
+  explicit operator bool() const { return _valid; }
+  std::string_view field() const { return _field; }
+  StringSplitter& operator++() {
+    advance();
+    return *this;
+  }
+
+ private:
+  void advance();
+
+  std::string_view _rest;
+  std::string_view _field;
+  char _sep;
+  bool _keep_empty;
+  bool _valid = false;
+  bool _done = false;
+};
+
+// View with ASCII whitespace (space, \t, \r, \n, \f, \v) removed from both
+// ends. A view into the input — no copy.
+std::string_view trim_whitespace(std::string_view s);
+
+// ASCII-only case mapping (bytes >= 0x80 pass through).
+std::string to_lower_ascii(std::string_view s);
+std::string to_upper_ascii(std::string_view s);
+
+// Lowercase hex codec. hex_decode returns false on odd length or non-hex
+// input (case-insensitive).
+std::string hex_encode(std::string_view bytes);
+bool hex_decode(std::string_view hex, std::string* out);
+
+}  // namespace tbutil

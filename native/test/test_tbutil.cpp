@@ -19,7 +19,9 @@
 #include "tbutil/iobuf.h"
 #include "tbutil/object_pool.h"
 #include "tbutil/resource_pool.h"
+#include "tbutil/recordio.h"
 #include "tbutil/snappy.h"
+#include "tbutil/string_utils.h"
 
 using namespace tbutil;
 
@@ -315,6 +317,119 @@ TEST_CASE(base64_roundtrip_and_vectors) {
   ASSERT_FALSE(tbutil::base64_decode("abc", &out));
   ASSERT_FALSE(tbutil::base64_decode("a!c=", &out));
   ASSERT_FALSE(tbutil::base64_decode("Zg==Zm8=", &out));
+}
+
+// ---- recordio (reference butil/recordio.h framing + resync) ----
+
+TEST_CASE(recordio_roundtrip_and_resync) {
+  char tmpl[] = "/tmp/tbrec_XXXXXX";
+  ASSERT_TRUE(mkdtemp(tmpl) != nullptr);
+  const std::string path = std::string(tmpl) + "/records.bin";
+  {
+    FILE* f = fopen(path.c_str(), "wb");
+    ASSERT_TRUE(f != nullptr);
+    tbutil::RecordWriter w(f);
+    for (int i = 0; i < 6; ++i) {
+      std::string rec = "record-" + std::to_string(i) +
+                        std::string(50 * i, static_cast<char>('a' + i));
+      ASSERT_TRUE(w.Write(rec.data(), rec.size()));
+    }
+    w.Flush();
+    fclose(f);
+  }
+  // Clean read: all 6, nothing skipped.
+  {
+    FILE* f = fopen(path.c_str(), "rb");
+    tbutil::RecordReader r(f);
+    std::string rec;
+    int n = 0;
+    while (r.Next(&rec)) {
+      ASSERT_TRUE(rec.rfind("record-" + std::to_string(n), 0) == 0);
+      ++n;
+    }
+    fclose(f);
+    ASSERT_EQ(n, 6);
+    ASSERT_EQ(r.skipped_bytes(), 0u);
+    ASSERT_TRUE(r.read_anything());
+  }
+  // Corrupt record 2's payload and tear the tail of record 5: the reader
+  // must resync and deliver the intact ones.
+  {
+    // Frame i is 12 + len_i where len_i = strlen("record-i") + 50*i.
+    auto frame_len = [](int i) { return 12l + 8 + 50 * i; };
+    long off2 = frame_len(0) + frame_len(1);
+    long off5 = off2 + frame_len(2) + frame_len(3) + frame_len(4);
+    FILE* f = fopen(path.c_str(), "r+b");
+    fseek(f, off2 + 12 + 3, SEEK_SET);  // 3 bytes into record 2's payload
+    fputc('X', f);
+    fclose(f);
+    // Tear record 5: header + 5 payload bytes survive.
+    ASSERT_EQ(truncate(path.c_str(), off5 + 12 + 5), 0);
+  }
+  {
+    FILE* f = fopen(path.c_str(), "rb");
+    tbutil::RecordReader r(f);
+    std::string rec;
+    std::vector<std::string> prefixes;
+    while (r.Next(&rec)) prefixes.push_back(rec.substr(0, 8));
+    fclose(f);
+    // 0,1 intact; 2 corrupted (crc fails); 3,4 intact; 5 torn off.
+    ASSERT_EQ(prefixes.size(), 4u);
+    ASSERT_EQ(prefixes[0], std::string("record-0"));
+    ASSERT_EQ(prefixes[1], std::string("record-1"));
+    ASSERT_EQ(prefixes[2], std::string("record-3"));
+    ASSERT_EQ(prefixes[3], std::string("record-4"));
+    ASSERT_TRUE(r.skipped_bytes() > 0);
+  }
+}
+
+// ---- string utils (reference string_printf/string_splitter role) ----
+
+TEST_CASE(string_utils_printf_split_trim_hex) {
+  ASSERT_EQ(tbutil::string_printf("%s=%d", "x", 42), std::string("x=42"));
+  // Long output exercises the heap path past the stack buffer.
+  std::string big_arg(500, 'y');
+  const std::string big = tbutil::string_printf("[%s]", big_arg.c_str());
+  ASSERT_EQ(big.size(), 502u);
+  std::string acc = "pre:";
+  tbutil::string_appendf(&acc, "%d,%d", 1, 2);
+  ASSERT_EQ(acc, std::string("pre:1,2"));
+
+  std::vector<std::string> fields;
+  for (tbutil::StringSplitter sp(",a,,b,", ','); sp; ++sp) {
+    fields.emplace_back(sp.field());
+  }
+  ASSERT_EQ(fields.size(), 2u);
+  ASSERT_EQ(fields[0], std::string("a"));
+  ASSERT_EQ(fields[1], std::string("b"));
+  fields.clear();
+  for (tbutil::StringSplitter sp(",a,,b,", ',', /*keep_empty=*/true); sp;
+       ++sp) {
+    fields.emplace_back(sp.field());
+  }
+  // ",a,,b," = "", "a", "", "b", "" — and the trailing empty must not loop.
+  ASSERT_EQ(fields.size(), 5u);
+  ASSERT_EQ(fields[1], std::string("a"));
+  ASSERT_EQ(fields[3], std::string("b"));
+  fields.clear();
+  for (tbutil::StringSplitter sp("", ','); sp; ++sp) {
+    fields.emplace_back(sp.field());
+  }
+  ASSERT_TRUE(fields.empty());
+
+  ASSERT_EQ(tbutil::trim_whitespace("  \t hi there\r\n "),
+            std::string_view("hi there"));
+  ASSERT_EQ(tbutil::trim_whitespace(" \n "), std::string_view(""));
+  ASSERT_EQ(tbutil::to_lower_ascii("MiXeD-42"), std::string("mixed-42"));
+  ASSERT_EQ(tbutil::to_upper_ascii("MiXeD-42"), std::string("MIXED-42"));
+
+  const std::string bytes("\x00\xff\x10war", 6);
+  ASSERT_EQ(tbutil::hex_encode(bytes), std::string("00ff10776172"));
+  std::string back;
+  ASSERT_TRUE(tbutil::hex_decode("00FF10776172", &back));
+  ASSERT_EQ(back, bytes);
+  ASSERT_FALSE(tbutil::hex_decode("abc", &back));   // odd length
+  ASSERT_FALSE(tbutil::hex_decode("zz", &back));    // non-hex
 }
 
 // ---- snappy codec (tbutil/snappy.cpp, public block format) ----

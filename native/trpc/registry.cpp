@@ -5,7 +5,6 @@
 #include "tbutil/fast_rand.h"
 #include "tbutil/json.h"
 #include "tbutil/logging.h"
-#include "tbutil/endpoint.h"
 #include "tbutil/time.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
@@ -22,6 +21,26 @@ struct Entry {
 
 std::mutex g_mu;
 std::map<std::string, Entry> g_table;  // addr -> entry
+
+// "host:port" shape check without resolving: host is 1-253 bytes of
+// [A-Za-z0-9.-] (or a numeric IP), port is 1..65535.
+bool registry_addr_plausible(const std::string& addr) {
+  const size_t colon = addr.rfind(':');
+  if (colon == std::string::npos || colon == 0 || colon >= 254) return false;
+  for (size_t i = 0; i < colon; ++i) {
+    const char c = addr[i];
+    const bool ok = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+                    (c >= '0' && c <= '9') || c == '.' || c == '-';
+    if (!ok) return false;
+  }
+  if (colon + 1 >= addr.size() || addr.size() - colon - 1 > 5) return false;
+  long port = 0;
+  for (size_t i = colon + 1; i < addr.size(); ++i) {
+    if (addr[i] < '0' || addr[i] > '9') return false;
+    port = port * 10 + (addr[i] - '0');
+  }
+  return port >= 1 && port <= 65535;
+}
 
 void prune_locked(int64_t now_us) {
   for (auto it = g_table.begin(); it != g_table.end();) {
@@ -44,12 +63,12 @@ void register_handler(const HttpRequest& req, HttpResponse* resp) {
   const std::string addr = addr_v != nullptr ? addr_v->as_string() : "";
   // Validate before serving to every resolver: a garbage addr would fail
   // node parsing in every client on every refresh, and unbounded strings /
-  // entries are a memory hole on an open port.
-  tbutil::EndPoint ep;
-  if (addr.empty() || addr.size() > 256 ||
-      tbutil::str2endpoint(addr.c_str(), &ep) != 0) {
+  // entries are a memory hole on an open port. Hostnames are accepted
+  // SYNTACTICALLY (clients resolve them via hostname2endpoint) — the
+  // handler must not block on DNS.
+  if (!registry_addr_plausible(addr)) {
     resp->status = 400;
-    resp->body = "addr must be a valid ip:port\n";
+    resp->body = "addr must be host:port (port 1-65535)\n";
     return;
   }
   const tbutil::JsonValue* ttl_v = parsed->find("ttl_s");
