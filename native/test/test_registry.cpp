@@ -172,6 +172,10 @@ TEST_CASE(registry_end_to_end_naming) {
     tbutil::IOBuf req, resp;
     req.append("x");
     ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    if (cntl.Failed()) {
+      fprintf(stderr, "echo %d failed: code=%d %s\n", i, cntl.ErrorCode(),
+              cntl.ErrorText().c_str());
+    }
     ASSERT_FALSE(cntl.Failed());
     const std::string who = resp.to_string();
     if (seen.find(who) == std::string::npos) seen += who + ",";

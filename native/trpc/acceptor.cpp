@@ -72,6 +72,8 @@ void Acceptor::OnNewConnection(int fd, const tbutil::EndPoint& remote) {
     close(fd);
     return;
   }
+  TB_VLOG(2) << "accepted fd=" << fd << " sid=" << sid << " from "
+             << tbutil::endpoint2str(remote);
   std::lock_guard<std::mutex> lk(_conn_mu);
   if (_stopped) {
     // Raced with StopAccept's snapshot: this connection would leak past

@@ -28,23 +28,40 @@ void DispatchMessage(InputMessageBase* msg, bool server_side) {
 struct ProcessArg {
   InputMessageBase* msg;
   bool server_side;
+  Socket* sock;  // counted + ref'd when non-null (client-side dispatch)
 };
 
 void* ProcessThunk(void* argv) {
   auto* arg = static_cast<ProcessArg*>(argv);
   DispatchMessage(arg->msg, arg->server_side);
+  if (arg->sock != nullptr) {
+    arg->sock->EndDispatch();
+    arg->sock->Deref();
+  }
   delete arg;
   return nullptr;
 }
 
 }  // namespace
 
-void InputMessenger::ProcessInline(InputMessageBase* msg) {
+void InputMessenger::ProcessInline(Socket* s, InputMessageBase* msg) {
+  // No dispatch accounting here: in-place messages (stream frames) run
+  // UNDER the input claim, and the trailing message's count was taken at
+  // parse time (OnNewMessages) — its EndDispatch is the caller's job.
+  (void)s;
   DispatchMessage(msg, _server_side);
 }
 
-void InputMessenger::ProcessInFiber(InputMessageBase* msg) {
-  auto* arg = new ProcessArg{msg, _server_side};
+void InputMessenger::ProcessInFiber(Socket* s, InputMessageBase* msg) {
+  // The dispatch COUNT was taken at parse time, while the input claim was
+  // held (see OnNewMessages) — a later EOF input event is guaranteed to
+  // observe it. Here we only carry a ref so EndDispatch outlives recycling.
+  Socket* counted = nullptr;
+  if (!_server_side && s != nullptr) {
+    counted = s;
+    s->Ref();
+  }
+  auto* arg = new ProcessArg{msg, _server_side, counted};
   tbthread::fiber_t tid;
   if (tbthread::fiber_start_urgent(&tid, nullptr, ProcessThunk, arg) != 0) {
     ProcessThunk(arg);
@@ -133,6 +150,8 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
       break;
     }
     if (nr == 0) {
+      TB_VLOG(2) << "read EOF sid=" << s->id() << " buf="
+                 << s->read_buf().size() << " pending=" << (pending != nullptr);
       *defer_error = TRPC_EEOF;
       break;
     }
@@ -157,11 +176,16 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
       r.msg->protocol_index = proto_index;
       if (r.msg->process_in_place) {
         // Order-sensitive (stream frames): handle now, in parse order.
-        ProcessInline(r.msg);
+        ProcessInline(s, r.msg);
         continue;
       }
+      // Count the dispatch NOW, while this fiber still owns the input
+      // claim: an EOF event can only start after the claim is released,
+      // so it is guaranteed to see the count and wait for the delivery
+      // (client side). Ended by ProcessThunk / the ProcessEvent tail path.
+      if (!_server_side) s->BeginDispatch();
       if (pending != nullptr) {
-        ProcessInFiber(pending);
+        ProcessInFiber(s, pending);
       }
       pending = r.msg;
     }

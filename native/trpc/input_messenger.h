@@ -35,8 +35,11 @@ class InputMessenger {
   virtual InputMessageBase* OnNewMessages(Socket* s, int* defer_error);
 
   // Dispatch a parsed message (request or response per _server_side).
-  void ProcessInline(InputMessageBase* msg);
-  void ProcessInFiber(InputMessageBase* msg);
+  // `s` is the connection the message arrived on: client-side dispatches
+  // are counted on it (BeginDispatch/EndDispatch) so a deferred EOF can
+  // drain them before erroring the pending correlation ids.
+  void ProcessInline(Socket* s, InputMessageBase* msg);
+  void ProcessInFiber(Socket* s, InputMessageBase* msg);
 
   bool server_side() const { return _server_side; }
 

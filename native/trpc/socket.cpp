@@ -13,6 +13,7 @@
 #include <cstring>
 
 #include "tbthread/fiber.h"
+#include "tbthread/task_group.h"
 #include "tbutil/logging.h"
 #include "tbutil/object_pool.h"
 #include "tbutil/time.h"
@@ -120,6 +121,8 @@ int Socket::Address(SocketId id, SocketUniquePtr* out) {
 }
 
 int Socket::SetFailed(int error) {
+  TB_VLOG(2) << "SetFailed sid=" << id() << " fd=" << fd() << " err=" << error
+             << (server_side() ? " (server)" : " (client)");
   return VersionedRefWithId<Socket>::SetFailed(error);
 }
 
@@ -179,6 +182,7 @@ void Socket::OnRecycle() {
   delete _ssl.exchange(nullptr, std::memory_order_acq_rel);
   int fd = _fd.exchange(-1, std::memory_order_acq_rel);
   if (fd >= 0) {
+    TB_VLOG(2) << "recycle close fd=" << fd << " sid=" << id();
     EventDispatcher::shard(id()).RemoveConsumer(fd);
     close(fd);
   }
@@ -196,6 +200,7 @@ void Socket::OnRecycle() {
   _messenger = nullptr;
   _user = nullptr;
   _nevent.store(0, std::memory_order_relaxed);
+  _inflight_dispatch.store(0, std::memory_order_relaxed);
   // The write queue is drained by the active writer before it drops its ref,
   // so by the time the last ref dies the head is null (or was released by
   // ReleaseAllWrites on failure).
@@ -272,6 +277,7 @@ void Socket::StartWrite(WriteRequest* req) {
                                             std::memory_order_acq_rel)) {
       tbutil::return_object(req);
       if (_close_after_write.load(std::memory_order_acquire)) {
+        TB_VLOG(2) << "graceful close (inline) sid=" << id();
         SetFailed(TRPC_EEOF);  // graceful Connection: close
       }
       return;
@@ -344,6 +350,7 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
                                             std::memory_order_acq_rel)) {
       tbutil::return_object(last);
       if (_close_after_write.load(std::memory_order_acquire)) {
+        TB_VLOG(2) << "graceful close (keepwrite) sid=" << id();
         SetFailed(TRPC_EEOF);  // graceful Connection: close
       }
       return;
@@ -788,6 +795,25 @@ ssize_t Socket::DoRead(size_t size_hint) {
   return _read_buf.append_from_file_descriptor(fd, size_hint);
 }
 
+void Socket::WaitDispatchDrain() {
+  const int64_t deadline_us = tbutil::monotonic_time_us() + 500 * 1000;
+  for (int spins = 0;
+       _inflight_dispatch.load(std::memory_order_acquire) > 0; ++spins) {
+    if (spins < 64) {
+      tbthread::fiber_yield();
+    } else {
+      if (tbutil::monotonic_time_us() >= deadline_us) {
+        // A dispatched handler is parked long-term; proceeding may race a
+        // response delivery, but the other pending RPCs on this dead
+        // connection need their error sweep more.
+        TB_LOG(WARNING) << "dispatch drain timed out on sock " << id();
+        return;
+      }
+      tbthread::fiber_usleep(100);
+    }
+  }
+}
+
 void Socket::StartInputEvent(SocketId sid) {
   SocketUniquePtr s;
   if (Address(sid, &s) != 0) return;
@@ -818,7 +844,7 @@ void Socket::ProcessEvent() {
     if (!Failed() && defer_error == 0 && messenger != nullptr) {
       InputMessageBase* m = messenger->OnNewMessages(this, &defer_error);
       if (m != nullptr) {
-        if (tail != nullptr) messenger->ProcessInFiber(tail);
+        if (tail != nullptr) messenger->ProcessInFiber(this, tail);
         tail = m;
       }
     }
@@ -836,11 +862,18 @@ void Socket::ProcessEvent() {
   // run the trailing handler inline — if it parks (slow service method), it
   // blocks just this fiber, not the connection (no head-of-line blocking).
   if (tail != nullptr && messenger != nullptr) {
-    messenger->ProcessInline(tail);
+    messenger->ProcessInline(this, tail);
+    if (!_server_side) EndDispatch();  // counted at parse time
   }
   // EOF/read errors fail the socket only AFTER the response that rode in
-  // with them was delivered (respond-then-close peers).
+  // with them was delivered (respond-then-close peers). Same-event tails
+  // were just delivered above; responses read by a PREVIOUS input event
+  // may still be mid-dispatch on other fibers — wait those out on client
+  // sockets, or SetFailed's pending-id sweep errors an RPC whose response
+  // is already in hand (server side skips the wait: request handlers may
+  // park on this very socket's write queue).
   if (defer_error != 0) {
+    if (!_server_side) WaitDispatchDrain();
     SetFailed(defer_error);
   }
   Deref();

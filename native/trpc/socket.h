@@ -115,6 +115,15 @@ class Socket : public VersionedRefWithId<Socket> {
 
   // After the write queue fully drains, fail the socket (graceful
   // "Connection: close" semantics). One-way.
+  void BeginDispatch() {
+    _inflight_dispatch.fetch_add(1, std::memory_order_acq_rel);
+  }
+  void EndDispatch() {
+    _inflight_dispatch.fetch_sub(1, std::memory_order_acq_rel);
+  }
+  // Bounded-patience drain (EOF cleanup path only — never hot).
+  void WaitDispatchDrain();
+
   void MarkCloseAfterLastWrite() {
     _close_after_write.store(true, std::memory_order_release);
   }
@@ -222,6 +231,12 @@ class Socket : public VersionedRefWithId<Socket> {
   std::atomic<bool> _close_after_write{false};
   tbthread::Butex* _epollout_butex;
   std::atomic<int> _nevent{0};  // pending read edges; input fiber active while > 0
+  // Parsed messages handed to dispatch whose handlers have not returned
+  // yet. A deferred EOF on a CLIENT socket waits for this to hit zero
+  // before SetFailed — the respond-then-close race across two input
+  // events (response in event 1, EOF in event 2) must not error the
+  // correlation id while the response dispatch is still in flight.
+  std::atomic<int> _inflight_dispatch{0};
   // True from fd-publication until the non-blocking connect completes —
   // gates ConnectIfNot's lock-free fast path.
   std::atomic<bool> _connecting{false};
