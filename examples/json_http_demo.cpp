@@ -1,0 +1,81 @@
+// One service, two doors: a structured JSON method served simultaneously
+// as a binary tstd RPC and as a curl-able HTTP+JSON endpoint — the
+// reference's json2pb story (src/json2pb) in framework form
+// (trpc/json_service.h bridges both).
+#include <cstdio>
+#include <string>
+
+#include "tbutil/json.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/errno.h"
+#include "trpc/http_protocol.h"
+#include "trpc/json_service.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+using tbutil::JsonValue;
+
+int main() {
+  auto* stats = new JsonService("Stats");
+  stats->AddMethod("Summarize", [](const JsonValue& req, JsonValue* resp,
+                                   Controller* cntl) {
+    const JsonValue* values = req.find("values");
+    if (values == nullptr || !values->is_array() || values->items().empty()) {
+      cntl->SetFailed(TRPC_EREQUEST, "expected {\"values\": [numbers...]}");
+      return;
+    }
+    double sum = 0, mn = 0, mx = 0;
+    bool first = true;
+    for (const JsonValue& v : values->items()) {
+      const double x = v.as_double();
+      sum += x;
+      if (first || x < mn) mn = x;
+      if (first || x > mx) mx = x;
+      first = false;
+    }
+    *resp = JsonValue::Object();
+    resp->set("count", JsonValue(int64_t(values->size())));
+    resp->set("sum", JsonValue(sum));
+    resp->set("min", JsonValue(mn));
+    resp->set("max", JsonValue(mx));
+  });
+
+  Server server;
+  if (server.AddService(stats) != 0) return 1;
+  if (server.Start("127.0.0.1:0", nullptr) != 0) return 1;
+  const int port = server.listen_address().port;
+  printf("try: curl -d '{\"values\":[3,1,4]}' "
+         "http://127.0.0.1:%d/Stats/Summarize\n", port);
+
+  // Door 1: binary tstd RPC carrying JSON.
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+  Channel rpc;
+  if (rpc.Init(addr, nullptr) != 0) return 1;
+  Controller c1;
+  tbutil::IOBuf req1, resp1;
+  req1.append("{\"values\":[3,1,4,1,5,9,2,6]}");
+  rpc.CallMethod("Stats/Summarize", &c1, req1, &resp1, nullptr);
+  if (c1.Failed()) return 1;
+  printf("tstd door: %s\n", resp1.to_string().c_str());
+
+  // Door 2: the same method over HTTP+JSON (what curl would do).
+  Channel http;
+  ChannelOptions hopts;
+  hopts.protocol = kHttpProtocolIndex;
+  if (http.Init(addr, &hopts) != 0) return 1;
+  Controller c2;
+  tbutil::IOBuf req2, resp2;
+  req2.append("{\"values\":[10,20,30]}");
+  http.CallMethod("Stats/Summarize", &c2, req2, &resp2, nullptr);
+  if (c2.Failed()) return 1;
+  printf("http door: %s\n", resp2.to_string().c_str());
+
+  auto parsed = JsonValue::Parse(resp2.to_string());
+  const bool ok = parsed && parsed->find("sum") != nullptr &&
+                  parsed->find("sum")->as_double() == 60.0;
+  server.Stop();
+  printf(ok ? "json http demo OK\n" : "json http demo FAILED\n");
+  return ok ? 0 : 1;
+}

@@ -1,0 +1,78 @@
+// Log tailing over HTTP server push: the /tail response never ends — lines
+// keep flowing through a ProgressiveAttachment until the server closes it
+// (reference progressive_attachment.h; example shape: curl keeps printing).
+// The demo tails its own endpoint with a raw socket client and shows the
+// chunks arriving AFTER the response headers went out.
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <memory>
+#include <string>
+#include <thread>
+
+#include "trpc/http_protocol.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+int main() {
+  static std::shared_ptr<ProgressiveAttachment> g_tail;
+  RegisterHttpHandler("/tail", [](const HttpRequest&, HttpResponse* resp) {
+    resp->content_type = "text/plain";
+    resp->body = "tail begins\n";
+    resp->progressive = std::make_shared<ProgressiveAttachment>();
+    g_tail = resp->progressive;
+  });
+
+  Server server;
+  if (server.Start("127.0.0.1:0", nullptr) != 0) return 1;
+  const int port = server.listen_address().port;
+  printf("try: curl http://127.0.0.1:%d/tail\n", port);
+
+  // Pusher: a "log line" every 50ms, then close.
+  std::thread pusher([] {
+    while (g_tail == nullptr) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+    for (int i = 1; i <= 8; ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      g_tail->Write("log line " + std::to_string(i) + "\n");
+    }
+    g_tail->Close();
+  });
+
+  // Raw client: GET, then read until the server terminates the stream.
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    return 1;
+  }
+  const char req[] = "GET /tail HTTP/1.1\r\nHost: x\r\n\r\n";
+  ::send(fd, req, sizeof(req) - 1, 0);
+  std::string wire;
+  char buf[4096];
+  while (true) {
+    const ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) break;
+    wire.append(buf, static_cast<size_t>(n));
+  }
+  ::close(fd);
+  pusher.join();
+  server.Stop();
+
+  int lines = 0;
+  for (int i = 1; i <= 8; ++i) {
+    if (wire.find("log line " + std::to_string(i)) != std::string::npos) {
+      ++lines;
+    }
+  }
+  printf("received %d/8 pushed lines over one chunked response\n", lines);
+  printf(lines == 8 ? "progressive tail demo OK\n"
+                    : "progressive tail demo FAILED\n");
+  return lines == 8 ? 0 : 1;
+}

@@ -1,0 +1,91 @@
+// A key-value store served over the redis protocol (RESP) by a trpc
+// Server, driven by the framework's own redis client — and reachable from
+// any stock redis-cli. Mirrors the reference's example/redis_c++ server
+// mode (RedisService in redis.h; the same port still answers tstd/HTTP).
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/redis_protocol.h"
+#include "trpc/server.h"
+
+using namespace trpc;
+
+namespace {
+
+class KvService : public RedisService {
+ public:
+  void OnCommand(const std::vector<std::string>& args,
+                 RedisReply* reply) override {
+    std::lock_guard<std::mutex> lk(_mu);
+    const std::string& cmd = args[0];
+    if (cmd == "PING") {
+      reply->type = RedisReply::Type::kStatus;
+      reply->str = "PONG";
+    } else if (cmd == "SET" && args.size() == 3) {
+      _kv[args[1]] = args[2];
+      reply->type = RedisReply::Type::kStatus;
+      reply->str = "OK";
+    } else if (cmd == "GET" && args.size() == 2) {
+      auto it = _kv.find(args[1]);
+      if (it == _kv.end()) {
+        reply->type = RedisReply::Type::kNil;
+      } else {
+        reply->type = RedisReply::Type::kString;
+        reply->str = it->second;
+      }
+    } else {
+      reply->type = RedisReply::Type::kError;
+      reply->str = "ERR unknown command '" + cmd + "'";
+    }
+  }
+
+ private:
+  std::mutex _mu;
+  std::map<std::string, std::string> _kv;
+};
+
+}  // namespace
+
+int main() {
+  KvService kv;
+  Server server;
+  ServerOptions opts;
+  opts.redis_service = &kv;
+  if (server.Start("127.0.0.1:0", &opts) != 0) return 1;
+  const int port = server.listen_address().port;
+  printf("redis kv server on 127.0.0.1:%d (try: redis-cli -p %d PING)\n",
+         port, port);
+
+  Channel ch;
+  ChannelOptions copts;
+  copts.protocol = kRedisProtocolIndex;
+  copts.timeout_ms = 2000;
+  char addr[32];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+  if (ch.Init(addr, &copts) != 0) return 1;
+
+  // One pipelined round trip: PING, SET, GET.
+  RedisRequest req;
+  req.AddCommand(std::vector<std::string>{"PING"});
+  req.AddCommand(std::vector<std::string>{"SET", "answer", "42"});
+  req.AddCommand(std::vector<std::string>{"GET", "answer"});
+  RedisResponse resp;
+  Controller cntl;
+  if (RedisExecute(ch, &cntl, req, &resp) != 0) {
+    fprintf(stderr, "redis call failed: %s\n", cntl.ErrorText().c_str());
+    return 1;
+  }
+  for (size_t i = 0; i < resp.reply_count(); ++i) {
+    printf("reply %zu: %s\n", i, resp.reply(i).str.c_str());
+  }
+  const bool ok = resp.reply_count() == 3 && resp.reply(0).str == "PONG" &&
+                  resp.reply(2).str == "42";
+  server.Stop();
+  printf(ok ? "redis kv demo OK\n" : "redis kv demo FAILED\n");
+  return ok ? 0 : 1;
+}

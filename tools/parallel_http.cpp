@@ -1,0 +1,163 @@
+// parallel_http: fetch many URLs concurrently over fibers and report
+// status/size/latency per URL (reference tools/parallel_http — mass-fetch
+// with high concurrency from one process).
+//
+// Usage:
+//   parallel_http [--concurrency=N] [--timeout_ms=T] URL...
+//   parallel_http --url_file=FILE          (one URL per line, # comments)
+//
+// URL form: HOST:PORT[/PATH] (http:// prefix optional, TLS via tls://).
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tbthread/fiber.h"
+#include "tbthread/sync.h"
+#include "tbutil/string_utils.h"
+#include "tbutil/time.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/http_protocol.h"
+
+using namespace trpc;
+
+namespace {
+
+struct Fetch {
+  std::string url;      // as given
+  std::string hostport;
+  std::string path;     // without leading '/'
+  bool tls = false;
+  int status = -1;      // 0 ok, else errno
+  size_t bytes = 0;
+  int64_t latency_us = 0;
+};
+
+bool split_url(const std::string& raw, Fetch* f) {
+  std::string u = raw;
+  f->url = raw;
+  if (u.rfind("http://", 0) == 0) u = u.substr(7);
+  if (u.rfind("tls://", 0) == 0) {
+    f->tls = true;
+    u = u.substr(6);
+  } else if (u.rfind("https://", 0) == 0) {
+    f->tls = true;
+    u = u.substr(8);
+  }
+  const size_t slash = u.find('/');
+  f->hostport = slash == std::string::npos ? u : u.substr(0, slash);
+  f->path = slash == std::string::npos ? "" : u.substr(slash + 1);
+  return !f->hostport.empty();
+}
+
+struct Job {
+  Fetch* fetch;
+  int timeout_ms;
+  tbthread::CountdownEvent* done;
+  tbthread::FiberSemaphore* gate;
+};
+
+void* fetch_one(void* arg) {
+  auto* job = static_cast<Job*>(arg);
+  Fetch& f = *job->fetch;
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kHttpProtocolIndex;
+  opts.timeout_ms = job->timeout_ms;
+  opts.max_retry = 0;
+  const std::string target =
+      (f.tls ? std::string("tls://") : std::string()) + f.hostport;
+  const int64_t t0 = tbutil::monotonic_time_us();
+  if (ch.Init(target.c_str(), &opts) != 0) {
+    f.status = -2;
+  } else {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    ch.CallMethod(f.path, &cntl, req, &resp, nullptr);
+    f.status = cntl.Failed() ? cntl.ErrorCode() : 0;
+    f.bytes = resp.size();
+  }
+  f.latency_us = tbutil::monotonic_time_us() - t0;
+  job->done->signal();
+  delete job;
+  return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int concurrency = 16;
+  int timeout_ms = 5000;
+  std::vector<Fetch> fetches;
+  for (int i = 1; i < argc; ++i) {
+    if (strncmp(argv[i], "--concurrency=", 14) == 0) {
+      concurrency = atoi(argv[i] + 14);
+    } else if (strncmp(argv[i], "--timeout_ms=", 13) == 0) {
+      timeout_ms = atoi(argv[i] + 13);
+    } else if (strncmp(argv[i], "--url_file=", 11) == 0) {
+      FILE* fp = fopen(argv[i] + 11, "r");
+      if (fp == nullptr) {
+        fprintf(stderr, "cannot open %s\n", argv[i] + 11);
+        return 1;
+      }
+      char line[1024];
+      while (fgets(line, sizeof(line), fp) != nullptr) {
+        const std::string_view t = tbutil::trim_whitespace(line);
+        if (t.empty() || t[0] == '#') continue;
+        Fetch f;
+        if (split_url(std::string(t), &f)) fetches.push_back(std::move(f));
+      }
+      fclose(fp);
+    } else if (argv[i][0] == '-') {
+      fprintf(stderr,
+              "usage: parallel_http [--concurrency=N] [--timeout_ms=T] "
+              "[--url_file=F] URL...\n");
+      return 1;
+    } else {
+      Fetch f;
+      if (split_url(argv[i], &f)) fetches.push_back(std::move(f));
+    }
+  }
+  if (fetches.empty()) {
+    fprintf(stderr, "no URLs given\n");
+    return 1;
+  }
+  if (concurrency < 1) concurrency = 1;
+
+  const int64_t t0 = tbutil::monotonic_time_us();
+  // Sliding window of `concurrency` in-flight fetches, each on a fiber.
+  tbthread::CountdownEvent all(static_cast<int>(fetches.size()));
+  tbthread::FiberSemaphore gate(concurrency);
+  for (Fetch& f : fetches) {
+    gate.wait();
+    auto* job = new Job{&f, timeout_ms, &all, &gate};
+    tbthread::fiber_t tid;
+    tbthread::fiber_start_background(
+        &tid, nullptr,
+        [](void* a) -> void* {
+          auto* g = static_cast<Job*>(a)->gate;
+          fetch_one(a);  // deletes the Job
+          g->post();
+          return nullptr;
+        },
+        job);
+  }
+  all.wait();
+  const double wall_ms = (tbutil::monotonic_time_us() - t0) / 1000.0;
+
+  size_t ok = 0, total_bytes = 0;
+  for (const Fetch& f : fetches) {
+    if (f.status == 0) {
+      ++ok;
+      total_bytes += f.bytes;
+    }
+    printf("%-50s %s bytes=%zu latency=%.1fms\n", f.url.c_str(),
+           f.status == 0 ? "OK  " : tbutil::string_printf("E%d ", f.status)
+                                        .c_str(),
+           f.bytes, f.latency_us / 1000.0);
+  }
+  printf("%zu/%zu ok, %zu bytes, wall %.1fms (concurrency %d)\n", ok,
+         fetches.size(), total_bytes, wall_ms, concurrency);
+  return ok == fetches.size() ? 0 : 2;
+}
