@@ -1,0 +1,124 @@
+// trackme end to end: a server hosting the bug registry, a client pinger
+// reporting its version over the real wire, severity surfacing as logs,
+// and the server-driven interval retune (reference trackme.{h,cpp,proto} +
+// tools/trackme_server BugsLoader semantics).
+#include <atomic>
+#include <string>
+#include <vector>
+
+#include "mini_test.h"
+#include "tbutil/logging.h"
+#include "tbthread/fiber.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/http_protocol.h"
+#include "trpc/server.h"
+#include "trpc/trackme.h"
+
+using namespace trpc;
+
+namespace {
+
+struct LogCounter : tbutil::LogSinkIf {
+  std::atomic<int> warnings{0};
+  std::atomic<int> errors{0};
+  std::string last;
+  bool OnLogMessage(int severity, const char*, int, const char* msg,
+                    size_t len) override {
+    if (severity == tbutil::LOG_WARNING) warnings.fetch_add(1);
+    if (severity == tbutil::LOG_ERROR) errors.fetch_add(1);
+    last.assign(msg, len);
+    return true;
+  }
+};
+
+}  // namespace
+
+TEST_CASE(trackme_end_to_end) {
+  TrackMeServer::ClearBugs();
+  TrackMeServer::Install();
+  Server server;
+  ASSERT_EQ(server.Start("127.0.0.1:0", nullptr), 0);
+  char addr[64];
+  snprintf(addr, sizeof(addr), "127.0.0.1:%d", server.listen_address().port);
+
+  // Clean version: severity OK, no logs.
+  LogCounter logs;
+  tbutil::LogSinkIf* old_sink = tbutil::SetLogSink(&logs);
+  TrackMePinger clean;
+  ASSERT_EQ(clean.Start(addr, "10.0.0.9:8000", /*interval_s=*/3600), 0);
+  ASSERT_EQ(clean.pings(), 1);  // first report is synchronous
+  ASSERT_EQ(clean.last_severity(), (int)kTrackMeOk);
+  ASSERT_EQ(logs.warnings.load(), 0);
+  ASSERT_EQ(logs.errors.load(), 0);
+  clean.Stop();
+
+  // Our version lands in a WARNING range and a non-matching FATAL range.
+  TrackMeServer::AddBugRange(1, kFrameworkVersion + 10, kTrackMeWarning,
+                             "upgrade: correlation-id bug in this range");
+  TrackMeServer::AddBugRange(1000, 2000, kTrackMeFatal, "not us");
+  TrackMePinger warned;
+  ASSERT_EQ(warned.Start(addr, "10.0.0.9:8000", 3600), 0);
+  ASSERT_EQ(warned.last_severity(), (int)kTrackMeWarning);
+  ASSERT_EQ(logs.warnings.load(), 1);
+  ASSERT_TRUE(logs.last.find("correlation-id bug") != std::string::npos);
+  warned.Stop();
+
+  // Overlapping FATAL range wins (worst severity) and logs an ERROR.
+  TrackMeServer::AddBugRange(kFrameworkVersion, kFrameworkVersion,
+                             kTrackMeFatal, "critical: do not deploy");
+  TrackMePinger doomed;
+  ASSERT_EQ(doomed.Start(addr, "10.0.0.9:8000", 3600), 0);
+  ASSERT_EQ(doomed.last_severity(), (int)kTrackMeFatal);
+  ASSERT_EQ(logs.errors.load(), 1);
+  doomed.Stop();
+  tbutil::SetLogSink(old_sink);
+
+  // Server-driven cadence: new_interval reaches the pinger and a short
+  // interval produces follow-up reports.
+  TrackMeServer::ClearBugs();
+  TrackMeServer::SetReportingInterval(1);
+  TrackMePinger fast;
+  const int64_t before = TrackMeServer::report_count();
+  ASSERT_EQ(fast.Start(addr, "10.0.0.9:8000", /*interval_s=*/3600), 0);
+  // First ping adopted new_interval=1s; within ~3s at least one more lands.
+  for (int i = 0; i < 40 && fast.pings() < 2; ++i) {
+    tbthread::fiber_usleep(100 * 1000);
+  }
+  ASSERT_TRUE(fast.pings() >= 2);
+  ASSERT_TRUE(TrackMeServer::report_count() >= before + 2);
+  fast.Stop();
+
+  // Double start refused.
+  TrackMePinger dup;
+  ASSERT_EQ(dup.Start(addr, "x", 3600), 0);
+  ASSERT_EQ(dup.Start(addr, "x", 3600), -1);
+  dup.Stop();
+
+  // Malformed reports get a 400, not a crash: junk body, JSON without a
+  // version, and a negative version.
+  {
+    Channel http;
+    ChannelOptions copts;
+    copts.protocol = kHttpProtocolIndex;
+    ASSERT_EQ(http.Init(addr, &copts), 0);
+    const int64_t count_before_bad = TrackMeServer::report_count();
+    for (const char* bad :
+         {"not json at all", "{\"server_addr\":\"x\"}", "{\"version\":-7}"}) {
+      Controller cntl;
+      tbutil::IOBuf req, resp;
+      req.append(bad);
+      http.CallMethod("trackme", &cntl, req, &resp, nullptr);
+      // The HTTP client maps non-2xx to a failed RPC; either way the
+      // server answered (no crash) and did not count a report.
+      ASSERT_TRUE(cntl.Failed() ||
+                  resp.to_string().find("expected") != std::string::npos);
+    }
+    ASSERT_EQ(TrackMeServer::report_count(), count_before_bad);
+  }
+
+  server.Stop();
+  TrackMeServer::ClearBugs();
+}
+
+TEST_MAIN

@@ -1,0 +1,57 @@
+#include "trpc/periodic_reporter.h"
+
+#include <chrono>
+
+#include "tbutil/fast_rand.h"
+#include "tbutil/logging.h"
+
+namespace trpc {
+
+PeriodicReporter::~PeriodicReporter() {
+  // The loop must already be stopped by the subclass destructor: stopping
+  // here would run after the subclass' members (which TickOnce uses) are
+  // gone. Catch violations loudly in debug runs.
+  if (_thread.joinable()) {
+    TB_LOG(ERROR) << "PeriodicReporter subclass destroyed without StopLoop()";
+    StopLoop();
+  }
+}
+
+int PeriodicReporter::StartLoop(const std::function<void()>& configure) {
+  std::lock_guard<std::mutex> lk(_lifecycle_mu);
+  if (_thread.joinable()) {
+    TB_LOG(ERROR) << "periodic reporter already started; Stop() first";
+    return -1;
+  }
+  if (configure) configure();
+  _stop.store(false);
+  TickOnce();  // prime state before returning (tests and callers rely on it)
+  _thread = std::thread([this] { Run(); });
+  return 0;
+}
+
+void PeriodicReporter::StopLoop() {
+  std::lock_guard<std::mutex> lk(_lifecycle_mu);
+  if (!_thread.joinable()) return;
+  _stop.store(true);
+  _thread.join();
+}
+
+void PeriodicReporter::Run() {
+  while (!_stop.load(std::memory_order_relaxed)) {
+    // ±25% jitter so a fleet of reporters doesn't tick in lockstep.
+    const int64_t base_ms = interval_ms();
+    const int64_t sleep_ms =
+        base_ms * 3 / 4 +
+        static_cast<int64_t>(tbutil::fast_rand_less_than(
+            static_cast<uint64_t>(base_ms) / 2 + 1));
+    for (int64_t waited = 0; waited < sleep_ms && !_stop.load();
+         waited += 50) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    if (_stop.load()) break;
+    TickOnce();
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,47 @@
+// Shared machinery for background reporters that tick a small RPC on a
+// jittered interval (registry heartbeats, trackme version reports).
+// One place owns the thread lifecycle (mutex-guarded start/stop — a
+// concurrent double Start must refuse, not std::terminate on the joinable
+// thread assignment), the ±25% fleet-decorrelating jitter, and the 50ms
+// chunked stop-responsive sleep.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <mutex>
+#include <thread>
+
+namespace trpc {
+
+class PeriodicReporter {
+ public:
+  virtual ~PeriodicReporter();  // subclasses: call StopLoop() in YOUR dtor
+                                // (TickOnce must not run mid-destruction)
+
+  PeriodicReporter() = default;
+  PeriodicReporter(const PeriodicReporter&) = delete;
+  PeriodicReporter& operator=(const PeriodicReporter&) = delete;
+
+ protected:
+  // Refuses (-1) if already running; otherwise runs `configure` UNDER the
+  // lifecycle lock (the only safe place to write subclass config — no loop
+  // thread exists yet and concurrent Starts are serialized), ticks once
+  // inline (so state is primed when StartLoop returns), then keeps ticking
+  // on a jittered interval_ms() cadence until StopLoop.
+  int StartLoop(const std::function<void()>& configure = nullptr);
+  // Joins the loop. Safe to call repeatedly / concurrently / when never
+  // started.
+  void StopLoop();
+
+  virtual void TickOnce() = 0;
+  virtual int64_t interval_ms() const = 0;
+
+ private:
+  void Run();
+
+  std::mutex _lifecycle_mu;
+  std::thread _thread;
+  std::atomic<bool> _stop{false};
+};
+
+}  // namespace trpc

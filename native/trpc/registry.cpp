@@ -1,8 +1,5 @@
 #include "trpc/registry.h"
 
-#include <chrono>
-
-#include "tbutil/fast_rand.h"
 #include "tbutil/json.h"
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
@@ -163,7 +160,22 @@ void RegistryService::clear() {
 
 // ---------------- client ----------------
 
-RegistryClient::~RegistryClient() { Stop(); }
+RegistryClient::~RegistryClient() { Stop(); }  // header contract:
+                                               // deregisters on destruction
+
+void RegistryClient::TickOnce() {
+  if (SendOnce("register") == 0) {
+    _beats.fetch_add(1, std::memory_order_relaxed);
+    _unreachable.store(false, std::memory_order_relaxed);
+  } else if (!_unreachable.exchange(true, std::memory_order_relaxed)) {
+    // Log the TRANSITION only — a multi-hour outage must not produce a
+    // warning per heartbeat per client. Retries continue silently; the
+    // registry may come up after us (the reference's discovery
+    // registration behaves the same).
+    TB_LOG(WARNING) << "registry " << _registry
+                    << " unreachable; will keep heartbeating";
+  }
+}
 
 int RegistryClient::SendOnce(const char* op) {
   Channel ch;
@@ -186,51 +198,22 @@ int RegistryClient::SendOnce(const char* op) {
 int RegistryClient::Start(const std::string& registry_hostport,
                           const std::string& addr, const std::string& tag,
                           int ttl_s) {
-  if (_thread.joinable()) {
-    TB_LOG(ERROR) << "RegistryClient already started; Stop() first";
-    return -1;
-  }
-  if (ttl_s < 1) ttl_s = 1;
-  _registry = registry_hostport;
-  _addr = addr;
-  _tag = tag;
-  _ttl_s = ttl_s;
-  if (SendOnce("register") != 0) {
-    // Keep trying in the background — the registry may come up after us
-    // (the reference's discovery registration retries the same way).
-    TB_LOG(WARNING) << "registry " << _registry
-                    << " unreachable; will keep heartbeating";
-  } else {
-    _beats.fetch_add(1, std::memory_order_relaxed);
-  }
-  _stop.store(false);
-  _thread = std::thread([this] { Run(); });
-  return 0;
-}
-
-void RegistryClient::Run() {
-  // Heartbeat at ttl/3 so two consecutive losses still leave the entry
-  // alive; ±25% jitter decorrelates a fleet.
-  while (!_stop.load(std::memory_order_relaxed)) {
-    const int base_ms = _ttl_s * 1000 / 3 + 1;
-    const int sleep_ms =
-        base_ms * 3 / 4 + static_cast<int>(tbutil::fast_rand_less_than(
-                              static_cast<uint64_t>(base_ms) / 2 + 1));
-    for (int waited = 0; waited < sleep_ms && !_stop.load(); waited += 50) {
-      std::this_thread::sleep_for(std::chrono::milliseconds(50));
-    }
-    if (_stop.load()) break;
-    if (SendOnce("register") == 0) {
-      _beats.fetch_add(1, std::memory_order_relaxed);
-    }
-  }
+  // Config writes happen inside StartLoop's lifecycle lock: a refused
+  // double Start must not retarget (or data-race with) a live heartbeat.
+  return StartLoop([&] {
+    _registry = registry_hostport;
+    _addr = addr;
+    _tag = tag;
+    _ttl_s = ttl_s < 1 ? 1 : ttl_s;
+    _started.store(true, std::memory_order_relaxed);
+  });
 }
 
 void RegistryClient::Stop() {
-  if (!_thread.joinable()) return;
-  _stop.store(true);
-  _thread.join();
-  SendOnce("deregister");
+  StopLoop();
+  if (_started.exchange(false, std::memory_order_relaxed)) {
+    SendOnce("deregister");  // once per Start; never for a never-started client
+  }
 }
 
 }  // namespace trpc

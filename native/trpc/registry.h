@@ -19,8 +19,9 @@
 #include <cstdint>
 #include <mutex>
 #include <string>
-#include <thread>
 #include <map>
+
+#include "trpc/periodic_reporter.h"
 
 namespace trpc {
 
@@ -38,10 +39,10 @@ class RegistryService {
 
 // Client side: keep one address registered with heartbeats at ttl/3.
 // Deregisters on Stop()/destruction.
-class RegistryClient {
+class RegistryClient : public PeriodicReporter {
  public:
   RegistryClient() = default;
-  ~RegistryClient();
+  ~RegistryClient() override;
 
   // registry_hostport: "ip:port" of the server running RegistryService.
   // addr: the address to advertise (usually this server's listen address).
@@ -53,16 +54,19 @@ class RegistryClient {
   int64_t beats() const { return _beats.load(std::memory_order_relaxed); }
 
  private:
-  void Run();
+  void TickOnce() override;
+  // Heartbeat at ttl/3: two consecutive losses still leave the entry
+  // alive (the jitter rides in PeriodicReporter).
+  int64_t interval_ms() const override { return _ttl_s * 1000 / 3 + 1; }
   int SendOnce(const char* op);
 
   std::string _registry;
   std::string _addr;
   std::string _tag;
   int _ttl_s = 10;
-  std::thread _thread;
-  std::atomic<bool> _stop{false};
   std::atomic<int64_t> _beats{0};
+  std::atomic<bool> _started{false};      // gates the deregister-on-Stop
+  std::atomic<bool> _unreachable{false};  // warn on transition only
 };
 
 }  // namespace trpc

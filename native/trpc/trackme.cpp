@@ -1,0 +1,160 @@
+#include "trpc/trackme.h"
+
+#include <mutex>
+#include <vector>
+
+#include "tbutil/json.h"
+#include "tbutil/logging.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/http_protocol.h"
+
+namespace trpc {
+
+namespace {
+
+struct BugRange {
+  int64_t min_version;
+  int64_t max_version;
+  int severity;
+  std::string error_text;
+};
+
+std::mutex g_mu;
+std::vector<BugRange> g_bugs;
+int g_reporting_interval = 0;
+std::atomic<int64_t> g_reports{0};
+
+void trackme_handler(const HttpRequest& req, HttpResponse* resp) {
+  int64_t version = -1;
+  auto parsed = tbutil::JsonValue::Parse(req.body.to_string());
+  if (parsed && parsed->is_object()) {
+    if (const tbutil::JsonValue* v = parsed->find("version")) {
+      version = v->as_int(-1);
+    }
+  }
+  if (version < 0) {
+    resp->status = 400;
+    resp->body = "expected {\"version\":N,...}\n";
+    return;
+  }
+  g_reports.fetch_add(1, std::memory_order_relaxed);
+  // Worst matching severity wins (a version can sit in several ranges).
+  int severity = kTrackMeOk;
+  std::string text;
+  int interval = 0;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    for (const BugRange& b : g_bugs) {
+      if (version >= b.min_version && version <= b.max_version &&
+          b.severity > severity) {
+        severity = b.severity;
+        text = b.error_text;
+      }
+    }
+    interval = g_reporting_interval;
+  }
+  tbutil::JsonValue out = tbutil::JsonValue::Object();
+  out.set("severity", tbutil::JsonValue(int64_t{severity}));
+  if (!text.empty()) out.set("error_text", text);
+  if (interval > 0) out.set("new_interval", tbutil::JsonValue(int64_t{interval}));
+  resp->content_type = "application/json";
+  resp->body = out.Dump();
+}
+
+}  // namespace
+
+void TrackMeServer::Install() {
+  static std::once_flag once;
+  std::call_once(once, [] { RegisterHttpHandler("/trackme", trackme_handler); });
+}
+
+void TrackMeServer::AddBugRange(int64_t min_version, int64_t max_version,
+                                int severity, const std::string& error_text) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_bugs.push_back({min_version, max_version, severity, error_text});
+}
+
+void TrackMeServer::SetReportingInterval(int seconds) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_reporting_interval = seconds;
+}
+
+void TrackMeServer::ClearBugs() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_bugs.clear();
+  g_reporting_interval = 0;
+}
+
+int64_t TrackMeServer::report_count() {
+  return g_reports.load(std::memory_order_relaxed);
+}
+
+// ---- client ----
+
+TrackMePinger::~TrackMePinger() { StopLoop(); }
+
+void TrackMePinger::TickOnce() {
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kHttpProtocolIndex;
+  opts.timeout_ms = 2000;
+  opts.max_retry = 0;  // the next ping IS the retry
+  if (ch.Init(_server.c_str(), &opts) != 0) return;
+  tbutil::JsonValue body = tbutil::JsonValue::Object();
+  body.set("version", tbutil::JsonValue(kFrameworkVersion));
+  body.set("server_addr", _self);
+  tbutil::IOBuf req, respb;
+  req.append(body.Dump());
+  Controller cntl;
+  ch.CallMethod("trackme", &cntl, req, &respb, nullptr);
+  if (cntl.Failed()) return;
+  auto parsed = tbutil::JsonValue::Parse(respb.to_string());
+  if (!parsed || !parsed->is_object()) return;
+  int severity = kTrackMeOk;
+  if (const tbutil::JsonValue* v = parsed->find("severity")) {
+    severity = static_cast<int>(v->as_int(0));
+  }
+  std::string text;
+  if (const tbutil::JsonValue* v = parsed->find("error_text")) {
+    text = v->as_string();
+  }
+  if (const tbutil::JsonValue* v = parsed->find("new_interval")) {
+    const int ni = static_cast<int>(v->as_int(0));
+    if (ni >= 1 && ni <= 24 * 3600) {
+      _interval_s.store(ni, std::memory_order_relaxed);
+    }
+  }
+  _last_severity.store(severity, std::memory_order_relaxed);
+  // Reference semantics: FATAL -> ERROR log, WARNING -> WARNING log,
+  // OK -> silence (trackme.proto response contract).
+  if (severity >= kTrackMeFatal) {
+    TB_LOG(ERROR) << "trackme: " << text;
+  } else if (severity == kTrackMeWarning) {
+    TB_LOG(WARNING) << "trackme: " << text;
+  }
+  _pings.fetch_add(1, std::memory_order_relaxed);
+}
+
+int TrackMePinger::Start(const std::string& trackme_hostport,
+                         const std::string& self_addr, int interval_s) {
+  // Config writes inside StartLoop's lifecycle lock: a refused double
+  // Start must not retarget (or data-race with) the live reporter.
+  return StartLoop([&] {
+    _server = trackme_hostport;
+    _self = self_addr;
+    _interval_s.store(interval_s < 1 ? 1 : interval_s,
+                      std::memory_order_relaxed);
+  });
+}
+
+void SetTrackMeAddress(const std::string& hostport,
+                       const std::string& self_addr) {
+  static std::mutex mu;  // serialize concurrent retargets
+  std::lock_guard<std::mutex> lk(mu);
+  static TrackMePinger* pinger = new TrackMePinger;  // immortal
+  pinger->Stop();
+  pinger->Start(hostport, self_addr);
+}
+
+}  // namespace trpc

@@ -1,0 +1,79 @@
+// trackme: deployed clients periodically report their framework version to
+// a central server, which answers with a severity + message when that
+// version carries known bugs ("your build has a critical correlation-id
+// bug, upgrade") and can retune the reporting interval.
+// Capability parity: reference src/brpc/trackme.{h,cpp,proto} +
+// tools/trackme_server (BugsLoader matching revision ranges). Ours rides
+// JSON over the builtin HTTP port instead of a pb service:
+//   POST /trackme {"version":N,"server_addr":"ip:port"}
+//     -> {"severity":0|1|2,"error_text":"...","new_interval":S}
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+#include "trpc/periodic_reporter.h"
+
+namespace trpc {
+
+// Version stamp reported by this build (bumped per release round).
+inline constexpr int64_t kFrameworkVersion = 4;
+
+enum TrackMeSeverity {
+  kTrackMeOk = 0,
+  kTrackMeWarning = 1,
+  kTrackMeFatal = 2,
+};
+
+// ---- server half: the bug registry + /trackme handler ----
+class TrackMeServer {
+ public:
+  // Registers the /trackme HTTP handler (idempotent, process-global).
+  static void Install();
+  // Versions in [min_version, max_version] answer with this severity/text
+  // (reference BugsLoader's RevisionInfo rows).
+  static void AddBugRange(int64_t min_version, int64_t max_version,
+                          int severity, const std::string& error_text);
+  // Ask clients to report every `seconds` (0 = leave client default).
+  static void SetReportingInterval(int seconds);
+  static void ClearBugs();  // tests
+  static int64_t report_count();
+};
+
+// ---- client half: the periodic reporter ----
+class TrackMePinger : public PeriodicReporter {
+ public:
+  TrackMePinger() = default;
+  ~TrackMePinger() override;
+
+  // trackme_hostport: where TrackMeServer lives. self_addr: advertised in
+  // reports. interval_s: initial cadence (server's new_interval overrides).
+  int Start(const std::string& trackme_hostport,
+            const std::string& self_addr, int interval_s = 300);
+  void Stop() { StopLoop(); }
+
+  int64_t pings() const { return _pings.load(std::memory_order_relaxed); }
+  int last_severity() const {
+    return _last_severity.load(std::memory_order_relaxed);
+  }
+
+ private:
+  void TickOnce() override;
+  int64_t interval_ms() const override {
+    return int64_t{_interval_s.load(std::memory_order_relaxed)} * 1000;
+  }
+
+  std::string _server;
+  std::string _self;
+  std::atomic<int> _interval_s{300};
+  std::atomic<int64_t> _pings{0};
+  std::atomic<int> _last_severity{kTrackMeOk};
+};
+
+// Reference-parity convenience: start (or retarget) a process-global
+// pinger, the way -trackme_server + TrackMe() work in the reference.
+void SetTrackMeAddress(const std::string& hostport,
+                       const std::string& self_addr);
+
+}  // namespace trpc
