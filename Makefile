@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test clean
+.PHONY: native test asan tsan clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -9,5 +9,22 @@ native:
 test: native
 	python -m pytest tests/ -x -q
 
+# Sanitizer trees. The fiber runtime carries the required annotations
+# (tbthread/sanitizer_fiber.h): ASan gets start/finish_switch_fiber around
+# every context jump; TSan gets per-fiber contexts + switch notifications,
+# making -fsanitize=thread usable for real race hunting over fibers.
+asan:
+	cmake -S native -B native/build-asan -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo \
+	  -DCMAKE_CXX_FLAGS="-fsanitize=address -fno-omit-frame-pointer" \
+	  -DCMAKE_EXE_LINKER_FLAGS="-fsanitize=address" >/dev/null
+	cmake --build native/build-asan
+
+tsan:
+	cmake -S native -B native/build-tsan -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo \
+	  -DCMAKE_CXX_FLAGS="-fsanitize=thread -fno-omit-frame-pointer" \
+	  -DCMAKE_CXX_FLAGS_RELWITHDEBINFO="-O1 -g -DNDEBUG" \
+	  -DCMAKE_EXE_LINKER_FLAGS="-fsanitize=thread" >/dev/null
+	cmake --build native/build-tsan
+
 clean:
-	rm -rf $(BUILD_DIR)
+	rm -rf $(BUILD_DIR) native/build-asan native/build-tsan

@@ -2,6 +2,7 @@
 
 #include <errno.h>
 
+#include "tbutil/object_pool.h"
 #include "tbthread/sys_futex.h"
 #include "tbthread/task_control.h"
 #include "tbthread/task_group.h"
@@ -44,7 +45,7 @@ void fiber_timeout_cb(void* wv) {
   Butex* b = w->owner;
   TaskMeta* to_wake = nullptr;
   {
-    std::lock_guard<std::mutex> g(b->waiter_lock);
+    std::lock_guard<ButexWaiterLock> g(b->waiter_lock);
     if (list_linked(w)) {
       list_unlink(w);
       w->timed_out = true;
@@ -72,7 +73,7 @@ int wait_as_pthread(Butex* b, int expected, const timespec* abstime) {
   w.type = ButexWaiter::PTHREAD;
   w.owner = b;
   {
-    std::lock_guard<std::mutex> g(b->waiter_lock);
+    std::lock_guard<ButexWaiterLock> g(b->waiter_lock);
     if (b->value.load(std::memory_order_relaxed) != expected) {
       errno = EWOULDBLOCK;
       return -1;
@@ -92,7 +93,7 @@ int wait_as_pthread(Butex* b, int expected, const timespec* abstime) {
         // Deadline passed: try to remove ourselves. If a waker already
         // unlinked us, it WILL set pthread_wake — keep waiting for it so it
         // never touches a dead node.
-        std::unique_lock<std::mutex> g(b->waiter_lock);
+        std::unique_lock<ButexWaiterLock> g(b->waiter_lock);
         if (list_linked(&w)) {
           list_unlink(&w);
           timed_out = true;
@@ -117,9 +118,29 @@ int wait_as_pthread(Butex* b, int expected, const timespec* abstime) {
 
 }  // namespace
 
-Butex* butex_create() { return new Butex; }
+// Butex memory is POOLED, NEVER FREED — same stance as the reference's
+// butex.cpp (its butexes live in resource pools precisely for this): a
+// waker that loaded the butex pointer can race the waiter's destroy — the
+// waiter may observe completion through ITS OWN state (e.g. a countdown
+// that hit zero), return, and destroy while the waker is still inside
+// wake_all. With pooled memory that racing waker touches a recycled butex:
+// worst case it pops and wakes a NEW incarnation's waiter — a spurious
+// wakeup, which every butex_wait caller must (and does) tolerate by
+// re-checking its predicate. With heap memory it would be a use-after-free
+// (found by the TSan fiber-annotation build on CountdownEvent teardown).
+Butex* butex_create() {
+  Butex* b = tbutil::get_object<Butex>();
+  b->value.store(0, std::memory_order_relaxed);
+  {
+    // A racing stale waker may hold the recycled lock momentarily.
+    std::lock_guard<ButexWaiterLock> g(b->waiter_lock);
+    b->waiters.prev = &b->waiters;
+    b->waiters.next = &b->waiters;
+  }
+  return b;
+}
 
-void butex_destroy(Butex* b) { delete b; }
+void butex_destroy(Butex* b) { tbutil::return_object(b); }
 
 int butex_wait(Butex* b, int expected, const timespec* abstime) {
   TaskGroup* g = TaskGroup::current();
@@ -179,7 +200,7 @@ static void wake_one_unlinked(ButexWaiter* w) {
 int butex_wake(Butex* b) {
   ButexWaiter* w;
   {
-    std::lock_guard<std::mutex> g(b->waiter_lock);
+    std::lock_guard<ButexWaiterLock> g(b->waiter_lock);
     w = list_pop(b);
   }
   if (w == nullptr) return 0;
@@ -192,7 +213,7 @@ int butex_wake_all(Butex* b) {
   ButexWaiter* head = nullptr;
   ButexWaiter* tail = nullptr;
   {
-    std::lock_guard<std::mutex> g(b->waiter_lock);
+    std::lock_guard<ButexWaiterLock> g(b->waiter_lock);
     while (ButexWaiter* w = list_pop(b)) {
       w->next = nullptr;
       if (tail == nullptr) {

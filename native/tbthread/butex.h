@@ -10,6 +10,8 @@
 // (task_group.h), so a waker can never observe a half-parked fiber.
 #pragma once
 
+#include <sched.h>
+
 #include <atomic>
 #include <cstdint>
 #include <ctime>
@@ -30,9 +32,34 @@ struct ButexWaiter {
   struct Butex* owner = nullptr;
 };
 
+// The waiter lock is taken in fiber context and RELEASED ON THE SCHEDULER
+// STACK after the fiber switched out (unlock_butex_after_park — the
+// lost-wakeup-free park protocol). TSan models mutex OWNERSHIP, so that
+// cross-context unlock reads as "unlock by wrong thread" and every access
+// under the lock then looks racy. Under -fsanitize=thread we swap in an
+// ownership-free atomic spinlock: TSan derives the happens-before edges
+// from the acquire/release atomics and stops second-guessing who unlocks.
+// Plain builds keep std::mutex (futex sleep beats spinning when contended).
+#if defined(__SANITIZE_THREAD__)
+class ButexWaiterLock {
+ public:
+  void lock() {
+    while (_locked.exchange(true, std::memory_order_acquire)) {
+      sched_yield();  // critical sections are O(1) list splices
+    }
+  }
+  void unlock() { _locked.store(false, std::memory_order_release); }
+
+ private:
+  std::atomic<bool> _locked{false};
+};
+#else
+using ButexWaiterLock = std::mutex;
+#endif
+
 struct Butex {
   std::atomic<int> value{0};
-  std::mutex waiter_lock;
+  ButexWaiterLock waiter_lock;
   ButexWaiter waiters;  // circular sentinel list
 
   Butex() {

@@ -1,5 +1,7 @@
 #include "tbthread/fiber.h"
 
+#include "tbthread/sanitizer_fiber.h"
+
 #include <errno.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -79,6 +81,7 @@ int start_fiber(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
   }
   m->ctx_sp = tb_make_fcontext(m->stack->stack_base, m->stack->stack_size,
                                TaskGroup::task_entry);
+  m->tsan_fiber = tsan_create_fiber();  // no-op outside -fsanitize=thread
   uint32_t version = static_cast<uint32_t>(
       m->version_butex->value.load(std::memory_order_relaxed));
   if (tid != nullptr) *tid = make_tid(slot, version);

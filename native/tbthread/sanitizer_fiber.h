@@ -1,0 +1,78 @@
+// Sanitizer fiber-switch annotations (ASan + TSan).
+//
+// ASan tracks one stack (and one fake-stack for use-after-return) per
+// thread; TSan tracks one happens-before context per thread. Jumping to a
+// fiber stack behind their backs corrupts ASan's allocator state (observed:
+// SEGV in asan_allocator.cpp on the first free after a switch) and floods
+// TSan with false races (every fiber migration looks like an unsynchronized
+// thread). The fix in both cases is the documented protocol:
+//  - ASan: __sanitizer_start_switch_fiber before the jump (destination
+//    stack), __sanitizer_finish_switch_fiber first thing on the new stack.
+//  - TSan: __tsan_create_fiber per fiber context, __tsan_switch_to_fiber
+//    immediately before each jump, __tsan_destroy_fiber once the context is
+//    dead (we destroy from the scheduler stack in task_ends).
+// The reference relies on ASan-only CI (SURVEY §5 sanitizers note); the
+// TSan half makes `-fsanitize=thread` builds usable for real race hunting
+// over the fiber runtime. No-ops in plain builds.
+#pragma once
+
+#include <cstddef>
+
+// GCC defines __SANITIZE_ADDRESS__/__SANITIZE_THREAD__; Clang only exposes
+// __has_feature. This is also the canonical detection site for other TUs
+// (heap_profiler.cpp, tests).
+#if defined(__has_feature)
+#if !defined(__SANITIZE_ADDRESS__) && __has_feature(address_sanitizer)
+#define __SANITIZE_ADDRESS__ 1
+#endif
+#if !defined(__SANITIZE_THREAD__) && __has_feature(thread_sanitizer)
+#define __SANITIZE_THREAD__ 1
+#endif
+#endif
+
+#if defined(__SANITIZE_ADDRESS__)
+#include <sanitizer/common_interface_defs.h>
+#endif
+#if defined(__SANITIZE_THREAD__)
+#include <sanitizer/tsan_interface.h>
+#endif
+
+namespace tbthread {
+
+#if defined(__SANITIZE_ADDRESS__)
+// fake_stack_save: where to stash the departing context's fake stack;
+// nullptr means the departing context is dying (ASan frees its fake stack).
+inline void asan_start_switch(void** fake_stack_save, const void* dest_bottom,
+                              size_t dest_size) {
+  __sanitizer_start_switch_fiber(fake_stack_save, dest_bottom, dest_size);
+}
+// fake_stack: the value stashed when this context last departed (nullptr on
+// a context's first entry).
+inline void asan_finish_switch(void* fake_stack) {
+  __sanitizer_finish_switch_fiber(fake_stack, nullptr, nullptr);
+}
+#else
+inline void asan_start_switch(void**, const void*, size_t) {}
+inline void asan_finish_switch(void*) {}
+#endif
+
+#if defined(__SANITIZE_THREAD__)
+inline void* tsan_current_fiber() { return __tsan_get_current_fiber(); }
+inline void* tsan_create_fiber() { return __tsan_create_fiber(0); }
+inline void tsan_destroy_fiber(void* f) {
+  if (f != nullptr) __tsan_destroy_fiber(f);
+}
+// Immediately before the jump. The default flags publish a happens-before
+// edge from the switching-out context — exactly what a cooperative
+// scheduler provides.
+inline void tsan_switch_fiber(void* f) {
+  if (f != nullptr) __tsan_switch_to_fiber(f, 0);
+}
+#else
+inline void* tsan_current_fiber() { return nullptr; }
+inline void* tsan_create_fiber() { return nullptr; }
+inline void tsan_destroy_fiber(void*) {}
+inline void tsan_switch_fiber(void*) {}
+#endif
+
+}  // namespace tbthread

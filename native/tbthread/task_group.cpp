@@ -2,7 +2,7 @@
 
 #include <pthread.h>
 
-#include "tbthread/asan_fiber.h"
+#include "tbthread/sanitizer_fiber.h"
 #include "tbthread/butex.h"
 #include "tbthread/context.h"
 #include "tbthread/key.h"
@@ -33,14 +33,16 @@ fiber_t TaskGroup::cur_tid() const {
 
 void TaskGroup::run_main_task() {
   tls_task_group = this;
-  // Capture this worker pthread's stack bounds once: every fiber->scheduler
-  // switch must describe this stack to ASan (asan_fiber.h).
+  // Capture this worker pthread's stack bounds (ASan: every
+  // fiber->scheduler switch describes this stack) and its TSan context
+  // (every fiber->scheduler switch targets it) — sanitizer_fiber.h.
   {
     pthread_attr_t attr;
     if (pthread_getattr_np(pthread_self(), &attr) == 0) {
       pthread_attr_getstack(&attr, &_sched_stack_bottom, &_sched_stack_size);
       pthread_attr_destroy(&attr);
     }
+    _tsan_sched_fiber = tsan_current_fiber();
   }
   TaskMeta* meta = nullptr;
   while (wait_task(&meta)) {
@@ -82,6 +84,7 @@ void TaskGroup::sched_to(TaskMeta* next) {
   _cur_meta.store(next, std::memory_order_relaxed);
   asan_start_switch(&_sched_fake_stack, next->stack->stack_base,
                     next->stack->stack_size);
+  tsan_switch_fiber(next->tsan_fiber);
   tb_jump_fcontext(&_main_sp, next->ctx_sp, reinterpret_cast<intptr_t>(this));
   // Back on the scheduler stack: the fiber parked, yielded, or exited.
   asan_finish_switch(_sched_fake_stack);
@@ -102,6 +105,7 @@ void TaskGroup::park(void (*remained)(void*), void* arg) {
   g->_remained_arg = arg;
   asan_start_switch(&m->asan_fake_stack, g->_sched_stack_bottom,
                     g->_sched_stack_size);
+  tsan_switch_fiber(g->_tsan_sched_fiber);
   tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
   // Resumed — possibly on a different worker; tls reads must be re-fetched
   // by the caller.
@@ -137,6 +141,7 @@ void TaskGroup::exit_current() {
   g->_remained_arg = m;
   // nullptr save slot = context is dying; ASan frees its fake stack.
   asan_start_switch(nullptr, g->_sched_stack_bottom, g->_sched_stack_size);
+  tsan_switch_fiber(g->_tsan_sched_fiber);
   tb_jump_fcontext(&m->ctx_sp, g->_main_sp, 0);
   __builtin_unreachable();  // never resumed
 }
@@ -154,6 +159,8 @@ void TaskGroup::task_ends(void* meta) {
   m->fn = nullptr;
   m->arg = nullptr;
   tracer_internal::Unregister(static_cast<uint32_t>(m->slot));
+  tsan_destroy_fiber(m->tsan_fiber);  // context dead; runs on sched stack
+  m->tsan_fiber = nullptr;
   butex_increment_and_wake_all(m->version_butex);
   tbutil::return_resource<TaskMeta>(m->slot);
 }

@@ -71,12 +71,13 @@ class TaskGroup {
   int _tag = 0;
   std::atomic<TaskMeta*> _cur_meta{nullptr};
   void* _main_sp = nullptr;  // scheduler context while a fiber runs
-  // ASan annotation state (asan_fiber.h): the worker pthread's stack bounds
+  // ASan annotation state (sanitizer_fiber.h): the worker pthread's stack bounds
   // (destination of every fiber->scheduler switch) and the scheduler
   // context's saved fake stack. Unused outside ASan builds.
   void* _sched_stack_bottom = nullptr;
   size_t _sched_stack_size = 0;
   void* _sched_fake_stack = nullptr;
+  void* _tsan_sched_fiber = nullptr;  // TSan context of the worker thread
   void (*_remained_fn)(void*) = nullptr;
   void* _remained_arg = nullptr;
 

@@ -33,9 +33,11 @@ struct TaskMeta {
   void* arg = nullptr;
   void* ctx_sp = nullptr;  // saved stack pointer while suspended
   StackContainer* stack = nullptr;
-  // ASan fake-stack handle saved at each switch-out (asan_fiber.h); unused
-  // (always nullptr) outside -fsanitize=address builds.
+  // Sanitizer fiber-context handles (sanitizer_fiber.h): ASan fake-stack
+  // saved at each switch-out; TSan fiber context created with the fcontext
+  // and destroyed in task_ends. Both stay nullptr in plain builds.
   void* asan_fake_stack = nullptr;
+  void* tsan_fiber = nullptr;
   FiberAttr attr;
   tbutil::ResourceId slot = 0;
   // Allocated on first use of the slot, never freed: join-after-reuse must

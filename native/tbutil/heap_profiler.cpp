@@ -8,7 +8,7 @@
 // so the whole override block compiles out (the explicit RecordAlloc /
 // RecordFree hooks still work).
 #include "tbutil/heap_profiler.h"
-#include "tbthread/asan_fiber.h"  // canonical __SANITIZE_ADDRESS__ detection
+#include "tbthread/sanitizer_fiber.h"  // canonical __SANITIZE_ADDRESS__ detection
 
 #include <pthread.h>
 

@@ -10,7 +10,7 @@
 #include <vector>
 #include "tbutil/cpu_profiler.h"
 #include "tbutil/heap_profiler.h"
-#include "tbthread/asan_fiber.h"  // canonical __SANITIZE_ADDRESS__ detection
+#include "tbthread/sanitizer_fiber.h"  // canonical __SANITIZE_ADDRESS__ detection
 #include "tbutil/time.h"
 
 // noinline + C linkage: a stable symbol the assertion can look for.

@@ -206,6 +206,8 @@ int RegistryClient::Start(const std::string& registry_hostport,
     _tag = tag;
     _ttl_s = ttl_s < 1 ? 1 : ttl_s;
     _started.store(true, std::memory_order_relaxed);
+    // Fresh session: the unreachable-transition warning must re-arm.
+    _unreachable.store(false, std::memory_order_relaxed);
   });
 }
 
