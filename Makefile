@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test asan tsan clean
+.PHONY: native test asan tsan test-asan test-tsan clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -25,6 +25,14 @@ tsan:
 	  -DCMAKE_CXX_FLAGS_RELWITHDEBINFO="-O1 -g -DNDEBUG" \
 	  -DCMAKE_EXE_LINKER_FLAGS="-fsanitize=thread" >/dev/null
 	cmake --build native/build-tsan
+
+# Run the native suite against the sanitizer trees (slow; keeps the
+# "TSan-clean" claim enforced rather than aspirational).
+test-asan: asan
+	cd native/build-asan && ctest -j1 --output-on-failure
+
+test-tsan: tsan
+	cd native/build-tsan && ctest -j1 --output-on-failure
 
 clean:
 	rm -rf $(BUILD_DIR) native/build-asan native/build-tsan

@@ -9,6 +9,7 @@
 
 #include <cstdio>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <thread>
 
@@ -18,11 +19,15 @@
 using namespace trpc;
 
 int main() {
+  // Handler fiber publishes, pusher thread consumes — a mutex makes the
+  // handoff race-free (the bare-pointer poll version trips TSan).
+  static std::mutex g_tail_mu;
   static std::shared_ptr<ProgressiveAttachment> g_tail;
   RegisterHttpHandler("/tail", [](const HttpRequest&, HttpResponse* resp) {
     resp->content_type = "text/plain";
     resp->body = "tail begins\n";
     resp->progressive = std::make_shared<ProgressiveAttachment>();
+    std::lock_guard<std::mutex> lk(g_tail_mu);
     g_tail = resp->progressive;
   });
 
@@ -33,14 +38,17 @@ int main() {
 
   // Pusher: a "log line" every 50ms, then close.
   std::thread pusher([] {
-    while (g_tail == nullptr) {
+    std::shared_ptr<ProgressiveAttachment> tail;
+    while (tail == nullptr) {
       std::this_thread::sleep_for(std::chrono::milliseconds(5));
+      std::lock_guard<std::mutex> lk(g_tail_mu);
+      tail = g_tail;
     }
     for (int i = 1; i <= 8; ++i) {
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
-      g_tail->Write("log line " + std::to_string(i) + "\n");
+      tail->Write("log line " + std::to_string(i) + "\n");
     }
-    g_tail->Close();
+    tail->Close();
   });
 
   // Raw client: GET, then read until the server terminates the stream.

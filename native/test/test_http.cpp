@@ -11,6 +11,7 @@
 #include <chrono>
 #include <memory>
 #include <thread>
+#include <mutex>
 #include <string>
 
 #include "mini_test.h"
@@ -318,11 +319,16 @@ TEST_CASE(http_framing_hardening) {
 // until Close() terminates the chunked body and the connection
 // (reference progressive_attachment.h — the log-tail/event-stream shape).
 TEST_CASE(http_progressive_attachment_streams) {
+  // Handler fiber publishes, pusher thread consumes: the handoff needs a
+  // real synchronizer (a bare shared_ptr poll is a data race — TSan).
+  static std::mutex g_pa_mu;
   static std::shared_ptr<ProgressiveAttachment> g_pa;
+  g_pa = nullptr;
   RegisterHttpHandler("/tail", [](const HttpRequest&, HttpResponse* resp) {
     resp->content_type = "text/plain";
     resp->body = "line-0\n";  // first chunk rides with the headers
     resp->progressive = std::make_shared<ProgressiveAttachment>();
+    std::lock_guard<std::mutex> lk(g_pa_mu);
     g_pa = resp->progressive;
   });
   Server server;
@@ -342,14 +348,17 @@ TEST_CASE(http_progressive_attachment_streams) {
 
   // Writer fiber: more lines after the response, then Close.
   std::thread pusher([&] {
-    while (g_pa == nullptr) {
+    std::shared_ptr<ProgressiveAttachment> pa;
+    while (pa == nullptr) {
       std::this_thread::sleep_for(std::chrono::milliseconds(5));
+      std::lock_guard<std::mutex> lk(g_pa_mu);
+      pa = g_pa;
     }
     for (int i = 1; i <= 5; ++i) {
       std::this_thread::sleep_for(std::chrono::milliseconds(20));
-      ASSERT_EQ(g_pa->Write("line-" + std::to_string(i) + "\n"), 0);
+      ASSERT_EQ(pa->Write("line-" + std::to_string(i) + "\n"), 0);
     }
-    g_pa->Close();
+    pa->Close();
   });
 
   std::string wire;

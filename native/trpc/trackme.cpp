@@ -75,6 +75,17 @@ void TrackMeServer::AddBugRange(int64_t min_version, int64_t max_version,
   g_bugs.push_back({min_version, max_version, severity, error_text});
 }
 
+void TrackMeServer::ReplaceBugs(std::vector<BugRule> rules) {
+  std::vector<BugRange> staged;
+  staged.reserve(rules.size());
+  for (BugRule& r : rules) {
+    staged.push_back({r.min_version, r.max_version, r.severity,
+                      std::move(r.error_text)});
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_bugs.swap(staged);
+}
+
 void TrackMeServer::SetReportingInterval(int seconds) {
   std::lock_guard<std::mutex> lk(g_mu);
   g_reporting_interval = seconds;

@@ -12,6 +12,7 @@
 #include <atomic>
 #include <cstdint>
 #include <string>
+#include <vector>
 
 #include "trpc/periodic_reporter.h"
 
@@ -35,6 +36,16 @@ class TrackMeServer {
   // (reference BugsLoader's RevisionInfo rows).
   static void AddBugRange(int64_t min_version, int64_t max_version,
                           int severity, const std::string& error_text);
+  // Atomic wholesale replacement (hot reload): no window where a
+  // concurrent /trackme sees an empty/partial table, and the reporting
+  // interval is untouched.
+  struct BugRule {
+    int64_t min_version;
+    int64_t max_version;
+    int severity;
+    std::string error_text;
+  };
+  static void ReplaceBugs(std::vector<BugRule> rules);
   // Ask clients to report every `seconds` (0 = leave client default).
   static void SetReportingInterval(int seconds);
   static void ClearBugs();  // tests

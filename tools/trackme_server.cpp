@@ -1,0 +1,120 @@
+// trackme_server: the fleet-wide version watchtower (reference
+// tools/trackme_server). Loads known-bug version ranges from a text file,
+// reloads it when it changes, and answers /trackme reports from every
+// deployed server with severity + advice (trpc/trackme.h carries the
+// wire contract and the in-process registry).
+//
+// Usage:
+//   trackme_server [--port=8877] [--bug_file=./bugs]
+//                  [--reporting_interval=300]
+//
+// bug_file lines: MIN_VERSION MAX_VERSION SEVERITY(1|2) MESSAGE...
+//   e.g.  "1 3 1 builds 1-3 leak fds in the stream path, upgrade"
+// '#' comments and blank lines ignored. The file is re-read when its
+// mtime changes (1s poll), like the reference's BugsLoader FileWatcher.
+#include <sys/stat.h>
+
+#include <chrono>
+#include <thread>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tbutil/logging.h"
+#include "tbutil/string_utils.h"
+#include "trpc/server.h"
+#include "trpc/trackme.h"
+
+using namespace trpc;
+
+namespace {
+
+time_t g_loaded_mtime = 0;
+
+// Returns the number of ranges loaded, -1 when unreadable. The new table
+// is staged locally and installed atomically (ReplaceBugs) — a concurrent
+// /trackme never sees an empty/partial table mid-reload, and the
+// configured reporting interval is untouched.
+int load_bugs(const std::string& path) {
+  FILE* fp = fopen(path.c_str(), "r");
+  if (fp == nullptr) return -1;
+  std::vector<TrackMeServer::BugRule> rules;
+  char line[1024];
+  while (fgets(line, sizeof(line), fp) != nullptr) {
+    const std::string_view t = tbutil::trim_whitespace(line);
+    if (t.empty() || t[0] == '#') continue;
+    long long min_v = 0, max_v = 0;
+    int severity = 0, consumed = 0;
+    if (sscanf(std::string(t).c_str(), "%lld %lld %d %n", &min_v, &max_v,
+               &severity, &consumed) < 3 ||
+        (severity != kTrackMeWarning && severity != kTrackMeFatal)) {
+      TB_LOG(WARNING) << "bug_file: skipping bad line: " << t;
+      continue;
+    }
+    rules.push_back({min_v, max_v, severity,
+                     std::string(tbutil::trim_whitespace(t.substr(consumed)))});
+  }
+  fclose(fp);
+  const int n = static_cast<int>(rules.size());
+  TrackMeServer::ReplaceBugs(std::move(rules));
+  return n;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 8877;
+  int reporting_interval = 300;
+  std::string bug_file = "./bugs";
+  for (int i = 1; i < argc; ++i) {
+    if (strncmp(argv[i], "--port=", 7) == 0) {
+      port = atoi(argv[i] + 7);
+    } else if (strncmp(argv[i], "--bug_file=", 11) == 0) {
+      bug_file = argv[i] + 11;
+    } else if (strncmp(argv[i], "--reporting_interval=", 21) == 0) {
+      reporting_interval = atoi(argv[i] + 21);
+    } else {
+      fprintf(stderr,
+              "usage: trackme_server [--port=N] [--bug_file=F] "
+              "[--reporting_interval=S]\n");
+      return 1;
+    }
+  }
+  TrackMeServer::Install();
+  TrackMeServer::SetReportingInterval(reporting_interval);
+  struct stat st;
+  if (stat(bug_file.c_str(), &st) == 0) {
+    g_loaded_mtime = st.st_mtime;
+    const int n = load_bugs(bug_file);
+    printf("loaded %d bug range(s) from %s\n", n < 0 ? 0 : n,
+           bug_file.c_str());
+  } else {
+    printf("no bug file at %s yet; serving empty table\n", bug_file.c_str());
+  }
+
+  Server server;
+  char addr[64];
+  snprintf(addr, sizeof(addr), "0.0.0.0:%d", port);
+  if (server.Start(addr, nullptr) != 0) {
+    fprintf(stderr, "cannot listen on %s\n", addr);
+    return 1;
+  }
+  printf("trackme_server on port %d (clients report every %ds; reports so "
+         "far visible at /vars)\n",
+         server.listen_address().port, reporting_interval);
+  fflush(stdout);
+
+  // Reload loop (the server itself runs on its own threads).
+  while (true) {
+    std::this_thread::sleep_for(std::chrono::seconds(1));
+    if (stat(bug_file.c_str(), &st) != 0) continue;
+    if (st.st_mtime == g_loaded_mtime) continue;
+    g_loaded_mtime = st.st_mtime;
+    const int n = load_bugs(bug_file);
+    TB_LOG(INFO) << "reloaded " << (n < 0 ? 0 : n) << " bug range(s) from "
+                 << bug_file;
+  }
+  return 0;
+}
