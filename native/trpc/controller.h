@@ -113,11 +113,13 @@ class Controller {
   int _protocol = 0;
   bool _tpu_transport = false;
   bool _tls = false;
+  bool _alpn_h2 = false;  // h2/gRPC channels offer ALPN h2 on TLS
   std::string _sni_host;
   ClientTransport transport() const {
     ClientTransport tr;
     tr.tpu = _tpu_transport;
     tr.tls = _tls;
+    tr.alpn_h2 = _alpn_h2;
     tr.sni_host = _sni_host;
     return tr;
   }

@@ -14,7 +14,7 @@ static auto* g_max_pool = TRPC_DEFINE_FLAG(
 
 int SocketMap::GetOrCreate(const tbutil::EndPoint& pt, SocketUniquePtr* out,
                            const ClientTransport& tr) {
-  const Key key{pt, tr.tpu, tr.tls};
+  const Key key{pt, tr.tpu, tr.tls, tr.alpn_h2};
   {
     std::lock_guard<std::mutex> lk(_mu);
     auto it = _map.find(key);
@@ -41,10 +41,12 @@ void SocketMap::Remove(const tbutil::EndPoint& pt, SocketId expected) {
   std::lock_guard<std::mutex> lk(_mu);
   for (bool tpu : {false, true}) {
     for (bool tls : {false, true}) {
-      auto it = _map.find(Key{pt, tpu, tls});
-      if (it != _map.end() && it->second == expected) {
-        _map.erase(it);
-        return;
+      for (bool alpn : {false, true}) {
+        auto it = _map.find(Key{pt, tpu, tls, alpn});
+        if (it != _map.end() && it->second == expected) {
+          _map.erase(it);
+          return;
+        }
       }
     }
   }
@@ -52,7 +54,7 @@ void SocketMap::Remove(const tbutil::EndPoint& pt, SocketId expected) {
 
 int SocketMap::GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
                          const ClientTransport& tr) {
-  const Key key{pt, tr.tpu, tr.tls};
+  const Key key{pt, tr.tpu, tr.tls, tr.alpn_h2};
   {
     std::lock_guard<std::mutex> lk(_mu);
     auto it = _pools.find(key);
@@ -73,9 +75,17 @@ int SocketMap::GetPooled(const tbutil::EndPoint& pt, SocketUniquePtr* out,
 }
 
 namespace {
-// One process-wide client SSL_CTX (no client certs / CA verification yet —
-// matches the reference's default VerifyOptions off).
-std::shared_ptr<SslContext> client_ssl_ctx() {
+// Two process-wide client SSL_CTXs (no client certs / CA verification yet —
+// matches the reference's default VerifyOptions off). gRPC/h2 channels use
+// the h2-ALPN one (strict gRPC servers refuse TLS without it); everything
+// else offers no ALPN so an ALPN-honoring third-party HTTPS server falls
+// back to HTTP/1.1 instead of selecting h2 against an HTTP/1.1 client.
+std::shared_ptr<SslContext> client_ssl_ctx(bool alpn_h2) {
+  if (alpn_h2) {
+    static std::shared_ptr<SslContext>* h2ctx =
+        new std::shared_ptr<SslContext>(SslContext::NewClient({"h2"}));
+    return *h2ctx;
+  }
   static std::shared_ptr<SslContext>* ctx =
       new std::shared_ptr<SslContext>(SslContext::NewClient({}));
   return *ctx;
@@ -91,7 +101,7 @@ int CreateClientSocket(const tbutil::EndPoint& pt, const ClientTransport& tr,
   opt.server_side = false;
   opt.tpu_transport = tr.tpu;
   if (tr.tls) {
-    opt.ssl_ctx = client_ssl_ctx();
+    opt.ssl_ctx = client_ssl_ctx(tr.alpn_h2);
     if (opt.ssl_ctx == nullptr) {
       errno = ENOTSUP;  // libssl unavailable
       return -1;
@@ -150,7 +160,7 @@ void SocketMap::ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
   SocketUniquePtr sock;
   if (Socket::Address(sid, &sock) != 0) return;  // died in flight
   std::unique_lock<std::mutex> lk(_mu);
-  auto& free_list = _pools[Key{pt, tr.tpu, tr.tls}];
+  auto& free_list = _pools[Key{pt, tr.tpu, tr.tls, tr.alpn_h2}];
   if (static_cast<int64_t>(free_list.size()) <
       g_max_pool->load(std::memory_order_relaxed)) {
     free_list.push_back(sid);
@@ -163,7 +173,7 @@ void SocketMap::ReturnPooled(const tbutil::EndPoint& pt, SocketId sid,
 size_t SocketMap::PooledIdleCount(const tbutil::EndPoint& pt,
                                   const ClientTransport& tr) {
   std::lock_guard<std::mutex> lk(_mu);
-  auto it = _pools.find(Key{pt, tr.tpu, tr.tls});
+  auto it = _pools.find(Key{pt, tr.tpu, tr.tls, tr.alpn_h2});
   return it != _pools.end() ? it->second.size() : 0;
 }
 

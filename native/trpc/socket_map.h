@@ -36,6 +36,12 @@ enum class ConnectionType : uint8_t { kSingle = 0, kPooled = 1, kShort = 2 };
 struct ClientTransport {
   bool tpu = false;
   bool tls = false;
+  // TLS ALPN policy: gRPC/h2 channels MUST offer h2 (strict gRPC servers
+  // refuse without it); HTTP/1.1 and tstd channels must NOT (an
+  // ALPN-honoring third-party server would select h2 and then reject
+  // their non-h2 bytes). Chosen per channel protocol, part of the pool
+  // key so connections with different handshakes never mix.
+  bool alpn_h2 = false;
   std::string sni_host;
   ClientTransport() = default;
   ClientTransport(bool tpu_) : tpu(tpu_) {}  // NOLINT: legacy bool-tpu sites
@@ -92,14 +98,16 @@ class SocketMap {
     tbutil::EndPoint pt;
     bool tpu;
     bool tls;
+    bool alpn_h2;
     bool operator==(const Key& o) const {
-      return pt == o.pt && tpu == o.tpu && tls == o.tls;
+      return pt == o.pt && tpu == o.tpu && tls == o.tls &&
+             alpn_h2 == o.alpn_h2;
     }
   };
   struct KeyHasher {
     size_t operator()(const Key& k) const {
-      return tbutil::EndPointHasher()(k.pt) * 4 + (k.tpu ? 1 : 0) +
-             (k.tls ? 2 : 0);
+      return tbutil::EndPointHasher()(k.pt) * 8 + (k.tpu ? 1 : 0) +
+             (k.tls ? 2 : 0) + (k.alpn_h2 ? 4 : 0);
     }
   };
   std::mutex _mu;

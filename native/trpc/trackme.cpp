@@ -13,15 +13,8 @@ namespace trpc {
 
 namespace {
 
-struct BugRange {
-  int64_t min_version;
-  int64_t max_version;
-  int severity;
-  std::string error_text;
-};
-
 std::mutex g_mu;
-std::vector<BugRange> g_bugs;
+std::vector<TrackMeServer::BugRule> g_bugs;
 int g_reporting_interval = 0;
 std::atomic<int64_t> g_reports{0};
 
@@ -45,7 +38,7 @@ void trackme_handler(const HttpRequest& req, HttpResponse* resp) {
   int interval = 0;
   {
     std::lock_guard<std::mutex> lk(g_mu);
-    for (const BugRange& b : g_bugs) {
+    for (const TrackMeServer::BugRule& b : g_bugs) {
       if (version >= b.min_version && version <= b.max_version &&
           b.severity > severity) {
         severity = b.severity;
@@ -76,14 +69,8 @@ void TrackMeServer::AddBugRange(int64_t min_version, int64_t max_version,
 }
 
 void TrackMeServer::ReplaceBugs(std::vector<BugRule> rules) {
-  std::vector<BugRange> staged;
-  staged.reserve(rules.size());
-  for (BugRule& r : rules) {
-    staged.push_back({r.min_version, r.max_version, r.severity,
-                      std::move(r.error_text)});
-  }
   std::lock_guard<std::mutex> lk(g_mu);
-  g_bugs.swap(staged);
+  g_bugs.swap(rules);
 }
 
 void TrackMeServer::SetReportingInterval(int seconds) {

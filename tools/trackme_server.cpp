@@ -31,7 +31,13 @@ using namespace trpc;
 
 namespace {
 
-time_t g_loaded_mtime = 0;
+// Nanosecond mtime: two writes within the same second must still register
+// as a change (plain st_mtime has 1s granularity).
+int64_t g_loaded_mtime_ns = -1;
+
+int64_t mtime_ns(const struct stat& st) {
+  return int64_t{st.st_mtim.tv_sec} * 1000000000 + st.st_mtim.tv_nsec;
+}
 
 // Returns the number of ranges loaded, -1 when unreadable. The new table
 // is staged locally and installed atomically (ReplaceBugs) — a concurrent
@@ -86,10 +92,14 @@ int main(int argc, char** argv) {
   TrackMeServer::SetReportingInterval(reporting_interval);
   struct stat st;
   if (stat(bug_file.c_str(), &st) == 0) {
-    g_loaded_mtime = st.st_mtime;
     const int n = load_bugs(bug_file);
-    printf("loaded %d bug range(s) from %s\n", n < 0 ? 0 : n,
-           bug_file.c_str());
+    if (n < 0) {
+      fprintf(stderr, "cannot read %s; will retry on change\n",
+              bug_file.c_str());
+    } else {
+      g_loaded_mtime_ns = mtime_ns(st);
+      printf("loaded %d bug range(s) from %s\n", n, bug_file.c_str());
+    }
   } else {
     printf("no bug file at %s yet; serving empty table\n", bug_file.c_str());
   }
@@ -110,11 +120,17 @@ int main(int argc, char** argv) {
   while (true) {
     std::this_thread::sleep_for(std::chrono::seconds(1));
     if (stat(bug_file.c_str(), &st) != 0) continue;
-    if (st.st_mtime == g_loaded_mtime) continue;
-    g_loaded_mtime = st.st_mtime;
+    if (mtime_ns(st) == g_loaded_mtime_ns) continue;
     const int n = load_bugs(bug_file);
-    TB_LOG(INFO) << "reloaded " << (n < 0 ? 0 : n) << " bug range(s) from "
-                 << bug_file;
+    if (n < 0) {
+      // Keep the old table AND the old mtime: the next poll retries (e.g.
+      // after the operator fixes permissions without touching mtime).
+      TB_LOG(ERROR) << "cannot read " << bug_file
+                    << "; keeping previous table";
+      continue;
+    }
+    g_loaded_mtime_ns = mtime_ns(st);
+    TB_LOG(INFO) << "reloaded " << n << " bug range(s) from " << bug_file;
   }
   return 0;
 }
