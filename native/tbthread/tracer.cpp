@@ -23,7 +23,11 @@ struct Shard {
   std::mutex mu;
   std::unordered_set<uint32_t> slots;
 };
-Shard g_shards[kShards];
+// IMMORTAL (leaked): worker/dispatcher threads keep registering fibers
+// through process exit; a static array would be destroyed by atexit while
+// they run, and the set's teardown races their inserts — an intermittent
+// exit-time segfault (TSan caught it in parallel_echo_demo).
+Shard* const g_shards = new Shard[kShards];
 
 // Saved-context frame layout (context.S): [sp+0] fp control words,
 // [sp+8] r15 ... [sp+48] rbp, [sp+56] return address.
@@ -101,7 +105,8 @@ size_t fiber_trace_all(std::vector<FiberTrace>* out) {
     }
     return false;
   };
-  for (Shard& shard : g_shards) {
+  for (int si = 0; si < kShards; ++si) {
+    Shard& shard = g_shards[si];
     std::vector<uint32_t> slots;
     {
       std::lock_guard<std::mutex> lk(shard.mu);

@@ -1,5 +1,7 @@
 #include "trpc/channel.h"
 
+#include "trpc/h2_protocol.h"
+
 #include <cstring>
 
 #include "tbutil/logging.h"
@@ -110,7 +112,7 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   cntl->_tpu_transport = _options.tpu_transport;
   cntl->_tls = _options.tls;
   // h2/gRPC over TLS must offer ALPN h2 (socket_map.h ClientTransport).
-  cntl->_alpn_h2 = _options.protocol == 5;  // kH2ProtocolIndex
+  cntl->_alpn_h2 = _options.protocol == kH2ProtocolIndex;
   cntl->_sni_host = _options.sni_host;
   cntl->_connection_type = static_cast<uint8_t>(_options.connection_type);
   if (cntl->_compress_type < 0) {

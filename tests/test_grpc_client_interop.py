@@ -79,7 +79,7 @@ def test_h2_client_grpc_error_mapping(grpcio_server):
 
 
 @pytest.fixture(scope="module")
-def grpcio_tls_server(tmp_path_factory):
+def grpcio_tls_server():
     """A real grpcio server behind TLS (requires ALPN h2 from the client)."""
     cryptography = pytest.importorskip("cryptography")  # noqa: F841
     import datetime

@@ -34,6 +34,7 @@ namespace {
 // Nanosecond mtime: two writes within the same second must still register
 // as a change (plain st_mtime has 1s granularity).
 int64_t g_loaded_mtime_ns = -1;
+bool g_read_failing = false;
 
 int64_t mtime_ns(const struct stat& st) {
   return int64_t{st.st_mtim.tv_sec} * 1000000000 + st.st_mtim.tv_nsec;
@@ -94,7 +95,7 @@ int main(int argc, char** argv) {
   if (stat(bug_file.c_str(), &st) == 0) {
     const int n = load_bugs(bug_file);
     if (n < 0) {
-      fprintf(stderr, "cannot read %s; will retry on change\n",
+      fprintf(stderr, "cannot read %s; retrying every poll\n",
               bug_file.c_str());
     } else {
       g_loaded_mtime_ns = mtime_ns(st);
@@ -124,10 +125,18 @@ int main(int argc, char** argv) {
     const int n = load_bugs(bug_file);
     if (n < 0) {
       // Keep the old table AND the old mtime: the next poll retries (e.g.
-      // after the operator fixes permissions without touching mtime).
-      TB_LOG(ERROR) << "cannot read " << bug_file
-                    << "; keeping previous table";
+      // after the operator fixes permissions without touching mtime) —
+      // but log only the unreadable->readable TRANSITION, not 1/s forever.
+      if (!g_read_failing) {
+        g_read_failing = true;
+        TB_LOG(ERROR) << "cannot read " << bug_file
+                      << "; keeping previous table (retrying every poll)";
+      }
       continue;
+    }
+    if (g_read_failing) {
+      g_read_failing = false;
+      TB_LOG(INFO) << bug_file << " readable again";
     }
     g_loaded_mtime_ns = mtime_ns(st);
     TB_LOG(INFO) << "reloaded " << n << " bug range(s) from " << bug_file;
