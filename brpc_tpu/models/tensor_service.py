@@ -193,3 +193,14 @@ def dryrun_multichip(n_devices: int) -> None:
     new_state, loss = step(state, x, t)
     jax.block_until_ready((new_state, loss))
     assert np.isfinite(float(loss)), "sharded step produced non-finite loss"
+
+    # Sequence parallelism (the long-context path): ring attention over the
+    # shard axis must compile + run on the same mesh — KV blocks make
+    # n_shard ppermute hops around the ICI ring.
+    from brpc_tpu.ops.ring_attention import ring_attention
+    seq = 4 * n_shard
+    qkv = jax.random.normal(jax.random.PRNGKey(3), (3, 2, seq, 8),
+                            jnp.float32)
+    attn = ring_attention(mesh)(qkv[0], qkv[1], qkv[2])
+    jax.block_until_ready(attn)
+    assert np.isfinite(np.asarray(attn)).all(), "ring attention non-finite"
