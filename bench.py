@@ -87,6 +87,16 @@ def main() -> None:
         print(f"# latency {key}: p50 {p50:.0f}us p99 {p99:.0f}us "
               f"({qps:.0f} qps)", file=sys.stderr)
 
+    # Device-compute point: ring attention (brpc_tpu/ops/ring_attention)
+    # on whatever accelerator JAX sees — on the real chip this exercises
+    # the MXU at bf16; on the 1-device mesh the ring degenerates to flash
+    # attention with no collectives. Guarded: a JAX/device problem must
+    # never cost the RPC headline above.
+    try:
+        sweep["ring_attention"] = ring_attention_point()
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# ring attention point skipped: {e}", file=sys.stderr)
+
     headline = sweep["tpu_1048576B"]["gbps"]
     print(json.dumps({
         "metric": "echo_1mb_oneway_throughput_tpu",
@@ -95,6 +105,41 @@ def main() -> None:
         "vs_baseline": round(headline / BASELINE_GBPS, 3),
         "sweep": sweep,
     }))
+
+
+def ring_attention_point():
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.ops.ring_attention import ring_attention
+    from brpc_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # Sized for one chip at bf16; CPU fallback keeps shapes tiny so a
+    # CPU-only environment stays fast.
+    batch, seq, d = (8, 4096, 128) if on_tpu else (2, 256, 32)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    mesh = make_mesh(jax.devices()[:1])
+    fn = ring_attention(mesh, SHARD_AXIS)
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (batch, seq, d), dtype) for kk in keys)
+    jax.block_until_ready(fn(q, k, v))  # compile
+    iters = 20 if on_tpu else 3
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / iters
+    # 2 matmuls of [b,s,d]x[b,s,d] -> 4*b*s^2*d FLOPs (fwd attention).
+    tflops = 4.0 * batch * seq * seq * d / dt / 1e12
+    print(f"# ring attention ({dev.platform}): {tflops:.2f} TFLOP/s "
+          f"(b={batch} s={seq} d={d} {dtype.__name__}, {dt * 1e3:.1f}ms/it)",
+          file=sys.stderr)
+    return {"tflops": round(tflops, 2), "platform": dev.platform,
+            "batch": batch, "seq": seq, "d": d, "ms_per_iter": round(dt * 1e3, 2)}
 
 
 if __name__ == "__main__":

@@ -4,6 +4,7 @@
 // Build: g++ -std=c++20 -Inative examples/fiber_pingpong_demo.cpp \
 //            -Lnative/build -lbrpc_tpu -o /tmp/fiber_pingpong
 #include <cstdio>
+#include <cstdlib>
 
 #include "tbthread/fiber.h"
 #include "tbthread/sync.h"
@@ -38,7 +39,15 @@ static void* player(void* arg, int me) {
 
 int main() {
   Court court;
+  // Sanitizer builds instrument every context switch (TSan notifies per
+  // fiber hop); the full 200k rounds would take minutes there. The round
+  // count stays overridable for benchmarking either way.
+#if defined(__SANITIZE_THREAD__) || defined(__SANITIZE_ADDRESS__)
+  court.limit = 5000;
+#else
   court.limit = 200000;
+#endif
+  if (const char* env = getenv("PINGPONG_ROUNDS")) court.limit = atoi(env);
   tbutil::Timer t;
   t.start();
   fiber_t ping, pong;

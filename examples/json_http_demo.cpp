@@ -17,9 +17,9 @@ using namespace trpc;
 using tbutil::JsonValue;
 
 int main() {
-  auto* stats = new JsonService("Stats");
-  stats->AddMethod("Summarize", [](const JsonValue& req, JsonValue* resp,
-                                   Controller* cntl) {
+  JsonService stats("Stats");
+  stats.AddMethod("Summarize", [](const JsonValue& req, JsonValue* resp,
+                                  Controller* cntl) {
     const JsonValue* values = req.find("values");
     if (values == nullptr || !values->is_array() || values->items().empty()) {
       cntl->SetFailed(TRPC_EREQUEST, "expected {\"values\": [numbers...]}");
@@ -42,7 +42,7 @@ int main() {
   });
 
   Server server;
-  if (server.AddService(stats) != 0) return 1;
+  if (server.AddService(&stats) != 0) return 1;
   if (server.Start("127.0.0.1:0", nullptr) != 0) return 1;
   const int port = server.listen_address().port;
   printf("try: curl -d '{\"values\":[3,1,4]}' "

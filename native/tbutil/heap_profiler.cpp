@@ -4,9 +4,10 @@
 // TLS byte-countdown sampling in the new/delete overrides, frame-pointer
 // stacks, live map of sampled pointers.
 //
-// ASan builds: the overrides would fight ASan's own new/delete interposers,
-// so the whole override block compiles out (the explicit RecordAlloc /
-// RecordFree hooks still work).
+// ASan/TSan builds: the overrides would fight the sanitizers' own
+// new/delete interposers (TSan's win symbol resolution outright, so ours
+// never run), so the whole override block compiles out (the explicit
+// RecordAlloc / RecordFree hooks still work).
 #include "tbutil/heap_profiler.h"
 #include "tbthread/sanitizer_fiber.h"  // canonical __SANITIZE_ADDRESS__ detection
 
@@ -312,7 +313,7 @@ std::string HeapProfiler::FlatText(size_t topn) {
 
 }  // namespace tbutil
 
-#if !defined(__SANITIZE_ADDRESS__)
+#if !defined(__SANITIZE_ADDRESS__) && !defined(__SANITIZE_THREAD__)
 
 // Global operator new/delete overrides. Every C++ allocation in the process
 // funnels through these once libbrpc_tpu is linked; cost while not
@@ -397,4 +398,4 @@ void operator delete[](void* p, size_t, std::align_val_t) noexcept {
   free(p);
 }
 
-#endif  // !__SANITIZE_ADDRESS__
+#endif  // !__SANITIZE_ADDRESS__ && !__SANITIZE_THREAD__

@@ -112,10 +112,11 @@ extern "C" __attribute__((noinline)) void heap_test_churn(size_t bytes,
 }
 
 TEST_CASE(heap_profiler_attributes_retained_bytes) {
-#if defined(__SANITIZE_ADDRESS__)
-  // The new/delete overrides compile out under ASan (they would fight its
-  // interposers) — nothing samples, so the assertions below can't hold.
-  fprintf(stderr, "skipped under ASan (overrides compiled out)\n");
+#if defined(__SANITIZE_ADDRESS__) || defined(__SANITIZE_THREAD__)
+  // The new/delete overrides compile out under ASan/TSan (they would
+  // fight the sanitizers' interposers) — nothing samples, so the
+  // assertions below can't hold.
+  fprintf(stderr, "skipped under sanitizers (overrides compiled out)\n");
   return;
 #endif
   using tbutil::HeapProfiler;
