@@ -108,38 +108,68 @@ def main() -> None:
 
 
 def ring_attention_point():
+    """Sustained attention TFLOP/s via the DELTA method.
+
+    Through the axon tunnel, block_until_ready does not reliably block on
+    compute, so naive timings over-report by orders of magnitude. Instead:
+    chain K dependent attention applications inside ONE jit (lax.scan whose
+    carry feeds the next q — nothing can be elided), force materialization
+    with a scalar readback, and report the MARGINAL rate between a small-K
+    and large-K run — the fixed ~100ms tunnel readback cancels out.
+    """
     import time
 
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from brpc_tpu.ops.ring_attention import ring_attention
     from brpc_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    # Sized for one chip at bf16; CPU fallback keeps shapes tiny so a
-    # CPU-only environment stays fast.
     batch, seq, d = (8, 4096, 128) if on_tpu else (2, 256, 32)
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    k_small, k_large = (8, 128) if on_tpu else (1, 4)
     mesh = make_mesh(jax.devices()[:1])
-    fn = ring_attention(mesh, SHARD_AXIS)
+    attn = ring_attention(mesh, SHARD_AXIS)
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (batch, seq, d), dtype) for kk in keys)
-    jax.block_until_ready(fn(q, k, v))  # compile
-    iters = 20 if on_tpu else 3
-    t0 = time.monotonic()
-    for _ in range(iters):
-        out = fn(q, k, v)
-    jax.block_until_ready(out)
-    dt = (time.monotonic() - t0) / iters
-    # 2 matmuls of [b,s,d]x[b,s,d] -> 4*b*s^2*d FLOPs (fwd attention).
-    tflops = 4.0 * batch * seq * seq * d / dt / 1e12
-    print(f"# ring attention ({dev.platform}): {tflops:.2f} TFLOP/s "
-          f"(b={batch} s={seq} d={d} {dtype.__name__}, {dt * 1e3:.1f}ms/it)",
+
+    def timed(K):
+        @jax.jit
+        def run(q, k, v):
+            out, _ = lax.scan(lambda c, _: (attn(c, k, v), None), q, None,
+                              length=K)
+            return jnp.sum(out.astype(jnp.float32))
+        float(run(q, k, v))  # compile + warm
+        samples = []
+        for _ in range(5):
+            t0 = time.monotonic()
+            float(run(q, k, v))  # scalar readback forces full compute
+            samples.append(time.monotonic() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    t_small, t_large = timed(k_small), timed(k_large)
+    flops_per_iter = 4.0 * batch * seq * seq * d  # QK^T + PV
+    dt = t_large - t_small
+    # A delta that isn't comfortably above the noise floor means the
+    # measurement is junk (scheduler/tunnel jitter inverted it); skip the
+    # point (main()'s try/except reports it) rather than publish garbage.
+    if dt < 0.25 * t_small:
+        raise RuntimeError(
+            f"delta timing noise-dominated (K={k_small}: {t_small * 1e3:.1f}ms,"
+            f" K={k_large}: {t_large * 1e3:.1f}ms)")
+    tflops = (k_large - k_small) * flops_per_iter / dt / 1e12
+    ms_per_iter = dt / (k_large - k_small) * 1e3
+    print(f"# ring attention ({dev.platform}): {tflops:.1f} TFLOP/s "
+          f"sustained (b={batch} s={seq} d={d} {dtype.__name__}, "
+          f"{ms_per_iter:.2f}ms/application, delta {k_small}->{k_large})",
           file=sys.stderr)
-    return {"tflops": round(tflops, 2), "platform": dev.platform,
-            "batch": batch, "seq": seq, "d": d, "ms_per_iter": round(dt * 1e3, 2)}
+    return {"tflops": round(tflops, 1), "platform": dev.platform,
+            "batch": batch, "seq": seq, "d": d,
+            "ms_per_application": round(ms_per_iter, 3)}
 
 
 if __name__ == "__main__":
