@@ -150,6 +150,21 @@ int fiber_usleep(uint64_t us) {
   return 0;
 }
 
+int fiber_timer_add(fiber_timer_t* id, int64_t abstime_us,
+                    void (*fn)(void*), void* arg) {
+  TimerThread::TaskId tid = TimerThread::singleton()->schedule(fn, arg,
+                                                              abstime_us);
+  if (tid == TimerThread::INVALID_TASK_ID) {
+    return ESHUTDOWN;  // timer thread stopped (reference uses its ESTOP)
+  }
+  if (id != nullptr) *id = tid;
+  return 0;
+}
+
+int fiber_timer_del(fiber_timer_t id) {
+  return TimerThread::singleton()->unschedule(id);
+}
+
 void fiber_stop_world() { TaskControl::singleton()->stop_and_join(); }
 
 }  // namespace tbthread

@@ -46,6 +46,20 @@ int fiber_add_worker_group(int tag, int nworkers,
 // fiber-blocking IO without owning a Socket.
 int fiber_fd_wait(int fd, unsigned int epoll_events, int64_t deadline_us = 0);
 
+// One-shot timer: `fn(arg)` runs ON THE TIMER THREAD at abstime_us
+// (gettimeofday clock) — start a fiber from the callback for anything
+// heavier than a flag/wake (same discipline as the reference's
+// bthread_timer_add, which this mirrors). Returns 0 and fills *id on
+// success. fiber_timer_del returns 0 when the timer was CANCELLED before
+// running; nonzero when it already ran / is running (reference
+// bthread_timer_del semantics — caller then must not free resources the
+// callback touches until it finishes). add returns ESHUTDOWN after
+// fiber_stop_world() (the reference's ESTOP analog).
+using fiber_timer_t = uint64_t;
+int fiber_timer_add(fiber_timer_t* id, int64_t abstime_us,
+                    void (*fn)(void*), void* arg);
+int fiber_timer_del(fiber_timer_t id);
+
 // Test/shutdown hook: stops all workers. Irreversible within the process.
 void fiber_stop_world();
 

@@ -530,4 +530,43 @@ TEST_CASE(worker_tags_isolate_and_pin) {
   ASSERT_EQ(affinity_ok.load(), 1);
 }
 
+// Public one-shot timer API (reference bthread_timer_add/del).
+TEST_CASE(fiber_timer_add_del) {
+  using namespace tbthread;
+  // Fires: callback wakes a parked fiber via a countdown.
+  static CountdownEvent fired(1);
+  static std::atomic<int64_t> fired_at{0};
+  fiber_timer_t t1 = 0;
+  const int64_t want = tbutil::gettimeofday_us() + 30 * 1000;
+  ASSERT_EQ(fiber_timer_add(&t1, want,
+                            [](void*) {
+                              fired_at.store(tbutil::gettimeofday_us());
+                              fired.signal();
+                            },
+                            nullptr),
+            0);
+  {
+    timespec abst{};
+    const int64_t dl = tbutil::gettimeofday_us() + 5 * 1000000;
+    abst.tv_sec = dl / 1000000;
+    abst.tv_nsec = (dl % 1000000) * 1000;
+    ASSERT_TRUE(fired.timed_wait(abst));  // a lost timer fails, not hangs
+  }
+  // Fired at/after the deadline (scheduling jitter allowed, not early).
+  ASSERT_TRUE(fired_at.load() >= want - 1000);
+  // Already ran: del reports "too late".
+  ASSERT_TRUE(fiber_timer_del(t1) != 0);
+
+  // Cancelled before running: callback must never fire.
+  static std::atomic<int> cancelled_fired{0};
+  fiber_timer_t t2 = 0;
+  ASSERT_EQ(fiber_timer_add(&t2, tbutil::gettimeofday_us() + 300 * 1000,
+                            [](void*) { cancelled_fired.fetch_add(1); },
+                            nullptr),
+            0);
+  ASSERT_EQ(fiber_timer_del(t2), 0);
+  fiber_usleep(400 * 1000);
+  ASSERT_EQ(cancelled_fired.load(), 0);
+}
+
 TEST_MAIN
