@@ -155,7 +155,7 @@ int fiber_timer_add(fiber_timer_t* id, int64_t abstime_us,
   TimerThread::TaskId tid = TimerThread::singleton()->schedule(fn, arg,
                                                               abstime_us);
   if (tid == TimerThread::INVALID_TASK_ID) {
-    return ESHUTDOWN;  // timer thread stopped (reference uses its ESTOP)
+    return ESHUTDOWN;  // timer thread in teardown (reference ESTOP analog)
   }
   if (id != nullptr) *id = tid;
   return 0;

@@ -53,8 +53,9 @@ int fiber_fd_wait(int fd, unsigned int epoll_events, int64_t deadline_us = 0);
 // success. fiber_timer_del returns 0 when the timer was CANCELLED before
 // running; nonzero when it already ran / is running (reference
 // bthread_timer_del semantics — caller then must not free resources the
-// callback touches until it finishes). add returns ESHUTDOWN after
-// fiber_stop_world() (the reference's ESTOP analog).
+// callback touches until it finishes). The timer thread lives for the
+// whole process; add returns ESHUTDOWN only during its teardown at exit
+// (the reference's ESTOP analog).
 using fiber_timer_t = uint64_t;
 int fiber_timer_add(fiber_timer_t* id, int64_t abstime_us,
                     void (*fn)(void*), void* arg);

@@ -536,6 +536,24 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
 
 // ---- request dispatch (server) ----
 
+// errno -> grpc-status for server responses (inverse of the client-side
+// status->errno map below; gRPC spec status codes).
+int grpc_status_for_errno(int err) {
+  switch (err) {
+    case 0: return 0;                       // OK
+    case TRPC_ECANCELED: return 1;          // CANCELLED
+    case TRPC_EREQUEST: return 3;           // INVALID_ARGUMENT
+    case TRPC_ERPCTIMEDOUT: return 4;       // DEADLINE_EXCEEDED
+    case TRPC_ELIMIT: return 8;             // RESOURCE_EXHAUSTED
+    case EACCES: return 7;                  // PERMISSION_DENIED
+    case TRPC_ENOSERVICE:
+    case TRPC_ENOMETHOD: return 12;         // UNIMPLEMENTED
+    case TRPC_EINTERNAL: return 13;         // INTERNAL
+    case TRPC_EFAILEDSOCKET: return 14;     // UNAVAILABLE
+    default: return 2;                      // UNKNOWN
+  }
+}
+
 void send_h2_error(Socket* s, H2Connection* conn, uint32_t stream_id,
                    bool grpc, int http_status, int grpc_status,
                    const std::string& message) {
@@ -669,8 +687,9 @@ void h2_process_request(InputMessageBase* base) {
           // DATA: 5-byte message prefix + payload, queued through the
           // flow-control path.
           HeaderList trailers;
-          trailers.emplace_back("grpc-status",
-                                std::to_string(cntl->Failed() ? 2 : 0));
+          trailers.emplace_back(
+              "grpc-status",
+              std::to_string(grpc_status_for_errno(cntl->ErrorCode())));
           if (cntl->Failed()) {
             trailers.emplace_back("grpc-message", cntl->ErrorText());
           }

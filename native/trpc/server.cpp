@@ -1,5 +1,7 @@
 #include "trpc/server.h"
 
+#include "trpc/errno.h"
+
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -37,6 +39,43 @@ int32_t Server::current_max_concurrency() const {
   return _limiter != nullptr ? _limiter->max_concurrency() : 0;
 }
 
+namespace {
+
+// Builtin gRPC health responder: standard probes (k8s, grpcurl, cloud
+// LBs) call /grpc.health.v1.Health/Check and expect a protobuf
+// HealthCheckResponse{status: SERVING} — on the wire exactly the two
+// bytes 0x08 0x01 (field 1, varint 1), so no protobuf dependency is
+// needed. Watch (server-streaming) answers UNIMPLEMENTED via ENOMETHOD.
+// The reference serves gRPC health through its builtin health service
+// family; ours registers automatically unless the app supplied its own.
+class GrpcHealthService;
+GrpcHealthService* builtin_grpc_health();
+
+class GrpcHealthService : public Service {
+ public:
+  std::string_view service_name() const override {
+    return "grpc.health.v1.Health";
+  }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override {
+    (void)request;  // any service name in the request is reported SERVING
+    if (method == "Check") {
+      response->append("\x08\x01", 2);
+    } else {
+      cntl->SetFailed(TRPC_ENOMETHOD, "unimplemented: " + method);
+    }
+    done->Run();
+  }
+};
+
+GrpcHealthService* builtin_grpc_health() {
+  static GrpcHealthService* health = new GrpcHealthService;  // immortal
+  return health;
+}
+
+}  // namespace
+
 int Server::AddService(Service* service) {
   if (service == nullptr) return -1;
   if (_running.load(std::memory_order_acquire)) {
@@ -44,7 +83,12 @@ int Server::AddService(Service* service) {
     return -1;
   }
   std::string name(service->service_name());
-  if (_services.seek(name) != nullptr) {
+  Service** existing = _services.seek(name);
+  if (existing != nullptr && *existing == builtin_grpc_health()) {
+    *existing = service;  // a user health service replaces the builtin
+    return 0;
+  }
+  if (existing != nullptr) {
     TB_LOG(ERROR) << "duplicate service: " << name;
     return -1;
   }
@@ -62,6 +106,10 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   if (_running.load(std::memory_order_acquire)) return -1;
   GlobalInitializeOrDie();
   if (options != nullptr) _options = *options;
+  if (_options.enable_grpc_health &&
+      _services.seek(std::string("grpc.health.v1.Health")) == nullptr) {
+    AddService(builtin_grpc_health());
+  }
   if (_options.timeout_concurrency_ms > 0) {
     _limiter = NewTimeoutLimiter(_options.timeout_concurrency_ms * 1000);
   } else if (_options.auto_concurrency) {

@@ -63,6 +63,9 @@ struct ServerOptions {
   // replay with rpc_replay/rpc_press (reference rpc_dump.h:67; sampling
   // rate via the rpc_dump_sample_every flag). Empty = off.
   std::string rpc_dump_path;
+  // Auto-register the builtin /grpc.health.v1.Health responder (standard
+  // gRPC health probes). A user service with that name always wins.
+  bool enable_grpc_health = true;
   // TLS (reference ServerOptions.ssl_options / ssl_helper.cpp): both set =
   // the port ALSO accepts TLS — the first byte is sniffed, so plaintext and
   // TLS clients share the listener. ALPN advertises h2 + http/1.1.

@@ -172,3 +172,22 @@ def test_https_console(tls_echo_server, tls_material):
             f"https://{host}:{port}/health", context=ctx, timeout=10) as r:
         assert r.status == 200
         assert b"ok" in r.read().lower()
+
+
+def test_grpc_health_check(echo_server):
+    """The builtin grpc.health.v1.Health/Check responder: standard probes
+    get HealthCheckResponse{status: SERVING} (wire bytes 08 01) without
+    the app registering anything."""
+    channel = grpc.insecure_channel(echo_server)
+    check = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=None, response_deserializer=None)
+    assert check(b"") == b"\x08\x01"
+    # Unknown method maps to UNIMPLEMENTED.
+    watch = channel.unary_unary(
+        "/grpc.health.v1.Health/Watch",
+        request_serializer=None, response_deserializer=None)
+    with pytest.raises(grpc.RpcError) as err:
+        watch(b"")
+    assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    channel.close()
