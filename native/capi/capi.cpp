@@ -12,6 +12,7 @@
 #include "trpc/channel.h"
 #include "trpc/errno.h"
 #include "trpc/server.h"
+#include "ttpu/tensor_arena.h"
 
 using namespace trpc;
 
@@ -71,11 +72,27 @@ class CallbackService : public Service {
   void* _ctx;
 };
 
+class TensorCallbackService : public Service {
+ public:
+  TensorCallbackService(std::string name, tbrpc_tensor_handler_cb cb,
+                        void* ctx)
+      : _name(std::move(name)), _cb(cb), _ctx(ctx) {}
+  std::string_view service_name() const override { return _name; }
+  void CallMethod(const std::string& method, Controller* cntl,
+                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
+                  Closure* done) override;
+
+ private:
+  std::string _name;
+  tbrpc_tensor_handler_cb _cb;
+  void* _ctx;
+};
+
 struct ServerBox {
   Server server;
   NativeEchoService echo;
   bool echo_added = false;
-  std::vector<CallbackService*> services;
+  std::vector<Service*> services;
   ~ServerBox() {
     for (auto* s : services) delete s;
   }
@@ -168,6 +185,183 @@ void tbrpc_channel_destroy(void* channel) {
 
 void* tbrpc_alloc(size_t n) { return malloc(n); }
 void tbrpc_free(void* p) { free(p); }
+
+// ---------------- TensorArena ----------------
+
+namespace {
+
+struct ArenaBox {
+  std::shared_ptr<ttpu::TensorArena> arena;
+};
+
+// THE user-data deleter for locally-owned arena ranges riding in IOBufs.
+void local_arena_release(void* ptr) {
+  auto arena = ttpu::TensorArena::FindContaining(ptr);
+  if (arena != nullptr) arena->OnLocalRelease(ptr);
+}
+
+// Append [off, off+len) of `arena` to `buf` as a tagged zero-copy block
+// (the tag lets the tpu:// send path ship it by reference).
+void append_arena_range(tbutil::IOBuf* buf, ttpu::TensorArena* arena,
+                        uint64_t off, size_t len) {
+  arena->AddLocalRef(off);
+  buf->append_user_data_with_meta(arena->base() + off, len,
+                                  &local_arena_release,
+                                  ttpu::arena_meta(arena->id()));
+}
+
+struct ViewBox {
+  tbutil::IOBuf buf;
+};
+
+}  // namespace
+
+void* tbrpc_arena_create(size_t bytes) {
+  auto arena = ttpu::TensorArena::Create(bytes);
+  if (arena == nullptr) return nullptr;
+  return new ArenaBox{std::move(arena)};
+}
+
+void tbrpc_arena_destroy(void* arena) {
+  auto* box = static_cast<ArenaBox*>(arena);
+  if (box == nullptr) return;
+  // Keep the mapping alive until in-flight references drain — a socket
+  // write queue may still point into the pages.
+  ttpu::TensorArena::DestroyWhenIdle(std::move(box->arena));
+  delete box;
+}
+
+void* tbrpc_arena_base(void* arena) {
+  return static_cast<ArenaBox*>(arena)->arena->base();
+}
+
+int64_t tbrpc_arena_alloc(void* arena, size_t len) {
+  return static_cast<ArenaBox*>(arena)->arena->Alloc(len);
+}
+
+int tbrpc_arena_free(void* arena, uint64_t off) {
+  return static_cast<ArenaBox*>(arena)->arena->Free(off);
+}
+
+int64_t tbrpc_arena_busy_bytes(void* arena) {
+  return static_cast<ArenaBox*>(arena)->arena->busy_bytes();
+}
+
+int tbrpc_arena_wait_reusable(void* arena, uint64_t off, int64_t timeout_ms) {
+  return static_cast<ArenaBox*>(arena)->arena->WaitReusable(off, timeout_ms);
+}
+
+int tbrpc_call_tensor(void* channel, const char* service_method,
+                      const void* req, size_t req_len, void* arena,
+                      uint64_t att_off, size_t att_len, void** resp,
+                      size_t* resp_len, void** view, const void** ratt_ptr,
+                      size_t* ratt_len, int* ratt_copied, char* errbuf,
+                      size_t errbuf_len) {
+  auto* box = static_cast<ChannelBox*>(channel);
+  Controller cntl;
+  tbutil::IOBuf request, response;
+  if (req_len > 0) request.append(req, req_len);
+  if (arena != nullptr && att_len > 0) {
+    append_arena_range(&cntl.request_attachment(),
+                       static_cast<ArenaBox*>(arena)->arena.get(), att_off,
+                       att_len);
+  }
+  box->channel.CallMethod(service_method, &cntl, request, &response, nullptr);
+  if (cntl.Failed()) {
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s", cntl.ErrorText().c_str());
+    }
+    return cntl.ErrorCode() != 0 ? cntl.ErrorCode() : -1;
+  }
+  if (resp != nullptr) {
+    *resp_len = response.size();
+    *resp = malloc(response.size() > 0 ? response.size() : 1);
+    response.copy_to(*resp, response.size());
+  }
+  if (view != nullptr) {
+    *view = nullptr;
+    *ratt_ptr = nullptr;
+    *ratt_len = cntl.response_attachment().size();
+    *ratt_copied = 0;
+    if (*ratt_len > 0) {
+      tbutil::IOBuf& att = cntl.response_attachment();
+      if (att.backing_block_num() == 1) {
+        // Contiguous (the single-ref tensor case): hand back the bytes in
+        // place; the view keeps the block — and through its deleter the
+        // remote arena range — alive until tbrpc_view_free.
+        auto* vb = new ViewBox;
+        vb->buf.append(att);
+        *view = vb;
+        *ratt_ptr = vb->buf.backing_block(0).data();
+      } else {
+        void* flat = malloc(*ratt_len);
+        att.copy_to(flat, *ratt_len);
+        *ratt_ptr = flat;
+        *ratt_copied = 1;
+      }
+    }
+  }
+  return 0;
+}
+
+void tbrpc_view_free(void* view) { delete static_cast<ViewBox*>(view); }
+
+void TensorCallbackService::CallMethod(const std::string& method,
+                                       Controller* cntl,
+                                       const tbutil::IOBuf& request,
+                                       tbutil::IOBuf* response,
+                                       Closure* done) {
+  const std::string req = request.to_string();
+  // Request attachment IN PLACE when it arrived as one block (the
+  // zero-copy tensor receive: the pointer is inside this process's mapping
+  // of the sender's arena / the connection's RX segment).
+  const tbutil::IOBuf& att = cntl->request_attachment();
+  std::string att_flat;
+  const void* att_ptr = nullptr;
+  const size_t att_len = att.size();
+  if (att.backing_block_num() == 1) {
+    att_ptr = att.backing_block(0).data();
+  } else if (att_len > 0) {
+    att.copy_to(&att_flat, att_len);
+    att_ptr = att_flat.data();
+  }
+  void* resp = nullptr;
+  size_t resp_len = 0;
+  void* resp_arena = nullptr;
+  uint64_t resp_att_off = 0;
+  size_t resp_att_len = 0;
+  int error_code = 0;
+  _cb(_ctx, method.c_str(), req.data(), req.size(), att_ptr, att_len, &resp,
+      &resp_len, &resp_arena, &resp_att_off, &resp_att_len, &error_code);
+  if (error_code != 0) {
+    cntl->SetFailed(error_code, "tensor service callback failed");
+  } else {
+    if (resp != nullptr && resp_len > 0) {
+      response->append(resp, resp_len);
+    }
+    if (resp_arena != nullptr && resp_att_len > 0) {
+      // The response tensor lives in the server's arena: it rides back by
+      // reference; the client's view release returns the range.
+      append_arena_range(&cntl->response_attachment(),
+                         static_cast<ArenaBox*>(resp_arena)->arena.get(),
+                         resp_att_off, resp_att_len);
+    }
+  }
+  free(resp);
+  done->Run();
+}
+
+int tbrpc_server_add_tensor_service(void* server, const char* name,
+                                    tbrpc_tensor_handler_cb cb, void* ctx) {
+  auto* box = static_cast<ServerBox*>(server);
+  auto* svc = new TensorCallbackService(name, cb, ctx);
+  if (box->server.AddService(svc) != 0) {
+    delete svc;
+    return -1;
+  }
+  box->services.push_back(svc);
+  return 0;
+}
 
 int tbrpc_call(void* channel, const char* service_method, const void* req,
                size_t req_len, const void* attach, size_t attach_len,

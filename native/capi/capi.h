@@ -53,6 +53,53 @@ int tbrpc_call(void* channel, const char* service_method, const void* req,
 void* tbrpc_alloc(size_t n);
 void tbrpc_free(void* p);
 
+// ---- TensorArena: registered transfer memory for tensor payloads ----
+// The RDMA-registration analog (reference rdma_helper.h:48): a shm-backed
+// region both ends of a tpu:// connection map. Attachments that live in an
+// arena cross the transport BY REFERENCE (zero host-side copies); over
+// plain TCP they writev straight from arena pages.
+void* tbrpc_arena_create(size_t bytes);  // null on failure; bytes <= 4GB
+void tbrpc_arena_destroy(void* arena);
+void* tbrpc_arena_base(void* arena);
+// First-fit range allocator (64B-aligned). Returns offset or -1.
+int64_t tbrpc_arena_alloc(void* arena, size_t len);
+// Deferred free: the range returns to the allocator once every local and
+// remote (wire) reference has dropped.
+int tbrpc_arena_free(void* arena, uint64_t off);
+int64_t tbrpc_arena_busy_bytes(void* arena);
+// Block the calling thread until `off`'s range has no references (safe to
+// overwrite). timeout_ms < 0 waits forever. 0 ok, -1 timeout.
+int tbrpc_arena_wait_reusable(void* arena, uint64_t off, int64_t timeout_ms);
+
+// Synchronous call whose request attachment is the arena range
+// [att_off, att_off+att_len). The response attachment comes back as a VIEW
+// when it is contiguous (zero-copy for single-range tensor responses over
+// tpu://): *view must be released with tbrpc_view_free (that release is
+// what returns the server's arena range); *ratt_ptr/*ratt_len point at the
+// bytes in place. *ratt_copied=1 means it was flattened into a tbrpc_alloc
+// buffer instead (then *view is null and *ratt_ptr is freed by the caller
+// via tbrpc_free). arena may be null / att_len 0 for no attachment.
+int tbrpc_call_tensor(void* channel, const char* service_method,
+                      const void* req, size_t req_len, void* arena,
+                      uint64_t att_off, size_t att_len, void** resp,
+                      size_t* resp_len, void** view, const void** ratt_ptr,
+                      size_t* ratt_len, int* ratt_copied, char* errbuf,
+                      size_t errbuf_len);
+void tbrpc_view_free(void* view);
+
+// Tensor service: the handler sees the request attachment IN PLACE (no
+// copy when it arrived as one zero-copy block) and may return its response
+// attachment as a range of a local arena — it rides back by reference.
+// resp_arena null => no response attachment.
+typedef void (*tbrpc_tensor_handler_cb)(
+    void* ctx, const char* method, const void* req, size_t req_len,
+    const void* att, size_t att_len,
+    void** resp, size_t* resp_len,  // tbrpc_alloc'd, ownership passes back
+    void** resp_arena, uint64_t* resp_att_off, size_t* resp_att_len,
+    int* error_code);
+int tbrpc_server_add_tensor_service(void* server, const char* name,
+                                    tbrpc_tensor_handler_cb cb, void* ctx);
+
 // ---- bench harness (loops in C so Python overhead is out of the path) ----
 // Echo round-trips of `payload_size`-byte attachments for ~`seconds`, with
 // `concurrency` concurrent callers. Returns one-way payload bytes/sec.

@@ -12,6 +12,7 @@
 
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "ttpu/tensor_arena.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
 #include "trpc/protocol.h"
@@ -63,6 +64,51 @@ void build_hello(std::string* out, uint8_t type, const IciSegment& seg) {
   out->append(seg.name());
 }
 
+void build_reg_arena(std::string* out, const TensorArena& arena) {
+  append_prefix(out, ici_internal::kRegArena);
+  put_u32(out, arena.id());
+  put_u32(out, static_cast<uint32_t>(arena.bytes()));
+  put_u16(out, static_cast<uint16_t>(arena.name().size()));
+  out->append(arena.name());
+}
+
+// True iff this block must ship BY REFERENCE: tagged as arena memory AND
+// the arena is still live AND the pointer is really inside it (a stale or
+// foreign tag — e.g. a forwarded block materialized from a PEER's arena —
+// ships as ordinary bytes instead).
+bool is_live_arena_block(const void* data, uint64_t meta,
+                         std::shared_ptr<TensorArena>* arena_out) {
+  if (!is_arena_meta(meta)) return false;
+  auto arena = TensorArena::ById(static_cast<uint32_t>(meta));
+  if (arena == nullptr || !arena->contains(data)) return false;
+  *arena_out = std::move(arena);
+  return true;
+}
+
+// Length of the front run of ordinary bytes (stops at the first LIVE
+// arena-backed block): the portion WriteMessage must copy into TX segment
+// blocks before the next by-reference send. Dead-tagged blocks count as
+// ordinary so they are copied, not re-judged forever.
+size_t plain_prefix_len(const tbutil::IOBuf& msg) {
+  struct Acc {
+    size_t n = 0;
+    bool stopped = false;
+  } acc;
+  msg.for_each_ref(
+      [](void* ctx, const void* data, size_t len, uint64_t meta) {
+        auto* a = static_cast<Acc*>(ctx);
+        if (a->stopped) return;
+        std::shared_ptr<TensorArena> unused;
+        if (is_live_arena_block(data, meta, &unused)) {
+          a->stopped = true;
+          return;
+        }
+        a->n += len;
+      },
+      &acc);
+  return acc.n;
+}
+
 }  // namespace
 
 IciEndpoint::IciEndpoint(trpc::Socket* s)
@@ -76,6 +122,15 @@ IciEndpoint::~IciEndpoint() {
   // mapped through the registry; unmap happens at the last release.
   if (_rx != nullptr) {
     PeerSegmentRegistry::OnEndpointGone(_rx.get());
+  }
+  for (auto& [id, mapping] : _peer_arenas) {
+    ArenaRxRegistry::OnEndpointGone(mapping.get());
+  }
+  // Wire refs that never got their release (peer died): hand the ranges
+  // back to their arenas so senders aren't stuck waiting on a dead socket.
+  for (const auto& [aid, off, len] : _sent_refs) {
+    auto arena = TensorArena::ById(aid);
+    if (arena != nullptr) arena->OnRemoteRelease(off, len);
   }
   _rx_new.clear();
   _rx_done.clear();
@@ -216,34 +271,95 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now) {
       // the peer; strict FIFO with doorbells since both ride one stream).
       _pending_ctrl.append(std::move(*msg));
     } else {
-      // Move as much as credit allows into TX blocks, one doorbell for the
-      // batch. Partial delivery is fine: the peer accumulates bytes.
+      // Block path. Walk the message front to back: arena-backed blocks
+      // (registered tensor memory, tagged via their IOBuf meta) ship BY
+      // REFERENCE — the bytes never move, the doorbell carries
+      // (arena_id, off, len) and consumes no TX credit. Ordinary bytes
+      // move into TX segment blocks as credit allows; partial delivery is
+      // fine (the peer accumulates). Both ref kinds share one kData frame
+      // in stream order, so tensors interleave exactly where the tstd
+      // frame put them.
       const uint32_t bs = _tx->block_size();
-      uint32_t want = static_cast<uint32_t>((msg->size() + bs - 1) / bs);
-      std::vector<uint32_t> blocks;
-      blocks.reserve(want);
-      _tx->AllocBatch(want, &blocks);
-      if (!blocks.empty()) {
+      std::string refs;
+      uint32_t n_refs = 0;
+      std::vector<uint32_t> blocks;  // TX blocks drawn for the plain runs
+      size_t bi = 0;
+      size_t moved = 0;
+      size_t plain_remaining = 0;  // bytes left in the current plain run
+      // Receiver frame bound is rx_blocks + 4096; chunk well under it (the
+      // doorbell stream is a byte stream, so a message may span frames).
+      constexpr uint32_t kMaxRefsPerFrame = 1024;
+      auto flush_frame = [&] {
+        if (n_refs == 0) return;
         std::string frame;
         append_prefix(&frame, ici_internal::kData);
-        put_u32(&frame, static_cast<uint32_t>(blocks.size()));
-        size_t moved = 0;
-        for (uint32_t idx : blocks) {
-          const uint32_t len =
-              static_cast<uint32_t>(msg->cutn(_tx->block(idx), bs));
-          put_u32(&frame, idx);
-          put_u32(&frame, 0);
-          put_u32(&frame, len);
-          moved += len;
-          // HELD -> INFLIGHT: the block returns to the pool when the peer's
-          // credit arrives, not before.
-          _tx->MarkInflight(idx);
-          _tx->Release(idx);
-        }
-        trpc::GlobalRpcMetrics::instance().bytes_out
-            << static_cast<int64_t>(moved);
+        put_u32(&frame, n_refs);
+        frame.append(refs);
         _pending_ctrl.append(frame);
+        refs.clear();
+        n_refs = 0;
+      };
+      while (!msg->empty()) {
+        const tbutil::IOBuf::BlockRef& fr = msg->front_ref();
+        const char* ptr = tbutil::IOBuf::block_data(fr.block) + fr.offset;
+        std::shared_ptr<TensorArena> arena;
+        if (plain_remaining == 0 &&
+            is_live_arena_block(ptr, msg->get_first_data_meta(), &arena)) {
+          const uint32_t len = fr.length;
+          const uint64_t off = ptr - arena->base();
+          if (_arenas_announced.insert(arena->id()).second) {
+            // First use on this connection: announce ahead of the data
+            // frame — the control stream is FIFO, so the peer maps the
+            // arena before any ref that needs it.
+            std::string reg;
+            build_reg_arena(&reg, *arena);
+            _pending_ctrl.append(reg);
+          }
+          arena->AddRemoteRef(off);
+          {
+            std::lock_guard<std::mutex> lk(_sent_refs_mu);
+            _sent_refs.insert({arena->id(), off, uint64_t(len)});
+          }
+          put_u32(&refs, ici_internal::kArenaRefFlag | arena->id());
+          put_u32(&refs, static_cast<uint32_t>(off));
+          put_u32(&refs, len);
+          if (++n_refs >= kMaxRefsPerFrame) flush_frame();
+          moved += len;
+          msg->pop_front(len);  // drops this message's local ref
+          continue;
+        }
+        // Ordinary bytes: copy the plain run into TX blocks (stopping at
+        // the next live arena block so its bytes are never duplicated).
+        // The run length is computed once per run, not per block.
+        if (plain_remaining == 0) plain_remaining = plain_prefix_len(*msg);
+        if (bi == blocks.size()) {
+          _tx->AllocBatch(
+              static_cast<uint32_t>((plain_remaining + bs - 1) / bs),
+              &blocks);
+          if (bi == blocks.size()) break;  // out of credit
+        }
+        const uint32_t idx = blocks[bi++];
+        const uint32_t len = static_cast<uint32_t>(msg->cutn(
+            _tx->block(idx), std::min<size_t>(bs, plain_remaining)));
+        plain_remaining -= len;
+        put_u32(&refs, idx);
+        put_u32(&refs, 0);
+        put_u32(&refs, len);
+        if (++n_refs >= kMaxRefsPerFrame) flush_frame();
+        moved += len;
+        // HELD -> INFLIGHT: the block returns to the pool when the peer's
+        // credit arrives, not before.
+        _tx->MarkInflight(idx);
+        _tx->Release(idx);
       }
+      // Blocks over-drawn for a run that ended early (arena boundary) go
+      // straight back to the pool.
+      for (; bi < blocks.size(); ++bi) {
+        _tx->Release(blocks[bi]);
+      }
+      flush_frame();
+      trpc::GlobalRpcMetrics::instance().bytes_out
+          << static_cast<int64_t>(moved);
       _tx_mid_message = !msg->empty();
       if (!msg->empty()) starved = true;  // out of blocks mid-message
     }
@@ -313,6 +429,21 @@ void IciEndpoint::QueueCredit(uint32_t block_idx) {
   tbthread::butex_increment_and_wake_all(_credit_btx);
 }
 
+void IciEndpoint::QueueArenaRelease(uint32_t arena_id, uint64_t off,
+                                    uint64_t len) {
+  std::string frame;
+  append_prefix(&frame, ici_internal::kArenaRelease);
+  put_u32(&frame, arena_id);
+  put_u32(&frame, static_cast<uint32_t>(off));
+  put_u32(&frame, static_cast<uint32_t>(len));
+  {
+    std::lock_guard<std::mutex> lk(_outbox_mu);
+    _outbox.append(frame);
+    _outbox_nonempty.store(true, std::memory_order_release);
+  }
+  tbthread::butex_increment_and_wake_all(_credit_btx);
+}
+
 // ---------------- receiver half ----------------
 
 int IciEndpoint::MaterializeData(const uint8_t* refs, uint32_t n_refs) {
@@ -322,6 +453,23 @@ int IciEndpoint::MaterializeData(const uint8_t* refs, uint32_t n_refs) {
     memcpy(&idx, p, 4);
     memcpy(&off, p + 4, 4);
     memcpy(&len, p + 8, 4);
+    if (idx & ici_internal::kArenaRefFlag) {
+      // Registered-arena ref: the bytes live in the sender's TensorArena,
+      // which we mapped when its kRegArena frame arrived (FIFO guarantees
+      // that happened first). Materialize a block pointing INTO the shared
+      // pages — the zero-copy receive half of the tensor bridge.
+      auto it = _peer_arenas.find(idx & ~ici_internal::kArenaRefFlag);
+      if (it == _peer_arenas.end()) return -1;
+      IciSegment* m = it->second.get();
+      const uint64_t arena_bytes = uint64_t(m->block_size()) * m->n_blocks();
+      if (len == 0 || uint64_t(off) + len > arena_bytes) return -1;
+      char* ptr = m->base() + off;
+      ArenaRxRegistry::OnMaterialize(ptr, len);
+      _rx_new.append_user_data_with_meta(ptr, len,
+                                         &ArenaRxRegistry::OnRelease,
+                                         /*meta=*/0);
+      continue;
+    }
     if (idx >= _rx->n_blocks() || len == 0 ||
         size_t(off) + len > _rx->block_size()) {
       return -1;
@@ -332,6 +480,28 @@ int IciEndpoint::MaterializeData(const uint8_t* refs, uint32_t n_refs) {
                                        /*meta=*/idx + 1);
   }
   return 0;
+}
+
+int IciEndpoint::OnRegArena(uint32_t arena_id, uint32_t bytes,
+                            const std::string& name) {
+  if (_peer_arenas.count(arena_id) != 0) return -1;  // duplicate announce
+  auto mapping = IciSegment::MapPeer(name, bytes, 1);
+  if (mapping == nullptr) return -1;
+  ArenaRxRegistry::Register(mapping, _socket_id, arena_id);
+  _peer_arenas[arena_id] = std::move(mapping);
+  return 0;
+}
+
+void IciEndpoint::OnArenaReleaseFrame(uint32_t arena_id, uint64_t off,
+                                      uint64_t len) {
+  {
+    std::lock_guard<std::mutex> lk(_sent_refs_mu);
+    auto it = _sent_refs.find({arena_id, off, len});
+    if (it == _sent_refs.end()) return;  // stale/bogus release
+    _sent_refs.erase(it);
+  }
+  auto arena = TensorArena::ById(arena_id);
+  if (arena != nullptr) arena->OnRemoteRelease(off, len);
 }
 
 // Copy the newest doorbell's segment-backed refs into heap memory and drop
@@ -387,6 +557,17 @@ void SendCreditFrame(uint64_t socket_id, uint32_t block_idx) {
   // Kick the write path: if no writer is active, this empty request runs
   // WriteMessage inline (flushing the outbox); if one is active, it either
   // drains the outbox on its next loop or is woken by QueueCredit.
+  tbutil::IOBuf empty;
+  s->Write(&empty);
+}
+
+void SendArenaReleaseFrame(uint64_t socket_id, uint32_t arena_id,
+                           uint64_t off, uint64_t len) {
+  trpc::SocketUniquePtr s;
+  if (trpc::Socket::Address(socket_id, &s) != 0) return;  // peer gone
+  IciEndpoint* ep = s->ici_endpoint();
+  if (ep == nullptr) return;
+  ep->QueueArenaRelease(arena_id, off, len);
   tbutil::IOBuf empty;
   s->Write(&empty);
 }
@@ -493,7 +674,9 @@ trpc::ParseResult tici_parse(tbutil::IOBuf* source, trpc::Socket* socket) {
         }
         uint32_t n_refs;
         source->copy_to(&n_refs, 4, kPrefix);
-        if (n_refs == 0 || n_refs > ep->rx()->n_blocks()) {
+        // Bound: one frame can at most reference the whole TX window plus
+        // a batch of arena ranges (arena refs consume no blocks).
+        if (n_refs == 0 || n_refs > ep->rx()->n_blocks() + 4096) {
           r.error = trpc::PARSE_ERROR_ABSOLUTELY_WRONG;
           return r;
         }
@@ -531,6 +714,42 @@ trpc::ParseResult tici_parse(tbutil::IOBuf* source, trpc::Socket* socket) {
         source->copy_to(&idx, 4, kPrefix);
         source->pop_front(kPrefix + 4);
         ep->OnCreditFrame(idx);
+        continue;
+      }
+      case kRegArena: {
+        // Same body layout as HELLO: u32 id | u32 bytes | u16 len | name.
+        uint32_t arena_id, bytes;
+        std::string name;
+        ssize_t consumed = parse_hello_body(*source, &arena_id, &bytes, &name);
+        if (consumed == 0) {
+          r.error = trpc::PARSE_ERROR_NOT_ENOUGH_DATA;
+          return r;
+        }
+        if (consumed < 0 || ep == nullptr ||
+            ep->OnRegArena(arena_id, bytes, name) != 0) {
+          r.error = trpc::PARSE_ERROR_ABSOLUTELY_WRONG;
+          return r;
+        }
+        source->pop_front(consumed);
+        continue;
+      }
+      case kArenaRelease: {
+        if (source->size() < kPrefix + 12) {
+          r.error = trpc::PARSE_ERROR_NOT_ENOUGH_DATA;
+          return r;
+        }
+        if (ep == nullptr) {
+          r.error = trpc::PARSE_ERROR_ABSOLUTELY_WRONG;
+          return r;
+        }
+        uint8_t body[12];
+        source->copy_to(body, 12, kPrefix);
+        source->pop_front(kPrefix + 12);
+        uint32_t aid, off, len;
+        memcpy(&aid, body, 4);
+        memcpy(&off, body + 4, 4);
+        memcpy(&len, body + 8, 4);
+        ep->OnArenaReleaseFrame(aid, off, len);
         continue;
       }
       default:

@@ -30,9 +30,12 @@
 
 #include <atomic>
 #include <cstdint>
+#include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
+#include <tuple>
 
 #include "tbthread/butex.h"
 #include "tbutil/iobuf.h"
@@ -99,6 +102,14 @@ class IciEndpoint {
   // write queue: a writer parked for ITS credits would otherwise block the
   // very frames that un-park the peer — a cross-connection deadlock cycle.
   void QueueCredit(uint32_t block_idx);
+  // Same out-of-band path for arena release notifications (receiver side:
+  // the last IOBuf ref to a materialized arena range dropped).
+  void QueueArenaRelease(uint32_t arena_id, uint64_t off, uint64_t len);
+
+  // ---- registered tensor memory (TensorArena) over this connection ----
+  // Parse-fiber handlers for the arena control frames.
+  int OnRegArena(uint32_t arena_id, uint32_t bytes, const std::string& name);
+  void OnArenaReleaseFrame(uint32_t arena_id, uint64_t off, uint64_t len);
   // Next complete inner message accumulated from doorbells, if any.
   // Implements the zero-copy fast path + partial-message compaction.
   trpc::ParseResult ParseInner(trpc::Socket* s);
@@ -139,6 +150,15 @@ class IciEndpoint {
   // message that spans doorbells (each byte copied at most once).
   tbutil::IOBuf _rx_new;
   tbutil::IOBuf _rx_done;
+  // Arena glue. _arenas_announced: local arenas already advertised on this
+  // connection (writer fiber only — single-writer discipline). _peer_arenas:
+  // peer arenas mapped from kRegArena (input fiber only). _sent_refs:
+  // wire refs emitted and not yet released, so socket death can return the
+  // ranges to their arenas (writer inserts, input fiber erases: locked).
+  std::set<uint32_t> _arenas_announced;
+  std::map<uint32_t, std::shared_ptr<IciSegment>> _peer_arenas;
+  std::mutex _sent_refs_mu;
+  std::multiset<std::tuple<uint32_t, uint64_t, uint64_t>> _sent_refs;
 };
 
 // ---- wire frames (control channel) ----
@@ -151,12 +171,20 @@ enum FrameType : uint8_t {
   kHelloAck = 1,
   kData = 2,
   kCredit = 3,
+  // TensorArena (registered app memory) support:
+  kRegArena = 4,       // u32 arena_id | u32 bytes | u16 name_len | name
+  kArenaRelease = 5,   // u32 arena_id | u32 off | u32 len
 };
 inline constexpr size_t kPrefix = 8;
-// kData ref entry: u32 block_idx, u32 offset, u32 len.
+// kData ref entry: u32 block_idx, u32 offset, u32 len. A block_idx with
+// kArenaRefFlag set references a registered TensorArena instead of the
+// connection's TX segment: arena_id = block_idx & ~kArenaRefFlag.
 inline constexpr size_t kRefBytes = 12;
+inline constexpr uint32_t kArenaRefFlag = 0x80000000u;
 
 void SendCreditFrame(uint64_t socket_id, uint32_t block_idx);
+void SendArenaReleaseFrame(uint64_t socket_id, uint32_t arena_id,
+                           uint64_t off, uint64_t len);
 
 // The tici protocol parse (registered at kTiciProtocolIndex): consumes
 // control frames, returns DATA payloads as parsed INNER tstd messages.

@@ -1,0 +1,364 @@
+#include "ttpu/tensor_arena.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <tuple>
+#include <vector>
+
+#include "tbutil/logging.h"
+#include "trpc/socket.h"
+#include "ttpu/ici_endpoint.h"
+#include "ttpu/ici_segment.h"
+
+namespace ttpu {
+
+namespace {
+
+constexpr uint64_t kAlign = 64;
+
+// Arena shm names share the framework prefix so MapPeer's namespace check
+// and the crash-debris sweep (ici_segment.cpp) cover them too.
+std::string next_arena_name() {
+  static std::atomic<uint64_t> counter{0};
+  return "/brpctpu_" + std::to_string(getpid()) + "_t" +
+         std::to_string(counter.fetch_add(1));
+}
+
+struct ArenaDirectory {
+  std::mutex mu;
+  uint32_t next_id = 1;
+  std::map<uint32_t, std::weak_ptr<TensorArena>> by_id;
+  std::map<const char*, std::weak_ptr<TensorArena>> by_base;
+  // Arenas whose owner is gone but whose pages are still referenced by
+  // sockets/IOBufs: kept mapped until the last reference drains.
+  std::map<TensorArena*, std::shared_ptr<TensorArena>> graveyard;
+};
+ArenaDirectory& directory() {
+  static ArenaDirectory* d = new ArenaDirectory;
+  return *d;
+}
+
+}  // namespace
+
+std::shared_ptr<TensorArena> TensorArena::Create(size_t bytes) {
+  if (bytes == 0 || bytes > (1ULL << 32) - kAlign) return nullptr;
+  bytes = (bytes + kAlign - 1) & ~(kAlign - 1);
+  auto arena = std::shared_ptr<TensorArena>(new TensorArena);
+  arena->_name = next_arena_name();
+  arena->_bytes = bytes;
+  int fd = shm_open(arena->_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0 && errno == EEXIST) {
+    shm_unlink(arena->_name.c_str());  // same-pid crash debris
+    fd = shm_open(arena->_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  }
+  if (fd < 0) {
+    TB_LOG(ERROR) << "arena shm_open " << arena->_name << " failed: "
+                  << strerror(errno);
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+    close(fd);
+    shm_unlink(arena->_name.c_str());
+    return nullptr;
+  }
+  arena->_base = static_cast<char*>(
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0));
+  close(fd);
+  if (arena->_base == MAP_FAILED) {
+    arena->_base = nullptr;
+    shm_unlink(arena->_name.c_str());
+    return nullptr;
+  }
+  arena->_free[0] = bytes;
+  ArenaDirectory& d = directory();
+  std::lock_guard<std::mutex> lk(d.mu);
+  arena->_id = d.next_id++;
+  d.by_id[arena->_id] = arena;
+  d.by_base[arena->_base] = arena;
+  return arena;
+}
+
+TensorArena::~TensorArena() {
+  {
+    ArenaDirectory& d = directory();
+    std::lock_guard<std::mutex> lk(d.mu);
+    d.by_id.erase(_id);
+    d.by_base.erase(_base);
+  }
+  if (_base != nullptr) {
+    munmap(_base, _bytes);
+    shm_unlink(_name.c_str());
+  }
+}
+
+void TensorArena::DestroyWhenIdle(std::shared_ptr<TensorArena> arena) {
+  if (arena == nullptr) return;
+  if (arena->busy_bytes() == 0) return;  // caller's drop unmaps now
+  ArenaDirectory& d = directory();
+  std::lock_guard<std::mutex> lk(d.mu);
+  d.graveyard[arena.get()] = arena;
+}
+
+void TensorArena::MaybeReap() {
+  // Called (unlocked) after a release zeroed some range's refs: if this
+  // arena is parked in the graveyard and fully idle, let it die.
+  ArenaDirectory& d = directory();
+  std::shared_ptr<TensorArena> dying;  // destructor runs OUTSIDE d.mu
+  std::lock_guard<std::mutex> lk(d.mu);
+  auto it = d.graveyard.find(this);
+  if (it == d.graveyard.end()) return;
+  if (busy_bytes() != 0) return;
+  dying = std::move(it->second);
+  d.graveyard.erase(it);
+}
+
+std::shared_ptr<TensorArena> TensorArena::ById(uint32_t id) {
+  ArenaDirectory& d = directory();
+  std::lock_guard<std::mutex> lk(d.mu);
+  auto it = d.by_id.find(id);
+  return it == d.by_id.end() ? nullptr : it->second.lock();
+}
+
+std::shared_ptr<TensorArena> TensorArena::FindContaining(const void* p) {
+  ArenaDirectory& d = directory();
+  std::lock_guard<std::mutex> lk(d.mu);
+  auto it = d.by_base.upper_bound(static_cast<const char*>(p));
+  if (it == d.by_base.begin()) return nullptr;
+  --it;
+  auto arena = it->second.lock();
+  if (arena == nullptr || !arena->contains(p)) return nullptr;
+  return arena;
+}
+
+int64_t TensorArena::Alloc(size_t len) {
+  if (len == 0) return -1;
+  len = (len + kAlign - 1) & ~(kAlign - 1);
+  std::lock_guard<std::mutex> lk(_mu);
+  for (auto it = _free.begin(); it != _free.end(); ++it) {
+    if (it->second < len) continue;
+    const uint64_t off = it->first;
+    const uint64_t rest = it->second - len;
+    _free.erase(it);
+    if (rest > 0) _free[off + len] = rest;
+    Range r;
+    r.len = len;
+    _ranges[off] = r;
+    return static_cast<int64_t>(off);
+  }
+  return -1;
+}
+
+// Caller holds _mu. Reclaims `off` into the free list if it was freed by
+// the app and no local or remote reference remains; coalesces neighbors.
+void TensorArena::MaybeReclaimLocked(uint64_t off, Range* r) {
+  if (!r->free_requested || r->local_refs > 0 || r->remote_refs > 0) return;
+  uint64_t len = r->len;
+  _ranges.erase(off);
+  auto next = _free.upper_bound(off);
+  if (next != _free.end() && off + len == next->first) {
+    len += next->second;
+    next = _free.erase(next);
+  }
+  if (next != _free.begin()) {
+    auto prev = std::prev(next);
+    if (prev->first + prev->second == off) {
+      prev->second += len;
+      return;
+    }
+  }
+  _free[off] = len;
+}
+
+int TensorArena::Free(uint64_t off) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _ranges.find(off);
+  if (it == _ranges.end()) return -1;
+  it->second.free_requested = true;
+  MaybeReclaimLocked(off, &it->second);
+  return 0;
+}
+
+std::map<uint64_t, TensorArena::Range>::iterator TensorArena::RangeContaining(
+    uint64_t off) {
+  auto it = _ranges.upper_bound(off);
+  if (it == _ranges.begin()) return _ranges.end();
+  --it;
+  if (off >= it->first + it->second.len) return _ranges.end();
+  return it;
+}
+
+void TensorArena::AddLocalRef(uint64_t off) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = RangeContaining(off);
+  if (it != _ranges.end()) ++it->second.local_refs;
+}
+
+void TensorArena::OnLocalRelease(void* ptr) {
+  const uint64_t off = static_cast<char*>(ptr) - _base;
+  bool wake = false;
+  {
+    std::lock_guard<std::mutex> lk(_mu);
+    auto it = RangeContaining(off);
+    if (it == _ranges.end()) return;
+    if (--it->second.local_refs <= 0) {
+      it->second.local_refs = 0;
+      wake = true;
+      MaybeReclaimLocked(it->first, &it->second);
+    }
+  }
+  if (wake) {
+    _cv.notify_all();
+    MaybeReap();
+  }
+}
+
+void TensorArena::AddRemoteRef(uint64_t off) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = RangeContaining(off);
+  if (it != _ranges.end()) ++it->second.remote_refs;
+}
+
+void TensorArena::OnRemoteRelease(uint64_t off, uint64_t len) {
+  bool wake = false;
+  {
+    std::lock_guard<std::mutex> lk(_mu);
+    auto it = RangeContaining(off);
+    if (it == _ranges.end()) return;
+    (void)len;  // release granularity is the whole allocated range
+    if (--it->second.remote_refs <= 0) {
+      it->second.remote_refs = 0;
+      wake = true;
+      MaybeReclaimLocked(it->first, &it->second);
+    }
+  }
+  if (wake) {
+    _cv.notify_all();
+    MaybeReap();
+  }
+}
+
+int64_t TensorArena::busy_bytes() const {
+  std::lock_guard<std::mutex> lk(_mu);
+  int64_t n = 0;
+  for (const auto& [off, r] : _ranges) {
+    if (r.local_refs > 0 || r.remote_refs > 0) {
+      n += static_cast<int64_t>(r.len);
+    }
+  }
+  return n;
+}
+
+int TensorArena::WaitReusable(uint64_t off, int64_t timeout_ms) {
+  std::unique_lock<std::mutex> lk(_mu);
+  auto idle = [&] {
+    auto it = RangeContaining(off);
+    return it == _ranges.end() ||
+           (it->second.local_refs == 0 && it->second.remote_refs == 0);
+  };
+  if (timeout_ms < 0) {
+    _cv.wait(lk, idle);
+    return 0;
+  }
+  return _cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), idle) ? 0
+                                                                       : -1;
+}
+
+// ---------------- receiver-side registry ----------------
+
+namespace {
+
+struct RxEntry {
+  std::shared_ptr<IciSegment> mapping;
+  uint64_t socket_id = 0;
+  uint32_t arena_id = 0;
+  int64_t outstanding = 0;
+  // Live materialized blocks: ptr -> len (multi: the peer may send the same
+  // range on several in-flight messages).
+  std::multimap<const char*, uint32_t> live;
+  bool endpoint_gone = false;
+};
+
+struct RxRegistry {
+  std::mutex mu;
+  std::map<const char*, RxEntry> map;  // keyed by mapping base address
+};
+RxRegistry& rx_registry() {
+  static RxRegistry* r = new RxRegistry;
+  return *r;
+}
+
+std::map<const char*, RxEntry>::iterator rx_find_containing(RxRegistry& r,
+                                                            const void* ptr) {
+  auto it = r.map.upper_bound(static_cast<const char*>(ptr));
+  if (it == r.map.begin()) return r.map.end();
+  --it;
+  if (!it->second.mapping->contains(ptr)) return r.map.end();
+  return it;
+}
+
+}  // namespace
+
+void ArenaRxRegistry::Register(std::shared_ptr<IciSegment> mapping,
+                               uint64_t socket_id, uint32_t arena_id) {
+  RxRegistry& r = rx_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  const char* base = mapping->base();
+  RxEntry& e = r.map[base];
+  e.mapping = std::move(mapping);
+  e.socket_id = socket_id;
+  e.arena_id = arena_id;
+}
+
+void ArenaRxRegistry::OnMaterialize(const void* ptr, uint32_t len) {
+  RxRegistry& r = rx_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = rx_find_containing(r, ptr);
+  if (it == r.map.end()) return;
+  it->second.live.emplace(static_cast<const char*>(ptr), len);
+  ++it->second.outstanding;
+}
+
+void ArenaRxRegistry::OnRelease(void* ptr) {
+  uint64_t socket_id = 0;
+  uint32_t arena_id = 0;
+  uint64_t off = 0;
+  uint32_t len = 0;
+  {
+    RxRegistry& r = rx_registry();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = rx_find_containing(r, ptr);
+    if (it == r.map.end()) return;
+    RxEntry& e = it->second;
+    auto lit = e.live.find(static_cast<const char*>(ptr));
+    if (lit == e.live.end()) return;
+    len = lit->second;
+    e.live.erase(lit);
+    socket_id = e.socket_id;
+    arena_id = e.arena_id;
+    off = static_cast<const char*>(ptr) - e.mapping->base();
+    if (--e.outstanding == 0 && e.endpoint_gone) {
+      r.map.erase(it);  // last shared_ptr drops: unmap
+      socket_id = 0;    // peer connection is gone; nothing to notify
+    }
+  }
+  if (socket_id != 0) {
+    ici_internal::SendArenaReleaseFrame(socket_id, arena_id, off, len);
+  }
+}
+
+void ArenaRxRegistry::OnEndpointGone(const IciSegment* mapping) {
+  RxRegistry& r = rx_registry();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.map.find(mapping->base());
+  if (it == r.map.end()) return;
+  if (it->second.outstanding == 0) {
+    r.map.erase(it);
+  } else {
+    it->second.endpoint_gone = true;
+  }
+}
+
+}  // namespace ttpu

@@ -1,0 +1,130 @@
+// TensorArena: application-registered transfer memory — the bridge that
+// lets a device tensor (jax.Array staged to host, or any app buffer) ride
+// the RPC framework without per-hop copies.
+//
+// This is the tpu-native answer to the reference's RDMA memory
+// registration: rdma_helper.h:48 RegisterMemoryForRdma feeds app buffers
+// into IOBuf via iobuf.h:252-256 append_user_data, and the send path ships
+// registered blocks by reference (rdma_endpoint.h:89 CutFromIOBufList).
+// Here the registered region is a shm segment BOTH endpoints of a tpu://
+// connection can map, so an attachment that lives in an arena crosses the
+// transport as a (arena_id, offset, len) reference in the doorbell stream:
+//   app writes tensor into arena -> IOBuf user-data block (pointer
+//   identity) -> kData arena ref on the wire -> receiver materializes an
+//   IOBuf block pointing INTO its mapping of the same physical pages ->
+//   handler reads it in place. Zero host-side copies end to end.
+// The receiver's drop of the last reference sends a kArenaRelease frame
+// back (the CQE analog), which returns the range to the sender's allocator.
+//
+// Over plain TCP the same arena-backed IOBuf writev's straight from arena
+// pages into the socket (zero-copy to the kernel); there is no remote
+// reference, so the range frees on the local drop alone.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+namespace ttpu {
+
+class IciSegment;
+
+// Meta tag stamped on arena-backed IOBuf user-data blocks so the tpu://
+// send path can recognize them in O(1): high 32 bits = magic, low = id.
+inline constexpr uint64_t kArenaMetaMagic = 0x41524E41ULL << 32;  // "ARNA"
+inline uint64_t arena_meta(uint32_t id) { return kArenaMetaMagic | id; }
+inline bool is_arena_meta(uint64_t m) {
+  return (m & 0xFFFFFFFF00000000ULL) == kArenaMetaMagic;
+}
+
+class TensorArena {
+ public:
+  // Shm-backed, <= 4GB (wire refs carry u32 offsets). Null on failure.
+  static std::shared_ptr<TensorArena> Create(size_t bytes);
+  ~TensorArena();
+
+  uint32_t id() const { return _id; }
+  char* base() const { return _base; }
+  size_t bytes() const { return _bytes; }
+  const std::string& name() const { return _name; }
+  bool contains(const void* p) const {
+    return p >= _base && p < _base + _bytes;
+  }
+
+  // ---- range allocator (first-fit, coalescing) ----
+  // Returns the offset of a fresh `len`-byte range, or -1 when fragmented/
+  // full. Alignment is 64 bytes (cacheline; also keeps numpy views aligned).
+  int64_t Alloc(size_t len);
+  // Give a range back. Deferred while references are outstanding: the range
+  // returns to the free list when the last local IOBuf ref drops AND every
+  // remote (wire) ref has been released by the peer.
+  int Free(uint64_t off);
+
+  // ---- reference bookkeeping (transport + IOBuf glue) ----
+  // Offsets may point ANYWHERE inside an allocated range (apps send
+  // sub-ranges, e.g. a tensor behind a header); the bookkeeping resolves
+  // the containing allocation.
+  void AddLocalRef(uint64_t off);      // IOBuf user-data block created
+  void OnLocalRelease(void* ptr);      // its deleter fired
+  void AddRemoteRef(uint64_t off);     // ref emitted on a tpu:// wire
+  void OnRemoteRelease(uint64_t off, uint64_t len);  // kArenaRelease arrived
+
+  // Bytes of ranges that still carry any reference (diagnostics/tests).
+  int64_t busy_bytes() const;
+  // Park the CALLING THREAD (not fiber) until `off`'s range has no
+  // references (safe to overwrite/reuse). 0 ok, -1 timeout.
+  int WaitReusable(uint64_t off, int64_t timeout_ms);
+
+  // ---- process-wide lookup ----
+  static std::shared_ptr<TensorArena> ById(uint32_t id);
+  static std::shared_ptr<TensorArena> FindContaining(const void* p);
+  // Drop the caller's ownership but keep the mapping alive until every
+  // outstanding reference drains (an arena destroyed mid-send must not
+  // unmap pages a socket write queue still points into).
+  static void DestroyWhenIdle(std::shared_ptr<TensorArena> arena);
+
+ private:
+  TensorArena() = default;
+  struct Range {
+    uint64_t len = 0;
+    int32_t local_refs = 0;
+    int32_t remote_refs = 0;
+    bool free_requested = false;
+  };
+  void MaybeReclaimLocked(uint64_t off, Range* r);
+  // The allocation containing `off` (end() if off is in free space).
+  std::map<uint64_t, Range>::iterator RangeContaining(uint64_t off);
+  void MaybeReap();  // graveyard sweep after a release drains refs
+
+  uint32_t _id = 0;
+  char* _base = nullptr;
+  size_t _bytes = 0;
+  std::string _name;
+
+  mutable std::mutex _mu;
+  std::condition_variable _cv;              // WaitReusable parkers
+  std::map<uint64_t, uint64_t> _free;       // off -> len, coalesced
+  std::map<uint64_t, Range> _ranges;        // allocated ranges by offset
+};
+
+// Receiver-side registry of PEER arena mappings (one per (socket, arena)),
+// mirroring PeerSegmentRegistry: the IOBuf deleter is a bare function
+// pointer, so releases find their mapping by address range and turn into
+// kArenaRelease frames on the socket the data arrived on.
+class ArenaRxRegistry {
+ public:
+  // kRegArena arrived: remember the mapping (idempotent per base address).
+  static void Register(std::shared_ptr<IciSegment> mapping, uint64_t socket_id,
+                       uint32_t arena_id);
+  // A zero-copy block (ptr,len) was materialized into an IOBuf.
+  static void OnMaterialize(const void* ptr, uint32_t len);
+  // THE user-data deleter for received arena blocks.
+  static void OnRelease(void* ptr);
+  // The endpoint died; mappings unmap once their outstanding refs drop.
+  static void OnEndpointGone(const IciSegment* mapping);
+};
+
+}  // namespace ttpu
