@@ -1,0 +1,112 @@
+"""A parameter server whose traffic rides the RPC framework as tensors.
+
+This closes the loop SURVEY.md §2.11/§7 charters: the reference's headline
+deployment is parameter-server fan-out over its RDMA transport; here the
+served state is jax.Arrays in device memory, and every pull/push crosses
+the framework's ``tpu://`` transport as a by-reference TensorArena
+attachment (brpc_tpu/runtime/tensor.py):
+
+  PULL:  device param --D2H--> server arena --by-ref--> client maps the
+         same pages --jax.device_put--> device replica
+  PUSH:  device grad --D2H--> client arena --by-ref--> server applies the
+         fused Pallas momentum update ON DEVICE and bumps the version.
+
+Reference mapping: example/parallel_echo_c++ fan-out + rdma payload path
+(rdma_endpoint.h:89); the update rule matches ops/fused_update.py so a
+local training loop and an RPC-driven one converge identically (asserted
+by tests/test_tensor_bridge.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from brpc_tpu.ops.fused_update import fused_momentum_update
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.tensor import (TensorArena, TensorChannel,
+                                     add_tensor_service)
+
+
+class ParameterServer:
+    """Serves named jax.Arrays over RPC; Push applies momentum SGD."""
+
+    def __init__(self, params: Dict[str, jax.Array], lr: float = 0.01,
+                 momentum: float = 0.9, arena: Optional[TensorArena] = None):
+        self._params = dict(params)
+        self._momenta = {k: jax.numpy.zeros_like(v)
+                         for k, v in self._params.items()}
+        self._version = {k: 0 for k in self._params}
+        self._lr = lr
+        self._mu = threading.Lock()  # handlers run on fiber workers
+        self.server = native.Server()
+        self.arena = add_tensor_service(self.server, "ParamService",
+                                        self._handle, arena)
+        self.port: Optional[int] = None
+
+    def start(self, addr: str = "127.0.0.1:0") -> int:
+        self.port = self.server.start(addr)
+        return self.port
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    # ---- handler (runs inside a server fiber) ----
+    def _handle(self, method: str, request: bytes, att):
+        if method == "Meta":
+            meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype),
+                        "version": self._version[k]}
+                    for k, v in self._params.items()}
+            return json.dumps(meta).encode(), None
+        name = request.decode()
+        if name not in self._params:
+            raise native.RpcError(2007, f"no such parameter: {name}")
+        if method == "Pull":
+            with self._mu:
+                return str(self._version[name]).encode(), self._params[name]
+        if method == "Push":
+            if att is None:
+                raise native.RpcError(2002, "push without gradient")
+            grad = jax.device_put(np.ascontiguousarray(att))
+            with self._mu:
+                p, m = fused_momentum_update(
+                    self._params[name], self._momenta[name],
+                    grad.astype(self._params[name].dtype),
+                    lr=self._lr)
+                self._params[name] = p
+                self._momenta[name] = m
+                self._version[name] += 1
+                return str(self._version[name]).encode(), None
+        raise native.RpcError(2007, f"no such method: {method}")
+
+
+class ParameterClient:
+    """Pulls params into device arrays / pushes device grads, all over the
+    framework (one TensorChannel per client)."""
+
+    def __init__(self, addr: str, arena: Optional[TensorArena] = None):
+        self.channel = TensorChannel(addr, arena)
+
+    def meta(self) -> dict:
+        payload, _ = self.channel.call("ParamService/Meta")
+        return json.loads(payload.decode())
+
+    def pull(self, name: str, device=None):
+        """-> (version, jax.Array) — H2D straight from the shared pages."""
+        rest, arr = self.channel.pull_device("ParamService/Pull",
+                                             request=name.encode(),
+                                             device=device)
+        return int(rest.decode()), arr
+
+    def push_grad(self, name: str, grad) -> int:
+        """Send a device gradient; returns the server's new version."""
+        payload = self.channel.push_device("ParamService/Push", grad,
+                                           request=name.encode())
+        return int(payload.decode())
+
+    def close(self) -> None:
+        self.channel.close()

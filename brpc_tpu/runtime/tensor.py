@@ -1,0 +1,387 @@
+"""Tensor-on-the-wire: jax.Array payloads riding the RPC framework.
+
+This is the Python face of the native TensorArena bridge
+(native/ttpu/tensor_arena.h — the tpu-native analog of the reference's
+RDMA memory registration, rdma_helper.h:48): a shm-backed arena both ends
+of a ``tpu://`` connection map. The flow per tensor:
+
+  device array --(one D2H DMA)--> arena pages --(by-reference doorbell)-->
+  receiver reads the SAME physical pages in place --(jax.device_put)-->
+  device array on the other side.
+
+No host-side copies happen between the arena and the receiving handler:
+the IOBuf blocks on both sides point into the shared mapping (pointer
+identity is asserted by native/test/test_tensor_arena.cpp). The staging
+copy INTO the arena is the registered-memory discipline the reference's
+RDMA path uses too (app data lands in registered blocks before the NIC
+sees it); on a real pod the arena plays the pinned-host staging buffer
+role that libtpu DMAs from.
+
+Typed tensors ride as: request/response payload = a tiny metadata header
+(dtype/shape, msgpack-free manual encoding), attachment = the raw bytes in
+the arena.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import struct
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.native import RpcError, lib
+
+
+def _bind_tensor_api(L: ctypes.CDLL) -> ctypes.CDLL:
+    if getattr(L, "_tensor_api_bound", False):
+        return L
+    L.tbrpc_arena_create.restype = ctypes.c_void_p
+    L.tbrpc_arena_create.argtypes = [ctypes.c_size_t]
+    L.tbrpc_arena_destroy.argtypes = [ctypes.c_void_p]
+    L.tbrpc_arena_base.restype = ctypes.c_void_p
+    L.tbrpc_arena_base.argtypes = [ctypes.c_void_p]
+    L.tbrpc_arena_alloc.restype = ctypes.c_int64
+    L.tbrpc_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    L.tbrpc_arena_free.restype = ctypes.c_int
+    L.tbrpc_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    L.tbrpc_arena_busy_bytes.restype = ctypes.c_int64
+    L.tbrpc_arena_busy_bytes.argtypes = [ctypes.c_void_p]
+    L.tbrpc_arena_wait_reusable.restype = ctypes.c_int
+    L.tbrpc_arena_wait_reusable.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64]
+    L.tbrpc_call_tensor.restype = ctypes.c_int
+    L.tbrpc_call_tensor.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_view_free.argtypes = [ctypes.c_void_p]
+    L.tbrpc_server_add_tensor_service.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _TENSOR_CB, ctypes.c_void_p]
+    L._tensor_api_bound = True
+    return L
+
+
+_TENSOR_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,                    # ctx
+    ctypes.c_char_p,                    # method
+    ctypes.c_void_p, ctypes.c_size_t,   # req
+    ctypes.c_void_p, ctypes.c_size_t,   # attachment, IN PLACE
+    ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),  # resp
+    ctypes.POINTER(ctypes.c_void_p),    # resp_arena
+    ctypes.POINTER(ctypes.c_uint64),    # resp_att_off
+    ctypes.POINTER(ctypes.c_size_t),    # resp_att_len
+    ctypes.POINTER(ctypes.c_int),       # resp_att_autofree
+    ctypes.POINTER(ctypes.c_int),       # error_code
+)
+
+
+def _encode_meta(arr: np.ndarray) -> bytes:
+    meta = json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)})
+    return struct.pack("<I", len(meta)) + meta.encode()
+
+
+def _decode_meta(buf: bytes) -> Tuple[np.dtype, tuple, bytes]:
+    (n,) = struct.unpack_from("<I", buf)
+    meta = json.loads(buf[4:4 + n].decode())
+    return np.dtype(meta["dtype"]), tuple(meta["shape"]), buf[4 + n:]
+
+
+def _as_host_array(array) -> np.ndarray:
+    """jax.Array -> host np.ndarray (one D2H DMA on TPU; zero-copy view on
+    the CPU backend); np.ndarray passes through."""
+    return np.asarray(array)
+
+
+class TensorArena:
+    """Registered transfer memory, exposed to numpy/jax as views."""
+
+    def __init__(self, nbytes: int):
+        self._L = _bind_tensor_api(lib())
+        self._h = self._L.tbrpc_arena_create(nbytes)
+        if not self._h:
+            raise MemoryError(f"arena create({nbytes}) failed")
+        self._base = self._L.tbrpc_arena_base(self._h)
+        self.nbytes = nbytes
+
+    @property
+    def handle(self) -> int:
+        return self._h
+
+    def alloc(self, nbytes: int) -> int:
+        off = self._L.tbrpc_arena_alloc(self._h, nbytes)
+        if off < 0:
+            raise MemoryError(f"arena alloc({nbytes}) failed (fragmented?)")
+        return off
+
+    def free(self, off: int) -> None:
+        self._L.tbrpc_arena_free(self._h, off)
+
+    def view(self, off: int, nbytes: int) -> np.ndarray:
+        """A uint8 numpy view of arena pages — writes here ARE the staging
+        transfer (no further copy before the wire)."""
+        buf = (ctypes.c_uint8 * nbytes).from_address(self._base + off)
+        return np.ctypeslib.as_array(buf)
+
+    def place(self, array) -> Tuple[int, int, np.ndarray]:
+        """Stage an array's bytes into the arena: (off, nbytes, host_copy).
+
+        One D2H DMA for a TPU-resident jax.Array; a plain memcpy for host
+        arrays. Returns the host ndarray too (carrying dtype/shape for the
+        metadata header).
+        """
+        host = _as_host_array(array)
+        if host.nbytes == 0:
+            return 0, 0, host  # empty tensors ride as metadata only
+        raw = host.reshape(-1).view(np.uint8)
+        off = self.alloc(host.nbytes)
+        self.view(off, host.nbytes)[:] = raw
+        return off, host.nbytes, host
+
+    def busy_bytes(self) -> int:
+        return self._L.tbrpc_arena_busy_bytes(self._h)
+
+    def wait_reusable(self, off: int, timeout_ms: int = -1) -> bool:
+        return self._L.tbrpc_arena_wait_reusable(self._h, off, timeout_ms) == 0
+
+    def close(self) -> None:
+        if self._h:
+            self._L.tbrpc_arena_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class TensorView:
+    """A zero-copy window onto a received tensor (the peer's arena pages or
+    the connection's RX segment). ``release()`` is what sends the release
+    frame back and lets the sender reuse the range — call it (or use as a
+    context manager) as soon as the bytes are consumed (e.g. after
+    jax.device_put returns)."""
+
+    def __init__(self, L, view_handle, ptr, nbytes, copied: bool):
+        self._L = L
+        self._view = view_handle
+        self._ptr = ptr
+        self._copied = copied
+        self.nbytes = nbytes
+
+    def ndarray(self) -> np.ndarray:
+        buf = (ctypes.c_uint8 * self.nbytes).from_address(self._ptr)
+        return np.ctypeslib.as_array(buf)
+
+    @property
+    def zero_copy(self) -> bool:
+        return not self._copied
+
+    def release(self) -> None:
+        if self._view:
+            self._L.tbrpc_view_free(self._view)
+            self._view = None
+        elif self._copied and self._ptr:
+            self._L.tbrpc_free(self._ptr)
+        self._ptr = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class TensorChannel:
+    """Client stub for tensor traffic: a ``tpu://`` channel plus a local
+    arena the outbound tensors stage through."""
+
+    def __init__(self, addr: str, arena: Optional[TensorArena] = None,
+                 timeout_ms: int = 20000, max_retry: int = 0):
+        self._L = _bind_tensor_api(lib())
+        if not addr.startswith("tpu://") and "://" not in addr:
+            addr = "tpu://" + addr
+        self._h = self._L.tbrpc_channel_create(addr.encode(), timeout_ms,
+                                               max_retry)
+        if not self._h:
+            raise RuntimeError(f"tensor channel init to {addr} failed")
+        self.arena = arena if arena is not None else TensorArena(256 << 20)
+
+    def call_raw(self, service_method: str, request: bytes,
+                 att_off: int = 0, att_len: int = 0
+                 ) -> Tuple[bytes, TensorView]:
+        """One RPC: request bytes + an arena range as the attachment.
+        Returns (response payload, response-attachment view)."""
+        L = self._L
+        resp = ctypes.c_void_p()
+        resp_len = ctypes.c_size_t()
+        view = ctypes.c_void_p()
+        ratt = ctypes.c_void_p()
+        ratt_len = ctypes.c_size_t()
+        copied = ctypes.c_int()
+        errbuf = ctypes.create_string_buffer(256)
+        rc = L.tbrpc_call_tensor(
+            self._h, service_method.encode(), request, len(request),
+            self.arena.handle if att_len else None, att_off, att_len,
+            ctypes.byref(resp), ctypes.byref(resp_len), ctypes.byref(view),
+            ctypes.byref(ratt), ctypes.byref(ratt_len), ctypes.byref(copied),
+            errbuf, len(errbuf))
+        if rc != 0:
+            raise RpcError(rc, errbuf.value.decode(errors="replace"))
+        try:
+            payload = (ctypes.string_at(resp, resp_len.value)
+                       if resp_len.value else b"")
+        finally:
+            L.tbrpc_free(resp)
+        return payload, TensorView(L, view.value, ratt.value, ratt_len.value,
+                                   bool(copied.value))
+
+    def call(self, service_method: str, array=None, request: bytes = b""
+             ) -> Tuple[bytes, Optional[np.ndarray]]:
+        """Send a tensor (or nothing), receive a tensor (or nothing).
+
+        The outbound array stages into the local arena (freed after the
+        wire release returns); the inbound one is device_put-able — it is
+        materialized as an ndarray COPY here only if the caller keeps it,
+        via pull() below for the zero-copy discipline.
+        """
+        off = length = 0
+        if array is not None:
+            off, length, host = self.place_with_meta(array)
+            request = _encode_meta(host) + request
+        try:
+            payload, view = self.call_raw(service_method, request, off,
+                                          length)
+        finally:
+            if length:
+                self.arena.free(off)  # deferred until releases drain
+        with view:
+            if view.nbytes == 0:
+                try:  # an empty tensor still carries its metadata header
+                    dtype, shape, rest = _decode_meta(payload)
+                    return rest, np.empty(shape, dtype=dtype)
+                except Exception:  # noqa: BLE001 — tensor-less response
+                    return payload, None
+            dtype, shape, rest = _decode_meta(payload)
+            arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
+            return rest, np.array(arr)  # detach before releasing the view
+
+    def place_with_meta(self, array) -> Tuple[int, int, np.ndarray]:
+        return self.arena.place(array)
+
+    def pull_device(self, service_method: str, request: bytes = b"",
+                    device=None):
+        """Fetch a tensor and jax.device_put it STRAIGHT from the received
+        view (H2D DMA from the shared pages; no intermediate host copy),
+        then release the view. Returns (rest_of_payload, jax.Array)."""
+        import jax
+
+        payload, view = self.call_raw(service_method, request)
+        with view:
+            dtype, shape, rest = _decode_meta(payload)
+            arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
+            dev = jax.device_put(arr, device)
+            dev.block_until_ready()  # H2D completes before the release
+        return rest, dev
+
+    def push_device(self, service_method: str, array,
+                    request: bytes = b"") -> bytes:
+        """Send a device array (D2H into the arena, by-reference on the
+        wire); waits for the wire release so the arena cannot fill up under
+        a streaming push loop. Returns the response payload."""
+        off, length, host = self.place_with_meta(array)
+        try:
+            payload, view = self.call_raw(
+                service_method, _encode_meta(host) + request, off, length)
+            view.release()
+            return payload
+        finally:
+            if length:
+                self.arena.free(off)
+
+    def close(self) -> None:
+        if self._h:
+            self._L.tbrpc_channel_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# Handler: (method, request_bytes, attachment_view: np.ndarray|None)
+#   -> (response_bytes, response_array_or_None)
+TensorHandler = Callable[[str, bytes, Optional[np.ndarray]],
+                         Tuple[bytes, Optional[object]]]
+
+
+def add_tensor_service(server: native.Server, name: str,
+                       handler: TensorHandler,
+                       arena: Optional[TensorArena] = None) -> TensorArena:
+    """Host a tensor service on a native Server: the handler reads request
+    tensors IN PLACE (a numpy view of the sender's pages) and returns
+    response tensors through the service's own arena (by-reference on the
+    wire). Returns that arena."""
+    L = _bind_tensor_api(lib())
+    srv_arena = arena if arena is not None else TensorArena(256 << 20)
+
+    def trampoline(ctx, method, req, req_len, att, att_len,
+                   resp, resp_len, resp_arena, resp_off, resp_att_len,
+                   resp_autofree, error_code):
+        try:
+            request = ctypes.string_at(req, req_len) if req_len else b""
+            att_view = None
+            if att_len:
+                buf = (ctypes.c_uint8 * att_len).from_address(att)
+                att_view = np.ctypeslib.as_array(buf)
+                if request[:4] and len(request) >= 4:
+                    # Typed sends prefix the payload with dtype/shape meta:
+                    # give the handler a shaped view of the pages in place.
+                    try:
+                        dtype, shape, request = _decode_meta(request)
+                        att_view = att_view.view(dtype).reshape(shape)
+                    except Exception:  # noqa: BLE001 — raw-byte sender
+                        pass
+            r, out_arr = handler(method.decode(), request, att_view)
+            if out_arr is not None:
+                off, nbytes, host = srv_arena.place(out_arr)
+                r = _encode_meta(host) + r
+                if nbytes:
+                    resp_arena[0] = srv_arena.handle
+                    resp_off[0] = off
+                    resp_att_len[0] = nbytes
+                    # Autofree: the C side frees AFTER taking the response
+                    # ref, so the range returns once the client releases.
+                    resp_autofree[0] = 1
+            if r:
+                buf = L.tbrpc_alloc(len(r))
+                ctypes.memmove(buf, r, len(r))
+                resp[0] = buf
+                resp_len[0] = len(r)
+        except RpcError as e:
+            error_code[0] = e.code if e.code != 0 else 2004
+        except Exception:  # noqa: BLE001 — handler bug => EINTERNAL
+            error_code[0] = 2004
+
+    cb = _TENSOR_CB(trampoline)
+    server._cbs.append(cb)  # keep alive alongside byte-service callbacks
+    if L.tbrpc_server_add_tensor_service(
+            server._h, name.encode(), cb, None) != 0:
+        raise RuntimeError(f"add_tensor_service({name}) failed")
+    return srv_arena

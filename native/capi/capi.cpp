@@ -330,11 +330,18 @@ void TensorCallbackService::CallMethod(const std::string& method,
   void* resp_arena = nullptr;
   uint64_t resp_att_off = 0;
   size_t resp_att_len = 0;
+  int resp_att_autofree = 0;
   int error_code = 0;
   _cb(_ctx, method.c_str(), req.data(), req.size(), att_ptr, att_len, &resp,
-      &resp_len, &resp_arena, &resp_att_off, &resp_att_len, &error_code);
+      &resp_len, &resp_arena, &resp_att_off, &resp_att_len,
+      &resp_att_autofree, &error_code);
   if (error_code != 0) {
     cntl->SetFailed(error_code, "tensor service callback failed");
+    if (resp_arena != nullptr && resp_att_len > 0 && resp_att_autofree) {
+      // The handler allocated a response range before failing: honor the
+      // autofree so the arena doesn't leak one range per failed call.
+      static_cast<ArenaBox*>(resp_arena)->arena->Free(resp_att_off);
+    }
   } else {
     if (resp != nullptr && resp_len > 0) {
       response->append(resp, resp_len);
@@ -342,9 +349,14 @@ void TensorCallbackService::CallMethod(const std::string& method,
     if (resp_arena != nullptr && resp_att_len > 0) {
       // The response tensor lives in the server's arena: it rides back by
       // reference; the client's view release returns the range.
-      append_arena_range(&cntl->response_attachment(),
-                         static_cast<ArenaBox*>(resp_arena)->arena.get(),
-                         resp_att_off, resp_att_len);
+      ttpu::TensorArena* a = static_cast<ArenaBox*>(resp_arena)->arena.get();
+      append_arena_range(&cntl->response_attachment(), a, resp_att_off,
+                         resp_att_len);
+      if (resp_att_autofree) {
+        // Ref taken above, so this free defers until the client releases —
+        // freeing inside the handler would race a concurrent realloc.
+        a->Free(resp_att_off);
+      }
     }
   }
   free(resp);

@@ -90,13 +90,18 @@ void tbrpc_view_free(void* view);
 // Tensor service: the handler sees the request attachment IN PLACE (no
 // copy when it arrived as one zero-copy block) and may return its response
 // attachment as a range of a local arena — it rides back by reference.
-// resp_arena null => no response attachment.
+// resp_arena null => no response attachment. Setting *resp_att_autofree=1
+// frees the range AFTER the response reference is taken (i.e. the range
+// returns to the allocator once the client's release arrives) — the safe
+// fire-and-forget mode for per-response allocations; freeing inside the
+// handler instead would let a concurrent request reuse the range before
+// the response is sent.
 typedef void (*tbrpc_tensor_handler_cb)(
     void* ctx, const char* method, const void* req, size_t req_len,
     const void* att, size_t att_len,
     void** resp, size_t* resp_len,  // tbrpc_alloc'd, ownership passes back
     void** resp_arena, uint64_t* resp_att_off, size_t* resp_att_len,
-    int* error_code);
+    int* resp_att_autofree, int* error_code);
 int tbrpc_server_add_tensor_service(void* server, const char* name,
                                     tbrpc_tensor_handler_cb cb, void* ctx);
 

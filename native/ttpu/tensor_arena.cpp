@@ -98,8 +98,18 @@ void TensorArena::DestroyWhenIdle(std::shared_ptr<TensorArena> arena) {
   if (arena == nullptr) return;
   if (arena->busy_bytes() == 0) return;  // caller's drop unmaps now
   ArenaDirectory& d = directory();
-  std::lock_guard<std::mutex> lk(d.mu);
-  d.graveyard[arena.get()] = arena;
+  std::unique_lock<std::mutex> lk(d.mu);
+  TensorArena* key = arena.get();
+  d.graveyard[key] = std::move(arena);
+  // Re-check AFTER parking: a release draining between the check above and
+  // the insertion would have found an empty graveyard (its MaybeReap
+  // no-op'ed), and no future release would ever reap — the mapping would
+  // leak for the life of the process.
+  if (d.graveyard[key]->busy_bytes() == 0) {
+    auto dying = std::move(d.graveyard[key]);  // dies after unlock
+    d.graveyard.erase(key);
+    lk.unlock();
+  }
 }
 
 void TensorArena::MaybeReap() {
@@ -174,10 +184,10 @@ void TensorArena::MaybeReclaimLocked(uint64_t off, Range* r) {
 
 int TensorArena::Free(uint64_t off) {
   std::lock_guard<std::mutex> lk(_mu);
-  auto it = _ranges.find(off);
+  auto it = RangeContaining(off);  // interior offsets free the allocation
   if (it == _ranges.end()) return -1;
   it->second.free_requested = true;
-  MaybeReclaimLocked(off, &it->second);
+  MaybeReclaimLocked(it->first, &it->second);
   return 0;
 }
 

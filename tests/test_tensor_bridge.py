@@ -1,0 +1,138 @@
+"""Tensor-on-the-wire: jax.Array payloads riding the RPC framework.
+
+The chartered path (SURVEY.md §5/§7, reference rdma_helper.h:48 /
+iobuf.h:252-256 / rdma_endpoint.h:89): arrays stage into a registered
+TensorArena, cross ``tpu://`` as by-reference doorbell entries, and the
+receiver reads the SAME physical pages (asserted via the shared-pages
+mutation trick, which only works if zero host-side copies happened on the
+wire path).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.param_server import ParameterClient, ParameterServer
+from brpc_tpu.runtime.tensor import TensorArena, TensorChannel, add_tensor_service
+
+
+@pytest.fixture
+def echo_env():
+    server = native.Server()
+    markers = {}
+
+    def handler(method, request, att):
+        if att is None:
+            return b"none", None
+        markers["dtype"] = att.dtype
+        markers["shape"] = att.shape
+        if method == "Mark" and att.dtype == np.uint8:
+            att[0] = 0xEE  # in-place write: visible to the sender iff the
+            # pages are shared (zero-copy), never if bytes were copied
+        return b"", np.asarray(att) * 2
+    arena = add_tensor_service(server, "Echo", handler)
+    port = server.start("127.0.0.1:0")
+    ch = TensorChannel(f"tpu://127.0.0.1:{port}", TensorArena(64 << 20))
+    yield server, ch, markers, arena
+    ch.close()
+    server.stop()
+
+
+def test_typed_tensor_round_trip(echo_env):
+    _, ch, markers, _ = echo_env
+    x = np.arange(1 << 20, dtype=np.float32).reshape(1024, 1024)
+    _, y = ch.call("Echo/Mul2", x)
+    assert markers["dtype"] == np.float32
+    assert markers["shape"] == (1024, 1024)
+    assert y.dtype == np.float32 and y.shape == (1024, 1024)
+    np.testing.assert_array_equal(y, x * 2)
+
+
+def test_zero_copy_shared_pages(echo_env):
+    _, ch, _, _ = echo_env
+    # Raw-byte path: place into the arena explicitly, watch the server's
+    # in-place marker appear through OUR mapping.
+    n = 1 << 20
+    off = ch.arena.alloc(n)
+    view = ch.arena.view(off, n)
+    view[:] = 7
+    payload, resp_view = ch.call_raw("Echo/Mark", b"", off, n)
+    with resp_view:
+        assert resp_view.zero_copy, "response should be a single-ref view"
+    assert view[0] == 0xEE, "server's write must land in OUR arena pages"
+    assert view[1] == 7
+    ch.arena.free(off)
+    assert ch.arena.wait_reusable(off, 5000)
+
+
+def test_arena_ranges_recycle(echo_env):
+    _, ch, _, arena = echo_env
+    # A loop of sends must not leak arena space: every range drains after
+    # its wire release (server side too).
+    for i in range(10):
+        x = np.full((256, 1024), i, dtype=np.float32)
+        _, y = ch.call("Echo/Mul2", x)
+        np.testing.assert_array_equal(y, x * 2)
+    deadline = 50
+    while (ch.arena.busy_bytes() or arena.busy_bytes()) and deadline:
+        import time
+        time.sleep(0.05)
+        deadline -= 1
+    assert ch.arena.busy_bytes() == 0
+    assert arena.busy_bytes() == 0
+
+
+def test_jax_device_arrays_ride_the_framework(echo_env):
+    _, ch, _, _ = echo_env
+    x = jnp.linspace(0.0, 1.0, 4096, dtype=jnp.float32).reshape(64, 64)
+    _, y = ch.call("Echo/Mul2", x)  # D2H staging happens inside place()
+    np.testing.assert_allclose(y, np.asarray(x) * 2, rtol=1e-6)
+
+
+def test_parameter_server_over_rpc_matches_local_training():
+    """The flagship workload: an RPC-driven training loop (pull params,
+    compute grads, push grads — every tensor crossing the framework) must
+    converge bit-identically with a purely local loop using the same
+    fused-momentum update."""
+    rng = np.random.default_rng(0)
+    w0 = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    data_x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    data_y = jnp.asarray(rng.normal(size=(128, 32)).astype(np.float32))
+
+    def grad_fn(w):
+        return jax.grad(
+            lambda w_: jnp.mean((data_x @ w_ - data_y) ** 2))(w)
+
+    ps = ParameterServer({"w": w0}, lr=0.05, momentum=0.9)
+    port = ps.start()
+    client = ParameterClient(f"tpu://127.0.0.1:{port}")
+
+    meta = client.meta()
+    assert meta["w"]["shape"] == [64, 32]
+
+    # Local reference loop (same update rule).
+    from brpc_tpu.ops.fused_update import fused_momentum_update
+    w_local = w0
+    m_local = jnp.zeros_like(w0)
+    for step in range(5):
+        # RPC loop: pull -> grad -> push.
+        version, w_remote = client.pull("w")
+        assert version == step
+        assert isinstance(w_remote, jax.Array)
+        np.testing.assert_allclose(np.asarray(w_remote),
+                                   np.asarray(w_local), rtol=1e-6)
+        g = grad_fn(w_remote)
+        new_version = client.push_grad("w", g)
+        assert new_version == step + 1
+        w_local, m_local = fused_momentum_update(
+            w_local, m_local, grad_fn(w_local), lr=0.05)
+
+    version, w_final = client.pull("w")
+    assert version == 5
+    np.testing.assert_allclose(np.asarray(w_final), np.asarray(w_local),
+                               rtol=1e-5)
+    client.close()
+    ps.stop()
