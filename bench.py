@@ -87,6 +87,13 @@ def main() -> None:
         print(f"# latency {key}: p50 {p50:.0f}us p99 {p99:.0f}us "
               f"({qps:.0f} qps)", file=sys.stderr)
 
+    # Tensor bridge rows (the chartered workload): jax/numpy arrays riding
+    # the framework through TensorArena by-reference attachments.
+    try:
+        sweep.update(tensor_bridge_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# tensor bridge point skipped: {e}", file=sys.stderr)
+
     # Device-compute point: ring attention (brpc_tpu/ops/ring_attention)
     # on whatever accelerator JAX sees — on the real chip this exercises
     # the MXU at bf16; on the 1-device mesh the ring degenerates to flash
@@ -98,13 +105,107 @@ def main() -> None:
         print(f"# ring attention point skipped: {e}", file=sys.stderr)
 
     headline = sweep["tpu_1048576B"]["gbps"]
+    tcp = sweep.get("tcp_1048576B", {}).get("gbps", 0.0)
     print(json.dumps({
         "metric": "echo_1mb_oneway_throughput_tpu",
         "value": headline,
         "unit": "GB/s",
+        # Per-transport ratios (VERDICT r4 #10): the headline compares our
+        # shm/ICI-class transport against the reference's best published
+        # number, which is a 10GbE NIC figure — a CROSS-TRANSPORT ratio.
+        # The like-for-like ratio is tcp_vs_baseline (our TCP loopback vs
+        # that same 2.3 GB/s); the reference publishes no RDMA number
+        # (BASELINE.md row 16) for a same-class comparison.
         "vs_baseline": round(headline / BASELINE_GBPS, 3),
+        "vs_baseline_note": "tpu-shm transport vs reference 10GbE NIC "
+                            "(cross-transport); see tcp_vs_baseline for "
+                            "like-for-like",
+        "tcp_vs_baseline": round(tcp / BASELINE_GBPS, 3),
         "sweep": sweep,
     }))
+
+
+def tensor_bridge_point():
+    """Tensor-on-the-wire rows: arrays crossing the framework through
+    registered TensorArena memory (by-reference over tpu://).
+
+    Host rows time the pure wire path (numpy push: one staging memcpy into
+    the arena, a doorbell ref, the handler reading the pages in place).
+    The device row times a parameter-server Pull with a real jax.Array on
+    each end (server D2H into its arena, client device_put from the shared
+    pages) and reports the MARGINAL GB/s between 1MB and 16MB — through
+    the axon tunnel every op pays a large size-independent floor, which
+    the delta cancels (same method as ring_attention_point).
+    """
+    import time
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.runtime import native as nat
+    from brpc_tpu.runtime.tensor import (TensorArena, TensorChannel,
+                                         add_tensor_service)
+
+    server = nat.Server()
+    state = {}
+
+    def handler(method, request, att):
+        if method == "Pull":
+            return b"", state["arr"]
+        return b"", None  # Sink: the view IS the delivery; nothing to do
+
+    srv_arena = add_tensor_service(server, "Bench", handler)
+    port = server.start("127.0.0.1:0")
+    ch = TensorChannel(f"tpu://127.0.0.1:{port}", TensorArena(256 << 20))
+    out = {}
+    try:
+        for nbytes, key in ((1 << 20, "tensor_host_1MB"),
+                            (16 << 20, "tensor_host_16MB")):
+            arr = np.ones(nbytes // 4, np.float32)
+            ch.push_device("Bench/Sink", arr)  # warm: allocator + announce
+            iters = max(4, (256 << 20) // nbytes)
+            t0 = time.monotonic()
+            for _ in range(iters):
+                ch.push_device("Bench/Sink", arr)
+            dt = time.monotonic() - t0
+            gbps = nbytes * iters / dt / 1e9
+            out[key] = {"gbps": round(gbps, 3), "iters": iters}
+            print(f"# {key}: {gbps:.3f} GB/s ({iters} pushes)",
+                  file=sys.stderr)
+
+        dev = jax.devices()[0]
+
+        def per_op(nbytes):
+            state["arr"] = jnp.ones((nbytes // 4,), jnp.float32)
+            jax.block_until_ready(state["arr"])
+            ch.pull_device("Bench/Pull")  # warm/compile
+            samples = []
+            for _ in range(5):
+                t0 = time.monotonic()
+                ch.pull_device("Bench/Pull")
+                samples.append(time.monotonic() - t0)
+            samples.sort()
+            return samples[len(samples) // 2]
+
+        t1, t16 = per_op(1 << 20), per_op(16 << 20)
+        print(f"# tensor_pull_device ({dev.platform}): 1MB {t1 * 1e3:.1f}ms,"
+              f" 16MB {t16 * 1e3:.1f}ms", file=sys.stderr)
+        row = {"platform": dev.platform, "ms_1MB": round(t1 * 1e3, 2),
+               "ms_16MB": round(t16 * 1e3, 2),
+               # On this host device DMA rides the axon tunnel, whose
+               # per-byte cost dominates the wire path (the host rows
+               # above are the transport's own number).
+               "note": "device DMA is axon-tunnel-limited on this host"}
+        # Same noise-floor discipline as ring_attention_point: a delta in
+        # the jitter band publishes garbage — omit the rate instead.
+        if t16 - t1 > 0.25 * t1:
+            row["marginal_gbps"] = round((15 << 20) / (t16 - t1) / 1e9, 3)
+        out["tensor_pull_device"] = row
+    finally:
+        ch.close()
+        server.stop()
+    return out
 
 
 def ring_attention_point():
@@ -116,6 +217,11 @@ def ring_attention_point():
     carry feeds the next q — nothing can be elided), force materialization
     with a scalar readback, and report the MARGINAL rate between a small-K
     and large-K run — the fixed ~100ms tunnel readback cancels out.
+
+    The op is the Pallas flash kernel (block-tiled online softmax in VMEM,
+    multi-head) at the LLM shape b=8, h=8, s=4096, d=128 bf16; on the
+    1-device mesh the ring degenerates to flash attention with no
+    collectives. v5e bf16 peak is 197 TFLOP/s — mfu_pct is against that.
     """
     import time
 
@@ -123,24 +229,23 @@ def ring_attention_point():
     import jax.numpy as jnp
     from jax import lax
 
-    from brpc_tpu.ops.ring_attention import ring_attention
-    from brpc_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+    from brpc_tpu.ops.flash_attention import flash_attention
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    batch, seq, d = (8, 4096, 128) if on_tpu else (2, 256, 32)
+    batch, heads, seq, d = (8, 8, 4096, 128) if on_tpu else (1, 2, 256, 32)
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    k_small, k_large = (8, 128) if on_tpu else (1, 4)
-    mesh = make_mesh(jax.devices()[:1])
-    attn = ring_attention(mesh, SHARD_AXIS)
+    k_small, k_large = (8, 56) if on_tpu else (1, 4)
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (batch, seq, d), dtype) for kk in keys)
+    q, k, v = (jax.random.normal(kk, (batch, heads, seq, d), dtype)
+               for kk in keys)
 
     def timed(K):
         @jax.jit
         def run(q, k, v):
-            out, _ = lax.scan(lambda c, _: (attn(c, k, v), None), q, None,
-                              length=K)
+            def body(c, _):
+                return flash_attention(c, k, v).astype(dtype), None
+            out, _ = lax.scan(body, q, None, length=K)
             return jnp.sum(out.astype(jnp.float32))
         float(run(q, k, v))  # compile + warm
         samples = []
@@ -152,7 +257,7 @@ def ring_attention_point():
         return samples[len(samples) // 2]
 
     t_small, t_large = timed(k_small), timed(k_large)
-    flops_per_iter = 4.0 * batch * seq * seq * d  # QK^T + PV
+    flops_per_iter = 4.0 * batch * heads * seq * seq * d  # QK^T + PV
     dt = t_large - t_small
     # A delta that isn't comfortably above the noise floor means the
     # measurement is junk (scheduler/tunnel jitter inverted it); skip the
@@ -163,12 +268,20 @@ def ring_attention_point():
             f" K={k_large}: {t_large * 1e3:.1f}ms)")
     tflops = (k_large - k_small) * flops_per_iter / dt / 1e12
     ms_per_iter = dt / (k_large - k_small) * 1e3
-    print(f"# ring attention ({dev.platform}): {tflops:.1f} TFLOP/s "
-          f"sustained (b={batch} s={seq} d={d} {dtype.__name__}, "
-          f"{ms_per_iter:.2f}ms/application, delta {k_small}->{k_large})",
-          file=sys.stderr)
-    return {"tflops": round(tflops, 1), "platform": dev.platform,
-            "batch": batch, "seq": seq, "d": d,
+    # bf16 peak by device generation; unknown kinds get no MFU claim
+    # rather than one computed against the wrong denominator.
+    peaks = {"v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0,
+             "v6 lite": 918.0, "v6e": 918.0}
+    kind = getattr(dev, "device_kind", "").lower()
+    peak = next((p for k2, p in peaks.items() if k2 in kind), None)
+    mfu = tflops / peak * 100 if (on_tpu and peak) else 0.0
+    print(f"# flash attention ({dev.platform}): {tflops:.1f} TFLOP/s "
+          f"sustained = {mfu:.0f}% MFU (b={batch} h={heads} s={seq} d={d} "
+          f"{dtype.__name__}, {ms_per_iter:.2f}ms/application, "
+          f"delta {k_small}->{k_large})", file=sys.stderr)
+    return {"tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
+            "platform": dev.platform, "batch": batch, "heads": heads,
+            "seq": seq, "d": d,
             "ms_per_application": round(ms_per_iter, 3)}
 
 

@@ -204,3 +204,14 @@ def dryrun_multichip(n_devices: int) -> None:
     attn = ring_attention(mesh)(qkv[0], qkv[1], qkv[2])
     jax.block_until_ready(attn)
     assert np.isfinite(np.asarray(attn)).all(), "ring attention non-finite"
+
+    # Multi-head causal ring (the LLM shape): [b, h, s, d] with GQA over
+    # the same mesh — the Pallas flash kernel folds each visiting kv shard
+    # with globally-correct causal masks.
+    seq = 8 * n_shard
+    mh = jax.random.normal(jax.random.PRNGKey(4), (3, 2, 4, seq, 8),
+                           jnp.float32)
+    attn_mh = ring_attention(mesh, causal=True)(mh[0], mh[1], mh[2])
+    jax.block_until_ready(attn_mh)
+    assert attn_mh.shape == (2, 4, seq, 8)
+    assert np.isfinite(np.asarray(attn_mh)).all(), "mh ring non-finite"

@@ -8,81 +8,94 @@ around the ring with ``jax.lax.ppermute`` — after N-1 hops every query has
 attended to every key, and only one S/N-sized KV block is ever in flight
 per device (memory O(S/N), bandwidth fully on ICI neighbor links).
 
-Numerical form: the online-softmax (flash) accumulation — running block
-max ``m``, normalizer ``l``, and weighted accumulator rescaled per hop —
-so the result is EXACT full attention (verified against the dense
-reference in tests/test_data_plane.py), not an approximation.
+The per-hop compute is the Pallas flash kernel
+(brpc_tpu/ops/flash_attention.py): block-tiled online softmax in VMEM —
+no [s, s/N] score materialization — multi-head [b, h, s, d] with causal
+masking and GQA. Each hop folds the visiting kv shard into the resident
+queries' (m, l, acc) carries; the kv origin offset is a runtime scalar so
+every hop reuses one compiled kernel and causal masks stay globally
+correct across shards.
 
-Public papers this follows: blockwise/ring attention (Liu et al.) and the
-flash-attention online softmax; the implementation here is original and
-shard_map-native so XLA schedules the ppermute against the block matmuls.
+Numerically EXACT full attention (verified against the dense reference in
+tests/test_data_plane.py and tests/test_flash_attention.py), not an
+approximation. Public papers this follows: blockwise/ring attention
+(Liu et al.) and the flash-attention online softmax (Dao et al.); the
+implementation is original and shard_map-native so XLA schedules the
+ppermute against the block matmuls.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from brpc_tpu.ops.flash_attention import (flash_attention_carry,
+                                          flash_finalize, flash_init)
 from brpc_tpu.parallel.mesh import SHARD_AXIS
 
 
-def ring_attention(mesh: Mesh, axis: str = SHARD_AXIS):
+def ring_attention(mesh: Mesh, axis: str = SHARD_AXIS, *,
+                   causal: bool = False, block_q: int = 1024,
+                   block_k: int = 1024):
     """Builds a jitted ``fn(q, k, v) -> out`` for sequence-sharded exact
     attention.
 
-    Shapes (global): q, k, v are [batch, seq, d]; seq must divide by the
-    mesh's ``axis`` size. In/out layouts shard the SEQUENCE dimension —
-    the long-context regime where activations do not fit one device.
+    Shapes (global): [batch, seq, d] (single-head) or [batch, heads, seq,
+    d]; kv may carry fewer heads (GQA: kv_heads | heads). seq must divide
+    by the mesh's ``axis`` size; in/out layouts shard the SEQUENCE
+    dimension — the long-context regime where activations do not fit one
+    device. causal=True masks by GLOBAL position (shard offsets ride into
+    the kernel as runtime scalars).
     """
     n = mesh.shape[axis]
     fwd = [(i, (i + 1) % n) for i in range(n)]
 
-    @functools.partial(
-        shard_map, mesh=mesh, check_vma=False,
-        in_specs=(P(None, axis, None), P(None, axis, None),
-                  P(None, axis, None)),
-        out_specs=P(None, axis, None))
-    def _ring(q, k, v):  # local blocks: [batch, seq/n, d]
-        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    def _ring4(q, k, v):  # local blocks: [b, h, seq/n, d]
+        b, h, sq, d = q.shape
+        idx = jax.lax.axis_index(axis)
+        q_off = idx * sq
+        m, l, acc = flash_init(b, h, sq, d)
 
-        def attend(k_blk, v_blk, m, l, acc):
-            # Scores of the RESIDENT queries against the VISITING kv block,
-            # folded in with the online-softmax rescale.
-            s = jnp.einsum("bqd,bkd->bqk", q, k_blk) * scale
-            m_new = jnp.maximum(m, s.max(axis=-1))
-            p = jnp.exp(s - m_new[..., None])
-            correction = jnp.exp(m - m_new)
-            l = l * correction + p.sum(axis=-1)
-            acc = acc * correction[..., None] + jnp.einsum(
-                "bqk,bkd->bqd", p, v_blk)
-            return m_new, l, acc
+        def fold(kv_src, k_blk, v_blk, m, l, acc):
+            offsets = jnp.stack([q_off, kv_src * sq]).astype(jnp.int32)
+            return flash_attention_carry(
+                q, k_blk, v_blk, m, l, acc, offsets, causal=causal,
+                block_q=min(block_q, sq), block_k=min(block_k, sq))
 
-        batch, sq, d = q.shape
-        m0 = jnp.full((batch, sq), -jnp.inf, dtype=q.dtype)
-        l0 = jnp.zeros((batch, sq), dtype=q.dtype)
-        a0 = jnp.zeros((batch, sq, d), dtype=q.dtype)
-        # Hop 0: the resident kv block, no collective. Then exactly n-1
-        # permute-and-attend hops — the final block is consumed where it
+        # Hop 0: the resident kv shard, no collective. Then exactly n-1
+        # permute-and-fold hops — the final block is consumed where it
         # lands, never rotated onward.
-        m, l, acc = attend(k, v, m0, l0, a0)
+        m, l, acc = fold(idx, k, v, m, l, acc)
 
-        def hop(carry, _):
+        def hop(carry, t):
             k_blk, v_blk, m, l, acc = carry
             # Rotate first; XLA overlaps the ICI hop with the matmuls.
             k_blk = jax.lax.ppermute(k_blk, axis, fwd)
             v_blk = jax.lax.ppermute(v_blk, axis, fwd)
-            m, l, acc = attend(k_blk, v_blk, m, l, acc)
+            # After t+1 rotations this shard holds device (idx - t - 1)'s
+            # kv block — its global offset drives the causal mask.
+            src = jax.lax.rem(idx - t - 1 + n, n)
+            m, l, acc = fold(src, k_blk, v_blk, m, l, acc)
             return (k_blk, v_blk, m, l, acc), None
 
-        (_, _, _, l, acc), _ = jax.lax.scan(hop, (k, v, m, l, acc), None,
-                                            length=n - 1)
-        return acc / l[..., None]
+        (_, _, m, l, acc), _ = jax.lax.scan(
+            hop, (k, v, m, l, acc), jnp.arange(n - 1))
+        return flash_finalize(l, acc, q.dtype)
 
-    return jax.jit(_ring)
+    spec4 = P(None, None, axis, None)
+    ring4 = shard_map(_ring4, mesh=mesh, check_vma=False,
+                      in_specs=(spec4, spec4, spec4), out_specs=spec4)
+
+    @jax.jit
+    def run(q, k, v):
+        if q.ndim == 3:  # single-head convenience: [b, s, d]
+            out = ring4(q[:, None], k[:, None], v[:, None])
+            return out[:, 0]
+        return ring4(q, k, v)
+
+    return run
 
 
 def dense_attention_reference(q: jax.Array, k: jax.Array,
