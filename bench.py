@@ -274,15 +274,19 @@ def ring_attention_point():
              "v6 lite": 918.0, "v6e": 918.0}
     kind = getattr(dev, "device_kind", "").lower()
     peak = next((p for k2, p in peaks.items() if k2 in kind), None)
-    mfu = tflops / peak * 100 if (on_tpu and peak) else 0.0
+    row = {"tflops": round(tflops, 1), "platform": dev.platform,
+           "batch": batch, "heads": heads, "seq": seq, "d": d,
+           "ms_per_application": round(ms_per_iter, 3)}
+    mfu_str = ""
+    if on_tpu and peak:
+        row["mfu_pct"] = round(tflops / peak * 100, 1)
+        row["peak_tflops"] = peak
+        mfu_str = f" = {row['mfu_pct']:.0f}% MFU (peak {peak:.0f})"
     print(f"# flash attention ({dev.platform}): {tflops:.1f} TFLOP/s "
-          f"sustained = {mfu:.0f}% MFU (b={batch} h={heads} s={seq} d={d} "
+          f"sustained{mfu_str} (b={batch} h={heads} s={seq} d={d} "
           f"{dtype.__name__}, {ms_per_iter:.2f}ms/application, "
           f"delta {k_small}->{k_large})", file=sys.stderr)
-    return {"tflops": round(tflops, 1), "mfu_pct": round(mfu, 1),
-            "platform": dev.platform, "batch": batch, "heads": heads,
-            "seq": seq, "d": d,
-            "ms_per_application": round(ms_per_iter, 3)}
+    return row
 
 
 if __name__ == "__main__":

@@ -205,13 +205,15 @@ def dryrun_multichip(n_devices: int) -> None:
     jax.block_until_ready(attn)
     assert np.isfinite(np.asarray(attn)).all(), "ring attention non-finite"
 
-    # Multi-head causal ring (the LLM shape): [b, h, s, d] with GQA over
-    # the same mesh — the Pallas flash kernel folds each visiting kv shard
-    # with globally-correct causal masks.
+    # Multi-head causal ring (the LLM shape): [b, h, s, d] with GQA (4 q
+    # heads over 2 kv heads) on the same mesh — the Pallas flash kernel
+    # folds each visiting kv shard with globally-correct causal masks.
     seq = 8 * n_shard
-    mh = jax.random.normal(jax.random.PRNGKey(4), (3, 2, 4, seq, 8),
-                           jnp.float32)
-    attn_mh = ring_attention(mesh, causal=True)(mh[0], mh[1], mh[2])
+    q_mh = jax.random.normal(jax.random.PRNGKey(4), (2, 4, seq, 8),
+                             jnp.float32)
+    kv_mh = jax.random.normal(jax.random.PRNGKey(5), (2, 2, 2, seq, 8),
+                              jnp.float32)
+    attn_mh = ring_attention(mesh, causal=True)(q_mh, kv_mh[0], kv_mh[1])
     jax.block_until_ready(attn_mh)
     assert attn_mh.shape == (2, 4, seq, 8)
     assert np.isfinite(np.asarray(attn_mh)).all(), "mh ring non-finite"

@@ -129,8 +129,8 @@ def flash_attention_carry(q, k, v, m, l, acc, offsets, *, causal: bool = False,
     def kvmap(bh, iq, jk):
         return (bh // h, (bh % h) // group, jk, 0)
 
-    def mlmap(bh, iq, jk):
-        return (bh // h, bh % h, iq, 0)
+    # m/l share q's (bh, iq) walk; their trailing dim is the singleton.
+    mlmap = qmap
 
     kernel = functools.partial(_carry_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk)

@@ -545,12 +545,20 @@ namespace {
 // noise on a small host.
 class QueueingService : public Service {
  public:
+  // Per-depth service tick. Calibrated at runtime (see run_overload):
+  // under a sanitizer's ~10x slowdown the CLIENT-side per-call overhead
+  // inflates, and with a fixed 2ms tick the 24 clients can no longer hold
+  // the queue >= 20 deep (equilibrium depth ~ 24 - overhead/tick) — the
+  // r4 TSan flake. Scaling the tick with measured overhead keeps the load
+  // SHAPE invariant across build flavors.
+  std::atomic<int64_t> base_us{2000};
+
   std::string_view service_name() const override { return "QueueSvc"; }
   void CallMethod(const std::string& method, Controller* cntl,
                   const tbutil::IOBuf& request, tbutil::IOBuf* response,
                   Closure* done) override {
     const int n = _inflight.fetch_add(1) + 1;
-    tbthread::fiber_usleep(2000 * n);
+    tbthread::fiber_usleep(base_us.load(std::memory_order_relaxed) * n);
     _inflight.fetch_sub(1);
     {
       std::lock_guard<std::mutex> lk(_mu);
@@ -579,6 +587,7 @@ class QueueingService : public Service {
 
 struct OverloadResult {
   int64_t p50_us = 0;
+  int64_t base_us = 2000;
   int median_depth = 0;
   int ok = 0;
   int shed = 0;
@@ -601,11 +610,36 @@ OverloadResult run_overload(bool auto_limit) {
   copts.connection_type = ConnectionType::kPooled;
   channel.Init(addr, &copts);
 
+  // Calibration: median per-call round-trip with zero service time = the
+  // stack's own overhead on THIS build flavor. The service tick must
+  // dominate it (see QueueingService::base_us) or the intended overload
+  // shape never forms under sanitizer slowdown.
+  {
+    svc.base_us.store(0);
+    std::vector<int64_t> rtts;
+    for (int i = 0; i < 32; ++i) {
+      Controller cntl;
+      tbutil::IOBuf req, resp;
+      req.append("c");
+      const int64_t t0 = tbutil::monotonic_time_us();
+      channel.CallMethod("QueueSvc/Q", &cntl, req, &resp, nullptr);
+      if (!cntl.Failed()) rtts.push_back(tbutil::monotonic_time_us() - t0);
+    }
+    std::sort(rtts.begin(), rtts.end());
+    const int64_t overhead = rtts.empty() ? 0 : rtts[rtts.size() / 2];
+    svc.base_us.store(std::max<int64_t>(2000, 3 * overhead));
+  }
+
   std::mutex mu;
   std::vector<int64_t> latencies;
   std::atomic<int> ok{0}, shed{0};
   std::vector<std::thread> threads;
-  const int64_t stop_at = tbutil::monotonic_time_us() + 2000000;
+  // Run long enough for ~15 settled calls per client at full depth
+  // (depth 24 x tick): fixed 2s on a plain build, stretched when the
+  // calibrated tick is larger.
+  const int64_t run_us = std::max<int64_t>(
+      2000000, 15 * 24 * svc.base_us.load());
+  const int64_t stop_at = tbutil::monotonic_time_us() + run_us;
   for (int t = 0; t < 24; ++t) {
     threads.emplace_back([&] {
       std::vector<int64_t> local;
@@ -630,6 +664,7 @@ OverloadResult run_overload(bool auto_limit) {
   OverloadResult r;
   r.ok = ok.load();
   r.shed = shed.load();
+  r.base_us = svc.base_us.load();
   r.final_limit = server.current_max_concurrency();
   r.median_depth = svc.median_settled_depth();
   if (!latencies.empty()) {
@@ -652,9 +687,10 @@ TEST_CASE(auto_concurrency_limiter_converges) {
   ASSERT_TRUE(unlimited.ok > 0);
   ASSERT_TRUE(adaptive.ok > 0);
   // Control: all 24 clients pile in — requests observe ~full queueing
-  // depth and median latency ~24 x 2ms.
+  // depth and median latency ~24 ticks. Thresholds are in units of the
+  // CALIBRATED tick, so sanitizer builds assert the same load shape.
   ASSERT_TRUE(unlimited.median_depth >= 20);
-  ASSERT_TRUE(unlimited.p50_us >= 25000);
+  ASSERT_TRUE(unlimited.p50_us >= 12 * unlimited.base_us);
   // Adaptive: the gate converged below the offered load, admitted requests
   // observe a much shallower queue, and the excess was shed.
   ASSERT_TRUE(adaptive.final_limit < 24);

@@ -233,6 +233,37 @@ TEST_CASE(tpu_peer_death_fails_inflight) {
   }
 }
 
+TEST_CASE(tpu_fallback_to_tcp_on_map_failure) {
+  // Segment mapping fails (the cross-host / no-shared-/dev/shm case): the
+  // server NACKs instead of killing the connection, and RPCs complete
+  // over plain TCP on the SAME socket (reference RDMA handshake fallback,
+  // rdma/rdma_endpoint.h:44-59).
+  ASSERT_TRUE(FlagRegistry::global().Set("ici_fail_map_for_test", "1"));
+  {
+    TpuEnv env;
+    std::string out;
+    // Both inline-sized and block-sized payloads must flow (no segment
+    // path exists; everything rides TCP).
+    ASSERT_EQ(echo_once(&env.channel, "over tcp now", &out), 0);
+    ASSERT_EQ(out, std::string("over tcp now"));
+    const std::string big = pattern_payload(1 << 20, 'F');
+    ASSERT_EQ(echo_once(&env.channel, big, &out), 0);
+    ASSERT_TRUE(out == big);
+    ASSERT_EQ(g_last_req_meta.load(), 0u);  // heap bytes, not segment refs
+    // The client endpoint settled into TCP fallback, not active.
+    tbutil::EndPoint pt;
+    char addr[32];
+    snprintf(addr, sizeof(addr), "127.0.0.1:%d", env.port);
+    ASSERT_EQ(tbutil::str2endpoint(addr, &pt), 0);
+    SocketUniquePtr s;
+    ASSERT_EQ(SocketMap::global().GetOrCreate(pt, &s, /*tpu=*/true), 0);
+    ASSERT_TRUE(s->ici_endpoint() != nullptr);
+    ASSERT_FALSE(s->ici_endpoint()->active());
+    ASSERT_TRUE(s->ici_endpoint()->tcp_fallback());
+  }
+  ASSERT_TRUE(FlagRegistry::global().Set("ici_fail_map_for_test", "0"));
+}
+
 TEST_CASE(tpu_and_plain_coexist) {
   // The same server serves tpu:// and plain tstd clients on one port (the
   // multi-protocol registry at work).

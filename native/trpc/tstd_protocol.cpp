@@ -166,7 +166,14 @@ static bool parse_meta(const std::string& raw, TstdMeta* meta) {
 ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
   ParseResult r;
   if (source->size() < kHeaderSize) {
-    r.error = PARSE_ERROR_NOT_ENOUGH_DATA;
+    // Judge the magic on whatever prefix exists before claiming the
+    // buffer: a short non-tstd frame (e.g. the 8-byte tici HELLO-NACK)
+    // must fall through to its own parser, not be held hostage here
+    // waiting for a 12-byte header that will never complete.
+    char head[4];
+    const size_t n = source->copy_to(head, 4);
+    r.error = memcmp(head, kMagic, n) == 0 ? PARSE_ERROR_NOT_ENOUGH_DATA
+                                           : PARSE_ERROR_TRY_OTHERS;
     return r;
   }
   char header[kHeaderSize];

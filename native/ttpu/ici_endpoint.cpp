@@ -163,7 +163,10 @@ int IciEndpoint::WaitActive(int64_t deadline_us) {
   timespec abstime;
   abstime.tv_sec = deadline_us / 1000000;
   abstime.tv_nsec = (deadline_us % 1000000) * 1000;
-  while (!active()) {
+  auto settled = [this] {
+    return _state.load(std::memory_order_acquire) != State::kClientPending;
+  };
+  while (!settled()) {
     if (_socket->Failed()) {
       errno = trpc::TRPC_ECONNECT;
       return -1;
@@ -176,14 +179,21 @@ int IciEndpoint::WaitActive(int64_t deadline_us) {
         tbthread::butex_value(_hs_btx)->load(std::memory_order_acquire);
     // Re-check BOTH exit conditions after the snapshot: a wake landing
     // between check and park would otherwise be lost until the deadline.
-    if (active()) break;
+    if (settled()) break;
     if (_socket->Failed()) {
       errno = trpc::TRPC_ECONNECT;
       return -1;
     }
     tbthread::butex_wait(_hs_btx, expected, &abstime);
   }
-  return 0;
+  return 0;  // kActive or kTcpFallback: either way the socket is usable
+}
+
+void IciEndpoint::OnNack() {
+  // The peer will never map our segment: drop the /dev/shm name now.
+  _tx->UnlinkEarly();
+  _state.store(State::kTcpFallback, std::memory_order_release);
+  tbthread::butex_increment_and_wake_all(_hs_btx);
 }
 
 IciEndpoint* IciEndpoint::StartServer(trpc::Socket* s,
@@ -636,8 +646,18 @@ trpc::ParseResult tici_parse(tbutil::IOBuf* source, trpc::Socket* socket) {
         source->pop_front(consumed);
         ep = IciEndpoint::StartServer(socket, name, bs, nb);
         if (ep == nullptr) {
-          r.error = trpc::PARSE_ERROR_ABSOLUTELY_WRONG;
-          return r;
+          // Can't set up the shm path (cross-host dial, /dev/shm mismatch,
+          // segment limits): NACK and keep serving this connection as
+          // plain TCP — the control channel already IS one. Reference
+          // parity: the RDMA handshake falls back to TCP the same way
+          // (rdma/rdma_endpoint.h:44-59).
+          TB_LOG(WARNING) << "tpu:// segment setup failed for peer " << name
+                          << "; continuing as plain TCP";
+          std::string nack;
+          append_prefix(&nack, kHelloNack);
+          tbutil::IOBuf buf;
+          buf.append(nack);
+          socket->Write(&buf);
         }
         continue;
       }
@@ -731,6 +751,15 @@ trpc::ParseResult tici_parse(tbutil::IOBuf* source, trpc::Socket* socket) {
           return r;
         }
         source->pop_front(consumed);
+        continue;
+      }
+      case kHelloNack: {
+        if (ep == nullptr || ep->active()) {
+          r.error = trpc::PARSE_ERROR_ABSOLUTELY_WRONG;
+          return r;
+        }
+        source->pop_front(kPrefix);
+        ep->OnNack();
         continue;
       }
       case kArenaRelease: {

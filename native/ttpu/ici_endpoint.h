@@ -50,13 +50,25 @@ namespace ttpu {
 
 class IciEndpoint {
  public:
-  enum class State { kClientPending, kActive };
+  // kTcpFallback: the server could not set up the shm path (segment map
+  // failed — e.g. a cross-host dial where /dev/shm isn't shared) and sent
+  // a HELLO-NACK; the connection stays up and every message rides plain
+  // TCP on the same socket forever. Mirrors the reference's RDMA
+  // handshake falling back to TCP (rdma/rdma_endpoint.h:44-59).
+  enum class State { kClientPending, kActive, kTcpFallback };
 
   // CLIENT: create the TX segment, install on the socket, queue the HELLO
-  // frame; caller then parks in WaitActive until the ACK (parsed on the
-  // input fiber) arrives. Returns null if the segment can't be created.
+  // frame; caller then parks in WaitActive until the ACK or NACK (parsed
+  // on the input fiber) arrives. Returns null if the segment can't be
+  // created. WaitActive returns 0 on BOTH outcomes — callers check
+  // active() if they must distinguish.
   static IciEndpoint* StartClient(trpc::Socket* s);
   int WaitActive(int64_t deadline_us);
+  // CLIENT: HELLO-NACK arrived — settle into TCP fallback.
+  void OnNack();
+  bool tcp_fallback() const {
+    return _state.load(std::memory_order_acquire) == State::kTcpFallback;
+  }
 
   // SERVER: HELLO arrived — map the client's segment, create our TX
   // segment, install on the socket, queue the ACK. Null on failure.
@@ -174,6 +186,8 @@ enum FrameType : uint8_t {
   // TensorArena (registered app memory) support:
   kRegArena = 4,       // u32 arena_id | u32 bytes | u16 name_len | name
   kArenaRelease = 5,   // u32 arena_id | u32 off | u32 len
+  // Server cannot do shm (segment map failed): stay plain TCP (no body).
+  kHelloNack = 6,
 };
 inline constexpr size_t kPrefix = 8;
 // kData ref entry: u32 block_idx, u32 offset, u32 len. A block_idx with

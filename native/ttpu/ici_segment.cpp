@@ -14,6 +14,7 @@
 #include <map>
 
 #include "tbutil/logging.h"
+#include "trpc/flags.h"
 #include "trpc/socket.h"
 #include "ttpu/ici_endpoint.h"
 
@@ -22,6 +23,12 @@ namespace ttpu {
 namespace {
 constexpr uint8_t kHeld = 1;
 constexpr uint8_t kInflight = 2;
+
+// Fault injection for the TCP-fallback path (tests flip it via /flags):
+// simulates the cross-host case where the peer's shm name can't be mapped.
+std::atomic<int64_t>* g_fail_map = TRPC_DEFINE_FLAG(
+    ici_fail_map_for_test, 0,
+    "fault injection: make tpu:// peer segment mapping fail (0/1)");
 
 std::string next_segment_name() {
   static std::atomic<uint64_t> counter{0};
@@ -96,6 +103,10 @@ std::shared_ptr<IciSegment> IciSegment::CreateOwner(uint32_t block_size,
 std::shared_ptr<IciSegment> IciSegment::MapPeer(const std::string& name,
                                                 uint32_t block_size,
                                                 uint32_t n_blocks) {
+  if (g_fail_map->load(std::memory_order_relaxed) != 0) {
+    TB_LOG(WARNING) << "ici_fail_map_for_test: refusing to map " << name;
+    return nullptr;
+  }
   if (block_size == 0 || n_blocks == 0 ||
       size_t(block_size) * n_blocks > (1ULL << 34)) {
     return nullptr;  // refuse absurd handshake values
