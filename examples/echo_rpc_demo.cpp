@@ -1,34 +1,37 @@
-// The canonical end-to-end drive: an echo Server + Channel over loopback
-// with timeout/retry — the analog of reference example/echo_c++
-// (client.cpp:36-63 sync stub call).
+// The canonical end-to-end drive: a TYPED echo Server + Channel over
+// loopback with timeout/retry — the analog of reference example/echo_c++
+// (client.cpp:36-63: generated EchoService_Stub + echo.proto messages).
+// All marshalling here is tidl_gen-generated code (examples/echo.tidl);
+// nothing is packed by hand.
 #include <cstdio>
 #include <string>
 
+#include "echo.tidl.h"
 #include "trpc/channel.h"
 #include "trpc/server.h"
 
 using namespace trpc;
 
-class EchoService : public Service {
+class EchoServiceImpl : public tidl_gen::EchoServiceBase {
  public:
-  std::string_view service_name() const override { return "EchoService"; }
-  void CallMethod(const std::string& method, Controller* cntl,
-                  const tbutil::IOBuf& request, tbutil::IOBuf* response,
-                  Closure* done) override {
-    if (method != "Echo") {
-      cntl->SetFailed(1002, "no such method");
-      done->Run();
-      return;
-    }
-    response->append(request);
+  void Echo(Controller* cntl, const tidl_gen::EchoRequest& request,
+            tidl_gen::EchoResponse* response) override {
+    response->message = request.message;
+    response->serial = request.serial;
+    response->stats.served = ++_served;
+    response->stats.mean_len =
+        (_total_len += request.message.size()) / double(_served);
     cntl->response_attachment().append(cntl->request_attachment());
-    done->Run();
   }
+
+ private:
+  int64_t _served = 0;
+  int64_t _total_len = 0;
 };
 
 int main() {
   Server server;
-  EchoService service;
+  EchoServiceImpl service;
   if (server.AddService(&service) != 0 || server.Start(0) != 0) {
     fprintf(stderr, "server start failed\n");
     return 1;
@@ -43,19 +46,30 @@ int main() {
     return 1;
   }
 
+  tidl_gen::EchoService_Stub stub(&channel);
   for (int i = 0; i < 5; ++i) {
     Controller cntl;
-    tbutil::IOBuf request, response;
-    request.append("echo #" + std::to_string(i));
+    tidl_gen::EchoRequest request;
+    tidl_gen::EchoResponse response;
+    request.message = "echo #" + std::to_string(i);
+    request.serial = i;
+    for (int h = 0; h < i; ++h) request.history.push_back(h);
     cntl.request_attachment().append("(attachment)");
-    channel.CallMethod("EchoService/Echo", &cntl, request, &response,
-                       nullptr);
+    stub.Echo(&cntl, request, &response);
     if (cntl.Failed()) {
       fprintf(stderr, "rpc failed: %s\n", cntl.ErrorText().c_str());
       return 1;
     }
-    printf("response=%s attachment=%s latency=%ldus\n",
-           response.to_string().c_str(),
+    if (response.message != request.message ||
+        response.serial != i || response.stats.served != i + 1) {
+      fprintf(stderr, "typed response mismatch at #%d\n", i);
+      return 1;
+    }
+    printf("response=%s serial=%d served=%lld mean_len=%.1f "
+           "attachment=%s latency=%ldus\n",
+           response.message.c_str(), response.serial,
+           static_cast<long long>(response.stats.served),
+           response.stats.mean_len,
            cntl.response_attachment().to_string().c_str(),
            static_cast<long>(cntl.latency_us()));
   }

@@ -1,14 +1,15 @@
-// One service, two doors: a structured JSON method served simultaneously
-// as a binary tstd RPC and as a curl-able HTTP+JSON endpoint — the
-// reference's json2pb story (src/json2pb) in framework form
-// (trpc/json_service.h bridges both).
+// One TYPED service, two doors: the tidl-generated EchoService served
+// simultaneously as a binary typed-stub RPC and as a curl-able HTTP+JSON
+// endpoint — the reference's json2pb story (src/json2pb: protobuf services
+// reachable as JSON over HTTP) driven entirely by generated marshalling
+// (examples/echo.tidl -> FromJson/ToJson/RegisterJson; nothing by hand).
 #include <cstdio>
 #include <string>
 
+#include "echo.tidl.h"
 #include "tbutil/json.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
-#include "trpc/errno.h"
 #include "trpc/http_protocol.h"
 #include "trpc/json_service.h"
 #include "trpc/server.h"
@@ -16,65 +17,83 @@
 using namespace trpc;
 using tbutil::JsonValue;
 
+namespace {
+
+class EchoImpl : public tidl_gen::EchoServiceBase {
+ public:
+  void Echo(Controller* cntl, const tidl_gen::EchoRequest& request,
+            tidl_gen::EchoResponse* response) override {
+    (void)cntl;
+    response->message = request.message;
+    response->serial = request.serial;
+    response->stats.served = ++_served;
+    response->stats.mean_len =
+        (_total += request.message.size()) / double(_served);
+  }
+
+ private:
+  int64_t _served = 0;
+  int64_t _total = 0;
+};
+
+}  // namespace
+
 int main() {
-  JsonService stats("Stats");
-  stats.AddMethod("Summarize", [](const JsonValue& req, JsonValue* resp,
-                                  Controller* cntl) {
-    const JsonValue* values = req.find("values");
-    if (values == nullptr || !values->is_array() || values->items().empty()) {
-      cntl->SetFailed(TRPC_EREQUEST, "expected {\"values\": [numbers...]}");
-      return;
-    }
-    double sum = 0, mn = 0, mx = 0;
-    bool first = true;
-    for (const JsonValue& v : values->items()) {
-      const double x = v.as_double();
-      sum += x;
-      if (first || x < mn) mn = x;
-      if (first || x > mx) mx = x;
-      first = false;
-    }
-    *resp = JsonValue::Object();
-    resp->set("count", JsonValue(int64_t(values->size())));
-    resp->set("sum", JsonValue(sum));
-    resp->set("min", JsonValue(mn));
-    resp->set("max", JsonValue(mx));
-  });
+  EchoImpl impl;
+  JsonService json_door("EchoJson");
+  impl.RegisterJson(&json_door);  // generated bridge
 
   Server server;
-  if (server.AddService(&stats) != 0) return 1;
+  if (server.AddService(&impl) != 0) return 1;
+  if (server.AddService(&json_door) != 0) return 1;
   if (server.Start("127.0.0.1:0", nullptr) != 0) return 1;
   const int port = server.listen_address().port;
-  printf("try: curl -d '{\"values\":[3,1,4]}' "
-         "http://127.0.0.1:%d/Stats/Summarize\n", port);
+  printf("try: curl -d '{\"message\":\"hi\",\"serial\":1}' "
+         "http://127.0.0.1:%d/EchoJson/Echo\n", port);
 
-  // Door 1: binary tstd RPC carrying JSON.
   char addr[32];
   snprintf(addr, sizeof(addr), "127.0.0.1:%d", port);
+
+  // Door 1: binary typed stub (generated wire marshalling).
   Channel rpc;
   if (rpc.Init(addr, nullptr) != 0) return 1;
+  tidl_gen::EchoService_Stub stub(&rpc);
   Controller c1;
-  tbutil::IOBuf req1, resp1;
-  req1.append("{\"values\":[3,1,4,1,5,9,2,6]}");
-  rpc.CallMethod("Stats/Summarize", &c1, req1, &resp1, nullptr);
-  if (c1.Failed()) return 1;
-  printf("tstd door: %s\n", resp1.to_string().c_str());
+  tidl_gen::EchoRequest req1;
+  req1.message = "binary door";
+  req1.serial = 7;
+  tidl_gen::EchoResponse resp1;
+  stub.Echo(&c1, req1, &resp1);
+  if (c1.Failed() || resp1.message != "binary door" ||
+      resp1.stats.served != 1) {
+    fprintf(stderr, "binary door failed: %s\n", c1.ErrorText().c_str());
+    return 1;
+  }
+  printf("binary door: message=%s served=%lld\n", resp1.message.c_str(),
+         static_cast<long long>(resp1.stats.served));
 
-  // Door 2: the same method over HTTP+JSON (what curl would do).
+  // Door 2: the SAME impl over HTTP+JSON (what curl would do), marshalled
+  // by the generated FromJson/ToJson.
   Channel http;
   ChannelOptions hopts;
   hopts.protocol = kHttpProtocolIndex;
   if (http.Init(addr, &hopts) != 0) return 1;
   Controller c2;
   tbutil::IOBuf req2, resp2;
-  req2.append("{\"values\":[10,20,30]}");
-  http.CallMethod("Stats/Summarize", &c2, req2, &resp2, nullptr);
-  if (c2.Failed()) return 1;
+  req2.append("{\"message\":\"json door\",\"serial\":8}");
+  http.CallMethod("EchoJson/Echo", &c2, req2, &resp2, nullptr);
+  if (c2.Failed()) {
+    fprintf(stderr, "http door failed: %s\n", c2.ErrorText().c_str());
+    return 1;
+  }
   printf("http door: %s\n", resp2.to_string().c_str());
 
   auto parsed = JsonValue::Parse(resp2.to_string());
-  const bool ok = parsed && parsed->find("sum") != nullptr &&
-                  parsed->find("sum")->as_double() == 60.0;
+  const bool ok = parsed && parsed->find("message") != nullptr &&
+                  parsed->find("message")->as_string() == "json door" &&
+                  parsed->find("stats") != nullptr &&
+                  parsed->find("stats")->find("served") != nullptr &&
+                  parsed->find("stats")->find("served")->as_int() == 2;
   server.Stop();
   printf(ok ? "json http demo OK\n" : "json http demo FAILED\n");
   return ok ? 0 : 1;

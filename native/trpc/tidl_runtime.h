@@ -1,0 +1,160 @@
+// Runtime support for tidl-generated code (tools/tidl_gen.cpp).
+//
+// tidl is the framework's typed-stub pipeline — the role protobuf + codegen
+// plays in the reference's programming model (generated EchoService_Stub,
+// example/echo_c++/client.cpp:36-63; generator pattern
+// mcpack2pb/generator.cpp). The wire format is the protobuf wire format
+// proper (varint tags, the four core wire types), so tidl messages are
+// binary-compatible with same-schema .proto messages; the generator stays
+// small because everything data-driven lives here.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+namespace tidl {
+
+enum WireType : uint32_t {
+  kVarint = 0,
+  kFixed64 = 1,
+  kLenDelim = 2,
+  kFixed32 = 5,
+};
+
+// ---- encode ----
+
+inline void put_varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+inline void put_tag(std::string* out, uint32_t field, WireType wt) {
+  put_varint(out, (uint64_t(field) << 3) | wt);
+}
+
+inline uint64_t zigzag(int64_t v) {
+  return (uint64_t(v) << 1) ^ uint64_t(v >> 63);
+}
+inline int64_t unzigzag(uint64_t v) {
+  return int64_t(v >> 1) ^ -int64_t(v & 1);
+}
+
+inline void put_varint_field(std::string* out, uint32_t f, uint64_t v) {
+  put_tag(out, f, kVarint);
+  put_varint(out, v);
+}
+inline void put_sint_field(std::string* out, uint32_t f, int64_t v) {
+  put_tag(out, f, kVarint);
+  put_varint(out, zigzag(v));
+}
+inline void put_bool_field(std::string* out, uint32_t f, bool v) {
+  put_varint_field(out, f, v ? 1 : 0);
+}
+inline void put_double_field(std::string* out, uint32_t f, double v) {
+  put_tag(out, f, kFixed64);
+  out->append(reinterpret_cast<const char*>(&v), 8);
+}
+inline void put_float_field(std::string* out, uint32_t f, float v) {
+  put_tag(out, f, kFixed32);
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+inline void put_bytes_field(std::string* out, uint32_t f,
+                            std::string_view v) {
+  put_tag(out, f, kLenDelim);
+  put_varint(out, v.size());
+  out->append(v.data(), v.size());
+}
+
+// ---- decode ----
+
+struct Reader {
+  const char* p;
+  const char* end;
+
+  explicit Reader(std::string_view s) : p(s.data()), end(s.data() + s.size()) {}
+  bool done() const { return p >= end; }
+
+  bool varint(uint64_t* v) {
+    uint64_t out = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      const uint8_t b = static_cast<uint8_t>(*p++);
+      out |= uint64_t(b & 0x7F) << shift;
+      if ((b & 0x80) == 0) {
+        *v = out;
+        return true;
+      }
+      shift += 7;
+    }
+    return false;
+  }
+
+  bool tag(uint32_t* field, uint32_t* wt) {
+    uint64_t t;
+    if (!varint(&t) || t > (uint64_t(1) << 35)) return false;
+    *field = static_cast<uint32_t>(t >> 3);
+    *wt = static_cast<uint32_t>(t & 7);
+    return *field != 0;
+  }
+
+  bool fixed64(uint64_t* v) {
+    if (end - p < 8) return false;
+    memcpy(v, p, 8);
+    p += 8;
+    return true;
+  }
+  bool fixed32(uint32_t* v) {
+    if (end - p < 4) return false;
+    memcpy(v, p, 4);
+    p += 4;
+    return true;
+  }
+  bool bytes(std::string_view* v) {
+    uint64_t n;
+    if (!varint(&n) || n > size_t(end - p)) return false;
+    *v = std::string_view(p, static_cast<size_t>(n));
+    p += n;
+    return true;
+  }
+
+  // Unknown fields are skipped, not rejected: schema evolution.
+  bool skip(uint32_t wt) {
+    switch (wt) {
+      case kVarint: {
+        uint64_t v;
+        return varint(&v);
+      }
+      case kFixed64: {
+        uint64_t v;
+        return fixed64(&v);
+      }
+      case kLenDelim: {
+        std::string_view v;
+        return bytes(&v);
+      }
+      case kFixed32: {
+        uint32_t v;
+        return fixed32(&v);
+      }
+      default:
+        return false;
+    }
+  }
+};
+
+// Flatten an IOBuf for parsing (messages are small relative to
+// attachments, which ride the attachment channel untouched).
+inline std::string flatten(const tbutil::IOBuf& buf) {
+  return buf.to_string();
+}
+
+}  // namespace tidl
+}  // namespace trpc

@@ -1,0 +1,123 @@
+"""tidl typed stubs: generated Python messages + stubs, and wire-format
+interop with protobuf proper.
+
+The generator (tools/tidl_gen.cpp — the reference's protoc/mcpack2pb
+codegen analog) emits the protobuf wire format, so a tidl message must be
+byte-compatible with a same-schema protobuf message; that is asserted here
+with a dynamically-built proto descriptor. The service test runs the
+generated Python stub against a generated-Python service over the native
+RPC stack.
+"""
+
+import os
+import sys
+
+import pytest
+
+_TIDL_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "build", "tidl_out")
+
+
+@pytest.fixture(scope="module")
+def echo_tidl():
+    from brpc_tpu.runtime import native
+    native.lib()  # builds the native tree (and codegen) on demand
+    if not os.path.isdir(_TIDL_OUT):
+        pytest.skip("tidl_out not generated")
+    sys.path.insert(0, _TIDL_OUT)
+    import echo_tidl
+    return echo_tidl
+
+
+def test_round_trip_all_field_kinds(echo_tidl):
+    req = echo_tidl.EchoRequest(message="héllo", serial=-3,
+                                history=[1, 2, 300000])
+    blob = req.encode()
+    back = echo_tidl.EchoRequest.decode(blob)
+    assert back.message == "héllo"
+    assert back.serial == -3
+    assert back.history == [1, 2, 300000]
+    resp = echo_tidl.EchoResponse(
+        message="m", serial=7,
+        stats=echo_tidl.Stats(served=41, mean_len=3.25))
+    back2 = echo_tidl.EchoResponse.decode(resp.encode())
+    assert back2.stats.served == 41
+    assert back2.stats.mean_len == 3.25
+
+
+def test_protobuf_wire_interop(echo_tidl):
+    pb = pytest.importorskip("google.protobuf")
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+
+    fdp = descriptor_pb2.FileDescriptorProto(
+        name="tidl_interop.proto", package="ti", syntax="proto3")
+    m = fdp.message_type.add(name="EchoRequest")
+    f = m.field.add(name="message", number=1, type=9, label=1)   # string
+    f = m.field.add(name="serial", number=2, type=5, label=1)    # int32
+    f = m.field.add(name="history", number=3, type=5, label=3)   # rep int32
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    cls = message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("ti.EchoRequest"))
+
+    # tidl -> protobuf
+    req = echo_tidl.EchoRequest(message="interop", serial=12,
+                                history=[5, 6, 7])
+    parsed = cls.FromString(req.encode())
+    assert parsed.message == "interop"
+    assert parsed.serial == 12
+    assert list(parsed.history) == [5, 6, 7]
+
+    # protobuf -> tidl (protobuf packs repeated int32 by default: the
+    # packed-decoding path)
+    msg = cls(message="back", serial=-9, history=[9, 10])
+    back = echo_tidl.EchoRequest.decode(msg.SerializeToString())
+    assert back.message == "back"
+    assert back.serial == -9
+    assert back.history == [9, 10]
+
+
+def test_generated_service_and_stub_over_rpc(echo_tidl):
+    from brpc_tpu.runtime import native
+
+    class Impl:
+        def __init__(self):
+            self.served = 0
+            self.total = 0
+
+        def Echo(self, request, attachment):
+            self.served += 1
+            self.total += len(request.message)
+            resp = echo_tidl.EchoResponse(
+                message=request.message, serial=request.serial,
+                stats=echo_tidl.Stats(served=self.served,
+                                      mean_len=self.total / self.served))
+            return resp, attachment
+
+    server = native.Server()
+    echo_tidl.add_EchoService(server, Impl())
+    port = server.start("127.0.0.1:0")
+    ch = native.Channel(f"127.0.0.1:{port}", timeout_ms=5000)
+    stub = echo_tidl.EchoServiceStub(ch)
+    for i in range(3):
+        resp, att = stub.Echo(
+            echo_tidl.EchoRequest(message=f"msg{i}", serial=i,
+                                  history=list(range(i))),
+            attachment=b"side")
+        assert resp.message == f"msg{i}"
+        assert resp.serial == i
+        assert resp.stats.served == i + 1
+        assert att == b"side"
+    server.stop()
+
+
+def test_cpp_python_cross_language(echo_tidl):
+    # The C++ typed demo's wire bytes parse with the Python classes: drive
+    # the generated PYTHON stub against the C++ generated-service demo's
+    # schema semantics by checking a C++-encoded response... covered
+    # end-to-end by demo_echo_rpc_demo in ctest; here assert the Python
+    # encoding of a request parses under the C++ rules implicitly via the
+    # wire interop test above. This test pins the service-name contract.
+    assert hasattr(echo_tidl, "EchoServiceStub")
+    assert hasattr(echo_tidl, "add_EchoService")

@@ -1,0 +1,761 @@
+// tidl_gen: the typed-stub compiler — .tidl schema -> C++ and Python
+// message structs, server service bases, and client stubs.
+//
+// This is the framework's analog of the reference's codegen pipeline: its
+// programming model is generated stubs (EchoService_Stub::Echo,
+// /root/reference/example/echo_c++/client.cpp:36-63) produced by protoc,
+// and it ships a generator subproject as the in-repo pattern
+// (mcpack2pb/generator.cpp). tidl accepts a proto3-like subset and emits
+// the protobuf wire format (see trpc/tidl_runtime.h), so tidl messages
+// interop with same-schema protobuf peers.
+//
+// Grammar (proto3 subset):
+//   message Name { [repeated] TYPE field = N; ... }
+//   service Name { rpc Method(Req) returns (Resp); ... }
+//   TYPE: int32 int64 uint32 uint64 sint32 sint64 bool float double
+//         string bytes | a message name
+//   // line comments and /* block comments */
+//
+// Usage: tidl_gen FILE.tidl [--cpp_out DIR] [--py_out DIR]
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Field {
+  std::string type;  // scalar keyword or message name
+  std::string name;
+  int number = 0;
+  bool repeated = false;
+};
+
+struct Message {
+  std::string name;
+  std::vector<Field> fields;
+};
+
+struct Method {
+  std::string name;
+  std::string req;
+  std::string resp;
+};
+
+struct ServiceDef {
+  std::string name;
+  std::vector<Method> methods;
+};
+
+struct Schema {
+  std::vector<Message> messages;
+  std::vector<ServiceDef> services;
+  std::set<std::string> message_names;
+};
+
+[[noreturn]] void die(const std::string& msg) {
+  fprintf(stderr, "tidl_gen: %s\n", msg.c_str());
+  exit(1);
+}
+
+// ---- tokenizer ----
+
+struct Lexer {
+  std::string src;
+  size_t pos = 0;
+  int line = 1;
+
+  explicit Lexer(std::string s) : src(std::move(s)) {}
+
+  void skip_ws() {
+    while (pos < src.size()) {
+      const char c = src[pos];
+      if (c == '\n') {
+        ++line;
+        ++pos;
+      } else if (isspace(static_cast<unsigned char>(c))) {
+        ++pos;
+      } else if (c == '/' && pos + 1 < src.size() && src[pos + 1] == '/') {
+        while (pos < src.size() && src[pos] != '\n') ++pos;
+      } else if (c == '/' && pos + 1 < src.size() && src[pos + 1] == '*') {
+        pos += 2;
+        while (pos + 1 < src.size() &&
+               !(src[pos] == '*' && src[pos + 1] == '/')) {
+          if (src[pos] == '\n') ++line;
+          ++pos;
+        }
+        pos += 2;
+      } else {
+        return;
+      }
+    }
+  }
+
+  std::string next() {
+    skip_ws();
+    if (pos >= src.size()) return "";
+    const char c = src[pos];
+    if (isalnum(static_cast<unsigned char>(c)) || c == '_') {
+      size_t start = pos;
+      while (pos < src.size() &&
+             (isalnum(static_cast<unsigned char>(src[pos])) ||
+              src[pos] == '_')) {
+        ++pos;
+      }
+      return src.substr(start, pos - start);
+    }
+    ++pos;
+    return std::string(1, c);
+  }
+
+  std::string expect_ident() {
+    std::string t = next();
+    if (t.empty() || !(isalpha(static_cast<unsigned char>(t[0])) ||
+                       t[0] == '_')) {
+      die("line " + std::to_string(line) + ": expected identifier, got '" +
+          t + "'");
+    }
+    return t;
+  }
+
+  void expect(const std::string& tok) {
+    std::string t = next();
+    if (t != tok) {
+      die("line " + std::to_string(line) + ": expected '" + tok +
+          "', got '" + t + "'");
+    }
+  }
+};
+
+const std::set<std::string> kScalars = {
+    "int32", "int64", "uint32", "uint64", "sint32", "sint64",
+    "bool",  "float", "double", "string", "bytes"};
+
+Schema parse(const std::string& text) {
+  Schema s;
+  Lexer lx(text);
+  for (std::string tok = lx.next(); !tok.empty(); tok = lx.next()) {
+    if (tok == "syntax") {  // tolerated, ignored: syntax = "...";
+      while (!tok.empty() && tok != ";") tok = lx.next();
+    } else if (tok == "message") {
+      Message m;
+      m.name = lx.expect_ident();
+      lx.expect("{");
+      while (true) {
+        std::string t = lx.next();
+        if (t == "}") break;
+        if (t.empty()) die("unterminated message " + m.name);
+        Field f;
+        if (t == "repeated") {
+          f.repeated = true;
+          t = lx.expect_ident();
+        }
+        f.type = t;
+        f.name = lx.expect_ident();
+        lx.expect("=");
+        std::string num = lx.next();
+        f.number = atoi(num.c_str());
+        if (f.number <= 0) die("bad field number for " + f.name);
+        lx.expect(";");
+        m.fields.push_back(f);
+      }
+      s.message_names.insert(m.name);
+      s.messages.push_back(std::move(m));
+    } else if (tok == "service") {
+      ServiceDef sv;
+      sv.name = lx.expect_ident();
+      lx.expect("{");
+      while (true) {
+        std::string t = lx.next();
+        if (t == "}") break;
+        if (t != "rpc") die("expected 'rpc' in service " + sv.name);
+        Method mth;
+        mth.name = lx.expect_ident();
+        lx.expect("(");
+        mth.req = lx.expect_ident();
+        lx.expect(")");
+        lx.expect("returns");
+        lx.expect("(");
+        mth.resp = lx.expect_ident();
+        lx.expect(")");
+        std::string end = lx.next();
+        if (end == "{") lx.expect("}");  // tolerate empty options block
+        else if (end != ";") die("expected ';' after rpc " + mth.name);
+        sv.methods.push_back(mth);
+      }
+      s.services.push_back(std::move(sv));
+    } else {
+      die("unexpected top-level token '" + tok + "'");
+    }
+  }
+  // Validate field types.
+  for (const auto& m : s.messages) {
+    for (const auto& f : m.fields) {
+      if (kScalars.count(f.type) == 0 && s.message_names.count(f.type) == 0) {
+        die("unknown type '" + f.type + "' in message " + m.name);
+      }
+    }
+  }
+  for (const auto& sv : s.services) {
+    for (const auto& mth : sv.methods) {
+      if (s.message_names.count(mth.req) == 0 ||
+          s.message_names.count(mth.resp) == 0) {
+        die("rpc " + mth.name + " uses unknown message type");
+      }
+    }
+  }
+  return s;
+}
+
+// ---- C++ emission ----
+
+std::string cpp_type(const Field& f) {
+  static const std::map<std::string, std::string> m = {
+      {"int32", "int32_t"},   {"int64", "int64_t"},
+      {"uint32", "uint32_t"}, {"uint64", "uint64_t"},
+      {"sint32", "int32_t"},  {"sint64", "int64_t"},
+      {"bool", "bool"},       {"float", "float"},
+      {"double", "double"},   {"string", "std::string"},
+      {"bytes", "std::string"}};
+  auto it = m.find(f.type);
+  std::string base = it != m.end() ? it->second : f.type;
+  return f.repeated ? "std::vector<" + base + ">" : base;
+}
+
+bool is_msg(const Schema& s, const Field& f) {
+  return s.message_names.count(f.type) != 0;
+}
+
+void emit_cpp_serialize_one(std::ostream& o, const Schema& s, const Field& f,
+                            const std::string& var) {
+  const std::string n = std::to_string(f.number);
+  if (is_msg(s, f)) {
+    o << "    { std::string sub_tidl; " << var << ".SerializeTo(&sub_tidl);\n"
+      << "      ::trpc::tidl::put_bytes_field(out_tidl, " << n << ", sub_tidl); }\n";
+  } else if (f.type == "string" || f.type == "bytes") {
+    o << "    ::trpc::tidl::put_bytes_field(out_tidl, " << n << ", " << var
+      << ");\n";
+  } else if (f.type == "double") {
+    o << "    ::trpc::tidl::put_double_field(out_tidl, " << n << ", " << var
+      << ");\n";
+  } else if (f.type == "float") {
+    o << "    ::trpc::tidl::put_float_field(out_tidl, " << n << ", " << var
+      << ");\n";
+  } else if (f.type == "sint32" || f.type == "sint64") {
+    o << "    ::trpc::tidl::put_sint_field(out_tidl, " << n << ", " << var
+      << ");\n";
+  } else if (f.type == "bool") {
+    o << "    ::trpc::tidl::put_bool_field(out_tidl, " << n << ", " << var
+      << ");\n";
+  } else {
+    o << "    ::trpc::tidl::put_varint_field(out_tidl, " << n
+      << ", static_cast<uint64_t>(" << var << "));\n";
+  }
+}
+
+void emit_cpp_parse_case(std::ostream& o, const Schema& s, const Field& f) {
+  const std::string tgt = f.name;
+  auto assign = [&](const std::string& expr, const std::string& cast) {
+    if (f.repeated) {
+      o << "          " << tgt << ".push_back(" << cast << "(" << expr
+        << "));\n";
+    } else {
+      o << "          " << tgt << " = " << cast << "(" << expr << ");\n";
+    }
+  };
+  o << "        case " << f.number << ":\n";
+  if (is_msg(s, f)) {
+    o << "          { std::string_view sub_tidl;\n"
+      << "            if (wt_tidl != ::trpc::tidl::kLenDelim || !r_tidl.bytes(&sub_tidl)) "
+         "return false;\n";
+    if (f.repeated) {
+      o << "            " << tgt << ".emplace_back();\n"
+        << "            if (!" << tgt
+        << ".back().ParseFrom(sub_tidl)) return false; }\n";
+    } else {
+      o << "            if (!" << tgt << ".ParseFrom(sub_tidl)) return false; }\n";
+    }
+  } else if (f.type == "string" || f.type == "bytes") {
+    o << "          { std::string_view v_tidl;\n"
+      << "            if (wt_tidl != ::trpc::tidl::kLenDelim || !r_tidl.bytes(&v_tidl)) "
+         "return false;\n";
+    if (f.repeated) {
+      o << "            " << tgt << ".emplace_back(v_tidl); }\n";
+    } else {
+      o << "            " << tgt << ".assign(v_tidl.data(), v_tidl.size()); }\n";
+    }
+  } else if (f.type == "double") {
+    o << "          { uint64_t v_tidl;\n"
+      << "            if (wt_tidl != ::trpc::tidl::kFixed64 || !r_tidl.fixed64(&v_tidl)) "
+         "return false;\n"
+      << "            double d_tidl; memcpy(&d_tidl, &v_tidl, 8);\n";
+    assign("d_tidl", "");
+    o << "          }\n";
+  } else if (f.type == "float") {
+    o << "          { uint32_t v_tidl;\n"
+      << "            if (wt_tidl != ::trpc::tidl::kFixed32 || !r_tidl.fixed32(&v_tidl)) "
+         "return false;\n"
+      << "            float d_tidl; memcpy(&d_tidl, &v_tidl, 4);\n";
+    assign("d_tidl", "");
+    o << "          }\n";
+  } else {
+    // Varint family; accept packed encoding on repeated numerics
+    // (proto3's default for them).
+    const bool zz = f.type == "sint32" || f.type == "sint64";
+    const std::string conv =
+        zz ? "::trpc::tidl::unzigzag(v_tidl)" : "v_tidl";
+    const std::string cast = "static_cast<" +
+                             cpp_type(Field{f.type, "", 0, false}) + ">";
+    o << "          { uint64_t v_tidl;\n";
+    if (f.repeated) {
+      o << "            if (wt_tidl == ::trpc::tidl::kLenDelim) {\n"
+        << "              std::string_view pk_tidl;\n"
+        << "              if (!r_tidl.bytes(&pk_tidl)) return false;\n"
+        << "              ::trpc::tidl::Reader pr_tidl(pk_tidl);\n"
+        << "              while (!pr_tidl.done()) {\n"
+        << "                if (!pr_tidl.varint(&v_tidl)) return false;\n"
+        << "                " << tgt << ".push_back(" << cast << "(" << conv
+        << "));\n"
+        << "              }\n"
+        << "            } else if (wt_tidl == ::trpc::tidl::kVarint) {\n"
+        << "              if (!r_tidl.varint(&v_tidl)) return false;\n"
+        << "              " << tgt << ".push_back(" << cast << "(" << conv
+        << "));\n"
+        << "            } else { return false; }\n";
+    } else {
+      o << "            if (wt_tidl != ::trpc::tidl::kVarint || !r_tidl.varint(&v_tidl)) "
+           "return false;\n"
+        << "            " << tgt << " = " << cast << "(" << conv << ");\n";
+    }
+    o << "          }\n";
+  }
+  o << "          break;\n";
+}
+
+void emit_cpp(const Schema& s, const std::string& stem, std::ostream& o) {
+  o << "// Generated by tidl_gen from " << stem
+    << ".tidl — do not edit.\n"
+    << "#pragma once\n\n"
+    << "#include <cstdint>\n#include <cstring>\n#include <string>\n"
+    << "#include <string_view>\n#include <vector>\n\n"
+    << "#include \"tbutil/base64.h\"\n"
+    << "#include \"tbutil/json.h\"\n"
+    << "#include \"trpc/channel.h\"\n"
+    << "#include \"trpc/controller.h\"\n"
+    << "#include \"trpc/errno.h\"\n"
+    << "#include \"trpc/json_service.h\"\n"
+    << "#include \"trpc/server.h\"\n"
+    << "#include \"trpc/tidl_runtime.h\"\n\n"
+    << "namespace tidl_gen {\n\n";
+  for (const auto& m : s.messages) {
+    o << "struct " << m.name << " {\n";
+    for (const auto& f : m.fields) {
+      o << "  " << cpp_type(f) << " " << f.name;
+      if (!f.repeated && !is_msg(s, f) && f.type != "string" &&
+          f.type != "bytes") {
+        o << (f.type == "bool" ? " = false" : " = 0");
+      }
+      o << ";\n";
+    }
+    o << "\n  void SerializeTo(std::string* out_tidl) const {\n";
+    for (const auto& f : m.fields) {
+      if (f.repeated) {
+        o << "    for (const auto& it_tidl : " << f.name << ") {\n  ";
+        emit_cpp_serialize_one(o, s, f, "it_tidl");
+        o << "    }\n";
+      } else if (f.type == "string" || f.type == "bytes") {
+        o << "    if (!" << f.name << ".empty()) {\n  ";
+        emit_cpp_serialize_one(o, s, f, f.name);
+        o << "    }\n";
+      } else if (is_msg(s, f)) {
+        emit_cpp_serialize_one(o, s, f, f.name);
+      } else {
+        o << "    if (" << f.name << " != " << cpp_type(f) << "{}) {\n  ";
+        emit_cpp_serialize_one(o, s, f, f.name);
+        o << "    }\n";
+      }
+    }
+    o << "  }\n"
+      << "  void SerializeTo(tbutil::IOBuf* out_tidl) const {\n"
+      << "    std::string s_tidl; SerializeTo(&s_tidl); out_tidl->append(s_tidl);\n  }\n"
+      << "\n  bool ParseFrom(std::string_view data) {\n"
+      << "    *this = " << m.name << "{};\n"
+      << "    ::trpc::tidl::Reader r_tidl(data);\n"
+      << "    while (!r_tidl.done()) {\n"
+      << "      uint32_t f_tidl, wt_tidl;\n"
+      << "      if (!r_tidl.tag(&f_tidl, &wt_tidl)) return false;\n"
+      << "      switch (f_tidl) {\n";
+    for (const auto& f : m.fields) {
+      emit_cpp_parse_case(o, s, f);
+    }
+    o << "        default:\n"
+      << "          if (!r_tidl.skip(wt_tidl)) return false;\n"
+      << "      }\n    }\n    return true;\n  }\n"
+      << "  bool ParseFrom(const tbutil::IOBuf& buf) {\n"
+      << "    return ParseFrom(::trpc::tidl::flatten(buf));\n  }\n";
+    // JSON bridge (the reference's json2pb story): every message converts
+    // to/from tbutil::JsonValue, so services serve HTTP+JSON for free.
+    o << "\n  tbutil::JsonValue ToJson() const {\n"
+      << "    auto j_tidl = tbutil::JsonValue::Object();\n";
+    for (const auto& f : m.fields) {
+      auto one_to_json = [&](const std::string& var) -> std::string {
+        if (is_msg(s, f)) return var + ".ToJson()";
+        if (f.type == "bytes") {
+          return "tbutil::JsonValue(tbutil::base64_encode(" + var + "))";
+        }
+        if (f.type == "string" || f.type == "bool") {
+          return "tbutil::JsonValue(" + var + ")";
+        }
+        if (f.type == "float" || f.type == "double") {
+          return "tbutil::JsonValue(double(" + var + "))";
+        }
+        return "tbutil::JsonValue(int64_t(" + var + "))";
+      };
+      if (f.repeated) {
+        o << "    { auto arr_tidl = tbutil::JsonValue::Array();\n"
+          << "      for (const auto& it_tidl : " << f.name << ") "
+          << "arr_tidl.push_back(" << one_to_json("it_tidl") << ");\n"
+          << "      j_tidl.set(\"" << f.name << "\", std::move(arr_tidl)); }\n";
+      } else {
+        o << "    j_tidl.set(\"" << f.name << "\", " << one_to_json(f.name)
+          << ");\n";
+      }
+    }
+    o << "    return j_tidl;\n  }\n"
+      << "\n  bool FromJson(const tbutil::JsonValue& j_tidl) {\n"
+      << "    *this = " << m.name << "{};\n"
+      << "    if (!j_tidl.is_object()) return false;\n";
+    for (const auto& f : m.fields) {
+      auto one_from_json = [&](const std::string& src,
+                               const std::string& dst) -> std::string {
+        if (is_msg(s, f)) {
+          return "if (!" + dst + ".FromJson(" + src + ")) return false;";
+        }
+        if (f.type == "bytes") {
+          return "if (!tbutil::base64_decode(" + src + ".as_string(), &" +
+                 dst + ")) return false;";
+        }
+        if (f.type == "string") return dst + " = " + src + ".as_string();";
+        if (f.type == "bool") return dst + " = " + src + ".as_bool();";
+        if (f.type == "float" || f.type == "double") {
+          return dst + " = static_cast<" +
+                 cpp_type(Field{f.type, "", 0, false}) + ">(" + src +
+                 ".as_double());";
+        }
+        return dst + " = static_cast<" +
+               cpp_type(Field{f.type, "", 0, false}) + ">(" + src +
+               ".as_int());";
+      };
+      o << "    if (const auto* v_tidl = j_tidl.find(\"" << f.name << "\")) {\n";
+      if (f.repeated) {
+        // Build into a temp then push: uniform for every element type
+        // (vector<bool>::back() returns a proxy, not a reference).
+        o << "      if (!v_tidl->is_array()) return false;\n"
+          << "      for (const auto& e_tidl : v_tidl->items()) {\n"
+          << "        " << cpp_type(Field{f.type, "", 0, false})
+          << " slot_tidl{};\n"
+          << "        " << one_from_json("e_tidl", "slot_tidl") << "\n"
+          << "        " << f.name << ".push_back(std::move(slot_tidl));\n"
+          << "      }\n";
+      } else {
+        o << "      " << one_from_json("(*v_tidl)", f.name) << "\n";
+      }
+      o << "    }\n";
+    }
+    o << "    return true;\n  }\n"
+      << "};\n\n";
+  }
+  for (const auto& sv : s.services) {
+    // Server base: parse -> typed virtual -> serialize. The implementer
+    // overrides the typed methods; done runs after the method returns
+    // (sync model — async handlers park on their own machinery).
+    o << "class " << sv.name << "Base : public ::trpc::Service {\n"
+      << " public:\n"
+      << "  std::string_view service_name() const override { return \""
+      << sv.name << "\"; }\n";
+    for (const auto& mth : sv.methods) {
+      o << "  virtual void " << mth.name << "(::trpc::Controller* cntl, "
+        << "const " << mth.req << "& request, " << mth.resp
+        << "* response) = 0;\n";
+    }
+    o << "  void CallMethod(const std::string& method, "
+      << "::trpc::Controller* cntl,\n"
+      << "                  const tbutil::IOBuf& request, "
+      << "tbutil::IOBuf* response,\n"
+      << "                  ::trpc::Closure* done) override {\n";
+    for (const auto& mth : sv.methods) {
+      o << "    if (method == \"" << mth.name << "\") {\n"
+        << "      " << mth.req << " req;\n"
+        << "      if (!req.ParseFrom(request)) {\n"
+        << "        cntl->SetFailed(::trpc::TRPC_EREQUEST, \"malformed "
+        << mth.req << "\");\n"
+        << "        done->Run();\n        return;\n      }\n"
+        << "      " << mth.resp << " resp;\n"
+        << "      " << mth.name << "(cntl, req, &resp);\n"
+        << "      if (!cntl->Failed()) resp.SerializeTo(response);\n"
+        << "      done->Run();\n      return;\n    }\n";
+    }
+    o << "    cntl->SetFailed(::trpc::TRPC_ENOMETHOD, \"no such method: \" + "
+      << "method);\n"
+      << "    done->Run();\n  }\n\n"
+      << "  // Serve every rpc as HTTP+JSON too (the reference's json2pb\n"
+      << "  // door): generated FromJson/ToJson do the marshalling.\n"
+      << "  void RegisterJson(::trpc::JsonService* js) {\n";
+    for (const auto& mth : sv.methods) {
+      o << "    js->AddMethod(\"" << mth.name
+        << "\", [this](const tbutil::JsonValue& jreq,\n"
+        << "                tbutil::JsonValue* jresp, "
+        << "::trpc::Controller* cntl) {\n"
+        << "      " << mth.req << " req;\n"
+        << "      if (!req.FromJson(jreq)) {\n"
+        << "        cntl->SetFailed(::trpc::TRPC_EREQUEST, \"malformed "
+        << mth.req << " json\");\n        return;\n      }\n"
+        << "      " << mth.resp << " resp;\n"
+        << "      this->" << mth.name << "(cntl, req, &resp);\n"
+        << "      if (!cntl->Failed()) *jresp = resp.ToJson();\n"
+        << "    });\n";
+    }
+    o << "  }\n};\n\n";
+    // Client stub (reference EchoService_Stub shape).
+    o << "class " << sv.name << "_Stub {\n"
+      << " public:\n"
+      << "  explicit " << sv.name << "_Stub(::trpc::Channel* channel) : "
+      << "_channel(channel) {}\n";
+    for (const auto& mth : sv.methods) {
+      o << "  void " << mth.name << "(::trpc::Controller* cntl, const "
+        << mth.req << "& request, " << mth.resp << "* response) {\n"
+        << "    tbutil::IOBuf req_buf, resp_buf;\n"
+        << "    request.SerializeTo(&req_buf);\n"
+        << "    _channel->CallMethod(\"" << sv.name << "/" << mth.name
+        << "\", cntl, req_buf, &resp_buf, nullptr);\n"
+        << "    if (!cntl->Failed() && !response->ParseFrom(resp_buf)) {\n"
+        << "      cntl->SetFailed(::trpc::TRPC_ERESPONSE, \"malformed "
+        << mth.resp << "\");\n    }\n  }\n";
+    }
+    o << "\n private:\n  ::trpc::Channel* _channel;\n};\n\n";
+  }
+  o << "}  // namespace tidl_gen\n";
+}
+
+// ---- Python emission ----
+
+std::string py_default(const Schema& s, const Field& f) {
+  if (f.repeated) return "field(default_factory=list)";
+  if (is_msg(s, f)) return "field(default_factory=lambda: " + f.type + "())";
+  if (f.type == "string") return "\"\"";
+  if (f.type == "bytes") return "b\"\"";
+  if (f.type == "bool") return "False";
+  if (f.type == "float" || f.type == "double") return "0.0";
+  return "0";
+}
+
+void emit_py(const Schema& s, const std::string& stem, std::ostream& o) {
+  o << "# Generated by tidl_gen from " << stem << ".tidl - do not edit.\n"
+    << "\"\"\"Typed messages + stubs over brpc_tpu.runtime.native "
+    << "(protobuf wire format).\"\"\"\n\n"
+    << "import struct\n"
+    << "from dataclasses import dataclass, field\n\n"
+    << "from brpc_tpu.runtime import native as _native\n"
+    << "from brpc_tpu.runtime import tidl as _rt\n\n";
+  for (const auto& m : s.messages) {
+    o << "@dataclass\nclass " << m.name << ":\n";
+    if (m.fields.empty()) o << "    pass\n";
+    for (const auto& f : m.fields) {
+      std::string ann;
+      if (f.repeated) {
+        ann = "list";
+      } else if (is_msg(s, f)) {
+        ann = "\"" + f.type + "\"";  // quoted: forward references allowed
+      } else if (f.type == "string") {
+        ann = "str";
+      } else if (f.type == "bytes") {
+        ann = "bytes";
+      } else if (f.type == "bool") {
+        ann = "bool";
+      } else if (f.type == "float" || f.type == "double") {
+        ann = "float";
+      } else {
+        ann = "int";
+      }
+      o << "    " << f.name << ": " << ann << " = " << py_default(s, f)
+        << "\n";
+    }
+    o << "\n    def encode(self):\n        out = bytearray()\n";
+    for (const auto& f : m.fields) {
+      const std::string n = std::to_string(f.number);
+      std::string one;
+      const std::string var = f.repeated ? "item" : ("self." + f.name);
+      if (is_msg(s, f)) {
+        one = "_rt.put_bytes(out, " + n + ", " + var + ".encode())";
+      } else if (f.type == "string") {
+        one = "_rt.put_bytes(out, " + n + ", " + var + ".encode('utf-8'))";
+      } else if (f.type == "bytes") {
+        one = "_rt.put_bytes(out, " + n + ", bytes(" + var + "))";
+      } else if (f.type == "double") {
+        one = "_rt.put_f64(out, " + n + ", " + var + ")";
+      } else if (f.type == "float") {
+        one = "_rt.put_f32(out, " + n + ", " + var + ")";
+      } else if (f.type == "sint32" || f.type == "sint64") {
+        one = "_rt.put_sint(out, " + n + ", " + var + ")";
+      } else if (f.type == "bool") {
+        one = "_rt.put_uint(out, " + n + ", 1 if " + var + " else 0)";
+      } else if (f.type == "int32" || f.type == "int64") {
+        one = "_rt.put_uint(out, " + n + ", " + var + " & 0xFFFFFFFFFFFFFFFF)";
+      } else {
+        one = "_rt.put_uint(out, " + n + ", " + var + ")";
+      }
+      if (f.repeated) {
+        o << "        for item in self." << f.name << ":\n            "
+          << one << "\n";
+      } else if (is_msg(s, f)) {
+        o << "        " << one << "\n";
+      } else {
+        o << "        if self." << f.name << ":\n            " << one
+          << "\n";
+      }
+    }
+    o << "        return bytes(out)\n"
+      << "\n    @classmethod\n    def decode(cls, data):\n"
+      << "        msg = cls()\n"
+      << "        r = _rt.Reader(data)\n"
+      << "        while not r.done():\n"
+      << "            f, wt = r.tag()\n";
+    bool first = true;
+    for (const auto& f : m.fields) {
+      const std::string kw = first ? "if" : "elif";
+      first = false;
+      o << "            " << kw << " f == " << f.number << ":\n";
+      // Read-one expression, parameterized by the reader variable so the
+      // packed branch can reuse it with a sub-reader.
+      auto read_with = [&](const std::string& rv) -> std::string {
+        if (is_msg(s, f)) return f.type + ".decode(" + rv + ".bytes())";
+        if (f.type == "string") return rv + ".bytes().decode('utf-8')";
+        if (f.type == "bytes") return rv + ".bytes()";
+        if (f.type == "double") return rv + ".f64()";
+        if (f.type == "float") return rv + ".f32()";
+        if (f.type == "sint32" || f.type == "sint64") {
+          return "_rt.unzigzag(" + rv + ".varint())";
+        }
+        if (f.type == "bool") return "bool(" + rv + ".varint())";
+        if (f.type == "int32") return "_rt.to_int32(" + rv + ".varint())";
+        if (f.type == "int64") return "_rt.to_int64(" + rv + ".varint())";
+        return rv + ".varint()";
+      };
+      const bool varint_family =
+          !is_msg(s, f) && f.type != "string" && f.type != "bytes" &&
+          f.type != "float" && f.type != "double";
+      // Expected wire type per field kind — mismatches raise, mirroring
+      // the generated C++'s ParseFrom returning false.
+      const char* want_wt = varint_family ? "0"
+                            : f.type == "double" ? "1"
+                            : f.type == "float" ? "5"
+                            : "2";
+      const std::string wt_guard =
+          std::string("                if wt != ") + want_wt +
+          ":\n                    raise ValueError(\"" + m.name + "." +
+          f.name + ": wire type %d\" % wt)\n";
+      if (f.repeated && varint_family) {
+        // Accept packed encoding too (proto3 default for numerics).
+        o << "                if wt == 2:\n"
+          << "                    pr = _rt.Reader(r.bytes())\n"
+          << "                    while not pr.done():\n"
+          << "                        msg." << f.name << ".append("
+          << read_with("pr") << ")\n"
+          << "                elif wt == 0:\n"
+          << "                    msg." << f.name << ".append("
+          << read_with("r") << ")\n"
+          << "                else:\n"
+          << "                    raise ValueError(\"" << m.name << "."
+          << f.name << ": wire type \" + str(wt))\n";
+      } else if (f.repeated) {
+        o << wt_guard
+          << "                msg." << f.name << ".append(" << read_with("r")
+          << ")\n";
+      } else {
+        o << wt_guard
+          << "                msg." << f.name << " = " << read_with("r")
+          << "\n";
+      }
+    }
+    o << "            " << (first ? "if" : "else") << (first ? " True:" : ":")
+      << "\n                r.skip(wt)\n"
+      << "        return msg\n\n";
+  }
+  for (const auto& sv : s.services) {
+    o << "class " << sv.name << "Stub:\n"
+      << "    \"\"\"Typed client stub over a native Channel.\"\"\"\n\n"
+      << "    def __init__(self, channel):\n"
+      << "        self._channel = channel\n\n";
+    for (const auto& mth : sv.methods) {
+      o << "    def " << mth.name << "(self, request, attachment=b\"\"):\n"
+        << "        payload, att = self._channel.call(\"" << sv.name << "/"
+        << mth.name << "\", request.encode(), attachment)\n"
+        << "        return " << mth.resp << ".decode(payload), att\n\n";
+    }
+    o << "\ndef add_" << sv.name << "(server, impl):\n"
+      << "    \"\"\"Host `impl` (methods named after the rpcs, taking\n"
+      << "    (request, attachment) and returning (response, attachment))\n"
+      << "    on a native Server.\"\"\"\n"
+      << "    def _handler(method, request, attachment):\n";
+    bool firstm = true;
+    for (const auto& mth : sv.methods) {
+      o << "        " << (firstm ? "if" : "elif") << " method == \""
+        << mth.name << "\":\n"
+        << "            resp, att = impl." << mth.name << "(" << mth.req
+        << ".decode(request), attachment)\n"
+        << "            return resp.encode(), att\n";
+      firstm = false;
+    }
+    o << "        raise _native.RpcError(2007, f\"no such method: "
+      << "{method}\")\n"
+      << "    server.add_service(\"" << sv.name << "\", _handler)\n\n";
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string input, cpp_out, py_out;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--cpp_out" && i + 1 < argc) {
+      cpp_out = argv[++i];
+    } else if (a == "--py_out" && i + 1 < argc) {
+      py_out = argv[++i];
+    } else if (a[0] != '-') {
+      input = a;
+    } else {
+      die("unknown flag " + a);
+    }
+  }
+  if (input.empty()) die("usage: tidl_gen FILE.tidl [--cpp_out D] [--py_out D]");
+  std::ifstream in(input);
+  if (!in) die("cannot open " + input);
+  std::stringstream ss;
+  ss << in.rdbuf();
+  Schema s = parse(ss.str());
+
+  std::string stem = input;
+  if (size_t slash = stem.find_last_of('/'); slash != std::string::npos) {
+    stem = stem.substr(slash + 1);
+  }
+  if (size_t dot = stem.rfind(".tidl"); dot != std::string::npos) {
+    stem = stem.substr(0, dot);
+  }
+  if (!cpp_out.empty()) {
+    std::ofstream o(cpp_out + "/" + stem + ".tidl.h");
+    if (!o) die("cannot write to " + cpp_out);
+    emit_cpp(s, stem, o);
+  }
+  if (!py_out.empty()) {
+    std::ofstream o(py_out + "/" + stem + "_tidl.py");
+    if (!o) die("cannot write to " + py_out);
+    emit_py(s, stem, o);
+  }
+  return 0;
+}
