@@ -1,0 +1,27 @@
+// MD5 (RFC 1321) — spec-implemented, self-contained.
+// Capability parity: reference src/butil/md5.h (MD5Sum/MD5HashSignature),
+// which backs the ketama consistent-hash ring
+// (policy/consistent_hashing_load_balancer.cpp:123). Not for security —
+// it exists because ketama's ring layout is DEFINED in terms of MD5
+// digests, and cache clients expect compatible placement.
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+#include <string_view>
+
+namespace tbutil {
+
+struct MD5Digest {
+  uint8_t a[16];
+};
+
+void md5_sum(const void* data, size_t len, MD5Digest* digest);
+
+inline MD5Digest md5_sum(std::string_view s) {
+  MD5Digest d;
+  md5_sum(s.data(), s.size(), &d);
+  return d;
+}
+
+}  // namespace tbutil

@@ -205,4 +205,81 @@ TEST_CASE(registry_end_to_end_naming) {
   FlagRegistry::global().Set("naming_refresh_ms", "0");
 }
 
+
+TEST_CASE(watch_mode_propagates_in_subsecond) {
+  // Blocking-query watch (consul index scheme): with the POLL interval set
+  // to 30s, a membership change must still reach a Channel's LB in <1s —
+  // the held GET wakes on the registry mutation, not on the next poll.
+  FlagRegistry::global().Set("naming_refresh_ms", "30000");
+  RegistryService::clear();
+  RegistryService::Install();
+  Server registry;
+  ASSERT_EQ(registry.Start("127.0.0.1:0", nullptr), 0);
+  char registry_addr[64];
+  snprintf(registry_addr, sizeof(registry_addr), "127.0.0.1:%d",
+           registry.listen_address().port);
+
+  Server s1;
+  EchoService e1("alpha");
+  ASSERT_EQ(s1.AddService(&e1), 0);
+  ASSERT_EQ(s1.Start("127.0.0.1:0", nullptr), 0);
+  char a1[64];
+  snprintf(a1, sizeof(a1), "127.0.0.1:%d", s1.listen_address().port);
+  RegistryClient c1;
+  ASSERT_EQ(c1.Start(registry_addr, a1, "", 30), 0);
+
+  Channel ch;
+  ChannelOptions copts;
+  copts.timeout_ms = 2000;
+  std::string url = std::string("http://") + registry_addr + "/registry/list";
+  ASSERT_EQ(ch.Init(url.c_str(), "rr", &copts), 0);
+  {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_EQ(resp.to_string(), std::string("alpha"));
+  }
+
+  // New backend joins AFTER the channel settled into its watch.
+  tbthread::fiber_usleep(300 * 1000);  // let the long-poll arm
+  Server s2;
+  EchoService e2("beta");
+  ASSERT_EQ(s2.AddService(&e2), 0);
+  ASSERT_EQ(s2.Start("127.0.0.1:0", nullptr), 0);
+  char a2[64];
+  snprintf(a2, sizeof(a2), "127.0.0.1:%d", s2.listen_address().port);
+  RegistryClient c2;
+  const int64_t t0 = tbutil::monotonic_time_us();
+  ASSERT_EQ(c2.Start(registry_addr, a2, "", 30), 0);
+
+  // The LB must route to beta well before any 30s poll could have fired.
+  bool saw_beta = false;
+  int64_t latency_us = 0;
+  while (!saw_beta && tbutil::monotonic_time_us() - t0 < 3000000) {
+    Controller cntl;
+    tbutil::IOBuf req, resp;
+    req.append("x");
+    ch.CallMethod("EchoService/Echo", &cntl, req, &resp, nullptr);
+    if (!cntl.Failed() && resp.to_string() == "beta") {
+      saw_beta = true;
+      latency_us = tbutil::monotonic_time_us() - t0;
+    }
+    tbthread::fiber_usleep(20 * 1000);
+  }
+  ASSERT_TRUE(saw_beta);
+  fprintf(stderr, "watch propagation: %lld ms\n",
+          (long long)(latency_us / 1000));
+  ASSERT_TRUE(latency_us < 1000000);
+
+  c1.Stop();
+  c2.Stop();
+  s1.Stop();
+  s2.Stop();
+  registry.Stop();
+  RegistryService::clear();
+  FlagRegistry::global().Set("naming_refresh_ms", "0");
+}
+
 TEST_MAIN

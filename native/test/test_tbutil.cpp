@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "mini_test.h"
+#include "tbutil/md5.h"
 #include "tbutil/logging.h"
 #include "tbutil/base64.h"
 #include "tbutil/crc32c.h"
@@ -625,6 +626,22 @@ TEST_CASE(logging_prefix_format) {
   ASSERT_EQ(p[0], 'W');
   ASSERT_TRUE(p.find("file.cpp:77] ") != std::string::npos);
   ASSERT_TRUE(p.find('/') == std::string::npos);  // path stripped
+}
+
+
+TEST_CASE(md5_rfc1321_vectors) {
+  auto hex = [](const tbutil::MD5Digest& d) {
+    char out[33];
+    for (int i = 0; i < 16; ++i) snprintf(out + 2 * i, 3, "%02x", d.a[i]);
+    return std::string(out);
+  };
+  ASSERT_EQ(hex(tbutil::md5_sum("")), std::string("d41d8cd98f00b204e9800998ecf8427e"));
+  ASSERT_EQ(hex(tbutil::md5_sum("abc")), std::string("900150983cd24fb0d6963f7d28e17f72"));
+  ASSERT_EQ(hex(tbutil::md5_sum("message digest")),
+            std::string("f96b697d7cb7938d525a2f31aaf161d0"));
+  // Crosses the single-block boundary (56..64 tail => two-block finalize).
+  ASSERT_EQ(hex(tbutil::md5_sum("12345678901234567890123456789012345678901234567890123456789012345678901234567890")),
+            std::string("57edf4a22be3c955ac49da2e2107b67a"));
 }
 
 TEST_MAIN

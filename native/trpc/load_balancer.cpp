@@ -6,6 +6,7 @@
 #include <map>
 
 #include "tbutil/fast_rand.h"
+#include "tbutil/md5.h"
 #include "tbutil/time.h"
 #include "trpc/errno.h"
 
@@ -115,6 +116,44 @@ class WeightedRandomLB : public ListLoadBalancer {
   }
 };
 
+// ---- wrr: smooth weighted round robin ----
+// The interleaving scheme (each pick: current += weight; take the max;
+// max -= total) spreads a {5,1,1} weighting as ABABACA, not AAAAABC —
+// reference policy/weighted_round_robin_load_balancer.cpp solves the same
+// clumping with stride scheduling.
+class SmoothWrrLB : public ListLoadBalancer {
+ protected:
+  size_t Pick(const ServerList& list, const SelectIn&, size_t) override {
+    std::lock_guard<std::mutex> lk(_mu);
+    const size_t n = list.nodes.size();
+    _current.resize(n, 0);
+    int64_t total = 0;
+    size_t best = 0;
+    for (size_t i = 0; i < n; ++i) {
+      _current[i] += list.nodes[i].weight;
+      total += list.nodes[i].weight;
+      if (_current[i] > _current[best]) best = i;
+    }
+    _current[best] -= total;
+    return best;
+  }
+
+ private:
+  std::mutex _mu;
+  std::vector<int64_t> _current;  // indexed like the server list
+};
+
+// ---- _dynpart: weight-proportional selection for partitioned backends ----
+// Reference policy/dynpart_load_balancer.cpp picks ∝ each sub-channel's
+// LIVE weight (schan::GetSubChannelWeight — the number of dynamic
+// partitions a server currently owns). Our naming pipeline delivers that
+// signal through the node tag ("w=N", refreshed on every ResetServers),
+// so selection is weight-proportional random over the current list.
+// Selection itself is weight-proportional random — same pick rule as wr;
+// the distinct name keeps the reference's registry contract and leaves
+// room for schan-specific behavior to diverge.
+class DynPartLB : public WeightedRandomLB {};
+
 // ---- c_murmurhash: ketama-style consistent hashing ----
 // 64-bit avalanche hash (splitmix-style) over (endpoint, vnode).
 uint64_t mix64(uint64_t x) {
@@ -126,26 +165,66 @@ uint64_t mix64(uint64_t x) {
   return x;
 }
 
+// Ring layouts: kMix64 (our native 64-bit scheme), kMd5 (one md5-derived
+// 32-bit point per vnode — reference DefaultReplicaPolicy(MD5Hash32)),
+// kKetama (libketama proper: md5("ip:port-i") yields FOUR 32-bit points,
+// reference KetamaReplicaPolicy, consistent_hashing_load_balancer.cpp:123
+// — cache clients expect this exact placement).
+enum class RingPolicy { kMix64, kMd5, kKetama };
+
 class ConsistentHashLB : public LoadBalancer {
-  static constexpr int kVNodes = 100;
+  static constexpr int kVNodes = 100;  // per weight unit
 
  public:
+  explicit ConsistentHashLB(RingPolicy policy) : _policy(policy) {}
+
   void ResetServers(const std::vector<ServerNode>& servers) override {
-    _list.Modify([&servers](Ring& ring) {
+    const RingPolicy policy = _policy;
+    _list.Modify([&servers, policy](Ring& ring) {
       ring.points.clear();
       ring.nodes.clear();
       ring.nodes.reserve(servers.size());
       for (const ServerNode& s : servers) {
         lb_detail::Node n;
         n.server = s;
+        n.weight = parse_weight(s.tag);
         n.health = GetNodeHealth(s.addr);
         ring.nodes.push_back(n);
       }
       for (size_t i = 0; i < ring.nodes.size(); ++i) {
-        uint64_t base = tbutil::endpoint_hash(ring.nodes[i].server.addr);
-        for (int v = 0; v < kVNodes; ++v) {
-          ring.points.emplace_back(mix64(base + v * 0x9E3779B97F4A7C15ULL),
-                                   i);
+        const lb_detail::Node& node = ring.nodes[i];
+        const uint32_t vnodes = kVNodes * node.weight;
+        if (policy == RingPolicy::kMix64) {
+          uint64_t base = tbutil::endpoint_hash(node.server.addr);
+          for (uint32_t v = 0; v < vnodes; ++v) {
+            ring.points.emplace_back(
+                mix64(base + v * 0x9E3779B97F4A7C15ULL), i);
+          }
+          continue;
+        }
+        const std::string addr = tbutil::endpoint2str(node.server.addr);
+        if (policy == RingPolicy::kKetama) {
+          // 4 points per digest; "ip:port-i" keys.
+          for (uint32_t rep = 0; rep < (vnodes + 3) / 4; ++rep) {
+            const tbutil::MD5Digest d =
+                tbutil::md5_sum(addr + "-" + std::to_string(rep));
+            for (int j = 0; j < 4; ++j) {
+              const uint32_t h = uint32_t(d.a[3 + j * 4]) << 24 |
+                                 uint32_t(d.a[2 + j * 4]) << 16 |
+                                 uint32_t(d.a[1 + j * 4]) << 8 |
+                                 uint32_t(d.a[0 + j * 4]);
+              ring.points.emplace_back(h, i);
+            }
+          }
+        } else {  // kMd5: one low-32 point per vnode
+          for (uint32_t v = 0; v < vnodes; ++v) {
+            const tbutil::MD5Digest d =
+                tbutil::md5_sum(addr + "-" + std::to_string(v));
+            const uint32_t h = uint32_t(d.a[3]) << 24 |
+                               uint32_t(d.a[2]) << 16 |
+                               uint32_t(d.a[1]) << 8 | uint32_t(d.a[0]);
+            ring.points.emplace_back(h, i);
+          }
         }
       }
       std::sort(ring.points.begin(), ring.points.end());
@@ -161,8 +240,14 @@ class ConsistentHashLB : public LoadBalancer {
     }
     const Ring& ring = *ptr;
     uint64_t key = in.has_request_code ? in.request_code : tbutil::fast_rand();
+    // kMix64 avalanches the caller's code itself; the 32-bit rings take
+    // it as-is (the caller supplies the hash of its key — the reference's
+    // request_code contract) truncated to ring width.
+    const uint64_t point = _policy == RingPolicy::kMix64
+                               ? mix64(key)
+                               : (key & 0xFFFFFFFFULL);
     auto it = std::lower_bound(ring.points.begin(), ring.points.end(),
-                               std::make_pair(mix64(key), size_t(0)));
+                               std::make_pair(point, size_t(0)));
     if (it == ring.points.end()) it = ring.points.begin();
     const int64_t now = tbutil::gettimeofday_us();
     // Walk the ring from the hash point until a healthy node.
@@ -189,6 +274,7 @@ class ConsistentHashLB : public LoadBalancer {
     std::vector<std::pair<uint64_t, size_t>> points;  // (hash, node index)
     std::vector<lb_detail::Node> nodes;
   };
+  const RingPolicy _policy;
   tbutil::DoublyBufferedData<Ring> _list;
 };
 
@@ -242,8 +328,16 @@ LoadBalancer* LoadBalancer::CreateByName(const std::string& name) {
   if (name == "rr" || name.empty()) return new lb_detail::RoundRobinLB;
   if (name == "random") return new lb_detail::RandomLB;
   if (name == "wr") return new lb_detail::WeightedRandomLB;
+  if (name == "wrr") return new lb_detail::SmoothWrrLB;
+  if (name == "_dynpart") return new lb_detail::DynPartLB;
   if (name == "c_murmurhash" || name == "c_hash") {
-    return new lb_detail::ConsistentHashLB;
+    return new lb_detail::ConsistentHashLB(lb_detail::RingPolicy::kMix64);
+  }
+  if (name == "c_md5") {
+    return new lb_detail::ConsistentHashLB(lb_detail::RingPolicy::kMd5);
+  }
+  if (name == "c_ketama") {
+    return new lb_detail::ConsistentHashLB(lb_detail::RingPolicy::kKetama);
   }
   if (name == "la") return new lb_detail::LocalityAwareLB;
   return nullptr;

@@ -124,7 +124,8 @@ bool node_from_json(const tbutil::JsonValue& v, ServerNode* node) {
 }  // namespace
 
 int NamingServiceThread::ParseHttpBody(const std::string& body,
-                                       std::vector<ServerNode>* out) {
+                                       std::vector<ServerNode>* out,
+                                       int64_t* index_out) {
   out->clear();
   // JSON first: {"servers":[...]} or a bare array; else text lines.
   auto parsed = tbutil::JsonValue::Parse(body);
@@ -136,6 +137,10 @@ int NamingServiceThread::ParseHttpBody(const std::string& body,
       arr = parsed->find("servers");
     }
     if (arr == nullptr || !arr->is_array()) return -1;
+    if (index_out != nullptr && parsed->is_object()) {
+      const tbutil::JsonValue* idx = parsed->find("index");
+      if (idx != nullptr && idx->is_number()) *index_out = idx->as_int();
+    }
     for (const auto& item : arr->items()) {
       ServerNode node;
       if (node_from_json(item, &node)) {
@@ -169,17 +174,32 @@ int NamingServiceThread::ParseHttpBody(const std::string& body,
 }
 
 int NamingServiceThread::FetchHttp(const std::string& payload,
-                                   std::vector<ServerNode>* out) {
+                                   std::vector<ServerNode>* out,
+                                   int64_t* index_io) {
   out->clear();
   const size_t slash = payload.find('/');
   const std::string hostport =
       slash == std::string::npos ? payload : payload.substr(0, slash);
-  const std::string path =
+  std::string path =
       slash == std::string::npos ? "" : payload.substr(slash + 1);
+  // Watch mode: long-poll the endpoint's blocking query (consul index
+  // scheme; our registry's /registry/list?index=N) — fleet changes arrive
+  // at propagation speed while the poll interval is just the safety net.
+  // 5s slices: changes still propagate instantly (the server wakes the
+  // held GET on every mutation); the slice only bounds how long a naming
+  // thread's Stop() can block behind an idle long-poll.
+  constexpr int64_t kWatchWaitMs = 5000;
+  const bool watching = index_io != nullptr && *index_io >= 0;
+  if (watching) {
+    path += (path.find('?') == std::string::npos ? '?' : '&');
+    path += "index=" + std::to_string(*index_io) +
+            "&wait_ms=" + std::to_string(kWatchWaitMs);
+  }
   Channel ch;
   ChannelOptions opts;
   opts.protocol = kHttpProtocolIndex;
-  opts.timeout_ms = 2000;
+  // A held blocking query is not a slow server: give it the wait + slack.
+  opts.timeout_ms = watching ? kWatchWaitMs + 3000 : 2000;
   opts.max_retry = 0;  // the refresh loop is the retry policy
   if (ch.Init(hostport.c_str(), &opts) != 0) return -1;
   Controller cntl;
@@ -190,7 +210,10 @@ int NamingServiceThread::FetchHttp(const std::string& payload,
                     << " failed: " << cntl.ErrorText();
     return -1;
   }
-  return ParseHttpBody(resp.to_string(), out);
+  int64_t new_index = -1;
+  const int rc = ParseHttpBody(resp.to_string(), out, &new_index);
+  if (rc == 0 && index_io != nullptr) *index_io = new_index;
+  return rc;
 }
 
 NamingServiceThread::~NamingServiceThread() { Stop(); }
@@ -212,7 +235,7 @@ int NamingServiceThread::Start(const std::string& url, Listener listener) {
   int rc = -1;
   if (_scheme == "list") rc = ParseList(_payload, &servers);
   else if (_scheme == "file") rc = ParseFile(_payload, &servers);
-  else if (_scheme == "http") rc = FetchHttp(_payload, &servers);
+  else if (_scheme == "http") rc = FetchHttp(_payload, &servers, &_watch_index);
   else rc = ResolveDns(_payload, &servers);
   if (rc == 0) _listener(servers);
   // For threaded schemes (file/dns/http) a failed first resolution is not
@@ -249,7 +272,11 @@ void NamingServiceThread::Run() {
         static_cast<int>(tbutil::fast_rand_less_than(base_ms / 2 + 1)) -
         base_ms / 4;
     const int sleep_ms = base_ms + jitter_ms;
-    for (int i = 0; i < sleep_ms / 50 && !_stop.load(); ++i) {
+    // With a live watch the long-poll IS the wait: re-arm immediately and
+    // let the server hold the request until the membership changes.
+    const bool watch_live =
+        _scheme == "http" && _watch_index >= 0 && failure_backoff == 1;
+    for (int i = 0; i < sleep_ms / 50 && !_stop.load() && !watch_live; ++i) {
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
     }
     if (_stop.load()) break;
@@ -265,11 +292,29 @@ void NamingServiceThread::Run() {
       last_mtime = st.st_mtime;
       if (ParseFile(_payload, &servers) == 0) _listener(servers);
     } else if (_scheme == "http") {
-      if (FetchHttp(_payload, &servers) == 0) {
+      const int64_t prev_index = _watch_index;
+      const int64_t fetch_start = tbutil::monotonic_time_us();
+      if (FetchHttp(_payload, &servers, &_watch_index) == 0) {
         failure_backoff = 1;
-        _listener(servers);
+        // A watch slice that timed out unchanged (same index) carries no
+        // news: skip the listener so idle fleets don't rebuild their LB
+        // ring every slice. Plain polls (-1) always deliver.
+        if (prev_index < 0 || _watch_index != prev_index) {
+          _listener(servers);
+        }
+        // Floor between watched fetches: a server that echoes an index
+        // but doesn't actually hold the request (proxy stripping query
+        // params) must degrade to ~2 req/s, not a hot fetch loop.
+        const int64_t took_us = tbutil::monotonic_time_us() - fetch_start;
+        if (_watch_index >= 0 && took_us < 500000) {
+          const int64_t rest_ms = (500000 - took_us) / 1000;
+          for (int64_t i = 0; i < rest_ms / 50 && !_stop.load(); ++i) {
+            std::this_thread::sleep_for(std::chrono::milliseconds(50));
+          }
+        }
       } else {
         failure_backoff = std::min(failure_backoff * 2, 16);
+        _watch_index = -1;  // re-probe for watch support after recovery
       }
     } else {  // dns
       if (ResolveDns(_payload, &servers) == 0) {

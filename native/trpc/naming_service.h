@@ -56,12 +56,19 @@ class NamingServiceThread {
                         std::vector<ServerNode>* out);
   // payload = "host:port/path?query"; fetches over the framework's own
   // HTTP client and parses the body (exposed for tests).
+  // *index_io: watch-mode state. Pass the last seen membership index (or
+  // -1 for a plain GET); a server that supports blocking queries returns
+  // the new index through it (stays -1 otherwise), and the next call with
+  // index >= 0 long-polls until the membership changes.
   static int FetchHttp(const std::string& payload,
-                       std::vector<ServerNode>* out);
+                       std::vector<ServerNode>* out,
+                       int64_t* index_io = nullptr);
   static int ParseHttpBody(const std::string& body,
-                           std::vector<ServerNode>* out);
+                           std::vector<ServerNode>* out,
+                           int64_t* index_out = nullptr);
 
  private:
+  int64_t _watch_index = -1;  // blocking-query index; -1 = plain polls
   void Run();
 
   std::string _scheme;

@@ -1,5 +1,8 @@
 #include "trpc/registry.h"
 
+#include <algorithm>
+
+#include "tbthread/butex.h"
 #include "tbutil/json.h"
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
@@ -18,6 +21,22 @@ struct Entry {
 
 std::mutex g_mu;
 std::map<std::string, Entry> g_table;  // addr -> entry
+
+// Membership version for blocking queries (the consul index scheme,
+// reference policy/consul_naming_service.cpp:99-115): every mutation bumps
+// it and wakes parked /registry/list watchers. A butex so watch handlers
+// park their FIBER, not a worker thread.
+tbthread::Butex* version_btx() {
+  static tbthread::Butex* b = tbthread::butex_create();
+  return b;
+}
+int current_version() {
+  return tbthread::butex_value(version_btx())
+      ->load(std::memory_order_acquire);
+}
+void bump_version() {
+  tbthread::butex_increment_and_wake_all(version_btx());
+}
 
 // "host:port" shape check without resolving: host is 1-253 bytes of
 // [A-Za-z0-9.-] (or a numeric IP), port is 1..65535.
@@ -40,13 +59,16 @@ bool registry_addr_plausible(const std::string& addr) {
 }
 
 void prune_locked(int64_t now_us) {
+  bool changed = false;
   for (auto it = g_table.begin(); it != g_table.end();) {
     if (it->second.expire_us <= now_us) {
       it = g_table.erase(it);
+      changed = true;
     } else {
       ++it;
     }
   }
+  if (changed) bump_version();
 }
 
 void register_handler(const HttpRequest& req, HttpResponse* resp) {
@@ -94,7 +116,12 @@ void register_handler(const HttpRequest& req, HttpResponse* resp) {
         return;
       }
     }
+    // Heartbeat renewals (same addr+tag) keep the version still so
+    // blocking watchers only wake on MEMBERSHIP change.
+    auto it = g_table.find(addr);
+    const bool changed = it == g_table.end() || it->second.tag != e.tag;
     g_table[addr] = std::move(e);
+    if (changed) bump_version();
   }
   resp->body = "ok\n";
 }
@@ -112,16 +139,54 @@ void deregister_handler(const HttpRequest& req, HttpResponse* resp) {
   {
     std::lock_guard<std::mutex> lk(g_mu);
     erased = g_table.erase(addr);
+    if (erased != 0) bump_version();
   }
   resp->body = erased != 0 ? "ok\n" : "not registered\n";
 }
 
 void list_handler(const HttpRequest& req, HttpResponse* resp) {
   const std::string want_tag = req.query_param("tag");
+  // Blocking query (watch mode): ?index=N holds the GET until the
+  // membership version advances past N (or wait_ms elapses), so fleet
+  // changes reach clients at propagation speed instead of poll cadence.
+  // Consul's blocking-query contract (consul_naming_service.cpp:99-115).
+  const std::string index_s = req.query_param("index");
+  if (!index_s.empty()) {
+    const int want = atoi(index_s.c_str());
+    int64_t wait_ms = 30000;
+    const std::string wait_s = req.query_param("wait_ms");
+    if (!wait_s.empty()) {
+      wait_ms = atol(wait_s.c_str());
+      if (wait_ms < 0) wait_ms = 0;
+      if (wait_ms > 60000) wait_ms = 60000;
+    }
+    int64_t deadline_us = tbutil::gettimeofday_us() + wait_ms * 1000;
+    // Expiry produces no wake by itself (pruning is lazy): cap the hold at
+    // the earliest TTL so a crashed backend's disappearance is DELIVERED
+    // at expiry, not at the watch timeout.
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      for (const auto& [addr, e] : g_table) {
+        deadline_us = std::min(deadline_us, e.expire_us);
+      }
+    }
+    timespec abstime;
+    abstime.tv_sec = deadline_us / 1000000;
+    abstime.tv_nsec = (deadline_us % 1000000) * 1000;
+    while (current_version() == want &&
+           tbutil::gettimeofday_us() < deadline_us) {
+      // Parks THIS FIBER; register/deregister mutations wake it. A
+      // timeout (including the TTL cap above) answers with the current —
+      // freshly pruned — list and the client re-arms.
+      tbthread::butex_wait(version_btx(), want, &abstime);
+    }
+  }
   tbutil::JsonValue servers = tbutil::JsonValue::Array();
+  int version = 0;
   {
     std::lock_guard<std::mutex> lk(g_mu);
     prune_locked(tbutil::gettimeofday_us());
+    version = current_version();
     for (const auto& [addr, e] : g_table) {
       if (!want_tag.empty() && e.tag != want_tag) continue;
       tbutil::JsonValue node = tbutil::JsonValue::Object();
@@ -131,6 +196,7 @@ void list_handler(const HttpRequest& req, HttpResponse* resp) {
     }
   }
   tbutil::JsonValue root = tbutil::JsonValue::Object();
+  root.set("index", int64_t{version});
   root.set("servers", std::move(servers));
   resp->content_type = "application/json";
   resp->body = root.Dump();

@@ -117,7 +117,14 @@ SslLib& lib() {
     load(s->ERR_error_string_n, "ERR_error_string_n", ch);
     load(s->ERR_clear_error, "ERR_clear_error", ch);
     if (all) {
-      s->OPENSSL_init_ssl(0, nullptr);
+      // OPENSSL_INIT_NO_ATEXIT: without it, OPENSSL_cleanup runs from
+      // atexit and destroys libcrypto's locks while our DETACHED fiber
+      // workers may still be draining socket recycles that call SSL_free —
+      // a real shutdown race TSan catches (~1-in-20 suite runs). The
+      // process is dying anyway; skipping cleanup leaks nothing that
+      // matters and removes the race entirely.
+      constexpr uint64_t kNoAtExit = 0x00080000L;  // OPENSSL_INIT_NO_ATEXIT
+      s->OPENSSL_init_ssl(kNoAtExit, nullptr);
       s->ok = true;
     }
     return s;
