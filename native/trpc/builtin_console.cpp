@@ -1,5 +1,7 @@
 #include "trpc/builtin_console.h"
 
+#include "trpc/pprof_profile.h"
+
 #include <algorithm>
 #include <cmath>
 #include <map>
@@ -8,6 +10,7 @@
 #include <vector>
 
 #include "tbthread/contention_profiler.h"
+#include "tbthread/task_control.h"
 #include "tbthread/fiber.h"
 #include "tbthread/tracer.h"
 #include "tbutil/cpu_profiler.h"
@@ -19,10 +22,14 @@
 #include "trpc/flags.h"
 #include "trpc/http_protocol.h"
 #include "trpc/server.h"
+#include "trpc/event_dispatcher.h"
 #include "trpc/socket.h"
 #include "trpc/span.h"
 
 namespace trpc {
+
+// Framework version served by /version (round-numbered per build round).
+#define BRPC_TPU_VERSION "1.5.0"
 
 namespace {
 
@@ -43,6 +50,11 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
       "<li><a href=\"/heap\">/heap</a> — sampling heap profile (in-use)</li>"
       "<li><a href=\"/contention\">/contention</a> — mutex wait profile</li>"
+      "<li><a href=\"/sockets\">/sockets</a> — every live socket</li>"
+      "<li><a href=\"/ids\">/ids</a> — in-flight rpc ids</li>"
+      "<li><a href=\"/threads\">/threads</a> — worker pool shape</li>"
+      "<li>/pprof/profile, /pprof/heap — go-tool-pprof format</li>"
+      "<li><a href=\"/version\">/version</a></li>"
       "</ul></body></html>";
 }
 
@@ -221,6 +233,68 @@ void metrics_page(const HttpRequest&, HttpResponse* resp) {
   tbvar::dump_prometheus(&resp->body);
 }
 
+// /sockets: EVERY live socket in the process, client side included —
+// /connections shows only this server's accepted ones (reference
+// builtin/sockets_service.cpp).
+void sockets_page(const HttpRequest&, HttpResponse* resp) {
+  std::vector<SocketId> ids;
+  Socket::ListAll(&ids);
+  resp->body = "count: " + std::to_string(ids.size()) + "\n";
+  for (SocketId sid : ids) {
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) != 0) continue;
+    resp->body += s->DebugString();
+    resp->body += '\n';
+  }
+}
+
+// /ids: in-flight RPC correlation ids per socket (reference
+// builtin/ids_service.cpp shows bthread_id usage the same way) — the page
+// that answers "what is this stuck connection waiting for".
+void ids_page(const HttpRequest&, HttpResponse* resp) {
+  std::vector<SocketId> ids;
+  Socket::ListAll(&ids);
+  size_t total = 0;
+  for (SocketId sid : ids) {
+    SocketUniquePtr s;
+    if (Socket::Address(sid, &s) != 0) continue;
+    std::vector<tbthread::fiber_id_t> pending;
+    const size_t n = s->PendingIdsSnapshot(&pending, 16);
+    if (n == 0) continue;
+    total += n;
+    resp->body += "sock=" + std::to_string(sid) +
+                  " remote=" + tbutil::endpoint2str(s->remote_side()) +
+                  " pending=" + std::to_string(n) + " [";
+    for (size_t i = 0; i < pending.size(); ++i) {
+      if (i != 0) resp->body += ' ';
+      resp->body += std::to_string(pending[i]);
+    }
+    if (n > pending.size()) resp->body += " ...";
+    resp->body += "]\n";
+  }
+  resp->body =
+      "in-flight rpc ids: " + std::to_string(total) + "\n" + resp->body;
+}
+
+// /threads: the pthread layout under the fiber runtime (reference
+// builtin/threads_service.cpp dumps pthread stacks; /fibers covers the
+// stack side here — this page covers the POOL shape).
+void threads_page(const HttpRequest&, HttpResponse* resp) {
+  auto* tc = tbthread::TaskControl::singleton();
+  resp->body = "fiber_workers: " + std::to_string(tc->concurrency()) + "\n";
+  std::vector<const tbthread::TaskMeta*> running;
+  tc->collect_running(&running);
+  resp->body += "running_fibers: " + std::to_string(running.size()) + "\n";
+  resp->body +=
+      "event_dispatchers: " + std::to_string(EventDispatcher::count()) +
+      "\n(per-fiber stacks: /fibers; cpu attribution: /hotspots)\n";
+}
+
+void version_page(const HttpRequest&, HttpResponse* resp) {
+  resp->body = std::string("brpc_tpu/") + BRPC_TPU_VERSION + " (built " +
+               __DATE__ + " " + __TIME__ + ")\n";
+}
+
 void health_page(const HttpRequest&, HttpResponse* resp) {
   resp->body = "OK\n";
 }
@@ -306,6 +380,8 @@ void rpcz_page(const HttpRequest& req, HttpResponse* resp) {
 // single-worker scheduler; the window itself parks only this handler's
 // fiber, and the lock is held through RENDERING so a second run cannot
 // reset the sample state mid-read), run start/stop around the window.
+// render receives the RESOLVED window length so pages that report it
+// (pprof duration_nanos) cannot drift from the window actually sampled.
 template <typename StartFn, typename StopFn, typename RenderFn>
 void run_profile_window(const HttpRequest& req, HttpResponse* resp,
                         StartFn start, StopFn stop, RenderFn render) {
@@ -328,7 +404,7 @@ void run_profile_window(const HttpRequest& req, HttpResponse* resp,
   }
   tbthread::fiber_usleep(static_cast<uint64_t>(seconds) * 1000000);
   stop();
-  render();
+  render(seconds);
 }
 
 // /hotspots: sampling CPU profile (reference builtin/hotspots_service.cpp,
@@ -339,7 +415,7 @@ void hotspots_page(const HttpRequest& req, HttpResponse* resp) {
   run_profile_window(
       req, resp, [] { return tbutil::CpuProfiler::Start(); },
       [] { tbutil::CpuProfiler::Stop(); },
-      [&req, resp] {
+      [&req, resp](int) {
         if (req.query_param("view") == "collapsed") {
           resp->body = tbutil::CpuProfiler::Collapsed();
         } else {
@@ -360,7 +436,7 @@ void heap_page(const HttpRequest& req, HttpResponse* resp) {
   run_profile_window(
       req, resp, [] { return tbutil::HeapProfiler::Start(); },
       [] { tbutil::HeapProfiler::Stop(); },
-      [&req, resp] {
+      [&req, resp](int) {
         if (req.query_param("view") == "collapsed") {
           resp->body = tbutil::HeapProfiler::Collapsed();
         } else {
@@ -369,6 +445,35 @@ void heap_page(const HttpRequest& req, HttpResponse* resp) {
               "\n(collapsed stacks for flamegraphs: /heap?seconds=N"
               "&view=collapsed)\n";
         }
+      });
+}
+
+// /pprof/profile + /pprof/heap: the SAME profile windows emitted in the
+// golang-pprof protobuf wire format (reference builtin/pprof_service.cpp
+// serves these paths), so standard tooling consumes a live server:
+//   go tool pprof http://host:port/pprof/profile?seconds=N
+void pprof_profile_page(const HttpRequest& req, HttpResponse* resp) {
+  run_profile_window(
+      req, resp, [] { return tbutil::CpuProfiler::Start(); },
+      [] { tbutil::CpuProfiler::Stop(); },
+      [resp](int seconds) {
+        constexpr int64_t kPeriodNs = 10'000'000;  // 100 Hz sampler
+        resp->content_type = "application/octet-stream";
+        resp->body = BuildPprofProfile(
+            tbutil::CpuProfiler::Collapsed(), "cpu", "nanoseconds",
+            kPeriodNs, int64_t(seconds) * 1000000000);
+      });
+}
+
+void pprof_heap_page(const HttpRequest& req, HttpResponse* resp) {
+  run_profile_window(
+      req, resp, [] { return tbutil::HeapProfiler::Start(); },
+      [] { tbutil::HeapProfiler::Stop(); },
+      [resp](int seconds) {
+        resp->content_type = "application/octet-stream";
+        resp->body = BuildPprofProfile(
+            tbutil::HeapProfiler::Collapsed(), "inuse_space", "bytes",
+            /*period_ns=*/1, int64_t(seconds) * 1000000000);
       });
 }
 
@@ -384,7 +489,7 @@ void contention_page(const HttpRequest& req, HttpResponse* resp) {
         return true;
       },
       [] { tbthread::contention_profiling_stop(); },
-      [resp] { resp->body = tbthread::contention_report(); });
+      [resp](int) { resp->body = tbthread::contention_report(); });
 }
 
 }  // namespace
@@ -401,10 +506,16 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/flags/", flags_page);
     RegisterHttpHandler("/connections", connections_page);
     RegisterHttpHandler("/metrics", metrics_page);
+    RegisterHttpHandler("/sockets", sockets_page);
+    RegisterHttpHandler("/ids", ids_page);
+    RegisterHttpHandler("/threads", threads_page);
+    RegisterHttpHandler("/version", version_page);
     RegisterHttpHandler("/health", health_page);
     RegisterHttpHandler("/rpcz", rpcz_page);
     RegisterHttpHandler("/fibers", fibers_page);
     RegisterHttpHandler("/hotspots", hotspots_page);
+    RegisterHttpHandler("/pprof/profile", pprof_profile_page);
+    RegisterHttpHandler("/pprof/heap", pprof_heap_page);
     RegisterHttpHandler("/heap", heap_page);
     RegisterHttpHandler("/contention", contention_page);
   });

@@ -96,24 +96,36 @@ static auto* g_event_dispatcher_num = TRPC_DEFINE_FLAG(
     event_dispatcher_num, 2,
     "number of epoll threads (latched at first socket creation)");
 
-EventDispatcher& EventDispatcher::shard(SocketId sid) {
-  struct Pool {
-    EventDispatcher* d;
-    size_t n;
-  };
-  static Pool pool = []() {
+namespace {
+struct DispatcherPool {
+  EventDispatcher* d;
+  size_t n;
+};
+DispatcherPool& dispatcher_pool() {
+  static DispatcherPool pool = []() {
     int64_t n = g_event_dispatcher_num->load(std::memory_order_relaxed);
     if (n < 1) n = 1;
     if (n > 64) n = 64;
     auto* d = new EventDispatcher[n];
     for (int64_t i = 0; i < n; ++i) d[i].Start();
-    return Pool{d, static_cast<size_t>(n)};
+    return DispatcherPool{d, static_cast<size_t>(n)};
   }();
+  return pool;
+}
+}  // namespace
+
+EventDispatcher& EventDispatcher::shard(SocketId sid) {
+  DispatcherPool& pool = dispatcher_pool();
   // SocketIds pack (slot << 32 | version); the slot is consecutive for
   // consecutive sockets, so modulo spreads them evenly. (The low 32 bits are
   // the version — always even for live sockets, so using them would pin
   // every socket to shard 0 whenever the pool size is even.)
   return pool.d[(sid >> 32) % pool.n];
+}
+
+size_t EventDispatcher::count() {
+  // The LATCHED pool size (flag changes after startup don't apply).
+  return dispatcher_pool().n;
 }
 
 }  // namespace trpc

@@ -11,6 +11,7 @@
 // Address() — never a dangling pointer.
 #pragma once
 
+#include <cstddef>
 #include <cstdint>
 
 namespace trpc {
@@ -36,6 +37,7 @@ class EventDispatcher {
   // socket population by id hash, so one hot connection cannot starve the
   // read path of every other connection.
   static EventDispatcher& shard(SocketId sid);
+  static size_t count();  // size of the epoll-thread pool (console)
 
  private:
   void Run();

@@ -21,11 +21,15 @@ namespace lb_detail {
 
 namespace {
 uint32_t parse_weight(const std::string& tag) {
-  // "w=N" anywhere in the tag; default 1.
+  // "w=N" anywhere in the tag; default 1. Clamped hard: tags arrive from
+  // naming endpoints (including the open registry port), and the ring LBs
+  // spend O(weight) memory per node — an unclamped remote value would be
+  // an OOM lever on every consumer.
   size_t pos = tag.find("w=");
   if (pos == std::string::npos) return 1;
   long w = strtol(tag.c_str() + pos + 2, nullptr, 10);
-  return w > 0 ? static_cast<uint32_t>(w) : 1;
+  if (w < 1) return 1;
+  return static_cast<uint32_t>(std::min<long>(w, 1000));
 }
 
 bool excluded(const LoadBalancer::SelectIn& in, const tbutil::EndPoint& pt) {
@@ -193,7 +197,10 @@ class ConsistentHashLB : public LoadBalancer {
       }
       for (size_t i = 0; i < ring.nodes.size(); ++i) {
         const lb_detail::Node& node = ring.nodes[i];
-        const uint32_t vnodes = kVNodes * node.weight;
+        // Ring cost is O(vnodes) memory + one hash each: cap the weight
+        // multiplier tighter than the general clamp.
+        const uint32_t vnodes =
+            kVNodes * std::min<uint32_t>(node.weight, 100);
         if (policy == RingPolicy::kMix64) {
           uint64_t base = tbutil::endpoint_hash(node.server.addr);
           for (uint32_t v = 0; v < vnodes; ++v) {

@@ -18,22 +18,36 @@ PeriodicReporter::~PeriodicReporter() {
 }
 
 int PeriodicReporter::StartLoop(const std::function<void()>& configure) {
-  std::lock_guard<std::mutex> lk(_lifecycle_mu);
-  if (_thread.joinable()) {
-    TB_LOG(ERROR) << "periodic reporter already started; Stop() first";
-    return -1;
+  {
+    std::lock_guard<std::mutex> lk(_lifecycle_mu);
+    if (_thread.joinable()) {
+      TB_LOG(ERROR) << "periodic reporter already started; Stop() first";
+      return -1;
+    }
+    if (configure) configure();
+    _stop.store(false);
   }
-  if (configure) configure();
-  _stop.store(false);
-  TickOnce();  // prime state before returning (tests and callers rely on it)
+  // Prime OUTSIDE the lifecycle lock: against a dead peer this is a
+  // blocking RPC with a 2s timeout, and a concurrent StopLoop must not
+  // hang on the mutex for the duration (ADVICE r4). Still synchronous —
+  // callers rely on the first beat having landed when StartLoop returns.
+  TickOnce();
+  std::lock_guard<std::mutex> lk(_lifecycle_mu);
+  if (_stop.load()) return 0;  // raced a StopLoop: stay stopped
+  if (_thread.joinable()) return -1;
   _thread = std::thread([this] { Run(); });
   return 0;
 }
 
 void PeriodicReporter::StopLoop() {
+  // _stop is set UNCONDITIONALLY (before the joinable check): a StopLoop
+  // racing StartLoop's unlocked priming TickOnce must leave the stop mark
+  // behind so StartLoop's re-lock sees it and never spawns the thread —
+  // otherwise a subclass destructor's StopLoop could return while Run()
+  // later starts against destroyed members.
+  _stop.store(true);
   std::lock_guard<std::mutex> lk(_lifecycle_mu);
   if (!_thread.joinable()) return;
-  _stop.store(true);
   _thread.join();
 }
 

@@ -1,0 +1,141 @@
+#include "trpc/pprof_profile.h"
+
+#include <map>
+#include <vector>
+
+#include "trpc/tidl_runtime.h"
+
+namespace trpc {
+
+namespace {
+
+using tidl::put_bytes_field;
+using tidl::put_tag;
+using tidl::put_varint;
+using tidl::put_varint_field;
+
+// profile.proto field numbers (github.com/google/pprof).
+// Profile: sample_type=1 sample=2 location=4 function=5 string_table=6
+//          time_nanos=9 duration_nanos=10 period_type=11 period=12
+// ValueType: type=1 unit=2 (string table indices)
+// Sample: location_id=1 value=2
+// Location: id=1 line=4
+// Line: function_id=1
+// Function: id=1 name=2 system_name=3
+
+std::string value_type_msg(int64_t type_idx, int64_t unit_idx) {
+  std::string m;
+  put_varint_field(&m, 1, uint64_t(type_idx));
+  put_varint_field(&m, 2, uint64_t(unit_idx));
+  return m;
+}
+
+}  // namespace
+
+std::string BuildPprofProfile(const std::string& collapsed,
+                              const std::string& value_type,
+                              const std::string& value_unit,
+                              int64_t period_ns, int64_t duration_ns) {
+  // CPU profiles carry (samples/count, cpu/ns); byte-valued profiles
+  // (heap) carry a single value type — labeling byte counts as "samples"
+  // would show nonsense under -sample_index=samples.
+  const bool two_value = period_ns > 1;
+  // String table: index 0 must be "" by spec.
+  std::vector<std::string> strings = {""};
+  std::map<std::string, int64_t> string_idx = {{"", 0}};
+  auto intern = [&](const std::string& s) -> int64_t {
+    auto [it, fresh] = string_idx.try_emplace(
+        s, static_cast<int64_t>(strings.size()));
+    if (fresh) strings.push_back(s);
+    return it->second;
+  };
+  // Function/location per unique frame name (our frames are already
+  // symbolized; addresses stay 0 and the Line carries the function).
+  std::map<std::string, uint64_t> frame_ids;
+  std::string functions;  // repeated Function
+  std::string locations;  // repeated Location
+  auto frame_id = [&](const std::string& name) -> uint64_t {
+    auto it = frame_ids.find(name);
+    if (it != frame_ids.end()) return it->second;
+    const uint64_t id = frame_ids.size() + 1;
+    frame_ids[name] = id;
+    std::string fn;
+    put_varint_field(&fn, 1, id);
+    const int64_t nidx = intern(name);
+    put_varint_field(&fn, 2, uint64_t(nidx));
+    put_varint_field(&fn, 3, uint64_t(nidx));
+    put_bytes_field(&functions, 5, fn);
+    std::string line;
+    put_varint_field(&line, 1, id);  // function_id
+    std::string loc;
+    put_varint_field(&loc, 1, id);
+    put_bytes_field(&loc, 4, line);
+    put_bytes_field(&locations, 4, loc);
+    return id;
+  };
+
+  std::string samples;  // repeated Sample
+  size_t start = 0;
+  while (start < collapsed.size()) {
+    size_t nl = collapsed.find('\n', start);
+    if (nl == std::string::npos) nl = collapsed.size();
+    const std::string line = collapsed.substr(start, nl - start);
+    start = nl + 1;
+    const size_t sp = line.rfind(' ');
+    if (sp == std::string::npos || sp == 0) continue;
+    const int64_t count = strtoll(line.c_str() + sp + 1, nullptr, 10);
+    if (count <= 0) continue;
+    // Split "outer;...;leaf": pprof wants the LEAF first in location_id.
+    std::vector<uint64_t> ids;
+    size_t fstart = 0;
+    const std::string stack = line.substr(0, sp);
+    while (fstart <= stack.size()) {
+      size_t semi = stack.find(';', fstart);
+      if (semi == std::string::npos) semi = stack.size();
+      if (semi > fstart) {
+        ids.push_back(frame_id(stack.substr(fstart, semi - fstart)));
+      }
+      fstart = semi + 1;
+    }
+    if (ids.empty()) continue;
+    std::string sm;
+    {
+      // location_id: packed varints, leaf first.
+      std::string packed;
+      for (auto it = ids.rbegin(); it != ids.rend(); ++it) {
+        put_varint(&packed, *it);
+      }
+      put_bytes_field(&sm, 1, packed);
+      std::string vals;
+      if (two_value) {
+        put_varint(&vals, uint64_t(count));
+        put_varint(&vals, uint64_t(count * period_ns));
+      } else {
+        put_varint(&vals, uint64_t(count));
+      }
+      put_bytes_field(&sm, 2, vals);
+    }
+    put_bytes_field(&samples, 2, sm);
+  }
+
+  std::string out;
+  if (two_value) {
+    put_bytes_field(&out, 1,
+                    value_type_msg(intern("samples"), intern("count")));
+  }
+  put_bytes_field(&out, 1,
+                  value_type_msg(intern(value_type), intern(value_unit)));
+  out += samples;
+  out += locations;
+  out += functions;
+  for (const std::string& s : strings) {
+    put_bytes_field(&out, 6, s);
+  }
+  put_varint_field(&out, 10, uint64_t(duration_ns));
+  put_bytes_field(&out, 11,
+                  value_type_msg(intern(value_type), intern(value_unit)));
+  put_varint_field(&out, 12, uint64_t(period_ns));
+  return out;
+}
+
+}  // namespace trpc

@@ -1,0 +1,27 @@
+// pprof protobuf profile emission: converts the in-repo profilers'
+// collapsed-stack aggregates into the canonical pprof wire format
+// (github.com/google/pprof proto/profile.proto — encoded with the
+// framework's own protobuf-wire runtime, trpc/tidl_runtime.h).
+//
+// Capability parity: reference builtin/pprof_service.cpp serves
+// /pprof/profile and /pprof/heap in exactly this format so standard
+// tooling ("go tool pprof http://host:port/pprof/profile") consumes a
+// live server directly.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+
+// collapsed: "outer;...;leaf <count>" per line (CpuProfiler::Collapsed /
+// HeapProfiler::Collapsed). For CPU profiles, value_unit="nanoseconds" and
+// each sample's second value is count * period_ns; for heap,
+// value_type="inuse_space"/"bytes" with the count already in bytes.
+// Returns the serialized (uncompressed) pprof Profile message.
+std::string BuildPprofProfile(const std::string& collapsed,
+                              const std::string& value_type,
+                              const std::string& value_unit,
+                              int64_t period_ns, int64_t duration_ns);
+
+}  // namespace trpc

@@ -11,7 +11,11 @@
 // Wire API (JSON over the builtin HTTP port):
 //   POST /registry/register    {"addr":"ip:port","tag":"...","ttl_s":N}
 //   POST /registry/deregister  {"addr":"ip:port"}
-//   GET  /registry/list[?tag=t] -> {"servers":[{"addr":...,"tag":...},...]}
+//   GET  /registry/list[?tag=t] -> {"index":V,"servers":[{"addr":..},...]}
+//   GET  /registry/list?index=V[&wait_ms=M] -> blocking query: held until
+//        the membership version advances past V (watch mode)
+// addr accepts IPv4 literals and hostnames only — bracketed IPv6 is
+// rejected by validation (EndPoint itself is IPv4; revisit together).
 // Entries expire ttl_s seconds after the last register (heartbeats renew).
 #pragma once
 

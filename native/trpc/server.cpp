@@ -59,9 +59,19 @@ class GrpcHealthService : public Service {
   void CallMethod(const std::string& method, Controller* cntl,
                   const tbutil::IOBuf& request, tbutil::IOBuf* response,
                   Closure* done) override {
-    (void)request;  // any service name in the request is reported SERVING
+    (void)request;  // any service name shares the server-wide answer
     if (method == "Check") {
-      response->append("\x08\x01", 2);
+      // SERVING only while the owning server is actually running: during
+      // Stop/drain probes must see NOT_SERVING (0x08 0x02) so LBs pull
+      // the instance before its listener vanishes (ADVICE r4).
+      bool serving = true;
+      SocketUniquePtr s;
+      if (Socket::Address(ControllerPrivateAccessor(cntl).server_socket(),
+                          &s) == 0 &&
+          s->user() != nullptr) {
+        serving = static_cast<Server*>(s->user())->running();
+      }
+      response->append(serving ? "\x08\x01" : "\x08\x02", 2);
     } else {
       cntl->SetFailed(TRPC_ENOMETHOD, "unimplemented: " + method);
     }

@@ -11,6 +11,7 @@
 
 #include <cerrno>
 #include <cstring>
+#include <set>
 
 #include "tbthread/fiber.h"
 #include "tbthread/task_group.h"
@@ -44,6 +45,12 @@ void set_no_delay(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
+
+// Live-socket registry backing the /sockets and /ids console pages
+// (reference builtin/sockets_service.cpp enumerates its SocketMap the same
+// way). Create/recycle are not hot paths; a mutexed set is fine.
+std::mutex g_live_mu;
+std::set<trpc::SocketId> g_live_sockets;
 
 struct KeepWriteArg {
   Socket* sock;  // carries one ref, released by KeepWrite
@@ -101,6 +108,10 @@ int Socket::Create(const Options& opt, SocketId* id) {
   s->_close_after_write.store(false, std::memory_order_relaxed);
   s->_connecting.store(false, std::memory_order_relaxed);
   s->_fd.store(opt.fd, std::memory_order_release);
+  {
+    std::lock_guard<std::mutex> lk(g_live_mu);
+    g_live_sockets.insert(vid);
+  }
   if (opt.fd >= 0) {
     make_non_blocking(opt.fd);
     set_no_delay(opt.fd);
@@ -176,6 +187,10 @@ void Socket::OnFailed(int error) {
 }
 
 void Socket::OnRecycle() {
+  {
+    std::lock_guard<std::mutex> lk(g_live_mu);
+    g_live_sockets.erase(id());
+  }
   // SslConn's destructor sends a best-effort close_notify through the fd:
   // it must run BEFORE close() — after close the number may already belong
   // to an unrelated descriptor and the TLS record would corrupt it.
@@ -605,6 +620,21 @@ void Socket::ReleaseAllWrites(WriteRequest* todo, WriteRequest* last,
     release_one(todo);
     todo = next;
   }
+}
+
+void Socket::ListAll(std::vector<SocketId>* out) {
+  std::lock_guard<std::mutex> lk(g_live_mu);
+  out->assign(g_live_sockets.begin(), g_live_sockets.end());
+}
+
+size_t Socket::PendingIdsSnapshot(std::vector<tbthread::fiber_id_t>* out,
+                                  size_t cap) {
+  std::lock_guard<std::mutex> lk(_pending_mu);
+  if (out != nullptr) {
+    const size_t n = std::min(cap, _pending_ids.size());
+    out->assign(_pending_ids.begin(), _pending_ids.begin() + n);
+  }
+  return _pending_ids.size();
 }
 
 // ---------------- connect path ----------------

@@ -101,6 +101,11 @@ class Socket : public VersionedRefWithId<Socket> {
 
   // Diagnostic snapshot (racy atomic reads only; safe anytime).
   std::string DebugString() const;
+  // Console support: every live socket id (server and client side), and a
+  // bounded snapshot of this socket's pending RPC ids (returns the total).
+  static void ListAll(std::vector<SocketId>* out);
+  size_t PendingIdsSnapshot(std::vector<tbthread::fiber_id_t>* out,
+                            size_t cap);
   // Hex of read_buf's first bytes. ONLY safe on a quiescent connection (the
   // hang state it exists to debug); returns a placeholder if input
   // processing is active.
