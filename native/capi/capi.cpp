@@ -8,6 +8,7 @@
 #include <thread>
 #include <vector>
 
+#include "tbthread/fiber.h"
 #include "tbutil/time.h"
 #include "trpc/channel.h"
 #include "trpc/errno.h"
@@ -486,35 +487,61 @@ double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
   std::atomic<bool> stop{false};
   std::mutex lat_mu;
   std::vector<int64_t> latencies;
-  std::vector<std::thread> workers;
   std::string payload(payload_size, 'b');
-  for (int t = 0; t < concurrency; ++t) {
-    workers.emplace_back([&] {
-      std::vector<int64_t> local;
-      local.reserve(1 << 14);
-      while (!stop.load(std::memory_order_relaxed)) {
-        Controller cntl;
-        tbutil::IOBuf request, response;
-        request.append("x");
-        cntl.request_attachment().append(payload);
-        env.channel->channel.CallMethod("EchoService/Echo", &cntl, request,
-                                        &response, nullptr);
-        if (!cntl.Failed()) {
-          total_bytes.fetch_add(
-              static_cast<int64_t>(cntl.response_attachment().size()),
-              std::memory_order_relaxed);
-          total_calls.fetch_add(1, std::memory_order_relaxed);
-          local.push_back(cntl.latency_us());
-        }
+  // Callers are FIBERS, the framework's native concurrency unit (the
+  // reference's multi_threaded_echo benchmarks drive with bthreads the
+  // same way): a parked fiber caller wakes by a queue push on an already
+  // running worker — no per-RPC futex wake/wait pair, which dominated the
+  // small-RPC profile with pthread callers.
+  struct CallerArg {
+    BenchEnv* env;
+    std::atomic<bool>* stop;
+    std::atomic<int64_t>* total_bytes;
+    std::atomic<int64_t>* total_calls;
+    std::mutex* lat_mu;
+    std::vector<int64_t>* latencies;
+    const std::string* payload;
+  };
+  auto caller = [](void* argv) -> void* {
+    auto* a = static_cast<CallerArg*>(argv);
+    std::vector<int64_t> local;
+    local.reserve(1 << 14);
+    while (!a->stop->load(std::memory_order_relaxed)) {
+      Controller cntl;
+      tbutil::IOBuf request, response;
+      request.append("x");
+      cntl.request_attachment().append(*a->payload);
+      a->env->channel->channel.CallMethod("EchoService/Echo", &cntl,
+                                          request, &response, nullptr);
+      if (!cntl.Failed()) {
+        a->total_bytes->fetch_add(
+            static_cast<int64_t>(cntl.response_attachment().size()),
+            std::memory_order_relaxed);
+        a->total_calls->fetch_add(1, std::memory_order_relaxed);
+        local.push_back(cntl.latency_us());
       }
-      std::lock_guard<std::mutex> lk(lat_mu);
-      latencies.insert(latencies.end(), local.begin(), local.end());
-    });
+    }
+    std::lock_guard<std::mutex> lk(*a->lat_mu);
+    a->latencies->insert(a->latencies->end(), local.begin(), local.end());
+    delete a;
+    return nullptr;
+  };
+  std::vector<tbthread::fiber_t> fibers(concurrency);
+  for (int t = 0; t < concurrency; ++t) {
+    auto* arg = new CallerArg{&env, &stop, &total_bytes, &total_calls,
+                              &lat_mu, &latencies, &payload};
+    if (tbthread::fiber_start_background(&fibers[t], nullptr, caller, arg) !=
+        0) {
+      delete arg;
+      fibers[t] = 0;
+    }
   }
   const int64_t t0 = tbutil::monotonic_time_us();
   std::this_thread::sleep_for(std::chrono::seconds(seconds));
   stop.store(true);
-  for (auto& w : workers) w.join();
+  for (auto& f : fibers) {
+    if (f != 0) tbthread::fiber_join(f, nullptr);
+  }
   const double elapsed_s = (tbutil::monotonic_time_us() - t0) / 1e6;
   if (qps_out != nullptr) {
     *qps_out = static_cast<double>(total_calls.load()) / elapsed_s;

@@ -150,6 +150,11 @@ int fiber_usleep(uint64_t us) {
   return 0;
 }
 
+bool fiber_worker_busy() {
+  TaskGroup* g = TaskGroup::current();
+  return g != nullptr && g->has_pending_local_work();
+}
+
 int fiber_timer_add(fiber_timer_t* id, int64_t abstime_us,
                     void (*fn)(void*), void* arg) {
   TimerThread::TaskId tid = TimerThread::singleton()->schedule(fn, arg,

@@ -23,6 +23,8 @@ int fiber_join(fiber_t tid, void** result);
 bool fiber_exists(fiber_t tid);
 fiber_t fiber_self();  // INVALID_FIBER off-fiber
 void fiber_yield();
+// On a worker with runnable fibers still queued locally? (false off-worker)
+bool fiber_worker_busy();
 int fiber_usleep(uint64_t us);  // parks the fiber; nanosleep off-fiber
 
 int fiber_get_concurrency();

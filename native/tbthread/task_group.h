@@ -57,6 +57,10 @@ class TaskGroup {
 
   TaskControl* control() const { return _control; }
   int tag() const { return _tag; }
+  // True when this worker has more runnable fibers queued locally — a
+  // hint for write-coalescing (a deferred flush WILL be followed by more
+  // producers on this same worker before anything idles).
+  bool has_pending_local_work() const { return _rq.volatile_size() != 0; }
 
   static void task_entry(intptr_t group_ptr);  // first frame of every fiber
 

