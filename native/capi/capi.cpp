@@ -562,45 +562,10 @@ double tbrpc_bench_echo_ex(size_t payload_size, int seconds, int concurrency,
 }
 
 double tbrpc_bench_echo_qps(int seconds, int concurrency, double* p99_us_out) {
-  BenchEnv env;
-  if (!env.ok) return -1;
-  if (concurrency < 1) concurrency = 1;
-  std::atomic<int64_t> total_calls{0};
-  std::atomic<bool> stop{false};
-  std::mutex lat_mu;
-  std::vector<int64_t> latencies;
-  std::vector<std::thread> workers;
-  for (int t = 0; t < concurrency; ++t) {
-    workers.emplace_back([&] {
-      std::vector<int64_t> local;
-      local.reserve(1 << 16);
-      while (!stop.load(std::memory_order_relaxed)) {
-        Controller cntl;
-        tbutil::IOBuf request, response;
-        request.append("ping");
-        env.channel->channel.CallMethod("EchoService/Echo", &cntl, request,
-                                        &response, nullptr);
-        if (!cntl.Failed()) {
-          total_calls.fetch_add(1, std::memory_order_relaxed);
-          local.push_back(cntl.latency_us());
-        }
-      }
-      std::lock_guard<std::mutex> lk(lat_mu);
-      latencies.insert(latencies.end(), local.begin(), local.end());
-    });
-  }
-  const int64_t t0 = tbutil::monotonic_time_us();
-  std::this_thread::sleep_for(std::chrono::seconds(seconds));
-  stop.store(true);
-  for (auto& w : workers) w.join();
-  const double elapsed_s = (tbutil::monotonic_time_us() - t0) / 1e6;
-  if (p99_us_out != nullptr) {
-    *p99_us_out = 0;
-    if (!latencies.empty()) {
-      std::sort(latencies.begin(), latencies.end());
-      *p99_us_out = static_cast<double>(
-          latencies[static_cast<size_t>(latencies.size() * 0.99)]);
-    }
-  }
-  return static_cast<double>(total_calls.load()) / elapsed_s;
+  // Same fiber-caller harness as tbrpc_bench_echo_ex (both entry points
+  // must measure the SAME concurrency regime).
+  double qps = 0;
+  tbrpc_bench_echo_ex(4, seconds, concurrency, /*transport=*/0,
+                      /*conn_type=*/0, &qps, nullptr, p99_us_out);
+  return qps;
 }

@@ -347,7 +347,6 @@ void* Socket::KeepWriteThunk(void* argv) {
 // _write_head. `last` is only released after a successful detach CAS to
 // prevent pool-reuse ABA on the head pointer.
 void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
-  _retention_yields = 1;  // one coalescing yield per writer session
   while (true) {
     while (todo != nullptr) {
       if (Failed()) {
@@ -381,24 +380,10 @@ void Socket::KeepWrite(WriteRequest* todo, WriteRequest* last) {
         continue;
       }
     }
-    // Writer retention: before retiring, yield once so fibers made
-    // runnable by the bytes we just delivered (responders, next pipelined
-    // callers) get to ENQUEUE their writes — the retained writer then
-    // carries them in one gathered writev instead of each paying its own
-    // inline syscall. Measured on the 64B conc=16 bench: the coalescing
-    // factor is what the small-RPC floor is made of.
-    if (_retention_yields > 0) {
-      --_retention_yields;
-      tbthread::fiber_yield();
-      if (_write_head.load(std::memory_order_acquire) != last) {
-        // New arrivals: fall through to the reversal path below.
-      }
-    }
     // Everything claimed is on the wire: try to retire the queue.
     WriteRequest* expected = last;
     if (_write_head.compare_exchange_strong(expected, nullptr,
                                             std::memory_order_acq_rel)) {
-      _retention_yields = 1;
       tbutil::return_object(last);
       if (_close_after_write.load(std::memory_order_acquire)) {
         TB_VLOG(2) << "graceful close (keepwrite) sid=" << id();

@@ -101,11 +101,6 @@ class Socket : public VersionedRefWithId<Socket> {
 
   // Diagnostic snapshot (racy atomic reads only; safe anytime).
   std::string DebugString() const;
-  // True once TLS is established (short-read heuristics must not apply:
-  // SSL_read buffers whole records).
-  bool ssl_on() const {
-    return _ssl_state.load(std::memory_order_acquire) != kSslOff;
-  }
   // Console support: every live socket id (server and client side), and a
   // bounded snapshot of this socket's pending RPC ids (returns the total).
   static void ListAll(std::vector<SocketId>* out);
@@ -224,8 +219,6 @@ class Socket : public VersionedRefWithId<Socket> {
   InputMessenger* _messenger = nullptr;
   std::atomic<ttpu::IciEndpoint*> _ici{nullptr};
   bool _tpu_requested = false;
-  // Writer-retention budget (KeepWrite only; single-writer state).
-  int _retention_yields = 0;
   bool _server_side = false;
   // TLS plumbing. _ssl_state: 0 = plain, 1 = server sniff pending, 2 =
   // handshaking (reads back off), 3 = established (_ssl non-null).
