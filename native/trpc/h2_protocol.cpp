@@ -75,6 +75,9 @@ struct H2Connection {
 
   // Send-side flow control (guarded by write_mu).
   std::mutex write_mu;
+  // TX header compression state (write_mu: insertions must hit the wire
+  // in emission order or the peer's dynamic table desyncs).
+  HpackEncoder hpack_tx;
   int64_t conn_send_window = 65535;
   std::unordered_map<uint32_t, int64_t> stream_send_window;
   // DATA blocked on window: (stream, remaining bytes, end_stream trailers
@@ -116,11 +119,20 @@ int write_raw(Socket* s, const std::string& bytes) {
 }
 
 // HEADERS frame with END_HEADERS (header blocks here are small).
-std::string make_headers_frame(const HeaderList& headers, uint32_t stream_id,
-                               bool end_stream) {
+// Caller holds the connection's write_mu and the frame goes to the wire
+// IMMEDIATELY (encoder insertions ride in emission order). For frames
+// whose write is DEFERRED (queued trailers), pass conn=nullptr: the
+// stateless encoder emits static-index/literal forms that carry no table
+// state and so tolerate reordering.
+std::string make_headers_frame(H2Connection* conn, const HeaderList& headers,
+                               uint32_t stream_id, bool end_stream) {
   std::string block;
   for (const auto& [n, v] : headers) {
-    HpackEncodeHeader(&block, n, v);
+    if (conn != nullptr) {
+      conn->hpack_tx.Encode(&block, n, v);
+    } else {
+      HpackEncodeHeader(&block, n, v);
+    }
   }
   std::string out;
   put_frame_header(&out, block.size(), kHeaders,
@@ -570,7 +582,7 @@ void send_h2_error(Socket* s, H2Connection* conn, uint32_t stream_id,
   } else {
     h.emplace_back(":status", std::to_string(http_status));
   }
-  write_raw(s, make_headers_frame(h, stream_id, /*end_stream=*/true));
+  write_raw(s, make_headers_frame(conn, h, stream_id, /*end_stream=*/true));
 }
 
 void h2_process_request(InputMessageBase* base) {
@@ -683,7 +695,7 @@ void h2_process_request(InputMessageBase* base) {
           h.emplace_back(":status", "200");
           h.emplace_back("content-type", "application/grpc");
           write_raw(sock.get(),
-                    make_headers_frame(h, stream_id, /*end_stream=*/false));
+                    make_headers_frame(conn, h, stream_id, /*end_stream=*/false));
           // DATA: 5-byte message prefix + payload, queued through the
           // flow-control path.
           HeaderList trailers;
@@ -693,15 +705,21 @@ void h2_process_request(InputMessageBase* base) {
           if (cntl->Failed()) {
             trailers.emplace_back("grpc-message", cntl->ErrorText());
           }
+          // Trailers are QUEUED behind window-governed DATA and reach the
+          // wire later — possibly after other streams' HEADERS. A frame
+          // whose emission is deferred must not touch the dynamic table
+          // (insertion order is the protocol), so trailers use the
+          // STATELESS encoder: static indices + literals only.
           conn->pending.push_back(make_grpc_pending(
               stream_id, std::move(*response),
-              make_headers_frame(trailers, stream_id, /*end_stream=*/true)));
+              make_headers_frame(nullptr, trailers, stream_id,
+                                 /*end_stream=*/true)));
           flush_pending_locked(conn, sock.get());
         } else {
           HeaderList h;
           h.emplace_back(":status", cntl->Failed() ? "500" : "200");
           write_raw(sock.get(),
-                    make_headers_frame(h, stream_id, /*end_stream=*/false));
+                    make_headers_frame(conn, h, stream_id, /*end_stream=*/false));
           H2Connection::Pending p;
           p.stream_id = stream_id;
           if (cntl->Failed()) {
@@ -829,7 +847,7 @@ void h2_pack_request(tbutil::IOBuf* out, Controller* cntl,
   // and IssueRPC's Write(empty) is a no-op. DATA rides the window-governed
   // Pending queue so a large request respects the peer's windows.
   (void)out;
-  if (write_raw(socket, make_headers_frame(h, sid, /*end_stream=*/false)) !=
+  if (write_raw(socket, make_headers_frame(conn, h, sid, /*end_stream=*/false)) !=
       0) {
     // Transient rejection (e.g. EOVERCROWDED): fail THIS RPC without
     // queuing DATA for a stream that never opened.

@@ -256,4 +256,74 @@ void HpackEncodeHeader(std::string* out, const std::string& name,
   out->append(value);
 }
 
+void HpackEncoder::evict_to(size_t cap) {
+  while (_size > cap && !_dynamic.empty()) {
+    const auto& [n, v] = _dynamic.back();
+    _size -= n.size() + v.size() + 32;
+    _dynamic.pop_back();
+  }
+}
+
+void HpackEncoder::insert(const std::string& name, const std::string& value) {
+  const size_t entry = name.size() + value.size() + 32;
+  evict_to(_cap >= entry ? _cap - entry : 0);
+  _dynamic.emplace_front(name, value);
+  _size += entry;
+}
+
+void HpackEncoder::Encode(std::string* out, const std::string& name,
+                          const std::string& value) {
+  // Exact match: static table first (stable small indices), then ours.
+  int name_static = 0;
+  for (int i = 1; i <= hpack::kStaticTableSize; ++i) {
+    if (hpack::kStaticTable[i].name == name) {
+      if (hpack::kStaticTable[i].value == value) {
+        encode_int(out, static_cast<uint64_t>(i), 7, 0x80);
+        return;
+      }
+      if (name_static == 0) name_static = i;
+    }
+  }
+  int name_dynamic = 0;
+  for (size_t i = 0; i < _dynamic.size(); ++i) {
+    if (_dynamic[i].first == name) {
+      if (_dynamic[i].second == value) {
+        encode_int(out,
+                   static_cast<uint64_t>(hpack::kStaticTableSize + 1 + i), 7,
+                   0x80);
+        return;
+      }
+      if (name_dynamic == 0) {
+        name_dynamic = static_cast<int>(hpack::kStaticTableSize + 1 + i);
+      }
+    }
+  }
+  const size_t entry = name.size() + value.size() + 32;
+  if (entry > _cap) {
+    // Indexing an oversized entry would just flush the whole table
+    // (RFC 7541 §4.4): send it literal-without-indexing instead.
+    encode_int(out, 0, 4, 0x00);
+    encode_int(out, name.size(), 7, 0x00);
+    out->append(name);
+    encode_int(out, value.size(), 7, 0x00);
+    out->append(value);
+    return;
+  }
+  // Literal WITH incremental indexing (prefix 01, 6-bit name index): the
+  // entry joins both tables, so the next occurrence is 1-2 bytes.
+  // NOTE on index stability: `insert` happens AFTER the name reference is
+  // written, and RFC 7541 resolves indices against the table state BEFORE
+  // the insertion, so referencing a dynamic name by its pre-insert index
+  // is exactly what the decoder expects.
+  const int name_idx = name_static != 0 ? name_static : name_dynamic;
+  encode_int(out, static_cast<uint64_t>(name_idx), 6, 0x40);
+  if (name_idx == 0) {
+    encode_int(out, name.size(), 7, 0x00);
+    out->append(name);
+  }
+  encode_int(out, value.size(), 7, 0x00);
+  out->append(value);
+  insert(name, value);
+}
+
 }  // namespace trpc

@@ -37,8 +37,30 @@ class HpackDecoder {
 };
 
 // Appends one header field (literal without indexing / indexed static hit).
+// Stateless — the zero-state fallback; connections use HpackEncoder.
 void HpackEncodeHeader(std::string* out, const std::string& name,
                        const std::string& value);
+
+// Stateful encoder with a dynamic table mirroring the state the peer's
+// decoder builds from our emissions (RFC 7541 §4): exact hits encode as a
+// single index, repeated headers (user-agent, :path, ...) shrink to 1-2
+// bytes after their first appearance. One instance per connection
+// DIRECTION; mutations must be serialized with HEADERS frame emission
+// order (callers hold the connection write lock), since the decoder
+// replays insertions in wire order.
+class HpackEncoder {
+ public:
+  void Encode(std::string* out, const std::string& name,
+              const std::string& value);
+
+ private:
+  void insert(const std::string& name, const std::string& value);
+  void evict_to(size_t cap);
+
+  std::deque<std::pair<std::string, std::string>> _dynamic;  // newest front
+  size_t _size = 0;        // RFC size (name + value + 32 per entry)
+  size_t _cap = 4096;      // default table size; we never signal a change
+};
 
 // Huffman-decode `n` bytes into *out; false on bad padding/EOS in stream.
 bool HuffmanDecode(const uint8_t* data, size_t n, std::string* out);
