@@ -1,10 +1,22 @@
 BUILD_DIR := native/build
 
-.PHONY: native test asan tsan test-asan test-tsan clean
+.PHONY: native test asan tsan test-asan test-tsan lint lint-sarif clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
 	cmake --build $(BUILD_DIR)
+
+# Static analysis (tools/tpulint): fiber-safety, lock-order, IOBuf
+# ownership, tidl wire-contract drift, metric hygiene, Python handler
+# blocking. Pure CPython, no native toolchain needed — this is the half of
+# the safety story that runs where test-asan/test-tsan (the dynamic half)
+# cannot. Non-zero exit on any finding not justified by an inline
+# `tpulint: allow(...)` or grandfathered in tools/tpulint/baseline.json.
+lint:
+	python -m tools.tpulint
+
+lint-sarif:
+	python -m tools.tpulint --format sarif > tpulint.sarif
 
 test: native
 	python -m pytest tests/ -x -q

@@ -29,7 +29,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from brpc_tpu.utils.compat import shard_map
 
 from brpc_tpu.ops.flash_attention import (flash_attention_carry,
                                           flash_finalize, flash_init)
@@ -69,8 +69,12 @@ def ring_attention(mesh: Mesh, axis: str = SHARD_AXIS, *,
         # lands, never rotated onward.
         m, l, acc = fold(idx, k, v, m, l, acc)
 
-        def hop(carry, t):
-            k_blk, v_blk, m, l, acc = carry
+        # Unrolled: n is the (small, static) mesh axis size, and unrolling
+        # lets XLA overlap each ICI hop with the previous fold's matmuls.
+        # (A lax.scan here also trips an XLA SPMD PartitionId lowering bug
+        # on older jax when combined with ppermute + interpreted pallas.)
+        k_blk, v_blk = k, v
+        for t in range(n - 1):
             # Rotate first; XLA overlaps the ICI hop with the matmuls.
             k_blk = jax.lax.ppermute(k_blk, axis, fwd)
             v_blk = jax.lax.ppermute(v_blk, axis, fwd)
@@ -78,10 +82,6 @@ def ring_attention(mesh: Mesh, axis: str = SHARD_AXIS, *,
             # kv block — its global offset drives the causal mask.
             src = jax.lax.rem(idx - t - 1 + n, n)
             m, l, acc = fold(src, k_blk, v_blk, m, l, acc)
-            return (k_blk, v_blk, m, l, acc), None
-
-        (_, _, m, l, acc), _ = jax.lax.scan(
-            hop, (k, v, m, l, acc), jnp.arange(n - 1))
         return flash_finalize(l, acc, q.dtype)
 
     spec4 = P(None, None, axis, None)

@@ -34,13 +34,16 @@ _lib = None
 
 
 def _build_native() -> None:
+    # Build-on-demand runs at the first lib() call, before any server,
+    # channel, or fiber exists — there is no handler path to stall yet.
     build = os.path.join(_REPO, "native", "build")
-    subprocess.run(
+    subprocess.run(  # tpulint: allow(py-blocking)
         ["cmake", "-S", "native", "-B", build, "-G", "Ninja",
          "-DCMAKE_BUILD_TYPE=RelWithDebInfo"],
         cwd=_REPO, check=True, capture_output=True)
-    subprocess.run(["cmake", "--build", build], cwd=_REPO, check=True,
-                   capture_output=True)
+    subprocess.run(  # tpulint: allow(py-blocking)
+        ["cmake", "--build", build], cwd=_REPO, check=True,
+        capture_output=True)
 
 
 def lib() -> ctypes.CDLL:

@@ -1,0 +1,36 @@
+"""Version-compat shims for the JAX surface the runtime depends on.
+
+The data plane targets the modern `jax.shard_map` (check_vma spelling);
+older jax (< 0.5) ships it as `jax.experimental.shard_map.shard_map` with
+the `check_rep` spelling.  Everything in brpc_tpu imports shard_map from
+here so the whole stack runs on both.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams across the rename (older jax: TPUCompilerParams)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def shard_map(f=None, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        check = kwargs.pop("check_vma")
+        if "check_rep" in _PARAMS:
+            kwargs["check_rep"] = check
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: butex IS the parking primitive; its waiter-list lock is spin-class and never held across a park.
+// tpulint: allow-file(fiber-blocking)
 // Butex: a futex-like wait/wake word that both fibers and raw pthreads can
 // block on — the foundation of every blocking primitive in the framework
 // (join, mutex, condvar, RPC Join(), ExecutionQueue idle, Socket epollout).

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: fiber_usleep's pthread fallback path: callers outside any worker must use the OS sleep.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/fiber.h"
 
 #include "tbthread/sanitizer_fiber.h"

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: epoll service bootstrap lock, held only around fd registration, never across a park.
+// tpulint: allow-file(fiber-blocking)
 // fiber_fd_wait: park the calling fiber until an arbitrary fd is readable/
 // writable — the general-purpose version of the Socket-internal epoll wait
 // (reference bthread/fd.cpp bthread_fd_wait): user code doing its own IO

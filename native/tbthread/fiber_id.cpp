@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: the `small` lock is documented spin-class: never held across callbacks or parks.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/fiber_id.h"
 
 #include <errno.h>

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: key-table registry lock, O(1) critical sections at fiber-local-storage setup only.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/key.h"
 
 #include <mutex>

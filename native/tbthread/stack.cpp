@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: stack pool free-list lock, taken only at fiber birth/death on the worker's own stack.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/stack.h"
 
 #include <sys/mman.h>

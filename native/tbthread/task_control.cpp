@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: worker-group bootstrap/registry lock; taken before workers run fibers.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/task_control.h"
 
 #include <unistd.h>

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: remote-queue mutex is the documented pthread-side entry door into the scheduler.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/task_group.h"
 
 #include <pthread.h>

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: remote-queue mutex declaration (see task_group.cpp).
+// tpulint: allow-file(fiber-blocking)
 // Per-worker scheduler: local work-stealing run queue + remote (cross-thread)
 // queue + the context-switching machinery.
 //

@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: dedicated timer pthread: std::condition_variable is its own wakeup, no fiber runs here.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/timer_thread.h"
 
 #include <condition_variable>

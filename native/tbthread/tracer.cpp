@@ -1,3 +1,5 @@
+// Scheduler-internal OS primitives: sampling profiler shard locks, signal-safe spin-class sections.
+// tpulint: allow-file(fiber-blocking)
 #include "tbthread/tracer.h"
 
 #include <dlfcn.h>

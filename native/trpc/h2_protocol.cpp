@@ -5,6 +5,7 @@
 #include <mutex>
 #include <unordered_map>
 
+#include "tbthread/sync.h"
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/controller.h"
@@ -74,7 +75,7 @@ struct H2Connection {
   int64_t peer_initial_window = 65535;
 
   // Send-side flow control (guarded by write_mu).
-  std::mutex write_mu;
+  tbthread::FiberMutex write_mu;
   // TX header compression state (write_mu: insertions must hit the wire
   // in emission order or the peer's dynamic table desyncs).
   HpackEncoder hpack_tx;
@@ -251,7 +252,7 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
           msg->headers = std::move(st.headers);
           msg->body = std::move(st.body);
           {
-            std::lock_guard<std::mutex> lk(conn->write_mu);
+            std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
             auto cit = conn->stream_to_correlation.find(it->first);
             if (cit != conn->stream_to_correlation.end()) {
               msg->correlation_id = cit->second;
@@ -348,7 +349,7 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
             // tracking at all — applying it to the decoder would evict
             // entries the peer still indexes against. (ADVICE r3.)
           } else if (id == 4) {
-            std::lock_guard<std::mutex> lk(conn->write_mu);
+            std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
             const int64_t delta =
                 int64_t(value) - conn->peer_initial_window;
             conn->peer_initial_window = value;
@@ -359,7 +360,7 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
           } else if (id == 5) {
             if (value >= 16384) {
               // write_mu: flush_pending_locked reads this from done fibers.
-              std::lock_guard<std::mutex> lk(conn->write_mu);
+              std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
               conn->peer_max_frame = value;
             }
           }
@@ -384,7 +385,7 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
                              (uint32_t(uint8_t(payload[1])) << 16) |
                              (uint32_t(uint8_t(payload[2])) << 8) |
                              uint8_t(payload[3]);
-        std::lock_guard<std::mutex> lk(conn->write_mu);
+        std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
         if (stream_id == 0) {
           conn->conn_send_window += inc;
         } else {
@@ -448,7 +449,7 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
             // Server: a response will be sent on this stream. (The client
             // emplaced ITS entry at pack time; re-emplacing here after
             // flush_pending_locked erased it would leak one per RPC.)
-            std::lock_guard<std::mutex> lk(conn->write_mu);
+            std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
             conn->stream_send_window.emplace(stream_id,
                                              conn->peer_initial_window);
           }
@@ -505,7 +506,7 @@ ParseResult h2_parse(tbutil::IOBuf* source, Socket* socket) {
         // entry would wedge every later response on the connection.
         uint64_t dead_correlation = 0;
         {
-          std::lock_guard<std::mutex> lk(conn->write_mu);
+          std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
           conn->stream_send_window.erase(stream_id);
           for (auto it = conn->pending.begin(); it != conn->pending.end();) {
             if (it->stream_id == stream_id) {
@@ -569,7 +570,7 @@ int grpc_status_for_errno(int err) {
 void send_h2_error(Socket* s, H2Connection* conn, uint32_t stream_id,
                    bool grpc, int http_status, int grpc_status,
                    const std::string& message) {
-  std::lock_guard<std::mutex> lk(conn->write_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
   // Error responses bypass the Pending queue, so drop the window entry
   // here (the success path drops it in flush_pending_locked).
   conn->stream_send_window.erase(stream_id);
@@ -689,7 +690,7 @@ void h2_process_request(InputMessageBase* base) {
     if (Socket::Address(sid, &sock) == 0) {
       auto* conn = static_cast<H2Connection*>(sock->protocol_data());
       if (conn != nullptr) {
-        std::lock_guard<std::mutex> lk(conn->write_mu);
+        std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
         if (grpc) {
           HeaderList h;
           h.emplace_back(":status", "200");
@@ -798,8 +799,8 @@ void h2_pack_request(tbutil::IOBuf* out, Controller* cntl,
     // SETTINGS, and the input fiber needs protocol_data set to route them
     // to h2_parse. The preface itself is written below, by whichever
     // packer takes write_mu first, so no racer's HEADERS can precede it.
-    static std::mutex create_mu;
-    std::lock_guard<std::mutex> lk(create_mu);
+    static tbthread::FiberMutex create_mu;
+    std::lock_guard<tbthread::FiberMutex> lk(create_mu);
     conn = static_cast<H2Connection*>(socket->protocol_data());
     if (conn == nullptr) {
       auto* fresh = new H2Connection;
@@ -808,7 +809,7 @@ void h2_pack_request(tbutil::IOBuf* out, Controller* cntl,
       conn = fresh;
     }
   }
-  std::lock_guard<std::mutex> lk(conn->write_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(conn->write_mu);
   if (!conn->preface_sent) {
     std::string first_flight(kPreface, kPrefaceLen);
     put_frame_header(&first_flight, 0, kSettings, 0, 0);

@@ -5,6 +5,7 @@
 #include <cstring>
 #include <map>
 
+#include "tbthread/sync.h"
 #include "tbutil/fast_rand.h"
 #include "tbutil/md5.h"
 #include "tbutil/time.h"
@@ -128,7 +129,7 @@ class WeightedRandomLB : public ListLoadBalancer {
 class SmoothWrrLB : public ListLoadBalancer {
  protected:
   size_t Pick(const ServerList& list, const SelectIn&, size_t) override {
-    std::lock_guard<std::mutex> lk(_mu);
+    std::lock_guard<tbthread::FiberMutex> lk(_mu);
     const size_t n = list.nodes.size();
     _current.resize(n, 0);
     int64_t total = 0;
@@ -143,7 +144,7 @@ class SmoothWrrLB : public ListLoadBalancer {
   }
 
  private:
-  std::mutex _mu;
+  tbthread::FiberMutex _mu;
   std::vector<int64_t> _current;  // indexed like the server list
 };
 
@@ -294,7 +295,7 @@ class LocalityAwareLB : public ListLoadBalancer {
   void Feedback(const tbutil::EndPoint& addr, int64_t latency_us,
                 bool failed) override {
     LoadBalancer::Feedback(addr, latency_us, failed);
-    std::lock_guard<std::mutex> lk(_mu);
+    std::lock_guard<tbthread::FiberMutex> lk(_mu);
     double& ewma = _latency_ewma[tbutil::endpoint_hash(addr)];
     double sample = failed ? 1e6 : static_cast<double>(latency_us);
     ewma = ewma <= 0 ? sample : ewma * 0.9 + sample * 0.1;
@@ -302,7 +303,7 @@ class LocalityAwareLB : public ListLoadBalancer {
 
  protected:
   size_t Pick(const ServerList& list, const SelectIn&, size_t) override {
-    std::lock_guard<std::mutex> lk(_mu);
+    std::lock_guard<tbthread::FiberMutex> lk(_mu);
     double total = 0;
     _w.resize(list.nodes.size());
     for (size_t i = 0; i < list.nodes.size(); ++i) {
@@ -323,7 +324,7 @@ class LocalityAwareLB : public ListLoadBalancer {
   }
 
  private:
-  std::mutex _mu;
+  tbthread::FiberMutex _mu;
   std::map<uint64_t, double> _latency_ewma;
   std::vector<double> _w;
 };

@@ -277,6 +277,8 @@ void NamingServiceThread::Run() {
     const bool watch_live =
         _scheme == "http" && _watch_index >= 0 && failure_backoff == 1;
     for (int i = 0; i < sleep_ms / 50 && !_stop.load() && !watch_live; ++i) {
+      // Dedicated std::thread (see Start), never a fiber worker: a plain
+      // sleep here parks only this refresher. tpulint: allow(fiber-blocking)
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
     }
     if (_stop.load()) break;
@@ -309,6 +311,7 @@ void NamingServiceThread::Run() {
         if (_watch_index >= 0 && took_us < 500000) {
           const int64_t rest_ms = (500000 - took_us) / 1000;
           for (int64_t i = 0; i < rest_ms / 50 && !_stop.load(); ++i) {
+            // Same dedicated refresher thread. tpulint: allow(fiber-blocking)
             std::this_thread::sleep_for(std::chrono::milliseconds(50));
           }
         }

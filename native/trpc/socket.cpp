@@ -49,7 +49,7 @@ void set_no_delay(int fd) {
 // Live-socket registry backing the /sockets and /ids console pages
 // (reference builtin/sockets_service.cpp enumerates its SocketMap the same
 // way). Create/recycle are not hot paths; a mutexed set is fine.
-std::mutex g_live_mu;
+tbthread::FiberMutex g_live_mu;
 std::set<trpc::SocketId> g_live_sockets;
 
 struct KeepWriteArg {
@@ -109,7 +109,7 @@ int Socket::Create(const Options& opt, SocketId* id) {
   s->_connecting.store(false, std::memory_order_relaxed);
   s->_fd.store(opt.fd, std::memory_order_release);
   {
-    std::lock_guard<std::mutex> lk(g_live_mu);
+    std::lock_guard<tbthread::FiberMutex> lk(g_live_mu);
     g_live_sockets.insert(vid);
   }
   if (opt.fd >= 0) {
@@ -146,12 +146,12 @@ void Socket::SetStreamFailCallback(StreamFailCallback cb) {
 }
 
 void Socket::AddPendingStream(uint64_t stream_id) {
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   _pending_streams.push_back(stream_id);
 }
 
 void Socket::RemovePendingStream(uint64_t stream_id) {
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   for (size_t i = 0; i < _pending_streams.size(); ++i) {
     if (_pending_streams[i] == stream_id) {
       _pending_streams[i] = _pending_streams.back();
@@ -173,7 +173,7 @@ void Socket::OnFailed(int error) {
   std::vector<tbthread::fiber_id_t> ids;
   std::vector<uint64_t> streams;
   {
-    std::lock_guard<std::mutex> lk(_pending_mu);
+    std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
     ids.swap(_pending_ids);
     streams.swap(_pending_streams);
   }
@@ -188,7 +188,7 @@ void Socket::OnFailed(int error) {
 
 void Socket::OnRecycle() {
   {
-    std::lock_guard<std::mutex> lk(g_live_mu);
+    std::lock_guard<tbthread::FiberMutex> lk(g_live_mu);
     g_live_sockets.erase(id());
   }
   // SslConn's destructor sends a best-effort close_notify through the fd:
@@ -219,18 +219,18 @@ void Socket::OnRecycle() {
   // The write queue is drained by the active writer before it drops its ref,
   // so by the time the last ref dies the head is null (or was released by
   // ReleaseAllWrites on failure).
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   _pending_ids.clear();
   _pending_streams.clear();
 }
 
 void Socket::AddPendingId(tbthread::fiber_id_t id) {
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   _pending_ids.push_back(id);
 }
 
 void Socket::RemovePendingId(tbthread::fiber_id_t id) {
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   for (size_t i = 0; i < _pending_ids.size(); ++i) {
     if (_pending_ids[i] == id) {
       _pending_ids[i] = _pending_ids.back();
@@ -241,7 +241,7 @@ void Socket::RemovePendingId(tbthread::fiber_id_t id) {
 }
 
 tbthread::fiber_id_t Socket::FirstPendingId() {
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   return _pending_ids.empty() ? 0 : _pending_ids.front();
 }
 
@@ -644,13 +644,13 @@ void Socket::ReleaseAllWrites(WriteRequest* todo, WriteRequest* last,
 }
 
 void Socket::ListAll(std::vector<SocketId>* out) {
-  std::lock_guard<std::mutex> lk(g_live_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(g_live_mu);
   out->assign(g_live_sockets.begin(), g_live_sockets.end());
 }
 
 size_t Socket::PendingIdsSnapshot(std::vector<tbthread::fiber_id_t>* out,
                                   size_t cap) {
-  std::lock_guard<std::mutex> lk(_pending_mu);
+  std::lock_guard<tbthread::FiberMutex> lk(_pending_mu);
   if (out != nullptr) {
     const size_t n = std::min(cap, _pending_ids.size());
     out->assign(_pending_ids.begin(), _pending_ids.begin() + n);

@@ -251,7 +251,7 @@ class Socket : public VersionedRefWithId<Socket> {
   tbthread::FiberMutex _connect_mu;
   tbutil::IOPortal _read_buf;
 
-  std::mutex _pending_mu;
+  tbthread::FiberMutex _pending_mu;
   std::vector<tbthread::fiber_id_t> _pending_ids;
   std::vector<uint64_t> _pending_streams;
 };

@@ -1,0 +1,3 @@
+"""Python half of the wire-type mismatch fixture (LEN=2, C++ says 3)."""
+
+VARINT, FIXED64, LEN, FIXED32 = 0, 1, 2, 5

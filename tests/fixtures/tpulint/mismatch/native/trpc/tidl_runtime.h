@@ -1,0 +1,16 @@
+// wire-contract positive half: kLenDelim here disagrees with LEN in the
+// sibling tidl.py (and with the protobuf wire format).
+#pragma once
+
+namespace trpc {
+namespace tidl {
+
+enum WireType : uint32_t {
+  kVarint = 0,
+  kFixed64 = 1,
+  kLenDelim = 3,
+  kFixed32 = 5,
+};
+
+}  // namespace tidl
+}  // namespace trpc

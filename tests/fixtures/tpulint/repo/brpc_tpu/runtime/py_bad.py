@@ -1,0 +1,19 @@
+"""py-blocking positives: a sleeping handler and a blocking ctypes callback."""
+
+import ctypes
+import subprocess
+import time
+
+_CB = ctypes.CFUNCTYPE(None)
+
+
+def handler(method, request, attachment):
+    time.sleep(0.5)
+    return b"", b""
+
+
+def make_callback():
+    def trampoline():
+        subprocess.run(["true"], check=True)
+
+    return _CB(trampoline)

@@ -1,0 +1,12 @@
+"""py-blocking negatives: non-blocking handler, and an annotated
+build-time helper (runs before any fiber exists)."""
+
+import subprocess
+
+
+def handler(method, request, attachment):
+    return request, attachment
+
+
+def build_helper():
+    subprocess.run(["true"], check=True)  # tpulint: allow(py-blocking)

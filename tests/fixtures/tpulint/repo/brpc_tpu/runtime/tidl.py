@@ -1,0 +1,7 @@
+"""Matching wire-type constants (parity negative — compare tidl_runtime.h)."""
+
+VARINT, FIXED64, LEN, FIXED32 = 0, 1, 2, 5
+
+
+def zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v < 0 else v << 1

@@ -1,0 +1,21 @@
+// fiber-blocking positives: every primitive here parks the worker pthread.
+#include <mutex>
+
+namespace trpc {
+
+std::mutex g_bad_mu;
+
+void BadCriticalSection() {
+  std::lock_guard<std::mutex> lk(g_bad_mu);
+}
+
+void BadSleep() {
+  usleep(1000);
+  std::this_thread::sleep_for(std::chrono::milliseconds(5));
+}
+
+int BadRead(int fd, char* buf) {
+  return ::read(fd, buf, 128);
+}
+
+}  // namespace trpc

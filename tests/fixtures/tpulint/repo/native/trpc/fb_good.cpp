@@ -1,0 +1,17 @@
+// fiber-blocking negatives: fiber-aware primitives only.  A comment that
+// merely mentions std::mutex or usleep() must not fire either.
+#include "tbthread/sync.h"
+
+namespace trpc {
+
+tbthread::FiberMutex g_good_mu;
+
+void GoodCriticalSection() {
+  std::lock_guard<tbthread::FiberMutex> lk(g_good_mu);
+}
+
+void GoodSleep() {
+  tbthread::fiber_usleep(1000);
+}
+
+}  // namespace trpc

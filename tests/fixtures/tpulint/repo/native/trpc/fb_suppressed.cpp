@@ -1,0 +1,14 @@
+// Suppression syntax: same-line and previous-line allow() comments.
+#include <mutex>
+
+namespace trpc {
+
+std::mutex g_tool_mu;  // CLI-only tool, no fibers. tpulint: allow(fiber-blocking)
+
+void ToolOnly() {
+  // Held for a bounded registry insert on the main thread only.
+  // tpulint: allow(fiber-blocking)
+  std::lock_guard<std::mutex> lk(g_tool_mu);
+}
+
+}  // namespace trpc

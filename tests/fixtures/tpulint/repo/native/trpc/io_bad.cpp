@@ -1,0 +1,17 @@
+// iobuf-ownership positives: null deleter, and a backing-block pointer
+// that survives a yield point.
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+void NullDeleter(tbutil::IOBuf* buf, void* region, size_t len) {
+  buf->append_user_data(region, len, nullptr);
+}
+
+size_t PointerAcrossYield(tbutil::IOBuf& buf) {
+  const char* p = buf.fetch1();
+  tbthread::butex_wait(nullptr, 0, nullptr);
+  return p[0];
+}
+
+}  // namespace trpc

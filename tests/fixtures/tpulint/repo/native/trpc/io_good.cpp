@@ -1,0 +1,21 @@
+// iobuf-ownership negatives: a real deleter, and a pointer re-fetched
+// after the wait instead of carried across it.
+#include "tbutil/iobuf.h"
+
+namespace trpc {
+
+static void ReleaseRegion(void* p) { free(p); }
+
+void OwnedAppend(tbutil::IOBuf* buf, void* region, size_t len) {
+  buf->append_user_data(region, len, ReleaseRegion);
+}
+
+size_t PointerRefetched(tbutil::IOBuf& buf) {
+  const char* p = buf.fetch1();
+  size_t first = p[0];
+  tbthread::butex_wait(nullptr, 0, nullptr);
+  const char* q = buf.fetch1();
+  return first + q[0];
+}
+
+}  // namespace trpc

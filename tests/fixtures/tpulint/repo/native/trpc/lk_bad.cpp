@@ -1,0 +1,19 @@
+// lock-order positive: g_order_a -> g_order_b here, the reverse below.
+#include "tbthread/sync.h"
+
+namespace trpc {
+
+tbthread::FiberMutex g_order_a;
+tbthread::FiberMutex g_order_b;
+
+void TakeAB() {
+  std::lock_guard<tbthread::FiberMutex> la(g_order_a);
+  std::lock_guard<tbthread::FiberMutex> lb(g_order_b);
+}
+
+void TakeBA() {
+  std::lock_guard<tbthread::FiberMutex> lb(g_order_b);
+  std::lock_guard<tbthread::FiberMutex> la(g_order_a);
+}
+
+}  // namespace trpc

@@ -1,0 +1,29 @@
+// lock-order negative: consistent a-then-b everywhere; the scoped release
+// between the pairs in SequentialNotNested must not create a false edge.
+#include "tbthread/sync.h"
+
+namespace trpc {
+
+tbthread::FiberMutex g_seq_a;
+tbthread::FiberMutex g_seq_b;
+
+void ConsistentOne() {
+  std::lock_guard<tbthread::FiberMutex> la(g_seq_a);
+  std::lock_guard<tbthread::FiberMutex> lb(g_seq_b);
+}
+
+void ConsistentTwo() {
+  std::lock_guard<tbthread::FiberMutex> la(g_seq_a);
+  std::lock_guard<tbthread::FiberMutex> lb(g_seq_b);
+}
+
+void SequentialNotNested() {
+  {
+    std::lock_guard<tbthread::FiberMutex> lb(g_seq_b);
+  }
+  {
+    std::lock_guard<tbthread::FiberMutex> la(g_seq_a);
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,15 @@
+// metric-name positives: exposition-charset violation and a collision.
+#include "tbvar/tbvar.h"
+
+namespace trpc {
+
+void RegisterBadMetrics() {
+  tbvar::Adder<int64_t> hyphens;
+  hyphens.expose("rpc-server-bad-name");
+  tbvar::Adder<int64_t> first;
+  first.expose("fixture_dup_metric");
+  tbvar::Adder<int64_t> second;
+  second.expose("fixture_dup_metric");
+}
+
+}  // namespace trpc

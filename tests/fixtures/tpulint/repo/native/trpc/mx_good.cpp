@@ -1,0 +1,13 @@
+// metric-name negatives: charset-clean, unique names; dots are fine
+// because tbvar normalises them to underscores on expose.
+#include "tbvar/tbvar.h"
+
+namespace trpc {
+
+void RegisterGoodMetrics() {
+  tbvar::Adder<int64_t> a;
+  a.expose("fixture_requests_total");
+  tbvar::LatencyRecorder lat("fixture.io.latency");
+}
+
+}  // namespace trpc

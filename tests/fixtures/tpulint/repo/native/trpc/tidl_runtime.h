@@ -1,0 +1,15 @@
+// Matching wire-type constants (parity negative — compare tidl.py).
+#pragma once
+
+namespace trpc {
+namespace tidl {
+
+enum WireType : uint32_t {
+  kVarint = 0,
+  kFixed64 = 1,
+  kLenDelim = 2,
+  kFixed32 = 5,
+};
+
+}  // namespace tidl
+}  // namespace trpc

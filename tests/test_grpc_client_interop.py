@@ -13,6 +13,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 grpc = pytest.importorskip("grpc")
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _needs_native():
+    from conftest import require_native_lib
+    require_native_lib()
+
+
 @pytest.fixture(scope="module")
 def grpcio_server():
     """A real grpcio server with an identity-echo unary method."""

@@ -13,6 +13,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 grpc = pytest.importorskip("grpc")
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _needs_native():
+    from conftest import require_native_lib
+    require_native_lib()
+
+
 @pytest.fixture(scope="module")
 def echo_server():
     from brpc_tpu.runtime import native
@@ -82,6 +88,8 @@ def test_grpc_unknown_service(echo_server):
 @pytest.fixture(scope="module")
 def tls_material(tmp_path_factory):
     """Self-signed localhost cert generated on the fly."""
+    pytest.importorskip(
+        "cryptography", reason="TLS tests need the cryptography extra")
     from cryptography import x509
     from cryptography.x509.oid import NameOID
     from cryptography.hazmat.primitives import hashes, serialization

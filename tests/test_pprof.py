@@ -56,6 +56,8 @@ def _profile_descriptor_cls(name):
 
 @pytest.fixture(scope="module")
 def busy_server():
+    from conftest import require_native_lib
+    require_native_lib()
     from brpc_tpu.runtime import native
 
     server = native.Server()

@@ -19,6 +19,12 @@ from brpc_tpu.runtime.param_server import ParameterClient, ParameterServer
 from brpc_tpu.runtime.tensor import TensorArena, TensorChannel, add_tensor_service
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _needs_native():
+    from conftest import require_native_lib
+    require_native_lib()
+
+
 @pytest.fixture
 def echo_env():
     server = native.Server()

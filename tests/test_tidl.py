@@ -20,6 +20,8 @@ _TIDL_OUT = os.path.join(os.path.dirname(os.path.dirname(
 
 @pytest.fixture(scope="module")
 def echo_tidl():
+    from conftest import require_native_lib
+    require_native_lib()
     from brpc_tpu.runtime import native
     native.lib()  # builds the native tree (and codegen) on demand
     if not os.path.isdir(_TIDL_OUT):

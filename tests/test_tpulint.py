@@ -1,0 +1,218 @@
+"""tpulint: one positive and one negative per rule class, suppression
+syntax, the baseline ratchet, the reporters — and the enforcement test
+that keeps the real repo lint-clean.  Pure CPython: runs in tier-1 with no
+native build.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.tpulint import run_lint
+from tools.tpulint.baseline import load_baseline, strip_baselined, \
+    write_baseline
+from tools.tpulint.report import render_json, render_sarif, render_text
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "tpulint")
+FIXTURE_REPO = os.path.join(FIXTURES, "repo")
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return run_lint(FIXTURE_REPO)
+
+
+def _of(findings, rule, path_part):
+    return [f for f in findings
+            if f.rule == rule and path_part in f.path]
+
+
+# ---- rule class 1: fiber-blocking ----
+
+def test_fiber_blocking_positive(fixture_findings):
+    hits = _of(fixture_findings, "fiber-blocking", "fb_bad.cpp")
+    flagged = " ".join(f.message for f in hits)
+    assert "std::mutex" in flagged
+    assert "usleep" in flagged
+    assert "sleep_for" in flagged
+    assert "::read" in flagged
+    assert all(f.hint for f in hits), "every finding carries a fix hint"
+
+
+def test_fiber_blocking_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "fb_good.cpp" in f.path]
+
+
+# ---- rule class 2: lock-order ----
+
+def test_lock_order_positive(fixture_findings):
+    hits = _of(fixture_findings, "lock-order", "lk_bad.cpp")
+    assert hits, "AB/BA acquisition must be reported"
+    assert "g_order_a" in hits[0].message and "g_order_b" in hits[0].message
+
+
+def test_lock_order_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "lk_good.cpp" in f.path]
+
+
+# ---- rule class 3: iobuf-ownership ----
+
+def test_iobuf_ownership_positive(fixture_findings):
+    hits = _of(fixture_findings, "iobuf-ownership", "io_bad.cpp")
+    msgs = " | ".join(f.message for f in hits)
+    assert "null deleter" in msgs
+    assert "yield point" in msgs
+
+
+def test_iobuf_ownership_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "io_good.cpp" in f.path]
+
+
+# ---- rule class 4: wire-contract ----
+
+def test_wire_contract_tag_hygiene_positive(fixture_findings):
+    msgs = " | ".join(
+        f.message for f in _of(fixture_findings, "wire-contract",
+                               "dup_tag.tidl"))
+    assert "reuses tag 2" in msgs
+    assert "reserved" in msgs
+
+
+def test_wire_contract_lock_drift_positive(fixture_findings):
+    msgs = " | ".join(
+        f.message for f in _of(fixture_findings, "wire-contract",
+                               "drift.tidl"))
+    assert "renumbered 2 -> 7" in msgs
+    assert "retired tag 2" in msgs
+    assert "changed wire type" in msgs
+
+
+def test_wire_contract_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "clean.tidl" in f.path]
+    # matching runtime constants: no parity finding anywhere in the tree
+    assert not [f for f in fixture_findings
+                if f.rule == "wire-contract" and "tidl" in f.path
+                and "constant" in f.message]
+
+
+def test_wire_contract_runtime_mismatch_positive():
+    findings = run_lint(os.path.join(FIXTURES, "mismatch"))
+    assert any(f.rule == "wire-contract" and "LEN" in f.message
+               for f in findings)
+
+
+# ---- rule class 5: metric-name ----
+
+def test_metric_name_positive(fixture_findings):
+    msgs = " | ".join(
+        f.message for f in _of(fixture_findings, "metric-name", "mx_bad.cpp"))
+    assert "violates the exposition charset" in msgs
+    assert "collides" in msgs
+
+
+def test_metric_name_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "mx_good.cpp" in f.path]
+
+
+# ---- rule class 6: py-blocking ----
+
+def test_py_blocking_positive(fixture_findings):
+    hits = _of(fixture_findings, "py-blocking", "py_bad.py")
+    msgs = " | ".join(f.message for f in hits)
+    assert "time.sleep" in msgs
+    assert "subprocess.run" in msgs
+
+
+def test_py_blocking_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "py_good.py" in f.path]
+
+
+# ---- suppressions ----
+
+def test_suppression_same_line_and_previous_line(fixture_findings):
+    assert not [f for f in fixture_findings if "fb_suppressed.cpp" in f.path]
+
+
+def test_suppression_wrong_rule_does_not_silence(tmp_path):
+    tree = tmp_path / "native" / "trpc"
+    tree.mkdir(parents=True)
+    (tree / "wrong.cpp").write_text(
+        "std::mutex g_mu;  // tpulint: allow(metric-name)\n")
+    findings = run_lint(str(tmp_path))
+    assert [f for f in findings if f.rule == "fiber-blocking"], \
+        "an allow() naming a different rule must not suppress"
+
+
+def test_file_level_suppression(tmp_path):
+    tree = tmp_path / "native" / "trpc"
+    tree.mkdir(parents=True)
+    (tree / "whole.cpp").write_text(
+        "// tpulint: allow-file(fiber-blocking)\n"
+        "std::mutex g_a;\nstd::mutex g_b;\n")
+    assert not run_lint(str(tmp_path))
+
+
+# ---- baseline ratchet ----
+
+def test_baseline_round_trip_and_ratchet(tmp_path, fixture_findings):
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, list(fixture_findings))
+    baseline = load_baseline(baseline_path)
+    assert strip_baselined(list(fixture_findings), baseline) == []
+
+    # a NEW violation (same rule, new source line) must survive the filter
+    tree = tmp_path / "native" / "trpc"
+    tree.mkdir(parents=True)
+    (tree / "fresh.cpp").write_text("std::mutex g_fresh_mu;\n")
+    fresh = run_lint(str(tmp_path))
+    assert strip_baselined(fresh, baseline), \
+        "baseline must not absorb findings it never saw"
+
+
+def test_real_repo_is_lint_clean():
+    """THE enforcement test: annotations + the committed baseline leave
+    zero reportable findings in the actual repository."""
+    findings = run_lint(ROOT)
+    baseline = load_baseline(
+        os.path.join(ROOT, "tools", "tpulint", "baseline.json"))
+    fresh = strip_baselined(findings, baseline)
+    assert fresh == [], "\n".join(
+        f"{f.location()}: [{f.rule}] {f.message}" for f in fresh)
+
+
+# ---- reporters & CLI ----
+
+def test_reporters_shapes(fixture_findings):
+    findings = list(fixture_findings)
+    text = render_text(findings)
+    assert "[fiber-blocking]" in text and "hint:" in text
+
+    doc = json.loads(render_json(findings))
+    assert doc["tool"] == "tpulint" and doc["findings"]
+    assert {"rule", "path", "line", "message"} <= set(doc["findings"][0])
+
+    sarif = json.loads(render_sarif(findings))
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    assert len(run["results"]) == len(findings)
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"fiber-blocking", "lock-order", "iobuf-ownership",
+            "wire-contract", "metric-name", "py-blocking"} <= rule_ids
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint",
+         "--root", FIXTURE_REPO, "--no-baseline"],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+    assert dirty.returncode == 1
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--root", ROOT],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+    assert clean.returncode == 0, clean.stdout + clean.stderr

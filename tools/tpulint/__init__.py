@@ -1,0 +1,17 @@
+"""tpulint — fiber-safety / wire-contract static analysis for brpc_tpu.
+
+The invariants this framework's correctness rests on — never block a worker
+pthread from fiber context, never hand IOBuf unowned memory, keep the tidl
+wire format bit-identical between the C++ and Python runtimes, keep metric
+names exposition-safe — are invisible to the compiler. tpulint checks them
+at diff time, in plain CPython with zero dependencies, so it runs in tier-1
+CI where the asan/tsan builds (the dynamic half of the same story) cannot.
+
+Usage:  python -m tools.tpulint [paths...] [--format text|json|sarif]
+"""
+
+from tools.tpulint.core import Finding, LintContext, run_lint  # noqa: F401
+from tools.tpulint.baseline import (  # noqa: F401
+    fingerprint, load_baseline, write_baseline, strip_baselined)
+
+__version__ = "1.0"

@@ -1,0 +1,96 @@
+"""CLI: python -m tools.tpulint [paths...] [options]
+
+Exit status: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.tpulint import baseline as baseline_mod
+from tools.tpulint.core import DEFAULT_PATHS, LintContext, all_rules, \
+    collect_files, run_lint
+from tools.tpulint.report import RENDERERS
+from tools.tpulint.rules_wire import LOCK_RELPATH, snapshot_lock
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="fiber-safety / wire-contract static analysis for "
+                    "brpc_tpu (see tools/tpulint/README.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"paths to scan, relative to --root "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--root", default=None,
+                    help="lint root (default: repo root containing this "
+                         "tool, else cwd)")
+    ap.add_argument("--format", choices=sorted(RENDERERS), default="text")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file of grandfathered findings "
+                         "(default: tools/tpulint/baseline.json under "
+                         "--root if it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring any baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file and "
+                         "exit 0")
+    ap.add_argument("--write-wire-lock", action="store_true",
+                    help="snapshot .tidl schemas into "
+                         f"{LOCK_RELPATH} and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id:16s} {r.description}")
+        return 0
+
+    root = args.root
+    if root is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        cand = os.path.dirname(os.path.dirname(here))
+        root = cand if os.path.isdir(os.path.join(cand, "native")) \
+            else os.getcwd()
+
+    if args.write_wire_lock:
+        ctx = LintContext(root=root, files=collect_files(
+            root, tuple(args.paths or DEFAULT_PATHS)))
+        lock_path = os.path.join(root, LOCK_RELPATH)
+        with open(lock_path, "w", encoding="utf-8") as fh:
+            json.dump(snapshot_lock(ctx), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"tpulint: wrote {LOCK_RELPATH}")
+        return 0
+
+    findings = run_lint(root, tuple(args.paths or DEFAULT_PATHS))
+
+    default_baseline = os.path.join(root, "tools", "tpulint", "baseline.json")
+    baseline_path = args.baseline or (
+        default_baseline if os.path.exists(default_baseline) else None)
+
+    if args.write_baseline:
+        if args.paths:
+            ap.error("--write-baseline rewrites the WHOLE baseline; a "
+                     "partial scan would silently drop every grandfathered "
+                     "finding outside the given paths. Run it without path "
+                     "arguments.")
+        path = args.baseline or default_baseline
+        n = baseline_mod.write_baseline(path, findings)
+        print(f"tpulint: baselined {n} finding{'s' if n != 1 else ''} "
+              f"-> {os.path.relpath(path, root)}")
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        findings = baseline_mod.strip_baselined(
+            findings, baseline_mod.load_baseline(baseline_path))
+
+    sys.stdout.write(RENDERERS[args.format](findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Baseline: the ratchet that makes the repo lint-clean from day one.
+
+A baseline entry fingerprints a finding by (rule, path, normalised snippet,
+occurrence index) — deliberately NOT by line number, so unrelated edits
+above a grandfathered finding don't break `make lint`.  Re-introducing a
+fixed violation produces a fingerprint that is not in the baseline (new
+snippet or higher occurrence index) and fails the build; deleting a stale
+entry is always safe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from collections import Counter
+
+from tools.tpulint.core import Finding
+
+
+def _normalise(snippet: str) -> str:
+    return re.sub(r"\s+", " ", snippet).strip()
+
+
+def fingerprint(f: Finding, occurrence: int) -> str:
+    h = hashlib.sha1()
+    h.update(f.rule.encode())
+    h.update(b"\0")
+    h.update(f.path.encode())
+    h.update(b"\0")
+    h.update(_normalise(f.snippet).encode())
+    h.update(b"\0")
+    h.update(str(occurrence).encode())
+    return h.hexdigest()[:16]
+
+
+def _fingerprints(findings: list[Finding]) -> list[tuple[Finding, str]]:
+    seen: Counter = Counter()
+    out = []
+    for f in findings:  # run_lint output is location-sorted => stable order
+        key = (f.rule, f.path, _normalise(f.snippet))
+        out.append((f, fingerprint(f, seen[key])))
+        seen[key] += 1
+    return out
+
+
+def load_baseline(path: str) -> set[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return set()
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: list[Finding]) -> int:
+    entries = [{
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,          # informational; matching ignores it
+        "snippet": _normalise(f.snippet),
+        "fingerprint": fp,
+    } for f, fp in _fingerprints(findings)]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "tool": "tpulint",
+                   "findings": entries}, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return len(entries)
+
+
+def strip_baselined(findings: list[Finding],
+                    baseline: set[str]) -> list[Finding]:
+    if not baseline:
+        return findings
+    return [f for f, fp in _fingerprints(findings) if fp not in baseline]

@@ -1,0 +1,250 @@
+"""Scanner core: file model, suppression engine, rule driver.
+
+Dependency-free by design — tier-1 CI guarantees CPython and nothing else.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+# Directories scanned when the caller gives no explicit paths.  Relative to
+# the lint root (normally the repo root).
+DEFAULT_PATHS = ("native", "brpc_tpu", "examples")
+
+# Never descend into build trees or caches.
+_SKIP_DIRS = {"build", "build-asan", "build-tsan", "__pycache__", ".git"}
+
+_CPP_EXTS = {".cpp", ".cc", ".h", ".hpp"}
+_PY_EXTS = {".py"}
+_TIDL_EXTS = {".tidl"}
+
+_ALLOW_RE = re.compile(r"tpulint:\s*allow\(([^)]*)\)")
+_ALLOW_FILE_RE = re.compile(r"tpulint:\s*allow-file\(([^)]*)\)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str           # lint-root-relative, posix separators
+    line: int           # 1-based
+    message: str
+    hint: str = ""
+    snippet: str = ""   # source text of the flagged line (fingerprint input)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+class SourceFile:
+    """One scanned file: raw lines, comment-aware views, suppressions."""
+
+    def __init__(self, root: str, relpath: str):
+        self.path = relpath.replace(os.sep, "/")
+        self.abspath = os.path.join(root, relpath)
+        with open(self.abspath, "r", encoding="utf-8", errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.ext = os.path.splitext(relpath)[1]
+        self._allow: dict[int, set[str]] = {}
+        self._allow_file: set[str] = set()
+        for i, line in enumerate(self.lines, 1):
+            m = _ALLOW_FILE_RE.search(line)
+            if m:
+                self._allow_file |= _parse_rule_list(m.group(1))
+                continue
+            m = _ALLOW_RE.search(line)
+            if m:
+                self._allow.setdefault(i, set()).update(
+                    _parse_rule_list(m.group(1)))
+        self._code_lines: list[str] | None = None
+
+    @property
+    def is_cpp(self) -> bool:
+        return self.ext in _CPP_EXTS
+
+    @property
+    def is_py(self) -> bool:
+        return self.ext in _PY_EXTS
+
+    @property
+    def is_tidl(self) -> bool:
+        return self.ext in _TIDL_EXTS
+
+    def code_lines(self) -> list[str]:
+        """Lines with comments blanked out (same line numbering).
+
+        C++: // and /* */ (string-literal aware).  Python/tidl: # and //.
+        Rules match against these so commented-out code never fires.
+        """
+        if self._code_lines is None:
+            if self.is_cpp:
+                self._code_lines = strip_cpp_comments(self.text).splitlines()
+            else:
+                self._code_lines = [
+                    re.sub(r"(#|//).*", "", ln) for ln in self.lines]
+            while len(self._code_lines) < len(self.lines):
+                self._code_lines.append("")
+        return self._code_lines
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """True if `rule` is suppressed at `line` (same line or line above,
+        or a file-level allow-file anywhere in the file)."""
+        if rule in self._allow_file or "*" in self._allow_file:
+            return True
+        for ln in (line, line - 1):
+            rules = self._allow.get(ln)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+@dataclass
+class LintContext:
+    root: str
+    files: list[SourceFile] = field(default_factory=list)
+
+    def select(self, *, under: tuple[str, ...] = (), ext: set[str] | None = None,
+               exclude_under: tuple[str, ...] = ()) -> list[SourceFile]:
+        out = []
+        for f in self.files:
+            if under and not any(f.path.startswith(u) for u in under):
+                continue
+            if any(f.path.startswith(u) for u in exclude_under):
+                continue
+            if ext is not None and f.ext not in ext:
+                continue
+            out.append(f)
+        return out
+
+
+def _parse_rule_list(raw: str) -> set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+def strip_cpp_comments(text: str) -> str:
+    """Blank out // and /* */ comments, preserving newlines and columns
+    (so line/col positions in the stripped text match the original).
+    String and char literals are honoured."""
+    out = []
+    i, n = 0, len(text)
+    state = "code"  # code | line | block | str | chr
+    while i < n:
+        c = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if state == "code":
+            if c == "/" and nxt == "/":
+                state = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                state = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                state = "str"
+            elif c == "'":
+                state = "chr"
+            out.append(c)
+        elif state == "line":
+            if c == "\n":
+                state = "code"
+                out.append(c)
+            else:
+                out.append(" ")
+        elif state == "block":
+            if c == "*" and nxt == "/":
+                state = "code"
+                out.append("  ")
+                i += 2
+                continue
+            out.append(c if c == "\n" else " ")
+        elif state == "str":
+            if c == "\\" and nxt:
+                out.append(c + nxt)
+                i += 2
+                continue
+            if c == '"':
+                state = "code"
+            out.append(c)
+        elif state == "chr":
+            if c == "\\" and nxt:
+                out.append(c + nxt)
+                i += 2
+                continue
+            if c == "'":
+                state = "code"
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def collect_files(root: str, paths: tuple[str, ...] = DEFAULT_PATHS
+                  ) -> list[SourceFile]:
+    files: list[SourceFile] = []
+    seen: set[str] = set()
+    for p in paths:
+        top = os.path.join(root, p)
+        if os.path.isfile(top):
+            _maybe_add(root, p, files, seen)
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                rel = os.path.relpath(os.path.join(dirpath, name), root)
+                _maybe_add(root, rel, files, seen)
+    return files
+
+
+def _maybe_add(root: str, rel: str, files: list[SourceFile],
+               seen: set[str]) -> None:
+    ext = os.path.splitext(rel)[1]
+    if ext not in _CPP_EXTS | _PY_EXTS | _TIDL_EXTS:
+        return
+    key = rel.replace(os.sep, "/")
+    if key in seen:
+        return
+    try:
+        if os.path.getsize(os.path.join(root, rel)) > 2 * 1024 * 1024:
+            return
+    except OSError:
+        return
+    seen.add(key)
+    files.append(SourceFile(root, rel))
+
+
+def all_rules():
+    """The rule registry (imported lazily to avoid import cycles)."""
+    from tools.tpulint import rules_cpp, rules_metrics, rules_py, rules_wire
+    return (rules_cpp.RULES + rules_wire.RULES + rules_metrics.RULES
+            + rules_py.RULES)
+
+
+def run_lint(root: str, paths: tuple[str, ...] | None = None,
+             rules=None) -> list[Finding]:
+    """Scan `paths` under `root`; returns unsuppressed findings sorted by
+    location.  Baseline filtering is the caller's job (see baseline.py) —
+    this function reports everything the annotations don't silence."""
+    ctx = LintContext(root=root,
+                      files=collect_files(root, tuple(paths or DEFAULT_PATHS)))
+    by_path = {f.path: f for f in ctx.files}
+    findings: list[Finding] = []
+    for rule in (rules if rules is not None else all_rules()):
+        for f in rule.run(ctx):
+            src = by_path.get(f.path)
+            if src is not None:
+                if src.allowed(f.rule, f.line):
+                    continue
+                if not f.snippet:
+                    f.snippet = src.snippet(f.line)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,72 @@
+"""Reporters: text (humans, make lint), json (tooling), sarif (code review
+UIs — GitHub code scanning ingests SARIF 2.1.0 directly)."""
+
+from __future__ import annotations
+
+import json
+
+from tools.tpulint.core import Finding, all_rules
+
+
+def render_text(findings: list[Finding]) -> str:
+    out = []
+    for f in findings:
+        out.append(f"{f.location()}: [{f.rule}] {f.message}")
+        if f.snippet:
+            out.append(f"    | {f.snippet}")
+        if f.hint:
+            out.append(f"    hint: {f.hint}")
+    n = len(findings)
+    out.append(f"tpulint: {n} finding{'s' if n != 1 else ''}")
+    return "\n".join(out) + "\n"
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps({
+        "version": 1,
+        "tool": "tpulint",
+        "findings": [{
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "message": f.message, "hint": f.hint, "snippet": f.snippet,
+        } for f in findings],
+    }, indent=1) + "\n"
+
+
+def render_sarif(findings: list[Finding]) -> str:
+    rules_meta = [{
+        "id": r.id,
+        "shortDescription": {"text": r.description},
+    } for r in all_rules()]
+    results = [{
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message + (f"  Hint: {f.hint}" if f.hint
+                                         else "")},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line},
+            },
+        }],
+    } for f in findings]
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri": "tools/tpulint/README.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1) + "\n"
+
+
+RENDERERS = {
+    "text": render_text,
+    "json": render_json,
+    "sarif": render_sarif,
+}

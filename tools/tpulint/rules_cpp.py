@@ -1,0 +1,297 @@
+"""C++ rules: fiber-blocking primitives, lock-order cycles, IOBuf ownership.
+
+All three work on comment-stripped source (core.SourceFile.code_lines), so
+commented-out code never fires, and all honour `// tpulint: allow(<rule>)`.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+
+from tools.tpulint.core import Finding, LintContext
+
+# ---------------------------------------------------------------------------
+# fiber-blocking
+# ---------------------------------------------------------------------------
+
+# Code under these trees runs (or is called from) fiber context: a worker
+# pthread multiplexes many fibers, so parking the *thread* stalls every
+# fiber scheduled behind it (SURVEY.md §bthread).
+FIBER_CONTEXT = ("native/tbthread/", "native/trpc/")
+
+# pattern, what it is, what to use instead
+_BLOCKING = [
+    (re.compile(r"\bstd::(recursive_|timed_)?mutex\b"),
+     "std::mutex", "tbthread::FiberMutex (tbthread/sync.h) parks the fiber, "
+     "not the worker pthread"),
+    (re.compile(r"\bstd::condition_variable\b"),
+     "std::condition_variable", "tbthread::FiberCond (tbthread/sync.h)"),
+    (re.compile(r"\bpthread_(mutex_lock|mutex_timedlock|cond_wait|"
+                r"cond_timedwait|rwlock_rdlock|rwlock_wrlock)\b"),
+     "pthread blocking call", "butex_wait-based primitives in "
+     "tbthread/sync.h"),
+    (re.compile(r"\bstd::this_thread::sleep_(for|until)\b"),
+     "std::this_thread::sleep_for", "tbthread::fiber_usleep"),
+    (re.compile(r"(?<![A-Za-z0-9_:])usleep\s*\("),
+     "usleep()", "tbthread::fiber_usleep"),
+    (re.compile(r"(?<![A-Za-z0-9_:.>])nanosleep\s*\("),
+     "nanosleep()", "tbthread::fiber_usleep"),
+    (re.compile(r"(?<![A-Za-z0-9_:.>])sleep\s*\(\s*[0-9A-Za-z_]"),
+     "sleep()", "tbthread::fiber_usleep"),
+    (re.compile(r"(?<![A-Za-z0-9_])::read\s*\("),
+     "blocking ::read()", "a non-blocking fd parked on fiber_fd_wait "
+     "(tbthread/fiber.h) until EPOLLIN"),
+    (re.compile(r"(?<![A-Za-z0-9_])::write\s*\("),
+     "blocking ::write()", "a non-blocking fd parked on fiber_fd_wait "
+     "until EPOLLOUT"),
+]
+
+
+class FiberBlockingRule:
+    id = "fiber-blocking"
+    description = ("OS-blocking primitive in fiber-context code; it parks "
+                   "the worker pthread and stalls every fiber behind it")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        for src in ctx.select(under=FIBER_CONTEXT,
+                              ext={".cpp", ".cc", ".h", ".hpp"}):
+            for lineno, line in enumerate(src.code_lines(), 1):
+                for pat, what, fix in _BLOCKING:
+                    if pat.search(line):
+                        findings.append(Finding(
+                            rule=self.id, path=src.path, line=lineno,
+                            message=f"{what} in fiber-context code",
+                            hint=f"use {fix}, or justify with "
+                                 f"`// tpulint: allow({self.id})`"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+_GUARD_RE = re.compile(
+    r"\bstd::(?:lock_guard|unique_lock|scoped_lock|shared_lock)\s*<[^>]*>\s*"
+    r"\w+\s*[({]\s*([A-Za-z_][\w.>\-]*(?:\(\))?)")
+_LOCK_CALL_RE = re.compile(
+    r"([A-Za-z_][\w.>\-]*)\s*(?:\.|->)\s*(?:lock|rdlock|wrlock)\s*\(\s*\)")
+
+
+def _norm_mutex(name: str, path: str) -> str:
+    """Identity of a mutex expression.  Globals (g_*) unify across files;
+    members/locals are qualified by file so same-named members of unrelated
+    classes don't weld the graphs together."""
+    name = name.replace("this->", "").replace("()", "")
+    base = name.split("->")[-1].split(".")[-1]
+    if base.startswith("g_"):
+        return base
+    return f"{path}::{base}"
+
+
+class LockOrderRule:
+    id = "lock-order"
+    description = ("inconsistent lock acquisition order across call sites "
+                   "can deadlock (A->B here, B->A elsewhere)")
+
+    def run(self, ctx: LintContext):
+        # edge (a, b) -> first (path, line, a_raw, b_raw) that witnessed it
+        edges: dict[tuple[str, str], tuple[str, int, str, str]] = {}
+        for src in ctx.select(under=("native/",),
+                              ext={".cpp", ".cc", ".h", ".hpp"}):
+            self._collect(src, edges)
+        graph = defaultdict(set)
+        for a, b in edges:
+            graph[a].add(b)
+        findings = []
+        for a, b in sorted(edges):
+            if a == b:
+                continue
+            if (b, a) in edges and a < b:  # report each cycle pair once
+                path, line, araw, braw = edges[(a, b)]
+                opath, oline, _, _ = edges[(b, a)]
+                findings.append(Finding(
+                    rule=self.id, path=path, line=line,
+                    message=(f"lock order {araw} -> {braw} here conflicts "
+                             f"with {braw} -> {araw} at {opath}:{oline}"),
+                    hint="pick one global order for these locks (document "
+                         "it next to their declarations) or collapse them "
+                         "into one lock"))
+        # longer cycles (A->B->C->A) via DFS
+        findings.extend(self._long_cycles(edges, graph))
+        return findings
+
+    def _collect(self, src, edges) -> None:
+        depth = 0
+        held: list[tuple[str, int, str]] = []  # (identity, depth, raw)
+        for lineno, line in enumerate(src.code_lines(), 1):
+            # At brace depth 0 we are outside any body: no guard survives.
+            if depth == 0:
+                held.clear()
+            acquisitions = [m.group(1) for m in _GUARD_RE.finditer(line)]
+            acquisitions += [m.group(1) for m in _LOCK_CALL_RE.finditer(line)]
+            for raw in acquisitions:
+                ident = _norm_mutex(raw, src.path)
+                for h_ident, _, h_raw in held:
+                    if h_ident != ident:
+                        edges.setdefault((h_ident, ident),
+                                         (src.path, lineno, h_raw, raw))
+                held.append((ident, depth, raw))
+            # .unlock() releases the most recent hold of that mutex
+            for m in re.finditer(
+                    r"([A-Za-z_][\w.>\-]*)\s*(?:\.|->)\s*"
+                    r"(?:unlock|rdunlock|wrunlock)\s*\(\s*\)", line):
+                ident = _norm_mutex(m.group(1), src.path)
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i][0] == ident:
+                        held.pop(i)
+                        break
+            depth += line.count("{") - line.count("}")
+            if depth < 0:
+                depth = 0
+            # scope-based release of RAII guards
+            held[:] = [h for h in held if h[1] <= depth]
+        return None
+
+    def _long_cycles(self, edges, graph):
+        findings = []
+        reported: set[frozenset] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path_ = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start and len(path_) > 2:
+                        key = frozenset(path_)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        fpath, line, araw, braw = edges[(node, start)]
+                        findings.append(Finding(
+                            rule=self.id, path=fpath, line=line,
+                            message=("lock-order cycle: "
+                                     + " -> ".join(path_ + [start])),
+                            hint="break the cycle by ordering or merging "
+                                 "these locks"))
+                    elif nxt not in path_ and len(path_) < 6:
+                        stack.append((nxt, path_ + [nxt]))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# iobuf-ownership
+# ---------------------------------------------------------------------------
+
+_AUD_RE = re.compile(r"\bappend_user_data(_with_meta)?\s*\(")
+# Yield points: anything that can reschedule the fiber.  A raw pointer into
+# an IOBuf backing block is only stable until the buf's refcount moves.
+_YIELD_RE = re.compile(
+    r"\b(butex_wait|fiber_usleep|fiber_yield|fiber_join|fiber_id_wait\w*|"
+    r"fiber_fd_wait\w*)\b|\.\s*(wait|timed_wait)\s*\(")
+_BLOCK_PTR_RE = re.compile(
+    r"\b(?:const\s+)?(?:char|uint8_t|void)\s*\*\s*(\w+)\s*=\s*"
+    r"[\w.>\-]*(?:\.|->)(?:fetch1|block|backing)\s*\(")
+
+
+def _split_args(text: str, start: int) -> list[str] | None:
+    """Top-level argument split of the parenthesised list starting at
+    text[start] == '('; returns None if unbalanced (multi-line call tail)."""
+    depth = 0
+    args, cur = [], []
+    for ch in text[start:]:
+        if ch in "([{":
+            depth += 1
+            if depth == 1:
+                continue
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                args.append("".join(cur).strip())
+                return [a for a in args if a != ""]
+        elif ch == "," and depth == 1:
+            args.append("".join(cur).strip())
+            cur = []
+            continue
+        if depth >= 1:
+            cur.append(ch)
+    return None
+
+
+class IOBufOwnershipRule:
+    id = "iobuf-ownership"
+    description = ("IOBuf given memory it cannot own (missing/null deleter) "
+                   "or a backing-block pointer held across a yield point")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        for src in ctx.select(under=("native/",),
+                              ext={".cpp", ".cc", ".h", ".hpp"}):
+            code = "\n".join(src.code_lines())
+            findings.extend(self._check_deleters(src, code))
+            findings.extend(self._check_yield_span(src))
+        return findings
+
+    def _check_deleters(self, src, code):
+        out = []
+        for m in _AUD_RE.finditer(code):
+            with_meta = bool(m.group(1))
+            args = _split_args(code, m.end() - 1)
+            if args is None:
+                continue  # call spans lines in a way we can't parse; skip
+            lineno = code.count("\n", 0, m.start()) + 1
+            need = 4 if with_meta else 3
+            name = "append_user_data_with_meta" if with_meta \
+                else "append_user_data"
+            deleter = args[2] if len(args) > 2 else None
+            if len(args) < need:
+                out.append(Finding(
+                    rule=self.id, path=src.path, line=lineno,
+                    message=f"{name} called without a deleter: the IOBuf "
+                            "cannot release this memory",
+                    hint="pass a deleter that frees/unpins the region when "
+                         "the last IOBuf ref drops"))
+            elif deleter in ("nullptr", "NULL", "0"):
+                out.append(Finding(
+                    rule=self.id, path=src.path, line=lineno,
+                    message=f"{name} with a null deleter: the block will "
+                            "leak or dangle once the IOBuf outlives the "
+                            "caller",
+                    hint="pass a real deleter (it may be a no-op lambda "
+                         "ONLY if the region provably outlives every ref; "
+                         "then say so in a tpulint: allow comment)"))
+        return out
+
+    def _check_yield_span(self, src):
+        out = []
+        lines = src.code_lines()
+        # pointers into IOBuf blocks live as (name, born_line)
+        live: list[tuple[str, int]] = []
+        depth = 0
+        for lineno, line in enumerate(lines, 1):
+            if depth == 0:
+                live = []
+            m = _BLOCK_PTR_RE.search(line)
+            yielded = _YIELD_RE.search(line)
+            if yielded and live:
+                live = [(n, -abs(b)) for n, b in live]  # mark crossed
+            if m:
+                live.append((m.group(1), lineno))
+            for name, born in list(live):
+                if born < 0 and re.search(rf"\b{re.escape(name)}\b", line) \
+                        and not _BLOCK_PTR_RE.search(line):
+                    out.append(Finding(
+                        rule=self.id, path=src.path, line=lineno,
+                        message=f"IOBuf backing-block pointer `{name}` used "
+                                "after a yield point; the block may have "
+                                "been recycled while the fiber was parked",
+                        hint="re-fetch the pointer after the wait, or copy "
+                             "the bytes out before yielding"))
+                    live.remove((name, born))
+            depth += line.count("{") - line.count("}")
+            if depth < 0:
+                depth = 0
+        return out
+
+
+RULES = [FiberBlockingRule(), LockOrderRule(), IOBufOwnershipRule()]

@@ -1,0 +1,78 @@
+"""metric-name: tbvar / Prometheus exposition hygiene.
+
+Two checks under one rule id:
+  * charset — an exposed name must render in the Prometheus exposition
+    format after tbvar's dot->underscore normalisation, i.e. match
+    [a-zA-Z_:.][a-zA-Z0-9_:.]* (dots allowed in source, normalised on
+    expose); anything else silently vanishes from /metrics scrapes;
+  * collision — two distinct expose sites registering the same final name:
+    the second expose() fails at runtime and its series is never emitted
+    (tbvar returns -1, reference bvar does the same), which reads as "the
+    metric flatlined" in dashboards.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+
+from tools.tpulint.core import Finding, LintContext
+
+# expose("name") / expose(prefix + "_suffix") — only literal-only names are
+# checked; computed prefixes are runtime-determined and out of scope.
+_EXPOSE_RE = re.compile(r"\.\s*expose\s*\(\s*\"([^\"]+)\"\s*\)")
+_CTOR_RE = re.compile(
+    r"\b(?:LatencyRecorder|PassiveStatus\s*<[^;{]*?>|Adder\s*<[^;{]*?>|"
+    r"Maxer\s*<[^;{]*?>|Miner\s*<[^;{]*?>|IntRecorder|"
+    r"MultiDimension\s*<[^;{]*?>)\s*"
+    r"[A-Za-z_]\w*\s*[({]\s*\"([^\"]+)\"")
+
+_VALID = re.compile(r"^[a-zA-Z_:.][a-zA-Z0-9_:.]*$")
+
+
+def _normalise(name: str) -> str:
+    return name.replace(".", "_")
+
+
+class MetricNameRule:
+    id = "metric-name"
+    description = ("tbvar metric name that breaks the Prometheus exposition "
+                   "charset or collides with another expose site")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        sites: dict[str, list[tuple[str, int, str]]] = defaultdict(list)
+        for src in ctx.select(under=("native/",),
+                              exclude_under=("native/test/",),
+                              ext={".cpp", ".cc", ".h", ".hpp"}):
+            for lineno, line in enumerate(src.code_lines(), 1):
+                for pat in (_EXPOSE_RE, _CTOR_RE):
+                    for m in pat.finditer(line):
+                        name = m.group(1)
+                        if not _VALID.match(name):
+                            findings.append(Finding(
+                                rule=self.id, path=src.path, line=lineno,
+                                message=f"metric name \"{name}\" violates "
+                                        "the exposition charset "
+                                        "[a-zA-Z_:.][a-zA-Z0-9_:.]*",
+                                hint="Prometheus drops series whose names "
+                                     "don't scan; rename using only "
+                                     "letters, digits, '_' and ':'"))
+                        else:
+                            sites[_normalise(name)].append(
+                                (src.path, lineno, name))
+        for norm, where in sorted(sites.items()):
+            if len(where) > 1:
+                first = where[0]
+                for path, lineno, name in where[1:]:
+                    findings.append(Finding(
+                        rule=self.id, path=path, line=lineno,
+                        message=f"metric \"{name}\" collides with the "
+                                f"expose at {first[0]}:{first[1]} "
+                                f"(both normalise to \"{norm}\")",
+                        hint="the second expose() fails and the series "
+                             "flatlines; prefix with the subsystem name"))
+        return findings
+
+
+RULES = [MetricNameRule()]

@@ -1,0 +1,111 @@
+"""py-blocking: OS-blocking calls in the Python half of the runtime.
+
+brpc_tpu/runtime/ is handler territory: service handlers and ctypes
+trampolines run INSIDE native fibers (native.py re-acquires the GIL from a
+fiber-hosted callback).  time.sleep / subprocess there parks a fiber worker
+pthread exactly like std::mutex does on the C++ side — and because the GIL
+is held, it can stall every other Python handler too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpulint.core import Finding, LintContext
+
+HANDLER_TREES = ("brpc_tpu/runtime/",)
+
+# (module, attr) call patterns that park the calling thread
+_BLOCKING_ATTRS = {
+    ("time", "sleep"): "time.sleep",
+    ("subprocess", "run"): "subprocess.run",
+    ("subprocess", "call"): "subprocess.call",
+    ("subprocess", "check_call"): "subprocess.check_call",
+    ("subprocess", "check_output"): "subprocess.check_output",
+    ("subprocess", "Popen"): "subprocess.Popen",
+    ("os", "system"): "os.system",
+    ("os", "wait"): "os.wait",
+    ("os", "waitpid"): "os.waitpid",
+}
+
+
+def _call_name(node: ast.Call):
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return (fn.value.id, fn.attr)
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, src, rule_id):
+        self.src = src
+        self.rule_id = rule_id
+        self.findings: list[Finding] = []
+        self.func_stack: list[str] = []
+        self.cfunctype_wrapped: set[str] = set()
+
+    # record functions handed to ctypes CFUNCTYPE factories so the message
+    # can say "ctypes callback" (the most dangerous flavour: native caller,
+    # no event loop above it to notice the stall)
+    def scan_cfunctype(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                callee = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else "")
+                if "CFUNCTYPE" in callee or callee.startswith("_HANDLER"):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            self.cfunctype_wrapped.add(arg.id)
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        name = _call_name(node)
+        pretty = _BLOCKING_ATTRS.get(name) if name else None
+        if pretty and self.func_stack:
+            where = self.func_stack[-1]
+            in_cb = any(f in self.cfunctype_wrapped for f in self.func_stack)
+            ctx = ("ctypes callback" if in_cb else
+                   "nested callback" if len(self.func_stack) > 1 else
+                   "runtime function")
+            self.findings.append(Finding(
+                rule=self.rule_id, path=self.src.path, line=node.lineno,
+                message=f"{pretty} inside {ctx} `{where}` on the RPC "
+                        "handler path; it parks the fiber worker (and the "
+                        "GIL) for every other handler",
+                hint="move the blocking work off the handler path (native "
+                     "timer / executor), or justify with "
+                     "`# tpulint: allow(py-blocking)`"))
+        self.generic_visit(node)
+
+
+class PyBlockingRule:
+    id = "py-blocking"
+    description = ("blocking call (time.sleep, subprocess, os.system) in "
+                   "brpc_tpu/runtime handler-path code")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        for src in ctx.select(under=HANDLER_TREES, ext={".py"}):
+            try:
+                tree = ast.parse(src.text, filename=src.path)
+            except SyntaxError as e:
+                findings.append(Finding(
+                    rule=self.id, path=src.path, line=e.lineno or 1,
+                    message=f"unparseable Python: {e.msg}",
+                    hint="fix the syntax error"))
+                continue
+            v = _Visitor(src, self.id)
+            v.scan_cfunctype(tree)
+            v.visit(tree)
+            findings.extend(v.findings)
+        return findings
+
+
+RULES = [PyBlockingRule()]

@@ -1,0 +1,236 @@
+"""wire-contract: the tidl schema and both runtimes must agree, forever.
+
+Three checks under one rule id:
+  * duplicate / out-of-range field tags inside a .tidl message;
+  * drift against the committed wire lock (tools/tpulint/wire_contract.lock):
+    renumbering a field or reusing a retired tag silently corrupts every
+    peer still speaking the old schema;
+  * wire-type constant parity between native/trpc/tidl_runtime.h and
+    brpc_tpu/runtime/tidl.py — the two encoders must emit identical tags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from tools.tpulint.core import Finding, LintContext
+
+LOCK_RELPATH = "tools/tpulint/wire_contract.lock"
+
+# tidl scalar type -> protobuf wire type name
+TYPE_TO_WIRE = {
+    "int32": "varint", "int64": "varint", "uint32": "varint",
+    "uint64": "varint", "sint32": "varint", "sint64": "varint",
+    "bool": "varint", "enum": "varint",
+    "fixed64": "fixed64", "sfixed64": "fixed64", "double": "fixed64",
+    "fixed32": "fixed32", "sfixed32": "fixed32", "float": "fixed32",
+    "string": "len", "bytes": "len",
+}
+
+_MSG_RE = re.compile(r"^\s*message\s+(\w+)\s*\{")
+_FIELD_RE = re.compile(
+    r"^\s*(repeated\s+)?(\w+)\s+(\w+)\s*=\s*(\d+)\s*;")
+
+# C++ enum:  kVarint = 0,
+_CPP_WT_RE = re.compile(r"\bk(Varint|Fixed64|LenDelim|Fixed32)\s*=\s*(\d+)")
+# Python:    VARINT, FIXED64, LEN, FIXED32 = 0, 1, 2, 5
+_PY_WT_TUPLE_RE = re.compile(
+    r"^(?P<names>[A-Z][A-Z0-9_]*(?:\s*,\s*[A-Z][A-Z0-9_]*)+)\s*=\s*"
+    r"(?P<vals>\d+(?:\s*,\s*\d+)+)\s*$", re.M)
+_PY_WT_SINGLE_RE = re.compile(
+    r"^(VARINT|FIXED64|LEN|FIXED32)\s*=\s*(\d+)\s*$", re.M)
+
+_CANON = {"Varint": "VARINT", "Fixed64": "FIXED64", "LenDelim": "LEN",
+          "Fixed32": "FIXED32"}
+# The protobuf wire format pins these values; anything else is not protobuf.
+_EXPECTED = {"VARINT": 0, "FIXED64": 1, "LEN": 2, "FIXED32": 5}
+
+
+def parse_tidl(src) -> dict[str, dict[str, tuple[int, str, int]]]:
+    """{message: {field_name: (tag, wire_type, lineno)}}"""
+    messages: dict[str, dict[str, tuple[int, str, int]]] = {}
+    current = None
+    for lineno, line in enumerate(src.code_lines(), 1):
+        m = _MSG_RE.match(line)
+        if m:
+            current = messages.setdefault(m.group(1), {})
+            continue
+        if re.match(r"^\s*\}", line):
+            current = None
+            continue
+        if current is None:
+            continue
+        m = _FIELD_RE.match(line)
+        if m:
+            _, ftype, fname, tag = m.groups()
+            wire = TYPE_TO_WIRE.get(ftype, "len")  # message-typed: len
+            current[fname] = (int(tag), wire, lineno)
+    return messages
+
+
+class WireContractRule:
+    id = "wire-contract"
+    description = ("tidl schema tag abuse, drift against the committed wire "
+                   "lock, or C++/Python wire-type constant mismatch")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        lock = self._load_lock(ctx.root)
+        for src in ctx.select(ext={".tidl"}):
+            schema = parse_tidl(src)
+            findings.extend(self._check_tags(src, schema))
+            if lock is not None:
+                findings.extend(
+                    self._check_lock(src, schema, lock.get(src.path, {})))
+        findings.extend(self._check_runtime_parity(ctx))
+        return findings
+
+    # -- in-schema tag hygiene ---------------------------------------------
+    def _check_tags(self, src, schema):
+        out = []
+        for msg, fields in schema.items():
+            by_tag: dict[int, str] = {}
+            for fname, (tag, _wire, lineno) in fields.items():
+                if not 1 <= tag < (1 << 29) or 19000 <= tag <= 19999:
+                    out.append(Finding(
+                        rule=self.id, path=src.path, line=lineno,
+                        message=f"{msg}.{fname} uses invalid/reserved field "
+                                f"tag {tag}",
+                        hint="tags must be in [1, 2^29) and outside the "
+                             "protobuf-reserved 19000-19999 range"))
+                if tag in by_tag:
+                    out.append(Finding(
+                        rule=self.id, path=src.path, line=lineno,
+                        message=f"{msg}.{fname} reuses tag {tag} already "
+                                f"held by {msg}.{by_tag[tag]}",
+                        hint="every field in a message needs a unique tag; "
+                             "retire tags, never recycle them"))
+                else:
+                    by_tag[tag] = fname
+        return out
+
+    # -- drift against the committed lock ----------------------------------
+    def _check_lock(self, src, schema, locked):
+        out = []
+        for msg, fields in schema.items():
+            lmsg = locked.get(msg)
+            if lmsg is None:
+                continue  # new message: fine
+            ltag_to_name = {int(t): n for n, (t, _w) in lmsg.items()}
+            for fname, (tag, wire, lineno) in fields.items():
+                if fname in lmsg:
+                    ltag, lwire = int(lmsg[fname][0]), lmsg[fname][1]
+                    if tag != ltag:
+                        out.append(Finding(
+                            rule=self.id, path=src.path, line=lineno,
+                            message=f"{msg}.{fname} renumbered {ltag} -> "
+                                    f"{tag}; old peers will misparse it",
+                            hint="keep the tag; add a NEW field for new "
+                                 "semantics (then refresh the wire lock)"))
+                    elif wire != lwire:
+                        out.append(Finding(
+                            rule=self.id, path=src.path, line=lineno,
+                            message=f"{msg}.{fname} changed wire type "
+                                    f"{lwire} -> {wire} under tag {tag}",
+                            hint="a tag's wire type is frozen; use a new "
+                                 "tag for the new representation"))
+                elif tag in ltag_to_name:
+                    out.append(Finding(
+                        rule=self.id, path=src.path, line=lineno,
+                        message=f"{msg}.{fname} reuses retired tag {tag} "
+                                f"(was {msg}.{ltag_to_name[tag]})",
+                        hint="old encoders still emit that tag with the old "
+                             "meaning; pick a fresh tag"))
+        return out
+
+    # -- C++ / Python runtime constant parity ------------------------------
+    def _check_runtime_parity(self, ctx):
+        cpp = py = None
+        cpp_src = py_src = None
+        for src in ctx.files:
+            if src.path.endswith("tidl_runtime.h"):
+                found = dict(_CPP_WT_RE.findall(src.text))
+                if found:
+                    cpp = {_CANON[k]: int(v) for k, v in found.items()}
+                    cpp_src = src
+            elif src.path.endswith("runtime/tidl.py"):
+                py = self._parse_py_constants(src)
+                py_src = src
+        out = []
+        if cpp is None or py is None:
+            return out  # one side absent: nothing to compare
+        for name in ("VARINT", "FIXED64", "LEN", "FIXED32"):
+            cv, pv = cpp.get(name), py.get(name)
+            if cv is None or pv is None:
+                continue
+            if cv != pv:
+                line = self._find_const_line(py_src, name)
+                out.append(Finding(
+                    rule=self.id, path=py_src.path, line=line,
+                    message=f"wire-type constant {name} is {pv} in Python "
+                            f"but {cv} in {cpp_src.path}; the two encoders "
+                            "emit incompatible tags",
+                    hint="the protobuf wire format fixes VARINT=0 FIXED64=1 "
+                         "LEN=2 FIXED32=5; restore the matching value"))
+            elif cv != _EXPECTED[name]:
+                out.append(Finding(
+                    rule=self.id, path=cpp_src.path,
+                    line=self._find_cpp_const_line(cpp_src, name),
+                    message=f"wire-type constant {name}={cv} diverges from "
+                            f"the protobuf wire format ({_EXPECTED[name]})",
+                    hint="tidl messages must stay binary-compatible with "
+                         "same-schema protobuf peers"))
+        return out
+
+    @staticmethod
+    def _parse_py_constants(src):
+        consts: dict[str, int] = {}
+        m = _PY_WT_TUPLE_RE.search(src.text)
+        if m:
+            names = [n.strip() for n in m.group("names").split(",")]
+            vals = [int(v) for v in m.group("vals").split(",")]
+            if len(names) == len(vals):
+                consts.update(zip(names, vals))
+        for name, val in _PY_WT_SINGLE_RE.findall(src.text):
+            consts[name] = int(val)
+        return consts
+
+    @staticmethod
+    def _find_const_line(src, name):
+        for i, line in enumerate(src.lines, 1):
+            if re.search(rf"\b{name}\b", line) and "=" in line:
+                return i
+        return 1
+
+    @staticmethod
+    def _find_cpp_const_line(src, name):
+        cpp_name = {v: k for k, v in _CANON.items()}[name]
+        for i, line in enumerate(src.lines, 1):
+            if f"k{cpp_name}" in line:
+                return i
+        return 1
+
+    def _load_lock(self, root):
+        path = os.path.join(root, LOCK_RELPATH)
+        if not os.path.exists(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+
+def snapshot_lock(ctx: LintContext) -> dict:
+    """Current schema state in wire_contract.lock shape (used by
+    --write-wire-lock and the fixture generator)."""
+    # Keyed by lint-root-relative path: same-named .tidl files in
+    # different directories must not merge or cross-compare.
+    lock: dict = {}
+    for src in ctx.select(ext={".tidl"}):
+        entry = lock.setdefault(src.path, {})
+        for msg, fields in parse_tidl(src).items():
+            entry[msg] = {n: [t, w] for n, (t, w, _ln) in fields.items()}
+    return lock
+
+
+RULES = [WireContractRule()]
