@@ -94,6 +94,17 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# tensor bridge point skipped: {e}", file=sys.stderr)
 
+    # Framework-recorder snapshots: the SAME LatencyRecorders the server
+    # console serves at /vars and /brpc_metrics, read after the sweeps —
+    # cross-checking the wall-clock numbers above against what the
+    # framework measured about itself (drift between the two is a finding,
+    # not noise). rpc_client covers every echo call the C bench loops made
+    # in this process; tensor_push/tensor_pull cover the tensor rows.
+    try:
+        sweep["framework_recorders"] = recorder_snapshot()
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# recorder snapshot skipped: {e}", file=sys.stderr)
+
     # Device-compute point: ring attention (brpc_tpu/ops/ring_attention)
     # on whatever accelerator JAX sees — on the real chip this exercises
     # the MXU at bf16; on the 1-device mesh the ring degenerates to flash
@@ -123,6 +134,45 @@ def main() -> None:
         "tcp_vs_baseline": round(tcp / BASELINE_GBPS, 3),
         "sweep": sweep,
     }))
+
+
+def recorder_snapshot():
+    """Framework-recorder rows for the BENCH json.
+
+    rpc_client_* come from the native GlobalRpcMetrics LatencyRecorder
+    (every client call in this process feeds it — including the C bench
+    loops); tensor_push/tensor_pull are the Python data-plane recorders
+    brpc_tpu/runtime/tensor.py records into. All values are microseconds
+    from the recorders' trailing window, NOT a re-measurement.
+    """
+    from brpc_tpu.observability import metrics as obs
+
+    out = {}
+    # Native client-side recorder: read through the exposed-vars registry
+    # (the handle lives in C); same numbers /vars serves.
+    rpc_client = {}
+    for line in obs.dump_vars("rpc_client").splitlines():
+        name, _, value = line.partition(" : ")
+        rpc_client[name.strip()] = value.strip()
+    if rpc_client.get("rpc_client_count", "0") != "0":
+        out["rpc_client"] = {
+            "count": int(rpc_client["rpc_client_count"]),
+            "avg_us": int(rpc_client["rpc_client_latency"]),
+            "p50_us": int(rpc_client["rpc_client_latency_50"]),
+            "p99_us": int(rpc_client["rpc_client_latency_99"]),
+            "max_us": int(rpc_client["rpc_client_max_latency"]),
+        }
+    # Python data-plane recorders (zeros mean the tensor rows were skipped).
+    for key in ("tensor_push", "tensor_pull"):
+        rec = obs.latency(key)
+        if rec.count() > 0:
+            out[key] = rec.snapshot()
+    for name, label in (("tensor_push_bytes", "push_bytes"),
+                        ("tensor_pull_bytes", "pull_bytes"),
+                        ("tensor_arena_wait_stalls", "arena_wait_stalls")):
+        out[label] = obs.counter(name).value()
+    print(f"# framework recorders: {json.dumps(out)}", file=sys.stderr)
+    return out
 
 
 def tensor_bridge_point():

@@ -1,0 +1,28 @@
+"""Cross-language observability: one /vars + /brpc_metrics + /rpcz view
+covering the native fiber runtime AND the Python/JAX tensor path.
+
+  metrics — Python-registered native tbvars (Counter / LatencyRecorder /
+            PassiveGauge) and dump helpers (/vars, Prometheus).
+  tracing — rpcz from Python: trace_span() spans, stage() annotations,
+            trace-context access, span dumps.
+
+Importing this package touches nothing native; the native library loads
+on first use (same lazy discipline as brpc_tpu.runtime.native).
+"""
+
+from brpc_tpu.observability import metrics, tracing
+from brpc_tpu.observability.metrics import (Counter, LatencyRecorder,
+                                            PassiveGauge, counter,
+                                            dump_prometheus, dump_vars,
+                                            gauge, latency)
+from brpc_tpu.observability.tracing import (annotate, current_trace,
+                                            dump_rpcz, rpcz_enable,
+                                            rpcz_enabled, stage, trace_span)
+
+__all__ = [
+    "metrics", "tracing",
+    "Counter", "LatencyRecorder", "PassiveGauge",
+    "counter", "latency", "gauge", "dump_vars", "dump_prometheus",
+    "annotate", "current_trace", "dump_rpcz", "rpcz_enable", "rpcz_enabled",
+    "stage", "trace_span",
+]

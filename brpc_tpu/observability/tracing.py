@@ -1,0 +1,155 @@
+"""rpcz tracing from Python — the ctypes boundary stops being a trace hole.
+
+The native stack already propagates {trace_id, span_id} through a
+fiber-local slot and the tstd wire (native/trpc/span.h): a traced server
+handler carries the server span while it runs, and any Channel call
+issued from it parents there automatically — INCLUDING calls a Python
+handler makes through tbrpc_call: handlers run on the capi's dedicated
+callback pthreads (never on a fiber — ctypes' GIL pairing must stay on
+one OS thread), and the pool hands the server span into the callback
+thread's context before invoking the handler. On a plain Python thread
+the context rides a thread-local slot, so a client-side `trace_span()`
+makes the calls it issues parent to a Python root span.
+
+What this module adds on top of the native machinery:
+  * trace_span(name): a real Python-created span — times the body, links
+    into the surrounding context (or starts a fresh trace), and records at
+    /rpcz next to the native legs;
+  * stage(name) / annotate(text): stage timings ("device_put=812us")
+    attached to whatever span is ACTIVE — a server handler annotates its
+    server span, a trace_span() body annotates itself;
+  * rpcz control and span dumps without HTTP round-trips.
+
+Everything no-ops cheaply while rpcz is off (the rpcz_enabled flag,
+flippable live at /flags/rpcz_enabled?setvalue=1 or rpcz_enable()).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import json
+import time
+from typing import Iterator, List, Optional, Tuple
+
+from brpc_tpu.runtime import native
+
+
+def rpcz_enable(on: bool = True) -> None:
+    native.lib().tbrpc_rpcz_set_enabled(1 if on else 0)
+
+
+def rpcz_enabled() -> bool:
+    return native.lib().tbrpc_rpcz_enabled() != 0
+
+
+def current_trace() -> Tuple[int, int]:
+    """The active (trace_id, span_id) on this thread/fiber; (0, 0) = none."""
+    t = ctypes.c_uint64()
+    s = ctypes.c_uint64()
+    native.lib().tbrpc_trace_current(ctypes.byref(t), ctypes.byref(s))
+    return t.value, s.value
+
+
+def set_trace(trace_id: int, span_id: int) -> None:
+    native.lib().tbrpc_trace_set(trace_id, span_id)
+
+
+def clear_trace() -> None:
+    native.lib().tbrpc_trace_clear()
+
+
+def new_id() -> int:
+    return native.lib().tbrpc_trace_new_id()
+
+
+def annotate(text: str) -> None:
+    """Attach free-form text to the active span (no-op without one)."""
+    native.lib().tbrpc_span_annotate(text.encode("utf-8", errors="replace"))
+
+
+@contextlib.contextmanager
+def stage(name: str) -> Iterator[None]:
+    """Time the body and attach "name=<us>us" to the ACTIVE span — the
+    per-stage breakdown (rpc / arena-map / device_put / fused-update) the
+    tensor path reports."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        us = int((time.monotonic() - t0) * 1e6)
+        annotate(f"{name}={us}us")
+
+
+class SpanHandle:
+    """The identifiers of an open trace_span (query /rpcz?trace=%016x)."""
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.error_code = 0
+
+    def set_error(self, code: int) -> None:
+        self.error_code = code
+
+    @property
+    def trace_hex(self) -> str:
+        return f"{self.trace_id:016x}"
+
+
+@contextlib.contextmanager
+def trace_span(name: str, *, server_side: bool = False
+               ) -> Iterator[SpanHandle]:
+    """A Python-created rpcz span around the body.
+
+    Links into the surrounding trace context when one is active (nested
+    spans, server handlers) or starts a fresh trace (a client root); the
+    body runs with THIS span as the context, so downstream tbrpc calls —
+    and nested trace_spans — parent here. Recorded via tbrpc_span_emit on
+    exit; while rpcz is off the body runs untraced at ~zero cost.
+    """
+    L = native.lib()
+    if not rpcz_enabled():
+        yield SpanHandle(0, 0)
+        return
+    parent_trace, parent_span = current_trace()
+    trace_id = parent_trace if parent_trace != 0 else new_id()
+    span_id = new_id()
+    handle = SpanHandle(trace_id, span_id)
+    set_trace(trace_id, span_id)
+    start_us = L.tbrpc_now_us()
+    try:
+        yield handle
+    except BaseException:
+        handle.error_code = handle.error_code or 2004
+        raise
+    finally:
+        end_us = L.tbrpc_now_us()
+        # Restore the surrounding context (or clear a root's).
+        if parent_trace != 0 or parent_span != 0:
+            set_trace(parent_trace, parent_span)
+        else:
+            clear_trace()
+        L.tbrpc_span_emit(trace_id, span_id, parent_span,
+                          1 if server_side else 0, start_us, end_us,
+                          handle.error_code, name.encode())
+
+
+def dump_rpcz(trace_id: int = 0) -> List[dict]:
+    """Collected spans as dicts (annotations included): every span field
+    the /rpcz page renders, without the HTTP round-trip. trace_id != 0
+    narrows to one trace, oldest first."""
+    from brpc_tpu.observability.metrics import _snapshot_buf
+
+    L = native.lib()
+    raw = _snapshot_buf(L.tbrpc_rpcz_dump_json, trace_id)
+    return json.loads(raw.decode(errors="replace")) if raw else []
+
+
+def find_trace(service_method: str) -> Optional[str]:
+    """The trace_id (hex) of the most recent span for `service_method`;
+    None if not collected. Convenience for tests and tooling."""
+    for span in dump_rpcz():
+        if span["service_method"] == service_method:
+            return span["trace_id"]
+    return None

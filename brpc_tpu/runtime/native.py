@@ -2,8 +2,14 @@
 
 The host RPC fabric (fiber scheduler, wait-free sockets, tstd protocol) is
 C++; this module is the Python doorway: Server/Channel objects, Python
-service handlers (run inside fibers; ctypes re-acquires the GIL), and the
-bench harness entry points whose hot loops stay in C.
+service handlers, and the bench harness entry points whose hot loops stay
+in C. Handlers run on a small DEDICATED PTHREAD POOL on the native side
+(capi PyCallbackPool, python_callback_threads flag), never on a fiber:
+ctypes pairs PyGILState_Ensure/Release on one OS thread, and a fiber that
+parks mid-handler (e.g. a nested RPC) could resume on a different worker.
+The service fiber parks until the handler returns, and the handler's
+thread carries the server's rpcz trace context, so downstream calls made
+inside a handler link into the caller's trace.
 
 Reference parity note: the reference's python/ tree is an empty "TBD" stub —
 bindings here are first-class because the TPU data plane (JAX) is Python.
@@ -11,9 +17,11 @@ bindings here are first-class because the TPU data plane (JAX) is Python.
 
 from __future__ import annotations
 
+import atexit
 import ctypes
 import os
 import subprocess
+import weakref
 from typing import Callable, Optional, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -28,9 +36,47 @@ _HANDLER_CB = ctypes.CFUNCTYPE(
     ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),  # resp
     ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),  # resp_attach
     ctypes.POINTER(ctypes.c_int),       # error_code
+    ctypes.c_void_p, ctypes.c_size_t,   # err_text buffer (C-owned)
 )
 
+
+def fill_err_text(err_text: int, err_text_cap: int, message: str) -> None:
+    """Copy a handler failure message into the C-owned err_text buffer
+    (NUL-terminated, truncated to cap-1) — it rides the wire back to the
+    client's RpcError.text."""
+    if not err_text or err_text_cap <= 1 or not message:
+        return
+    data = message.encode("utf-8", errors="replace")[:err_text_cap - 1]
+    ctypes.memmove(err_text, data, len(data))
+    ctypes.memset(err_text + len(data), 0, 1)
+
+# PassiveStatus gauge callback: ctx -> current int64 value. Evaluated at
+# scrape time under the native registry lock — keep the Python body trivial
+# (no dump_vars/metric creation re-entry).
+_GAUGE_CB = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p)
+
 _lib = None
+
+# Native handles torn down during interpreter FINALIZATION (module-dict
+# clearing order) abort in glibc — a client channel to a live in-process
+# server destroyed that late double-frees. Destroying explicitly is always
+# safe, so every wrapper registers here and one atexit hook (which runs
+# BEFORE module teardown) closes channels first, then servers.
+_LIVE_CHANNELS: "weakref.WeakSet" = weakref.WeakSet()
+_LIVE_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _teardown_native_handles() -> None:
+    for ch in list(_LIVE_CHANNELS):
+        try:
+            ch.close()
+        except Exception:  # noqa: BLE001 — best-effort exit hygiene
+            pass
+    for srv in list(_LIVE_SERVERS):
+        try:
+            srv.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def _build_native() -> None:
@@ -54,6 +100,19 @@ def lib() -> ctypes.CDLL:
     if not os.path.exists(_LIB_PATH):
         _build_native()
     L = ctypes.CDLL(_LIB_PATH)
+    if not hasattr(L, "tbrpc_var_arena_gauges_create"):
+        # Stale build from before the current bindings: the handler ABI
+        # carries extra out-params now, so using it would marshal garbage
+        # (not just miss symbols). Rebuild — and verify the reload took:
+        # if the stale mapping was already dlopen'd, glibc hands the same
+        # handle back and only a fresh process can pick up the new build.
+        _build_native()
+        L = ctypes.CDLL(_LIB_PATH)
+        if not hasattr(L, "tbrpc_var_arena_gauges_create"):
+            raise RuntimeError(
+                "libbrpc_tpu.so was built before the current bindings and "
+                "the stale mapping is already loaded in this process; the "
+                "rebuild is on disk — restart Python to pick it up")
     L.tbrpc_server_create.restype = ctypes.c_void_p
     L.tbrpc_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     L.tbrpc_server_start_tls.argtypes = [
@@ -91,7 +150,43 @@ def lib() -> ctypes.CDLL:
         ctypes.c_size_t, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+    # ---- observability: metrics + dumps + tracing (capi.h) ----
+    L.tbrpc_var_adder_create.restype = ctypes.c_void_p
+    L.tbrpc_var_adder_create.argtypes = [ctypes.c_char_p]
+    L.tbrpc_var_adder_add.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    L.tbrpc_var_adder_value.restype = ctypes.c_int64
+    L.tbrpc_var_adder_value.argtypes = [ctypes.c_void_p]
+    L.tbrpc_var_latency_create.restype = ctypes.c_void_p
+    L.tbrpc_var_latency_create.argtypes = [ctypes.c_char_p]
+    L.tbrpc_var_latency_record.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    L.tbrpc_var_latency_value.restype = ctypes.c_int64
+    L.tbrpc_var_latency_value.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    L.tbrpc_var_gauge_create.restype = ctypes.c_void_p
+    L.tbrpc_var_gauge_create.argtypes = [
+        ctypes.c_char_p, _GAUGE_CB, ctypes.c_void_p]
+    L.tbrpc_vars_dump.restype = ctypes.c_int64
+    L.tbrpc_vars_dump.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_vars_dump_prometheus.restype = ctypes.c_int64
+    L.tbrpc_vars_dump_prometheus.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_rpcz_dump_json.restype = ctypes.c_int64
+    L.tbrpc_rpcz_dump_json.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_rpcz_enabled.restype = ctypes.c_int
+    L.tbrpc_rpcz_set_enabled.argtypes = [ctypes.c_int]
+    L.tbrpc_trace_new_id.restype = ctypes.c_uint64
+    L.tbrpc_trace_current.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    L.tbrpc_trace_set.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    L.tbrpc_span_annotate.argtypes = [ctypes.c_char_p]
+    L.tbrpc_span_emit.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_char_p]
+    L.tbrpc_now_us.restype = ctypes.c_int64
+    L.tbrpc_flag_set.restype = ctypes.c_int
+    L.tbrpc_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     _lib = L
+    atexit.register(_teardown_native_handles)
     return L
 
 
@@ -115,6 +210,7 @@ class Server:
         self._h = self._L.tbrpc_server_create()
         self._cbs = []  # keep CFUNCTYPE objects alive
         self.port: Optional[int] = None
+        _LIVE_SERVERS.add(self)
 
     def add_echo_service(self) -> None:
         if self._L.tbrpc_server_add_echo_service(self._h) != 0:
@@ -124,7 +220,8 @@ class Server:
         L = self._L
 
         def trampoline(ctx, method, req, req_len, att, att_len,
-                       resp, resp_len, resp_att, resp_att_len, error_code):
+                       resp, resp_len, resp_att, resp_att_len, error_code,
+                       err_text, err_text_cap):
             try:
                 request = ctypes.string_at(req, req_len) if req_len else b""
                 attachment = ctypes.string_at(att, att_len) if att_len else b""
@@ -138,8 +235,11 @@ class Server:
                         pl[0] = len(data)
             except RpcError as e:
                 error_code[0] = e.code if e.code != 0 else 2004
-            except Exception:  # noqa: BLE001 — handler bug => EINTERNAL
+                fill_err_text(err_text, err_text_cap, e.text)
+            except Exception as e:  # noqa: BLE001 — handler bug => EINTERNAL
                 error_code[0] = 2004
+                fill_err_text(err_text, err_text_cap,
+                              f"{type(e).__name__}: {e}")
 
         cb = _HANDLER_CB(trampoline)
         self._cbs.append(cb)
@@ -151,6 +251,8 @@ class Server:
               ssl_key: str = "") -> int:
         """ssl_cert+ssl_key make the port ALSO accept TLS (sniffed, so
         plaintext clients keep working; ALPN offers h2 for gRPC-over-TLS)."""
+        if not self._h:
+            raise RuntimeError("server is closed")
         if ssl_cert or ssl_key:
             port = self._L.tbrpc_server_start_tls(
                 self._h, addr.encode(), ssl_cert.encode(), ssl_key.encode())
@@ -162,13 +264,19 @@ class Server:
         return port
 
     def stop(self) -> None:
-        self._L.tbrpc_server_stop(self._h)
+        if self._h:  # no-op after close (stop-in-finally patterns)
+            self._L.tbrpc_server_stop(self._h)
+
+    def close(self) -> None:
+        """Stop and release the native server (idempotent)."""
+        if self._h:
+            self._L.tbrpc_server_stop(self._h)
+            self._L.tbrpc_server_destroy(self._h)
+            self._h = None
 
     def __del__(self):
         try:
-            if self._h:
-                self._L.tbrpc_server_destroy(self._h)
-                self._h = None
+            self.close()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 
@@ -190,9 +298,13 @@ class Channel:
             addr.encode(), timeout_ms, max_retry, proto)
         if not self._h:
             raise RuntimeError(f"channel init to {addr} failed")
+        _LIVE_CHANNELS.add(self)
 
     def call(self, service_method: str, request: bytes = b"",
              attachment: bytes = b"") -> Tuple[bytes, bytes]:
+        if not self._h:
+            # NULL through ctypes would be a native deref, not an error.
+            raise RuntimeError("channel is closed")
         L = self._L
         resp = ctypes.c_void_p()
         resp_len = ctypes.c_size_t()
@@ -216,11 +328,15 @@ class Channel:
             L.tbrpc_free(resp_att)
         return r, ra
 
+    def close(self) -> None:
+        """Release the native channel (idempotent)."""
+        if self._h:
+            self._L.tbrpc_channel_destroy(self._h)
+            self._h = None
+
     def __del__(self):
         try:
-            if self._h:
-                self._L.tbrpc_channel_destroy(self._h)
-                self._h = None
+            self.close()
         except Exception:  # noqa: BLE001
             pass
 

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import json
 import threading
+import time
+import weakref
 from typing import Dict, Optional
 
 import jax
@@ -30,6 +32,38 @@ from brpc_tpu.ops.fused_update import fused_momentum_update
 from brpc_tpu.runtime import native
 from brpc_tpu.runtime.tensor import (TensorArena, TensorChannel,
                                      add_tensor_service)
+
+# Process-wide recorders (brpc_tpu/observability): every ParameterServer
+# instance feeds the same series, like native per-method stats aggregate.
+_metrics_cache = None
+_SERVERS: "weakref.WeakSet[ParameterServer]" = weakref.WeakSet()
+
+
+def _max_version_lag() -> int:
+    """Largest (max - min) parameter-version spread across live servers —
+    how far the most- and least-updated parameters have drifted apart.
+    Reads the lock-free mirror each Push maintains: gauge callbacks run
+    at scrape time under the native registry walk, so taking srv._mu here
+    would stall every metrics consumer behind an in-flight update."""
+    return max((srv._version_spread for srv in list(_SERVERS)), default=0)
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from brpc_tpu.observability import metrics as obs
+
+        _metrics_cache = {
+            # HANDLER-BODY time only: Pull's D2H + arena staging happens
+            # after the handler returns (add_tensor_service trampoline) —
+            # the tensor_handler recorder carries that full server-side
+            # cost; the client's tensor_pull carries the end-to-end view.
+            "pull": obs.latency("param_server_pull"),
+            "push": obs.latency("param_server_push"),
+            "push_bytes": obs.counter("param_server_push_bytes"),
+            "lag": obs.gauge("param_server_version_lag", _max_version_lag),
+        }
+    return _metrics_cache
 
 
 class ParameterServer:
@@ -42,7 +76,12 @@ class ParameterServer:
                          for k, v in self._params.items()}
         self._version = {k: 0 for k in self._params}
         self._lr = lr
-        self._mu = threading.Lock()  # handlers run on fiber workers
+        self._mu = threading.Lock()  # handlers run on callback-pool threads
+        # Lock-free mirror of max(version)-min(version), updated by Push
+        # under _mu, read by the version-lag gauge without it.
+        self._version_spread = 0
+        _SERVERS.add(self)
+        self._m = _metrics()
         self.server = native.Server()
         self.arena = add_tensor_service(self.server, "ParamService",
                                         self._handle, arena)
@@ -57,30 +96,54 @@ class ParameterServer:
 
     # ---- handler (runs inside a server fiber) ----
     def _handle(self, method: str, request: bytes, att):
+        from brpc_tpu.observability import tracing
+
         if method == "Meta":
-            meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype),
-                        "version": self._version[k]}
-                    for k, v in self._params.items()}
+            # Under _mu: Push swaps self._params values and bumps
+            # self._version concurrently on other fibers — an unlocked
+            # read here can pair a new version with an old shape/dtype
+            # (or hit a dict mutated mid-iteration).
+            with self._mu:
+                meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype),
+                            "version": self._version[k]}
+                        for k, v in self._params.items()}
             return json.dumps(meta).encode(), None
         name = request.decode()
-        if name not in self._params:
+        with self._mu:
+            known = name in self._params
+        if not known:
             raise native.RpcError(2007, f"no such parameter: {name}")
         if method == "Pull":
+            t0 = time.monotonic()
             with self._mu:
-                return str(self._version[name]).encode(), self._params[name]
+                out = str(self._version[name]).encode(), self._params[name]
+            self._m["pull"].record_s(time.monotonic() - t0)
+            return out
         if method == "Push":
             if att is None:
                 raise native.RpcError(2002, "push without gradient")
-            grad = jax.device_put(np.ascontiguousarray(att))
+            t0 = time.monotonic()
+            with tracing.stage("device_put"):
+                grad = jax.device_put(np.ascontiguousarray(att))
             with self._mu:
-                p, m = fused_momentum_update(
-                    self._params[name], self._momenta[name],
-                    grad.astype(self._params[name].dtype),
-                    lr=self._lr)
+                # Dispatch-only timing: blocking on device completion here
+                # would serialize Pull/Meta (and the version-lag gauge)
+                # behind every update's device round-trip; JAX's async
+                # dispatch already orders later reads of the new arrays.
+                with tracing.stage("fused_update"):
+                    p, m = fused_momentum_update(
+                        self._params[name], self._momenta[name],
+                        grad.astype(self._params[name].dtype),
+                        lr=self._lr)
                 self._params[name] = p
                 self._momenta[name] = m
                 self._version[name] += 1
-                return str(self._version[name]).encode(), None
+                version = self._version[name]
+                vs = self._version.values()
+                self._version_spread = max(vs) - min(vs)
+            self._m["push"].record_s(time.monotonic() - t0)
+            self._m["push_bytes"].add(att.nbytes)
+            return str(version).encode(), None
         raise native.RpcError(2007, f"no such method: {method}")
 
 

@@ -27,12 +27,13 @@ from __future__ import annotations
 import ctypes
 import json
 import struct
+import time
 from typing import Callable, Optional, Tuple
 
 import numpy as np
 
 from brpc_tpu.runtime import native
-from brpc_tpu.runtime.native import RpcError, lib
+from brpc_tpu.runtime.native import RpcError, fill_err_text, lib
 
 
 def _bind_tensor_api(L: ctypes.CDLL) -> ctypes.CDLL:
@@ -49,6 +50,11 @@ def _bind_tensor_api(L: ctypes.CDLL) -> ctypes.CDLL:
     L.tbrpc_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     L.tbrpc_arena_busy_bytes.restype = ctypes.c_int64
     L.tbrpc_arena_busy_bytes.argtypes = [ctypes.c_void_p]
+    L.tbrpc_arenas_busy_bytes.restype = ctypes.c_int64
+    L.tbrpc_arenas_busy_bytes.argtypes = []
+    L.tbrpc_arenas_total_bytes.restype = ctypes.c_int64
+    L.tbrpc_arenas_total_bytes.argtypes = []
+    L.tbrpc_var_arena_gauges_create.argtypes = []
     L.tbrpc_arena_wait_reusable.restype = ctypes.c_int
     L.tbrpc_arena_wait_reusable.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64]
@@ -80,7 +86,49 @@ _TENSOR_CB = ctypes.CFUNCTYPE(
     ctypes.POINTER(ctypes.c_size_t),    # resp_att_len
     ctypes.POINTER(ctypes.c_int),       # resp_att_autofree
     ctypes.POINTER(ctypes.c_int),       # error_code
+    ctypes.c_void_p, ctypes.c_size_t,   # err_text buffer (C-owned)
 )
+
+
+# ---- data-plane metrics (brpc_tpu/observability) ----
+# Created lazily on first use: importing this module must not load the
+# native library. One process-wide set — every channel/arena feeds the
+# same recorders, mirroring how the native side aggregates per-method.
+
+_metrics_cache = None
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from brpc_tpu.observability import metrics as obs
+
+        L = _bind_tensor_api(lib())
+        # Arena occupancy gauges (tensor_arena_busy_bytes/_total_bytes)
+        # are NATIVE PassiveStatus vars over every live arena — created
+        # through the capi but evaluated entirely in C++, so scrapes pay
+        # no callback-pool hop or GIL, and a closing arena can't race the
+        # walk. They ride /vars + /brpc_metrics + /tensorz like the rest.
+        L.tbrpc_var_arena_gauges_create()
+        _metrics_cache = {
+            "pull": obs.latency("tensor_pull"),
+            "push": obs.latency("tensor_push"),
+            "pull_bytes": obs.counter("tensor_pull_bytes"),
+            "push_bytes": obs.counter("tensor_push_bytes"),
+            "wait_stalls": obs.counter("tensor_arena_wait_stalls"),
+            # Server-side complement of the client recorders: the FULL
+            # per-request cost of a Python tensor service — handler body
+            # PLUS response staging into the arena (which happens after
+            # the handler returns, so per-service recorders can't see it).
+            "serve": obs.latency("tensor_handler"),
+        }
+    return _metrics_cache
+
+
+def _stage(name):
+    from brpc_tpu.observability import tracing
+
+    return tracing.stage(name)
 
 
 def _encode_meta(arr: np.ndarray) -> bytes:
@@ -110,12 +158,15 @@ class TensorArena:
             raise MemoryError(f"arena create({nbytes}) failed")
         self._base = self._L.tbrpc_arena_base(self._h)
         self.nbytes = nbytes
+        _metrics()  # occupancy gauges cover this arena from now on
 
     @property
     def handle(self) -> int:
         return self._h
 
     def alloc(self, nbytes: int) -> int:
+        if not self._h:
+            raise RuntimeError("arena is closed")
         off = self._L.tbrpc_arena_alloc(self._h, nbytes)
         if off < 0:
             raise MemoryError(f"arena alloc({nbytes}) failed (fragmented?)")
@@ -146,10 +197,22 @@ class TensorArena:
         return off, host.nbytes, host
 
     def busy_bytes(self) -> int:
+        if not self._h:
+            return 0  # a closed arena holds nothing
         return self._L.tbrpc_arena_busy_bytes(self._h)
 
     def wait_reusable(self, off: int, timeout_ms: int = -1) -> bool:
-        return self._L.tbrpc_arena_wait_reusable(self._h, off, timeout_ms) == 0
+        # Zero-timeout probe first: an actual PARK here means the data
+        # plane is gated on reference drain (the wire release hasn't come
+        # back) — the stall counter is the backpressure signal /tensorz
+        # and dashboards watch.
+        if self._L.tbrpc_arena_wait_reusable(self._h, off, 0) == 0:
+            return True
+        if timeout_ms == 0:
+            return False
+        _metrics()["wait_stalls"].add(1)
+        return self._L.tbrpc_arena_wait_reusable(self._h, off,
+                                                 timeout_ms) == 0
 
     def close(self) -> None:
         if self._h:
@@ -219,6 +282,7 @@ class TensorChannel:
                                                max_retry)
         if not self._h:
             raise RuntimeError(f"tensor channel init to {addr} failed")
+        native._LIVE_CHANNELS.add(self)  # atexit teardown hygiene
         self.arena = arena if arena is not None else TensorArena(256 << 20)
 
     def call_raw(self, service_method: str, request: bytes,
@@ -226,6 +290,9 @@ class TensorChannel:
                  ) -> Tuple[bytes, TensorView]:
         """One RPC: request bytes + an arena range as the attachment.
         Returns (response payload, response-attachment view)."""
+        if not self._h:
+            # NULL through ctypes would be a native deref, not an error.
+            raise RuntimeError("tensor channel is closed")
         L = self._L
         resp = ctypes.c_void_p()
         resp_len = ctypes.c_size_t()
@@ -287,27 +354,48 @@ class TensorChannel:
                     device=None):
         """Fetch a tensor and jax.device_put it STRAIGHT from the received
         view (H2D DMA from the shared pages; no intermediate host copy),
-        then release the view. Returns (rest_of_payload, jax.Array)."""
+        then release the view. Returns (rest_of_payload, jax.Array).
+
+        Observability: records into the tensor_pull LatencyRecorder and
+        tensor_pull_bytes counter, and annotates the active rpcz span with
+        the rpc / device_put stage split."""
         import jax
 
-        payload, view = self.call_raw(service_method, request)
+        t0 = time.monotonic()
+        with _stage("rpc"):
+            payload, view = self.call_raw(service_method, request)
         with view:
             dtype, shape, rest = _decode_meta(payload)
             arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
-            dev = jax.device_put(arr, device)
-            dev.block_until_ready()  # H2D completes before the release
+            nbytes = view.nbytes
+            with _stage("device_put"):
+                dev = jax.device_put(arr, device)
+                dev.block_until_ready()  # H2D completes before the release
+        m = _metrics()
+        m["pull"].record_s(time.monotonic() - t0)
+        m["pull_bytes"].add(nbytes)
         return rest, dev
 
     def push_device(self, service_method: str, array,
                     request: bytes = b"") -> bytes:
         """Send a device array (D2H into the arena, by-reference on the
         wire); waits for the wire release so the arena cannot fill up under
-        a streaming push loop. Returns the response payload."""
-        off, length, host = self.place_with_meta(array)
+        a streaming push loop. Returns the response payload.
+
+        Observability: records into the tensor_push LatencyRecorder and
+        tensor_push_bytes counter, and annotates the active rpcz span with
+        the arena_stage (D2H + staging copy) / rpc stage split."""
+        t0 = time.monotonic()
+        with _stage("arena_stage"):
+            off, length, host = self.place_with_meta(array)
         try:
-            payload, view = self.call_raw(
-                service_method, _encode_meta(host) + request, off, length)
+            with _stage("rpc"):
+                payload, view = self.call_raw(
+                    service_method, _encode_meta(host) + request, off, length)
             view.release()
+            m = _metrics()
+            m["push"].record_s(time.monotonic() - t0)
+            m["push_bytes"].add(length)
             return payload
         finally:
             if length:
@@ -343,7 +431,8 @@ def add_tensor_service(server: native.Server, name: str,
 
     def trampoline(ctx, method, req, req_len, att, att_len,
                    resp, resp_len, resp_arena, resp_off, resp_att_len,
-                   resp_autofree, error_code):
+                   resp_autofree, error_code, err_text, err_text_cap):
+        t0 = time.monotonic()
         try:
             request = ctypes.string_at(req, req_len) if req_len else b""
             att_view = None
@@ -376,8 +465,14 @@ def add_tensor_service(server: native.Server, name: str,
                 resp_len[0] = len(r)
         except RpcError as e:
             error_code[0] = e.code if e.code != 0 else 2004
-        except Exception:  # noqa: BLE001 — handler bug => EINTERNAL
+            fill_err_text(err_text, err_text_cap, e.text)
+        except Exception as e:  # noqa: BLE001 — handler bug => EINTERNAL
             error_code[0] = 2004
+            fill_err_text(err_text, err_text_cap, f"{type(e).__name__}: {e}")
+        finally:
+            # Handler + response staging: what the client's tensor_pull
+            # would otherwise misattribute to the network.
+            _metrics()["serve"].record_s(time.monotonic() - t0)
 
     cb = _TENSOR_CB(trampoline)
     server._cbs.append(cb)  # keep alive alongside byte-service callbacks

@@ -2,22 +2,172 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "tbthread/fiber.h"
+#include "tbthread/sync.h"
+#include "tbutil/json.h"
 #include "tbutil/time.h"
+#include "tbvar/tbvar.h"
 #include "trpc/channel.h"
 #include "trpc/errno.h"
+#include "trpc/flags.h"
 #include "trpc/server.h"
+#include "trpc/span.h"
 #include "ttpu/tensor_arena.h"
 
 using namespace trpc;
 
 namespace {
+
+// Python callbacks MUST run on pthread-stable threads. ctypes pairs
+// PyGILState_Ensure/Release around every callback on the CURRENT OS
+// thread — but a fiber that parks mid-callback (a Python handler issuing
+// a nested tbrpc_call parks on the correlation id) can resume on a
+// DIFFERENT worker pthread, tearing the GIL pairing apart ("auto-releasing
+// thread-state, but no thread-state for this thread" aborts). So every
+// Python callback runs on a small dedicated pthread pool: the service
+// fiber parks on a CountdownEvent until the callback returns, nested calls
+// block the POOL thread (butex takes pthread waiters), and the fiber's
+// trace context is handed across explicitly so downstream calls still
+// link to the server span at /rpcz.
+static auto* g_python_cb_threads = TRPC_DEFINE_FLAG(
+    python_callback_threads, 8,
+    "idle pthreads RETAINED for Python service callbacks; the pool grows "
+    "on demand (every concurrent handler gets a thread — a hard cap would "
+    "deadlock nested Python->Python in-process calls) and shrinks back");
+
+static auto* g_python_cb_max = TRPC_DEFINE_FLAG(
+    python_callback_max_threads, 256,
+    "admission bound on OUTSTANDING Python callback jobs (each costs one "
+    "pool pthread while it runs); beyond it new jobs fail with ELIMIT "
+    "instead of minting threads without bound");
+
+class PyCallbackPool {
+ public:
+  static PyCallbackPool& instance() {
+    static PyCallbackPool* p = new PyCallbackPool;
+    return *p;
+  }
+
+  // Run `job` on a pool pthread; the CALLING fiber parks until it returns.
+  // False = admission bound hit (job not run): fail the RPC with ELIMIT.
+  bool Run(std::function<void()> job) {
+    tbthread::CountdownEvent done(1);
+    if (!Enqueue([&job, &done] {
+          job();
+          done.signal();
+        })) {
+      return false;
+    }
+    done.wait();  // fiber-aware park
+    return true;
+  }
+
+  // Like Run, but the caller BLOCKS ITS WORKER PTHREAD instead of parking.
+  // Required when invoked under a lock other fibers contend for (the tbvar
+  // registry walk evaluating a gauge): parking would free this worker to
+  // pick fibers that then block on that same lock — with every worker
+  // blocked, the parked scraper can never resume (a 2-worker process
+  // wedges). Blocking keeps the caller on its worker; the pool thread
+  // completes independently, so progress is guaranteed.
+  bool RunBlocking(std::function<void()> job) {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool finished = false;
+    if (!Enqueue([&] {
+          job();
+          // Notify UNDER the lock: the waiter destroys cv the moment its
+          // predicate-wait returns, which it cannot do before we release.
+          std::lock_guard<std::mutex> lk(mu);
+          finished = true;
+          cv.notify_one();
+        })) {
+      return false;
+    }
+    // Deliberate pthread block (see above).
+    std::unique_lock<std::mutex> lk(mu);  // tpulint: allow(fiber-blocking)
+    cv.wait(lk, [&] { return finished; });
+    return true;
+  }
+
+ private:
+  struct Job {
+    std::function<void()> fn;
+  };
+
+  bool Enqueue(std::function<void()> fn) {
+    {
+      // O(1) queue push; pool threads block by design (dedicated pthreads,
+      // not fiber workers).
+      std::lock_guard<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking)
+      const int64_t max_jobs = std::max<int64_t>(
+          1, g_python_cb_max->load(std::memory_order_relaxed));
+      if (_outstanding >= max_jobs) {
+        return false;  // admission bound: shed instead of minting threads
+      }
+      ++_outstanding;
+      _queue.push_back(Job{std::move(fn)});
+      // Grow whenever queued jobs outnumber idle threads: a hard spawn cap
+      // (or an _idle==0 test, which two racing enqueues can both pass with
+      // one idle thread) would strand a job with no thread to serve it —
+      // and DEADLOCK the nested case, where every pool thread is blocked
+      // inside a handler whose downstream Python-handler job sits in this
+      // very queue. Thread count is bounded by the admission check above;
+      // surplus threads retire in Loop() once a burst drains.
+      if (_idle < static_cast<int>(_queue.size())) {
+        std::thread([this] { Loop(); }).detach();
+      }
+    }
+    _cv.notify_one();
+    return true;
+  }
+
+  void Loop() {
+    for (;;) {
+      Job job;
+      {
+        // Dedicated pthread, not a fiber worker: blocking here is the
+        // whole point.
+        std::unique_lock<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking)
+        ++_idle;
+        while (_queue.empty()) {
+          const auto rc = _cv.wait_for(lk, std::chrono::seconds(5));
+          const int64_t keep = std::max<int64_t>(
+              1, g_python_cb_threads->load(std::memory_order_relaxed));
+          if (rc == std::cv_status::timeout && _queue.empty() &&
+              _idle > keep) {
+            --_idle;
+            return;  // retire a surplus idle thread once the burst drains
+          }
+        }
+        --_idle;
+        job = std::move(_queue.front());
+        _queue.pop_front();
+      }
+      job.fn();
+      {
+        std::lock_guard<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking)
+        --_outstanding;
+      }
+    }
+  }
+
+  std::mutex _mu;  // tpulint: allow(fiber-blocking)
+  std::condition_variable _cv;
+  std::deque<Job> _queue;
+  int _idle = 0;
+  int64_t _outstanding = 0;
+};
 
 class NativeEchoService : public Service {
  public:
@@ -50,10 +200,28 @@ class CallbackService : public Service {
     void* resp_att = nullptr;
     size_t resp_att_len = 0;
     int error_code = 0;
-    _cb(_ctx, method.c_str(), req.data(), req.size(), att.data(), att.size(),
-        &resp, &resp_len, &resp_att, &resp_att_len, &error_code);
+    char err_text[256];
+    err_text[0] = '\0';
+    const TraceContext trace_ctx = current_trace_context();
+    const bool ran = PyCallbackPool::instance().Run([&] {
+      // The pool thread inherits the server span: nested calls the Python
+      // handler issues parent there, keeping the trace linked.
+      ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
+      _cb(_ctx, method.c_str(), req.data(), req.size(), att.data(),
+          att.size(), &resp, &resp_len, &resp_att, &resp_att_len,
+          &error_code, err_text, sizeof(err_text));
+    });
+    if (!ran) {
+      error_code = TRPC_ELIMIT;
+      snprintf(err_text, sizeof(err_text), "%s",
+               "python callback pool saturated "
+               "(python_callback_max_threads)");
+    }
     if (error_code != 0) {
-      cntl->SetFailed(error_code, "service callback failed");
+      err_text[sizeof(err_text) - 1] = '\0';
+      cntl->SetFailed(error_code, err_text[0] != '\0'
+                                      ? err_text
+                                      : "service callback failed");
     } else {
       if (resp != nullptr && resp_len > 0) {
         response->append(resp, resp_len);
@@ -248,6 +416,35 @@ int64_t tbrpc_arena_busy_bytes(void* arena) {
   return static_cast<ArenaBox*>(arena)->arena->busy_bytes();
 }
 
+int64_t tbrpc_arenas_busy_bytes(void) {
+  std::vector<std::shared_ptr<ttpu::TensorArena>> arenas;
+  ttpu::TensorArena::ListAll(&arenas);
+  int64_t n = 0;
+  for (const auto& a : arenas) n += a->busy_bytes();
+  return n;
+}
+
+int64_t tbrpc_arenas_total_bytes(void) {
+  std::vector<std::shared_ptr<ttpu::TensorArena>> arenas;
+  ttpu::TensorArena::ListAll(&arenas);
+  int64_t n = 0;
+  for (const auto& a : arenas) n += static_cast<int64_t>(a->bytes());
+  return n;
+}
+
+void tbrpc_var_arena_gauges_create(void) {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    // Immortal native gauges: evaluated entirely in C++ at scrape time.
+    (new tbvar::PassiveStatus<int64_t>(
+         [] { return tbrpc_arenas_busy_bytes(); }))
+        ->expose("tensor_arena_busy_bytes");
+    (new tbvar::PassiveStatus<int64_t>(
+         [] { return tbrpc_arenas_total_bytes(); }))
+        ->expose("tensor_arena_total_bytes");
+  });
+}
+
 int tbrpc_arena_wait_reusable(void* arena, uint64_t off, int64_t timeout_ms) {
   return static_cast<ArenaBox*>(arena)->arena->WaitReusable(off, timeout_ms);
 }
@@ -333,11 +530,25 @@ void TensorCallbackService::CallMethod(const std::string& method,
   size_t resp_att_len = 0;
   int resp_att_autofree = 0;
   int error_code = 0;
-  _cb(_ctx, method.c_str(), req.data(), req.size(), att_ptr, att_len, &resp,
-      &resp_len, &resp_arena, &resp_att_off, &resp_att_len,
-      &resp_att_autofree, &error_code);
+  char err_text[256];
+  err_text[0] = '\0';
+  const TraceContext trace_ctx = current_trace_context();
+  const bool ran = PyCallbackPool::instance().Run([&] {
+    ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
+    _cb(_ctx, method.c_str(), req.data(), req.size(), att_ptr, att_len,
+        &resp, &resp_len, &resp_arena, &resp_att_off, &resp_att_len,
+        &resp_att_autofree, &error_code, err_text, sizeof(err_text));
+  });
+  if (!ran) {
+    error_code = TRPC_ELIMIT;
+    snprintf(err_text, sizeof(err_text), "%s",
+             "python callback pool saturated (python_callback_max_threads)");
+  }
   if (error_code != 0) {
-    cntl->SetFailed(error_code, "tensor service callback failed");
+    err_text[sizeof(err_text) - 1] = '\0';
+    cntl->SetFailed(error_code, err_text[0] != '\0'
+                                    ? err_text
+                                    : "tensor service callback failed");
     if (resp_arena != nullptr && resp_att_len > 0 && resp_att_autofree) {
       // The handler allocated a response range before failing: honor the
       // autofree so the arena doesn't leak one range per failed call.
@@ -402,6 +613,195 @@ int tbrpc_call(void* channel, const char* service_method, const void* req,
     out(cntl.response_attachment(), resp_attach, resp_attach_len);
   }
   return 0;
+}
+
+// ---------------- observability ----------------
+
+namespace {
+
+// Copy-out convention shared by the dump entry points: NUL-terminated
+// truncation into (buf, cap), return the untruncated length.
+int64_t copy_out(const std::string& s, char* buf, size_t cap) {
+  if (buf != nullptr && cap > 0) {
+    const size_t n = std::min(s.size(), cap - 1);
+    memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(s.size());
+}
+
+}  // namespace
+
+void* tbrpc_var_adder_create(const char* name) {
+  auto* adder = new tbvar::Adder<int64_t>();
+  if (adder->expose(name != nullptr ? name : "") != 0) {
+    delete adder;
+    return nullptr;
+  }
+  return adder;  // immortal: the registry references it by name forever
+}
+
+void tbrpc_var_adder_add(void* adder, int64_t delta) {
+  *static_cast<tbvar::Adder<int64_t>*>(adder) << delta;
+}
+
+int64_t tbrpc_var_adder_value(void* adder) {
+  return static_cast<tbvar::Adder<int64_t>*>(adder)->get_value();
+}
+
+void* tbrpc_var_latency_create(const char* prefix) {
+  const std::string p = prefix != nullptr ? prefix : "";
+  // LatencyRecorder::expose can't fail, so probe the registry for EVERY
+  // facade name ourselves — a collision on any one of them must be
+  // visible to the caller, or that series silently flatlines. (The probe
+  // and the expose are not atomic; concurrent same-prefix creators still
+  // race, but each of them sees the other's names on its next probe.)
+  for (const char* suffix :
+       {"_latency", "_max_latency", "_qps", "_count", "_latency_50",
+        "_latency_99", "_latency_999"}) {
+    std::ostringstream probe;
+    if (tbvar::Variable::describe_exposed(
+            tbvar::to_underscored_name(p + suffix), probe)) {
+      return nullptr;
+    }
+  }
+  auto* rec = new tbvar::LatencyRecorder();
+  rec->expose(p);
+  return rec;  // immortal
+}
+
+void tbrpc_var_latency_record(void* rec, int64_t latency_us) {
+  *static_cast<tbvar::LatencyRecorder*>(rec) << latency_us;
+}
+
+int64_t tbrpc_var_latency_value(void* rec, int what) {
+  auto* r = static_cast<tbvar::LatencyRecorder*>(rec);
+  switch (what) {
+    case 0: return r->count();
+    case 1: return r->qps();
+    case 2: return r->latency();
+    case 3: return r->max_latency();
+    case 50: return r->p50();
+    case 90: return r->p90();
+    case 99: return r->p99();
+    case 999: return r->p999();
+    default: return -1;
+  }
+}
+
+void* tbrpc_var_gauge_create(const char* name, tbrpc_gauge_cb cb, void* ctx) {
+  auto* gauge = new tbvar::PassiveStatus<int64_t>([cb, ctx]() -> int64_t {
+    // Scrapes evaluate getters on server FIBERS while the registry walk
+    // holds its mutex: the Python callback must run on a pthread-stable
+    // pool thread (GIL pairing), and the caller must BLOCK, not park —
+    // parking under that held mutex lets the workers fill up with fibers
+    // blocked on the same mutex, leaving no worker to resume the scraper.
+    int64_t v = -1;  // saturation/shed reads as -1, not a stale 0
+    PyCallbackPool::instance().RunBlocking([&] { v = cb(ctx); });
+    return v;
+  });
+  if (gauge->expose(name != nullptr ? name : "") != 0) {
+    delete gauge;
+    return nullptr;
+  }
+  return gauge;  // immortal
+}
+
+int64_t tbrpc_vars_dump(const char* prefix, char* buf, size_t cap) {
+  const std::string want = prefix != nullptr ? prefix : "";
+  std::map<std::string, std::string> vars;
+  tbvar::Variable::dump_exposed(&vars);
+  std::string out;
+  for (const auto& [name, value] : vars) {
+    if (!want.empty() && name.compare(0, want.size(), want) != 0) continue;
+    out += name;
+    out += " : ";
+    out += value;
+    out += '\n';
+  }
+  return copy_out(out, buf, cap);
+}
+
+int64_t tbrpc_vars_dump_prometheus(char* buf, size_t cap) {
+  std::string out;
+  tbvar::dump_prometheus(&out);
+  return copy_out(out, buf, cap);
+}
+
+int64_t tbrpc_rpcz_dump_json(uint64_t trace_id, char* buf, size_t cap) {
+  std::vector<Span> spans;
+  SpanStore::global().Dump(&spans, trace_id);
+  if (trace_id != 0) std::reverse(spans.begin(), spans.end());  // oldest 1st
+  char hex[20];
+  tbutil::JsonValue arr = tbutil::JsonValue::Array();
+  for (const Span& s : spans) {
+    tbutil::JsonValue o = tbutil::JsonValue::Object();
+    // Ids as 16-digit hex strings: they are opaque u64 tokens (JSON
+    // numbers would lose the top bit), and /rpcz?trace= takes hex.
+    snprintf(hex, sizeof(hex), "%016llx",
+             static_cast<unsigned long long>(s.trace_id));
+    o.set("trace_id", hex);
+    snprintf(hex, sizeof(hex), "%016llx",
+             static_cast<unsigned long long>(s.span_id));
+    o.set("span_id", hex);
+    snprintf(hex, sizeof(hex), "%016llx",
+             static_cast<unsigned long long>(s.parent_span_id));
+    o.set("parent_span_id", hex);
+    o.set("server_side", s.server_side);
+    o.set("start_us", s.start_us);
+    o.set("end_us", s.end_us);
+    o.set("latency_us", s.end_us - s.start_us);
+    o.set("error_code", s.error_code);
+    o.set("service_method", s.service_method);
+    o.set("peer", tbutil::endpoint2str(s.remote_side));
+    tbutil::JsonValue ann = tbutil::JsonValue::Array();
+    for (const std::string& a : s.annotations) ann.push_back(a);
+    o.set("annotations", std::move(ann));
+    arr.push_back(std::move(o));
+  }
+  return copy_out(arr.Dump(), buf, cap);
+}
+
+int tbrpc_rpcz_enabled(void) { return rpcz_enabled() ? 1 : 0; }
+
+void tbrpc_rpcz_set_enabled(int on) {
+  FlagRegistry::global().Set("rpcz_enabled", on != 0 ? "1" : "0");
+}
+
+uint64_t tbrpc_trace_new_id(void) { return new_trace_or_span_id(); }
+
+void tbrpc_trace_current(uint64_t* trace_id, uint64_t* span_id) {
+  const TraceContext ctx = current_trace_context();
+  if (trace_id != nullptr) *trace_id = ctx.trace_id;
+  if (span_id != nullptr) *span_id = ctx.span_id;
+}
+
+void tbrpc_trace_set(uint64_t trace_id, uint64_t span_id) {
+  set_current_trace_context({trace_id, span_id});
+}
+
+void tbrpc_trace_clear(void) { clear_current_trace_context(); }
+
+void tbrpc_span_annotate(const char* text) {
+  if (text == nullptr) return;
+  const TraceContext ctx = current_trace_context();
+  AnnotateSpan(ctx.span_id, text);
+}
+
+void tbrpc_span_emit(uint64_t trace_id, uint64_t span_id,
+                     uint64_t parent_span_id, int server_side,
+                     int64_t start_us, int64_t end_us, int error_code,
+                     const char* name) {
+  if (!rpcz_enabled()) return;
+  EmitSpan(trace_id, span_id, parent_span_id, server_side != 0, start_us,
+           end_us, error_code, name != nullptr ? name : "");
+}
+
+int64_t tbrpc_now_us(void) { return tbutil::gettimeofday_us(); }
+
+int tbrpc_flag_set(const char* name, const char* value) {
+  if (name == nullptr || value == nullptr) return -1;
+  return FlagRegistry::global().Set(name, value) ? 0 : -1;
 }
 
 // ---------------- bench harness ----------------

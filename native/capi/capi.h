@@ -23,14 +23,21 @@ void tbrpc_server_destroy(void* server);
 // attachment are echoed back untouched. Used by benchmarks and smoke tests.
 int tbrpc_server_add_echo_service(void* server);
 
-// Python-backed service: the callback runs in a fiber (ctypes acquires the
-// GIL). It must fill *resp/resp_len via tbrpc_alloc (ownership passes back).
+// Python-backed service: the callback runs on a dedicated pthread from a
+// small pool (NOT on the fiber — ctypes pairs PyGILState_Ensure/Release on
+// one OS thread, and a fiber that parks mid-callback could resume on a
+// different worker; the service fiber parks until the callback returns).
+// It must fill *resp/resp_len via tbrpc_alloc (ownership passes back).
+// On failure it sets *error_code and MAY write a NUL-terminated message
+// into err_text (err_text_cap bytes, provided by the caller) — the text
+// rides the wire back to the client's errbuf.
 typedef void (*tbrpc_handler_cb)(void* ctx, const char* method,
                                  const void* req, size_t req_len,
                                  const void* attach, size_t attach_len,
                                  void** resp, size_t* resp_len,
                                  void** resp_attach, size_t* resp_attach_len,
-                                 int* error_code);
+                                 int* error_code, char* err_text,
+                                 size_t err_text_cap);
 int tbrpc_server_add_callback_service(void* server, const char* name,
                                       tbrpc_handler_cb cb, void* ctx);
 
@@ -67,6 +74,16 @@ int64_t tbrpc_arena_alloc(void* arena, size_t len);
 // remote (wire) reference has dropped.
 int tbrpc_arena_free(void* arena, uint64_t off);
 int64_t tbrpc_arena_busy_bytes(void* arena);
+// Aggregates over EVERY live arena in the process (occupancy gauges and
+// /tensorz use the same walk) — safe to call concurrently with arena
+// destruction, unlike per-handle reads from another thread.
+int64_t tbrpc_arenas_busy_bytes(void);
+int64_t tbrpc_arenas_total_bytes(void);
+// Expose those aggregates as NATIVE PassiveStatus gauges
+// (tensor_arena_busy_bytes / tensor_arena_total_bytes) so scrapes never
+// leave C++ (a Python-callback gauge would pay a callback-pool hop + GIL
+// per scrape for a value computable natively). Idempotent.
+void tbrpc_var_arena_gauges_create(void);
 // Block the calling thread until `off`'s range has no references (safe to
 // overwrite). timeout_ms < 0 waits forever. 0 ok, -1 timeout.
 int tbrpc_arena_wait_reusable(void* arena, uint64_t off, int64_t timeout_ms);
@@ -101,9 +118,76 @@ typedef void (*tbrpc_tensor_handler_cb)(
     const void* att, size_t att_len,
     void** resp, size_t* resp_len,  // tbrpc_alloc'd, ownership passes back
     void** resp_arena, uint64_t* resp_att_off, size_t* resp_att_len,
-    int* resp_att_autofree, int* error_code);
+    int* resp_att_autofree, int* error_code, char* err_text,
+    size_t err_text_cap);
 int tbrpc_server_add_tensor_service(void* server, const char* name,
                                     tbrpc_tensor_handler_cb cb, void* ctx);
+
+// ---- observability: tbvar metrics from the data plane ----
+// Native variables created and fed from Python (or any embedder): they live
+// in the SAME registry as the framework's own metrics, so /vars,
+// /brpc_metrics and /tensorz show the Python tensor path next to the fiber
+// runtime. Handles are immortal (the registry is process-lifetime);
+// create returns null when the name is already taken (tbvar semantics:
+// the second expose of a name fails and its series would flatline).
+void* tbrpc_var_adder_create(const char* name);
+void tbrpc_var_adder_add(void* adder, int64_t delta);
+int64_t tbrpc_var_adder_value(void* adder);
+
+// LatencyRecorder bundle: exposes {prefix}_latency/_max_latency/_qps/
+// _count/_latency_50/_latency_99/_latency_999 like every native RPC leg.
+void* tbrpc_var_latency_create(const char* prefix);
+void tbrpc_var_latency_record(void* rec, int64_t latency_us);
+// what: 0=count, 1=qps, 2=avg latency, 3=max latency; 50/90/99/999 =
+// that percentile. Unknown selectors return -1.
+int64_t tbrpc_var_latency_value(void* rec, int what);
+
+// PassiveStatus gauge: cb(ctx) is evaluated at scrape/dump time (the
+// busy-bytes pattern — the value is owned elsewhere). cb must stay callable
+// for the process lifetime.
+typedef int64_t (*tbrpc_gauge_cb)(void* ctx);
+void* tbrpc_var_gauge_create(const char* name, tbrpc_gauge_cb cb, void* ctx);
+
+// ---- observability: dumps ----
+// Each writes a NUL-terminated snapshot into buf (truncated at cap) and
+// returns the FULL length required excluding the NUL — if the return is
+// >= cap, call again with a larger buffer. buf may be null with cap 0 to
+// size a first call.
+// All exposed vars as "name : value" lines; prefix ("" = all) filters.
+int64_t tbrpc_vars_dump(const char* prefix, char* buf, size_t cap);
+// Prometheus text format — byte-identical to the /brpc_metrics page.
+int64_t tbrpc_vars_dump_prometheus(char* buf, size_t cap);
+// Collected rpcz spans as a JSON array (newest first), annotations
+// included; trace_id != 0 filters to one trace (oldest first).
+int64_t tbrpc_rpcz_dump_json(uint64_t trace_id, char* buf, size_t cap);
+
+// ---- observability: tracing ----
+// The fiber-local trace context the native stack propagates (span.h):
+// reading/writing it from Python lets the tensor path join native traces.
+// On a plain (non-fiber) thread the context rides a thread-local slot, so
+// a Python client thread can carry a root span across its calls too.
+int tbrpc_rpcz_enabled(void);
+void tbrpc_rpcz_set_enabled(int on);
+uint64_t tbrpc_trace_new_id(void);
+void tbrpc_trace_current(uint64_t* trace_id, uint64_t* span_id);
+void tbrpc_trace_set(uint64_t trace_id, uint64_t span_id);
+void tbrpc_trace_clear(void);
+// Attach "key=value" stage text to the ACTIVE span (the current trace
+// context's span — a server handler annotates its server span; a Python
+// trace_span() annotates itself). No-op when no span is active.
+void tbrpc_span_annotate(const char* text);
+// Record an externally-timed span (Python-created spans: trace_span()
+// times the body and emits here). No-op when span_id == 0 or rpcz is off.
+void tbrpc_span_emit(uint64_t trace_id, uint64_t span_id,
+                     uint64_t parent_span_id, int server_side,
+                     int64_t start_us, int64_t end_us, int error_code,
+                     const char* name);
+// Wall-clock microseconds on the same clock spans use (gettimeofday).
+int64_t tbrpc_now_us(void);
+
+// Reloadable-flag access (the /flags page, from code): 0 ok, -1 on unknown
+// flag / parse error / validator veto.
+int tbrpc_flag_set(const char* name, const char* value);
 
 // ---- bench harness (loops in C so Python overhead is out of the path) ----
 // Echo round-trips of `payload_size`-byte attachments for ~`seconds`, with

@@ -25,37 +25,51 @@ class ObjectPool {
   }
 
   T* get_object() {
-    LocalCache& lc = local_cache();
-    if (!lc.free_objs.empty()) {
-      T* p = lc.free_objs.back();
-      lc.free_objs.pop_back();
+    LocalCache* lc = local_cache();
+    if (lc == nullptr) {  // thread teardown: straight to the global list
+      std::lock_guard<std::mutex> g(_mutex);
+      if (!_global_free.empty()) {
+        T* p = _global_free.back();
+        _global_free.pop_back();
+        return p;
+      }
+      return new T;
+    }
+    if (!lc->free_objs.empty()) {
+      T* p = lc->free_objs.back();
+      lc->free_objs.pop_back();
       return p;
     }
     {
       std::lock_guard<std::mutex> g(_mutex);
       if (!_global_free.empty()) {
         size_t take = std::min(_global_free.size(), kLocalFreeCap / 2);
-        lc.free_objs.assign(_global_free.end() - take, _global_free.end());
+        lc->free_objs.assign(_global_free.end() - take, _global_free.end());
         _global_free.resize(_global_free.size() - take);
       }
     }
-    if (!lc.free_objs.empty()) {
-      T* p = lc.free_objs.back();
-      lc.free_objs.pop_back();
+    if (!lc->free_objs.empty()) {
+      T* p = lc->free_objs.back();
+      lc->free_objs.pop_back();
       return p;
     }
     return new T;
   }
 
   void return_object(T* p) {
-    LocalCache& lc = local_cache();
-    lc.free_objs.push_back(p);
-    if (lc.free_objs.size() > kLocalFreeCap) {
+    LocalCache* lc = local_cache();
+    if (lc == nullptr) {  // thread teardown: straight to the global list
       std::lock_guard<std::mutex> g(_mutex);
-      size_t spill = lc.free_objs.size() / 2;
-      _global_free.insert(_global_free.end(), lc.free_objs.end() - spill,
-                          lc.free_objs.end());
-      lc.free_objs.resize(lc.free_objs.size() - spill);
+      _global_free.push_back(p);
+      return;
+    }
+    lc->free_objs.push_back(p);
+    if (lc->free_objs.size() > kLocalFreeCap) {
+      std::lock_guard<std::mutex> g(_mutex);
+      size_t spill = lc->free_objs.size() / 2;
+      _global_free.insert(_global_free.end(), lc->free_objs.end() - spill,
+                          lc->free_objs.end());
+      lc->free_objs.resize(lc->free_objs.size() - spill);
     }
   }
 
@@ -63,19 +77,31 @@ class ObjectPool {
   struct LocalCache {
     std::vector<T*> free_objs;
     ObjectPool* owner = nullptr;
+    bool* alive = nullptr;
     ~LocalCache() {
       if (owner != nullptr && !free_objs.empty()) {
         std::lock_guard<std::mutex> g(owner->_mutex);
         owner->_global_free.insert(owner->_global_free.end(),
                                    free_objs.begin(), free_objs.end());
       }
+      if (alive != nullptr) *alive = false;
     }
   };
 
-  LocalCache& local_cache() {
+  // Null once this thread's cache has been destroyed. The exit sequence
+  // makes this reachable: the main thread's thread_local dtors run BEFORE
+  // __cxa_finalize statics, and a static-storage FiberMutex destructor
+  // (butex_destroy -> return_object) would otherwise push into the
+  // destroyed vector — a double free at every process exit. The flag is
+  // trivially-destructible thread_local storage, so it stays readable for
+  // the whole teardown; dead-thread callers fall back to the global list.
+  LocalCache* local_cache() {
+    static thread_local bool tls_alive = true;
     static thread_local LocalCache tls;
+    if (!tls_alive) return nullptr;
     tls.owner = this;
-    return tls;
+    tls.alive = &tls_alive;
+    return &tls;
   }
 
   std::mutex _mutex;

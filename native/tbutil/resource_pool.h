@@ -48,29 +48,30 @@ class ResourcePool {
 
   // Allocate a slot (possibly recycled). *id receives the slot id.
   T* get_resource(ResourceId* id) {
-    LocalCache& lc = local_cache();
-    if (!lc.free_ids.empty()) {
-      ResourceId rid = lc.free_ids.back();
-      lc.free_ids.pop_back();
+    LocalCache* lc = local_cache();
+    if (lc != nullptr && !lc->free_ids.empty()) {
+      ResourceId rid = lc->free_ids.back();
+      lc->free_ids.pop_back();
       *id = rid;
       return address_resource(rid);
     }
     // Refill from the global free list in a batch. The lock-free emptiness
     // hint keeps the fresh-carve path (startup, connection storms) from
     // serializing on _free_mutex when there is nothing to refill from.
-    if (_global_free_size.load(std::memory_order_relaxed) > 0) {
+    if (lc != nullptr &&
+        _global_free_size.load(std::memory_order_relaxed) > 0) {
       std::lock_guard<std::mutex> g(_free_mutex);
       if (!_global_free.empty()) {
         size_t take = std::min(_global_free.size(), kLocalFreeCap / 2);
-        lc.free_ids.assign(_global_free.end() - take, _global_free.end());
+        lc->free_ids.assign(_global_free.end() - take, _global_free.end());
         _global_free.resize(_global_free.size() - take);
         _global_free_size.store(_global_free.size(),
                                 std::memory_order_relaxed);
       }
     }
-    if (!lc.free_ids.empty()) {
-      ResourceId rid = lc.free_ids.back();
-      lc.free_ids.pop_back();
+    if (lc != nullptr && !lc->free_ids.empty()) {
+      ResourceId rid = lc->free_ids.back();
+      lc->free_ids.pop_back();
       *id = rid;
       return address_resource(rid);
     }
@@ -98,14 +99,20 @@ class ResourcePool {
   }
 
   void return_resource(ResourceId id) {
-    LocalCache& lc = local_cache();
-    lc.free_ids.push_back(id);
-    if (lc.free_ids.size() > kLocalFreeCap) {
+    LocalCache* lc = local_cache();
+    if (lc == nullptr) {  // thread teardown: straight to the global list
       std::lock_guard<std::mutex> g(_free_mutex);
-      size_t spill = lc.free_ids.size() / 2;
-      _global_free.insert(_global_free.end(), lc.free_ids.end() - spill,
-                          lc.free_ids.end());
-      lc.free_ids.resize(lc.free_ids.size() - spill);
+      _global_free.push_back(id);
+      _global_free_size.store(_global_free.size(), std::memory_order_relaxed);
+      return;
+    }
+    lc->free_ids.push_back(id);
+    if (lc->free_ids.size() > kLocalFreeCap) {
+      std::lock_guard<std::mutex> g(_free_mutex);
+      size_t spill = lc->free_ids.size() / 2;
+      _global_free.insert(_global_free.end(), lc->free_ids.end() - spill,
+                          lc->free_ids.end());
+      lc->free_ids.resize(lc->free_ids.size() - spill);
       _global_free_size.store(_global_free.size(), std::memory_order_relaxed);
     }
   }
@@ -127,6 +134,7 @@ class ResourcePool {
   struct LocalCache {
     std::vector<ResourceId> free_ids;
     ResourcePool* owner = nullptr;
+    bool* alive = nullptr;
     ~LocalCache() {
       // Thread exit: spill everything back so ids aren't leaked.
       if (owner != nullptr && !free_ids.empty()) {
@@ -136,13 +144,22 @@ class ResourcePool {
         owner->_global_free_size.store(owner->_global_free.size(),
                                        std::memory_order_relaxed);
       }
+      if (alive != nullptr) *alive = false;
     }
   };
 
-  LocalCache& local_cache() {
+  // Null once this thread's cache was destroyed (main-thread thread_local
+  // dtors run BEFORE __cxa_finalize statics — a static-storage object
+  // releasing a pooled resource at exit would otherwise push into the
+  // destroyed vector; see ObjectPool::local_cache). The flag is trivially
+  // destructible, so its storage stays readable through teardown.
+  LocalCache* local_cache() {
+    static thread_local bool tls_alive = true;
     static thread_local LocalCache tls;
+    if (!tls_alive) return nullptr;
     tls.owner = this;
-    return tls;
+    tls.alive = &tls_alive;
+    return &tls;
   }
 
   ResourcePool() : _blocks(kMaxBlocks) {}

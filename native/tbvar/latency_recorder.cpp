@@ -54,6 +54,8 @@ int LatencyRecorder::expose(const std::string& prefix) {
       new PassiveStatus<int64_t>(prefix + "_qps", [this] { return qps(); }));
   _count_var.reset(new PassiveStatus<int64_t>(prefix + "_count",
                                               [this] { return count(); }));
+  _p50_var.reset(new PassiveStatus<int64_t>(prefix + "_latency_50",
+                                            [this] { return p50(); }));
   _p99_var.reset(new PassiveStatus<int64_t>(prefix + "_latency_99",
                                             [this] { return p99(); }));
   _p999_var.reset(new PassiveStatus<int64_t>(prefix + "_latency_999",

@@ -37,7 +37,8 @@ class LatencyRecorder {
   // Events/second over the window.
   int64_t qps() const;
 
-  // Expose {prefix}_latency, _max_latency, _qps, _count as variables.
+  // Expose {prefix}_latency, _max_latency, _qps, _count, _latency_50,
+  // _latency_99, _latency_999 as variables.
   int expose(const std::string& prefix);
 
  private:
@@ -51,7 +52,7 @@ class LatencyRecorder {
   Window<Maxer<int64_t>> _max_window;
   // Exposed facade vars (created by expose()).
   std::unique_ptr<Variable> _latency_var, _max_var, _qps_var, _count_var,
-      _p99_var, _p999_var;
+      _p50_var, _p99_var, _p999_var;
 };
 
 }  // namespace tbvar

@@ -25,6 +25,7 @@
 #include "trpc/event_dispatcher.h"
 #include "trpc/socket.h"
 #include "trpc/span.h"
+#include "ttpu/tensor_arena.h"
 
 namespace trpc {
 
@@ -43,9 +44,12 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/flags\">/flags</a> — reloadable flags "
       "(set: /flags/NAME?setvalue=V)</li>"
       "<li><a href=\"/connections\">/connections</a> — live sockets</li>"
-      "<li><a href=\"/metrics\">/metrics</a> — Prometheus text format</li>"
+      "<li><a href=\"/metrics\">/metrics</a> — Prometheus text format "
+      "(also at <a href=\"/brpc_metrics\">/brpc_metrics</a>)</li>"
       "<li><a href=\"/health\">/health</a></li>"
       "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
+      "<li><a href=\"/tensorz\">/tensorz</a> — tensor arenas + data-plane "
+      "stage latencies</li>"
       "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
       "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
       "<li><a href=\"/heap\">/heap</a> — sampling heap profile (in-use)</li>"
@@ -233,6 +237,55 @@ void metrics_page(const HttpRequest&, HttpResponse* resp) {
   tbvar::dump_prometheus(&resp->body);
 }
 
+// /tensorz: the tensor data plane at a glance — arena occupancy (every
+// live TensorArena in the process) plus the tensor-path stage recorders
+// the Python side registers (tensor_*, param_server_* vars). The page the
+// next perf PR reads before and after.
+void tensorz_page(const HttpRequest&, HttpResponse* resp) {
+  std::string& b = resp->body;
+  std::vector<std::shared_ptr<ttpu::TensorArena>> arenas;
+  ttpu::TensorArena::ListAll(&arenas);
+  b += "tensor arenas: " + std::to_string(arenas.size()) + "\n";
+  int64_t total = 0, busy = 0;
+  for (const auto& a : arenas) {
+    const int64_t ab = a->busy_bytes();
+    total += static_cast<int64_t>(a->bytes());
+    busy += ab;
+    char line[160];
+    snprintf(line, sizeof(line),
+             "  arena %-4u %s  %10zu bytes  busy %10lld (%.1f%%)\n", a->id(),
+             a->name().c_str(), a->bytes(), static_cast<long long>(ab),
+             a->bytes() > 0 ? 100.0 * static_cast<double>(ab) /
+                                  static_cast<double>(a->bytes())
+                            : 0.0);
+    b += line;
+  }
+  char line[96];
+  snprintf(line, sizeof(line), "total %lld bytes, busy %lld bytes\n",
+           static_cast<long long>(total), static_cast<long long>(busy));
+  b += line;
+  b += "\ntensor-path stage vars (tensor_*, param_server_*):\n";
+  std::map<std::string, std::string> vars;
+  tbvar::Variable::dump_exposed(&vars);
+  size_t matched = 0;
+  for (const auto& [name, value] : vars) {
+    if (name.rfind("tensor_", 0) != 0 &&
+        name.rfind("param_server_", 0) != 0) {
+      continue;
+    }
+    ++matched;
+    b += "  ";
+    b += name;
+    b += " : ";
+    b += value;
+    b += '\n';
+  }
+  if (matched == 0) {
+    b += "  (none registered yet — the Python data plane registers them "
+         "on first use: brpc_tpu/observability)\n";
+  }
+}
+
 // /sockets: EVERY live socket in the process, client side included —
 // /connections shows only this server's accepted ones (reference
 // builtin/sockets_service.cpp).
@@ -359,6 +412,11 @@ void rpcz_page(const HttpRequest& req, HttpResponse* resp) {
                static_cast<unsigned long long>(s.span_id),
                static_cast<unsigned long long>(s.parent_span_id));
       b += line;
+      for (const std::string& a : s.annotations) {
+        b += "        @ ";
+        b += a;
+        b += '\n';
+      }
     }
     return;
   }
@@ -506,6 +564,10 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/flags/", flags_page);
     RegisterHttpHandler("/connections", connections_page);
     RegisterHttpHandler("/metrics", metrics_page);
+    // The reference serves Prometheus at /brpc_metrics; dashboards and
+    // scrape configs written for it must point here unchanged.
+    RegisterHttpHandler("/brpc_metrics", metrics_page);
+    RegisterHttpHandler("/tensorz", tensorz_page);
     RegisterHttpHandler("/sockets", sockets_page);
     RegisterHttpHandler("/ids", ids_page);
     RegisterHttpHandler("/threads", threads_page);

@@ -1,5 +1,7 @@
 #include "trpc/span.h"
 
+#include <deque>
+#include <map>
 #include <mutex>
 
 #include "tbthread/key.h"
@@ -25,6 +27,63 @@ uint64_t new_trace_or_span_id() {
   return id;
 }
 
+// ---------------- pending annotations ----------------
+// Annotations arrive while a span is active (before its Record). Buffered
+// here by span_id; Record drains matches. Capped: a span whose Record never
+// comes (rpcz flipped off mid-flight, handler crashed) must not leak — the
+// oldest span's buffer is dropped once kMaxPendingSpans is reached.
+
+namespace {
+
+struct PendingAnnotations {
+  // O(1)-bounded critical sections (map insert/erase, capped), no parking
+  // inside — same discipline as SpanStore's ring mutex below.
+  std::mutex mu;  // tpulint: allow(fiber-blocking)
+  std::map<uint64_t, std::vector<std::string>> by_span;
+  std::deque<uint64_t> order;  // insertion order, for eviction
+};
+
+PendingAnnotations& pending_annotations() {
+  static PendingAnnotations* p = new PendingAnnotations;
+  return *p;
+}
+
+constexpr size_t kMaxPendingSpans = 1024;
+constexpr size_t kMaxAnnotationsPerSpan = 64;
+constexpr size_t kMaxAnnotationLen = 256;
+
+}  // namespace
+
+void AnnotateSpan(uint64_t span_id, const std::string& text) {
+  if (span_id == 0) return;
+  PendingAnnotations& p = pending_annotations();
+  std::lock_guard<std::mutex> lk(p.mu);  // tpulint: allow(fiber-blocking)
+  auto it = p.by_span.find(span_id);
+  if (it == p.by_span.end()) {
+    while (p.order.size() >= kMaxPendingSpans) {
+      p.by_span.erase(p.order.front());
+      p.order.pop_front();
+    }
+    it = p.by_span.emplace(span_id, std::vector<std::string>()).first;
+    p.order.push_back(span_id);
+  }
+  if (it->second.size() >= kMaxAnnotationsPerSpan) return;
+  it->second.push_back(text.size() <= kMaxAnnotationLen
+                           ? text
+                           : text.substr(0, kMaxAnnotationLen));
+}
+
+static void drain_annotations(Span* span) {
+  PendingAnnotations& p = pending_annotations();
+  std::lock_guard<std::mutex> lk(p.mu);  // tpulint: allow(fiber-blocking)
+  auto it = p.by_span.find(span->span_id);
+  if (it == p.by_span.end()) return;
+  span->annotations = std::move(it->second);
+  p.by_span.erase(it);
+  // The deque entry stays until eviction wraps around; a stale id with no
+  // map entry is skipped for free there.
+}
+
 // ---------------- ring store ----------------
 
 struct SpanStore::Impl {
@@ -38,6 +97,7 @@ struct SpanStore::Impl {
 SpanStore::SpanStore() : _impl(new Impl) {}
 
 void SpanStore::Record(Span&& span) {
+  drain_annotations(&span);
   std::lock_guard<std::mutex> lk(_impl->mu);
   if (_impl->ring.empty()) {
     size_t cap = static_cast<size_t>(
@@ -87,6 +147,22 @@ void RecordServerSpan(uint64_t trace_id, uint64_t span_id,
   sp.error_code = error_code;
   sp.service_method = service_method;
   sp.remote_side = remote;
+  SpanStore::global().Record(std::move(sp));
+}
+
+void EmitSpan(uint64_t trace_id, uint64_t span_id, uint64_t parent_span_id,
+              bool server_side, int64_t start_us, int64_t end_us,
+              int error_code, const std::string& name) {
+  if (span_id == 0) return;
+  Span sp;
+  sp.trace_id = trace_id;
+  sp.span_id = span_id;
+  sp.parent_span_id = parent_span_id;
+  sp.server_side = server_side;
+  sp.start_us = start_us;
+  sp.end_us = end_us;
+  sp.error_code = error_code;
+  sp.service_method = name;
   SpanStore::global().Record(std::move(sp));
 }
 

@@ -29,6 +29,10 @@ struct Span {
   int error_code = 0;
   std::string service_method;
   tbutil::EndPoint remote_side;
+  // Stage annotations ("device_put=812us") attached while the span was
+  // active — AnnotateSpan buffers them by span_id; Record drains the buffer
+  // into the span. The Python data plane reports its stage timings here.
+  std::vector<std::string> annotations;
 };
 
 // Fixed ring of the most recent spans (rpcz_max_spans flag). Recording is
@@ -60,6 +64,18 @@ void clear_current_trace_context();
 
 // Non-zero random id (fast_rand based).
 uint64_t new_trace_or_span_id();
+
+// Attach a stage annotation to a span that is still ACTIVE (its Record has
+// not happened yet). Buffered by span_id in a capped pending store; the
+// matching Record drains it into Span::annotations. No-op when span_id == 0.
+void AnnotateSpan(uint64_t span_id, const std::string& text);
+
+// Record an externally-timed span (the capi path for Python-created spans:
+// trace_span() times the body in Python and emits the result here). No-op
+// when span_id == 0.
+void EmitSpan(uint64_t trace_id, uint64_t span_id, uint64_t parent_span_id,
+              bool server_side, int64_t start_us, int64_t end_us,
+              int error_code, const std::string& name);
 
 // One server leg, shared by every server protocol (tstd/HTTP/h2): no-op
 // when span_id == 0.

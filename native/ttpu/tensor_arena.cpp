@@ -125,6 +125,16 @@ void TensorArena::MaybeReap() {
   d.graveyard.erase(it);
 }
 
+void TensorArena::ListAll(std::vector<std::shared_ptr<TensorArena>>* out) {
+  out->clear();
+  ArenaDirectory& d = directory();
+  std::lock_guard<std::mutex> lk(d.mu);
+  for (const auto& [id, weak] : d.by_id) {
+    auto arena = weak.lock();
+    if (arena != nullptr) out->push_back(std::move(arena));
+  }
+}
+
 std::shared_ptr<TensorArena> TensorArena::ById(uint32_t id) {
   ArenaDirectory& d = directory();
   std::lock_guard<std::mutex> lk(d.mu);

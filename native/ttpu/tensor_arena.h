@@ -27,6 +27,7 @@
 #include <memory>
 #include <mutex>
 #include <string>
+#include <vector>
 
 namespace ttpu {
 
@@ -81,6 +82,8 @@ class TensorArena {
   // ---- process-wide lookup ----
   static std::shared_ptr<TensorArena> ById(uint32_t id);
   static std::shared_ptr<TensorArena> FindContaining(const void* p);
+  // Every live arena (diagnostics: /tensorz occupancy, aggregate gauges).
+  static void ListAll(std::vector<std::shared_ptr<TensorArena>>* out);
   // Drop the caller's ownership but keep the mapping alive until every
   // outstanding reference drains (an arena destroyed mid-send must not
   // unmap pages a socket write queue still points into).

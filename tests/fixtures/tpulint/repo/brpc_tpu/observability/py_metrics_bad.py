@@ -1,0 +1,16 @@
+"""metric-name positives, Python side: charset violation + collisions
+(python-python and python-vs-native — the capi lands both in ONE native
+registry, so "fixture_dup_metric" here collides with the expose() in
+native/trpc/mx_bad.cpp)."""
+
+from brpc_tpu.observability import counter, gauge, latency
+
+
+def register():
+    bad = counter("tensor pull ms")  # space: drops out of Prometheus
+    sq_bad = counter('py fixture sq bad')  # single-quoted: same rule
+    first = latency("py_fixture_stage")
+    second = counter("py_fixture_stage")  # py-py collision
+    cross = counter("fixture_dup_metric")  # py-native collision
+    ok = gauge("py_fixture_busy_bytes", lambda: 0)  # clean
+    return bad, sq_bad, first, second, cross, ok

@@ -1,0 +1,16 @@
+// wire-contract capi fixture: one kept signature, one drifted signature
+// (the lock says tbrpc_fix_call has no trailing size_t), one symbol the
+// lock still carries but the header dropped (tbrpc_fix_gone).
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+extern "C" {
+
+typedef void (*tbrpc_fix_cb)(void* ctx, int* error_code);
+
+void* tbrpc_fix_create(const char* name);
+int tbrpc_fix_call(void* h, const void* req, size_t req_len, size_t extra);
+
+}  // extern "C"

@@ -105,6 +105,34 @@ def test_wire_contract_runtime_mismatch_positive():
                for f in findings)
 
 
+def test_wire_contract_capi_drift_positive(fixture_findings):
+    msgs = " | ".join(
+        f.message for f in _of(fixture_findings, "wire-contract", "capi.h"))
+    assert "tbrpc_fix_call" in msgs and "drifted" in msgs
+    assert "tbrpc_fix_gone" in msgs and "removed" in msgs
+    # matching entries stay silent
+    assert "tbrpc_fix_create" not in msgs
+    assert "tbrpc_fix_cb" not in msgs
+
+
+def test_wire_contract_capi_real_repo_lock_is_current():
+    """The committed lock must describe the capi surface as it IS — a capi
+    change without a lock refresh (and the matching ctypes update) fails
+    here and in test_real_repo_is_lint_clean."""
+    from tools.tpulint.core import SourceFile
+    from tools.tpulint.rules_wire import parse_capi
+
+    with open(os.path.join(ROOT, "tools", "tpulint",
+                           "wire_contract.lock")) as fh:
+        locked = json.load(fh)["native/capi/capi.h"]["__capi__"]
+    current = {sym: sig for sym, (sig, _ln) in parse_capi(
+        SourceFile(ROOT, os.path.join("native", "capi", "capi.h"))).items()}
+    assert current == locked
+    # The handler ABIs carry the error-text out-params end to end.
+    assert "char *, size_t)" in locked["typedef:tbrpc_handler_cb"]
+    assert "char *, size_t)" in locked["typedef:tbrpc_tensor_handler_cb"]
+
+
 # ---- rule class 5: metric-name ----
 
 def test_metric_name_positive(fixture_findings):
@@ -116,6 +144,19 @@ def test_metric_name_positive(fixture_findings):
 
 def test_metric_name_negative(fixture_findings):
     assert not [f for f in fixture_findings if "mx_good.cpp" in f.path]
+
+
+def test_metric_name_python_positive(fixture_findings):
+    hits = _of(fixture_findings, "metric-name", "py_metrics_bad.py")
+    msgs = " | ".join(f.message for f in hits)
+    assert "tensor pull ms" in msgs and "charset" in msgs
+    assert "py fixture sq bad" in msgs  # single-quoted literals too
+    assert "py_fixture_stage" in msgs and "collides" in msgs
+    # cross-language: the python site collides with the native expose()
+    assert any("fixture_dup_metric" in f.message and "mx_bad.cpp" in f.message
+               for f in hits)
+    # the clean registration stays silent
+    assert "py_fixture_busy_bytes" not in msgs
 
 
 # ---- rule class 6: py-blocking ----

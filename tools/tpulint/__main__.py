@@ -39,8 +39,8 @@ def main(argv=None) -> int:
                     help="write current findings to the baseline file and "
                          "exit 0")
     ap.add_argument("--write-wire-lock", action="store_true",
-                    help="snapshot .tidl schemas into "
-                         f"{LOCK_RELPATH} and exit 0")
+                    help="snapshot .tidl schemas + the capi extern-C "
+                         f"surface into {LOCK_RELPATH} and exit 0")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 

@@ -1,6 +1,10 @@
 """metric-name: tbvar / Prometheus exposition hygiene.
 
-Two checks under one rule id:
+Two checks under one rule id, covering BOTH languages that register
+metrics — C++ expose()/ctor sites under native/ and the Python data
+plane's registrations under brpc_tpu/ (brpc_tpu/observability rides the
+same native registry through the capi, so the two namespaces collide for
+real at runtime):
   * charset — an exposed name must render in the Prometheus exposition
     format after tbvar's dot->underscore normalisation, i.e. match
     [a-zA-Z_:.][a-zA-Z0-9_:.]* (dots allowed in source, normalised on
@@ -8,7 +12,9 @@ Two checks under one rule id:
   * collision — two distinct expose sites registering the same final name:
     the second expose() fails at runtime and its series is never emitted
     (tbvar returns -1, reference bvar does the same), which reads as "the
-    metric flatlined" in dashboards.
+    metric flatlined" in dashboards. Python call sites that intentionally
+    share a series must funnel through ONE registration site (the
+    observability get-or-create helpers) — or carry an allow().
 """
 
 from __future__ import annotations
@@ -27,6 +33,24 @@ _CTOR_RE = re.compile(
     r"MultiDimension\s*<[^;{]*?>)\s*"
     r"[A-Za-z_]\w*\s*[({]\s*\"([^\"]+)\"")
 
+# Python registration sites (brpc_tpu/observability + the capi bindings),
+# either quote style:
+#   counter("name") / obs.latency('prefix') / metrics.gauge("name", fn)
+#   Counter("name") / LatencyRecorder("prefix") / PassiveGauge("name", fn)
+#   tbrpc_var_*_create(b"name")
+# A dotted receiver is honoured: `collections.Counter("abc")` is stdlib,
+# not a metric — only receivers that look like the observability module
+# (obs / metrics / *observability*) count. Bare calls can't be told apart
+# textually; an unrelated bare Counter("...") needs an allow().
+_PY_REG_RE = re.compile(
+    r"(?:([A-Za-z_][\w.]*)\s*\.\s*)?"
+    r"\b(?:counter|latency|gauge|Counter|LatencyRecorder|PassiveGauge)"
+    r"\s*\(\s*[bf]?(?:\"([^\"]+)\"|'([^']+)')")
+_PY_METRIC_RECEIVERS = ("obs", "metrics", "observability")
+_PY_CAPI_RE = re.compile(
+    r"()\btbrpc_var_(?:adder|latency|gauge)_create\s*\(\s*"
+    r"b?(?:\"([^\"]+)\"|'([^']+)')")
+
 _VALID = re.compile(r"^[a-zA-Z_:.][a-zA-Z0-9_:.]*$")
 
 
@@ -42,25 +66,40 @@ class MetricNameRule:
     def run(self, ctx: LintContext):
         findings = []
         sites: dict[str, list[tuple[str, int, str]]] = defaultdict(list)
+
+        def check(src, lineno, name):
+            if not _VALID.match(name):
+                findings.append(Finding(
+                    rule=self.id, path=src.path, line=lineno,
+                    message=f"metric name \"{name}\" violates "
+                            "the exposition charset "
+                            "[a-zA-Z_:.][a-zA-Z0-9_:.]*",
+                    hint="Prometheus drops series whose names "
+                         "don't scan; rename using only "
+                         "letters, digits, '_' and ':'"))
+            else:
+                sites[_normalise(name)].append((src.path, lineno, name))
+
         for src in ctx.select(under=("native/",),
                               exclude_under=("native/test/",),
                               ext={".cpp", ".cc", ".h", ".hpp"}):
             for lineno, line in enumerate(src.code_lines(), 1):
                 for pat in (_EXPOSE_RE, _CTOR_RE):
                     for m in pat.finditer(line):
-                        name = m.group(1)
-                        if not _VALID.match(name):
-                            findings.append(Finding(
-                                rule=self.id, path=src.path, line=lineno,
-                                message=f"metric name \"{name}\" violates "
-                                        "the exposition charset "
-                                        "[a-zA-Z_:.][a-zA-Z0-9_:.]*",
-                                hint="Prometheus drops series whose names "
-                                     "don't scan; rename using only "
-                                     "letters, digits, '_' and ':'"))
-                        else:
-                            sites[_normalise(name)].append(
-                                (src.path, lineno, name))
+                        check(src, lineno, m.group(1))
+        # Python side: registrations land in the SAME native registry via
+        # the capi, so they join the one collision namespace.
+        for src in ctx.select(under=("brpc_tpu/",), ext={".py"}):
+            for lineno, line in enumerate(src.code_lines(), 1):
+                for pat in (_PY_REG_RE, _PY_CAPI_RE):
+                    for m in pat.finditer(line):
+                        receiver = m.group(1)
+                        if receiver and not any(
+                                part in _PY_METRIC_RECEIVERS or
+                                "observability" in part
+                                for part in receiver.split(".")):
+                            continue  # someone else's API, e.g. stdlib
+                        check(src, lineno, m.group(2) or m.group(3))
         for norm, where in sorted(sites.items()):
             if len(where) > 1:
                 first = where[0]

@@ -1,12 +1,19 @@
 """wire-contract: the tidl schema and both runtimes must agree, forever.
 
-Three checks under one rule id:
+Four checks under one rule id:
   * duplicate / out-of-range field tags inside a .tidl message;
   * drift against the committed wire lock (tools/tpulint/wire_contract.lock):
     renumbering a field or reusing a retired tag silently corrupts every
     peer still speaking the old schema;
   * wire-type constant parity between native/trpc/tidl_runtime.h and
-    brpc_tpu/runtime/tidl.py — the two encoders must emit identical tags.
+    brpc_tpu/runtime/tidl.py — the two encoders must emit identical tags;
+  * capi ABI drift: the extern-C surface of native/capi/capi.h (functions
+    AND callback typedefs) against the "__capi__" section of the same
+    lock — the ctypes bindings in brpc_tpu/runtime mirror these
+    signatures by hand, so a silent change corrupts calls instead of
+    failing to link. Adding entry points is fine (refresh the lock);
+    removing or re-typing one is a finding until the lock is regenerated
+    IN THE SAME change that updates the Python bindings.
 """
 
 from __future__ import annotations
@@ -48,6 +55,66 @@ _CANON = {"Varint": "VARINT", "Fixed64": "FIXED64", "LenDelim": "LEN",
 _EXPECTED = {"VARINT": 0, "FIXED64": 1, "LEN": 2, "FIXED32": 5}
 
 
+# Function declaration / callback typedef inside the extern "C" block,
+# matched over comment-stripped, whitespace-collapsed text:
+#   int tbrpc_server_start(void* server, const char* addr);
+#   typedef void (*tbrpc_handler_cb)(void* ctx, ...);
+_CAPI_FN_RE = re.compile(
+    r"(?<![\w)])([A-Za-z_][\w ]*?[\w*])\s+\**\s*(tbrpc_\w+)\s*\(([^;{)]*)\)"
+    r"\s*;")
+_CAPI_TYPEDEF_RE = re.compile(
+    r"typedef\s+([A-Za-z_][\w ]*?[\w*])\s*\(\s*\*\s*(tbrpc_\w+)\s*\)\s*"
+    r"\(([^;{)]*)\)\s*;")
+
+
+def _norm_type(t: str) -> str:
+    """Whitespace/pointer-spacing normalisation of a C type spelling."""
+    return re.sub(r"\s+", " ", t.replace("*", " * ")).strip()
+
+
+def _norm_param(p: str) -> str:
+    p = p.strip()
+    if p in ("", "void", "..."):
+        return p
+    # Drop a trailing identifier (the parameter NAME) when a type precedes
+    # it — renames are ABI-neutral and must not read as drift.
+    m = re.match(r"^(.*?[\s*])([A-Za-z_]\w*)$", p)
+    if m and m.group(1).strip():
+        p = m.group(1)
+    return _norm_type(p)
+
+
+def _capi_signature(ret: str, params: str) -> str:
+    parts = [x for x in (_norm_param(p) for p in params.split(","))
+             if x not in ("", "void")]
+    return f"{_norm_type(ret)}({', '.join(parts)})"
+
+
+def parse_capi(src) -> dict[str, tuple[str, int]]:
+    """{symbol: (normalised signature, lineno)} for the extern-C surface.
+
+    Pointer-returning functions normalise the '*' into the name side and
+    lose it — acceptable: every handle is void* and a return-type change
+    between pointer/non-pointer also changes the spelled type word.
+    """
+    stripped = "\n".join(src.code_lines())
+    out: dict[str, tuple[str, int]] = {}
+    flat = re.sub(r"\s+", " ", stripped)
+    # Line lookup: first line mentioning the symbol.
+    def line_of(symbol: str) -> int:
+        for i, line in enumerate(src.lines, 1):
+            if symbol in line:
+                return i
+        return 1
+
+    for pat, kind in ((_CAPI_TYPEDEF_RE, "typedef"), (_CAPI_FN_RE, "fn")):
+        for m in pat.finditer(flat):
+            ret, name, params = m.groups()
+            prefix = "typedef:" if kind == "typedef" else ""
+            out[prefix + name] = (_capi_signature(ret, params), line_of(name))
+    return out
+
+
 def parse_tidl(src) -> dict[str, dict[str, tuple[int, str, int]]]:
     """{message: {field_name: (tag, wire_type, lineno)}}"""
     messages: dict[str, dict[str, tuple[int, str, int]]] = {}
@@ -84,8 +151,40 @@ class WireContractRule:
             if lock is not None:
                 findings.extend(
                     self._check_lock(src, schema, lock.get(src.path, {})))
+        if lock is not None:
+            for src in ctx.files:
+                if src.path.endswith("capi/capi.h"):
+                    findings.extend(self._check_capi(
+                        src, lock.get(src.path, {}).get("__capi__")))
         findings.extend(self._check_runtime_parity(ctx))
         return findings
+
+    # -- capi ABI drift against the committed lock --------------------------
+    def _check_capi(self, src, locked):
+        if not locked:
+            return []  # no capi section yet: --write-wire-lock adds one
+        out = []
+        current = parse_capi(src)
+        for symbol, lsig in sorted(locked.items()):
+            got = current.get(symbol)
+            if got is None:
+                out.append(Finding(
+                    rule=self.id, path=src.path, line=1,
+                    message=f"capi entry point {symbol} was removed but is "
+                            "still in the wire lock",
+                    hint="the ctypes bindings (brpc_tpu/runtime) may still "
+                         "call it; delete the binding too, then refresh "
+                         "the lock (python -m tools.tpulint "
+                         "--write-wire-lock)"))
+            elif got[0] != lsig:
+                out.append(Finding(
+                    rule=self.id, path=src.path, line=got[1],
+                    message=f"capi signature of {symbol} drifted: lock says "
+                            f"\"{lsig}\", header says \"{got[0]}\"",
+                    hint="ctypes marshals by these signatures — update the "
+                         "argtypes/restype in brpc_tpu/runtime IN THE SAME "
+                         "change, then refresh the lock"))
+        return out
 
     # -- in-schema tag hygiene ---------------------------------------------
     def _check_tags(self, src, schema):
@@ -230,6 +329,13 @@ def snapshot_lock(ctx: LintContext) -> dict:
         entry = lock.setdefault(src.path, {})
         for msg, fields in parse_tidl(src).items():
             entry[msg] = {n: [t, w] for n, (t, w, _ln) in fields.items()}
+    # The extern-C ABI the ctypes bindings mirror, under a reserved key no
+    # tidl message can use.
+    for src in ctx.files:
+        if src.path.endswith("capi/capi.h"):
+            lock.setdefault(src.path, {})["__capi__"] = {
+                sym: sig for sym, (sig, _ln) in sorted(parse_capi(src).items())
+            }
     return lock
 
 
