@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test asan tsan test-asan test-tsan lint lint-sarif clean
+.PHONY: native test asan tsan test-asan test-tsan lint lint-sarif bench-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -17,6 +17,13 @@ lint:
 
 lint-sarif:
 	python -m tools.tpulint --format sarif > tpulint.sarif
+
+# ~10s perf sanity sweep: one subprocess-guarded 64B echo sample + a
+# 4x1MB pipelined pull point. Every sample runs under a hard timeout, so
+# a transport wedge records {"wedged": true} instead of hanging the
+# terminal (or tier-1).
+bench-smoke:
+	python bench.py --smoke
 
 test: native
 	python -m pytest tests/ -x -q

@@ -17,6 +17,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "sweep"}.
 
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -26,15 +27,91 @@ BASELINE_GBPS = 2.3  # reference: multi-connection large-packet echo max
 PAYLOADS = [64, 4096, 65536, 1 << 20, 16 << 20]
 CONCURRENCY = [1, 2, 8, 16]
 
+# Wedge watchdog: every tbrpc_bench_echo_ex sample runs in its OWN
+# subprocess under a hard timeout. The C fiber-caller harness has a known
+# failure mode on this host class (historically the socket-id-0 credit
+# leak — see PERF.md round 6 — plus any future all-threads-park bug):
+# when it strikes, ALL threads park including the timer thread, so no
+# in-process deadline can rescue the run. A killed subprocess records a
+# {"wedged": true} sample and retries instead of hanging the whole bench.
+_ECHO_EX_CHILD = r"""
+import json, sys
+sys.path.insert(0, {root!r})
+from brpc_tpu.runtime import native
+bps, qps, p50, p99 = native.bench_echo_ex(
+    {payload}, seconds={seconds}, concurrency={conc},
+    transport={transport!r}, conn_type={conn_type!r})
+snap = {{}}
+try:
+    from brpc_tpu.observability import metrics as obs
+    for line in obs.dump_vars("rpc_client").splitlines():
+        name, _, value = line.partition(" : ")
+        snap[name.strip()] = value.strip()
+except Exception:
+    pass
+print(json.dumps({{"bps": bps, "qps": qps, "p50": p50, "p99": p99,
+                   "rpc_client": snap}}))
+"""
 
-def best_point(native, payload, transport, seconds=2):
-    """Best (GB/s, qps, p99_us, concurrency) across the concurrency set."""
+
+def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
+                          conn_type, retries=2, wedge_log=None):
+    """One echo sample in a watchdogged subprocess.
+
+    Returns the child's result dict; after `retries` consecutive
+    wedges/failures returns {"wedged": True, "attempts": N} so a stuck
+    transport reads as a recorded finding, not a hung bench run.
+    """
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _ECHO_EX_CHILD.format(root=root, payload=payload, seconds=seconds,
+                                 conc=concurrency, transport=transport,
+                                 conn_type=conn_type)
+    timeout = seconds * 3 + 30  # library load + server spin-up headroom
+    wedges = 0
+    for _ in range(retries + 1):
+        try:
+            proc = subprocess.run(  # tpulint: allow(py-blocking)
+                [sys.executable, "-c", code], capture_output=True,
+                timeout=timeout, text=True)
+            out = proc.stdout.strip().splitlines()
+            if proc.returncode == 0 and out:
+                result = json.loads(out[-1])
+                if wedges:
+                    result["wedged_retries"] = wedges
+                return result
+            if proc.returncode != 0 and proc.stderr:
+                # A fast crash (import error, stale .so) is NOT a wedge:
+                # surface its traceback or the retry loop misdirects the
+                # operator toward the transport.
+                print(f"# bench child rc={proc.returncode}: "
+                      f"{proc.stderr.strip()[-800:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            pass
+        wedges += 1
+        if wedge_log is not None:
+            wedge_log.append({"payload": payload, "concurrency": concurrency,
+                              "transport": transport})
+        print(f"# WEDGED sample: payload={payload} conc={concurrency} "
+              f"transport={transport} (attempt {wedges})", file=sys.stderr)
+    return {"wedged": True, "attempts": wedges}
+
+
+def best_point(payload, transport, seconds=2, wedge_log=None):
+    """Best (GB/s, qps, p99_us, concurrency) across the concurrency set.
+
+    Individual wedged samples are skipped (and logged); if EVERY
+    concurrency level wedges the point raises so the run records a
+    failure rather than a ~0 GB/s result.
+    """
     best = (-1.0, 0.0, 0.0, 0)
     for conc in CONCURRENCY:
-        bps, qps, _p50, p99 = native.bench_echo_ex(
-            payload, seconds=seconds, concurrency=conc,
-            transport=transport, conn_type="pooled" if transport == "tcp"
-            else "single")
+        r = bench_echo_ex_guarded(
+            payload, seconds, conc, transport,
+            "pooled" if transport == "tcp" else "single",
+            wedge_log=wedge_log)
+        if r.get("wedged"):
+            continue
+        bps = r["bps"]
         if bps < 0:
             # Bench env failed (server/channel init) — a broken transport
             # must fail the run, not read as a ~0 GB/s result.
@@ -42,7 +119,11 @@ def best_point(native, payload, transport, seconds=2):
                 f"bench point failed: payload={payload} transport={transport}"
                 f" concurrency={conc}")
         if bps > best[0]:
-            best = (bps, qps, p99, conc)
+            best = (bps, r["qps"], r["p99"], conc)
+    if best[0] < 0:
+        raise RuntimeError(
+            f"every concurrency level wedged: payload={payload} "
+            f"transport={transport}")
     return best
 
 
@@ -56,10 +137,11 @@ def fmt_point(bps, qps, p99, conc):
 
 
 def main() -> None:
-    from brpc_tpu.runtime import native
-
-    # Warmup (first connect + fiber pool spin-up).
-    native.bench_echo_ex(1 << 20, seconds=1, concurrency=2, transport="tpu")
+    wedges = []
+    # Warmup (first connect + fiber pool spin-up) — in its own child like
+    # every sample, so a warmup wedge can't hang the run.
+    bench_echo_ex_guarded(1 << 20, 1, 2, "tpu", "single", retries=0,
+                          wedge_log=wedges)
 
     sweep = {}
     # Headline first: the 1MB point runs in the cleanest process state
@@ -67,25 +149,35 @@ def main() -> None:
     ordered = sorted(PAYLOADS, key=lambda p: p != (1 << 20))
     for payload in ordered:
         seconds = 2 if payload >= (1 << 20) else 1
-        bps, qps, p99, conc = best_point(native, payload, "tpu",
-                                         seconds=seconds)
+        bps, qps, p99, conc = best_point(payload, "tpu", seconds=seconds,
+                                         wedge_log=wedges)
         sweep[f"tpu_{payload}B"] = fmt_point(bps, qps, p99, conc)
         print(f"# tpu {payload}B: {bps / 1e9:.3f} GB/s, {qps:.0f} qps, "
               f"p99 {p99:.0f}us (conc={conc})", file=sys.stderr)
     # TCP comparison at the headline point.
-    bps, qps, p99, conc = best_point(native, 1 << 20, "tcp")
+    bps, qps, p99, conc = best_point(1 << 20, "tcp", wedge_log=wedges)
     sweep["tcp_1048576B"] = fmt_point(bps, qps, p99, conc)
     print(f"# tcp 1MB: {bps / 1e9:.3f} GB/s (conc={conc})", file=sys.stderr)
 
     # Latency mode (conc=1): the un-queued floor — regressions here are
     # invisible in the throughput-optimal rows above (VERDICT r3 weak #3).
     for payload, key in ((64, "lat_tpu_64B"), (1 << 20, "lat_tpu_1MB")):
-        _bps, qps, p50, p99 = native.bench_echo_ex(
-            payload, seconds=2, concurrency=1, transport="tpu")
-        sweep[key] = {"qps": round(qps), "p50_us": round(p50),
-                      "p99_us": round(p99), "concurrency": 1}
-        print(f"# latency {key}: p50 {p50:.0f}us p99 {p99:.0f}us "
-              f"({qps:.0f} qps)", file=sys.stderr)
+        r = bench_echo_ex_guarded(payload, 2, 1, "tpu", "single",
+                                  wedge_log=wedges)
+        if r.get("wedged"):
+            sweep[key] = {"wedged": True}
+            continue
+        sweep[key] = {"qps": round(r["qps"]), "p50_us": round(r["p50"]),
+                      "p99_us": round(r["p99"]), "concurrency": 1}
+        print(f"# latency {key}: p50 {r['p50']:.0f}us p99 {r['p99']:.0f}us "
+              f"({r['qps']:.0f} qps)", file=sys.stderr)
+
+    # Pipelined parameter-server rows (async tensor RPC tentpole): 32x1MB
+    # serial round-trips vs one bounded PipelineWindow, pull and push.
+    try:
+        sweep.update(param_pipeline_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# param pipeline point skipped: {e}", file=sys.stderr)
 
     # Tensor bridge rows (the chartered workload): jax/numpy arrays riding
     # the framework through TensorArena by-reference attachments.
@@ -115,6 +207,9 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# ring attention point skipped: {e}", file=sys.stderr)
 
+    if wedges:
+        sweep["wedged_samples"] = wedges
+
     headline = sweep["tpu_1048576B"]["gbps"]
     tcp = sweep.get("tcp_1048576B", {}).get("gbps", 0.0)
     print(json.dumps({
@@ -136,14 +231,146 @@ def main() -> None:
     }))
 
 
+# The whole serial-vs-pipelined measurement runs in ONE watchdogged child
+# (which spawns the ParameterServer in a FURTHER process: sharing a process
+# would serialize the client loop and the server's Python handlers on one
+# GIL and measure lock contention, not the wire). argv:
+#   n_tensors nbytes window reps pull_only(0/1)
+_PARAM_CHILD = r"""
+import json, statistics, sys, time, subprocess
+sys.path.insert(0, ROOT)
+import numpy as np
+
+n_tensors, nbytes, window, reps, pull_only = (int(a) for a in sys.argv[1:6])
+server_code = (
+    "import sys, json\n"
+    "sys.path.insert(0, %r)\n"
+    "import jax.numpy as jnp\n"
+    "from brpc_tpu.runtime.param_server import ParameterServer\n"
+    "params = {'w%%02d' %% i: jnp.ones((%d // 4,), jnp.float32) * i\n"
+    "          for i in range(%d)}\n"
+    "ps = ParameterServer(params)\n"
+    "print(json.dumps({'port': ps.start()}), flush=True)\n"
+    "sys.stdin.readline()\n"
+    "ps.stop()\n" % (ROOT, nbytes, n_tensors))
+srv = subprocess.Popen([sys.executable, "-c", server_code],
+                       stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                       text=True)
+try:
+    port = json.loads(srv.stdout.readline())["port"]
+    from brpc_tpu.runtime.param_server import ParameterClient
+    client = ParameterClient(f"tpu://127.0.0.1:{port}")
+    names = sorted(client.meta())
+    grads = {n: np.ones(nbytes // 4, np.float32) for n in names}
+    client.pull(names[0])
+    client.pull_all(names[: min(2, len(names))], window=2)
+    if not pull_only:
+        client.push_grad(names[0], grads[names[0]])
+
+    def once(fn):
+        t0 = time.monotonic()
+        fn()
+        return time.monotonic() - t0
+
+    total = n_tensors * nbytes
+    modes = [("pull", lambda: [client.pull(n) for n in names],
+              lambda: client.pull_all(names, window=window))]
+    if not pull_only:
+        modes.append(("push",
+                      lambda: [client.push_grad(n, grads[n]) for n in names],
+                      lambda: client.push_all(grads, window=window)))
+    rows = {}
+    for kind, serial_fn, piped_fn in modes:
+        # INTERLEAVED pairs: this host's steal is bimodal (PERF.md r4) and
+        # a slow window hitting only one mode fabricates or destroys the
+        # comparison; adjacent serial/pipelined runs see the same host
+        # state, so the per-pair ratio is steal-robust. Median of ratios,
+        # alongside median absolute times.
+        ts_samples, tp_samples, ratios = [], [], []
+        for _ in range(reps):
+            ts_i = once(serial_fn)
+            tp_i = once(piped_fn)
+            ts_samples.append(ts_i)
+            tp_samples.append(tp_i)
+            ratios.append(ts_i / tp_i)
+        ts = statistics.median(ts_samples)
+        tp = statistics.median(tp_samples)
+        rows[kind] = {
+            "serial_ms": round(ts * 1e3, 1),
+            "pipelined_ms": round(tp * 1e3, 1),
+            "serial_gbps": round(total / ts / 1e9, 2),
+            "pipelined_gbps": round(total / tp / 1e9, 2),
+            "speedup": round(statistics.median(ratios), 2),
+            "speedup_samples": [round(r, 2) for r in ratios],
+            "window": window, "tensors": n_tensors, "reps": reps,
+        }
+    client.close()
+    print(json.dumps(rows))
+finally:
+    try:
+        srv.stdin.close()
+        srv.wait(timeout=10)
+    except Exception:
+        srv.kill()
+"""
+
+
+def param_pipeline_point(n_tensors=32, nbytes=1 << 20, window=8, reps=7,
+                         pull_only=False, timeout=240):
+    """Serial vs pipelined multi-tensor parameter traffic — the async
+    tensor RPC tentpole rows. N named 1MB parameters cross the wire as N
+    serial `pull`/`push_grad` round-trips, then again through one bounded
+    `PipelineWindow` (`pull_all`/`push_all`); median of `reps` per mode,
+    same process, back to back, so both see the same host conditions.
+    Subprocess-guarded like the echo samples."""
+    code = "ROOT = %r\n%s" % (
+        os.path.dirname(os.path.abspath(__file__)), _PARAM_CHILD)
+    proc = subprocess.run(  # tpulint: allow(py-blocking)
+        [sys.executable, "-c", code, str(n_tensors), str(nbytes),
+         str(window), str(reps), "1" if pull_only else "0"],
+        capture_output=True, timeout=timeout, text=True)
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(f"param pipeline child failed rc={proc.returncode}")
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    size_mb = nbytes >> 20
+    out = {}
+    for kind, row in rows.items():
+        key = f"param_{kind}_all_{n_tensors}x{size_mb}MB"
+        out[key] = row
+        print(f"# {key}: serial {row['serial_gbps']} GB/s -> pipelined "
+              f"{row['pipelined_gbps']} GB/s ({row['speedup']}x, "
+              f"window={row['window']})", file=sys.stderr)
+    return out
+
+
+def smoke() -> None:
+    """`make bench-smoke`: a <=10s-scale sanity sweep — one subprocess-
+    guarded 64B echo sample plus a 4x1MB pipelined pull point — usable as
+    a local perf smoke test that cannot wedge the calling terminal."""
+    wedges = []
+    out = {"echo_64B": bench_echo_ex_guarded(64, 1, 2, "tpu", "single",
+                                             retries=1, wedge_log=wedges)}
+    try:
+        out.update(param_pipeline_point(n_tensors=4, window=4, reps=1,
+                                        pull_only=True, timeout=90))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["param_pull_all_4x1MB"] = {"error": str(e)}
+    if wedges:
+        out["wedged_samples"] = wedges
+    print(json.dumps({"metric": "bench_smoke", "sweep": out}))
+
+
 def recorder_snapshot():
     """Framework-recorder rows for the BENCH json.
 
-    rpc_client_* come from the native GlobalRpcMetrics LatencyRecorder
-    (every client call in this process feeds it — including the C bench
-    loops); tensor_push/tensor_pull are the Python data-plane recorders
-    brpc_tpu/runtime/tensor.py records into. All values are microseconds
-    from the recorders' trailing window, NOT a re-measurement.
+    rpc_client_* come from the native GlobalRpcMetrics LatencyRecorder —
+    since the echo loops moved into watchdogged subprocesses it reflects
+    THIS process's tensor-bridge traffic only (each echo child reports its
+    own rpc_client snapshot in its sample); tensor_push/tensor_pull are
+    the Python data-plane recorders brpc_tpu/runtime/tensor.py records
+    into. All values are microseconds from the recorders' trailing
+    window, NOT a re-measurement.
     """
     from brpc_tpu.observability import metrics as obs
 
@@ -340,4 +567,7 @@ def ring_attention_point():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv[1:]:
+        smoke()
+    else:
+        main()

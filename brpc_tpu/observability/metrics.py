@@ -188,3 +188,19 @@ def dump_prometheus() -> str:
     L = native.lib()
     return _snapshot_buf(L.tbrpc_vars_dump_prometheus).decode(
         errors="replace")
+
+
+def dump_fibers() -> str:
+    """Every live fiber with state and (for parked fibers) a symbolized
+    stack — the /fibers page, reachable from a plain watchdog thread even
+    when every fiber worker is parked (hang forensics)."""
+    L = native.lib()
+    return _snapshot_buf(L.tbrpc_debug_dump_fibers).decode(errors="replace")
+
+
+def dump_ici() -> str:
+    """Sender/receiver state of every live tpu:// endpoint (TX credit
+    level, pending control bytes, parked-writer flags) — the companion
+    view to dump_fibers for wedge hunting."""
+    L = native.lib()
+    return _snapshot_buf(L.tbrpc_debug_dump_ici).decode(errors="replace")

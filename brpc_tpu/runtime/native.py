@@ -100,7 +100,7 @@ def lib() -> ctypes.CDLL:
     if not os.path.exists(_LIB_PATH):
         _build_native()
     L = ctypes.CDLL(_LIB_PATH)
-    if not hasattr(L, "tbrpc_var_arena_gauges_create"):
+    if not hasattr(L, "tbrpc_call_tensor_async"):
         # Stale build from before the current bindings: the handler ABI
         # carries extra out-params now, so using it would marshal garbage
         # (not just miss symbols). Rebuild — and verify the reload took:
@@ -108,7 +108,7 @@ def lib() -> ctypes.CDLL:
         # handle back and only a fresh process can pick up the new build.
         _build_native()
         L = ctypes.CDLL(_LIB_PATH)
-        if not hasattr(L, "tbrpc_var_arena_gauges_create"):
+        if not hasattr(L, "tbrpc_call_tensor_async"):
             raise RuntimeError(
                 "libbrpc_tpu.so was built before the current bindings and "
                 "the stale mapping is already loaded in this process; the "
@@ -172,6 +172,13 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_rpcz_dump_json.restype = ctypes.c_int64
     L.tbrpc_rpcz_dump_json.argtypes = [
         ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
+    # Hang forensics: callable from ANY plain pthread even when every
+    # fiber worker is parked (how the socket-id-0 credit-leak wedge was
+    # root-caused — see PERF.md round 6).
+    L.tbrpc_debug_dump_fibers.restype = ctypes.c_int64
+    L.tbrpc_debug_dump_fibers.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_debug_dump_ici.restype = ctypes.c_int64
+    L.tbrpc_debug_dump_ici.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     L.tbrpc_rpcz_enabled.restype = ctypes.c_int
     L.tbrpc_rpcz_set_enabled.argtypes = [ctypes.c_int]
     L.tbrpc_trace_new_id.restype = ctypes.c_uint64

@@ -20,6 +20,7 @@ by tests/test_tensor_bridge.py).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import weakref
@@ -30,7 +31,8 @@ import numpy as np
 
 from brpc_tpu.ops.fused_update import fused_momentum_update
 from brpc_tpu.runtime import native
-from brpc_tpu.runtime.tensor import (TensorArena, TensorChannel,
+from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
+                                     TensorChannel, _device_put_from_view,
                                      add_tensor_service)
 
 # Process-wide recorders (brpc_tpu/observability): every ParameterServer
@@ -71,11 +73,47 @@ class ParameterServer:
 
     def __init__(self, params: Dict[str, jax.Array], lr: float = 0.01,
                  momentum: float = 0.9, arena: Optional[TensorArena] = None):
-        self._params = dict(params)
-        self._momenta = {k: jax.numpy.zeros_like(v)
-                         for k, v in self._params.items()}
+        # Backend split for the Push hot path. On TPU the update is the
+        # fused Pallas kernel over device arrays (device_put = a real H2D
+        # DMA). On the CPU backend that same shape is all dispatch
+        # overhead: per-push jax dispatch (~0.5ms) dominated the pipelined
+        # bench, and device_put ZERO-COPY ALIASES 64B-aligned host buffers
+        # — with the update dispatched async, the grad view's arena range
+        # could be reused under the pending computation. The CPU path
+        # keeps params/momenta as numpy and applies the update
+        # synchronously, reading straight from the request view (safe:
+        # the read completes before the handler returns and the view
+        # releases) — but COPY-ON-WRITE, never in place; see
+        # _apply_update for why handed-out arrays must stay immutable.
+        self._on_device = jax.default_backend() == "tpu"
+        if self._on_device:
+            self._params = dict(params)
+            self._momenta = {k: jax.numpy.zeros_like(v)
+                             for k, v in self._params.items()}
+        else:
+            self._params = {k: np.array(v) for k, v in params.items()}
+            self._momenta = {k: np.zeros_like(v)
+                             for k, v in self._params.items()}
         self._version = {k: 0 for k in self._params}
         self._lr = lr
+        self._momentum = momentum
+        # Per-parameter update locks: pushes to the SAME name must
+        # serialize (momentum reads its own previous write), but pushes to
+        # different names are independent — and numpy releases the GIL for
+        # the 1MB elementwise math, so pipelined pushes of a sharded model
+        # really do update in parallel. _mu stays the dict/version lock
+        # and is never held while an update lock is taken... the update
+        # lock is taken FIRST (fixed order, no cycle).
+        self._update_locks = {k: threading.Lock() for k in self._params}
+        # Update admission: a pipelined client parks a whole window of
+        # pushes on the server at once, and running every update's math
+        # concurrently just thrashes the cores the transport needs (the
+        # math releases the GIL, so an unbounded pool really does fan
+        # out). Cap concurrent update computations near the core count;
+        # excess handlers queue on the semaphore (pool pthreads — safe to
+        # block) with the wire already overlapped.
+        self._update_sem = threading.BoundedSemaphore(
+            min(4, max(2, os.cpu_count() or 2)))
         self._mu = threading.Lock()  # handlers run on callback-pool threads
         # Lock-free mirror of max(version)-min(version), updated by Push
         # under _mu, read by the version-lag gauge without it.
@@ -123,28 +161,54 @@ class ParameterServer:
             if att is None:
                 raise native.RpcError(2002, "push without gradient")
             t0 = time.monotonic()
-            with tracing.stage("device_put"):
-                grad = jax.device_put(np.ascontiguousarray(att))
-            with self._mu:
-                # Dispatch-only timing: blocking on device completion here
-                # would serialize Pull/Meta (and the version-lag gauge)
-                # behind every update's device round-trip; JAX's async
-                # dispatch already orders later reads of the new arrays.
-                with tracing.stage("fused_update"):
-                    p, m = fused_momentum_update(
-                        self._params[name], self._momenta[name],
-                        grad.astype(self._params[name].dtype),
-                        lr=self._lr)
-                self._params[name] = p
-                self._momenta[name] = m
-                self._version[name] += 1
-                version = self._version[name]
-                vs = self._version.values()
-                self._version_spread = max(vs) - min(vs)
+            self._update_sem.acquire()
+            try:
+                version = self._apply_update(name, att, tracing)
+            finally:
+                self._update_sem.release()
             self._m["push"].record_s(time.monotonic() - t0)
             self._m["push_bytes"].add(att.nbytes)
             return str(version).encode(), None
         raise native.RpcError(2007, f"no such method: {method}")
+
+    def _apply_update(self, name: str, att, tracing) -> int:
+        if self._on_device:
+            with tracing.stage("device_put"):
+                # H2D DMA from the request view, completed (and thus
+                # detached from the arena pages) before the handler
+                # returns and the view's range can be reused.
+                grad = _device_put_from_view(np.ascontiguousarray(att), None)
+        with self._update_locks[name]:
+            with self._mu:
+                p = self._params[name]
+                m = self._momenta[name]
+            with tracing.stage("fused_update"):
+                if self._on_device:
+                    # Dispatch-only: blocking on device completion here
+                    # would serialize every update behind its device
+                    # round-trip; JAX's async dispatch already orders
+                    # later reads of the new arrays.
+                    p2, m2 = fused_momentum_update(
+                        p, m, grad.astype(p.dtype),
+                        lr=self._lr, beta=self._momentum)
+                else:
+                    # Copy-on-write numpy momentum step, read straight
+                    # from the zero-copy view. NOT in-place: a Pull's
+                    # response staging copies the returned array after
+                    # the handler drops _mu, so arrays must stay
+                    # immutable once handed out (same discipline as the
+                    # jax path's functional update).
+                    g = att.astype(p.dtype, copy=False)
+                    m2 = self._momentum * m + g
+                    p2 = p - self._lr * m2
+            with self._mu:
+                self._params[name] = p2
+                self._momenta[name] = m2
+                self._version[name] += 1
+                version = self._version[name]
+                vs = self._version.values()
+                self._version_spread = max(vs) - min(vs)
+        return version
 
 
 class ParameterClient:
@@ -170,6 +234,65 @@ class ParameterClient:
         payload = self.channel.push_device("ParamService/Push", grad,
                                            request=name.encode())
         return int(payload.decode())
+
+    # ---- pipelined multi-tensor hot path (PipelineWindow) ----
+    # The serial pull/push above pay one full round-trip per tensor: a
+    # model with N parameter tensors pays N x the ~260us 1MB latency
+    # floor (PERF.md round 3) although the transport sustains ~3x the
+    # single-stream throughput at conc=8 (BENCH r05). These keep a
+    # bounded window of RPCs in flight instead, so N tensors cost ~1
+    # round-trip plus N wire times.
+
+    def pull_all(self, names=None, device=None, window: int = 4
+                 ) -> Dict[str, tuple]:
+        """Pull many parameters through one bounded pipeline window.
+
+        -> ``{name: (version, jax.Array)}``. Every tensor is
+        ``jax.device_put`` STRAIGHT from its zero-copy response view (the
+        peer's arena pages) — no intermediate host copy — overlapped with
+        the wire transfer of the next tensor. ``names=None`` pulls every
+        parameter the server's Meta lists.
+        """
+        from brpc_tpu.runtime.tensor import _metrics, consume_pull_reply
+
+        if names is None:
+            names = sorted(self.meta())
+        m = _metrics()
+        out: Dict[str, tuple] = {}
+
+        def on_reply(name, payload, view):
+            rest, dev, nbytes = consume_pull_reply(payload, view, device)
+            m["pull_bytes"].add(nbytes)
+            out[name] = (int(rest.decode()), dev)
+
+        with PipelineWindow(self.channel, window, on_reply=on_reply) as win:
+            for name in names:
+                win.submit("ParamService/Pull", request=name.encode(),
+                           tag=name)
+        return out
+
+    def push_all(self, grads: Dict[str, object], window: int = 4
+                 ) -> Dict[str, int]:
+        """Push many gradients through one bounded pipeline window.
+
+        -> ``{name: new_version}``. Staging (D2H + arena memcpy) of
+        gradient k+1 overlaps the wire transfer of gradient k; the client
+        arena never holds more than ``window`` staged gradients.
+        """
+        from brpc_tpu.runtime.tensor import _metrics
+        m = _metrics()
+        versions: Dict[str, int] = {}
+
+        def on_reply(name, payload, view):
+            view.release()  # push responses carry no tensor
+            versions[name] = int(payload.decode())
+
+        with PipelineWindow(self.channel, window, on_reply=on_reply) as win:
+            for name, grad in grads.items():
+                win.submit("ParamService/Push", array=grad,
+                           request=name.encode(), tag=name)
+                m["push_bytes"].add(int(getattr(grad, "nbytes", 0)))
+        return versions
 
     def close(self) -> None:
         self.channel.close()

@@ -27,7 +27,9 @@ from __future__ import annotations
 import ctypes
 import json
 import struct
+import threading
 import time
+from collections import deque
 from typing import Callable, Optional, Tuple
 
 import numpy as np
@@ -70,9 +72,46 @@ def _bind_tensor_api(L: ctypes.CDLL) -> ctypes.CDLL:
     L.tbrpc_view_free.argtypes = [ctypes.c_void_p]
     L.tbrpc_server_add_tensor_service.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, _TENSOR_CB, ctypes.c_void_p]
+    # ---- async tensor RPC (futures over the native async CallMethod) ----
+    L.tbrpc_call_tensor_async.restype = ctypes.c_void_p
+    L.tbrpc_call_tensor_async.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_size_t,
+        _TENSOR_DONE_CB, ctypes.c_void_p]
+    _future_outs = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_future_wait.restype = ctypes.c_int
+    L.tbrpc_future_wait.argtypes = [ctypes.c_void_p] + _future_outs
+    L.tbrpc_future_timed_wait.restype = ctypes.c_int
+    L.tbrpc_future_timed_wait.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64] + _future_outs
+    L.tbrpc_future_cancel.restype = ctypes.c_int
+    L.tbrpc_future_cancel.argtypes = [ctypes.c_void_p]
+    L.tbrpc_future_destroy.argtypes = [ctypes.c_void_p]
+    L.tbrpc_async_inflight.restype = ctypes.c_int64
+    L.tbrpc_async_inflight.argtypes = []
     L._tensor_api_bound = True
     return L
 
+
+# Completion notification for tbrpc_call_tensor_async: fired on a
+# callback-pool pthread BEFORE the future becomes waitable, with the same
+# values a wait would return — ownership stays with the future (the
+# callback must not free anything).
+_TENSOR_DONE_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,                    # ctx
+    ctypes.c_int,                       # status (0 = ok)
+    ctypes.c_void_p, ctypes.c_size_t,   # resp
+    ctypes.c_void_p,                    # view handle
+    ctypes.c_void_p, ctypes.c_size_t,   # ratt ptr/len
+    ctypes.c_int,                       # ratt_copied
+    ctypes.c_char_p,                    # err_text
+)
 
 _TENSOR_CB = ctypes.CFUNCTYPE(
     None,
@@ -131,6 +170,36 @@ def _stage(name):
     return tracing.stage(name)
 
 
+# ---- pipeline in-flight gauge ----
+# One process-wide gauge over every live PipelineWindow (tbvar names are a
+# process-wide namespace — per-window registrations would collide); the
+# native capi keeps its own `tensor_rpc_inflight` twin counting ALL async
+# tensor RPCs, windowed or not.
+
+_pipeline_mu = threading.Lock()
+_pipeline_inflight = 0
+
+# Anchors for in-flight completion-notification trampolines: a future
+# dropped mid-flight must not let GC free a CFUNCTYPE the native side is
+# about to call. Fired notifications remove themselves; a canceled future
+# whose notification never fires leaks one small object (rare, bounded by
+# the caller's cancel rate). A list, not a set — ctypes function pointers
+# are unhashable.
+_live_done_cbs: list = []
+
+
+def _pipeline_inflight_add(delta: int) -> None:
+    global _pipeline_inflight
+    with _pipeline_mu:
+        _pipeline_inflight += delta
+
+
+def _pipeline_gauge() -> None:
+    from brpc_tpu.observability import metrics as obs
+
+    obs.gauge("tensor_pipeline_inflight", lambda: _pipeline_inflight)
+
+
 def _encode_meta(arr: np.ndarray) -> bytes:
     meta = json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)})
     return struct.pack("<I", len(meta)) + meta.encode()
@@ -146,6 +215,26 @@ def _as_host_array(array) -> np.ndarray:
     """jax.Array -> host np.ndarray (one D2H DMA on TPU; zero-copy view on
     the CPU backend); np.ndarray passes through."""
     return np.asarray(array)
+
+
+def _device_put_from_view(arr: np.ndarray, device):
+    """``jax.device_put`` an array that VIEWS arena/view pages, safely.
+
+    On a real accelerator this is the zero-copy discipline: the H2D DMA
+    copies by definition, so the view can be released the moment
+    ``block_until_ready`` returns. On the CPU backend, XLA ZERO-COPY
+    ALIASES 64-byte-aligned host buffers — and arena ranges are 64B-
+    aligned — so the "device" array would keep pointing into pages the
+    release hands back for reuse. Detach with a host copy there first.
+    """
+    import jax
+
+    target = device if device is not None else jax.devices()[0]
+    if getattr(target, "platform", "cpu") == "cpu":
+        arr = np.array(arr)
+    dev = jax.device_put(arr, device)
+    dev.block_until_ready()  # transfer completes before the view release
+    return dev
 
 
 class TensorArena:
@@ -269,6 +358,243 @@ class TensorView:
             pass
 
 
+def consume_pull_reply(payload: bytes, view: "TensorView", device=None):
+    """Decode a pulled-tensor reply and device_put it straight from the
+    zero-copy view, releasing the view once the transfer completed.
+    Returns ``(rest_of_payload, jax.Array, nbytes)``.
+
+    ONE implementation for the sync ``pull_device`` and the pipelined
+    consumers (``ParameterClient.pull_all``'s on_reply) so the decode path
+    and its aliasing discipline cannot drift apart.
+    """
+    with view:
+        dtype, shape, rest = _decode_meta(payload)
+        arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
+        nbytes = view.nbytes
+        with _stage("device_put"):
+            dev = _device_put_from_view(arr, device)
+    return rest, dev, nbytes
+
+
+class TensorFuture:
+    """One in-flight async tensor RPC (``TensorChannel.call_async``).
+
+    ``result()`` parks the calling thread until the response arrives and
+    returns ``(payload, TensorView)`` — the exact ownership contract of
+    the sync ``call_raw`` (release the view once the bytes are consumed).
+    Results are cached on first take, so repeated ``result()`` calls
+    return the same objects, and the future stays valid after its channel
+    closes (the native controller owns everything it needs).
+
+    ``cancel()`` ends an in-flight RPC with ECANCELED; ``close()`` (or
+    GC) on a never-waited future cancels it and lets the native side
+    release the response exactly once, whichever way the race goes.
+    """
+
+    def __init__(self, L, handle, service_method, done_cb=None):
+        self._L = L
+        self._h = handle
+        self._method = service_method
+        self._cb = done_cb  # the ctypes trampoline must outlive the RPC
+        self._payload = None
+        self._view: Optional[TensorView] = None
+        self._error: Optional[RpcError] = None
+        self._taken = False
+
+    def done(self) -> bool:
+        """Non-blocking completion probe (moves a ready native result
+        into the Python-side cache)."""
+        return self._taken or self._poll(0)
+
+    def result(self, timeout_ms: int = -1) -> Tuple[bytes, TensorView]:
+        """Wait for completion -> (payload, view). ``timeout_ms >= 0``
+        raises TimeoutError if still in flight (retry later); RPC
+        failures raise RpcError."""
+        if not self._taken and not self._poll(timeout_ms):
+            raise TimeoutError(
+                f"{self._method}: still in flight after {timeout_ms}ms")
+        if self._error is not None:
+            raise self._error
+        return self._payload, self._view
+
+    def _poll(self, timeout_ms: int) -> bool:
+        if not self._h:
+            raise RuntimeError("future is closed")
+        L = self._L
+        resp = ctypes.c_void_p()
+        resp_len = ctypes.c_size_t()
+        view = ctypes.c_void_p()
+        ratt = ctypes.c_void_p()
+        ratt_len = ctypes.c_size_t()
+        copied = ctypes.c_int()
+        errbuf = ctypes.create_string_buffer(256)
+        outs = (ctypes.byref(resp), ctypes.byref(resp_len),
+                ctypes.byref(view), ctypes.byref(ratt),
+                ctypes.byref(ratt_len), ctypes.byref(copied),
+                errbuf, len(errbuf))
+        if timeout_ms < 0:
+            rc = L.tbrpc_future_wait(self._h, *outs)
+        else:
+            rc = L.tbrpc_future_timed_wait(self._h, timeout_ms, *outs)
+            if rc == -1:
+                return False  # still in flight; nothing consumed
+        self._taken = True
+        if rc != 0:
+            self._error = RpcError(rc, errbuf.value.decode(errors="replace"))
+        else:
+            try:
+                self._payload = (ctypes.string_at(resp, resp_len.value)
+                                 if resp_len.value else b"")
+            finally:
+                L.tbrpc_free(resp)
+            self._view = TensorView(L, view.value, ratt.value,
+                                    ratt_len.value, bool(copied.value))
+        self.close()  # ownership is out; the native box is spent
+        return True
+
+    def cancel(self) -> None:
+        """Cancel an in-flight RPC (later ``result()`` raises RpcError
+        ECANCELED); a completed-but-unconsumed response is released now,
+        exactly once. No-op once the result was taken."""
+        if self._h and not self._taken:
+            self._L.tbrpc_future_cancel(self._h)
+
+    def close(self) -> None:
+        """Release the native future (idempotent). In flight: cancels;
+        the completion path frees the response."""
+        if self._h:
+            self._L.tbrpc_future_destroy(self._h)
+            self._h = None
+            # The notification trampoline unanchors ITSELF when it fires
+            # (_notify); dropping our ref here is enough — a close that
+            # races an unfired notification leaves the anchor in place.
+            self._cb = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class PipelineWindow:
+    """Bounded-window pipelining over one ``TensorChannel``.
+
+    Keeps up to ``window`` tensor RPCs in flight, overlapping the arena
+    staging (D2H DMA + memcpy) of tensor k+1 with the wire transfer of
+    tensors k, k-1, ... Submission order == delivery order: a full window
+    completes the OLDEST call before staging the next, and each range is
+    freed as its RPC completes — so the arena holds at most ``window``
+    staged chunks (window x chunk bytes) at any moment, double-buffered
+    against the wire.
+
+    Results are handed to ``on_reply(tag, payload, view)`` in submit
+    order on the submitting thread (release the view as soon as the bytes
+    are consumed), or — without ``on_reply`` — collected by ``flush()``
+    as ``[(tag, payload, view), ...]``.
+
+    Observability: submissions ride the process-wide
+    ``tensor_pipeline_inflight`` gauge, and the staging/drain phases
+    annotate the active rpcz span as ``arena_stage`` / ``wire_wait``.
+    """
+
+    def __init__(self, channel: "TensorChannel", window: int = 4,
+                 on_reply: Optional[Callable] = None):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.channel = channel
+        self.window = window
+        self.on_reply = on_reply
+        self._q: deque = deque()  # (tag, future, arena_off, arena_len)
+        self._results: list = []
+        _pipeline_gauge()
+
+    def inflight(self) -> int:
+        return len(self._q)
+
+    def submit(self, service_method: str, array=None, request: bytes = b"",
+               tag=None) -> None:
+        """Stage ``array`` (optional) into the channel arena and start
+        the RPC; blocks only while the window is full (draining the
+        oldest in-flight call first)."""
+        while len(self._q) >= self.window:
+            self._complete_oldest()
+        off = length = 0
+        if array is not None:
+            with _stage("arena_stage"):
+                off, length, host = self.channel.place_with_meta(array)
+            request = _encode_meta(host) + request
+        try:
+            fut = self.channel.call_async(service_method, request, off,
+                                          length)
+        except Exception:
+            # Not in _q yet, so abort()/flush() would never free it — a
+            # caller surviving transient submit failures must not leak one
+            # staged chunk per retry.
+            if length:
+                self.channel.arena.free(off)
+            raise
+        _pipeline_inflight_add(1)
+        self._q.append((tag, fut, off, length))
+
+    def _complete_oldest(self) -> None:
+        tag, fut, off, length = self._q.popleft()
+        try:
+            with _stage("wire_wait"):
+                payload, view = fut.result()
+        finally:
+            _pipeline_inflight_add(-1)
+            if length:
+                self.channel.arena.free(off)  # deferred until refs drain
+        if self.on_reply is not None:
+            try:
+                self.on_reply(tag, payload, view)
+            except Exception:
+                # The view was handed out but is in neither _q nor
+                # _results: release here or the PEER's range never drains
+                # (releasing twice is safe — release() is idempotent).
+                view.release()
+                raise
+        else:
+            self._results.append((tag, payload, view))
+
+    def flush(self) -> list:
+        """Drain the window; returns (and clears) collected results when
+        no ``on_reply`` consumer was given."""
+        while self._q:
+            self._complete_oldest()
+        out, self._results = self._results, []
+        return out
+
+    def abort(self) -> None:
+        """Error-path teardown: cancel and release everything in flight
+        and every undelivered collected result."""
+        while self._q:
+            _tag, fut, off, length = self._q.popleft()
+            _pipeline_inflight_add(-1)
+            try:
+                fut.cancel()
+                fut.close()
+            finally:
+                if length:
+                    self.channel.arena.free(off)
+        for _tag, _payload, view in self._results:
+            try:
+                view.release()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._results = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *_exc):
+        if exc_type is None:
+            self.flush()
+        else:
+            self.abort()
+
+
 class TensorChannel:
     """Client stub for tensor traffic: a ``tpu://`` channel plus a local
     arena the outbound tensors stage through."""
@@ -317,6 +643,45 @@ class TensorChannel:
         return payload, TensorView(L, view.value, ratt.value, ratt_len.value,
                                    bool(copied.value))
 
+    def call_async(self, service_method: str, request: bytes = b"",
+                   att_off: int = 0, att_len: int = 0,
+                   on_done: Optional[Callable[[int], None]] = None
+                   ) -> TensorFuture:
+        """Submit one RPC without blocking: ``call_raw``'s async twin,
+        returning a :class:`TensorFuture`. The arena range (if any) takes
+        its local reference before this returns, so ``arena.free`` any
+        time after submission is safe (deferred-free semantics).
+
+        ``on_done(status)`` (optional) fires on a callback-pool pthread
+        before the future becomes waitable — a light notification hook
+        (wake an event loop); consume results via ``future.result()``,
+        never inside the hook."""
+        if not self._h:
+            raise RuntimeError("tensor channel is closed")
+        L = self._L
+        cb = ctypes.cast(None, _TENSOR_DONE_CB)  # NULL fn ptr: no hook
+        if on_done is not None:
+            def _notify(_ctx, status, *_rest):
+                try:
+                    on_done(status)
+                except Exception:  # noqa: BLE001 — a notification hook
+                    pass           # must not unwind into the pool thread
+                finally:
+                    try:
+                        _live_done_cbs.remove(cb)
+                    except ValueError:
+                        pass
+
+            cb = _TENSOR_DONE_CB(_notify)
+            _live_done_cbs.append(cb)
+        h = L.tbrpc_call_tensor_async(
+            self._h, service_method.encode(), request, len(request),
+            self.arena.handle if att_len else None, att_off, att_len,
+            cb, None)
+        if not h:
+            raise RpcError(2004, f"async submit of {service_method} failed")
+        return TensorFuture(L, h, service_method, done_cb=cb)
+
     def call(self, service_method: str, array=None, request: bytes = b""
              ) -> Tuple[bytes, Optional[np.ndarray]]:
         """Send a tensor (or nothing), receive a tensor (or nothing).
@@ -359,18 +724,10 @@ class TensorChannel:
         Observability: records into the tensor_pull LatencyRecorder and
         tensor_pull_bytes counter, and annotates the active rpcz span with
         the rpc / device_put stage split."""
-        import jax
-
         t0 = time.monotonic()
         with _stage("rpc"):
             payload, view = self.call_raw(service_method, request)
-        with view:
-            dtype, shape, rest = _decode_meta(payload)
-            arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
-            nbytes = view.nbytes
-            with _stage("device_put"):
-                dev = jax.device_put(arr, device)
-                dev.block_until_ready()  # H2D completes before the release
+        rest, dev, nbytes = consume_pull_reply(payload, view, device)
         m = _metrics()
         m["pull"].record_s(time.monotonic() - t0)
         m["pull_bytes"].add(nbytes)

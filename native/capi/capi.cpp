@@ -14,7 +14,9 @@
 #include <vector>
 
 #include "tbthread/fiber.h"
+#include "tbthread/fiber_id.h"
 #include "tbthread/sync.h"
+#include "tbthread/tracer.h"
 #include "tbutil/json.h"
 #include "tbutil/time.h"
 #include "tbvar/tbvar.h"
@@ -23,6 +25,7 @@
 #include "trpc/flags.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
+#include "ttpu/ici_segment.h"
 #include "ttpu/tensor_arena.h"
 
 using namespace trpc;
@@ -504,6 +507,309 @@ int tbrpc_call_tensor(void* channel, const char* service_method,
 
 void tbrpc_view_free(void* view) { delete static_cast<ViewBox*>(view); }
 
+// ---------------- async tensor RPC ----------------
+
+namespace {
+
+std::atomic<int64_t> g_async_inflight{0};
+
+// Native gauge over the submit/completion counter: evaluated entirely in
+// C++ at scrape time, like the arena occupancy gauges. Idempotent.
+void async_inflight_gauge_create() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    (new tbvar::PassiveStatus<int64_t>([] {
+      return g_async_inflight.load(std::memory_order_relaxed);
+    }))->expose("tensor_rpc_inflight");
+  });
+}
+
+// One in-flight async tensor RPC. Shared between the caller's handle and
+// the completion closure: `refs` starts at 2 and whoever drops the last
+// reference deletes. Waiters are plain pthreads (ctypes releases the GIL
+// around the wait), so a std::mutex/condition_variable pair is the right
+// primitive — never fiber waiters.
+struct FutureBox {
+  std::mutex mu;  // tpulint: allow(fiber-blocking) — pthread waiters only
+  std::condition_variable cv;
+  int refs = 2;            // caller handle + completion closure
+  bool done = false;
+  bool abandoned = false;  // cancel/destroy: results released, not handed out
+  bool consumed = false;   // a wait transferred ownership out
+  int rc = 0;
+  std::string err;
+  void* resp = nullptr;
+  size_t resp_len = 0;
+  void* view = nullptr;
+  const void* ratt_ptr = nullptr;
+  size_t ratt_len = 0;
+  int ratt_copied = 0;
+  tbrpc_tensor_done_cb cb = nullptr;
+  void* cb_ctx = nullptr;
+  Controller cntl;
+  tbutil::IOBuf response;
+
+  ~FutureBox() { ReleaseResultsLocked(); }  // sole owner by then
+
+  // Free unconsumed result buffers; idempotent (fields nulled) so the
+  // cancel-then-destroy sequence releases the response view exactly once.
+  void ReleaseResultsLocked() {
+    if (view != nullptr) {
+      tbrpc_view_free(view);
+    } else if (ratt_copied && ratt_ptr != nullptr) {
+      free(const_cast<void*>(ratt_ptr));
+    }
+    view = nullptr;
+    ratt_ptr = nullptr;
+    ratt_len = 0;
+    ratt_copied = 0;
+    free(resp);
+    resp = nullptr;
+    resp_len = 0;
+  }
+};
+
+// Completion closure body: runs wherever EndRPC ran done->Run() — a fiber
+// on the response path, the canceling pthread on the cancel path. Extracts
+// results exactly as the sync tbrpc_call_tensor does (view deferral
+// included), fires the notification callback, then publishes.
+void async_on_done(FutureBox* fut) {
+  Controller& cntl = fut->cntl;
+  int rc = 0;
+  std::string err;
+  void* resp = nullptr;
+  size_t resp_len = 0;
+  void* view = nullptr;
+  const void* ratt_ptr = nullptr;
+  size_t ratt_len = 0;
+  int ratt_copied = 0;
+  if (cntl.Failed()) {
+    // Never -1 here: -1 is tbrpc_future_timed_wait's "still in flight".
+    rc = cntl.ErrorCode() != 0 ? cntl.ErrorCode() : TRPC_EINTERNAL;
+    err = cntl.ErrorText();
+  } else {
+    resp_len = fut->response.size();
+    resp = malloc(resp_len > 0 ? resp_len : 1);
+    fut->response.copy_to(resp, resp_len);
+    tbutil::IOBuf& att = cntl.response_attachment();
+    ratt_len = att.size();
+    if (ratt_len > 0) {
+      if (att.backing_block_num() == 1) {
+        // Contiguous: hand back in place; the view keeps the block — and
+        // through its deleter the remote arena range — alive until
+        // tbrpc_view_free (sync-path parity).
+        auto* vb = new ViewBox;
+        vb->buf.append(att);
+        view = vb;
+        ratt_ptr = vb->buf.backing_block(0).data();
+      } else {
+        void* flat = malloc(ratt_len);
+        att.copy_to(flat, ratt_len);
+        ratt_ptr = flat;
+        ratt_copied = 1;
+      }
+    }
+  }
+  bool abandoned;
+  {
+    std::lock_guard<std::mutex> lk(fut->mu);  // tpulint: allow(fiber-blocking)
+    abandoned = fut->abandoned;
+    fut->rc = rc;
+    fut->err = std::move(err);
+    if (!abandoned) {
+      fut->resp = resp;
+      fut->resp_len = resp_len;
+      fut->view = view;
+      fut->ratt_ptr = ratt_ptr;
+      fut->ratt_len = ratt_len;
+      fut->ratt_copied = ratt_copied;
+    }
+  }
+  if (abandoned) {
+    // Canceled/destroyed before the response: nobody will consume.
+    // Releasing HERE (not in destroy) is what makes the release happen
+    // exactly once whichever side wins the race.
+    if (view != nullptr) {
+      tbrpc_view_free(view);
+    } else if (ratt_copied && ratt_ptr != nullptr) {
+      free(const_cast<void*>(ratt_ptr));
+    }
+    free(resp);
+  } else if (fut->cb != nullptr) {
+    // Notification BEFORE the future becomes waitable: the waiter cannot
+    // consume (and free) the buffers the callback is reading. Python
+    // callbacks need a pthread-stable thread (GIL pairing); pool
+    // saturation drops the notification, never the completion.
+    tbrpc_tensor_done_cb cb = fut->cb;
+    void* cb_ctx = fut->cb_ctx;
+    PyCallbackPool::instance().Run([&] {
+      cb(cb_ctx, fut->rc, fut->resp, fut->resp_len, fut->view,
+         fut->ratt_ptr, fut->ratt_len, fut->ratt_copied, fut->err.c_str());
+    });
+  }
+  g_async_inflight.fetch_sub(1, std::memory_order_relaxed);
+  bool del;
+  {
+    std::lock_guard<std::mutex> lk(fut->mu);  // tpulint: allow(fiber-blocking)
+    // A cancel/destroy that raced in AFTER the store above (abandoned
+    // flipped between the two critical sections) would otherwise strand
+    // the stored buffers until destroy: release promptly, exactly once.
+    if (fut->abandoned && !fut->consumed) fut->ReleaseResultsLocked();
+    fut->done = true;
+    del = (--fut->refs == 0);
+    // Notify UNDER the lock: a waiter may consume, destroy the handle and
+    // free the box the moment its predicate-wait returns — which it
+    // cannot do before we release.
+    if (!del) fut->cv.notify_all();
+  }
+  if (del) delete fut;  // handle already destroyed; no waiter can exist
+}
+
+// Hand results out under fut->mu. First successful take transfers
+// ownership; later calls (or abandoned futures) return the code with
+// every out zeroed.
+int future_take_locked(FutureBox* fut, void** resp, size_t* resp_len,
+                       void** view, const void** ratt_ptr, size_t* ratt_len,
+                       int* ratt_copied, char* errbuf, size_t errbuf_len) {
+  if (resp != nullptr) *resp = nullptr;
+  if (resp_len != nullptr) *resp_len = 0;
+  if (view != nullptr) *view = nullptr;
+  if (ratt_ptr != nullptr) *ratt_ptr = nullptr;
+  if (ratt_len != nullptr) *ratt_len = 0;
+  if (ratt_copied != nullptr) *ratt_copied = 0;
+  if (fut->abandoned) {
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s", "rpc canceled by caller");
+    }
+    return TRPC_ECANCELED;
+  }
+  if (fut->rc != 0) {
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s", fut->err.c_str());
+    }
+    return fut->rc;
+  }
+  if (fut->consumed) return 0;  // second wait: success code, zeroed outs
+  fut->consumed = true;
+  if (resp != nullptr) *resp = fut->resp;
+  if (resp_len != nullptr) *resp_len = fut->resp_len;
+  if (view != nullptr) *view = fut->view;
+  if (ratt_ptr != nullptr) *ratt_ptr = fut->ratt_ptr;
+  if (ratt_len != nullptr) *ratt_len = fut->ratt_len;
+  if (ratt_copied != nullptr) *ratt_copied = fut->ratt_copied;
+  fut->resp = nullptr;
+  fut->view = nullptr;
+  fut->ratt_ptr = nullptr;
+  return 0;
+}
+
+}  // namespace
+
+void* tbrpc_call_tensor_async(void* channel, const char* service_method,
+                              const void* req, size_t req_len, void* arena,
+                              uint64_t att_off, size_t att_len,
+                              tbrpc_tensor_done_cb done_cb, void* done_ctx) {
+  auto* box = static_cast<ChannelBox*>(channel);
+  async_inflight_gauge_create();
+  auto* fut = new FutureBox;
+  fut->cb = done_cb;
+  fut->cb_ctx = done_ctx;
+  tbutil::IOBuf request;
+  if (req_len > 0) request.append(req, req_len);
+  if (arena != nullptr && att_len > 0) {
+    append_arena_range(&fut->cntl.request_attachment(),
+                       static_cast<ArenaBox*>(arena)->arena.get(), att_off,
+                       att_len);
+  }
+  g_async_inflight.fetch_add(1, std::memory_order_relaxed);
+  // Async CallMethod: serializes, issues attempt 0 and returns; the done
+  // closure runs from EndRPC (response, timeout, retry exhaustion or
+  // cancel). Immediate failures run it inline — the returned future is
+  // then already completed.
+  box->channel.CallMethod(service_method, &fut->cntl, request,
+                          &fut->response,
+                          NewCallback([fut] { async_on_done(fut); }));
+  return fut;
+}
+
+int tbrpc_future_wait(void* f, void** resp, size_t* resp_len, void** view,
+                      const void** ratt_ptr, size_t* ratt_len,
+                      int* ratt_copied, char* errbuf, size_t errbuf_len) {
+  auto* fut = static_cast<FutureBox*>(f);
+  // Caller threads are Python pthreads with the GIL released (ctypes) —
+  // blocking them is the contract, same as the sync call path's join.
+  std::unique_lock<std::mutex> lk(fut->mu);  // tpulint: allow(fiber-blocking)
+  fut->cv.wait(lk, [&] { return fut->done; });
+  return future_take_locked(fut, resp, resp_len, view, ratt_ptr, ratt_len,
+                            ratt_copied, errbuf, errbuf_len);
+}
+
+int tbrpc_future_timed_wait(void* f, int64_t timeout_ms, void** resp,
+                            size_t* resp_len, void** view,
+                            const void** ratt_ptr, size_t* ratt_len,
+                            int* ratt_copied, char* errbuf,
+                            size_t errbuf_len) {
+  auto* fut = static_cast<FutureBox*>(f);
+  std::unique_lock<std::mutex> lk(fut->mu);  // tpulint: allow(fiber-blocking)
+  if (!fut->cv.wait_for(lk, std::chrono::milliseconds(
+                                timeout_ms > 0 ? timeout_ms : 0),
+                        [&] { return fut->done; })) {
+    return -1;  // still in flight; nothing consumed, wait again later
+  }
+  return future_take_locked(fut, resp, resp_len, view, ratt_ptr, ratt_len,
+                            ratt_copied, errbuf, errbuf_len);
+}
+
+int tbrpc_future_cancel(void* f) {
+  auto* fut = static_cast<FutureBox*>(f);
+  tbthread::fiber_id_t cid = tbthread::INVALID_FIBER_ID;
+  {
+    std::lock_guard<std::mutex> lk(fut->mu);  // tpulint: allow(fiber-blocking)
+    if (fut->abandoned) return 0;
+    fut->abandoned = true;
+    if (fut->done) {
+      if (!fut->consumed) fut->ReleaseResultsLocked();
+      return 0;
+    }
+    cid = fut->cntl.call_id();
+  }
+  // Raise ECANCELED through the correlation id — the controller ends the
+  // RPC early (OnError's cancel path) and the completion closure sees
+  // `abandoned` and releases. A lost race (response already accepted) is
+  // fine: the error raise no-ops on a destroyed id.
+  if (cid != tbthread::INVALID_FIBER_ID) {
+    tbthread::fiber_id_error(cid, TRPC_ECANCELED);
+  }
+  return 0;
+}
+
+void tbrpc_future_destroy(void* f) {
+  if (f == nullptr) return;
+  auto* fut = static_cast<FutureBox*>(f);
+  tbthread::fiber_id_t cid = tbthread::INVALID_FIBER_ID;
+  bool del;
+  {
+    std::lock_guard<std::mutex> lk(fut->mu);  // tpulint: allow(fiber-blocking)
+    if (!fut->abandoned) {
+      fut->abandoned = true;
+      if (fut->done) {
+        if (!fut->consumed) fut->ReleaseResultsLocked();
+      } else {
+        cid = fut->cntl.call_id();  // hurry the in-flight RPC to an end
+      }
+    }
+    del = (--fut->refs == 0);
+  }
+  if (cid != tbthread::INVALID_FIBER_ID) {
+    tbthread::fiber_id_error(cid, TRPC_ECANCELED);
+  }
+  if (del) delete fut;
+}
+
+int64_t tbrpc_async_inflight(void) {
+  return g_async_inflight.load(std::memory_order_relaxed);
+}
+
 void TensorCallbackService::CallMethod(const std::string& method,
                                        Controller* cntl,
                                        const tbutil::IOBuf& request,
@@ -760,6 +1066,29 @@ int64_t tbrpc_rpcz_dump_json(uint64_t trace_id, char* buf, size_t cap) {
     arr.push_back(std::move(o));
   }
   return copy_out(arr.Dump(), buf, cap);
+}
+
+int64_t tbrpc_debug_dump_fibers(char* buf, size_t cap) {
+  std::vector<tbthread::FiberTrace> traces;
+  tbthread::fiber_trace_all(&traces);
+  std::string out;
+  char line[128];
+  for (const auto& t : traces) {
+    snprintf(line, sizeof(line), "fiber %llu %s\n",
+             static_cast<unsigned long long>(t.tid),
+             t.running ? "RUNNING" : "parked");
+    out += line;
+    for (size_t i = 0; i < t.frames.size(); ++i) {
+      snprintf(line, sizeof(line), "  #%zu %p %s\n", i, t.frames[i],
+               i < t.symbols.size() ? t.symbols[i].c_str() : "?");
+      out += line;
+    }
+  }
+  return copy_out(out, buf, cap);
+}
+
+int64_t tbrpc_debug_dump_ici(char* buf, size_t cap) {
+  return copy_out(ttpu::DebugDumpEndpoints(false), buf, cap);
 }
 
 int tbrpc_rpcz_enabled(void) { return rpcz_enabled() ? 1 : 0; }

@@ -104,6 +104,67 @@ int tbrpc_call_tensor(void* channel, const char* service_method,
                       size_t errbuf_len);
 void tbrpc_view_free(void* view);
 
+// ---- async tensor RPC: futures over the native async CallMethod ----
+// The pipelined data-plane primitive: submit keeps the calling thread free
+// while the RPC is in flight, so N tensors cost ~1 round-trip + N wire
+// times instead of N full round-trips (the PipelineWindow in
+// brpc_tpu/runtime/tensor.py rides this).
+//
+// Completion callback (optional, may be null): runs on a dedicated
+// callback-pool pthread (same PyGILState discipline as service handlers)
+// BEFORE the future becomes waitable, carrying the same resp/view/ratt
+// values a subsequent tbrpc_future_wait returns. It is a NOTIFICATION:
+// ownership does not transfer here (the future still owns the buffers
+// until a wait consumes them or cancel/destroy releases them), so the
+// callback must not free anything — and must not call tbrpc_future_wait
+// on its own future (the wait cannot complete until the callback returns).
+typedef void (*tbrpc_tensor_done_cb)(void* ctx, int status, const void* resp,
+                                     size_t resp_len, void* view,
+                                     const void* ratt_ptr, size_t ratt_len,
+                                     int ratt_copied, const char* err_text);
+// Start the RPC and return a future handle (never null). Request/arena
+// semantics are identical to tbrpc_call_tensor; the arena range gets its
+// local reference before this returns, so the caller may tbrpc_arena_free
+// the range any time after submission (deferred-free semantics hold the
+// bytes until every wire reference drops).
+void* tbrpc_call_tensor_async(void* channel, const char* service_method,
+                              const void* req, size_t req_len, void* arena,
+                              uint64_t att_off, size_t att_len,
+                              tbrpc_tensor_done_cb done_cb, void* done_ctx);
+// Block the calling thread until completion, then hand out the results
+// EXACTLY ONCE (the same out-param contract as tbrpc_call_tensor,
+// including the deferred view release via tbrpc_view_free). Returns 0 on
+// success or the RPC error code; a second wait (or a wait after cancel)
+// returns the code with every out zeroed. The future handle stays valid
+// until tbrpc_future_destroy.
+int tbrpc_future_wait(void* fut, void** resp, size_t* resp_len, void** view,
+                      const void** ratt_ptr, size_t* ratt_len,
+                      int* ratt_copied, char* errbuf, size_t errbuf_len);
+// Like tbrpc_future_wait but gives up after timeout_ms (>= 0): returns -1
+// with nothing consumed when the RPC is still in flight — the future can
+// be waited again. (RPC failures always return the positive framework
+// code, never -1, so the two cannot collide.)
+int tbrpc_future_timed_wait(void* fut, int64_t timeout_ms, void** resp,
+                            size_t* resp_len, void** view,
+                            const void** ratt_ptr, size_t* ratt_len,
+                            int* ratt_copied, char* errbuf,
+                            size_t errbuf_len);
+// Cancel: in flight, raises TRPC_ECANCELED through the controller (the
+// attempt socket's pending id), ending the RPC early; already complete
+// and unconsumed, releases the response view/buffers NOW (exactly once —
+// a later destroy will not touch them). After cancel every wait returns
+// TRPC_ECANCELED with zeroed outs. Always 0.
+int tbrpc_future_cancel(void* fut);
+// Release the future. In flight: detaches — the RPC is canceled and the
+// completion path frees everything, including the response view if the
+// response wins the race (the exactly-once release the lifetime tests
+// pin down). Completed: frees whatever a wait has not consumed.
+void tbrpc_future_destroy(void* fut);
+// Async tensor RPCs currently between submit and completion, process-wide.
+// Also exposed as the native PassiveStatus gauge `tensor_rpc_inflight`
+// (created on the first async submit) on /vars + /brpc_metrics.
+int64_t tbrpc_async_inflight(void);
+
 // Tensor service: the handler sees the request attachment IN PLACE (no
 // copy when it arrived as one zero-copy block) and may return its response
 // attachment as a range of a local arena — it rides back by reference.
@@ -160,6 +221,16 @@ int64_t tbrpc_vars_dump_prometheus(char* buf, size_t cap);
 // Collected rpcz spans as a JSON array (newest first), annotations
 // included; trace_id != 0 filters to one trace (oldest first).
 int64_t tbrpc_rpcz_dump_json(uint64_t trace_id, char* buf, size_t cap);
+// Every live fiber with its state and (for parked fibers) symbolized
+// stack — the /fibers page through the capi. Callable from ANY plain
+// pthread even when every fiber worker is parked (the wedge-hunting
+// entry point: a Python watchdog thread can ask a stuck process what its
+// fibers are waiting on).
+int64_t tbrpc_debug_dump_fibers(char* buf, size_t cap);
+// Sender/receiver state of every live tpu:// endpoint (TX credit level,
+// pending control bytes, parked-writer flags — ttpu::DebugDumpEndpoints).
+// The companion hang-forensics view to the fiber dump.
+int64_t tbrpc_debug_dump_ici(char* buf, size_t cap);
 
 // ---- observability: tracing ----
 // The fiber-local trace context the native stack propagates (span.h):

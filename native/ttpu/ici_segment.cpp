@@ -266,6 +266,13 @@ void PeerSegmentRegistry::OnMaterialize(const IciSegment* seg) {
 void PeerSegmentRegistry::OnRelease(void* ptr) {
   uint64_t socket_id = 0;
   uint32_t idx = 0;
+  // Explicit flag, NOT a socket_id==0 sentinel: 0 is a VALID SocketId
+  // (INVALID_SOCKET_ID is ~0, and the first socket a client process
+  // creates gets id 0). The sentinel silently dropped EVERY credit owed
+  // by such a peer — one leaked TX block per response until the sender's
+  // pool emptied and its writer parked forever (the long-standing
+  // "all threads parked" tpu:// bench wedge).
+  bool notify = false;
   {
     Registry& r = registry();
     std::lock_guard<std::mutex> lk(r.mu);
@@ -274,12 +281,12 @@ void PeerSegmentRegistry::OnRelease(void* ptr) {
     RegEntry& e = it->second;
     socket_id = e.socket_id;
     idx = e.seg->index_of(ptr);
+    notify = !e.endpoint_gone;
     if (--e.outstanding == 0 && e.endpoint_gone) {
       r.map.erase(it);  // drops the last shared_ptr: unmap
-      socket_id = 0;    // peer is gone too; no credit to send
     }
   }
-  if (socket_id != 0) {
+  if (notify) {
     ici_internal::SendCreditFrame(socket_id, idx);
   }
 }

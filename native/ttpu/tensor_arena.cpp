@@ -346,6 +346,7 @@ void ArenaRxRegistry::OnRelease(void* ptr) {
   uint32_t arena_id = 0;
   uint64_t off = 0;
   uint32_t len = 0;
+  bool notify = false;
   {
     RxRegistry& r = rx_registry();
     std::lock_guard<std::mutex> lk(r.mu);
@@ -359,12 +360,17 @@ void ArenaRxRegistry::OnRelease(void* ptr) {
     socket_id = e.socket_id;
     arena_id = e.arena_id;
     off = static_cast<const char*>(ptr) - e.mapping->base();
+    // Explicit flag, NOT a socket_id==0 sentinel: 0 is a VALID SocketId
+    // (the first socket a client process creates), and the sentinel
+    // silently swallowed every arena release such a peer owed — the
+    // sender's ranges never drained (same leak class as the TX-credit
+    // wedge fixed in ici_segment.cpp PeerSegmentRegistry::OnRelease).
+    notify = !e.endpoint_gone;
     if (--e.outstanding == 0 && e.endpoint_gone) {
       r.map.erase(it);  // last shared_ptr drops: unmap
-      socket_id = 0;    // peer connection is gone; nothing to notify
     }
   }
-  if (socket_id != 0) {
+  if (notify) {
     ici_internal::SendArenaReleaseFrame(socket_id, arena_id, off, len);
   }
 }

@@ -1,6 +1,9 @@
 // wire-contract capi fixture: one kept signature, one drifted signature
 // (the lock says tbrpc_fix_call has no trailing size_t), one symbol the
-// lock still carries but the header dropped (tbrpc_fix_gone).
+// lock still carries but the header dropped (tbrpc_fix_gone), and the
+// async-completion ABI shape (a many-arg callback typedef + a function
+// taking it) kept in sync — pinning that the parser handles the wide
+// multi-pointer signatures tbrpc_call_tensor_async introduced.
 #pragma once
 
 #include <stddef.h>
@@ -9,8 +12,17 @@
 extern "C" {
 
 typedef void (*tbrpc_fix_cb)(void* ctx, int* error_code);
+// Async-completion callback ABI (mirrors tbrpc_tensor_done_cb).
+typedef void (*tbrpc_fix_done_cb)(void* ctx, int status, const void* resp,
+                                  size_t resp_len, void* view,
+                                  const void* ratt_ptr, size_t ratt_len,
+                                  int ratt_copied, const char* err_text);
 
 void* tbrpc_fix_create(const char* name);
 int tbrpc_fix_call(void* h, const void* req, size_t req_len, size_t extra);
+void* tbrpc_fix_call_async(void* h, const void* req, size_t req_len,
+                           tbrpc_fix_done_cb done_cb, void* done_ctx);
+int tbrpc_fix_future_wait(void* fut, void** resp, size_t* resp_len,
+                          char* errbuf, size_t errbuf_len);
 
 }  // extern "C"

@@ -1,0 +1,233 @@
+"""Async tensor RPC: futures, the pipeline window, and the exactly-once
+release discipline under cancel/timeout/destroy races.
+
+The lifetime assertions lean on the arena accounting: a response range
+only returns to its allocator when the view's release actually happened
+(and happened once — a double release crashes the process, a missed one
+shows up as busy_bytes never draining).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.param_server import ParameterClient, ParameterServer
+from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
+                                     TensorChannel, _bind_tensor_api,
+                                     _decode_meta, add_tensor_service)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _needs_native():
+    from conftest import require_native_lib
+    require_native_lib()
+
+
+@pytest.fixture(scope="module")
+def env():
+    server = native.Server()
+
+    def echo(method, request, att):
+        if att is None:
+            return b"none:" + request, None
+        return request, np.asarray(att) * 2
+
+    def slow(method, request, att):
+        time.sleep(0.4)
+        return b"slow", None
+
+    echo_arena = add_tensor_service(server, "Echo", echo)
+    add_tensor_service(server, "Slow", slow, arena=echo_arena)
+    port = server.start("127.0.0.1:0")
+    ch = TensorChannel(f"tpu://127.0.0.1:{port}", TensorArena(64 << 20))
+    yield server, ch, port, echo_arena
+    ch.close()
+    server.stop()
+
+
+def _drain(arena, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while arena.busy_bytes() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return arena.busy_bytes()
+
+
+def test_call_async_matches_sync(env):
+    _, ch, _, _ = env
+    x = np.arange(1 << 16, dtype=np.float32)
+    _, sync_arr = ch.call("Echo/Mul2", x)
+    from brpc_tpu.runtime.tensor import _encode_meta
+    off, length, host = ch.place_with_meta(x)
+    fut = ch.call_async("Echo/Mul2", _encode_meta(host) + b"t", off, length)
+    assert fut.done() or not fut.done()  # probe never throws pre-completion
+    payload, view = fut.result()
+    ch.arena.free(off)
+    with view:
+        dtype, shape, rest = _decode_meta(payload)
+        assert rest == b"t"
+        arr = np.array(np.frombuffer(view.ndarray(),
+                                     dtype=dtype).reshape(shape))
+    fut.close()
+    np.testing.assert_array_equal(arr, sync_arr)
+    # repeated result() hands back the same cached objects
+    p2, v2 = fut.result()
+    assert p2 is payload and v2 is view
+
+
+def test_future_outlives_channel_close(env):
+    _, _, port, _ = env
+    ch2 = TensorChannel(f"tpu://127.0.0.1:{port}", TensorArena(8 << 20))
+    fut = ch2.call_async("Slow/Z")
+    ch2.close()  # the in-flight controller owns everything it needs
+    payload, view = fut.result()
+    assert payload == b"slow"
+    view.release()
+    fut.close()
+
+
+def test_future_timed_wait_then_result(env):
+    _, ch, _, _ = env
+    fut = ch.call_async("Slow/Z")
+    with pytest.raises(TimeoutError):
+        fut.result(timeout_ms=30)
+    payload, view = fut.result()  # a timed-out wait consumed nothing
+    assert payload == b"slow"
+    view.release()
+    view.release()  # view release is idempotent
+    fut.close()
+    fut.close()  # and so is the future's
+
+
+def test_cancel_in_flight(env):
+    _, ch, _, _ = env
+    fut = ch.call_async("Slow/Z")
+    fut.cancel()
+    with pytest.raises(native.RpcError) as ei:
+        fut.result()
+    assert ei.value.code == 1012  # TRPC_ECANCELED
+    fut.close()
+    # The channel is still healthy afterwards.
+    payload, _ = ch.call("Echo/Nop", request=b"ok")
+    assert payload == b"none:ok"
+
+
+def test_cancel_after_completion_releases_view_once(env):
+    _, ch, _, echo_arena = env
+    x = np.ones(1 << 18, np.float32)
+    from brpc_tpu.runtime.tensor import _encode_meta
+    off, length, host = ch.place_with_meta(x)
+    fut = ch.call_async("Echo/Mul2", _encode_meta(host), off, length)
+    time.sleep(0.3)  # response has landed; result NOT taken
+    fut.cancel()  # releases the unconsumed response view exactly once
+    with pytest.raises(native.RpcError):
+        fut.result()
+    fut.close()  # must not release again (double free would abort)
+    ch.arena.free(off)
+    assert _drain(ch.arena) == 0
+    assert _drain(echo_arena) == 0
+
+
+def test_destroy_in_flight_releases_on_completion(env):
+    _, ch, _, echo_arena = env
+    L = _bind_tensor_api(native.lib())
+    fut = ch.call_async("Slow/Z")
+    fut.close()  # destroy before completion: completion path cleans up
+    deadline = time.monotonic() + 5
+    while L.tbrpc_async_inflight() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert L.tbrpc_async_inflight() == 0
+    assert _drain(echo_arena) == 0
+
+
+def test_pipeline_window_orders_and_bounds(env):
+    _, ch, _, _ = env
+    got = []
+
+    def on_reply(tag, payload, view):
+        with view:
+            dtype, shape, _ = _decode_meta(payload)
+            arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
+            got.append((tag, float(arr[0])))
+
+    with PipelineWindow(ch, window=3, on_reply=on_reply) as win:
+        for i in range(10):
+            win.submit("Echo/Mul2", array=np.full((64,), i, np.float32),
+                       tag=i)
+            assert win.inflight() <= 3
+    assert got == [(i, float(i * 2)) for i in range(10)]
+    assert _drain(ch.arena) == 0
+
+
+def test_pipeline_window_abort_on_error(env):
+    _, ch, _, _ = env
+    win = PipelineWindow(ch, window=2)
+    win.submit("Slow/Z", array=np.ones(64, np.float32), tag=0)
+    win.submit("Slow/Z", array=np.ones(64, np.float32), tag=1)
+    win.abort()
+    assert win.inflight() == 0
+    assert _drain(ch.arena) == 0
+
+
+def test_pull_all_equals_serial_pulls():
+    rng = np.random.default_rng(7)
+    params = {
+        f"p{i}": jnp.asarray(rng.normal(size=(32, 16 + i)).astype(np.float32))
+        for i in range(6)
+    }
+    ps = ParameterServer(dict(params))
+    port = ps.start()
+    client = ParameterClient(f"tpu://127.0.0.1:{port}")
+    try:
+        pulled = client.pull_all(window=4)
+        assert set(pulled) == set(params)
+        for name in params:
+            version, arr = client.pull(name)
+            assert pulled[name][0] == version == 0
+            assert isinstance(pulled[name][1], jax.Array)
+            np.testing.assert_array_equal(np.asarray(pulled[name][1]),
+                                          np.asarray(arr))
+    finally:
+        client.close()
+        ps.stop()
+
+
+def test_push_all_versions_and_convergence():
+    params = {f"q{i}": jnp.ones((128,), jnp.float32) for i in range(5)}
+    ps = ParameterServer(dict(params), lr=0.1)
+    port = ps.start()
+    client = ParameterClient(f"tpu://127.0.0.1:{port}")
+    try:
+        grads = {k: jnp.full((128,), 0.5, jnp.float32) for k in params}
+        versions = client.push_all(grads, window=4)
+        assert versions == {k: 1 for k in params}
+        pulled = client.pull_all(window=4)
+        from brpc_tpu.ops.fused_update import fused_momentum_update
+        want, _ = fused_momentum_update(
+            params["q0"], jnp.zeros_like(params["q0"]), grads["q0"], lr=0.1)
+        for name in params:
+            assert pulled[name][0] == 1
+            np.testing.assert_allclose(np.asarray(pulled[name][1]),
+                                       np.asarray(want), rtol=1e-6,
+                                       atol=1e-7)
+    finally:
+        client.close()
+        ps.stop()
+
+
+def test_async_inflight_gauge(env):
+    _, ch, _, _ = env
+    L = _bind_tensor_api(native.lib())
+    fut = ch.call_async("Slow/Z")
+    assert L.tbrpc_async_inflight() >= 1
+    payload, view = fut.result()
+    view.release()
+    fut.close()
+    assert L.tbrpc_async_inflight() == 0
+    # The native gauge is registered in the shared registry.
+    from brpc_tpu.observability import metrics as obs
+    assert "tensor_rpc_inflight" in obs.dump_vars("tensor_rpc_inflight")

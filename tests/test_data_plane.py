@@ -71,7 +71,11 @@ def test_pallas_fused_update_matches_reference():
     p = jnp.asarray(rng.randn(33, 190), jnp.float32)  # non-tile-aligned
     m = jnp.asarray(rng.randn(33, 190), jnp.float32)
     g = jnp.asarray(rng.randn(33, 190), jnp.float32)
-    p1, m1 = fused_momentum_update(p, m, g, lr=0.05, beta=0.8)
+    # interpret=True forces the PALLAS kernel through the interpreter on
+    # CPU (the auto path routes non-TPU to the jnp reference, which would
+    # make this comparison vacuous).
+    p1, m1 = fused_momentum_update(p, m, g, lr=0.05, beta=0.8,
+                                   interpret=True)
     p2, m2 = momentum_update_reference(p, m, g, lr=0.05, beta=0.8)
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-5,
                                atol=1e-6)

@@ -108,11 +108,33 @@ def test_wire_contract_runtime_mismatch_positive():
 def test_wire_contract_capi_drift_positive(fixture_findings):
     msgs = " | ".join(
         f.message for f in _of(fixture_findings, "wire-contract", "capi.h"))
-    assert "tbrpc_fix_call" in msgs and "drifted" in msgs
+    assert "tbrpc_fix_call " in msgs and "drifted" in msgs
     assert "tbrpc_fix_gone" in msgs and "removed" in msgs
     # matching entries stay silent
     assert "tbrpc_fix_create" not in msgs
     assert "tbrpc_fix_cb" not in msgs
+    # the async-completion ABI (wide multi-pointer callback typedef + the
+    # submit/wait pair taking it) parses and matches the lock silently
+    assert "tbrpc_fix_done_cb" not in msgs
+    assert "tbrpc_fix_call_async" not in msgs
+    assert "tbrpc_fix_future_wait" not in msgs
+
+
+def test_wire_contract_capi_parses_async_abi(fixture_findings):
+    """The fixture's async signatures normalise to the locked spellings —
+    if the parser mis-handles the 9-arg callback typedef or the
+    callback-typed parameter, this (not just silence) catches it."""
+    from tools.tpulint.core import SourceFile
+    from tools.tpulint.rules_wire import parse_capi
+
+    src = SourceFile(FIXTURES + "/repo",
+                     os.path.join("native", "capi", "capi.h"))
+    parsed = {sym: sig for sym, (sig, _ln) in parse_capi(src).items()}
+    assert parsed["typedef:tbrpc_fix_done_cb"] == (
+        "void(void *, int, const void *, size_t, void *, const void *, "
+        "size_t, int, const char *)")
+    assert parsed["tbrpc_fix_call_async"] == (
+        "void *(void *, const void *, size_t, tbrpc_fix_done_cb, void *)")
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
