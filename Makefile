@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test asan tsan test-asan test-tsan lint lint-sarif bench-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -25,8 +25,17 @@ lint-sarif:
 bench-smoke:
 	python bench.py --smoke
 
+# Slow-marked tests (the watchdog soak) are excluded here, same as
+# tier-1; run them explicitly with `make soak`.
 test: native
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q -m 'not slow'
+
+# Watchdog soak: repeated async pull_all/push_all bursts over tpu:// with
+# the stall watchdog armed. Fails if health ever reaches `stalled`
+# WITHOUT a dump artifact (a hang the framework cannot explain); a wedge
+# WITH forensics is a captured finding. SOAK_SECONDS=N scales the run.
+soak: native
+	python -m pytest tests/test_soak.py -q -m slow
 
 # Sanitizer trees. The fiber runtime carries the required annotations
 # (tbthread/sanitizer_fiber.h): ASan gets start/finish_switch_fiber around

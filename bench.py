@@ -38,6 +38,15 @@ _ECHO_EX_CHILD = r"""
 import json, sys
 sys.path.insert(0, {root!r})
 from brpc_tpu.runtime import native
+try:
+    # Self-monitoring: if this sample wedges, the in-child watchdog writes
+    # fiber stacks + ICI credit state + the flight tail into {dump_dir!r}
+    # BEFORE the parent's hard timeout kills us — the wedge row then
+    # carries its own forensics instead of only {{"wedged": true}}.
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
 bps, qps, p50, p99 = native.bench_echo_ex(
     {payload}, seconds={seconds}, concurrency={conc},
     transport={transport!r}, conn_type={conn_type!r})
@@ -54,20 +63,70 @@ print(json.dumps({{"bps": bps, "qps": qps, "p50": p50, "p99": p99,
 """
 
 
+_BENCH_DUMP_DIR = None
+
+
+def _dump_dir():
+    """Stall-dump directory shared by every bench child of this run; the
+    watchdog inside a wedged child writes here and the parent attaches the
+    paths to the wedged sample after the kill."""
+    global _BENCH_DUMP_DIR
+    if _BENCH_DUMP_DIR is None:
+        import tempfile
+        _BENCH_DUMP_DIR = tempfile.mkdtemp(prefix="brpc_tpu_bench_dumps_")
+    return _BENCH_DUMP_DIR
+
+
+def _new_dump_files(seen):
+    """Dump files that appeared since `seen` was last updated."""
+    try:
+        paths = sorted(os.path.join(_dump_dir(), n)
+                       for n in os.listdir(_dump_dir()))
+    except OSError:
+        return []
+    fresh = [p for p in paths if p not in seen]
+    seen.update(fresh)
+    return fresh
+
+
+def _dump_transitions(path):
+    """The health-state transition log a stall auto-dump carries (the
+    wedged child's ok -> degraded -> stalled walk, with reasons)."""
+    lines = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            in_section = False
+            for line in fh:
+                if line.startswith("health transitions"):
+                    in_section = True
+                    continue
+                if in_section:
+                    if not line.startswith("  "):
+                        break
+                    lines.append(line.strip())
+    except OSError:
+        pass
+    return lines
+
+
 def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
                           conn_type, retries=2, wedge_log=None):
     """One echo sample in a watchdogged subprocess.
 
     Returns the child's result dict; after `retries` consecutive
-    wedges/failures returns {"wedged": True, "attempts": N} so a stuck
-    transport reads as a recorded finding, not a hung bench run.
+    wedges/failures returns {"wedged": True, "attempts": N, "dump_files":
+    [...]} — the child runs the native stall watchdog pointed at a shared
+    dump dir, so a wedge row carries the auto-captured forensics (fiber
+    stacks + ICI credit state + flight-recorder tail) of its own hang.
     """
     root = os.path.dirname(os.path.abspath(__file__))
     code = _ECHO_EX_CHILD.format(root=root, payload=payload, seconds=seconds,
                                  conc=concurrency, transport=transport,
-                                 conn_type=conn_type)
+                                 conn_type=conn_type, dump_dir=_dump_dir())
     timeout = seconds * 3 + 30  # library load + server spin-up headroom
     wedges = 0
+    seen_dumps = set(_new_dump_files(set()))  # ignore earlier samples' dumps
+    dump_files = []
     for _ in range(retries + 1):
         try:
             proc = subprocess.run(  # tpulint: allow(py-blocking)
@@ -78,6 +137,7 @@ def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
                 result = json.loads(out[-1])
                 if wedges:
                     result["wedged_retries"] = wedges
+                    result["dump_files"] = dump_files
                 return result
             if proc.returncode != 0 and proc.stderr:
                 # A fast crash (import error, stale .so) is NOT a wedge:
@@ -88,12 +148,19 @@ def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
         except subprocess.TimeoutExpired:
             pass
         wedges += 1
+        fresh = _new_dump_files(seen_dumps)
+        dump_files.extend(fresh)
         if wedge_log is not None:
             wedge_log.append({"payload": payload, "concurrency": concurrency,
-                              "transport": transport})
+                              "transport": transport, "dump_files": fresh})
         print(f"# WEDGED sample: payload={payload} conc={concurrency} "
-              f"transport={transport} (attempt {wedges})", file=sys.stderr)
-    return {"wedged": True, "attempts": wedges}
+              f"transport={transport} (attempt {wedges})"
+              + (f"; watchdog dump: {' '.join(fresh)}" if fresh
+                 else "; no watchdog dump captured"), file=sys.stderr)
+    result = {"wedged": True, "attempts": wedges, "dump_files": dump_files}
+    if dump_files:
+        result["health_transitions"] = _dump_transitions(dump_files[-1])
+    return result
 
 
 def best_point(payload, transport, seconds=2, wedge_log=None):

@@ -5,12 +5,18 @@ covering the native fiber runtime AND the Python/JAX tensor path.
             PassiveGauge) and dump helpers (/vars, Prometheus).
   tracing — rpcz from Python: trace_span() spans, stage() annotations,
             trace-context access, span dumps.
+  health  — the self-monitoring layer: stall-watchdog state machine
+            (/healthz), flight-recorder snapshots (/flightz), stall
+            auto-dump paths.
 
 Importing this package touches nothing native; the native library loads
 on first use (same lazy discipline as brpc_tpu.runtime.native).
 """
 
-from brpc_tpu.observability import metrics, tracing
+from brpc_tpu.observability import health, metrics, tracing
+from brpc_tpu.observability.health import (flight_events, flight_snapshot,
+                                           health_state, last_dump_path,
+                                           start_watchdog)
 from brpc_tpu.observability.metrics import (Counter, LatencyRecorder,
                                             PassiveGauge, counter,
                                             dump_prometheus, dump_vars,
@@ -20,9 +26,11 @@ from brpc_tpu.observability.tracing import (annotate, current_trace,
                                             rpcz_enabled, stage, trace_span)
 
 __all__ = [
-    "metrics", "tracing",
+    "metrics", "tracing", "health",
     "Counter", "LatencyRecorder", "PassiveGauge",
     "counter", "latency", "gauge", "dump_vars", "dump_prometheus",
     "annotate", "current_trace", "dump_rpcz", "rpcz_enable", "rpcz_enabled",
     "stage", "trace_span",
+    "start_watchdog", "health_state", "last_dump_path",
+    "flight_snapshot", "flight_events",
 ]

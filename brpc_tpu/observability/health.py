@@ -1,0 +1,146 @@
+"""Self-monitoring: the stall watchdog's health state machine and the
+flight recorder, from Python.
+
+The native side (native/trpc/stall_watchdog.*, native/tbvar/
+flight_recorder.*) does the real work: a dedicated watchdog PTHREAD —
+never a fiber, never touching the GIL — heartbeats the fiber scheduler and
+the timer thread, ages writers parked for ICI credit, and walks a health
+state machine (``ok -> degraded -> stalled``). On entering ``stalled`` it
+auto-dumps fiber stacks + ICI credit state + the flight-recorder tail to a
+timestamped file, so a wedge like the historical socket-id-0 credit leak
+is captured with zero operator action. This module is the thin doorway:
+
+  * :func:`start_watchdog` / :func:`configure` — bring the watchdog up and
+    tune its windows (reloadable flags via ``tbrpc_flag_set``);
+  * :func:`state` / :func:`health` — the /healthz verdict (string / full
+    decoded JSON with transition history);
+  * :func:`last_dump_path` — where the newest stall forensics landed;
+  * :func:`flight_snapshot` / :func:`flight_events` — the flight recorder
+    tail, raw text or decoded into dicts.
+
+Everything here is callable from any plain Python thread even when every
+fiber worker is parked — that is the point.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional
+
+from brpc_tpu.observability.metrics import _snapshot_buf
+from brpc_tpu.runtime import native
+
+STATE_NAMES = {0: "ok", 1: "degraded", 2: "stalled"}
+
+# One flight-recorder line, as rendered by tbrpc_flight_snapshot//flightz:
+#   <ts_us> tid=<os_tid>[!] seq=<n> <TYPE> a=0x<hex> b=0x<hex> [phase=<p>]
+_FLIGHT_LINE = re.compile(
+    r"^(?P<ts_us>\d+) tid=(?P<tid>\d+)(?P<gone>!?) seq=(?P<seq>\d+) "
+    r"(?P<type>\S+)\s+a=0x(?P<a>[0-9a-f]+) b=0x(?P<b>[0-9a-f]+)"
+    r"(?: phase=(?P<phase>\S+))?$")
+
+# Watchdog/flight knobs -> native reloadable flag names.
+_FLAG_NAMES = {
+    "poll_ms": "watchdog_poll_ms",
+    "degraded_ms": "watchdog_degraded_ms",
+    "stalled_ms": "watchdog_stalled_ms",
+    "credit_stall_ms": "watchdog_credit_stall_ms",
+    "autodump": "watchdog_autodump",
+    "flight_enabled": "flight_recorder_enabled",
+    "flight_ring_events": "flight_recorder_ring_events",
+}
+
+
+def configure(**knobs: int) -> None:
+    """Set watchdog/flight-recorder flags by short name (reloadable, takes
+    effect on the watchdog's next poll): ``poll_ms``, ``degraded_ms``,
+    ``stalled_ms``, ``credit_stall_ms``, ``autodump``, ``flight_enabled``,
+    ``flight_ring_events``."""
+    L = native.lib()
+    for key, value in knobs.items():
+        flag = _FLAG_NAMES.get(key)
+        if flag is None:
+            raise ValueError(
+                f"unknown watchdog knob {key!r}; choose from "
+                f"{sorted(_FLAG_NAMES)}")
+        if L.tbrpc_flag_set(flag.encode(), str(int(value)).encode()) != 0:
+            raise ValueError(f"flag {flag} rejected value {value!r}")
+
+
+def start_watchdog(dump_dir: Optional[str] = None, **knobs: int) -> None:
+    """Start the native watchdog pthread (idempotent). ``dump_dir``
+    receives stall auto-dumps; omit it to keep the state machine without
+    dumping. Extra kwargs are passed to :func:`configure` first, so
+    ``start_watchdog(d, stalled_ms=500)`` is race-free: the windows are in
+    place before the first poll."""
+    if knobs:
+        configure(**knobs)
+    if native.lib().tbrpc_watchdog_start(
+            dump_dir.encode() if dump_dir else None) != 0:
+        raise RuntimeError("watchdog thread failed to start")
+
+
+def stop_watchdog() -> None:
+    """Stop and join the watchdog pthread (tests; restartable)."""
+    native.lib().tbrpc_watchdog_stop()
+
+
+def state() -> str:
+    """Current health state: "ok", "degraded" or "stalled"."""
+    return STATE_NAMES.get(native.lib().tbrpc_health_state(), "unknown")
+
+
+# Package-level alias: brpc_tpu.observability.health_state() — "state" is
+# too generic a name to hoist out of this module.
+def health_state() -> str:
+    return state()
+
+
+def health() -> Dict:
+    """The decoded /healthz document: state, reason, since_us, stall
+    count, transition history, last auto-dump path."""
+    raw = _snapshot_buf(native.lib().tbrpc_health_dump_json)
+    return json.loads(raw.decode(errors="replace"))
+
+
+def last_dump_path() -> Optional[str]:
+    """Absolute path of the newest stall auto-dump, or None."""
+    raw = _snapshot_buf(native.lib().tbrpc_health_last_dump_path)
+    return raw.decode(errors="replace") or None
+
+
+def flight_snapshot(max_events: int = 256) -> str:
+    """The flight-recorder tail as text, one line per event (the /flightz
+    page body): newest ``max_events`` across every thread ring, merged and
+    time-sorted."""
+    L = native.lib()
+    return _snapshot_buf(L.tbrpc_flight_snapshot, max_events).decode(
+        errors="replace")
+
+
+def flight_events(max_events: int = 256) -> List[Dict]:
+    """The flight-recorder tail decoded: one dict per event with ts_us,
+    tid, thread_live, seq, type, a, b (ints) and phase (for RPC_PHASE
+    events)."""
+    out: List[Dict] = []
+    for line in flight_snapshot(max_events).splitlines():
+        m = _FLIGHT_LINE.match(line.rstrip())
+        if m is None:
+            continue  # header/unknown line: decode is best-effort
+        out.append({
+            "ts_us": int(m.group("ts_us")),
+            "tid": int(m.group("tid")),
+            "thread_live": m.group("gone") != "!",
+            "seq": int(m.group("seq")),
+            "type": m.group("type"),
+            "a": int(m.group("a"), 16),
+            "b": int(m.group("b"), 16),
+            "phase": m.group("phase"),
+        })
+    return out
+
+
+def flight_total_events() -> int:
+    """Events ever recorded process-wide (the rpc_flight_events gauge)."""
+    return native.lib().tbrpc_flight_total_events()

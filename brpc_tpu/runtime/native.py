@@ -179,6 +179,26 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_debug_dump_fibers.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     L.tbrpc_debug_dump_ici.restype = ctypes.c_int64
     L.tbrpc_debug_dump_ici.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    # Flight recorder + stall watchdog (the self-monitoring layer): all of
+    # these stay callable from any plain Python thread while every fiber
+    # worker is parked — brpc_tpu.observability.health rides them.
+    L.tbrpc_flight_snapshot.restype = ctypes.c_int64
+    L.tbrpc_flight_snapshot.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_flight_total_events.restype = ctypes.c_int64
+    L.tbrpc_watchdog_start.restype = ctypes.c_int
+    L.tbrpc_watchdog_start.argtypes = [ctypes.c_char_p]
+    L.tbrpc_watchdog_stop.restype = ctypes.c_int
+    L.tbrpc_health_state.restype = ctypes.c_int
+    L.tbrpc_health_dump_json.restype = ctypes.c_int64
+    L.tbrpc_health_dump_json.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_health_last_dump_path.restype = ctypes.c_int64
+    L.tbrpc_health_last_dump_path.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_debug_hold_workers.restype = ctypes.c_int
+    L.tbrpc_debug_hold_workers.argtypes = [ctypes.c_int, ctypes.c_int64]
+    L.tbrpc_debug_induce_contention.restype = ctypes.c_int64
+    L.tbrpc_debug_induce_contention.argtypes = [ctypes.c_int, ctypes.c_int64]
     L.tbrpc_rpcz_enabled.restype = ctypes.c_int
     L.tbrpc_rpcz_set_enabled.argtypes = [ctypes.c_int]
     L.tbrpc_trace_new_id.restype = ctypes.c_uint64

@@ -16,15 +16,18 @@
 #include "tbthread/fiber.h"
 #include "tbthread/fiber_id.h"
 #include "tbthread/sync.h"
+#include "tbthread/sys_futex.h"
 #include "tbthread/tracer.h"
 #include "tbutil/json.h"
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 #include "tbvar/tbvar.h"
 #include "trpc/channel.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
+#include "trpc/stall_watchdog.h"
 #include "ttpu/ici_segment.h"
 #include "ttpu/tensor_arena.h"
 
@@ -1089,6 +1092,133 @@ int64_t tbrpc_debug_dump_fibers(char* buf, size_t cap) {
 
 int64_t tbrpc_debug_dump_ici(char* buf, size_t cap) {
   return copy_out(ttpu::DebugDumpEndpoints(false), buf, cap);
+}
+
+// ---------------- flight recorder + stall watchdog ----------------
+
+int64_t tbrpc_flight_snapshot(int64_t max_events, char* buf, size_t cap) {
+  const size_t n = max_events > 0 ? static_cast<size_t>(max_events) : 0;
+  return copy_out(tbvar::flight_snapshot_text(n), buf, cap);
+}
+
+int64_t tbrpc_flight_total_events(void) {
+  return tbvar::flight_total_events();
+}
+
+int tbrpc_watchdog_start(const char* dump_dir) {
+  return StallWatchdog::singleton().Start(
+      dump_dir != nullptr ? dump_dir : "");
+}
+
+int tbrpc_watchdog_stop(void) {
+  StallWatchdog::singleton().Stop();
+  return 0;
+}
+
+int tbrpc_health_state(void) { return StallWatchdog::singleton().state(); }
+
+int64_t tbrpc_health_dump_json(char* buf, size_t cap) {
+  return copy_out(StallWatchdog::singleton().DumpJson(), buf, cap);
+}
+
+int64_t tbrpc_health_last_dump_path(char* buf, size_t cap) {
+  return copy_out(StallWatchdog::singleton().last_dump_path(), buf, cap);
+}
+
+namespace {
+
+// tbrpc_debug_hold_workers state. The holder fibers deliberately block
+// their worker PTHREAD (a raw futex wait, not a fiber park) — the whole
+// point is to deny the scheduler its workers the way the historical
+// all-threads-parked wedge did, so the watchdog's probe path can be tested
+// deterministically.
+std::atomic<int> g_hold_release{1};  // 0 = holding, 1 = released
+
+void* worker_holder_fn(void* deadline_ptr) {
+  const int64_t deadline_us =
+      reinterpret_cast<intptr_t>(deadline_ptr);
+  while (g_hold_release.load(std::memory_order_acquire) == 0) {
+    const int64_t left_us = deadline_us - tbutil::gettimeofday_us();
+    if (left_us <= 0) break;
+    timespec rel;
+    rel.tv_sec = left_us / 1000000;
+    rel.tv_nsec = (left_us % 1000000) * 1000;
+    tbthread::futex_wait_private(&g_hold_release, 0, &rel);
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+int tbrpc_debug_hold_workers(int nfibers, int64_t hold_ms) {
+  if (nfibers <= 0) nfibers = tbthread::fiber_get_concurrency();
+  if (nfibers <= 0) return 0;
+  if (hold_ms <= 0) hold_ms = 1000;
+  const int64_t deadline_us = tbutil::gettimeofday_us() + hold_ms * 1000;
+  g_hold_release.store(0, std::memory_order_release);
+  int started = 0;
+  for (int i = 0; i < nfibers; ++i) {
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_background(
+            &tid, nullptr, worker_holder_fn,
+            reinterpret_cast<void*>(static_cast<intptr_t>(deadline_us))) ==
+        0) {
+      ++started;
+    }
+  }
+  return started;
+}
+
+void tbrpc_debug_release_workers(void) {
+  g_hold_release.store(1, std::memory_order_release);
+  tbthread::futex_wake_private(&g_hold_release, INT32_MAX);
+}
+
+namespace {
+
+struct ContendArg {
+  tbthread::FiberMutex* mu;
+  std::atomic<int64_t>* acquisitions;
+  int64_t deadline_us;
+};
+
+void* contender_fn(void* argv) {
+  auto* a = static_cast<ContendArg*>(argv);
+  while (tbutil::gettimeofday_us() < a->deadline_us) {
+    a->mu->lock();
+    // Hold briefly so every OTHER contender measurably waits — the
+    // contention profiler samples wait time, not acquisitions.
+    tbthread::fiber_usleep(1000);
+    a->mu->unlock();
+    a->acquisitions->fetch_add(1, std::memory_order_relaxed);
+    tbthread::fiber_usleep(100);  // let a waiter win the next round
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+int64_t tbrpc_debug_induce_contention(int nfibers, int64_t ms) {
+  if (nfibers < 2) nfibers = 2;
+  if (nfibers > 64) nfibers = 64;
+  if (ms <= 0) ms = 1000;
+  tbthread::FiberMutex mu;
+  std::atomic<int64_t> acquisitions{0};
+  ContendArg arg{&mu, &acquisitions,
+                 tbutil::gettimeofday_us() + ms * 1000};
+  std::vector<tbthread::fiber_t> fibers;
+  fibers.reserve(nfibers);
+  for (int i = 0; i < nfibers; ++i) {
+    tbthread::fiber_t tid;
+    if (tbthread::fiber_start_background(&tid, nullptr, contender_fn,
+                                         &arg) == 0) {
+      fibers.push_back(tid);
+    }
+  }
+  for (tbthread::fiber_t tid : fibers) {
+    tbthread::fiber_join(tid, nullptr);  // caller is a plain pthread
+  }
+  return acquisitions.load(std::memory_order_relaxed);
 }
 
 int tbrpc_rpcz_enabled(void) { return rpcz_enabled() ? 1 : 0; }

@@ -232,6 +232,48 @@ int64_t tbrpc_debug_dump_fibers(char* buf, size_t cap);
 // The companion hang-forensics view to the fiber dump.
 int64_t tbrpc_debug_dump_ici(char* buf, size_t cap);
 
+// ---- observability: flight recorder + stall watchdog ----
+// The always-on flight recorder (tbvar/flight_recorder.h): newest
+// `max_events` (<= 0 = all retained) events across every thread ring,
+// merged and time-sorted, one text line per event — the same view /flightz
+// serves. Same copy-out convention as the dumps above. Callable from any
+// plain pthread even when every fiber worker is parked.
+int64_t tbrpc_flight_snapshot(int64_t max_events, char* buf, size_t cap);
+// Events ever recorded process-wide (the rpc_flight_events gauge).
+int64_t tbrpc_flight_total_events(void);
+
+// Start the stall-watchdog pthread (idempotent; 0 ok). `dump_dir` receives
+// the stall auto-dumps (fibers + ICI credit state + flight tail); null or
+// "" keeps the health state machine but skips dumping. Configure via
+// tbrpc_flag_set: watchdog_poll_ms / watchdog_degraded_ms /
+// watchdog_stalled_ms / watchdog_credit_stall_ms / watchdog_autodump,
+// plus flight_recorder_enabled / flight_recorder_ring_events.
+int tbrpc_watchdog_start(const char* dump_dir);
+// Stop and join the watchdog pthread (tests; restartable). Always 0.
+int tbrpc_watchdog_stop(void);
+// Current health state: 0 ok, 1 degraded, 2 stalled (rpc_health_state).
+int tbrpc_health_state(void);
+// The /healthz JSON body: state, reason, transition history, stall count,
+// last auto-dump path. Copy-out convention.
+int64_t tbrpc_health_dump_json(char* buf, size_t cap);
+// Absolute path of the newest stall auto-dump ("" before the first one).
+int64_t tbrpc_health_last_dump_path(char* buf, size_t cap);
+
+// TEST-ONLY stall injection: start `nfibers` fibers (<= 0: one per worker)
+// that each BLOCK their worker pthread on a private futex until
+// tbrpc_debug_release_workers or `hold_ms` elapses — from the scheduler's
+// point of view every worker is wedged, which is exactly what the watchdog
+// must detect. Returns the number of holder fibers started.
+int tbrpc_debug_hold_workers(int nfibers, int64_t hold_ms);
+void tbrpc_debug_release_workers(void);
+
+// TEST-ONLY contention generator: run `nfibers` fibers hammering one
+// FiberMutex (a short sleep inside the critical section) for ~`ms`,
+// BLOCKING the calling pthread until they finish. Guarantees the
+// /contention profiler has waits to sample inside a profile window.
+// Returns total acquisitions.
+int64_t tbrpc_debug_induce_contention(int nfibers, int64_t ms);
+
 // ---- observability: tracing ----
 // The fiber-local trace context the native stack propagates (span.h):
 // reading/writing it from Python lets the tensor path join native traces.

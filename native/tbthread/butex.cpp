@@ -8,10 +8,23 @@
 #include "tbthread/task_group.h"
 #include "tbthread/timer_thread.h"
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 
 namespace tbthread {
 
 namespace {
+
+// Flight-recorder identity of a (possibly off-worker) waiter: the fiber
+// tid for fiber waiters, 0 for raw pthread waiters.
+inline uint64_t waiter_tid(const ButexWaiter* w) {
+  if (w->type != ButexWaiter::FIBER || w->meta == nullptr ||
+      w->meta->version_butex == nullptr) {
+    return 0;
+  }
+  return make_tid(w->meta->slot,
+                  static_cast<uint32_t>(butex_value(w->meta->version_butex)
+                                            ->load(std::memory_order_relaxed)));
+}
 
 inline void list_append(Butex* b, ButexWaiter* w) {
   w->prev = b->waiters.prev;
@@ -50,6 +63,8 @@ void fiber_timeout_cb(void* wv) {
       list_unlink(w);
       w->timed_out = true;
       to_wake = w->meta;
+      tbvar::flight_record(tbvar::FLIGHT_FIBER_TIMEOUT,
+                           reinterpret_cast<uint64_t>(b), waiter_tid(w));
     }
   }
   w->timer_cb_done.store(true, std::memory_order_release);
@@ -80,6 +95,9 @@ int wait_as_pthread(Butex* b, int expected, const timespec* abstime) {
     }
     list_append(b, &w);
   }
+  // b = 0 marks a pthread waiter (no fiber identity to park).
+  tbvar::flight_record(tbvar::FLIGHT_FIBER_PARK,
+                       reinterpret_cast<uint64_t>(b), 0);
   bool timed_out = false;
   while (w.pthread_wake.load(std::memory_order_acquire) == 0) {
     timespec rel;
@@ -169,6 +187,8 @@ int butex_wait(Butex* b, int expected, const timespec* abstime) {
     timer = TimerThread::singleton()->schedule(fiber_timeout_cb, &w, dl_us);
   }
   ParkArg pa{b};
+  tbvar::flight_record(tbvar::FLIGHT_FIBER_PARK,
+                       reinterpret_cast<uint64_t>(b), g->cur_tid());
   // The lock is released on the scheduler stack after the switch.
   TaskGroup::park(unlock_butex_after_park, &pa);
 
@@ -189,6 +209,8 @@ int butex_wait(Butex* b, int expected, const timespec* abstime) {
 }
 
 static void wake_one_unlinked(ButexWaiter* w) {
+  tbvar::flight_record(tbvar::FLIGHT_FIBER_UNPARK,
+                       reinterpret_cast<uint64_t>(w->owner), waiter_tid(w));
   if (w->type == ButexWaiter::FIBER) {
     TaskControl::singleton()->ready_to_run_general(w->meta);
   } else {

@@ -26,7 +26,7 @@ class FiberMutex {
   FiberMutex& operator=(const FiberMutex&) = delete;
 
   void lock() {
-    // 0 free, 1 locked no waiters, 2 locked with waiters.
+    // 0 free, 1 locked no waiters, 2 locked with (possible) waiters.
     int expected = 0;
     if (_b->value.compare_exchange_strong(expected, 1,
                                           std::memory_order_acquire,
@@ -35,15 +35,21 @@ class FiberMutex {
     }
     const bool profile = contention_profiling_enabled();
     const int64_t t0 = profile ? tbutil::monotonic_time_us() : 0;
-    do {
-      if (expected == 2 ||
-          _b->value.exchange(2, std::memory_order_acquire) != 0) {
-        butex_wait(_b, 2, nullptr);
-      }
-      expected = 0;
-    } while (!_b->value.compare_exchange_strong(expected, 2,
-                                                std::memory_order_acquire,
-                                                std::memory_order_relaxed));
+    // Canonical contended loop (reference bthread/mutex.cpp
+    // mutex_lock_contended): exchange(2) returning 0 means WE acquired —
+    // the word stays 2, so our unlock wakes (possibly spuriously, which
+    // butex waiters tolerate); nonzero means someone else holds it, so
+    // park while the word still reads 2. The previous CAS-retry shape had
+    // a fatal window: a holder unlocking between the failed fast-path CAS
+    // and the exchange made the exchange return 0 (free), the retry CAS
+    // then failed against the 2 the locker itself had just written, and
+    // it parked on a mutex NOBODY owned — every later locker piled up
+    // behind it forever. That was the rare all-callers-parked in-process
+    // wedge: the flight recorder pinned it as two FIBER_PARKs on a socket
+    // _pending_mu butex with no UNPARK ever and no live holder.
+    while (_b->value.exchange(2, std::memory_order_acquire) != 0) {
+      butex_wait(_b, 2, nullptr);
+    }
     if (profile) {
       contention_internal::Record(tbutil::monotonic_time_us() - t0);
     }

@@ -10,6 +10,7 @@
 #include <vector>
 
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 
 namespace tbthread {
 
@@ -97,6 +98,11 @@ void TimerThread::run() {
     Entry e = it->second;
     _impl->live.erase(it);
     lk.unlock();
+    // Timer liveness evidence: the watchdog heartbeats this thread, and a
+    // wedge where the timer parks shows as these events stopping.
+    tbvar::flight_record(tbvar::FLIGHT_TIMER_FIRE,
+                         static_cast<uint64_t>(top.when_us),
+                         static_cast<uint64_t>(now - top.when_us));
     e.fn(e.arg);  // outside the lock: fn may (un)schedule timers
     lk.lock();
   }

@@ -1,0 +1,207 @@
+#include "tbvar/flight_recorder.h"
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <mutex>
+
+#include "tbutil/time.h"
+
+namespace tbvar {
+
+namespace flight_internal {
+
+std::atomic<bool> g_enabled{true};
+std::atomic<int64_t> g_ring_events{2048};
+
+thread_local FlightRing* tls_ring = nullptr;
+
+int64_t NowUs() { return tbutil::gettimeofday_us(); }
+
+namespace {
+
+// Registry of every ring ever created. IMMORTAL (leaked): a snapshot may
+// run during process exit while other threads still record; destroying the
+// vector under them would be the exit-time crash class ObjectPool already
+// taught us about. Locked ONLY at ring creation and in snapshots — never
+// on the event-write path.
+struct Registry {
+  std::mutex mu;
+  std::vector<FlightRing*> rings;
+};
+Registry* const g_registry = new Registry;
+
+uint32_t round_up_pow2(uint32_t n) {
+  uint32_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+// Marks the ring dead when its owning thread exits; the ring itself (and
+// its slots) leak on purpose — an exited thread's tail is evidence.
+struct RingGuard {
+  FlightRing* ring = nullptr;
+  ~RingGuard() {
+    if (ring != nullptr) ring->live.store(false, std::memory_order_release);
+  }
+};
+thread_local RingGuard tls_ring_guard;
+
+}  // namespace
+
+FlightRing* CreateThisThreadRing() {
+  int64_t want = g_ring_events.load(std::memory_order_relaxed);
+  if (want < 64) want = 64;
+  if (want > 65536) want = 65536;
+  const uint32_t n = round_up_pow2(static_cast<uint32_t>(want));
+  auto* ring = new (std::nothrow) FlightRing;
+  if (ring == nullptr) return nullptr;
+  ring->slots = new (std::nothrow) FlightSlot[n];
+  if (ring->slots == nullptr) {
+    delete ring;
+    return nullptr;
+  }
+  ring->mask = n - 1;
+  ring->os_tid = static_cast<uint32_t>(syscall(SYS_gettid));
+  {
+    std::lock_guard<std::mutex> lk(g_registry->mu);
+    g_registry->rings.push_back(ring);
+  }
+  tls_ring = ring;
+  tls_ring_guard.ring = ring;
+  return ring;
+}
+
+}  // namespace flight_internal
+
+const char* flight_event_type_name(uint16_t type) {
+  switch (type) {
+    case FLIGHT_FIBER_PARK: return "FIBER_PARK";
+    case FLIGHT_FIBER_UNPARK: return "FIBER_UNPARK";
+    case FLIGHT_FIBER_TIMEOUT: return "FIBER_TIMEOUT";
+    case FLIGHT_RPC_PHASE: return "RPC_PHASE";
+    case FLIGHT_ICI_CREDIT_CONSUME: return "ICI_CREDIT_CONSUME";
+    case FLIGHT_ICI_CREDIT_GRANT: return "ICI_CREDIT_GRANT";
+    case FLIGHT_ICI_CREDIT_STARVE: return "ICI_CREDIT_STARVE";
+    case FLIGHT_ARENA_ALLOC: return "ARENA_ALLOC";
+    case FLIGHT_ARENA_RELEASE: return "ARENA_RELEASE";
+    case FLIGHT_TIMER_FIRE: return "TIMER_FIRE";
+    case FLIGHT_HEALTH: return "HEALTH";
+    default: return "UNKNOWN";
+  }
+}
+
+const char* flight_rpc_phase_name(uint64_t phase) {
+  switch (phase) {
+    case FLIGHT_RPC_CLIENT_ISSUE: return "client_issue";
+    case FLIGHT_RPC_CLIENT_END: return "client_end";
+    case FLIGHT_RPC_SERVER_IN: return "server_in";
+    case FLIGHT_RPC_SERVER_DONE: return "server_done";
+    default: return "?";
+  }
+}
+
+size_t flight_snapshot(std::vector<FlightEventView>* out, size_t max_events) {
+  using namespace flight_internal;
+  out->clear();
+  std::vector<FlightRing*> rings;
+  {
+    std::lock_guard<std::mutex> lk(g_registry->mu);
+    rings = g_registry->rings;
+  }
+  for (FlightRing* r : rings) {
+    const uint64_t head = r->head.load(std::memory_order_acquire);
+    const uint64_t size = static_cast<uint64_t>(r->mask) + 1;
+    const uint64_t n = std::min(head, size);
+    const bool live = r->live.load(std::memory_order_acquire);
+    for (uint64_t i = head - n; i < head; ++i) {
+      const FlightSlot& s = r->slots[i & r->mask];
+      const uint64_t seq1 = s.seq.load(std::memory_order_acquire);
+      if (seq1 == 0) continue;
+      FlightEventView ev;
+      ev.ts_us = s.ts_us.load(std::memory_order_relaxed);
+      ev.a = s.a.load(std::memory_order_relaxed);
+      ev.b = s.b.load(std::memory_order_relaxed);
+      ev.type = s.type.load(std::memory_order_relaxed);
+      std::atomic_thread_fence(std::memory_order_acquire);
+      const uint64_t seq2 = s.seq.load(std::memory_order_relaxed);
+      if (seq1 != seq2) continue;  // caught mid-rewrite: discard
+      ev.seq = seq1;
+      ev.os_tid = r->os_tid;
+      ev.thread_live = live;
+      out->push_back(ev);
+    }
+  }
+  std::sort(out->begin(), out->end(),
+            [](const FlightEventView& x, const FlightEventView& y) {
+              if (x.ts_us != y.ts_us) return x.ts_us < y.ts_us;
+              if (x.os_tid != y.os_tid) return x.os_tid < y.os_tid;
+              return x.seq < y.seq;
+            });
+  if (max_events > 0 && out->size() > max_events) {
+    out->erase(out->begin(),
+               out->begin() + static_cast<ptrdiff_t>(out->size() - max_events));
+  }
+  return out->size();
+}
+
+void flight_render_line(const FlightEventView& ev, std::string* out) {
+  char line[192];
+  snprintf(line, sizeof(line),
+           "%lld tid=%u%s seq=%llu %-18s a=0x%llx b=0x%llx",
+           static_cast<long long>(ev.ts_us), ev.os_tid,
+           ev.thread_live ? "" : "!",
+           static_cast<unsigned long long>(ev.seq),
+           flight_event_type_name(ev.type),
+           static_cast<unsigned long long>(ev.a),
+           static_cast<unsigned long long>(ev.b));
+  *out += line;
+  if (ev.type == FLIGHT_RPC_PHASE) {
+    *out += " phase=";
+    *out += flight_rpc_phase_name(ev.a);
+  }
+}
+
+std::string flight_snapshot_text(size_t max_events) {
+  std::vector<FlightEventView> events;
+  flight_snapshot(&events, max_events);
+  std::string out;
+  out.reserve(events.size() * 96);
+  for (const FlightEventView& ev : events) {
+    flight_render_line(ev, &out);
+    out += '\n';
+  }
+  return out;
+}
+
+int64_t flight_total_events() {
+  using namespace flight_internal;
+  std::lock_guard<std::mutex> lk(g_registry->mu);
+  int64_t n = 0;
+  for (const FlightRing* r : g_registry->rings) {
+    n += static_cast<int64_t>(r->head.load(std::memory_order_relaxed));
+  }
+  return n;
+}
+
+void flight_set_enabled(bool on) {
+  flight_internal::g_enabled.store(on, std::memory_order_relaxed);
+}
+
+bool flight_enabled() {
+  return flight_internal::g_enabled.load(std::memory_order_relaxed);
+}
+
+void flight_set_ring_events(int64_t n) {
+  if (n < 64) n = 64;
+  if (n > 65536) n = 65536;
+  flight_internal::g_ring_events.store(n, std::memory_order_relaxed);
+}
+
+int64_t flight_ring_events() {
+  return flight_internal::g_ring_events.load(std::memory_order_relaxed);
+}
+
+}  // namespace tbvar

@@ -1,0 +1,171 @@
+// Flight recorder: an always-on, per-thread ring of fixed-size binary
+// events — the black box the stall watchdog reads out after a crash-less
+// failure. The state that explains a hang (who parked on what, which
+// doorbell consumed the last TX credit, which timer actually fired) is
+// gone by the time an operator attaches; the recorder keeps the last few
+// thousand scheduling/transport events per thread at a cost low enough to
+// leave on in production.
+//
+// Design:
+//   * one ring per thread, created lazily on that thread's first event and
+//     registered once (the ONLY lock in the subsystem guards that
+//     registration list — never the event-write path);
+//   * the write path is wait-free: a monotonic per-ring head plus a
+//     per-slot sequence stamp (seqlock-style, all fields atomics so racing
+//     snapshots are benign); a concurrent reader that catches a slot
+//     mid-rewrite discards it;
+//   * snapshots run from ANY pthread — including a watchdog observing a
+//     process whose every fiber worker is parked — merge all rings and
+//     sort by timestamp;
+//   * rings are leaked at thread exit (marked dead, kept readable): the
+//     events of an exited thread are often exactly the forensics wanted.
+//
+// "T3: Transparent Tracking & Triggering" (PAPERS.md) argues progress
+// tracking belongs in the fabric itself; this is that layer for the fiber
+// runtime + ICI transport.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tbvar {
+
+// Event vocabulary. `a`/`b` meanings per type — kept to two u64s so a slot
+// stays one cache line.
+enum FlightEventType : uint16_t {
+  FLIGHT_NONE = 0,
+  FLIGHT_FIBER_PARK = 1,      // a = butex address, b = fiber tid (0: pthread)
+  FLIGHT_FIBER_UNPARK = 2,    // a = butex address, b = woken fiber tid
+  FLIGHT_FIBER_TIMEOUT = 3,   // a = butex address, b = timed-out fiber tid
+  FLIGHT_RPC_PHASE = 4,       // a = FlightRpcPhase, b = correlation id
+  FLIGHT_ICI_CREDIT_CONSUME = 5,  // a = socket id, b = TX blocks consumed
+  FLIGHT_ICI_CREDIT_GRANT = 6,    // a = socket id, b = block index returned
+  FLIGHT_ICI_CREDIT_STARVE = 7,   // a = socket id, b = free TX blocks
+  FLIGHT_ARENA_ALLOC = 8,     // a = arena id, b = range offset
+  FLIGHT_ARENA_RELEASE = 9,   // a = arena id, b = range offset
+  FLIGHT_TIMER_FIRE = 10,     // a = scheduled abstime_us, b = lateness_us
+  FLIGHT_HEALTH = 11,         // a = old health state, b = new health state
+};
+
+enum FlightRpcPhase : uint64_t {
+  FLIGHT_RPC_CLIENT_ISSUE = 1,
+  FLIGHT_RPC_CLIENT_END = 2,
+  FLIGHT_RPC_SERVER_IN = 3,
+  FLIGHT_RPC_SERVER_DONE = 4,
+};
+
+const char* flight_event_type_name(uint16_t type);
+const char* flight_rpc_phase_name(uint64_t phase);
+
+namespace flight_internal {
+
+// One event slot. All fields are atomics: snapshots race the writer by
+// design, and the seq stamp (position+1, 0 = never written) lets a reader
+// discard a slot it caught mid-rewrite. Best-effort by contract — a torn
+// diagnostic event is dropped, never propagated.
+struct FlightSlot {
+  std::atomic<uint64_t> seq{0};
+  std::atomic<int64_t> ts_us{0};
+  std::atomic<uint64_t> a{0};
+  std::atomic<uint64_t> b{0};
+  std::atomic<uint16_t> type{0};
+};
+static_assert(std::atomic<uint64_t>::is_always_lock_free &&
+                  std::atomic<int64_t>::is_always_lock_free,
+              "flight recorder slots must be lock-free atomics");
+
+struct FlightRing {
+  FlightSlot* slots = nullptr;
+  uint32_t mask = 0;                 // slot count - 1 (power of two)
+  uint32_t os_tid = 0;               // gettid() of the owning thread
+  std::atomic<bool> live{true};      // false once the thread exited
+  std::atomic<uint64_t> head{0};     // events ever written by this thread
+};
+
+extern std::atomic<bool> g_enabled;        // flight_recorder_enabled flag
+extern std::atomic<int64_t> g_ring_events; // size of the NEXT ring created
+
+// Create + register this thread's ring (locks the registry ONCE per
+// thread lifetime; every subsequent event is lock-free).
+FlightRing* CreateThisThreadRing();
+
+extern thread_local FlightRing* tls_ring;
+
+int64_t NowUs();
+
+}  // namespace flight_internal
+
+// THE event-write path. Wait-free after the calling thread's first event:
+// no lock, no allocation, no syscall beyond the vDSO clock read —
+// tests/test_health.py pins the lock-free property on this region.
+// flight-write-path-begin
+inline void flight_record(uint16_t type, uint64_t a, uint64_t b) {
+  using namespace flight_internal;
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  FlightRing* r = tls_ring;
+  if (r == nullptr) {
+    r = CreateThisThreadRing();  // once per thread; null if out of memory
+    if (r == nullptr) return;
+  }
+  const uint64_t h = r->head.load(std::memory_order_relaxed);
+  FlightSlot& s = r->slots[h & r->mask];
+  // Invalidate, fill, publish: a snapshot reading seq twice around its
+  // field copies discards the slot unless both reads saw h+1. The
+  // release fence orders the invalidation BEFORE the payload stores for
+  // weakly-ordered CPUs: a reader whose payload copy observed any new
+  // field (its own acquire fence pairing with this one) then cannot
+  // re-read the OLD nonzero seq and validate a torn event.
+  s.seq.store(0, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.ts_us.store(NowUs(), std::memory_order_relaxed);
+  s.a.store(a, std::memory_order_relaxed);
+  s.b.store(b, std::memory_order_relaxed);
+  s.type.store(type, std::memory_order_relaxed);
+  s.seq.store(h + 1, std::memory_order_release);
+  r->head.store(h + 1, std::memory_order_release);
+}
+// flight-write-path-end
+
+// One merged snapshot event (reader-side copy of a slot).
+struct FlightEventView {
+  int64_t ts_us = 0;
+  uint64_t seq = 0;       // per-thread position (1-based)
+  uint32_t os_tid = 0;
+  bool thread_live = true;
+  uint16_t type = 0;
+  uint64_t a = 0;
+  uint64_t b = 0;
+};
+
+// Merge every ring's consistent slots, sort by timestamp, keep the newest
+// `max_events` (0 = unbounded). Callable from any pthread at any time.
+size_t flight_snapshot(std::vector<FlightEventView>* out, size_t max_events);
+
+// THE canonical text rendering of one event (no trailing newline):
+//   <ts_us> tid=<os_tid>[!] seq=<n> <TYPE> a=0x<hex> b=0x<hex> [phase=...]
+// ("!" marks an exited thread). One renderer serves flight_snapshot_text,
+// the /flightz console page, and the Python decoder's line regex
+// (brpc_tpu/observability/health.py) — keep all three in lockstep by
+// changing only this.
+void flight_render_line(const FlightEventView& ev, std::string* out);
+
+// The same snapshot rendered one flight_render_line per event, oldest
+// first.
+std::string flight_snapshot_text(size_t max_events);
+
+// Lifetime event count across all rings (dead threads included) — the
+// rpc_flight_events gauge.
+int64_t flight_total_events();
+
+// Runtime switches (also reachable as reloadable flags:
+// flight_recorder_enabled / flight_recorder_ring_events).
+void flight_set_enabled(bool on);
+bool flight_enabled();
+// Applies to rings created AFTER the call (clamped to [64, 65536], rounded
+// up to a power of two); existing rings keep their size.
+void flight_set_ring_events(int64_t n);
+int64_t flight_ring_events();
+
+}  // namespace tbvar

@@ -16,10 +16,12 @@
 #include "tbutil/cpu_profiler.h"
 #include "tbutil/heap_profiler.h"
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 #include "tbvar/prometheus.h"
 #include "tbvar/series.h"
 #include "tbvar/variable.h"
 #include "trpc/flags.h"
+#include "trpc/stall_watchdog.h"
 #include "trpc/http_protocol.h"
 #include "trpc/server.h"
 #include "trpc/event_dispatcher.h"
@@ -47,6 +49,10 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/metrics\">/metrics</a> — Prometheus text format "
       "(also at <a href=\"/brpc_metrics\">/brpc_metrics</a>)</li>"
       "<li><a href=\"/health\">/health</a></li>"
+      "<li><a href=\"/healthz\">/healthz</a> — watchdog health state "
+      "machine + transitions (JSON)</li>"
+      "<li><a href=\"/flightz\">/flightz</a> — flight recorder: merged "
+      "per-thread event rings (?tid=&amp;type=&amp;a=&amp;b=&amp;max=)</li>"
       "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
       "<li><a href=\"/tensorz\">/tensorz</a> — tensor arenas + data-plane "
       "stage latencies</li>"
@@ -352,6 +358,78 @@ void health_page(const HttpRequest&, HttpResponse* resp) {
   resp->body = "OK\n";
 }
 
+// /healthz: the stall watchdog's self-judgment as JSON — state machine
+// (ok/degraded/stalled), reason, transition history, last auto-dump path.
+// Served even when the watchdog pthread was never started (state stays ok,
+// watchdog_running:false tells the scraper the verdict is unsupervised).
+void healthz_page(const HttpRequest&, HttpResponse* resp) {
+  resp->content_type = "application/json";
+  resp->body = StallWatchdog::singleton().DumpJson();
+  resp->body += '\n';
+}
+
+// /flightz: the flight recorder — every thread ring merged and time-sorted.
+//   ?max=N    newest N events (default 256, cap 65536)
+//   ?tid=N    one OS thread
+//   ?type=S   event-type substring (e.g. type=CREDIT, type=FIBER_PARK)
+//   ?a=X ?b=X numeric match on the payload words (0x hex or decimal) —
+//             a butex address, fiber tid, socket id, arena id...
+void flightz_page(const HttpRequest& req, HttpResponse* resp) {
+  size_t max_events = 256;
+  const std::string max_s = req.query_param("max");
+  if (!max_s.empty()) {
+    long v = atol(max_s.c_str());
+    if (v > 0) max_events = std::min<long>(v, 65536);
+  }
+  const std::string tid_s = req.query_param("tid");
+  const std::string type_s = req.query_param("type");
+  const std::string a_s = req.query_param("a");
+  const std::string b_s = req.query_param("b");
+  const bool has_tid = !tid_s.empty();
+  const bool has_a = !a_s.empty();
+  const bool has_b = !b_s.empty();
+  const uint32_t want_tid =
+      has_tid ? static_cast<uint32_t>(strtoul(tid_s.c_str(), nullptr, 0)) : 0;
+  const uint64_t want_a =
+      has_a ? strtoull(a_s.c_str(), nullptr, 0) : 0;
+  const uint64_t want_b =
+      has_b ? strtoull(b_s.c_str(), nullptr, 0) : 0;
+  std::vector<tbvar::FlightEventView> events;
+  // Filtered views must still return up to `max` MATCHING events: snapshot
+  // unbounded, filter, then cut to the newest `max`.
+  tbvar::flight_snapshot(&events, 0);
+  std::vector<const tbvar::FlightEventView*> kept;
+  kept.reserve(events.size());
+  for (const auto& ev : events) {
+    if (has_tid && ev.os_tid != want_tid) continue;
+    if (!type_s.empty() &&
+        std::string(tbvar::flight_event_type_name(ev.type))
+                .find(type_s) == std::string::npos) {
+      continue;
+    }
+    if (has_a && ev.a != want_a) continue;
+    if (has_b && ev.b != want_b) continue;
+    kept.push_back(&ev);
+  }
+  if (kept.size() > max_events) {
+    kept.erase(kept.begin(),
+               kept.begin() + static_cast<ptrdiff_t>(kept.size() - max_events));
+  }
+  std::string& body = resp->body;
+  char line[128];
+  snprintf(line, sizeof(line),
+           "%zu event(s) shown (%zu matched, %lld recorded ever; "
+           "recorder %s)\n",
+           kept.size(), events.size(),
+           static_cast<long long>(tbvar::flight_total_events()),
+           tbvar::flight_enabled() ? "on" : "OFF");
+  body += line;
+  for (const auto* ev : kept) {
+    tbvar::flight_render_line(*ev, &body);
+    body += '\n';
+  }
+}
+
 // /fibers: every live fiber with the parked ones' call stacks — the
 // TaskTracer page (reference bthread tracer / /bthreads).
 void fibers_page(const HttpRequest&, HttpResponse* resp) {
@@ -573,6 +651,8 @@ void RegisterBuiltinConsole() {
     RegisterHttpHandler("/threads", threads_page);
     RegisterHttpHandler("/version", version_page);
     RegisterHttpHandler("/health", health_page);
+    RegisterHttpHandler("/healthz", healthz_page);
+    RegisterHttpHandler("/flightz", flightz_page);
     RegisterHttpHandler("/rpcz", rpcz_page);
     RegisterHttpHandler("/fibers", fibers_page);
     RegisterHttpHandler("/hotspots", hotspots_page);

@@ -3,6 +3,7 @@
 #include "tbthread/fiber.h"
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 #include "trpc/channel.h"
 #include "trpc/compress.h"
 #include "trpc/errno.h"
@@ -79,6 +80,8 @@ bool Controller::HasRetryBudget() const {
 // synchronous failure, falls through to the retry/finish decision directly
 // (no fiber_id_error: we already hold the lock).
 void Controller::IssueRPC() {
+  tbvar::flight_record(tbvar::FLIGHT_RPC_PHASE, tbvar::FLIGHT_RPC_CLIENT_ISSUE,
+                       _correlation_id);
   while (true) {
     const Protocol* proto = GetProtocol(_protocol);
     if (proto == nullptr || proto->pack_request == nullptr) {
@@ -430,6 +433,8 @@ void Controller::BackupThunk(void* arg) {
 // Runs with the id LOCKED; finishes the RPC: records the result, stops the
 // timer, destroys the id (waking Join) and runs the async done.
 void Controller::EndRPC(int error, const std::string& error_text) {
+  tbvar::flight_record(tbvar::FLIGHT_RPC_PHASE, tbvar::FLIGHT_RPC_CLIENT_END,
+                       _correlation_id);
   if (error != 0) {
     _error_code = error;
     _error_text = error_text;

@@ -1,0 +1,454 @@
+// Watchdog-thread code. Everything here runs on (or is read from) a plain
+// dedicated pthread that must stay schedulable when every fiber worker is
+// parked — OS primitives are REQUIRED, fiber primitives are forbidden.
+// tpulint: pthread-only
+// tpulint: allow-file(fiber-blocking)
+#include "trpc/stall_watchdog.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "tbthread/fiber.h"
+#include "tbthread/timer_thread.h"
+#include "tbthread/tracer.h"
+#include "tbutil/json.h"
+#include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
+#include "tbvar/passive_status.h"
+#include "tbvar/reducer.h"
+#include "trpc/flags.h"
+#include "ttpu/ici_segment.h"
+
+namespace trpc {
+
+namespace {
+
+// All hot-reloadable: an operator can tighten the stall window on a
+// misbehaving pod via /flags without a restart.
+std::atomic<int64_t>* g_poll_ms = TRPC_DEFINE_FLAG(
+    watchdog_poll_ms, 100,
+    "stall watchdog poll period; each poll heartbeats the scheduler (a "
+    "no-op probe fiber) and the timer thread (a probe timer)");
+std::atomic<int64_t>* g_degraded_ms = TRPC_DEFINE_FLAG(
+    watchdog_degraded_ms, 500,
+    "a probe or credit wait older than this turns health degraded");
+std::atomic<int64_t>* g_stalled_ms = TRPC_DEFINE_FLAG(
+    watchdog_stalled_ms, 2000,
+    "a scheduler/timer probe older than this turns health stalled (and "
+    "triggers the auto-dump)");
+std::atomic<int64_t>* g_credit_stall_ms = TRPC_DEFINE_FLAG(
+    watchdog_credit_stall_ms, 10000,
+    "a writer parked in WaitCredit longer than this turns health stalled "
+    "— long waits are legal under backpressure, so this window is wider "
+    "than the scheduler one");
+std::atomic<int64_t>* g_autodump = TRPC_DEFINE_FLAG(
+    watchdog_autodump, 1,
+    "on entering stalled, dump fibers + ICI credit state + the flight "
+    "recorder tail to a timestamped file in the watchdog's dump dir");
+
+// The flight recorder's own switches, surfaced as flags here (tbvar owns
+// the atomics; trpc owns the flag registry — DefineLinked keeps one source
+// of truth).
+struct FlightFlagRegistrar {
+  FlightFlagRegistrar() {
+    FlagRegistry::global().DefineLinked(
+        "flight_recorder_enabled", 1,
+        "record fiber/RPC/ICI/arena/timer events into the per-thread "
+        "flight rings (/flightz)",
+        [] { return tbvar::flight_enabled() ? int64_t{1} : int64_t{0}; },
+        [](int64_t v) {
+          tbvar::flight_set_enabled(v != 0);
+          return true;
+        });
+    FlagRegistry::global().DefineLinked(
+        "flight_recorder_ring_events", tbvar::flight_ring_events(),
+        "events kept per thread ring (applies to rings created after the "
+        "change; clamped to [64, 65536], rounded up to a power of two)",
+        [] { return tbvar::flight_ring_events(); },
+        [](int64_t v) {
+          if (v < 64 || v > 65536) return false;
+          tbvar::flight_set_ring_events(v);
+          return true;
+        });
+  }
+};
+FlightFlagRegistrar g_flight_flags;
+
+// ---- ICI credit-wait bookkeeping (lock-free, approximate) ----
+// `g_oldest_wait_start_us` holds the park time of the FIRST waiter of the
+// current busy period; it resets when the waiter count hits zero, so with
+// overlapping waiters the age can over-report (fine for a stall
+// detector). The races are self-healing rather than blinding: Begin
+// stamps with a CAS so it never shrinks an older stamp, and the READER
+// re-stamps when it finds waiters with no stamp (an End racing a Begin
+// can clobber the stamp to 0; the watchdog's next poll restarts the age
+// clock, bounding the under-report to one poll instead of forever).
+std::atomic<int64_t> g_credit_waiters{0};
+std::atomic<int64_t> g_oldest_wait_start_us{0};
+
+int64_t clamp_ms(std::atomic<int64_t>* flag, int64_t lo, int64_t hi) {
+  int64_t v = flag->load(std::memory_order_relaxed);
+  if (v < lo) v = lo;
+  if (v > hi) v = hi;
+  return v;
+}
+
+std::string render_fiber_dump() {
+  std::vector<tbthread::FiberTrace> traces;
+  tbthread::fiber_trace_all(&traces);
+  std::string out = std::to_string(traces.size()) + " live fiber(s)\n";
+  char line[128];
+  for (const auto& t : traces) {
+    snprintf(line, sizeof(line), "fiber %llu %s\n",
+             static_cast<unsigned long long>(t.tid),
+             t.running ? "RUNNING" : "parked");
+    out += line;
+    for (size_t i = 0; i < t.frames.size(); ++i) {
+      snprintf(line, sizeof(line), "  #%zu %p %s\n", i, t.frames[i],
+               i < t.symbols.size() ? t.symbols[i].c_str() : "?");
+      out += line;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+void WatchdogCreditWaitBegin() {
+  g_credit_waiters.fetch_add(1, std::memory_order_acq_rel);
+  // Stamp only an UNSET clock: never move an older (larger-age) stamp.
+  int64_t expected = 0;
+  g_oldest_wait_start_us.compare_exchange_strong(
+      expected, tbutil::gettimeofday_us(), std::memory_order_acq_rel,
+      std::memory_order_relaxed);
+}
+
+void WatchdogCreditWaitEnd() {
+  if (g_credit_waiters.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    g_oldest_wait_start_us.store(0, std::memory_order_release);
+  }
+}
+
+int64_t WatchdogOldestCreditWaitUs() {
+  if (g_credit_waiters.load(std::memory_order_acquire) <= 0) return 0;
+  const int64_t start = g_oldest_wait_start_us.load(std::memory_order_acquire);
+  if (start == 0) {
+    // Waiters exist but the stamp was lost to an End/Begin race: restart
+    // the age clock HERE so a real stall still ages to detection (one
+    // poll late) instead of reading 0 until the count next hits zero.
+    int64_t expected = 0;
+    g_oldest_wait_start_us.compare_exchange_strong(
+        expected, tbutil::gettimeofday_us(), std::memory_order_acq_rel,
+        std::memory_order_relaxed);
+    return 0;
+  }
+  const int64_t age = tbutil::gettimeofday_us() - start;
+  return age > 0 ? age : 0;
+}
+
+const char* health_state_name(int state) {
+  switch (state) {
+    case static_cast<int>(HealthState::kOk): return "ok";
+    case static_cast<int>(HealthState::kDegraded): return "degraded";
+    case static_cast<int>(HealthState::kStalled): return "stalled";
+    default: return "unknown";
+  }
+}
+
+struct StallWatchdog::Impl {
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> thread_running{false};
+
+  // Scheduler probe: a no-op fiber per poll; its age while unexecuted IS
+  // the scheduler's responsiveness (idle and busy processes both run it
+  // promptly; only a wedged worker pool lets it age).
+  std::atomic<bool> sched_outstanding{false};
+  std::atomic<bool> sched_done{false};
+  std::atomic<int64_t> sched_sent_us{0};
+
+  // Timer-thread probe: an immediate TimerThread task per poll.
+  std::atomic<bool> timer_outstanding{false};
+  std::atomic<bool> timer_done{false};
+  std::atomic<int64_t> timer_sent_us{0};
+
+  std::atomic<int> state{static_cast<int>(HealthState::kOk)};
+  std::atomic<int64_t> since_us{0};
+
+  struct Transition {
+    int64_t ts_us;
+    int from;
+    int to;
+    std::string reason;
+  };
+
+  mutable std::mutex mu;  // reason/transitions/dump path/dump dir
+  std::string reason;
+  std::deque<Transition> transitions;  // newest last, capped
+  std::string dump_dir;
+  std::string last_dump_path;
+  bool dumped_this_episode = false;
+
+  tbvar::Adder<int64_t>* stalls = nullptr;  // rpc_health_stalls
+
+  static void* SchedProbeFn(void* self) {
+    static_cast<Impl*>(self)->sched_done.store(true,
+                                               std::memory_order_release);
+    return nullptr;
+  }
+
+  static void TimerProbeFn(void* self) {
+    static_cast<Impl*>(self)->timer_done.store(true,
+                                               std::memory_order_release);
+  }
+
+  void ExposeVars() {
+    static std::once_flag once;
+    std::call_once(once, [this] {
+      (new tbvar::PassiveStatus<int64_t>([this] {
+        return static_cast<int64_t>(state.load(std::memory_order_relaxed));
+      }))->expose("rpc_health_state");
+      (new tbvar::PassiveStatus<int64_t>([] {
+        return tbvar::flight_total_events();
+      }))->expose("rpc_flight_events");
+      stalls = new tbvar::Adder<int64_t>();
+      stalls->expose("rpc_health_stalls");
+    });
+  }
+
+  void WriteAutoDump(int64_t now_us, const std::string& why) {
+    std::string dir;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      dir = dump_dir;
+    }
+    if (dir.empty()) return;
+    const std::string path =
+        dir + "/brpc_tpu_stall_" + std::to_string(now_us) + ".dump";
+    // Gather OUTSIDE any watchdog lock: the collectors take their own
+    // (short, never-held-across-park) locks.
+    const std::string fibers = render_fiber_dump();
+    std::string ici = ttpu::DebugDumpEndpoints(false);
+    if (ici.empty()) ici = "(no live tpu:// endpoints)\n";
+    const std::string flight = tbvar::flight_snapshot_text(512);
+    FILE* f = fopen(path.c_str(), "w");
+    if (f == nullptr) return;
+    fprintf(f, "brpc_tpu stall auto-dump\ntime_us: %lld\nreason: %s\n",
+            static_cast<long long>(now_us), why.c_str());
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      fprintf(f, "health transitions (oldest first):\n");
+      for (const Transition& t : transitions) {
+        fprintf(f, "  %lld %s -> %s (%s)\n",
+                static_cast<long long>(t.ts_us), health_state_name(t.from),
+                health_state_name(t.to), t.reason.c_str());
+      }
+    }
+    fprintf(f, "\n== fibers ==\n%s", fibers.c_str());
+    fprintf(f, "\n== ici endpoints ==\n%s", ici.c_str());
+    fprintf(f, "\n== flight recorder tail ==\n%s", flight.c_str());
+    fclose(f);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      last_dump_path = path;
+    }
+  }
+
+  void TransitionTo(int new_state, const std::string& why, int64_t now_us) {
+    const int old = state.exchange(new_state, std::memory_order_release);
+    if (old == new_state) return;
+    since_us.store(now_us, std::memory_order_release);
+    tbvar::flight_record(tbvar::FLIGHT_HEALTH, old, new_state);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      reason = new_state == static_cast<int>(HealthState::kOk) ? "" : why;
+      transitions.push_back({now_us, old, new_state, why});
+      while (transitions.size() > 64) transitions.pop_front();
+      if (new_state == static_cast<int>(HealthState::kOk)) {
+        dumped_this_episode = false;  // a fresh episode may dump again
+      }
+    }
+    if (new_state == static_cast<int>(HealthState::kStalled)) {
+      if (stalls != nullptr) *stalls << 1;
+      bool do_dump = g_autodump->load(std::memory_order_relaxed) != 0;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (dumped_this_episode) do_dump = false;
+        dumped_this_episode = true;
+      }
+      if (do_dump) WriteAutoDump(now_us, why);
+    }
+  }
+
+  void Poll() {
+    const int64_t now = tbutil::gettimeofday_us();
+    // Harvest + resubmit the scheduler probe.
+    if (sched_outstanding.load(std::memory_order_acquire) &&
+        sched_done.load(std::memory_order_acquire)) {
+      sched_outstanding.store(false, std::memory_order_release);
+    }
+    if (!sched_outstanding.load(std::memory_order_acquire)) {
+      sched_done.store(false, std::memory_order_release);
+      sched_sent_us.store(now, std::memory_order_release);
+      tbthread::fiber_t tid;
+      if (tbthread::fiber_start_background(&tid, nullptr, &SchedProbeFn,
+                                           this) == 0) {
+        sched_outstanding.store(true, std::memory_order_release);
+      }
+    }
+    // Harvest + resubmit the timer probe.
+    if (timer_outstanding.load(std::memory_order_acquire) &&
+        timer_done.load(std::memory_order_acquire)) {
+      timer_outstanding.store(false, std::memory_order_release);
+    }
+    if (!timer_outstanding.load(std::memory_order_acquire)) {
+      timer_done.store(false, std::memory_order_release);
+      timer_sent_us.store(now, std::memory_order_release);
+      if (tbthread::TimerThread::singleton()->schedule(&TimerProbeFn, this,
+                                                       now) !=
+          tbthread::TimerThread::INVALID_TASK_ID) {
+        timer_outstanding.store(true, std::memory_order_release);
+      }
+    }
+
+    const int64_t sched_age =
+        sched_outstanding.load(std::memory_order_acquire) &&
+                !sched_done.load(std::memory_order_acquire)
+            ? now - sched_sent_us.load(std::memory_order_acquire)
+            : 0;
+    const int64_t timer_age =
+        timer_outstanding.load(std::memory_order_acquire) &&
+                !timer_done.load(std::memory_order_acquire)
+            ? now - timer_sent_us.load(std::memory_order_acquire)
+            : 0;
+    const int64_t credit_age = WatchdogOldestCreditWaitUs();
+
+    const int64_t degraded_us = clamp_ms(g_degraded_ms, 10, 3600000) * 1000;
+    const int64_t stalled_us = clamp_ms(g_stalled_ms, 20, 3600000) * 1000;
+    const int64_t credit_us = clamp_ms(g_credit_stall_ms, 20, 3600000) * 1000;
+
+    int worst = static_cast<int>(HealthState::kOk);
+    char why[160];
+    why[0] = '\0';
+    auto consider = [&](int64_t age_us, int64_t stall_at,
+                        const char* what) {
+      int s = static_cast<int>(HealthState::kOk);
+      if (age_us >= stall_at) {
+        s = static_cast<int>(HealthState::kStalled);
+      } else if (age_us >= degraded_us) {
+        s = static_cast<int>(HealthState::kDegraded);
+      }
+      if (s > worst) {
+        worst = s;
+        snprintf(why, sizeof(why), "%s for %lldms", what,
+                 static_cast<long long>(age_us / 1000));
+      }
+    };
+    consider(sched_age, stalled_us,
+             "scheduler: probe fiber not executed (no worker progress)");
+    consider(timer_age, stalled_us,
+             "timer_thread: heartbeat timer not firing");
+    consider(credit_age, credit_us,
+             "ici_credit: writer parked in WaitCredit");
+    TransitionTo(worst, why, now);
+  }
+
+  void Loop() {
+    thread_running.store(true, std::memory_order_release);
+    while (!stop.load(std::memory_order_acquire)) {
+      Poll();
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(clamp_ms(g_poll_ms, 10, 10000)));
+    }
+    thread_running.store(false, std::memory_order_release);
+  }
+};
+
+StallWatchdog& StallWatchdog::singleton() {
+  static StallWatchdog* w = [] {
+    auto* wd = new StallWatchdog;
+    wd->_impl = new Impl;
+    return wd;
+  }();
+  return *w;
+}
+
+int StallWatchdog::Start(const std::string& dump_dir) {
+  Impl* impl = _impl;
+  impl->ExposeVars();
+  {
+    std::lock_guard<std::mutex> lk(impl->mu);
+    if (!dump_dir.empty()) impl->dump_dir = dump_dir;
+  }
+  if (impl->thread.joinable()) return 0;  // already running
+  impl->stop.store(false, std::memory_order_release);
+  try {
+    impl->thread = std::thread([impl] { impl->Loop(); });
+  } catch (...) {
+    return -1;
+  }
+  return 0;
+}
+
+void StallWatchdog::Stop() {
+  Impl* impl = _impl;
+  if (!impl->thread.joinable()) return;
+  impl->stop.store(true, std::memory_order_release);
+  impl->thread.join();
+  impl->thread = std::thread();
+}
+
+bool StallWatchdog::running() const {
+  return _impl->thread_running.load(std::memory_order_acquire);
+}
+
+int StallWatchdog::state() const {
+  return _impl->state.load(std::memory_order_acquire);
+}
+
+std::string StallWatchdog::reason() const {
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  return _impl->reason;
+}
+
+std::string StallWatchdog::last_dump_path() const {
+  std::lock_guard<std::mutex> lk(_impl->mu);
+  return _impl->last_dump_path;
+}
+
+std::string StallWatchdog::DumpJson() const {
+  Impl* impl = _impl;
+  tbutil::JsonValue o = tbutil::JsonValue::Object();
+  o.set("state", health_state_name(impl->state.load(
+                     std::memory_order_acquire)));
+  o.set("since_us", impl->since_us.load(std::memory_order_acquire));
+  o.set("watchdog_running", running());
+  o.set("credit_waiters",
+        g_credit_waiters.load(std::memory_order_acquire));
+  o.set("flight_events", tbvar::flight_total_events());
+  o.set("stalls",
+        impl->stalls != nullptr ? impl->stalls->get_value() : int64_t{0});
+  {
+    std::lock_guard<std::mutex> lk(impl->mu);
+    o.set("reason", impl->reason);
+    o.set("last_dump_path", impl->last_dump_path);
+    tbutil::JsonValue arr = tbutil::JsonValue::Array();
+    for (const Impl::Transition& t : impl->transitions) {
+      tbutil::JsonValue tr = tbutil::JsonValue::Object();
+      tr.set("ts_us", t.ts_us);
+      tr.set("from", health_state_name(t.from));
+      tr.set("to", health_state_name(t.to));
+      tr.set("reason", t.reason);
+      arr.push_back(std::move(tr));
+    }
+    o.set("transitions", std::move(arr));
+  }
+  return o.Dump();
+}
+
+}  // namespace trpc

@@ -1,0 +1,59 @@
+// Stall watchdog: a dedicated PTHREAD (never a fiber — it supervises the
+// fiber scheduler, so it must stay schedulable when every fiber worker is
+// parked) that heartbeats the scheduler and the timer thread, tracks
+// writers parked for ICI credit, and drives a health state machine
+//   ok -> degraded -> stalled
+// with reason strings. On entering `stalled` it auto-dumps fibers + ICI
+// credit state + the flight-recorder tail to a timestamped file, so the
+// next occurrence of a rare wedge is captured with zero operator action.
+//
+// Surfaces: /healthz (JSON), rpc_health_state / rpc_health_stalls tbvars,
+// capi tbrpc_watchdog_* / tbrpc_health_*. Config: reloadable flags
+// watchdog_poll_ms / watchdog_degraded_ms / watchdog_stalled_ms /
+// watchdog_credit_stall_ms / watchdog_autodump (set via /flags or
+// tbrpc_flag_set).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace trpc {
+
+enum class HealthState : int { kOk = 0, kDegraded = 1, kStalled = 2 };
+const char* health_state_name(int state);
+
+class StallWatchdog {
+ public:
+  static StallWatchdog& singleton();
+
+  // Start the watchdog pthread (idempotent). `dump_dir` receives the
+  // stall auto-dumps; empty keeps the state machine but skips dumping.
+  // Returns 0 (running), -1 on thread-start failure.
+  int Start(const std::string& dump_dir);
+  // Stop and join the pthread (tests; restartable with Start).
+  void Stop();
+  bool running() const;
+
+  int state() const;             // HealthState as int
+  std::string reason() const;    // why the state is not ok ("" when ok)
+  std::string last_dump_path() const;  // "" before the first auto-dump
+  // The /healthz body: {state, reason, since_us, watchdog_running,
+  // stalls, transitions: [{ts_us, from, to, reason}], last_dump_path}.
+  std::string DumpJson() const;
+
+ private:
+  StallWatchdog() = default;
+  struct Impl;
+  Impl* _impl = nullptr;
+};
+
+// ICI credit-wait bookkeeping (called by ttpu around the WaitCredit park):
+// lets the watchdog age the oldest parked writer without walking endpoint
+// internals. Lock-free counters; approximate by design.
+void WatchdogCreditWaitBegin();
+void WatchdogCreditWaitEnd();
+// Microseconds the oldest currently-parked credit waiter has waited
+// (0 when none).
+int64_t WatchdogOldestCreditWaitUs();
+
+}  // namespace trpc

@@ -12,6 +12,7 @@
 
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 #include "trpc/builtin_console.h"
 #include "trpc/compress.h"
 #include "trpc/controller.h"
@@ -417,6 +418,8 @@ void tstd_process_request(InputMessageBase* base) {
         RecordServerSpan(span_trace_id, server_span_id, span_parent,
                          received_us, latency_us, cntl->ErrorCode(),
                          span_method, span_remote);
+        tbvar::flight_record(tbvar::FLIGHT_RPC_PHASE,
+                             tbvar::FLIGHT_RPC_SERVER_DONE, cid);
         tstd_send_response(sid, cid, cntl, response);
         server->EndRequest(latency_us);
         delete cntl;
@@ -470,6 +473,8 @@ void tstd_process_request(InputMessageBase* base) {
   // another fiber makes nested calls untraced, same as the reference's
   // bthread-local scope.)
   ScopedTraceContext trace_scope(span_trace_id, server_span_id);
+  tbvar::flight_record(tbvar::FLIGHT_RPC_PHASE, tbvar::FLIGHT_RPC_SERVER_IN,
+                       cid);
   svc->CallMethod(method, cntl, request, response, done);
 }
 

@@ -12,12 +12,14 @@
 
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "tbvar/flight_recorder.h"
 #include "ttpu/tensor_arena.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
 #include "trpc/protocol.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/socket.h"
+#include "trpc/stall_watchdog.h"
 #include "trpc/tstd_protocol.h"
 
 namespace ttpu {
@@ -292,6 +294,7 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now) {
       const uint32_t bs = _tx->block_size();
       std::string refs;
       uint32_t n_refs = 0;
+      uint32_t n_blocks_used = 0;  // TX credits consumed by this pass
       std::vector<uint32_t> blocks;  // TX blocks drawn for the plain runs
       size_t bi = 0;
       size_t moved = 0;
@@ -361,6 +364,7 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now) {
         // credit arrives, not before.
         _tx->MarkInflight(idx);
         _tx->Release(idx);
+        ++n_blocks_used;
       }
       // Blocks over-drawn for a run that ended early (arena boundary) go
       // straight back to the pool.
@@ -368,6 +372,10 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now) {
         _tx->Release(blocks[bi]);
       }
       flush_frame();
+      if (n_blocks_used > 0) {
+        tbvar::flight_record(tbvar::FLIGHT_ICI_CREDIT_CONSUME, _socket_id,
+                             n_blocks_used);
+      }
       trpc::GlobalRpcMetrics::instance().bytes_out
           << static_cast<int64_t>(moved);
       _tx_mid_message = !msg->empty();
@@ -394,6 +402,8 @@ int IciEndpoint::WriteMessage(tbutil::IOBuf* msg, int fd, bool flush_now) {
   if (!_pending_ctrl.empty()) return 0;  // TCP backpressure: epollout park
   if (starved) {
     _credit_starved.store(true, std::memory_order_release);
+    tbvar::flight_record(tbvar::FLIGHT_ICI_CREDIT_STARVE, _socket_id,
+                         _tx->free_blocks());
     return 0;
   }
   return 1;
@@ -417,12 +427,18 @@ void IciEndpoint::WaitCredit() {
     _credit_starved.store(false, std::memory_order_release);
     return;
   }
+  // The watchdog tracks the oldest credit wait: a writer parked here past
+  // the stall window is THE historical wedge signature (a leaked credit
+  // starves the pool forever; see brpc-tpu-known-flakes / PERF.md round 6).
+  trpc::WatchdogCreditWaitBegin();
   tbthread::butex_wait(_credit_btx, expected, nullptr);
+  trpc::WatchdogCreditWaitEnd();
   _credit_starved.store(false, std::memory_order_release);
 }
 
 void IciEndpoint::OnCreditFrame(uint32_t block_idx) {
   _tx->OnCreditReturned(block_idx);
+  tbvar::flight_record(tbvar::FLIGHT_ICI_CREDIT_GRANT, _socket_id, block_idx);
   tbthread::butex_increment_and_wake_all(_credit_btx);
 }
 

@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "tbutil/logging.h"
+#include "tbvar/flight_recorder.h"
 #include "trpc/socket.h"
 #include "ttpu/ici_endpoint.h"
 #include "ttpu/ici_segment.h"
@@ -166,6 +167,7 @@ int64_t TensorArena::Alloc(size_t len) {
     Range r;
     r.len = len;
     _ranges[off] = r;
+    tbvar::flight_record(tbvar::FLIGHT_ARENA_ALLOC, _id, off);
     return static_cast<int64_t>(off);
   }
   return -1;
@@ -197,6 +199,7 @@ int TensorArena::Free(uint64_t off) {
   auto it = RangeContaining(off);  // interior offsets free the allocation
   if (it == _ranges.end()) return -1;
   it->second.free_requested = true;
+  tbvar::flight_record(tbvar::FLIGHT_ARENA_RELEASE, _id, it->first);
   MaybeReclaimLocked(it->first, &it->second);
   return 0;
 }

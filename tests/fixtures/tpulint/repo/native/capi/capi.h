@@ -24,5 +24,10 @@ void* tbrpc_fix_call_async(void* h, const void* req, size_t req_len,
                            tbrpc_fix_done_cb done_cb, void* done_ctx);
 int tbrpc_fix_future_wait(void* fut, void** resp, size_t* resp_len,
                           char* errbuf, size_t errbuf_len);
+// Self-monitoring surface shape (mirrors tbrpc_flight_snapshot /
+// tbrpc_watchdog_start): an int64 count-prefixed copy-out dump plus a
+// const-char* config entry point, kept in sync with the lock.
+int64_t tbrpc_fix_flight_snapshot(int64_t max_events, char* buf, size_t cap);
+int tbrpc_fix_watchdog_start(const char* dump_dir);
 
 }  // extern "C"

@@ -1,0 +1,17 @@
+// pthread-only positives: a watchdog-style supervisor thread that parks
+// on the very scheduler it is meant to supervise.  The file-level marker
+// below opts the whole file into the rule.
+// tpulint: pthread-only
+#include "tbthread/sync.h"
+
+namespace trpc {
+
+tbthread::FiberMutex g_po_bad_mu;  // butex-backed lock in supervisor code
+
+void BadWatchdogLoop() {
+  tbthread::CountdownEvent done(1);  // butex-backed wait primitive
+  tbthread::butex_wait(nullptr, 0, nullptr);
+  tbthread::fiber_usleep(1000);
+}
+
+}  // namespace trpc

@@ -1,0 +1,24 @@
+// pthread-only negatives: a supervisor thread correctly built on OS
+// primitives (which the fiber-blocking rule must then be told about), and
+// a comment that merely mentions fiber_usleep() or FiberMutex must not
+// fire.  Probe SUBMISSION (fiber_start_background) is fine — it enqueues
+// without parking.
+// tpulint: pthread-only
+// tpulint: allow-file(fiber-blocking)
+#include <condition_variable>
+#include <mutex>
+
+#include "tbthread/fiber.h"
+
+namespace trpc {
+
+std::mutex g_po_good_mu;
+std::condition_variable g_po_good_cv;
+
+void GoodWatchdogLoop() {
+  std::lock_guard<std::mutex> lk(g_po_good_mu);
+  tbthread::fiber_t tid;
+  tbthread::fiber_start_background(&tid, nullptr, nullptr, nullptr);
+}
+
+}  // namespace trpc

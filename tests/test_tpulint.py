@@ -47,6 +47,36 @@ def test_fiber_blocking_negative(fixture_findings):
     assert not [f for f in fixture_findings if "fb_good.cpp" in f.path]
 
 
+# ---- rule class 1b: pthread-only (the inverse of fiber-blocking) ----
+
+def test_pthread_only_positive(fixture_findings):
+    hits = _of(fixture_findings, "pthread-only", "po_bad.cpp")
+    msgs = " ".join(f.message for f in hits)
+    assert "butex_wait" in msgs
+    assert "fiber_usleep" in msgs
+    assert "FiberMutex" in msgs
+    assert "CountdownEvent" in msgs
+    assert all("supervises the fiber scheduler" in f.hint for f in hits)
+
+
+def test_pthread_only_negative(fixture_findings):
+    # OS primitives in a marked file are the CORRECT shape (they need a
+    # fiber-blocking allow, which po_good carries), and probe submission
+    # does not park.
+    assert not [f for f in fixture_findings if "po_good.cpp" in f.path]
+    # An UNMARKED file full of fiber primitives (fb_good) stays silent —
+    # the rule keys on the explicit pthread-only contract, not heuristics.
+    assert not _of(fixture_findings, "pthread-only", "fb_good.cpp")
+
+
+def test_pthread_only_guards_the_real_watchdog():
+    """The actual stall watchdog carries the marker, so a fiber-parking
+    call slipping into it fails test_real_repo_is_lint_clean."""
+    src = open(os.path.join(ROOT, "native", "trpc", "stall_watchdog.cpp"),
+               encoding="utf-8").read()
+    assert "tpulint: pthread-only" in src
+
+
 # ---- rule class 2: lock-order ----
 
 def test_lock_order_positive(fixture_findings):
@@ -135,6 +165,11 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
         "size_t, int, const char *)")
     assert parsed["tbrpc_fix_call_async"] == (
         "void *(void *, const void *, size_t, tbrpc_fix_done_cb, void *)")
+    # The self-monitoring shapes (flight snapshot dump + watchdog start)
+    # normalise to their locked spellings too.
+    assert parsed["tbrpc_fix_flight_snapshot"] == (
+        "int64_t(int64_t, char *, size_t)")
+    assert parsed["tbrpc_fix_watchdog_start"] == "int(const char *)"
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
@@ -153,6 +188,11 @@ def test_wire_contract_capi_real_repo_lock_is_current():
     # The handler ABIs carry the error-text out-params end to end.
     assert "char *, size_t)" in locked["typedef:tbrpc_handler_cb"]
     assert "char *, size_t)" in locked["typedef:tbrpc_tensor_handler_cb"]
+    # The self-monitoring surface is part of the locked contract.
+    assert locked["tbrpc_flight_snapshot"] == (
+        "int64_t(int64_t, char *, size_t)")
+    assert locked["tbrpc_watchdog_start"] == "int(const char *)"
+    assert "tbrpc_health_dump_json" in locked
 
 
 # ---- rule class 5: metric-name ----

@@ -1,7 +1,9 @@
-"""C++ rules: fiber-blocking primitives, lock-order cycles, IOBuf ownership.
+"""C++ rules: fiber-blocking primitives, lock-order cycles, IOBuf
+ownership, and the pthread-only inverse of fiber-blocking.
 
-All three work on comment-stripped source (core.SourceFile.code_lines), so
-commented-out code never fires, and all honour `// tpulint: allow(<rule>)`.
+All of them work on comment-stripped source (core.SourceFile.code_lines),
+so commented-out code never fires, and all honour
+`// tpulint: allow(<rule>)`.
 """
 
 from __future__ import annotations
@@ -65,6 +67,62 @@ class FiberBlockingRule:
                             message=f"{what} in fiber-context code",
                             hint=f"use {fix}, or justify with "
                                  f"`// tpulint: allow({self.id})`"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# pthread-only
+# ---------------------------------------------------------------------------
+
+# The INVERSE of fiber-blocking: files marked `// tpulint: pthread-only`
+# hold watchdog/supervisor-thread code that must stay schedulable when
+# every fiber worker is parked (the stall watchdog exists to catch exactly
+# that state).  A fiber-PARKING primitive there is a liveness bug: the
+# supervisor would wait on the very scheduler it supervises.
+_PTHREAD_ONLY_MARK_RE = re.compile(r"tpulint:\s*pthread-only")
+
+# pattern, what it is — anything that parks (or can park) on the fiber
+# scheduler: butex waits, fiber sleeps/joins, and the butex-backed sync
+# primitives (constructing one in pthread-only code invites the wait).
+_FIBER_PARKING = [
+    (re.compile(r"\bbutex_wait\s*\("), "butex_wait"),
+    (re.compile(r"\bfiber_usleep\s*\("), "fiber_usleep"),
+    (re.compile(r"\bfiber_join\s*\("), "fiber_join"),
+    (re.compile(r"\bfiber_fd_wait\s*\("), "fiber_fd_wait"),
+    (re.compile(r"\bfiber_yield\s*\("), "fiber_yield"),
+    (re.compile(r"\bFiberMutex\b"), "FiberMutex"),
+    (re.compile(r"\bFiberCond\b"), "FiberCond"),
+    (re.compile(r"\bFiberRWLock\b"), "FiberRWLock"),
+    (re.compile(r"\bFiberSemaphore\b"), "FiberSemaphore"),
+    (re.compile(r"\bCountdownEvent\b"), "CountdownEvent"),
+]
+
+
+class PthreadOnlyRule:
+    id = "pthread-only"
+    description = ("fiber-parking primitive in code marked `tpulint: "
+                   "pthread-only`; a watchdog thread that waits on the "
+                   "fiber scheduler cannot supervise it")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        for src in ctx.select(ext={".cpp", ".cc", ".h", ".hpp"}):
+            # The marker is a comment, so look at the RAW lines.
+            if not any(_PTHREAD_ONLY_MARK_RE.search(ln)
+                       for ln in src.lines):
+                continue
+            for lineno, line in enumerate(src.code_lines(), 1):
+                for pat, what in _FIBER_PARKING:
+                    if pat.search(line):
+                        findings.append(Finding(
+                            rule=self.id, path=src.path, line=lineno,
+                            message=f"{what} in pthread-only code",
+                            hint="this file supervises the fiber scheduler "
+                                 "and must stay schedulable when every "
+                                 "worker is parked: use std::mutex/"
+                                 "condition_variable/sleep_for here (with "
+                                 "a fiber-blocking allow), or move the "
+                                 "parking work onto a fiber"))
         return findings
 
 
@@ -294,4 +352,5 @@ class IOBufOwnershipRule:
         return out
 
 
-RULES = [FiberBlockingRule(), LockOrderRule(), IOBufOwnershipRule()]
+RULES = [FiberBlockingRule(), PthreadOnlyRule(), LockOrderRule(),
+         IOBufOwnershipRule()]
