@@ -47,6 +47,9 @@ try:
     health.start_watchdog({dump_dir!r})
 except Exception:
     pass
+for _name, _value in {flags!r}:
+    if native.lib().tbrpc_flag_set(_name.encode(), _value.encode()) != 0:
+        raise SystemExit(f"tbrpc_flag_set({{_name}}={{_value}}) refused")
 bps, qps, p50, p99 = native.bench_echo_ex(
     {payload}, seconds={seconds}, concurrency={conc},
     transport={transport!r}, conn_type={conn_type!r})
@@ -110,7 +113,7 @@ def _dump_transitions(path):
 
 
 def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
-                          conn_type, retries=2, wedge_log=None):
+                          conn_type, retries=2, wedge_log=None, flags=()):
     """One echo sample in a watchdogged subprocess.
 
     Returns the child's result dict; after `retries` consecutive
@@ -122,7 +125,8 @@ def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
     root = os.path.dirname(os.path.abspath(__file__))
     code = _ECHO_EX_CHILD.format(root=root, payload=payload, seconds=seconds,
                                  conc=concurrency, transport=transport,
-                                 conn_type=conn_type, dump_dir=_dump_dir())
+                                 conn_type=conn_type, dump_dir=_dump_dir(),
+                                 flags=tuple(flags))
     timeout = seconds * 3 + 30  # library load + server spin-up headroom
     wedges = 0
     seen_dumps = set(_new_dump_files(set()))  # ignore earlier samples' dumps
@@ -161,6 +165,80 @@ def bench_echo_ex_guarded(payload, seconds, concurrency, transport,
     if dump_files:
         result["health_transitions"] = _dump_transitions(dump_files[-1])
     return result
+
+
+def _ab_point(payload, a_flags, b_flags, a_key, b_key, reps=5, seconds=1,
+              concurrency=16, wedge_log=None):
+    """Interleaved A/B echo qps comparison (PERF.md methodology).
+
+    Runs `reps` ADJACENT (A, B) subprocess pairs — this host's steal is
+    bimodal, and a slow window hitting only one mode fabricates or destroys
+    the comparison; adjacent samples see the same host state, so per-pair
+    ratios are steal-robust. Reports median qps per mode plus the
+    median-of-ratios speedup (A/B) with the raw per-pair ratios."""
+    a_qps, b_qps, a_p99, b_p99, ratios = [], [], [], [], []
+    for _ in range(reps):
+        pair = {}
+        for mode, flags in (("a", a_flags), ("b", b_flags)):
+            r = bench_echo_ex_guarded(payload, seconds, concurrency, "tpu",
+                                      "single", retries=1,
+                                      wedge_log=wedge_log, flags=flags)
+            pair[mode] = r
+        if pair["a"].get("wedged") or pair["b"].get("wedged"):
+            continue  # drop the PAIR: a half-wedged pair is not a sample
+        a_qps.append(pair["a"]["qps"])
+        b_qps.append(pair["b"]["qps"])
+        a_p99.append(pair["a"]["p99"])
+        b_p99.append(pair["b"]["p99"])
+        ratios.append(pair["a"]["qps"] / max(pair["b"]["qps"], 1e-9))
+    if not ratios:
+        raise RuntimeError(f"every A/B pair wedged: payload={payload}")
+    import statistics
+    return {
+        a_key + "_qps": round(statistics.median(a_qps)),
+        b_key + "_qps": round(statistics.median(b_qps)),
+        a_key + "_p99_us": round(statistics.median(a_p99)),
+        b_key + "_p99_us": round(statistics.median(b_p99)),
+        "speedup": round(statistics.median(ratios), 2),
+        "speedup_samples": [round(r, 2) for r in ratios],
+        "payload": payload, "concurrency": concurrency, "reps": len(ratios),
+    }
+
+
+def small_rpc_point(payload, reps=5, seconds=1, concurrency=16,
+                    wedge_log=None):
+    """Batched vs per-message dispatch at one small payload: the tentpole
+    rows (rpc_small_qps_64B / rpc_small_qps_4KB). One reloadable flag flips
+    the whole regime — rpc_dispatch_batch_max=1 restores fiber-per-message
+    dispatch AND disables response coalescing (the seed's write path)."""
+    row = _ab_point(payload,
+                    a_flags=(("rpc_dispatch_batch_max", "16"),),
+                    b_flags=(("rpc_dispatch_batch_max", "1"),),
+                    a_key="batched", b_key="permsg", reps=reps,
+                    seconds=seconds, concurrency=concurrency,
+                    wedge_log=wedge_log)
+    print(f"# rpc_small_qps_{payload}B: per-message {row['permsg_qps']} qps "
+          f"-> batched {row['batched_qps']} qps ({row['speedup']}x, "
+          f"samples {row['speedup_samples']})", file=sys.stderr)
+    return row
+
+
+def ici_threshold_point(reps=5, seconds=1, concurrency=16, wedge_log=None):
+    """The ici_small_msg_threshold crossover at the 4KB payload (~4.1KB
+    frames with tstd header+meta): threshold 16384 keeps these frames on
+    the inline control channel; 64 forces every one through a TX block +
+    doorbell + credit return. The winner decides the default documented in
+    PERF.md round 7."""
+    row = _ab_point(4096,
+                    a_flags=(("ici_small_msg_threshold", "16384"),),
+                    b_flags=(("ici_small_msg_threshold", "64"),),
+                    a_key="inline", b_key="block", reps=reps,
+                    seconds=seconds, concurrency=concurrency,
+                    wedge_log=wedge_log)
+    print(f"# ici_threshold_4KB: block-path {row['block_qps']} qps vs "
+          f"inline-path {row['inline_qps']} qps ({row['speedup']}x)",
+          file=sys.stderr)
+    return row
 
 
 def best_point(payload, transport, seconds=2, wedge_log=None):
@@ -238,6 +316,20 @@ def main() -> None:
                       "p99_us": round(r["p99"]), "concurrency": 1}
         print(f"# latency {key}: p50 {r['p50']:.0f}us p99 {r['p99']:.0f}us "
               f"({r['qps']:.0f} qps)", file=sys.stderr)
+
+    # Small-RPC fast path rows: batched vs per-message dispatch (the
+    # rpc_dispatch_batch_max toggle) at 64B and 4KB, plus the ici
+    # small-message threshold crossover at 4KB. Guarded like every point.
+    for payload, key in ((64, "rpc_small_qps_64B"),
+                         (4096, "rpc_small_qps_4KB")):
+        try:
+            sweep[key] = small_rpc_point(payload, wedge_log=wedges)
+        except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+            print(f"# {key} skipped: {e}", file=sys.stderr)
+    try:
+        sweep["ici_threshold_4KB"] = ici_threshold_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# ici_threshold_4KB skipped: {e}", file=sys.stderr)
 
     # Pipelined parameter-server rows (async tensor RPC tentpole): 32x1MB
     # serial round-trips vs one bounded PipelineWindow, pull and push.
@@ -418,6 +510,14 @@ def smoke() -> None:
     wedges = []
     out = {"echo_64B": bench_echo_ex_guarded(64, 1, 2, "tpu", "single",
                                              retries=1, wedge_log=wedges)}
+    # Fast-path rot guard: one interleaved batched-vs-per-message 64B pair
+    # — if the batch dispatcher stops batching (or starts losing to the
+    # seed path by a wide margin), the smoke row shows it immediately.
+    try:
+        out["rpc_small_qps_64B"] = small_rpc_point(
+            64, reps=1, seconds=1, concurrency=8, wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["rpc_small_qps_64B"] = {"error": str(e)}
     try:
         out.update(param_pipeline_point(n_tensors=4, window=4, reps=1,
                                         pull_only=True, timeout=90))

@@ -100,7 +100,7 @@ def lib() -> ctypes.CDLL:
     if not os.path.exists(_LIB_PATH):
         _build_native()
     L = ctypes.CDLL(_LIB_PATH)
-    if not hasattr(L, "tbrpc_call_tensor_async"):
+    if not hasattr(L, "tbrpc_server_set_inline"):
         # Stale build from before the current bindings: the handler ABI
         # carries extra out-params now, so using it would marshal garbage
         # (not just miss symbols). Rebuild — and verify the reload took:
@@ -108,7 +108,7 @@ def lib() -> ctypes.CDLL:
         # handle back and only a fresh process can pick up the new build.
         _build_native()
         L = ctypes.CDLL(_LIB_PATH)
-        if not hasattr(L, "tbrpc_call_tensor_async"):
+        if not hasattr(L, "tbrpc_server_set_inline"):
             raise RuntimeError(
                 "libbrpc_tpu.so was built before the current bindings and "
                 "the stale mapping is already loaded in this process; the "
@@ -120,6 +120,9 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_server_stop.argtypes = [ctypes.c_void_p]
     L.tbrpc_server_destroy.argtypes = [ctypes.c_void_p]
     L.tbrpc_server_add_echo_service.argtypes = [ctypes.c_void_p]
+    L.tbrpc_server_set_inline.restype = ctypes.c_int
+    L.tbrpc_server_set_inline.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     L.tbrpc_server_add_callback_service.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, _HANDLER_CB, ctypes.c_void_p]
     L.tbrpc_channel_create.restype = ctypes.c_void_p
@@ -242,6 +245,21 @@ class Server:
     def add_echo_service(self) -> None:
         if self._L.tbrpc_server_add_echo_service(self._h) != 0:
             raise RuntimeError("add_echo_service failed")
+
+    def set_inline(self, service: str, enabled: bool = True) -> None:
+        """Run SMALL requests to `service` directly on the input fiber (the
+        small-RPC inline fast path), skipping the dispatch hop.
+
+        Only native services whose implementation declares itself
+        non-blocking qualify; Python handler services are ALWAYS refused —
+        they park the fiber on the GIL-safe callback pool, and a parked
+        input fiber would head-of-line-block its whole connection."""
+        if self._L.tbrpc_server_set_inline(
+                self._h, service.encode(), 1 if enabled else 0) != 0:
+            raise RuntimeError(
+                f"set_inline({service!r}) refused: unknown service or not "
+                "inline-safe (Python handlers always run on the callback "
+                "pool)")
 
     def add_service(self, name: str, handler: Handler) -> None:
         L = self._L

@@ -178,6 +178,10 @@ class PyCallbackPool {
 class NativeEchoService : public Service {
  public:
   std::string_view service_name() const override { return "EchoService"; }
+  // inline_safe contract: the body below must never park the calling
+  // fiber — tpulint's inline-handler rule checks the marked region.
+  bool inline_safe() const override { return true; }
+  // tpulint: inline-handler-begin
   void CallMethod(const std::string& method, Controller* cntl,
                   const tbutil::IOBuf& request, tbutil::IOBuf* response,
                   Closure* done) override {
@@ -189,6 +193,7 @@ class NativeEchoService : public Service {
     }
     done->Run();
   }
+  // tpulint: inline-handler-end
 };
 
 class CallbackService : public Service {
@@ -312,6 +317,16 @@ int tbrpc_server_add_echo_service(void* server) {
   if (box->echo_added) return 0;
   box->echo_added = true;
   return box->server.AddService(&box->echo);
+}
+
+int tbrpc_server_set_inline(void* server, const char* service, int enabled) {
+  if (server == nullptr || service == nullptr) return -1;
+  auto* box = static_cast<ServerBox*>(server);
+  // AddService registers every service (echo, callback, builtin) in the
+  // server's map at registration time, so the registry lookup covers all.
+  Service* svc = box->server.FindService(service);
+  if (svc == nullptr) return -1;
+  return svc->set_allow_inline(enabled != 0);
 }
 
 int tbrpc_server_add_callback_service(void* server, const char* name,
@@ -1132,6 +1147,14 @@ namespace {
 // point is to deny the scheduler its workers the way the historical
 // all-threads-parked wedge did, so the watchdog's probe path can be tested
 // deterministically.
+//
+// Inline-fast-path audit (small-RPC PR): an inline handler runs on the
+// INPUT fiber, but input fibers are scheduled on these same worker
+// pthreads — fiber_start_urgent only ENQUEUES (its run-inline fallback
+// fires on spawn failure, not on busy workers), so holding every worker
+// still wedges inline-registered methods exactly like dispatched ones.
+// No exclusion needed; tests/test_small_rpc.py::test_hold_workers_still_
+// wedges_inline_path pins this.
 std::atomic<int> g_hold_release{1};  // 0 = holding, 1 = released
 
 void* worker_holder_fn(void* deadline_ptr) {
@@ -1275,6 +1298,10 @@ struct BenchEnv {
   explicit BenchEnv(bool tpu = false, int conn_type = 0) {
     server = new ServerBox;
     tbrpc_server_add_echo_service(server);
+    // The native echo handler is non-blocking: register it on the inline
+    // fast path (inert while rpc_dispatch_batch_max == 1, so the
+    // per-message A/B mode still measures the seed regime).
+    tbrpc_server_set_inline(server, "EchoService", 1);
     int port = tbrpc_server_start(server, "127.0.0.1:0");
     if (port <= 0) return;
     char addr[48];

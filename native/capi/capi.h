@@ -23,6 +23,15 @@ void tbrpc_server_destroy(void* server);
 // attachment are echoed back untouched. Used by benchmarks and smoke tests.
 int tbrpc_server_add_echo_service(void* server);
 
+// Inline fast path: run SMALL requests (bodies <= ici_small_msg_threshold)
+// to `service` directly on the input fiber, skipping the dispatch hop.
+// ONLY services whose native implementation declares itself non-blocking
+// qualify (Service::inline_safe); Python-backed services are always
+// refused — their handlers park the fiber on the GIL-safe callback pool,
+// and a parked input fiber head-of-line-blocks its whole connection.
+// Returns 0 on success, -1 on unknown service or a non-inline-safe one.
+int tbrpc_server_set_inline(void* server, const char* service, int enabled);
+
 // Python-backed service: the callback runs on a dedicated pthread from a
 // small pool (NOT on the fiber — ctypes pairs PyGILState_Ensure/Release on
 // one OS thread, and a fiber that parks mid-callback could resume on a

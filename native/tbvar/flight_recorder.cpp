@@ -89,6 +89,7 @@ const char* flight_event_type_name(uint16_t type) {
     case FLIGHT_ARENA_RELEASE: return "ARENA_RELEASE";
     case FLIGHT_TIMER_FIRE: return "TIMER_FIRE";
     case FLIGHT_HEALTH: return "HEALTH";
+    case FLIGHT_BATCH_DISPATCH: return "BATCH_DISPATCH";
     default: return "UNKNOWN";
   }
 }

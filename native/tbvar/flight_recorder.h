@@ -47,6 +47,7 @@ enum FlightEventType : uint16_t {
   FLIGHT_ARENA_RELEASE = 9,   // a = arena id, b = range offset
   FLIGHT_TIMER_FIRE = 10,     // a = scheduled abstime_us, b = lateness_us
   FLIGHT_HEALTH = 11,         // a = old health state, b = new health state
+  FLIGHT_BATCH_DISPATCH = 12, // a = socket id, b = messages in the batch
 };
 
 enum FlightRpcPhase : uint64_t {

@@ -22,6 +22,15 @@ Controller::~Controller() { Reset(); }
 void Controller::Reset() {
   // Client-side ids are destroyed by EndRPC; a Controller being reset while
   // an RPC is in flight is a caller bug (same contract as the reference).
+  // EVERY field must be restored to its declaration default here: server
+  // Controllers are pooled (tstd_protocol.cpp ServerSession) and any field
+  // this misses leaks one RPC's state into an unrelated later RPC.
+  // tests/test_small_rpc.py pins this list against controller.h.
+  _timeout_ms = -1;
+  _max_retry = -1;
+  _protocol = 0;
+  _alpn_h2 = false;
+  _remote_side = tbutil::EndPoint();
   _service_method.clear();
   _request_payload.clear();
   _response_payload = nullptr;
@@ -557,7 +566,7 @@ void TstdHandleResponse(TstdInputMessage* msg) {
   const tbthread::fiber_id_t attempt_id = msg->meta.correlation_id;
   void* data = nullptr;
   if (tbthread::fiber_id_lock(attempt_id, &data) != 0) {
-    delete msg;  // RPC already finished (timeout/retry won) — stale
+    msg->Destroy();  // RPC already finished (timeout/retry won) — stale
     return;
   }
   ControllerPrivateAccessor acc(static_cast<Controller*>(data));
@@ -566,7 +575,7 @@ void TstdHandleResponse(TstdInputMessage* msg) {
     // drop it; a live attempt's response will resolve the id. (A hedge
     // sibling IS live — AcceptResponseFor admits it.)
     tbthread::fiber_id_unlock(attempt_id);
-    delete msg;
+    msg->Destroy();
     return;
   }
   acc.mark_response_received();
@@ -606,7 +615,7 @@ void TstdHandleResponse(TstdInputMessage* msg) {
       stream_internal::OnRpcFailed(acc.request_stream(), EINVAL);
     }
   }
-  delete msg;
+  msg->Destroy();
   acc.EndRPC(err, err_text);
 }
 

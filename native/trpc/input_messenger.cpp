@@ -5,17 +5,47 @@
 
 #include "tbthread/fiber.h"
 #include "tbutil/logging.h"
+#include "tbvar/flight_recorder.h"
 #include "trpc/errno.h"
+#include "trpc/flags.h"
 #include "trpc/socket.h"
 
 namespace trpc {
 
 namespace {
 
+// Upper bound on messages handed to one dispatch fiber. 1 restores the
+// reference's fiber-per-message dispatch (the bench A/B toggle); the cap
+// bounds how long a burst monopolizes one worker.
+const auto* g_dispatch_batch_max = trpc::FlagRegistry::global().DefineInt(
+    "rpc_dispatch_batch_max", 16,
+    "Max parsed messages per dispatch fiber (1 = one fiber per message)",
+    [](int64_t v) { return v >= 1 && v <= 1024; });
+
+// Dispatch-path instrumentation: batch-size distribution plus the
+// inline-vs-spawned split, all visible at /vars and /brpc_metrics.
+struct DispatchMetrics {
+  tbvar::LatencyRecorder batch_size;  // value = messages per dispatch fiber
+  tbvar::Adder<int64_t> inline_count;
+  tbvar::Adder<int64_t> spawned_count;
+
+  static DispatchMetrics& instance() {
+    static DispatchMetrics* m = new DispatchMetrics;  // immortal, like bvars
+    return *m;
+  }
+
+ private:
+  DispatchMetrics() {
+    batch_size.expose("rpc_dispatch_batch_size");
+    inline_count.expose("rpc_dispatch_inline");
+    spawned_count.expose("rpc_dispatch_spawned");
+  }
+};
+
 void DispatchMessage(InputMessageBase* msg, bool server_side) {
   const Protocol* proto = GetProtocol(msg->protocol_index);
   if (proto == nullptr) {
-    delete msg;
+    msg->Destroy();
     return;
   }
   if (server_side) {
@@ -42,12 +72,64 @@ void* ProcessThunk(void* argv) {
   return nullptr;
 }
 
+struct BatchArg {
+  InputMessageBase* head;  // batch_next-chained, parse order
+  int count;
+  bool server_side;
+  // False on the fiber-spawn-failure degrade path, where the thunk runs
+  // ON the input fiber under its read claim: responses then take the
+  // normal Socket::Write path (the seed's behavior) — an adopted chain
+  // whose flush hit backpressure there would have no claim-safe owner.
+  bool coalesce;
+  Socket* sock;  // the batch's connection — always ref'd by the batch
+};
+
+void* BatchThunk(void* argv) {
+  auto* arg = static_cast<BatchArg*>(argv);
+  tbvar::flight_record(tbvar::FLIGHT_BATCH_DISPATCH,
+                       arg->sock != nullptr ? arg->sock->id() : 0,
+                       static_cast<uint64_t>(arg->count));
+  DispatchMetrics::instance().batch_size << arg->count;
+  {
+    // Responses the handlers of this batch write synchronously chain into
+    // the connection's write queue and flush ONCE at scope exit — one
+    // writev/doorbell flush carries the whole batch's responses. Pinned
+    // to the batch's own socket: a handler's nested client RPC (another
+    // socket) is sent immediately, never held for this flush.
+    WriteCoalesceScope scope(arg->coalesce && response_coalescing_enabled(),
+                             arg->sock);
+    InputMessageBase* m = arg->head;
+    while (m != nullptr) {
+      InputMessageBase* next = m->batch_next;
+      m->batch_next = nullptr;
+      // Per-message isolation: DispatchMessage owns msg and reports any
+      // protocol-level failure through that message's own response path;
+      // the loop continues to m+1 regardless.
+      DispatchMessage(m, arg->server_side);
+      if (!arg->server_side && arg->sock != nullptr) {
+        arg->sock->EndDispatch();
+      }
+      m = next;
+    }
+  }
+  if (arg->sock != nullptr) arg->sock->Deref();
+  delete arg;
+  return nullptr;
+}
+
 }  // namespace
 
+int64_t dispatch_batch_max() {
+  return g_dispatch_batch_max->load(std::memory_order_relaxed);
+}
+
+bool response_coalescing_enabled() { return dispatch_batch_max() > 1; }
+
 void InputMessenger::ProcessInline(Socket* s, InputMessageBase* msg) {
-  // No dispatch accounting here: in-place messages (stream frames) run
-  // UNDER the input claim, and the trailing message's count was taken at
-  // parse time (OnNewMessages) — its EndDispatch is the caller's job.
+  // No dispatch accounting here: in-place messages (stream frames, inline
+  // fast-path requests) run UNDER the input claim, and the trailing
+  // message's count was taken at parse time (OnNewMessages) — its
+  // EndDispatch is the caller's job.
   (void)s;
   DispatchMessage(msg, _server_side);
 }
@@ -61,10 +143,26 @@ void InputMessenger::ProcessInFiber(Socket* s, InputMessageBase* msg) {
     counted = s;
     s->Ref();
   }
+  DispatchMetrics::instance().spawned_count << 1;
   auto* arg = new ProcessArg{msg, _server_side, counted};
   tbthread::fiber_t tid;
   if (tbthread::fiber_start_urgent(&tid, nullptr, ProcessThunk, arg) != 0) {
     ProcessThunk(arg);
+  }
+}
+
+void InputMessenger::ProcessBatchInFiber(Socket* s, InputMessageBase* head,
+                                         int count) {
+  if (head == nullptr) return;
+  // The ref pins the socket for the coalescing scope (both sides) and for
+  // the client-side EndDispatch accounting.
+  if (s != nullptr) s->Ref();
+  DispatchMetrics::instance().spawned_count << count;
+  auto* arg = new BatchArg{head, count, _server_side, /*coalesce=*/true, s};
+  tbthread::fiber_t tid;
+  if (tbthread::fiber_start_urgent(&tid, nullptr, BatchThunk, arg) != 0) {
+    arg->coalesce = false;  // running under the caller's read claim
+    BatchThunk(arg);
   }
 }
 
@@ -139,8 +237,20 @@ ParseResult InputMessenger::CutInputMessage(Socket* s, int* protocol_index) {
 
 InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
   // Keep only the newest complete message as the inline candidate; older
-  // ones go to their own fibers immediately.
+  // ones accumulate into a batch_next chain and go to ONE dispatch fiber
+  // per <= batch_max messages (per their own fibers when batch_max == 1).
   InputMessageBase* pending = nullptr;
+  InputMessageBase* batch_head = nullptr;
+  InputMessageBase* batch_tail = nullptr;
+  int batch_len = 0;
+  const int64_t batch_max = dispatch_batch_max();
+  auto flush_batch = [&] {
+    if (batch_head != nullptr) {
+      ProcessBatchInFiber(s, batch_head, batch_len);
+      batch_head = batch_tail = nullptr;
+      batch_len = 0;
+    }
+  };
   while (true) {
     ssize_t nr = s->DoRead(1 << 19);
     if (nr < 0) {
@@ -170,26 +280,50 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
                         << " size=" << s->read_buf().size()
                         << " head=" << dbg;
         *defer_error = TRPC_EREQUEST;
+        // Messages parsed BEFORE the junk are intact — dispatch them; the
+        // deferred error is applied by the caller after delivery.
+        flush_batch();
         return pending;
       }
       r.msg->socket_id = s->id();
       r.msg->protocol_index = proto_index;
       if (r.msg->process_in_place) {
-        // Order-sensitive (stream frames): handle now, in parse order.
+        // Order-sensitive (stream frames) or the inline fast path (a
+        // request to a non-blocking service): handle now, in parse order.
+        if (r.msg->inline_fast_path) {
+          DispatchMetrics::instance().inline_count << 1;
+        }
         ProcessInline(s, r.msg);
         continue;
       }
       // Count the dispatch NOW, while this fiber still owns the input
       // claim: an EOF event can only start after the claim is released,
       // so it is guaranteed to see the count and wait for the delivery
-      // (client side). Ended by ProcessThunk / the ProcessEvent tail path.
+      // (client side). Ended by ProcessThunk/BatchThunk / the ProcessEvent
+      // tail path.
       if (!_server_side) s->BeginDispatch();
       if (pending != nullptr) {
-        ProcessInFiber(s, pending);
+        if (batch_max > 1 && pending->dispatch_batchable) {
+          pending->batch_next = nullptr;
+          if (batch_tail == nullptr) {
+            batch_head = pending;
+          } else {
+            batch_tail->batch_next = pending;
+          }
+          batch_tail = pending;
+          if (++batch_len >= batch_max) flush_batch();
+        } else {
+          // Non-batchable (large) message: release the accumulated batch
+          // first so cross-message dispatch keeps parse order, then give
+          // this one its own fiber.
+          flush_batch();
+          ProcessInFiber(s, pending);
+        }
       }
       pending = r.msg;
     }
   }
+  flush_batch();
   return pending;
 }
 

@@ -3,6 +3,14 @@
 // Capability parity: reference src/brpc/input_messenger.h/.cpp:361
 // (OnNewMessages: DoRead loop -> CutInputMessage trying last-used protocol
 // then all -> per-message processing fiber, last message inline).
+//
+// Small-RPC fast path (this repo, beyond the reference): all complete
+// messages of one read event are chained and handed to ONE dispatch fiber
+// (rpc_dispatch_batch_max) instead of one fiber_start_urgent per message —
+// at 64B-echo rates the per-message spawn was the dominant cost. A
+// protocol-level failure in message k of a batch (unknown service, bad
+// payload) is answered like any other request and must never poison
+// k+1..n: the batch loop treats every message independently.
 #pragma once
 
 #include <cstddef>
@@ -20,9 +28,11 @@ class InputMessenger {
   virtual ~InputMessenger() = default;
 
   // Read everything available on `s` (until EAGAIN/EOF), cutting complete
-  // messages. All but the LAST are dispatched to their own fibers; the last
-  // is RETURNED so the caller (Socket::ProcessEvent) can run it inline
-  // AFTER releasing the input-fiber claim — a handler that parks must not
+  // messages. All but the LAST are dispatched in batches to dispatch
+  // fibers (one fiber per <= rpc_dispatch_batch_max messages; exactly the
+  // reference's fiber-per-message shape when the flag is 1); the last is
+  // RETURNED so the caller (Socket::ProcessEvent) can run it inline AFTER
+  // releasing the input-fiber claim — a handler that parks must not
   // head-of-line-block later requests on the connection (reference
   // input_messenger.cpp:182-223).
   //
@@ -40,6 +50,9 @@ class InputMessenger {
   // drain them before erroring the pending correlation ids.
   void ProcessInline(Socket* s, InputMessageBase* msg);
   void ProcessInFiber(Socket* s, InputMessageBase* msg);
+  // One fiber for a whole batch_next-chained run of `count` messages,
+  // processed in parse order. Dispatch counts were taken at parse time.
+  void ProcessBatchInFiber(Socket* s, InputMessageBase* head, int count);
 
   bool server_side() const { return _server_side; }
 
@@ -53,5 +66,14 @@ class InputMessenger {
 
   bool _server_side;
 };
+
+// Live value of the rpc_dispatch_batch_max flag (>= 1; 1 = the reference's
+// fiber-per-message dispatch, also the bench A/B toggle).
+int64_t dispatch_batch_max();
+// True when the small-RPC fast path should also coalesce responses
+// (dispatch_batch_max() > 1): Socket::ProcessEvent and the batch fiber
+// open a WriteCoalesceScope only under this, so one flag flips the whole
+// batched regime for interleaved A/B benching.
+bool response_coalescing_enabled();
 
 }  // namespace trpc

@@ -31,9 +31,33 @@ struct InputMessageBase {
   // Process in PARSE ORDER on the input fiber instead of a per-message
   // fiber. Set by parse() for order-sensitive cheap messages — stream
   // frames, whose handling is an enqueue (reference: streaming frames go
-  // straight to Stream::OnReceived from the parse context).
+  // straight to Stream::OnReceived from the parse context) — and for
+  // requests to inline-registered services (the small-RPC fast path).
   bool process_in_place = false;
+  // True iff process_in_place was set by the INLINE FAST PATH (a request
+  // to a non-blocking service), not by a stream frame: keeps the
+  // inline-vs-spawned counters honest.
+  bool inline_fast_path = false;
+  // Eligible for batched dispatch — OPT-IN, set by the parser only when
+  // processing this message provably cannot (a) dominate a core or (b)
+  // park the dispatch fiber. Batching a LARGE message serializes exactly
+  // the work that wants multi-core parallelism (measured 0.65x at 1MB
+  // when everything batched), and batching a PARKING handler (Python
+  // callback pool) holds every later message — and every already-adopted
+  // response — hostage to one handler's run time. tstd grants it to
+  // small responses and to small requests targeting inline_safe (or
+  // nonexistent) services; everything else keeps the reference's
+  // fiber-per-message dispatch.
+  bool dispatch_batchable = false;
+  // Intrusive link for batched dispatch: the messenger chains the messages
+  // of one read event and hands the whole chain to ONE dispatch fiber
+  // (rpc_dispatch_batch_max). Owned by the messenger until dispatch.
+  InputMessageBase* batch_next = nullptr;
   virtual ~InputMessageBase() = default;
+  // The ONE teardown path: protocols with pooled message objects override
+  // this to reset + return to their pool instead of freeing. Every owner
+  // that would `delete` an InputMessageBase must call Destroy() instead.
+  virtual void Destroy() { delete this; }
 };
 
 struct ParseResult {

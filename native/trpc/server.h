@@ -34,6 +34,33 @@ class Service {
   virtual void CallMethod(const std::string& method, Controller* cntl,
                           const tbutil::IOBuf& request,
                           tbutil::IOBuf* response, Closure* done) = 0;
+
+  // ---- inline execution (the small-RPC fast path) ----
+  // An implementation that NEVER parks the calling fiber (no nested RPCs,
+  // no fiber mutex/sleep/join, no Python callback pool — its CallMethod
+  // runs to done->Run() on the caller's stack) may override this to true.
+  // The declaration is a liveness contract: an inline handler runs ON THE
+  // INPUT FIBER, so parking it head-of-line-blocks the whole connection.
+  // tpulint's `inline-handler` rule statically checks marked handler
+  // bodies; Python-backed services (capi CallbackService et al.) must keep
+  // the default — their handlers park the fiber on the callback pool.
+  virtual bool inline_safe() const { return false; }
+  // Run SMALL requests to this service right on the input fiber, skipping
+  // the dispatch hop (set via capi tbrpc_server_set_inline). Refused (-1)
+  // unless the implementation declares inline_safe().
+  int set_allow_inline(bool on) {
+    if (on && !inline_safe()) return -1;
+    _allow_inline.store(on, std::memory_order_release);
+    return 0;
+  }
+  bool allow_inline() const {
+    return _allow_inline.load(std::memory_order_acquire);
+  }
+
+ private:
+  // Atomic: flipped from a control thread (capi) while input fibers read
+  // it per-message in tstd_parse.
+  std::atomic<bool> _allow_inline{false};
 };
 
 // Pre-dispatch hook: runs after admission, before the service method.

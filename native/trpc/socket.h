@@ -49,6 +49,60 @@ struct WriteRequest {
   tbthread::fiber_id_t notify_id = 0;
 };
 
+// Fiber-scoped response coalescing (the small-RPC fast path's write half).
+// While a scope is active on the current fiber, the FIRST small write to a
+// socket that would have become the inline writer instead leaves the bytes
+// queued and records the writer role here; Flush() (or the destructor)
+// drains the whole accumulated chain through KeepWrite/WriteBatch — one
+// writev (plain TCP) or one doorbell flush (tpu://) carries every response
+// the scope's handlers produced. Without a scope, queued *requests* already
+// gather into batched writes but each response pays its own flush; this is
+// the seam that extends the batching to the server's reply path.
+//
+// PINNED to one socket — the batch's own connection, known at scope
+// construction: a dispatch batch is per-connection, and responses answer
+// on the socket the requests arrived on. Writes to ANY other socket take
+// the normal Socket::Write path unchanged — critically, a handler's
+// nested synchronous client RPC (issued on a client socket while the
+// handler's fiber will park for the response) must be SENT immediately,
+// not adopted into a flush that can only run after the handler returns.
+// Large writes and writes while no scope is active are also unchanged.
+// The scope lives on the dispatching fiber's stack, so a handler that
+// parks mid-batch delays the flush by at most its own run time — never
+// past the scope's end.
+class WriteCoalesceScope {
+ public:
+  // enabled=false constructs an inert scope (the per-message-dispatch A/B
+  // toggle: rpc_dispatch_batch_max == 1 must reproduce the old write path
+  // exactly). `only` is the single socket this scope may adopt.
+  WriteCoalesceScope(bool enabled, Socket* only);
+  ~WriteCoalesceScope();
+  WriteCoalesceScope(const WriteCoalesceScope&) = delete;
+  WriteCoalesceScope& operator=(const WriteCoalesceScope&) = delete;
+
+  // Drain the adopted chain now (idempotent; the scope can adopt again
+  // afterwards). May park on transport backpressure, like any writer.
+  void Flush();
+  // Hand the adopted chain to a background KeepWrite fiber instead of
+  // draining on THIS fiber. For flush points where parking is not
+  // allowed — the input fiber still holding its read claim must never
+  // park in WaitCredit/WaitEpollOut: on tpu:// the credit frames that
+  // would wake it arrive through the very read path it is blocking.
+  void FlushDetached();
+
+  // The scope active on the current fiber/thread (nullptr when none).
+  static WriteCoalesceScope* current();
+
+ private:
+  friend class Socket;
+  Socket* _only = nullptr;  // the one socket this scope may adopt
+  Socket* _sock = nullptr;  // ref held while a chain is adopted
+  WriteRequest* _todo = nullptr;
+  WriteRequest* _last = nullptr;
+  WriteCoalesceScope* _prev = nullptr;
+  bool _installed = false;
+};
+
 class Socket : public VersionedRefWithId<Socket> {
  public:
   struct Options {
@@ -191,11 +245,16 @@ class Socket : public VersionedRefWithId<Socket> {
 
  private:
   friend class VersionedRefWithId<Socket>;
+  friend class WriteCoalesceScope;
 
   // Writer-side machinery (see socket.cpp for the protocol).
   void StartWrite(WriteRequest* req);
   static void* KeepWriteThunk(void* arg);
   void KeepWrite(WriteRequest* todo, WriteRequest* last);
+  // Shared drain body: may_park=false returns false (with the remaining
+  // chain in the out-params) instead of parking on backpressure.
+  bool KeepWriteImpl(WriteRequest** todo_io, WriteRequest** last_io,
+                     bool may_park);
   // Write out req->data as far as the kernel accepts. 1 = fully written,
   // 0 = EAGAIN with leftover, -1 = error.
   int WriteOnce(WriteRequest* req);

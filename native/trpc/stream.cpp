@@ -396,7 +396,7 @@ void OnStreamFrame(TstdInputMessage* msg) {
   const StreamId local = msg->meta.correlation_id;
   StreamPtr s = find_stream(local);
   if (s == nullptr) {
-    delete msg;
+    msg->Destroy();
     return;
   }
   switch (msg->meta.msg_type) {
@@ -419,7 +419,7 @@ void OnStreamFrame(TstdInputMessage* msg) {
     default:
       break;
   }
-  delete msg;
+  msg->Destroy();
 }
 
 void ConnectClientStream(StreamId local, uint64_t peer_id,

@@ -11,6 +11,7 @@
 #include <mutex>
 
 #include "tbutil/logging.h"
+#include "tbutil/object_pool.h"
 #include "tbutil/time.h"
 #include "tbvar/flight_recorder.h"
 #include "trpc/builtin_console.h"
@@ -18,6 +19,7 @@
 #include "trpc/controller.h"
 #include "trpc/h2_protocol.h"
 #include "trpc/http_protocol.h"
+#include "trpc/input_messenger.h"
 #include "trpc/memcache_protocol.h"
 #include "trpc/redis_protocol.h"
 #include "trpc/errno.h"
@@ -80,6 +82,52 @@ uint32_t crc_of_iobuf(uint32_t crc, const tbutil::IOBuf& buf) {
 
 bool checksum_enabled() {
   return g_tstd_checksum->load(std::memory_order_relaxed) != 0;
+}
+
+}  // namespace
+
+// ---------------- pooled per-RPC state ----------------
+
+// Inbound frames: one pooled object per message instead of new/delete on
+// the parse hot path. Destroy is THE teardown everywhere (protocol.h).
+TstdInputMessage* GetPooledTstdMessage() {
+  return tbutil::get_object<TstdInputMessage>();
+}
+
+void TstdInputMessage::Destroy() {
+  meta = TstdMeta();
+  payload.clear();
+  attachment.clear();
+  socket_id = 0;
+  protocol_index = -1;
+  process_in_place = false;
+  inline_fast_path = false;
+  dispatch_batchable = false;
+  batch_next = nullptr;
+  tbutil::return_object(this);
+}
+
+namespace {
+
+// Server-side per-RPC session: the Controller + response buffer that live
+// from request dispatch until done->Run(). Pooled so the small-RPC path
+// pays two pointer pops instead of a new/delete pair per request on each
+// of them. Reset happens at RETURN time (ReturnServerSession) so pooled
+// objects hold no stale RPC state (and no retained buffers) while idle —
+// Controller::Reset's completeness is pinned by tests/test_small_rpc.py.
+struct ServerSession {
+  Controller cntl;
+  tbutil::IOBuf response;
+};
+
+ServerSession* GetServerSession() {
+  return tbutil::get_object<ServerSession>();
+}
+
+void ReturnServerSession(ServerSession* sess) {
+  sess->cntl.Reset();
+  sess->response.clear();
+  tbutil::return_object(sess);
 }
 
 }  // namespace
@@ -164,7 +212,7 @@ static bool parse_meta(const std::string& raw, TstdMeta* meta) {
   return true;
 }
 
-ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
+ParseResult tstd_parse(tbutil::IOBuf* source, Socket* sock) {
   ParseResult r;
   if (source->size() < kHeaderSize) {
     // Judge the magic on whatever prefix exists before claiming the
@@ -199,10 +247,10 @@ ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
   source->pop_front(kHeaderSize);
   std::string raw_meta;
   source->cutn(&raw_meta, meta_size);
-  auto* msg = new TstdInputMessage;
+  TstdInputMessage* msg = GetPooledTstdMessage();
   if (!parse_meta(raw_meta, &msg->meta) ||
       msg->meta.attachment_size > body_size) {
-    delete msg;
+    msg->Destroy();
     r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
     return r;
   }
@@ -216,12 +264,48 @@ ParseResult tstd_parse(tbutil::IOBuf* source, Socket*) {
       // connection can be trusted — kill it loudly.
       TB_LOG(ERROR) << "tstd body crc mismatch: got " << got << " want "
                     << msg->meta.body_crc << " (" << body_size << "B body)";
-      delete msg;
+      msg->Destroy();
       r.error = PARSE_ERROR_ABSOLUTELY_WRONG;
       return r;
     }
   }
   msg->process_in_place = msg->meta.msg_type >= 2;  // stream frames: ordered
+  // Small-RPC fast path gates, all keyed on ONE size cutoff (the ici
+  // control-channel small-message threshold, so "small" means the same
+  // thing on both halves of the transport) and on the batched regime
+  // (rpc_dispatch_batch_max > 1 — the per-message A/B setting restores
+  // the seed's dispatch behavior wholesale). Batchability is granted only
+  // where processing provably never parks the dispatch fiber (protocol.h):
+  //   * responses — client-side resolution is a correlation lookup + a
+  //     caller wake (the rare small async completion with a Python
+  //     callback can park; bounded by batch_max, and tensor-class
+  //     responses are large, hence excluded by size anyway);
+  //   * requests to inline_safe services (a declared never-parks
+  //     contract) or to no service at all (the ENOSERVICE answer path);
+  //     a Python-backed handler parks its fiber on the callback pool, so
+  //     those keep fiber-per-message dispatch and their natural
+  //     pool-wide concurrency.
+  // The same single FindService feeds the inline-execution decision: a
+  // small request to an inline-REGISTERED service runs right on the input
+  // fiber (process_in_place), skipping the dispatch hop entirely.
+  if (sock != nullptr && response_coalescing_enabled() &&
+      body_size <= ttpu::ici_small_msg_threshold()) {
+    if (msg->meta.msg_type == 1) {
+      msg->dispatch_batchable = true;
+    } else if (msg->meta.msg_type == 0 && sock->server_side()) {
+      auto* server = static_cast<Server*>(sock->user());
+      Service* svc = server != nullptr
+                         ? server->FindService(msg->meta.service)
+                         : nullptr;
+      if (svc == nullptr || svc->inline_safe()) {
+        msg->dispatch_batchable = true;
+      }
+      if (svc != nullptr && svc->allow_inline()) {
+        msg->process_in_place = true;
+        msg->inline_fast_path = true;
+      }
+    }
+  }
   r.error = PARSE_OK;
   r.msg = msg;
   return r;
@@ -338,16 +422,19 @@ void tstd_process_request(InputMessageBase* base) {
   }
   SocketUniquePtr s;
   if (Socket::Address(msg->socket_id, &s) != 0) {
-    delete msg;
+    msg->Destroy();
     return;
   }
   auto* server = static_cast<Server*>(s->user());
   const SocketId sid = msg->socket_id;
   const uint64_t cid = msg->meta.correlation_id;
 
-  // Controller + response live until done->Run(): handlers may be async.
-  auto* cntl = new Controller;
-  auto* response = new tbutil::IOBuf;
+  // Controller + response live until done->Run() (handlers may be async):
+  // pooled as one ServerSession so the per-request new/delete pair is gone
+  // from the hot path. Returned — reset — by the single teardown below.
+  ServerSession* sess = GetServerSession();
+  Controller* cntl = &sess->cntl;
+  tbutil::IOBuf* response = &sess->response;
   ControllerPrivateAccessor acc(cntl);
   int64_t deadline_us = 0;
   if (msg->meta.code_or_timeout > 0) {
@@ -362,10 +449,9 @@ void tstd_process_request(InputMessageBase* base) {
   }
   auto fail_without_gate = [&](int code, const std::string& text) {
     cntl->SetFailed(code, text);
-    delete msg;
+    msg->Destroy();
     tstd_send_response(sid, cid, cntl, response);
-    delete cntl;
-    delete response;
+    ReturnServerSession(sess);
   };
   if (server == nullptr) {
     fail_without_gate(TRPC_EINTERNAL, "socket has no server");
@@ -405,8 +491,9 @@ void tstd_process_request(InputMessageBase* base) {
   // From here the gate is released exactly once — by done (the single
   // teardown path for both the error and success branches).
   Closure* done = NewCallback(
-      [sid, cid, cntl, response, server, ms, received_us, server_span_id,
-       span_trace_id, span_parent, span_method, span_remote]() {
+      [sid, cid, sess, cntl, response, server, ms, received_us,
+       server_span_id, span_trace_id, span_parent, span_method,
+       span_remote]() {
         // Clamped: gettimeofday can step backward (NTP), and a negative
         // value here would read as the shed sentinel in EndRequest,
         // leaking a limiter slot.
@@ -422,13 +509,12 @@ void tstd_process_request(InputMessageBase* base) {
                              tbvar::FLIGHT_RPC_SERVER_DONE, cid);
         tstd_send_response(sid, cid, cntl, response);
         server->EndRequest(latency_us);
-        delete cntl;
-        delete response;
+        ReturnServerSession(sess);
       });
   if (svc == nullptr) {
     cntl->SetFailed(TRPC_ENOSERVICE,
                     "no such service: " + msg->meta.service);
-    delete msg;
+    msg->Destroy();
     done->Run();
     return;
   }
@@ -442,7 +528,7 @@ void tstd_process_request(InputMessageBase* base) {
         g_max_body_size->load(std::memory_order_relaxed));
     if (c == nullptr || !c->decompress(request, &plain, max_out)) {
       cntl->SetFailed(TRPC_EREQUEST, "cannot decompress request payload");
-      delete msg;
+      msg->Destroy();
       done->Run();
       return;
     }
@@ -450,7 +536,7 @@ void tstd_process_request(InputMessageBase* base) {
     // The response answers in the request's codec (tstd_send_response).
     cntl->set_compress_type(msg->meta.compress_type);
   }
-  delete msg;
+  msg->Destroy();
   // rpc_dump sampling (post-decompression: replay feeds plain bytes).
   if (RpcDumper* d = server->dumper()) {
     d->MaybeSample(full_method, request, cntl->request_attachment());

@@ -72,6 +72,15 @@ struct TstdInputMessage : InputMessageBase {
   TstdMeta meta;
   tbutil::IOBuf payload;
   tbutil::IOBuf attachment;
+
+  // Pooled (tbutil::ObjectPool): the small-RPC hot path allocates one of
+  // these per inbound frame, so creation/teardown must be pointer pops,
+  // not malloc/free. Resets every field, then returns to the pool.
+  void Destroy() override;
 };
+
+// Pool accessor for tstd_parse (defined with Destroy in tstd_protocol.cpp;
+// objects coming back from the pool were reset by Destroy).
+TstdInputMessage* GetPooledTstdMessage();
 
 }  // namespace trpc

@@ -40,9 +40,44 @@ std::atomic<int64_t>* g_ici_blocks = TRPC_DEFINE_FLAG(
     ici_blocks, 64, "tpu:// transport TX blocks per connection direction");
 // Messages at or below this ride the control channel as plain bytes — a
 // 64KB block per tiny RPC would cap in-flight QPS at the window size.
-std::atomic<int64_t>* g_ici_inline_max = TRPC_DEFINE_FLAG(
-    ici_inline_max, 4096,
-    "tpu:// messages <= this many bytes ride the control channel inline");
+// Same [0, 1MB] bound as the ici_small_msg_threshold alias below: BOTH
+// names write the same storage, so both must refuse values that would
+// make "small" swallow block-sized tensors (batching/coalescing them
+// serializes exactly the work that wants its own fiber).
+std::atomic<int64_t>* g_ici_inline_max =
+    trpc::FlagRegistry::global().DefineInt(
+        "ici_inline_max", 4096,
+        "tpu:// messages <= this many bytes ride the control channel inline",
+        [](int64_t v) { return v >= 0 && v <= (1 << 20); });
+
+// Reloadable alias with the cutoff's REAL name: ici_small_msg_threshold is
+// the knob the small-RPC fast path documents (PERF.md round 7 carries the
+// 4KB crossover sweep behind the default). Same storage as ici_inline_max
+// (DefineLinked: one atomic, two names — no stale shadow either way);
+// bounded to [0, 1MB] so "inline" can never swallow block-sized tensors.
+const bool g_ici_small_msg_threshold_linked = [] {
+  trpc::FlagRegistry::global().DefineLinked(
+      "ici_small_msg_threshold", 4096,
+      "small-message cutoff: tpu:// messages <= this many bytes ride the "
+      "control channel inline (alias of ici_inline_max), and only bodies "
+      "<= this run on the server's inline fast path",
+      [] { return g_ici_inline_max->load(std::memory_order_relaxed); },
+      [](int64_t v) {
+        if (v < 0 || v > (1 << 20)) return false;
+        g_ici_inline_max->store(v, std::memory_order_relaxed);
+        return true;
+      });
+  return true;
+}();
+
+}  // namespace
+
+size_t ici_small_msg_threshold() {
+  const int64_t v = g_ici_inline_max->load(std::memory_order_relaxed);
+  return v > 0 ? static_cast<size_t>(v) : 0;
+}
+
+namespace {
 
 void put_u32(std::string* s, uint32_t v) {
   s->append(reinterpret_cast<const char*>(&v), 4);

@@ -48,6 +48,11 @@ struct ParseResult;
 
 namespace ttpu {
 
+// Live value of the ici_small_msg_threshold / ici_inline_max flag: the
+// control-channel small-message cutoff, which also bounds what the server's
+// inline fast path counts as "small" (trpc/tstd_protocol.cpp).
+size_t ici_small_msg_threshold();
+
 class IciEndpoint {
  public:
   // kTcpFallback: the server could not set up the shm path (segment map

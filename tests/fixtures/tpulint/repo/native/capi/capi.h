@@ -29,5 +29,8 @@ int tbrpc_fix_future_wait(void* fut, void** resp, size_t* resp_len,
 // const-char* config entry point, kept in sync with the lock.
 int64_t tbrpc_fix_flight_snapshot(int64_t max_events, char* buf, size_t cap);
 int tbrpc_fix_watchdog_start(const char* dump_dir);
+// Service-flag entry-point shape (mirrors tbrpc_server_set_inline): a
+// handle + name + int toggle, kept in sync with the lock.
+int tbrpc_fix_set_inline(void* server, const char* service, int enabled);
 
 }  // extern "C"

@@ -1,0 +1,33 @@
+// inline-handler fixture (positive): the marked region is a service
+// handler registered on the inline fast path, so every fiber-parking
+// primitive inside it must be reported.
+#include <string>
+
+namespace fx {
+
+void fiber_usleep(unsigned long us);
+int butex_wait(void* b, int v, const void* abstime);
+struct FiberMutex {
+  void lock();
+  void unlock();
+};
+
+struct InlineBadService {
+  // tpulint: inline-handler-begin
+  void CallMethod(const std::string& method) {
+    (void)method;
+    FiberMutex mu;  // constructing the parkable primitive counts
+    mu.lock();
+    fiber_usleep(1000);
+    int word = 0;
+    butex_wait(&word, 0, nullptr);
+    mu.unlock();
+  }
+  // tpulint: inline-handler-end
+
+  // Outside the region: the same primitives are the dispatch path's
+  // business, not this rule's.
+  void SlowMethod() { fiber_usleep(5000); }
+};
+
+}  // namespace fx

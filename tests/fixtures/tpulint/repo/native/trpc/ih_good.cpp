@@ -1,0 +1,30 @@
+// inline-handler fixture (negative): a correct inline handler — pure
+// compute + buffer appends, done->Run() on the caller's stack, nothing
+// that can park the input fiber.
+#include <string>
+
+namespace fx {
+
+struct Buf {
+  void append(const std::string& s);
+};
+struct Done {
+  void Run();
+};
+
+struct InlineGoodService {
+  // tpulint: inline-handler-begin
+  void CallMethod(const std::string& method, const std::string& request,
+                  Buf* response, Done* done) {
+    (void)method;
+    response->append(request);
+    done->Run();
+  }
+  // tpulint: inline-handler-end
+};
+
+// An UNMARKED handler full of fiber primitives stays silent for this rule
+// (fb_good.cpp covers the fiber-context side).
+void fiber_usleep_user();
+
+}  // namespace fx

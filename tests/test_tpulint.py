@@ -77,6 +77,34 @@ def test_pthread_only_guards_the_real_watchdog():
     assert "tpulint: pthread-only" in src
 
 
+# ---- rule class 1c: inline-handler (the fast-path liveness contract) ----
+
+def test_inline_handler_positive(fixture_findings):
+    hits = _of(fixture_findings, "inline-handler", "ih_bad.cpp")
+    msgs = " ".join(f.message for f in hits)
+    assert "FiberMutex" in msgs
+    assert "fiber_usleep" in msgs
+    assert "butex_wait" in msgs
+    assert all("input fiber" in f.hint for f in hits)
+    # the same primitive OUTSIDE the marked region stays silent
+    assert not any(f.line > 30 for f in hits), \
+        "SlowMethod (outside the region) must not be flagged"
+
+
+def test_inline_handler_negative(fixture_findings):
+    assert not _of(fixture_findings, "inline-handler", "ih_good.cpp")
+
+
+def test_inline_handler_guards_the_real_echo_service():
+    """The native echo service is registered on the inline fast path
+    (BenchEnv/set_inline), so its handler body carries the markers — a
+    fiber-parking call slipping in fails test_real_repo_is_lint_clean."""
+    src = open(os.path.join(ROOT, "native", "capi", "capi.cpp"),
+               encoding="utf-8").read()
+    assert "tpulint: inline-handler-begin" in src
+    assert "tpulint: inline-handler-end" in src
+
+
 # ---- rule class 2: lock-order ----
 
 def test_lock_order_positive(fixture_findings):
@@ -170,6 +198,9 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
     assert parsed["tbrpc_fix_flight_snapshot"] == (
         "int64_t(int64_t, char *, size_t)")
     assert parsed["tbrpc_fix_watchdog_start"] == "int(const char *)"
+    # The service-flag shape (handle + name + int toggle) of
+    # tbrpc_server_set_inline.
+    assert parsed["tbrpc_fix_set_inline"] == "int(void *, const char *, int)"
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
@@ -193,6 +224,9 @@ def test_wire_contract_capi_real_repo_lock_is_current():
         "int64_t(int64_t, char *, size_t)")
     assert locked["tbrpc_watchdog_start"] == "int(const char *)"
     assert "tbrpc_health_dump_json" in locked
+    # The small-RPC fast path's registration flag is part of the contract.
+    assert locked["tbrpc_server_set_inline"] == (
+        "int(void *, const char *, int)")
 
 
 # ---- rule class 5: metric-name ----

@@ -127,6 +127,57 @@ class PthreadOnlyRule:
 
 
 # ---------------------------------------------------------------------------
+# inline-handler
+# ---------------------------------------------------------------------------
+
+# Regions between `// tpulint: inline-handler-begin` and `-end` are service
+# handler bodies registered on the small-RPC inline fast path: they run ON
+# THE INPUT FIBER (Service::inline_safe, trpc/server.h), so any
+# fiber-parking call head-of-line-blocks every later request on that
+# connection — and, under the read claim, the connection's reads too.
+_INLINE_BEGIN_RE = re.compile(r"tpulint:\s*inline-handler-begin")
+_INLINE_END_RE = re.compile(r"tpulint:\s*inline-handler-end")
+
+
+class InlineHandlerRule:
+    id = "inline-handler"
+    description = ("fiber-parking primitive inside a handler marked "
+                   "`tpulint: inline-handler-begin/-end`; inline handlers "
+                   "run on the input fiber and must never park it")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        for src in ctx.select(ext={".cpp", ".cc", ".h", ".hpp"}):
+            # Markers are comments: track the region over RAW lines, scan
+            # the comment-stripped text of the same line numbers.
+            if not any(_INLINE_BEGIN_RE.search(ln) for ln in src.lines):
+                continue
+            code = src.code_lines()
+            in_region = False
+            for lineno, raw in enumerate(src.lines, 1):
+                if _INLINE_BEGIN_RE.search(raw):
+                    in_region = True
+                    continue
+                if _INLINE_END_RE.search(raw):
+                    in_region = False
+                    continue
+                if not in_region:
+                    continue
+                line = code[lineno - 1] if lineno - 1 < len(code) else ""
+                for pat, what in _FIBER_PARKING:
+                    if pat.search(line):
+                        findings.append(Finding(
+                            rule=self.id, path=src.path, line=lineno,
+                            message=f"{what} in an inline RPC handler",
+                            hint="inline handlers run on the input fiber "
+                                 "(Service::inline_safe contract): move the "
+                                 "parking work onto the normal dispatch "
+                                 "path (drop the inline registration) or "
+                                 "complete asynchronously without parking"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------------
 
@@ -352,5 +403,5 @@ class IOBufOwnershipRule:
         return out
 
 
-RULES = [FiberBlockingRule(), PthreadOnlyRule(), LockOrderRule(),
-         IOBufOwnershipRule()]
+RULES = [FiberBlockingRule(), PthreadOnlyRule(), InlineHandlerRule(),
+         LockOrderRule(), IOBufOwnershipRule()]
