@@ -338,6 +338,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# param pipeline point skipped: {e}", file=sys.stderr)
 
+    # Sharded-fleet rows: aggregate pull_all GB/s at 1/2/4 shards (one
+    # server process per shard) + the kill-a-shard recovery drive.
+    try:
+        sweep.update(fleet_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# fleet point skipped: {e}", file=sys.stderr)
+
     # Tensor bridge rows (the chartered workload): jax/numpy arrays riding
     # the framework through TensorArena by-reference attachments.
     try:
@@ -503,6 +510,146 @@ def param_pipeline_point(n_tensors=32, nbytes=1 << 20, window=8, reps=7,
     return out
 
 
+# Sharded-fleet rows. ONE watchdogged child orchestrates: an in-child
+# registry hub, one SUBPROCESS per shard (a shard shares nothing with the
+# client loop — same reasoning as _PARAM_CHILD, and exactly the deployment
+# shape: N server processes, one trainer), persistent FleetClients per
+# shard count. Samples interleave across shard counts (adjacent samples
+# see the same host state; per-rep ratios are steal-robust). argv:
+#   n_tensors nbytes reps do_kill(0/1) counts...
+_FLEET_CHILD = r"""
+import json, statistics, subprocess, sys, time
+sys.path.insert(0, ROOT)
+import numpy as np
+from brpc_tpu.fleet import FleetClient, RegistryHub
+
+n_tensors, nbytes, reps, do_kill = (int(a) for a in sys.argv[1:5])
+counts = [int(a) for a in sys.argv[5:]]
+SERVER = (
+    "import sys, json\n"
+    "sys.path.insert(0, %r)\n"
+    "from brpc_tpu.fleet import FleetServer\n"
+    "s = FleetServer(sys.argv[1], tag=sys.argv[2], ttl_s=3)\n"
+    "print(json.dumps({'addr': s.start()}), flush=True)\n"
+    "sys.stdin.readline()\n"
+    "s.stop()\n" % ROOT)
+
+hub = RegistryHub()
+hub.start()
+procs = []
+try:
+    shard_procs = {}
+    for n in counts:
+        tag = f"bench{n}"
+        shard_procs[tag] = [
+            subprocess.Popen([sys.executable, "-c", SERVER, hub.hostport,
+                              tag], stdin=subprocess.PIPE,
+                             stdout=subprocess.PIPE, text=True)
+            for _ in range(n)]
+        procs.extend(shard_procs[tag])
+    for p in procs:  # all spawned first: jax import dominates, overlap it
+        json.loads(p.stdout.readline())
+    names = [f"w{i:02d}" for i in range(n_tensors)]
+    fleets = {}
+    for n in counts:
+        fc = FleetClient(hub.hostport, tag=f"bench{n}", window=4,
+                         op_deadline_s=30.0)
+        for name in names:  # one registry refresh, not one per tensor
+            fc.install(name, np.ones(nbytes // 4, np.float32),
+                       refresh=False)
+        fc.pull_all(names)  # warm: channels + arenas + meta caches
+        fleets[n] = fc
+    samples = {n: [] for n in counts}
+    for _ in range(reps):
+        for n in counts:
+            t0 = time.monotonic()
+            got = fleets[n].pull_all(names)
+            samples[n].append(time.monotonic() - t0)
+            assert len(got) == n_tensors
+    total = n_tensors * nbytes
+    out = {}
+    for n in counts:
+        med = statistics.median(samples[n])
+        best = min(samples[n])
+        # Median for the headline; best-of for the steal floor (this host
+        # class has bimodal steal — PERF.md r4 — and an N-process fleet
+        # multiplies exposure to it; the min is what the fleet does on a
+        # quiet slice of the box).
+        row = {"gbps": round(total / med / 1e9, 2),
+               "ms": round(med * 1e3, 1),
+               "best_gbps": round(total / best / 1e9, 2),
+               "best_ms": round(best * 1e3, 1), "shards": n,
+               "tensors": n_tensors, "nbytes": nbytes, "reps": reps}
+        if n != counts[0]:
+            ratios = [samples[counts[0]][i] / samples[n][i]
+                      for i in range(reps)]
+            row["speedup_vs_1s"] = round(statistics.median(ratios), 2)
+            row["speedup_samples"] = [round(r, 2) for r in ratios]
+        out[f"fleet_pull_GBps_{n}s"] = row
+    if do_kill and 2 in fleets:
+        # Abrupt shard death on the 2-shard fleet: time from SIGKILL to
+        # the first CLEAN partial pull_all (watch registry pruned the
+        # victim at TTL, lost names report missing fast, survivors serve).
+        kfc = FleetClient(hub.hostport, tag="bench2", window=4,
+                          op_deadline_s=6.0)
+        kfc.pull_all(names)
+        victim = shard_procs["bench2"][-1]
+        t0 = time.monotonic()
+        victim.kill()
+        survivors = None
+        while time.monotonic() - t0 < 60:
+            try:
+                got = kfc.pull_all(names, on_missing="skip")
+            except Exception:
+                continue  # still inside the TTL window; retry
+            if len(got) < n_tensors:
+                survivors = len(got)
+                break
+        out["fleet_kill_recovery"] = {
+            "recovery_ms": round((time.monotonic() - t0) * 1e3),
+            "survivors": survivors, "lost": n_tensors - (survivors or 0),
+            "ttl_s": 3}
+        kfc.close()
+    for fc in fleets.values():
+        fc.close()
+    print(json.dumps(out))
+finally:
+    for p in procs:
+        try:
+            p.stdin.close()
+            p.wait(timeout=5)
+        except Exception:
+            p.kill()
+"""
+
+
+def fleet_point(counts=(1, 2, 4), n_tensors=32, nbytes=1 << 20, reps=7,
+                do_kill=True, timeout=420):
+    """Sharded-fleet pull rows: aggregate pull_all GB/s vs shard count
+    (each shard its own server process; interleaved samples, median of
+    per-rep ratios vs the 1-shard fleet) plus the kill-a-shard
+    recovery-time row. Subprocess-guarded like every bench point."""
+    code = "ROOT = %r\n%s" % (
+        os.path.dirname(os.path.abspath(__file__)), _FLEET_CHILD)
+    argv = [sys.executable, "-c", code, str(n_tensors), str(nbytes),
+            str(reps), "1" if do_kill else "0"] + [str(c) for c in counts]
+    proc = subprocess.run(  # tpulint: allow(py-blocking)
+        argv, capture_output=True, timeout=timeout, text=True)
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(f"fleet child failed rc={proc.returncode}")
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    for key, row in rows.items():
+        if key.startswith("fleet_pull"):
+            speedup = row.get("speedup_vs_1s")
+            print(f"# {key}: {row['gbps']} GB/s ({row['ms']} ms/pull_all)"
+                  + (f", {speedup}x vs 1 shard" if speedup else ""),
+                  file=sys.stderr)
+        else:
+            print(f"# {key}: {row}", file=sys.stderr)
+    return rows
+
+
 def smoke() -> None:
     """`make bench-smoke`: a <=10s-scale sanity sweep — one subprocess-
     guarded 64B echo sample plus a 4x1MB pipelined pull point — usable as
@@ -523,6 +670,15 @@ def smoke() -> None:
                                         pull_only=True, timeout=90))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["param_pull_all_4x1MB"] = {"error": str(e)}
+    # Guarded 2-shard fleet row: a quick 1-vs-2-shard aggregate pull pair
+    # — if scatter/gather stops scaling (or the fleet path breaks), the
+    # smoke run shows it before the full sweep would.
+    try:
+        out.update(fleet_point(counts=(1, 2), n_tensors=8,
+                               nbytes=512 << 10, reps=1, do_kill=False,
+                               timeout=150))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["fleet_pull_GBps_2s"] = {"error": str(e)}
     if wedges:
         out["wedged_samples"] = wedges
     print(json.dumps({"metric": "bench_smoke", "sweep": out}))

@@ -1,0 +1,33 @@
+"""Sharded parameter-server fleet with live resharding.
+
+The single-`ParameterServer` story scaled the endpoint; this package
+scales the FLEET (ROADMAP item 1): parameters shard across N servers by
+the same ketama ring the native `c_ketama` balancer uses, membership
+rides the framework's watch-mode registry, cross-shard `pull_all`/
+`push_all` scatter/gather over per-shard `PipelineWindow`s, and a
+`Migrator` keeps placement converged through joins/leaves with a
+two-phase per-tensor handoff that clients never observe as a torn or
+stale-beyond-lag-bound read.
+
+  ShardMap      name -> shard placement (ketama ring / explicit overrides)
+  registry      HTTP glue over native/trpc/registry.* (watch mode)
+  FleetServer   one shard: ParameterServer + registry heartbeat
+  FleetClient   scatter/gather client with mid-reshard routing
+  Migrator      watch-triggered planner + bandwidth-bounded migrator
+"""
+
+from brpc_tpu.fleet.fleet_client import FleetClient
+from brpc_tpu.fleet.migrator import Migrator, ReshardPlan, plan_reshard
+from brpc_tpu.fleet.registry import (Registration, RegistryHub,
+                                     RegistryWatcher, clear_registry,
+                                     deregister, install_registry,
+                                     list_servers, register)
+from brpc_tpu.fleet.server import FleetServer
+from brpc_tpu.fleet.shard_map import ShardMap, key_point
+
+__all__ = [
+    "FleetClient", "FleetServer", "Migrator", "Registration", "RegistryHub",
+    "RegistryWatcher", "ReshardPlan", "ShardMap", "clear_registry",
+    "deregister", "install_registry", "key_point", "list_servers",
+    "plan_reshard", "register",
+]

@@ -1,0 +1,392 @@
+"""FleetClient: one parameter-server interface over N shards.
+
+`pull_all`/`push_all` become cross-shard scatter/gather: names group by
+their ketama owner (shard_map.py — computed locally from the registry's
+membership list), each shard's group rides its OWN `ParameterClient`
+(own TensorChannel + arena) through its own `PipelineWindow` on its own
+thread, so aggregate bandwidth scales with shard count instead of
+serializing behind one endpoint.
+
+Mid-reshard correctness is a routing protocol, not luck:
+
+  * the client keeps the CURRENT map and the PREVIOUS one; a miss at the
+    new owner falls back to the old owner (reads are served by the old
+    owner until a tensor's handoff commits);
+  * E_MOVED redirects carry "moved:<addr>" — the forwarding chain is
+    followed without a registry round trip;
+  * E_MIGRATING (installed but not yet committed) and connection errors
+    back off and retry under a deadline, refreshing membership between
+    rounds;
+  * a name answering E_NO_SUCH everywhere with stable membership raises
+    KeyError fast (vs. spinning out the deadline) — the kill-a-shard
+    data-loss signal, repaired by `install()` reseeding.
+
+Per-shard Meta traffic rides `ParameterClient.cached_meta()` (the
+epoch-validated cache), so a warm fleet meta() costs one tiny Epoch RPC
+per shard, not N full Meta payloads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor, wait
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from brpc_tpu.fleet import gauges, registry
+from brpc_tpu.fleet.shard_map import ShardMap
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.param_server import (E_MIGRATING, E_MOVED, E_NO_SUCH,
+                                           ParameterClient, moved_dest)
+from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
+                                     _decode_meta, _metrics)
+
+
+def _pull_group_host(pc: ParameterClient, names: List[str],
+                     window: int) -> Dict[str, tuple]:
+    """One shard's pull stream -> {name: (version, DETACHED host array)}.
+
+    The fleet's shard streams run on concurrent threads, and
+    `jax.device_put` dispatch is effectively serialized by the JAX runtime
+    — concurrent per-tensor dispatch from N threads CONTENDS instead of
+    scaling (measured 2.5x slower at 2 shards than one thread's worth of
+    work). So shard threads stop at a detached host copy (np.array of the
+    zero-copy view: a GIL-releasing memcpy that scales with threads) and
+    the caller's thread does the device dispatch alone. On the CPU
+    backend the later device_put zero-copy-aliases the detached buffer,
+    so nothing is copied twice; on accelerators the H2D DMA reads from
+    the detached copy instead of the arena pages — one staging copy,
+    bought deliberately to keep the N-shard wire path parallel."""
+    out: Dict[str, tuple] = {}
+    m = _metrics()
+
+    def on_reply(name, payload, view):
+        with view:
+            dtype, shape, rest = _decode_meta(payload)
+            host = np.array(np.frombuffer(view.ndarray(),
+                                          dtype=dtype).reshape(shape))
+            m["pull_bytes"].add(view.nbytes)
+        out[name] = (int(rest.decode()), host)
+
+    with PipelineWindow(pc.channel, window, on_reply=on_reply) as win:
+        for name in names:
+            win.submit("ParamService/Pull", request=name.encode(), tag=name)
+    return out
+
+
+class FleetClient:
+    """Scatter/gather parameter access across a registered shard fleet."""
+
+    def __init__(self, registry_hostport: str, tag: str = "param",
+                 window: int = 4, arena_bytes: int = 64 << 20,
+                 device=None, op_deadline_s: float = 15.0,
+                 overrides: Optional[Dict[str, str]] = None):
+        self._registry = registry_hostport
+        self._tag = tag
+        self.window = window
+        self._arena_bytes = arena_bytes
+        self._device = device
+        self._deadline_s = op_deadline_s
+        self._overrides = dict(overrides or {})
+        self._mu = threading.Lock()
+        self._clients: Dict[str, ParameterClient] = {}
+        self._map: Optional[ShardMap] = None
+        self._prev_map: Optional[ShardMap] = None
+        # Weakly bound: the repointable-gauge holder table is immortal,
+        # and a strongly-captured self would pin a closed client and its
+        # per-shard arenas (64MB each) for the process lifetime.
+        ref = weakref.ref(self)
+
+        def _shards() -> int:
+            c = ref()
+            return len(c._map.shards) if c is not None and \
+                c._map is not None else 0
+
+        def _epoch() -> int:
+            c = ref()
+            return c._map.epoch if c is not None and \
+                c._map is not None else 0
+
+        gauges.publish("shards", _shards)
+        gauges.publish("map_epoch", _epoch)
+        self.refresh()
+
+    # ---- membership / routing ----
+
+    def refresh(self) -> None:
+        """Re-derive the shard map from the registry's membership list.
+        The map epoch IS the registry index, so every fleet participant
+        derives the same (map, epoch) pair with no coordination RPC."""
+        index, addrs = registry.list_servers(self._registry, self._tag)
+        with self._mu:
+            if self._map is not None:
+                if self._map.shards == tuple(sorted(set(addrs))):
+                    return  # membership unchanged; keep both maps as-is
+                self._prev_map = self._map
+                self._map = self._map.with_shards(addrs, index)
+            else:
+                self._map = ShardMap(addrs, epoch=index,
+                                     overrides=self._overrides)
+            live = set(self._map.shards)
+            if self._prev_map is not None:
+                live |= set(self._prev_map.shards)
+            for addr in [a for a in self._clients if a not in live]:
+                self._clients.pop(addr).close()
+
+    @property
+    def map(self) -> ShardMap:
+        with self._mu:
+            if self._map is None:
+                raise RuntimeError("fleet client is closed")
+            return self._map
+
+    def _client(self, addr: str) -> ParameterClient:
+        with self._mu:
+            pc = self._clients.get(addr)
+            if pc is None:
+                pc = ParameterClient(f"tpu://{addr}",
+                                     TensorArena(self._arena_bytes))
+                self._clients[addr] = pc
+            return pc
+
+    def _candidates(self, name: str) -> List[str]:
+        """Owner under the current map, then under the previous one —
+        mid-reshard reads are served by the OLD owner until the handoff
+        commits, so both generations are live routing targets."""
+        with self._mu:
+            maps = [m for m in (self._map, self._prev_map) if m is not None]
+        out: List[str] = []
+        for m in maps:
+            try:
+                addr = m.owner(name)
+            except LookupError:
+                continue
+            if addr not in out:
+                out.append(addr)
+        return out
+
+    def _with_retry(self, name: str, op):
+        """Run `op(ParameterClient)` against the candidate owners,
+        following E_MOVED forwarding, backing off on E_MIGRATING and
+        transport errors, refreshing membership between rounds."""
+        deadline = time.monotonic() + self._deadline_s
+        delay = 0.01
+        last_err: Optional[Exception] = None
+        while True:
+            # One consistent snapshot per round: a concurrent close()
+            # nulls self._map, and unsnapshotted check-then-use would
+            # surface as AttributeError instead of the clean error below.
+            with self._mu:
+                smap = self._map
+            if smap is None:
+                raise RuntimeError("fleet client is closed")
+            retriable = False
+            tried = set()
+            queue = self._candidates(name)
+            while queue:
+                addr = queue.pop(0)
+                if addr in tried:
+                    continue
+                tried.add(addr)
+                try:
+                    return op(self._client(addr))
+                except native.RpcError as e:
+                    last_err = e
+                    dest = moved_dest(e)
+                    if dest and dest not in tried:
+                        queue.append(dest)  # follow the forwarding chain
+                    if e.code == E_NO_SUCH:
+                        continue
+                    if e.code == E_MOVED:
+                        # A forward to a live member (or a mid-handshake
+                        # freeze with no dest yet) resolves shortly; a
+                        # forward to a DEPARTED shard means the tensor
+                        # died with it — don't spin out the deadline.
+                        if not dest or dest in smap:
+                            retriable = True
+                        continue
+                    # Transport errors from a CURRENT member retry (TTL
+                    # lag, a joiner warming up); from a departed shard
+                    # (prev-map fallback) they don't — its data either
+                    # migrated (the live owner answers) or died with it
+                    # (KeyError is the truth).
+                    if e.code == E_MIGRATING or addr in smap:
+                        retriable = True
+            self.refresh()
+            with self._mu:
+                changed = (self._map is not None
+                           and self._map.epoch != smap.epoch)
+            if not retriable and not changed:
+                # Every live candidate disowns it and membership is
+                # stable: the name is not in the fleet (lost with a dead
+                # shard, or never seeded). install() repairs data loss.
+                raise KeyError(f"parameter {name!r} not in fleet") \
+                    from last_err
+            if time.monotonic() >= deadline:
+                assert last_err is not None
+                raise last_err
+            time.sleep(delay)
+            delay = min(delay * 2, 0.25)
+
+    # ---- metadata ----
+
+    def meta(self) -> dict:
+        """Merged fleet meta: {name: {shape, dtype, version, shard}}.
+        Mid-handoff duplicates (frozen at the old owner, pending at the
+        new) collapse to the higher-version entry."""
+        with self._mu:
+            if self._map is None:
+                raise RuntimeError("fleet client is closed")
+            shards = self._map.shards
+        merged: Dict[str, Tuple[str, dict]] = {}
+        for addr in shards:
+            try:
+                m = self._client(addr).cached_meta()
+            except native.RpcError:
+                continue  # dead shard: TTL expiry will drop it from the map
+            for k, v in m.items():
+                cur = merged.get(k)
+                if cur is None or v.get("version", 0) >= cur[1].get(
+                        "version", 0):
+                    merged[k] = (addr, v)
+        return {k: dict(v, shard=addr) for k, (addr, v) in merged.items()}
+
+    # ---- single-tensor ops ----
+
+    def pull(self, name: str, device=None):
+        """-> (version, jax.Array), routed/redirected to the live owner."""
+        dev = device if device is not None else self._device
+        return self._with_retry(name,
+                                lambda pc: pc.pull(name, device=dev))
+
+    def push_grad(self, name: str, grad) -> int:
+        return self._with_retry(name,
+                                lambda pc: pc.push_grad(name, grad))
+
+    def install(self, name: str, array, version: int = 0,
+                refresh: bool = True) -> str:
+        """Seed (or re-seed after a shard died with its data) a parameter
+        at its current ketama owner; returns the owning shard.
+        `refresh=False` skips the registry round trip — for seeding loops
+        that already refreshed once (one list call, not one per tensor)."""
+        arr = np.asarray(array)
+        stacked = np.stack([arr, np.zeros_like(arr)])
+        if refresh:
+            self.refresh()
+        addr = self.map.owner(name)
+        self._client(addr).install(name, stacked, version, commit=True)
+        return addr
+
+    # ---- cross-shard scatter/gather ----
+
+    def pull_all(self, names: Optional[Iterable[str]] = None, device=None,
+                 window: Optional[int] = None,
+                 on_missing: str = "error") -> Dict[str, tuple]:
+        """Pull many parameters fleet-wide -> {name: (version, jax.Array)}.
+
+        Scatter: each owning shard's name group streams through that
+        shard's own PipelineWindow on its own thread (aggregate bandwidth
+        = sum of shard streams). Gather: one merged dict. Shard-level
+        failures (mid-reshard misses, a killed shard) fall back to
+        per-name routed retries; `on_missing`: "error" raises KeyError for
+        names the fleet no longer holds, "skip" drops them from the
+        result.
+        """
+        if on_missing not in ("error", "skip"):
+            raise ValueError(f"on_missing must be error|skip: {on_missing!r}")
+        win = window if window is not None else self.window
+        dev = device if device is not None else self._device
+        if names is None:
+            names = sorted(self.meta())
+        names = list(names)
+        hosts: Dict[str, tuple] = {}
+        res_mu = threading.Lock()
+
+        def pull_group(addr: str, group: List[str]) -> List[str]:
+            try:
+                got = _pull_group_host(self._client(addr), group, win)
+            except (native.RpcError, OSError, RuntimeError):
+                return group  # salvage path re-routes the whole group
+            with res_mu:
+                hosts.update(got)
+            return []
+
+        failed = self._scatter(names, pull_group)
+        # Salvage: re-group under refreshed membership once (a whole-shard
+        # miss is usually one stale map), then per-name routed retries.
+        if failed:
+            self.refresh()
+            failed = self._scatter(failed, pull_group)
+        # Device dispatch on THIS thread only (see _pull_group_host); the
+        # CPU backend aliases the detached buffers, so this costs nothing
+        # there, and JAX's async dispatch overlaps real H2D transfers.
+        import jax
+
+        results: Dict[str, tuple] = {
+            name: (version, jax.device_put(host, dev))
+            for name, (version, host) in hosts.items()}
+        for name in failed:
+            try:
+                results[name] = self._with_retry(
+                    name, lambda pc, n=name: pc.pull(n, device=dev))
+            except KeyError:
+                if on_missing == "error":
+                    raise
+        return results
+
+    def push_all(self, grads: Dict[str, object],
+                 window: Optional[int] = None) -> Dict[str, int]:
+        """Push many gradients fleet-wide -> {name: new_version}; same
+        scatter/gather + salvage shape as pull_all."""
+        win = window if window is not None else self.window
+        versions: Dict[str, int] = {}
+        res_mu = threading.Lock()
+
+        def push_group(addr: str, group: List[str]) -> List[str]:
+            try:
+                got = self._client(addr).push_all(
+                    {n: grads[n] for n in group}, window=win)
+            except (native.RpcError, OSError, RuntimeError):
+                return group
+            with res_mu:
+                versions.update(got)
+            return []
+
+        failed = self._scatter(list(grads), push_group)
+        if failed:
+            self.refresh()
+            failed = self._scatter(failed, push_group)
+        for name in failed:
+            versions[name] = self._with_retry(
+                name, lambda pc, n=name: pc.push_grad(n, grads[n]))
+        return versions
+
+    def _scatter(self, names: List[str], shard_op) -> List[str]:
+        """Run `shard_op(addr, group)` per owning shard concurrently;
+        returns the names the ops reported as failed."""
+        groups = self.map.assignment(names)
+        if not groups:
+            return list(names)
+        failed: List[str] = []
+        if len(groups) == 1:
+            (addr, group), = groups.items()
+            return shard_op(addr, group)
+        with ThreadPoolExecutor(max_workers=len(groups),
+                                thread_name_prefix="fleet-io") as pool:
+            futs = [pool.submit(shard_op, addr, group)
+                    for addr, group in groups.items()]
+            wait(futs)
+        for f in futs:
+            failed.extend(f.result())
+        return failed
+
+    def close(self) -> None:
+        with self._mu:
+            clients, self._clients = self._clients, {}
+            self._map = None
+            self._prev_map = None
+        for pc in clients.values():
+            pc.close()

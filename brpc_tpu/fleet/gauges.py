@@ -1,0 +1,38 @@
+"""Fleet observability vars (the /tensorz fleet view).
+
+Thin naming wrappers over `brpc_tpu.observability.metrics`: gauges ride
+`repointable_gauge` because fleet roles restart within one process
+(tests, notebook reconnects) while tbvar registrations are immortal —
+the newest publisher of a name wins. Counters are plain get-or-create.
+
+Series (all surfaced by /vars, /brpc_metrics and the /tensorz fleet
+section):
+
+  fleet_shards                  live shards in the current map
+  fleet_map_epoch               registry membership index the map is built on
+  fleet_resharding              1 while a migration is executing
+  fleet_migration_moving        tensors still to move (nonzero after a
+                                reshard = the migrator could not converge)
+  fleet_migration_moved_total   tensors handed off fleet-lifetime (counter)
+  fleet_migration_bytes_total   parameter bytes migrated (counter)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def publish(name: str, fn: Callable[[], int]) -> None:
+    """(Re)point gauge `fleet_<name>` at `fn`."""
+    from brpc_tpu.observability import metrics as obs
+
+    # Names come from this package's fixed publish() sites (shards,
+    # map_epoch, resharding, migration_moving) — always charset-clean.
+    obs.repointable_gauge(f"fleet_{name}", fn)  # tpulint: allow(metric-name)
+
+
+def counter(name: str):
+    from brpc_tpu.observability import metrics as obs
+
+    # Fixed call sites only (migration_moved_total / migration_bytes_total).
+    return obs.counter(f"fleet_{name}")  # tpulint: allow(metric-name)

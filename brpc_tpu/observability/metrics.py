@@ -174,6 +174,34 @@ def gauge(name: str, fn: Callable[[], int]) -> PassiveGauge:
                           lambda: PassiveGauge(name, fn))
 
 
+# Roles that restart within one process (fleet clients, migrators, a
+# re-created named server) can't re-register their gauges — registrations
+# are immortal and keep the original callback. These route reads through a
+# re-pointable table instead: the newest repointable_gauge(name, ...) wins.
+
+_repoint_mu = threading.Lock()
+_repoint_holders: Dict[str, Callable[[], int]] = {}
+
+
+def repointable_gauge(name: str, fn: Callable[[], int]) -> None:
+    """(Re)point gauge `name` at `fn`; the native registration happens on
+    the first call for the name and reads the CURRENT holder at scrape
+    time. A failing holder reads as -1 (never unwinds into the scrape)."""
+    with _repoint_mu:
+        first = name not in _repoint_holders
+        _repoint_holders[name] = fn
+    if first:
+        def _read(name=name) -> int:
+            with _repoint_mu:
+                f = _repoint_holders.get(name)
+            try:
+                return int(f()) if f is not None else 0
+            except Exception:  # noqa: BLE001 — a failing gauge reads as -1
+                return -1
+
+        gauge(name, _read)
+
+
 # ---- dumps (the same snapshots the console pages serve) ----
 
 def dump_vars(prefix: str = "") -> str:

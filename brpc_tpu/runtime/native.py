@@ -100,7 +100,7 @@ def lib() -> ctypes.CDLL:
     if not os.path.exists(_LIB_PATH):
         _build_native()
     L = ctypes.CDLL(_LIB_PATH)
-    if not hasattr(L, "tbrpc_server_set_inline"):
+    if not hasattr(L, "tbrpc_registry_install"):
         # Stale build from before the current bindings: the handler ABI
         # carries extra out-params now, so using it would marshal garbage
         # (not just miss symbols). Rebuild — and verify the reload took:
@@ -108,7 +108,7 @@ def lib() -> ctypes.CDLL:
         # handle back and only a fresh process can pick up the new build.
         _build_native()
         L = ctypes.CDLL(_LIB_PATH)
-        if not hasattr(L, "tbrpc_server_set_inline"):
+        if not hasattr(L, "tbrpc_registry_install"):
             raise RuntimeError(
                 "libbrpc_tpu.so was built before the current bindings and "
                 "the stale mapping is already loaded in this process; the "
@@ -215,6 +215,12 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_now_us.restype = ctypes.c_int64
     L.tbrpc_flag_set.restype = ctypes.c_int
     L.tbrpc_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    # Fleet: the process-global service registry (brpc_tpu/fleet rides it
+    # over plain HTTP once installed; clear is test isolation).
+    L.tbrpc_registry_install.restype = ctypes.c_int
+    L.tbrpc_registry_install.argtypes = []
+    L.tbrpc_registry_clear.restype = ctypes.c_int
+    L.tbrpc_registry_clear.argtypes = []
     _lib = L
     atexit.register(_teardown_native_handles)
     return L

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import weakref
@@ -34,6 +35,29 @@ from brpc_tpu.runtime import native
 from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
                                      TensorChannel, _device_put_from_view,
                                      add_tensor_service)
+
+# App-level error codes, disjoint from trpc/errno.h. The server
+# historically answered "no such parameter" with 2007 — which COLLIDES
+# with TRPC_ECONNECT, so a fleet client couldn't tell "that shard doesn't
+# have it" (don't retry) from "that shard is unreachable" (do retry):
+# E_NO_SUCH moves to its own code. E_MOVED's text carries the forwarding
+# address as "moved:<host:port>" — the fleet client parses it to re-route
+# mid-reshard; E_MIGRATING means installed-but-uncommitted (retry soon).
+E_NO_SUCH = 2040
+E_MOVED = 2041
+E_MIGRATING = 2042
+E_EXISTS = 2043  # install over a live (serving) parameter
+
+_MOVED_RE = re.compile(r"moved:(\S+)")
+
+
+def moved_dest(err: "native.RpcError") -> Optional[str]:
+    """The forwarding address an E_MOVED redirect carries, or None."""
+    if err.code != E_MOVED:
+        return None
+    m = _MOVED_RE.search(err.text or "")
+    return m.group(1) if m else None
+
 
 # Process-wide recorders (brpc_tpu/observability): every ParameterServer
 # instance feeds the same series, like native per-method stats aggregate.
@@ -68,11 +92,48 @@ def _metrics():
     return _metrics_cache
 
 
+def _per_server_lag_gauge(name: str, srv: "ParameterServer") -> None:
+    """Expose this server's version spread as its OWN gauge
+    (`param_server_version_lag_<name>`) beside the process-wide max —
+    satellite: per-server (and per-shard, via the fleet's shard names)
+    version-lag series on /vars, /brpc_metrics and /tensorz. Re-pointable
+    (newest server claiming the name wins) and weakly bound, so a test's
+    re-created server neither collides nor leaks."""
+    from brpc_tpu.observability import metrics as obs
+
+    safe = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    ref = weakref.ref(srv)
+    # `safe` is re.sub-sanitized to the exposition charset just above.
+    obs.repointable_gauge(
+        f"param_server_version_lag_{safe}",  # tpulint: allow(metric-name)
+        lambda: getattr(ref(), "_version_spread", 0))
+
+
 class ParameterServer:
-    """Serves named jax.Arrays over RPC; Push applies momentum SGD."""
+    """Serves named jax.Arrays over RPC; Push applies momentum SGD.
+
+    Shard-aware (brpc_tpu/fleet): Meta carries a schema epoch (bumped when
+    the parameter SET changes — Install/Retire — never by plain updates,
+    so clients can cache the name->shape/dtype map); Handoff/Install/
+    Retire/Commit are the live-resharding handshake a fleet Migrator
+    drives. Per-name migration states:
+
+      serving  normal pulls + pushes
+      frozen   Handoff exported it: pulls still served (old-owner reads
+               until the handoff commits), pushes refused with E_MOVED so
+               no update can land that the export missed
+      pending  Installed here but not yet committed: pulls served (same
+               version the old owner still serves), pushes refused with
+               E_MIGRATING until Commit — so a version can never advance
+               on the new owner while the old owner still answers reads
+
+    A retired name answers E_MOVED with "moved:<dest>" so clients holding
+    a stale shard map re-route without a registry round trip.
+    """
 
     def __init__(self, params: Dict[str, jax.Array], lr: float = 0.01,
-                 momentum: float = 0.9, arena: Optional[TensorArena] = None):
+                 momentum: float = 0.9, arena: Optional[TensorArena] = None,
+                 name: Optional[str] = None):
         # Backend split for the Push hot path. On TPU the update is the
         # fused Pallas kernel over device arrays (device_put = a real H2D
         # DMA). On the CPU backend that same shape is all dispatch
@@ -118,6 +179,16 @@ class ParameterServer:
         # Lock-free mirror of max(version)-min(version), updated by Push
         # under _mu, read by the version-lag gauge without it.
         self._version_spread = 0
+        # ---- shard-aware state (brpc_tpu/fleet) ----
+        # Schema epoch: bumps when the parameter SET changes (Install /
+        # Retire), never on plain version bumps — the client Meta cache key.
+        self._schema_epoch = 1
+        self._state: Dict[str, str] = {}        # absent == "serving"
+        self._handoff_dest: Dict[str, str] = {}  # frozen name -> dest addr
+        self._moved: Dict[str, str] = {}         # retired name -> dest addr
+        self.name = name
+        if name is not None:
+            _per_server_lag_gauge(name, self)
         _SERVERS.add(self)
         self._m = _metrics()
         self.server = native.Server()
@@ -142,18 +213,49 @@ class ParameterServer:
             # read here can pair a new version with an old shape/dtype
             # (or hit a dict mutated mid-iteration).
             with self._mu:
-                meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype),
-                            "version": self._version[k]}
-                        for k, v in self._params.items()}
-            return json.dumps(meta).encode(), None
+                meta = {}
+                for k, v in self._params.items():
+                    entry = {"shape": list(v.shape), "dtype": str(v.dtype),
+                             "version": self._version[k]}
+                    state = self._state.get(k)
+                    if state is not None:  # frozen/pending: the migrator's
+                        entry["state"] = state  # repair pass reads this
+                    meta[k] = entry
+                epoch = self._schema_epoch
+            return json.dumps({"epoch": epoch, "params": meta}).encode(), None
+        if method == "Epoch":
+            # The Meta-cache validator: a tiny small-RPC-fast-path answer
+            # (schema epoch only) instead of the full Meta payload.
+            with self._mu:
+                epoch = self._schema_epoch
+            return json.dumps({"epoch": epoch}).encode(), None
+        if method == "Handoff":
+            return self._handle_handoff(request)
+        if method == "Install":
+            return self._handle_install(request, att)
+        if method == "Retire":
+            return self._handle_retire(request)
+        if method == "Commit":
+            return self._handle_commit(request)
         name = request.decode()
         with self._mu:
             known = name in self._params
+            dest = self._moved.get(name)
         if not known:
-            raise native.RpcError(2007, f"no such parameter: {name}")
+            if dest is not None:
+                raise native.RpcError(E_MOVED,
+                                      f"parameter {name} moved:{dest}")
+            raise native.RpcError(E_NO_SUCH, f"no such parameter: {name}")
         if method == "Pull":
             t0 = time.monotonic()
             with self._mu:
+                if name not in self._params:  # retired under our feet
+                    moved = self._moved.get(name)
+                    if moved is not None:
+                        raise native.RpcError(
+                            E_MOVED, f"parameter {name} moved:{moved}")
+                    raise native.RpcError(E_NO_SUCH,
+                                          f"no such parameter: {name}")
                 out = str(self._version[name]).encode(), self._params[name]
             self._m["pull"].record_s(time.monotonic() - t0)
             return out
@@ -169,7 +271,140 @@ class ParameterServer:
             self._m["push"].record_s(time.monotonic() - t0)
             self._m["push_bytes"].add(att.nbytes)
             return str(version).encode(), None
-        raise native.RpcError(2007, f"no such method: {method}")
+        raise native.RpcError(E_NO_SUCH, f"no such method: {method}")
+
+    # ---- live-resharding handshake (driven by brpc_tpu/fleet.Migrator) ----
+
+    def _recompute_spread_locked(self) -> None:
+        vs = self._version.values()
+        self._version_spread = max(vs) - min(vs) if vs else 0
+
+    def _handle_handoff(self, request: bytes):
+        """Freeze `name` for export: pushes refuse with E_MOVED from here
+        on (no update can land that the export would miss); pulls keep
+        serving the frozen — latest committed — version until Retire.
+        Returns {"version"} + the stacked [param, momentum] tensor.
+        Idempotent: a migrator retry re-exports the same frozen state."""
+        req = json.loads(request.decode())
+        name, dest = req["name"], req.get("dest", "")
+        with self._mu:
+            lock = self._update_locks.get(name)
+            if lock is None:
+                moved = self._moved.get(name)
+                if moved is not None:
+                    raise native.RpcError(E_MOVED,
+                                          f"parameter {name} moved:{moved}")
+                raise native.RpcError(E_NO_SUCH,
+                                      f"no such parameter: {name}")
+        with lock:  # an in-flight push completes (or sees frozen) first
+            with self._mu:
+                if name not in self._params:  # retired while we waited
+                    moved = self._moved.get(name)
+                    raise native.RpcError(
+                        E_MOVED, f"parameter {name} retired"
+                        + (f"; moved:{moved}" if moved else ""))
+                self._state[name] = "frozen"
+                if dest:
+                    self._handoff_dest[name] = dest
+                p = self._params[name]
+                m = self._momenta[name]
+                version = self._version[name]
+        # Updates are functional (p/m replaced, never mutated) and frozen
+        # names take no more of them: stacking outside the locks reads
+        # stable arrays. One D2H per array on the device path.
+        stacked = np.stack([np.asarray(p), np.asarray(m)])
+        return json.dumps({"name": name, "version": version}).encode(), stacked
+
+    def _handle_install(self, request: bytes, att):
+        """Adopt a handed-off tensor in `pending` state: pulls serve it
+        (same version the frozen old owner still answers), pushes refuse
+        with E_MIGRATING until Commit — a version can never advance here
+        while the old owner still serves reads. Idempotent re-install of a
+        pending name is allowed (migrator retry)."""
+        req = json.loads(request.decode())
+        name = req["name"]
+        version = int(req.get("version", 0))
+        if att is None:
+            raise native.RpcError(1003, "install without tensor payload")
+        if att.ndim < 1 or att.shape[0] != 2:
+            raise native.RpcError(
+                1003, f"install expects stacked [param, momentum], "
+                      f"got shape {tuple(att.shape)}")
+        # Detach from the sender's arena pages BEFORE the handler returns.
+        param = np.array(att[0])
+        mom = np.array(att[1])
+        if self._on_device:
+            param = _device_put_from_view(param, None)
+            mom = _device_put_from_view(mom, None)
+        with self._mu:
+            # Re-install over `pending` (migrator retry) or `frozen` (this
+            # shard handed the name off once and a later remap brought it
+            # back before the stale copy was retired) is recovery, not a
+            # conflict; only a SERVING copy refuses.
+            if name in self._params and self._state.get(name) not in (
+                    "pending", "frozen"):
+                raise native.RpcError(
+                    E_EXISTS, f"install over live parameter: {name}")
+            self._params[name] = param
+            self._momenta[name] = mom
+            self._version[name] = version
+            self._update_locks.setdefault(name, threading.Lock())
+            self._state[name] = "pending"
+            self._moved.pop(name, None)  # keys can migrate back later
+            self._handoff_dest.pop(name, None)  # any old freeze is void
+            self._schema_epoch += 1
+            self._recompute_spread_locked()
+        return json.dumps({"name": name, "version": version}).encode(), None
+
+    def _handle_retire(self, request: bytes):
+        """Drop a handed-off tensor and remember its forwarding address:
+        later pulls/pushes answer E_MOVED "moved:<dest>" so stale-mapped
+        clients re-route without a registry round trip. Idempotent."""
+        req = json.loads(request.decode())
+        name, dest = req["name"], req.get("dest", "")
+        with self._mu:
+            lock = self._update_locks.get(name)
+        if lock is not None:
+            with lock:
+                with self._mu:
+                    self._params.pop(name, None)
+                    self._momenta.pop(name, None)
+                    self._version.pop(name, None)
+                    self._update_locks.pop(name, None)
+                    self._state.pop(name, None)
+                    self._handoff_dest.pop(name, None)
+                    if dest:  # an empty dest would forward into "moved:"
+                        self._moved[name] = dest  # — unparseable; a plain
+                    self._schema_epoch += 1       # drop answers E_NO_SUCH
+                    self._recompute_spread_locked()
+        else:
+            with self._mu:
+                if dest and self._moved.get(name) != dest:
+                    # Recording a (new) redirect is a schema change too —
+                    # without the bump a warm Meta cache on this server
+                    # would keep validating against the pre-retire set.
+                    self._moved[name] = dest
+                    self._schema_epoch += 1
+        return json.dumps({"name": name}).encode(), None
+
+    def _handle_commit(self, request: bytes):
+        """pending -> serving: the write-side commit point. Ordered by the
+        Migrator AFTER the old owner retired, so reads and writes can
+        never disagree across the two owners."""
+        name = request.decode()
+        with self._mu:
+            if name not in self._params:
+                moved = self._moved.get(name)
+                if moved is not None:
+                    raise native.RpcError(E_MOVED,
+                                          f"parameter {name} moved:{moved}")
+                raise native.RpcError(E_NO_SUCH,
+                                      f"no such parameter: {name}")
+            self._state.pop(name, None)
+            # A stale forwarding hint must not outlive the commit: a later
+            # dest-less Handoff would re-surface it as a dead redirect.
+            self._handoff_dest.pop(name, None)
+        return b"ok", None
 
     def _apply_update(self, name: str, att, tracing) -> int:
         if self._on_device:
@@ -178,8 +413,30 @@ class ParameterServer:
                 # detached from the arena pages) before the handler
                 # returns and the view's range can be reused.
                 grad = _device_put_from_view(np.ascontiguousarray(att), None)
-        with self._update_locks[name]:
+        with self._mu:
+            lock = self._update_locks.get(name)
+            if lock is None:  # retired between the known-check and here
+                moved = self._moved.get(name)
+                raise native.RpcError(
+                    E_MOVED, f"parameter {name} retired"
+                    + (f"; moved:{moved}" if moved else ""))
+        with lock:
             with self._mu:
+                if name not in self._params:  # retired while we waited
+                    moved = self._moved.get(name)
+                    raise native.RpcError(
+                        E_MOVED, f"parameter {name} retired"
+                        + (f"; moved:{moved}" if moved else ""))
+                state = self._state.get(name)
+                if state == "frozen":
+                    dest = self._handoff_dest.get(name)
+                    raise native.RpcError(
+                        E_MOVED, f"parameter {name} handed off"
+                        + (f"; moved:{dest}" if dest else ""))
+                if state == "pending":
+                    raise native.RpcError(
+                        E_MIGRATING,
+                        f"parameter {name} migrating in; retry shortly")
                 p = self._params[name]
                 m = self._momenta[name]
             with tracing.stage("fused_update"):
@@ -206,8 +463,7 @@ class ParameterServer:
                 self._momenta[name] = m2
                 self._version[name] += 1
                 version = self._version[name]
-                vs = self._version.values()
-                self._version_spread = max(vs) - min(vs)
+                self._recompute_spread_locked()
         return version
 
 
@@ -216,11 +472,34 @@ class ParameterClient:
     framework (one TensorChannel per client)."""
 
     def __init__(self, addr: str, arena: Optional[TensorArena] = None):
+        self.addr = addr
         self.channel = TensorChannel(addr, arena)
+        # Meta cache keyed by the server's schema epoch: the epoch bumps
+        # only when the parameter SET changes (Install/Retire), so the
+        # name -> shape/dtype map stays valid across ordinary pushes.
+        # Cached VERSIONS are stale by design — versions ride each pull.
+        self._meta_epoch: Optional[int] = None
+        self._meta_cache: Optional[dict] = None
 
     def meta(self) -> dict:
         payload, _ = self.channel.call("ParamService/Meta")
-        return json.loads(payload.decode())
+        doc = json.loads(payload.decode())
+        self._meta_epoch = doc["epoch"]
+        self._meta_cache = doc["params"]
+        return doc["params"]
+
+    def epoch(self) -> int:
+        """The server's schema epoch (a tiny small-RPC-fast-path call)."""
+        payload, _ = self.channel.call("ParamService/Epoch")
+        return json.loads(payload.decode())["epoch"]
+
+    def cached_meta(self) -> dict:
+        """The Meta map through the epoch-validated cache: one Epoch
+        round trip (bytes, not the whole schema) when warm; a full Meta
+        fetch only on the first call or an epoch mismatch."""
+        if self._meta_cache is not None and self.epoch() == self._meta_epoch:
+            return self._meta_cache
+        return self.meta()
 
     def pull(self, name: str, device=None):
         """-> (version, jax.Array) — H2D straight from the shared pages."""
@@ -234,6 +513,32 @@ class ParameterClient:
         payload = self.channel.push_device("ParamService/Push", grad,
                                            request=name.encode())
         return int(payload.decode())
+
+    # ---- live-resharding handshake (used by brpc_tpu/fleet.Migrator) ----
+
+    def handoff(self, name: str, dest: str = ""):
+        """Freeze + export `name` -> (version, stacked [param, momentum]
+        host array). The server refuses pushes to it from now on."""
+        req = json.dumps({"name": name, "dest": dest}).encode()
+        payload, stacked = self.channel.call("ParamService/Handoff",
+                                             request=req)
+        return json.loads(payload.decode())["version"], stacked
+
+    def install(self, name: str, stacked, version: int,
+                commit: bool = False) -> None:
+        """Adopt a stacked [param, momentum] tensor at `version` in
+        pending state; `commit=True` also flips it serving (reseed path)."""
+        req = json.dumps({"name": name, "version": int(version)}).encode()
+        self.channel.call("ParamService/Install", array=stacked, request=req)
+        if commit:
+            self.commit(name)
+
+    def retire(self, name: str, dest: str = "") -> None:
+        req = json.dumps({"name": name, "dest": dest}).encode()
+        self.channel.call("ParamService/Retire", request=req)
+
+    def commit(self, name: str) -> None:
+        self.channel.call("ParamService/Commit", request=name.encode())
 
     # ---- pipelined multi-tensor hot path (PipelineWindow) ----
     # The serial pull/push above pay one full round-trip per tensor: a
@@ -256,7 +561,7 @@ class ParameterClient:
         from brpc_tpu.runtime.tensor import _metrics, consume_pull_reply
 
         if names is None:
-            names = sorted(self.meta())
+            names = sorted(self.cached_meta())
         m = _metrics()
         out: Dict[str, tuple] = {}
 

@@ -25,6 +25,7 @@
 #include "trpc/channel.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
+#include "trpc/registry.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
 #include "trpc/stall_watchdog.h"
@@ -1284,6 +1285,18 @@ int64_t tbrpc_now_us(void) { return tbutil::gettimeofday_us(); }
 int tbrpc_flag_set(const char* name, const char* value) {
   if (name == nullptr || value == nullptr) return -1;
   return FlagRegistry::global().Set(name, value) ? 0 : -1;
+}
+
+// ---------------- fleet: service registry ----------------
+
+int tbrpc_registry_install(void) {
+  RegistryService::Install();
+  return 0;
+}
+
+int tbrpc_registry_clear(void) {
+  RegistryService::clear();
+  return 0;
 }
 
 // ---------------- bench harness ----------------

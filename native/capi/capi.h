@@ -311,6 +311,18 @@ int64_t tbrpc_now_us(void);
 // flag / parse error / validator veto.
 int tbrpc_flag_set(const char* name, const char* value);
 
+// ---- fleet: service registry (trpc/registry.h) ----
+// Install the in-process service registry: after this, EVERY server in the
+// process answers /registry/register, /registry/deregister and
+// /registry/list (watch mode via ?index=N&wait_ms=M) on its builtin HTTP
+// port — any server can BE the fleet's registry. The table is
+// process-global and entries expire ttl_s after their last heartbeat.
+// Idempotent; returns 0.
+int tbrpc_registry_install(void);
+// Drop every registry entry (test isolation between fleets sharing one
+// process — the table is process-global). Returns 0.
+int tbrpc_registry_clear(void);
+
 // ---- bench harness (loops in C so Python overhead is out of the path) ----
 // Echo round-trips of `payload_size`-byte attachments for ~`seconds`, with
 // `concurrency` concurrent callers. Returns one-way payload bytes/sec.

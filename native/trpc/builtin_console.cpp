@@ -290,6 +290,21 @@ void tensorz_page(const HttpRequest&, HttpResponse* resp) {
     b += "  (none registered yet — the Python data plane registers them "
          "on first use: brpc_tpu/observability)\n";
   }
+  // Fleet view: shard membership, shard-map epoch and live-resharding
+  // progress (brpc_tpu/fleet registers these; migration gauges converging
+  // to zero IS the reshard-completion proof the acceptance test reads).
+  size_t fleet_matched = 0;
+  for (const auto& [name, value] : vars) {
+    if (name.rfind("fleet_", 0) != 0) continue;
+    if (fleet_matched++ == 0) {
+      b += "\nfleet (shard map + migration — brpc_tpu/fleet):\n";
+    }
+    b += "  ";
+    b += name;
+    b += " : ";
+    b += value;
+    b += '\n';
+  }
 }
 
 // /sockets: EVERY live socket in the process, client side included —

@@ -32,5 +32,8 @@ int tbrpc_fix_watchdog_start(const char* dump_dir);
 // Service-flag entry-point shape (mirrors tbrpc_server_set_inline): a
 // handle + name + int toggle, kept in sync with the lock.
 int tbrpc_fix_set_inline(void* server, const char* service, int enabled);
+// Niladic entry-point shape (mirrors tbrpc_registry_install): an explicit
+// (void) parameter list must normalise to the lock's "int()" spelling.
+int tbrpc_fix_registry_install(void);
 
 }  // extern "C"

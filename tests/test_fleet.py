@@ -1,0 +1,470 @@
+"""Sharded parameter-server fleet (ISSUE 6 acceptance surface).
+
+Pure-Python half (runs in tier-1 with no native build):
+  * ketama zero-collateral remap at the FLEET level — adding shard N+1
+    moves only ~1/(N+1) of keys and ONLY onto the new shard; a leave
+    moves only the departed shard's keys;
+  * explicit per-tensor overrides win over the ring and fall back when
+    their target leaves;
+  * the reshard planner emits the minimal movement set from OBSERVED
+    placement (plus in-place repairs for stuck frozen/pending states).
+
+Native half (skips cleanly without libbrpc_tpu.so), under an ARMED stall
+watchdog so a wedge in the new fleet paths becomes a stall dump:
+  * cross-shard scatter/gather pull_all/push_all equals the single-server
+    result bit for bit;
+  * the Meta cache (epoch-validated) skips full Meta round trips and
+    invalidates on schema change;
+  * per-server version-lag gauges and the /tensorz fleet section;
+  * a LIVE 1 -> 2 reshard under concurrent pull+push load: no pull ever
+    returns a torn tensor (mixed elements) or a version that went
+    backwards, the registry watch edge triggers the migration sub-second,
+    and the fleet_* progress vars converge;
+  * kill-a-shard mid-pull_all: the watch registry drops it at TTL, pulls
+    of surviving tensors recover with no torn versions, lost tensors
+    report missing fast, and install() reseeds them.
+"""
+
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from brpc_tpu.fleet.shard_map import ShardMap
+from brpc_tpu.fleet.migrator import plan_reshard
+
+KEYS = [f"layer{i:03d}/w" for i in range(400)]
+
+
+# ---------------------------------------------------------------------------
+# ShardMap: ketama placement properties (tier-1, no native lib needed).
+# ---------------------------------------------------------------------------
+
+def _addrs(n):
+    return [f"10.0.0.{i + 1}:8000" for i in range(n)]
+
+
+def test_shard_map_balances_keys():
+    sm = ShardMap(_addrs(4))
+    counts = {a: 0 for a in sm.shards}
+    for k in KEYS:
+        counts[sm.owner(k)] += 1
+    # 100 vnodes x 4 points per digest: every shard takes a real share.
+    assert min(counts.values()) > len(KEYS) * 0.10, counts
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_shard_map_zero_collateral_join(n):
+    """Adding shard N+1 moves ~1/(N+1) of keys, all TO the new shard —
+    the fleet-level twin of the native ketama_remap_fraction pin."""
+    old = ShardMap(_addrs(n))
+    newcomer = f"10.0.0.{n + 1}:8000"
+    new = old.with_shards(list(old.shards) + [newcomer], epoch=1)
+    moves = old.moved_keys(new, KEYS)
+    frac = len(moves) / len(KEYS)
+    ideal = 1.0 / (n + 1)
+    assert 0.4 * ideal <= frac <= 1.9 * ideal, (frac, ideal)
+    assert all(dst == newcomer for (_src, dst) in moves.values()), (
+        "a join must never shuffle keys between surviving shards")
+
+
+def test_shard_map_leave_moves_only_departed_keys():
+    old = ShardMap(_addrs(4))
+    gone = old.shards[2]
+    new = old.with_shards([a for a in old.shards if a != gone], epoch=1)
+    moves = old.moved_keys(new, KEYS)
+    assert moves, "the departed shard owned nothing?"
+    assert all(src == gone for (src, _dst) in moves.values()), (
+        "a leave must move only the departed shard's keys")
+    untouched = [k for k in KEYS if k not in moves]
+    assert all(old.owner(k) == new.owner(k) for k in untouched)
+
+
+def test_shard_map_explicit_overrides():
+    sm = ShardMap(_addrs(3), overrides={"pinned": "10.0.0.3:8000"})
+    assert sm.owner("pinned") == "10.0.0.3:8000"
+    # An override to a shard that left falls back to the ring...
+    smaller = sm.with_shards(_addrs(2), epoch=1)
+    assert smaller.owner("pinned") in smaller.shards
+    # ...and snaps back when the target rejoins (overrides survive
+    # membership churn in full; owner() applies them by liveness).
+    assert smaller.with_shards(_addrs(3), epoch=2).owner(
+        "pinned") == "10.0.0.3:8000"
+    # Overridden keys don't move while their target stays live.
+    bigger = sm.with_shards(_addrs(4), epoch=3)
+    assert bigger.owner("pinned") == "10.0.0.3:8000"
+    # A constructor override to a not-(yet-)registered target rides the
+    # ring instead of routing to an unreachable address.
+    cold = ShardMap(_addrs(2), overrides={"pinned": "10.9.9.9:8000"})
+    assert cold.owner("pinned") in cold.shards
+
+
+def test_plan_reshard_minimal_moves_and_repairs():
+    a, b, c = _addrs(3)
+    target = ShardMap([a, b, c], epoch=5)
+    names = KEYS[:60]
+    entry = {"shape": [256], "dtype": "float32", "version": 3}
+    # Everything currently sits on `a` (the 1 -> 3 grow scenario)...
+    placement = {a: {n: dict(entry) for n in names}, b: {}, c: {}}
+    # ...except one tensor stuck frozen where it already belongs, and one
+    # name visible on two shards mid-handoff (higher version wins).
+    stuck = next(n for n in names if target.owner(n) == a)
+    placement[a][stuck]["state"] = "frozen"
+    dup = next(n for n in names if target.owner(n) == b)
+    placement[b][dup] = dict(entry, version=7)
+    plan = plan_reshard(placement, target)
+    assert (a, stuck) in plan.repairs
+    moved_names = {m.name for m in plan.moves}
+    assert dup not in moved_names, "highest-version holder already owns it"
+    # The superseded copy at `a` (a crash between Install and Retire
+    # strands exactly this) is planned as a stale retire toward the
+    # surviving holder.
+    assert (a, dup, b) in plan.stale
+    for m in plan.moves:
+        assert m.src == a and m.dst == target.owner(m.name)
+        assert m.nbytes == 256 * 4
+    expected = {n for n in names
+                if target.owner(n) != a and n != dup}
+    assert moved_names == expected, "plan must be exactly the owner diff"
+    assert plan.total_bytes == len(expected) * 256 * 4
+
+
+# ---------------------------------------------------------------------------
+# Native fleet tests.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.fleet import RegistryHub, clear_registry
+    from brpc_tpu.observability import health, metrics
+    dump_dir = tmp_path_factory.mktemp("fleet_dumps")
+    health.start_watchdog(str(dump_dir))
+    hub = RegistryHub()
+    hub.start()
+    yield {"hub": hub, "health": health, "metrics": metrics}
+    clear_registry()
+    hub.stop()
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after fleet tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def _mk_params(n, size=256, dtype=np.float32):
+    return {f"w{i:02d}": np.full((size,), float(i + 1), dtype)
+            for i in range(n)}
+
+
+def _fleet(env, tag, n_shards, ttl_s=2):
+    from brpc_tpu.fleet import FleetServer
+    shards = []
+    for i in range(n_shards):
+        s = FleetServer(env["hub"].hostport, tag=tag,
+                        shard_name=f"{tag}_s{i}", ttl_s=ttl_s)
+        s.start()
+        shards.append(s)
+    return shards
+
+
+def test_fleet_scatter_gather_matches_single_server(fleet_env):
+    """Sharded pull_all/push_all == the single-server result, versions
+    and values, across a 2-shard scatter."""
+    from brpc_tpu.fleet import FleetClient
+    from brpc_tpu.runtime.param_server import ParameterClient, ParameterServer
+
+    params = _mk_params(12)
+    grads = {k: np.full_like(v, 0.5) for k, v in params.items()}
+
+    single = ParameterServer(params)
+    single.start()
+    spc = ParameterClient(f"tpu://127.0.0.1:{single.port}")
+
+    shards = _fleet(fleet_env, "parity", 2)
+    fc = FleetClient(fleet_env["hub"].hostport, tag="parity",
+                     op_deadline_s=10.0)
+    try:
+        for k, v in params.items():
+            fc.install(k, v)
+        # Tensors really are spread across both shards.
+        placement = {m["shard"] for m in fc.meta().values()}
+        assert placement == {s.addr for s in shards}, placement
+
+        fleet_pull = fc.pull_all()
+        single_pull = spc.pull_all()
+        assert sorted(fleet_pull) == sorted(single_pull) == sorted(params)
+        for k in params:
+            assert fleet_pull[k][0] == single_pull[k][0] == 0
+            np.testing.assert_array_equal(np.asarray(fleet_pull[k][1]),
+                                          np.asarray(single_pull[k][1]))
+
+        fleet_vers = fc.push_all(grads)
+        single_vers = spc.push_all(grads)
+        assert fleet_vers == single_vers
+        after_fleet = fc.pull_all()
+        after_single = spc.pull_all()
+        for k in params:
+            np.testing.assert_allclose(np.asarray(after_fleet[k][1]),
+                                       np.asarray(after_single[k][1]))
+    finally:
+        fc.close()
+        spc.close()
+        for s in shards:
+            s.stop()
+        single.stop()
+
+
+def test_meta_cache_validates_by_epoch(fleet_env):
+    """Satellite: pull_all no longer pays a full Meta round trip per call
+    — the cache revalidates with one tiny Epoch RPC and refetches only on
+    a schema change."""
+    from brpc_tpu.runtime.param_server import ParameterClient, ParameterServer
+
+    ps = ParameterServer(_mk_params(4))
+    ps.start()
+    pc = ParameterClient(f"tpu://127.0.0.1:{ps.port}")
+    try:
+        first = pc.cached_meta()  # cold: full fetch
+        full_fetches = []
+        orig_meta = pc.meta
+        pc.meta = lambda: full_fetches.append(1) or orig_meta()
+        assert pc.cached_meta() is first  # warm: Epoch only
+        assert pc.pull_all() and not full_fetches
+        # Ordinary pushes bump versions, NOT the schema epoch.
+        pc.push_grad("w00", np.full((256,), 1.0, np.float32))
+        assert pc.cached_meta() is first and not full_fetches
+        # A schema change (Install) invalidates.
+        arr = np.zeros((256,), np.float32)
+        pc.install("fresh", np.stack([arr, arr]), version=0, commit=True)
+        refreshed = pc.cached_meta()
+        assert full_fetches and "fresh" in refreshed
+    finally:
+        pc.close()
+        ps.stop()
+
+
+def test_version_lag_gauges_and_tensorz_fleet_view(fleet_env):
+    """Satellite: per-server version-lag gauges exist beside the
+    process-wide one, and /tensorz shows the fleet section."""
+    from brpc_tpu.fleet import FleetClient, Migrator
+    obs = fleet_env["metrics"]
+
+    shards = _fleet(fleet_env, "lagview", 2)
+    fc = FleetClient(fleet_env["hub"].hostport, tag="lagview",
+                     op_deadline_s=10.0)
+    # Constructing the migrator is what publishes the migration-progress
+    # vars the /tensorz fleet section shows (no watcher needed here).
+    Migrator(fleet_env["hub"].hostport, tag="lagview")
+    try:
+        for k, v in _mk_params(6).items():
+            fc.install(k, v)
+        # Skew ONE tensor's version to open a spread on its shard (pick a
+        # name whose owner holds at least one OTHER tensor, so the spread
+        # is nonzero there).
+        meta = fc.meta()
+        by_shard = {}
+        for k, m in meta.items():
+            by_shard.setdefault(m["shard"], []).append(k)
+        owner, names_there = next((a, ns) for a, ns in by_shard.items()
+                                  if len(ns) > 1)
+        name = sorted(names_there)[0]
+        for _ in range(3):
+            fc.push_grad(name, np.full((256,), 0.25, np.float32))
+        lag = {s.addr: 0 for s in shards}
+        for line in obs.dump_vars("param_server_version_lag_").splitlines():
+            key, _, value = line.partition(" : ")
+            for i, s in enumerate(shards):
+                if key.strip() == f"param_server_version_lag_lagview_s{i}":
+                    lag[s.addr] = int(value.strip())
+        assert lag[owner] == 3, lag
+        assert all(v == 0 for a, v in lag.items() if a != owner), lag
+
+        port = fleet_env["hub"].port  # console handlers are process-global
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/tensorz", timeout=5).read().decode()
+        assert "fleet (shard map + migration" in page
+        assert "fleet_shards" in page and "fleet_migration_moving" in page
+        assert "param_server_version_lag_lagview_s0" in page
+    finally:
+        fc.close()
+        for s in shards:
+            s.stop()
+
+
+def test_live_reshard_under_load(fleet_env):
+    """THE acceptance loop: a shard joins under concurrent pull+push
+    traffic; the registry watch edge triggers the migration, every pull
+    stays untorn (all elements equal) with per-name versions never going
+    backwards, and the fleet converges with both shards serving."""
+    from brpc_tpu.fleet import FleetClient, FleetServer, Migrator
+
+    params = _mk_params(16, size=1024)
+    (s1,) = _fleet(fleet_env, "livemove", 1)
+    fc = FleetClient(fleet_env["hub"].hostport, tag="livemove",
+                     op_deadline_s=20.0)
+    mig = Migrator(fleet_env["hub"].hostport, tag="livemove",
+                   window=4).start()
+    for k, v in params.items():
+        fc.install(k, v)
+
+    stop = threading.Event()
+    errors = []
+    last_version = {}
+
+    def puller():
+        while not stop.is_set():
+            try:
+                got = fc.pull_all(sorted(params))
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(f"pull: {type(e).__name__}: {e}")
+                return
+            for k, (version, arr) in got.items():
+                host = np.asarray(arr)
+                if np.unique(host).size != 1:
+                    errors.append(f"TORN {k}@v{version}: "
+                                  f"{np.unique(host)[:4]}")
+                    return
+                if version < last_version.get(k, 0):
+                    errors.append(f"STALE {k}: v{version} after "
+                                  f"v{last_version[k]}")
+                    return
+                last_version[k] = version
+
+    def pusher():
+        i = 0
+        names = sorted(params)
+        while not stop.is_set():
+            name = names[i % len(names)]
+            try:
+                fc.push_grad(name,
+                             np.full((1024,), 0.125, np.float32))
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"push {name}: {type(e).__name__}: {e}")
+                return
+            i += 1
+
+    threads = [threading.Thread(target=puller, daemon=True),
+               threading.Thread(target=pusher, daemon=True)]
+    s2 = None
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # steady-state load on one shard first
+        s2 = FleetServer(fleet_env["hub"].hostport, tag="livemove",
+                         shard_name="livemove_s1", ttl_s=2)
+        s2.start()
+        joined = time.monotonic()
+        # The watch edge (not polling) must kick the reshard promptly.
+        while mig.reshards == 0 and time.monotonic() - joined < 8:
+            time.sleep(0.05)
+        assert mig.reshards >= 1, "watch event never triggered a reshard"
+        time.sleep(1.0)  # keep load running across the tail of the move
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:5]
+
+        # Converged: both shards serve, nothing is mid-migration, and the
+        # /tensorz progress vars say so.
+        final = fc.pull_all()
+        assert sorted(final) == sorted(params)
+        placement = {m["shard"] for m in fc.meta().values()}
+        assert placement == {s1.addr, s2.addr}, placement
+        obs = fleet_env["metrics"]
+        vars_txt = obs.dump_vars("fleet_")
+        moved = int([line for line in vars_txt.splitlines()
+                     if "fleet_migration_moved_total" in line][0]
+                    .rpartition(":")[2])
+        assert moved >= 1
+        for k, (version, arr) in final.items():
+            host = np.asarray(arr)
+            assert np.unique(host).size == 1, (k, version)
+        mig.stop()
+        fc.close()
+        s1.stop()
+        if s2 is not None:
+            s2.stop()
+
+
+def test_kill_shard_mid_pull_recovers(fleet_env):
+    """Abruptly killing a shard mid-pull_all: the watch registry prunes
+    it at TTL, surviving tensors keep pulling untorn, lost tensors report
+    missing FAST (not a hang — watchdog armed), and install() reseeds
+    them at the survivor."""
+    from brpc_tpu.fleet import FleetClient, Migrator
+    from brpc_tpu.runtime.param_server import ParameterClient
+
+    params = _mk_params(12)
+    shards = _fleet(fleet_env, "killmove", 2, ttl_s=2)
+    fc = FleetClient(fleet_env["hub"].hostport, tag="killmove",
+                     op_deadline_s=10.0)
+    mig = Migrator(fleet_env["hub"].hostport, tag="killmove",
+                   window=4).start()
+    victim, survivor = shards[1], shards[0]
+    try:
+        owners = {}
+        for k, v in params.items():
+            owners[k] = fc.install(k, v)
+        lost = {k for k, a in owners.items() if a == victim.addr}
+        kept = set(params) - lost
+        assert lost and kept, owners  # both shards own something
+
+        stop = threading.Event()
+        errors = []
+        observed = []
+
+        def puller():
+            while not stop.is_set():
+                try:
+                    got = fc.pull_all(sorted(params), on_missing="skip")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"pull: {type(e).__name__}: {e}")
+                    return
+                for k, (version, arr) in got.items():
+                    if np.unique(np.asarray(arr)).size != 1:
+                        errors.append(f"TORN {k}@v{version}")
+                        return
+                observed.append(set(got))
+
+        t = threading.Thread(target=puller, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        # CRASH, not a graceful leave: the server dies, the heartbeat
+        # thread dies with it, no deregister is sent.
+        victim._registration.stop(deregister_now=False)
+        victim.ps.stop()
+        # Recovery: within TTL + watch propagation the fleet serves the
+        # surviving set again (and nothing more).
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if observed and observed[-1] == kept and not errors:
+                break
+            time.sleep(0.2)
+        stop.set()
+        t.join(timeout=30)
+        assert not errors, errors[:5]
+        assert observed[-1] == kept, (observed[-1], kept)
+
+        # The trainer reseeds the lost tensors; the fleet is whole again,
+        # now entirely on the survivor.
+        for k in sorted(lost):
+            addr = fc.install(k, params[k])
+            assert addr == survivor.addr
+        full = fc.pull_all()
+        assert sorted(full) == sorted(params)
+        assert fleet_env["health"].state() != "stalled"
+    finally:
+        mig.stop()
+        fc.close()
+        for s in shards:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — victim already dead
+                pass

@@ -201,6 +201,9 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
     # The service-flag shape (handle + name + int toggle) of
     # tbrpc_server_set_inline.
     assert parsed["tbrpc_fix_set_inline"] == "int(void *, const char *, int)"
+    # The niladic entry-point shape of tbrpc_registry_install: an explicit
+    # (void) list normalises to the lock's "int()" spelling.
+    assert parsed["tbrpc_fix_registry_install"] == "int()"
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
