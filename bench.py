@@ -338,6 +338,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# param pipeline point skipped: {e}", file=sys.stderr)
 
+    # Quantized tensor wire rows: raw vs int8 pull_all/push_all with wire
+    # AND effective GB/s (the past-the-byte-ceiling metric, PERF round 9).
+    try:
+        sweep.update(param_quant_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# param quant point skipped: {e}", file=sys.stderr)
+
     # Sharded-fleet rows: aggregate pull_all GB/s at 1/2/4 shards (one
     # server process per shard) + the kill-a-shard recovery drive.
     try:
@@ -479,6 +486,144 @@ finally:
     except Exception:
         srv.kill()
 """
+
+
+# Quantized tensor wire rows: raw vs negotiated-int8 pull_all/push_all on
+# the SAME server, interleaved pairs (PERF methodology — adjacent samples
+# see the same host state, median of per-pair ratios). Reports BOTH wire
+# GB/s (bytes that crossed the transport / wall time) and effective GB/s
+# (logical tensor bytes / wall time) — the codec's whole point is that
+# the second exceeds the transport's byte ceiling. argv:
+#   n_tensors nbytes window reps pull_only(0/1)
+_QUANT_CHILD = r"""
+import json, statistics, sys, time, subprocess
+sys.path.insert(0, ROOT)
+import numpy as np
+
+n_tensors, nbytes, window, reps, pull_only = (int(a) for a in sys.argv[1:6])
+server_code = (
+    "import sys, json\n"
+    "sys.path.insert(0, %r)\n"
+    "import jax.numpy as jnp\n"
+    "from brpc_tpu.runtime.param_server import ParameterServer\n"
+    "import numpy as _np\n"
+    "rng = _np.random.default_rng(0)\n"
+    "params = {'w%%02d' %% i:\n"
+    "          jnp.asarray(rng.normal(size=(%d // 4,)).astype('float32'))\n"
+    "          for i in range(%d)}\n"
+    "ps = ParameterServer(params)\n"
+    "print(json.dumps({'port': ps.start()}), flush=True)\n"
+    "sys.stdin.readline()\n"
+    "ps.stop()\n" % (ROOT, nbytes, n_tensors))
+srv = subprocess.Popen([sys.executable, "-c", server_code],
+                       stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                       text=True)
+try:
+    port = json.loads(srv.stdout.readline())["port"]
+    from brpc_tpu.runtime import codec as codec_mod
+    from brpc_tpu.runtime.param_server import ParameterClient
+    raw = ParameterClient(f"tpu://127.0.0.1:{port}")
+    quant = ParameterClient(f"tpu://127.0.0.1:{port}", codec="int8")
+    assert quant.negotiated_codec() == "int8", "codec negotiation failed"
+    names = sorted(raw.meta())
+    rng = np.random.default_rng(1)
+    grads = {n: rng.normal(size=(nbytes // 4,)).astype(np.float32)
+             for n in names}
+    n_el = nbytes // 4
+    wire_per = -(-n_el // codec_mod.DEFAULT_BLOCK) * 4 + n_el  # scales+codes
+    # Warm both paths: channels, jax dispatch, the server's encode cache
+    # (quantize-once-serve-many — the steady state a parameter server
+    # actually runs in; the first quant pull pays the encode).
+    raw.pull_all(names, window=window)
+    quant.pull_all(names, window=window)
+    if not pull_only:
+        raw.push_all({names[0]: grads[names[0]]}, window=2)
+        quant.push_all({names[0]: grads[names[0]]}, window=2)
+        quant.pull_all(names[:2], window=2)  # re-warm encode cache post-push
+
+    def timed(fn, min_s=0.4):
+        # One sample = a >= min_s loop, not one call: a single pull_all is
+        # 10-40ms and this host's steal comes in windows of that same
+        # order, so single-shot pairs are coin flips — looping averages
+        # the steal duty cycle into every sample (same reason the echo
+        # samples run for a full second).
+        iters = 0
+        t0 = time.monotonic()
+        while True:
+            fn()
+            iters += 1
+            dt = time.monotonic() - t0
+            if dt >= min_s and iters >= 2:
+                return dt / iters
+
+    logical = n_tensors * nbytes
+    wire_q = n_tensors * wire_per
+    modes = [("pull", lambda: raw.pull_all(names, window=window),
+              lambda: quant.pull_all(names, window=window))]
+    if not pull_only:
+        modes.append(("push", lambda: raw.push_all(grads, window=window),
+                      lambda: quant.push_all(grads, window=window)))
+    rows = {}
+    for kind, raw_fn, quant_fn in modes:
+        tr_samples, tq_samples, ratios = [], [], []
+        for _ in range(reps):
+            tr = timed(raw_fn)
+            tq = timed(quant_fn)
+            tr_samples.append(tr)
+            tq_samples.append(tq)
+            ratios.append(tr / tq)
+        tr = statistics.median(tr_samples)
+        tq = statistics.median(tq_samples)
+        rows[kind] = {
+            "raw_ms": round(tr * 1e3, 1),
+            "quant_ms": round(tq * 1e3, 1),
+            "raw_gbps": round(logical / tr / 1e9, 2),
+            "quant_eff_gbps": round(logical / tq / 1e9, 2),
+            "quant_wire_gbps": round(wire_q / tq / 1e9, 2),
+            "wire_ratio": round(logical / wire_q, 2),
+            "speedup": round(statistics.median(ratios), 2),
+            "speedup_samples": [round(r, 2) for r in ratios],
+            "codec": "int8", "window": window, "tensors": n_tensors,
+            "reps": reps,
+        }
+    raw.close()
+    quant.close()
+    print(json.dumps(rows))
+finally:
+    try:
+        srv.stdin.close()
+        srv.wait(timeout=10)
+    except Exception:
+        srv.kill()
+"""
+
+
+def param_quant_point(n_tensors=32, nbytes=1 << 20, window=8, reps=7,
+                      pull_only=False, timeout=300):
+    """Quantized-wire vs raw parameter traffic — the tensor-codec
+    tentpole rows (param_pull_all_quant_* / param_push_all_quant_*).
+    Same interleaved-pair methodology as param_pipeline_point; the
+    headline number is effective GB/s = logical bytes / wall time."""
+    code = "ROOT = %r\n%s" % (
+        os.path.dirname(os.path.abspath(__file__)), _QUANT_CHILD)
+    proc = subprocess.run(  # tpulint: allow(py-blocking)
+        [sys.executable, "-c", code, str(n_tensors), str(nbytes),
+         str(window), str(reps), "1" if pull_only else "0"],
+        capture_output=True, timeout=timeout, text=True)
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(f"param quant child failed rc={proc.returncode}")
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    size_mb = nbytes >> 20
+    out = {}
+    for kind, row in rows.items():
+        key = f"param_{kind}_all_quant_{n_tensors}x{size_mb}MB"
+        out[key] = row
+        print(f"# {key}: raw {row['raw_gbps']} GB/s -> int8 effective "
+              f"{row['quant_eff_gbps']} GB/s (wire {row['quant_wire_gbps']}"
+              f" GB/s, {row['speedup']}x, samples {row['speedup_samples']})",
+              file=sys.stderr)
+    return out
 
 
 def param_pipeline_point(n_tensors=32, nbytes=1 << 20, window=8, reps=7,
@@ -670,6 +815,14 @@ def smoke() -> None:
                                         pull_only=True, timeout=90))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["param_pull_all_4x1MB"] = {"error": str(e)}
+    # Guarded quant row: one raw-vs-int8 pull pair — if negotiation or the
+    # codec path breaks (or the effective-bandwidth win evaporates), the
+    # smoke run shows it before the full sweep would.
+    try:
+        out.update(param_quant_point(n_tensors=4, window=4, reps=1,
+                                     pull_only=True, timeout=120))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["param_pull_all_quant_4x1MB"] = {"error": str(e)}
     # Guarded 2-shard fleet row: a quick 1-vs-2-shard aggregate pull pair
     # — if scatter/gather stops scaling (or the fleet path breaks), the
     # smoke run shows it before the full sweep would.

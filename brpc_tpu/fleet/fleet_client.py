@@ -40,9 +40,10 @@ from brpc_tpu.fleet import gauges, registry
 from brpc_tpu.fleet.shard_map import ShardMap
 from brpc_tpu.runtime import native
 from brpc_tpu.runtime.param_server import (E_MIGRATING, E_MOVED, E_NO_SUCH,
-                                           ParameterClient, moved_dest)
-from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
-                                     _decode_meta, _metrics)
+                                           ParameterClient,
+                                           PartialPullError,
+                                           PartialPushError, moved_dest)
+from brpc_tpu.runtime.tensor import TensorArena
 
 
 def _pull_group_host(pc: ParameterClient, names: List[str],
@@ -53,28 +54,13 @@ def _pull_group_host(pc: ParameterClient, names: List[str],
     `jax.device_put` dispatch is effectively serialized by the JAX runtime
     — concurrent per-tensor dispatch from N threads CONTENDS instead of
     scaling (measured 2.5x slower at 2 shards than one thread's worth of
-    work). So shard threads stop at a detached host copy (np.array of the
-    zero-copy view: a GIL-releasing memcpy that scales with threads) and
-    the caller's thread does the device dispatch alone. On the CPU
-    backend the later device_put zero-copy-aliases the detached buffer,
-    so nothing is copied twice; on accelerators the H2D DMA reads from
-    the detached copy instead of the arena pages — one staging copy,
-    bought deliberately to keep the N-shard wire path parallel."""
-    out: Dict[str, tuple] = {}
-    m = _metrics()
-
-    def on_reply(name, payload, view):
-        with view:
-            dtype, shape, rest = _decode_meta(payload)
-            host = np.array(np.frombuffer(view.ndarray(),
-                                          dtype=dtype).reshape(shape))
-            m["pull_bytes"].add(view.nbytes)
-        out[name] = (int(rest.decode()), host)
-
-    with PipelineWindow(pc.channel, window, on_reply=on_reply) as win:
-        for name in names:
-            win.submit("ParamService/Pull", request=name.encode(), tag=name)
-    return out
+    work). So shard threads stop at a detached host copy and the caller's
+    thread does the device dispatch alone; `ParameterClient.pull_all`'s
+    ``to_host=True`` mode implements exactly that (and with it the shard
+    stream inherits the whole codec story: per-shard negotiation, grouped
+    PullQ RPCs when quantized, the raw byte-identical path when not — one
+    decode path, so fleet and single-server cannot drift)."""
+    return pc.pull_all(names, window=window, to_host=True)
 
 
 class FleetClient:
@@ -83,7 +69,8 @@ class FleetClient:
     def __init__(self, registry_hostport: str, tag: str = "param",
                  window: int = 4, arena_bytes: int = 64 << 20,
                  device=None, op_deadline_s: float = 15.0,
-                 overrides: Optional[Dict[str, str]] = None):
+                 overrides: Optional[Dict[str, str]] = None,
+                 codec: Optional[str] = None):
         self._registry = registry_hostport
         self._tag = tag
         self.window = window
@@ -91,6 +78,12 @@ class FleetClient:
         self._device = device
         self._deadline_s = op_deadline_s
         self._overrides = dict(overrides or {})
+        # Quantized tensor wire: negotiated PER SHARD STREAM — each
+        # shard's ParameterClient checks its own server's Meta
+        # advertisement, so a mixed fleet (some shards codec-enabled,
+        # some not) serves each stream in the best format that shard
+        # speaks, raw included.
+        self._codec = codec
         self._mu = threading.Lock()
         self._clients: Dict[str, ParameterClient] = {}
         self._map: Optional[ShardMap] = None
@@ -135,6 +128,20 @@ class FleetClient:
                 live |= set(self._prev_map.shards)
             for addr in [a for a in self._clients if a not in live]:
                 self._clients.pop(addr).close()
+            # Reshard edge: drop error-feedback residuals for names a
+            # surviving shard client no longer owns — they are
+            # full-gradient-sized fp32 buffers, and without this hook N
+            # reshards leave every shard client holding residuals
+            # approaching the full parameter set. An in-flight push may
+            # re-settle a just-moved name once; the next edge prunes it.
+            cur = self._map
+            for addr, pc in self._clients.items():
+                def _still_ours(n, a=addr):
+                    try:
+                        return cur.owner(n) == a
+                    except LookupError:
+                        return False
+                pc.prune_residuals(_still_ours)
 
     @property
     def map(self) -> ShardMap:
@@ -148,7 +155,8 @@ class FleetClient:
             pc = self._clients.get(addr)
             if pc is None:
                 pc = ParameterClient(f"tpu://{addr}",
-                                     TensorArena(self._arena_bytes))
+                                     TensorArena(self._arena_bytes),
+                                     codec=self._codec)
                 self._clients[addr] = pc
             return pc
 
@@ -308,6 +316,13 @@ class FleetClient:
         def pull_group(addr: str, group: List[str]) -> List[str]:
             try:
                 got = _pull_group_host(self._client(addr), group, win)
+            except PartialPullError as e:
+                # The shard delivered the groupmates before a per-name
+                # miss (mid-reshard move): keep them, re-route ONLY the
+                # stragglers — never pay a second full group RPC.
+                with res_mu:
+                    hosts.update(e.partial)
+                return list(e.missing)
             except (native.RpcError, OSError, RuntimeError):
                 return group  # salvage path re-routes the whole group
             with res_mu:
@@ -349,8 +364,17 @@ class FleetClient:
             try:
                 got = self._client(addr).push_all(
                     {n: grads[n] for n in group}, window=win)
+            except PartialPushError as e:
+                # The shard APPLIED the groupmates before a per-name
+                # failure: keep their versions and re-route ONLY the
+                # unconfirmed names — a whole-group retry would apply
+                # the confirmed gradients a second time (double
+                # momentum step), which no amount of retrying undoes.
+                with res_mu:
+                    versions.update(e.applied)
+                return list(e.unpushed)
             except (native.RpcError, OSError, RuntimeError):
-                return group
+                return group  # nothing confirmed: whole group re-routes
             with res_mu:
                 versions.update(got)
             return []

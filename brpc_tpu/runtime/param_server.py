@@ -25,15 +25,19 @@ import re
 import threading
 import time
 import weakref
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
 
 from brpc_tpu.ops.fused_update import fused_momentum_update
+from brpc_tpu.runtime import codec as codec_mod
 from brpc_tpu.runtime import native
-from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
-                                     TensorChannel, _device_put_from_view,
+from brpc_tpu.runtime.tensor import (E_UNDECODABLE, PipelineWindow,
+                                     TensorArena, TensorChannel, WireTensor,
+                                     _dequant_widen,
+                                     _detach_device_put_batch,
+                                     _device_put_from_view,
                                      add_tensor_service)
 
 # App-level error codes, disjoint from trpc/errno.h. The server
@@ -47,6 +51,12 @@ E_NO_SUCH = 2040
 E_MOVED = 2041
 E_MIGRATING = 2042
 E_EXISTS = 2043  # install over a live (serving) parameter
+# E_UNDECODABLE = 2044 lives in tensor.py (the raise site is the typed-send
+# trampoline); it completes this 2040+ app-code range.
+
+# trpc/errno.h transport code a handler bug surfaces as — what a PRE-codec
+# server answers to a quantized push (see _codec_push_failed).
+TRPC_EINTERNAL = 2004
 
 _MOVED_RE = re.compile(r"moved:(\S+)")
 
@@ -57,6 +67,43 @@ def moved_dest(err: "native.RpcError") -> Optional[str]:
         return None
     m = _MOVED_RE.search(err.text or "")
     return m.group(1) if m else None
+
+
+class PartialPullError(native.RpcError):
+    """A ``pull_all`` that delivered SOME tensors before a per-name
+    failure: ``partial`` holds the decoded ``{name: (version, value)}``,
+    ``missing`` the names not delivered (the failed name plus anything
+    the aborted window never drained). Raised instead of discarding the
+    survivors so the fleet's salvage path re-routes ONLY the stragglers
+    — mid-reshard, one moved tensor must not cost its groupmates a
+    second full group RPC. Catches as a plain RpcError (same code/text
+    as the first failure) for callers that don't care."""
+
+    def __init__(self, cause: "native.RpcError",
+                 partial: Dict[str, tuple], missing: List[str]):
+        super().__init__(cause.code, cause.text)
+        self.partial = partial
+        self.missing = missing
+
+
+class PartialPushError(native.RpcError):
+    """A ``push_all`` that APPLIED some gradients before a per-name
+    failure: ``applied`` holds the confirmed ``{name: new_version}``,
+    ``unpushed`` the names with no confirmed apply (the failed name plus
+    anything the aborted window never drained — those MAY have landed
+    server-side with the reply lost, the usual retry ambiguity). Raised
+    instead of discarding the confirmed versions: re-pushing a gradient
+    the server already applied is not idempotent (a second momentum step
+    and version bump corrupt training state), so the fleet's salvage
+    path must re-route ONLY the unconfirmed names. Catches as a plain
+    RpcError (same code/text as the first failure) for callers that
+    don't care."""
+
+    def __init__(self, cause: "native.RpcError",
+                 applied: Dict[str, int], unpushed: List[str]):
+        super().__init__(cause.code, cause.text)
+        self.applied = applied
+        self.unpushed = unpushed
 
 
 # Process-wide recorders (brpc_tpu/observability): every ParameterServer
@@ -85,6 +132,10 @@ def _metrics():
             # the tensor_handler recorder carries that full server-side
             # cost; the client's tensor_pull carries the end-to-end view.
             "pull": obs.latency("param_server_pull"),
+            # PullQ groups up to _GROUP tensors per sample — a separate
+            # recorder, or quant traffic would read as ~8x slower/rarer
+            # pulls beside the per-tensor path.
+            "pull_group": obs.latency("param_server_pull_group"),
             "push": obs.latency("param_server_push"),
             "push_bytes": obs.counter("param_server_push_bytes"),
             "lag": obs.gauge("param_server_version_lag", _max_version_lag),
@@ -133,7 +184,7 @@ class ParameterServer:
 
     def __init__(self, params: Dict[str, jax.Array], lr: float = 0.01,
                  momentum: float = 0.9, arena: Optional[TensorArena] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, codecs=None):
         # Backend split for the Push hot path. On TPU the update is the
         # fused Pallas kernel over device arrays (device_put = a real H2D
         # DMA). On the CPU backend that same shape is all dispatch
@@ -186,6 +237,22 @@ class ParameterServer:
         self._state: Dict[str, str] = {}        # absent == "serving"
         self._handoff_dest: Dict[str, str] = {}  # frozen name -> dest addr
         self._moved: Dict[str, str] = {}         # retired name -> dest addr
+        # ---- quantized tensor wire (brpc_tpu/runtime/codec.py) ----
+        # Codecs this server will encode pulls with / decode pushes from,
+        # advertised in Meta (the per-peer negotiation); codecs=() turns
+        # the feature off entirely (every call rides raw).
+        # Advertising a codec this build cannot decode (e.g. fp8e4m3
+        # without ml_dtypes) would let a client negotiate pushes the
+        # server then cannot parse — intersect, caller order kept.
+        self._codecs = tuple(codec_mod.supported_codecs() if codecs is None
+                             else (c for c in codecs
+                                   if c in codec_mod.supported_codecs()))
+        # Quantize-once-serve-many: Pull responses are encoded per
+        # (version, codec) and cached until the next update replaces them
+        # — name -> {codec: (version, meta, wire uint8 array, logical)}.
+        # Holds ~1/4 of the fp32 parameter bytes per codec in use when
+        # clients pull quantized; invalidation pops the whole name.
+        self._enc_cache: Dict[str, Dict[str, tuple]] = {}
         self.name = name
         if name is not None:
             _per_server_lag_gauge(name, self)
@@ -222,13 +289,16 @@ class ParameterServer:
                         entry["state"] = state  # repair pass reads this
                     meta[k] = entry
                 epoch = self._schema_epoch
-            return json.dumps({"epoch": epoch, "params": meta}).encode(), None
+            return json.dumps({"epoch": epoch, "params": meta,
+                               "codecs": list(self._codecs)}).encode(), None
         if method == "Epoch":
             # The Meta-cache validator: a tiny small-RPC-fast-path answer
             # (schema epoch only) instead of the full Meta payload.
             with self._mu:
                 epoch = self._schema_epoch
             return json.dumps({"epoch": epoch}).encode(), None
+        if method == "PullQ":
+            return self._handle_pull_group(request)
         if method == "Handoff":
             return self._handle_handoff(request)
         if method == "Install":
@@ -237,7 +307,12 @@ class ParameterServer:
             return self._handle_retire(request)
         if method == "Commit":
             return self._handle_commit(request)
-        name = request.decode()
+        # Per-call codec negotiation marker: "<name>\x00<codec>" — only
+        # sent by clients that saw this codec in our Meta advertisement,
+        # so a plain name (every pre-codec client) parses unchanged.
+        name_b, _, want_b = request.partition(b"\x00")
+        name = name_b.decode()
+        want = want_b.decode()
         with self._mu:
             known = name in self._params
             dest = self._moved.get(name)
@@ -256,7 +331,10 @@ class ParameterServer:
                             E_MOVED, f"parameter {name} moved:{moved}")
                     raise native.RpcError(E_NO_SUCH,
                                           f"no such parameter: {name}")
-                out = str(self._version[name]).encode(), self._params[name]
+                p = self._params[name]
+                version = self._version[name]
+            out = str(version).encode(), self._encode_pull(name, p, version,
+                                                           want)
             self._m["pull"].record_s(time.monotonic() - t0)
             return out
         if method == "Push":
@@ -272,6 +350,129 @@ class ParameterServer:
             self._m["push_bytes"].add(att.nbytes)
             return str(version).encode(), None
         raise native.RpcError(E_NO_SUCH, f"no such method: {method}")
+
+    # ---- quantized pull encode (quantize once, serve many) ----
+
+    def _encoded_entry(self, name: str, p, version: int, want: str):
+        """-> (meta_dict, flat uint8 wire bytes) for one pull response
+        tensor: the block-quantized codes when the caller negotiated a
+        codec this server enables AND the tensor is eligible (fp32, above
+        the size floor), else the raw bytes (meta carries no codec key —
+        the per-call degrade). Quantized entries are encoded once per
+        (version, codec) and cached PER CODEC until the next update
+        replaces them — mixed int8/fp8 clients each get their own slot
+        instead of thrashing one (a parameter server serves many more
+        pulls than it takes pushes, so the quantize cost amortizes to
+        ~zero; the 4x-smaller response staging is pure win)."""
+        # eligible() reads dtype/nbytes only — an ineligible tensor from
+        # a negotiated client skips straight to raw with NO host
+        # materialization here (the trampoline's place() does the one
+        # D2H the response needs).
+        if want and want in self._codecs and codec_mod.eligible(p):
+            with self._mu:
+                ent = self._enc_cache.get(name, {}).get(want)
+            if ent is None or ent[0] != version:
+                host = np.asarray(p)  # one D2H on the device path
+                enc = codec_mod.encode(host, want)
+                if enc is None:
+                    ent = None  # defensive: eligible() said yes above
+                else:
+                    meta = {"dtype": host.dtype.str,
+                            "shape": list(host.shape),
+                            "codec": want, "block": enc.block}
+                    ent = (version, meta, enc.wire, int(host.nbytes))
+                    with self._mu:
+                        # Re-check under _mu: a concurrent Retire may
+                        # have popped the name (params AND cache) while
+                        # we encoded lock-free — inserting now would
+                        # strand the wire bytes until a re-install.
+                        # Still SERVE this response (the snapshot `p`
+                        # predates the retire, matching single-Pull
+                        # semantics); just don't cache it.
+                        if name in self._params:
+                            self._enc_cache.setdefault(name, {})[want] = ent
+            if ent is not None:
+                codec_mod.note(name, want, ent[3], int(ent[2].nbytes))
+                return ent[1], ent[2]
+        host = np.asarray(p)
+        return ({"dtype": host.dtype.str, "shape": list(host.shape)},
+                np.ascontiguousarray(host).reshape(-1).view(np.uint8))
+
+    def _encode_pull(self, name: str, p, version: int, want: str):
+        """The single-Pull response tensor: the array itself (raw — the
+        trampoline stages it with the legacy header, byte-identical to
+        the pre-codec wire) or the cached quantized bytes as a
+        WireTensor."""
+        if (not want or want not in self._codecs
+                or not codec_mod.eligible(p)):
+            return p  # raw: the trampoline's place() is the only D2H
+        meta, data = self._encoded_entry(name, p, version, want)
+        if "codec" not in meta:
+            return p  # ineligible: identical to the never-negotiated path
+        return WireTensor(data, codec_mod.pack_header(meta))
+
+    def _handle_pull_group(self, request: bytes):
+        """PullQ: one RPC carrying MANY pull responses — the quantized
+        wire's second lever. Once the codec cuts a 1MB tensor to ~0.26MB,
+        the per-RPC fixed cost (dispatch, handler hop, response staging
+        bookkeeping) dominates a per-tensor pull stream, so the client
+        groups pulls and this handler concatenates the encoded tensors
+        into ONE attachment behind a JSON manifest. (Raw pulls stay
+        per-tensor: at 4 logical bytes per wire byte they are transport-
+        bound, and grouping would buy nothing — measured in PERF r9.)
+
+        Per-name misses ride the manifest as {"name", "code", "error"}
+        entries instead of failing the group: mid-reshard a single moved
+        tensor must not poison its groupmates; the client re-routes the
+        stragglers through the per-tensor retry path.
+        """
+        t0 = time.monotonic()
+        req = json.loads(request.decode())
+        want = req.get("codec", "")
+        entries, blobs, total = [], [], 0
+        for name in req["names"]:
+            with self._mu:
+                known = name in self._params
+                moved = self._moved.get(name)
+                if known:
+                    p = self._params[name]
+                    version = self._version[name]
+            if not known:
+                entries.append({
+                    "name": name,
+                    "code": E_MOVED if moved else E_NO_SUCH,
+                    "error": (f"parameter {name} moved:{moved}" if moved
+                              else f"no such parameter: {name}")})
+                continue
+            meta, data = self._encoded_entry(name, p, version, want)
+            e = dict(meta)
+            e["name"] = name
+            e["version"] = version
+            e["nbytes"] = int(data.nbytes)
+            entries.append(e)
+            blobs.append(data)
+            total += int(data.nbytes)
+        # Write each encoded tensor straight into the service arena (the
+        # writes ARE the staging transfer) and hand the trampoline the
+        # pre-placed range — a concat buffer here would be memcpy'd into
+        # the arena AGAIN by place(), one redundant full-payload copy per
+        # group on the hot quantized pull path.
+        placed = (0, 0)  # all-miss group: manifest only, no attachment
+        if total:
+            arena_off = self.arena.alloc(total)
+            try:
+                view = self.arena.view(arena_off, total)
+                off = 0
+                for b in blobs:
+                    view[off:off + b.nbytes] = b.reshape(-1)
+                    off += b.nbytes
+            except BaseException:
+                self.arena.free(arena_off)
+                raise
+            placed = (arena_off, total)
+        self._m["pull_group"].record_s(time.monotonic() - t0)
+        return (json.dumps({"tensors": entries}).encode(),
+                WireTensor(None, b"", placed=placed))
 
     # ---- live-resharding handshake (driven by brpc_tpu/fleet.Migrator) ----
 
@@ -348,6 +549,7 @@ class ParameterServer:
             self._params[name] = param
             self._momenta[name] = mom
             self._version[name] = version
+            self._enc_cache.pop(name, None)  # encoded for the old bytes
             self._update_locks.setdefault(name, threading.Lock())
             self._state[name] = "pending"
             self._moved.pop(name, None)  # keys can migrate back later
@@ -370,6 +572,7 @@ class ParameterServer:
                     self._params.pop(name, None)
                     self._momenta.pop(name, None)
                     self._version.pop(name, None)
+                    self._enc_cache.pop(name, None)
                     self._update_locks.pop(name, None)
                     self._state.pop(name, None)
                     self._handoff_dest.pop(name, None)
@@ -407,7 +610,23 @@ class ParameterServer:
         return b"ok", None
 
     def _apply_update(self, name: str, att, tracing) -> int:
-        if self._on_device:
+        if isinstance(att, codec_mod.QuantizedView):
+            # Quantized gradient push: account the wire win, then either
+            # dequantize on-device (H2D moves the ~4x smaller codes, the
+            # Pallas/jnp kernel widens there) or into a fresh host buffer
+            # (which IS the detach the CPU path needs anyway).
+            codec_mod.note(name, att.codec, att.nbytes, att.wire_nbytes)
+            if self._on_device:
+                with tracing.stage("device_put"):
+                    q_dev, s_dev = _detach_device_put_batch(
+                        [(att.q, att.scales)], None)
+                with tracing.stage("dequant"):
+                    grad = _dequant_widen(q_dev, s_dev, att.block, att.n,
+                                          att.shape)
+            else:
+                with tracing.stage("dequant"):
+                    att = att.dequantize()
+        elif self._on_device:
             with tracing.stage("device_put"):
                 # H2D DMA from the request view, completed (and thus
                 # detached from the arena pages) before the handler
@@ -469,9 +688,18 @@ class ParameterServer:
 
 class ParameterClient:
     """Pulls params into device arrays / pushes device grads, all over the
-    framework (one TensorChannel per client)."""
+    framework (one TensorChannel per client).
 
-    def __init__(self, addr: str, arena: Optional[TensorArena] = None):
+    ``codec="int8"`` (or ``"fp8e4m3"``) asks for the quantized tensor
+    wire format (brpc_tpu/runtime/codec.py): engaged per call only after
+    the server advertises the codec in Meta — against an older or
+    codec-disabled server everything rides raw, transparently. Pulls
+    request quantized responses; pushes quantize gradients with
+    error-feedback accumulators (the residual of push k rides along with
+    push k+1, so repeated pushes never compound rounding bias)."""
+
+    def __init__(self, addr: str, arena: Optional[TensorArena] = None,
+                 codec: Optional[str] = None):
         self.addr = addr
         self.channel = TensorChannel(addr, arena)
         # Meta cache keyed by the server's schema epoch: the epoch bumps
@@ -480,12 +708,16 @@ class ParameterClient:
         # Cached VERSIONS are stale by design — versions ride each pull.
         self._meta_epoch: Optional[int] = None
         self._meta_cache: Optional[dict] = None
+        self._codec = codec
+        self._srv_codecs: Optional[tuple] = None  # unknown until Meta
+        self._ef = codec_mod.ErrorFeedback()
 
     def meta(self) -> dict:
         payload, _ = self.channel.call("ParamService/Meta")
         doc = json.loads(payload.decode())
         self._meta_epoch = doc["epoch"]
         self._meta_cache = doc["params"]
+        self._srv_codecs = tuple(doc.get("codecs", ()))
         return doc["params"]
 
     def epoch(self) -> int:
@@ -501,17 +733,149 @@ class ParameterClient:
             return self._meta_cache
         return self.meta()
 
+    # ---- per-call codec negotiation (quantized tensor wire) ----
+
+    def negotiated_codec(self) -> Optional[str]:
+        """The codec this client/server pair agreed on, or None (raw).
+        The advertisement is fetched on first use (one Meta RPC) and
+        then trusted for the client's lifetime — NOT revalidated per
+        call (this runs per pull/push). Pulls from any codec-aware
+        server are safe regardless of restarts (decode follows the
+        response's self-describing header); the stale-advertisement
+        failure modes all self-heal: a push the server can no longer
+        decode answers E_UNDECODABLE (_codec_push_failed drops the
+        advertisement), a push to a PRE-codec rollback dies
+        TRPC_EINTERNAL (_codec_push_failed re-reads the advertisement
+        and heals only when the codec is gone), and a pull a PRE-codec
+        rollback reads as an unknown name/method dies E_NO_SUCH
+        (_codec_pull_failed re-reads the advertisement and retries raw
+        when it changed)."""
+        if self._codec is None:
+            return None
+        if self._srv_codecs is None:
+            # Full Meta fetch, NOT cached_meta(): after an invalidation
+            # the schema epoch usually still matches (restarted servers
+            # reuse epochs), and the epoch-hit path returns the cached
+            # map without repopulating the advertisement — renegotiation
+            # must actually re-read it.
+            self.meta()
+        return codec_mod.choose(self._codec, self._srv_codecs)
+
+    def _codec_push_failed(self, e: "native.RpcError") -> None:
+        """Self-heal a stale codec advertisement: a server restarted
+        without our negotiated codec (build lost ml_dtypes, operator
+        set codecs=()) cannot decode our quantized pushes."""
+        if e.code == E_UNDECODABLE:
+            self._srv_codecs = None  # renegotiate on the next call
+            return
+        if e.code != TRPC_EINTERNAL or self.negotiated_codec() is None:
+            return
+        # A PRE-codec build has no E_UNDECODABLE answer: its trampoline
+        # hands the handler the flat quantized bytes, whose shape
+        # mismatch dies in the update math as a generic internal error.
+        # Mirror _codec_pull_failed: re-read the advertisement ONCE — a
+        # rollback no longer carries our codec (heal; the next push
+        # rides raw), while a genuine handler bug re-advertises the same
+        # codec and keeps both its error and the negotiation, costing
+        # one Meta RPC on an already-failing path.
+        self._srv_codecs = None
+        try:
+            self.meta()
+        except Exception:  # noqa: BLE001 — keep the original error
+            pass
+
+    def _codec_pull_failed(self, e: "native.RpcError") -> bool:
+        """A NEGOTIATED pull that died E_NO_SUCH may mean the server was
+        rolled back to a pre-codec build: such a server reads the
+        "name\\x00codec" marker as part of an unknown parameter name, and
+        has no PullQ method at all — every pull wedges as "no such"
+        although raw would work. Re-read the advertisement ONCE: if it no
+        longer carries our codec, renegotiation happened and the caller
+        should retry (now raw). A genuine miss re-advertises the same
+        codec, so misses cost one extra Meta RPC and keep their error —
+        success paths pay nothing."""
+        if e.code != E_NO_SUCH or self.negotiated_codec() is None:
+            return False
+        self._srv_codecs = None
+        try:
+            self.meta()
+        except Exception:  # noqa: BLE001 — keep the original error
+            return False
+        return self.negotiated_codec() is None
+
+    def prune_residuals(self, keep) -> int:
+        """Drop error-feedback residuals for names failing ``keep(name)``.
+        Fleet reshard hook: once a name's ownership moves to another
+        shard this client never pushes it again, and its residual (a
+        full-gradient-sized fp32 buffer) would otherwise live for the
+        client's lifetime. Dropping one costs at most a single quant
+        step of accuracy on a stream that has already ended."""
+        return self._ef.prune(keep)
+
+    def _pull_request(self, name: str) -> bytes:
+        """Pull request bytes: the bare name (byte-identical to the
+        pre-codec wire) unless a codec is negotiated — then the per-call
+        marker the server's Pull parses. Also used by the fleet's shard
+        streams, so single-server and fleet negotiation cannot drift."""
+        c = self.negotiated_codec()
+        return name.encode() + (b"\x00" + c.encode() if c else b"")
+
+    def _grad_encoder(self, name: str):
+        """The per-tensor PipelineWindow/push_device encoder closure for
+        a quantized gradient push (None when riding raw): compensates
+        with the error-feedback residual, quantizes at arena-stage time,
+        settles the new residual."""
+        c = self.negotiated_codec()
+        if c is None:
+            # Raw stream: nothing will be owed, and a residual left by
+            # an EARLIER quantized push (stream degraded after an
+            # E_UNDECODABLE self-heal) is a full-gradient-sized fp32
+            # buffer that would otherwise strand for the client's
+            # lifetime. Dropping it costs at most one quant step on a
+            # stream that has ended.
+            self._ef.clear(name)
+            return None
+
+        def enc(host: np.ndarray):
+            if not codec_mod.eligible(host):
+                self._ef.clear(name)  # nothing quantized, nothing owed
+                return None
+            x = self._ef.compensate(name, host)
+            e = codec_mod.encode(x, c)
+            if e is None:
+                self._ef.clear(name)
+                return None
+            self._ef.settle(name, x, e.dequantized())
+            codec_mod.note(name, c, e.logical_bytes, e.wire_bytes)
+            return e.wire, e.header
+
+        return enc
+
     def pull(self, name: str, device=None):
         """-> (version, jax.Array) — H2D straight from the shared pages."""
-        rest, arr = self.channel.pull_device("ParamService/Pull",
-                                             request=name.encode(),
-                                             device=device)
+        try:
+            rest, arr = self.channel.pull_device(
+                "ParamService/Pull", request=self._pull_request(name),
+                device=device, note_name=name)
+        except native.RpcError as e:
+            if not self._codec_pull_failed(e):
+                raise
+            # Renegotiated (server rolled back to a pre-codec build):
+            # the marker-less request is byte-identical to the old wire.
+            rest, arr = self.channel.pull_device(
+                "ParamService/Pull", request=self._pull_request(name),
+                device=device)
         return int(rest.decode()), arr
 
     def push_grad(self, name: str, grad) -> int:
         """Send a device gradient; returns the server's new version."""
-        payload = self.channel.push_device("ParamService/Push", grad,
-                                           request=name.encode())
+        try:
+            payload = self.channel.push_device(
+                "ParamService/Push", grad, request=name.encode(),
+                encoder=self._grad_encoder(name))
+        except native.RpcError as e:
+            self._codec_push_failed(e)
+            raise
         return int(payload.decode())
 
     # ---- live-resharding handshake (used by brpc_tpu/fleet.Migrator) ----
@@ -548,32 +912,248 @@ class ParameterClient:
     # bounded window of RPCs in flight instead, so N tensors cost ~1
     # round-trip plus N wire times.
 
-    def pull_all(self, names=None, device=None, window: int = 4
-                 ) -> Dict[str, tuple]:
+    def pull_all(self, names=None, device=None, window: int = 4,
+                 group: int = 8, to_host: bool = False) -> Dict[str, tuple]:
         """Pull many parameters through one bounded pipeline window.
 
-        -> ``{name: (version, jax.Array)}``. Every tensor is
-        ``jax.device_put`` STRAIGHT from its zero-copy response view (the
-        peer's arena pages) — no intermediate host copy — overlapped with
-        the wire transfer of the next tensor. ``names=None`` pulls every
-        parameter the server's Meta lists.
-        """
-        from brpc_tpu.runtime.tensor import _metrics, consume_pull_reply
+        -> ``{name: (version, jax.Array)}``. ``names=None`` pulls every
+        parameter the server's Meta lists. ``to_host=True`` returns
+        DETACHED host ndarrays instead of device arrays (the fleet's
+        shard streams use this: device dispatch from N threads contends,
+        so shards stop at host copies and the caller dispatches alone).
 
+        Raw (no negotiated codec): one RPC per tensor, each
+        ``jax.device_put`` straight from its zero-copy response view —
+        byte-identical to the pre-codec wire. Negotiated codec: pulls ride
+        ``PullQ`` in groups of ``group`` tensors per RPC — the codec cuts
+        each tensor ~4x, which leaves the per-RPC fixed cost dominating a
+        per-tensor stream, so grouping is where the second half of the
+        effective-bandwidth win comes from (PERF round 9).
+        """
+        from brpc_tpu.runtime.tensor import (_decode_meta_ex, _metrics,
+                                             _stage, consume_pull_reply)
+
+        listed_meta = None
         if names is None:
-            names = sorted(self.cached_meta())
+            listed_meta = self.cached_meta()
+            names = sorted(listed_meta)
+        names = list(names)
         m = _metrics()
         out: Dict[str, tuple] = {}
+        c = self.negotiated_codec()
 
-        def on_reply(name, payload, view):
-            rest, dev, nbytes = consume_pull_reply(payload, view, device)
+        if c is None:
+            if to_host:
+                def on_reply(name, payload, view):
+                    with view:
+                        meta, rest = _decode_meta_ex(payload)
+                        host = np.array(np.frombuffer(
+                            view.ndarray(),
+                            dtype=np.dtype(meta["dtype"])).reshape(
+                                tuple(meta["shape"])))
+                    m["pull_bytes"].add(host.nbytes)
+                    out[name] = (int(rest.decode()), host)
+            else:
+                def on_reply(name, payload, view):
+                    rest, dev, nbytes = consume_pull_reply(payload, view,
+                                                           device)
+                    m["pull_bytes"].add(nbytes)
+                    out[name] = (int(rest.decode()), dev)
+
+            try:
+                with PipelineWindow(self.channel, window,
+                                    on_reply=on_reply) as win:
+                    for name in names:
+                        win.submit("ParamService/Pull",
+                                   request=self._pull_request(name),
+                                   tag=name)
+            except native.RpcError as e:
+                if out:
+                    raise PartialPullError(
+                        e, dict(out),
+                        [n for n in names if n not in out]) from e
+                raise
+            return out
+
+        import jax
+
+        target = device if device is not None else jax.devices()[0]
+        on_accel = getattr(target, "platform", "cpu") != "cpu"
+
+        # Codec-ineligible tensors (non-fp32 / below the size floor) gain
+        # nothing from PullQ — the server serves them raw inside the
+        # group and the client's manifest decode costs a full host copy
+        # the per-tensor path avoids (_device_put_from_view aliases the
+        # response view). Meta already carries dtype/shape, so predict
+        # eligibility and keep those names on the per-tensor raw path
+        # (same window, so they still pipeline). Host-copy targets
+        # (to_host) pay the copy either way — no reason to split.
+        # Prediction misses (name absent from the cached map, or the
+        # server swapped the tensor since) just ride the group, whose
+        # raw-entry decode stays correct.
+        singles: list = []
+        if not to_host:
+            try:
+                meta_map = (listed_meta if listed_meta is not None
+                            else self.cached_meta())
+            except native.RpcError:
+                meta_map = {}
+
+            def _predict_eligible(n: str) -> bool:
+                e = meta_map.get(n)
+                if e is None:
+                    return True  # unknown: the group reports it per-name
+                return (e["dtype"] == "float32"
+                        and int(np.prod(e["shape"], dtype=np.int64)) * 4
+                        >= codec_mod.MIN_QUANT_BYTES)
+
+            singles = [n for n in names if not _predict_eligible(n)]
+        single_set = set(singles)
+        grouped = ([n for n in names if n not in single_set]
+                   if singles else names)
+
+        def on_group(_tag, payload, view):
+            # Decode every tensor of the group while the view is held
+            # (the codes live in the peer's pages), then dispatch ONE
+            # jax.device_put for the whole group: per-tensor dispatch is
+            # ~0.1-0.4ms of pure overhead on this path (PR 6 measured the
+            # contention flavor of the same cost), and the dequant output
+            # is a FRESH buffer — no view-release hazard, so no per-
+            # tensor block_until_ready either.
+            metas, hosts = [], []
+            qmetas, qpairs, qdevs = [], [], []
+            err: Optional[native.RpcError] = None
+            with view:
+                man = json.loads(payload.decode())
+                # b"" (not None): a group of only zero-size tensors ships
+                # a manifest with no attachment, and b""[0:0] keeps the
+                # slice-decode loop valid for their empty entries.
+                buf = view.ndarray() if view.nbytes else b""
+                off = 0
+                for t in man["tensors"]:
+                    if "error" in t:
+                        # Surface like the per-tensor path would — after
+                        # the groupmates decoded (a moved tensor must not
+                        # poison them; the fleet retries it per name).
+                        if err is None:
+                            err = native.RpcError(t["code"], t["error"])
+                        continue
+                    nb = t["nbytes"]
+                    sub = buf[off:off + nb]
+                    off += nb
+                    if "codec" in t:
+                        # Decode side of the tensor_codec_* accounting:
+                        # pull-only processes must still show their
+                        # logical/wire bytes and ratio on /vars+/tensorz.
+                        codec_mod.note(
+                            t["name"], t["codec"],
+                            int(np.prod(t["shape"], dtype=np.int64))
+                            * np.dtype(t["dtype"]).itemsize, nb)
+                    try:
+                        with _stage("dequant"):
+                            if on_accel and not to_host and "codec" in t:
+                                # Real accelerator: collect the (4x
+                                # smaller) codes+scales views; the single
+                                # H2D below detaches the whole group.
+                                q, s = codec_mod.split_wire(t, sub)
+                                qmetas.append(t)
+                                qpairs.append((q, s))
+                                continue
+                            if "codec" in t:
+                                host = codec_mod.decode(t, sub)
+                            else:
+                                host = np.array(np.frombuffer(
+                                    sub, dtype=np.dtype(t["dtype"])
+                                ).reshape(tuple(t["shape"])))
+                    except ValueError as ve:
+                        # Corrupt entry: ride the same per-name error
+                        # path as a manifest miss (groupmates survive
+                        # into PartialPullError; a bare ValueError would
+                        # bypass the salvage and the fleet re-route).
+                        if err is None:
+                            err = native.RpcError(
+                                E_UNDECODABLE, "undecodable tensor "
+                                f"payload for {t['name']}: {ve}")
+                        continue
+                    metas.append(t)
+                    hosts.append(host)
+                if qpairs:
+                    with _stage("dequant"):
+                        # Detach the whole group before the view releases
+                        # (one put + one barrier — see the helper).
+                        qdevs = _detach_device_put_batch(qpairs, device)
+            if qmetas:
+                with _stage("dequant"):
+                    for i, t in enumerate(qmetas):
+                        val = _dequant_widen(
+                            qdevs[2 * i], qdevs[2 * i + 1], t["block"],
+                            int(np.prod(t["shape"], dtype=np.int64)),
+                            t["shape"], want=t["dtype"])
+                        out[t["name"]] = (int(t["version"]), val)
+                        m["pull_bytes"].add(
+                            int(np.prod(t["shape"], dtype=np.int64))
+                            * np.dtype(t["dtype"]).itemsize)
+            if hosts:
+                vals = hosts if to_host else jax.device_put(hosts, device)
+                for t, val in zip(metas, vals):
+                    m["pull_bytes"].add(
+                        int(np.prod(t["shape"], dtype=np.int64))
+                        * np.dtype(t["dtype"]).itemsize)
+                    out[t["name"]] = (int(t["version"]), val)
+            if err is not None:
+                raise err
+
+        def on_reply(tag, payload, view):
+            if isinstance(tag, tuple):
+                return on_group(tag, payload, view)
+            # Predicted-ineligible per-tensor pull: raw reply, zero-copy
+            # device_put straight from the view (the path the raw branch
+            # above uses; the self-describing header keeps this correct
+            # even if the server quantized after all).
+            rest, dev, nbytes = consume_pull_reply(payload, view, device,
+                                                   note_name=tag)
             m["pull_bytes"].add(nbytes)
-            out[name] = (int(rest.decode()), dev)
+            out[tag] = (int(rest.decode()), dev)
 
-        with PipelineWindow(self.channel, window, on_reply=on_reply) as win:
-            for name in names:
-                win.submit("ParamService/Pull", request=name.encode(),
-                           tag=name)
+        try:
+            with PipelineWindow(self.channel, window,
+                                on_reply=on_reply) as win:
+                for name in singles:
+                    win.submit("ParamService/Pull",
+                               request=self._pull_request(name), tag=name)
+                for i in range(0, len(grouped), max(1, group)):
+                    g = grouped[i:i + max(1, group)]
+                    req = json.dumps({"names": g, "codec": c}).encode()
+                    win.submit("ParamService/PullQ", request=req,
+                               tag=tuple(g))
+        except native.RpcError as e:
+            if self._codec_pull_failed(e):
+                # Pre-codec rollback (no PullQ method): renegotiated to
+                # raw — re-pull the stragglers through the per-tensor
+                # raw path and merge, keeping any decoded survivors.
+                rem = [n for n in names if n not in out]
+                try:
+                    out.update(self.pull_all(rem, device=device,
+                                             window=window, group=group,
+                                             to_host=to_host))
+                except PartialPullError as pe:
+                    raise PartialPullError(pe, {**out, **pe.partial},
+                                           pe.missing) from pe
+                except native.RpcError as re2:
+                    # The raw re-pull died before delivering anything new
+                    # (e.g. the rolled-back server is still restarting).
+                    # The survivors in `out` must still reach the caller.
+                    if out:
+                        raise PartialPullError(
+                            re2, dict(out),
+                            [n for n in rem if n not in out]) from re2
+                    raise
+                return out
+            if out:
+                raise PartialPullError(
+                    e, dict(out),
+                    [n for n in names if n not in out]) from e
+            raise
         return out
 
     def push_all(self, grads: Dict[str, object], window: int = 4
@@ -592,11 +1172,21 @@ class ParameterClient:
             view.release()  # push responses carry no tensor
             versions[name] = int(payload.decode())
 
-        with PipelineWindow(self.channel, window, on_reply=on_reply) as win:
-            for name, grad in grads.items():
-                win.submit("ParamService/Push", array=grad,
-                           request=name.encode(), tag=name)
-                m["push_bytes"].add(int(getattr(grad, "nbytes", 0)))
+        try:
+            with PipelineWindow(self.channel, window,
+                                on_reply=on_reply) as win:
+                for name, grad in grads.items():
+                    win.submit("ParamService/Push", array=grad,
+                               request=name.encode(), tag=name,
+                               encoder=self._grad_encoder(name))
+                    m["push_bytes"].add(int(getattr(grad, "nbytes", 0)))
+        except native.RpcError as e:
+            self._codec_push_failed(e)
+            if versions:
+                raise PartialPushError(
+                    e, dict(versions),
+                    [n for n in grads if n not in versions]) from e
+            raise
         return versions
 
     def close(self) -> None:

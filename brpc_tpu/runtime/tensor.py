@@ -37,6 +37,14 @@ import numpy as np
 from brpc_tpu.runtime import native
 from brpc_tpu.runtime.native import RpcError, fill_err_text, lib
 
+# App-level error code (param_server.py holds the rest of the 2040+ range:
+# E_NO_SUCH..E_EXISTS at 2040-2043): a typed tensor send whose decoded
+# meta header cannot be applied to the payload (truncated/corrupt
+# quantized bytes, a codec this build can't parse). Deliberately NOT
+# 2004/TRPC_EINTERNAL — the client-side codec self-heal keys on this
+# code, and app codes must never collide with transport codes.
+E_UNDECODABLE = 2044
+
 
 def _bind_tensor_api(L: ctypes.CDLL) -> ctypes.CDLL:
     if getattr(L, "_tensor_api_bound", False):
@@ -201,14 +209,47 @@ def _pipeline_gauge() -> None:
 
 
 def _encode_meta(arr: np.ndarray) -> bytes:
-    meta = json.dumps({"dtype": arr.dtype.str, "shape": list(arr.shape)})
-    return struct.pack("<I", len(meta)) + meta.encode()
+    # Delegates to codec.pack_header — the ONE home of the '<I len + JSON'
+    # header framing, so the raw and quantized wire cannot drift apart.
+    from brpc_tpu.runtime import codec as codec_mod
+
+    return codec_mod.pack_header({"dtype": arr.dtype.str,
+                                  "shape": list(arr.shape)})
+
+
+def _decode_meta_ex(buf: bytes) -> Tuple[dict, bytes]:
+    """Header -> (full metadata dict, rest of payload). The dict carries
+    dtype/shape always, plus codec/block when the tensor rides the
+    quantized wire format (brpc_tpu/runtime/codec.py)."""
+    (n,) = struct.unpack_from("<I", buf)
+    return json.loads(buf[4:4 + n].decode()), buf[4 + n:]
 
 
 def _decode_meta(buf: bytes) -> Tuple[np.dtype, tuple, bytes]:
-    (n,) = struct.unpack_from("<I", buf)
-    meta = json.loads(buf[4:4 + n].decode())
-    return np.dtype(meta["dtype"]), tuple(meta["shape"]), buf[4 + n:]
+    meta, rest = _decode_meta_ex(buf)
+    return np.dtype(meta["dtype"]), tuple(meta["shape"]), rest
+
+
+class WireTensor:
+    """A response tensor already encoded for the wire: ``data`` (a uint8
+    ndarray staged into the service arena as-is) plus the exact metadata
+    ``header`` prefix to send — the quantized pull path's way of handing
+    the trampoline pre-built bytes instead of a host array (whose header
+    the trampoline would synthesize as raw).
+
+    ``placed`` is an optional ``(off, nbytes)`` range the handler already
+    wrote into the SERVICE'S OWN arena (``PullQ`` assembles its group
+    payload in place to skip the concat-then-place double memcpy); the
+    trampoline sends that range as-is — with autofree, so the handler
+    must not free it — instead of staging ``data``."""
+
+    __slots__ = ("data", "header", "placed")
+
+    def __init__(self, data: Optional[np.ndarray], header: bytes,
+                 placed: Optional[Tuple[int, int]] = None):
+        self.data = data
+        self.header = header
+        self.placed = placed
 
 
 def _as_host_array(array) -> np.ndarray:
@@ -330,6 +371,10 @@ class TensorView:
         self.nbytes = nbytes
 
     def ndarray(self) -> np.ndarray:
+        if not self.nbytes or not self._ptr:
+            # Zero-size tensors ride as metadata only — there is no
+            # attachment, so the view holds no pages (_ptr is None).
+            return np.empty(0, dtype=np.uint8)
         buf = (ctypes.c_uint8 * self.nbytes).from_address(self._ptr)
         return np.ctypeslib.as_array(buf)
 
@@ -358,22 +403,119 @@ class TensorView:
             pass
 
 
-def consume_pull_reply(payload: bytes, view: "TensorView", device=None):
+def consume_pull_reply(payload: bytes, view: "TensorView", device=None,
+                       note_name: Optional[str] = None):
     """Decode a pulled-tensor reply and device_put it straight from the
     zero-copy view, releasing the view once the transfer completed.
-    Returns ``(rest_of_payload, jax.Array, nbytes)``.
+    Returns ``(rest_of_payload, jax.Array, logical_nbytes)``.
 
     ONE implementation for the sync ``pull_device`` and the pipelined
     consumers (``ParameterClient.pull_all``'s on_reply) so the decode path
-    and its aliasing discipline cannot drift apart.
+    and its aliasing discipline cannot drift apart. Responses are
+    self-describing: a header carrying codec/block fields takes the
+    dequantize path (fused into the device_put — on TPU the H2D DMA moves
+    the ~4x smaller codes and the Pallas kernel widens on-chip; elsewhere
+    the numpy dequant IS the detach copy, so nothing is copied twice);
+    without codec fields this is byte-for-byte the raw path.
     """
     with view:
-        dtype, shape, rest = _decode_meta(payload)
-        arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
-        nbytes = view.nbytes
-        with _stage("device_put"):
-            dev = _device_put_from_view(arr, device)
+        meta, rest = _decode_meta_ex(payload)
+        if "codec" in meta:
+            from brpc_tpu.runtime import codec as codec_mod
+
+            nbytes = int(np.prod(meta["shape"], dtype=np.int64)
+                         ) * np.dtype(meta["dtype"]).itemsize
+            if note_name is not None:
+                # Decode side of the tensor_codec_* accounting contract:
+                # a pull-only trainer must still show its logical/wire
+                # bytes and ratio on /vars and /tensorz.
+                codec_mod.note(note_name, meta["codec"], nbytes,
+                               int(view.nbytes))
+            with _stage("dequant"):
+                try:
+                    dev = _dequant_put_from_view(meta, view.ndarray(),
+                                                 device, codec_mod)
+                except ValueError as ve:
+                    # Corrupt/truncated quantized reply (size mismatch,
+                    # unknown codec, missing ml_dtypes): surface as the
+                    # structural app code so pull_all's PartialPullError
+                    # salvage and the fleet's per-name re-route engage —
+                    # a bare ValueError would bypass both and discard
+                    # every already-decoded groupmate.
+                    raise RpcError(
+                        E_UNDECODABLE,
+                        f"undecodable tensor payload: {ve}") from ve
+        else:
+            arr = np.frombuffer(
+                view.ndarray(), dtype=np.dtype(meta["dtype"])).reshape(
+                    tuple(meta["shape"]))
+            nbytes = view.nbytes
+            with _stage("device_put"):
+                dev = _device_put_from_view(arr, device)
     return rest, dev, nbytes
+
+
+def _detach_device_put_batch(parts, device):
+    """ONE ``jax.device_put`` over every (codes, scales) pair in ``parts``
+    and ONE completion barrier BEFORE the caller releases the arena pages
+    those buffers alias — the quantized wire's view-aliasing discipline
+    lives here and nowhere else (single-tensor callers pass one pair;
+    ``pull_all``'s group path amortizes the ~0.1-0.4ms per-put dispatch
+    across the whole group). Mirrors ``_device_put_from_view``'s CPU
+    hazard: XLA zero-copy aliases 64B-aligned host buffers, so a CPU
+    target detaches with a host copy first. Returns the flat
+    ``[q0, s0, q1, s1, ...]`` device list."""
+    import jax
+
+    target = device if device is not None else jax.devices()[0]
+    flat = []
+    for q, s in parts:
+        flat.extend((q, s))
+    if getattr(target, "platform", "cpu") == "cpu":
+        flat = [np.array(a) for a in flat]
+    devs = jax.device_put(flat, device)
+    jax.block_until_ready(devs)
+    return devs
+
+
+def _dequant_widen(q_dev, s_dev, block, n, shape, want=None):
+    """Widen-and-scale already-detached codes/scales on device (Pallas on
+    TPU, the jnp reference elsewhere — ``dequantize_blocks`` auto-routes
+    like ``fused_momentum_update``). The output is a FRESH buffer, so no
+    further blocking; ``want`` restores a non-fp32 logical dtype."""
+    from brpc_tpu.ops.quantize import dequantize_blocks
+
+    out = dequantize_blocks(q_dev, s_dev, block=int(block), n=int(n),
+                            shape=tuple(shape))
+    if want is not None and np.dtype(want) != np.float32:
+        out = out.astype(np.dtype(want))
+    return out
+
+
+def _dequant_put_from_view(meta: dict, payload_u8: np.ndarray, device,
+                           codec_mod):
+    """Dequantize a received ``[scales][codes]`` view into a device array.
+
+    TPU: device_put the codes + scales (the H2D DMA detaches them from
+    the arena pages by definition) and run the Pallas widen-and-scale
+    kernel on-chip (brpc_tpu/ops/quantize.py — auto-routed like
+    fused_momentum_update). Elsewhere: the numpy dequant writes a fresh
+    fp32 buffer — detached by construction, so device_put may alias it
+    safely (unlike raw views, which need an explicit detach copy).
+    """
+    import jax
+
+    target = device if device is not None else jax.devices()[0]
+    if getattr(target, "platform", "cpu") != "cpu":
+        q, scales = codec_mod.split_wire(meta, payload_u8)
+        q_dev, s_dev = _detach_device_put_batch([(q, scales)], device)
+        return _dequant_widen(q_dev, s_dev, meta["block"],
+                              int(np.prod(meta["shape"], dtype=np.int64)),
+                              meta["shape"], want=meta["dtype"])
+    host = codec_mod.decode(meta, payload_u8)  # fresh buffer: no alias risk
+    dev = jax.device_put(host, device)
+    dev.block_until_ready()
+    return dev
 
 
 class TensorFuture:
@@ -513,17 +655,33 @@ class PipelineWindow:
         return len(self._q)
 
     def submit(self, service_method: str, array=None, request: bytes = b"",
-               tag=None) -> None:
+               tag=None, encoder=None) -> None:
         """Stage ``array`` (optional) into the channel arena and start
         the RPC; blocks only while the window is full (draining the
-        oldest in-flight call first)."""
+        oldest in-flight call first).
+
+        ``encoder(host) -> (wire_uint8, header_bytes) | None`` (optional)
+        runs at arena-stage time — quantization overlaps the wire exactly
+        like the staging copy already does (codes of tensor k+1 are being
+        computed while tensor k's bytes fly). ``None`` means this tensor
+        rides raw (the per-call degrade)."""
         while len(self._q) >= self.window:
             self._complete_oldest()
         off = length = 0
         if array is not None:
             with _stage("arena_stage"):
-                off, length, host = self.channel.place_with_meta(array)
-            request = _encode_meta(host) + request
+                enc = None
+                if encoder is not None:
+                    host = _as_host_array(array)
+                    enc = encoder(host)
+                    array = host
+                if enc is None:
+                    off, length, host = self.channel.place_with_meta(array)
+                    request = _encode_meta(host) + request
+                else:
+                    wire, header = enc
+                    off, length, _ = self.channel.arena.place(wire)
+                    request = header + request
         try:
             fut = self.channel.call_async(service_method, request, off,
                                           length)
@@ -708,15 +866,21 @@ class TensorChannel:
                     return rest, np.empty(shape, dtype=dtype)
                 except Exception:  # noqa: BLE001 — tensor-less response
                     return payload, None
-            dtype, shape, rest = _decode_meta(payload)
-            arr = np.frombuffer(view.ndarray(), dtype=dtype).reshape(shape)
+            meta, rest = _decode_meta_ex(payload)
+            if "codec" in meta:  # self-describing quantized response
+                from brpc_tpu.runtime import codec as codec_mod
+
+                return rest, codec_mod.decode(meta, view.ndarray())
+            arr = np.frombuffer(
+                view.ndarray(), dtype=np.dtype(meta["dtype"])).reshape(
+                    tuple(meta["shape"]))
             return rest, np.array(arr)  # detach before releasing the view
 
     def place_with_meta(self, array) -> Tuple[int, int, np.ndarray]:
         return self.arena.place(array)
 
     def pull_device(self, service_method: str, request: bytes = b"",
-                    device=None):
+                    device=None, note_name: Optional[str] = None):
         """Fetch a tensor and jax.device_put it STRAIGHT from the received
         view (H2D DMA from the shared pages; no intermediate host copy),
         then release the view. Returns (rest_of_payload, jax.Array).
@@ -727,28 +891,40 @@ class TensorChannel:
         t0 = time.monotonic()
         with _stage("rpc"):
             payload, view = self.call_raw(service_method, request)
-        rest, dev, nbytes = consume_pull_reply(payload, view, device)
+        rest, dev, nbytes = consume_pull_reply(payload, view, device,
+                                               note_name=note_name)
         m = _metrics()
         m["pull"].record_s(time.monotonic() - t0)
         m["pull_bytes"].add(nbytes)
         return rest, dev
 
     def push_device(self, service_method: str, array,
-                    request: bytes = b"") -> bytes:
+                    request: bytes = b"", encoder=None) -> bytes:
         """Send a device array (D2H into the arena, by-reference on the
         wire); waits for the wire release so the arena cannot fill up under
         a streaming push loop. Returns the response payload.
+
+        ``encoder`` is the same per-tensor hook ``PipelineWindow.submit``
+        takes: ``(wire_uint8, header_bytes) | None`` computed at
+        arena-stage time; None rides raw.
 
         Observability: records into the tensor_push LatencyRecorder and
         tensor_push_bytes counter, and annotates the active rpcz span with
         the arena_stage (D2H + staging copy) / rpc stage split."""
         t0 = time.monotonic()
         with _stage("arena_stage"):
-            off, length, host = self.place_with_meta(array)
+            host = _as_host_array(array)
+            enc = encoder(host) if encoder is not None else None
+            if enc is None:
+                off, length, host = self.place_with_meta(host)
+                header = _encode_meta(host)
+            else:
+                wire, header = enc
+                off, length, _ = self.arena.place(wire)
         try:
             with _stage("rpc"):
                 payload, view = self.call_raw(
-                    service_method, _encode_meta(host) + request, off, length)
+                    service_method, header + request, off, length)
             view.release()
             m = _metrics()
             m["push"].record_s(time.monotonic() - t0)
@@ -799,13 +975,52 @@ def add_tensor_service(server: native.Server, name: str,
                 if request[:4] and len(request) >= 4:
                     # Typed sends prefix the payload with dtype/shape meta:
                     # give the handler a shaped view of the pages in place.
+                    meta = None
                     try:
-                        dtype, shape, request = _decode_meta(request)
-                        att_view = att_view.view(dtype).reshape(shape)
+                        meta, request = _decode_meta_ex(request)
                     except Exception:  # noqa: BLE001 — raw-byte sender
                         pass
+                    # Once a meta header DID decode (request is already
+                    # header-stripped), a failure to apply it is a
+                    # malformed/undecodable typed send — answer a clean
+                    # RPC error, never hand the handler the flat wire
+                    # bytes as if they were the tensor.
+                    if meta is not None:
+                        try:
+                            if "codec" in meta:
+                                # Quantized send: hand the handler the
+                                # typed zero-copy window (codes + scales
+                                # in place); dequantize() detaches when
+                                # it consumes.
+                                from brpc_tpu.runtime import (
+                                    codec as codec_mod)
+
+                                att_view = codec_mod.QuantizedView(
+                                    meta, att_view)
+                            else:
+                                att_view = att_view.view(
+                                    np.dtype(meta["dtype"])).reshape(
+                                        tuple(meta["shape"]))
+                        except Exception as e:  # noqa: BLE001
+                            raise RpcError(
+                                E_UNDECODABLE,
+                                f"undecodable tensor payload "
+                                f"(meta={meta!r}): {e}") from e
             r, out_arr = handler(method.decode(), request, att_view)
-            if out_arr is not None:
+            if isinstance(out_arr, WireTensor):
+                # Pre-encoded response (quantized pull path): stage the
+                # wire bytes as-is, send the handler's exact header.
+                if out_arr.placed is not None:
+                    off, nbytes = out_arr.placed
+                else:
+                    off, nbytes, _ = srv_arena.place(out_arr.data)
+                r = out_arr.header + r
+                if nbytes:
+                    resp_arena[0] = srv_arena.handle
+                    resp_off[0] = off
+                    resp_att_len[0] = nbytes
+                    resp_autofree[0] = 1
+            elif out_arr is not None:
                 off, nbytes, host = srv_arena.place(out_arr)
                 r = _encode_meta(host) + r
                 if nbytes:

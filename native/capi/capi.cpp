@@ -23,12 +23,14 @@
 #include "tbvar/flight_recorder.h"
 #include "tbvar/tbvar.h"
 #include "trpc/channel.h"
+#include "trpc/compress.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
 #include "trpc/registry.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
 #include "trpc/stall_watchdog.h"
+#include "trpc/tstd_protocol.h"
 #include "ttpu/ici_segment.h"
 #include "ttpu/tensor_arena.h"
 
@@ -1285,6 +1287,31 @@ int64_t tbrpc_now_us(void) { return tbutil::gettimeofday_us(); }
 int tbrpc_flag_set(const char* name, const char* value) {
   if (name == nullptr || value == nullptr) return -1;
   return FlagRegistry::global().Set(name, value) ? 0 : -1;
+}
+
+// ---------------- quantized tensor wire: codec registry ----------------
+
+int tbrpc_tensor_codec_id(const char* name) {
+  GlobalInitializeOrDie();  // registry is filled by the builtin hookup
+  return TensorCodecId(name);
+}
+
+int64_t tbrpc_tensor_codec_list(char* buf, size_t cap) {
+  GlobalInitializeOrDie();
+  return copy_out(TensorCodecList(), buf, cap);
+}
+
+void tbrpc_tensor_codec_note(const char* tensor, int codec_id,
+                             uint64_t logical_bytes, uint64_t wire_bytes) {
+  GlobalInitializeOrDie();  // builtin codec names must resolve in stats
+  if (codec_id < 0 || codec_id > 255) return;
+  NoteTensorCodec(tensor, static_cast<uint8_t>(codec_id), logical_bytes,
+                  wire_bytes);
+}
+
+int64_t tbrpc_tensor_codec_stats_json(char* buf, size_t cap) {
+  GlobalInitializeOrDie();
+  return copy_out(TensorCodecStatsJson(), buf, cap);
 }
 
 // ---------------- fleet: service registry ----------------

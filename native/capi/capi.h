@@ -311,6 +311,28 @@ int64_t tbrpc_now_us(void);
 // flag / parse error / validator veto.
 int tbrpc_flag_set(const char* name, const char* value);
 
+// ---- quantized tensor wire: codec registry + accounting ----
+// The tensor-codec negotiation seam (trpc/compress.h — the registry that
+// sits beside gzip/snappy): ids/names are the per-call currency of the
+// quantized tensor wire format (block-wise int8 / fp8-e4m3 with
+// per-block fp32 scales; encode/decode math lives in
+// brpc_tpu/runtime/codec.py). Codec id for a name ("raw"/"" = 0), or -1
+// when unknown to this build — the mixed-fleet degrade probe.
+int tbrpc_tensor_codec_id(const char* name);
+// CSV of registered codec names (the capability advertisement servers
+// put in Meta). Copy-out convention (see the dump section above).
+int64_t tbrpc_tensor_codec_list(char* buf, size_t cap);
+// Per-tensor wire accounting from either end of a quantized transfer:
+// bumps the process-wide tensor_codec_bytes_logical /
+// tensor_codec_bytes_wire adders (and the tensor_codec_ratio gauge) on
+// /vars + /brpc_metrics, and the bounded per-tensor table /tensorz
+// renders (last codec + cumulative logical/wire + compression ratio).
+void tbrpc_tensor_codec_note(const char* tensor, int codec_id,
+                             uint64_t logical_bytes, uint64_t wire_bytes);
+// {"bytes_logical":N,"bytes_wire":N,"tensors":[{name,codec,logical,
+// wire,count}...]} — the accounting table as JSON. Copy-out convention.
+int64_t tbrpc_tensor_codec_stats_json(char* buf, size_t cap);
+
 // ---- fleet: service registry (trpc/registry.h) ----
 // Install the in-process service registry: after this, EVERY server in the
 // process answers /registry/register, /registry/deregister and

@@ -20,6 +20,7 @@
 #include "tbvar/prometheus.h"
 #include "tbvar/series.h"
 #include "tbvar/variable.h"
+#include "trpc/compress.h"
 #include "trpc/flags.h"
 #include "trpc/stall_watchdog.h"
 #include "trpc/http_protocol.h"
@@ -305,6 +306,11 @@ void tensorz_page(const HttpRequest&, HttpResponse* resp) {
     b += value;
     b += '\n';
   }
+  // Quantized tensor wire: per-tensor codec + compression ratio (the
+  // registry/accounting in trpc/compress.cpp — tensor_codec_bytes_* above
+  // carry the process totals; this table attributes them per tensor).
+  b += "\nquantized tensor wire (codec registry + per-tensor ratio):\n";
+  b += TensorCodecTableText();
 }
 
 // /sockets: EVERY live socket in the process, client side included —

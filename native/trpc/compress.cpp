@@ -4,10 +4,15 @@
 
 #include <atomic>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <string>
 
+#include "tbutil/json.h"
 #include "tbutil/logging.h"
 #include "tbutil/snappy.h"
+#include "tbvar/passive_status.h"
+#include "tbvar/reducer.h"
 
 namespace trpc {
 
@@ -156,6 +161,168 @@ void RegisterBuiltinCompressors() {
   sn.compress = snappy_compress_iobuf;
   sn.decompress = snappy_decompress_iobuf;
   TB_CHECK(RegisterCompressor(kCompressSnappy, sn) == 0);
+}
+
+// ---- tensor codec registry + wire accounting ----
+
+namespace {
+
+std::atomic<const char*> g_tensor_codecs[256] = {};
+
+// Accounting state. One note per tensor RPC (multi-KB payloads) and
+// microsecond critical sections with callers on BOTH plain pthreads
+// (Python callback pool) and fibers (/tensorz) — the span collector's
+// std::mutex precedent (span.cpp), not a FiberMutex.
+struct CodecStats {
+  std::mutex mu;  // tpulint: allow(fiber-blocking)
+  tbvar::Adder<int64_t>* logical = nullptr;
+  tbvar::Adder<int64_t>* wire = nullptr;
+  struct Entry {
+    uint8_t codec = 0;
+    uint64_t logical = 0;
+    uint64_t wire = 0;
+    uint64_t count = 0;
+  };
+  std::map<std::string, Entry> tensors;
+  uint64_t dropped = 0;  // inserts refused past the table cap
+};
+
+constexpr size_t kCodecTableCap = 512;
+
+CodecStats& codec_stats() {
+  static CodecStats* s = [] {
+    auto* st = new CodecStats();
+    st->logical = new tbvar::Adder<int64_t>();
+    st->logical->expose("tensor_codec_bytes_logical");
+    st->wire = new tbvar::Adder<int64_t>();
+    st->wire->expose("tensor_codec_bytes_wire");
+    // Effective-bandwidth multiplier at a glance: logical/wire across
+    // every quantized tensor this process encoded or decoded.
+    (new tbvar::PassiveStatus<double>([st]() -> double {
+      const int64_t w = st->wire->get_value();
+      return w > 0 ? static_cast<double>(st->logical->get_value()) /
+                         static_cast<double>(w)
+                   : 1.0;
+    }))->expose("tensor_codec_ratio");
+    return st;
+  }();
+  return *s;
+}
+
+}  // namespace
+
+int RegisterTensorCodec(uint8_t id, const char* name) {
+  if (id == kTensorCodecRaw || name == nullptr) return -1;
+  char* heap = strdup(name);
+  const char* expected = nullptr;
+  if (!g_tensor_codecs[id].compare_exchange_strong(
+          expected, heap, std::memory_order_acq_rel)) {
+    free(heap);
+    return -1;
+  }
+  return 0;
+}
+
+const char* TensorCodecName(uint8_t id) {
+  return g_tensor_codecs[id].load(std::memory_order_acquire);
+}
+
+int TensorCodecId(const char* name) {
+  if (name == nullptr) return -1;
+  if (name[0] == '\0' || strcmp(name, "raw") == 0) return kTensorCodecRaw;
+  for (int id = 1; id < 256; ++id) {
+    const char* n =
+        g_tensor_codecs[id].load(std::memory_order_acquire);
+    if (n != nullptr && strcmp(n, name) == 0) return id;
+  }
+  return -1;
+}
+
+std::string TensorCodecList() {
+  std::string out;
+  for (int id = 1; id < 256; ++id) {
+    const char* n =
+        g_tensor_codecs[id].load(std::memory_order_acquire);
+    if (n == nullptr) continue;
+    if (!out.empty()) out += ',';
+    out += n;
+  }
+  return out;
+}
+
+void NoteTensorCodec(const char* tensor, uint8_t id, uint64_t logical_bytes,
+                     uint64_t wire_bytes) {
+  CodecStats& s = codec_stats();
+  *s.logical << static_cast<int64_t>(logical_bytes);
+  *s.wire << static_cast<int64_t>(wire_bytes);
+  std::lock_guard<std::mutex> lk(s.mu);  // tpulint: allow(fiber-blocking)
+  auto it = s.tensors.find(tensor ? tensor : "");
+  if (it == s.tensors.end()) {
+    if (s.tensors.size() >= kCodecTableCap) {  // bounded: /tensorz, not a DB
+      ++s.dropped;
+      return;
+    }
+    it = s.tensors.emplace(tensor ? tensor : "",
+                           CodecStats::Entry{}).first;
+  }
+  it->second.codec = id;  // last codec wins (mixed raw/quant per tensor)
+  it->second.logical += logical_bytes;
+  it->second.wire += wire_bytes;
+  ++it->second.count;
+}
+
+std::string TensorCodecTableText() {
+  CodecStats& s = codec_stats();
+  std::lock_guard<std::mutex> lk(s.mu);  // tpulint: allow(fiber-blocking)
+  std::string b = "tensor codecs (" + std::to_string(s.tensors.size()) +
+                  " tensors, registry: " + TensorCodecList() + ")\n";
+  for (const auto& [name, e] : s.tensors) {
+    const char* cn = TensorCodecName(e.codec);
+    char line[192];
+    snprintf(line, sizeof(line),
+             "  %-24s %-8s logical %12llu  wire %12llu  ratio %5.2fx  "
+             "notes %llu\n",
+             name.c_str(), cn ? cn : "raw",
+             static_cast<unsigned long long>(e.logical),
+             static_cast<unsigned long long>(e.wire),
+             e.wire > 0 ? static_cast<double>(e.logical) /
+                              static_cast<double>(e.wire)
+                        : 1.0,
+             static_cast<unsigned long long>(e.count));
+    b += line;
+  }
+  if (s.dropped > 0) {
+    b += "  (+" + std::to_string(s.dropped) +
+         " notes for tensors past the " + std::to_string(kCodecTableCap) +
+         "-entry cap)\n";
+  }
+  return b;
+}
+
+std::string TensorCodecStatsJson() {
+  CodecStats& s = codec_stats();
+  std::lock_guard<std::mutex> lk(s.mu);  // tpulint: allow(fiber-blocking)
+  tbutil::JsonValue o = tbutil::JsonValue::Object();
+  o.set("bytes_logical", static_cast<int64_t>(s.logical->get_value()));
+  o.set("bytes_wire", static_cast<int64_t>(s.wire->get_value()));
+  tbutil::JsonValue arr = tbutil::JsonValue::Array();
+  for (const auto& [name, e] : s.tensors) {
+    const char* cn = TensorCodecName(e.codec);
+    tbutil::JsonValue t = tbutil::JsonValue::Object();
+    t.set("name", name);
+    t.set("codec", cn ? cn : "raw");
+    t.set("logical", static_cast<int64_t>(e.logical));
+    t.set("wire", static_cast<int64_t>(e.wire));
+    t.set("count", static_cast<int64_t>(e.count));
+    arr.push_back(std::move(t));
+  }
+  o.set("tensors", std::move(arr));
+  return o.Dump();
+}
+
+void RegisterBuiltinTensorCodecs() {
+  TB_CHECK(RegisterTensorCodec(kTensorCodecInt8, "int8") == 0);
+  TB_CHECK(RegisterTensorCodec(kTensorCodecFp8E4M3, "fp8e4m3") == 0);
 }
 
 }  // namespace trpc

@@ -8,6 +8,7 @@
 #pragma once
 
 #include <cstdint>
+#include <string>
 
 #include "tbutil/iobuf.h"
 
@@ -40,5 +41,45 @@ bool MaybeCompress(uint8_t type, const tbutil::IOBuf& in, tbutil::IOBuf* out);
 
 // Built-ins (gzip, snappy); called by GlobalInitializeOrDie.
 void RegisterBuiltinCompressors();
+
+// ---- tensor codec registry (the quantized tensor wire format) ----
+// The tensor-payload sibling of the compress registry above: where
+// compress_type trades CPU for generic byte entropy, a tensor codec
+// trades bounded numeric precision for a ~4x byte cut (block-wise int8 /
+// fp8-e4m3 with per-block fp32 scales — brpc_tpu/runtime/codec.py holds
+// the encode/decode math; EQuARX is the design source). This registry is
+// the NEGOTIATION seam: ids/names are the per-call currency (a pull
+// request carries the codec name, the response header echoes what was
+// actually used), and the accounting below makes "effective GB/s"
+// (logical bytes / wall time) a first-class metric next to wire GB/s.
+
+inline constexpr uint8_t kTensorCodecRaw = 0;
+inline constexpr uint8_t kTensorCodecInt8 = 1;
+inline constexpr uint8_t kTensorCodecFp8E4M3 = 2;
+
+// id 1..255 (0 = raw, reserved). Returns -1 if the slot is taken.
+int RegisterTensorCodec(uint8_t id, const char* name);
+// nullptr for raw/unknown.
+const char* TensorCodecName(uint8_t id);
+// -1 for unknown names ("" and "raw" map to 0).
+int TensorCodecId(const char* name);
+// CSV of registered codec names (the capability advertisement).
+std::string TensorCodecList();
+
+// Per-tensor wire accounting, fed by both encode and decode sides:
+// bumps the tensor_codec_bytes_logical / tensor_codec_bytes_wire adders
+// (exposed on /vars + /brpc_metrics, with a tensor_codec_ratio gauge)
+// and a bounded per-tensor table /tensorz renders (last codec, totals,
+// compression ratio). Wait-free off the hot path is NOT required here —
+// one note per multi-KB tensor RPC, a mutex is fine.
+void NoteTensorCodec(const char* tensor, uint8_t id, uint64_t logical_bytes,
+                     uint64_t wire_bytes);
+// The /tensorz section body (header line + one line per tensor).
+std::string TensorCodecTableText();
+// {"bytes_logical":N,"bytes_wire":N,"tensors":[{...}]} for tests/tools.
+std::string TensorCodecStatsJson();
+
+// Built-ins (int8, fp8e4m3); called by GlobalInitializeOrDie.
+void RegisterBuiltinTensorCodecs();
 
 }  // namespace trpc

@@ -575,6 +575,7 @@ void GlobalInitializeOrDie() {
     signal(SIGPIPE, SIG_IGN);
     tbvar::ExposeDefaultVariables();
     RegisterBuiltinCompressors();
+    RegisterBuiltinTensorCodecs();  // quantized tensor wire negotiation
     Protocol p;
     p.parse = tstd_parse;
     p.pack_request = tstd_pack_request;

@@ -35,5 +35,11 @@ int tbrpc_fix_set_inline(void* server, const char* service, int enabled);
 // Niladic entry-point shape (mirrors tbrpc_registry_install): an explicit
 // (void) parameter list must normalise to the lock's "int()" spelling.
 int tbrpc_fix_registry_install(void);
+// Tensor-codec accounting shape (mirrors tbrpc_tensor_codec_note): a
+// void-returning entry point with uint64_t scalar params, kept in sync
+// with the lock — pins that the parser keeps unsigned fixed-width
+// scalars distinct from their pointer forms.
+void tbrpc_fix_codec_note(const char* tensor, int codec_id,
+                          uint64_t logical_bytes, uint64_t wire_bytes);
 
 }  // extern "C"

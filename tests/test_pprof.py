@@ -122,11 +122,21 @@ def test_pprof_profile_wire_format(busy_server):
 
 
 def test_pprof_heap_wire_format(busy_server):
-    raw = urllib.request.urlopen(
-        f"http://127.0.0.1:{busy_server}/pprof/heap?seconds=1",
-        timeout=30).read()
-    # Heap samples depend on allocation traffic during the window; the
-    # echo load allocates (IOBuf blocks), so expect samples here too.
+    # Heap samples depend on allocation traffic landing INSIDE the 1s
+    # sampling window; the echo load allocates steadily (IOBuf blocks),
+    # but on a 2-core box host steal can starve the burner threads for a
+    # whole window (observed once across a full run — PR 6 notes), so a
+    # dry window gets a bounded rerun instead of failing tier-1. The
+    # wire-format invariants are asserted on EVERY attempt; only the
+    # has-samples expectation reruns.
+    raw = b""
+    for _attempt in range(3):
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{busy_server}/pprof/heap?seconds=1",
+            timeout=30).read()
+        prof = _check_profile(raw, expect_samples=False, n_value_types=1)
+        if len(prof.sample) > 0:
+            break
     # Byte-valued profiles carry ONE value type (inuse_space/bytes) — a
     # (samples, count) column would mislabel byte counts.
     _check_profile(raw, expect_samples=True, n_value_types=1)

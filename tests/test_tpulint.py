@@ -204,6 +204,11 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
     # The niladic entry-point shape of tbrpc_registry_install: an explicit
     # (void) list normalises to the lock's "int()" spelling.
     assert parsed["tbrpc_fix_registry_install"] == "int()"
+    # The tensor-codec accounting shape of tbrpc_tensor_codec_note: a
+    # void return with uint64_t scalar params stays distinct from any
+    # pointer spelling.
+    assert parsed["tbrpc_fix_codec_note"] == (
+        "void(const char *, int, uint64_t, uint64_t)")
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
@@ -230,6 +235,13 @@ def test_wire_contract_capi_real_repo_lock_is_current():
     # The small-RPC fast path's registration flag is part of the contract.
     assert locked["tbrpc_server_set_inline"] == (
         "int(void *, const char *, int)")
+    # The quantized-tensor-wire codec surface is part of the contract.
+    assert locked["tbrpc_tensor_codec_id"] == "int(const char *)"
+    assert locked["tbrpc_tensor_codec_note"] == (
+        "void(const char *, int, uint64_t, uint64_t)")
+    assert locked["tbrpc_tensor_codec_list"] == "int64_t(char *, size_t)"
+    assert locked["tbrpc_tensor_codec_stats_json"] == (
+        "int64_t(char *, size_t)")
 
 
 # ---- rule class 5: metric-name ----
