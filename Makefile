@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -24,6 +24,14 @@ lint-sarif:
 # terminal (or tier-1).
 bench-smoke:
 	python bench.py --smoke
+
+# Fast local gate for the fleet observability plane (the bench-smoke
+# analog): the cross-process trace-assembly + /fleetz scrape + sampling
+# tests, then lint. The pure assembly/skew tests run even without the
+# native library; the live-fleet halves skip cleanly there.
+obs-smoke:
+	python -m pytest tests/test_fleet_view.py -q
+	python -m tools.tpulint
 
 # Slow-marked tests (the watchdog soak) are excluded here, same as
 # tier-1; run them explicitly with `make soak`.

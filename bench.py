@@ -12,7 +12,10 @@ reference's BEST published number — 2.3 GB/s multi-connection echo
 (docs/cn/benchmark.md:104, BASELINE.md) — not the flattering 0.8 GB/s
 single-connection figure.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "sweep"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "sweep"}
+— and persists the same document as BENCH_r<N>.json (N = one past the
+highest committed round), so the machine-readable trajectory advances
+with every full run.
 """
 
 import json
@@ -241,6 +244,27 @@ def ici_threshold_point(reps=5, seconds=1, concurrency=16, wedge_log=None):
     return row
 
 
+def rpcz_overhead_point(reps=5, seconds=1, concurrency=16, sample_n=64,
+                        wedge_log=None):
+    """Always-on rpcz cost on the 64B hot path: span collection ON with
+    1-in-`sample_n` root sampling vs rpcz OFF, interleaved pairs (the
+    fleet-observability acceptance row — production keeps rpcz live only
+    if this stays <= 5%). overhead_pct = (1 - sampled/off) * 100."""
+    row = _ab_point(64,
+                    a_flags=(("rpcz_enabled", "1"),
+                             ("rpcz_sample_1_in_n", str(sample_n))),
+                    b_flags=(("rpcz_enabled", "0"),),
+                    a_key="sampled", b_key="off", reps=reps,
+                    seconds=seconds, concurrency=concurrency,
+                    wedge_log=wedge_log)
+    row["sample_1_in_n"] = sample_n
+    row["overhead_pct"] = round((1 - row["speedup"]) * 100, 1)
+    print(f"# rpcz_overhead_64B: off {row['off_qps']} qps -> sampled 1/"
+          f"{sample_n} {row['sampled_qps']} qps ({row['overhead_pct']}% "
+          f"overhead, samples {row['speedup_samples']})", file=sys.stderr)
+    return row
+
+
 def best_point(payload, transport, seconds=2, wedge_log=None):
     """Best (GB/s, qps, p99_us, concurrency) across the concurrency set.
 
@@ -331,6 +355,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# ici_threshold_4KB skipped: {e}", file=sys.stderr)
 
+    # Sampled-rpcz overhead row (fleet observability plane): the cost of
+    # keeping span collection live in production at 1-in-64 root sampling.
+    try:
+        sweep["rpcz_overhead_64B"] = rpcz_overhead_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# rpcz_overhead_64B skipped: {e}", file=sys.stderr)
+
     # Pipelined parameter-server rows (async tensor RPC tentpole): 32x1MB
     # serial round-trips vs one bounded PipelineWindow, pull and push.
     try:
@@ -385,7 +416,7 @@ def main() -> None:
 
     headline = sweep["tpu_1048576B"]["gbps"]
     tcp = sweep.get("tcp_1048576B", {}).get("gbps", 0.0)
-    print(json.dumps({
+    doc = {
         "metric": "echo_1mb_oneway_throughput_tpu",
         "value": headline,
         "unit": "GB/s",
@@ -401,7 +432,42 @@ def main() -> None:
                             "like-for-like",
         "tcp_vs_baseline": round(tcp / BASELINE_GBPS, 3),
         "sweep": sweep,
-    }))
+    }
+    print(json.dumps(doc))
+    write_bench_json(doc)
+
+
+def next_bench_round() -> int:
+    """One past the highest committed BENCH_r<N>.json in the repo root."""
+    import glob
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [0]
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def write_bench_json(doc) -> str:
+    """Persist the machine-readable trajectory point: every FULL run
+    writes BENCH_r<N>.json beside the earlier rounds (the series stalled
+    at r05 while PERF.md rounds ran to 9 — the trajectory is only useful
+    if it keeps being written). Failure to write must not fail the bench
+    (read-only checkouts); the stdout JSON line is still the result."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(root, f"BENCH_r{next_bench_round():02d}.json")
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"# wrote {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# BENCH json not written: {e}", file=sys.stderr)
+        return ""
+    return path
 
 
 # The whole serial-vs-pipelined measurement runs in ONE watchdogged child

@@ -14,9 +14,14 @@ stale-beyond-lag-bound read.
   FleetServer   one shard: ParameterServer + registry heartbeat
   FleetClient   scatter/gather client with mid-reshard routing
   Migrator      watch-triggered planner + bandwidth-bounded migrator
+  FleetObserver observability plane: cross-process trace assembly +
+                registry-driven metric/health rollups (also the /fleetz
+                console page on any member; lives in
+                brpc_tpu.observability.fleet_view)
 """
 
 from brpc_tpu.fleet.fleet_client import FleetClient
+from brpc_tpu.observability.fleet_view import FleetObserver
 from brpc_tpu.fleet.migrator import Migrator, ReshardPlan, plan_reshard
 from brpc_tpu.fleet.registry import (Registration, RegistryHub,
                                      RegistryWatcher, clear_registry,
@@ -26,8 +31,8 @@ from brpc_tpu.fleet.server import FleetServer
 from brpc_tpu.fleet.shard_map import ShardMap, key_point
 
 __all__ = [
-    "FleetClient", "FleetServer", "Migrator", "Registration", "RegistryHub",
-    "RegistryWatcher", "ReshardPlan", "ShardMap", "clear_registry",
-    "deregister", "install_registry", "key_point", "list_servers",
-    "plan_reshard", "register",
+    "FleetClient", "FleetObserver", "FleetServer", "Migrator",
+    "Registration", "RegistryHub", "RegistryWatcher", "ReshardPlan",
+    "ShardMap", "clear_registry", "deregister", "install_registry",
+    "key_point", "list_servers", "plan_reshard", "register",
 ]

@@ -38,6 +38,7 @@ import numpy as np
 
 from brpc_tpu.fleet import gauges, registry
 from brpc_tpu.fleet.shard_map import ShardMap
+from brpc_tpu.observability import tracing
 from brpc_tpu.runtime import native
 from brpc_tpu.runtime.param_server import (E_MIGRATING, E_MOVED, E_NO_SUCH,
                                            ParameterClient,
@@ -305,11 +306,20 @@ class FleetClient:
         """
         if on_missing not in ("error", "skip"):
             raise ValueError(f"on_missing must be error|skip: {on_missing!r}")
+        # One span covers the whole scatter/gather; the per-shard client
+        # legs (and through the wire, every shard's server span) parent
+        # here, so the fleet observer assembles a pull_all into ONE
+        # cross-process trace. No-op cost while rpcz is off/unsampled.
+        with tracing.trace_span("FleetClient/pull_all"):
+            return self._pull_all_traced(names, device, window, on_missing)
+
+    def _pull_all_traced(self, names, device, window, on_missing):
         win = window if window is not None else self.window
         dev = device if device is not None else self._device
         if names is None:
             names = sorted(self.meta())
         names = list(names)
+        tracing.annotate(f"tensors={len(names)}")
         hosts: Dict[str, tuple] = {}
         res_mu = threading.Lock()
 
@@ -356,6 +366,11 @@ class FleetClient:
                  window: Optional[int] = None) -> Dict[str, int]:
         """Push many gradients fleet-wide -> {name: new_version}; same
         scatter/gather + salvage shape as pull_all."""
+        with tracing.trace_span("FleetClient/push_all"):
+            tracing.annotate(f"tensors={len(grads)}")
+            return self._push_all_traced(grads, window)
+
+    def _push_all_traced(self, grads, window):
         win = window if window is not None else self.window
         versions: Dict[str, int] = {}
         res_mu = threading.Lock()
@@ -398,9 +413,26 @@ class FleetClient:
         if len(groups) == 1:
             (addr, group), = groups.items()
             return shard_op(addr, group)
+        # Hand the caller's trace context into the shard threads: the
+        # native context rides a PER-THREAD slot, so without this each
+        # shard stream's RPCs would mint their own (independently
+        # sampled) root traces instead of parenting under the pull_all/
+        # push_all span — and the assembled fleet trace would shatter
+        # into N unlinked pieces.
+        ctx = tracing.current_trace()
+
+        def run_with_ctx(addr: str, group: List[str]) -> List[str]:
+            if ctx != (0, 0):
+                tracing.set_trace(*ctx)
+            try:
+                return shard_op(addr, group)
+            finally:
+                if ctx != (0, 0):
+                    tracing.clear_trace()  # pooled thread: don't leak ctx
+
         with ThreadPoolExecutor(max_workers=len(groups),
                                 thread_name_prefix="fleet-io") as pool:
-            futs = [pool.submit(shard_op, addr, group)
+            futs = [pool.submit(run_with_ctx, addr, group)
                     for addr, group in groups.items()]
             wait(futs)
         for f in futs:

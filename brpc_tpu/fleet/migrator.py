@@ -49,6 +49,7 @@ import numpy as np
 
 from brpc_tpu.fleet import gauges, registry
 from brpc_tpu.fleet.shard_map import ShardMap
+from brpc_tpu.observability import tracing
 from brpc_tpu.runtime import native
 from brpc_tpu.runtime.param_server import ParameterClient
 from brpc_tpu.runtime.tensor import (PipelineWindow, TensorArena,
@@ -211,7 +212,18 @@ class Migrator:
             return 0  # an empty fleet has nowhere to put anything
         target = ShardMap(addrs, epoch=index, overrides=self._overrides)
         with self._reshard_mu:
-            return self._reshard_locked(index, addrs, target)
+            # One root span per reshard: every Handoff/Install/Retire/
+            # Commit leg (and each touched shard's server spans) parents
+            # here, so a reshard reads as ONE cross-process trace in the
+            # fleet observer instead of a scatter of unlinked moves.
+            with tracing.trace_span("Migrator/reshard") as sp:
+                tracing.annotate(
+                    f"epoch={index} shards={len(addrs)}")
+                moved = self._reshard_locked(index, addrs, target)
+                tracing.annotate(f"moved={moved} stuck={self.stuck_moves}")
+                if self.stuck_moves:
+                    sp.set_error(1)
+                return moved
 
     def _reshard_locked(self, index: int, addrs: List[str],
                         target: ShardMap) -> int:
@@ -283,9 +295,23 @@ class Migrator:
             for link, moves in links:
                 moved += self._migrate_link(link[0], link[1], moves)
             return moved
+        # Link threads carry the reshard span's context (the native trace
+        # context is per-thread — see FleetClient._scatter): every move's
+        # RPC legs stay inside the one reshard trace.
+        ctx = tracing.current_trace()
+
+        def run_link(src, dst, moves):
+            if ctx != (0, 0):
+                tracing.set_trace(*ctx)
+            try:
+                return self._migrate_link(src, dst, moves)
+            finally:
+                if ctx != (0, 0):
+                    tracing.clear_trace()
+
         with ThreadPoolExecutor(max_workers=min(self.max_links, len(links)),
                                 thread_name_prefix="fleet-migrate") as pool:
-            futs = [pool.submit(self._migrate_link, src, dst, moves)
+            futs = [pool.submit(run_link, src, dst, moves)
                     for (src, dst), moves in links]
             wait(futs)
         for f in futs:

@@ -1,19 +1,25 @@
 """Cross-language observability: one /vars + /brpc_metrics + /rpcz view
 covering the native fiber runtime AND the Python/JAX tensor path.
 
-  metrics — Python-registered native tbvars (Counter / LatencyRecorder /
-            PassiveGauge) and dump helpers (/vars, Prometheus).
-  tracing — rpcz from Python: trace_span() spans, stage() annotations,
-            trace-context access, span dumps.
-  health  — the self-monitoring layer: stall-watchdog state machine
-            (/healthz), flight-recorder snapshots (/flightz), stall
-            auto-dump paths.
+  metrics    — Python-registered native tbvars (Counter / LatencyRecorder /
+               PassiveGauge) and dump helpers (/vars, Prometheus).
+  tracing    — rpcz from Python: trace_span() spans, stage() annotations,
+               trace-context access, span dumps, 1-in-N root sampling.
+  health     — the self-monitoring layer: stall-watchdog state machine
+               (/healthz), flight-recorder snapshots (/flightz), stall
+               auto-dump paths.
+  fleet_view — the fleet plane: cross-process trace assembly (skew-
+               corrected), registry-driven metric/health aggregation
+               (the Python twin of /fleetz).
 
 Importing this package touches nothing native; the native library loads
 on first use (same lazy discipline as brpc_tpu.runtime.native).
 """
 
-from brpc_tpu.observability import health, metrics, tracing
+from brpc_tpu.observability import fleet_view, health, metrics, tracing
+from brpc_tpu.observability.fleet_view import (AssembledTrace, FleetObserver,
+                                               assemble_trace,
+                                               estimate_skew_us)
 from brpc_tpu.observability.health import (flight_events, flight_snapshot,
                                            health_state, last_dump_path,
                                            start_watchdog)
@@ -21,16 +27,21 @@ from brpc_tpu.observability.metrics import (Counter, LatencyRecorder,
                                             PassiveGauge, counter,
                                             dump_prometheus, dump_vars,
                                             gauge, latency)
-from brpc_tpu.observability.tracing import (annotate, current_trace,
-                                            dump_rpcz, rpcz_enable,
-                                            rpcz_enabled, stage, trace_span)
+from brpc_tpu.observability.tracing import (RpczDisabled, annotate,
+                                            current_trace, dump_rpcz,
+                                            rpcz_enable, rpcz_enabled,
+                                            rpcz_sample_1_in_n,
+                                            rpcz_set_sample_1_in_n, stage,
+                                            trace_span)
 
 __all__ = [
-    "metrics", "tracing", "health",
+    "metrics", "tracing", "health", "fleet_view",
     "Counter", "LatencyRecorder", "PassiveGauge",
     "counter", "latency", "gauge", "dump_vars", "dump_prometheus",
     "annotate", "current_trace", "dump_rpcz", "rpcz_enable", "rpcz_enabled",
+    "rpcz_sample_1_in_n", "rpcz_set_sample_1_in_n", "RpczDisabled",
     "stage", "trace_span",
+    "AssembledTrace", "FleetObserver", "assemble_trace", "estimate_skew_us",
     "start_watchdog", "health_state", "last_dump_path",
     "flight_snapshot", "flight_events",
 ]

@@ -35,12 +35,43 @@ from typing import Iterator, List, Optional, Tuple
 from brpc_tpu.runtime import native
 
 
+class RpczDisabled(RuntimeError):
+    """Typed "rpcz is off" signal.
+
+    Raised by span dumps when collection is disabled, so callers (tests,
+    the fleet observer) can tell "no spans because tracing is off" from
+    "traced but nothing matched" — the two used to be the same empty
+    list, which silently read as 'no traffic'. `source` names where the
+    dump was attempted ("local", or a shard address in fleet context).
+    """
+
+    def __init__(self, source: str = "local"):
+        super().__init__(
+            f"rpcz is disabled on {source} (enable with rpcz_enable() or "
+            "GET /flags/rpcz_enabled?setvalue=1)")
+        self.source = source
+
+
 def rpcz_enable(on: bool = True) -> None:
     native.lib().tbrpc_rpcz_set_enabled(1 if on else 0)
 
 
 def rpcz_enabled() -> bool:
     return native.lib().tbrpc_rpcz_enabled() != 0
+
+
+def rpcz_set_sample_1_in_n(n: int) -> None:
+    """Keep rpcz live at bounded cost: collect 1 of every `n` NEW root
+    traces (1 = every trace). Spans inside a sampled trace always record,
+    so sampled traces stay complete fleet-wide. Reloadable — the same
+    storage as the native rpcz_sample_1_in_n flag."""
+    if native.lib().tbrpc_flag_set(b"rpcz_sample_1_in_n",
+                                   str(int(n)).encode()) != 0:
+        raise ValueError(f"rpcz_sample_1_in_n rejected {n!r} (must be >= 1)")
+
+
+def rpcz_sample_1_in_n() -> int:
+    return native.lib().tbrpc_rpcz_sample_1_in_n()
 
 
 def current_trace() -> Tuple[int, int]:
@@ -113,6 +144,13 @@ def trace_span(name: str, *, server_side: bool = False
         yield SpanHandle(0, 0)
         return
     parent_trace, parent_span = current_trace()
+    # Head sampling: a span with NO surrounding context would start a new
+    # root trace — consult the 1-in-N gate exactly like the native client
+    # path does. Unsampled roots run untraced (inert handle, no context
+    # set); spans inside a sampled trace never re-consult the gate.
+    if parent_trace == 0 and not L.tbrpc_rpcz_sample_root():
+        yield SpanHandle(0, 0)
+        return
     trace_id = parent_trace if parent_trace != 0 else new_id()
     span_id = new_id()
     handle = SpanHandle(trace_id, span_id)
@@ -138,10 +176,16 @@ def trace_span(name: str, *, server_side: bool = False
 def dump_rpcz(trace_id: int = 0) -> List[dict]:
     """Collected spans as dicts (annotations included): every span field
     the /rpcz page renders, without the HTTP round-trip. trace_id != 0
-    narrows to one trace, oldest first."""
+    narrows to one trace, oldest first.
+
+    Raises :class:`RpczDisabled` when collection is off — an empty list
+    always means "nothing matched", never "tracing wasn't running".
+    """
     from brpc_tpu.observability.metrics import _snapshot_buf
 
     L = native.lib()
+    if L.tbrpc_rpcz_enabled() == 0:
+        raise RpczDisabled("local")
     raw = _snapshot_buf(L.tbrpc_rpcz_dump_json, trace_id)
     return json.loads(raw.decode(errors="replace")) if raw else []
 

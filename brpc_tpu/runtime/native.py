@@ -204,6 +204,12 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_debug_induce_contention.argtypes = [ctypes.c_int, ctypes.c_int64]
     L.tbrpc_rpcz_enabled.restype = ctypes.c_int
     L.tbrpc_rpcz_set_enabled.argtypes = [ctypes.c_int]
+    # Head sampling for Python-created ROOT spans (trace_span): combines
+    # rpcz_enabled with the reloadable rpcz_sample_1_in_n flag.
+    L.tbrpc_rpcz_sample_root.restype = ctypes.c_int
+    L.tbrpc_rpcz_sample_root.argtypes = []
+    L.tbrpc_rpcz_sample_1_in_n.restype = ctypes.c_int
+    L.tbrpc_rpcz_sample_1_in_n.argtypes = []
     L.tbrpc_trace_new_id.restype = ctypes.c_uint64
     L.tbrpc_trace_current.argtypes = [
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]

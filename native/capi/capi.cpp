@@ -1056,37 +1056,9 @@ int64_t tbrpc_vars_dump_prometheus(char* buf, size_t cap) {
 }
 
 int64_t tbrpc_rpcz_dump_json(uint64_t trace_id, char* buf, size_t cap) {
-  std::vector<Span> spans;
-  SpanStore::global().Dump(&spans, trace_id);
-  if (trace_id != 0) std::reverse(spans.begin(), spans.end());  // oldest 1st
-  char hex[20];
-  tbutil::JsonValue arr = tbutil::JsonValue::Array();
-  for (const Span& s : spans) {
-    tbutil::JsonValue o = tbutil::JsonValue::Object();
-    // Ids as 16-digit hex strings: they are opaque u64 tokens (JSON
-    // numbers would lose the top bit), and /rpcz?trace= takes hex.
-    snprintf(hex, sizeof(hex), "%016llx",
-             static_cast<unsigned long long>(s.trace_id));
-    o.set("trace_id", hex);
-    snprintf(hex, sizeof(hex), "%016llx",
-             static_cast<unsigned long long>(s.span_id));
-    o.set("span_id", hex);
-    snprintf(hex, sizeof(hex), "%016llx",
-             static_cast<unsigned long long>(s.parent_span_id));
-    o.set("parent_span_id", hex);
-    o.set("server_side", s.server_side);
-    o.set("start_us", s.start_us);
-    o.set("end_us", s.end_us);
-    o.set("latency_us", s.end_us - s.start_us);
-    o.set("error_code", s.error_code);
-    o.set("service_method", s.service_method);
-    o.set("peer", tbutil::endpoint2str(s.remote_side));
-    tbutil::JsonValue ann = tbutil::JsonValue::Array();
-    for (const std::string& a : s.annotations) ann.push_back(a);
-    o.set("annotations", std::move(ann));
-    arr.push_back(std::move(o));
-  }
-  return copy_out(arr.Dump(), buf, cap);
+  // Renderer shared with the console's /rpcz?format=json (span.cpp) — the
+  // cross-process fleet scrape and the in-process dump cannot drift.
+  return copy_out(RpczDumpJson(trace_id), buf, cap);
 }
 
 int64_t tbrpc_debug_dump_fibers(char* buf, size_t cap) {
@@ -1251,6 +1223,17 @@ int tbrpc_rpcz_enabled(void) { return rpcz_enabled() ? 1 : 0; }
 
 void tbrpc_rpcz_set_enabled(int on) {
   FlagRegistry::global().Set("rpcz_enabled", on != 0 ? "1" : "0");
+}
+
+int tbrpc_rpcz_sample_root(void) {
+  // One combined gate for Python-created root spans (trace_span):
+  // rpcz off OR an unsampled root both mean "don't collect".
+  return rpcz_enabled() && rpcz_sample_root() ? 1 : 0;
+}
+
+int tbrpc_rpcz_sample_1_in_n(void) {
+  const int64_t n = rpcz_sample_1_in_n();
+  return n > INT32_MAX ? INT32_MAX : static_cast<int>(n);
 }
 
 uint64_t tbrpc_trace_new_id(void) { return new_trace_or_span_id(); }

@@ -290,6 +290,16 @@ int64_t tbrpc_debug_induce_contention(int nfibers, int64_t ms);
 // a Python client thread can carry a root span across its calls too.
 int tbrpc_rpcz_enabled(void);
 void tbrpc_rpcz_set_enabled(int on);
+// Head-sampling gate for a Python-created ROOT span (trace_span with no
+// surrounding context): 1 = collect this root. Combines rpcz_enabled with
+// the reloadable rpcz_sample_1_in_n flag (1 = every trace; N = 1-in-N on
+// average), the same gate the native client/server protocols consult, so
+// production keeps rpcz live at bounded cost. Spans inside an already
+// sampled trace must NOT re-consult this — a sampled trace stays complete.
+int tbrpc_rpcz_sample_root(void);
+// Current rpcz_sample_1_in_n value (>= 1; set via tbrpc_flag_set or
+// /flags/rpcz_sample_1_in_n?setvalue=N).
+int tbrpc_rpcz_sample_1_in_n(void);
 uint64_t tbrpc_trace_new_id(void);
 void tbrpc_trace_current(uint64_t* trace_id, uint64_t* span_id);
 void tbrpc_trace_set(uint64_t trace_id, uint64_t span_id);

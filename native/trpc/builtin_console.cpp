@@ -4,6 +4,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <cstring>
 #include <map>
 #include <mutex>
 #include <string>
@@ -20,8 +21,12 @@
 #include "tbvar/prometheus.h"
 #include "tbvar/series.h"
 #include "tbvar/variable.h"
+#include "tbutil/json.h"
+#include "trpc/channel.h"
 #include "trpc/compress.h"
+#include "trpc/controller.h"
 #include "trpc/flags.h"
+#include "trpc/registry.h"
 #include "trpc/stall_watchdog.h"
 #include "trpc/http_protocol.h"
 #include "trpc/server.h"
@@ -54,9 +59,13 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "machine + transitions (JSON)</li>"
       "<li><a href=\"/flightz\">/flightz</a> — flight recorder: merged "
       "per-thread event rings (?tid=&amp;type=&amp;a=&amp;b=&amp;max=)</li>"
-      "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans</li>"
+      "<li><a href=\"/rpcz\">/rpcz</a> — sampled RPC spans "
+      "(?format=json for the fleet scrape)</li>"
       "<li><a href=\"/tensorz\">/tensorz</a> — tensor arenas + data-plane "
       "stage latencies</li>"
+      "<li><a href=\"/fleetz\">/fleetz</a> — fleet pane of glass: "
+      "registry-driven per-shard health/qps/p99/codec/version-lag scrape "
+      "(?tag=&amp;format=json)</li>"
       "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
       "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
       "<li><a href=\"/heap\">/heap</a> — sampling heap profile (in-use)</li>"
@@ -389,6 +398,291 @@ void healthz_page(const HttpRequest&, HttpResponse* resp) {
   resp->body += '\n';
 }
 
+// ---------------- /fleetz: the fleet pane of glass ----------------
+// Registry-driven: the member list is the installed RegistryService's
+// live table (the same source of truth FleetClient routes by), and each
+// member's numbers come from ITS builtin console over plain HTTP
+// (/healthz JSON + /vars lines + /flags), so the page works against any
+// mix of processes and hosts with no new per-shard wire surface.
+
+static auto* g_fleetz_timeout_ms = TRPC_DEFINE_FLAG(
+    fleetz_scrape_timeout_ms, 1500,
+    "per-request timeout of the /fleetz fan-out scrape");
+
+struct ShardScrape {
+  std::string addr, tag;
+  bool reachable = false;
+  std::string health = "unreachable";
+  std::string reason;
+  double qps = 0;              // sum over rpc_server_*_qps
+  int64_t p99_us = 0;          // max over rpc_server_*_latency_99
+  int64_t codec_logical = 0;   // tensor_codec_bytes_logical
+  int64_t codec_wire = 0;      // tensor_codec_bytes_wire
+  int64_t version_lag_max = 0; // max over param_server_version_lag_*
+  int rpcz_on = -1;            // -1 = unknown (flags page unreadable)
+  int64_t rpcz_sample_n = 0;
+};
+
+// One GET against a member's builtin console (path WITHOUT the leading
+// '/') over an already-Init'ed channel; false on timeout/HTTP failure.
+// Runs on a scrape fiber — the nested call parks the fiber, never a
+// worker. The channel is per-shard so the (up to) 3 GETs of one scrape
+// share a connection instead of paying 3 connects.
+bool fleet_http_get(Channel* ch, const std::string& path,
+                    std::string* body) {
+  tbutil::IOBuf req, respb;
+  Controller cntl;
+  ch->CallMethod(path, &cntl, req, &respb, nullptr);
+  if (cntl.Failed()) return false;
+  *body = respb.to_string();
+  return true;
+}
+
+bool str_ends_with(const std::string& s, const char* suffix) {
+  const size_t n = strlen(suffix);
+  return s.size() >= n && s.compare(s.size() - n, n, suffix) == 0;
+}
+
+// Fold one member's /vars dump ("name : value" lines) into the scrape.
+void fleetz_fold_vars(const std::string& text, ShardScrape* s) {
+  size_t pos = 0;
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    if (eol == std::string::npos) eol = text.size();
+    const std::string line = text.substr(pos, eol - pos);
+    pos = eol + 1;
+    const size_t sep = line.find(" : ");
+    if (sep == std::string::npos) continue;
+    const std::string name = line.substr(0, sep);
+    const char* val = line.c_str() + sep + 3;
+    if (name.rfind("rpc_server_", 0) == 0) {
+      if (str_ends_with(name, "_qps")) {
+        s->qps += strtod(val, nullptr);
+      } else if (str_ends_with(name, "_latency_99")) {
+        s->p99_us = std::max<int64_t>(s->p99_us, strtoll(val, nullptr, 10));
+      }
+    } else if (name == "tensor_codec_bytes_logical") {
+      s->codec_logical = strtoll(val, nullptr, 10);
+    } else if (name == "tensor_codec_bytes_wire") {
+      s->codec_wire = strtoll(val, nullptr, 10);
+    } else if (name.rfind("param_server_version_lag_", 0) == 0) {
+      s->version_lag_max =
+          std::max<int64_t>(s->version_lag_max, strtoll(val, nullptr, 10));
+    }
+  }
+}
+
+// Fold the member's /flags page ("name = value[ (default D)]  # help").
+void fleetz_fold_flags(const std::string& text, ShardScrape* s) {
+  auto flag_value = [&text](const char* name, int64_t dflt) -> int64_t {
+    const std::string want = std::string(name) + " = ";
+    size_t pos = text.rfind(want, 0) == 0 ? 0 : text.find("\n" + want);
+    if (pos == std::string::npos) return dflt;
+    if (pos != 0) pos += 1;  // skip the '\n'
+    return strtoll(text.c_str() + pos + want.size(), nullptr, 10);
+  };
+  s->rpcz_on = static_cast<int>(flag_value("rpcz_enabled", -1));
+  s->rpcz_sample_n = flag_value("rpcz_sample_1_in_n", 0);
+}
+
+ShardScrape fleetz_scrape_one(const RegistryService::Member& m) {
+  ShardScrape s;
+  s.addr = m.addr;
+  s.tag = m.tag;
+  Channel ch;
+  ChannelOptions opts;
+  opts.protocol = kHttpProtocolIndex;
+  opts.timeout_ms = g_fleetz_timeout_ms->load(std::memory_order_relaxed);
+  opts.max_retry = 0;
+  if (ch.Init(m.addr.c_str(), &opts) != 0) return s;
+  std::string body;
+  if (fleet_http_get(&ch, "healthz", &body)) {
+    s.reachable = true;
+    auto parsed = tbutil::JsonValue::Parse(body);
+    if (parsed && parsed->is_object()) {
+      const tbutil::JsonValue* st = parsed->find("state");
+      s.health = st != nullptr ? st->as_string() : "unknown";
+      const tbutil::JsonValue* rs = parsed->find("reason");
+      if (rs != nullptr) s.reason = rs->as_string();
+    } else {
+      s.health = "unknown";
+    }
+  }
+  if (s.reachable && fleet_http_get(&ch, "vars", &body)) {
+    fleetz_fold_vars(body, &s);
+  }
+  if (s.reachable && fleet_http_get(&ch, "flags", &body)) {
+    fleetz_fold_flags(body, &s);
+  }
+  return s;
+}
+
+// Fiber thunk: one member's scrape, so the page-level fan-out really is
+// concurrent — a serial walk would cost up to timeout_ms PER dead
+// member (64 dead members = minutes for one page load).
+struct FleetzScrapeArg {
+  const RegistryService::Member* member;
+  ShardScrape* out;
+};
+
+void* fleetz_scrape_thunk(void* raw) {
+  auto* a = static_cast<FleetzScrapeArg*>(raw);
+  *a->out = fleetz_scrape_one(*a->member);
+  return nullptr;
+}
+
+// Severity order for the fleet health rollup (worst wins).
+int health_rank(const std::string& h) {
+  if (h == "ok") return 0;
+  if (h == "degraded") return 1;
+  if (h == "stalled") return 2;
+  return 3;  // unreachable / unknown
+}
+
+void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
+  std::vector<RegistryService::Member> members;
+  RegistryService::Snapshot(&members, req.query_param("tag"));
+  // Bound the fan-out (fiber count + page size); truncation is
+  // reported, never silent. The scrapes run CONCURRENTLY — one fiber
+  // per member, joined below — so the page answers in ~one scrape
+  // timeout even when members are down, not members x timeout.
+  constexpr size_t kMaxScrape = 64;
+  const size_t total_members = members.size();
+  if (members.size() > kMaxScrape) members.resize(kMaxScrape);
+  std::vector<ShardScrape> shards(members.size());
+  std::vector<FleetzScrapeArg> args(members.size());
+  std::vector<tbthread::fiber_t> tids(members.size());
+  std::vector<bool> started(members.size(), false);
+  for (size_t i = 0; i < members.size(); ++i) {
+    args[i] = FleetzScrapeArg{&members[i], &shards[i]};
+    started[i] = tbthread::fiber_start_background(
+                     &tids[i], nullptr, fleetz_scrape_thunk, &args[i]) == 0;
+  }
+  for (size_t i = 0; i < members.size(); ++i) {
+    if (started[i]) {
+      tbthread::fiber_join(tids[i], nullptr);
+    } else {
+      fleetz_scrape_thunk(&args[i]);  // spawn failed: scrape inline
+    }
+  }
+  // Rollups.
+  double qps_total = 0;
+  int64_t p99_max = 0, lag_max = 0, logical = 0, wire = 0;
+  int worst = 0;
+  size_t reachable = 0;
+  std::vector<const ShardScrape*> rpcz_off;
+  for (const auto& s : shards) {
+    qps_total += s.qps;
+    p99_max = std::max(p99_max, s.p99_us);
+    lag_max = std::max(lag_max, s.version_lag_max);
+    logical += s.codec_logical;
+    wire += s.codec_wire;
+    worst = std::max(worst, health_rank(s.health));
+    if (s.reachable) ++reachable;
+    if (s.rpcz_on == 0) rpcz_off.push_back(&s);
+  }
+  static const char* kWorstNames[] = {"ok", "degraded", "stalled",
+                                      "unreachable"};
+  const char* health_worst =
+      shards.empty() ? "empty" : kWorstNames[worst];
+  const double codec_ratio =
+      wire > 0 ? static_cast<double>(logical) / static_cast<double>(wire)
+               : 0.0;
+  if (req.query_param("format") == "json") {
+    resp->content_type = "application/json";
+    tbutil::JsonValue o = tbutil::JsonValue::Object();
+    tbutil::JsonValue arr = tbutil::JsonValue::Array();
+    for (const auto& s : shards) {
+      tbutil::JsonValue e = tbutil::JsonValue::Object();
+      e.set("addr", s.addr);
+      e.set("tag", s.tag);
+      e.set("reachable", s.reachable);
+      e.set("health", s.health);
+      if (!s.reason.empty()) e.set("reason", s.reason);
+      e.set("qps", s.qps);
+      e.set("p99_us", s.p99_us);
+      e.set("codec_bytes_logical", s.codec_logical);
+      e.set("codec_bytes_wire", s.codec_wire);
+      e.set("version_lag_max", s.version_lag_max);
+      e.set("rpcz_enabled", int64_t{s.rpcz_on});
+      e.set("rpcz_sample_1_in_n", s.rpcz_sample_n);
+      arr.push_back(std::move(e));
+    }
+    o.set("shards", std::move(arr));
+    tbutil::JsonValue roll = tbutil::JsonValue::Object();
+    roll.set("members", int64_t(total_members));
+    roll.set("scraped", int64_t(shards.size()));
+    roll.set("reachable", int64_t(reachable));
+    roll.set("qps_total", qps_total);
+    roll.set("p99_max_us", p99_max);
+    roll.set("health_worst", health_worst);
+    roll.set("codec_ratio", codec_ratio);
+    roll.set("version_lag_max", lag_max);
+    tbutil::JsonValue off = tbutil::JsonValue::Array();
+    for (const auto* s : rpcz_off) off.push_back(s->addr);
+    roll.set("rpcz_off", std::move(off));
+    o.set("rollup", std::move(roll));
+    resp->body = o.Dump();
+    return;
+  }
+  std::string& b = resp->body;
+  char line[256];
+  snprintf(line, sizeof(line),
+           "fleet: %zu member(s), %zu reachable (registry-driven scrape"
+           "%s%s)\n",
+           total_members, reachable,
+           req.query_param("tag").empty() ? ""
+                                          : (", tag=" +
+                                             req.query_param("tag")).c_str(),
+           total_members > shards.size() ? "; TRUNCATED to first 64" : "");
+  b += line;
+  snprintf(line, sizeof(line),
+           "rollup: health=%s qps_total=%.0f p99_max=%lldus "
+           "codec_ratio=%.2f version_lag_max=%lld\n\n",
+           health_worst, qps_total, static_cast<long long>(p99_max),
+           codec_ratio, static_cast<long long>(lag_max));
+  b += line;
+  snprintf(line, sizeof(line), "%-21s %-8s %-11s %9s %9s %7s %5s %s\n",
+           "shard", "tag", "health", "qps", "p99_us", "lag", "codec",
+           "rpcz");
+  b += line;
+  for (const auto& s : shards) {
+    const double ratio =
+        s.codec_wire > 0 ? static_cast<double>(s.codec_logical) /
+                               static_cast<double>(s.codec_wire)
+                         : 0.0;
+    std::string rpcz = s.rpcz_on < 0    ? "?"
+                       : s.rpcz_on == 0 ? "OFF"
+                                        : (s.rpcz_sample_n > 1
+                                               ? "1/" + std::to_string(
+                                                            s.rpcz_sample_n)
+                                               : "on");
+    snprintf(line, sizeof(line),
+             "%-21s %-8s %-11s %9.0f %9lld %7lld %5.2f %s\n", s.addr.c_str(),
+             s.tag.c_str(), s.health.c_str(), s.qps,
+             static_cast<long long>(s.p99_us),
+             static_cast<long long>(s.version_lag_max), ratio, rpcz.c_str());
+    b += line;
+    if (!s.reason.empty() && s.health != "ok") {
+      b += "    reason: " + s.reason + "\n";
+    }
+  }
+  if (!rpcz_off.empty()) {
+    b += "\nrpcz sampling OFF on:";
+    for (const auto* s : rpcz_off) {
+      b += ' ';
+      b += s->addr;
+    }
+    b += "  (traces from these shards will be missing their server legs)\n";
+  }
+  if (shards.empty()) {
+    b += "(no registered members";
+    b += req.query_param("tag").empty() ? "" : " under this tag";
+    b += "; register shards via /registry/register — see "
+         "brpc_tpu.fleet)\n";
+  }
+}
+
 // /flightz: the flight recorder — every thread ring merged and time-sorted.
 //   ?max=N    newest N events (default 256, cap 65536)
 //   ?tid=N    one OS thread
@@ -477,15 +771,33 @@ void fibers_page(const HttpRequest&, HttpResponse* resp) {
 // builtin/rpcz_service.cpp).
 void rpcz_page(const HttpRequest& req, HttpResponse* resp) {
   std::string& b = resp->body;
-  if (!rpcz_enabled()) {
-    b = "rpcz is off. Enable span collection live:\n"
-        "  GET /flags/rpcz_enabled?setvalue=1\n";
-    // Still fall through and show whatever was collected while it was on.
-  }
   uint64_t want_trace = 0;
   const std::string t = req.query_param("trace");
   if (!t.empty()) {
     want_trace = strtoull(t.c_str(), nullptr, 16);
+  }
+  // ?format=json: the machine-readable scrape the fleet observer
+  // assembles cross-process traces from. The envelope is HONEST about
+  // collection state — `enabled:false` is a typed "rpcz disabled" signal,
+  // not an indistinguishable empty span list.
+  if (req.query_param("format") == "json") {
+    resp->content_type = "application/json";
+    b = "{\"enabled\":";
+    b += rpcz_enabled() ? "true" : "false";
+    b += ",\"sample_1_in_n\":";
+    b += std::to_string(rpcz_sample_1_in_n());
+    b += ",\"spans\":";
+    b += RpczDumpJson(want_trace);
+    b += "}";
+    return;
+  }
+  if (!rpcz_enabled()) {
+    b = "rpcz is off. Enable span collection live:\n"
+        "  GET /flags/rpcz_enabled?setvalue=1\n";
+    // Still fall through and show whatever was collected while it was on.
+  } else if (rpcz_sample_1_in_n() > 1) {
+    b = "rpcz sampling 1-in-" + std::to_string(rpcz_sample_1_in_n()) +
+        " new root traces (/flags/rpcz_sample_1_in_n)\n";
   }
   std::vector<Span> spans;
   SpanStore::global().Dump(&spans, want_trace);
@@ -667,6 +979,7 @@ void RegisterBuiltinConsole() {
     // scrape configs written for it must point here unchanged.
     RegisterHttpHandler("/brpc_metrics", metrics_page);
     RegisterHttpHandler("/tensorz", tensorz_page);
+    RegisterHttpHandler("/fleetz", fleetz_page);
     RegisterHttpHandler("/sockets", sockets_page);
     RegisterHttpHandler("/ids", ids_page);
     RegisterHttpHandler("/threads", threads_page);

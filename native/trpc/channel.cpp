@@ -122,13 +122,19 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
     cntl->_backup_request_ms = _options.backup_request_ms;
   }
   // rpcz: mint this leg's span, inheriting the fiber's trace context (set
-  // while a traced server handler runs) so nested calls link up.
+  // while a traced server handler runs) so nested calls link up. A call
+  // with NO surrounding context would start a new root trace — that is
+  // the head-sampling point (rpcz_sample_1_in_n): unsampled roots stay
+  // untraced end to end (trace_id 0 on the wire), sampled traces record
+  // every leg in every process they touch.
   if (rpcz_enabled()) {
     const TraceContext parent = current_trace_context();
-    cntl->_trace_id =
-        parent.trace_id != 0 ? parent.trace_id : new_trace_or_span_id();
-    cntl->_parent_span_id = parent.span_id;
-    cntl->_span_id = new_trace_or_span_id();
+    if (parent.trace_id != 0 || rpcz_sample_root()) {
+      cntl->_trace_id =
+          parent.trace_id != 0 ? parent.trace_id : new_trace_or_span_id();
+      cntl->_parent_span_id = parent.span_id;
+      cntl->_span_id = new_trace_or_span_id();
+    }
   }
   cntl->_service_method = service_method;
   cntl->_remote_side = _server;

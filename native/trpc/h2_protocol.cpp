@@ -661,9 +661,9 @@ void h2_process_request(InputMessageBase* base) {
   ms->OnRequested();
   const int64_t received_us = tbutil::gettimeofday_us();
   // rpcz: gRPC/h2 inbound carries no tstd trace fields — self-sample a
-  // root span, same policy as the other server protocols.
+  // root span, same policy as the other server protocols (1-in-N gated).
   uint64_t span_id = 0, span_trace = 0;
-  if (rpcz_enabled()) {
+  if (rpcz_enabled() && rpcz_sample_root()) {
     span_id = new_trace_or_span_id();
     span_trace = new_trace_or_span_id();
   }

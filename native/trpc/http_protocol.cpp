@@ -579,9 +579,9 @@ void http_process_request(InputMessageBase* base) {
   ms->OnRequested();
   const int64_t received_us = tbutil::gettimeofday_us();
   // rpcz: HTTP carries no inbound trace fields — self-sample a root span
-  // (same policy as tstd's untraced-inbound case).
+  // (same policy as tstd's untraced-inbound case, 1-in-N gated).
   uint64_t span_id = 0, span_trace = 0;
-  if (rpcz_enabled()) {
+  if (rpcz_enabled() && rpcz_sample_root()) {
     span_id = new_trace_or_span_id();
     span_trace = new_trace_or_span_id();
   }

@@ -224,6 +224,19 @@ void RegistryService::clear() {
   g_table.clear();
 }
 
+void RegistryService::Snapshot(std::vector<Member>* out,
+                               const std::string& tag) {
+  out->clear();
+  // Same O(1)-bounded critical section as the handlers above (map walk,
+  // capped at kMaxEntries; no parking inside).
+  std::lock_guard<std::mutex> lk(g_mu);  // tpulint: allow(fiber-blocking)
+  prune_locked(tbutil::gettimeofday_us());
+  for (const auto& [addr, e] : g_table) {
+    if (!tag.empty() && e.tag != tag) continue;
+    out->push_back(Member{addr, e.tag});
+  }
+}
+
 // ---------------- client ----------------
 
 RegistryClient::~RegistryClient() { Stop(); }  // header contract:

@@ -24,6 +24,7 @@
 #include <mutex>
 #include <string>
 #include <map>
+#include <vector>
 
 #include "trpc/periodic_reporter.h"
 
@@ -39,6 +40,15 @@ class RegistryService {
   static size_t live_count();
   // Drop everything (tests).
   static void clear();
+
+  struct Member {
+    std::string addr;
+    std::string tag;
+  };
+  // Live (unexpired) members, pruned first; tag != "" filters. The /fleetz
+  // console page fans its scrape out over exactly this list — the registry
+  // IS the fleet's source of truth for "who should be answering".
+  static void Snapshot(std::vector<Member>* out, const std::string& tag = "");
 };
 
 // Client side: keep one address registered with heartbeats at ttl/3.

@@ -6,6 +6,7 @@
 
 #include "tbthread/key.h"
 #include "tbutil/fast_rand.h"
+#include "tbutil/json.h"
 #include "trpc/flags.h"
 
 namespace trpc {
@@ -14,9 +15,28 @@ static auto* g_rpcz_enabled = TRPC_DEFINE_FLAG(
     rpcz_enabled, 0, "collect per-RPC spans for /rpcz (1 = on)");
 static auto* g_rpcz_max_spans = TRPC_DEFINE_FLAG(
     rpcz_max_spans, 2048, "span ring capacity (applied at first record)");
+// Production keeps rpcz live at bounded cost: only 1 of every N NEW root
+// traces is collected (validator keeps the divisor sane; 1 = every trace).
+// Registered through FlagRegistry so /flags/rpcz_sample_1_in_n?setvalue=N
+// and tbrpc_flag_set reload it live.
+static auto* g_rpcz_sample_1_in_n = FlagRegistry::global().DefineInt(
+    "rpcz_sample_1_in_n", 1,
+    "collect 1 of every N new root traces while rpcz is on (1 = all)",
+    [](int64_t v) { return v >= 1 && v <= (int64_t{1} << 32); });
 
 bool rpcz_enabled() {
   return g_rpcz_enabled->load(std::memory_order_relaxed) != 0;
+}
+
+int64_t rpcz_sample_1_in_n() {
+  const int64_t n = g_rpcz_sample_1_in_n->load(std::memory_order_relaxed);
+  return n >= 1 ? n : 1;
+}
+
+bool rpcz_sample_root() {
+  const int64_t n = g_rpcz_sample_1_in_n->load(std::memory_order_relaxed);
+  if (n <= 1) return true;
+  return tbutil::fast_rand() % static_cast<uint64_t>(n) == 0;
 }
 
 uint64_t new_trace_or_span_id() {
@@ -164,6 +184,40 @@ void EmitSpan(uint64_t trace_id, uint64_t span_id, uint64_t parent_span_id,
   sp.error_code = error_code;
   sp.service_method = name;
   SpanStore::global().Record(std::move(sp));
+}
+
+std::string RpczDumpJson(uint64_t trace_id) {
+  std::vector<Span> spans;
+  SpanStore::global().Dump(&spans, trace_id);
+  if (trace_id != 0) std::reverse(spans.begin(), spans.end());  // oldest 1st
+  char hex[20];
+  tbutil::JsonValue arr = tbutil::JsonValue::Array();
+  for (const Span& s : spans) {
+    tbutil::JsonValue o = tbutil::JsonValue::Object();
+    // Ids as 16-digit hex strings: they are opaque u64 tokens (JSON
+    // numbers would lose the top bit), and /rpcz?trace= takes hex.
+    snprintf(hex, sizeof(hex), "%016llx",
+             static_cast<unsigned long long>(s.trace_id));
+    o.set("trace_id", hex);
+    snprintf(hex, sizeof(hex), "%016llx",
+             static_cast<unsigned long long>(s.span_id));
+    o.set("span_id", hex);
+    snprintf(hex, sizeof(hex), "%016llx",
+             static_cast<unsigned long long>(s.parent_span_id));
+    o.set("parent_span_id", hex);
+    o.set("server_side", s.server_side);
+    o.set("start_us", s.start_us);
+    o.set("end_us", s.end_us);
+    o.set("latency_us", s.end_us - s.start_us);
+    o.set("error_code", s.error_code);
+    o.set("service_method", s.service_method);
+    o.set("peer", tbutil::endpoint2str(s.remote_side));
+    tbutil::JsonValue ann = tbutil::JsonValue::Array();
+    for (const std::string& a : s.annotations) ann.push_back(a);
+    o.set("annotations", std::move(ann));
+    arr.push_back(std::move(o));
+  }
+  return arr.Dump();
 }
 
 // ---------------- fiber-local context ----------------

@@ -53,6 +53,25 @@ class SpanStore {
 // True when spans should be collected (rpcz_enabled flag, hot-path cached).
 bool rpcz_enabled();
 
+// Head sampling for always-on production rpcz (rpcz_sample_1_in_n flag,
+// default 1 = every trace). Consulted ONLY where a NEW root trace would be
+// minted (a client call with no surrounding context; a server request whose
+// wire meta carries no trace_id): true = collect this root. Spans that are
+// already part of a sampled trace are never re-gated — a sampled trace
+// stays complete across every process it touches, because only sampled
+// clients stamp trace ids onto the wire. 1-in-n is probabilistic
+// (fast_rand), so concurrent callers need no shared counter line.
+bool rpcz_sample_root();
+// Current rpcz_sample_1_in_n value (>= 1).
+int64_t rpcz_sample_1_in_n();
+
+// The collected spans as a JSON array string (newest first; trace_id != 0
+// filters to one trace, oldest first) — one renderer shared by the capi
+// dump (tbrpc_rpcz_dump_json) and the console's /rpcz?format=json, so the
+// cross-process scrape the fleet observer does cannot drift from the
+// in-process dump.
+std::string RpczDumpJson(uint64_t trace_id);
+
 // Fiber-local trace context (valid while a traced handler runs).
 struct TraceContext {
   uint64_t trace_id = 0;

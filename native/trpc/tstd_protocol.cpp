@@ -478,7 +478,10 @@ void tstd_process_request(InputMessageBase* base) {
   // calls link up.
   uint64_t server_span_id = 0;
   uint64_t span_trace_id = msg->meta.trace_id;
-  if (rpcz_enabled()) {
+  // A request carrying a trace_id belongs to a trace its CLIENT already
+  // sampled — always record, or the assembled fleet trace loses legs. An
+  // untraced inbound self-samples a fresh root at 1-in-N.
+  if (rpcz_enabled() && (span_trace_id != 0 || rpcz_sample_root())) {
     server_span_id = new_trace_or_span_id();
     if (span_trace_id == 0) span_trace_id = new_trace_or_span_id();
     acc.set_trace(span_trace_id, server_span_id, msg->meta.span_id);

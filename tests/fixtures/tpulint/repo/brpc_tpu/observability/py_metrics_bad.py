@@ -1,9 +1,12 @@
 """metric-name positives, Python side: charset violation + collisions
 (python-python and python-vs-native — the capi lands both in ONE native
 registry, so "fixture_dup_metric" here collides with the expose() in
-native/trpc/mx_bad.cpp)."""
+native/trpc/mx_bad.cpp). repointable_gauge registrations (the fleet_view
+rollup style) join the same collision namespace: the first publish of a
+name IS an immortal native registration."""
 
 from brpc_tpu.observability import counter, gauge, latency
+from brpc_tpu.observability import metrics as obs
 
 
 def register():
@@ -13,4 +16,8 @@ def register():
     second = counter("py_fixture_stage")  # py-py collision
     cross = counter("fixture_dup_metric")  # py-native collision
     ok = gauge("py_fixture_busy_bytes", lambda: 0)  # clean
+    # fleet_view-style shard-rollup registration: collides with `first`.
+    obs.repointable_gauge("py_fixture_stage", lambda: 0)
+    obs.repointable_gauge("py fixture rg bad", lambda: 0)  # charset
+    obs.repointable_gauge("py_fixture_rollup_ok", lambda: 0)  # clean
     return bad, sq_bad, first, second, cross, ok

@@ -35,6 +35,11 @@ int tbrpc_fix_set_inline(void* server, const char* service, int enabled);
 // Niladic entry-point shape (mirrors tbrpc_registry_install): an explicit
 // (void) parameter list must normalise to the lock's "int()" spelling.
 int tbrpc_fix_registry_install(void);
+// rpcz head-sampling gate shape (mirrors tbrpc_rpcz_sample_root /
+// tbrpc_rpcz_sample_1_in_n, the fleet-observability sampling surface):
+// a second niladic int beside registry_install pins that SAME-shaped
+// niladic symbols stay distinct entries in the lock, not merged.
+int tbrpc_fix_sample_root(void);
 // Tensor-codec accounting shape (mirrors tbrpc_tensor_codec_note): a
 // void-returning entry point with uint64_t scalar params, kept in sync
 // with the lock — pins that the parser keeps unsigned fixed-width

@@ -1,0 +1,560 @@
+"""Fleet-wide observability plane (ISSUE 8 acceptance surface).
+
+Pure half (runs in tier-1 with no native build):
+  * NTP-style per-shard clock-skew estimation from matched client/server
+    span pairs, and its chaining across sources;
+  * cross-process trace assembly: parentage, dedup, monotone corrected
+    timestamps, orphan handling, typed rpcz-off honesty;
+  * Prometheus relabeling (shard label injection) + fleet rollup math.
+
+Native half (skips cleanly without libbrpc_tpu.so), under an ARMED stall
+watchdog so a wedge in the new scrape paths becomes a stall dump:
+  * a REAL 2-process fleet: a client root span runs through FleetClient
+    scatter/gather to 2 shard SUBPROCESSES and the FleetObserver
+    assembles client root + client legs + both shards' server spans into
+    ONE parentage-correct, time-ordered trace;
+  * /fleetz (text + JSON) scraped live from the registry membership, and
+    its honesty about shards whose rpcz sampling is off;
+  * rpcz_sample_1_in_n on/off A/B (roots suppressed, sampled traces stay
+    complete) and the typed RpczDisabled signal from dump_rpcz.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from brpc_tpu.observability.fleet_view import (AssembledTrace, ZERO_ID,
+                                               assemble_trace,
+                                               estimate_skew_us,
+                                               fold_exposition, fold_flags,
+                                               fold_vars,
+                                               relabel_exposition, rollup)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Pure half: skew estimation + assembly (tier-1, no native lib needed).
+# ---------------------------------------------------------------------------
+
+def _span(trace, span, parent, source, start, end, server=False,
+          method="m", annotations=()):
+    return {"trace_id": trace, "span_id": span, "parent_span_id": parent,
+            "server_side": server, "start_us": start, "end_us": end,
+            "error_code": 0, "service_method": method, "peer": "",
+            "annotations": list(annotations), "source": source}
+
+
+T = "00000000000000aa"
+
+
+def _two_shard_spans():
+    """Client 'local' (reference clock), shard A running +5s ahead, shard
+    B running -3s behind; asymmetric network delays so the estimator has
+    to average, not just subtract."""
+    base = 1_000_000_000
+    spans = [
+        _span(T, "r" + "0" * 15, ZERO_ID, "local",
+              base, base + 10_000, method="root"),
+        _span(T, "c1" + "0" * 14, "r" + "0" * 15, "local",
+              base + 1_000, base + 5_000, method="A/pull"),
+        _span(T, "c2" + "0" * 14, "r" + "0" * 15, "local",
+              base + 1_200, base + 6_000, method="B/pull"),
+    ]
+    skew_a, skew_b = 5_000_000, -3_000_000
+    # Shard A server span: truly [base+2000, base+4500] (out delay 1000,
+    # back delay 500), recorded on A's skewed clock.
+    spans.append(_span(T, "s1" + "0" * 14, "c1" + "0" * 14, "A",
+                       base + 2_000 + skew_a, base + 4_500 + skew_a,
+                       server=True, method="A/pull"))
+    # Shard B server span: truly [base+2200, base+5600].
+    spans.append(_span(T, "s2" + "0" * 14, "c2" + "0" * 14, "B",
+                       base + 2_200 + skew_b, base + 5_600 + skew_b,
+                       server=True, method="B/pull"))
+    return spans, skew_a, skew_b
+
+
+def test_skew_estimation_recovers_offsets():
+    spans, skew_a, skew_b = _two_shard_spans()
+    off = estimate_skew_us(spans)
+    assert off["local"] == 0
+    # The NTP estimate is exact up to the delay asymmetry /2 (250us here).
+    assert abs(off["A"] + skew_a) <= 300
+    assert abs(off["B"] + skew_b) <= 300
+
+
+def test_skew_intersection_beats_averaging():
+    """Same-clock regression: one asymmetric-delay link (connection
+    setup: long request leg, short reply leg) must not drag the shard's
+    offset estimate far enough to push a LATER tight child span before
+    its parent. Bound-intersection keeps every link nested; averaging
+    the per-link NTP midpoints did not (offset -212us here, breaking
+    the second link's -10us lower bound)."""
+    spans = [
+        _span(T, "r" + "0" * 15, ZERO_ID, "local", 500, 4000,
+              method="root"),
+        # Link 1: out-delay 900us, back-delay 50us -> bound [-900, +50].
+        _span(T, "c1" + "0" * 14, "r" + "0" * 15, "local", 1000, 2000),
+        _span(T, "s1" + "0" * 14, "c1" + "0" * 14, "A", 1900, 1950,
+              server=True),
+        # Link 2: tight and symmetric -> bound [-10, +10].
+        _span(T, "c2" + "0" * 14, "r" + "0" * 15, "local", 3000, 3100),
+        _span(T, "s2" + "0" * 14, "c2" + "0" * 14, "A", 3010, 3090,
+              server=True),
+    ]
+    off = estimate_skew_us(spans)
+    assert -10 <= off["A"] <= 10  # inside EVERY link's bound
+    tr = assemble_trace(T, {"local": [s for s in spans
+                                      if s["source"] == "local"],
+                            "A": [s for s in spans if s["source"] == "A"]})
+    by_id = {s["span_id"]: s for s in tr.spans}
+    for parent_id, children in tr.children.items():
+        p = by_id[parent_id]
+        for c in children:
+            assert c["start_us"] >= p["start_us"], (p, c)
+            assert c["end_us"] <= p["end_us"], (p, c)
+
+
+def test_assemble_trace_monotone_and_parentage():
+    spans, _a, _b = _two_shard_spans()
+    tr = assemble_trace(T, {"local": [s for s in spans
+                                      if s["source"] == "local"],
+                            "A": [s for s in spans if s["source"] == "A"],
+                            "B": [s for s in spans if s["source"] == "B"]})
+    assert tr.root is not None and tr.root["service_method"] == "root"
+    assert tr.sources == ["A", "B", "local"]
+    by_id = {s["span_id"]: s for s in tr.spans}
+    # Parentage: both client legs under the root, each server span under
+    # its client leg.
+    kids = {k: [c["span_id"] for c in v] for k, v in tr.children.items()}
+    assert kids["r" + "0" * 15] == ["c1" + "0" * 14, "c2" + "0" * 14]
+    assert kids["c1" + "0" * 14] == ["s1" + "0" * 14]
+    # Skew-corrected monotonicity: every child nests INSIDE its parent
+    # even though shard A's raw timestamps were 5s in the future and
+    # shard B's 3s in the past.
+    for parent_id, children in tr.children.items():
+        p = by_id[parent_id]
+        for c in children:
+            assert c["start_us"] >= p["start_us"], (p, c)
+            assert c["end_us"] <= p["end_us"], (p, c)
+    # walk() yields depth-first, siblings in corrected start order.
+    order = [(d, s["span_id"]) for d, s in tr.walk()]
+    assert order[0] == (0, "r" + "0" * 15)
+    assert (1, "c1" + "0" * 14) in order and (2, "s1" + "0" * 14) in order
+    assert tr.render().startswith(f"trace {T}")
+
+
+def test_assemble_trace_dedup_orphans_and_honesty():
+    spans, _a, _b = _two_shard_spans()
+    local = [s for s in spans if s["source"] == "local"]
+    orphan = _span(T, "ff" + "0" * 14, "ee" + "0" * 14, "A",
+                   2_000_000_000, 2_000_001_000, server=True)
+    # Shard A scraped twice under two names: span_ids dedupe (first
+    # sighting wins); a different trace's span is dropped entirely.
+    other_trace = _span("00000000000000bb", "dd" + "0" * 14, ZERO_ID, "A",
+                        5, 10)
+    a_spans = [s for s in spans if s["source"] == "A"] + [orphan,
+                                                          other_trace]
+    tr = assemble_trace(T, {"local": local, "A": a_spans, "A2": a_spans},
+                        rpcz_off=["B"], unreachable=["10.0.0.9:1"])
+    assert all(s["trace_id"] == T for s in tr.spans)
+    assert len([s for s in tr.spans if s["span_id"] == "s1" + "0" * 14]) == 1
+    # The orphan (parent never scraped) surfaces as an extra root, not
+    # silently dropped.
+    assert "ff" + "0" * 14 in [r["span_id"] for r in tr.roots]
+    # Honesty: the blind shard and the dead one are NAMED in the result
+    # and the rendering.
+    assert tr.rpcz_off == ["B"] and tr.unreachable == ["10.0.0.9:1"]
+    assert "rpcz disabled" in tr.render()
+    assert "unreachable" in tr.render()
+
+
+def test_skew_reference_prefers_client_side_orphan():
+    """With the true root missing (its process's rpcz off), the skew
+    reference must anchor on the CLIENT-side orphan, not whichever
+    shard's uncorrected clock sorts first — the timeline contract is
+    'reads in the client's clock'."""
+    base = 1_000_000_000
+    skew_a = -3_000_000  # shard A runs 3s behind: raw-sorts first
+    spans = [
+        # Local client leg, parent (the root) never scraped -> orphan.
+        _span(T, "c1" + "0" * 14, "r" + "0" * 15, "local",
+              base + 1_000, base + 5_000),
+        # Its server half on shard A (NOT parentless).
+        _span(T, "s1" + "0" * 14, "c1" + "0" * 14, "A",
+              base + 2_000 + skew_a, base + 4_000 + skew_a, server=True),
+        # A second A-side orphan (parent never scraped), raw-earliest.
+        _span(T, "s2" + "0" * 14, "ee" + "0" * 14, "A",
+              base + 100 + skew_a, base + 200 + skew_a, server=True),
+    ]
+    off = estimate_skew_us(sorted(spans, key=lambda s: s["start_us"]))
+    assert off["local"] == 0  # reference = the client-side source
+    assert abs(off["A"] + skew_a) <= 1_000
+
+
+def test_assemble_empty_trace():
+    tr = assemble_trace(T, {"local": []}, rpcz_off=["local"])
+    assert isinstance(tr, AssembledTrace)
+    assert tr.root is None and tr.spans == [] and tr.rpcz_off == ["local"]
+
+
+def test_relabel_exposition_injects_shard_label():
+    text = ("# HELP x helptext\n"
+            "# TYPE x counter\n"
+            "rpc_server_qps 42\n"
+            'thing{method="Pull"} 7\n')
+    out = relabel_exposition(text, 'h"o:1')
+    lines = out.splitlines()
+    # Comments dropped (they would repeat per shard in the merged
+    # exposition); labels injected, existing labels preserved, quotes in
+    # the shard name escaped.
+    assert lines[0] == 'rpc_server_qps{shard="h\\"o:1"} 42'
+    assert lines[1] == 'thing{method="Pull",shard="h\\"o:1"} 7'
+
+
+def test_fold_vars_and_flags_and_rollup():
+    vars_text = ("rpc_server_param_service_pull_qps : 120\n"
+                 "rpc_server_param_service_pull_latency_99 : 900\n"
+                 "rpc_server_epoch_qps : 30\n"
+                 "rpc_server_epoch_latency_99 : 150\n"
+                 "tensor_codec_bytes_logical : 4000\n"
+                 "tensor_codec_bytes_wire : 1000\n"
+                 "param_server_version_lag_s0 : 3\n"
+                 "rpc_client_qps : 999\n")  # client side: not fleet qps
+    folded = fold_vars(vars_text)
+    assert folded["qps"] == 150.0 and folded["p99_us"] == 900
+    assert folded["version_lag_max"] == 3
+    # The Prometheus-exposition fold (fleet_prometheus's rollup source)
+    # agrees with the /vars fold over the same series.
+    expo_text = ("# TYPE rpc_server_param_service_pull_qps gauge\n"
+                 "rpc_server_param_service_pull_qps 120\n"
+                 "rpc_server_param_service_pull_latency_99 900\n"
+                 "rpc_server_epoch_qps 30\n"
+                 "rpc_server_epoch_latency_99 150\n"
+                 "tensor_codec_bytes_logical 4000\n"
+                 "tensor_codec_bytes_wire 1000\n"
+                 "param_server_version_lag_s0 3\n"
+                 "rpc_client_qps 999\n")
+    assert fold_exposition(expo_text) == folded
+    flags_text = ("rpcz_enabled = 1  # collect spans\n"
+                  "rpcz_sample_1_in_n = 64 (default 1)  # sampling\n")
+    assert fold_flags(flags_text) == {"rpcz_enabled": 1,
+                                      "rpcz_sample_1_in_n": 64}
+    rows = [dict(addr="a:1", reachable=True, health="ok", **folded,
+                 rpcz_enabled=1),
+            dict(addr="b:2", reachable=True, health="degraded", qps=50.0,
+                 p99_us=2000, codec_bytes_logical=0, codec_bytes_wire=0,
+                 version_lag_max=7, rpcz_enabled=0),
+            {"addr": "c:3", "reachable": False, "health": "unreachable"}]
+    roll = rollup(rows)
+    assert roll["members"] == 3 and roll["reachable"] == 2
+    assert roll["qps_total"] == 200.0 and roll["p99_max_us"] == 2000
+    assert roll["health_worst"] == "unreachable"  # worst wins
+    assert roll["version_lag_max"] == 7
+    assert roll["codec_ratio"] == 4.0
+    assert roll["rpcz_off"] == ["b:2"]
+    assert rollup([])["health_worst"] == "empty"
+
+
+# ---------------------------------------------------------------------------
+# Native half: a real 2-process fleet under an armed watchdog.
+# ---------------------------------------------------------------------------
+
+TAG = "obsfleet"
+
+_SHARD = (
+    "import sys, json\n"
+    "sys.path.insert(0, %r)\n"
+    "from brpc_tpu.runtime import native\n"
+    "native.lib().tbrpc_flag_set(b'rpcz_enabled', b'1')\n"
+    "from brpc_tpu.fleet import FleetServer\n"
+    "s = FleetServer(sys.argv[1], tag=sys.argv[2], ttl_s=3)\n"
+    "print(json.dumps({'addr': s.start()}), flush=True)\n"
+    "sys.stdin.readline()\n"
+    "s.stop()\n" % ROOT)
+
+
+@pytest.fixture(scope="module")
+def obs_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.fleet import RegistryHub, clear_registry
+    from brpc_tpu.observability import health, tracing
+    dump_dir = tmp_path_factory.mktemp("fleet_view_dumps")
+    health.start_watchdog(str(dump_dir))
+    hub = RegistryHub()
+    hub.start()
+    procs = [subprocess.Popen(  # tpulint: allow(py-blocking)
+        [sys.executable, "-c", _SHARD, hub.hostport, TAG],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        for _ in range(2)]
+    addrs = [json.loads(p.stdout.readline())["addr"] for p in procs]
+    tracing.rpcz_enable(True)
+    tracing.rpcz_set_sample_1_in_n(1)
+    yield {"hub": hub, "addrs": sorted(addrs), "procs": procs,
+           "health": health}
+    tracing.rpcz_enable(False)
+    for p in procs:
+        try:
+            p.stdin.close()
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — teardown must reach the kill
+            p.kill()
+    clear_registry()
+    hub.stop()
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after fleet_view tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def _http(hostport, path, timeout=10):
+    with urllib.request.urlopen(f"http://{hostport}{path}",
+                                timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+@pytest.fixture(scope="module")
+def fleet(obs_env):
+    """One seeded 2-process fleet shared by the native tests (a live
+    parameter refuses re-install with E_EXISTS, so seeding happens
+    exactly once)."""
+    from brpc_tpu.fleet import FleetClient
+    fc = FleetClient(obs_env["hub"].hostport, tag=TAG, op_deadline_s=15.0)
+    names = [f"w{i:02d}" for i in range(12)]
+    fc.refresh()
+    for name in names:
+        fc.install(name, np.full((256,), 1.0, np.float32), refresh=False)
+    # The fleet really is 2-process: tensors spread over both shards.
+    placement = {m["shard"] for m in fc.meta().values()}
+    assert placement == set(obs_env["addrs"]), placement
+    yield fc, names
+    fc.close()
+
+
+def test_two_process_fleet_trace_assembly(obs_env, fleet):
+    """THE acceptance loop: one client root span through FleetClient
+    scatter/gather to 2 shard processes, assembled into ONE
+    parentage-correct, skew-corrected trace by the FleetObserver."""
+    from brpc_tpu.fleet import FleetObserver
+    from brpc_tpu.observability import tracing
+
+    fc, names = fleet
+    with tracing.trace_span("test/train_step") as root:
+        got = fc.pull_all(names)
+    assert sorted(got) == names
+    assert root.trace_id != 0
+
+    obs = FleetObserver(obs_env["hub"].hostport, tag=TAG)
+    tr = obs.assemble(root.trace_id)
+    assert tr.rpcz_off == [] and tr.unreachable == []
+    # Every process is represented: the local client + both shards.
+    assert set(tr.sources) == {"local"} | set(obs_env["addrs"])
+    assert tr.root is not None
+    assert tr.root["service_method"] == "test/train_step"
+    assert tr.root["source"] == "local"
+    by_id = {s["span_id"]: s for s in tr.spans}
+    # The FleetClient span sits under the root.
+    pull_spans = [s for s in tr.spans
+                  if s["service_method"] == "FleetClient/pull_all"]
+    assert len(pull_spans) == 1
+    assert pull_spans[0]["parent_span_id"] == tr.root["span_id"]
+    assert any(a == f"tensors={len(names)}"
+               for a in pull_spans[0]["annotations"])
+    # BOTH shards contributed server spans, each parented on a local
+    # client leg of this same trace (cross-process linkage).
+    for addr in obs_env["addrs"]:
+        server_spans = [s for s in tr.spans
+                        if s["source"] == addr and s["server_side"]]
+        assert server_spans, f"no server spans scraped from {addr}"
+        for s in server_spans:
+            parent = by_id.get(s["parent_span_id"])
+            assert parent is not None, s
+            assert parent["source"] == "local"
+            assert not parent["server_side"]
+    # Skew-corrected monotone ordering: children nest inside parents
+    # (same-host clocks here, so correction must not BREAK the natural
+    # nesting either) and the span list is time-sorted.
+    for parent_id, children in tr.children.items():
+        p = by_id[parent_id]
+        for c in children:
+            assert c["start_us"] >= p["start_us"], (p, c)
+            assert c["end_us"] <= p["end_us"], (p, c)
+    starts = [s["start_us"] for s in tr.spans]
+    assert starts == sorted(starts)
+    # The rendering is a usable one-page timeline.
+    text = tr.render()
+    assert "test/train_step" in text and "FleetClient/pull_all" in text
+
+
+def test_reshard_is_one_trace(obs_env, fleet):
+    """A Migrator pass reads as ONE trace: the reshard root span with the
+    handoff RPC legs linked under it (the one-trace-per-reshard
+    workflow)."""
+    from brpc_tpu.fleet import FleetObserver, Migrator
+    from brpc_tpu.observability import tracing
+
+    mig = Migrator(obs_env["hub"].hostport, tag=TAG)
+    try:
+        mig.reshard()  # placement already converged: plan-only pass
+        spans = tracing.dump_rpcz()
+        reshard = [s for s in spans
+                   if s["service_method"] == "Migrator/reshard"]
+        assert reshard, "reshard pass did not record a root span"
+        tr = FleetObserver(obs_env["hub"].hostport, tag=TAG).assemble(
+            int(reshard[0]["trace_id"], 16))
+        assert tr.root is not None
+        assert tr.root["service_method"] == "Migrator/reshard"
+        assert any(a.startswith("moved=") for a in tr.root["annotations"])
+    finally:
+        mig.stop()
+
+
+def test_fleetz_page_and_observer_parity(obs_env, fleet):
+    """/fleetz renders live per-shard health/qps/p99/codec/version-lag
+    from a registry-driven scrape, flags rpcz-off shards, and the Python
+    FleetObserver computes the same document."""
+    from brpc_tpu.fleet import FleetObserver
+
+    fc, names = fleet
+    for _ in range(3):
+        fc.pull_all(names)
+    hub_port = obs_env["hub"].port
+    doc = json.loads(_http(f"127.0.0.1:{hub_port}",
+                           f"/fleetz?tag={TAG}&format=json"))
+    assert [s["addr"] for s in doc["shards"]] == obs_env["addrs"]
+    roll = doc["rollup"]
+    assert roll["members"] == 2 and roll["reachable"] == 2
+    assert roll["health_worst"] == "ok"
+    assert roll["qps_total"] > 0  # the pulls just happened
+    assert roll["p99_max_us"] >= 0 and roll["version_lag_max"] >= 0
+    for s in doc["shards"]:
+        assert s["health"] == "ok" and s["reachable"]
+        assert s["rpcz_enabled"] == 1
+        assert "version_lag_max" in s and "codec_bytes_wire" in s
+    # Text rendering carries the same table.
+    page = _http(f"127.0.0.1:{hub_port}", f"/fleetz?tag={TAG}")
+    for addr in obs_env["addrs"]:
+        assert addr in page
+    assert "rollup:" in page and "health=ok" in page
+
+    # Python twin: same members, same rollup shape.
+    obs = FleetObserver(obs_env["hub"].hostport, tag=TAG)
+    pdoc = obs.fleetz()
+    assert [s["addr"] for s in pdoc["shards"]] == obs_env["addrs"]
+    assert pdoc["rollup"]["reachable"] == 2
+    assert pdoc["rollup"]["health_worst"] == "ok"
+
+    # Aggregated Prometheus exposition: every shard's series carries
+    # its shard label, and the fleet rollup series ride along.
+    merged = obs.fleet_prometheus()
+    for addr in obs_env["addrs"]:
+        assert f'fleet_shard_up{{shard="{addr}"}} 1' in merged
+        assert f'shard="{addr}"' in merged
+    assert "fleet_qps_total " in merged
+    assert "fleet_health_worst 0" in merged
+
+    # Rollup gauges repoint into the LOCAL native registry.
+    from brpc_tpu.observability import metrics as obsm
+    obs.publish_rollup_gauges()
+    obs.fleetz()
+    dumped = obsm.dump_vars("fleet_")
+    assert "fleet_members_reachable : 2" in dumped
+    assert "fleet_health_worst : 0" in dumped
+
+
+def test_fleetz_names_rpcz_off_shards(obs_env):
+    """Honesty satellite: a shard with sampling off is NAMED on /fleetz
+    and in assembled traces, instead of silently contributing nothing."""
+    from brpc_tpu.fleet import FleetObserver
+    from brpc_tpu.observability import tracing
+
+    victim = obs_env["addrs"][0]
+    assert "= 0" in _http(victim, "/flags/rpcz_enabled?setvalue=0")
+    try:
+        hub_port = obs_env["hub"].port
+        doc = json.loads(_http(f"127.0.0.1:{hub_port}",
+                               f"/fleetz?tag={TAG}&format=json"))
+        assert doc["rollup"]["rpcz_off"] == [victim]
+        page = _http(f"127.0.0.1:{hub_port}", f"/fleetz?tag={TAG}")
+        assert "rpcz sampling OFF on: " + victim in page
+        # The observer's trace assembly carries the same warning.
+        obs = FleetObserver(obs_env["hub"].hostport, tag=TAG)
+        with tracing.trace_span("test/blind_pull") as root:
+            pass
+        tr = obs.assemble(root.trace_id)
+        assert tr.rpcz_off == [victim]
+    finally:
+        assert "= 1" in _http(victim, "/flags/rpcz_enabled?setvalue=1")
+
+
+def test_sampling_flag_ab(obs_env):
+    """rpcz_sample_1_in_n A/B: a huge divisor suppresses NEW roots (the
+    always-on production mode) while spans inside a sampled trace still
+    record; divisor 1 restores full collection; the validator rejects 0."""
+    from brpc_tpu.observability import tracing
+    from brpc_tpu.runtime import native
+
+    assert tracing.rpcz_sample_1_in_n() == 1
+    try:
+        tracing.rpcz_set_sample_1_in_n(1 << 30)
+        assert tracing.rpcz_sample_1_in_n() == 1 << 30
+        # New roots are (probabilistically ~always) suppressed...
+        for _ in range(8):
+            with tracing.trace_span("test/unsampled") as h:
+                pass
+            assert (h.trace_id, h.span_id) == (0, 0)
+        # ...but a span nested in an ALREADY-SAMPLED trace still records:
+        # sampled traces stay complete regardless of the divisor.
+        tracing.set_trace(0xabc, 0xdef)
+        try:
+            with tracing.trace_span("test/nested_sampled") as nested:
+                pass
+            assert nested.trace_id == 0xabc and nested.span_id != 0
+        finally:
+            tracing.clear_trace()
+        spans = tracing.dump_rpcz(0xabc)
+        assert [s["service_method"] for s in spans] == [
+            "test/nested_sampled"]
+        # The flag validator refuses nonsense.
+        with pytest.raises(ValueError):
+            tracing.rpcz_set_sample_1_in_n(0)
+        assert native.lib().tbrpc_flag_set(b"rpcz_sample_1_in_n",
+                                           b"-5") != 0
+    finally:
+        tracing.rpcz_set_sample_1_in_n(1)
+    with tracing.trace_span("test/sampled_again") as h:
+        pass
+    assert h.span_id != 0
+
+
+def test_dump_rpcz_disabled_is_typed(obs_env):
+    """dump_rpcz raises the typed RpczDisabled signal instead of
+    returning an indistinguishable empty list; /rpcz?format=json makes
+    the same distinction on the wire."""
+    from brpc_tpu.observability import tracing
+
+    shard = obs_env["addrs"][0]  # shards keep rpcz ON: scrape says so
+    doc = json.loads(_http(shard, "/rpcz?format=json"))
+    assert doc["enabled"] is True and isinstance(doc["spans"], list)
+    assert doc["sample_1_in_n"] == 1
+    tracing.rpcz_enable(False)
+    try:
+        with pytest.raises(tracing.RpczDisabled) as exc:
+            tracing.dump_rpcz()
+        assert exc.value.source == "local"
+        # The local console is equally honest over HTTP.
+        hub_port = obs_env["hub"].port
+        local = json.loads(_http(f"127.0.0.1:{hub_port}",
+                                 "/rpcz?format=json"))
+        assert local["enabled"] is False
+    finally:
+        tracing.rpcz_enable(True)

@@ -182,10 +182,13 @@ def test_flight_write_path_takes_no_lock():
 
 
 def test_flight_recorder_overhead_within_noise(health):
-    """Recorder on vs off on the in-process echo microbench: interleaved
-    1s samples, medians within 5%. The recorder's per-event cost is a
-    clock read plus a handful of relaxed stores — if this fails, the write
-    path regressed."""
+    """Recorder on vs off on the in-process echo microbench: the median
+    of ADJACENT-pair on/off ratios (the PERF.md steal-robust statistic —
+    a difference of independent medians flakes when this host's bimodal
+    steal lands across a 5% bound) must stay within 5%, with a bounded
+    window rerun like test_pprof's heap sampling. The recorder's
+    per-event cost is a clock read plus a handful of relaxed stores — a
+    ratio that fails 3 windows straight means the write path regressed."""
     from brpc_tpu.runtime import native
 
     def sample(enabled):
@@ -195,14 +198,19 @@ def test_flight_recorder_overhead_within_noise(health):
 
     try:
         sample(True)  # warm: server/channel/fiber pool spin-up
-        on, off = [], []
-        for _ in range(3):  # interleaved: both modes see the same host
-            off.append(sample(False))
-            on.append(sample(True))
-        med_on, med_off = statistics.median(on), statistics.median(off)
-        assert med_on > 0 and med_off > 0
-        assert med_on >= 0.95 * med_off, \
-            f"recorder overhead over 5%: on={on} off={off}"
+        med = 0.0
+        for _window in range(3):
+            ratios = []
+            for _ in range(3):  # adjacent pairs see the same host state
+                off = sample(False)
+                on = sample(True)
+                assert on > 0 and off > 0
+                ratios.append(on / off)
+            med = statistics.median(ratios)
+            if med >= 0.95:
+                break
+        assert med >= 0.95, \
+            f"recorder overhead over 5% in 3 windows: last ratios={ratios}"
     finally:
         health.configure(flight_enabled=1)
 

@@ -564,6 +564,9 @@ def test_codec_counters_console_and_rpcz(codec_env):
         obs.rpcz_enable()
         with obs.trace_span("quant_pull") as span:
             client.pull("codec_counter_w")
+        # Dump the trace while collection is still ON: a dump with rpcz
+        # off is now the typed RpczDisabled signal, not an empty list.
+        spans = obs.dump_rpcz(span.trace_id)
         obs.rpcz_enable(False)
         g = np.ones((1 << 16,), np.float32)
         client.push_grad("codec_counter_w", g)
@@ -593,7 +596,6 @@ def test_codec_counters_console_and_rpcz(codec_env):
                    for t in doc["tensors"])
 
         # /rpcz: the client span carries the dequant stage annotation.
-        spans = obs.dump_rpcz(span.trace_id)
         notes = " ".join(a for s in spans
                          for a in s.get("annotations", []))
         assert "dequant" in notes

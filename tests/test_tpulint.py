@@ -202,8 +202,11 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
     # tbrpc_server_set_inline.
     assert parsed["tbrpc_fix_set_inline"] == "int(void *, const char *, int)"
     # The niladic entry-point shape of tbrpc_registry_install: an explicit
-    # (void) list normalises to the lock's "int()" spelling.
+    # (void) list normalises to the lock's "int()" spelling — and a SECOND
+    # same-shaped niladic (the rpcz sampling gate, tbrpc_rpcz_sample_root)
+    # stays a distinct lock entry, not merged with the first.
     assert parsed["tbrpc_fix_registry_install"] == "int()"
+    assert parsed["tbrpc_fix_sample_root"] == "int()"
     # The tensor-codec accounting shape of tbrpc_tensor_codec_note: a
     # void return with uint64_t scalar params stays distinct from any
     # pointer spelling.
@@ -242,6 +245,10 @@ def test_wire_contract_capi_real_repo_lock_is_current():
     assert locked["tbrpc_tensor_codec_list"] == "int64_t(char *, size_t)"
     assert locked["tbrpc_tensor_codec_stats_json"] == (
         "int64_t(char *, size_t)")
+    # The fleet-observability rpcz sampling surface is part of the
+    # contract (reloadable 1-in-N head sampling behind the capi).
+    assert locked["tbrpc_rpcz_sample_root"] == "int()"
+    assert locked["tbrpc_rpcz_sample_1_in_n"] == "int()"
 
 
 # ---- rule class 5: metric-name ----
@@ -266,8 +273,15 @@ def test_metric_name_python_positive(fixture_findings):
     # cross-language: the python site collides with the native expose()
     assert any("fixture_dup_metric" in f.message and "mx_bad.cpp" in f.message
                for f in hits)
-    # the clean registration stays silent
+    # repointable_gauge registrations (fleet_view rollup style) are in the
+    # same namespace: charset-checked AND collision-checked against every
+    # other registration kind.
+    assert "py fixture rg bad" in msgs
+    assert sum("py_fixture_stage" in f.message and "collides" in f.message
+               for f in hits) >= 2  # counter AND repointable_gauge collide
+    # the clean registrations stay silent
     assert "py_fixture_busy_bytes" not in msgs
+    assert "py_fixture_rollup_ok" not in msgs
 
 
 # ---- rule class 6: py-blocking ----

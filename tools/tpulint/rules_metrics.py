@@ -37,14 +37,20 @@ _CTOR_RE = re.compile(
 # either quote style:
 #   counter("name") / obs.latency('prefix') / metrics.gauge("name", fn)
 #   Counter("name") / LatencyRecorder("prefix") / PassiveGauge("name", fn)
+#   obs.repointable_gauge("name", fn)   (fleet_view rollups, fleet gauges)
 #   tbrpc_var_*_create(b"name")
 # A dotted receiver is honoured: `collections.Counter("abc")` is stdlib,
 # not a metric — only receivers that look like the observability module
 # (obs / metrics / *observability*) count. Bare calls can't be told apart
 # textually; an unrelated bare Counter("...") needs an allow().
+# repointable_gauge joined the alternation with the fleet_view rollup
+# registrations: repointables land in the SAME immortal native registry
+# (the first publish registers; later ones only repoint), so their names
+# collide for real with every other expose site in both languages.
 _PY_REG_RE = re.compile(
     r"(?:([A-Za-z_][\w.]*)\s*\.\s*)?"
-    r"\b(?:counter|latency|gauge|Counter|LatencyRecorder|PassiveGauge)"
+    r"\b(?:counter|latency|gauge|repointable_gauge|Counter|LatencyRecorder|"
+    r"PassiveGauge)"
     r"\s*\(\s*[bf]?(?:\"([^\"]+)\"|'([^']+)')")
 _PY_METRIC_RECEIVERS = ("obs", "metrics", "observability")
 _PY_CAPI_RE = re.compile(
