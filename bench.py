@@ -265,6 +265,165 @@ def rpcz_overhead_point(reps=5, seconds=1, concurrency=16, sample_n=64,
     return row
 
 
+# The whole 10x-overload A/B runs in ONE watchdogged child: an echo server
+# with a constant gate + injected (deterministic) service time, BULK
+# callers offering >10x the gate's capacity, and a HIGH-lane prober whose
+# time-to-success is the control-plane latency. Protection ON = priority
+# lanes armed (bulk headroom reserved, callers stamp their lanes);
+# protection OFF = rpc_bulk_headroom_pct=0 and every caller unmarked — the
+# same drive, so the A/B isolates exactly the overload-protection plane.
+_OVERLOAD_CHILD = r"""
+import json, sys, threading, time
+sys.path.insert(0, {root!r})
+from brpc_tpu.runtime import native
+try:
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
+
+GATE = {gate}
+SVC_MS = {svc_ms}
+DRIVE_S = {drive_s}
+BULK_THREADS = {bulk_threads}
+BULK = b"x" * 8192  # non-batchable: every request gets its own fiber
+
+srv = native.Server(); srv.add_echo_service()
+srv.set_max_concurrency(GATE)
+port = srv.start(); addr = "127.0.0.1:%d" % port
+native.inject_latency("EchoService", SVC_MS)
+capacity_rps = GATE * 1000.0 / SVC_MS
+
+def high_probe(n, interval_s, priority):
+    # Time-to-success per control-plane op: each op retries (1ms pause)
+    # until admitted — with protection off, that retry spin against a
+    # bulk-full gate IS the tail the A/B exposes.
+    ch = native.Channel(addr, timeout_ms=8000, max_retry=0)
+    lats = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        while True:
+            try:
+                with native.qos(priority, "ctl"):
+                    ch.call("EchoService/Echo", b"hb")
+                break
+            except native.RpcError:
+                time.sleep(0.001)
+        lats.append((time.monotonic() - t0) * 1000.0)
+        time.sleep(interval_s)
+    ch.close()
+    lats.sort()
+    return lats
+
+def drive(bulk_priority, high_priority, headroom_pct):
+    assert native.lib().tbrpc_flag_set(
+        b"rpc_bulk_headroom_pct", str(headroom_pct).encode()) == 0
+    stop = threading.Event()
+    mu = threading.Lock()
+    stats = {{"ok": 0, "shed": 0, "attempts": 0}}
+    def bulk_loop():
+        ch = native.Channel(addr, timeout_ms=8000, max_retry=0)
+        while not stop.is_set():
+            with mu:
+                stats["attempts"] += 1
+            try:
+                with native.qos(bulk_priority, "bulk"):
+                    ch.call("EchoService/Echo", BULK)
+                with mu:
+                    stats["ok"] += 1
+            except native.RpcError:
+                with mu:
+                    stats["shed"] += 1
+                time.sleep(0.002)
+        ch.close()
+    threads = [threading.Thread(target=bulk_loop)
+               for _ in range(BULK_THREADS)]
+    for t in threads: t.start()
+    time.sleep(0.3)  # let bulk saturate the gate first
+    with mu:
+        before = dict(stats)
+    t0 = time.monotonic()
+    n_high = max(8, int(DRIVE_S / 0.03))
+    lats = high_probe(n_high, 0.03, high_priority)
+    window = time.monotonic() - t0  # goodput over the PROBED window only
+    with mu:
+        after = dict(stats)
+    stop.set()
+    for t in threads: t.join()
+    bulk_ok = after["ok"] - before["ok"]
+    return {{
+        "high_p99_ms": round(lats[max(0, int(len(lats) * 0.99) - 1)], 2),
+        "high_p50_ms": round(lats[len(lats) // 2], 2),
+        "goodput_rps": round((bulk_ok + n_high) / window, 1),
+        "offered_x_capacity": round(
+            (after["attempts"] - before["attempts"]) / window
+            / capacity_rps, 1),
+        "bulk_ok": after["ok"], "bulk_shed": after["shed"],
+    }}
+
+unloaded = high_probe(20, 0.01, native.PRIORITY_HIGH)
+row = {{
+    "gate": GATE, "svc_ms": SVC_MS, "bulk_threads": BULK_THREADS,
+    "capacity_rps": capacity_rps,
+    "high_p99_ms_unloaded": round(
+        unloaded[max(0, int(len(unloaded) * 0.99) - 1)], 2),
+    "protected": drive(native.PRIORITY_BULK, native.PRIORITY_HIGH, 10),
+    "unprotected": drive(native.PRIORITY_NORMAL, native.PRIORITY_NORMAL, 0),
+}}
+native.inject_latency("", 0)
+native.lib().tbrpc_flag_set(b"rpc_bulk_headroom_pct", b"10")
+base = max(row["high_p99_ms_unloaded"], 1e-9)
+row["high_p99_x_protected"] = round(row["protected"]["high_p99_ms"] / base, 2)
+row["high_p99_x_unprotected"] = round(
+    row["unprotected"]["high_p99_ms"] / base, 2)
+row["goodput_frac_protected"] = round(
+    row["protected"]["goodput_rps"] / capacity_rps, 2)
+srv.close()
+print(json.dumps(row))
+"""
+
+
+def overload_point(gate=10, svc_ms=40, drive_s=2.0, bulk_threads=16,
+                   wedge_log=None):
+    """The 10x-overload A/B (ISSUE 9 acceptance row): goodput + HIGH-lane
+    p99 while BULK drives the gate at >10x its capacity, protection on vs
+    off in the SAME child. Acceptance: protected HIGH p99 <= 2x its
+    unloaded value and goodput >= 0.9x capacity; unprotected shows the
+    control-plane tail blowing up."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _OVERLOAD_CHILD.format(root=root, dump_dir=_dump_dir(),
+                                  gate=gate, svc_ms=svc_ms,
+                                  drive_s=drive_s,
+                                  bulk_threads=bulk_threads)
+    timeout = 60 + drive_s * 10
+    seen = set(_new_dump_files(set()))
+    try:
+        proc = subprocess.run(  # tpulint: allow(py-blocking)
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        row = {"wedged": True, "dump_files": _new_dump_files(seen)}
+        if wedge_log is not None:
+            wedge_log.append({"point": "overload_10x",
+                              "dump_files": row["dump_files"]})
+        return row
+    out = proc.stdout.strip().splitlines()
+    if proc.returncode != 0 or not out:
+        raise RuntimeError(
+            f"overload child rc={proc.returncode}: "
+            f"{proc.stderr.strip()[-800:]}")
+    row = json.loads(out[-1])
+    print(f"# overload_10x: unloaded HIGH p99 {row['high_p99_ms_unloaded']}"
+          f"ms -> protected {row['protected']['high_p99_ms']}ms "
+          f"({row['high_p99_x_protected']}x) vs unprotected "
+          f"{row['unprotected']['high_p99_ms']}ms "
+          f"({row['high_p99_x_unprotected']}x); goodput "
+          f"{row['goodput_frac_protected']}x capacity at "
+          f"{row['protected']['offered_x_capacity']}x offered",
+          file=sys.stderr)
+    return row
+
+
 def best_point(payload, transport, seconds=2, wedge_log=None):
     """Best (GB/s, qps, p99_us, concurrency) across the concurrency set.
 
@@ -361,6 +520,13 @@ def main() -> None:
         sweep["rpcz_overhead_64B"] = rpcz_overhead_point(wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# rpcz_overhead_64B skipped: {e}", file=sys.stderr)
+
+    # 10x-overload A/B (overload-protection plane): HIGH-lane p99 +
+    # goodput while BULK saturates, priority lanes on vs off.
+    try:
+        sweep["overload_10x"] = overload_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# overload_10x skipped: {e}", file=sys.stderr)
 
     # Pipelined parameter-server rows (async tensor RPC tentpole): 32x1MB
     # serial round-trips vs one bounded PipelineWindow, pull and push.
@@ -898,6 +1064,13 @@ def smoke() -> None:
                                timeout=150))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["fleet_pull_GBps_2s"] = {"error": str(e)}
+    # Guarded overload mini-row: a short protection-on/off A/B — if the
+    # priority lanes stop protecting the control plane (HIGH p99 no longer
+    # flat under bulk saturation), the smoke run shows it first.
+    try:
+        out["overload_10x"] = overload_point(drive_s=0.6, wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["overload_10x"] = {"error": str(e)}
     if wedges:
         out["wedged_samples"] = wedges
     print(json.dumps({"metric": "bench_smoke", "sweep": out}))

@@ -71,7 +71,7 @@ class FleetClient:
                  window: int = 4, arena_bytes: int = 64 << 20,
                  device=None, op_deadline_s: float = 15.0,
                  overrides: Optional[Dict[str, str]] = None,
-                 codec: Optional[str] = None):
+                 codec: Optional[str] = None, tenant: str = ""):
         self._registry = registry_hostport
         self._tag = tag
         self.window = window
@@ -79,6 +79,12 @@ class FleetClient:
         self._device = device
         self._deadline_s = op_deadline_s
         self._overrides = dict(overrides or {})
+        # Overload protection: every shard client stamps this tenant id
+        # onto its requests (the servers' per-tenant quota key; "" falls
+        # back to peer ip server-side). Control-plane calls (Epoch/Meta,
+        # migrator handshake) ride the HIGH lane, Pull/Push ride BULK —
+        # the per-method defaults live in ParameterClient.
+        self._tenant = tenant
         # Quantized tensor wire: negotiated PER SHARD STREAM — each
         # shard's ParameterClient checks its own server's Meta
         # advertisement, so a mixed fleet (some shards codec-enabled,
@@ -157,7 +163,8 @@ class FleetClient:
             if pc is None:
                 pc = ParameterClient(f"tpu://{addr}",
                                      TensorArena(self._arena_bytes),
-                                     codec=self._codec)
+                                     codec=self._codec,
+                                     tenant=self._tenant)
                 self._clients[addr] = pc
             return pc
 
@@ -180,7 +187,15 @@ class FleetClient:
     def _with_retry(self, name: str, op):
         """Run `op(ParameterClient)` against the candidate owners,
         following E_MOVED forwarding, backing off on E_MIGRATING and
-        transport errors, refreshing membership between rounds."""
+        transport errors, refreshing membership between rounds.
+
+        Overload answers (ELIMIT/EOVERCROWDED — `RpcError.overloaded`)
+        are classified APART from the reshard signals: retriable with
+        backoff paced by the server's retry_after_ms hint, but NEVER
+        counted as moved/migrating evidence — an overloaded-only round
+        skips the registry refresh (a shed storm must not also become a
+        registry-poll storm), can never trip the not-in-fleet KeyError,
+        and never reads as shard death."""
         deadline = time.monotonic() + self._deadline_s
         delay = 0.01
         last_err: Optional[Exception] = None
@@ -193,6 +208,8 @@ class FleetClient:
             if smap is None:
                 raise RuntimeError("fleet client is closed")
             retriable = False
+            overload_only = True  # no non-overload signal seen this round
+            overload_hint_s = 0.0
             tried = set()
             queue = self._candidates(name)
             while queue:
@@ -204,6 +221,16 @@ class FleetClient:
                     return op(self._client(addr))
                 except native.RpcError as e:
                     last_err = e
+                    if e.overloaded:
+                        # Shed-before-queue answer: the parameter is
+                        # where the map says — the owner is just over
+                        # capacity. Pace on its hint and try again.
+                        retriable = True
+                        overload_hint_s = max(
+                            overload_hint_s,
+                            (e.retry_after_ms or 0) / 1000.0)
+                        continue
+                    overload_only = False
                     dest = moved_dest(e)
                     if dest and dest not in tried:
                         queue.append(dest)  # follow the forwarding chain
@@ -224,6 +251,15 @@ class FleetClient:
                     # (KeyError is the truth).
                     if e.code == E_MIGRATING or addr in smap:
                         retriable = True
+            if retriable and overload_only:
+                # Pure overload: membership is not in question — skip the
+                # registry round trip and just pace out the shed.
+                if time.monotonic() >= deadline:
+                    assert last_err is not None
+                    raise last_err
+                time.sleep(max(delay, overload_hint_s))
+                delay = min(delay * 2, 0.25)
+                continue
             self.refresh()
             with self._mu:
                 changed = (self._map is not None
@@ -237,7 +273,7 @@ class FleetClient:
             if time.monotonic() >= deadline:
                 assert last_err is not None
                 raise last_err
-            time.sleep(delay)
+            time.sleep(max(delay, overload_hint_s))
             delay = min(delay * 2, 0.25)
 
     # ---- metadata ----

@@ -18,11 +18,30 @@ bindings here are first-class because the TPU data plane (JAX) is Python.
 from __future__ import annotations
 
 import atexit
+import contextlib
 import ctypes
 import os
+import re
 import subprocess
 import weakref
 from typing import Callable, Optional, Tuple
+
+# Request priority lanes (native/trpc/qos.h): HIGH is the control plane
+# (heartbeats, version polls, Epoch/Meta, migrator handshakes) — admitted
+# up to the server's full concurrency gate; BULK is tensor pull/push —
+# admitted only while the gate keeps headroom free; NORMAL is the unmarked
+# default (wire stays byte-identical to the pre-QoS format).
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_BULK = 2
+
+# Overload answers (trpc/errno.h): the server's admission shed and the
+# client-side write-queue backpressure — retriable WITH BACKOFF, never
+# hot-retried (see RpcError.retry_after_ms and the fleet retry layer).
+TRPC_ELIMIT = 1011
+TRPC_EOVERCROWDED = 2006
+
+_RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+)")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _LIB_PATH = os.path.join(_REPO, "native", "build", "libbrpc_tpu.so")
@@ -227,9 +246,77 @@ def lib() -> ctypes.CDLL:
     L.tbrpc_registry_install.argtypes = []
     L.tbrpc_registry_clear.restype = ctypes.c_int
     L.tbrpc_registry_clear.argtypes = []
+    # Overload protection: ambient QoS context (priority lanes + tenant),
+    # deadline propagation, per-tenant quotas, and the latency-injection
+    # test hook (capi.h "overload protection" section).
+    L.tbrpc_qos_set.restype = ctypes.c_int
+    L.tbrpc_qos_set.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    L.tbrpc_qos_clear.restype = None
+    L.tbrpc_qos_clear.argtypes = []
+    L.tbrpc_qos_get.restype = ctypes.c_int64
+    L.tbrpc_qos_get.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_deadline_remaining_ms.restype = ctypes.c_int64
+    L.tbrpc_deadline_remaining_ms.argtypes = []
+    L.tbrpc_server_set_max_concurrency.restype = ctypes.c_int
+    L.tbrpc_server_set_max_concurrency.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32]
+    L.tbrpc_server_set_tenant_quota.restype = ctypes.c_int
+    L.tbrpc_server_set_tenant_quota.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32]
+    L.tbrpc_server_tenantz_json.restype = ctypes.c_int64
+    L.tbrpc_server_tenantz_json.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_debug_inject_latency.restype = ctypes.c_int
+    L.tbrpc_debug_inject_latency.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     _lib = L
     atexit.register(_teardown_native_handles)
     return L
+
+
+@contextlib.contextmanager
+def qos(priority: int = PRIORITY_NORMAL, tenant: str = ""):
+    """Ambient QoS for calls issued inside the scope (THIS thread only —
+    the native slot is per-thread, like the trace context): requests stamp
+    `priority` (PRIORITY_HIGH/NORMAL/BULK) and `tenant` onto the wire, and
+    the server's admission uses both (priority lanes + per-tenant quotas).
+    With neither set, the wire stays byte-identical to the pre-QoS format.
+
+    Nestable: exit restores the REAL surrounding ambient values (read back
+    through the native slot), so a scope used inside a server handler —
+    whose thread carries the request's own priority/tenant, installed
+    natively — hands the handler's context back intact. The propagated
+    DEADLINE lives in the same slot but is untouched by set/restore, so
+    nested-call clamping survives any qos() nesting. Raises ValueError
+    for tenants over the 256-byte wire cap."""
+    L = lib()
+    prev_prio = ctypes.c_int()
+    prev_tenant = ctypes.create_string_buffer(512)  # cap is 256
+    L.tbrpc_qos_get(ctypes.byref(prev_prio), prev_tenant, len(prev_tenant))
+    if L.tbrpc_qos_set(priority,
+                       tenant.encode() if tenant else b"") != 0:
+        raise ValueError(f"tenant id too long ({len(tenant)} bytes > 256)")
+    try:
+        yield
+    finally:
+        L.tbrpc_qos_set(prev_prio.value, prev_tenant.value)
+
+
+def deadline_remaining_ms() -> Optional[int]:
+    """Remaining budget (ms) of the request this thread is handling —
+    the deadline the client propagated, minus time already burned. None
+    when no deadline is in scope (not inside a handler, or the client set
+    no timeout). 0 means expired: shed the work, the caller is gone."""
+    left = lib().tbrpc_deadline_remaining_ms()
+    return None if left < 0 else int(left)
+
+
+def inject_latency(service: str, ms: int) -> None:
+    """TEST-ONLY (beside debug hold_workers): every admitted request to
+    `service` holds its gate slot for `ms` before the handler runs —
+    deterministic queueing for overload/shed tests. ms <= 0 clears;
+    service='' clears all injections."""
+    lib().tbrpc_debug_inject_latency(service.encode(), ms)
 
 
 # Handler signature: (method: str, request: bytes, attachment: bytes)
@@ -239,9 +326,27 @@ Handler = Callable[[str, bytes, bytes], Tuple[bytes, bytes]]
 
 class RpcError(Exception):
     def __init__(self, code: int, text: str = ""):
-        super().__init__(f"rpc error {code}: {text}")
+        overloaded = code in (TRPC_ELIMIT, TRPC_EOVERCROWDED)
+        super().__init__(
+            f"rpc error {code}"
+            + (" (server overloaded — back off)" if overloaded else "")
+            + f": {text}")
         self.code = code
         self.text = text
+        # Shed responses carry a computed drain-time hint in their text
+        # (" (retry_after_ms=N)", from the server's EMA latency): clients
+        # pace their retry on it instead of hot-looping into the shed
+        # storm. None when the error carries no hint.
+        m = _RETRY_AFTER_RE.search(text) if text else None
+        self.retry_after_ms: Optional[int] = int(m.group(1)) if m else None
+
+    @property
+    def overloaded(self) -> bool:
+        """True for the overload-shed codes (ELIMIT / EOVERCROWDED):
+        retriable with backoff, and NEVER evidence that a parameter moved
+        or a shard died (the fleet retry layer keeps them out of its
+        reshard handling)."""
+        return self.code in (TRPC_ELIMIT, TRPC_EOVERCROWDED)
 
 
 class Server:
@@ -272,6 +377,38 @@ class Server:
                 f"set_inline({service!r}) refused: unknown service or not "
                 "inline-safe (Python handlers always run on the callback "
                 "pool)")
+
+    def set_max_concurrency(self, max_inflight: int) -> None:
+        """Concurrency gate applied at start() (0 = unlimited). Requests
+        over the cap shed with ELIMIT + a retry_after_ms hint; the BULK
+        lane additionally keeps rpc_bulk_headroom_pct of the gate free
+        for control-plane traffic. Must be called BEFORE start()."""
+        if self._L.tbrpc_server_set_max_concurrency(
+                self._h, max_inflight) != 0:
+            raise RuntimeError(
+                "set_max_concurrency must be called before start()")
+
+    def set_tenant_quota(self, max_inflight: int) -> None:
+        """Per-tenant in-flight quota layered under the global gate
+        (0 = off): each tenant (QoS meta field, falling back to the peer
+        ip) sheds its own overflow before it can crowd out others.
+        Runtime-safe."""
+        if self._L.tbrpc_server_set_tenant_quota(self._h, max_inflight) != 0:
+            raise RuntimeError("set_tenant_quota failed")
+
+    def tenantz(self) -> dict:
+        """The per-tenant admission table: {"quota": N, "tenants":
+        [{name, admitted, shed, inflight, quota}, ...]} — the same
+        document /tenantz?format=json serves."""
+        import json as _json
+
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            need = self._L.tbrpc_server_tenantz_json(self._h, buf, cap)
+            if need < cap:
+                return _json.loads(buf.value.decode())
+            cap = int(need) + 1
 
     def add_service(self, name: str, handler: Handler) -> None:
         L = self._L

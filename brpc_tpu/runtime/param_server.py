@@ -69,6 +69,63 @@ def moved_dest(err: "native.RpcError") -> Optional[str]:
     return m.group(1) if m else None
 
 
+class OverloadPacer:
+    """Client-side brake for shed storms (the retry-after consumer).
+
+    ELIMIT/EOVERCROWDED answers mean the server (or the socket write
+    queue) is over capacity RIGHT NOW — hot-retrying turns one shed into
+    a storm that keeps the server pinned at its admission gate. The shed
+    response carries a drain-time hint (" (retry_after_ms=N)", from the
+    server's EMA latency); this pacer holds the NEXT call back until the
+    hint elapses, doubling an exponential floor when sheds repeat without
+    a hint, and heals instantly on the first success. The same role the
+    native per-node CircuitBreaker (trpc/circuit_breaker.h) plays for
+    transport failures, at the application layer where overload answers
+    live (an ELIMIT response IS a received response, so the transport
+    breaker rightly never trips on it).
+
+    Thread-safe; `sheds` is the bounded-retry-rate observable the
+    shed-storm test asserts against the server's per-tenant counters."""
+
+    _MIN_DELAY_S = 0.005
+    _MAX_DELAY_S = 0.5
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._until = 0.0   # monotonic time before which calls pace
+        self._delay = 0.0   # current backoff floor
+        self.sheds = 0
+
+    def note(self, err) -> float:
+        """Record an error; returns the pacing delay now owed (0 for
+        non-overload errors, which also leave the pacer untouched)."""
+        if not getattr(err, "overloaded", False):
+            return 0.0
+        hint_s = (getattr(err, "retry_after_ms", None) or 0) / 1000.0
+        with self._mu:
+            self.sheds += 1
+            self._delay = min(max(self._delay * 2, self._MIN_DELAY_S),
+                              self._MAX_DELAY_S)
+            delay = max(hint_s, self._delay)
+            self._until = max(self._until, time.monotonic() + delay)
+            return max(0.0, self._until - time.monotonic())
+
+    def clear(self) -> None:
+        """A success: the server is admitting again — stop pacing."""
+        with self._mu:
+            self._delay = 0.0
+            self._until = 0.0
+
+    def pace(self) -> None:
+        """Sleep out any pacing debt before issuing the next call.
+        Client-side only: runs on the CALLER's thread (training loop /
+        fleet worker), never inside a server handler."""
+        with self._mu:
+            wait = self._until - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)  # tpulint: allow(py-blocking)
+
+
 class PartialPullError(native.RpcError):
     """A ``pull_all`` that delivered SOME tensors before a per-name
     failure: ``partial`` holds the decoded ``{name: (version, value)}``,
@@ -289,7 +346,12 @@ class ParameterServer:
                         entry["state"] = state  # repair pass reads this
                     meta[k] = entry
                 epoch = self._schema_epoch
-            return json.dumps({"epoch": epoch, "params": meta,
+            # "qos": 1 is the QoS-advertisement half of the negotiation
+            # discipline (same pattern as "codecs"): clients stamp
+            # priority/tenant wire fields ONLY after seeing it, so an
+            # upgraded client never sends a meta a pre-QoS parser would
+            # reject.
+            return json.dumps({"epoch": epoch, "params": meta, "qos": 1,
                                "codecs": list(self._codecs)}).encode(), None
         if method == "Epoch":
             # The Meta-cache validator: a tiny small-RPC-fast-path answer
@@ -699,7 +761,7 @@ class ParameterClient:
     push k+1, so repeated pushes never compound rounding bias)."""
 
     def __init__(self, addr: str, arena: Optional[TensorArena] = None,
-                 codec: Optional[str] = None):
+                 codec: Optional[str] = None, tenant: str = ""):
         self.addr = addr
         self.channel = TensorChannel(addr, arena)
         # Meta cache keyed by the server's schema epoch: the epoch bumps
@@ -711,18 +773,84 @@ class ParameterClient:
         self._codec = codec
         self._srv_codecs: Optional[tuple] = None  # unknown until Meta
         self._ef = codec_mod.ErrorFeedback()
+        # Overload protection: the tenant id this client's requests carry
+        # (the server's per-tenant quota key; "" falls back to peer ip
+        # server-side), and the shed-storm pacer overload answers feed.
+        self._tenant = tenant
+        self.pacer = OverloadPacer()
+        # QoS negotiation state: None until the first Meta fetch; True
+        # when the server advertised "qos": 1. Stamping before the
+        # advertisement (or against a pre-QoS server, whose parser
+        # rejects the unknown meta fields) would kill the connection.
+        self._srv_qos: Optional[bool] = None
+
+    # ---- QoS lanes (native/trpc/qos.h) ----
+    # Control-plane calls (Epoch, the migrator handshake) ride HIGH —
+    # they must stay live while bulk tensor traffic saturates the
+    # server's gate; Pull/Push/PullQ ride BULK and accept the headroom
+    # shed. NEGOTIATED like the codec advertisement: fields are stamped
+    # only after the server's Meta carried "qos": 1 (a pre-QoS parser
+    # reads the extra meta bytes as a corrupt service name and kills the
+    # connection), and Meta itself — the negotiation vehicle — always
+    # rides unstamped so renegotiation works against any build.
+
+    def _qos(self, priority: int):
+        import contextlib
+
+        if self._srv_qos is None:
+            # Lazy negotiation (the codec pattern): one Meta RPC the
+            # first time a stamped call would happen. A fetch failure
+            # leaves the state unknown — this call rides unstamped and a
+            # later one retries the advertisement.
+            try:
+                self.meta()
+            except Exception:  # noqa: BLE001 — the op itself will report
+                pass
+        if not self._srv_qos:
+            return contextlib.nullcontext()
+        return native.qos(priority, self._tenant)
+
+    def _qos_high(self):
+        return self._qos(native.PRIORITY_HIGH)
+
+    def _qos_bulk(self):
+        return self._qos(native.PRIORITY_BULK)
+
+    def _qos_failed(self, e: "native.RpcError") -> bool:
+        """Self-heal a stale QoS advertisement: a server rolled back to a
+        pre-QoS build rejects stamped frames at PARSE time, which
+        surfaces client-side as a transport error (connection killed —
+        EEOF/EFAILEDSOCKET/ECONNECT). Re-read the advertisement ONCE
+        (Meta rides unstamped, so it works against any build); True =
+        the server no longer advertises QoS and the caller should retry
+        its now-unstamped call. Genuine transport failures re-advertise
+        and keep their error, costing one Meta RPC on an already-failing
+        path — the _codec_pull_failed discipline."""
+        if not self._srv_qos or e.code not in (2001, 2002, 2007):
+            return False
+        self._srv_qos = None
+        try:
+            self.meta()
+        except Exception:  # noqa: BLE001 — keep the original error
+            return False
+        return not self._srv_qos
 
     def meta(self) -> dict:
+        # UNSTAMPED deliberately: Meta is the negotiation vehicle for both
+        # the codec and the QoS advertisement — it must parse on any
+        # build, including one that predates the QoS meta fields.
         payload, _ = self.channel.call("ParamService/Meta")
         doc = json.loads(payload.decode())
         self._meta_epoch = doc["epoch"]
         self._meta_cache = doc["params"]
         self._srv_codecs = tuple(doc.get("codecs", ()))
+        self._srv_qos = bool(doc.get("qos", 0))
         return doc["params"]
 
     def epoch(self) -> int:
         """The server's schema epoch (a tiny small-RPC-fast-path call)."""
-        payload, _ = self.channel.call("ParamService/Epoch")
+        with self._qos_high():
+            payload, _ = self.channel.call("ParamService/Epoch")
         return json.loads(payload.decode())["epoch"]
 
     def cached_meta(self) -> dict:
@@ -853,29 +981,47 @@ class ParameterClient:
 
     def pull(self, name: str, device=None):
         """-> (version, jax.Array) — H2D straight from the shared pages."""
+        self.pacer.pace()
         try:
-            rest, arr = self.channel.pull_device(
-                "ParamService/Pull", request=self._pull_request(name),
-                device=device, note_name=name)
+            with self._qos_bulk():
+                rest, arr = self.channel.pull_device(
+                    "ParamService/Pull", request=self._pull_request(name),
+                    device=device, note_name=name)
         except native.RpcError as e:
-            if not self._codec_pull_failed(e):
+            self.pacer.note(e)
+            if not (self._codec_pull_failed(e) or self._qos_failed(e)):
                 raise
-            # Renegotiated (server rolled back to a pre-codec build):
-            # the marker-less request is byte-identical to the old wire.
-            rest, arr = self.channel.pull_device(
-                "ParamService/Pull", request=self._pull_request(name),
-                device=device)
+            # Renegotiated (server rolled back to a pre-codec or pre-QoS
+            # build): the retried request is byte-identical to the wire
+            # that build speaks.
+            with self._qos_bulk():
+                rest, arr = self.channel.pull_device(
+                    "ParamService/Pull", request=self._pull_request(name),
+                    device=device)
+        self.pacer.clear()
         return int(rest.decode()), arr
 
     def push_grad(self, name: str, grad) -> int:
         """Send a device gradient; returns the server's new version."""
+        self.pacer.pace()
         try:
-            payload = self.channel.push_device(
-                "ParamService/Push", grad, request=name.encode(),
-                encoder=self._grad_encoder(name))
+            with self._qos_bulk():
+                payload = self.channel.push_device(
+                    "ParamService/Push", grad, request=name.encode(),
+                    encoder=self._grad_encoder(name))
         except native.RpcError as e:
+            self.pacer.note(e)
             self._codec_push_failed(e)
-            raise
+            if self._qos_failed(e):
+                # Pre-QoS rollback: retry once unstamped (the heal
+                # re-read the advertisement; the frame is now the old
+                # wire exactly).
+                payload = self.channel.push_device(
+                    "ParamService/Push", grad, request=name.encode(),
+                    encoder=self._grad_encoder(name))
+            else:
+                raise
+        self.pacer.clear()
         return int(payload.decode())
 
     # ---- live-resharding handshake (used by brpc_tpu/fleet.Migrator) ----
@@ -884,8 +1030,9 @@ class ParameterClient:
         """Freeze + export `name` -> (version, stacked [param, momentum]
         host array). The server refuses pushes to it from now on."""
         req = json.dumps({"name": name, "dest": dest}).encode()
-        payload, stacked = self.channel.call("ParamService/Handoff",
-                                             request=req)
+        with self._qos_high():  # migrator handshake = control plane
+            payload, stacked = self.channel.call("ParamService/Handoff",
+                                                 request=req)
         return json.loads(payload.decode())["version"], stacked
 
     def install(self, name: str, stacked, version: int,
@@ -893,16 +1040,20 @@ class ParameterClient:
         """Adopt a stacked [param, momentum] tensor at `version` in
         pending state; `commit=True` also flips it serving (reseed path)."""
         req = json.dumps({"name": name, "version": int(version)}).encode()
-        self.channel.call("ParamService/Install", array=stacked, request=req)
+        with self._qos_high():
+            self.channel.call("ParamService/Install", array=stacked,
+                              request=req)
         if commit:
             self.commit(name)
 
     def retire(self, name: str, dest: str = "") -> None:
         req = json.dumps({"name": name, "dest": dest}).encode()
-        self.channel.call("ParamService/Retire", request=req)
+        with self._qos_high():
+            self.channel.call("ParamService/Retire", request=req)
 
     def commit(self, name: str) -> None:
-        self.channel.call("ParamService/Commit", request=name.encode())
+        with self._qos_high():
+            self.channel.call("ParamService/Commit", request=name.encode())
 
     # ---- pipelined multi-tensor hot path (PipelineWindow) ----
     # The serial pull/push above pay one full round-trip per tensor: a
@@ -933,6 +1084,7 @@ class ParameterClient:
         from brpc_tpu.runtime.tensor import (_decode_meta_ex, _metrics,
                                              _stage, consume_pull_reply)
 
+        self.pacer.pace()  # shed-storm brake: honor any retry-after debt
         listed_meta = None
         if names is None:
             listed_meta = self.cached_meta()
@@ -961,18 +1113,20 @@ class ParameterClient:
                     out[name] = (int(rest.decode()), dev)
 
             try:
-                with PipelineWindow(self.channel, window,
-                                    on_reply=on_reply) as win:
+                with self._qos_bulk(), PipelineWindow(
+                        self.channel, window, on_reply=on_reply) as win:
                     for name in names:
                         win.submit("ParamService/Pull",
                                    request=self._pull_request(name),
                                    tag=name)
             except native.RpcError as e:
+                self.pacer.note(e)
                 if out:
                     raise PartialPullError(
                         e, dict(out),
                         [n for n in names if n not in out]) from e
                 raise
+            self.pacer.clear()
             return out
 
         import jax
@@ -1116,8 +1270,8 @@ class ParameterClient:
             out[tag] = (int(rest.decode()), dev)
 
         try:
-            with PipelineWindow(self.channel, window,
-                                on_reply=on_reply) as win:
+            with self._qos_bulk(), PipelineWindow(
+                    self.channel, window, on_reply=on_reply) as win:
                 for name in singles:
                     win.submit("ParamService/Pull",
                                request=self._pull_request(name), tag=name)
@@ -1127,6 +1281,7 @@ class ParameterClient:
                     win.submit("ParamService/PullQ", request=req,
                                tag=tuple(g))
         except native.RpcError as e:
+            self.pacer.note(e)
             if self._codec_pull_failed(e):
                 # Pre-codec rollback (no PullQ method): renegotiated to
                 # raw — re-pull the stragglers through the per-tensor
@@ -1154,6 +1309,7 @@ class ParameterClient:
                     e, dict(out),
                     [n for n in names if n not in out]) from e
             raise
+        self.pacer.clear()
         return out
 
     def push_all(self, grads: Dict[str, object], window: int = 4
@@ -1172,21 +1328,24 @@ class ParameterClient:
             view.release()  # push responses carry no tensor
             versions[name] = int(payload.decode())
 
+        self.pacer.pace()
         try:
-            with PipelineWindow(self.channel, window,
-                                on_reply=on_reply) as win:
+            with self._qos_bulk(), PipelineWindow(
+                    self.channel, window, on_reply=on_reply) as win:
                 for name, grad in grads.items():
                     win.submit("ParamService/Push", array=grad,
                                request=name.encode(), tag=name,
                                encoder=self._grad_encoder(name))
                     m["push_bytes"].add(int(getattr(grad, "nbytes", 0)))
         except native.RpcError as e:
+            self.pacer.note(e)
             self._codec_push_failed(e)
             if versions:
                 raise PartialPushError(
                     e, dict(versions),
                     [n for n in grads if n not in versions]) from e
             raise
+        self.pacer.clear()
         return versions
 
     def close(self) -> None:

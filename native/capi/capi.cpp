@@ -26,7 +26,9 @@
 #include "trpc/compress.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
+#include "trpc/qos.h"
 #include "trpc/registry.h"
+#include "trpc/rpc_metrics.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
 #include "trpc/stall_watchdog.h"
@@ -70,12 +72,16 @@ class PyCallbackPool {
 
   // Run `job` on a pool pthread; the CALLING fiber parks until it returns.
   // False = admission bound hit (job not run): fail the RPC with ELIMIT.
-  bool Run(std::function<void()> job) {
+  // `priority` (qos.h RequestPriority) is the overload-protection lane:
+  // HIGH jobs jump the queue, and BULK jobs shed 1/8 of the admission
+  // bound early so pool saturation by tensor traffic can never consume
+  // the last threads a heartbeat handler needs.
+  bool Run(std::function<void()> job, int priority = PRIORITY_NORMAL) {
     tbthread::CountdownEvent done(1);
     if (!Enqueue([&job, &done] {
           job();
           done.signal();
-        })) {
+        }, priority)) {
       return false;
     }
     done.wait();  // fiber-aware park
@@ -100,7 +106,7 @@ class PyCallbackPool {
           std::lock_guard<std::mutex> lk(mu);
           finished = true;
           cv.notify_one();
-        })) {
+        }, PRIORITY_HIGH)) {  // scrape/gauge paths are control plane
       return false;
     }
     // Deliberate pthread block (see above).
@@ -114,18 +120,30 @@ class PyCallbackPool {
     std::function<void()> fn;
   };
 
-  bool Enqueue(std::function<void()> fn) {
+  bool Enqueue(std::function<void()> fn, int priority) {
     {
       // O(1) queue push; pool threads block by design (dedicated pthreads,
       // not fiber workers).
       std::lock_guard<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking)
-      const int64_t max_jobs = std::max<int64_t>(
+      int64_t max_jobs = std::max<int64_t>(
           1, g_python_cb_max->load(std::memory_order_relaxed));
+      if (priority == PRIORITY_BULK) {
+        // BULK sheds early: at least one slot (1/8 of larger bounds)
+        // stays reserved for HIGH/NORMAL handlers while bulk tensor
+        // traffic saturates — the max(1,...) floor keeps the reservation
+        // real for small operator-tuned bounds too.
+        max_jobs = std::max<int64_t>(
+            1, max_jobs - std::max<int64_t>(1, max_jobs / 8));
+      }
       if (_outstanding >= max_jobs) {
         return false;  // admission bound: shed instead of minting threads
       }
       ++_outstanding;
-      _queue.push_back(Job{std::move(fn)});
+      if (priority == PRIORITY_HIGH) {
+        _queue.push_front(Job{std::move(fn)});  // jump the bulk backlog
+      } else {
+        _queue.push_back(Job{std::move(fn)});
+      }
       // Grow whenever queued jobs outnumber idle threads: a hard spawn cap
       // (or an _idle==0 test, which two racing enqueues can both pass with
       // one idle thread) would strand a job with no thread to serve it —
@@ -217,19 +235,28 @@ class CallbackService : public Service {
     char err_text[256];
     err_text[0] = '\0';
     const TraceContext trace_ctx = current_trace_context();
+    const QosContext qos_ctx = current_qos_context();
     const bool ran = PyCallbackPool::instance().Run([&] {
       // The pool thread inherits the server span: nested calls the Python
-      // handler issues parent there, keeping the trace linked.
+      // handler issues parent there, keeping the trace linked. Same for
+      // the request QoS — a nested RPC the handler issues inherits the
+      // tenant/priority and clamps to the remaining deadline budget.
       ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
+      ScopedQosContext qos_scope(qos_ctx);
       _cb(_ctx, method.c_str(), req.data(), req.size(), att.data(),
           att.size(), &resp, &resp_len, &resp_att, &resp_att_len,
           &error_code, err_text, sizeof(err_text));
-    });
+    }, qos_ctx.priority);
     if (!ran) {
+      // A pool shed is an overload answer like any gate shed: count it
+      // and carry a retry-after hint (drain time of a saturated pool is
+      // one callback's runtime — unknown here, so a small fixed pace
+      // beats the client's blind exponential floor).
       error_code = TRPC_ELIMIT;
+      GlobalRpcMetrics::instance().shed_total << 1;
       snprintf(err_text, sizeof(err_text), "%s",
                "python callback pool saturated "
-               "(python_callback_max_threads)");
+               "(python_callback_max_threads) (retry_after_ms=10)");
     }
     if (error_code != 0) {
       err_text[sizeof(err_text) - 1] = '\0';
@@ -275,6 +302,10 @@ struct ServerBox {
   Server server;
   NativeEchoService echo;
   bool echo_added = false;
+  // Options applied at Start — the pre-start setters
+  // (tbrpc_server_set_max_concurrency, tbrpc_server_set_tenant_quota)
+  // write here.
+  ServerOptions opts;
   std::vector<Service*> services;
   ~ServerBox() {
     for (auto* s : services) delete s;
@@ -291,7 +322,7 @@ void* tbrpc_server_create() { return new ServerBox; }
 
 int tbrpc_server_start(void* server, const char* addr) {
   auto* box = static_cast<ServerBox*>(server);
-  if (box->server.Start(addr, nullptr) != 0) return -1;
+  if (box->server.Start(addr, &box->opts) != 0) return -1;
   return box->server.listen_address().port;
 }
 
@@ -300,11 +331,28 @@ int tbrpc_server_start(void* server, const char* addr) {
 int tbrpc_server_start_tls(void* server, const char* addr, const char* cert,
                            const char* key) {
   auto* box = static_cast<ServerBox*>(server);
-  ServerOptions opts;
-  if (cert != nullptr) opts.ssl_cert_file = cert;
-  if (key != nullptr) opts.ssl_key_file = key;
-  if (box->server.Start(addr, &opts) != 0) return -1;
+  if (cert != nullptr) box->opts.ssl_cert_file = cert;
+  if (key != nullptr) box->opts.ssl_key_file = key;
+  if (box->server.Start(addr, &box->opts) != 0) return -1;
   return box->server.listen_address().port;
+}
+
+int tbrpc_server_set_max_concurrency(void* server, int32_t max) {
+  if (server == nullptr) return -1;
+  auto* box = static_cast<ServerBox*>(server);
+  if (box->server.running()) return -1;  // the limiter is built at Start
+  box->opts.max_concurrency = max < 0 ? 0 : max;
+  return 0;
+}
+
+int tbrpc_server_set_tenant_quota(void* server, int32_t max_inflight) {
+  if (server == nullptr) return -1;
+  auto* box = static_cast<ServerBox*>(server);
+  // Runtime-safe (atomic + lazy gate rebuild); also seeds the Start-time
+  // option so a pre-start call behaves identically.
+  box->opts.tenant_max_concurrency = max_inflight < 0 ? 0 : max_inflight;
+  box->server.set_tenant_quota(max_inflight);
+  return 0;
 }
 
 int tbrpc_server_stop(void* server) {
@@ -860,16 +908,20 @@ void TensorCallbackService::CallMethod(const std::string& method,
   char err_text[256];
   err_text[0] = '\0';
   const TraceContext trace_ctx = current_trace_context();
+  const QosContext qos_ctx = current_qos_context();
   const bool ran = PyCallbackPool::instance().Run([&] {
     ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
+    ScopedQosContext qos_scope(qos_ctx);
     _cb(_ctx, method.c_str(), req.data(), req.size(), att_ptr, att_len,
         &resp, &resp_len, &resp_arena, &resp_att_off, &resp_att_len,
         &resp_att_autofree, &error_code, err_text, sizeof(err_text));
-  });
+  }, qos_ctx.priority);
   if (!ran) {
     error_code = TRPC_ELIMIT;
+    GlobalRpcMetrics::instance().shed_total << 1;
     snprintf(err_text, sizeof(err_text), "%s",
-             "python callback pool saturated (python_callback_max_threads)");
+             "python callback pool saturated (python_callback_max_threads)"
+             " (retry_after_ms=10)");
   }
   if (error_code != 0) {
     err_text[sizeof(err_text) - 1] = '\0';
@@ -1270,6 +1322,47 @@ int64_t tbrpc_now_us(void) { return tbutil::gettimeofday_us(); }
 int tbrpc_flag_set(const char* name, const char* value) {
   if (name == nullptr || value == nullptr) return -1;
   return FlagRegistry::global().Set(name, value) ? 0 : -1;
+}
+
+// ---------------- overload protection: QoS + tenant quotas ----------------
+
+int tbrpc_qos_set(int priority, const char* tenant) {
+  QosContext ctx = current_qos_context();
+  ctx.priority = clamp_priority(priority);
+  std::string t = tenant != nullptr ? tenant : "";
+  if (t.size() > 256) {
+    return -1;  // tenant ids are short labels; refuse wire-bloating ones
+  }
+  ctx.tenant = std::move(t);
+  set_current_qos_context(ctx);
+  return 0;
+}
+
+void tbrpc_qos_clear(void) { clear_current_qos_context(); }
+
+int64_t tbrpc_qos_get(int* priority, char* tenant_buf, size_t cap) {
+  const QosContext ctx = current_qos_context();
+  if (priority != nullptr) *priority = ctx.priority;
+  return copy_out(ctx.tenant, tenant_buf, cap);
+}
+
+int64_t tbrpc_deadline_remaining_ms(void) {
+  const QosContext ctx = current_qos_context();
+  if (ctx.deadline_us <= 0) return -1;
+  const int64_t left_us = ctx.deadline_us - tbutil::gettimeofday_us();
+  return left_us > 0 ? left_us / 1000 : 0;
+}
+
+int64_t tbrpc_server_tenantz_json(void* server, char* buf, size_t cap) {
+  if (server == nullptr) return copy_out("{}", buf, cap);
+  std::string out;
+  static_cast<ServerBox*>(server)->server.TenantzJson(&out);
+  return copy_out(out, buf, cap);
+}
+
+int tbrpc_debug_inject_latency(const char* service, int64_t ms) {
+  SetDebugInjectedLatency(service != nullptr ? service : "", ms);
+  return 0;
 }
 
 // ---------------- quantized tensor wire: codec registry ----------------

@@ -321,6 +321,49 @@ int64_t tbrpc_now_us(void);
 // flag / parse error / validator veto.
 int tbrpc_flag_set(const char* name, const char* value);
 
+// ---- overload protection: priority lanes, tenant quotas, deadlines ----
+// Ambient QoS context (trpc/qos.h): a fiber-local (thread-local off-fiber)
+// slot — the same discipline as the trace context — read by every
+// Channel::CallMethod on this thread. priority: 0 HIGH, 1 NORMAL (the
+// unmarked default), 2 BULK; tenant (may be null/"") keys the server's
+// per-tenant quota gate. Stamped requests carry both in the tstd meta
+// behind a flag bit; an unmarked request's wire is byte-identical to the
+// pre-QoS format. Always 0.
+// tenant is capped at 256 bytes (-1 when longer — tenant ids are short
+// labels, and the wire field is length-prefixed).
+int tbrpc_qos_set(int priority, const char* tenant);
+void tbrpc_qos_clear(void);
+// Read the slot back (the qos() scope-nesting restore in the Python
+// bindings reads the REAL ambient values — including those a server
+// handler scope installed — instead of a Python-side shadow). *priority
+// gets the current lane; the tenant copies out (copy-out convention).
+int64_t tbrpc_qos_get(int* priority, char* tenant_buf, size_t cap);
+// Remaining budget of the request the CURRENT thread is handling (set by
+// the server around every handler, including the Python callback-pool
+// threads): milliseconds left, 0 when expired, -1 when no deadline is in
+// scope. Nested RPCs clamp to this automatically; handlers use it to shed
+// doomed work early.
+int64_t tbrpc_deadline_remaining_ms(void);
+// Concurrency gate for the server (0 = unlimited). Pre-start only (the
+// limiter is built at Start): -1 once the server is running.
+int tbrpc_server_set_max_concurrency(void* server, int32_t max);
+// Per-tenant in-flight quota layered UNDER the global gate (0 = off):
+// each tenant (QoS meta tenant, falling back to the peer ip) sheds its
+// own overflow with TRPC_ELIMIT + a retry_after_ms hint before it can
+// crowd out other tenants. Runtime-safe. 0 ok.
+int tbrpc_server_set_tenant_quota(void* server, int32_t max_inflight);
+// The /tenantz document for one server: {"quota":N,"tenants":[{name,
+// admitted,shed,inflight,quota}...]}. Copy-out convention.
+int64_t tbrpc_server_tenantz_json(void* server, char* buf, size_t cap);
+
+// TEST-ONLY fault injection beside tbrpc_debug_hold_workers: every
+// ADMITTED tstd request to `service` parks its dispatch fiber for `ms`
+// while holding its gate slot — a slow handler's exact footprint, so
+// overload/shed tests create deterministic queueing without
+// host-steal-sensitive busy loops. ms <= 0 clears; empty/null service
+// clears every injection. Always 0.
+int tbrpc_debug_inject_latency(const char* service, int64_t ms);
+
 // ---- quantized tensor wire: codec registry + accounting ----
 // The tensor-codec negotiation seam (trpc/compress.h — the registry that
 // sits beside gzip/snappy): ids/names are the per-call currency of the

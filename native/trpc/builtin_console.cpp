@@ -27,6 +27,7 @@
 #include "trpc/controller.h"
 #include "trpc/flags.h"
 #include "trpc/registry.h"
+#include "trpc/rpc_metrics.h"
 #include "trpc/stall_watchdog.h"
 #include "trpc/http_protocol.h"
 #include "trpc/server.h"
@@ -63,6 +64,9 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "(?format=json for the fleet scrape)</li>"
       "<li><a href=\"/tensorz\">/tensorz</a> — tensor arenas + data-plane "
       "stage latencies</li>"
+      "<li><a href=\"/tenantz\">/tenantz</a> — overload protection: "
+      "per-tenant admitted/shed/inflight + lane p99 + shed counters "
+      "(?format=json)</li>"
       "<li><a href=\"/fleetz\">/fleetz</a> — fleet pane of glass: "
       "registry-driven per-shard health/qps/p99/codec/version-lag scrape "
       "(?tag=&amp;format=json)</li>"
@@ -396,6 +400,74 @@ void healthz_page(const HttpRequest&, HttpResponse* resp) {
   resp->content_type = "application/json";
   resp->body = StallWatchdog::singleton().DumpJson();
   resp->body += '\n';
+}
+
+// ---------------- /tenantz: overload protection at a glance -------------
+// The serving Server's per-tenant admission table (server.h TenantStats):
+// who got admitted, who was shed (with the quota that shed them), plus the
+// process-wide shed counters and per-lane latency the priority lanes
+// maintain. ?format=json serves the raw Server::TenantzJson document (the
+// same bytes capi tbrpc_server_tenantz_json returns, so scrapes can't
+// drift from the console).
+void tenantz_page(const HttpRequest& req, HttpResponse* resp) {
+  if (req.server == nullptr) {
+    resp->status = 500;
+    resp->body = "no serving server\n";
+    return;
+  }
+  std::string doc;
+  req.server->TenantzJson(&doc);
+  if (req.query_param("format") == "json") {
+    resp->content_type = "application/json";
+    resp->body = doc + "\n";
+    return;
+  }
+  auto& gm = GlobalRpcMetrics::instance();
+  std::string& b = resp->body;
+  char line[256];
+  snprintf(line, sizeof(line),
+           "tenant quota: %d (0 = off)\nema latency: %lld us\n\n",
+           req.server->tenant_quota(),
+           static_cast<long long>(req.server->ema_latency_us()));
+  b += line;
+  snprintf(line, sizeof(line),
+           "sheds: total=%lld bulk=%lld tenant=%lld deadline=%lld\n",
+           static_cast<long long>(gm.shed_total.get_value()),
+           static_cast<long long>(gm.shed_bulk.get_value()),
+           static_cast<long long>(gm.shed_tenant.get_value()),
+           static_cast<long long>(gm.shed_deadline.get_value()));
+  b += line;
+  snprintf(line, sizeof(line),
+           "lane p99 (us): high=%lld bulk=%lld\n\n",
+           static_cast<long long>(
+               gm.server_high_latency.latency_percentile(0.99)),
+           static_cast<long long>(
+               gm.server_bulk_latency.latency_percentile(0.99)));
+  b += line;
+  b += "tenant                         admitted       shed   inflight  "
+       "quota\n";
+  const auto parsed = tbutil::JsonValue::Parse(doc);
+  const tbutil::JsonValue* tenants =
+      parsed.has_value() ? parsed->find("tenants") : nullptr;
+  if (tenants == nullptr || tenants->size() == 0) {
+    b += "(no tenants seen yet)\n";
+    return;
+  }
+  auto field_int = [](const tbutil::JsonValue& o, const char* key) {
+    const tbutil::JsonValue* v = o.find(key);
+    return v != nullptr ? v->as_int() : int64_t{0};
+  };
+  for (size_t i = 0; i < tenants->size(); ++i) {
+    const tbutil::JsonValue& t = (*tenants)[i];
+    const tbutil::JsonValue* name = t.find("name");
+    snprintf(line, sizeof(line), "%-28s %10lld %10lld %10lld %6lld\n",
+             name != nullptr ? name->as_string().c_str() : "?",
+             static_cast<long long>(field_int(t, "admitted")),
+             static_cast<long long>(field_int(t, "shed")),
+             static_cast<long long>(field_int(t, "inflight")),
+             static_cast<long long>(field_int(t, "quota")));
+    b += line;
+  }
 }
 
 // ---------------- /fleetz: the fleet pane of glass ----------------
@@ -979,6 +1051,7 @@ void RegisterBuiltinConsole() {
     // scrape configs written for it must point here unchanged.
     RegisterHttpHandler("/brpc_metrics", metrics_page);
     RegisterHttpHandler("/tensorz", tensorz_page);
+    RegisterHttpHandler("/tenantz", tenantz_page);
     RegisterHttpHandler("/fleetz", fleetz_page);
     RegisterHttpHandler("/sockets", sockets_page);
     RegisterHttpHandler("/ids", ids_page);

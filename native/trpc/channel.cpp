@@ -7,6 +7,7 @@
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
 #include "trpc/errno.h"
+#include "trpc/qos.h"
 #include "trpc/span.h"
 #include "trpc/tstd_protocol.h"
 
@@ -144,6 +145,24 @@ void Channel::CallMethod(const std::string& service_method, Controller* cntl,
   cntl->_done = done;
   if (cntl->_timeout_ms > 0) {
     cntl->_deadline_us = cntl->_begin_time_us + cntl->_timeout_ms * 1000;
+  }
+  // Ambient QoS (qos.h): priority/tenant stamp the wire unless the caller
+  // set them explicitly, and a server handler's remaining budget CLAMPS
+  // this nested call — deadline = min(own timeout, parent remaining) — so
+  // a doomed request stops consuming downstream capacity instead of
+  // timing out independently at every hop.
+  {
+    const QosContext qos = current_qos_context();
+    if (cntl->_priority < 0 && qos.priority != PRIORITY_NORMAL) {
+      cntl->_priority = static_cast<int16_t>(qos.priority);
+    }
+    if (cntl->_tenant.empty() && !qos.tenant.empty()) {
+      cntl->_tenant = qos.tenant;
+    }
+    if (qos.deadline_us > 0 && (cntl->_deadline_us == 0 ||
+                                qos.deadline_us < cntl->_deadline_us)) {
+      cntl->_deadline_us = qos.deadline_us;
+    }
   }
 
   tbthread::fiber_id_t cid;

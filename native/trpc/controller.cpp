@@ -52,6 +52,8 @@ void Controller::Reset() {
   _sni_host.clear();
   _connection_type = 0;
   _compress_type = -1;
+  _priority = -1;
+  _tenant.clear();
   _lb.reset();
   _tried.clear();
   _request_code = 0;

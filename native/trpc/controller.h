@@ -85,6 +85,17 @@ class Controller {
   int64_t deadline_us() const { return _deadline_us; }
   bool server_side() const { return _server_side; }
 
+  // ---- request QoS (qos.h: priority lanes + tenant quotas) ----
+  // Explicit per-call override; unset (-1) inherits the ambient QoS
+  // context in Channel::CallMethod (the usual path — Python stamps the
+  // context, not the controller).
+  void set_priority(int p) { _priority = static_cast<int16_t>(p); }
+  int priority() const {
+    return _priority < 0 ? 1 /* PRIORITY_NORMAL */ : _priority;
+  }
+  void set_tenant(const std::string& t) { _tenant = t; }
+  const std::string& tenant() const { return _tenant; }
+
  private:
   friend class Channel;
   friend class ControllerPrivateAccessor;
@@ -128,6 +139,9 @@ class Controller {
   // default) so an explicit set_compress_type(kCompressNone) can DISABLE a
   // channel-level default.
   int16_t _compress_type = -1;
+  // Request QoS: -1 = unset (inherit the ambient context at CallMethod).
+  int16_t _priority = -1;
+  std::string _tenant;
 
   // call state
   std::string _service_method;

@@ -1,0 +1,46 @@
+#include "trpc/qos.h"
+
+#include "tbthread/key.h"
+
+namespace trpc {
+
+// Same machinery as the rpcz trace context (span.cpp): a fiber key whose
+// storage degrades to a plain thread-local slot on non-fiber threads, so a
+// Python callback-pool pthread (or any embedder thread) can carry the
+// request QoS across the calls it issues.
+
+namespace {
+
+void qos_ctx_dtor(void* p) { delete static_cast<QosContext*>(p); }
+
+tbthread::FiberKey qos_key() {
+  static tbthread::FiberKey key = [] {
+    tbthread::FiberKey k;
+    tbthread::fiber_key_create(&k, qos_ctx_dtor);
+    return k;
+  }();
+  return key;
+}
+
+}  // namespace
+
+QosContext current_qos_context() {
+  auto* ctx = static_cast<QosContext*>(tbthread::fiber_getspecific(qos_key()));
+  return ctx != nullptr ? *ctx : QosContext{};
+}
+
+void set_current_qos_context(const QosContext& ctx) {
+  auto* cur = static_cast<QosContext*>(tbthread::fiber_getspecific(qos_key()));
+  if (cur == nullptr) {
+    cur = new QosContext;
+    tbthread::fiber_setspecific(qos_key(), cur);
+  }
+  *cur = ctx;
+}
+
+void clear_current_qos_context() {
+  auto* cur = static_cast<QosContext*>(tbthread::fiber_getspecific(qos_key()));
+  if (cur != nullptr) *cur = QosContext{};  // keep the allocation
+}
+
+}  // namespace trpc

@@ -49,6 +49,12 @@ GlobalRpcMetrics::GlobalRpcMetrics() {
   bytes_in.expose("rpc_socket_bytes_in");
   bytes_out.expose("rpc_socket_bytes_out");
   connections_accepted.expose("rpc_connections_accepted");
+  shed_total.expose("rpc_shed_total");
+  shed_bulk.expose("rpc_shed_bulk");
+  shed_tenant.expose("rpc_shed_tenant");
+  shed_deadline.expose("rpc_shed_deadline");
+  server_high_latency.expose("rpc_server_lane_high");
+  server_bulk_latency.expose("rpc_server_lane_bulk");
 }
 
 GlobalRpcMetrics& GlobalRpcMetrics::instance() {

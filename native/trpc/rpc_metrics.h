@@ -42,7 +42,7 @@ class MethodStatus {
 
 MethodStatus* GetMethodStatus(const std::string& service_method);
 
-// Global counters (exposed as rpc_client_*, rpc_socket_*).
+// Global counters (exposed as rpc_client_*, rpc_socket_*, rpc_shed_*).
 struct GlobalRpcMetrics {
   tbvar::LatencyRecorder client_latency{60};
   tbvar::Adder<int64_t> client_errors;
@@ -50,6 +50,15 @@ struct GlobalRpcMetrics {
   tbvar::Adder<int64_t> bytes_in;
   tbvar::Adder<int64_t> bytes_out;
   tbvar::Adder<int64_t> connections_accepted;
+  // Overload-protection plane (server admission, server.cpp): why requests
+  // were shed, and the per-lane server latency the 10x-overload bench
+  // reads (HIGH-lane p99 must stay flat while BULK saturates).
+  tbvar::Adder<int64_t> shed_total;     // every shed, any reason
+  tbvar::Adder<int64_t> shed_bulk;      // BULK lane lost its headroom race
+  tbvar::Adder<int64_t> shed_tenant;    // per-tenant quota
+  tbvar::Adder<int64_t> shed_deadline;  // propagated deadline already gone
+  tbvar::LatencyRecorder server_high_latency{60};  // rpc_server_lane_high
+  tbvar::LatencyRecorder server_bulk_latency{60};  // rpc_server_lane_bulk
 
   static GlobalRpcMetrics& instance();
 

@@ -6,14 +6,28 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
 #include <cstring>
 
+#include "tbutil/json.h"
 #include "tbutil/logging.h"
 #include "tbutil/time.h"
+#include "trpc/flags.h"
+#include "trpc/rpc_metrics.h"
 #include "trpc/tstd_protocol.h"
 
 namespace trpc {
+
+// Percentage of the active concurrency gate RESERVED for HIGH/NORMAL
+// traffic: BULK requests are admitted only while that many slots stay
+// free, so a saturating tensor client can never occupy the last slots a
+// heartbeat or version poll needs. 0 disables the reservation (the
+// protection-off side of the 10x-overload bench A/B).
+static auto* g_bulk_headroom_pct = TRPC_DEFINE_FLAG(
+    rpc_bulk_headroom_pct, 10,
+    "percent of the concurrency gate reserved away from BULK-lane "
+    "requests (0 = no priority reservation)");
 
 Server::~Server() {
   Stop();
@@ -22,6 +36,12 @@ Server::~Server() {
   }
   if (_drain_butex != nullptr) {
     tbthread::butex_destroy(_drain_butex);
+  }
+  // Stop() drained every in-flight request, so no Admission still points
+  // at a tenant entry.
+  for (auto& [name, t] : _tenants) {
+    (void)name;
+    delete t;
   }
 }
 
@@ -35,8 +55,226 @@ void Server::EndRequest(int64_t latency_us) {
   }
 }
 
+void Server::EndRequest(int64_t latency_us, const Admission& admit) {
+  if (latency_us >= 0) {
+    // Lossy racy EMA (alpha 1/8) of admitted-request latency: the
+    // retry-after source. Precision is irrelevant next to the question
+    // "roughly how long until a slot frees".
+    const int64_t cur = _ema_latency_us.load(std::memory_order_relaxed);
+    _ema_latency_us.store(
+        cur == 0 ? latency_us : cur + (latency_us - cur) / 8,
+        std::memory_order_relaxed);
+    if (admit.priority == PRIORITY_HIGH) {
+      GlobalRpcMetrics::instance().server_high_latency << latency_us;
+    } else if (admit.priority == PRIORITY_BULK) {
+      GlobalRpcMetrics::instance().server_bulk_latency << latency_us;
+    }
+  }
+  if (admit.tenant != nullptr) {
+    admit.tenant->End();
+  }
+  EndRequest(latency_us);
+}
+
+TenantStats* Server::TenantEntry(std::string_view tenant) {
+  const int32_t quota = _tenant_quota.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(_tenant_mu);  // tpulint: allow(fiber-blocking)
+  auto it = _tenants.find(tenant);
+  if (it == _tenants.end()) {
+    // Tenant ids arrive off the wire: cap the table (the GetMethodStatus
+    // discipline) so a client cycling fresh tenant strings can't grow
+    // immortal entries without bound — overflow tenants share one
+    // aggregate bucket, still quota-gated and visible on /tenantz.
+    constexpr size_t kMaxTenants = 1024;
+    if (_tenants.size() >= kMaxTenants) {
+      it = _tenants.find(std::string_view("(overflow)"));
+      if (it == _tenants.end()) {
+        auto* of = new TenantStats;
+        of->name = "(overflow)";
+        it = _tenants.emplace(of->name, of).first;
+      }
+    } else {
+      auto* t = new TenantStats;
+      t->name = std::string(tenant);
+      it = _tenants.emplace(t->name, t).first;
+    }
+  }
+  // Propagate a live quota change as a plain atomic store: the entry's
+  // gate is an inflight/quota atomic pair (server.h), so there is no
+  // limiter object to swap under lock-free readers — the next admission
+  // simply reads the new bound.
+  it->second->quota.store(quota, std::memory_order_relaxed);
+  return it->second;
+}
+
+void Server::set_tenant_quota(int32_t max_inflight) {
+  _tenant_quota.store(max_inflight < 0 ? 0 : max_inflight,
+                      std::memory_order_relaxed);
+}
+
+bool Server::BeginRequest() {
+  // Legacy single-lane path (HTTP/h2): the pre-QoS behavior exactly — no
+  // tenant accounting (its matching EndRequest(latency) overload releases
+  // no tenant gate) and no lane reservation.
+  _concurrency.fetch_add(1, std::memory_order_acquire);
+  if (_limiter != nullptr && !_limiter->OnRequestBegin()) {
+    EndRequest(-1);
+    return false;
+  }
+  return true;
+}
+
+bool Server::BeginRequest(const RequestQos& qos,
+                          const tbutil::EndPoint& peer, Admission* admit) {
+  auto& gm = GlobalRpcMetrics::instance();
+  admit->priority = clamp_priority(qos.priority);
+  const int32_t inflight_now =
+      _concurrency.fetch_add(1, std::memory_order_acquire) + 1;
+
+  auto shed = [&](int error, std::string text) {
+    admit->error = error;
+    admit->text = std::move(text);
+    admit->text += " (retry_after_ms=" +
+                   std::to_string(ComputeRetryAfterMs(inflight_now)) + ")";
+    gm.shed_total << 1;
+    EndRequest(-1, *admit);
+    admit->tenant = nullptr;
+    return false;
+  };
+
+  // 1. Dead on arrival: the budget the client propagated is already gone —
+  // answering TRPC_ERPCTIMEDOUT here costs nothing downstream.
+  if (qos.deadline_us > 0 &&
+      tbutil::gettimeofday_us() >= qos.deadline_us) {
+    gm.shed_deadline << 1;
+    return shed(TRPC_ERPCTIMEDOUT,
+                "propagated deadline already expired; shed at admission");
+  }
+
+  // 2. Per-tenant quota: a greedy tenant sheds BEFORE it reaches the
+  // shared gate, so it cannot crowd the others out of it.
+  if (_tenant_quota.load(std::memory_order_relaxed) > 0) {
+    std::string peer_key;
+    std::string_view tname = qos.tenant;
+    if (tname.empty()) {
+      // Fall back to peer identity — the ip, not ip:port, so one client
+      // host is one tenant regardless of connection churn.
+      peer_key = tbutil::endpoint2str(peer);
+      const size_t colon = peer_key.rfind(':');
+      if (colon != std::string::npos) peer_key.resize(colon);
+      tname = peer_key;
+    }
+    TenantStats* t = TenantEntry(tname);
+    if (!t->TryBegin()) {
+      gm.shed_tenant << 1;
+      return shed(TRPC_ELIMIT, "tenant '" + t->name + "' over quota");
+    }
+    admit->tenant = t;
+  }
+
+  // 3. Priority lanes: BULK is admitted only while the gate keeps
+  // headroom free for the control plane.
+  if (admit->priority == PRIORITY_BULK && _limiter != nullptr) {
+    const int32_t limit = _limiter->max_concurrency();
+    const int64_t pct =
+        g_bulk_headroom_pct->load(std::memory_order_relaxed);
+    if (limit > 0 && pct > 0) {
+      const int32_t headroom = std::max<int32_t>(
+          1, static_cast<int32_t>(limit * pct / 100));
+      if (inflight_now > limit - headroom) {
+        gm.shed_bulk << 1;
+        return shed(TRPC_ELIMIT, "bulk lane shed: gate headroom reserved "
+                                 "for control-plane traffic");
+      }
+    }
+  }
+
+  // 4. The configured limiter (constant / auto / timeout) has the last
+  // word for every lane.
+  if (_limiter != nullptr && !_limiter->OnRequestBegin()) {
+    return shed(TRPC_ELIMIT, "server concurrency limit reached");
+  }
+  return true;
+}
+
+int64_t Server::ComputeRetryAfterMs(int32_t inflight_now) const {
+  // Roughly how long until a slot frees at the observed EMA latency,
+  // scaled by how oversubscribed the gate is. Clamped so a cold EMA
+  // still paces (>= 1ms) and a pathological spike can't tell clients to
+  // sleep forever.
+  const int64_t ema = _ema_latency_us.load(std::memory_order_relaxed);
+  if (ema <= 0) return 1;
+  const int32_t limit =
+      _limiter != nullptr ? _limiter->max_concurrency() : 0;
+  const int64_t factor =
+      limit > 0 ? std::max<int64_t>(1, inflight_now / limit) : 1;
+  return std::clamp<int64_t>(ema * factor / 1000, 1, 2000);
+}
+
+void Server::TenantzJson(std::string* out) const {
+  tbutil::JsonValue doc = tbutil::JsonValue::Object();
+  doc.set("quota",
+          static_cast<int64_t>(_tenant_quota.load(std::memory_order_relaxed)));
+  tbutil::JsonValue arr = tbutil::JsonValue::Array();
+  {
+    std::lock_guard<std::mutex> lk(_tenant_mu);  // tpulint: allow(fiber-blocking)
+    for (const auto& [name, t] : _tenants) {
+      tbutil::JsonValue o = tbutil::JsonValue::Object();
+      o.set("name", name);
+      o.set("admitted", t->admitted.load(std::memory_order_relaxed));
+      o.set("shed", t->shed.load(std::memory_order_relaxed));
+      o.set("inflight", t->inflight.load(std::memory_order_relaxed));
+      o.set("quota", static_cast<int64_t>(
+                         t->quota.load(std::memory_order_relaxed)));
+      arr.push_back(std::move(o));
+    }
+  }
+  doc.set("tenants", std::move(arr));
+  *out = doc.Dump();
+}
+
 int32_t Server::current_max_concurrency() const {
   return _limiter != nullptr ? _limiter->max_concurrency() : 0;
+}
+
+// ---------------- test-only latency injection ----------------
+
+namespace {
+
+struct InjectedLatency {
+  std::mutex mu;  // tpulint: allow(fiber-blocking) — O(1) map ops
+  std::map<std::string, int64_t> by_service;
+  std::atomic<int64_t> active{0};  // fast-path gate: 0 == nothing injected
+};
+
+InjectedLatency& injected_latency() {
+  static InjectedLatency* p = new InjectedLatency;
+  return *p;
+}
+
+}  // namespace
+
+void SetDebugInjectedLatency(const std::string& service, int64_t ms) {
+  InjectedLatency& inj = injected_latency();
+  std::lock_guard<std::mutex> lk(inj.mu);  // tpulint: allow(fiber-blocking)
+  if (service.empty()) {
+    inj.by_service.clear();
+  } else if (ms <= 0) {
+    inj.by_service.erase(service);
+  } else {
+    inj.by_service[service] = ms;
+  }
+  inj.active.store(static_cast<int64_t>(inj.by_service.size()),
+                   std::memory_order_release);
+}
+
+int64_t DebugInjectedLatencyMs(const std::string& service) {
+  InjectedLatency& inj = injected_latency();
+  // One relaxed load on the hot path while the hook is unused.
+  if (inj.active.load(std::memory_order_acquire) == 0) return 0;
+  std::lock_guard<std::mutex> lk(inj.mu);  // tpulint: allow(fiber-blocking)
+  auto it = inj.by_service.find(service);
+  return it != inj.by_service.end() ? it->second : 0;
 }
 
 namespace {
@@ -116,6 +354,9 @@ int Server::Start(const char* addr, const ServerOptions* options) {
   if (_running.load(std::memory_order_acquire)) return -1;
   GlobalInitializeOrDie();
   if (options != nullptr) _options = *options;
+  if (_options.tenant_max_concurrency > 0) {
+    set_tenant_quota(_options.tenant_max_concurrency);
+  }
   if (_options.enable_grpc_health &&
       _services.seek(std::string("grpc.health.v1.Health")) == nullptr) {
     AddService(builtin_grpc_health());

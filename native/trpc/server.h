@@ -7,6 +7,9 @@
 #pragma once
 
 #include <atomic>
+#include <map>
+#include <memory>
+#include <mutex>
 #include <string>
 #include <string_view>
 #include <vector>
@@ -18,6 +21,7 @@
 #include "trpc/closure.h"
 #include "trpc/concurrency_limiter.h"
 #include "trpc/controller.h"
+#include "trpc/qos.h"
 #include "trpc/rpc_dump.h"
 
 namespace trpc {
@@ -80,6 +84,52 @@ class Interceptor {
 class RedisService;
 class ThriftFramedService;
 
+// Per-tenant admission bookkeeping (overload protection): one entry per
+// tenant id ever seen, immortal for the server's lifetime so the hot path
+// caches raw pointers. The gate is the inflight/quota atomic pair (the
+// ConstantLimiter admission rule inlined) rather than a swappable limiter
+// object: a live quota change is then just an atomic store consulted by
+// the NEXT admission — no object replacement racing lock-free readers.
+// Counters feed /tenantz and the shed-storm tests.
+struct TenantStats {
+  std::string name;
+  std::atomic<int32_t> quota{0};  // <= 0 admits everything
+  std::atomic<int64_t> admitted{0};
+  std::atomic<int64_t> shed{0};
+  std::atomic<int64_t> inflight{0};
+
+  // ConstantLimiter semantics with a live-readable quota.
+  bool TryBegin() {
+    const int32_t q = quota.load(std::memory_order_relaxed);
+    const int64_t prev = inflight.fetch_add(1, std::memory_order_acquire);
+    if (q > 0 && prev >= q) {
+      inflight.fetch_sub(1, std::memory_order_release);
+      shed.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+    admitted.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+  void End() { inflight.fetch_sub(1, std::memory_order_release); }
+};
+
+// The admission decision's inputs (from the request's tstd QoS meta) and
+// outputs (what EndRequest must release + the shed answer).
+struct RequestQos {
+  int priority = PRIORITY_NORMAL;
+  std::string_view tenant;  // "" = fall back to the peer's ip
+  int64_t deadline_us = 0;  // propagated absolute deadline (0 = none)
+};
+
+struct Admission {
+  TenantStats* tenant = nullptr;  // counted into this gate when non-null
+  int priority = PRIORITY_NORMAL;
+  // Filled when BeginRequest sheds: the error code and a reason text
+  // carrying the computed " (retry_after_ms=N)" hint clients pace on.
+  int error = 0;
+  std::string text;
+};
+
 struct ServerOptions {
   // 0 = unlimited. Requests over the cap are rejected with TRPC_ELIMIT
   // (reference ServerOptions.max_concurrency server.h:132).
@@ -108,6 +158,12 @@ struct ServerOptions {
   // the observed average latency (reference max_concurrency = "timeout",
   // policy/timeout_concurrency_limiter.cpp).
   int64_t timeout_concurrency_ms = 0;
+  // Per-tenant concurrency quota layered UNDER the global gate (overload
+  // protection): each tenant id (tstd QoS meta field, falling back to the
+  // peer ip) gets its own constant gate of this many in-flight requests,
+  // so one greedy client sheds before it crowds out others. 0 = off.
+  // Runtime-adjustable via Server::set_tenant_quota / the capi.
+  int32_t tenant_max_concurrency = 0;
   // Non-null = this port ALSO speaks RESP (reference
   // ServerOptions.redis_service). Not owned; must outlive the server.
   class RedisService* redis_service = nullptr;
@@ -144,18 +200,52 @@ class Server {
   // Request-level concurrency gate. Always counts in-flight requests (not
   // only when capped): Stop() drains to zero before returning, so a done
   // closure can never touch a destroyed Server (handlers may outlive their
-  // connection). Admission itself is the limiter's call (constant or auto).
-  bool BeginRequest() {
-    _concurrency.fetch_add(1, std::memory_order_acquire);
-    if (_limiter != nullptr && !_limiter->OnRequestBegin()) {
-      EndRequest(-1);
-      return false;
-    }
-    return true;
-  }
+  // connection). Admission itself is layered (overload protection):
+  //   1. a request whose propagated deadline already passed is shed
+  //      (TRPC_ERPCTIMEDOUT) without consuming any gate — a defensive
+  //      layer for direct callers: on the tstd path the deadline is
+  //      reconstructed at dispatch from a wire budget clamped >= 1ms, so
+  //      the burned-in-queue re-check AFTER dispatch delay
+  //      (tstd_protocol.cpp) is the one that fires in practice;
+  //   2. the per-tenant quota gate (when configured) sheds a greedy
+  //      tenant's overflow BEFORE it reaches the shared gate;
+  //   3. the BULK lane is admitted only while the global gate keeps
+  //      `rpc_bulk_headroom_pct` percent of slots free (HIGH/NORMAL use
+  //      the full gate), so bulk saturation can't starve the control
+  //      plane;
+  //   4. the configured limiter (constant/auto/timeout) has the last word.
+  // On a shed, `admit->error/text` carry the answer — the text ends with
+  // " (retry_after_ms=N)" computed from the server's EMA latency so
+  // clients pace instead of hot-retrying.
+  bool BeginRequest(const RequestQos& qos, const tbutil::EndPoint& peer,
+                    Admission* admit);
+  // Legacy single-lane entry (HTTP/h2 server paths): NORMAL priority, no
+  // tenant, no deadline — exactly the old behavior.
+  bool BeginRequest();
   // latency_us: handler wall time for admitted+finished requests; -1 from
-  // the shed path (never reached the limiter's accounting).
+  // the shed path (never reached the limiter's accounting). The Admission
+  // overload also releases the tenant gate and feeds the per-lane
+  // recorders the 10x-overload bench reads.
   void EndRequest(int64_t latency_us);
+  void EndRequest(int64_t latency_us, const Admission& admit);
+
+  // Per-tenant quota (0 = off). Runtime-safe: the hot path reads an
+  // atomic; existing tenant gates are rebuilt lazily on quota change.
+  void set_tenant_quota(int32_t max_inflight);
+  int32_t tenant_quota() const {
+    return _tenant_quota.load(std::memory_order_relaxed);
+  }
+  // EMA of admitted-request latency (us): the retry-after source.
+  int64_t ema_latency_us() const {
+    return _ema_latency_us.load(std::memory_order_relaxed);
+  }
+  // The retry-after hint every shed path shares (EMA latency scaled by
+  // gate oversubscription, clamped to [1, 2000] ms) — ONE home so the
+  // admission sheds and the burned-in-queue deadline shed cannot drift.
+  int64_t ComputeRetryAfterMs(int32_t inflight_now) const;
+  // The /tenantz document: {"quota":N,"tenants":[{name,admitted,shed,
+  // inflight,quota}...]} (sorted by name).
+  void TenantzJson(std::string* out) const;
 
   // Server-side streams (StreamAccept) hold the server exactly like an
   // in-flight request: Stop() must not return while a stream's consumer
@@ -179,9 +269,18 @@ class Server {
   }
 
  private:
+  TenantStats* TenantEntry(std::string_view tenant);
+
   tbutil::FlatMap<std::string, Service*> _services;
   ServerOptions _options;
   std::unique_ptr<ConcurrencyLimiter> _limiter;
+  // Tenant table: entries immortal for the server's lifetime (hot paths
+  // hold raw pointers across the request). O(1)-bounded critical sections
+  // — lookup/insert only, no parking inside.
+  mutable std::mutex _tenant_mu;  // tpulint: allow(fiber-blocking)
+  std::map<std::string, TenantStats*, std::less<>> _tenants;
+  std::atomic<int32_t> _tenant_quota{0};
+  std::atomic<int64_t> _ema_latency_us{0};
   std::unique_ptr<RpcDumper> _dumper;
   Acceptor _acceptor;
   tbutil::EndPoint _listen_address;
@@ -191,5 +290,14 @@ class Server {
   tbthread::Butex* _stop_butex = nullptr;
   tbthread::Butex* _drain_butex = nullptr;  // woken when concurrency hits 0
 };
+
+// TEST-ONLY fault injection (capi tbrpc_debug_inject_latency, beside
+// tbrpc_debug_hold_workers): every ADMITTED tstd request to `service`
+// parks its dispatch fiber for `ms` while holding its gate slot — exactly
+// the footprint of a slow handler, so overload/shed tests create
+// deterministic queueing without host-steal-sensitive busy loops.
+// ms <= 0 clears the injection; empty service clears all.
+void SetDebugInjectedLatency(const std::string& service, int64_t ms);
+int64_t DebugInjectedLatencyMs(const std::string& service);
 
 }  // namespace trpc

@@ -10,6 +10,7 @@
 #include <cstring>
 #include <mutex>
 
+#include "tbthread/fiber.h"
 #include "tbutil/logging.h"
 #include "tbutil/object_pool.h"
 #include "tbutil/time.h"
@@ -21,6 +22,7 @@
 #include "trpc/http_protocol.h"
 #include "trpc/input_messenger.h"
 #include "trpc/memcache_protocol.h"
+#include "trpc/qos.h"
 #include "trpc/redis_protocol.h"
 #include "trpc/errno.h"
 #include "trpc/flags.h"
@@ -139,6 +141,12 @@ void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
             meta.error_text.size() + 24);
   uint16_t flags = meta.flags;
   if (meta.stream_id != 0) flags |= kTstdFlagHasStream;
+  // QoS fields cost bytes ONLY when stamped: an unmarked request (default
+  // priority, no tenant) serializes byte-identically to the pre-QoS wire —
+  // pinned by tests/test_overload.py.
+  const bool has_qos = meta.priority != PRIORITY_NORMAL ||
+                       !meta.tenant.empty();
+  if (has_qos) flags |= kTstdFlagHasQos;
   put<uint8_t>(&m, meta.msg_type);
   put<uint8_t>(&m, meta.compress_type);
   put<uint16_t>(&m, flags);
@@ -154,6 +162,15 @@ void tstd_serialize_meta(tbutil::IOBuf* out, const TstdMeta& meta,
   }
   if (flags & kTstdFlagHasChecksum) {
     put<uint32_t>(&m, meta.body_crc);
+  }
+  if (has_qos) {
+    put<uint8_t>(&m, meta.priority);
+    // Length field is u16: truncate CONSISTENTLY (length AND bytes) so an
+    // oversized tenant can never desynchronize the meta walk. The public
+    // entry (tbrpc_qos_set) rejects long tenants before they get here.
+    const size_t tlen = std::min<size_t>(meta.tenant.size(), 0xFFFF);
+    put<uint16_t>(&m, static_cast<uint16_t>(tlen));
+    m.append(meta.tenant.data(), tlen);
   }
   if (meta.msg_type == 0) {
     put<uint16_t>(&m, static_cast<uint16_t>(meta.service.size()));
@@ -204,6 +221,12 @@ static bool parse_meta(const std::string& raw, TstdMeta* meta) {
     p += len;
     return true;
   };
+  if (meta->flags & kTstdFlagHasQos) {
+    if (p + 1 > end) return false;
+    meta->priority = static_cast<uint8_t>(
+        clamp_priority(get<uint8_t>(p)));
+    if (!get_str(&meta->tenant)) return false;
+  }
   if (meta->msg_type == 0) {
     if (!get_str(&meta->service) || !get_str(&meta->method)) return false;
   } else {
@@ -331,6 +354,11 @@ static void tstd_pack_request(tbutil::IOBuf* out, Controller* cntl,
     meta.stream_id = acc0.request_stream();
     meta.stream_window = stream_internal::AdvertisedWindow(meta.stream_id);
   }
+  // QoS stamping (qos.h): priority/tenant resolved in Channel::CallMethod
+  // (explicit set > ambient context > defaults). Default priority + no
+  // tenant serialize to ZERO extra bytes (kTstdFlagHasQos stays clear).
+  meta.priority = static_cast<uint8_t>(clamp_priority(cntl->priority()));
+  meta.tenant = cntl->tenant();
   if (cntl->deadline_us() > 0) {
     int64_t remaining_ms =
         (cntl->deadline_us() - tbutil::gettimeofday_us()) / 1000;
@@ -444,6 +472,10 @@ void tstd_process_request(InputMessageBase* base) {
   acc.set_server_side(s->remote_side(), deadline_us);
   acc.set_request_attachment(std::move(msg->attachment));
   acc.set_server_socket(sid);
+  // Server-side QoS mirror: handlers (and the handler QoS scope below)
+  // read the request's lane + tenant off the controller.
+  cntl->set_priority(clamp_priority(msg->meta.priority));
+  cntl->set_tenant(msg->meta.tenant);
   if (msg->meta.stream_id != 0) {
     acc.set_remote_stream(msg->meta.stream_id, msg->meta.stream_window);
   }
@@ -457,9 +489,29 @@ void tstd_process_request(InputMessageBase* base) {
     fail_without_gate(TRPC_EINTERNAL, "socket has no server");
     return;
   }
-  if (!server->BeginRequest()) {
-    fail_without_gate(TRPC_ELIMIT, "server concurrency limit reached");
+  // Layered admission (overload protection, server.h BeginRequest):
+  // deadline-expired shed, per-tenant quota, BULK-lane headroom, then the
+  // configured limiter. A shed answers WITHOUT running anything further —
+  // shed-before-queue — and its error text carries the retry-after hint.
+  RequestQos qos;
+  qos.priority = msg->meta.priority;
+  qos.tenant = msg->meta.tenant;
+  qos.deadline_us = deadline_us;
+  Admission admit;
+  if (!server->BeginRequest(qos, s->remote_side(), &admit)) {
+    fail_without_gate(admit.error, admit.text);
     return;
+  }
+  // Admission time: the latency window opens HERE, so injected queueing
+  // below reads as handler time everywhere (method stats, lane
+  // recorders, the EMA the retry-after hints derive from) — a slow
+  // handler's exact footprint.
+  const int64_t received_us = tbutil::gettimeofday_us();
+  // TEST-ONLY deterministic queueing (tbrpc_debug_inject_latency): an
+  // admitted request holds its gate slot for the injected time.
+  const int64_t inject_ms = DebugInjectedLatencyMs(msg->meta.service);
+  if (inject_ms > 0) {
+    tbthread::fiber_usleep(static_cast<uint64_t>(inject_ms) * 1000);
   }
   Service* svc = server->FindService(msg->meta.service);
   // Per-method stats (reference details/method_status.h): looked up only
@@ -470,7 +522,6 @@ void tstd_process_request(InputMessageBase* base) {
     ms = GetMethodStatus(full_method);
     ms->OnRequested();
   }
-  const int64_t received_us = tbutil::gettimeofday_us();
   // rpcz: with collection on, every request gets a server span — parenting
   // on the client's span when the request carries one, or starting a fresh
   // self-sampled trace otherwise (a server debugged in isolation must see
@@ -494,7 +545,7 @@ void tstd_process_request(InputMessageBase* base) {
   // From here the gate is released exactly once — by done (the single
   // teardown path for both the error and success branches).
   Closure* done = NewCallback(
-      [sid, cid, sess, cntl, response, server, ms, received_us,
+      [sid, cid, sess, cntl, response, server, ms, received_us, admit,
        server_span_id, span_trace_id, span_parent, span_method,
        span_remote]() {
         // Clamped: gettimeofday can step backward (NTP), and a negative
@@ -511,9 +562,31 @@ void tstd_process_request(InputMessageBase* base) {
         tbvar::flight_record(tbvar::FLIGHT_RPC_PHASE,
                              tbvar::FLIGHT_RPC_SERVER_DONE, cid);
         tstd_send_response(sid, cid, cntl, response);
-        server->EndRequest(latency_us);
+        // Releases the tenant gate too, and feeds the per-lane recorders.
+        server->EndRequest(latency_us, admit);
         ReturnServerSession(sess);
       });
+  // Deadline shed-before-handler: the queueing above (injected or real
+  // fiber-scheduling delay) may have consumed the whole propagated budget
+  // — running the handler now would burn capacity on a response nobody is
+  // waiting for. This is THE deadline shed on the tstd path: the wire
+  // budget is clamped >= 1ms at pack time and the absolute deadline is
+  // reconstructed just above, so BeginRequest's pre-gate check (step 1)
+  // cannot fire here — it covers direct native callers only.
+  if (deadline_us > 0 && svc != nullptr &&
+      tbutil::gettimeofday_us() >= deadline_us) {
+    GlobalRpcMetrics::instance().shed_deadline << 1;
+    GlobalRpcMetrics::instance().shed_total << 1;
+    cntl->SetFailed(TRPC_ERPCTIMEDOUT,
+                    "propagated deadline expired before the handler ran; "
+                    "shed (retry_after_ms=" +
+                        std::to_string(server->ComputeRetryAfterMs(
+                            server->concurrency())) +
+                        ")");
+    msg->Destroy();
+    done->Run();
+    return;
+  }
   if (svc == nullptr) {
     cntl->SetFailed(TRPC_ENOSERVICE,
                     "no such service: " + msg->meta.service);
@@ -562,6 +635,17 @@ void tstd_process_request(InputMessageBase* base) {
   // another fiber makes nested calls untraced, same as the reference's
   // bthread-local scope.)
   ScopedTraceContext trace_scope(span_trace_id, server_span_id);
+  // Same scope for the request's QoS: nested RPCs the handler issues
+  // inherit the caller's tenant + priority, and their deadline clamps to
+  // min(own timeout, this request's remaining budget) in
+  // Channel::CallMethod — deadline propagation across hops. The Python
+  // callback services hand this across their pool thread explicitly
+  // (capi.cpp), like the trace context.
+  QosContext handler_qos;
+  handler_qos.priority = admit.priority;
+  handler_qos.tenant = cntl->tenant();
+  handler_qos.deadline_us = deadline_us;
+  ScopedQosContext qos_scope(handler_qos);
   tbvar::flight_record(tbvar::FLIGHT_RPC_PHASE, tbvar::FLIGHT_RPC_SERVER_IN,
                        cid);
   svc->CallMethod(method, cntl, request, response, done);

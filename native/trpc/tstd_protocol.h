@@ -25,6 +25,12 @@ inline constexpr uint16_t kTstdFlagHasStream = 1;
 // Senders set it when the tstd_checksum flag is on; receivers ALWAYS
 // verify when present.
 inline constexpr uint16_t kTstdFlagHasChecksum = 2;
+// Request QoS (qos.h): meta additionally carries priority u8 + tenant
+// (u16-length-prefixed string). Set ONLY when the sender stamped a
+// non-default priority or a tenant id — an unmarked request's wire stays
+// byte-identical to the pre-QoS format (the same advertisement discipline
+// as the codec negotiation: the feature costs zero bytes until used).
+inline constexpr uint16_t kTstdFlagHasQos = 4;
 
 struct TstdMeta {
   // 0 request, 1 response, 2 stream-data, 3 stream-close, 4 stream-feedback
@@ -47,6 +53,11 @@ struct TstdMeta {
   int64_t stream_window = 0;
   // Present iff flags & kTstdFlagHasChecksum.
   uint32_t body_crc = 0;
+  // Present iff flags & kTstdFlagHasQos (requests): the overload-
+  // protection plane's priority lane + tenant identity (qos.h). Absent on
+  // the wire, priority reads as PRIORITY_NORMAL and tenant as unset.
+  uint8_t priority = 1;    // RequestPriority (qos.h)
+  std::string tenant;      // request: quota key ("" = fall back to peer ip)
   std::string service;     // request
   std::string method;      // request
   std::string error_text;  // response

@@ -46,5 +46,14 @@ int tbrpc_fix_sample_root(void);
 // scalars distinct from their pointer forms.
 void tbrpc_fix_codec_note(const char* tensor, int codec_id,
                           uint64_t logical_bytes, uint64_t wire_bytes);
+// Overload-protection surface shapes (mirror tbrpc_qos_set /
+// tbrpc_deadline_remaining_ms / tbrpc_server_set_tenant_quota /
+// tbrpc_debug_inject_latency): a plain-int + const-char* setter, a
+// niladic int64 (distinct from the niladic ints above), an int32_t
+// handle setter, and a const-char* + int64_t injection hook.
+int tbrpc_fix_qos_set(int priority, const char* tenant);
+int64_t tbrpc_fix_deadline_remaining(void);
+int tbrpc_fix_tenant_quota(void* server, int32_t max_inflight);
+int tbrpc_fix_inject_latency(const char* service, int64_t ms);
 
 }  // extern "C"

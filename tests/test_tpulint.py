@@ -212,6 +212,13 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
     # pointer spelling.
     assert parsed["tbrpc_fix_codec_note"] == (
         "void(const char *, int, uint64_t, uint64_t)")
+    # Overload-protection shapes: the QoS setter's plain-int param, a
+    # NILADIC INT64 (must not merge with the niladic ints above), the
+    # int32_t tenant-quota setter and the latency-injection hook.
+    assert parsed["tbrpc_fix_qos_set"] == "int(int, const char *)"
+    assert parsed["tbrpc_fix_deadline_remaining"] == "int64_t()"
+    assert parsed["tbrpc_fix_tenant_quota"] == "int(void *, int32_t)"
+    assert parsed["tbrpc_fix_inject_latency"] == "int(const char *, int64_t)"
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
@@ -249,6 +256,18 @@ def test_wire_contract_capi_real_repo_lock_is_current():
     # contract (reloadable 1-in-N head sampling behind the capi).
     assert locked["tbrpc_rpcz_sample_root"] == "int()"
     assert locked["tbrpc_rpcz_sample_1_in_n"] == "int()"
+    # The overload-protection surface is part of the locked contract.
+    assert locked["tbrpc_qos_set"] == "int(int, const char *)"
+    assert locked["tbrpc_qos_clear"] == "void()"
+    assert locked["tbrpc_qos_get"] == "int64_t(int *, char *, size_t)"
+    assert locked["tbrpc_deadline_remaining_ms"] == "int64_t()"
+    assert locked["tbrpc_server_set_tenant_quota"] == "int(void *, int32_t)"
+    assert locked["tbrpc_server_set_max_concurrency"] == (
+        "int(void *, int32_t)")
+    assert locked["tbrpc_server_tenantz_json"] == (
+        "int64_t(void *, char *, size_t)")
+    assert locked["tbrpc_debug_inject_latency"] == (
+        "int(const char *, int64_t)")
 
 
 # ---- rule class 5: metric-name ----
