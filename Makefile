@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -31,6 +31,14 @@ bench-smoke:
 # native library; the live-fleet halves skip cleanly there.
 obs-smoke:
 	python -m pytest tests/test_fleet_view.py -q
+	python -m tools.tpulint
+
+# Fast local gate for the serving plane (the obs-smoke analog): the
+# session/scheduler units + the live streamed-decode tests, then lint.
+# The pure halves run even without the native library; the native halves
+# skip cleanly there.
+serve-smoke:
+	python -m pytest tests/test_serving.py -q
 	python -m tools.tpulint
 
 # Slow-marked tests (the watchdog soak) are excluded here, same as

@@ -424,6 +424,164 @@ def overload_point(gate=10, svc_ms=40, drive_s=2.0, bulk_threads=16,
     return row
 
 
+_SERVING_CHILD = """
+import json, sys, threading, time
+sys.path.insert(0, {root!r})
+import jax
+from brpc_tpu.runtime import native
+try:
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
+from brpc_tpu.models.decoder import init_decoder
+from brpc_tpu.serving import ServingServer, ServingClient
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+N_TOK = {n_tok}
+DRIVE_S = {drive_s}
+FLOOD_THREADS = {flood_threads}
+MAX_BATCH = {max_batch}
+
+def pctl(xs, q):
+    xs = sorted(xs)
+    return xs[max(0, int(len(xs) * q) - 1)] if xs else 0.0
+
+def drive(protected):
+    # Protection = per-tenant SESSION quota (the serving twin of the PR 9
+    # RPC quota): on, the flood tenant holds at most MAX_BATCH sessions
+    # and its overflow sheds at open with a retry hint; off, every flood
+    # session is admitted and queues ahead of the probing user.
+    srv = ServingServer(PARAMS, max_batch=MAX_BATCH,
+                        tenant_max_sessions=(MAX_BATCH if protected else 0))
+    port = srv.start()
+    addr = "127.0.0.1:%d" % port
+    w = ServingClient(addr)
+    w.generate([1], 2)  # absorb the jit compile outside every timing
+    # Unloaded TTFT reference (one session, empty batch).
+    unloaded = []
+    for _ in range(5):
+        ts = w.open([5, 2], 8)
+        list(ts)
+        unloaded.append(ts.ttft_s * 1000.0)
+    w.close()
+    stop = threading.Event()
+    mu = threading.Lock()
+    stats = {{"flood_tokens": 0, "flood_shed": 0, "user_tokens": 0}}
+    def flood_loop():
+        c = ServingClient(addr, tenant="flood")
+        while not stop.is_set():
+            try:
+                toks = c.generate([3, 7], N_TOK)
+                with mu:
+                    stats["flood_tokens"] += len(toks)
+            except native.RpcError as e:
+                with mu:
+                    stats["flood_shed"] += 1
+                time.sleep((getattr(e, "retry_after_ms", None) or 20)
+                           / 1000.0)
+        c.close()
+    threads = [threading.Thread(target=flood_loop)
+               for _ in range(FLOOD_THREADS)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # let the flood fill the batch (and any queue)
+    uc = ServingClient(addr, tenant="user")
+    ttfts = []
+    with mu:
+        before = dict(stats)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < DRIVE_S:
+        ts = uc.open([5, 2], N_TOK)
+        toks = list(ts)
+        ttfts.append(ts.ttft_s * 1000.0)
+        with mu:
+            stats["user_tokens"] += len(toks)
+    window = time.monotonic() - t0
+    with mu:
+        after = dict(stats)
+    stop.set()
+    for t in threads:
+        t.join()
+    uc.close()
+    tokens = (after["flood_tokens"] - before["flood_tokens"]
+              + after["user_tokens"])
+    row = {{
+        "stream_ttft_p50_ms": round(pctl(ttfts, 0.50), 2),
+        "stream_ttft_p99_ms": round(pctl(ttfts, 0.99), 2),
+        "unloaded_ttft_p50_ms": round(pctl(unloaded, 0.50), 2),
+        "serving_tokens_s": round(tokens / window, 1),
+        "user_sessions": len(ttfts),
+        "flood_shed": after["flood_shed"],
+    }}
+    srv.stop()
+    return row
+
+row = {{
+    "n_tok": N_TOK, "max_batch": MAX_BATCH,
+    "flood_sessions_offered": FLOOD_THREADS,
+    "protected": drive(True),
+    "unprotected": drive(False),
+}}
+base = max(row["protected"]["unloaded_ttft_p50_ms"], 1e-9)
+row["ttft_p99_x_protected"] = round(
+    row["protected"]["stream_ttft_p99_ms"] / base, 2)
+row["ttft_p99_x_unprotected"] = round(
+    row["unprotected"]["stream_ttft_p99_ms"] / base, 2)
+# The protection story is clearest at the MEDIAN: protected, a probe
+# usually finds a free lane (the flood's overflow shed at open);
+# unprotected, it queues behind the whole flood backlog.
+row["ttft_p50_x_protected"] = round(
+    row["protected"]["stream_ttft_p50_ms"] / base, 2)
+row["ttft_p50_x_unprotected"] = round(
+    row["unprotected"]["stream_ttft_p50_ms"] / base, 2)
+print(json.dumps(row))
+"""
+
+
+def serving_point(n_tok=40, drive_s=2.0, flood_threads=8, max_batch=4,
+                  wedge_log=None):
+    """Streaming-inference rows (ISSUE 10): TTFT p50/p99 and aggregate
+    tokens/s for a probing tenant while a flood tenant offers 2x the
+    batch capacity in concurrent sessions — per-tenant session quota
+    (protection) on vs off in the same child. Protection keeps the
+    probe's TTFT near its unloaded value (the flood's overflow sheds at
+    open with a retry hint instead of queueing ahead of everyone)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _SERVING_CHILD.format(root=root, dump_dir=_dump_dir(),
+                                 n_tok=n_tok, drive_s=drive_s,
+                                 flood_threads=flood_threads,
+                                 max_batch=max_batch)
+    timeout = 120 + drive_s * 20
+    seen = set(_new_dump_files(set()))
+    try:
+        proc = subprocess.run(  # tpulint: allow(py-blocking)
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        row = {"wedged": True, "dump_files": _new_dump_files(seen)}
+        if wedge_log is not None:
+            wedge_log.append({"point": "serving_stream",
+                              "dump_files": row["dump_files"]})
+        return row
+    out = proc.stdout.strip().splitlines()
+    if proc.returncode != 0 or not out:
+        raise RuntimeError(
+            f"serving child rc={proc.returncode}: "
+            f"{proc.stderr.strip()[-800:]}")
+    row = json.loads(out[-1])
+    print(f"# serving_stream: ttft p50/p99 protected "
+          f"{row['protected']['stream_ttft_p50_ms']}/"
+          f"{row['protected']['stream_ttft_p99_ms']}ms vs unprotected "
+          f"{row['unprotected']['stream_ttft_p50_ms']}/"
+          f"{row['unprotected']['stream_ttft_p99_ms']}ms "
+          f"(unloaded p50 {row['protected']['unloaded_ttft_p50_ms']}ms); "
+          f"tokens/s {row['protected']['serving_tokens_s']} protected / "
+          f"{row['unprotected']['serving_tokens_s']} unprotected",
+          file=sys.stderr)
+    return row
+
+
 def best_point(payload, transport, seconds=2, wedge_log=None):
     """Best (GB/s, qps, p99_us, concurrency) across the concurrency set.
 
@@ -527,6 +685,14 @@ def main() -> None:
         sweep["overload_10x"] = overload_point(wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# overload_10x skipped: {e}", file=sys.stderr)
+
+    # Streaming-inference rows (serving plane): TTFT p99 + aggregate
+    # tokens/s for N concurrent streamed sessions, per-tenant session
+    # quota (protection) on vs off in the same child.
+    try:
+        sweep["serving_stream"] = serving_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# serving_stream skipped: {e}", file=sys.stderr)
 
     # Pipelined parameter-server rows (async tensor RPC tentpole): 32x1MB
     # serial round-trips vs one bounded PipelineWindow, pull and push.
@@ -1071,6 +1237,15 @@ def smoke() -> None:
         out["overload_10x"] = overload_point(drive_s=0.6, wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["overload_10x"] = {"error": str(e)}
+    # Guarded serving mini-row: a short streamed-session TTFT/tokens-s
+    # A/B — if token streaming, continuous batching, or the session
+    # quota shed breaks, the smoke run shows it before the full sweep.
+    try:
+        out["serving_stream"] = serving_point(n_tok=16, drive_s=0.6,
+                                              flood_threads=4,
+                                              wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["serving_stream"] = {"error": str(e)}
     if wedges:
         out["wedged_samples"] = wedges
     print(json.dumps({"metric": "bench_smoke", "sweep": out}))

@@ -1,0 +1,111 @@
+"""A small autoregressive decode model with an explicit KV cache — the
+inference-shaped workload the serving plane (brpc_tpu/serving) batches.
+
+One attention layer over a learned embedding, deliberately tiny: the point
+is the SERVING mechanics (per-session KV state, continuous batching at
+step boundaries, token-at-a-time emission), not model quality. Decoding is
+GREEDY (argmax), so a batched decode is token-for-token identical to a
+serial one — the property the streaming tests pin.
+
+The step function is jitted over FIXED shapes (max_batch lanes x max_len
+cache rows): the continuous-batching engine maps live sessions onto lanes
+and masks the rest, so admitting or retiring a session never recompiles.
+The per-lane KV cache rows live OUTSIDE the model, in TensorArena pages
+keyed by session (brpc_tpu/serving/session.py) — the model consumes a
+stacked view and returns just the new (k, v) row per lane for the engine
+to write back.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DecoderParams(NamedTuple):
+    embed: jax.Array  # (vocab, dim)
+    pos: jax.Array    # (max_pos, dim) — positions keep greedy decoding
+    wq: jax.Array     # (dim, dim)        from collapsing to a fixed point
+    wk: jax.Array     # (dim, dim)
+    wv: jax.Array     # (dim, dim)
+    wo: jax.Array     # (dim, dim)
+
+
+def init_decoder(rng: jax.Array, vocab: int = 64, dim: int = 32,
+                 max_pos: int = 256) -> DecoderParams:
+    ks = jax.random.split(rng, 6)
+    s = 1.0 / np.sqrt(dim)
+    return DecoderParams(
+        embed=jax.random.normal(ks[0], (vocab, dim), jnp.float32),
+        pos=jax.random.normal(ks[5], (max_pos, dim), jnp.float32),
+        wq=jax.random.normal(ks[1], (dim, dim), jnp.float32) * s,
+        wk=jax.random.normal(ks[2], (dim, dim), jnp.float32) * s,
+        wv=jax.random.normal(ks[3], (dim, dim), jnp.float32) * s,
+        wo=jax.random.normal(ks[4], (dim, dim), jnp.float32) * s)
+
+
+@jax.jit
+def decode_step(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
+                lengths: jax.Array, tokens: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One batched decode step.
+
+    kv_k/kv_v: (B, L, D) — each lane's cache with rows [0, lengths[b])
+    valid. tokens: (B,) the input token per lane. Returns
+    (next_tokens (B,), k_new (B, D), v_new (B, D)): the engine writes
+    k_new/v_new into row lengths[b] of the lane's arena-backed cache and
+    advances the length. Inactive lanes are simply ignored by the caller
+    (their outputs are well-defined garbage; fixed shapes keep this one
+    compiled program for every batch composition).
+    """
+    x = params.embed[tokens] + params.pos[lengths]  # (B, D)
+    q = x @ params.wq
+    k_new = x @ params.wk
+    v_new = x @ params.wv
+    # The new row participates in its own attention step (position
+    # lengths[b]); write it into the device copy functionally.
+    b_idx = jnp.arange(tokens.shape[0])
+    kv_k = kv_k.at[b_idx, lengths].set(k_new)
+    kv_v = kv_v.at[b_idx, lengths].set(v_new)
+    scores = jnp.einsum("bd,bld->bl", q, kv_k) / np.sqrt(q.shape[-1])
+    mask = jnp.arange(kv_k.shape[1])[None, :] <= lengths[:, None]
+    scores = jnp.where(mask, scores, -1e30)
+    attn = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bl,bld->bd", attn, kv_v)
+    # No input residual into the logits: embed[t] · embed.T peaks at t
+    # itself, which would make greedy decoding a fixed point (emit the
+    # input forever) — the attention context + position drive the output.
+    out = ctx @ params.wo + 0.5 * params.pos[lengths]
+    logits = out @ params.embed.T
+    return jnp.argmax(logits, axis=-1), k_new, v_new
+
+
+def decode_serial(params: DecoderParams, prompt, max_tokens: int,
+                  max_len: int, eos_id: int = 0) -> list:
+    """Reference single-session greedy decode (numpy cache) — the parity
+    oracle for the streamed/batched path: same prompt in, SAME tokens out,
+    token for token."""
+    dim = params.embed.shape[1]
+    kv_k = np.zeros((1, max_len, dim), np.float32)
+    kv_v = np.zeros((1, max_len, dim), np.float32)
+    pos = 0
+    out = []
+    token = None
+    for step in range(len(prompt) + max_tokens):
+        inp = prompt[pos] if pos < len(prompt) else token
+        nxt, k_new, v_new = decode_step(
+            params, jnp.asarray(kv_k), jnp.asarray(kv_v),
+            jnp.asarray([pos], jnp.int32), jnp.asarray([inp], jnp.int32))
+        kv_k[0, pos] = np.asarray(k_new[0])
+        kv_v[0, pos] = np.asarray(v_new[0])
+        pos += 1
+        if pos < len(prompt):
+            continue  # prefill: consume the prompt, emit nothing
+        token = int(np.asarray(nxt)[0])
+        out.append(token)
+        if token == eos_id or len(out) >= max_tokens:
+            break
+    return out

@@ -20,6 +20,7 @@ from __future__ import annotations
 import atexit
 import contextlib
 import ctypes
+import errno
 import os
 import re
 import subprocess
@@ -73,6 +74,21 @@ def fill_err_text(err_text: int, err_text_cap: int, message: str) -> None:
 # scrape time under the native registry lock — keep the Python body trivial
 # (no dump_vars/metric creation re-entry).
 _GAUGE_CB = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p)
+
+# /sessionz provider: fill the JSON document into (buf, cap) with the dump
+# copy-out convention; runs on a callback-pool pthread at page-scrape time.
+_SESSIONZ_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t)
+
+# HTTP streaming fallback handler: (ctx, path, query, progressive_id,
+# body*, body_len*, use_progressive*, status*) — setting use_progressive=1
+# turns the response into an unbounded chunked body fed afterwards via
+# tbrpc_progressive_write(progressive_id, ...).
+_HTTP_STREAM_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+    ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_int))
 
 _lib = None
 
@@ -269,6 +285,37 @@ def lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
     L.tbrpc_debug_inject_latency.restype = ctypes.c_int
     L.tbrpc_debug_inject_latency.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    # Streaming RPC: the serving plane's transport (token streams over the
+    # credit-windowed native Stream, tcp AND tpu://). Reads/writes run on
+    # plain Python pthreads with the GIL released; a slow reader's
+    # backpressure is confined to its own stream (manual consumption).
+    L.tbrpc_stream_accept.restype = ctypes.c_int64
+    L.tbrpc_stream_accept.argtypes = [ctypes.c_int64]
+    L.tbrpc_stream_create.restype = ctypes.c_int64
+    L.tbrpc_stream_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_stream_write.restype = ctypes.c_int
+    L.tbrpc_stream_write.argtypes = [
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int64]
+    L.tbrpc_stream_read.restype = ctypes.c_int
+    L.tbrpc_stream_read.argtypes = [
+        ctypes.c_uint64, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t)]
+    L.tbrpc_stream_close.restype = ctypes.c_int
+    L.tbrpc_stream_close.argtypes = [ctypes.c_uint64, ctypes.c_int]
+    # Serving observability + HTTP streaming fallback.
+    L.tbrpc_sessionz_set_provider.restype = ctypes.c_int
+    L.tbrpc_sessionz_set_provider.argtypes = [_SESSIONZ_CB, ctypes.c_void_p]
+    L.tbrpc_http_stream_register.restype = ctypes.c_int
+    L.tbrpc_http_stream_register.argtypes = [
+        ctypes.c_char_p, _HTTP_STREAM_CB, ctypes.c_void_p]
+    L.tbrpc_progressive_write.restype = ctypes.c_int
+    L.tbrpc_progressive_write.argtypes = [
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t]
+    L.tbrpc_progressive_close.restype = ctypes.c_int
+    L.tbrpc_progressive_close.argtypes = [ctypes.c_uint64]
     _lib = L
     atexit.register(_teardown_native_handles)
     return L
@@ -533,6 +580,228 @@ class Channel:
             self.close()
         except Exception:  # noqa: BLE001
             pass
+
+
+# ---------------------------------------------------------------------------
+# Streaming RPC: the serving plane's transport.
+# ---------------------------------------------------------------------------
+
+class StreamClosed(Exception):
+    """The peer closed the stream (EOF). ``error`` carries the close code
+    (0 = clean close); an abnormal close (connection death, server shed)
+    surfaces it so readers can distinguish 'generation finished' from
+    'stream died'."""
+
+    def __init__(self, error: int = 0):
+        super().__init__("stream closed"
+                         + (f" (error {error})" if error else ""))
+        self.error = error
+
+
+class Stream:
+    """One half of a native credit-windowed message stream (trpc/stream.h
+    over the capi): ordered messages, per-stream flow control on BOTH
+    transports (tcp and tpu://). Reads/writes block only the calling
+    Python thread (ctypes releases the GIL); a slow reader exhausts ITS
+    OWN peer window — never another stream's.
+
+    Obtained from :func:`open_stream` (client) or :func:`accept_stream`
+    (inside a server handler). Always :meth:`close` (context manager
+    supported): the native read buffer lives until then."""
+
+    def __init__(self, stream_id: int):
+        self._L = lib()
+        self.id = int(stream_id)
+        self._closed = False
+
+    def write(self, data: bytes, timeout_ms: int = -1) -> bool:
+        """Send one message. timeout_ms < 0 blocks until the peer's
+        window opens (credit backpressure), 0 probes, > 0 bounds the
+        wait. Returns False when the window stayed exhausted for the
+        whole bound (the caller buffers or sheds THIS stream); raises
+        StreamClosed once the stream is gone."""
+        rc = self._L.tbrpc_stream_write(self.id, data, len(data),
+                                        timeout_ms)
+        if rc == 0:
+            return True
+        if rc == errno.EAGAIN:  # credit stayed exhausted for the bound
+            return False
+        raise StreamClosed(rc)
+
+    def read(self, timeout_ms: int = -1) -> Optional[bytes]:
+        """Next message in order, or None on timeout. Raises StreamClosed
+        at EOF (after the queue drained); consumption feedback — the
+        peer's write credit — advances with each message taken here."""
+        L = self._L
+        data = ctypes.c_void_p()
+        length = ctypes.c_size_t()
+        rc = L.tbrpc_stream_read(self.id, timeout_ms, ctypes.byref(data),
+                                 ctypes.byref(length))
+        if rc == 0:
+            try:
+                return (ctypes.string_at(data, length.value)
+                        if length.value else b"")
+            finally:
+                L.tbrpc_free(data)
+        if rc == -1:
+            return None
+        if rc in (1, -2):
+            raise StreamClosed(0)
+        raise StreamClosed(rc)
+
+    def close(self, error: int = 0) -> None:
+        """Close the local half and release the native read buffer.
+        error > 0 rides the CLOSE control frame (bypassing the data
+        credit window): the peer's reads drain, then raise StreamClosed
+        with that code instead of a clean EOF — how a server shed stays
+        visible to a reader whose window is full. Idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._L.tbrpc_stream_close(self.id, error)
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def open_stream(channel: Channel, service_method: str,
+                request: bytes = b"", *,
+                max_buf_size: int = 0) -> Tuple[Stream, bytes]:
+    """Open `service_method` with a stream attached (the RPC carries the
+    handshake; the handler must call :func:`accept_stream`). Returns the
+    CONNECTED stream and the RPC response body. max_buf_size (<= 0 =
+    default 2MB) is OUR receive window — the peer's write budget."""
+    if not channel._h:
+        raise RuntimeError("channel is closed")
+    L = lib()
+    resp = ctypes.c_void_p()
+    resp_len = ctypes.c_size_t()
+    errbuf = ctypes.create_string_buffer(256)
+    sid = L.tbrpc_stream_create(
+        channel._h, service_method.encode(), request, len(request),
+        max_buf_size, ctypes.byref(resp), ctypes.byref(resp_len),
+        errbuf, len(errbuf))
+    if sid <= 0:
+        raise RpcError(int(-sid) if sid < 0 else 2004,
+                       errbuf.value.decode(errors="replace"))
+    try:
+        body = (ctypes.string_at(resp, resp_len.value)
+                if resp_len.value else b"")
+    finally:
+        L.tbrpc_free(resp)
+    return Stream(sid), body
+
+
+def accept_stream(max_buf_size: int = 0) -> Optional[Stream]:
+    """Accept the client's stream from INSIDE a service handler (the
+    callback-pool thread), before returning — the response carries the
+    acceptance. None when the client didn't attach a stream (or called
+    outside a handler). max_buf_size is the server's receive window."""
+    sid = lib().tbrpc_stream_accept(max_buf_size)
+    return Stream(sid) if sid > 0 else None
+
+
+# CFUNCTYPE trampolines registered with process-lifetime native slots must
+# never be collected while native may still call them (HTTP handlers).
+_immortal_native_cbs: list = []
+
+# The /sessionz provider slot holds exactly ONE trampoline: the native
+# side swaps AND scrapes under one mutex, so once
+# tbrpc_sessionz_set_provider returns, the previous trampoline can never
+# be called again — releasing it here (instead of an immortal append)
+# keeps a replaced provider's closure (a whole SessionManager graph) from
+# being pinned for the process lifetime.
+_sessionz_holder: dict = {"fn": None, "cb": None}
+
+
+def set_sessionz_provider(fn: Optional[Callable[[], str]]) -> None:
+    """(Re)point the /sessionz console page at `fn` (returns the JSON
+    document string); None clears it. The callback runs on a pool pthread
+    at page-scrape time — keep it snapshot-cheap."""
+    L = lib()
+    if fn is None:
+        L.tbrpc_sessionz_set_provider(ctypes.cast(None, _SESSIONZ_CB),
+                                      None)
+        _sessionz_holder["fn"] = _sessionz_holder["cb"] = None
+        return
+
+    def _cb(_ctx, buf, cap) -> int:
+        try:
+            doc = fn().encode()
+        except Exception:  # noqa: BLE001 — a failing provider reads empty
+            doc = b"{}"
+        if buf and cap > 0:
+            n = min(len(doc), cap - 1)
+            ctypes.memmove(buf, doc, n)
+            ctypes.memset(buf + n, 0, 1)
+        return len(doc)
+
+    cb = _SESSIONZ_CB(_cb)
+    L.tbrpc_sessionz_set_provider(cb, None)
+    _sessionz_holder["fn"] = fn
+    _sessionz_holder["cb"] = cb  # old trampoline unreferenced -> GC
+
+
+def clear_sessionz_provider(fn: Callable[[], str]) -> None:
+    """Clear the /sessionz provider IF `fn` is still the registered one
+    (a shutdown must not clear a newer manager's registration)."""
+    if _sessionz_holder["fn"] is fn:
+        set_sessionz_provider(None)
+
+
+# HTTP streaming fallback handler signature:
+#   (path: str, query: str, progressive_id: int)
+#     -> (status: int, body: bytes, progressive: bool)
+# progressive=True keeps the response open; feed it with
+# progressive_write(progressive_id, ...) then progressive_close(...).
+HttpStreamHandler = Callable[[str, str, int], Tuple[int, bytes, bool]]
+
+
+def register_http_stream_handler(path: str, fn: HttpStreamHandler) -> None:
+    """Serve `path` on every server's builtin HTTP port with optional
+    ProgressiveAttachment streaming — the plain-HTTP fallback for token
+    streams (curl consumes them without speaking tstd)."""
+    L = lib()
+
+    def _cb(_ctx, cpath, cquery, pid, body, body_len, use_prog, status):
+        try:
+            st, payload, progressive = fn(
+                cpath.decode() if cpath else "",
+                cquery.decode() if cquery else "", int(pid))
+        except Exception as e:  # noqa: BLE001 — handler bug => 500
+            st, payload, progressive = 500, f"{type(e).__name__}: {e}\n"\
+                .encode(), False
+        status[0] = int(st)
+        use_prog[0] = 1 if progressive else 0
+        if payload:
+            buf = L.tbrpc_alloc(len(payload))
+            ctypes.memmove(buf, payload, len(payload))
+            body[0] = buf
+            body_len[0] = len(payload)
+
+    cb = _HTTP_STREAM_CB(_cb)
+    _immortal_native_cbs.append(cb)
+    if L.tbrpc_http_stream_register(path.encode(), cb, None) != 0:
+        raise RuntimeError(f"http path already registered: {path!r}")
+
+
+def progressive_write(progressive_id: int, data: bytes) -> bool:
+    """Feed a progressive HTTP response; False once the peer is gone."""
+    return lib().tbrpc_progressive_write(
+        progressive_id, data, len(data)) == 0
+
+
+def progressive_close(progressive_id: int) -> None:
+    """Terminal chunk; the connection closes after it drains."""
+    lib().tbrpc_progressive_close(progressive_id)
 
 
 def bench_echo_throughput(payload_size: int, seconds: int = 2,

@@ -1,0 +1,32 @@
+"""Streaming inference serving (ISSUE 10): the repo's first
+inference-shaped workload — token streams over the native Streaming RPC
+(credit-windowed, tcp AND tpu://), per-session KV caches in TensorArena
+pages, and a continuous-batching decode driver that admits/retires
+sessions at step boundaries so time-to-first-token is decoupled from any
+other session's completion.
+
+  session  — Session/SessionManager: KV arena pages, open/decode/close
+             lifecycle, TTL eviction, per-tenant session quotas, the
+             /sessionz document, serving_* recorders
+  engine   — DecodeEngine: the batched step loop over
+             models/decoder.decode_step, try-write token emission with
+             bounded pending buffers (slow-reader isolation), rpcz spans
+  server   — ServingServer: Gen/Open + Gen/Close over tstd (stream
+             handshake in the RPC), the /gen HTTP chunked fallback
+  client   — ServingClient/TokenStream: HIGH-stamped session control,
+             token iteration with TTFT tracking
+"""
+
+from brpc_tpu.serving.client import ServingClient, SessionShed, TokenStream
+from brpc_tpu.serving.engine import DecodeEngine
+from brpc_tpu.serving.server import ServingServer
+from brpc_tpu.serving.session import (ACTIVE, DONE, QUEUED, SHED,
+                                      CallableSink, Session, SessionManager,
+                                      serving_metrics)
+
+__all__ = [
+    "ACTIVE", "DONE", "QUEUED", "SHED",
+    "CallableSink", "DecodeEngine", "ServingClient", "ServingServer",
+    "Session", "SessionManager", "SessionShed", "TokenStream",
+    "serving_metrics",
+]

@@ -1,0 +1,285 @@
+"""Continuous-batching decode driver: a step loop over the small jnp
+decode model (brpc_tpu/models/decoder.py) that admits newly-opened
+sessions into the running batch AT STEP BOUNDARIES and retires finished /
+shed ones, emitting each session's token on its own stream the moment the
+step that produced it completes — time-to-first-token is decoupled from
+any other session's completion.
+
+The batch has FIXED max_batch lanes (one compiled program for every batch
+composition): live sessions map onto lanes, the rest are masked. Each
+lane's KV cache rows live in the session's TensorArena range; the step
+stacks them, runs the jitted decode_step, and writes back only the new
+(k, v) row per lane.
+
+Emission NEVER blocks the step loop: tokens are try-written (timeout 0)
+onto the session's sink; a slow reader's tokens queue in that session's
+bounded pending buffer and the SESSION is shed when the buffer overflows
+or stalls past the configured timeout — one stalled consumer costs only
+its own stream (the acceptance criterion the slow-reader test pins).
+
+QoS: a session's deadline is checked BETWEEN steps (an expired session
+sheds at a step boundary, never mid-write); admission prefers HIGH-
+priority sessions over BULK when lanes are scarce. Each decode step runs
+inside an rpcz span (head-sampled like every root) with admit/model/emit
+stage annotations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from brpc_tpu.models.decoder import DecoderParams, decode_step, init_decoder
+from brpc_tpu.serving.session import (ACTIVE, DONE, FRAME_TOKEN, QUEUED,
+                                      SHED, Session, SessionManager,
+                                      serving_metrics)
+
+
+class DecodeEngine:
+    """Owns the step loop thread. ``start()``/``stop()`` bracket it; tests
+    may instead call ``step()`` directly for deterministic single-stepping
+    (the loop and the manual mode share every code path)."""
+
+    def __init__(self, manager: SessionManager,
+                 params: Optional[DecoderParams] = None, *,
+                 max_batch: int = 4, eos_id: int = 0,
+                 step_idle_s: float = 0.02):
+        import jax
+
+        self.manager = manager
+        self.params = params if params is not None else init_decoder(
+            jax.random.PRNGKey(0), dim=manager.dim)
+        self.max_batch = max_batch
+        self.eos_id = eos_id
+        self.step_idle_s = step_idle_s
+        self.steps = 0
+        self._lanes: List[Optional[Session]] = [None] * max_batch
+        self._mu = threading.Lock()
+        self._wake = threading.Condition(self._mu)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._m = serving_metrics()
+        # rpcz spans need the native lib; the pure path (tier-1 scheduler
+        # units) runs the identical step logic under null contexts.
+        if manager._native:
+            from brpc_tpu.observability import tracing
+
+            self._trace_span = tracing.trace_span
+            self._stage = tracing.stage
+            self._annotate = tracing.annotate
+        else:
+            self._trace_span = lambda *_a, **_k: contextlib.nullcontext()
+            self._stage = lambda *_a, **_k: contextlib.nullcontext()
+            self._annotate = lambda *_a: None
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        with self._mu:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="decode-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._mu:
+            self._running = False
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def notify(self) -> None:
+        """A session was opened: wake the loop for admission."""
+        with self._mu:
+            self._wake.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._mu:
+                if not self._running:
+                    return
+            try:
+                progressed = self.step()
+            except Exception:  # noqa: BLE001 — a dead engine thread hangs
+                # every session on the server; log loudly, pause, go on.
+                import traceback
+
+                traceback.print_exc()
+                progressed = False
+                time.sleep(0.1)  # tpulint: allow(py-blocking)
+            if not progressed:
+                with self._mu:
+                    if not self._running:
+                        return
+                    self._wake.wait(timeout=self.step_idle_s)
+
+    # ---- one step ----
+
+    def _admit(self) -> None:
+        """Fill free lanes from QUEUED sessions, HIGH priority first (PR 9
+        lanes applied to batch admission), then open order."""
+        free = [i for i, s in enumerate(self._lanes) if s is None]
+        if not free:
+            return
+        queued = [s for s in self.manager.live() if s.state == QUEUED]
+        queued.sort(key=lambda s: (s.priority, s.opened_at))
+        for sess in queued:
+            if not free:
+                break
+            # Atomic under the manager lock: a Gen/Close racing this
+            # admission loses cleanly (activate False) instead of being
+            # resurrected onto a lane with freed KV views.
+            if self.manager.activate(sess, free[0]):
+                self._lanes[free.pop(0)] = sess
+
+    def _retire(self, sess: Session, *, shed_reason: str = "") -> None:
+        if 0 <= sess.lane < len(self._lanes):
+            self._lanes[sess.lane] = None
+        sess.lane = -1
+        self.manager.finish(sess, shed_reason=shed_reason)
+
+    def _flush_pending(self, sess: Session, now: float) -> bool:
+        """Drain the session's pending frames with try-writes. False =>
+        the session must be shed (dead sink, overflow, or stall)."""
+        while sess.pending:
+            frame = sess.pending[0]
+            verdict = sess.sink.emit(frame)
+            if verdict == "ok":
+                sess.pending.pop(0)
+                sess.pending_bytes -= len(frame)
+                sess.stalled_since = None
+                continue
+            if verdict == "dead":
+                sess.shed_reason = "reader gone"
+                return False
+            # "full": the reader is slow. Bounded patience.
+            if sess.stalled_since is None:
+                sess.stalled_since = now
+            if (sess.pending_bytes > self.manager.max_pending_bytes
+                    or now - sess.stalled_since
+                    > self.manager.stall_timeout_s):
+                sess.shed_reason = "slow reader"
+                return False
+            return True  # keep buffering; retry next step
+        return True
+
+    def _emit(self, sess: Session, token: int, now: float) -> bool:
+        frame = FRAME_TOKEN + str(token).encode()
+        sess.pending.append(frame)
+        sess.pending_bytes += len(frame)
+        ok = self._flush_pending(sess, now)
+        if ok:
+            if sess.emitted == 0:
+                # TTFT = open -> first token produced (handed to the wire
+                # or, for a briefly-full window, its credit queue).
+                sess.ttft_s = now - sess.opened_at
+                self._m["ttft"].record_s(sess.ttft_s)
+            sess.emitted += 1
+            self._m["tokens"].add(1)
+            self._m["token"].record_us(1)  # one sample per token: qps
+        return ok
+
+    def step(self) -> bool:
+        """One decode step: evict/admit at the boundary, run the batched
+        model over active lanes, emit. Returns False when there was
+        nothing to do (the loop then idles)."""
+        trace_span, stage, annotate = (self._trace_span, self._stage,
+                                       self._annotate)
+        now = time.monotonic()
+        # Step boundary: deadline/TTL sheds first — an expired session
+        # never consumes another model step (and is never cut mid-write).
+        for sess in self.manager.evict_expired(now):
+            if 0 <= sess.lane < len(self._lanes):
+                self._lanes[sess.lane] = None
+                sess.lane = -1
+                self.manager.release_kv(sess)
+        # Sweep lanes whose session was finished EXTERNALLY (client
+        # Close, shutdown) since the last step: free the lane and release
+        # the KV range finish() deferred to us — the one point where no
+        # step can be mid-write into it.
+        for i, sess in enumerate(self._lanes):
+            if sess is not None and sess.state in (DONE, SHED):
+                self._lanes[i] = None
+                sess.lane = -1
+                self.manager.release_kv(sess)
+        self._admit()
+        active = [s for s in self._lanes if s is not None]
+        if not active:
+            return False
+        # Finished sessions may linger on their lane while a slow reader
+        # drains their pending tail — they no longer decode. With NOTHING
+        # decodable, skip the model/span entirely and report idle so the
+        # loop sleeps between drain attempts instead of busy-spinning
+        # (and minting empty rpcz spans) until the tail flushes or the
+        # stall timeout sheds it.
+        decodable = [s for s in active if s.emitted < s.max_tokens]
+        if not decodable:
+            self._drain_finished(now)
+            return False
+        with trace_span("decode_step"):
+            annotate(f"batch={len(decodable)}")
+            with stage("model"):
+                B = self.max_batch
+                L = self.manager.max_len
+                D = self.manager.dim
+                kv_k = np.zeros((B, L, D), np.float32)
+                kv_v = np.zeros((B, L, D), np.float32)
+                lengths = np.zeros((B,), np.int32)
+                tokens = np.zeros((B,), np.int32)
+                for sess in decodable:
+                    i = sess.lane
+                    kv_k[i] = sess.kv_k
+                    kv_v[i] = sess.kv_v
+                    lengths[i] = sess.pos
+                    tokens[i] = (sess.prompt[sess.pos]
+                                 if sess.pos < len(sess.prompt)
+                                 else sess.token)
+                nxt, k_new, v_new = decode_step(
+                    self.params, jnp.asarray(kv_k), jnp.asarray(kv_v),
+                    jnp.asarray(lengths), jnp.asarray(tokens))
+                nxt = np.asarray(nxt)
+                k_new = np.asarray(k_new)
+                v_new = np.asarray(v_new)
+            with stage("emit"):
+                now = time.monotonic()
+                for sess in decodable:
+                    if sess.state != ACTIVE:
+                        continue  # finished externally mid-step: swept
+                    i = sess.lane  # at the next boundary
+                    sess.kv_k[sess.pos] = k_new[i]
+                    sess.kv_v[sess.pos] = v_new[i]
+                    sess.pos += 1
+                    sess.last_progress = now
+                    if sess.pos < len(sess.prompt):
+                        continue  # prefill: consume prompt, emit nothing
+                    sess.token = int(nxt[i])
+                    if not self._emit(sess, sess.token, now):
+                        self._retire(sess, shed_reason=sess.shed_reason)
+                        continue
+                    if sess.token == self.eos_id:
+                        sess.max_tokens = sess.emitted  # EOS: stop decoding
+            self.steps += 1
+        self._drain_finished(now)
+        return True
+
+    def _drain_finished(self, now: float) -> None:
+        """Close finished sessions once their pending tail drains — a
+        slow reader keeps its lane (bounded by the stall/overflow shed)
+        but never delays anyone else's close."""
+        for sess in [s for s in self._lanes if s is not None]:
+            if sess.state != ACTIVE:
+                continue
+            if (sess.pos >= len(sess.prompt)
+                    and sess.emitted >= sess.max_tokens):
+                if not self._flush_pending(sess, now):
+                    self._retire(sess, shed_reason=sess.shed_reason)
+                elif not sess.pending:
+                    self._retire(sess)

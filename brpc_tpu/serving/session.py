@@ -1,0 +1,503 @@
+"""Session manager for streaming inference: per-session KV cache in
+TensorArena pages, explicit open/decode/close lifecycle, TTL eviction,
+per-tenant session quotas, and the /sessionz observability surface.
+
+A session is one generation request: a prompt, a token budget, a KV cache
+(two (max_len, dim) fp32 planes living in a TensorArena range keyed by the
+session id — the registered-memory pool the tensor data plane already
+uses, so /tensorz occupancy and the arena gauges cover serving state too),
+and a SINK the engine emits tokens into (a native credit-windowed Stream,
+an HTTP ProgressiveAttachment, or any callable — the engine does not care).
+
+QoS (PR 9) rides along: a session carries the opener's tenant + priority;
+session CONTROL (the Open/Close RPCs) is stamped HIGH by the client,
+token DATA rides the stream's own credit window outside admission
+entirely, and the session's deadline is honored BETWEEN decode steps (an
+expired session sheds at a step boundary, never mid-write).
+
+Slow-reader isolation: the engine only ever try-writes (timeout 0). A
+stalled reader's tokens queue in the session's bounded pending buffer;
+when the buffer overflows or stalls past `stall_timeout_s`, THAT session
+is shed — no other session's emission ever waits on it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.tensor import TensorArena
+
+# Session states.
+QUEUED = "queued"    # admitted, waiting for a batch lane
+ACTIVE = "active"    # in the running batch
+DONE = "done"        # generation finished (EOS / budget), sink closed
+SHED = "shed"        # evicted: deadline, TTL, stalled reader, or quota
+
+# Token wire framing on a stream (and, textually, on the HTTP fallback):
+# b"T" + ascii token id per message; b"E" + utf-8 reason terminates a shed
+# session before close. A clean close with no E-frame means generation
+# completed. Ascii keeps the frames curl-readable on the HTTP path while
+# staying trivially parseable.
+FRAME_TOKEN = b"T"
+FRAME_ERROR = b"E"
+
+
+class StreamSink:
+    """Emits token frames into a native Stream (server half)."""
+
+    def __init__(self, stream: "native.Stream"):
+        self.stream = stream
+
+    def emit(self, frame: bytes) -> str:
+        """-> "ok" | "full" (credit window exhausted — buffer it) |
+        "dead" (peer gone)."""
+        try:
+            return "ok" if self.stream.write(frame, timeout_ms=0) else "full"
+        except native.StreamClosed:
+            return "dead"
+
+    def close(self, error: str = "") -> None:
+        if error:
+            # Best-effort human-readable reason as a data frame — a PROBE
+            # only (close runs on the engine thread; a bounded wait here
+            # would stall every other session's emission on exactly the
+            # full window that caused the shed)...
+            try:
+                self.stream.write(FRAME_ERROR + error.encode(),
+                                  timeout_ms=0)
+            except native.StreamClosed:
+                pass
+            # ...but the SIGNAL is guaranteed regardless: the close
+            # itself carries an error code on the credit-exempt CLOSE
+            # frame, so the client's reads never mistake a shed for a
+            # completed generation even when the E-frame didn't fit.
+            self.stream.close(native.TRPC_ELIMIT)
+        else:
+            self.stream.close()
+
+
+class ProgressiveSink:
+    """Emits token frames as text lines on an HTTP chunked response (the
+    ProgressiveAttachment fallback): no per-reader credit window — the
+    socket write queue is the only backpressure — but the same bounded
+    pending-buffer shed policy applies via the "dead" signal."""
+
+    def __init__(self, progressive_id: int):
+        self.progressive_id = progressive_id
+
+    def emit(self, frame: bytes) -> str:
+        ok = native.progressive_write(self.progressive_id, frame + b"\n")
+        return "ok" if ok else "dead"
+
+    def close(self, error: str = "") -> None:
+        if error:
+            native.progressive_write(self.progressive_id,
+                                     FRAME_ERROR + error.encode() + b"\n")
+        native.progressive_close(self.progressive_id)
+
+
+class CallableSink:
+    """Test/offline sink: tokens go to a Python callable."""
+
+    def __init__(self, fn: Callable[[bytes], None]):
+        self.fn = fn
+        self.closed_with: Optional[str] = None
+
+    def emit(self, frame: bytes) -> str:
+        self.fn(frame)
+        return "ok"
+
+    def close(self, error: str = "") -> None:
+        self.closed_with = error
+
+
+def _native_available() -> bool:
+    """True when the native library is loadable — the pure-Python halves
+    (session/scheduler units in tier-1) run without it on host-side
+    fallbacks; everything wire-shaped requires it."""
+    try:
+        native.lib()
+        return True
+    except Exception:  # noqa: BLE001 — no lib and no toolchain
+        return False
+
+
+class _HostArena:
+    """Pure-numpy stand-in for TensorArena (tier-1, no native lib): same
+    alloc/free/view surface, first-fit over freed ranges."""
+
+    def __init__(self, nbytes: int):
+        self.nbytes = nbytes
+        self._buf = np.zeros(nbytes, np.uint8)
+        self._top = 0
+        self._free: List[tuple] = []  # (off, size)
+        self._sizes: Dict[int, int] = {}
+
+    def alloc(self, nbytes: int) -> int:
+        nbytes = (nbytes + 63) & ~63
+        for i, (off, size) in enumerate(self._free):
+            if size >= nbytes:
+                self._free.pop(i)
+                if size > nbytes:
+                    self._free.append((off + nbytes, size - nbytes))
+                self._sizes[off] = nbytes
+                return off
+        if self._top + nbytes > self.nbytes:
+            raise MemoryError("host arena exhausted")
+        off = self._top
+        self._top += nbytes
+        self._sizes[off] = nbytes
+        return off
+
+    def free(self, off: int) -> None:
+        size = self._sizes.pop(off, 0)
+        if size:
+            self._free.append((off, size))
+
+    def view(self, off: int, nbytes: int) -> np.ndarray:
+        return self._buf[off:off + nbytes]
+
+    def close(self) -> None:
+        self._buf = None
+
+
+class _NullSeries:
+    """No-op metric shims for the pure path (same read surface)."""
+
+    def record_s(self, *_a) -> None: ...
+
+    def record_us(self, *_a) -> None: ...
+
+    def add(self, *_a) -> None: ...
+
+    def p99(self) -> int:
+        return 0
+
+    def qps(self) -> int:
+        return 0
+
+    def value(self) -> int:
+        return 0
+
+
+_metrics_cache = None
+
+
+def serving_metrics():
+    """Process-wide serving recorders (native tbvar series — they ride
+    /vars, /brpc_metrics and every fleet scrape with no special-casing).
+    Pure no-op shims when the native library is absent (tier-1)."""
+    global _metrics_cache
+    if _metrics_cache is None:
+        if _native_available():
+            from brpc_tpu.observability import metrics as obs
+
+            _metrics_cache = {
+                # Time-to-first-token: open() -> first token frame emitted.
+                "ttft": obs.latency("serving_ttft"),
+                # One sample per emitted token: _qps IS aggregate tokens/s.
+                "token": obs.latency("serving_token_emit"),
+                "tokens": obs.counter("serving_tokens"),
+                "shed": obs.counter("serving_shed"),
+            }
+            # serving_sessions / serving_kv_bytes gauges are registered
+            # (and re-pointed per manager) by SessionManager itself.
+        else:
+            _metrics_cache = {k: _NullSeries()
+                              for k in ("ttft", "token", "tokens", "shed")}
+    return _metrics_cache
+
+
+class Session:
+    """One generation request. Engine-internal fields (lane, pos, token)
+    are owned by the engine thread; bookkeeping fields are guarded by the
+    manager's lock."""
+
+    def __init__(self, sid: str, prompt: List[int], max_tokens: int,
+                 tenant: str, priority: int, deadline_s: Optional[float],
+                 sink, kv_off: int, kv_nbytes: int,
+                 kv_k: np.ndarray, kv_v: np.ndarray):
+        self.id = sid
+        self.prompt = list(prompt)
+        self.max_tokens = max_tokens
+        self.tenant = tenant
+        self.priority = priority
+        self.sink = sink
+        self.kv_off = kv_off
+        self.kv_nbytes = kv_nbytes
+        self.kv_k = kv_k  # (max_len, dim) fp32 view of arena pages
+        self.kv_v = kv_v
+        self.state = QUEUED
+        self.opened_at = time.monotonic()
+        # `is not None`, not truthiness: deadline_s == 0.0 is a REAL
+        # (already-expired) deadline that must shed at the first boundary.
+        self.deadline_at = (self.opened_at + deadline_s
+                            if deadline_s is not None else None)
+        self.last_progress = self.opened_at
+        # Engine-owned decode state.
+        self.lane = -1
+        self.pos = 0            # cache rows filled (prompt + generated)
+        self.token = 0          # last generated token (next step's input)
+        self.emitted = 0
+        self.ttft_s: Optional[float] = None
+        # Slow-reader pending buffer (engine-owned).
+        self.pending: List[bytes] = []
+        self.pending_bytes = 0
+        self.stalled_since: Optional[float] = None
+        self.shed_reason = ""
+
+    def age_s(self) -> float:
+        return time.monotonic() - self.opened_at
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_at is not None and now >= self.deadline_at
+
+
+class SessionManager:
+    """Open/close lifecycle + KV arena + quotas + TTL + /sessionz.
+
+    `kv_arena_bytes` bounds total KV state; per-session usage is
+    2 * max_len * dim * 4 bytes. `tenant_max_sessions` (0 = off) sheds a
+    tenant's session OPENs beyond its quota with ELIMIT — the serving
+    twin of the per-tenant RPC quota (PR 9), applied at the session
+    granularity where KV memory is the scarce resource."""
+
+    def __init__(self, *, max_len: int = 64, dim: int = 32,
+                 kv_arena_bytes: int = 8 << 20, ttl_s: float = 30.0,
+                 tenant_max_sessions: int = 0,
+                 stall_timeout_s: float = 2.0,
+                 max_pending_bytes: int = 32 << 10):
+        self.max_len = max_len
+        self.dim = dim
+        self.ttl_s = ttl_s
+        self.tenant_max_sessions = tenant_max_sessions
+        self.stall_timeout_s = stall_timeout_s
+        self.max_pending_bytes = max_pending_bytes
+        self._native = _native_available()
+        # KV state lives in REGISTERED transfer memory when the native lib
+        # is present (arena gauges + /tensorz cover serving state for
+        # free); the pure path gets a numpy arena with the same surface.
+        self.arena = (TensorArena(kv_arena_bytes) if self._native
+                      else _HostArena(kv_arena_bytes))
+        self._mu = threading.Lock()
+        self._sessions: Dict[str, Session] = {}
+        self._ids = itertools.count(1)
+        self._kv_bytes = 0
+        self._shed_total = 0
+        self._done_total = 0
+        self._m = serving_metrics()
+        if self._native:
+            from brpc_tpu.observability import metrics as obs
+
+            obs.repointable_gauge("serving_sessions", self._live_count)
+            obs.repointable_gauge("serving_kv_bytes",
+                                  lambda: self._kv_bytes)
+            # Keep ONE stable bound-method object: the guarded clear at
+            # shutdown compares identity against the registered provider.
+            self._sessionz_fn = self.sessionz_json
+            native.set_sessionz_provider(self._sessionz_fn)
+
+    # ---- lifecycle ----
+
+    def open(self, prompt: List[int], max_tokens: int, sink, *,
+             tenant: str = "", priority: int = native.PRIORITY_BULK,
+             deadline_s: Optional[float] = None) -> Session:
+        """Admit a session (or shed with ELIMIT on tenant quota / arena
+        exhaustion — carrying a retry hint like every PR 9 shed)."""
+        if not prompt:
+            raise native.RpcError(2004, "empty prompt")
+        if max_tokens < 1:
+            # A zero-budget session would be admitted to a lane but never
+            # decode and never satisfy the retire condition — pinned until
+            # the TTL sweep, a client-triggerable lane exhaustion.
+            raise native.RpcError(2004, "max_tokens must be >= 1")
+        if len(prompt) + max_tokens > self.max_len:
+            raise native.RpcError(
+                2004, f"prompt+max_tokens {len(prompt)}+{max_tokens} "
+                      f"exceeds the KV window {self.max_len}")
+        per_plane = self.max_len * self.dim * 4
+        with self._mu:
+            if self.tenant_max_sessions > 0:
+                live = sum(1 for s in self._sessions.values()
+                           if s.tenant == tenant
+                           and s.state in (QUEUED, ACTIVE))
+                if live >= self.tenant_max_sessions:
+                    self._shed_total += 1
+                    self._m["shed"].add(1)
+                    raise native.RpcError(
+                        native.TRPC_ELIMIT,
+                        f"tenant {tenant or '(none)'} over session quota "
+                        f"{self.tenant_max_sessions} (retry_after_ms=50)")
+            try:
+                off = self.arena.alloc(2 * per_plane)
+            except MemoryError:
+                self._shed_total += 1
+                self._m["shed"].add(1)
+                raise native.RpcError(
+                    native.TRPC_ELIMIT,
+                    "KV arena exhausted (retry_after_ms=100)") from None
+            sid = f"s{next(self._ids)}"
+            kv_k = self.arena.view(off, per_plane).view(np.float32).reshape(
+                self.max_len, self.dim)
+            kv_v = self.arena.view(off + per_plane, per_plane).view(
+                np.float32).reshape(self.max_len, self.dim)
+            kv_k[:] = 0.0
+            kv_v[:] = 0.0
+            sess = Session(sid, prompt, max_tokens, tenant, priority,
+                           deadline_s, sink, off, 2 * per_plane, kv_k, kv_v)
+            self._sessions[sid] = sess
+            self._kv_bytes += 2 * per_plane
+        return sess
+
+    def get(self, sid: str) -> Optional[Session]:
+        with self._mu:
+            return self._sessions.get(sid)
+
+    def activate(self, sess: Session, lane: int) -> bool:
+        """Atomic QUEUED -> ACTIVE(+lane) transition for the engine's
+        admission. False when the session left QUEUED concurrently (a
+        Gen/Close between the engine's snapshot and this call) — without
+        the lock, admission could resurrect a SHED session whose KV views
+        finish() already released. The lane is assigned IN the same
+        critical section so a finish() racing right after always sees
+        lane >= 0 and defers the KV release to the engine's sweep."""
+        with self._mu:
+            if sess.state != QUEUED:
+                return False
+            sess.state = ACTIVE
+            sess.lane = lane
+            sess.last_progress = time.monotonic()
+            return True
+
+    def finish(self, sess: Session, *, shed_reason: str = "") -> None:
+        """Terminal transition (engine thread or Close RPC): close the
+        sink, account, and release the KV range — UNLESS the session
+        still sits on an engine lane: a concurrent decode step may be
+        mid-write into the KV views, so laned sessions keep their range
+        until the engine's step-boundary sweep calls release_kv (writing
+        into a terminal session's still-held range is harmless; writing
+        into a freed-and-reallocated one is not). Idempotent."""
+        with self._mu:
+            if sess.state in (DONE, SHED):
+                return
+            sess.state = SHED if shed_reason else DONE
+            sess.shed_reason = shed_reason
+            if shed_reason:
+                self._shed_total += 1
+                self._m["shed"].add(1)
+            else:
+                self._done_total += 1
+            if sess.lane < 0:
+                self._release_kv_locked(sess)
+        try:
+            sess.sink.close(shed_reason)
+        except Exception:  # noqa: BLE001 — a dead sink is already closed
+            pass
+
+    def _release_kv_locked(self, sess: Session) -> None:
+        if sess.kv_k is None:
+            return
+        self._kv_bytes -= sess.kv_nbytes
+        # Drop the views BEFORE freeing the range: a freed range can be
+        # reallocated to a new session immediately.
+        sess.kv_k = sess.kv_v = None
+        self.arena.free(sess.kv_off)
+
+    def release_kv(self, sess: Session) -> None:
+        """Free a terminal session's KV range (the engine's lane sweep —
+        the one place that knows no step is mid-write)."""
+        with self._mu:
+            self._release_kv_locked(sess)
+
+    def close(self, sid: str) -> bool:
+        """Explicit client Close: ends the session whatever its state."""
+        sess = self.get(sid)
+        if sess is None:
+            return False
+        self.finish(sess, shed_reason="closed by client")
+        return True
+
+    def evict_expired(self, now: Optional[float] = None) -> List[Session]:
+        """TTL + deadline sweep — called at step boundaries (and usable
+        standalone): deadline-expired live sessions and TERMINAL sessions
+        older than ttl_s (retained for /sessionz post-mortems) go."""
+        now = time.monotonic() if now is None else now
+        shed, drop = [], []
+        with self._mu:
+            for sess in self._sessions.values():
+                if sess.state in (QUEUED, ACTIVE):
+                    if sess.expired(now):
+                        shed.append(sess)
+                    elif now - sess.last_progress > self.ttl_s:
+                        shed.append(sess)  # idle past TTL: evict
+                elif now - sess.last_progress > self.ttl_s:
+                    drop.append(sess.id)
+            for sid in drop:
+                del self._sessions[sid]
+        for sess in shed:
+            reason = ("deadline expired" if sess.expired(now)
+                      else "idle past ttl")
+            self.finish(sess, shed_reason=reason)
+        return shed
+
+    # ---- introspection ----
+
+    def _live_count(self) -> int:
+        with self._mu:
+            return sum(1 for s in self._sessions.values()
+                       if s.state in (QUEUED, ACTIVE))
+
+    def live(self) -> List[Session]:
+        with self._mu:
+            return [s for s in self._sessions.values()
+                    if s.state in (QUEUED, ACTIVE)]
+
+    def sessionz_doc(self) -> dict:
+        m = self._m
+        with self._mu:
+            sessions = [{
+                "id": s.id, "tenant": s.tenant or "(none)",
+                "priority": s.priority, "state": s.state,
+                "tokens": s.emitted, "kv_bytes": (s.kv_nbytes
+                                                  if s.kv_k is not None
+                                                  else 0),
+                "age_s": int(s.age_s()), "pending": s.pending_bytes,
+            } for s in self._sessions.values()]
+            active = sum(1 for s in self._sessions.values()
+                         if s.state in (QUEUED, ACTIVE))
+            kv_bytes = self._kv_bytes
+            shed_total = self._shed_total
+        return {
+            "active": active,
+            "kv_bytes": kv_bytes,
+            "tokens_per_s": m["token"].qps(),
+            "ttft_p99_us": m["ttft"].p99(),
+            "tokens_total": m["tokens"].value(),
+            "shed_total": shed_total,
+            "sessions": sessions,
+        }
+
+    def sessionz_json(self) -> str:
+        return json.dumps(self.sessionz_doc())
+
+    def shutdown(self) -> None:
+        """Finish every live session and release the arena."""
+        for sess in self.live():
+            self.finish(sess, shed_reason="server shutting down")
+        with self._mu:
+            # The engine is stopped by now (ServingServer.stop order):
+            # laned sessions' deferred ranges can be reclaimed safely.
+            for sess in self._sessions.values():
+                self._release_kv_locked(sess)
+        if self._native:
+            # Clear only if WE are still the registered provider (a newer
+            # manager's registration survives our shutdown).
+            native.clear_sessionz_provider(self._sessionz_fn)
+        self.arena.close()

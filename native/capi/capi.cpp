@@ -2,15 +2,19 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "tbthread/fiber.h"
@@ -30,8 +34,10 @@
 #include "trpc/registry.h"
 #include "trpc/rpc_metrics.h"
 #include "trpc/server.h"
+#include "trpc/http_protocol.h"
 #include "trpc/span.h"
 #include "trpc/stall_watchdog.h"
+#include "trpc/stream.h"
 #include "trpc/tstd_protocol.h"
 #include "ttpu/ici_segment.h"
 #include "ttpu/tensor_arena.h"
@@ -196,6 +202,19 @@ class PyCallbackPool {
   int64_t _outstanding = 0;
 };
 
+// The Controller of the RPC a Python handler is CURRENTLY serving, on the
+// callback-pool pthread running it. Lets in-handler capi entry points that
+// need the Controller (tbrpc_stream_accept: the response must carry the
+// stream acceptance, so it has to happen before done->Run()) work without
+// widening every handler ABI. Thread-local is exactly right here: the pool
+// thread runs ONE handler at a time, synchronously.
+thread_local Controller* t_handler_cntl = nullptr;
+
+struct ScopedHandlerController {
+  explicit ScopedHandlerController(Controller* c) { t_handler_cntl = c; }
+  ~ScopedHandlerController() { t_handler_cntl = nullptr; }
+};
+
 class NativeEchoService : public Service {
  public:
   std::string_view service_name() const override { return "EchoService"; }
@@ -243,6 +262,7 @@ class CallbackService : public Service {
       // tenant/priority and clamps to the remaining deadline budget.
       ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
       ScopedQosContext qos_scope(qos_ctx);
+      ScopedHandlerController hc(cntl);  // tbrpc_stream_accept's doorway
       _cb(_ctx, method.c_str(), req.data(), req.size(), att.data(),
           att.size(), &resp, &resp_len, &resp_att, &resp_att_len,
           &error_code, err_text, sizeof(err_text));
@@ -912,6 +932,7 @@ void TensorCallbackService::CallMethod(const std::string& method,
   const bool ran = PyCallbackPool::instance().Run([&] {
     ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
     ScopedQosContext qos_scope(qos_ctx);
+    ScopedHandlerController hc(cntl);  // tbrpc_stream_accept's doorway
     _cb(_ctx, method.c_str(), req.data(), req.size(), att_ptr, att_len,
         &resp, &resp_len, &resp_arena, &resp_att_off, &resp_att_len,
         &resp_att_autofree, &error_code, err_text, sizeof(err_text));
@@ -1399,6 +1420,425 @@ int tbrpc_registry_install(void) {
 
 int tbrpc_registry_clear(void) {
   RegistryService::clear();
+  return 0;
+}
+
+// ---------------- streaming RPC: token streams ----------------
+
+namespace {
+
+// Native read buffer for one capi stream, running in MANUAL consumption
+// mode: delivery queues here, and flow-control feedback advances only as
+// tbrpc_stream_read drains — a slow Python reader exhausts its own peer
+// window (that stream's writers park/EAGAIN) instead of buffering without
+// bound. Waiters are plain Python pthreads (ctypes releases the GIL), so
+// mutex/condvar is the right primitive; the consumer fiber's push is a
+// brief non-parking critical section.
+class StreamReadBuffer : public StreamInputHandler {
+ public:
+  int on_received_messages(StreamId, tbutil::IOBuf* const messages[],
+                           size_t size) override {
+    std::lock_guard<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking) — brief push, never parks
+    for (size_t i = 0; i < size; ++i) {
+      _msgs.push_back(messages[i]->to_string());
+    }
+    _cv.notify_all();
+    return 0;
+  }
+
+  void on_closed(StreamId id) override {
+    // The registry entry is still live inside on_closed: capture the
+    // close error while it can be read.
+    const int err = StreamCloseError(id);
+    std::lock_guard<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking)
+    _closed = true;
+    _close_error = err;
+    _cv.notify_all();
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking)
+    return _closed;
+  }
+
+  // The tbrpc_stream_read contract: 0 message, 1 clean EOF, -1 timeout,
+  // positive close error once drained.
+  int Read(uint64_t id, int64_t timeout_ms, void** data, size_t* len) {
+    std::string msg;
+    {
+      std::unique_lock<std::mutex> lk(_mu);  // tpulint: allow(fiber-blocking) — plain Python pthread
+      auto ready = [&] { return !_msgs.empty() || _closed; };
+      if (timeout_ms < 0) {
+        _cv.wait(lk, ready);
+      } else if (!_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                               ready)) {
+        return -1;
+      }
+      if (_msgs.empty()) {
+        return _close_error != 0 ? _close_error : 1;  // EOF after drain
+      }
+      msg = std::move(_msgs.front());
+      _msgs.pop_front();
+    }
+    *len = msg.size();
+    *data = malloc(msg.size() > 0 ? msg.size() : 1);
+    memcpy(*data, msg.data(), msg.size());
+    // Feedback advances NOW — the whole point of manual mode (a closed
+    // stream makes this a no-op, which is fine: nobody is waiting for
+    // credit on it anymore).
+    StreamConsume(id, static_cast<int64_t>(msg.size()));
+    return 0;
+  }
+
+ private:
+  std::mutex _mu;  // tpulint: allow(fiber-blocking)
+  std::condition_variable _cv;
+  std::deque<std::string> _msgs;
+  bool _closed = false;
+  int _close_error = 0;
+};
+
+std::mutex g_streams_mu;
+std::unordered_map<uint64_t, std::shared_ptr<StreamReadBuffer>> g_streams;
+
+std::shared_ptr<StreamReadBuffer> find_stream_buf(uint64_t id) {
+  std::lock_guard<std::mutex> lk(g_streams_mu);  // tpulint: allow(fiber-blocking)
+  auto it = g_streams.find(id);
+  return it != g_streams.end() ? it->second : nullptr;
+}
+
+}  // namespace
+
+int64_t tbrpc_stream_accept(int64_t max_buf_size) {
+  Controller* cntl = t_handler_cntl;
+  if (cntl == nullptr) return -1;  // not inside a Python handler
+  auto rbuf = std::make_shared<StreamReadBuffer>();
+  StreamOptions opts;
+  if (max_buf_size > 0) opts.max_buf_size = max_buf_size;
+  opts.handler = rbuf.get();
+  opts.manual_consumption = true;
+  StreamId sid = INVALID_STREAM_ID;
+  if (StreamAccept(&sid, *cntl, &opts) != 0) {
+    return -1;  // the client didn't attach a stream
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_streams_mu);  // tpulint: allow(fiber-blocking)
+    g_streams[sid] = std::move(rbuf);
+  }
+  return static_cast<int64_t>(sid);
+}
+
+int64_t tbrpc_stream_create(void* channel, const char* service_method,
+                            const void* req, size_t req_len,
+                            int64_t max_buf_size, void** resp,
+                            size_t* resp_len, char* errbuf,
+                            size_t errbuf_len) {
+  auto* box = static_cast<ChannelBox*>(channel);
+  if (resp != nullptr) {
+    *resp = nullptr;
+    *resp_len = 0;
+  }
+  auto rbuf = std::make_shared<StreamReadBuffer>();
+  StreamOptions opts;
+  if (max_buf_size > 0) opts.max_buf_size = max_buf_size;
+  opts.handler = rbuf.get();
+  opts.manual_consumption = true;
+  Controller cntl;
+  StreamId sid = INVALID_STREAM_ID;
+  StreamCreate(&sid, cntl, &opts);
+  tbutil::IOBuf request, response;
+  if (req_len > 0) request.append(req, req_len);
+  box->channel.CallMethod(service_method, &cntl, request, &response,
+                          nullptr);
+  // `rbuf` must survive until the stream's close COMPLETES (the handler
+  // pointer lives in the Stream); StreamWait provides that barrier on
+  // both failure paths below.
+  if (cntl.Failed()) {
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s", cntl.ErrorText().c_str());
+    }
+    StreamClose(sid);  // idempotent with OnRpcFailed's close
+    StreamWait(sid);
+    const int code =
+        cntl.ErrorCode() != 0 ? cntl.ErrorCode() : TRPC_EINTERNAL;
+    return -static_cast<int64_t>(code);
+  }
+  if (!StreamIsConnected(sid) && !rbuf->Closed()) {
+    // RPC succeeded but the handler never called StreamAccept: writers
+    // would park forever on a window that can never open. (A stream
+    // that WAS accepted but already closed again — the server shed the
+    // session before we processed the acceptance, e.g. an
+    // already-expired deadline — is handed out instead: its reads drain
+    // whatever arrived, then surface the close error.)
+    if (errbuf != nullptr && errbuf_len > 0) {
+      snprintf(errbuf, errbuf_len, "%s",
+               "server answered without accepting a stream");
+    }
+    StreamClose(sid);
+    StreamWait(sid);
+    return -static_cast<int64_t>(ENOTCONN);
+  }
+  if (resp != nullptr) {
+    *resp_len = response.size();
+    *resp = malloc(response.size() > 0 ? response.size() : 1);
+    response.copy_to(*resp, response.size());
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_streams_mu);  // tpulint: allow(fiber-blocking)
+    g_streams[sid] = std::move(rbuf);
+  }
+  return static_cast<int64_t>(sid);
+}
+
+int tbrpc_stream_write(uint64_t stream_id, const void* data, size_t len,
+                       int64_t timeout_ms) {
+  tbutil::IOBuf msg;
+  if (len > 0) msg.append(data, len);
+  return StreamWriteTimed(stream_id, msg, timeout_ms);
+}
+
+int tbrpc_stream_read(uint64_t stream_id, int64_t timeout_ms, void** data,
+                      size_t* len) {
+  if (data != nullptr) *data = nullptr;
+  if (len != nullptr) *len = 0;
+  auto rbuf = find_stream_buf(stream_id);
+  if (rbuf == nullptr) return -2;
+  return rbuf->Read(stream_id, timeout_ms, data, len);
+}
+
+int tbrpc_stream_close(uint64_t stream_id, int error_code) {
+  // EINVAL when already gone — close is idempotent.
+  StreamCloseWithError(stream_id, error_code);
+  // Barrier: the close has fully completed (consumer joined, on_closed
+  // delivered) before the read buffer the Stream points at can be freed.
+  StreamWait(stream_id);
+  std::shared_ptr<StreamReadBuffer> rbuf;
+  {
+    std::lock_guard<std::mutex> lk(g_streams_mu);  // tpulint: allow(fiber-blocking)
+    auto it = g_streams.find(stream_id);
+    if (it != g_streams.end()) {
+      rbuf = std::move(it->second);
+      g_streams.erase(it);
+    }
+  }
+  return 0;  // rbuf's last reference may drop here (or with a late reader)
+}
+
+// ---------------- serving observability: /sessionz ----------------
+
+namespace {
+
+std::mutex g_sessionz_mu;  // tpulint: allow(fiber-blocking) — pointer swap
+tbrpc_sessionz_cb g_sessionz_cb = nullptr;
+void* g_sessionz_ctx = nullptr;
+
+void sessionz_page(const HttpRequest& req, HttpResponse* resp) {
+  // The mutex is held across the WHOLE scrape (not just the pointer
+  // copy): a provider swap — which frees the previous Python trampoline
+  // — must not land between reading cb and calling it. Scrapes serialize
+  // against each other as a side effect; both are rare and cheap.
+  std::lock_guard<std::mutex> lk(g_sessionz_mu);  // tpulint: allow(fiber-blocking)
+  tbrpc_sessionz_cb cb = g_sessionz_cb;
+  void* ctx = g_sessionz_ctx;
+  if (cb == nullptr) {
+    resp->status = 404;
+    resp->body = "no serving engine registered in this process\n";
+    return;
+  }
+  // The provider is Python: it must run on a callback-pool pthread (GIL
+  // pairing) while this fiber BLOCKS its worker — the PassiveStatus gauge
+  // discipline (parking could strand the scrape with every worker stuck
+  // behind the same page).
+  std::string doc;
+  const bool ran = PyCallbackPool::instance().RunBlocking([&] {
+    // Grow-retry like every copy-out consumer: the document may grow
+    // between the size probe and the fill (a session opening mid-scrape
+    // must not truncate the JSON).
+    int64_t need = cb(ctx, nullptr, 0);
+    for (int attempt = 0; attempt < 4 && need > 0; ++attempt) {
+      doc.resize(static_cast<size_t>(need) + 1);
+      const int64_t got = cb(ctx, doc.data(), doc.size());
+      if (got <= 0) {
+        doc.clear();
+        break;
+      }
+      if (static_cast<size_t>(got) < doc.size()) {
+        doc.resize(static_cast<size_t>(got));
+        break;
+      }
+      need = got;  // grew under us: refetch at the new size
+    }
+  });
+  if (!ran) {
+    resp->status = 503;
+    resp->body = "python callback pool saturated\n";
+    return;
+  }
+  if (req.query_param("format") == "json") {
+    resp->content_type = "application/json";
+    resp->body = doc + "\n";
+    return;
+  }
+  std::string& b = resp->body;
+  const auto parsed = tbutil::JsonValue::Parse(doc);
+  if (!parsed.has_value()) {
+    b = "sessionz provider returned unparseable JSON\n" + doc + "\n";
+    return;
+  }
+  auto top_int = [&](const char* key) -> int64_t {
+    const tbutil::JsonValue* v = parsed->find(key);
+    return v != nullptr ? v->as_int() : 0;
+  };
+  char line[320];
+  snprintf(line, sizeof(line),
+           "active sessions: %lld\nkv bytes: %lld\ntokens/s: %lld\n"
+           "ttft p99 (us): %lld\ntokens total: %lld\nshed total: %lld\n\n",
+           static_cast<long long>(top_int("active")),
+           static_cast<long long>(top_int("kv_bytes")),
+           static_cast<long long>(top_int("tokens_per_s")),
+           static_cast<long long>(top_int("ttft_p99_us")),
+           static_cast<long long>(top_int("tokens_total")),
+           static_cast<long long>(top_int("shed_total")));
+  b += line;
+  const tbutil::JsonValue* sessions = parsed->find("sessions");
+  if (sessions == nullptr || sessions->size() == 0) {
+    b += "(no live sessions)\n";
+    return;
+  }
+  // Per-tenant counts folded from the rows (the JSON carries per-session
+  // truth; the rollup is presentation).
+  std::map<std::string, int64_t> per_tenant;
+  b += "session                tenant        pri state     tokens  "
+       "kv_bytes   age_s  pending\n";
+  for (size_t i = 0; i < sessions->size(); ++i) {
+    const tbutil::JsonValue& s = (*sessions)[i];
+    auto fint = [&](const char* key) -> int64_t {
+      const tbutil::JsonValue* v = s.find(key);
+      return v != nullptr ? v->as_int() : 0;
+    };
+    auto fstr = [&](const char* key) -> std::string {
+      const tbutil::JsonValue* v = s.find(key);
+      return v != nullptr ? v->as_string() : "?";
+    };
+    const std::string tenant = fstr("tenant");
+    ++per_tenant[tenant];
+    snprintf(line, sizeof(line),
+             "%-22s %-13s %3lld %-9s %6lld %9lld %7lld %8lld\n",
+             fstr("id").c_str(), tenant.c_str(),
+             static_cast<long long>(fint("priority")),
+             fstr("state").c_str(), static_cast<long long>(fint("tokens")),
+             static_cast<long long>(fint("kv_bytes")),
+             static_cast<long long>(fint("age_s")),
+             static_cast<long long>(fint("pending")));
+    b += line;
+  }
+  b += "\nper-tenant sessions:\n";
+  for (const auto& [tenant, n] : per_tenant) {
+    snprintf(line, sizeof(line), "  %-20s %lld\n", tenant.c_str(),
+             static_cast<long long>(n));
+    b += line;
+  }
+}
+
+}  // namespace
+
+int tbrpc_sessionz_set_provider(tbrpc_sessionz_cb cb, void* ctx) {
+  {
+    std::lock_guard<std::mutex> lk(g_sessionz_mu);  // tpulint: allow(fiber-blocking)
+    g_sessionz_cb = cb;
+    g_sessionz_ctx = ctx;
+  }
+  static std::once_flag once;
+  std::call_once(once, [] { RegisterHttpHandler("/sessionz", sessionz_page); });
+  return 0;
+}
+
+// ---------------- HTTP streaming fallback ----------------
+
+namespace {
+
+std::mutex g_prog_mu;  // tpulint: allow(fiber-blocking)
+uint64_t g_prog_next_id = 1;
+std::unordered_map<uint64_t, std::shared_ptr<ProgressiveAttachment>> g_prog;
+
+std::shared_ptr<ProgressiveAttachment> find_progressive(uint64_t id) {
+  std::lock_guard<std::mutex> lk(g_prog_mu);  // tpulint: allow(fiber-blocking)
+  auto it = g_prog.find(id);
+  return it != g_prog.end() ? it->second : nullptr;
+}
+
+}  // namespace
+
+int tbrpc_http_stream_register(const char* path, tbrpc_http_stream_cb cb,
+                               void* ctx) {
+  if (path == nullptr || cb == nullptr) return -1;
+  return RegisterHttpHandler(
+      path, [cb, ctx](const HttpRequest& req, HttpResponse* resp) {
+        // The id is live BEFORE the callback runs: an engine thread the
+        // handler hands the session to may emit the first token before
+        // the handler returns, and ProgressiveAttachment buffers writes
+        // until the response binds the socket.
+        auto pa = std::make_shared<ProgressiveAttachment>();
+        uint64_t pid;
+        {
+          std::lock_guard<std::mutex> lk(g_prog_mu);  // tpulint: allow(fiber-blocking)
+          pid = g_prog_next_id++;
+          g_prog[pid] = pa;
+        }
+        void* body = nullptr;
+        size_t body_len = 0;
+        int use_progressive = 0;
+        int status = 200;
+        const std::string path_copy = req.path;
+        const std::string query = req.query;
+        const TraceContext trace_ctx = current_trace_context();
+        const bool ran = PyCallbackPool::instance().Run([&] {
+          ScopedTraceContext scope(trace_ctx.trace_id, trace_ctx.span_id);
+          cb(ctx, path_copy.c_str(), query.c_str(), pid, &body, &body_len,
+             &use_progressive, &status);
+        });
+        if (!ran) {
+          std::lock_guard<std::mutex> lk(g_prog_mu);  // tpulint: allow(fiber-blocking)
+          g_prog.erase(pid);
+          resp->status = 503;
+          resp->body = "python callback pool saturated\n";
+          free(body);
+          return;
+        }
+        resp->status = status;
+        if (body != nullptr && body_len > 0) {
+          resp->body.assign(static_cast<const char*>(body), body_len);
+        }
+        free(body);
+        if (use_progressive != 0) {
+          resp->progressive = pa;
+        } else {
+          std::lock_guard<std::mutex> lk(g_prog_mu);  // tpulint: allow(fiber-blocking)
+          g_prog.erase(pid);
+        }
+      });
+}
+
+int tbrpc_progressive_write(uint64_t progressive_id, const void* data,
+                            size_t len) {
+  auto pa = find_progressive(progressive_id);
+  if (pa == nullptr) return -1;
+  tbutil::IOBuf chunk;
+  if (len > 0) chunk.append(data, len);
+  return pa->Write(chunk);
+}
+
+int tbrpc_progressive_close(uint64_t progressive_id) {
+  std::shared_ptr<ProgressiveAttachment> pa;
+  {
+    std::lock_guard<std::mutex> lk(g_prog_mu);  // tpulint: allow(fiber-blocking)
+    auto it = g_prog.find(progressive_id);
+    if (it != g_prog.end()) {
+      pa = std::move(it->second);
+      g_prog.erase(it);
+    }
+  }
+  if (pa != nullptr) pa->Close();
   return 0;
 }
 

@@ -398,6 +398,88 @@ int tbrpc_registry_install(void);
 // process — the table is process-global). Returns 0.
 int tbrpc_registry_clear(void);
 
+// ---- streaming RPC: token streams over the native Stream (trpc/stream.h) --
+// The serving plane's transport: an ordered, credit-flow-controlled,
+// full-duplex message stream established by an RPC and multiplexed on its
+// connection (tcp AND tpu://). The capi surface runs every stream in
+// MANUAL-consumption mode: received messages queue in a native read buffer
+// and the flow-control feedback advances only when tbrpc_stream_read
+// drains them — so a slow Python reader exhausts ITS OWN peer window
+// (that stream's writers park/EAGAIN) without buffering unboundedly or
+// stalling any other stream.
+//
+// Server: call from INSIDE a Python service handler (callback-pool
+// thread), before returning — the response carries the acceptance.
+// Returns the stream id (> 0), or -1 when no handler RPC is in scope /
+// the client didn't attach a stream. max_buf_size <= 0 uses the default
+// 2MB receive window.
+int64_t tbrpc_stream_accept(int64_t max_buf_size);
+// Client: open `service_method` with a stream attached; blocks for the
+// RPC like tbrpc_call. On success returns the CONNECTED stream id (> 0)
+// and hands out the RPC response body (*resp tbrpc_alloc'd, caller frees
+// via tbrpc_free). On failure returns the negated RPC error code and
+// fills errbuf; no stream is left behind.
+int64_t tbrpc_stream_create(void* channel, const char* service_method,
+                            const void* req, size_t req_len,
+                            int64_t max_buf_size, void** resp,
+                            size_t* resp_len, char* errbuf,
+                            size_t errbuf_len);
+// Ordered write of one message. timeout_ms < 0 blocks the calling thread
+// until the peer's window opens (credit backpressure), 0 probes, > 0
+// bounds the wait. Returns 0, EAGAIN when the window stayed exhausted for
+// the whole bound, EINVAL on an unknown/closed id, or the close/socket
+// error once the stream died.
+int tbrpc_stream_write(uint64_t stream_id, const void* data, size_t len,
+                       int64_t timeout_ms);
+// Pop the next message: 0 = delivered (*data tbrpc_alloc'd, caller frees;
+// consumption feedback advances by its size), 1 = clean EOF (peer closed
+// and the queue is drained), -1 = timeout, -2 = unknown stream id, any
+// other positive value = the error the stream closed with (after the
+// queue drained). timeout_ms < 0 waits forever.
+int tbrpc_stream_read(uint64_t stream_id, int64_t timeout_ms, void** data,
+                      size_t* len);
+// Close the local half (peer's on_closed fires), wait for the close to
+// complete, release the read buffer. error_code > 0 rides the CLOSE
+// control frame — which bypasses the data credit window — so the peer's
+// reads observe the code after draining instead of a clean EOF (how a
+// shed session stays distinguishable from a completed one even when the
+// reader's window is full). 0 = clean EOF. Idempotent per id; 0 always.
+int tbrpc_stream_close(uint64_t stream_id, int error_code);
+
+// ---- serving observability: the /sessionz console page ----
+// The session table lives in Python (brpc_tpu/serving); the console
+// renders whatever the registered provider reports. cb fills the
+// /sessionz JSON document into (buf, cap) with the dump copy-out
+// convention and runs on a callback-pool pthread (GIL discipline), the
+// page's fiber blocking — not parking — meanwhile (the PassiveStatus
+// gauge pattern). cb null clears the provider. Registers the /sessionz
+// page on first use; 0 ok.
+typedef int64_t (*tbrpc_sessionz_cb)(void* ctx, char* buf, size_t cap);
+int tbrpc_sessionz_set_provider(tbrpc_sessionz_cb cb, void* ctx);
+
+// ---- HTTP streaming fallback (ProgressiveAttachment over the console) ----
+// Register a Python-backed HTTP handler at `path` on every server's
+// builtin HTTP port whose responses MAY stream: the callback receives a
+// pre-allocated progressive id; setting *use_progressive=1 turns the
+// response into an unbounded chunked body the handler keeps feeding via
+// tbrpc_progressive_write until tbrpc_progressive_close — so plain-HTTP
+// clients (curl) consume token streams without speaking tstd. The id is
+// LIVE (writes buffer) from before the callback runs, so an engine thread
+// may start emitting the moment the session is registered. *body/
+// *body_len (tbrpc_alloc'd) is the plain — or first — chunk; *status the
+// HTTP status. Returns 0, -1 when the path is taken.
+typedef void (*tbrpc_http_stream_cb)(void* ctx, const char* path,
+                                     const char* query,
+                                     uint64_t progressive_id, void** body,
+                                     size_t* body_len, int* use_progressive,
+                                     int* status);
+int tbrpc_http_stream_register(const char* path, tbrpc_http_stream_cb cb,
+                               void* ctx);
+// 0 on success; -1 once the peer is gone / the id was closed or unused.
+int tbrpc_progressive_write(uint64_t progressive_id, const void* data,
+                            size_t len);
+int tbrpc_progressive_close(uint64_t progressive_id);
+
 // ---- bench harness (loops in C so Python overhead is out of the path) ----
 // Echo round-trips of `payload_size`-byte attachments for ~`seconds`, with
 // `concurrency` concurrent callers. Returns one-way payload bytes/sec.

@@ -70,6 +70,9 @@ void index_page(const HttpRequest&, HttpResponse* resp) {
       "<li><a href=\"/fleetz\">/fleetz</a> — fleet pane of glass: "
       "registry-driven per-shard health/qps/p99/codec/version-lag scrape "
       "(?tag=&amp;format=json)</li>"
+      "<li><a href=\"/sessionz\">/sessionz</a> — streaming inference: "
+      "live sessions, per-tenant counts, KV bytes, tokens/s "
+      "(serving processes only; ?format=json)</li>"
       "<li><a href=\"/fibers\">/fibers</a> — live fibers + stacks</li>"
       "<li><a href=\"/hotspots\">/hotspots</a> — sampling CPU profile</li>"
       "<li><a href=\"/heap\">/heap</a> — sampling heap profile (in-use)</li>"

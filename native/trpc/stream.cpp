@@ -171,8 +171,14 @@ void close_stream(const StreamPtr& s, int error, bool notify_peer) {
   s->close_error.store(error, std::memory_order_release);
   s->closed.store(true, std::memory_order_release);
   if (notify_peer && s->connected.load(std::memory_order_acquire)) {
+    // The CLOSE frame carries the application error (0 = clean) in the
+    // meta trace field, the way FEEDBACK carries the consumed count —
+    // control frames bypass the data credit window, so even a peer whose
+    // window is full learns WHY the stream ended.
     send_stream_frame(s->socket_id.load(std::memory_order_acquire), 3,
-                      s->peer_id.load(std::memory_order_acquire), 0, nullptr);
+                      s->peer_id.load(std::memory_order_acquire),
+                      static_cast<uint64_t>(error > 0 ? error : 0),
+                      nullptr);
   }
   SocketUniquePtr sock;
   if (Socket::Address(s->socket_id.load(std::memory_order_acquire), &sock) ==
@@ -191,6 +197,33 @@ void close_stream(const StreamPtr& s, int error, bool notify_peer) {
     }
   } else {
     finish_close(s);
+  }
+}
+
+// Advance the flow-control counter by `nbytes` and replenish the peer once
+// half the window has been consumed since the last feedback (reference
+// stream_impl.h:80 SetRemoteConsumed). last_feedback advances only on a
+// SUCCESSFUL send: data can arrive before the stream's socket is connected
+// (server writes ahead of the RPC response landing), and a dropped
+// feedback must be retried by the next call — or by ConnectClientStream's
+// sync-up. Shared between the automatic consumer-fiber path and the
+// manual StreamConsume entry point.
+void advance_consumed(Stream* raw, int64_t nbytes) {
+  const int64_t consumed =
+      raw->consumed.fetch_add(nbytes, std::memory_order_acq_rel) + nbytes;
+  const int64_t since =
+      consumed - raw->last_feedback.load(std::memory_order_acquire);
+  if (since >= raw->options.max_buf_size / 2 &&
+      !raw->closed.load(std::memory_order_acquire)) {
+    if (send_stream_frame(raw->socket_id.load(std::memory_order_acquire), 4,
+                          raw->peer_id.load(std::memory_order_acquire),
+                          static_cast<uint64_t>(consumed), nullptr)) {
+      raw->last_feedback.store(consumed, std::memory_order_release);
+    } else {
+      TB_LOG(WARNING) << "stream " << raw->id
+                      << ": consumption feedback send failed (consumed="
+                      << consumed << ")";
+    }
   }
 }
 
@@ -220,28 +253,11 @@ int consume_incoming(tbthread::ExecutionQueue<tbutil::IOBuf>::Iterator& iter,
     if (raw->options.handler != nullptr) {
       raw->options.handler->on_received_messages(raw->id, ptrs, n);
     }
-    const int64_t consumed =
-        raw->consumed.fetch_add(batch_bytes, std::memory_order_acq_rel) +
-        batch_bytes;
-    // Replenish the peer once half the window has been consumed since the
-    // last feedback (reference stream_impl.h:80 SetRemoteConsumed).
-    // last_feedback advances only on a SUCCESSFUL send: data can arrive
-    // before the stream's socket is connected (server writes ahead of the
-    // RPC response landing), and a dropped feedback must be retried by the
-    // next batch — or by ConnectClientStream's sync-up.
-    const int64_t since =
-        consumed - raw->last_feedback.load(std::memory_order_acquire);
-    if (since >= raw->options.max_buf_size / 2 &&
-        !raw->closed.load(std::memory_order_acquire)) {
-      if (send_stream_frame(raw->socket_id.load(std::memory_order_acquire),
-                            4, raw->peer_id.load(std::memory_order_acquire),
-                            static_cast<uint64_t>(consumed), nullptr)) {
-        raw->last_feedback.store(consumed, std::memory_order_release);
-      } else {
-        TB_LOG(WARNING) << "stream " << raw->id
-                        << ": consumption feedback send failed (consumed="
-                        << consumed << ")";
-      }
+    // Manual mode: delivery is NOT consumption — the application reports
+    // drained bytes through StreamConsume, so a slow reader's peer runs
+    // out of credit instead of this fiber buffering without bound.
+    if (!raw->options.manual_consumption) {
+      advance_consumed(raw, batch_bytes);
     }
     for (size_t i = 0; i < n; ++i) bufs[i].clear();
   }
@@ -313,9 +329,24 @@ int StreamAccept(StreamId* response_stream, Controller& cntl,
 }
 
 int StreamWrite(StreamId stream, const tbutil::IOBuf& message) {
+  return StreamWriteTimed(stream, message, -1);
+}
+
+int StreamWriteTimed(StreamId stream, const tbutil::IOBuf& message,
+                     int64_t timeout_ms) {
   StreamPtr s = find_stream(stream);
   if (s == nullptr) return EINVAL;
   const int64_t size = static_cast<int64_t>(message.size());
+  // Absolute deadline on the butex clock (gettimeofday, see butex.cpp).
+  timespec abs;
+  timespec* absp = nullptr;
+  if (timeout_ms >= 0) {
+    const int64_t deadline_us =
+        tbutil::gettimeofday_us() + timeout_ms * 1000;
+    abs.tv_sec = deadline_us / 1000000;
+    abs.tv_nsec = (deadline_us % 1000000) * 1000;
+    absp = &abs;
+  }
   while (true) {
     if (s->closed.load(std::memory_order_acquire)) {
       const int e = s->close_error.load(std::memory_order_acquire);
@@ -333,7 +364,11 @@ int StreamWrite(StreamId stream, const tbutil::IOBuf& message) {
         break;
       }
     }
-    tbthread::butex_wait(s->wbtx, seq, nullptr);
+    if (absp != nullptr && tbutil::gettimeofday_us() >=
+                               abs.tv_sec * 1000000LL + abs.tv_nsec / 1000) {
+      return EAGAIN;  // credit stayed exhausted: only THIS stream is stuck
+    }
+    tbthread::butex_wait(s->wbtx, seq, absp);
   }
   s->sent.fetch_add(size, std::memory_order_acq_rel);
   SocketUniquePtr sock;
@@ -359,10 +394,33 @@ int StreamWrite(StreamId stream, const tbutil::IOBuf& message) {
   return 0;
 }
 
+int StreamConsume(StreamId stream, int64_t nbytes) {
+  StreamPtr s = find_stream(stream);
+  if (s == nullptr || !s->options.manual_consumption || nbytes < 0) {
+    return EINVAL;
+  }
+  if (nbytes > 0) advance_consumed(s.get(), nbytes);
+  return 0;
+}
+
+int StreamCloseError(StreamId stream) {
+  StreamPtr s = find_stream(stream);
+  return s != nullptr ? s->close_error.load(std::memory_order_acquire) : 0;
+}
+
+bool StreamIsConnected(StreamId stream) {
+  StreamPtr s = find_stream(stream);
+  return s != nullptr && s->connected.load(std::memory_order_acquire);
+}
+
 int StreamClose(StreamId stream) {
+  return StreamCloseWithError(stream, 0);
+}
+
+int StreamCloseWithError(StreamId stream, int error) {
   StreamPtr s = find_stream(stream);
   if (s == nullptr) return EINVAL;
-  close_stream(s, 0, /*notify_peer=*/true);
+  close_stream(s, error > 0 ? error : 0, /*notify_peer=*/true);
   return 0;
 }
 
@@ -407,12 +465,21 @@ void OnStreamFrame(TstdInputMessage* msg) {
       s->incoming.execute(std::move(chunk));
       break;
     }
-    case 3:  // CLOSE from peer
-      close_stream(s, 0, /*notify_peer=*/false);
+    case 3:  // CLOSE from peer (trace field = application error, 0 clean)
+      close_stream(s, static_cast<int>(msg->meta.trace_id),
+                   /*notify_peer=*/false);
       break;
     case 4: {  // FEEDBACK: consumed-total from the peer
-      s->acked.store(static_cast<int64_t>(msg->meta.trace_id),
-                     std::memory_order_release);
+      // MAX-merge, not a blind store: manual-consumption mode lets
+      // concurrent readers send feedback, and two in-flight frames can
+      // arrive out of order — a regressed acked would under-credit the
+      // window and could park a writer forever. Totals are monotonic per
+      // stream, so the larger value is always the truth.
+      const int64_t v = static_cast<int64_t>(msg->meta.trace_id);
+      int64_t cur = s->acked.load(std::memory_order_acquire);
+      while (v > cur && !s->acked.compare_exchange_weak(
+                            cur, v, std::memory_order_acq_rel)) {
+      }
       tbthread::butex_increment_and_wake_all(s->wbtx);
       break;
     }

@@ -42,6 +42,14 @@ struct StreamOptions {
   int64_t max_buf_size = 2 * 1024 * 1024;
   // Required to RECEIVE; a pure writer may leave it null.
   StreamInputHandler* handler = nullptr;
+  // Manual consumption accounting: the consumer fiber DELIVERS batches but
+  // does not advance the flow-control `consumed` counter — the application
+  // calls StreamConsume when it actually drains the bytes (the capi read
+  // buffer: a slow Python reader withholds feedback, the peer's window
+  // fills, and ITS writers park — per-stream backpressure with no parked
+  // consumer fiber). Default keeps the handler-returns-means-consumed
+  // semantics of the reference.
+  bool manual_consumption = false;
 };
 
 // Client: call BEFORE Channel::CallMethod on the same Controller; the RPC
@@ -59,9 +67,43 @@ int StreamAccept(StreamId* response_stream, Controller& cntl,
 // write error.
 int StreamWrite(StreamId stream, const tbutil::IOBuf& message);
 
+// StreamWrite with a credit-wait bound: timeout_ms < 0 waits forever
+// (== StreamWrite), 0 probes, > 0 parks at most that long. Returns EAGAIN
+// when the peer's window stayed exhausted for the whole bound — the
+// caller's cue to buffer or shed THAT stream without stalling its thread
+// (the continuous-batching engine emits tokens for many sessions from one
+// step loop; a stalled reader must cost only its own stream).
+int StreamWriteTimed(StreamId stream, const tbutil::IOBuf& message,
+                     int64_t timeout_ms);
+
+// Manual-consumption mode only (StreamOptions::manual_consumption):
+// report `nbytes` drained by the application; advances the flow-control
+// counter and replenishes the peer once half the advertised window has
+// been consumed since the last feedback. Returns 0, EINVAL on an unknown
+// stream or one in automatic mode.
+int StreamConsume(StreamId stream, int64_t nbytes);
+
+// The error a live stream is closing with (0 = clean close / unknown id).
+// Valid inside on_closed and until the registry entry is erased.
+int StreamCloseError(StreamId stream);
+
+// Whether the stream reached its peer (a request stream connects when the
+// RPC response lands CARRYING an acceptance; an accepted stream is born
+// connected). A successful RPC whose handler never called StreamAccept
+// leaves the request stream unconnected — the caller's cue to close it
+// instead of parking writers forever.
+bool StreamIsConnected(StreamId stream);
+
 // Graceful close: flushes queued credit state, notifies the peer
 // (on_closed fires there), destroys the local half.
 int StreamClose(StreamId stream);
+
+// Close carrying an application error code to the peer (rides the CLOSE
+// control frame, which bypasses the data credit window — the one channel
+// guaranteed open toward a reader whose window is full). The peer's half
+// closes with that error: its pending reads drain, then observe the code
+// instead of a clean EOF. error <= 0 behaves like StreamClose.
+int StreamCloseWithError(StreamId stream, int error);
 
 // Blocks until the peer closes (or the connection dies). Test helper.
 int StreamWait(StreamId stream);

@@ -55,5 +55,21 @@ int tbrpc_fix_qos_set(int priority, const char* tenant);
 int64_t tbrpc_fix_deadline_remaining(void);
 int tbrpc_fix_tenant_quota(void* server, int32_t max_inflight);
 int tbrpc_fix_inject_latency(const char* service, int64_t ms);
+// Streaming-RPC surface shapes (mirror tbrpc_stream_create /
+// tbrpc_stream_write / tbrpc_stream_read and the /sessionz provider):
+// an int64-returning open with a wide out-param tail, uint64_t stream
+// handles as SCALAR params (distinct from their pointer forms), and a
+// copy-out provider callback typedef taken as a parameter.
+typedef int64_t (*tbrpc_fix_sessionz_cb)(void* ctx, char* buf, size_t cap);
+int64_t tbrpc_fix_stream_create(void* channel, const char* service_method,
+                                const void* req, size_t req_len,
+                                int64_t max_buf_size, void** resp,
+                                size_t* resp_len, char* errbuf,
+                                size_t errbuf_len);
+int tbrpc_fix_stream_write(uint64_t stream_id, const void* data, size_t len,
+                           int64_t timeout_ms);
+int tbrpc_fix_stream_read(uint64_t stream_id, int64_t timeout_ms,
+                          void** data, size_t* len);
+int tbrpc_fix_sessionz_set_provider(tbrpc_fix_sessionz_cb cb, void* ctx);
 
 }  // extern "C"

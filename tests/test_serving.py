@@ -1,0 +1,473 @@
+"""Streaming inference serving (ISSUE 10 acceptance surface).
+
+Pure half (tier-1, no native lib): the decode model's determinism, the
+continuous-batching engine's scheduler (admit at step boundaries, batched
+== serial token-for-token, slow-reader pending-buffer shed, deadline shed
+between steps, TTL eviction, per-tenant session quotas, KV arena
+accounting) — all on the host arena + null-metric fallbacks, exercising
+the identical step logic the native path runs.
+
+Native half (skips cleanly without libbrpc_tpu.so), under an ARMED stall
+watchdog:
+  * 2 concurrent STREAMED sessions, token-for-token vs serial decode,
+    tokens arriving incrementally (TTFT bounded well below total stream
+    time — the acceptance criterion);
+  * the first Python-level stream over tpu://;
+  * slow-reader isolation: a deliberately-stalled reader (tiny receive
+    window) never delays the other session's tokens and is eventually
+    shed alone;
+  * tenant session quota sheds a 3rd session mid-batch with a retry hint
+    while another tenant sails through;
+  * TTL eviction of an idle session closes its stream with an E-frame;
+  * /sessionz (text + json) and the serving_* vars riding the generic
+    fleet scrape (fold path, no per-page special-casing);
+  * the /gen HTTP ProgressiveAttachment fallback.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from brpc_tpu.models.decoder import decode_serial, init_decoder
+from brpc_tpu.runtime import native
+from brpc_tpu.serving import (ACTIVE, DONE, QUEUED, SHED, CallableSink,
+                              DecodeEngine, SessionManager, SessionShed)
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+MAX_LEN = 64
+
+
+def pure_manager(**kw):
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("kv_arena_bytes", 1 << 20)
+    return SessionManager(**kw)
+
+
+class TokenCollector:
+    """CallableSink helper: decodes T-frames, remembers the close."""
+
+    def __init__(self):
+        self.tokens = []
+        self.sink = CallableSink(self._on)
+
+    def _on(self, frame: bytes):
+        if frame.startswith(b"T"):
+            self.tokens.append(int(frame[1:]))
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 pure half.
+# ---------------------------------------------------------------------------
+
+def test_decode_serial_deterministic_and_prompt_sensitive():
+    a = decode_serial(PARAMS, [3, 7, 11], 8, MAX_LEN)
+    b = decode_serial(PARAMS, [3, 7, 11], 8, MAX_LEN)
+    c = decode_serial(PARAMS, [5, 2], 8, MAX_LEN)
+    assert a == b, "greedy decode must be deterministic"
+    assert a != c, "different prompts must decode differently"
+    assert len(a) <= 8
+    assert len(set(a)) > 2, "token trajectory should not be a fixed point"
+
+
+def test_batched_engine_matches_serial_token_for_token():
+    """Two sessions admitted at different step boundaries decode to
+    EXACTLY the serial tokens — continuous batching is invisible."""
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4)
+    c1, c2 = TokenCollector(), TokenCollector()
+    s1 = mgr.open([3, 7, 11], 8, c1.sink)
+    eng.step()  # s1 alone for a step
+    s2 = mgr.open([5, 2], 8, c2.sink)  # admitted mid-generation of s1
+    for _ in range(40):
+        if not eng.step():
+            break
+    assert s1.state == DONE and s2.state == DONE
+    assert c1.tokens == decode_serial(PARAMS, [3, 7, 11], 8, MAX_LEN)
+    assert c2.tokens == decode_serial(PARAMS, [5, 2], 8, MAX_LEN)
+
+
+def test_admission_prefers_high_priority_when_lanes_scarce():
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=1)
+    bulk = mgr.open([3], 4, TokenCollector().sink,
+                    priority=native.PRIORITY_BULK)
+    high = mgr.open([5], 4, TokenCollector().sink,
+                    priority=native.PRIORITY_HIGH)
+    eng.step()
+    assert high.state == ACTIVE, "HIGH jumps the single lane"
+    assert bulk.state == QUEUED
+
+
+def test_slow_reader_pending_buffer_sheds_only_that_session():
+    """A sink that never accepts frames: its session buffers, stalls past
+    the timeout, and is shed — the healthy groupmate streams every token
+    on schedule."""
+    mgr = pure_manager(stall_timeout_s=0.05)
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4)
+
+    class FullSink:
+        def __init__(self):
+            self.closed_with = None
+
+        def emit(self, frame):
+            return "full"
+
+        def close(self, error=""):
+            self.closed_with = error
+
+    stuck_sink = FullSink()
+    stuck = mgr.open([3, 7, 11], 8, stuck_sink)
+    ok = TokenCollector()
+    healthy = mgr.open([5, 2], 8, ok.sink)
+    deadline = time.monotonic() + 5
+    while (healthy.state != DONE or stuck.state not in (DONE, SHED)) \
+            and time.monotonic() < deadline:
+        eng.step()
+        time.sleep(0.005)
+    assert healthy.state == DONE
+    assert ok.tokens == decode_serial(PARAMS, [5, 2], 8, MAX_LEN)
+    assert stuck.state == SHED
+    assert stuck.shed_reason == "slow reader"
+    assert stuck_sink.closed_with == "slow reader"
+
+
+def test_deadline_sheds_between_steps():
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2)
+    col = TokenCollector()
+    sess = mgr.open([3, 7], 40, col.sink, deadline_s=0.05)
+    eng.step()
+    emitted_before = len(col.tokens)
+    time.sleep(0.08)
+    eng.step()  # boundary check fires BEFORE the model runs
+    assert sess.state == SHED
+    assert sess.shed_reason == "deadline expired"
+    assert col.sink.closed_with == "deadline expired"
+    # Shed at the boundary, not mid-write: nothing emitted by the
+    # shedding step itself.
+    assert len(col.tokens) == emitted_before
+
+
+def test_ttl_evicts_idle_sessions():
+    mgr = pure_manager(ttl_s=0.05)
+    sess = mgr.open([3], 4, CallableSink(lambda f: None))
+    assert mgr.evict_expired() == []
+    time.sleep(0.08)
+    shed = mgr.evict_expired()
+    assert shed == [sess] and sess.state == SHED
+    assert sess.shed_reason == "idle past ttl"
+
+
+def test_tenant_session_quota_sheds_with_retry_hint():
+    mgr = pure_manager(tenant_max_sessions=2)
+    mgr.open([1], 4, CallableSink(lambda f: None), tenant="a")
+    mgr.open([2], 4, CallableSink(lambda f: None), tenant="a")
+    with pytest.raises(native.RpcError) as ei:
+        mgr.open([3], 4, CallableSink(lambda f: None), tenant="a")
+    assert ei.value.overloaded and ei.value.retry_after_ms is not None
+    # Another tenant is untouched by a's quota.
+    other = mgr.open([4], 4, CallableSink(lambda f: None), tenant="b")
+    assert other.state == QUEUED
+    doc = mgr.sessionz_doc()
+    assert doc["shed_total"] == 1 and doc["active"] == 3
+
+
+def test_kv_arena_accounting_and_reuse():
+    mgr = pure_manager()
+    per_session = 2 * MAX_LEN * mgr.dim * 4
+    s1 = mgr.open([1, 2], 4, CallableSink(lambda f: None))
+    assert mgr.sessionz_doc()["kv_bytes"] == per_session
+    off1 = s1.kv_off
+    mgr.finish(s1)
+    assert mgr.sessionz_doc()["kv_bytes"] == 0
+    s2 = mgr.open([3], 4, CallableSink(lambda f: None))
+    assert s2.kv_off == off1, "freed KV range is reused"
+    assert float(np.sum(s2.kv_k)) == 0.0, "reused cache arrives zeroed"
+
+
+def test_prompt_budget_validated_against_kv_window():
+    mgr = pure_manager()
+    with pytest.raises(native.RpcError):
+        mgr.open(list(range(60)), 10, CallableSink(lambda f: None))
+    with pytest.raises(native.RpcError):
+        mgr.open([], 4, CallableSink(lambda f: None))
+
+
+# ---------------------------------------------------------------------------
+# Native half: streams on the wire, under an armed watchdog.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serving_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.observability import health
+    dump_dir = tmp_path_factory.mktemp("serving_dumps")
+    health.start_watchdog(str(dump_dir))
+    yield {"health": health}
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after serving tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def _serving_server(**kw):
+    from brpc_tpu.serving import ServingServer
+    srv = ServingServer(PARAMS, max_len=MAX_LEN, **kw)
+    port = srv.start()
+    return srv, port
+
+
+def _drain(ts, out, timings):
+    for tok in ts:
+        out.append(tok)
+        timings.append(time.monotonic())
+
+
+def test_two_streamed_sessions_incremental_and_parity(serving_env):
+    """The acceptance drive: two concurrent streamed sessions, tokens
+    arriving incrementally (TTFT < 25% of each session's total stream
+    time), token-for-token identical to serial decode."""
+    from brpc_tpu.serving import ServingClient
+    srv, port = _serving_server(max_batch=4)
+    try:
+        warm = ServingClient(f"127.0.0.1:{port}")
+        warm.generate([1], 2)  # absorb the jit compile outside the timing
+        warm.close()
+        n_tok = 24
+        c1 = ServingClient(f"127.0.0.1:{port}", tenant="u1")
+        c2 = ServingClient(f"127.0.0.1:{port}", tenant="u2")
+        t0 = time.monotonic()
+        ts1 = c1.open([3, 7, 11], n_tok)
+        ts2 = c2.open([5, 2], n_tok)
+        out1, out2, times1, times2 = [], [], [], []
+        th1 = threading.Thread(target=_drain, args=(ts1, out1, times1))
+        th2 = threading.Thread(target=_drain, args=(ts2, out2, times2))
+        th1.start(); th2.start(); th1.join(); th2.join()
+        assert out1 == decode_serial(PARAMS, [3, 7, 11], n_tok, MAX_LEN)
+        assert out2 == decode_serial(PARAMS, [5, 2], n_tok, MAX_LEN)
+        for times in (times1, times2):
+            total = times[-1] - t0
+            ttft = times[0] - t0
+            assert ttft < 0.25 * total, (
+                "tokens must arrive incrementally, not at batch "
+                f"completion (ttft={ttft:.4f}s total={total:.4f}s)")
+        assert ts1.ttft_s is not None and ts2.ttft_s is not None
+        c1.close(); c2.close()
+    finally:
+        srv.stop()
+
+
+def test_stream_over_tpu_transport(serving_env):
+    """First Python-level Streaming-RPC coverage over tpu:// — same
+    handshake, same credit window, shm transport underneath."""
+    from brpc_tpu.serving import ServingClient
+    srv, port = _serving_server(max_batch=2)
+    try:
+        c = ServingClient(f"tpu://127.0.0.1:{port}", tenant="tpu-user")
+        toks = c.generate([9, 4, 1], 12)
+        assert toks == decode_serial(PARAMS, [9, 4, 1], 12, MAX_LEN)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_slow_reader_never_delays_the_other_session(serving_env):
+    """A deliberately-stalled reader (64-byte receive window, never
+    reads): the OTHER session's tokens keep arriving on schedule; the
+    stalled session is shed alone."""
+    from brpc_tpu.serving import ServingClient
+    srv, port = _serving_server(max_batch=4, stall_timeout_s=0.4)
+    try:
+        stuck = ServingClient(f"127.0.0.1:{port}", tenant="stuck")
+        fast = ServingClient(f"127.0.0.1:{port}", tenant="fast")
+        # Tiny window: ~10 frames of credit, then the engine's try-writes
+        # go pending and the stall clock starts. NEVER read from it.
+        ts_stuck = stuck.open([3, 7], 40, recv_window=64)
+        n_tok = 30
+        t0 = time.monotonic()
+        ts_fast = fast.open([5, 2], n_tok)
+        out, times = [], []
+        _drain(ts_fast, out, times)
+        total = times[-1] - t0
+        assert out == decode_serial(PARAMS, [5, 2], n_tok, MAX_LEN)
+        # The fast reader's stream finished promptly — not serialized
+        # behind the stalled one (which is still mid-shed at this point).
+        assert total < 5.0, total
+        gaps = np.diff(times)
+        assert float(np.max(gaps)) < 2.0, (
+            "a token gap that long means the batch stalled on the "
+            "slow reader", gaps.tolist())
+        # The stalled session is shed (E-frame then close) once its
+        # pending buffer stalls past the timeout.
+        deadline = time.monotonic() + 8
+        shed_reason = None
+        while shed_reason is None and time.monotonic() < deadline:
+            sess = srv.manager.get(ts_stuck.session_id)
+            if sess is not None and sess.state == SHED:
+                shed_reason = sess.shed_reason
+            time.sleep(0.05)
+        assert shed_reason == "slow reader", shed_reason
+        # The shed is VISIBLE to the stalled client even though its
+        # window was too full for the E-frame: the close itself carries
+        # an error code on the credit-exempt CLOSE frame.
+        with pytest.raises(SessionShed):
+            while True:
+                ts_stuck.read_token(timeout_ms=4000)
+        stuck.close(); fast.close()
+    finally:
+        srv.stop()
+
+
+def test_tenant_quota_sheds_third_session_mid_batch(serving_env):
+    from brpc_tpu.serving import ServingClient
+    srv, port = _serving_server(max_batch=4, tenant_max_sessions=2)
+    try:
+        c = ServingClient(f"127.0.0.1:{port}", tenant="greedy")
+        other = ServingClient(f"127.0.0.1:{port}", tenant="polite")
+        ts1 = c.open([3, 7], 40)
+        ts2 = c.open([5, 2], 40)
+        with pytest.raises(native.RpcError) as ei:
+            c.open([9], 8)
+        assert ei.value.overloaded and ei.value.retry_after_ms is not None
+        # Another tenant is admitted while greedy's batch still runs.
+        toks = other.generate([9, 4, 1], 8)
+        assert toks == decode_serial(PARAMS, [9, 4, 1], 8, MAX_LEN)
+        ts1.close(); ts2.close()
+        c.close(); other.close()
+    finally:
+        srv.stop()
+
+
+def test_ttl_eviction_closes_stream_with_e_frame(serving_env):
+    """An idle session (engine stopped) TTL-evicts; the client observes
+    the E-frame shed reason, not a silent hang."""
+    from brpc_tpu.serving import ServingClient
+    srv, port = _serving_server(max_batch=2, ttl_s=0.2)
+    try:
+        srv.engine.stop()  # nobody decodes: the session stays idle
+        c = ServingClient(f"127.0.0.1:{port}", tenant="idle")
+        ts = c.open([3, 7], 8)
+        time.sleep(0.3)
+        shed = srv.manager.evict_expired()
+        assert len(shed) == 1
+        with pytest.raises(SessionShed) as ei:
+            ts.read_token(timeout_ms=2000)
+        assert "ttl" in ei.value.reason
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_open_without_stream_is_a_clean_error(serving_env):
+    srv, port = _serving_server(max_batch=2)
+    try:
+        ch = native.Channel(f"127.0.0.1:{port}", timeout_ms=2000,
+                            max_retry=0)
+        with pytest.raises(native.RpcError) as ei:
+            ch.call("Gen/Open", json.dumps(
+                {"prompt": [1], "max_tokens": 2}).encode())
+        assert "requires a stream" in ei.value.text
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_sessionz_and_generic_fleet_scrape(serving_env):
+    """/sessionz renders live state (text + json), and the serving_*
+    recorders ride the GENERIC metric fold — dump_vars, /brpc_metrics and
+    fleet_prometheus() pick them up with zero per-page special-casing."""
+    from brpc_tpu.fleet import RegistryHub, Registration, clear_registry
+    from brpc_tpu.observability import metrics as obs
+    from brpc_tpu.observability.fleet_view import FleetObserver
+    from brpc_tpu.serving import ServingClient
+    srv, port = _serving_server(max_batch=2)
+    hub = RegistryHub()
+    hub.start()
+    try:
+        c = ServingClient(f"127.0.0.1:{port}", tenant="scrape-me")
+        toks = c.generate([3, 7, 11], 8)
+        assert len(toks) >= 1
+        # Local fold: the recorders are plain native vars.
+        vars_text = obs.dump_vars("serving_")
+        assert "serving_tokens" in vars_text
+        assert "serving_ttft_latency" in vars_text
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/sessionz?format=json",
+            timeout=5).read().decode())
+        assert doc["tokens_total"] >= 8
+        by_id = {s["id"]: s for s in doc["sessions"]}
+        assert any(s["tenant"] == "scrape-me" for s in by_id.values())
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/sessionz", timeout=5).read().decode()
+        assert "per-tenant sessions" in text and "scrape-me" in text
+        # Fleet scrape: register this process and let the observer fold
+        # every member's /brpc_metrics — serving_* series must appear with
+        # the injected shard label, through the generic path only.
+        reg = Registration(hub.hostport, f"127.0.0.1:{port}",
+                           tag="serve").start()
+        obs_view = FleetObserver(hub.hostport, tag="serve")
+        try:
+            prom = obs_view.fleet_prometheus()
+            assert (f'serving_tokens{{shard="127.0.0.1:{port}"}}'
+                    in prom), prom[:2000]
+            assert "serving_ttft_latency" in prom
+            # /fleetz's generic member scrape covers the serving process
+            # like any shard — no per-page special-casing.
+            fz = obs_view.fleetz()
+            assert any(r["addr"] == f"127.0.0.1:{port}"
+                       and r["reachable"] for r in fz["shards"]), fz
+        finally:
+            reg.stop()
+        c.close()
+    finally:
+        clear_registry()
+        hub.stop()
+        srv.stop()
+
+
+def test_http_fallback_streams_progressively(serving_env):
+    """Plain-HTTP client: /gen streams T-lines over a chunked
+    ProgressiveAttachment, arriving incrementally (first token line well
+    before the response completes)."""
+    srv, port = _serving_server(max_batch=2)
+    try:
+        ref = decode_serial(PARAMS, [3, 7, 11], 16, MAX_LEN)
+        # Raw socket so chunk arrival TIMES are observable.
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(b"GET /gen?prompt=3,7,11&max_tokens=16 HTTP/1.1\r\n"
+                  b"Host: x\r\n\r\n")
+        buf = b""
+        t0 = time.monotonic()
+        first_tok_at = done_at = None
+        while time.monotonic() - t0 < 10:
+            try:
+                chunk = s.recv(4096)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            if first_tok_at is None and b"\nT" in buf:
+                first_tok_at = time.monotonic()
+            if b"0\r\n\r\n" in buf:  # terminal chunk
+                done_at = time.monotonic()
+                break
+        s.close()
+        assert first_tok_at is not None and done_at is not None
+        header, _, body = buf.partition(b"\r\n\r\n")
+        assert b"Transfer-Encoding: chunked" in header, header
+        # De-chunk crudely: keep T-lines.
+        toks = [int(line[1:]) for line in body.splitlines()
+                if line.startswith(b"T")]
+        assert toks == ref, (toks, ref)
+    finally:
+        srv.stop()

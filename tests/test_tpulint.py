@@ -219,6 +219,20 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
     assert parsed["tbrpc_fix_deadline_remaining"] == "int64_t()"
     assert parsed["tbrpc_fix_tenant_quota"] == "int(void *, int32_t)"
     assert parsed["tbrpc_fix_inject_latency"] == "int(const char *, int64_t)"
+    # Streaming-RPC shapes: uint64_t stream handles stay SCALAR (distinct
+    # from any pointer spelling), the wide int64-returning open parses,
+    # and a copy-out callback typedef rides as a parameter type.
+    assert parsed["tbrpc_fix_stream_create"] == (
+        "int64_t(void *, const char *, const void *, size_t, int64_t, "
+        "void * *, size_t *, char *, size_t)")
+    assert parsed["tbrpc_fix_stream_write"] == (
+        "int(uint64_t, const void *, size_t, int64_t)")
+    assert parsed["tbrpc_fix_stream_read"] == (
+        "int(uint64_t, int64_t, void * *, size_t *)")
+    assert parsed["typedef:tbrpc_fix_sessionz_cb"] == (
+        "int64_t(void *, char *, size_t)")
+    assert parsed["tbrpc_fix_sessionz_set_provider"] == (
+        "int(tbrpc_fix_sessionz_cb, void *)")
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
@@ -268,6 +282,26 @@ def test_wire_contract_capi_real_repo_lock_is_current():
         "int64_t(void *, char *, size_t)")
     assert locked["tbrpc_debug_inject_latency"] == (
         "int(const char *, int64_t)")
+    # The streaming-RPC serving surface is part of the locked contract.
+    assert locked["tbrpc_stream_accept"] == "int64_t(int64_t)"
+    assert locked["tbrpc_stream_create"] == (
+        "int64_t(void *, const char *, const void *, size_t, int64_t, "
+        "void * *, size_t *, char *, size_t)")
+    assert locked["tbrpc_stream_write"] == (
+        "int(uint64_t, const void *, size_t, int64_t)")
+    assert locked["tbrpc_stream_read"] == (
+        "int(uint64_t, int64_t, void * *, size_t *)")
+    assert locked["tbrpc_stream_close"] == "int(uint64_t, int)"
+    assert locked["tbrpc_sessionz_set_provider"] == (
+        "int(tbrpc_sessionz_cb, void *)")
+    assert locked["typedef:tbrpc_sessionz_cb"] == (
+        "int64_t(void *, char *, size_t)")
+    assert locked["typedef:tbrpc_http_stream_cb"] == (
+        "void(void *, const char *, const char *, uint64_t, void * *, "
+        "size_t *, int *, int *)")
+    assert locked["tbrpc_progressive_write"] == (
+        "int(uint64_t, const void *, size_t)")
+    assert locked["tbrpc_progressive_close"] == "int(uint64_t)"
 
 
 # ---- rule class 5: metric-name ----
