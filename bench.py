@@ -244,6 +244,52 @@ def ici_threshold_point(reps=5, seconds=1, concurrency=16, wedge_log=None):
     return row
 
 
+def input_poll_point(reps=5, seconds=1, wedge_log=None):
+    """Doorbell-free input polling (rpc_input_poll_us) at the 64B conc=1
+    ping-pong floor — the latency regime the ROADMAP's second one-sided
+    tenant names. Polling keeps the input fiber re-reading its fd between
+    back-to-back requests instead of parking into epoll, so each RPC
+    skips the doorbell-edge wakeup (epoll_wait + dispatcher hop + fiber
+    spawn). Interleaved poll/no-poll pairs, median-of-ratios on p50 (the
+    floor statistic; p99 carries the steal tail)."""
+    import statistics
+    a_flags = (("rpc_input_poll_us", "200"),)
+    b_flags = (("rpc_input_poll_us", "0"),)
+    a_p50, b_p50, a_p99, b_p99, a_qps, b_qps, ratios = ([] for _ in range(7))
+    for _ in range(reps):
+        pair = {}
+        for mode, flags in (("poll", a_flags), ("nopoll", b_flags)):
+            pair[mode] = bench_echo_ex_guarded(
+                64, seconds, 1, "tpu", "single", retries=1,
+                wedge_log=wedge_log, flags=flags)
+        if pair["poll"].get("wedged") or pair["nopoll"].get("wedged"):
+            continue  # drop the PAIR (the _ab_point discipline)
+        a_p50.append(pair["poll"]["p50"])
+        b_p50.append(pair["nopoll"]["p50"])
+        a_p99.append(pair["poll"]["p99"])
+        b_p99.append(pair["nopoll"]["p99"])
+        a_qps.append(pair["poll"]["qps"])
+        b_qps.append(pair["nopoll"]["qps"])
+        ratios.append(pair["nopoll"]["p50"] / max(pair["poll"]["p50"], 1e-9))
+    if not ratios:
+        raise RuntimeError("every poll/no-poll pair wedged")
+    row = {
+        "poll_p50_us": round(statistics.median(a_p50), 1),
+        "nopoll_p50_us": round(statistics.median(b_p50), 1),
+        "poll_p99_us": round(statistics.median(a_p99), 1),
+        "nopoll_p99_us": round(statistics.median(b_p99), 1),
+        "poll_qps": round(statistics.median(a_qps)),
+        "nopoll_qps": round(statistics.median(b_qps)),
+        "p50_speedup": round(statistics.median(ratios), 2),
+        "speedup_samples": [round(r, 2) for r in ratios],
+        "payload": 64, "concurrency": 1, "reps": len(ratios),
+    }
+    print(f"# rpc_poll_64B: no-poll p50 {row['nopoll_p50_us']}us -> "
+          f"poll p50 {row['poll_p50_us']}us ({row['p50_speedup']}x, "
+          f"samples {row['speedup_samples']})", file=sys.stderr)
+    return row
+
+
 def rpcz_overhead_point(reps=5, seconds=1, concurrency=16, sample_n=64,
                         wedge_log=None):
     """Always-on rpcz cost on the 64B hot path: span collection ON with
@@ -672,6 +718,20 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# ici_threshold_4KB skipped: {e}", file=sys.stderr)
 
+    # Doorbell-free input polling at the conc=1 latency floor (the
+    # one-sided plane's second tenant): poll vs no-poll p50/p99.
+    try:
+        sweep["rpc_poll_64B"] = input_poll_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# rpc_poll_64B skipped: {e}", file=sys.stderr)
+
+    # One-sided vs two-sided pull p50/p99 at 64B-16MB against a second
+    # server process (the memory-semantics tentpole rows).
+    try:
+        sweep.update(oneside_pull_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# oneside pull point skipped: {e}", file=sys.stderr)
+
     # Sampled-rpcz overhead row (fleet observability plane): the cost of
     # keeping span collection live in production at 1-in-64 root sampling.
     try:
@@ -996,6 +1056,132 @@ finally:
 """
 
 
+# One-sided vs two-sided pull latency (the memory-semantics data plane).
+# The server runs in a FURTHER process so the client's one-sided reads
+# really cross a process boundary through the shm mapping — in-process
+# both paths would share one allocator and one GIL and measure neither.
+# argv: reps
+_ONESIDE_CHILD = r"""
+import json, statistics, sys, time, subprocess
+sys.path.insert(0, ROOT)
+import numpy as np
+
+reps = int(sys.argv[1])
+sizes = json.loads(sys.argv[2])  # [[nbytes, key, iters], ...]
+server_code = (
+    "import sys, json\n"
+    "sys.path.insert(0, %r)\n"
+    "import numpy as np\n"
+    "import jax.numpy as jnp\n"
+    "from brpc_tpu.runtime.param_server import ParameterServer\n"
+    "params = {'s%%d' %% n: jnp.asarray(\n"
+    "    np.arange(max(n // 4, 1), dtype=np.float32))\n"
+    "          for n in %s}\n"
+    "ps = ParameterServer(params, oneside=True)\n"
+    "print(json.dumps({'port': ps.start()}), flush=True)\n"
+    "sys.stdin.readline()\n"
+    "ps.stop()\n" % (ROOT, [s[0] for s in sizes]))
+srv = subprocess.Popen([sys.executable, "-c", server_code],
+                       stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                       text=True)
+try:
+    port = json.loads(srv.stdout.readline())["port"]
+    from brpc_tpu.observability import metrics as obs
+    from brpc_tpu.runtime.param_server import ParameterClient
+    c_one = ParameterClient(f"tpu://127.0.0.1:{port}", oneside=True)
+    c_rpc = ParameterClient(f"tpu://127.0.0.1:{port}")
+    c_one.pull(f"s{sizes[0][0]}")  # warmup: map + first decode + compile
+    c_rpc.pull(f"s{sizes[0][0]}")
+    # The row is meaningless if the mapping silently fell back to RPC.
+    assert obs.counter("oneside_pull_hits").value() > 0, "no one-sided hits"
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+    rows = {}
+    for nbytes, key, iters in sizes:
+        name = f"s{nbytes}"
+        # Per-size warmup OUTSIDE the timed window: first-touch page
+        # faults of the fresh 16MB buffers (and the first XLA transfer
+        # of each shape) otherwise dominate a short p50.
+        for _ in range(3):
+            c_one.pull(name)
+            c_rpc.pull(name)
+        o50, o99, r50, r99, ratios = [], [], [], [], []
+        for _ in range(reps):
+            pair = {}
+            # INTERLEAVED one-sided/RPC batches: adjacent batches see the
+            # same host-steal state, so per-pair p50 ratios are robust
+            # (PERF.md methodology).
+            for mode, cl in (("one", c_one), ("rpc", c_rpc)):
+                lat = []
+                for _ in range(iters):
+                    t0 = time.monotonic()
+                    cl.pull(name)
+                    lat.append((time.monotonic() - t0) * 1e6)
+                pair[mode] = (pctl(lat, 0.5), pctl(lat, 0.99))
+            o50.append(pair["one"][0]); o99.append(pair["one"][1])
+            r50.append(pair["rpc"][0]); r99.append(pair["rpc"][1])
+            ratios.append(pair["rpc"][0] / max(pair["one"][0], 1e-9))
+        # The RAW memory-semantics read (epoch pin + seqlock snapshot +
+        # copy-out, no decode/device dispatch): what the data movement
+        # itself costs once the RPC plane is out of the path.
+        rd = c_one._oneside_reader
+        raw = []
+        for _ in range(min(iters * 2, 500)):
+            t0 = time.monotonic()
+            rd.read(name)
+            raw.append((time.monotonic() - t0) * 1e6)
+        rows[key] = {
+            "oneside_raw_p50_us": round(pctl(raw, 0.5), 1),
+            "oneside_p50_us": round(statistics.median(o50), 1),
+            "oneside_p99_us": round(statistics.median(o99), 1),
+            "rpc_p50_us": round(statistics.median(r50), 1),
+            "rpc_p99_us": round(statistics.median(r99), 1),
+            "p50_speedup": round(statistics.median(ratios), 2),
+            "speedup_samples": [round(r, 2) for r in ratios],
+            "iters": iters, "reps": reps}
+    print(json.dumps(rows))
+    c_one.close()
+    c_rpc.close()
+finally:
+    try:
+        srv.stdin.write("\n")
+        srv.stdin.flush()
+        srv.wait(timeout=10)
+    except Exception:
+        srv.kill()
+"""
+
+
+def oneside_pull_point(reps=5, timeout=420, sizes=None):
+    """One-sided read vs two-sided Pull RPC, p50/p99 at 64B-16MB against
+    a REAL second server process (the same-host mapping the tentpole
+    serves). The one-sided number is the whole client path — epoch pin,
+    seqlock descriptor snapshot, payload copy-out, decode, device
+    dispatch — just with zero RPCs in it."""
+    if sizes is None:
+        sizes = [[64, "oneside_pull_64B", 400],
+                 [4096, "oneside_pull_4KB", 400],
+                 [1 << 20, "oneside_pull_1MB", 40],
+                 [16 << 20, "oneside_pull_16MB", 12]]
+    code = "ROOT = %r\n%s" % (
+        os.path.dirname(os.path.abspath(__file__)), _ONESIDE_CHILD)
+    proc = subprocess.run(  # tpulint: allow(py-blocking)
+        [sys.executable, "-c", code, str(reps), json.dumps(sizes)],
+        capture_output=True, timeout=timeout, text=True)
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(f"oneside child failed rc={proc.returncode}")
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    for key, row in rows.items():
+        print(f"# {key}: rpc p50 {row['rpc_p50_us']}us -> one-sided p50 "
+              f"{row['oneside_p50_us']}us ({row['p50_speedup']}x, samples "
+              f"{row['speedup_samples']})", file=sys.stderr)
+    return rows
+
+
 def param_quant_point(n_tensors=32, nbytes=1 << 20, window=8, reps=7,
                       pull_only=False, timeout=300):
     """Quantized-wire vs raw parameter traffic — the tensor-codec
@@ -1230,6 +1416,15 @@ def smoke() -> None:
                                timeout=150))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["fleet_pull_GBps_2s"] = {"error": str(e)}
+    # Guarded one-sided mini-row: one 4KB one-sided-vs-RPC pull pair —
+    # if the mapping handshake, the seqlock read path, or the fallback
+    # parity breaks, the smoke run shows it before the full sweep would.
+    try:
+        out.update(oneside_pull_point(
+            reps=1, timeout=120,
+            sizes=[[4096, "oneside_pull_4KB", 100]]))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["oneside_pull_4KB"] = {"error": str(e)}
     # Guarded overload mini-row: a short protection-on/off A/B — if the
     # priority lanes stop protecting the control plane (HIGH p99 no longer
     # flat under bulk saturation), the smoke run shows it first.

@@ -71,7 +71,8 @@ class FleetClient:
                  window: int = 4, arena_bytes: int = 64 << 20,
                  device=None, op_deadline_s: float = 15.0,
                  overrides: Optional[Dict[str, str]] = None,
-                 codec: Optional[str] = None, tenant: str = ""):
+                 codec: Optional[str] = None, tenant: str = "",
+                 oneside: bool = False):
         self._registry = registry_hostport
         self._tag = tag
         self.window = window
@@ -91,6 +92,12 @@ class FleetClient:
         # some not) serves each stream in the best format that shard
         # speaks, raw included.
         self._codec = codec
+        # One-sided reads: routed PER SHARD BY LOCALITY — each shard's
+        # ParameterClient maps that server's published window only when
+        # its shm is reachable (same host) and its Meta advertises it;
+        # remote shards stay on the RPC path, transparently, stream by
+        # stream (the same per-shard negotiation shape as the codec).
+        self._oneside = oneside
         self._mu = threading.Lock()
         self._clients: Dict[str, ParameterClient] = {}
         self._map: Optional[ShardMap] = None
@@ -164,7 +171,8 @@ class FleetClient:
                 pc = ParameterClient(f"tpu://{addr}",
                                      TensorArena(self._arena_bytes),
                                      codec=self._codec,
-                                     tenant=self._tenant)
+                                     tenant=self._tenant,
+                                     oneside=self._oneside)
                 self._clients[addr] = pc
             return pc
 

@@ -33,12 +33,15 @@ import numpy as np
 from brpc_tpu.ops.fused_update import fused_momentum_update
 from brpc_tpu.runtime import codec as codec_mod
 from brpc_tpu.runtime import native
-from brpc_tpu.runtime.tensor import (E_UNDECODABLE, PipelineWindow,
-                                     TensorArena, TensorChannel, WireTensor,
+from brpc_tpu.runtime.tensor import (E_UNDECODABLE, OnesideGone, OnesideMiss,
+                                     OnesideReader, OnesideWindow,
+                                     PipelineWindow, TensorArena,
+                                     TensorChannel, WireTensor,
                                      _dequant_widen,
                                      _detach_device_put_batch,
                                      _device_put_from_view,
-                                     add_tensor_service)
+                                     add_tensor_service,
+                                     consume_oneside_payload, pad_header64)
 
 # App-level error codes, disjoint from trpc/errno.h. The server
 # historically answered "no such parameter" with 2007 — which COLLIDES
@@ -241,7 +244,9 @@ class ParameterServer:
 
     def __init__(self, params: Dict[str, jax.Array], lr: float = 0.01,
                  momentum: float = 0.9, arena: Optional[TensorArena] = None,
-                 name: Optional[str] = None, codecs=None):
+                 name: Optional[str] = None, codecs=None,
+                 oneside: bool = False,
+                 oneside_codec: Optional[str] = None):
         # Backend split for the Push hot path. On TPU the update is the
         # fused Pallas kernel over device arrays (device_put = a real H2D
         # DMA). On the CPU backend that same shape is all dispatch
@@ -318,6 +323,22 @@ class ParameterServer:
         self.server = native.Server()
         self.arena = add_tensor_service(self.server, "ParamService",
                                         self._handle, arena)
+        # ---- one-sided tensor reads (brpc_tpu/runtime/tensor.py) ----
+        # Publish every committed version into a seqlock-stamped window of
+        # the service arena: a same-host client that mapped the window
+        # pulls WITHOUT an RPC (no dispatch, no handler, no response
+        # frame), falling back to the Pull path off-host. Published
+        # regions may hold the encoded wire form (oneside_codec) — the
+        # reader decodes by the same self-describing header the RPC path
+        # ships, so the two paths cannot disagree.
+        self._oneside_window: Optional[OnesideWindow] = None
+        self._oneside_codec = (oneside_codec
+                               if oneside_codec in self._codecs else None)
+        if oneside:
+            self._oneside_window = OnesideWindow(self.arena)
+            for k in list(self._params):
+                with self._update_locks[k]:
+                    self._publish_oneside(k)
         self.port: Optional[int] = None
 
     def start(self, addr: str = "127.0.0.1:0") -> int:
@@ -351,8 +372,15 @@ class ParameterServer:
             # priority/tenant wire fields ONLY after seeing it, so an
             # upgraded client never sends a meta a pre-QoS parser would
             # reject.
-            return json.dumps({"epoch": epoch, "params": meta, "qos": 1,
-                               "codecs": list(self._codecs)}).encode(), None
+            doc = {"epoch": epoch, "params": meta, "qos": 1,
+                   "codecs": list(self._codecs)}
+            # One-sided advertisement (the codec/QoS negotiation
+            # discipline): clients ask for the window descriptor only
+            # after seeing it, so a pre-oneside server never receives an
+            # Oneside method call it cannot parse.
+            if self._oneside_window is not None:
+                doc["oneside"] = 1
+            return json.dumps(doc).encode(), None
         if method == "Epoch":
             # The Meta-cache validator: a tiny small-RPC-fast-path answer
             # (schema epoch only) instead of the full Meta payload.
@@ -361,6 +389,18 @@ class ParameterServer:
             return json.dumps({"epoch": epoch}).encode(), None
         if method == "PullQ":
             return self._handle_pull_group(request)
+        if method == "Oneside":
+            # The mapping handshake: ONE ordinary RPC hands out the
+            # window descriptor; every read after it is memory-semantics.
+            if self._oneside_window is None:
+                raise native.RpcError(E_NO_SUCH, "one-sided reads disabled")
+            desc = self._oneside_window.describe()
+            # The token stays a decimal STRING on the wire (the capi
+            # contract): a double-typed JSON parser would round a bare
+            # u64 above 2^53 and the reader's token check would fail
+            # forever. OnesideReader.map int()s either form.
+            desc["token"] = str(desc["token"])
+            return json.dumps(desc).encode(), None
         if method == "Handoff":
             return self._handle_handoff(request)
         if method == "Install":
@@ -536,6 +576,55 @@ class ParameterServer:
         return (json.dumps({"tensors": entries}).encode(),
                 WireTensor(None, b"", placed=placed))
 
+    # ---- one-sided publication (memory-semantics pulls) ----
+
+    def _publish_oneside(self, name: str) -> None:
+        """Publish ``name``'s committed version into the one-sided
+        window: [self-describing header|bytes] — raw, or the encoded
+        wire form when ``oneside_codec`` engages — written into a fresh
+        arena range the window takes ownership of (the displaced
+        version's range retires through epoch reclamation, never under a
+        reader mid-copy). Callers hold the per-name update lock, so
+        publish order matches version order. Arena exhaustion skips the
+        publish — readers of this name fall back to the RPC path, which
+        serves the same committed state."""
+        win = self._oneside_window
+        if win is None:
+            return
+        with self._mu:
+            if name not in self._params:
+                return
+            p = self._params[name]
+            version = self._version[name]
+        host = np.asarray(p)  # one D2H on the device path
+        header = data = None
+        c = self._oneside_codec
+        if c and codec_mod.eligible(host):
+            enc = codec_mod.encode(host, c)
+            if enc is not None:
+                header, data = enc.header, enc.wire
+                codec_mod.note(name, c, enc.logical_bytes, enc.wire_bytes)
+        if data is None:
+            header = codec_mod.pack_header({"dtype": host.dtype.str,
+                                            "shape": list(host.shape)})
+            data = np.ascontiguousarray(host).reshape(-1).view(np.uint8)
+        # 64B-multiple header => the payload starts 64B-aligned in the
+        # blob, so a reader's device_put can alias it zero-copy.
+        header = pad_header64(header)
+        total = len(header) + int(data.nbytes)
+        try:
+            off = self.arena.alloc(total)
+        except MemoryError:
+            return  # unpublished version: one-sided readers fall back
+        view = self.arena.view(off, total)
+        view[:len(header)] = np.frombuffer(header, dtype=np.uint8)
+        if data.nbytes:
+            view[len(header):] = data.reshape(-1)
+        try:
+            win.publish(name, off, total, version)
+        except (ValueError, RuntimeError):
+            self.arena.free(off)
+
     # ---- live-resharding handshake (driven by brpc_tpu/fleet.Migrator) ----
 
     def _recompute_spread_locked(self) -> None:
@@ -618,6 +707,9 @@ class ParameterServer:
             self._handoff_dest.pop(name, None)  # any old freeze is void
             self._schema_epoch += 1
             self._recompute_spread_locked()
+        # Pending names refuse pushes until Commit, so no concurrent
+        # publish can race this one out of version order.
+        self._publish_oneside(name)
         return json.dumps({"name": name, "version": version}).encode(), None
 
     def _handle_retire(self, request: bytes):
@@ -642,6 +734,10 @@ class ParameterServer:
                         self._moved[name] = dest  # — unparseable; a plain
                     self._schema_epoch += 1       # drop answers E_NO_SUCH
                     self._recompute_spread_locked()
+                if self._oneside_window is not None:
+                    # A retired name must not serve stale one-sided reads:
+                    # mapped clients miss here and re-route via E_MOVED.
+                    self._oneside_window.unpublish(name)
         else:
             with self._mu:
                 if dest and self._moved.get(name) != dest:
@@ -745,6 +841,9 @@ class ParameterServer:
                 self._version[name] += 1
                 version = self._version[name]
                 self._recompute_spread_locked()
+            # Inside the per-name update lock: publish order == version
+            # order, so a mapped reader's versions are monotonic.
+            self._publish_oneside(name)
         return version
 
 
@@ -761,7 +860,8 @@ class ParameterClient:
     push k+1, so repeated pushes never compound rounding bias)."""
 
     def __init__(self, addr: str, arena: Optional[TensorArena] = None,
-                 codec: Optional[str] = None, tenant: str = ""):
+                 codec: Optional[str] = None, tenant: str = "",
+                 oneside: bool = False):
         self.addr = addr
         self.channel = TensorChannel(addr, arena)
         # Meta cache keyed by the server's schema epoch: the epoch bumps
@@ -783,6 +883,13 @@ class ParameterClient:
         # advertisement (or against a pre-QoS server, whose parser
         # rejects the unknown meta fields) would kill the connection.
         self._srv_qos: Optional[bool] = None
+        # One-sided reads: engaged only when asked for AND the server
+        # advertises "oneside" in Meta AND its window maps (same host).
+        # _oneside_reader: None = not tried yet, False = permanently on
+        # the RPC path (off-host / disabled / gone), else the mapping.
+        self._oneside = oneside
+        self._oneside_reader = None
+        self._srv_oneside: Optional[bool] = None
 
     # ---- QoS lanes (native/trpc/qos.h) ----
     # Control-plane calls (Epoch, the migrator handshake) ride HIGH —
@@ -845,6 +952,7 @@ class ParameterClient:
         self._meta_cache = doc["params"]
         self._srv_codecs = tuple(doc.get("codecs", ()))
         self._srv_qos = bool(doc.get("qos", 0))
+        self._srv_oneside = bool(doc.get("oneside", 0))
         return doc["params"]
 
     def epoch(self) -> int:
@@ -931,6 +1039,76 @@ class ParameterClient:
             return False
         return self.negotiated_codec() is None
 
+    # ---- one-sided reads (memory-semantics pulls) ----
+
+    def _oneside_enabled(self, oneside: Optional[bool]) -> bool:
+        return self._oneside if oneside is None else bool(oneside)
+
+    def _ensure_oneside_reader(self):
+        """The mapped window, lazily established: one Meta RPC for the
+        advertisement (the codec/QoS negotiation discipline), one
+        Oneside RPC for the descriptor, one map. Any failure parks this
+        client permanently on the RPC path — off-host mappings cannot
+        start working later, and a restarted server re-advertises
+        through a fresh client."""
+        r = self._oneside_reader
+        if r is not None:
+            return r if r is not False else None
+        if self._srv_oneside is None:
+            try:
+                self.meta()
+            except native.RpcError:
+                return None  # unknown stays unknown: retry next call
+        if not self._srv_oneside:
+            self._oneside_reader = False
+            return None
+        try:
+            payload, _ = self.channel.call("ParamService/Oneside")
+            desc = json.loads(payload.decode())
+            r = OnesideReader.map(desc)
+        except (native.RpcError, ValueError):
+            r = None
+        self._oneside_reader = r if r is not None else False
+        return r
+
+    def _drop_oneside_reader(self) -> None:
+        r = self._oneside_reader
+        self._oneside_reader = False  # permanent fallback
+        if r not in (None, False):
+            r.close()
+
+    def _oneside_read(self, name: str, device=None, to_host: bool = False):
+        """-> (version, array) straight from the peer's published window,
+        or None when this pull should ride the RPC path (every miss
+        counts into oneside_pull_fallbacks; the RPC path serves the same
+        committed state, so fallback is invisible to the caller)."""
+        from brpc_tpu.runtime.tensor import _metrics
+
+        m = _metrics()
+        r = self._ensure_oneside_reader()
+        if r is None:
+            m["oneside_fallbacks"].add(1)
+            return None
+        try:
+            # read_np: the owned-ndarray form — one copy out of the
+            # window, viewed (and on CPU device_put-aliased) in place.
+            version, payload = r.read_np(name)
+        except OnesideGone:
+            self._drop_oneside_reader()
+            m["oneside_fallbacks"].add(1)
+            return None
+        except OnesideMiss:
+            m["oneside_fallbacks"].add(1)
+            return None
+        try:
+            arr = consume_oneside_payload(payload, device, note_name=name,
+                                          to_host=to_host)
+        except Exception:  # noqa: BLE001 — undecodable publication
+            m["oneside_fallbacks"].add(1)
+            return None
+        m["oneside_hits"].add(1)
+        return int(version), arr
+
     def prune_residuals(self, keep) -> int:
         """Drop error-feedback residuals for names failing ``keep(name)``.
         Fleet reshard hook: once a name's ownership moves to another
@@ -979,8 +1157,17 @@ class ParameterClient:
 
         return enc
 
-    def pull(self, name: str, device=None):
-        """-> (version, jax.Array) — H2D straight from the shared pages."""
+    def pull(self, name: str, device=None, oneside: Optional[bool] = None):
+        """-> (version, jax.Array) — H2D straight from the shared pages.
+
+        ``oneside=True`` (or the constructor flag) reads the committed
+        version straight from the server's published window when it is
+        mapped — no RPC at all — and falls back here transparently
+        otherwise."""
+        if self._oneside_enabled(oneside):
+            got = self._oneside_read(name, device)
+            if got is not None:
+                return got
         self.pacer.pace()
         try:
             with self._qos_bulk():
@@ -1064,7 +1251,8 @@ class ParameterClient:
     # round-trip plus N wire times.
 
     def pull_all(self, names=None, device=None, window: int = 4,
-                 group: int = 8, to_host: bool = False) -> Dict[str, tuple]:
+                 group: int = 8, to_host: bool = False,
+                 oneside: Optional[bool] = None) -> Dict[str, tuple]:
         """Pull many parameters through one bounded pipeline window.
 
         -> ``{name: (version, jax.Array)}``. ``names=None`` pulls every
@@ -1092,6 +1280,21 @@ class ParameterClient:
         names = list(names)
         m = _metrics()
         out: Dict[str, tuple] = {}
+        # One-sided pre-pass: every name the mapped window serves skips
+        # the RPC plane entirely; the stragglers (unpublished, torn,
+        # unmapped, off-host) ride the pipelined RPC path below — the
+        # per-shard locality routing the fleet client inherits as-is.
+        if self._oneside_enabled(oneside) and names:
+            rest = []
+            for n in names:
+                got = self._oneside_read(n, device, to_host=to_host)
+                if got is not None:
+                    out[n] = got
+                else:
+                    rest.append(n)
+            if not rest:
+                return out
+            names = rest
         c = self.negotiated_codec()
 
         if c is None:
@@ -1349,4 +1552,7 @@ class ParameterClient:
         return versions
 
     def close(self) -> None:
+        if self._oneside_reader not in (None, False):
+            self._oneside_reader.close()
+        self._oneside_reader = False
         self.channel.close()

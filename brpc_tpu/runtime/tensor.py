@@ -102,6 +102,41 @@ def _bind_tensor_api(L: ctypes.CDLL) -> ctypes.CDLL:
     L.tbrpc_future_destroy.argtypes = [ctypes.c_void_p]
     L.tbrpc_async_inflight.restype = ctypes.c_int64
     L.tbrpc_async_inflight.argtypes = []
+    # ---- one-sided tensor reads (published arena windows) ----
+    L.tbrpc_oneside_window_create.restype = ctypes.c_void_p
+    L.tbrpc_oneside_window_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    L.tbrpc_oneside_window_destroy.argtypes = [ctypes.c_void_p]
+    L.tbrpc_oneside_publish.restype = ctypes.c_int
+    L.tbrpc_oneside_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_int]
+    L.tbrpc_oneside_begin_rewrite.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p]
+    L.tbrpc_oneside_unpublish.restype = ctypes.c_int
+    L.tbrpc_oneside_unpublish.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    L.tbrpc_oneside_window_describe.restype = ctypes.c_int64
+    L.tbrpc_oneside_window_describe.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    L.tbrpc_oneside_map.restype = ctypes.c_void_p
+    L.tbrpc_oneside_map.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+    L.tbrpc_oneside_read.restype = ctypes.c_int
+    L.tbrpc_oneside_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    L.tbrpc_oneside_stat.restype = ctypes.c_int
+    L.tbrpc_oneside_stat.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64)]
+    L.tbrpc_oneside_read_into.restype = ctypes.c_int
+    L.tbrpc_oneside_read_into.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    L.tbrpc_oneside_unmap.restype = ctypes.c_int
+    L.tbrpc_oneside_unmap.argtypes = [ctypes.c_void_p]
+    L.tbrpc_oneside_stats_json.restype = ctypes.c_int64
+    L.tbrpc_oneside_stats_json.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     L._tensor_api_bound = True
     return L
 
@@ -168,6 +203,13 @@ def _metrics():
             # PLUS response staging into the arena (which happens after
             # the handler returns, so per-service recorders can't see it).
             "serve": obs.latency("tensor_handler"),
+            # One-sided pull routing: hits read the peer's published
+            # window directly (no RPC); fallbacks took the two-sided
+            # path (off-host, unmapped, unpublished name, torn budget).
+            # The native side keeps its own oneside_* adders; these two
+            # count the CLIENT-side routing decision.
+            "oneside_hits": obs.counter("oneside_pull_hits"),
+            "oneside_fallbacks": obs.counter("oneside_pull_fallbacks"),
         }
     return _metrics_cache
 
@@ -215,6 +257,22 @@ def _encode_meta(arr: np.ndarray) -> bytes:
 
     return codec_mod.pack_header({"dtype": arr.dtype.str,
                                   "shape": list(arr.shape)})
+
+
+def pad_header64(header: bytes) -> bytes:
+    """Pad a [u32 n|JSON] header with trailing spaces until its TOTAL
+    length is a 64-byte multiple. One-sided publications use this so the
+    payload that follows the header in the blob starts 64B-aligned:
+    ``read_np`` aligns the BLOB start, and the CPU backend's zero-copy
+    ``device_put`` alias check needs the DATA start aligned — without
+    the pad, essentially every header length breaks the alias and
+    re-adds the full-payload copy the owned-buffer path exists to
+    remove. JSON parsers ignore the trailing whitespace."""
+    pad = -len(header) % 64
+    if pad == 0:
+        return header
+    body = header[4:] + b" " * pad
+    return struct.pack("<I", len(body)) + body
 
 
 def _decode_meta_ex(buf: bytes) -> Tuple[dict, bytes]:
@@ -354,6 +412,224 @@ class TensorArena:
             self.close()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
+
+
+class OnesideMiss(Exception):
+    """A one-sided read that must fall back to the RPC path for this
+    call: not published (status 1) or torn past the retry budget under a
+    republish storm (status 2). Transient by contract."""
+
+    def __init__(self, name: str, status: int):
+        super().__init__(f"oneside read miss for {name!r} (status {status})")
+        self.name = name
+        self.status = status
+
+
+class OnesideGone(OnesideMiss):
+    """The mapped window is gone (destroyed window, swept reader claim):
+    unmap and stop trying — the permanent-fallback signal."""
+
+
+class OnesideWindow:
+    """Publisher side of one-sided tensor reads: seqlock-stamped
+    publication slots inside a :class:`TensorArena`, readable by any
+    same-host process that mapped the arena's shm segment. ``publish``
+    hands over a range the caller already wrote (the window retires and
+    reclaims the displaced range via epoch-based reclamation — never
+    under a reader mid-copy); ``own=False`` publishes in place without
+    ever freeing (serving KV pages, whose ranges the session owns)."""
+
+    def __init__(self, arena: TensorArena, n_slots: int = 256,
+                 n_readers: int = 64):
+        self._L = _bind_tensor_api(lib())
+        self.arena = arena
+        self._h = self._L.tbrpc_oneside_window_create(arena.handle, n_slots,
+                                                      n_readers)
+        if not self._h:
+            raise MemoryError("oneside window create failed (arena full?)")
+
+    def publish(self, name: str, off: int, nbytes: int, version: int,
+                own: bool = True) -> None:
+        if not self._h:
+            raise RuntimeError("oneside window is closed")
+        if self._L.tbrpc_oneside_publish(self._h, name.encode(), off,
+                                         nbytes, version,
+                                         1 if own else 0) != 0:
+            raise ValueError(
+                f"oneside publish({name!r}, off={off}, n={nbytes}) refused")
+
+    def begin_rewrite(self, name: str) -> None:
+        """Write-lock ``name`` (readers retry) while its payload is
+        rewritten in place; the next ``publish`` commits."""
+        if self._h:
+            self._L.tbrpc_oneside_begin_rewrite(self._h, name.encode())
+
+    def unpublish(self, name: str) -> bool:
+        if not self._h:
+            return False
+        return self._L.tbrpc_oneside_unpublish(self._h, name.encode()) == 0
+
+    def describe(self) -> dict:
+        """The mapping-handshake descriptor a server hands to clients
+        (over any ordinary RPC): shm name, size, directory offset and the
+        random window token a reader validates after mapping."""
+        if not self._h:
+            raise RuntimeError("oneside window is closed")
+        n = self._L.tbrpc_oneside_window_describe(self._h, None, 0)
+        buf = ctypes.create_string_buffer(n + 1)
+        self._L.tbrpc_oneside_window_describe(self._h, buf, n + 1)
+        doc = json.loads(buf.value.decode())
+        doc["token"] = int(doc["token"])  # shipped as a decimal string
+        return doc
+
+    def close(self) -> None:
+        if self._h:
+            self._L.tbrpc_oneside_window_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def oneside_stats() -> dict:
+    """Process-wide one-sided counters + per-window reclamation state."""
+    L = _bind_tensor_api(lib())
+    n = L.tbrpc_oneside_stats_json(None, 0)
+    buf = ctypes.create_string_buffer(n + 1)
+    L.tbrpc_oneside_stats_json(buf, n + 1)
+    return json.loads(buf.value.decode())
+
+
+class OnesideReader:
+    """Reader side: a same-host mapping of a peer's published window.
+    ``read`` copies out one committed version under the reader's epoch
+    pin (the publisher cannot reclaim the range mid-copy) and raises
+    :class:`OnesideMiss`/:class:`OnesideGone` when the caller should use
+    the RPC path instead."""
+
+    def __init__(self, handle):
+        self._L = _bind_tensor_api(lib())
+        self._h = handle
+
+    @classmethod
+    def map(cls, desc: dict) -> Optional["OnesideReader"]:
+        """Map from a window descriptor; None means stay on the RPC path
+        (off-host shm name, stale token, full reader table)."""
+        L = _bind_tensor_api(lib())
+        try:
+            h = L.tbrpc_oneside_map(str(desc["shm"]).encode(),
+                                    int(desc["bytes"]),
+                                    int(desc["dir_off"]),
+                                    int(desc["token"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+        return cls(h) if h else None
+
+    def read(self, name: str) -> Tuple[int, bytes]:
+        """-> (version, payload bytes) of the committed publication."""
+        version, arr = self.read_np(name)
+        return version, arr.tobytes()
+
+    def read_np(self, name: str) -> Tuple[int, np.ndarray]:
+        """-> (version, OWNED uint8 ndarray): stat for the size, then ONE
+        native memcpy straight into a 64B-aligned numpy buffer the
+        caller owns — decode may view and even device_put-alias it with
+        no reuse hazard (unlike arena pages, nothing ever rewrites this
+        buffer). The large-tensor hot path: the bytes-returning ``read``
+        costs one more copy."""
+        if not self._h:
+            raise OnesideGone(name, 3)
+        nbytes = ctypes.c_uint64()
+        version = ctypes.c_uint64()
+        rc = self._L.tbrpc_oneside_stat(self._h, name.encode(),
+                                        ctypes.byref(nbytes),
+                                        ctypes.byref(version))
+        # A republish between stat and read_into may grow the payload:
+        # read_into answers TOO_SMALL (4) with the needed size — retry.
+        for _ in range(8):
+            if rc not in (0, 4):
+                break
+            need = nbytes.value
+            # Over-allocate 64 bytes and slice to a 64B-aligned start so
+            # the CPU backend's zero-copy device_put alias check passes.
+            backing = np.empty(need + 64, np.uint8)
+            shift = (-backing.ctypes.data) % 64
+            arr = backing[shift:shift + need]
+            rc = self._L.tbrpc_oneside_read_into(
+                self._h, name.encode(), ctypes.c_void_p(backing.ctypes.data
+                                                        + shift),
+                need, ctypes.byref(nbytes), ctypes.byref(version))
+            if rc == 0:
+                return int(version.value), arr
+        if rc == 3:
+            raise OnesideGone(name, rc)
+        raise OnesideMiss(name, rc)
+
+    def close(self) -> None:
+        if self._h:
+            self._L.tbrpc_oneside_unmap(self._h)
+            self._h = None
+
+    unmap = close
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def consume_oneside_payload(payload, device=None,
+                            note_name: Optional[str] = None,
+                            to_host: bool = False):
+    """Decode one one-sided payload — the same self-describing
+    [u32 meta-len|meta JSON|bytes] framing the Pull RPC ships (raw or
+    quantized), so the two paths CANNOT return different values for the
+    same committed version (the fallback-parity contract). Returns a
+    device array, or a detached host ndarray with ``to_host=True``.
+
+    ``payload`` is either ``bytes`` or an OWNED uint8 ndarray
+    (:meth:`OnesideReader.read_np`). The owned form is the large-tensor
+    hot path: its buffer is never rewritten, so the raw branch may view
+    it in place and let ``jax.device_put`` alias it on the CPU backend —
+    the detach copy the arena-view path needs is pure waste here."""
+    owned = isinstance(payload, np.ndarray)
+    if owned:
+        (n,) = struct.unpack("<I", payload[:4].tobytes())
+        meta = json.loads(payload[4:4 + n].tobytes().decode())
+        u8 = payload[4 + n:]
+    else:
+        meta, rest = _decode_meta_ex(payload)
+        u8 = np.frombuffer(rest, dtype=np.uint8)
+    if "codec" in meta:
+        from brpc_tpu.runtime import codec as codec_mod
+
+        if note_name is not None:
+            nbytes = int(np.prod(meta["shape"], dtype=np.int64)
+                         ) * np.dtype(meta["dtype"]).itemsize
+            codec_mod.note(note_name, meta["codec"], nbytes, int(u8.nbytes))
+        with _stage("dequant"):
+            if to_host:
+                return codec_mod.decode(meta, u8)
+            return _dequant_put_from_view(meta, u8, device, codec_mod)
+    arr = u8.view(np.dtype(meta["dtype"])).reshape(tuple(meta["shape"])) \
+        if owned else np.frombuffer(
+            u8, dtype=np.dtype(meta["dtype"])).reshape(tuple(meta["shape"]))
+    if to_host:
+        return arr if owned else np.array(arr)
+    with _stage("device_put"):
+        if owned:
+            # Alias-safe: the caller-owned buffer outlives the jax array
+            # (device_put keeps a reference) and is never rewritten.
+            import jax
+
+            return jax.device_put(arr, device)
+        # `bytes` payloads are read-only frombuffer views — the helper's
+        # detach discipline covers them.
+        return _device_put_from_view(arr, device)
 
 
 class TensorView:

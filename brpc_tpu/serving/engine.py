@@ -250,6 +250,12 @@ class DecodeEngine:
                 v_new = np.asarray(v_new)
             with stage("emit"):
                 now = time.monotonic()
+                # Published KV slots go write-locked across the in-place
+                # row writes below; publish_kv() after each session's
+                # write commits the new version (one-sided readers of a
+                # mid-step plane retry/fall back instead of seeing a
+                # half-written row).
+                self.manager.kv_begin_step(decodable)
                 for sess in decodable:
                     if sess.state != ACTIVE:
                         continue  # finished externally mid-step: swept
@@ -266,6 +272,11 @@ class DecodeEngine:
                         continue
                     if sess.token == self.eos_id:
                         sess.max_tokens = sess.emitted  # EOS: stop decoding
+                # Commit every slot kv_begin_step write-locked — including
+                # sessions the loop skipped (their bytes are unchanged;
+                # the republish just restores an even seq).
+                for sess in decodable:
+                    self.manager.publish_kv(sess)
             self.steps += 1
         self._drain_finished(now)
         return True

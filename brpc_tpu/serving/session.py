@@ -273,7 +273,8 @@ class SessionManager:
                  kv_arena_bytes: int = 8 << 20, ttl_s: float = 30.0,
                  tenant_max_sessions: int = 0,
                  stall_timeout_s: float = 2.0,
-                 max_pending_bytes: int = 32 << 10):
+                 max_pending_bytes: int = 32 << 10,
+                 publish_kv: bool = False):
         self.max_len = max_len
         self.dim = dim
         self.ttl_s = ttl_s
@@ -286,6 +287,18 @@ class SessionManager:
         # free); the pure path gets a numpy arena with the same surface.
         self.arena = (TensorArena(kv_arena_bytes) if self._native
                       else _HostArena(kv_arena_bytes))
+        # One-sided KV publication (publish_kv=True, native only):
+        # session KV planes are exactly the large, versioned, read-mostly
+        # objects one-sided reads want — publish each plane (not-owned:
+        # the session keeps its range) under "kv:<sid>:k"/":v" with
+        # version = rows filled, seqlock-write-locked across each decode
+        # step, so a migration/prefill reader in another process can pull
+        # a session's cache without a serving RPC.
+        self.oneside = None
+        if publish_kv and self._native:
+            from brpc_tpu.runtime.tensor import OnesideWindow
+
+            self.oneside = OnesideWindow(self.arena)
         self._mu = threading.Lock()
         self._sessions: Dict[str, Session] = {}
         self._ids = itertools.count(1)
@@ -354,6 +367,11 @@ class SessionManager:
                            deadline_s, sink, off, 2 * per_plane, kv_k, kv_v)
             self._sessions[sid] = sess
             self._kv_bytes += 2 * per_plane
+            # Publishable from birth (version 0 = no rows filled), INSIDE
+            # _mu: published before any finish()/evict can release the
+            # range — a post-release publish would pin a freed (and
+            # reallocatable) range under this session's name forever.
+            self.publish_kv(sess)
         return sess
 
     def get(self, sid: str) -> Optional[Session]:
@@ -404,6 +422,12 @@ class SessionManager:
     def _release_kv_locked(self, sess: Session) -> None:
         if sess.kv_k is None:
             return
+        if self.oneside is not None:
+            # Unpublish BEFORE the free: the range may be reallocated to
+            # a new session immediately, and a still-published slot would
+            # hand a reader the new session's bytes under the old name.
+            self.oneside.unpublish(f"kv:{sess.id}:k")
+            self.oneside.unpublish(f"kv:{sess.id}:v")
         self._kv_bytes -= sess.kv_nbytes
         # Drop the views BEFORE freeing the range: a freed range can be
         # reallocated to a new session immediately.
@@ -415,6 +439,38 @@ class SessionManager:
         the one place that knows no step is mid-write)."""
         with self._mu:
             self._release_kv_locked(sess)
+
+    # ---- one-sided KV publication (publish_kv=True) ----
+
+    def kv_begin_step(self, sessions) -> None:
+        """Write-lock the published KV slots of ``sessions`` (seq -> odd)
+        before the engine's in-place plane writes: a one-sided reader
+        that lands mid-step retries/falls back instead of copying a
+        half-written row. ``publish_kv(sess)`` commits after the step.
+        No-op without a window."""
+        if self.oneside is None:
+            return
+        for sess in sessions:
+            if sess.kv_k is not None:
+                self.oneside.begin_rewrite(f"kv:{sess.id}:k")
+                self.oneside.begin_rewrite(f"kv:{sess.id}:v")
+
+    def publish_kv(self, sess: Session) -> None:
+        """(Re)publish ``sess``'s KV planes at version = rows filled.
+        Not-owned publication: the session keeps its range (released via
+        the engine's lane sweep, which unpublishes first). No-op without
+        a window or once the KV is released."""
+        if self.oneside is None or sess.kv_k is None:
+            return
+        per_plane = self.max_len * self.dim * 4
+        try:
+            self.oneside.publish(f"kv:{sess.id}:k", sess.kv_off, per_plane,
+                                 sess.pos, own=False)
+            self.oneside.publish(f"kv:{sess.id}:v",
+                                 sess.kv_off + per_plane, per_plane,
+                                 sess.pos, own=False)
+        except (ValueError, RuntimeError):
+            pass  # directory full: this session simply isn't publishable
 
     def close(self, sid: str) -> bool:
         """Explicit client Close: ends the session whatever its state."""

@@ -40,6 +40,7 @@
 #include "trpc/stream.h"
 #include "trpc/tstd_protocol.h"
 #include "ttpu/ici_segment.h"
+#include "ttpu/oneside.h"
 #include "ttpu/tensor_arena.h"
 
 using namespace trpc;
@@ -539,6 +540,95 @@ void tbrpc_var_arena_gauges_create(void) {
 
 int tbrpc_arena_wait_reusable(void* arena, uint64_t off, int64_t timeout_ms) {
   return static_cast<ArenaBox*>(arena)->arena->WaitReusable(off, timeout_ms);
+}
+
+// ---------------- one-sided tensor reads (ttpu/oneside.h) ----------------
+
+namespace {
+// Defined in the observability-dumps section below (same anonymous
+// namespace; declarations merge).
+int64_t copy_out(const std::string& s, char* buf, size_t cap);
+
+struct OnesideWindowBox {
+  std::shared_ptr<ttpu::OnesideWindow> win;
+};
+}  // namespace
+
+void* tbrpc_oneside_window_create(void* arena, int32_t n_slots,
+                                  int32_t n_readers) {
+  if (arena == nullptr || n_slots <= 0 || n_readers <= 0) return nullptr;
+  auto win = ttpu::OnesideWindow::Create(
+      static_cast<ArenaBox*>(arena)->arena,
+      static_cast<uint32_t>(n_slots), static_cast<uint32_t>(n_readers));
+  if (win == nullptr) return nullptr;
+  return new OnesideWindowBox{std::move(win)};
+}
+
+void tbrpc_oneside_window_destroy(void* win) {
+  delete static_cast<OnesideWindowBox*>(win);
+}
+
+int tbrpc_oneside_publish(void* win, const char* name, uint64_t off,
+                          uint64_t len, uint64_t version,
+                          int take_ownership) {
+  if (win == nullptr || name == nullptr) return -1;
+  return static_cast<OnesideWindowBox*>(win)->win->Publish(
+      name, off, len, version, take_ownership != 0);
+}
+
+void tbrpc_oneside_begin_rewrite(void* win, const char* name) {
+  if (win == nullptr || name == nullptr) return;
+  static_cast<OnesideWindowBox*>(win)->win->BeginRewrite(name);
+}
+
+int tbrpc_oneside_unpublish(void* win, const char* name) {
+  if (win == nullptr || name == nullptr) return -1;
+  return static_cast<OnesideWindowBox*>(win)->win->Unpublish(name);
+}
+
+int64_t tbrpc_oneside_window_describe(void* win, char* buf, size_t cap) {
+  if (win == nullptr) return copy_out("", buf, cap);
+  return copy_out(static_cast<OnesideWindowBox*>(win)->win->DescribeJson(),
+                  buf, cap);
+}
+
+void* tbrpc_oneside_map(const char* shm_name, uint64_t bytes,
+                        uint64_t dir_off, uint64_t token) {
+  if (shm_name == nullptr) return nullptr;
+  auto rd = ttpu::OnesideReader::Map(shm_name, bytes, dir_off, token);
+  return rd.release();  // boxed as-is; unmap deletes
+}
+
+int tbrpc_oneside_read(void* reader, const char* name, void** data,
+                       uint64_t* len, uint64_t* version) {
+  if (reader == nullptr || name == nullptr) return ttpu::ONESIDE_GONE;
+  // The reader mallocs, tbrpc_free frees — same allocator by contract.
+  return static_cast<ttpu::OnesideReader*>(reader)->Read(name, data, len,
+                                                         version);
+}
+
+int tbrpc_oneside_stat(void* reader, const char* name, uint64_t* len,
+                       uint64_t* version) {
+  if (reader == nullptr || name == nullptr) return ttpu::ONESIDE_GONE;
+  return static_cast<ttpu::OnesideReader*>(reader)->Stat(name, len, version);
+}
+
+int tbrpc_oneside_read_into(void* reader, const char* name, void* buf,
+                            uint64_t cap, uint64_t* len, uint64_t* version) {
+  if (reader == nullptr || name == nullptr || buf == nullptr) {
+    return ttpu::ONESIDE_GONE;
+  }
+  return static_cast<ttpu::OnesideReader*>(reader)->ReadInto(name, buf, cap,
+                                                             len, version);
+}
+
+int tbrpc_oneside_unmap(void* reader) {
+  delete static_cast<ttpu::OnesideReader*>(reader);
+  return 0;
+}
+
+int64_t tbrpc_oneside_stats_json(char* buf, size_t cap) {
+  return copy_out(ttpu::OnesideStatsJson(), buf, cap);
 }
 
 int tbrpc_call_tensor(void* channel, const char* service_method,

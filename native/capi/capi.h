@@ -386,6 +386,69 @@ void tbrpc_tensor_codec_note(const char* tensor, int codec_id,
 // wire,count}...]} — the accounting table as JSON. Copy-out convention.
 int64_t tbrpc_tensor_codec_stats_json(char* buf, size_t cap);
 
+// ---- one-sided tensor reads: published arena windows (ttpu/oneside.h) --
+// Memory-semantics pulls beside the RPC plane: a server PUBLISHES
+// committed tensor versions into seqlock-stamped slots of its
+// TensorArena, and a same-host client that mapped the window READS them
+// directly — no request frame, no handler dispatch, no response frame.
+// The seqlock protects the descriptor (torn snapshots retry); epoch-based
+// reclamation protects the payload bytes (a republish retires the old
+// range and frees it only once no mapped reader can still be traversing
+// it). Any non-OK read means "use the two-sided Pull RPC" — fallback is
+// the contract, off-host or when the window is gone.
+//
+// Publisher: create a window inside a tbrpc_arena (returns a handle;
+// null on failure). The directory consumes arena space.
+void* tbrpc_oneside_window_create(void* arena, int32_t n_slots,
+                                  int32_t n_readers);
+void tbrpc_oneside_window_destroy(void* win);
+// Publish `name` -> the payload the caller already wrote at [off,
+// off+len) in the window's arena. take_ownership != 0 hands the range to
+// the window (the PREVIOUS range published under `name` retires and
+// returns to the arena allocator once reclaimable; the caller must not
+// free either range); 0 publishes in place without ever freeing (serving
+// KV pages — the session owns its plane). 0 ok, -1 on a bad name/range
+// or a full directory.
+int tbrpc_oneside_publish(void* win, const char* name, uint64_t off,
+                          uint64_t len, uint64_t version,
+                          int take_ownership);
+// Write-lock `name`'s slot so readers retry while the caller rewrites
+// the payload in place (the not-owned mode); the next publish commits.
+void tbrpc_oneside_begin_rewrite(void* win, const char* name);
+int tbrpc_oneside_unpublish(void* win, const char* name);
+// The mapping-handshake descriptor, served to clients over any ordinary
+// RPC: {"shm","bytes","dir_off","token","pid",...}. Copy-out convention.
+int64_t tbrpc_oneside_window_describe(void* win, char* buf, size_t cap);
+//
+// Reader: map a published window from its descriptor. Returns a reader
+// handle, or null when the shm name cannot be mapped (off-host, server
+// gone), the token mismatches, or the window's reader table is full —
+// every null means "stay on the RPC path".
+void* tbrpc_oneside_map(const char* shm_name, uint64_t bytes,
+                        uint64_t dir_off, uint64_t token);
+// Copy out the committed payload under `name`: 0 ok (*data tbrpc_alloc-
+// compatible, caller frees with tbrpc_free; *len/*version filled), 1 not
+// published, 2 torn (descriptor stayed write-locked past the retry
+// budget — transient), 3 gone (window destroyed: unmap and stop trying).
+int tbrpc_oneside_read(void* reader, const char* name, void** data,
+                       uint64_t* len, uint64_t* version);
+// Descriptor-only probe (size + version, no payload touch): what a
+// caller allocates from before tbrpc_oneside_read_into.
+int tbrpc_oneside_stat(void* reader, const char* name, uint64_t* len,
+                       uint64_t* version);
+// Copy the committed payload into CALLER memory (`cap` bytes at `buf`)
+// — the large-tensor hot path: exactly one memcpy into a buffer whose
+// alignment and lifetime the caller controls. Statuses as read, plus
+// 4 = buffer too small (*len = needed size; reallocate and retry — the
+// payload was republished bigger between stat and read).
+int tbrpc_oneside_read_into(void* reader, const char* name, void* buf,
+                            uint64_t cap, uint64_t* len, uint64_t* version);
+int tbrpc_oneside_unmap(void* reader);
+// Process-wide counters + per-window reclamation state as JSON
+// ({"publishes","reads","read_retries","reads_torn","reclaims",
+// "reader_evictions","windows":[...]}). Copy-out convention.
+int64_t tbrpc_oneside_stats_json(char* buf, size_t cap);
+
 // ---- fleet: service registry (trpc/registry.h) ----
 // Install the in-process service registry: after this, EVERY server in the
 // process answers /registry/register, /registry/deregister and

@@ -90,6 +90,10 @@ const char* flight_event_type_name(uint16_t type) {
     case FLIGHT_TIMER_FIRE: return "TIMER_FIRE";
     case FLIGHT_HEALTH: return "HEALTH";
     case FLIGHT_BATCH_DISPATCH: return "BATCH_DISPATCH";
+    case FLIGHT_ONESIDE_PUBLISH: return "ONESIDE_PUBLISH";
+    case FLIGHT_ONESIDE_READ_BEGIN: return "ONESIDE_READ_BEGIN";
+    case FLIGHT_ONESIDE_READ_RETRY: return "ONESIDE_READ_RETRY";
+    case FLIGHT_ONESIDE_RECLAIM: return "ONESIDE_RECLAIM";
     default: return "UNKNOWN";
   }
 }

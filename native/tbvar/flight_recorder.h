@@ -48,6 +48,13 @@ enum FlightEventType : uint16_t {
   FLIGHT_TIMER_FIRE = 10,     // a = scheduled abstime_us, b = lateness_us
   FLIGHT_HEALTH = 11,         // a = old health state, b = new health state
   FLIGHT_BATCH_DISPATCH = 12, // a = socket id, b = messages in the batch
+  // One-sided publication/read lifecycle (ttpu/oneside.h): PUBLISH and
+  // RECLAIM record in the publisher process, READ_BEGIN/READ_RETRY in the
+  // reader — each side's /flightz explains its half of a race.
+  FLIGHT_ONESIDE_PUBLISH = 13,     // a = slot index, b = version
+  FLIGHT_ONESIDE_READ_BEGIN = 14,  // a = 0, b = pinned epoch
+  FLIGHT_ONESIDE_READ_RETRY = 15,  // a = slot index, b = retry attempt
+  FLIGHT_ONESIDE_RECLAIM = 16,     // a = range offset, b = range bytes
 };
 
 enum FlightRpcPhase : uint64_t {

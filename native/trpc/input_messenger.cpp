@@ -22,6 +22,23 @@ const auto* g_dispatch_batch_max = trpc::FlagRegistry::global().DefineInt(
     "Max parsed messages per dispatch fiber (1 = one fiber per message)",
     [](int64_t v) { return v >= 1 && v <= 1024; });
 
+// Doorbell-free polling mode for the batched input path: after a read
+// pass drains its fd (EAGAIN) with no handler left to run, the input
+// fiber keeps RE-POLLING the fd for this many microseconds instead of
+// releasing its claim and parking back into epoll. Back-to-back small
+// RPCs (a ping-pong client, a pipelined window) then skip the
+// doorbell-edge wakeup entirely — no epoll_wait, no dispatcher hop, no
+// fiber re-spawn between consecutive messages; on the tpu:// transport
+// the doorbell stream is consumed the moment it lands rather than when
+// its readiness edge schedules us. Costs one spinning worker pthread per
+// polled connection while armed, so it is an explicit low-latency
+// opt-in, never a default.
+const auto* g_input_poll_us = trpc::FlagRegistry::global().DefineInt(
+    "rpc_input_poll_us", 0,
+    "Busy-poll the fd this many us after each drained read pass "
+    "(doorbell-free wakeup for back-to-back small RPCs; 0 = off)",
+    [](int64_t v) { return v >= 0 && v <= 1000000; });
+
 // Dispatch-path instrumentation: batch-size distribution plus the
 // inline-vs-spawned split, all visible at /vars and /brpc_metrics.
 struct DispatchMetrics {
@@ -124,6 +141,10 @@ int64_t dispatch_batch_max() {
 }
 
 bool response_coalescing_enabled() { return dispatch_batch_max() > 1; }
+
+int64_t input_poll_us() {
+  return g_input_poll_us->load(std::memory_order_relaxed);
+}
 
 void InputMessenger::ProcessInline(Socket* s, InputMessageBase* msg) {
   // No dispatch accounting here: in-place messages (stream frames, inline
@@ -266,6 +287,7 @@ InputMessageBase* InputMessenger::OnNewMessages(Socket* s, int* defer_error) {
       break;
     }
     GlobalRpcMetrics::instance().bytes_in << nr;
+    s->NoteInputProgress(tbutil::cpuwide_time_us());
     while (true) {
       int proto_index = -1;
       ParseResult r = CutInputMessage(s, &proto_index);

@@ -76,4 +76,9 @@ int64_t dispatch_batch_max();
 // batched regime for interleaved A/B benching.
 bool response_coalescing_enabled();
 
+// Live value of rpc_input_poll_us (>= 0; 0 = doorbell-free input polling
+// off): how long Socket::ProcessEvent busy-polls its fd after a drained
+// read pass before handing the read claim back to epoll.
+int64_t input_poll_us();
+
 }  // namespace trpc

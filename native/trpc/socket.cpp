@@ -1043,6 +1043,30 @@ void Socket::ProcessEvent() {
     // on backpressure would block this connection's reads — on tpu://
     // including the credit frames the drain itself might wait for.
     coalesce.FlushDetached();
+    // Doorbell-free polling mode (rpc_input_poll_us): with the fd
+    // drained, nothing owed to a deferred handler and input seen less
+    // than poll_us ago, keep the read claim and re-poll instead of
+    // parking back into epoll — consecutive small RPCs skip the
+    // doorbell-edge wakeup (epoll_wait + dispatcher hop + fiber spawn)
+    // entirely. The budget is measured from the LAST byte that arrived,
+    // so a live ping-pong stream stays in the polled regime while an
+    // idle connection stops burning its worker after one window. `tail`
+    // taking a non-inline message ends the poll: running its handler
+    // beats shaving the next wakeup.
+    if (tail == nullptr && defer_error == 0 && !Failed() &&
+        messenger != nullptr) {
+      const int64_t poll_us = input_poll_us();
+      const int64_t last = last_input_us();
+      if (poll_us > 0 && last != 0 &&
+          tbutil::cpuwide_time_us() - last < poll_us) {
+        for (int i = 0; i < 64; ++i) {
+#if defined(__x86_64__) || defined(__i386__)
+          asm volatile("pause" ::: "memory");
+#endif
+        }
+        continue;  // re-run the read pass: the poll IS the next DoRead
+      }
+    }
     // If no new edges arrived while we read, hand the read claim back.
     if (_nevent.compare_exchange_strong(n, 0, std::memory_order_acq_rel,
                                         std::memory_order_acquire)) {

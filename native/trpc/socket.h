@@ -143,6 +143,15 @@ class Socket : public VersionedRefWithId<Socket> {
   // -- read path (called from the input fiber / messenger) --
   ssize_t DoRead(size_t size_hint);
   tbutil::IOPortal& read_buf() { return _read_buf; }
+  // Input-progress timestamp for the doorbell-free polling mode: the
+  // read loop stamps every pass that got bytes; ProcessEvent polls until
+  // the stamp ages past rpc_input_poll_us.
+  void NoteInputProgress(int64_t now_us) {
+    _last_input_us.store(now_us, std::memory_order_relaxed);
+  }
+  int64_t last_input_us() const {
+    return _last_input_us.load(std::memory_order_relaxed);
+  }
 
   // Ensure the client socket is connected (fiber-blocking; parks on the
   // epollout butex during a non-blocking connect). deadline_us on the
@@ -295,6 +304,11 @@ class Socket : public VersionedRefWithId<Socket> {
   std::atomic<bool> _close_after_write{false};
   tbthread::Butex* _epollout_butex;
   std::atomic<int> _nevent{0};  // pending read edges; input fiber active while > 0
+  // When input bytes last arrived (cpuwide us; 0 = never). Fed by the
+  // read loop, consumed by the doorbell-free polling mode
+  // (rpc_input_poll_us): ProcessEvent keeps busy-polling the fd until
+  // this falls poll_us behind now.
+  std::atomic<int64_t> _last_input_us{0};
   // Parsed messages handed to dispatch whose handlers have not returned
   // yet. A deferred EOF on a CLIENT socket waits for this to hit zero
   // before SetFailed — the respond-then-close race across two input

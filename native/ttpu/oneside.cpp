@@ -1,0 +1,640 @@
+#include "ttpu/oneside.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <set>
+
+#include "tbutil/fast_rand.h"
+#include "tbutil/json.h"
+#include "tbutil/logging.h"
+#include "tbvar/flight_recorder.h"
+#include "tbvar/reducer.h"
+#include "ttpu/tensor_arena.h"
+
+namespace ttpu {
+
+using namespace oneside_internal;
+
+namespace {
+
+#if defined(__x86_64__) || defined(__i386__)
+inline void cpu_relax() { asm volatile("pause" ::: "memory"); }
+#else
+inline void cpu_relax() { asm volatile("" ::: "memory"); }
+#endif
+
+// Descriptor-snapshot retry budget. Descriptor updates are a handful of
+// stores, so a torn seq normally resolves within a few spins; the long
+// tail is a not-owned slot held write-locked across an in-place payload
+// rewrite (BeginRewrite — serving KV pages mid-decode-step), where the
+// right answer IS "fall back to RPC for now". Escalate from pause to
+// yield so the budget spans ~a few ms without burning a core.
+constexpr int kReadRetryBudget = 2000;
+constexpr int kSpinBeforeYield = 64;
+
+// Process-wide accounting: /vars + /brpc_metrics names, and the backing
+// numbers of tbrpc_oneside_stats_json. Immortal like every tbvar.
+struct OnesideVars {
+  tbvar::Adder<int64_t> publishes;
+  tbvar::Adder<int64_t> reads;
+  tbvar::Adder<int64_t> read_retries;
+  tbvar::Adder<int64_t> reads_torn;
+  tbvar::Adder<int64_t> reclaims;
+  tbvar::Adder<int64_t> reader_evictions;  // dead-pid pins swept
+
+  static OnesideVars& instance() {
+    static OnesideVars* v = new OnesideVars;
+    return *v;
+  }
+
+ private:
+  OnesideVars() {
+    publishes.expose("oneside_publishes");
+    reads.expose("oneside_reads");
+    read_retries.expose("oneside_read_retries");
+    reads_torn.expose("oneside_reads_torn");
+    reclaims.expose("oneside_reclaims");
+    reader_evictions.expose("oneside_reader_evictions");
+  }
+};
+
+// Live windows, for the stats dump only (publish/read paths never take
+// this lock).
+struct WindowRegistry {
+  std::mutex mu;
+  std::set<OnesideWindow*> live;
+};
+WindowRegistry& window_registry() {
+  static WindowRegistry* r = new WindowRegistry;
+  return *r;
+}
+
+}  // namespace
+
+// ---------------- publisher ----------------
+
+std::shared_ptr<OnesideWindow> OnesideWindow::Create(
+    std::shared_ptr<TensorArena> arena, uint32_t n_slots,
+    uint32_t n_readers) {
+  if (arena == nullptr || n_slots == 0 || n_slots > 65536 ||
+      n_readers == 0 || n_readers > 4096) {
+    return nullptr;
+  }
+  const size_t need = window_bytes(n_slots, n_readers);
+  const int64_t off = arena->Alloc(need);
+  if (off < 0) {
+    TB_LOG(ERROR) << "oneside window: arena alloc(" << need << ") failed";
+    return nullptr;
+  }
+  auto win = std::shared_ptr<OnesideWindow>(new OnesideWindow);
+  win->_arena = std::move(arena);
+  win->_dir_off = static_cast<uint64_t>(off);
+  win->_n_slots = n_slots;
+  win->_n_readers = n_readers;
+  win->_token = tbutil::fast_rand();
+  if (win->_token == 0) win->_token = 1;  // 0 is the "unset" probe value
+  char* base = win->_arena->base() + win->_dir_off;
+  memset(base, 0, need);
+  // Placement-init the shared structures (atomics over zeroed shm).
+  auto* hdr = new (base) WindowHeader;
+  for (uint32_t i = 0; i < n_readers; ++i) {
+    new (base + sizeof(WindowHeader) + size_t(i) * sizeof(ReaderSlot))
+        ReaderSlot;
+    win->reader_slot(i)->in_epoch.store(kQuiescent,
+                                        std::memory_order_relaxed);
+  }
+  for (uint32_t i = 0; i < n_slots; ++i) {
+    new (base + sizeof(WindowHeader) + size_t(n_readers) * sizeof(ReaderSlot) +
+         size_t(i) * sizeof(PubSlot)) PubSlot;
+  }
+  win->_hdr = hdr;
+  hdr->epoch.store(1, std::memory_order_relaxed);
+  hdr->n_slots.store(n_slots, std::memory_order_relaxed);
+  hdr->n_readers.store(n_readers, std::memory_order_relaxed);
+  hdr->token.store(win->_token, std::memory_order_relaxed);
+  // Magic last, released: a racing reader validates against a fully
+  // initialized header or fails closed.
+  hdr->magic.store(kWindowMagic, std::memory_order_release);
+  {
+    WindowRegistry& r = window_registry();
+    std::lock_guard<std::mutex> lk(r.mu);
+    r.live.insert(win.get());
+  }
+  (void)OnesideVars::instance();  // expose the vars with the first window
+  return win;
+}
+
+OnesideWindow::~OnesideWindow() {
+  {
+    WindowRegistry& r = window_registry();
+    std::lock_guard<std::mutex> lk(r.mu);
+    r.live.erase(this);
+  }
+  // Fail readers closed FIRST: every later Read observes the dead magic
+  // and reports GONE (permanent fallback) instead of copying from ranges
+  // the frees below hand back to the allocator. A reader mid-copy keeps
+  // its own mapping (shm pages cannot vanish under it); its POST-copy
+  // magic re-check (Read/ReadInto) turns a copy that overlapped the
+  // teardown into GONE rather than a successful read of bytes the owner
+  // may already be reusing.
+  if (_hdr != nullptr) {
+    _hdr->magic.store(0, std::memory_order_release);
+  }
+  std::lock_guard<std::mutex> lk(_mu);
+  for (const auto& [name, pub] : _published) {
+    if (pub.owned) _arena->Free(pub.off);
+  }
+  for (const auto& r : _retired) {
+    _arena->Free(r.off);
+  }
+  _arena->Free(_dir_off);
+}
+
+PubSlot* OnesideWindow::slot(uint32_t i) const {
+  return reinterpret_cast<PubSlot*>(
+      _arena->base() + _dir_off + sizeof(WindowHeader) +
+      size_t(_n_readers) * sizeof(ReaderSlot) + size_t(i) * sizeof(PubSlot));
+}
+
+ReaderSlot* OnesideWindow::reader_slot(uint32_t i) const {
+  return reinterpret_cast<ReaderSlot*>(_arena->base() + _dir_off +
+                                       sizeof(WindowHeader) +
+                                       size_t(i) * sizeof(ReaderSlot));
+}
+
+int OnesideWindow::Publish(const std::string& name, uint64_t off,
+                           uint64_t len, uint64_t version,
+                           bool take_ownership) {
+  if (name.empty() || name.size() >= kNameCap) return -1;
+  if (len == 0 || off + len > _arena->bytes() || off + len < off) return -1;
+  std::lock_guard<std::mutex> lk(_mu);
+  uint32_t idx;
+  Pub* pub;
+  auto it = _published.find(name);
+  if (it != _published.end()) {
+    idx = it->second.slot;
+    pub = &it->second;
+  } else {
+    // First publication of this name: find an empty slot (slot count ==
+    // published-name count, so scanning for the first hole is exact).
+    idx = _n_slots;
+    for (uint32_t i = 0; i < _n_slots; ++i) {
+      if (slot(i)->name[0] == '\0') {
+        idx = i;
+        break;
+      }
+    }
+    if (idx == _n_slots) return -1;  // directory full
+    pub = &_published[name];
+    pub->slot = idx;
+  }
+  PubSlot* s = slot(idx);
+  // Seqlock write: odd while the descriptor fields are in motion. The
+  // payload bytes were written by the caller BEFORE this call; the final
+  // release store publishes them along with the descriptor.
+  uint64_t seq = s->seq.load(std::memory_order_relaxed);
+  if ((seq & 1) == 0) {
+    s->seq.store(seq + 1, std::memory_order_relaxed);
+    seq += 1;
+  }
+  std::atomic_thread_fence(std::memory_order_release);
+  s->version.store(version, std::memory_order_relaxed);
+  s->payload_off.store(off, std::memory_order_relaxed);
+  s->payload_len.store(len, std::memory_order_relaxed);
+  strncpy(s->name, name.c_str(), kNameCap - 1);
+  s->name[kNameCap - 1] = '\0';
+  s->seq.store(seq + 1, std::memory_order_release);
+
+  // Retire the displaced range (ownership transfer happens even when the
+  // new publish is not owned — each range's ownership was fixed at ITS
+  // publish time). Same-range republish (the in-place KV mode) retires
+  // nothing.
+  const bool had_range = it != _published.end();
+  if (had_range && pub->owned && pub->off != off) {
+    const uint64_t retire_epoch =
+        _hdr->epoch.load(std::memory_order_relaxed);
+    _retired.push_back({pub->off, pub->len, retire_epoch});
+    _hdr->epoch.fetch_add(1, std::memory_order_seq_cst);
+  }
+  pub->off = off;
+  pub->len = len;
+  pub->owned = take_ownership;
+  OnesideVars::instance().publishes << 1;
+  tbvar::flight_record(tbvar::FLIGHT_ONESIDE_PUBLISH, idx, version);
+  if (!_retired.empty()) ReclaimPassLocked();
+  return 0;
+}
+
+void OnesideWindow::BeginRewrite(const std::string& name) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _published.find(name);
+  if (it == _published.end()) return;
+  PubSlot* s = slot(it->second.slot);
+  const uint64_t seq = s->seq.load(std::memory_order_relaxed);
+  if ((seq & 1) == 0) {
+    // Release-ordered so a reader that STILL validates an even seq it
+    // read earlier cannot also have seen any of the caller's upcoming
+    // payload stores (its acquire fence pairs with this).
+    s->seq.store(seq + 1, std::memory_order_release);
+  }
+}
+
+int OnesideWindow::Unpublish(const std::string& name) {
+  std::lock_guard<std::mutex> lk(_mu);
+  auto it = _published.find(name);
+  if (it == _published.end()) return -1;
+  PubSlot* s = slot(it->second.slot);
+  uint64_t seq = s->seq.load(std::memory_order_relaxed);
+  if ((seq & 1) == 0) {
+    s->seq.store(seq + 1, std::memory_order_relaxed);
+    seq += 1;
+  }
+  std::atomic_thread_fence(std::memory_order_release);
+  s->name[0] = '\0';
+  s->payload_off.store(0, std::memory_order_relaxed);
+  s->payload_len.store(0, std::memory_order_relaxed);
+  s->seq.store(seq + 1, std::memory_order_release);
+  if (it->second.owned) {
+    _retired.push_back({it->second.off, it->second.len,
+                        _hdr->epoch.load(std::memory_order_relaxed)});
+    _hdr->epoch.fetch_add(1, std::memory_order_seq_cst);
+  }
+  _published.erase(it);
+  if (!_retired.empty()) ReclaimPassLocked();
+  return 0;
+}
+
+uint64_t OnesideWindow::min_pinned_epoch_locked() {
+  uint64_t min_pin = kQuiescent;
+  for (uint32_t i = 0; i < _n_readers; ++i) {
+    ReaderSlot* r = reader_slot(i);
+    const uint64_t pid = r->pid.load(std::memory_order_acquire);
+    if (pid == 0) continue;
+    const uint64_t e = r->in_epoch.load(std::memory_order_seq_cst);
+    if (e == kQuiescent) continue;
+    // A pin can only block reclamation forever if its owner is gone —
+    // sweep crash debris so a hard-killed reader never leaks retired
+    // ranges for the window's lifetime. (Pid reuse can evict a live
+    // reader's claim in theory; its reads then fail the slot-owner check
+    // and fall back to RPC — safe, just slower.)
+    if (kill(static_cast<pid_t>(pid), 0) != 0 && errno == ESRCH) {
+      r->in_epoch.store(kQuiescent, std::memory_order_relaxed);
+      r->pid.store(0, std::memory_order_release);
+      OnesideVars::instance().reader_evictions << 1;
+      continue;
+    }
+    if (e < min_pin) min_pin = e;
+  }
+  return min_pin;
+}
+
+void OnesideWindow::ReclaimPassLocked() {
+  const uint64_t min_pin = min_pinned_epoch_locked();
+  while (!_retired.empty()) {
+    // FIFO: retire epochs are monotone, so the head blocks the tail.
+    const Retired& r = _retired.front();
+    if (min_pin != kQuiescent && r.epoch >= min_pin) break;
+    _arena->Free(r.off);
+    tbvar::flight_record(tbvar::FLIGHT_ONESIDE_RECLAIM, r.off, r.len);
+    OnesideVars::instance().reclaims << 1;
+    _retired.pop_front();
+  }
+}
+
+int OnesideWindow::ReclaimPass() {
+  std::lock_guard<std::mutex> lk(_mu);
+  const size_t before = _retired.size();
+  ReclaimPassLocked();
+  return static_cast<int>(before - _retired.size());
+}
+
+std::string OnesideWindow::DescribeJson() const {
+  tbutil::JsonValue doc = tbutil::JsonValue::Object();
+  doc.set("shm", _arena->name());
+  doc.set("bytes", static_cast<int64_t>(_arena->bytes()));
+  doc.set("dir_off", static_cast<int64_t>(_dir_off));
+  // Tokens are random u64s; ship as a decimal string so no JSON consumer
+  // (or double-typed parser in between) can round it.
+  doc.set("token", std::to_string(_token));
+  doc.set("pid", static_cast<int64_t>(getpid()));
+  doc.set("slots", static_cast<int64_t>(_n_slots));
+  doc.set("readers", static_cast<int64_t>(_n_readers));
+  return doc.Dump();
+}
+
+int64_t OnesideWindow::retired_ranges() const {
+  std::lock_guard<std::mutex> lk(_mu);
+  return static_cast<int64_t>(_retired.size());
+}
+
+int64_t OnesideWindow::retired_bytes() const {
+  std::lock_guard<std::mutex> lk(_mu);
+  int64_t n = 0;
+  for (const auto& r : _retired) n += static_cast<int64_t>(r.len);
+  return n;
+}
+
+// ---------------- reader ----------------
+
+std::unique_ptr<OnesideReader> OnesideReader::Map(const std::string& shm_name,
+                                                  uint64_t bytes,
+                                                  uint64_t dir_off,
+                                                  uint64_t token) {
+  // The name is peer-controlled: constrain to the framework namespace
+  // (the MapPeer discipline — a descriptor can't map an unrelated shm
+  // object).
+  if (shm_name.rfind("/brpctpu_", 0) != 0 ||
+      shm_name.find('/', 1) != std::string::npos) {
+    return nullptr;
+  }
+  if (bytes == 0 || bytes > (1ULL << 32) ||
+      dir_off + sizeof(WindowHeader) > bytes ||
+      dir_off + sizeof(WindowHeader) < dir_off) {  // u64 wrap: a corrupt
+    return nullptr;  // descriptor must fall back, not wild-deref
+  }
+  int fd = shm_open(shm_name.c_str(), O_RDWR, 0600);
+  if (fd < 0) return nullptr;  // off-host / server gone: the fallback case
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(bytes)) {
+    close(fd);
+    return nullptr;
+  }
+  char* base = static_cast<char*>(
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0));
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  auto rd = std::unique_ptr<OnesideReader>(new OnesideReader);
+  rd->_base = base;
+  rd->_bytes = bytes;
+  auto* hdr = reinterpret_cast<WindowHeader*>(base + dir_off);
+  if (hdr->magic.load(std::memory_order_acquire) != kWindowMagic ||
+      hdr->token.load(std::memory_order_relaxed) != token) {
+    return nullptr;  // destructor unmaps
+  }
+  const uint32_t n_slots = hdr->n_slots.load(std::memory_order_relaxed);
+  const uint32_t n_readers = hdr->n_readers.load(std::memory_order_relaxed);
+  if (n_slots == 0 || n_slots > 65536 || n_readers == 0 ||
+      n_readers > 4096 ||
+      dir_off + window_bytes(n_slots, n_readers) > bytes) {
+    return nullptr;
+  }
+  rd->_hdr = hdr;
+  rd->_n_slots = n_slots;
+  // Claim a reader slot by pid (several readers in one process each take
+  // their own slot; pid is the liveness key the publisher's dead-reader
+  // sweep checks).
+  auto* slots = reinterpret_cast<ReaderSlot*>(base + dir_off +
+                                              sizeof(WindowHeader));
+  const uint64_t me = static_cast<uint64_t>(getpid());
+  for (uint32_t i = 0; i < n_readers; ++i) {
+    uint64_t expect = 0;
+    if (slots[i].pid.compare_exchange_strong(expect, me,
+                                             std::memory_order_acq_rel)) {
+      slots[i].in_epoch.store(kQuiescent, std::memory_order_release);
+      rd->_my = &slots[i];
+      return rd;
+    }
+  }
+  return nullptr;  // reader table full: fall back to RPC
+}
+
+OnesideReader::~OnesideReader() {
+  if (_my != nullptr) {
+    _my->in_epoch.store(kQuiescent, std::memory_order_release);
+    // Only release a claim that is still ours (the publisher's dead-pid
+    // sweep may have evicted us after a pid-reuse false positive).
+    uint64_t me = static_cast<uint64_t>(getpid());
+    _my->pid.compare_exchange_strong(me, 0, std::memory_order_acq_rel);
+  }
+  if (_base != nullptr) munmap(_base, _bytes);
+}
+
+PubSlot* OnesideReader::slot(uint32_t i) const {
+  const uint32_t n_readers = _hdr->n_readers.load(std::memory_order_relaxed);
+  return reinterpret_cast<PubSlot*>(
+      reinterpret_cast<char*>(_hdr) + sizeof(WindowHeader) +
+      size_t(n_readers) * sizeof(ReaderSlot) + size_t(i) * sizeof(PubSlot));
+}
+
+void OnesideReader::pin_epoch() {
+  // Standard epoch-pin loop: publish the pin, then re-check the global
+  // epoch — a publisher that advanced between our load and our store
+  // must either see the pin or have us re-pin at its new epoch
+  // (seq_cst on both sides makes the two-way race safe).
+  uint64_t e = _hdr->epoch.load(std::memory_order_acquire);
+  while (true) {
+    _my->in_epoch.store(e, std::memory_order_seq_cst);
+    const uint64_t e2 = _hdr->epoch.load(std::memory_order_seq_cst);
+    if (e2 == e) return;
+    e = e2;
+  }
+}
+
+void OnesideReader::unpin_epoch() {
+  _my->in_epoch.store(kQuiescent, std::memory_order_release);
+}
+
+int OnesideReader::LocateLocked(const std::string& name, uint64_t* off_out,
+                                uint64_t* len_out, uint64_t* ver_out) {
+  // Descriptor snapshot under the seqlock; any payload copy the caller
+  // makes afterwards runs outside it, protected by the epoch pin alone
+  // (a republish during the copy retires — never frees — the range
+  // being traversed, and the read still returns the consistent version
+  // it started with).
+  auto snapshot = [&](uint32_t idx) -> int {
+    // 1 = matched+consistent, 0 = name mismatch, -1 = torn budget spent
+    PubSlot* s = slot(idx);
+    for (int attempt = 0; attempt < kReadRetryBudget; ++attempt) {
+      const uint64_t s1 = s->seq.load(std::memory_order_acquire);
+      if ((s1 & 1) == 0) {
+        char nm[kNameCap];
+        memcpy(nm, s->name, kNameCap);
+        const uint64_t off = s->payload_off.load(std::memory_order_relaxed);
+        const uint64_t ln = s->payload_len.load(std::memory_order_relaxed);
+        const uint64_t ver = s->version.load(std::memory_order_relaxed);
+        std::atomic_thread_fence(std::memory_order_acquire);
+        if (s->seq.load(std::memory_order_relaxed) == s1) {
+          nm[kNameCap - 1] = '\0';
+          if (name != nm) return 0;
+          *off_out = off;
+          *len_out = ln;
+          *ver_out = ver;
+          return 1;
+        }
+      }
+      ++_retries;
+      OnesideVars::instance().read_retries << 1;
+      tbvar::flight_record(tbvar::FLIGHT_ONESIDE_READ_RETRY, idx, attempt);
+      if (attempt < kSpinBeforeYield) {
+        cpu_relax();
+      } else {
+        sched_yield();  // plain client pthread, never a fiber
+      }
+    }
+    return -1;
+  };
+  int hit = 0;
+  auto cached = _slot_cache.find(name);
+  if (cached != _slot_cache.end()) {
+    hit = snapshot(cached->second);
+    if (hit == 0) _slot_cache.erase(cached);  // name moved slots
+  }
+  if (hit == 0) {
+    for (uint32_t i = 0; i < _n_slots && hit == 0; ++i) {
+      hit = snapshot(i);
+      if (hit == 1) _slot_cache[name] = i;
+    }
+  }
+  return hit;
+}
+
+// Shared entry checks + pinned locate for the two copy-out paths.
+// Returns ONESIDE_OK with the epoch PINNED (caller must unpin), any
+// other status unpinned.
+int OnesideReader::ReadPrologue(const std::string& name, uint64_t* off,
+                                uint64_t* ln, uint64_t* ver) {
+  if (name.empty() || name.size() >= kNameCap) return ONESIDE_NOT_PUBLISHED;
+  if (_hdr->magic.load(std::memory_order_acquire) != kWindowMagic) {
+    return ONESIDE_GONE;  // window destroyed: permanent fallback
+  }
+  if (_my->pid.load(std::memory_order_acquire) !=
+      static_cast<uint64_t>(getpid())) {
+    return ONESIDE_GONE;  // our claim was swept (pid-reuse eviction)
+  }
+  pin_epoch();
+  tbvar::flight_record(tbvar::FLIGHT_ONESIDE_READ_BEGIN, 0,
+                       _my->in_epoch.load(std::memory_order_relaxed));
+  const int hit = LocateLocked(name, off, ln, ver);
+  if (hit != 1) {
+    unpin_epoch();
+    if (hit == -1) {
+      OnesideVars::instance().reads_torn << 1;
+      return ONESIDE_TORN;
+    }
+    return ONESIDE_NOT_PUBLISHED;
+  }
+  if (*ln == 0 || *off + *ln > _bytes || *off + *ln < *off) {
+    unpin_epoch();
+    return ONESIDE_NOT_PUBLISHED;  // defensive: malformed descriptor
+  }
+  return ONESIDE_OK;
+}
+
+int OnesideReader::Read(const std::string& name, void** data, uint64_t* len,
+                        uint64_t* version) {
+  *data = nullptr;
+  *len = 0;
+  *version = 0;
+  std::lock_guard<std::mutex> lk(_mu);  // one pin slot per handle
+  uint64_t off = 0, ln = 0, ver = 0;
+  const int st = ReadPrologue(name, &off, &ln, &ver);
+  if (st != ONESIDE_OK) return st;
+  void* out = malloc(ln);
+  if (out == nullptr) {
+    unpin_epoch();
+    return ONESIDE_TORN;  // treat as transient; caller falls back
+  }
+  memcpy(out, _base + off, ln);
+  // Post-copy liveness re-check: window destruction bypasses the epoch
+  // protocol (the destructor frees EVERYTHING), so a destroy racing this
+  // copy could have let the owner reuse the range mid-memcpy. The
+  // destructor zeroes magic BEFORE any free — a copy that completed
+  // while magic was still live copied bytes the allocator had not been
+  // given back.
+  if (_hdr->magic.load(std::memory_order_acquire) != kWindowMagic) {
+    unpin_epoch();
+    free(out);
+    return ONESIDE_GONE;
+  }
+  unpin_epoch();
+  *data = out;
+  *len = ln;
+  *version = ver;
+  ++_reads_ok;
+  OnesideVars::instance().reads << 1;
+  return ONESIDE_OK;
+}
+
+int OnesideReader::Stat(const std::string& name, uint64_t* len,
+                        uint64_t* version) {
+  *len = 0;
+  *version = 0;
+  std::lock_guard<std::mutex> lk(_mu);
+  if (name.empty() || name.size() >= kNameCap) return ONESIDE_NOT_PUBLISHED;
+  if (_hdr->magic.load(std::memory_order_acquire) != kWindowMagic) {
+    return ONESIDE_GONE;
+  }
+  // Descriptor-only: the seqlock alone makes the snapshot consistent;
+  // no payload is touched, so no epoch pin.
+  uint64_t off = 0;
+  const int hit = LocateLocked(name, &off, len, version);
+  if (hit == 1) return ONESIDE_OK;
+  if (hit == -1) {
+    OnesideVars::instance().reads_torn << 1;
+    return ONESIDE_TORN;
+  }
+  return ONESIDE_NOT_PUBLISHED;
+}
+
+int OnesideReader::ReadInto(const std::string& name, void* buf, uint64_t cap,
+                            uint64_t* len, uint64_t* version) {
+  *len = 0;
+  *version = 0;
+  std::lock_guard<std::mutex> lk(_mu);
+  uint64_t off = 0, ln = 0, ver = 0;
+  const int st = ReadPrologue(name, &off, &ln, &ver);
+  if (st != ONESIDE_OK) return st;
+  if (ln > cap) {
+    unpin_epoch();
+    *len = ln;  // the needed size: reallocate and retry
+    return ONESIDE_TOO_SMALL;
+  }
+  memcpy(buf, _base + off, ln);
+  // Same post-copy liveness re-check as Read: a destroy mid-copy must
+  // surface as GONE, never as a successful read of reused bytes.
+  if (_hdr->magic.load(std::memory_order_acquire) != kWindowMagic) {
+    unpin_epoch();
+    return ONESIDE_GONE;
+  }
+  unpin_epoch();
+  *len = ln;
+  *version = ver;
+  ++_reads_ok;
+  OnesideVars::instance().reads << 1;
+  return ONESIDE_OK;
+}
+
+// ---------------- stats ----------------
+
+std::string OnesideStatsJson() {
+  OnesideVars& v = OnesideVars::instance();
+  tbutil::JsonValue doc = tbutil::JsonValue::Object();
+  doc.set("publishes", v.publishes.get_value());
+  doc.set("reads", v.reads.get_value());
+  doc.set("read_retries", v.read_retries.get_value());
+  doc.set("reads_torn", v.reads_torn.get_value());
+  doc.set("reclaims", v.reclaims.get_value());
+  doc.set("reader_evictions", v.reader_evictions.get_value());
+  tbutil::JsonValue wins = tbutil::JsonValue::Array();
+  {
+    WindowRegistry& r = window_registry();
+    std::lock_guard<std::mutex> lk(r.mu);
+    for (OnesideWindow* w : r.live) {
+      tbutil::JsonValue e = tbutil::JsonValue::Object();
+      e.set("dir_off", static_cast<int64_t>(w->dir_off()));
+      e.set("retired_ranges", w->retired_ranges());
+      e.set("retired_bytes", w->retired_bytes());
+      wins.push_back(std::move(e));
+    }
+  }
+  doc.set("windows", std::move(wins));
+  return doc.Dump();
+}
+
+}  // namespace ttpu

@@ -1,0 +1,248 @@
+// One-sided tensor reads: memory-semantics pulls over published arena
+// windows — the data plane the RPC path cannot be ("RPC Considered
+// Harmful", PAPERS.md: DL data movement wants memory semantics, not
+// message semantics; fabric-lib's one-sided point-to-point design is the
+// shape).
+//
+// A server PUBLISHES committed tensor versions into seqlock-stamped
+// directory slots inside its TensorArena (already a shm segment any
+// same-host peer can map — the IciSegment/MapPeer machinery); a client
+// that mapped the window READS committed versions directly: no request
+// frame, no handler dispatch, no response frame. The publication protocol
+// splits protection in two:
+//
+//   * the seqlock protects the DESCRIPTOR (name/version/offset/length):
+//     a reader that catches a slot mid-republish retries the tiny
+//     descriptor snapshot (READ_RETRY flight events make the races
+//     diagnosable from dumps);
+//   * epoch-based reclamation protects the PAYLOAD BYTES: a republish
+//     retires the old range instead of freeing it, and the range returns
+//     to the arena allocator only once every mapped reader is quiescent
+//     or pinned at a LATER epoch — so a reader copying a 16MB tensor is
+//     never mid-copy over a range the allocator has handed to a new
+//     publication (the seqlock alone cannot give this: a DIFFERENT
+//     slot's publish reusing the freed range would rewrite bytes under a
+//     reader whose own slot's seq never moved).
+//
+// Readers register in a fixed slot table inside the window (claimed by
+// pid at map time); a hard-killed reader's pin is swept by the
+// publisher's reclaim pass (kill(pid, 0) == ESRCH), so crash debris can
+// not pin retired ranges forever. Cross-host safety: the descriptor a
+// server hands out carries a random 64-bit window token checked after
+// mapping — a stale or foreign shm name fails closed, and the caller
+// falls back to the two-sided Pull RPC.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+namespace ttpu {
+
+class TensorArena;
+
+// Read() statuses (capi mirrors them): anything but OK means "use the
+// two-sided RPC path for this name" — the fallback is the contract.
+enum OnesideReadStatus {
+  ONESIDE_OK = 0,
+  ONESIDE_NOT_PUBLISHED = 1,  // no committed slot carries this name
+  ONESIDE_TORN = 2,           // descriptor stayed write-locked past the
+                              // retry budget (republish storm)
+  ONESIDE_GONE = 3,           // window destroyed / token mismatch: unmap
+                              // and stop trying (permanent fallback)
+  ONESIDE_TOO_SMALL = 4,      // ReadInto only: caller buffer < payload
+                              // (*len carries the needed size; retry)
+};
+
+namespace oneside_internal {
+
+inline constexpr uint64_t kWindowMagic = 0x314E4957'45444953ULL;  // SIDEWIN1
+inline constexpr uint64_t kQuiescent = ~0ULL;
+inline constexpr uint32_t kNameCap = 56;  // incl. NUL
+
+// All shared-memory fields are lock-free atomics (address-free on this
+// platform), written through the owner's and readers' own mappings.
+struct WindowHeader {
+  std::atomic<uint64_t> magic;
+  std::atomic<uint64_t> token;
+  std::atomic<uint64_t> epoch;      // global reclamation epoch
+  std::atomic<uint32_t> n_slots;
+  std::atomic<uint32_t> n_readers;
+  char pad[32];
+};
+static_assert(sizeof(WindowHeader) == 64, "one cache line");
+
+struct ReaderSlot {
+  std::atomic<uint64_t> pid;       // 0 = free; claimed by reader pid
+  std::atomic<uint64_t> in_epoch;  // kQuiescent, or the epoch pinned by
+                                   // an in-progress read
+  char pad[48];                    // own cache line: readers spin here
+};
+static_assert(sizeof(ReaderSlot) == 64, "no false sharing between readers");
+
+struct PubSlot {
+  std::atomic<uint64_t> seq;  // seqlock: odd = mid-update
+  std::atomic<uint64_t> version;
+  std::atomic<uint64_t> payload_off;
+  std::atomic<uint64_t> payload_len;
+  char name[kNameCap];        // NUL-terminated; name[0]==0 = empty slot
+  char pad[40];
+};
+static_assert(sizeof(PubSlot) == 128, "two cache lines per publication");
+static_assert(std::atomic<uint64_t>::is_always_lock_free,
+              "oneside shm fields must be lock-free atomics");
+
+inline size_t window_bytes(uint32_t n_slots, uint32_t n_readers) {
+  return sizeof(WindowHeader) + size_t(n_readers) * sizeof(ReaderSlot) +
+         size_t(n_slots) * sizeof(PubSlot);
+}
+
+}  // namespace oneside_internal
+
+// Publisher side: owns the directory region (allocated from the arena)
+// and, for ranges published with take_ownership, the payload ranges. One
+// window per arena is the expected shape (the ParameterServer's service
+// arena); nothing enforces it.
+class OnesideWindow {
+ public:
+  // Allocates + initializes the directory inside `arena`. Null on
+  // failure (arena full / absurd sizes).
+  static std::shared_ptr<OnesideWindow> Create(
+      std::shared_ptr<TensorArena> arena, uint32_t n_slots = 256,
+      uint32_t n_readers = 64);
+  ~OnesideWindow();
+
+  // Publish `name` -> the payload already WRITTEN at [off, off+len) in
+  // the arena (framing is the caller's business; the ParameterServer
+  // stores the same self-describing [u32 meta-len|meta JSON|bytes] wire
+  // form the Pull RPC ships). With take_ownership (the default) the
+  // window owns the range from here on: the range published under this
+  // name before is RETIRED and arena-freed once no reader epoch can
+  // still observe it, and the caller must not free either range itself.
+  // take_ownership=false (serving KV pages: the session owns its plane)
+  // publishes without ever freeing — a republish of the same range just
+  // re-stamps the descriptor. len == 0 is invalid; use Unpublish.
+  // Returns 0, or -1 (bad name/range, slot table full).
+  int Publish(const std::string& name, uint64_t off, uint64_t len,
+              uint64_t version, bool take_ownership = true);
+  // Write-lock `name`'s slot (seq -> odd) so readers retry while the
+  // caller rewrites the payload IN PLACE (the not-owned KV mode); the
+  // next Publish of the name commits it. No-op for unknown names.
+  void BeginRewrite(const std::string& name);
+  // Empty the slot; the owned payload range (if any) retires as above.
+  int Unpublish(const std::string& name);
+  // Free retired ranges no longer observable by any reader pin, sweeping
+  // dead-pid reader slots first. Runs amortized from Publish; callable
+  // any time. Returns ranges freed.
+  int ReclaimPass();
+
+  // Descriptor for the mapping handshake (served to clients over an
+  // ordinary RPC): {"shm","bytes","dir_off","token","pid"}.
+  std::string DescribeJson() const;
+
+  uint64_t dir_off() const { return _dir_off; }
+  uint64_t token() const { return _token; }
+  int64_t retired_ranges() const;
+  int64_t retired_bytes() const;
+
+ private:
+  OnesideWindow() = default;
+  oneside_internal::PubSlot* slot(uint32_t i) const;
+  oneside_internal::ReaderSlot* reader_slot(uint32_t i) const;
+  uint64_t min_pinned_epoch_locked();  // sweeps dead pids; _mu held
+  void ReclaimPassLocked();
+
+  std::shared_ptr<TensorArena> _arena;
+  oneside_internal::WindowHeader* _hdr = nullptr;
+  uint64_t _dir_off = 0;
+  uint64_t _token = 0;
+  uint32_t _n_slots = 0;
+  uint32_t _n_readers = 0;
+
+  mutable std::mutex _mu;  // publisher bookkeeping (never on a fiber path)
+  struct Pub {
+    uint32_t slot = 0;
+    uint64_t off = 0;
+    uint64_t len = 0;
+    bool owned = false;
+  };
+  std::map<std::string, Pub> _published;
+  struct Retired {
+    uint64_t off = 0;
+    uint64_t len = 0;
+    uint64_t epoch = 0;  // freed once every pin is quiescent or > epoch
+  };
+  std::deque<Retired> _retired;
+};
+
+// Reader side: a same-host peer's mapping of a published window. NOT
+// tied to any socket/endpoint — that is the point.
+class OnesideReader {
+ public:
+  // Maps `shm_name` (the framework namespace only), validates size,
+  // magic and token, claims a reader slot. Null on any failure — the
+  // caller falls back to RPC.
+  static std::unique_ptr<OnesideReader> Map(const std::string& shm_name,
+                                            uint64_t bytes,
+                                            uint64_t dir_off,
+                                            uint64_t token);
+  ~OnesideReader();
+
+  // Copy out the committed payload published under `name`. On ONESIDE_OK
+  // fills *data (malloc'd, caller frees), *len, *version. The copy runs
+  // under this reader's epoch pin, so the publisher cannot reclaim the
+  // range mid-copy; the descriptor snapshot retries on a torn seq.
+  int Read(const std::string& name, void** data, uint64_t* len,
+           uint64_t* version);
+  // Descriptor-only snapshot (seqlock, no pin, no payload touch): the
+  // cheap size/version probe a caller uses to allocate before ReadInto.
+  int Stat(const std::string& name, uint64_t* len, uint64_t* version);
+  // Copy the committed payload into CALLER memory (`cap` bytes at
+  // `buf`) — the large-tensor hot path: exactly one memcpy, into a
+  // buffer whose alignment/lifetime the caller controls (a 64B-aligned
+  // numpy buffer the CPU backend can zero-copy-alias). Adds
+  // ONESIDE_TOO_SMALL when the committed payload outgrew `cap` between
+  // the caller's Stat and this call (*len = needed size; retry).
+  int ReadInto(const std::string& name, void* buf, uint64_t cap,
+               uint64_t* len, uint64_t* version);
+
+  int64_t reads_ok() const { return _reads_ok; }
+  int64_t retries() const { return _retries; }
+
+ private:
+  OnesideReader() = default;
+  oneside_internal::PubSlot* slot(uint32_t i) const;
+  void pin_epoch();
+  void unpin_epoch();
+  // Seqlock descriptor snapshot (cache + scan). 1 = found, 0 = not
+  // published, -1 = torn budget spent. Caller holds _mu.
+  int LocateLocked(const std::string& name, uint64_t* off, uint64_t* len,
+                   uint64_t* version);
+  // Checks + pin + locate for the copy-out paths; OK returns PINNED.
+  int ReadPrologue(const std::string& name, uint64_t* off, uint64_t* len,
+                   uint64_t* version);
+
+  char* _base = nullptr;
+  uint64_t _bytes = 0;
+  oneside_internal::WindowHeader* _hdr = nullptr;
+  oneside_internal::ReaderSlot* _my = nullptr;
+  uint32_t _n_slots = 0;
+  // One handle = one epoch-pin slot, so concurrent Reads through the
+  // SAME handle serialize (ctypes releases the GIL around the call, so
+  // two Python threads can really get here); separate handles stay
+  // fully concurrent.
+  std::mutex _mu;
+  std::map<std::string, uint32_t> _slot_cache;  // name -> last known idx
+  int64_t _reads_ok = 0;
+  int64_t _retries = 0;
+};
+
+// Process-wide stats for tbrpc_oneside_stats_json + the oneside_* native
+// adders: {"publishes","reads","read_retries","reclaims","fallbacks"...}.
+std::string OnesideStatsJson();
+
+}  // namespace ttpu

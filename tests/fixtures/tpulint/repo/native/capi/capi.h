@@ -71,5 +71,14 @@ int tbrpc_fix_stream_write(uint64_t stream_id, const void* data, size_t len,
 int tbrpc_fix_stream_read(uint64_t stream_id, int64_t timeout_ms,
                           void** data, size_t* len);
 int tbrpc_fix_sessionz_set_provider(tbrpc_fix_sessionz_cb cb, void* ctx);
+// One-sided-read surface shapes (mirror tbrpc_oneside_map /
+// tbrpc_oneside_read): a pointer-RETURNING entry point keyed by
+// uint64_t scalars, and a read whose out-params are uint64_t POINTERS —
+// pins that the parser keeps uint64_t* distinct from both the scalar
+// spelling and the other pointer out-param shapes (void**, size_t*).
+void* tbrpc_fix_oneside_map(const char* shm_name, uint64_t bytes,
+                            uint64_t dir_off, uint64_t token);
+int tbrpc_fix_oneside_read(void* reader, const char* name, void** data,
+                           uint64_t* len, uint64_t* version);
 
 }  // extern "C"

@@ -233,6 +233,14 @@ def test_wire_contract_capi_parses_async_abi(fixture_findings):
         "int64_t(void *, char *, size_t)")
     assert parsed["tbrpc_fix_sessionz_set_provider"] == (
         "int(tbrpc_fix_sessionz_cb, void *)")
+    # One-sided-read shapes: a pointer-returning map keyed by uint64_t
+    # SCALARS, and a read whose out-params are uint64_t POINTERS — the
+    # parser must keep uint64_t* distinct from both the scalar spelling
+    # and the void**/size_t* out-param shapes above.
+    assert parsed["tbrpc_fix_oneside_map"] == (
+        "void *(const char *, uint64_t, uint64_t, uint64_t)")
+    assert parsed["tbrpc_fix_oneside_read"] == (
+        "int(void *, const char *, void * *, uint64_t *, uint64_t *)")
 
 
 def test_wire_contract_capi_real_repo_lock_is_current():
