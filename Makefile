@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke train-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -39,6 +39,15 @@ obs-smoke:
 # skip cleanly there.
 serve-smoke:
 	python -m pytest tests/test_serving.py -q
+	python -m tools.tpulint
+
+# Fast local gate for the overlapped training step (the obs-smoke
+# analog): the pure scheduler units (topology, failure propagation,
+# serial==overlapped equivalence) plus — with the native lib present —
+# the overlapped-vs-serial parity drive over a live ParameterServer,
+# then lint. The native halves skip cleanly without the lib.
+train-smoke:
+	python -m pytest tests/test_step_overlap.py -q
 	python -m tools.tpulint
 
 # Slow-marked tests (the watchdog soak) are excluded here, same as

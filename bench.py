@@ -754,6 +754,22 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# serving_stream skipped: {e}", file=sys.stderr)
 
+    # Overlapped-training-step rows (step-driver tentpole): serial vs
+    # dependency-scheduled step on the RPC train loop. Headline config
+    # rides one-sided pulls (PR 11 composing with PR 12: wire-lane CPU
+    # stays low, so the wire is RTT/optimizer wait the compute hides);
+    # the _rpc variant shows the pure two-sided path.
+    try:
+        sweep.update(step_overlap_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# step overlap point skipped: {e}", file=sys.stderr)
+    try:
+        rpc = step_overlap_point(n_layers=8, dim=1024, batch=16, steps=5,
+                                 reps=4, oneside=False)
+        sweep["step_overlap_rpc"] = rpc["step_overlap"]
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# step overlap rpc point skipped: {e}", file=sys.stderr)
+
     # Pipelined parameter-server rows (async tensor RPC tentpole): 32x1MB
     # serial round-trips vs one bounded PipelineWindow, pull and push.
     try:
@@ -1239,6 +1255,138 @@ def param_pipeline_point(n_tensors=32, nbytes=1 << 20, window=8, reps=7,
     return out
 
 
+# Overlapped-vs-serial training step (the ISSUE 12 tentpole row). ONE
+# watchdogged child drives BOTH modes against one ParameterServer process
+# (the deployment shape: trainer process + server process), interleaving
+# serial/overlapped samples so adjacent drives see the same host-steal
+# state (PERF methodology, median of per-pair ratios). The step-time
+# breakdown (compute / exposed-comm / overlapped-comm) comes from the
+# driver's own RunTrace accounting — the acceptance shape is exposed-comm
+# shrinking while compute stays put. argv:
+#   n_layers dim batch steps reps oneside(0/1)
+_STEP_CHILD = r"""
+import json, statistics, sys, time, subprocess
+sys.path.insert(0, ROOT)
+# The overlapped step runs TWO Python threads (compute + wire lane); the
+# default 5ms GIL switch interval lets the wire thread's poll loops hold
+# the GIL in whole scheduler quanta while jax's Python dispatch starves —
+# a convoy that reads as inflated compute. 0.5ms keeps dispatch moving at
+# negligible switching cost (both modes get the same setting: fair A/B).
+sys.setswitchinterval(0.0005)
+
+n_layers, dim, batch, steps, reps, oneside = (int(a) for a in sys.argv[1:7])
+sizes = [dim] * (n_layers + 1)
+server_code = (
+    "import sys, json\n"
+    "sys.path.insert(0, %r)\n"
+    "from brpc_tpu.models.tensor_service import LayeredMLP\n"
+    "from brpc_tpu.runtime.param_server import ParameterServer\n"
+    "h = LayeredMLP(%r, seed=0)\n"
+    "ps = ParameterServer(dict(h.init_params()), oneside=%d)\n"
+    "print(json.dumps({'port': ps.start()}), flush=True)\n"
+    "sys.stdin.readline()\n"
+    "ps.stop()\n" % (ROOT, sizes, oneside))
+srv = subprocess.Popen([sys.executable, "-c", server_code],
+                       stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                       text=True)
+try:
+    port = json.loads(srv.stdout.readline())["port"]
+    from brpc_tpu.models.tensor_service import LayeredMLP
+    from brpc_tpu.runtime.param_server import ParameterClient
+    from brpc_tpu.runtime.step_driver import OverlappedStepDriver
+
+    h = LayeredMLP(sizes, seed=0)
+    drivers = {}
+    for mode in ("serial", "overlapped"):
+        cl = ParameterClient(f"tpu://127.0.0.1:{port}",
+                             oneside=bool(oneside))
+        d = OverlappedStepDriver(cl, h, overlap=(mode == "overlapped"),
+                                 window=4)
+        d.prime()
+        drivers[mode] = d
+    x, y = h.data(batch, seed=1)
+    for mode in ("serial", "overlapped"):  # warm: jit + channels + meta
+        for _ in range(2):
+            drivers[mode].step(x, y)
+
+    def drive(d):
+        stats = []
+        t0 = time.monotonic()
+        for _ in range(steps):
+            d.step(x, y)
+            stats.append(d.last_stats)
+        return time.monotonic() - t0, stats
+
+    samples = {"serial": [], "overlapped": []}
+    breakdown = {"serial": [], "overlapped": []}
+    ratios = []
+    for _ in range(reps):
+        ts, st_s = drive(drivers["serial"])
+        to, st_o = drive(drivers["overlapped"])
+        samples["serial"].append(ts)
+        samples["overlapped"].append(to)
+        breakdown["serial"].extend(st_s)
+        breakdown["overlapped"].extend(st_o)
+        ratios.append(ts / to)
+
+    def med(xs):
+        return statistics.median(xs)
+
+    row = {"speedup": round(med(ratios), 2),
+           "speedup_samples": [round(r, 2) for r in ratios],
+           "layers": n_layers, "dim": dim, "batch": batch,
+           "steps": steps, "reps": reps, "oneside": bool(oneside),
+           "param_bytes_per_layer": dim * dim * 4}
+    for mode in ("serial", "overlapped"):
+        t = med(samples[mode])
+        bd = breakdown[mode]
+        row[f"{mode}_steps_s"] = round(steps / t, 2)
+        row[f"{mode}_step_ms"] = round(t / steps * 1e3, 1)
+        row[f"{mode}_compute_ms"] = round(
+            med([s["compute_ms"] for s in bd]), 1)
+        row[f"{mode}_exposed_comm_ms"] = round(
+            med([s["exposed_comm_ms"] for s in bd]), 1)
+        row[f"{mode}_overlapped_comm_ms"] = round(
+            med([s["overlapped_comm_ms"] for s in bd]), 1)
+    for d in drivers.values():
+        d.client.close()
+    print(json.dumps({"step_overlap": row}))
+finally:
+    try:
+        srv.stdin.close()
+        srv.wait(timeout=10)
+    except Exception:
+        srv.kill()
+"""
+
+
+def step_overlap_point(n_layers=16, dim=512, batch=8, steps=6, reps=7,
+                       oneside=True, timeout=600):
+    """Serial vs overlapped step driver on the RPC train loop — the
+    overlapped-training-step tentpole row: end-to-end steps/s plus the
+    per-step compute / exposed-comm / overlapped-comm breakdown the
+    driver accounts itself. Subprocess-guarded like every bench point."""
+    code = "ROOT = %r\n%s" % (
+        os.path.dirname(os.path.abspath(__file__)), _STEP_CHILD)
+    proc = subprocess.run(  # tpulint: allow(py-blocking)
+        [sys.executable, "-c", code, str(n_layers), str(dim), str(batch),
+         str(steps), str(reps), "1" if oneside else "0"],
+        capture_output=True, timeout=timeout, text=True)
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(f"step overlap child failed rc={proc.returncode}")
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    row = rows["step_overlap"]
+    print(f"# step_overlap: serial {row['serial_steps_s']} steps/s -> "
+          f"overlapped {row['overlapped_steps_s']} steps/s "
+          f"({row['speedup']}x, samples {row['speedup_samples']}); "
+          f"exposed comm {row['serial_exposed_comm_ms']} -> "
+          f"{row['overlapped_exposed_comm_ms']} ms/step, compute "
+          f"{row['serial_compute_ms']} -> {row['overlapped_compute_ms']}"
+          " ms/step", file=sys.stderr)
+    return rows
+
+
 # Sharded-fleet rows. ONE watchdogged child orchestrates: an in-child
 # registry hub, one SUBPROCESS per shard (a shard shares nothing with the
 # client loop — same reasoning as _PARAM_CHILD, and exactly the deployment
@@ -1425,6 +1573,14 @@ def smoke() -> None:
             sizes=[[4096, "oneside_pull_4KB", 100]]))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["oneside_pull_4KB"] = {"error": str(e)}
+    # Guarded step-overlap mini-row: a 3-step overlapped-vs-serial drive
+    # — if the scheduled step stops overlapping (or the driver breaks),
+    # the smoke run shows it before the full sweep would.
+    try:
+        out.update(step_overlap_point(n_layers=4, dim=256, batch=8,
+                                      steps=3, reps=1, timeout=150))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["step_overlap"] = {"error": str(e)}
     # Guarded overload mini-row: a short protection-on/off A/B — if the
     # priority lanes stop protecting the control plane (HIGH p99 no longer
     # flat under bulk saturation), the smoke run shows it first.

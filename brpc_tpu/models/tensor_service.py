@@ -164,6 +164,133 @@ def make_sharded_train_step(mesh: Mesh):
     return jax.jit(sharded)
 
 
+# ---------------------------------------------------------------------------
+# RPC-driven sharded-step harness (ISSUE 12): the layered step the
+# overlapped driver schedules node by node.
+# ---------------------------------------------------------------------------
+
+def _layer_fwd(a: jax.Array, w: jax.Array, last: bool):
+    z = jnp.dot(a, w)
+    return (z if last else jax.nn.relu(z)), z
+
+
+def _loss_and_head_delta(pred: jax.Array, y: jax.Array):
+    r = pred - y
+    return jnp.mean(jnp.square(r)), (2.0 / r.size) * r
+
+
+_fwd_jit = jax.jit(_layer_fwd, static_argnames=("last",))
+_loss_jit = jax.jit(_loss_and_head_delta)
+
+
+@jax.jit
+def _grad_w(a_prev: jax.Array, delta: jax.Array) -> jax.Array:
+    # Contracts over the (possibly CLIENT-sharded) batch axis: under a
+    # dp mesh XLA lowers this to the gradient fan-in psum for free.
+    return jnp.dot(a_prev.T, delta)
+
+
+@jax.jit
+def _delta_prev(delta: jax.Array, w: jax.Array,
+                z_prev: jax.Array) -> jax.Array:
+    return jnp.dot(delta, w.T) * (z_prev > 0)
+
+
+class LayeredMLP:
+    """An L-layer MLP whose training step decomposes per layer — the
+    harness :class:`~brpc_tpu.runtime.step_driver.OverlappedStepDriver`
+    schedules: ``forward`` runs the whole stack saving activations, then
+    ``backward(ctx, name)`` is called TOP LAYER FIRST, yielding that
+    layer's weight gradient (and propagating the delta one layer down)
+    so the driver can push grad k while computing grad k-1.
+
+    ``mesh``: the dp+tp mesh of ``dryrun_multichip`` — batches shard
+    over CLIENT (dp), weights alternate column-/row-sharding over SHARD
+    (tp) exactly like ``PSState.w1``/``w2``; ``place()`` re-applies the
+    weight sharding to arrays the driver pulls off the wire, and the
+    per-layer matmuls lower to the same psum fan-ins the monolithic
+    sharded step uses (sequence parallelism — ring attention — rides the
+    same mesh one module over, ``ops/ring_attention``). ``mesh=None``
+    runs single-device. The manual per-layer backward matches
+    ``jax.grad`` of the same stack (pinned in tests), fp32 throughout.
+    """
+
+    def __init__(self, sizes, mesh: Mesh | None = None, seed: int = 0):
+        if len(sizes) < 2:
+            raise ValueError("need at least one layer (two sizes)")
+        self.sizes = list(sizes)
+        self.mesh = mesh
+        self.seed = seed
+        self.names = [f"layer{k:02d}" for k in range(len(sizes) - 1)]
+        self._spec = {}
+        if mesh is not None:
+            for k, name in enumerate(self.names):
+                self._spec[name] = (P(None, SHARD_AXIS) if k % 2 == 0
+                                    else P(SHARD_AXIS, None))
+
+    def init_params(self):
+        rng = jax.random.PRNGKey(self.seed)
+        params = {}
+        for k, name in enumerate(self.names):
+            rng, sub = jax.random.split(rng)
+            din, dout = self.sizes[k], self.sizes[k + 1]
+            w = jax.random.normal(sub, (din, dout), jnp.float32)
+            params[name] = self.place(name, w / np.sqrt(din))
+        return params
+
+    def data(self, batch: int, seed: int = 1):
+        """A (x, y) pair shaped for this stack (dp-sharded on a mesh)."""
+        kx, ky = jax.random.split(jax.random.PRNGKey(seed))
+        x = jax.random.normal(kx, (batch, self.sizes[0]), jnp.float32)
+        y = jax.random.normal(ky, (batch, self.sizes[-1]), jnp.float32)
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(CLIENT_AXIS, None))
+            x, y = jax.device_put(x, sh), jax.device_put(y, sh)
+        return x, y
+
+    def place(self, name: str, arr):
+        if self.mesh is None:
+            return arr
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, self._spec[name]))
+
+    def forward(self, params, x, y) -> dict:
+        acts, zs = [x], []
+        a = x
+        for k, name in enumerate(self.names):
+            a, z = _fwd_jit(a, params[name],
+                            last=(k == len(self.names) - 1))
+            zs.append(z)
+            acts.append(a)
+        loss, delta = _loss_jit(a, y)
+        return {"acts": acts, "zs": zs, "loss": loss, "delta": delta,
+                "params": dict(params), "next": len(self.names) - 1}
+
+    def backward(self, ctx: dict, name: str):
+        k = self.names.index(name)
+        if k != ctx["next"]:
+            raise ValueError(
+                f"backward order violated: expected layer {ctx['next']}"
+                f", got {name} — deltas propagate top-down only")
+        delta = ctx["delta"]
+        g = _grad_w(ctx["acts"][k], delta)
+        if k > 0:
+            ctx["delta"] = _delta_prev(delta, ctx["params"][name],
+                                       ctx["zs"][k - 1])
+        ctx["next"] = k - 1
+        return g
+
+    def loss(self, ctx: dict) -> float:
+        return float(ctx["loss"])
+
+    def grads(self, params, x, y):
+        """The whole gradient dict in one call (the serial reference the
+        parity tests compare the scheduled path against)."""
+        ctx = self.forward(params, x, y)
+        return {name: self.backward(ctx, name)
+                for name in reversed(self.names)}, float(ctx["loss"])
+
+
 def dryrun_multichip(n_devices: int) -> None:
     """Compile + run ONE sharded step on tiny shapes over an n-device mesh
     (the driver validates multi-chip sharding on a virtual CPU mesh)."""

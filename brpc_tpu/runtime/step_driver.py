@@ -1,0 +1,422 @@
+"""Overlapped training step driver — hide the tensor wire behind compute.
+
+The flagship training loop used to run compute -> gradient push ->
+next-step pull strictly serially, so every wire byte was exposed step
+time even though ``PipelineWindow`` (#83) already overlaps arena staging
+with the wire one level down. This driver lifts that overlap to the
+WHOLE step (PAPERS.md T3: fine-grained compute/communication overlap is
+where the step time hides): the step decomposes into per-tensor nodes
+
+    forward -> bwd:k (compute lane, top layer first)
+    bwd:k   -> push:k -> opt:k -> pull:k (wire lane)
+
+scheduled by the tier-1-pure :mod:`step_sched` core, so the gradient
+push of layer k (encode included — the PR 7 ``encoder=`` hook runs at
+arena-stage time on the wire lane) overlaps backward compute of the next
+layer, and next-step pulls overlap the server-side optimizer applies of
+the remaining pushes. Everything rides the EXISTING client machinery:
+pushes go through one bounded :class:`PipelineWindow` per step (async
+futures, submit-order replies, ``complete_one`` as the per-tensor
+confirm point), pulls through ``client.pull`` (one-sided reads when the
+client maps the server's window, quantized when negotiated, QoS-stamped,
+paced). ``overlap=False`` runs the SAME nodes serially on one thread —
+today's driver exactly, the A/B baseline.
+
+Failure semantics: a mid-step push failure cancels only its dependents,
+every other branch completes, and the step raises
+:class:`~brpc_tpu.runtime.param_server.PartialPushError` with the
+versions that DID land (``applied``) vs the names with no confirmed
+apply (``unpushed``) — re-pushing an applied gradient double-steps the
+server's momentum, so salvage must be per-name (the PR 7 discipline).
+
+Instrumented end to end: one ``train_step`` rpcz root span per step with
+a child span per node (wire-side spans carry the PipelineWindow's
+``arena_stage``/``wire_wait`` and the driver's ``encode`` stage
+annotations, so a trace VISIBLY shows push spans inside the next layer's
+compute span), plus ``step_exposed_comm_ms`` / ``step_overlapped_comm_ms``
+recorders on /vars (samples in milliseconds, as named).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.param_server import PartialPushError
+from brpc_tpu.runtime.step_sched import (COMPUTE, WIRE, StepFailure,
+                                         StepGraph, run_graph)
+from brpc_tpu.runtime.tensor import PipelineWindow
+
+_metrics_cache = None
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from brpc_tpu.observability import metrics as obs
+
+        _metrics_cache = {
+            # Full step wall time (us, the standard recorder unit).
+            "step": obs.latency("step_driver_step"),
+            # Samples are MILLISECONDS, as the names say: the step
+            # breakdown reads naturally next to wall-clock step times
+            # (a 1MB-scale step is tens of ms; us percentiles of comm
+            # slices would render as noise-width integers).
+            "exposed": obs.latency("step_exposed_comm_ms"),
+            "overlapped": obs.latency("step_overlapped_comm_ms"),
+            "steps": obs.counter("step_driver_steps"),
+            "partial": obs.counter("step_driver_partial_failures"),
+        }
+    return _metrics_cache
+
+
+class OverlappedStepDriver:
+    """Drive an RPC training loop over a layered harness.
+
+    ``client``: a :class:`ParameterClient` (pushes ride one
+    ``PipelineWindow`` per step over its channel) or any fleet-shaped
+    object with ``pull``/``push_grad``/``pull_all`` (pushes confirm
+    synchronously per name — the FleetClient path, where windowing lives
+    inside each shard stream).
+
+    ``harness`` protocol (see ``models.tensor_service.LayeredMLP``):
+      * ``names``: parameter names in FORWARD order;
+      * ``place(name, arr)``: apply the harness's sharding/placement;
+      * ``forward(params, x, y) -> ctx``;
+      * ``backward(ctx, name) -> grad`` (called top layer first);
+      * ``loss(ctx) -> float``.
+    """
+
+    def __init__(self, client, harness, overlap: bool = True,
+                 window: int = 4):
+        self.client = client
+        self.harness = harness
+        self.overlap = overlap
+        self.window = max(1, window)
+        self._params: Dict[str, object] = {}  # placed device arrays
+        self._raw: Dict[str, object] = {}     # pulled, not yet placed
+        self.versions: Dict[str, int] = {}    # last confirmed per name
+        self._m = _metrics()
+        self.last_stats: Optional[dict] = None
+        self.last_trace = None  # RunTrace of the last SUCCESSFUL step
+        self.totals = {"steps": 0, "wall_ms": 0.0, "compute_ms": 0.0,
+                       "wire_busy_ms": 0.0, "exposed_comm_ms": 0.0,
+                       "overlapped_comm_ms": 0.0}
+
+    # ---- setup ----
+
+    def prime(self) -> None:
+        """Fetch the full parameter set once (the step-0 pull the
+        overlap then amortizes into every later step's shadow)."""
+        got = self.client.pull_all(list(self.harness.names),
+                                   window=self.window)
+        for name, (version, arr) in got.items():
+            self._raw[name] = arr
+            self.versions[name] = version
+
+    def _note_push_error(self, e: "native.RpcError") -> None:
+        """The push-side healing every other push path runs on RpcError
+        (push_grad/push_all): overload answers feed the client's pacer,
+        and an undecodable-push / pre-codec-rollback answer drops the
+        stale codec advertisement so the NEXT step renegotiates (raw).
+        The driver still surfaces THIS step's failure to the caller —
+        healing changes what the retry sends, not whether this step
+        failed. Fleet-shaped clients run both hooks inside their own
+        push_grad, so the getattr guards just no-op there."""
+        pacer = getattr(self.client, "pacer", None)
+        if pacer is not None:
+            pacer.note(e)
+        heal = getattr(self.client, "_codec_push_failed", None)
+        if heal is not None:
+            heal(e)
+
+    # ---- one step ----
+
+    def step(self, x, y) -> float:
+        """One training step; returns the loss. Overlapped mode pulls
+        each parameter's NEXT version inside this step's shadow, so the
+        next call starts compute immediately."""
+        from brpc_tpu.observability import tracing
+
+        import jax
+
+        t0 = time.monotonic()
+        pacer = getattr(self.client, "pacer", None)
+        if pacer is not None:
+            pacer.pace()  # honor any shed-storm retry-after debt
+        names: List[str] = list(self.harness.names)
+        rev = list(reversed(names))
+        grads: Dict[str, object] = {}
+        step_versions: Dict[str, int] = {}
+        push_failed: Dict[str, BaseException] = {}
+        ctx_box: Dict[str, object] = {}
+        channel = getattr(self.client, "channel", None)
+
+        def on_push_reply(tag, payload, view):
+            view.release()  # push responses carry no tensor
+            step_versions[tag] = int(payload.decode())
+
+        win = (PipelineWindow(channel, self.window, on_reply=on_push_reply)
+               if channel is not None else None)
+        # PipelineWindow.submit counts no bytes itself — the push_all
+        # discipline: account per submit so the flagship loop's push
+        # volume shows on /vars like every other push path (the fleet
+        # path counts inside push_device).
+        from brpc_tpu.runtime.tensor import _metrics as _tensor_metrics
+        push_bytes = _tensor_metrics()["push_bytes"]
+
+        def traced(span_name, fn):
+            def run(done):
+                with tracing.trace_span(span_name):
+                    return fn(done)
+            return run
+
+        def fn_forward(done):
+            for name, arr in self._raw.items():
+                self._params[name] = self.harness.place(name, arr)
+            self._raw.clear()
+            ctx_box["ctx"] = self.harness.forward(self._params, x, y)
+            return None
+
+        def make_bwd(name):
+            def fn(done):
+                g = self.harness.backward(ctx_box["ctx"], name)
+                # Materialize here so compute time is attributed to the
+                # compute lane (and the wire lane's staging D2H reads a
+                # finished array instead of blocking on dispatch).
+                grads[name] = jax.block_until_ready(g)
+                return None
+            return fn
+
+        def drain_one_recording() -> bool:
+            """One complete_one() with per-tag failure recording — the
+            single home of the drain discipline (opt nodes, full-window
+            pre-drain, and the post-run late drain all ride it), so a
+            failed reply is always attributed to ITS tag and the stale-
+            advertisement / pacer healing hooks always run."""
+            try:
+                return win.complete_one()
+            except Exception as e:  # noqa: BLE001 — ANY reply failure
+                # (RpcError or a malformed-payload decode error from
+                # on_push_reply) belongs to the tag that produced it,
+                # never to whichever innocent node happened to drain.
+                tag = getattr(e, "pipeline_tag", None)
+                push_failed.setdefault(tag if tag is not None else "?", e)
+                if isinstance(e, native.RpcError):
+                    self._note_push_error(e)
+                return True
+
+        def make_push(name):
+            if win is not None:
+                def fn(done):
+                    # Drain a full window HERE (recording per tag), not
+                    # inside submit: submit's internal drain raises an
+                    # EARLIER push's reply error untagged out of THIS
+                    # node, failing an innocent layer and cancelling its
+                    # salvageable push.
+                    while win.inflight() >= win.window:
+                        if not drain_one_recording():
+                            break
+                    enc = self.client._grad_encoder(name)
+                    if enc is not None:
+                        enc = _staged_encode(enc)
+                    win.submit("ParamService/Push", array=grads[name],
+                               request=name.encode(), tag=name,
+                               encoder=enc)
+                    push_bytes.add(int(getattr(grads[name], "nbytes", 0)))
+                    return None
+            else:
+                def fn(done):
+                    step_versions[name] = self.client.push_grad(
+                        name, grads[name])
+                    return None
+            return fn
+
+        def make_opt(name):
+            def fn(done):
+                # Drain the window until THIS push's reply lands (the
+                # server applied its momentum step and bumped the
+                # version) — earlier-submitted replies deliver on the
+                # way, later pushes stay in flight. A failed drain is
+                # recorded against the tag it belongs to, so one bad
+                # push never mis-attributes its neighbours.
+                while (name not in step_versions
+                       and name not in push_failed and win is not None):
+                    if not drain_one_recording():
+                        break
+                if name in step_versions:
+                    self.versions[name] = step_versions[name]
+                    return step_versions[name]
+                err = push_failed.get(name)
+                if err is None:
+                    err = native.RpcError(
+                        2001, f"push reply for {name} never arrived")
+                raise err
+            return fn
+
+        def make_pull(name):
+            def fn(done):
+                version, arr = self.client.pull(name)
+                self._raw[name] = arr
+                self.versions[name] = version
+                return version
+            return fn
+
+        graph = StepGraph()
+        # Insertion order IS the serial schedule: forward, every
+        # backward, every push, every confirm, every pull — today's
+        # driver exactly when overlap=False.
+        graph.add("fwd", traced("step/fwd", fn_forward), lane=COMPUTE)
+        prev = "fwd"
+        for name in rev:
+            prev = graph.add(f"bwd:{name}",
+                             traced(f"step/bwd:{name}", make_bwd(name)),
+                             deps=(prev,), lane=COMPUTE)
+        for name in rev:
+            graph.add(f"push:{name}",
+                      traced(f"step/push:{name}", make_push(name)),
+                      deps=(f"bwd:{name}",), lane=WIRE)
+        for name in rev:
+            graph.add(f"opt:{name}",
+                      traced(f"step/opt:{name}", make_opt(name)),
+                      deps=(f"push:{name}",), lane=WIRE)
+        for name in rev:
+            graph.add(f"pull:{name}",
+                      traced(f"step/pull:{name}", make_pull(name)),
+                      deps=(f"opt:{name}",), lane=WIRE)
+
+        failure: Optional[StepFailure] = None
+        trace = None
+        with tracing.trace_span("train_step"):
+            tid, sid = tracing.current_trace()
+
+            @contextlib.contextmanager
+            def wire_ctx():
+                # Hand the step's trace context and the BULK QoS stamp
+                # across the wire-thread boundary (the FleetClient
+                # worker-thread discipline). In serial mode this wraps
+                # the caller's own thread: restore, don't clear.
+                had_t, had_s = tracing.current_trace()
+                if tid:
+                    tracing.set_trace(tid, sid)
+                qos = getattr(self.client, "_qos_bulk", None)
+                try:
+                    with (qos() if qos is not None
+                          else contextlib.nullcontext()):
+                        yield
+                finally:
+                    if tid:
+                        if had_t or had_s:
+                            tracing.set_trace(had_t, had_s)
+                        else:
+                            tracing.clear_trace()
+
+            try:
+                _results, trace = run_graph(graph, overlap=self.overlap,
+                                            wire_ctx=wire_ctx)
+            except StepFailure as sf:
+                failure = sf
+            except BaseException:
+                # Ctrl-C and friends: the scheduler aborted promptly —
+                # do NOT drain in-flight replies here (each blocks up
+                # to the channel timeout, and this path never uses the
+                # salvage data). Cancel and free the staged window
+                # instead; the wire thread is joined, so no concurrent
+                # access.
+                if win is not None:
+                    win.abort()
+                raise
+            # Late replies still count: a push whose confirm was
+            # cancelled may have landed server-side — drain the window
+            # so `applied` is accurate before salvage math (the wire
+            # thread is joined; no concurrent access).
+            if win is not None:
+                while drain_one_recording():
+                    pass
+            if failure is not None:
+                # The success path's pulls already recorded NEWER
+                # versions per name — only the failure path needs the
+                # late-drained confirms merged (never backwards).
+                for name, v in step_versions.items():
+                    self.versions[name] = max(
+                        self.versions.get(name, 0), v)
+            if trace is not None:
+                wall_ms = trace.wall_s * 1e3
+                exposed_ms = trace.exposed_wait_s * 1e3
+                overlapped_ms = trace.overlapped_comm_s() * 1e3
+                tracing.annotate(
+                    f"exposed_comm={int(exposed_ms * 1e3)}us")
+                tracing.annotate(
+                    f"overlapped_comm={int(overlapped_ms * 1e3)}us")
+                tracing.annotate(
+                    f"compute={int(trace.compute_busy_s * 1e6)}us")
+
+        if failure is not None:
+            raise self._salvage(failure, names, step_versions, push_failed)
+
+        if pacer is not None:
+            pacer.clear()  # a whole step landed: the server is admitting
+        loss = float(self.harness.loss(ctx_box["ctx"]))
+        stats = {
+            "loss": loss, "overlap": self.overlap,
+            "wall_ms": wall_ms,
+            "compute_ms": trace.compute_busy_s * 1e3,
+            "wire_busy_ms": trace.wire_busy_s * 1e3,
+            "exposed_comm_ms": exposed_ms,
+            "overlapped_comm_ms": overlapped_ms,
+        }
+        self.last_stats = stats
+        self.last_trace = trace
+        self.totals["steps"] += 1
+        for k in ("wall_ms", "compute_ms", "wire_busy_ms",
+                  "exposed_comm_ms", "overlapped_comm_ms"):
+            self.totals[k] += stats[k]
+        self._m["steps"].add(1)
+        self._m["step"].record_s(time.monotonic() - t0)
+        self._m["exposed"].record_us(int(exposed_ms))      # ms samples
+        self._m["overlapped"].record_us(int(overlapped_ms))  # ms samples
+        return loss
+
+    def _salvage(self, sf: StepFailure, names, step_versions,
+                 push_failed) -> BaseException:
+        """Map a StepFailure onto the per-name push salvage contract."""
+        wire_fail = {n: e for n, e in sf.failed.items()
+                     if n.startswith(("push:", "opt:"))}
+        if not wire_fail:
+            # Compute- or pull-side failure: nothing ambiguous about the
+            # pushes (they all confirmed or never started) — surface the
+            # original cause. Not a PARTIAL-push failure, so the
+            # counter stays put (operators alert on it).
+            return sf.cause
+        self._m["partial"].add(1)
+        cause = None
+        for e in list(wire_fail.values()) + list(push_failed.values()):
+            if isinstance(e, native.RpcError):
+                cause = e
+                break
+        if cause is None:
+            cause = native.RpcError(2001, str(next(iter(
+                wire_fail.values()))))
+        unpushed = [n for n in names if n not in step_versions]
+        err = PartialPushError(cause, dict(step_versions), unpushed)
+        err.step_failure = sf
+        return err
+
+    def run(self, batches) -> List[float]:
+        """Convenience loop: ``batches`` yields ``(x, y)`` pairs."""
+        return [self.step(x, y) for x, y in batches]
+
+
+def _staged_encode(enc):
+    """Wrap a gradient encoder so its quantize cost shows as an
+    ``encode`` stage on the push node's span — running at arena-stage
+    time on the wire lane, i.e. inside the next layer's compute shadow
+    (the PR 7 quantize-at-stage hook riding the overlap for free)."""
+    from brpc_tpu.observability import tracing
+
+    def run(host):
+        with tracing.stage("encode"):
+            return enc(host)
+    return run

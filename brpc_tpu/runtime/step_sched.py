@@ -1,0 +1,354 @@
+"""Dependency-tracked step scheduler — the tier-1-pure core of the
+overlapped training step (ISSUE 12).
+
+A training step decomposes into per-tensor nodes (forward, backward-k,
+push-k, optimizer-k, pull-k) with explicit dependencies; this module
+executes such a graph on exactly TWO lanes:
+
+  * ``compute`` nodes run on the CALLER's thread, in deterministic
+    priority order — jax dispatch must stay single-threaded (PR 6
+    measured concurrent ``device_put`` from N threads CONTENDING ~5x
+    instead of scaling), so everything that touches the device runs
+    where the caller already is;
+  * ``wire`` nodes run on ONE worker thread — RPC submissions, reply
+    drains and pulls, whose wall time is exactly what the overlap is
+    meant to hide behind the compute lane.
+
+``overlap=False`` runs every node on the caller's thread in insertion
+order instead — the serial A/B baseline, same nodes, same results, all
+wire time exposed.
+
+Scheduling is DETERMINISTIC: dependencies must already exist when a node
+is added (so the graph is a DAG by construction and insertion order is a
+valid topological order), and among ready nodes of a lane the
+lowest-insertion-index one runs first. Two runs of the same graph
+execute the same per-lane sequences; only the cross-lane interleaving
+varies with timing.
+
+Failure semantics (the no-deadlock contract): a node that raises marks
+itself failed, transitively CANCELS its dependents (they never run), and
+every independent branch keeps running to completion — partial salvage,
+the :class:`PartialPushError` discipline one level up. The run then
+raises :class:`StepFailure` carrying ``failed``/``cancelled``/``done``,
+with the wire thread always joined first.
+
+Pure Python on purpose: no native library, no jax — the topology,
+failure-propagation and serial==overlapped equivalence units run in
+tier-1 with nothing else installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+COMPUTE = "compute"
+WIRE = "wire"
+_LANES = (COMPUTE, WIRE)
+
+
+class Node:
+    """One schedulable unit: ``fn(done)`` receives the results-so-far
+    mapping (read-only by convention) and its return value becomes
+    ``results[name]``."""
+
+    __slots__ = ("name", "fn", "deps", "lane", "index")
+
+    def __init__(self, name: str, fn: Callable, deps: Tuple[str, ...],
+                 lane: str, index: int):
+        self.name = name
+        self.fn = fn
+        self.deps = deps
+        self.lane = lane
+        self.index = index
+
+
+class StepGraph:
+    """A DAG of named nodes. Dependencies must be added BEFORE their
+    dependents — cycles are impossible by construction and insertion
+    order doubles as the deterministic serial schedule."""
+
+    def __init__(self):
+        self._nodes: Dict[str, Node] = {}
+        self._order: List[Node] = []
+
+    def add(self, name: str, fn: Callable, deps=(), lane: str = COMPUTE
+            ) -> str:
+        if lane not in _LANES:
+            raise ValueError(f"unknown lane {lane!r} (use {_LANES})")
+        if name in self._nodes:
+            raise ValueError(f"duplicate node name {name!r}")
+        deps = tuple(deps)
+        for d in deps:
+            if d not in self._nodes:
+                raise ValueError(
+                    f"node {name!r} depends on unknown node {d!r} "
+                    "(dependencies must be added first)")
+        node = Node(name, fn, deps, lane, len(self._order))
+        self._nodes[name] = node
+        self._order.append(node)
+        return name
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._nodes
+
+    def nodes(self) -> List[Node]:
+        return list(self._order)
+
+    def serial_order(self) -> List[str]:
+        """The deterministic single-thread schedule (insertion order —
+        a valid topological order by the add-deps-first construction)."""
+        return [n.name for n in self._order]
+
+
+class StepFailure(RuntimeError):
+    """One or more nodes failed; every runnable branch still completed.
+
+    ``failed``: {node: exception}; ``cancelled``: nodes never run because
+    a transitive dependency failed; ``done``: {node: result} for the
+    salvaged branches. ``cause`` is the first failure in schedule order.
+    """
+
+    def __init__(self, failed: Dict[str, BaseException],
+                 cancelled: List[str], done: Dict[str, object]):
+        names = ", ".join(f"{n}: {e}" for n, e in failed.items())
+        super().__init__(f"{len(failed)} step node(s) failed ({names}); "
+                         f"{len(cancelled)} cancelled, {len(done)} done")
+        self.failed = failed
+        self.cancelled = cancelled
+        self.done = done
+        self.cause = next(iter(failed.values()))
+
+
+class RunTrace:
+    """Per-node execution record + the lane-time accounting the
+    step-breakdown metrics read.
+
+    ``events``: ``[(name, lane, start_s, end_s), ...]`` in completion
+    order (monotonic clock). ``wire_busy_s`` is total wire-lane node
+    time; ``exposed_wait_s`` is the time the CALLER's thread spent
+    blocked with no compute node ready (including the end-of-step join)
+    — the step's EXPOSED communication. Overlapped communication is
+    ``wire_busy_s - exposed_wait_s`` clamped at zero: wire time that ran
+    in compute's shadow.
+    """
+
+    def __init__(self, overlap: bool):
+        self.overlap = overlap
+        self.events: List[Tuple[str, str, float, float]] = []
+        self.wire_busy_s = 0.0
+        self.exposed_wait_s = 0.0
+        self.compute_busy_s = 0.0
+        self.wall_s = 0.0
+
+    def span(self, name: str) -> Optional[Tuple[float, float]]:
+        for n, _lane, s, e in self.events:
+            if n == name:
+                return (s, e)
+        return None
+
+    def overlapped(self, a: str, b: str) -> bool:
+        """True when node ``a``'s execution interval intersects ``b``'s
+        — the schedule-level proof two nodes really ran concurrently."""
+        sa, sb = self.span(a), self.span(b)
+        if sa is None or sb is None:
+            return False
+        return sa[0] < sb[1] and sb[0] < sa[1]
+
+    def overlapped_comm_s(self) -> float:
+        return max(0.0, self.wire_busy_s - self.exposed_wait_s)
+
+    def order(self) -> List[str]:
+        return [e[0] for e in sorted(self.events, key=lambda e: e[2])]
+
+
+def run_graph(graph: StepGraph, overlap: bool = True,
+              wire_ctx: Optional[Callable] = None
+              ) -> Tuple[Dict[str, object], RunTrace]:
+    """Execute ``graph``; returns ``(results, trace)`` or raises
+    :class:`StepFailure` (wire thread always joined first).
+
+    ``wire_ctx()`` (optional) must return a context manager; it is
+    entered around the whole wire lane — the driver hands the rpcz trace
+    context and the QoS stamp across the thread boundary through it (the
+    FleetClient worker-thread discipline). In serial mode it wraps the
+    whole run, so the A/B stamps identical wire metadata.
+    """
+    trace = RunTrace(overlap)
+    t_start = time.monotonic()
+    ctx = wire_ctx if wire_ctx is not None else contextlib.nullcontext
+    if not overlap:
+        try:
+            with ctx():
+                results = _run_serial(graph, trace)
+        finally:
+            trace.wall_s = time.monotonic() - t_start
+        return results, trace
+
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    done: Dict[str, object] = {}
+    failed: Dict[str, BaseException] = {}
+    cancelled: set = set()
+    ready: Dict[str, List[Node]] = {COMPUTE: [], WIRE: []}
+    pending = {n.name: len(n.deps) for n in graph.nodes()}
+    children: Dict[str, List[Node]] = {n.name: [] for n in graph.nodes()}
+    lane_total = {COMPUTE: 0, WIRE: 0}
+    lane_done = {COMPUTE: 0, WIRE: 0}
+    aborted = [False]
+    for n in graph.nodes():
+        lane_total[n.lane] += 1
+        for d in n.deps:
+            children[d].append(n)
+        if not n.deps:
+            ready[n.lane].append(n)
+
+    def _cancel_dependents_locked(name: str) -> None:
+        stack = list(children[name])
+        while stack:
+            c = stack.pop()
+            if c.name in done or c.name in failed or c.name in cancelled:
+                continue
+            cancelled.add(c.name)
+            lane_done[c.lane] += 1
+            stack.extend(children[c.name])
+
+    def _finish_locked(node: Node, result, exc) -> None:
+        lane_done[node.lane] += 1
+        if exc is not None:
+            failed[node.name] = exc
+            _cancel_dependents_locked(node.name)
+        else:
+            done[node.name] = result
+            for c in children[node.name]:
+                if c.name in cancelled:
+                    continue
+                pending[c.name] -= 1
+                if pending[c.name] == 0:
+                    ready[c.lane].append(c)
+        cond.notify_all()
+
+    def _pop_ready_locked(lane: str) -> Optional[Node]:
+        q = ready[lane]
+        if not q:
+            return None
+        best = min(range(len(q)), key=lambda i: q[i].index)
+        return q.pop(best)
+
+    def _run_lane(lane: str, count_wait: bool) -> None:
+        while True:
+            with lock:
+                # An abort (BaseException on the caller) stops the lane
+                # BEFORE the next node, not merely when the ready queue
+                # happens to drain — each wire completion readies the
+                # next push/confirm/pull in its chain, so checking only
+                # on empty would run the whole remaining wire schedule
+                # (blocking reply waits included) under a Ctrl-C.
+                node = None if aborted[0] else _pop_ready_locked(lane)
+                while node is None:
+                    if lane_done[lane] >= lane_total[lane] or aborted[0]:
+                        return
+                    t0 = time.monotonic()
+                    cond.wait()
+                    if count_wait:
+                        trace.exposed_wait_s += time.monotonic() - t0
+                    node = (None if aborted[0]
+                            else _pop_ready_locked(lane))
+            t0 = time.monotonic()
+            exc = result = None
+            try:
+                result = node.fn(done)
+            except Exception as e:  # noqa: BLE001 — failure IS the contract
+                exc = e
+            t1 = time.monotonic()
+            with lock:
+                trace.events.append((node.name, lane, t0, t1))
+                if lane == WIRE:
+                    trace.wire_busy_s += t1 - t0
+                else:
+                    trace.compute_busy_s += t1 - t0
+                _finish_locked(node, result, exc)
+
+    def _wire_main() -> None:
+        try:
+            with ctx():
+                _run_lane(WIRE, count_wait=False)
+        except BaseException as e:  # noqa: BLE001 — a dead wire lane
+            # must surface, never read as success: wire_ctx enter/exit
+            # raising (or a BaseException escaping a wire node) would
+            # otherwise leave every remaining wire node unrun with
+            # `failed` empty — run_graph would RETURN normally while
+            # zero pushes/pulls happened (and a graph with a compute
+            # node downstream of a wire node would hang in cond.wait).
+            with lock:
+                failed["<wire-lane>"] = e
+                for n in graph.nodes():
+                    if (n.lane == WIRE and n.name not in done
+                            and n.name not in failed
+                            and n.name not in cancelled):
+                        cancelled.add(n.name)
+                        lane_done[WIRE] += 1
+                        _cancel_dependents_locked(n.name)
+                cond.notify_all()
+
+    wire_thread = threading.Thread(target=_wire_main,
+                                   name="step-wire", daemon=True)
+    wire_thread.start()
+    try:
+        _run_lane(COMPUTE, count_wait=True)
+    except BaseException:
+        # KeyboardInterrupt & friends: stop handing out new nodes and
+        # get the wire thread back before unwinding — a daemon thread
+        # left touching a half-torn-down driver is a wedge.
+        with lock:
+            aborted[0] = True
+            cond.notify_all()
+        wire_thread.join()
+        raise
+    # The end-of-step barrier: whatever wire work is still running/queued
+    # is EXPOSED communication by definition — nothing computes under it.
+    t_join = time.monotonic()
+    wire_thread.join()
+    trace.exposed_wait_s += time.monotonic() - t_join
+    trace.wall_s = time.monotonic() - t_start
+    if failed:
+        raise StepFailure(failed, sorted(cancelled),
+                          dict(done))
+    return done, trace
+
+
+def _run_serial(graph: StepGraph, trace: RunTrace) -> Dict[str, object]:
+    done: Dict[str, object] = {}
+    failed: Dict[str, BaseException] = {}
+    cancelled: List[str] = []
+    dead: set = set()
+    for node in graph.nodes():
+        if any(d in failed or d in dead for d in node.deps):
+            dead.add(node.name)
+            cancelled.append(node.name)
+            continue
+        t0 = time.monotonic()
+        try:
+            result = node.fn(done)
+        except Exception as e:  # noqa: BLE001 — failure IS the contract
+            failed[node.name] = e
+            dead.add(node.name)
+            t1 = time.monotonic()
+        else:
+            done[node.name] = result
+            t1 = time.monotonic()
+        trace.events.append((node.name, node.lane, t0, t1))
+        if node.lane == WIRE:
+            trace.wire_busy_s += t1 - t0
+        else:
+            trace.compute_busy_s += t1 - t0
+    # Serial mode hides nothing: every wire second is exposed step time.
+    trace.exposed_wait_s = trace.wire_busy_s
+    if failed:
+        raise StepFailure(failed, cancelled, done)
+    return done

@@ -930,6 +930,28 @@ class PipelineWindow:
     def inflight(self) -> int:
         return len(self._q)
 
+    def complete_one(self) -> bool:
+        """Drain the OLDEST in-flight call only (delivering its reply
+        through ``on_reply`` / the collected results); ``False`` when
+        nothing is in flight. The overlapped step driver's per-tensor
+        confirm point: ``opt-k`` drains exactly until push k's reply
+        lands instead of flushing the whole window (which would serialize
+        every later push behind the first confirm). A failure carries the
+        failed call's tag as ``e.pipeline_tag`` so the caller can
+        attribute it per tensor (partial-salvage bookkeeping)."""
+        if not self._q:
+            return False
+        tag = self._q[0][0]
+        try:
+            self._complete_oldest()
+        except Exception as e:  # noqa: BLE001 — annotate and re-raise
+            try:
+                e.pipeline_tag = tag
+            except Exception:  # noqa: BLE001 — exotic immutable exception
+                pass
+            raise
+        return True
+
     def submit(self, service_method: str, array=None, request: bytes = b"",
                tag=None, encoder=None) -> None:
         """Stage ``array`` (optional) into the channel arena and start
