@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke train-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke train-smoke collectives-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -48,6 +48,15 @@ serve-smoke:
 # then lint. The native halves skip cleanly without the lib.
 train-smoke:
 	python -m pytest tests/test_step_overlap.py -q
+	python -m tools.tpulint
+
+# Fast local gate for the fleet-collectives plane (the obs-smoke
+# analog): the pure schedule/codec/EF/salvage units plus — with the
+# native lib present — the live ring/tree drives, PushQ parity and the
+# collective step driver, then lint. The native halves skip cleanly
+# without the lib.
+collectives-smoke:
+	python -m pytest tests/test_collectives.py -q
 	python -m tools.tpulint
 
 # Slow-marked tests (the watchdog soak) are excluded here, same as

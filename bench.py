@@ -791,6 +791,26 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# fleet point skipped: {e}", file=sys.stderr)
 
+    # Collective rows (fleet collectives tentpole): ring allreduce and
+    # allgather at 1/2/4 members, raw vs int8-per-hop — loopback truth
+    # first, then the WIRE-BOUND config (per-member uplink paced to a
+    # 1GbE-class 0.125 GB/s, where the byte cut must convert to time),
+    # plus the quantized-training convergence-parity row.
+    try:
+        sweep.update(collective_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# collective point skipped: {e}", file=sys.stderr)
+    try:
+        sweep.update(collective_point(counts=(2,), emu_gbps=0.125,
+                                      reps=5))
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# collective wirebound point skipped: {e}",
+              file=sys.stderr)
+    try:
+        sweep.update(collective_converge_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# collective converge point skipped: {e}", file=sys.stderr)
+
     # Tensor bridge rows (the chartered workload): jax/numpy arrays riding
     # the framework through TensorArena by-reference attachments.
     try:
@@ -1527,6 +1547,277 @@ def fleet_point(counts=(1, 2, 4), n_tensors=32, nbytes=1 << 20, reps=7,
     return rows
 
 
+# Collective rows (ISSUE 13): ring allreduce/allgather over the tensor
+# wire. ONE orchestrating child runs the registry hub and spawns one
+# member PROCESS per rank (the deployment shape — and jax dispatch from
+# member THREADS in one process contends, PR 6); members coordinate only
+# through the registry + the wire, exactly like a real fleet. Raw and
+# int8 groups alternate per rep (interleaved pairs, median-of-ratios —
+# the PERF.md discipline). argv: nbytes reps emu_gbps counts...
+_COLL_MEMBER = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, ROOT)
+import numpy as np
+from brpc_tpu.collectives.group import CollectiveGroup
+from brpc_tpu.observability import health
+
+hub, n, size, reps, emu = (sys.argv[1], int(sys.argv[2]),
+                           int(sys.argv[3]), int(sys.argv[4]),
+                           float(sys.argv[5]))
+health.start_watchdog(tempfile.mkdtemp(prefix="coll_bench_dumps_"))
+kw = dict(window=8, op_timeout_s=120.0)
+if emu > 0:
+    kw["emulate_wire_gbps"] = emu
+graw = CollectiveGroup(hub, tag="raw", **kw)
+gq = CollectiveGroup(hub, tag="q", codec="int8", **kw)
+graw.sync(expect=n, timeout_s=60)
+gq.sync(expect=n, timeout_s=60)
+x = np.random.RandomState(graw.rank).randn(size).astype(np.float32)
+xg = x[:size // 2]
+# Warmup: channels, Hello negotiation, arenas, the fused-encoder jit.
+graw.allreduce("w", x, algo="ring")
+gq.allreduce("w", x, algo="ring")
+gq.allreduce("w2", x, algo="ring")
+t_raw, t_q, t_agr, t_agq = [], [], [], []
+for i in range(reps):
+    t0 = time.monotonic()
+    graw.allreduce("r%d" % i, x, algo="ring")
+    t_raw.append(time.monotonic() - t0)
+    t0 = time.monotonic()
+    gq.allreduce("q%d" % i, x, algo="ring")
+    t_q.append(time.monotonic() - t0)
+for i in range(max(1, reps // 2)):
+    t0 = time.monotonic()
+    graw.allgather("gr%d" % i, xg)
+    t_agr.append(time.monotonic() - t0)
+    t0 = time.monotonic()
+    gq.allgather("gq%d" % i, xg)
+    t_agq.append(time.monotonic() - t0)
+print(json.dumps({"rank": graw.rank, "raw": t_raw, "q": t_q,
+                  "ag_raw": t_agr, "ag_q": t_agq}), flush=True)
+graw.close()
+gq.close()
+"""
+
+_COLL_CHILD = r"""
+import json, statistics, subprocess, sys, tempfile, time
+sys.path.insert(0, ROOT)
+from brpc_tpu.fleet import RegistryHub
+from brpc_tpu.observability import health
+
+nbytes, reps, emu = int(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3])
+counts = [int(a) for a in sys.argv[4:]]
+health.start_watchdog(tempfile.mkdtemp(prefix="coll_dumps_"))
+MEMBER = "ROOT = %r\n%s" % (ROOT, MEMBER_SRC)
+size = nbytes // 4
+hub = RegistryHub()
+hub.start()
+out = {}
+try:
+    for n in counts:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", MEMBER, hub.hostport, str(n),
+             str(size), str(reps), str(emu)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for _ in range(n)]
+        docs = []
+        try:
+            for p in procs:
+                so, se = p.communicate(timeout=420)
+                if p.returncode != 0 or not so.strip():
+                    sys.stderr.write(se[-1500:])
+                    raise RuntimeError("collective member failed")
+                docs.append(json.loads(so.strip().splitlines()[-1]))
+        finally:
+            # One member failing must not orphan its ring mates: they
+            # would sit against a dead op for up to op_timeout_s while
+            # the caller's retry spawns a SECOND member set on top.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        d = [x for x in docs if x["rank"] == 0][0]
+        med_raw = statistics.median(d["raw"])
+        med_q = statistics.median(d["q"])
+        ratios = sorted(a / b for a, b in zip(d["raw"], d["q"]))
+        row = {"members": n, "nbytes": nbytes, "reps": reps,
+               "raw_ms": round(med_raw * 1e3, 1),
+               "raw_GBps": round(nbytes / med_raw / 1e9, 3),
+               "quant_ms": round(med_q * 1e3, 1),
+               "quant_eff_GBps": round(nbytes / med_q / 1e9, 3),
+               "quant_vs_raw": round(statistics.median(ratios), 2),
+               "quant_vs_raw_samples": [round(r, 2) for r in ratios]}
+        if emu > 0:
+            row["emulated_wire_gbps"] = emu
+        out["allreduce_GBps_%ds" % n] = row
+        if n == max(counts) or (emu > 0 and n == counts[-1]):
+            agm_r = statistics.median(d["ag_raw"])
+            agm_q = statistics.median(d["ag_q"])
+            agr = sorted(a / b for a, b in zip(d["ag_raw"], d["ag_q"]))
+            ag = {"members": n, "nbytes": nbytes // 2,
+                  "raw_ms": round(agm_r * 1e3, 1),
+                  "raw_GBps": round((nbytes // 2) * (n - 1) / agm_r
+                                    / 1e9, 3) if n > 1 else 0.0,
+                  "quant_ms": round(agm_q * 1e3, 1),
+                  "quant_vs_raw": round(statistics.median(agr), 2),
+                  "quant_vs_raw_samples": [round(r, 2) for r in agr]}
+            if emu > 0:
+                ag["emulated_wire_gbps"] = emu
+            out["allgather_GBps"] = ag
+    print(json.dumps(out))
+finally:
+    hub.stop()
+"""
+
+
+def collective_point(counts=(1, 2, 4), nbytes=16 << 20, reps=5,
+                     emu_gbps=0.0, timeout=900):
+    """Ring allreduce/allgather rows: raw fp32 vs int8-quantized over
+    the live wire, one member process per rank, interleaved pairs,
+    median-of-ratios. ``emu_gbps`` > 0 runs the WIRE-BOUND config: each
+    member's uplink paced to that bandwidth (loopback shm moves bytes
+    at memcpy speed, which no cross-host fleet link does — the paced
+    link is where the byte cut must convert to time; the unpaced rows
+    report the loopback truth beside it)."""
+    code = ("ROOT = %r\nMEMBER_SRC = %r\n%s"
+            % (os.path.dirname(os.path.abspath(__file__)), _COLL_MEMBER,
+               _COLL_CHILD))
+    argv = [sys.executable, "-c", code, str(nbytes), str(reps),
+            str(emu_gbps)] + [str(c) for c in counts]
+    # One retry: the child is N jax member processes — a host-pressure
+    # window (steal/paging) can starve a hop past its op timeout once
+    # in a full sweep; a clean re-run distinguishes that from a real
+    # regression (the wedge-guard discipline).
+    for attempt in (0, 1):
+        proc = subprocess.run(  # tpulint: allow(py-blocking)
+            argv, capture_output=True, timeout=timeout, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            break
+        sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(f"collective child failed rc={proc.returncode}")
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    if emu_gbps > 0:
+        rows = {k + "_wirebound": v for k, v in rows.items()}
+    for key, row in rows.items():
+        print(f"# {key}: raw {row['raw_ms']} ms -> quant "
+              f"{row['quant_ms']} ms ({row['quant_vs_raw']}x, samples "
+              f"{row['quant_vs_raw_samples']})", file=sys.stderr)
+    return rows
+
+
+# Convergence-parity row: N-member data-parallel training where the
+# gradient exchange is the quantized collective — the trajectory must
+# track the fp32 reduction (EF on), with the naive requantizer as the
+# pinned negative control. Each member runs all three trajectories and
+# compares locally. argv: hub n steps
+_COLL_TRAIN_MEMBER = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, ROOT)
+import numpy as np
+from brpc_tpu.collectives.group import CollectiveGroup
+from brpc_tpu.models.tensor_service import LayeredMLP
+from brpc_tpu.runtime.step_driver import CollectiveStepDriver
+from brpc_tpu.observability import health
+
+hub, n, steps = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+health.start_watchdog(tempfile.mkdtemp(prefix="coll_train_dumps_"))
+SIZES = [64, 256, 256, 64]
+
+
+def trajectory(tag, codec, ef):
+    g = CollectiveGroup(hub, tag=tag, codec=codec, ef=ef, window=8,
+                        op_timeout_s=120.0)
+    g.sync(expect=n, timeout_s=60)
+    h = LayeredMLP(SIZES, seed=0)
+    d = CollectiveStepDriver(g, h, overlap=True, wire_lanes=2)
+    d.prime()
+    losses = []
+    for s in range(steps):
+        x, y = h.data(8, seed=700 + s * n + g.rank)
+        losses.append(d.step(x, y))
+    params = d.params()
+    g.close()
+    return losses, params
+
+
+l_raw, p_raw = trajectory("t_raw", None, True)
+l_qef, p_qef = trajectory("t_qef", "int8", True)
+l_qnv, p_qnv = trajectory("t_qnv", "int8", False)
+d_ef = max(float(np.abs(p_raw[k] - p_qef[k]).max()) for k in p_raw)
+d_nv = max(float(np.abs(p_raw[k] - p_qnv[k]).max()) for k in p_raw)
+print(json.dumps({"steps": steps,
+                  "loss_fp32": [round(x, 6) for x in l_raw],
+                  "loss_quant_ef": [round(x, 6) for x in l_qef],
+                  "max_param_delta_ef": d_ef,
+                  "max_param_delta_naive": d_nv}), flush=True)
+"""
+
+_COLL_TRAIN_CHILD = r"""
+import json, subprocess, sys, tempfile, time
+sys.path.insert(0, ROOT)
+from brpc_tpu.fleet import RegistryHub
+from brpc_tpu.observability import health
+
+n, steps = int(sys.argv[1]), int(sys.argv[2])
+health.start_watchdog(tempfile.mkdtemp(prefix="coll_train_dumps_"))
+MEMBER = "ROOT = %r\n%s" % (ROOT, MEMBER_SRC)
+hub = RegistryHub()
+hub.start()
+try:
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", MEMBER, hub.hostport, str(n), str(steps)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for _ in range(n)]
+    docs = []
+    try:
+        for p in procs:
+            so, se = p.communicate(timeout=420)
+            if p.returncode != 0 or not so.strip():
+                sys.stderr.write(se[-1500:])
+                raise RuntimeError("collective train member failed")
+            docs.append(json.loads(so.strip().splitlines()[-1]))
+    finally:
+        for p in procs:  # never orphan ring mates (see _COLL_CHILD)
+            if p.poll() is None:
+                p.kill()
+    d = docs[0]
+    d["members"] = n
+    d["tolerance"] = 5e-2
+    d["ef_within_tolerance"] = bool(d["max_param_delta_ef"] < 5e-2)
+    d["naive_vs_ef"] = round(d["max_param_delta_naive"]
+                             / max(d["max_param_delta_ef"], 1e-12), 1)
+    print(json.dumps(d))
+finally:
+    hub.stop()
+"""
+
+
+def collective_converge_point(n=2, steps=6, timeout=600):
+    """Training-trajectory parity: quantized-EF allreduce vs the fp32
+    reduction on the LayeredMLP loop (documented 5e-2 tolerance), naive
+    requantizer reported beside it as the negative control."""
+    code = ("ROOT = %r\nMEMBER_SRC = %r\n%s"
+            % (os.path.dirname(os.path.abspath(__file__)),
+               _COLL_TRAIN_MEMBER, _COLL_TRAIN_CHILD))
+    for attempt in (0, 1):  # host-pressure retry, see collective_point
+        proc = subprocess.run(  # tpulint: allow(py-blocking)
+            [sys.executable, "-c", code, str(n), str(steps)],
+            capture_output=True, timeout=timeout, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            break
+        sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(
+            f"collective converge child failed rc={proc.returncode}")
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    print(f"# collective_converge: EF delta "
+          f"{row['max_param_delta_ef']:.2e} (tol 5e-2, ok="
+          f"{row['ef_within_tolerance']}), naive "
+          f"{row['max_param_delta_naive']:.2e} "
+          f"({row['naive_vs_ef']}x worse)", file=sys.stderr)
+    return {"collective_converge": row}
+
+
 def smoke() -> None:
     """`make bench-smoke`: a <=10s-scale sanity sweep — one subprocess-
     guarded 64B echo sample plus a 4x1MB pipelined pull point — usable as
@@ -1597,6 +1888,15 @@ def smoke() -> None:
                                               wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["serving_stream"] = {"error": str(e)}
+    # Guarded collective mini-row: one 2-member 4MB raw-vs-int8 ring
+    # allreduce pair — if the ring schedule, the per-hop codec, or the
+    # member wiring breaks, the smoke run shows it before the full
+    # sweep would (wedges become watchdog dumps in the child).
+    try:
+        out.update(collective_point(counts=(2,), nbytes=4 << 20, reps=1,
+                                    timeout=240))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["allreduce_GBps_2s"] = {"error": str(e)}
     if wedges:
         out["wedged_samples"] = wedges
     print(json.dumps({"metric": "bench_smoke", "sweep": out}))

@@ -1,0 +1,46 @@
+"""Fleet collectives: ring/tree allreduce + allgather over the tensor
+wire (ISSUE 13).
+
+Layering (pure -> wire):
+
+  * :mod:`~brpc_tpu.collectives.ring` — chunk spans and hop schedules,
+    pure arithmetic;
+  * :mod:`~brpc_tpu.collectives.quant` — per-chunk per-hop quantization
+    with cross-step error feedback (EQuARX's dequant/reduce/requant);
+  * :mod:`~brpc_tpu.collectives.core` — transport-agnostic algorithms +
+    mailbox + the per-chunk-salvage failure contract;
+  * :mod:`~brpc_tpu.collectives.group` — :class:`CollectiveGroup`, the
+    registry-membered, per-peer-channeled, QoS/overload/trace-integrated
+    real thing.
+"""
+
+from brpc_tpu.collectives.core import (CollectiveAborted,  # noqa: F401
+                                       CollectiveTimeout, E_COLL_ABORT,
+                                       E_COLL_EPOCH, Mailbox, MemberLeft,
+                                       ring_allgather, ring_allreduce,
+                                       tree_allreduce)
+from brpc_tpu.collectives.quant import ChunkCodec  # noqa: F401
+from brpc_tpu.collectives.ring import (allgather_steps,  # noqa: F401
+                                       chunk_spans, owned_chunk,
+                                       reduce_order, reduce_scatter_steps,
+                                       ring_order)
+
+__all__ = [
+    "CollectiveAborted", "CollectiveTimeout", "MemberLeft", "Mailbox",
+    "ChunkCodec", "CollectiveGroup", "collective_metrics",
+    "E_COLL_ABORT", "E_COLL_EPOCH",
+    "ring_allreduce", "ring_allgather", "tree_allreduce",
+    "chunk_spans", "ring_order", "owned_chunk", "reduce_order",
+    "reduce_scatter_steps", "allgather_steps",
+]
+
+
+def __getattr__(name):
+    # CollectiveGroup pulls in the RPC stack (param_server -> jax);
+    # lazy-load it so the pure schedule/codec layers import with nothing
+    # but numpy (the tier-1-unit contract).
+    if name in ("CollectiveGroup", "collective_metrics"):
+        from brpc_tpu.collectives import group as _g
+
+        return getattr(_g, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -137,6 +137,33 @@ class PassiveGauge:
         self.name = name
 
 
+class NullSeries:
+    """No-op stand-in for a Counter/LatencyRecorder when the native
+    library is absent (same read surface) — the ONE implementation of
+    the tier-1 metrics shim: planes that must import pure (serving,
+    collectives) build their recorder dicts from this instead of each
+    re-inventing it. Importing THIS module never loads the native lib;
+    only constructing the real series does."""
+
+    def record_s(self, *_a) -> None: ...
+
+    def record_us(self, *_a) -> None: ...
+
+    def add(self, *_a) -> None: ...
+
+    def count(self) -> int:
+        return 0
+
+    def p99(self) -> int:
+        return 0
+
+    def qps(self) -> int:
+        return 0
+
+    def value(self) -> int:
+        return 0
+
+
 # ---- get-or-create registry ----
 
 _mu = threading.Lock()

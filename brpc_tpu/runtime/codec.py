@@ -308,6 +308,13 @@ class ErrorFeedback:
     def settle(self, name: str, x: np.ndarray, dq: np.ndarray) -> None:
         self._residual[name] = x - dq
 
+    def set_residual(self, name: str, res: np.ndarray) -> None:
+        """``settle`` for callers whose encoder already produced the
+        residual (the collectives' fused kernel computes x - dq in the
+        same XLA program as the quantization — re-deriving it here
+        would cost the two passes the fusion saved)."""
+        self._residual[name] = res
+
     def clear(self, name: str) -> None:
         self._residual.pop(name, None)
 

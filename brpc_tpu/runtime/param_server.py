@@ -32,7 +32,7 @@ import numpy as np
 
 from brpc_tpu.ops.fused_update import fused_momentum_update
 from brpc_tpu.runtime import codec as codec_mod
-from brpc_tpu.runtime import native
+from brpc_tpu.runtime import groupwire, native
 from brpc_tpu.runtime.tensor import (E_UNDECODABLE, OnesideGone, OnesideMiss,
                                      OnesideReader, OnesideWindow,
                                      PipelineWindow, TensorArena,
@@ -197,6 +197,9 @@ def _metrics():
             # pulls beside the per-tensor path.
             "pull_group": obs.latency("param_server_pull_group"),
             "push": obs.latency("param_server_push"),
+            # PushQ applies up to _GROUP updates per sample — its own
+            # recorder for the same reason pull_group has one.
+            "push_group": obs.latency("param_server_push_group"),
             "push_bytes": obs.counter("param_server_push_bytes"),
             "lag": obs.gauge("param_server_version_lag", _max_version_lag),
         }
@@ -372,8 +375,11 @@ class ParameterServer:
             # priority/tenant wire fields ONLY after seeing it, so an
             # upgraded client never sends a meta a pre-QoS parser would
             # reject.
+            # "pushq": grouped quantized pushes served here (the PullQ
+            # write-side twin) — advertised like the codec so a client
+            # never sends a method an older build lacks.
             doc = {"epoch": epoch, "params": meta, "qos": 1,
-                   "codecs": list(self._codecs)}
+                   "codecs": list(self._codecs), "pushq": 1}
             # One-sided advertisement (the codec/QoS negotiation
             # discipline): clients ask for the window descriptor only
             # after seeing it, so a pre-oneside server never receives an
@@ -389,6 +395,8 @@ class ParameterServer:
             return json.dumps({"epoch": epoch}).encode(), None
         if method == "PullQ":
             return self._handle_pull_group(request)
+        if method == "PushQ":
+            return self._handle_push_group(request, att, tracing)
         if method == "Oneside":
             # The mapping handshake: ONE ordinary RPC hands out the
             # window descriptor; every read after it is memory-semantics.
@@ -575,6 +583,63 @@ class ParameterServer:
         self._m["pull_group"].record_s(time.monotonic() - t0)
         return (json.dumps({"tensors": entries}).encode(),
                 WireTensor(None, b"", placed=placed))
+
+    def _handle_push_group(self, request: bytes, att, tracing):
+        """PushQ: one RPC carrying MANY gradient pushes — the write-side
+        twin of PullQ (PR 7's named leftover, retired here). The client
+        concatenates its quantized gradients behind a groupwire manifest;
+        this handler slices the attachment per entry and applies each
+        update exactly like a per-tensor Push would (same QuantizedView
+        decode, same per-name update locks and admission semaphore, same
+        version bumps), answering a manifest of per-name results.
+
+        Per-name salvage is the whole point: a moved/undecodable name
+        answers ``{"name", "code", "error"}`` in the results instead of
+        failing its groupmates — re-pushing an APPLIED gradient is not
+        idempotent (double momentum step), so a client must learn
+        exactly which names landed.
+        """
+        t0 = time.monotonic()
+        man = groupwire.parse_group(request)
+        payload = None
+        if att is not None:
+            payload = np.ascontiguousarray(att).reshape(-1).view(np.uint8)
+        try:
+            pairs = list(groupwire.split_group(man, payload))
+        except ValueError as ve:
+            raise native.RpcError(E_UNDECODABLE,
+                                  f"undecodable push group: {ve}")
+        results = []
+        for entry, run in pairs:
+            name = entry.get("name", "?")
+            try:
+                if "codec" in entry:
+                    grad = codec_mod.QuantizedView(entry, run)
+                    logical = grad.nbytes
+                else:
+                    grad = run.view(np.dtype(entry["dtype"])).reshape(
+                        tuple(entry["shape"]))
+                    logical = int(grad.nbytes)
+                self._update_sem.acquire()
+                try:
+                    version = self._apply_update(name, grad, tracing)
+                finally:
+                    self._update_sem.release()
+                self._m["push_bytes"].add(logical)
+                results.append({"name": name, "version": version})
+            except native.RpcError as e:
+                results.append({"name": name, "code": e.code,
+                                "error": e.text})
+            except ValueError as ve:
+                # Corrupt entry (size mismatch, unknown codec): the
+                # E_UNDECODABLE discipline, per name — groupmates after
+                # it still apply.
+                results.append({
+                    "name": name, "code": E_UNDECODABLE,
+                    "error": f"undecodable tensor payload for {name}: "
+                             f"{ve}"})
+        self._m["push_group"].record_s(time.monotonic() - t0)
+        return json.dumps({"results": results}).encode(), None
 
     # ---- one-sided publication (memory-semantics pulls) ----
 
@@ -872,6 +937,11 @@ class ParameterClient:
         self._meta_cache: Optional[dict] = None
         self._codec = codec
         self._srv_codecs: Optional[tuple] = None  # unknown until Meta
+        # PushQ advertisement (grouped quantized pushes): False until the
+        # server's Meta carried "pushq": 1 — a PR 7-era server decodes
+        # quantized per-tensor pushes but has no PushQ method, so the
+        # method itself is negotiated separately from the codec.
+        self._srv_pushq = False
         self._ef = codec_mod.ErrorFeedback()
         # Overload protection: the tenant id this client's requests carry
         # (the server's per-tenant quota key; "" falls back to peer ip
@@ -953,6 +1023,7 @@ class ParameterClient:
         self._srv_codecs = tuple(doc.get("codecs", ()))
         self._srv_qos = bool(doc.get("qos", 0))
         self._srv_oneside = bool(doc.get("oneside", 0))
+        self._srv_pushq = bool(doc.get("pushq", 0))
         return doc["params"]
 
     def epoch(self) -> int:
@@ -1019,6 +1090,23 @@ class ParameterClient:
             self.meta()
         except Exception:  # noqa: BLE001 — keep the original error
             pass
+
+    def _pushq_failed(self, e: "native.RpcError") -> bool:
+        """A grouped push that died E_NO_SUCH may mean the server rolled
+        back to a pre-PushQ build (PR 7-era: quantized per-tensor pushes
+        fine, no PushQ method) — per-NAME misses ride the result
+        manifest, so a group-level E_NO_SUCH is the method itself.
+        Re-read the advertisement once (the _codec_pull_failed
+        discipline); True = PushQ is gone and the caller should retry
+        per-tensor (still quantized if the codec survives)."""
+        if e.code != E_NO_SUCH or not self._srv_pushq:
+            return False
+        self._srv_codecs = None  # force a FULL Meta re-read (see
+        try:                     # negotiated_codec on epoch reuse)
+            self.meta()
+        except Exception:  # noqa: BLE001 — keep the original error
+            return False
+        return not self._srv_pushq
 
     def _codec_pull_failed(self, e: "native.RpcError") -> bool:
         """A NEGOTIATED pull that died E_NO_SUCH may mean the server was
@@ -1515,39 +1603,159 @@ class ParameterClient:
         self.pacer.clear()
         return out
 
-    def push_all(self, grads: Dict[str, object], window: int = 4
-                 ) -> Dict[str, int]:
+    def push_all(self, grads: Dict[str, object], window: int = 4,
+                 group: int = 8) -> Dict[str, int]:
         """Push many gradients through one bounded pipeline window.
 
         -> ``{name: new_version}``. Staging (D2H + arena memcpy) of
-        gradient k+1 overlaps the wire transfer of gradient k; the client
-        arena never holds more than ``window`` staged gradients.
+        gradient k+1 overlaps the wire transfer of gradient k; the
+        client arena never holds more than ``window`` staged gradients.
+
+        Raw (no negotiated codec): one Push RPC per tensor —
+        byte-identical to the pre-codec wire. Negotiated codec against a
+        PushQ-advertising server: eligible gradients quantize (with
+        error feedback) into groups of ``group`` per PushQ RPC — the
+        codec cuts each ~4x, which leaves the per-RPC fixed cost
+        dominating a per-tensor stream, the same second lever PullQ is
+        on the read side (PERF round 9). Per-name results ride the
+        response manifest; a moved/undecodable name raises
+        :class:`PartialPushError` with its groupmates' confirmed
+        versions in ``applied``.
         """
-        from brpc_tpu.runtime.tensor import _metrics
+        from brpc_tpu.runtime.tensor import _as_host_array, _metrics
         m = _metrics()
         versions: Dict[str, int] = {}
+        per_name_err: Dict[str, native.RpcError] = {}
+        c = self.negotiated_codec()
+        use_group = c is not None and self._srv_pushq and group > 1
 
-        def on_reply(name, payload, view):
+        def on_reply(tag, payload, view):
             view.release()  # push responses carry no tensor
-            versions[name] = int(payload.decode())
+            if isinstance(tag, tuple):
+                doc = json.loads(payload.decode())
+                for r in doc["results"]:
+                    if "error" in r:
+                        per_name_err[r["name"]] = native.RpcError(
+                            int(r["code"]), r["error"])
+                    else:
+                        versions[r["name"]] = int(r["version"])
+            else:
+                versions[tag] = int(payload.decode())
 
         self.pacer.pace()
         try:
             with self._qos_bulk(), PipelineWindow(
                     self.channel, window, on_reply=on_reply) as win:
-                for name, grad in grads.items():
-                    win.submit("ParamService/Push", array=grad,
-                               request=name.encode(), tag=name,
-                               encoder=self._grad_encoder(name))
-                    m["push_bytes"].add(int(getattr(grad, "nbytes", 0)))
+                if not use_group:
+                    for name, grad in grads.items():
+                        win.submit("ParamService/Push", array=grad,
+                                   request=name.encode(), tag=name,
+                                   encoder=self._grad_encoder(name))
+                        m["push_bytes"].add(
+                            int(getattr(grad, "nbytes", 0)))
+                else:
+                    # Split by METADATA (dtype/nbytes — no D2H needed),
+                    # then materialize host copies one group slice at a
+                    # time: an up-front copy of every gradient would
+                    # hold a full host replica of the model where the
+                    # per-tensor path never stages more than `window`
+                    # tensors. Ineligible tensors ride per-tensor raw
+                    # in the SAME window so they still pipeline (submit
+                    # does their D2H, window-bounded).
+                    names = list(grads)
+
+                    def _predict(g) -> bool:
+                        try:
+                            return (np.dtype(getattr(g, "dtype", None))
+                                    == np.float32
+                                    and int(getattr(g, "nbytes", 0))
+                                    >= codec_mod.MIN_QUANT_BYTES)
+                        except TypeError:
+                            return False
+
+                    grouped = [n for n in names if _predict(grads[n])]
+                    gset = set(grouped)
+                    for name in names:
+                        if name in gset:
+                            continue
+                        self._ef.clear(name)  # raw hop: nothing owed
+                        win.submit("ParamService/Push",
+                                   array=grads[name],
+                                   request=name.encode(), tag=name)
+                        m["push_bytes"].add(
+                            int(getattr(grads[name], "nbytes", 0)))
+                    for i in range(0, len(grouped), group):
+                        gnames = grouped[i:i + group]
+                        entries, blobs = [], []
+                        for n in gnames:
+                            host = _as_host_array(grads[n])
+                            x = self._ef.compensate(n, host)
+                            e = codec_mod.encode(x, c)
+                            if e is None:  # raced ineligible: raw
+                                self._ef.clear(n)
+                                win.submit("ParamService/Push",
+                                           array=host,
+                                           request=n.encode(), tag=n)
+                                m["push_bytes"].add(host.nbytes)
+                                continue
+                            self._ef.settle(n, x, e.dequantized())
+                            codec_mod.note(n, c, e.logical_bytes,
+                                           e.wire_bytes)
+                            entries.append(
+                                {"name": n, "dtype": host.dtype.str,
+                                 "shape": list(host.shape),
+                                 "codec": c, "block": e.block})
+                            blobs.append(e.wire)
+                            m["push_bytes"].add(host.nbytes)
+                        if entries:
+                            manifest, concat = groupwire.pack_group(
+                                entries, blobs)
+                            win.submit("ParamService/PushQ",
+                                       array=concat, request=manifest,
+                                       tag=tuple(e["name"]
+                                                 for e in entries))
         except native.RpcError as e:
             self.pacer.note(e)
             self._codec_push_failed(e)
+            group_tagged = isinstance(getattr(e, "pipeline_tag", None),
+                                      tuple)
+            if group_tagged and self._pushq_failed(e):
+                # Pre-PushQ rollback: the method is gone, the names are
+                # fine — re-push the unconfirmed stragglers per-tensor
+                # (renegotiated; still quantized if the codec survived)
+                # and merge, keeping every confirmed version.
+                rem = {n: grads[n] for n in grads if n not in versions}
+                try:
+                    versions.update(self.push_all(rem, window=window,
+                                                  group=group))
+                except PartialPushError as pe:
+                    raise PartialPushError(
+                        pe, {**versions, **pe.applied},
+                        pe.unpushed) from pe
+                except native.RpcError as re2:
+                    if versions:
+                        raise PartialPushError(
+                            re2, dict(versions),
+                            [n for n in rem if n not in versions]
+                        ) from re2
+                    raise
+                return versions
             if versions:
                 raise PartialPushError(
                     e, dict(versions),
                     [n for n in grads if n not in versions]) from e
             raise
+        if per_name_err:
+            # Per-name refusals from the result manifest (moved mid-
+            # reshard, undecodable): surface the PartialPush salvage —
+            # and run the stale-advertisement heal for undecodable
+            # answers exactly like a per-tensor push would.
+            cause = next(iter(per_name_err.values()))
+            for err in per_name_err.values():
+                self._codec_push_failed(err)
+            raise PartialPushError(
+                cause, dict(versions),
+                [n for n in grads if n not in versions])
         self.pacer.clear()
         return versions
 

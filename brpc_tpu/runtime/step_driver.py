@@ -52,6 +52,35 @@ from brpc_tpu.runtime.tensor import PipelineWindow
 _metrics_cache = None
 
 
+def _trace_handoff_ctx(tid: int, sid: int, qos=None):
+    """The wire-lane context factory both drivers hand to ``run_graph``:
+    each lane's thread inherits the step's rpcz trace context (and,
+    when ``qos`` — a zero-arg context-manager factory — is given, the
+    BULK QoS stamp: the FleetClient worker-thread discipline). Restore,
+    don't clear, on exit: in serial mode this wraps the CALLER's own
+    thread, whose ambient context must survive the step."""
+
+    @contextlib.contextmanager
+    def wire_ctx():
+        from brpc_tpu.observability import tracing
+
+        had_t, had_s = tracing.current_trace()
+        if tid:
+            tracing.set_trace(tid, sid)
+        try:
+            with (qos() if qos is not None
+                  else contextlib.nullcontext()):
+                yield
+        finally:
+            if tid:
+                if had_t or had_s:
+                    tracing.set_trace(had_t, had_s)
+                else:
+                    tracing.clear_trace()
+
+    return wire_ctx
+
+
 def _metrics():
     global _metrics_cache
     if _metrics_cache is None:
@@ -291,27 +320,8 @@ class OverlappedStepDriver:
         trace = None
         with tracing.trace_span("train_step"):
             tid, sid = tracing.current_trace()
-
-            @contextlib.contextmanager
-            def wire_ctx():
-                # Hand the step's trace context and the BULK QoS stamp
-                # across the wire-thread boundary (the FleetClient
-                # worker-thread discipline). In serial mode this wraps
-                # the caller's own thread: restore, don't clear.
-                had_t, had_s = tracing.current_trace()
-                if tid:
-                    tracing.set_trace(tid, sid)
-                qos = getattr(self.client, "_qos_bulk", None)
-                try:
-                    with (qos() if qos is not None
-                          else contextlib.nullcontext()):
-                        yield
-                finally:
-                    if tid:
-                        if had_t or had_s:
-                            tracing.set_trace(had_t, had_s)
-                        else:
-                            tracing.clear_trace()
+            wire_ctx = _trace_handoff_ctx(
+                tid, sid, qos=getattr(self.client, "_qos_bulk", None))
 
             try:
                 _results, trace = run_graph(graph, overlap=self.overlap,
@@ -406,6 +416,196 @@ class OverlappedStepDriver:
 
     def run(self, batches) -> List[float]:
         """Convenience loop: ``batches`` yields ``(x, y)`` pairs."""
+        return [self.step(x, y) for x, y in batches]
+
+
+class CollectiveStepDriver:
+    """Data-parallel training where the gradient exchange is a ring
+    allreduce over a :class:`~brpc_tpu.collectives.group.CollectiveGroup`
+    instead of N point-to-point pushes into a parameter server (ISSUE
+    13): every member holds the full parameter set locally, computes
+    gradients on its own batch shard, and each layer's exchange is an
+    ``allreduce:k`` node scheduled on a NAMED wire lane —
+
+        forward -> bwd:k (compute lane, top layer first)
+        bwd:k   -> allreduce:k (lane ``wire:ar<k % wire_lanes>``)
+        allreduce:k -> opt:k (compute lane: the local momentum update)
+
+    A collective hop BLOCKS waiting for its ring predecessor, so one
+    wire thread would serialize layer k+1's collective behind layer k's
+    waits; per-peer wire lanes (the :mod:`step_sched` generalization —
+    PR 12's named leftover) let reduction hops of layer k hide behind
+    layer k+1's backward AND behind each other. ``overlap=False`` runs
+    the same nodes serially — the A/B baseline.
+
+    The optimizer math is the ParameterServer CPU path's exactly
+    (copy-on-write numpy momentum step), so a collective-trained
+    trajectory is comparable to the parameter-server one; ``ef=False``
+    on the group is the naive-requantizer negative control the
+    convergence tests pin.
+
+    Failure: a hop failure (member left, timeout) cancels exactly that
+    layer's ``opt:k`` while every other layer completes (partial
+    salvage across lanes); the step raises the triggering
+    :class:`~brpc_tpu.collectives.core.CollectiveAborted` with the full
+    graph post-mortem on ``.step_failure`` — the caller re-``sync()``\\ s
+    the group and resumes on the surviving ring.
+    """
+
+    def __init__(self, group, harness, overlap: bool = True,
+                 wire_lanes: int = 2, lr: float = 0.01,
+                 momentum: float = 0.9, average: bool = True):
+        self.group = group
+        self.harness = harness
+        self.overlap = overlap
+        self.wire_lanes = max(1, wire_lanes)
+        self.lr = lr
+        self.momentum = momentum
+        self.average = average
+        self._params: Dict[str, object] = {}   # numpy fp32 masters
+        self._momenta: Dict[str, object] = {}
+        self._m = _metrics()
+        self.last_stats: Optional[dict] = None
+        self.last_trace = None
+        self.totals = {"steps": 0, "wall_ms": 0.0, "compute_ms": 0.0,
+                       "wire_busy_ms": 0.0, "exposed_comm_ms": 0.0,
+                       "overlapped_comm_ms": 0.0}
+
+    def prime(self, params: Optional[Dict[str, object]] = None) -> None:
+        """Adopt the initial parameter set (fp32 numpy masters). All
+        members must start identical — ``harness.init_params()`` is
+        deterministic per seed, so calling this with the default on
+        every member satisfies that."""
+        import numpy as np
+
+        src = params if params is not None else self.harness.init_params()
+        for name in self.harness.names:
+            self._params[name] = np.array(np.asarray(src[name]),
+                                          dtype=np.float32)
+            self._momenta[name] = np.zeros_like(self._params[name])
+
+    def params(self) -> Dict[str, object]:
+        return dict(self._params)
+
+    def step(self, x, y) -> float:
+        from brpc_tpu.observability import tracing
+
+        import jax
+        import numpy as np
+
+        t0 = time.monotonic()
+        names: List[str] = list(self.harness.names)
+        rev = list(reversed(names))
+        world = max(1, self.group.world)
+        grads: Dict[str, object] = {}
+        reduced: Dict[str, object] = {}
+        ctx_box: Dict[str, object] = {}
+
+        def traced(span_name, fn):
+            def run(done):
+                with tracing.trace_span(span_name):
+                    return fn(done)
+            return run
+
+        def fn_forward(done):
+            placed = {n: self.harness.place(n, self._params[n])
+                      for n in names}
+            ctx_box["ctx"] = self.harness.forward(placed, x, y)
+            return None
+
+        def make_bwd(name):
+            def fn(done):
+                g = self.harness.backward(ctx_box["ctx"], name)
+                grads[name] = jax.block_until_ready(g)
+                return None
+            return fn
+
+        def make_allreduce(name):
+            def fn(done):
+                g = np.asarray(grads[name])  # D2H on the wire lane
+                red = self.group.allreduce(name, g)
+                if self.average:
+                    red /= np.float32(world)
+                reduced[name] = red
+                return None
+            return fn
+
+        def make_opt(name):
+            def fn(done):
+                # The ParameterServer CPU update exactly: copy-on-write
+                # numpy momentum step (handed-out arrays stay immutable).
+                g = reduced[name]
+                m2 = self.momentum * self._momenta[name] + g
+                p2 = self._params[name] - self.lr * m2
+                self._momenta[name] = m2
+                self._params[name] = p2
+                return None
+            return fn
+
+        graph = StepGraph()
+        graph.add("fwd", traced("step/fwd", fn_forward), lane=COMPUTE)
+        prev = "fwd"
+        for name in rev:
+            prev = graph.add(f"bwd:{name}",
+                             traced(f"step/bwd:{name}", make_bwd(name)),
+                             deps=(prev,), lane=COMPUTE)
+        for k, name in enumerate(rev):
+            graph.add(f"allreduce:{name}",
+                      traced(f"step/allreduce:{name}",
+                             make_allreduce(name)),
+                      deps=(f"bwd:{name}",),
+                      lane=f"wire:ar{k % self.wire_lanes}")
+        for name in rev:
+            graph.add(f"opt:{name}",
+                      traced(f"step/opt:{name}", make_opt(name)),
+                      deps=(f"allreduce:{name}",), lane=COMPUTE)
+
+        with tracing.trace_span("train_step"):
+            tid, sid = tracing.current_trace()
+            # No qos factory: the collective stamps its own BULK QoS
+            # per peer inside the hop sends.
+            wire_ctx = _trace_handoff_ctx(tid, sid)
+
+            try:
+                _results, trace = run_graph(graph, overlap=self.overlap,
+                                            wire_ctx=wire_ctx)
+            except StepFailure as sf:
+                self._m["partial"].add(1)
+                cause = sf.cause
+                try:
+                    cause.step_failure = sf
+                except Exception:  # noqa: BLE001 — exotic exception
+                    pass
+                raise cause
+            wall_ms = trace.wall_s * 1e3
+            exposed_ms = trace.exposed_wait_s * 1e3
+            overlapped_ms = trace.overlapped_comm_s() * 1e3
+            tracing.annotate(f"exposed_comm={int(exposed_ms * 1e3)}us")
+            tracing.annotate(
+                f"overlapped_comm={int(overlapped_ms * 1e3)}us")
+
+        loss = float(self.harness.loss(ctx_box["ctx"]))
+        stats = {
+            "loss": loss, "overlap": self.overlap,
+            "wall_ms": wall_ms,
+            "compute_ms": trace.compute_busy_s * 1e3,
+            "wire_busy_ms": trace.wire_busy_s * 1e3,
+            "exposed_comm_ms": exposed_ms,
+            "overlapped_comm_ms": overlapped_ms,
+        }
+        self.last_stats = stats
+        self.last_trace = trace
+        self.totals["steps"] += 1
+        for k in ("wall_ms", "compute_ms", "wire_busy_ms",
+                  "exposed_comm_ms", "overlapped_comm_ms"):
+            self.totals[k] += stats[k]
+        self._m["steps"].add(1)
+        self._m["step"].record_s(time.monotonic() - t0)
+        self._m["exposed"].record_us(int(exposed_ms))      # ms samples
+        self._m["overlapped"].record_us(int(overlapped_ms))  # ms samples
+        return loss
+
+    def run(self, batches) -> List[float]:
         return [self.step(x, y) for x, y in batches]
 
 

@@ -10,9 +10,15 @@ executes such a graph on exactly TWO lanes:
     measured concurrent ``device_put`` from N threads CONTENDING ~5x
     instead of scaling), so everything that touches the device runs
     where the caller already is;
-  * ``wire`` nodes run on ONE worker thread — RPC submissions, reply
+  * ``wire`` nodes run on worker threads — RPC submissions, reply
     drains and pulls, whose wall time is exactly what the overlap is
-    meant to hide behind the compute lane.
+    meant to hide behind the compute lane. There is ONE worker per
+    NAMED wire lane: ``"wire"`` (the default — PR 12's single lane,
+    byte-identical semantics) plus any number of ``"wire:<name>"``
+    lanes, each its own thread. Multiple lanes exist for work that
+    BLOCKS on a peer mid-node — a collective hop waiting for its ring
+    predecessor parks its lane, and layer k+1's collective must keep
+    flowing on another (the fleet's per-peer wire lanes; ISSUE 13).
 
 ``overlap=False`` runs every node on the caller's thread in insertion
 order instead — the serial A/B baseline, same nodes, same results, all
@@ -46,7 +52,16 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 COMPUTE = "compute"
 WIRE = "wire"
-_LANES = (COMPUTE, WIRE)
+_LANES = (COMPUTE, WIRE)  # the closed set PLUS "wire:<name>" extensions
+
+
+def _valid_lane(lane: str) -> bool:
+    """``compute``, ``wire``, or a named wire lane ``wire:<suffix>`` —
+    anything else is a typo, rejected exactly as before the lanes
+    generalized (the one-lane topology contracts stay pinned)."""
+    return lane in _LANES or (isinstance(lane, str)
+                              and lane.startswith("wire:")
+                              and len(lane) > len("wire:"))
 
 
 class Node:
@@ -76,8 +91,9 @@ class StepGraph:
 
     def add(self, name: str, fn: Callable, deps=(), lane: str = COMPUTE
             ) -> str:
-        if lane not in _LANES:
-            raise ValueError(f"unknown lane {lane!r} (use {_LANES})")
+        if not _valid_lane(lane):
+            raise ValueError(f"unknown lane {lane!r} "
+                             f"(use {_LANES} or 'wire:<name>')")
         if name in self._nodes:
             raise ValueError(f"duplicate node name {name!r}")
         deps = tuple(deps)
@@ -131,17 +147,19 @@ class RunTrace:
 
     ``events``: ``[(name, lane, start_s, end_s), ...]`` in completion
     order (monotonic clock). ``wire_busy_s`` is total wire-lane node
-    time; ``exposed_wait_s`` is the time the CALLER's thread spent
-    blocked with no compute node ready (including the end-of-step join)
-    — the step's EXPOSED communication. Overlapped communication is
-    ``wire_busy_s - exposed_wait_s`` clamped at zero: wire time that ran
-    in compute's shadow.
+    time summed across EVERY wire lane (``lane_busy_s`` splits it per
+    named lane); ``exposed_wait_s`` is the time the CALLER's thread
+    spent blocked with no compute node ready (including the end-of-step
+    join) — the step's EXPOSED communication. Overlapped communication
+    is ``wire_busy_s - exposed_wait_s`` clamped at zero: wire time that
+    ran in compute's shadow.
     """
 
     def __init__(self, overlap: bool):
         self.overlap = overlap
         self.events: List[Tuple[str, str, float, float]] = []
         self.wire_busy_s = 0.0
+        self.lane_busy_s: Dict[str, float] = {}
         self.exposed_wait_s = 0.0
         self.compute_busy_s = 0.0
         self.wall_s = 0.0
@@ -174,10 +192,11 @@ def run_graph(graph: StepGraph, overlap: bool = True,
     :class:`StepFailure` (wire thread always joined first).
 
     ``wire_ctx()`` (optional) must return a context manager; it is
-    entered around the whole wire lane — the driver hands the rpcz trace
-    context and the QoS stamp across the thread boundary through it (the
-    FleetClient worker-thread discipline). In serial mode it wraps the
-    whole run, so the A/B stamps identical wire metadata.
+    entered around EACH wire lane's thread (one fresh instance per
+    lane) — the driver hands the rpcz trace context and the QoS stamp
+    across the thread boundary through it (the FleetClient
+    worker-thread discipline). In serial mode it wraps the whole run,
+    so the A/B stamps identical wire metadata.
     """
     trace = RunTrace(overlap)
     t_start = time.monotonic()
@@ -195,11 +214,18 @@ def run_graph(graph: StepGraph, overlap: bool = True,
     done: Dict[str, object] = {}
     failed: Dict[str, BaseException] = {}
     cancelled: set = set()
-    ready: Dict[str, List[Node]] = {COMPUTE: [], WIRE: []}
+    # One worker thread per DISTINCT wire lane present in the graph
+    # (first-appearance order — deterministic). A graph that only ever
+    # says lane=WIRE gets exactly PR 12's single worker.
+    wire_lanes: List[str] = []
+    for n in graph.nodes():
+        if n.lane != COMPUTE and n.lane not in wire_lanes:
+            wire_lanes.append(n.lane)
+    ready: Dict[str, List[Node]] = {ln: [] for ln in [COMPUTE] + wire_lanes}
     pending = {n.name: len(n.deps) for n in graph.nodes()}
     children: Dict[str, List[Node]] = {n.name: [] for n in graph.nodes()}
-    lane_total = {COMPUTE: 0, WIRE: 0}
-    lane_done = {COMPUTE: 0, WIRE: 0}
+    lane_total = {ln: 0 for ln in ready}
+    lane_done = {ln: 0 for ln in ready}
     aborted = [False]
     for n in graph.nodes():
         lane_total[n.lane] += 1
@@ -268,52 +294,60 @@ def run_graph(graph: StepGraph, overlap: bool = True,
             t1 = time.monotonic()
             with lock:
                 trace.events.append((node.name, lane, t0, t1))
-                if lane == WIRE:
+                if lane != COMPUTE:
                     trace.wire_busy_s += t1 - t0
+                    trace.lane_busy_s[lane] = (
+                        trace.lane_busy_s.get(lane, 0.0) + (t1 - t0))
                 else:
                     trace.compute_busy_s += t1 - t0
                 _finish_locked(node, result, exc)
 
-    def _wire_main() -> None:
+    def _wire_main(lane: str) -> None:
         try:
             with ctx():
-                _run_lane(WIRE, count_wait=False)
+                _run_lane(lane, count_wait=False)
         except BaseException as e:  # noqa: BLE001 — a dead wire lane
             # must surface, never read as success: wire_ctx enter/exit
             # raising (or a BaseException escaping a wire node) would
-            # otherwise leave every remaining wire node unrun with
-            # `failed` empty — run_graph would RETURN normally while
-            # zero pushes/pulls happened (and a graph with a compute
-            # node downstream of a wire node would hang in cond.wait).
+            # otherwise leave every remaining node of THIS lane unrun
+            # with `failed` empty — run_graph would RETURN normally
+            # while zero pushes/pulls happened (and a graph with a
+            # compute node downstream of a wire node would hang in
+            # cond.wait). Other lanes keep draining their independent
+            # branches — partial salvage applies across lanes too.
             with lock:
-                failed["<wire-lane>"] = e
+                failed[f"<{lane}-lane>"] = e
                 for n in graph.nodes():
-                    if (n.lane == WIRE and n.name not in done
+                    if (n.lane == lane and n.name not in done
                             and n.name not in failed
                             and n.name not in cancelled):
                         cancelled.add(n.name)
-                        lane_done[WIRE] += 1
+                        lane_done[lane] += 1
                         _cancel_dependents_locked(n.name)
                 cond.notify_all()
 
-    wire_thread = threading.Thread(target=_wire_main,
-                                   name="step-wire", daemon=True)
-    wire_thread.start()
+    wire_threads = [threading.Thread(target=_wire_main, args=(ln,),
+                                     name=f"step-{ln}", daemon=True)
+                    for ln in wire_lanes]
+    for t in wire_threads:
+        t.start()
     try:
         _run_lane(COMPUTE, count_wait=True)
     except BaseException:
         # KeyboardInterrupt & friends: stop handing out new nodes and
-        # get the wire thread back before unwinding — a daemon thread
+        # get the wire threads back before unwinding — a daemon thread
         # left touching a half-torn-down driver is a wedge.
         with lock:
             aborted[0] = True
             cond.notify_all()
-        wire_thread.join()
+        for t in wire_threads:
+            t.join()
         raise
     # The end-of-step barrier: whatever wire work is still running/queued
     # is EXPOSED communication by definition — nothing computes under it.
     t_join = time.monotonic()
-    wire_thread.join()
+    for t in wire_threads:
+        t.join()
     trace.exposed_wait_s += time.monotonic() - t_join
     trace.wall_s = time.monotonic() - t_start
     if failed:
@@ -343,8 +377,10 @@ def _run_serial(graph: StepGraph, trace: RunTrace) -> Dict[str, object]:
             done[node.name] = result
             t1 = time.monotonic()
         trace.events.append((node.name, node.lane, t0, t1))
-        if node.lane == WIRE:
+        if node.lane != COMPUTE:
             trace.wire_busy_s += t1 - t0
+            trace.lane_busy_s[node.lane] = (
+                trace.lane_busy_s.get(node.lane, 0.0) + (t1 - t0))
         else:
             trace.compute_busy_s += t1 - t0
     # Serial mode hides nothing: every wire second is exposed step time.

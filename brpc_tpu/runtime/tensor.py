@@ -941,15 +941,7 @@ class PipelineWindow:
         attribute it per tensor (partial-salvage bookkeeping)."""
         if not self._q:
             return False
-        tag = self._q[0][0]
-        try:
-            self._complete_oldest()
-        except Exception as e:  # noqa: BLE001 — annotate and re-raise
-            try:
-                e.pipeline_tag = tag
-            except Exception:  # noqa: BLE001 — exotic immutable exception
-                pass
-            raise
+        self._complete_oldest()
         return True
 
     def submit(self, service_method: str, array=None, request: bytes = b"",
@@ -994,25 +986,38 @@ class PipelineWindow:
         self._q.append((tag, fut, off, length))
 
     def _complete_oldest(self) -> None:
+        # EVERY drain point annotates failures with the failed call's
+        # tag (``e.pipeline_tag``) — not just complete_one: submit's
+        # window-full drain and flush() surface the same errors, and
+        # per-tag salvage/retry layers (the step driver, PushQ's
+        # rollback check, the collectives' shed redelivery) must be
+        # able to attribute those too.
         tag, fut, off, length = self._q.popleft()
         try:
-            with _stage("wire_wait"):
-                payload, view = fut.result()
-        finally:
-            _pipeline_inflight_add(-1)
-            if length:
-                self.channel.arena.free(off)  # deferred until refs drain
-        if self.on_reply is not None:
             try:
-                self.on_reply(tag, payload, view)
-            except Exception:
-                # The view was handed out but is in neither _q nor
-                # _results: release here or the PEER's range never drains
-                # (releasing twice is safe — release() is idempotent).
-                view.release()
-                raise
-        else:
-            self._results.append((tag, payload, view))
+                with _stage("wire_wait"):
+                    payload, view = fut.result()
+            finally:
+                _pipeline_inflight_add(-1)
+                if length:
+                    self.channel.arena.free(off)  # freed as refs drain
+            if self.on_reply is not None:
+                try:
+                    self.on_reply(tag, payload, view)
+                except Exception:
+                    # The view was handed out but is in neither _q nor
+                    # _results: release here or the PEER's range never
+                    # drains (release() is idempotent).
+                    view.release()
+                    raise
+            else:
+                self._results.append((tag, payload, view))
+        except Exception as e:  # noqa: BLE001 — annotate and re-raise
+            try:
+                e.pipeline_tag = tag
+            except Exception:  # noqa: BLE001 — exotic immutable exception
+                pass
+            raise
 
     def flush(self) -> list:
         """Drain the window; returns (and clears) collected results when

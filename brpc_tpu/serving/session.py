@@ -168,25 +168,6 @@ class _HostArena:
         self._buf = None
 
 
-class _NullSeries:
-    """No-op metric shims for the pure path (same read surface)."""
-
-    def record_s(self, *_a) -> None: ...
-
-    def record_us(self, *_a) -> None: ...
-
-    def add(self, *_a) -> None: ...
-
-    def p99(self) -> int:
-        return 0
-
-    def qps(self) -> int:
-        return 0
-
-    def value(self) -> int:
-        return 0
-
-
 _metrics_cache = None
 
 
@@ -210,7 +191,9 @@ def serving_metrics():
             # serving_sessions / serving_kv_bytes gauges are registered
             # (and re-pointed per manager) by SessionManager itself.
         else:
-            _metrics_cache = {k: _NullSeries()
+            from brpc_tpu.observability.metrics import NullSeries
+
+            _metrics_cache = {k: NullSeries()
                               for k in ("ttft", "token", "tokens", "shed")}
     return _metrics_cache
 

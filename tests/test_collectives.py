@@ -1,0 +1,867 @@
+"""Quantized fleet collectives (ISSUE 13 acceptance surface).
+
+Pure half (tier-1 — no native lib; the algorithm layers are numpy-only):
+  * chunk spans cover/balance, ring & tree schedule contracts;
+  * raw ring allreduce over an in-memory link is BYTE-identical to the
+    ring-order numpy reference (``reduce_order``);
+  * quantized allreduce: all members return identical values, error
+    bounded; allgather raw exact + quantized member agreement;
+  * error feedback across hops: accumulated quantized sums track the
+    fp32 reduction within ~one quant step while the naive requantizer
+    (``ef=False`` — the negative control) compounds linearly;
+  * per-chunk salvage: a dead link mid-collective raises
+    ``CollectiveAborted`` carrying exactly the finished chunks;
+  * groupwire manifest framing roundtrip + overrun rejection;
+  * step_sched N named wire lanes: two blocking lanes really overlap,
+    per-lane busy accounting, cross-lane failure isolation, and the
+    one-lane/serial configs unchanged.
+
+Native half (skips cleanly without libbrpc_tpu.so), under an ARMED
+stall watchdog so a wedge in the new wire paths becomes a stall dump:
+  * 3-member groups over a live registry: raw allreduce byte-identical
+    to the numpy reference, quantized within the documented tolerance,
+    members bitwise-agreed, allgather round trip;
+  * PushQ: grouped quantized push_all lands the identical server state
+    as per-tensor quantized pushes; a missing name raises
+    PartialPushError with groupmates' versions applied; raw push_all
+    never touches PushQ;
+  * member death mid-collective: clean MemberLeft with per-chunk
+    salvage, survivors re-sync() and reduce on the smaller ring;
+  * one rpcz trace per collective (chunk RPC spans under one
+    ``collective/allreduce`` root);
+  * CollectiveStepDriver: overlapped == serial trajectory, quantized-EF
+    within 5e-2 of the fp32 reduction, the naive requantizer pinned
+    worse, allreduce spans on multiple named lanes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu.collectives import core, quant, ring
+from brpc_tpu.runtime import groupwire
+from brpc_tpu.runtime.step_sched import (COMPUTE, StepFailure, StepGraph,
+                                         run_graph)
+
+# ---------------------------------------------------------------------------
+# Pure: schedules.
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_spans_cover_and_balance():
+    for n, parts in [(10, 3), (7, 7), (5, 8), (0, 2), (100, 1)]:
+        spans = ring.chunk_spans(n, parts)
+        assert len(spans) == parts
+        off = 0
+        for o, ln in spans:
+            assert o == off and ln >= 0
+            off += ln
+        assert off == n
+        lens = [ln for _o, ln in spans]
+        assert max(lens) - min(lens) <= 1
+    with pytest.raises(ValueError):
+        ring.chunk_spans(4, 0)
+
+
+def test_ring_schedule_contracts():
+    for n in (2, 3, 4, 7):
+        for rank in range(n):
+            rs = ring.reduce_scatter_steps(rank, n)
+            ag = ring.allgather_steps(rank, n)
+            assert len(rs) == len(ag) == n - 1
+            # Forwarding invariants: what step s receives is what step
+            # s+1 sends (reduce-scatter: after adding; allgather:
+            # verbatim).
+            for s in range(n - 2):
+                assert rs[s][1] == rs[s + 1][0]
+                assert ag[s][1] == ag[s + 1][0]
+            # The reduction completes at the owned chunk, which is the
+            # first chunk allgather broadcasts.
+            assert rs[-1][1] == ring.owned_chunk(rank, n) == ag[0][0]
+            # Every chunk is received exactly once per phase:
+            # reduce-scatter receives all but the chunk this rank SENDS
+            # first (its own), allgather all but the one it OWNS.
+            assert sorted(r for _s, r in rs) == sorted(
+                set(range(n)) - {rank})
+            assert sorted(r for _s, r in ag) == sorted(
+                set(range(n)) - {ring.owned_chunk(rank, n)})
+        # reduce_order: each chunk's contributions start at its index.
+        for j in range(n):
+            order = ring.reduce_order(j, n)
+            assert sorted(order) == list(range(n)) and order[0] == j
+
+
+def test_ring_order_is_deterministic():
+    assert ring.ring_order(["b:2", "a:1", "b:2", "c:3"]) == \
+        ["a:1", "b:2", "c:3"]
+
+
+# ---------------------------------------------------------------------------
+# Pure: in-memory link + the algorithms.
+# ---------------------------------------------------------------------------
+
+
+class _QueueLink:
+    """The pure transport: one Mailbox per member, direct deposit."""
+
+    def __init__(self, boxes, rank, timeout_s=10.0, fail_after=None):
+        self.boxes = boxes
+        self.rank = rank
+        self.deadline = time.monotonic() + timeout_s
+        self.fail_after = fail_after  # (phase, step) -> die before send
+        self.sends = 0
+
+    def send(self, dst, ph, step, idx, meta, blob, frag=0, nfrags=1):
+        if self.fail_after is not None and (ph, step) == self.fail_after:
+            raise core.MemberLeft("member-left", ph, step)
+        self.sends += 1
+        detached = np.array(np.asarray(blob).reshape(-1).view(np.uint8))
+        self.boxes[dst].deposit(("op", 0, ph, int(step), int(frag)),
+                                (idx, meta, detached))
+
+    def recv(self, ph, step, frag=0):
+        return self.boxes[self.rank].take(
+            ("op", 0, ph, int(step), int(frag)), self.deadline)
+
+
+def _run_members(n, fn):
+    """fn(rank) on n threads; returns [result_by_rank]; re-raises the
+    first member failure."""
+    out = [None] * n
+    errs = {}
+
+    def worker(r):
+        try:
+            out[r] = fn(r)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs[r] = e
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise next(iter(errs.values()))
+    return out
+
+
+def _ring_reference(xs, spans):
+    """The byte-exact fp32 reference: chunk j accumulates contributions
+    left-to-right in ``reduce_order(j, n)`` — precisely the ring's
+    addition order."""
+    n = len(xs)
+    ref = np.empty_like(xs[0])
+    for j, (off, ln) in enumerate(spans):
+        order = ring.reduce_order(j, n)
+        a = xs[order[0]][off:off + ln].copy()
+        for r in order[1:]:
+            a = a + xs[r][off:off + ln]
+        ref[off:off + ln] = a
+    return ref
+
+
+def test_pure_ring_allreduce_raw_byte_identical():
+    n, size = 3, 10007  # deliberately not divisible by n
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+    boxes = [core.Mailbox() for _ in range(n)]
+
+    def member(r):
+        link = _QueueLink(boxes, r)
+        # frag_elems far below the chunk size: the multi-fragment path
+        # (including the uneven tail fragment) is what this pins.
+        return core.ring_allreduce(r, n, xs[r], quant.ChunkCodec(),
+                                   link, "g", None, frag_elems=777)
+
+    outs = _run_members(n, member)
+    ref = _ring_reference(xs, ring.chunk_spans(size, n))
+    for r in range(n):
+        assert np.array_equal(outs[r], ref), f"rank {r} drifted from the " \
+            "ring-order reference (raw must be byte-exact)"
+
+
+def test_pure_ring_allreduce_quantized_agreement_and_bound():
+    n, size = 4, 80000
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+    boxes = [core.Mailbox() for _ in range(n)]
+    codecs = [quant.ChunkCodec() for _ in range(n)]
+
+    def member(r):
+        link = _QueueLink(boxes, r)
+        return core.ring_allreduce(r, n, xs[r], codecs[r], link, "g",
+                                   "int8", frag_elems=6000)
+
+    outs = _run_members(n, member)
+    for r in range(1, n):
+        assert np.array_equal(outs[r], outs[0]), \
+            "quantization made members disagree"
+    fp32 = np.sum(np.stack(xs), axis=0, dtype=np.float32)
+    # Per-hop error is bounded by one int8 step of the running partial's
+    # block absmax; n-1 reduce hops + 1 broadcast quant compound to a
+    # small multiple of scale/2 — assert a generous envelope.
+    scale = np.abs(fp32).max() / 127.0
+    assert np.abs(outs[0] - fp32).max() < scale * n
+
+
+def test_pure_tree_allreduce_exact_and_small():
+    n, size = 4, 512  # below any quant floor: raw, exact
+    rng = np.random.RandomState(2)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+    boxes = [core.Mailbox() for _ in range(n)]
+
+    def member(r):
+        link = _QueueLink(boxes, r)
+        return core.tree_allreduce(r, n, xs[r], quant.ChunkCodec(),
+                                   link, "t", "int8")
+
+    outs = _run_members(n, member)
+    ref = xs[0].copy()
+    for x in xs[1:]:
+        ref = ref + x  # ascending-rank accumulation = the root's order
+    for r in range(n):
+        assert np.array_equal(outs[r], ref)
+
+
+def test_pure_allgather_raw_exact_quant_agrees():
+    n, size = 3, 20000
+    rng = np.random.RandomState(3)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+
+    def run(codec_name):
+        boxes = [core.Mailbox() for _ in range(n)]
+
+        def member(r):
+            link = _QueueLink(boxes, r)
+            return core.ring_allgather(r, n, xs[r], quant.ChunkCodec(),
+                                       link, "a", codec_name)
+        return _run_members(n, member)
+
+    outs = run(None)
+    for r in range(n):
+        for i in range(n):
+            assert np.array_equal(outs[r][i], xs[i])
+    qouts = run("int8")
+    for r in range(1, n):
+        for i in range(n):
+            assert np.array_equal(qouts[r][i], qouts[0][i]), \
+                "quantized allgather members disagree"
+    assert np.abs(qouts[0][1] - xs[1]).max() < np.abs(xs[1]).max() / 64
+
+
+def test_ef_across_hops_beats_naive_linear_compounding():
+    """The EQuARX discipline pinned: accumulated quantized-allreduce
+    sums track the fp32 reduction within ~one quant step with EF on,
+    while the naive requantizer's error grows ~linearly in steps (the
+    negative control, >= 3x worse here, typically ~20x)."""
+    n, size, steps = 3, 30000, 20
+    rng = np.random.RandomState(4)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+    fp32 = np.sum(np.stack(xs), axis=0, dtype=np.float64)
+
+    def accumulated_error(ef):
+        boxes = [core.Mailbox() for _ in range(n)]
+        codecs = [quant.ChunkCodec(ef=ef) for _ in range(n)]
+        acc = np.zeros(size, np.float64)
+        for _s in range(steps):
+            def member(r):
+                link = _QueueLink(boxes, r)
+                return core.ring_allreduce(r, n, xs[r], codecs[r], link,
+                                           "e", "int8")
+            outs = _run_members(n, member)
+            acc += outs[0]
+        return np.abs(acc - steps * fp32).max()
+
+    e_ef = accumulated_error(True)
+    e_naive = accumulated_error(False)
+    # One quant step of the summed magnitude, with slack for the
+    # broadcast quantization (which EF also compensates across steps).
+    scale = np.abs(fp32).max() / 127.0
+    assert e_ef < scale * 4, f"EF error {e_ef} above one-quant-step " \
+        f"envelope {scale * 4}"
+    assert e_naive > 3 * e_ef, (
+        f"naive requantizer not measurably worse: {e_naive} vs {e_ef} "
+        "(the negative control must compound)")
+
+
+def test_salvage_on_abort_carries_finished_chunks():
+    """A member dying mid-allgather-phase: the survivor's error carries
+    exactly the chunks whose FINAL value it already had."""
+    n, size = 3, 9000
+    rng = np.random.RandomState(5)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+    boxes = [core.Mailbox() for _ in range(n)]
+
+    # Rank 0 dies before its allgather step-1 send; run ranks 1/2 with
+    # short timeouts so their waits for the broken chain fail promptly.
+    def member(r):
+        fail = ("ag", 1) if r == 0 else None
+        link = _QueueLink(boxes, r, timeout_s=1.0, fail_after=fail)
+        return core.ring_allreduce(r, n, xs[r], quant.ChunkCodec(),
+                                   link, "s", None)
+
+    with pytest.raises(core.CollectiveAborted) as ei:
+        _run_members(n, member)
+    e = ei.value
+    assert e.done, "no per-chunk salvage on the abort"
+    spans = ring.chunk_spans(size, n)
+    ref = _ring_reference(xs, spans)
+    for idx, ((off, ln), vals) in e.done.items():
+        assert (off, ln) == spans[idx]
+        np.testing.assert_array_equal(vals, ref[off:off + ln])
+
+
+def test_mailbox_abort_timeout_and_gc():
+    box = core.Mailbox()
+    ev = threading.Event()
+    with pytest.raises(core.CollectiveTimeout):
+        box.take(("op", 0, "rs", 0), time.monotonic() + 0.05)
+    ev.set()
+    with pytest.raises(core.MemberLeft):
+        box.take(("op", 0, "rs", 0), time.monotonic() + 5,
+                 abort_event=ev)
+    box.deposit(("op", 1, "rs", 0), (0, {}, b""))
+    box.deposit(("op", 1, "rs", 1), (1, {}, b""))
+    box.deposit(("other", 1, "rs", 0), (2, {}, b""))
+    assert box.drop_op(("op", 1)) == 2
+    assert box.take(("other", 1, "rs", 0),
+                    time.monotonic() + 1)[0] == 2
+    # Tombstone: a LATE chunk for the dropped op (still in flight when
+    # the abort ran) is discarded on arrival, never stranded.
+    box.deposit(("op", 1, "ag", 0), (3, {}, b""))
+    with pytest.raises(core.CollectiveTimeout):
+        box.take(("op", 1, "ag", 0), time.monotonic() + 0.05)
+    assert not box._slots, "late chunk for a dropped op was stranded"
+
+
+def test_groupwire_roundtrip_and_overrun():
+    entries = [{"name": "a", "dtype": "<f4", "shape": [4]},
+               {"name": "gone", "code": 2040, "error": "no such"},
+               {"name": "b", "dtype": "<f4", "shape": [2],
+                "codec": "int8", "block": 256}]
+    blobs = [np.arange(16, dtype=np.uint8), None,
+             np.arange(8, dtype=np.uint8)]
+    manifest, concat = groupwire.pack_group(entries, blobs,
+                                            extra={"ep": 7})
+    doc = groupwire.parse_group(manifest)
+    assert doc["ep"] == 7
+    pairs = list(groupwire.split_group(doc, concat))
+    assert pairs[1][1] is None and "error" in pairs[1][0]
+    np.testing.assert_array_equal(pairs[0][1], blobs[0])
+    np.testing.assert_array_equal(pairs[2][1], blobs[2])
+    doc["tensors"][2]["nbytes"] = 10 ** 6  # claim past the payload
+    with pytest.raises(ValueError, match="overruns"):
+        list(groupwire.split_group(doc, concat))
+    with pytest.raises(ValueError, match="entries vs"):
+        groupwire.pack_group(entries, blobs[:1])
+
+
+# ---------------------------------------------------------------------------
+# Pure: step_sched N named wire lanes.
+# ---------------------------------------------------------------------------
+
+
+def test_named_wire_lanes_really_overlap():
+    """Two nodes that BLOCK (the collective-hop shape) on different
+    named lanes run concurrently; on one lane they serialize."""
+    def build(lane_b):
+        g = StepGraph()
+        g.add("a", lambda r: 1)
+        g.add("w1", lambda r: time.sleep(0.15),  # tpulint: allow(py-blocking)
+              deps=("a",), lane="wire:0")
+        g.add("w2", lambda r: time.sleep(0.15),  # tpulint: allow(py-blocking)
+              deps=("a",), lane=lane_b)
+        return g
+
+    _r, two = run_graph(build("wire:1"), overlap=True)
+    assert two.overlapped("w1", "w2"), "named lanes did not overlap"
+    assert two.wall_s < 0.27
+    assert set(two.lane_busy_s) == {"wire:0", "wire:1"}
+    assert abs(two.wire_busy_s - sum(two.lane_busy_s.values())) < 1e-9
+
+    _r, one = run_graph(build("wire:0"), overlap=True)
+    assert not one.overlapped("w1", "w2"), "one lane must serialize"
+    assert one.wall_s >= 0.29
+
+
+def test_lane_failure_isolated_to_dependents():
+    g = StepGraph()
+    g.add("a", lambda r: 1)
+    g.add("bad", lambda r: 1 / 0, deps=("a",), lane="wire:0")
+    g.add("dep", lambda r: 2, deps=("bad",), lane="wire:0")
+    g.add("ok", lambda r: 3, deps=("a",), lane="wire:1")
+    g.add("okc", lambda r: r["ok"] + 1, deps=("ok",))
+    with pytest.raises(StepFailure) as ei:
+        run_graph(g, overlap=True)
+    sf = ei.value
+    assert set(sf.failed) == {"bad"}
+    assert sf.cancelled == ["dep"]
+    assert sf.done.get("ok") == 3 and sf.done.get("okc") == 4, (
+        "the independent lane's branch must complete (partial salvage)")
+
+
+def test_named_lanes_serial_mode_and_validation():
+    g = StepGraph()
+    g.add("a", lambda r: 1)
+    g.add("w", lambda r: r["a"] + 1, deps=("a",), lane="wire:x")
+    rs, ts = run_graph(g, overlap=False)
+    assert rs == {"a": 1, "w": 2}
+    assert ts.exposed_wait_s == ts.wire_busy_s  # serial hides nothing
+    with pytest.raises(ValueError, match="lane"):
+        g.add("bad", lambda r: 0, lane="gpu")
+    with pytest.raises(ValueError, match="lane"):
+        g.add("bad2", lambda r: 0, lane="wire:")  # empty suffix
+
+
+# ---------------------------------------------------------------------------
+# Native half: live groups over the real wire, armed watchdog.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def coll_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.fleet import RegistryHub, clear_registry
+    from brpc_tpu.observability import health
+    dump_dir = tmp_path_factory.mktemp("coll_dumps")
+    health.start_watchdog(str(dump_dir))
+    hub = RegistryHub()
+    hub.start()
+    yield {"hub": hub, "health": health}
+    clear_registry()
+    hub.stop()
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after collective tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def _mk_groups(env, tag, n, **kw):
+    from brpc_tpu.collectives.group import CollectiveGroup
+    groups = [CollectiveGroup(env["hub"].hostport, tag=tag, **kw)
+              for _ in range(n)]
+    for g in groups:
+        g.sync(expect=n, timeout_s=20)
+    return sorted(groups, key=lambda g: g.rank)
+
+
+def _member_threads(groups, fn):
+    out = {}
+    errs = {}
+
+    def worker(g):
+        try:
+            out[g.rank] = fn(g)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs[g.rank] = e
+
+    ts = [threading.Thread(target=worker, args=(g,)) for g in groups]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out, errs
+
+
+def test_wire_allreduce_raw_identity_and_quant_parity(coll_env):
+    """3 members over the live wire: raw byte-identical to the
+    ring-order numpy reference; quantized within tolerance with all
+    members bitwise agreed; collective_* counters move."""
+    from brpc_tpu.collectives.group import collective_metrics
+
+    size = 150000
+    rng = np.random.RandomState(7)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(3)]
+    m = collective_metrics()
+    ops0 = m["ops"].value()
+
+    groups = _mk_groups(coll_env, "ar_raw", 3)
+    try:
+        out, errs = _member_threads(
+            groups, lambda g: g.allreduce("g", xs[g.rank], algo="ring"))
+        assert not errs, errs
+        ref = _ring_reference(xs, ring.chunk_spans(size, 3))
+        for r in range(3):
+            np.testing.assert_array_equal(out[r], ref)
+    finally:
+        for g in groups:
+            g.close()
+
+    groups = _mk_groups(coll_env, "ar_q", 3, codec="int8")
+    try:
+        out, errs = _member_threads(
+            groups, lambda g: g.allreduce("g", xs[g.rank], algo="ring"))
+        assert not errs, errs
+        fp32 = np.sum(np.stack(xs), axis=0, dtype=np.float32)
+        for r in range(1, 3):
+            assert np.array_equal(out[r], out[0])
+        scale = np.abs(fp32).max() / 127.0
+        assert np.abs(out[0] - fp32).max() < scale * 3
+
+        ag, errs = _member_threads(
+            groups, lambda g: g.allgather("ag", xs[g.rank][:30000]))
+        assert not errs, errs
+        for r in range(3):
+            for i in range(3):
+                assert np.array_equal(ag[r][i], ag[0][i])
+    finally:
+        for g in groups:
+            g.close()
+    assert m["ops"].value() > ops0
+    assert m["wire_bytes"].value() > 0
+
+
+def test_wire_tree_small_tensor_exact(coll_env):
+    """A sub-4KB tensor auto-routes through the tree and reduces
+    EXACTLY (below the quant floor it rides raw even on a quantized
+    group)."""
+    xs = [np.arange(256, dtype=np.float32) * (r + 1) for r in range(3)]
+    groups = _mk_groups(coll_env, "tree", 3, codec="int8")
+    try:
+        out, errs = _member_threads(
+            groups, lambda g: g.allreduce("small", xs[g.rank]))
+        assert not errs, errs
+        ref = xs[0] + xs[1] + xs[2]
+        for r in range(3):
+            np.testing.assert_array_equal(out[r], ref)
+    finally:
+        for g in groups:
+            g.close()
+
+
+def test_member_death_mid_collective_clean_failure_and_resync(coll_env):
+    """The fleet-chaos contract: one member drops out (deregisters and
+    dies) while the others reduce — survivors get a clean MemberLeft
+    (never a wedge; the armed watchdog would dump one), then re-sync()
+    and complete on the 2-ring."""
+    from brpc_tpu.collectives.core import CollectiveAborted
+
+    size = 120000
+    rng = np.random.RandomState(8)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(3)]
+    groups = _mk_groups(coll_env, "death", 3, op_timeout_s=8.0)
+    dead = groups[2]
+    survivors = groups[:2]
+    try:
+        def member(g):
+            if g.rank == 2:
+                # Participate in nothing: deregister + die just as the
+                # others enter the collective.
+                time.sleep(0.1)
+                g.close()
+                return None
+            return g.allreduce("d", xs[g.rank], timeout_s=8.0)
+
+        out, errs = _member_threads(groups, member)
+        assert set(errs) == {0, 1}, (out.keys(), errs)
+        for e in errs.values():
+            assert isinstance(e, CollectiveAborted), type(e)
+            assert hasattr(e, "done")  # per-chunk salvage surface
+        # Survivors rebuild the ring and reduce cleanly.
+        for g in survivors:
+            g.sync(expect=2, timeout_s=20)
+        out, errs = _member_threads(
+            survivors, lambda g: g.allreduce("after", xs[g.rank]))
+        assert not errs, errs
+        ref = _ring_reference(xs[:2], ring.chunk_spans(size, 2))
+        for r in range(2):
+            np.testing.assert_array_equal(out[r], ref)
+    finally:
+        for g in survivors:
+            g.close()
+
+
+def test_close_aborts_blocked_op_promptly(coll_env):
+    """close() from another thread fails a blocked collective NOW (as
+    MemberLeft), not after the op deadline — shutdown must never sit
+    out a 20s mailbox wait for chunks that can no longer arrive."""
+    from brpc_tpu.collectives.core import CollectiveAborted
+
+    groups = _mk_groups(coll_env, "close_abort", 2, op_timeout_s=30.0)
+    g0, g1 = groups
+    x = np.ones(100000, np.float32)
+    err, took = {}, {}
+    try:
+        def blocked():
+            t0 = time.monotonic()
+            try:
+                # g1 never calls: g0 blocks waiting for its chunks.
+                g0.allreduce("never", x, algo="ring")
+            except CollectiveAborted as e:
+                err["e"] = e
+            took["s"] = time.monotonic() - t0
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.5)
+        g0.close()
+        t.join(timeout=10)
+        assert not t.is_alive(), "blocked op survived close()"
+        assert "e" in err, "close() did not fail the op"
+        assert took["s"] < 5.0, f"close() took {took['s']:.1f}s to abort"
+    finally:
+        g1.close()
+
+
+def test_tree_mixed_capability_degrades_raw(coll_env):
+    """A tree collective negotiates with its ACTUAL peers: when the
+    root (or any leaf, for the root's single broadcast encode) doesn't
+    advertise the codec, that leg rides raw — never an undecodable
+    send. Simulated by pinning the peer-caps cache to a no-codec
+    advertisement before the op."""
+    xs = [np.arange(2048, dtype=np.float32) * (r + 1) for r in range(2)]
+    groups = _mk_groups(coll_env, "treemix", 2, codec="int8",
+                        tree_max_bytes=1 << 20)
+    try:
+        for g in groups:
+            for peer in g.members:
+                if peer != g.addr:
+                    with g._mu:  # the degraded peer: raw, unstamped
+                        g._peer_caps[peer] = {"qos": 0, "codecs": []}
+        out, errs = _member_threads(
+            groups, lambda g: g.allreduce("mix", xs[g.rank],
+                                          algo="tree"))
+        assert not errs, errs
+        ref = xs[0] + xs[1]
+        for r in range(2):
+            np.testing.assert_array_equal(out[r], ref)  # raw => exact
+    finally:
+        for g in groups:
+            g.close()
+
+
+def test_one_trace_per_collective_on_rpcz(coll_env):
+    """One allreduce assembles as ONE trace: a collective/allreduce
+    root span with the chunk RPC client spans inside its interval."""
+    from brpc_tpu.observability import tracing
+
+    groups = _mk_groups(coll_env, "trace", 2, codec="int8")
+    tracing.rpcz_enable(True)
+    old_n = tracing.rpcz_sample_1_in_n()
+    tracing.rpcz_set_sample_1_in_n(1)
+    try:
+        x = np.random.RandomState(9).randn(100000).astype(np.float32)
+        _out, errs = _member_threads(
+            groups, lambda g: g.allreduce("tr", x, algo="ring"))
+        assert not errs, errs
+        spans = tracing.dump_rpcz()
+        roots = [s for s in spans
+                 if s["service_method"] == "collective/allreduce"]
+        assert roots, f"no collective root span: " \
+            f"{sorted({s['service_method'] for s in spans})}"
+        root = roots[0]
+        notes = " ".join(root.get("annotations", []))
+        assert "op=tr" in notes and "n=2" in notes
+        # Chunk RPCs parent under the SAME trace id as a root span.
+        chunk_spans = [s for s in spans
+                       if "CollectiveService/Chunk" in s["service_method"]]
+        assert chunk_spans, "chunk RPC spans missing from rpcz"
+        root_tids = {s["trace_id"] for s in roots}
+        assert any(s["trace_id"] in root_tids for s in chunk_spans), (
+            "chunk spans did not join the collective root's trace")
+    finally:
+        tracing.rpcz_set_sample_1_in_n(old_n)
+        for g in groups:
+            g.close()
+
+
+# ---------------------------------------------------------------------------
+# Native half: PushQ (the PR 7 leftover, retired).
+# ---------------------------------------------------------------------------
+
+
+def test_pushq_matches_per_tensor_quantized_pushes(coll_env):
+    """Grouped quantized push_all == the same gradients pushed
+    per-tensor: identical versions AND identical server state bit for
+    bit (same codec math, same EF sequence, same update order)."""
+    from brpc_tpu.runtime.param_server import (ParameterClient,
+                                               ParameterServer)
+
+    params = {f"w{i:02d}": np.full((64 * 1024,), float(i + 1), np.float32)
+              for i in range(10)}
+    params["tiny"] = np.ones((8,), np.float32)  # ineligible: rides raw
+    grads = {k: np.random.RandomState(11).randn(*v.shape).astype(
+        np.float32) for k, v in params.items()}
+
+    s1 = ParameterServer(dict(params))
+    s1.start()
+    s2 = ParameterServer(dict(params))
+    s2.start()
+    c1 = ParameterClient(f"tpu://127.0.0.1:{s1.port}", codec="int8")
+    c2 = ParameterClient(f"tpu://127.0.0.1:{s2.port}", codec="int8")
+    try:
+        v1 = c1.push_all(dict(grads))
+        v2 = {k: c2.push_grad(k, g) for k, g in grads.items()}
+        assert v1 == v2
+        for k in params:
+            a = np.asarray(c1.pull(k)[1])
+            b = np.asarray(c2.pull(k)[1])
+            assert np.array_equal(a, b), f"PushQ state drifted on {k}"
+    finally:
+        c1.close()
+        c2.close()
+        s1.stop()
+        s2.stop()
+
+
+def test_pushq_per_name_salvage_and_raw_gate(coll_env):
+    """A missing name mid-group raises PartialPushError with every
+    groupmate's version APPLIED (no double-apply ambiguity); a raw
+    client's push_all never touches PushQ (byte-identical legacy
+    path, pinned via the push_group recorder)."""
+    from brpc_tpu.observability import metrics as obs
+    from brpc_tpu.runtime.param_server import (ParameterClient,
+                                               ParameterServer,
+                                               PartialPushError)
+
+    params = {f"p{i}": np.ones((32 * 1024,), np.float32)
+              for i in range(6)}
+    srv = ParameterServer(dict(params))
+    srv.start()
+    cq = ParameterClient(f"tpu://127.0.0.1:{srv.port}", codec="int8")
+    craw = ParameterClient(f"tpu://127.0.0.1:{srv.port}")
+    pg = obs.latency("param_server_push_group")
+    try:
+        grads = {k: np.ones_like(v) for k, v in params.items()}
+        grads["ghost"] = np.ones((32 * 1024,), np.float32)
+        with pytest.raises(PartialPushError) as ei:
+            cq.push_all(grads)
+        e = ei.value
+        assert "ghost" in e.unpushed
+        assert sorted(e.applied) == sorted(params)
+        assert all(v == 1 for v in e.applied.values())
+
+        n0 = pg.count()
+        vr = craw.push_all({k: np.ones_like(v)
+                            for k, v in params.items()})
+        assert pg.count() == n0, "raw push_all used PushQ"
+        assert all(v == 2 for v in vr.values())
+    finally:
+        cq.close()
+        craw.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Native half: the collective step driver.
+# ---------------------------------------------------------------------------
+
+_MLP_SIZES = [64, 256, 256, 64]  # >=4KB layer grads: the quant/ring path
+
+
+def _drive_collective(env, tag, codec, ef, steps=4, overlap=True,
+                      wire_lanes=2, n=2):
+    """n-member data-parallel run -> (losses, params, last_trace) from
+    rank 0 (members assert bitwise agreement before returning)."""
+    from brpc_tpu.models.tensor_service import LayeredMLP
+    from brpc_tpu.runtime.step_driver import CollectiveStepDriver
+
+    groups = _mk_groups(env, tag, n, codec=codec, ef=ef)
+    results = {}
+    try:
+        def member(g):
+            h = LayeredMLP(list(_MLP_SIZES), seed=0)
+            d = CollectiveStepDriver(g, h, overlap=overlap,
+                                     wire_lanes=wire_lanes)
+            d.prime()
+            losses = []
+            for s in range(steps):
+                x, y = h.data(8, seed=500 + s * n + g.rank)
+                losses.append(d.step(x, y))
+            return losses, d.params(), d.last_trace
+
+        out, errs = _member_threads(groups, member)
+        assert not errs, errs
+        p0 = out[0][1]
+        for r in range(1, n):
+            for k in p0:
+                assert np.array_equal(p0[k], out[r][1][k]), \
+                    f"members diverged on {k}"
+        results = out[0]
+    finally:
+        for g in groups:
+            g.close()
+    return results
+
+
+def test_collective_driver_overlap_equals_serial(coll_env):
+    """overlap=True == overlap=False trajectories exactly (same fp ops
+    in the same order on one compute thread), and the overlapped trace
+    really used multiple named wire lanes."""
+    lo, po, tro = _drive_collective(coll_env, "drv_o", None, True,
+                                    overlap=True)
+    ls, ps, _trs = _drive_collective(coll_env, "drv_s", None, True,
+                                     overlap=False)
+    assert lo == ls
+    for k in po:
+        np.testing.assert_array_equal(po[k], ps[k])
+    assert len(tro.lane_busy_s) == 2, tro.lane_busy_s
+    assert all(ln.startswith("wire:ar") for ln in tro.lane_busy_s)
+
+
+def test_collective_driver_quant_parity_and_naive_control(coll_env):
+    """The acceptance pin: the quantized-EF trajectory matches the fp32
+    reduction within the documented 5e-2 tolerance, and the naive
+    requantizer (ef=False) is measurably worse — the linear-compounding
+    negative control."""
+    steps = 6
+    lr, pr, _t = _drive_collective(coll_env, "drv_raw", None, True,
+                                   steps=steps)
+    lq, pq, _t = _drive_collective(coll_env, "drv_qef", "int8", True,
+                                   steps=steps)
+    ln, pn, _t = _drive_collective(coll_env, "drv_qnv", "int8", False,
+                                   steps=steps)
+    d_ef = max(float(np.abs(pr[k] - pq[k]).max()) for k in pr)
+    d_nv = max(float(np.abs(pr[k] - pn[k]).max()) for k in pr)
+    # Documented tolerance (matches the PR 7 quantized-training pin):
+    # the EF trajectory stays within 5e-2 of the fp32 reduction.
+    assert d_ef < 5e-2, f"quantized-EF drifted {d_ef} from fp32"
+    assert max(abs(a - b) for a, b in zip(lr, lq)) < 5e-2
+    assert d_nv > d_ef, (
+        f"naive requantizer not worse than EF ({d_nv} vs {d_ef}) — "
+        "the negative control lost its teeth")
+
+
+def test_collective_driver_member_death_partial_salvage(coll_env):
+    """A member dying mid-step surfaces as CollectiveAborted with the
+    step post-mortem attached; the graph's other layers completed
+    (partial salvage across lanes), nothing wedged."""
+    from brpc_tpu.collectives.core import CollectiveAborted
+    from brpc_tpu.models.tensor_service import LayeredMLP
+    from brpc_tpu.runtime.step_driver import CollectiveStepDriver
+
+    groups = _mk_groups(coll_env, "drv_death", 2, op_timeout_s=6.0)
+    try:
+        def member(g):
+            h = LayeredMLP(list(_MLP_SIZES), seed=0)
+            d = CollectiveStepDriver(g, h, overlap=True)
+            d.prime()
+            x, y = h.data(8, seed=900 + g.rank)
+            if g.rank == 1:
+                time.sleep(0.1)
+                g.close()
+                return None
+            d.step(x, y)
+            return None
+
+        _out, errs = _member_threads(groups, member)
+        assert 0 in errs, "survivor did not fail"
+        e = errs[0]
+        assert isinstance(e, CollectiveAborted), type(e)
+        sf = getattr(e, "step_failure", None)
+        assert sf is not None, "no step post-mortem attached"
+        # Forward + every backward completed (compute lane salvage).
+        assert "fwd" in sf.done
+        assert any(n.startswith("bwd:") for n in sf.done)
+        # Only allreduce/opt nodes failed or were cancelled.
+        for n in list(sf.failed) + list(sf.cancelled):
+            assert n.startswith(("allreduce:", "opt:", "<wire:")), n
+    finally:
+        for g in groups[:1]:
+            g.close()

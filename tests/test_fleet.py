@@ -179,16 +179,27 @@ def test_fleet_scatter_gather_matches_single_server(fleet_env):
     from brpc_tpu.fleet import FleetClient
     from brpc_tpu.runtime.param_server import ParameterClient, ParameterServer
 
-    params = _mk_params(12)
+    shards = _fleet(fleet_env, "parity", 2)
+    fc = FleetClient(fleet_env["hub"].hostport, tag="parity",
+                     op_deadline_s=10.0)
+    # Pick names until BOTH shards own some: ketama placement keys on
+    # the shards' EPHEMERAL ports, and a fixed 12-name set lands
+    # entirely on one shard for ~0.07% of port pairs (hit in a real
+    # full-suite run; confirmed by simulating the failing pair) — the
+    # cross-shard assertions need tensors on each side by construction.
+    names, i = [], 0
+    while i < 200 and (len(names) < 12 or len(
+            {fc.map.owner(n) for n in names}) < 2):
+        names.append(f"w{i:02d}")
+        i += 1
+    params = {n: np.full((256,), float(k + 1), np.float32)
+              for k, n in enumerate(names)}
     grads = {k: np.full_like(v, 0.5) for k, v in params.items()}
 
     single = ParameterServer(params)
     single.start()
     spc = ParameterClient(f"tpu://127.0.0.1:{single.port}")
 
-    shards = _fleet(fleet_env, "parity", 2)
-    fc = FleetClient(fleet_env["hub"].hostport, tag="parity",
-                     op_deadline_s=10.0)
     try:
         for k, v in params.items():
             fc.install(k, v)

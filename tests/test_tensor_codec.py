@@ -440,9 +440,19 @@ def test_mixed_fleet_negotiates_per_shard(codec_env):
                      op_deadline_s=20.0)
     try:
         rng = _rng(9)
-        seeds = {f"t{i}": rng.normal(size=(1 << 14,)).astype(np.float32)
-                 for i in range(6)}
         fc.refresh()
+        # Pick names until BOTH shards own some: placement is ketama
+        # over the ephemeral server ports, and a fixed 6-name set lands
+        # entirely on one shard in ~3% of port draws — the mixed-fleet
+        # assertion needs tensors on each side by construction, not by
+        # luck (flaked twice in full-suite runs before this).
+        names, i = [], 0
+        while i < 200 and (len(names) < 6 or len(
+                {fc.map.owner(n) for n in names}) < 2):
+            names.append(f"t{i}")
+            i += 1
+        seeds = {n: rng.normal(size=(1 << 14,)).astype(np.float32)
+                 for n in names}
         for name, arr in seeds.items():
             fc.install(name, arr, refresh=False)
         placed = fc.meta()
