@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke train-smoke collectives-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke train-smoke collectives-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -39,6 +39,15 @@ obs-smoke:
 # skip cleanly there.
 serve-smoke:
 	python -m pytest tests/test_serving.py -q
+	python -m tools.tpulint
+
+# Fast local gate for the serving FLEET plane (the serve-smoke analog
+# one level up): routing determinism, migration/paging round trips, and
+# — with the native lib present — the live drain-migration parity,
+# prefill/decode split and /fleetz serving-column tests, then lint.
+# The pure halves run even without the native library.
+serving-fleet-smoke:
+	python -m pytest tests/test_serving_fleet.py -q
 	python -m tools.tpulint
 
 # Fast local gate for the overlapped training step (the obs-smoke

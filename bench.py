@@ -628,6 +628,288 @@ def serving_point(n_tok=40, drive_s=2.0, flood_threads=8, max_batch=4,
     return row
 
 
+_FLEET_MEMBER = r'''
+import sys
+sys.path.insert(0, sys.argv[1])
+import jax
+from brpc_tpu.models.decoder import init_decoder
+from brpc_tpu.serving import FleetServingServer
+srv = FleetServingServer(sys.argv[2], init_decoder(jax.random.PRNGKey(0)),
+                         tag=sys.argv[3], role=sys.argv[4],
+                         max_batch=int(sys.argv[5]), reg_ttl_s=3)
+srv.start()
+print("READY", srv.addr, flush=True)
+sys.stdin.readline()  # parent closes stdin to stop
+srv.stop()
+'''
+
+
+_SERVING_FLEET_CHILD = """
+import json, subprocess, sys, threading, time
+sys.path.insert(0, {root!r})
+from brpc_tpu.runtime import native
+try:
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
+from brpc_tpu.fleet import RegistryHub, clear_registry
+from brpc_tpu.serving import ServingFleetClient
+
+MEMBER = {member!r}
+ROOT = {root!r}
+N_TOK = {n_tok}
+DRIVE_S = {drive_s}
+WORKERS = {workers}
+
+def pctl(xs, q):
+    xs = sorted(xs)
+    return xs[max(0, int(len(xs) * q) - 1)] if xs else 0.0
+
+def spawn(hub, tag, role):
+    p = subprocess.Popen([sys.executable, "-c", MEMBER, ROOT, hub, tag,
+                          role, "4"], stdin=subprocess.PIPE,
+                         stdout=subprocess.PIPE, text=True)
+    line = p.stdout.readline().strip()
+    assert line.startswith("READY"), line
+    return p, line.split()[1]
+
+def stop(procs):
+    for p, _addr in procs:
+        try:
+            p.stdin.close()
+            p.wait(timeout=15)
+        except Exception:
+            p.kill()
+
+def drive(tag, roles):
+    # One serving-member PROCESS per role (in-process members contend in
+    # jax — the PR 6 finding); aggregate tokens/s + TTFT over WORKERS
+    # concurrent session loops against the whole fleet.
+    hub = RegistryHub()
+    hub.start()
+    procs = [spawn(hub.hostport, tag, r) for r in roles]
+    try:
+        c = ServingFleetClient(hub.hostport, tag=tag)
+        for i in range(2 * len(roles)):  # absorb every member's jit
+            c.generate([1], 2, session_key="warm-%d" % i)
+        stop_ev = threading.Event()
+        mu = threading.Lock()
+        stats = {{"tokens": 0, "ttfts": []}}
+        def worker(w):
+            cl = ServingFleetClient(hub.hostport, tag=tag)
+            i = 0
+            while not stop_ev.is_set():
+                ts = cl.open([3, 7, (i % 40) + 1], N_TOK,
+                             session_key="d%d-%d" % (w, i))
+                toks = list(ts)
+                ts.close()
+                with mu:
+                    stats["tokens"] += len(toks)
+                    if ts.ttft_s is not None:
+                        stats["ttfts"].append(ts.ttft_s * 1000.0)
+                i += 1
+            cl.close()
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(WORKERS)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(DRIVE_S)
+        stop_ev.set()
+        for t in threads:
+            t.join()
+        window = time.monotonic() - t0
+        c.close()
+        return {{
+            "members": len(roles), "roles": list(roles),
+            "tokens_s": round(stats["tokens"] / window, 1),
+            "ttft_p50_ms": round(pctl(stats["ttfts"], 0.50), 2),
+            "ttft_p99_ms": round(pctl(stats["ttfts"], 0.99), 2),
+            "sessions": len(stats["ttfts"]),
+        }}
+    finally:
+        stop(procs)
+        clear_registry()
+        hub.stop()
+
+row = {{
+    "fleet_1": drive("sf1", ["both"]),
+    "fleet_2": drive("sf2", ["both", "both"]),
+    "split_prefill_decode": drive("sfp", ["prefill", "decode"]),
+}}
+base = max(row["fleet_1"]["tokens_s"], 1e-9)
+row["tokens_s_x_2v1"] = round(row["fleet_2"]["tokens_s"] / base, 2)
+row["split_vs_colocated_tokens_s"] = round(
+    row["split_prefill_decode"]["tokens_s"]
+    / max(row["fleet_2"]["tokens_s"], 1e-9), 2)
+print(json.dumps(row))
+"""
+
+
+_SERVING_DRAIN_CHILD = """
+import json, subprocess, sys, threading, time
+sys.path.insert(0, {root!r})
+import jax
+from brpc_tpu.runtime import native
+try:
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
+from brpc_tpu.fleet import RegistryHub, clear_registry
+from brpc_tpu.models.decoder import decode_serial, init_decoder
+from brpc_tpu.serving import ServingFleetClient
+
+MEMBER = {member!r}
+ROOT = {root!r}
+N_TOK = {n_tok}
+STREAMS = {streams}
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+
+def pctl(xs, q):
+    xs = sorted(xs)
+    return xs[max(0, int(len(xs) * q) - 1)] if xs else 0.0
+
+def spawn(hub, tag):
+    p = subprocess.Popen([sys.executable, "-c", MEMBER, ROOT, hub, tag,
+                          "both", "4"], stdin=subprocess.PIPE,
+                         stdout=subprocess.PIPE, text=True)
+    line = p.stdout.readline().strip()
+    assert line.startswith("READY"), line
+    return p, line.split()[1]
+
+hub = RegistryHub()
+hub.start()
+pa, addr_a = spawn(hub.hostport, "sdr")
+pb, addr_b = spawn(hub.hostport, "sdr")
+try:
+    c = ServingFleetClient(hub.hostport, tag="sdr")
+    c.router.refresh()
+    # Warm BOTH members' jit with sticky keys before timing anything.
+    for addr in (addr_a, addr_b):
+        i = 0
+        while c.router.route("w-%s-%d" % (addr, i)) != addr:
+            i += 1
+        c.generate([1], 2, session_key="w-%s-%d" % (addr, i))
+    keys, i = [], 0
+    while len(keys) < STREAMS:
+        k = "dr-%d" % i
+        if c.router.route(k) == addr_a:
+            keys.append(k)
+        i += 1
+    prompts = {{k: [3, 7, (j % 40) + 1] for j, k in enumerate(keys)}}
+    refs = {{k: decode_serial(PARAMS, p, N_TOK, 64)
+            for k, p in prompts.items()}}
+    streams = {{k: c.open(p, N_TOK, session_key=k)
+               for k, p in prompts.items()}}
+    for ts in streams.values():
+        while len(ts.tokens) < 4:
+            ts.read_token(timeout_ms=10000)
+    def reader(ts):
+        list(ts)
+    threads = [threading.Thread(target=reader, args=(ts,))
+               for ts in streams.values()]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    ch = native.Channel(addr_a, timeout_ms=5000, max_retry=0)
+    ch.call("Gen/Drain", b"")  # async trigger; the streams show the rest
+    for t in threads:
+        t.join()
+    drain_wall_s = time.monotonic() - t0
+    ch.close()
+    gaps = [ts.last_gap_s * 1000.0 for ts in streams.values()
+            if ts.last_gap_s is not None]
+    row = {{
+        "streams": len(streams),
+        "migrated": sum(1 for ts in streams.values() if ts.resumes),
+        "token_parity": all(ts.tokens == refs[k]
+                            for k, ts in streams.items()),
+        "stream_gap_ms_p50": round(pctl(gaps, 0.50), 1),
+        "stream_gap_ms_max": round(max(gaps), 1) if gaps else 0.0,
+        "drain_wall_s": round(drain_wall_s, 2),
+    }}
+    for ts in streams.values():
+        ts.close()
+    c.close()
+finally:
+    for p in (pa, pb):
+        try:
+            p.stdin.close()
+            p.wait(timeout=15)
+        except Exception:
+            p.kill()
+    clear_registry()
+    hub.stop()
+print(json.dumps(row))
+"""
+
+
+def _run_guarded_child(name, code, timeout, wedge_log=None):
+    """The serving/overload child-runner shape: one subprocess under a
+    hard timeout; a wedge records dump files instead of hanging the
+    terminal."""
+    seen = set(_new_dump_files(set()))
+    try:
+        proc = subprocess.run(  # tpulint: allow(py-blocking)
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        row = {"wedged": True, "dump_files": _new_dump_files(seen)}
+        if wedge_log is not None:
+            wedge_log.append({"point": name,
+                              "dump_files": row["dump_files"]})
+        return row
+    out = proc.stdout.strip().splitlines()
+    if proc.returncode != 0 or not out:
+        raise RuntimeError(f"{name} child rc={proc.returncode}: "
+                           f"{proc.stderr.strip()[-800:]}")
+    return json.loads(out[-1])
+
+
+def serving_fleet_point(n_tok=24, drive_s=2.0, workers=4, wedge_log=None):
+    """Serving-fleet rows (ISSUE 14): aggregate tokens/s + TTFT p50/p99
+    at fleet size 1 vs 2 (one member process each), and the
+    prefill/decode split vs the colocated 2-member fleet — the
+    disaggregation cost/benefit on this box."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _SERVING_FLEET_CHILD.format(root=root, dump_dir=_dump_dir(),
+                                       member=_FLEET_MEMBER, n_tok=n_tok,
+                                       drive_s=drive_s, workers=workers)
+    row = _run_guarded_child("serving_fleet", code,
+                             240 + drive_s * 30, wedge_log)
+    if not row.get("wedged"):
+        print(f"# serving_fleet: tokens/s 1-member "
+              f"{row['fleet_1']['tokens_s']} -> 2-member "
+              f"{row['fleet_2']['tokens_s']} ({row['tokens_s_x_2v1']}x); "
+              f"split {row['split_prefill_decode']['tokens_s']} "
+              f"({row['split_vs_colocated_tokens_s']}x of colocated); "
+              f"ttft p99 {row['fleet_2']['ttft_p99_ms']}ms fleet-2 / "
+              f"{row['split_prefill_decode']['ttft_p99_ms']}ms split",
+              file=sys.stderr)
+    return row
+
+
+def serving_drain_point(n_tok=40, streams=3, wedge_log=None):
+    """The live-migration drive (ISSUE 14 acceptance row): STREAMS
+    mid-stream sessions on member A, Gen/Drain A, every stream resumes
+    on B — token parity asserted in-child, per-stream resume gap
+    reported in ms."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _SERVING_DRAIN_CHILD.format(root=root, dump_dir=_dump_dir(),
+                                       member=_FLEET_MEMBER, n_tok=n_tok,
+                                       streams=streams)
+    row = _run_guarded_child("serving_fleet_drain", code, 240, wedge_log)
+    if not row.get("wedged"):
+        print(f"# serving_fleet_drain: {row['migrated']}/{row['streams']} "
+              f"streams migrated, parity={row['token_parity']}, gap p50 "
+              f"{row['stream_gap_ms_p50']}ms max "
+              f"{row['stream_gap_ms_max']}ms "
+              f"(drain wall {row['drain_wall_s']}s)", file=sys.stderr)
+    return row
+
+
 def best_point(payload, transport, seconds=2, wedge_log=None):
     """Best (GB/s, qps, p99_us, concurrency) across the concurrency set.
 
@@ -753,6 +1035,19 @@ def main() -> None:
         sweep["serving_stream"] = serving_point(wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# serving_stream skipped: {e}", file=sys.stderr)
+
+    # Serving-fleet rows (ISSUE 14): aggregate tokens/s + TTFT vs fleet
+    # size 1/2 and prefill/decode split vs colocated, plus the live
+    # drain-migration drive (stream-gap ms, token parity).
+    try:
+        sweep["serving_fleet"] = serving_fleet_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# serving_fleet skipped: {e}", file=sys.stderr)
+    try:
+        sweep["serving_fleet_drain"] = serving_drain_point(
+            wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# serving_fleet_drain skipped: {e}", file=sys.stderr)
 
     # Overlapped-training-step rows (step-driver tentpole): serial vs
     # dependency-scheduled step on the RPC train loop. Headline config
@@ -1897,6 +2192,15 @@ def smoke() -> None:
                                     timeout=240))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["allreduce_GBps_2s"] = {"error": str(e)}
+    # Guarded serving-fleet mini-row: one 2-member drain-migration drive
+    # (2 mid-stream sessions) — if session routing, the KV ship path, or
+    # the resume replay breaks token parity, the smoke run shows it
+    # before the full sweep would.
+    try:
+        out["serving_fleet_drain"] = serving_drain_point(
+            n_tok=16, streams=2, wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["serving_fleet_drain"] = {"error": str(e)}
     if wedges:
         out["wedged_samples"] = wedges
     print(json.dumps({"metric": "bench_smoke", "sweep": out}))

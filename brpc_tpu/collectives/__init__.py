@@ -18,7 +18,8 @@ from brpc_tpu.collectives.core import (CollectiveAborted,  # noqa: F401
                                        CollectiveTimeout, E_COLL_ABORT,
                                        E_COLL_EPOCH, Mailbox, MemberLeft,
                                        ring_allgather, ring_allreduce,
-                                       tree_allreduce)
+                                       ring_reduce_scatter, tree_allreduce,
+                                       tree_broadcast)
 from brpc_tpu.collectives.quant import ChunkCodec  # noqa: F401
 from brpc_tpu.collectives.ring import (allgather_steps,  # noqa: F401
                                        chunk_spans, owned_chunk,
@@ -29,7 +30,8 @@ __all__ = [
     "CollectiveAborted", "CollectiveTimeout", "MemberLeft", "Mailbox",
     "ChunkCodec", "CollectiveGroup", "collective_metrics",
     "E_COLL_ABORT", "E_COLL_EPOCH",
-    "ring_allreduce", "ring_allgather", "tree_allreduce",
+    "ring_allreduce", "ring_allgather", "ring_reduce_scatter",
+    "tree_allreduce", "tree_broadcast",
     "chunk_spans", "ring_order", "owned_chunk", "reduce_order",
     "reduce_scatter_steps", "allgather_steps",
 ]

@@ -170,6 +170,91 @@ def ring_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
     return acc.reshape(np.shape(x))
 
 
+def ring_reduce_scatter(rank: int, n: int, x: np.ndarray,
+                        codec: ChunkCodec, link, name: str, codec_name=None,
+                        frag_elems: int = DEFAULT_FRAG_ELEMS):
+    """The ring's reduce-scatter phase as a standalone verb: sum ``x``
+    across the ring, each member keeping ONLY its owned chunk — the
+    bandwidth-optimal building block (S(n-1)/n bytes per member, half an
+    allreduce) for workloads that shard the reduced result anyway (a
+    sharded optimizer step; an allgather later completes an allreduce).
+    Returns ``((offset, length), fp32 chunk values)`` over the flattened
+    input — ``offset/length`` = ``chunk_spans(x.size, n)[owned_chunk]``,
+    identical on every member's derivation.
+
+    Each hop re-encodes (dequant -> add -> requant with the hop's own EF
+    position, exactly the allreduce rs phase), so the codec negotiates
+    per SUCCESSOR — no ring-wide agreement needed (unlike allgather
+    forwarding)."""
+    flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    spans = ring_mod.chunk_spans(flat.size, n)
+    own = ring_mod.owned_chunk(rank, n)
+    if n == 1:
+        return spans[0], flat.copy()
+    acc = flat.copy()
+    succ = (rank + 1) % n
+    for s, (send_idx, recv_idx) in enumerate(
+            ring_mod.reduce_scatter_steps(rank, n)):
+        off, ln = spans[send_idx]
+        try:
+            frags = codec.encode_chunk(f"{name}#rs{s}",
+                                       acc[off:off + ln], codec_name,
+                                       frag_elems)
+            for f, (meta, blob) in enumerate(frags):
+                link.send(succ, "rs", s, send_idx, meta, blob,
+                          frag=f, nfrags=len(frags))
+            roff, rln = spans[recv_idx]
+            for f, (fo, fl) in enumerate(
+                    ring_mod.fragment_spans(rln, frag_elems)):
+                _idx, rmeta, rblob = link.recv("rs", s, frag=f)
+                if fl:
+                    codec.reduce_into(rmeta, rblob,
+                                      acc[roff + fo:roff + fo + fl])
+        except CollectiveAborted as e:
+            e.done = {}  # no chunk is final until the last hop lands
+            raise
+    off, ln = spans[own]
+    return (off, ln), acc[off:off + ln].copy()
+
+
+def tree_broadcast(rank: int, n: int, x, codec: ChunkCodec, link,
+                   name: str, codec_name=None, root: int = 0,
+                   frag_elems: int = DEFAULT_FRAG_ELEMS) -> np.ndarray:
+    """One-to-all broadcast on the tree schedule: the root encodes ONCE
+    (quantized only when every receiver can decode it — the tree-
+    allreduce broadcast-leg rule) and sends to every other member; the
+    root ADOPTS its own dequantized form so all members return bitwise
+    identical arrays. Non-root members pass ``x=None`` — fragment 0's
+    metadata carries the shape (the allgather framing), which is all a
+    receiver needs."""
+    if n == 1:
+        return np.ascontiguousarray(x, dtype=np.float32).copy()
+    if rank != root:
+        _idx, rmeta0, rblob0 = link.recv("bc", 0, frag=0)
+        nfrags = int(rmeta0.get("nfrags", 1))
+        parts = [codec.decode(rmeta0, rblob0)]
+        for f in range(1, nfrags):
+            _idx, rmeta, rblob = link.recv("bc", 0, frag=f)
+            parts.append(codec.decode(rmeta, rblob))
+        return np.concatenate(parts).reshape(rmeta0.get("oshape", [-1]))
+    if x is None:
+        raise ValueError("broadcast root must supply the array")
+    flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    shape = list(np.shape(x))
+    frags = codec.encode_chunk(f"{name}#bc", flat, codec_name, frag_elems)
+    frags[0] = (dict(frags[0][0], oshape=shape, src=root,
+                     nfrags=len(frags)), frags[0][1])
+    for dst in range(n):
+        if dst == root:
+            continue
+        for f, (meta, blob) in enumerate(frags):
+            link.send(dst, "bc", 0, root, meta, blob,
+                      frag=f, nfrags=len(frags))
+    parts = [codec.decode(meta, blob) for meta, blob in frags]
+    out = np.concatenate(parts) if parts else flat.copy()
+    return out.reshape(shape)
+
+
 def tree_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
                    link, name: str, codec_name=None) -> np.ndarray:
     """The small-tensor latency play: leaves send to the root, the root

@@ -75,6 +75,8 @@ def collective_metrics():
             _metrics_cache = {
                 "allreduce": obs.latency("collective_allreduce"),
                 "allgather": obs.latency("collective_allgather"),
+                "reduce_scatter": obs.latency("collective_reduce_scatter"),
+                "broadcast": obs.latency("collective_broadcast"),
                 "ops": obs.counter("collective_ops"),
                 "aborts": obs.counter("collective_aborts"),
                 # Logical vs wire: the quantized-collective bandwidth win
@@ -86,8 +88,8 @@ def collective_metrics():
             from brpc_tpu.observability.metrics import NullSeries
 
             _metrics_cache = {k: NullSeries() for k in (
-                "allreduce", "allgather", "ops", "aborts",
-                "logical_bytes", "wire_bytes")}
+                "allreduce", "allgather", "reduce_scatter", "broadcast",
+                "ops", "aborts", "logical_bytes", "wire_bytes")}
     return _metrics_cache
 
 
@@ -623,6 +625,95 @@ class CollectiveGroup:
         self._m["ops"].add(1)
         self._m["wire_bytes"].add(link.wire_bytes)
         self._m["logical_bytes"].add(int(host.nbytes * (n - 1)))
+        return out
+
+    def reduce_scatter(self, name: str, array,
+                       timeout_s: Optional[float] = None):
+        """Sum ``array`` across the ring, keeping only this member's
+        owned chunk -> ``((offset, length), fp32 values)`` over the
+        flattened input (every member derives the same span layout from
+        ``ring.chunk_spans``). Half an allreduce's bytes; the verb for
+        consumers that shard the reduced result anyway. Per-successor
+        codec negotiation — each hop re-encodes."""
+        members = self._pre_op(name)
+        n = len(members)
+        host = np.ascontiguousarray(np.asarray(array), dtype=np.float32)
+        seq = self._next_seq(name)
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.op_timeout_s)
+        codec_name = self._codec_for(members[(self.rank + 1) % n]) \
+            if n > 1 else None
+        link = _RpcLink(self, name, seq, deadline)
+        t0 = time.monotonic()
+        ok = False
+        with tracing.trace_span("collective/reduce_scatter"):
+            tracing.annotate(f"op={name} seq={seq} n={n} "
+                             f"bytes={host.nbytes}")
+            try:
+                span, chunk = core.ring_reduce_scatter(
+                    self.rank, n, host, self.chunk_codec, link, name,
+                    codec_name, frag_elems=self.frag_elems)
+                ok = True
+            finally:
+                try:
+                    link.close(ok)
+                except native.RpcError as e:
+                    raise self._map_rpc_error(e, "close", -1)
+                finally:
+                    self._mailbox.drop_op((name, seq))
+                    if not ok:
+                        self._m["aborts"].add(1)
+        self._m["reduce_scatter"].record_s(time.monotonic() - t0)
+        self._m["ops"].add(1)
+        self._m["wire_bytes"].add(link.wire_bytes)
+        self._m["logical_bytes"].add(int(host.nbytes * (n - 1) / n))
+        return span, chunk
+
+    def broadcast(self, name: str, array=None, root: int = 0,
+                  timeout_s: Optional[float] = None) -> np.ndarray:
+        """One-to-all: rank ``root`` supplies ``array``; every member
+        (root included, which adopts its own dequantized encode) returns
+        the bitwise-identical fp32 result. The root quantizes only when
+        EVERY member advertised the codec (one encode serves all — the
+        tree-allreduce broadcast-leg rule)."""
+        members = self._pre_op(name)
+        n = len(members)
+        host = None
+        if array is not None:
+            host = np.ascontiguousarray(np.asarray(array),
+                                        dtype=np.float32)
+        if self.rank == root and host is None:
+            raise ValueError("broadcast root must supply the array")
+        seq = self._next_seq(name)
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.op_timeout_s)
+        codec_name = self._ring_codec(members) \
+            if n > 1 and self.rank == root else None
+        link = _RpcLink(self, name, seq, deadline)
+        t0 = time.monotonic()
+        ok = False
+        with tracing.trace_span("collective/broadcast"):
+            tracing.annotate(f"op={name} seq={seq} n={n} root={root}")
+            try:
+                out = core.tree_broadcast(self.rank, n, host,
+                                          self.chunk_codec, link, name,
+                                          codec_name, root=root,
+                                          frag_elems=self.frag_elems)
+                ok = True
+            finally:
+                try:
+                    link.close(ok)
+                except native.RpcError as e:
+                    raise self._map_rpc_error(e, "close", -1)
+                finally:
+                    self._mailbox.drop_op((name, seq))
+                    if not ok:
+                        self._m["aborts"].add(1)
+        self._m["broadcast"].record_s(time.monotonic() - t0)
+        self._m["ops"].add(1)
+        self._m["wire_bytes"].add(link.wire_bytes)
+        if self.rank == root:
+            self._m["logical_bytes"].add(int(host.nbytes * (n - 1)))
         return out
 
     # ---- lifecycle ----

@@ -87,6 +87,34 @@ class ShardMap:
             i = 0  # the ring wraps
         return self._points[i][1]
 
+    def preference(self, name: str,
+                   limit: Optional[int] = None) -> List[str]:
+        """Distinct shards in RING ORDER from `name`'s point: element 0
+        is ``owner()``; the rest are the deterministic spill order (a
+        quota/drain answer at the owner walks clockwise to the next
+        distinct shard — the ketama replica-choice rule, so every router
+        instance derives the SAME fallback chain with no coordination).
+        A live override leads the list like it leads ownership."""
+        out: List[str] = []
+        ov = self.overrides.get(name)
+        if ov is not None and ov in self.shards:
+            out.append(ov)
+        if not self._points:
+            if not out:
+                raise LookupError("shard map is empty (no live shards)")
+            return out
+        if limit is not None and len(out) >= limit:
+            return out  # the override head counts toward the limit
+        i = bisect.bisect_left(self._keys, key_point(name))
+        n = len(self._points)
+        for j in range(n):
+            addr = self._points[(i + j) % n][1]
+            if addr not in out:
+                out.append(addr)
+                if limit is not None and len(out) >= limit:
+                    break
+        return out
+
     def assignment(self, names: Iterable[str]) -> Dict[str, List[str]]:
         """Group `names` by owning shard -> {addr: [names...]}, the
         scatter plan for a cross-shard pull_all/push_all."""

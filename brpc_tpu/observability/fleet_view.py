@@ -291,10 +291,13 @@ def relabel_exposition(text: str, shard: str) -> str:
 
 def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
     """The native /fleetz per-shard fold: sum qps / max p99 over the
-    rpc_server_* recorders, the codec byte counters, and the max
-    param_server_version_lag_* — over (name, value) series pairs."""
+    rpc_server_* recorders, the codec byte counters, the max
+    param_server_version_lag_*, and the serving_* columns (tokens/s,
+    live sessions, TTFT p99) — over (name, value) series pairs."""
     out = {"qps": 0.0, "p99_us": 0, "codec_bytes_logical": 0,
-           "codec_bytes_wire": 0, "version_lag_max": 0}
+           "codec_bytes_wire": 0, "version_lag_max": 0,
+           "serving_tokens_s": 0.0, "serving_sessions": 0,
+           "serving_ttft_p99_us": 0}
     for name, value in pairs:
         try:
             if name.startswith("rpc_server_"):
@@ -309,6 +312,13 @@ def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
             elif name.startswith("param_server_version_lag_"):
                 out["version_lag_max"] = max(out["version_lag_max"],
                                              int(float(value)))
+            elif name == "serving_token_emit_qps":
+                # One recorder sample per emitted token: qps IS tokens/s.
+                out["serving_tokens_s"] = float(value)
+            elif name == "serving_sessions":
+                out["serving_sessions"] = int(float(value))
+            elif name == "serving_ttft_latency_99":
+                out["serving_ttft_p99_us"] = int(float(value))
         except ValueError:
             continue  # non-numeric var under a matched prefix
     return out
@@ -354,7 +364,9 @@ def fold_flags(text: str) -> dict:
 
 def rollup(shards: List[dict]) -> dict:
     """Fleet rollup over per-shard scrape rows (the /fleetz rollup shape):
-    sum qps, max p99, WORST health, aggregate codec ratio, max lag."""
+    sum qps, max p99, WORST health, aggregate codec ratio, max lag —
+    plus the serving columns: aggregate tokens/s, live sessions, worst
+    TTFT p99."""
     worst = 0
     logical = wire = 0
     roll = {"members": len(shards),
@@ -364,6 +376,13 @@ def rollup(shards: List[dict]) -> dict:
                               default=0),
             "version_lag_max": max([s.get("version_lag_max", 0)
                                     for s in shards], default=0),
+            "serving_tokens_s_total": sum(s.get("serving_tokens_s", 0.0)
+                                          for s in shards),
+            "serving_sessions_total": sum(s.get("serving_sessions", 0)
+                                          for s in shards),
+            "serving_ttft_p99_max_us": max(
+                [s.get("serving_ttft_p99_us", 0) for s in shards],
+                default=0),
             "rpcz_off": sorted(s["addr"] for s in shards
                                if s.get("rpcz_enabled") == 0)}
     for s in shards:
@@ -588,6 +607,12 @@ class FleetObserver:
             f"fleet_codec_ratio_x1000 {int(roll['codec_ratio'] * 1000)}",
             f"fleet_version_lag_max {roll['version_lag_max']}",
             f"fleet_members_reachable {roll['reachable']}",
+            f"fleet_serving_tokens_s_total "
+            f"{roll['serving_tokens_s_total']:.1f}",
+            f"fleet_serving_sessions_total "
+            f"{roll['serving_sessions_total']}",
+            f"fleet_serving_ttft_p99_max_us "
+            f"{roll['serving_ttft_p99_max_us']}",
         ])
 
     def publish_rollup_gauges(self) -> None:
@@ -629,4 +654,10 @@ class FleetObserver:
                               reader("version_lag_max"))
         obs.repointable_gauge("fleet_members_reachable",
                               reader("reachable"))
+        obs.repointable_gauge("fleet_serving_tokens_s_total",
+                              reader("serving_tokens_s_total"))
+        obs.repointable_gauge("fleet_serving_sessions_total",
+                              reader("serving_sessions_total"))
+        obs.repointable_gauge("fleet_serving_ttft_p99_max_us",
+                              reader("serving_ttft_p99_max_us"))
         self._gauges_published = True

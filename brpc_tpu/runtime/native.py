@@ -42,7 +42,35 @@ PRIORITY_BULK = 2
 TRPC_ELIMIT = 1011
 TRPC_EOVERCROWDED = 2006
 
+# Structural app-error codes, continuing the 2040+ range (param_server.py
+# holds E_NO_SUCH 2040..E_EXISTS 2043, tensor.py E_UNDECODABLE 2044,
+# collectives E_COLL_EPOCH 2045/E_COLL_ABORT 2046). These two are the
+# serving fleet's routing signals; they live HERE so RpcError can
+# classify them without importing the serving plane:
+#   E_DRAINING      — the server refuses new sessions while it migrates
+#                     its live ones out: retriable elsewhere/later, text
+#                     carries the standard retry_after_ms pacer hint.
+#   E_SESSION_MOVED — the session now lives on another server: the text
+#                     carries "moved:<addr>" and the client FOLLOWS it
+#                     (Gen/Resume), exactly the E_MOVED "moved:" shape
+#                     the parameter fleet uses — keyed on the CODE, never
+#                     on message strings.
+E_DRAINING = 2047
+E_SESSION_MOVED = 2048
+
 _RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+)")
+_MOVED_RE = re.compile(r"moved:([^\s;,]+)")
+
+
+def parse_moved(text: str) -> Optional[str]:
+    """The ONE parser for the "moved:<addr>" forwarding grammar (error
+    texts, E-frames, shed reasons) — every consumer (RpcError.moved_to,
+    SessionShed.moved, the serving fleet's forwarding table) shares it
+    so the grammar cannot drift between implementations."""
+    if not text:
+        return None
+    m = _MOVED_RE.search(text)
+    return m.group(1) if m else None
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _LIB_PATH = os.path.join(_REPO, "native", "build", "libbrpc_tpu.so")
@@ -394,6 +422,23 @@ class RpcError(Exception):
         or a shard died (the fleet retry layer keeps them out of its
         reshard handling)."""
         return self.code in (TRPC_ELIMIT, TRPC_EOVERCROWDED)
+
+    @property
+    def draining(self) -> bool:
+        """True when the server refused because it is draining
+        (E_DRAINING): the request is fine, THIS server is leaving —
+        retriable on another member, paced by retry_after_ms like an
+        overload shed, but never counted as overload/capacity evidence."""
+        return self.code == E_DRAINING
+
+    @property
+    def moved_to(self) -> Optional[str]:
+        """The forwarding address of an E_SESSION_MOVED redirect (the
+        "moved:<addr>" the text carries), or None — classification keys
+        on the code; only a moved error is ever parsed for an address."""
+        if self.code != E_SESSION_MOVED:
+            return None
+        return parse_moved(self.text)
 
 
 class Server:

@@ -19,14 +19,18 @@ other session's completion.
 
 from brpc_tpu.serving.client import ServingClient, SessionShed, TokenStream
 from brpc_tpu.serving.engine import DecodeEngine
+from brpc_tpu.serving.fleet import FleetServingServer
+from brpc_tpu.serving.router import (FleetTokenStream, ServingFleetClient,
+                                     ServingRouter)
 from brpc_tpu.serving.server import ServingServer
-from brpc_tpu.serving.session import (ACTIVE, DONE, QUEUED, SHED,
+from brpc_tpu.serving.session import (ACTIVE, DONE, FROZEN, QUEUED, SHED,
                                       CallableSink, Session, SessionManager,
                                       serving_metrics)
 
 __all__ = [
-    "ACTIVE", "DONE", "QUEUED", "SHED",
-    "CallableSink", "DecodeEngine", "ServingClient", "ServingServer",
-    "Session", "SessionManager", "SessionShed", "TokenStream",
-    "serving_metrics",
+    "ACTIVE", "DONE", "FROZEN", "QUEUED", "SHED",
+    "CallableSink", "DecodeEngine", "FleetServingServer",
+    "FleetTokenStream", "ServingClient", "ServingFleetClient",
+    "ServingRouter", "ServingServer", "Session", "SessionManager",
+    "SessionShed", "TokenStream", "serving_metrics",
 ]

@@ -20,11 +20,24 @@ from brpc_tpu.serving.session import FRAME_ERROR, FRAME_TOKEN
 
 class SessionShed(native.RpcError):
     """The server shed this session mid-stream (deadline, slow reader,
-    quota, shutdown); ``reason`` carries the server's E-frame text."""
+    quota, shutdown — or a migration retire); ``reason`` carries the
+    server's E-frame text, ``code`` the error-coded close when the shed
+    arrived as a coded CLOSE frame (E_SESSION_MOVED = the session lives
+    on, follow it with Gen/Resume)."""
 
-    def __init__(self, reason: str):
-        super().__init__(native.TRPC_ELIMIT, f"session shed: {reason}")
+    def __init__(self, reason: str, code: int = native.TRPC_ELIMIT):
+        super().__init__(code or native.TRPC_ELIMIT,
+                         f"session shed: {reason}")
         self.reason = reason
+
+    @property
+    def moved(self) -> Optional[str]:
+        """The migration forwarding address, from the E-frame's
+        "moved:<addr>" text — None when this shed is not a move (the
+        coded-close-only case still reads as moved via ``code``)."""
+        if self.reason.startswith("moved:"):
+            return native.parse_moved(self.reason)
+        return None
 
 
 class TokenStream:
@@ -53,16 +66,20 @@ class TokenStream:
             if e.error:
                 # The server closed with an error code (credit-exempt
                 # CLOSE frame): a shed, even when the E-frame carrying
-                # the reason couldn't fit our full window.
-                raise SessionShed(
-                    f"stream closed with error {e.error}") from None
+                # the reason couldn't fit our full window. The code rides
+                # along so a fleet client can key E_SESSION_MOVED off it.
+                raise SessionShed(f"stream closed with error {e.error}",
+                                  code=e.error) from None
             raise StopIteration from None
         if frame is None:
             return None
         if frame.startswith(FRAME_ERROR):
             self._done = True
-            raise SessionShed(frame[len(FRAME_ERROR):].decode(
-                errors="replace"))
+            reason = frame[len(FRAME_ERROR):].decode(errors="replace")
+            raise SessionShed(
+                reason, code=(native.E_SESSION_MOVED
+                              if reason.startswith("moved:")
+                              else native.TRPC_ELIMIT))
         token = int(frame[len(FRAME_TOKEN):])
         if self.ttft_s is None:
             self.ttft_s = time.monotonic() - self.opened_at
@@ -106,22 +123,49 @@ class ServingClient:
     def open(self, prompt: List[int], max_tokens: int = 16, *,
              deadline_ms: Optional[int] = None,
              priority: Optional[int] = None,
-             recv_window: int = 256 << 10) -> TokenStream:
+             recv_window: int = 256 << 10,
+             session: Optional[str] = None) -> TokenStream:
         """Open a generation session; raises RpcError (``.overloaded``
-        with a retry hint) when the server sheds the OPEN. `priority` is
-        the SESSION's batch-admission lane (BULK default — token data);
-        the Open RPC itself always rides HIGH (control)."""
+        with a retry hint, ``.draining`` when the server is leaving the
+        fleet) when the server sheds the OPEN. `priority` is the
+        SESSION's batch-admission lane (BULK default — token data); the
+        Open RPC itself always rides HIGH (control). `session` picks the
+        session id — the serving fleet's sticky routing key."""
         req = {"prompt": list(prompt), "max_tokens": max_tokens}
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
         if priority is not None:
             req["priority"] = priority
+        if session is not None:
+            req["session"] = session
         with native.qos(native.PRIORITY_HIGH, self.tenant):
             stream, body = native.open_stream(
                 self.channel, "Gen/Open", json.dumps(req).encode(),
                 max_buf_size=recv_window)
         sid = str(json.loads(body.decode()).get("session", ""))
         return TokenStream(self, sid, stream)
+
+    def resume(self, session_id: str, have: int = 0, *,
+               recv_window: int = 256 << 10) -> TokenStream:
+        """Re-attach to a session that migrated HERE (``have`` = tokens
+        already received — the server replays everything after them, so
+        the stream stays prefix-exact across the move). Raises RpcError:
+        E_SESSION_MOVED with ``.moved_to`` when it moved again (follow
+        it), E_NO_SUCH when this server never had it."""
+        req = {"session": session_id, "have": int(have)}
+        with native.qos(native.PRIORITY_HIGH, self.tenant):
+            stream, _body = native.open_stream(
+                self.channel, "Gen/Resume", json.dumps(req).encode(),
+                max_buf_size=recv_window)
+        return TokenStream(self, session_id, stream)
+
+    def locate(self, session_id: str) -> Optional[str]:
+        """Where a session this server used to hold went: the forwarding
+        address recorded by its migration retire, or None (unknown /
+        still local)."""
+        resp, _ = self.channel.call("Gen/Locate", json.dumps(
+            {"session": session_id}).encode())
+        return json.loads(resp.decode()).get("moved") or None
 
     def generate(self, prompt: List[int], max_tokens: int = 16,
                  **kw) -> List[int]:

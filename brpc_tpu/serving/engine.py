@@ -35,8 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from brpc_tpu.models.decoder import DecoderParams, decode_step, init_decoder
-from brpc_tpu.serving.session import (ACTIVE, DONE, FRAME_TOKEN, QUEUED,
-                                      SHED, Session, SessionManager,
+from brpc_tpu.serving.session import (ACTIVE, DONE, FRAME_TOKEN, FROZEN,
+                                      QUEUED, SHED, Session, SessionManager,
                                       serving_metrics)
 
 
@@ -58,6 +58,10 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.step_idle_s = step_idle_s
         self.steps = 0
+        # Serving-fleet hook: called (engine thread, must only enqueue)
+        # when a prefill-role session freezes at its handoff point — the
+        # fleet server ships it to a decode member from its own thread.
+        self.on_session_frozen = None
         self._lanes: List[Optional[Session]] = [None] * max_batch
         self._mu = threading.Lock()
         self._wake = threading.Condition(self._mu)
@@ -125,15 +129,21 @@ class DecodeEngine:
 
     def _admit(self) -> None:
         """Fill free lanes from QUEUED sessions, HIGH priority first (PR 9
-        lanes applied to batch admission), then open order."""
+        lanes applied to batch admission), then open order. PARKED
+        sessions (imported by a migration, no sink until the client's
+        Resume attaches one) are skipped; paged-out sessions fault their
+        KV back in here — the "next decode" of the paging contract."""
         free = [i for i, s in enumerate(self._lanes) if s is None]
         if not free:
             return
-        queued = [s for s in self.manager.live() if s.state == QUEUED]
+        queued = [s for s in self.manager.live()
+                  if s.state == QUEUED and s.sink is not None]
         queued.sort(key=lambda s: (s.priority, s.opened_at))
         for sess in queued:
             if not free:
                 break
+            if sess.paged and not self.manager.fault_in(sess):
+                continue  # arena still exhausted: stays queued for now
             # Atomic under the manager lock: a Gen/Close racing this
             # admission loses cleanly (activate False) instead of being
             # resurrected onto a lane with freed KV views.
@@ -183,6 +193,7 @@ class DecodeEngine:
                 sess.ttft_s = now - sess.opened_at
                 self._m["ttft"].record_s(sess.ttft_s)
             sess.emitted += 1
+            sess.out_tokens.append(token)  # the resume-replay record
             self._m["tokens"].add(1)
             self._m["token"].record_us(1)  # one sample per token: qps
         return ok
@@ -204,12 +215,25 @@ class DecodeEngine:
         # Sweep lanes whose session was finished EXTERNALLY (client
         # Close, shutdown) since the last step: free the lane and release
         # the KV range finish() deferred to us — the one point where no
-        # step can be mid-write into it.
+        # step can be mid-write into it. FROZEN sessions (a migrator's
+        # freeze landing mid-step) free their lane the same way but KEEP
+        # their KV: lane == -1 is the exporter's it-is-safe-to-read
+        # signal, and the range stays live for the export.
         for i, sess in enumerate(self._lanes):
-            if sess is not None and sess.state in (DONE, SHED):
+            if sess is None:
+                continue
+            if sess.state in (DONE, SHED):
                 self._lanes[i] = None
                 sess.lane = -1
                 self.manager.release_kv(sess)
+            elif sess.state == FROZEN:
+                # State re-checked UNDER the manager lock: an unfreeze
+                # (failed ship resuming locally) racing this sweep must
+                # either win (session back to ACTIVE, keeps its lane) or
+                # lose (lane parked, unfreeze re-queues it) — never leave
+                # an off-lane ACTIVE session or a double-laned one.
+                if self.manager.park_frozen_lane(sess):
+                    self._lanes[i] = None
         self._admit()
         active = [s for s in self._lanes if s is not None]
         if not active:
@@ -256,6 +280,7 @@ class DecodeEngine:
                 # mid-step plane retry/fall back instead of seeing a
                 # half-written row).
                 self.manager.kv_begin_step(decodable)
+                handoffs = []
                 for sess in decodable:
                     if sess.state != ACTIVE:
                         continue  # finished externally mid-step: swept
@@ -267,6 +292,23 @@ class DecodeEngine:
                     if sess.pos < len(sess.prompt):
                         continue  # prefill: consume prompt, emit nothing
                     sess.token = int(nxt[i])
+                    if sess.prefill_handoff and sess.emitted == 0:
+                        # Disaggregation handoff point: the prompt rows
+                        # are all in KV and the first token is computed.
+                        # Record it as generated-but-not-streamed (the
+                        # DECODE server replays it at resume — prefill is
+                        # throughput-shaped; TTFT belongs to decode) and
+                        # freeze after the publish below. The EOS clamp
+                        # must apply HERE too (the normal emit path below
+                        # is skipped): it rides the manifest's max_tokens
+                        # so the destination — or the local fallback —
+                        # stops exactly where colocated decode would.
+                        sess.out_tokens.append(sess.token)
+                        sess.emitted += 1
+                        if sess.token == self.eos_id:
+                            sess.max_tokens = sess.emitted
+                        handoffs.append(sess)
+                        continue
                     if not self._emit(sess, sess.token, now):
                         self._retire(sess, shed_reason=sess.shed_reason)
                         continue
@@ -277,6 +319,16 @@ class DecodeEngine:
                 # the republish just restores an even seq).
                 for sess in decodable:
                     self.manager.publish_kv(sess)
+                # Freeze prefill-complete sessions AFTER the commit above
+                # so the exporter (lane == -1 is its go signal) only ever
+                # reads a fully published position.
+                for sess in handoffs:
+                    if 0 <= sess.lane < len(self._lanes):
+                        self._lanes[sess.lane] = None
+                    sess.lane = -1
+                    if self.manager.freeze(sess) \
+                            and self.on_session_frozen is not None:
+                        self.on_session_frozen(sess)
             self.steps += 1
         self._drain_finished(now)
         return True

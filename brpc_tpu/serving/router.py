@@ -1,0 +1,379 @@
+"""Session routing for the serving fleet: sticky ketama placement with
+quota/drain-aware spill, and the redirect-following fleet client.
+
+:class:`ServingRouter` is pure placement: the registry's membership list
+(or a static member list — the tier-1 mode) through the SAME ketama
+:class:`~brpc_tpu.fleet.shard_map.ShardMap` the parameter fleet routes
+by, keyed by SESSION ID. Every router instance — every client process,
+every prefill server picking a handoff destination — derives the
+IDENTICAL owner and the IDENTICAL clockwise spill chain from the
+membership list alone, with no coordination RPC (the determinism the
+acceptance test pins). Load-awareness is a local penalty box: an
+ELIMIT/E_DRAINING answer benches that member for the server's
+retry_after hint, so spill traffic walks the ring instead of hammering
+the shedding owner.
+
+:class:`ServingFleetClient` is one client to the whole fleet: ``open``
+routes sticky-by-session-id with spill, prefers prefill members when the
+fleet is disaggregated, and returns a :class:`FleetTokenStream` whose
+reads FOLLOW ``moved:`` redirects — an E_SESSION_MOVED-coded close (or a
+"moved:<addr>" E-frame) triggers a ``Gen/Resume`` at the destination
+carrying ``have`` = tokens already received, so the stream stays
+prefix-exact across live migrations and prefill/decode handoffs: never a
+torn or duplicated token, at most a bounded gap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional
+
+from brpc_tpu.fleet.shard_map import ShardMap
+from brpc_tpu.runtime import native
+from brpc_tpu.runtime.param_server import (E_EXISTS, E_MIGRATING, E_MOVED,
+                                           E_NO_SUCH)
+from brpc_tpu.serving.client import ServingClient, SessionShed, TokenStream
+
+
+class ServingRouter:
+    """Sticky session placement over the fleet membership.
+
+    ``members=`` pins a static list (pure mode: tier-1 determinism units
+    and embedded rings); otherwise membership comes from the registry
+    tag and ``refresh()`` re-derives the map. ``penalize()`` implements
+    the load/quota awareness: a benched member drops to the BACK of the
+    candidate walk until its penalty expires (it never disappears — with
+    everyone benched, the walk still visits everyone)."""
+
+    # A refresh() inside this window is a no-op: routing reads per-open
+    # must not each pay a registry round trip (membership edges are
+    # sub-second via the watch plane, and spill covers the lag).
+    REFRESH_TTL_S = 0.5
+
+    def __init__(self, registry_hostport: Optional[str] = None,
+                 tag: str = "serving",
+                 members: Optional[List[str]] = None):
+        if registry_hostport is None and members is None:
+            raise ValueError("need a registry hostport or a member list")
+        self._registry = registry_hostport
+        self._tag = tag
+        self._mu = threading.Lock()
+        self._penalty: Dict[str, float] = {}
+        self._map: Optional[ShardMap] = None
+        self._last_refresh = 0.0
+        if members is not None:
+            self._map = ShardMap(members)
+
+    def refresh(self, force: bool = False) -> None:
+        if self._registry is None:
+            return  # static membership: nothing to poll
+        with self._mu:
+            if not force and self._map is not None and \
+                    time.monotonic() - self._last_refresh \
+                    < self.REFRESH_TTL_S:
+                return
+        from brpc_tpu.fleet import registry
+
+        index, addrs = registry.list_servers(self._registry, self._tag)
+        with self._mu:
+            self._last_refresh = time.monotonic()
+            if self._map is None or self._map.shards != tuple(
+                    sorted(set(addrs))):
+                self._map = ShardMap(addrs, epoch=index)
+
+    def members(self) -> List[str]:
+        with self._mu:
+            return list(self._map.shards) if self._map is not None else []
+
+    def route(self, session_id: str) -> str:
+        """The sticky owner for ``session_id`` (ignores penalties —
+        pure placement; ``candidates`` is the spill-aware walk)."""
+        with self._mu:
+            if self._map is None or not len(self._map):
+                raise LookupError("no serving members")
+            return self._map.owner(session_id)
+
+    def candidates(self, session_id: str) -> List[str]:
+        """The spill walk: owner first, then the ring clockwise —
+        currently-penalized members moved to the back (stable order
+        within each half, so routing stays deterministic given the same
+        membership and penalty state)."""
+        with self._mu:
+            if self._map is None or not len(self._map):
+                raise LookupError("no serving members")
+            pref = self._map.preference(session_id)
+            now = time.monotonic()
+            for addr in [a for a in self._penalty
+                         if self._penalty[a] <= now]:
+                del self._penalty[addr]
+            benched = self._penalty
+            return ([a for a in pref if a not in benched]
+                    + [a for a in pref if a in benched])
+
+    def penalize(self, addr: str, for_s: float = 0.1) -> None:
+        with self._mu:
+            self._penalty[addr] = max(self._penalty.get(addr, 0.0),
+                                      time.monotonic() + for_s)
+
+
+class FleetTokenStream:
+    """A TokenStream that survives migrations: reads follow
+    E_SESSION_MOVED closes / "moved:" E-frames through ``Gen/Resume``
+    transparently. ``tokens`` is the full prefix-exact list;
+    ``resumes``/``last_gap_s`` expose the migration cost (the bench's
+    stream-gap statistic)."""
+
+    def __init__(self, client: "ServingFleetClient", session_id: str,
+                 ts: TokenStream, addr: str):
+        self._fc = client
+        self.session_id = session_id
+        self._ts = ts
+        self.addr = addr          # member currently serving the stream
+        self.tokens: List[int] = []
+        self.opened_at = time.monotonic()
+        self.ttft_s: Optional[float] = None
+        self.resumes = 0
+        self.last_gap_s: Optional[float] = None
+        self._done = False
+        self._failed: Optional[Exception] = None
+
+    def read_token(self, timeout_ms: int = -1) -> Optional[int]:
+        """Next token, None on timeout; StopIteration at clean EOF;
+        SessionShed for a NON-migration shed. A migration shed resumes
+        at the destination and keeps reading. A FAILED resume is sticky:
+        later reads re-raise it — a truncated stream must never read as
+        a clean EOF."""
+        if self._failed is not None:
+            raise self._failed
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                tok = self._ts.read_token(timeout_ms)
+            except StopIteration:
+                self._done = True
+                raise
+            except SessionShed as e:
+                if e.code != native.E_SESSION_MOVED:
+                    self._done = True
+                    raise
+                gap_t0 = time.monotonic()
+                try:
+                    self._follow(e.moved)
+                except Exception as follow_err:
+                    self._failed = follow_err
+                    raise
+                self.resumes += 1
+                self.last_gap_s = time.monotonic() - gap_t0
+                continue
+            if tok is None:
+                return None
+            if self.ttft_s is None:
+                self.ttft_s = time.monotonic() - self.opened_at
+            self.tokens.append(tok)
+            return tok
+
+    def _follow(self, hint: Optional[str]) -> None:
+        ts, addr = self._fc._resume(self.session_id, len(self.tokens),
+                                    hint=hint, last_addr=self.addr)
+        self._ts.stream.close()
+        self._ts = ts
+        self.addr = addr
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            try:
+                tok = self.read_token()
+            except StopIteration:
+                return
+            if tok is not None:
+                yield tok
+
+    def close(self) -> None:
+        self._done = True
+        self._failed = None  # an explicit close ends the error contract
+        # TokenStream.close sends Gen/Close at the CURRENT owner when the
+        # stream is still live, and just releases the stream otherwise.
+        self._ts.close()
+
+    def __enter__(self) -> "FleetTokenStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ServingFleetClient:
+    """One client to a serving fleet: sticky routed opens with spill,
+    prefill-preferring when the fleet is disaggregated, migration-
+    transparent token streams."""
+
+    def __init__(self, registry_hostport: str, *, tag: str = "serving",
+                 tenant: str = "", timeout_ms: int = 5000,
+                 prefer_prefill: bool = True,
+                 op_deadline_s: float = 15.0):
+        self._registry = registry_hostport
+        self.tag = tag
+        self.tenant = tenant
+        self._timeout_ms = timeout_ms
+        self._prefer_prefill = prefer_prefill
+        self._deadline_s = op_deadline_s
+        self.router = ServingRouter(registry_hostport, tag=tag)
+        # Disaggregated fleets register prefill-only members under
+        # "<tag>-prefill": session opens go there (throughput plane) and
+        # the decode ring serves the resumes.
+        self.prefill_router = ServingRouter(registry_hostport,
+                                            tag=f"{tag}-prefill")
+        self._mu = threading.Lock()
+        self._clients: Dict[str, ServingClient] = {}
+
+    def _client(self, addr: str) -> ServingClient:
+        with self._mu:
+            c = self._clients.get(addr)
+            if c is None:
+                c = ServingClient(addr, tenant=self.tenant,
+                                  timeout_ms=self._timeout_ms)
+                self._clients[addr] = c
+            return c
+
+    # ---- open (routing + spill) ----
+
+    def open(self, prompt: List[int], max_tokens: int = 16, *,
+             session_key: Optional[str] = None,
+             deadline_ms: Optional[int] = None,
+             priority: Optional[int] = None,
+             recv_window: int = 256 << 10) -> FleetTokenStream:
+        """Route a session open: sticky by ``session_key`` (minted when
+        omitted), spilling clockwise on quota/drain/transport answers,
+        pacing on every server hint. Prefill members take the open when
+        present (their engines run the prompt, then hand the session to
+        a decode member — the stream follows automatically)."""
+        sid = session_key if session_key is not None \
+            else f"g{uuid.uuid4().hex[:16]}"
+        deadline = time.monotonic() + self._deadline_s
+        delay = 0.01
+        last_err: Optional[Exception] = None
+        while True:
+            ring = self.router
+            if self._prefer_prefill:
+                try:
+                    self.prefill_router.refresh()
+                    if self.prefill_router.members():
+                        ring = self.prefill_router
+                except (OSError, native.RpcError):
+                    pass
+            if ring is self.router:
+                self.router.refresh()
+            hint_s = 0.0
+            try:
+                cands = ring.candidates(sid)
+            except LookupError:
+                cands = []
+            for addr in cands:
+                try:
+                    ts = self._client(addr).open(
+                        prompt, max_tokens, deadline_ms=deadline_ms,
+                        priority=priority, recv_window=recv_window,
+                        session=sid)
+                    return FleetTokenStream(self, sid, ts, addr)
+                except native.RpcError as e:
+                    last_err = e
+                    if e.code == E_EXISTS:
+                        raise  # duplicate session key: caller's bug
+                    if e.overloaded or e.draining:
+                        ring.penalize(
+                            addr, (e.retry_after_ms or 50) / 1000.0)
+                        hint_s = max(hint_s,
+                                     (e.retry_after_ms or 0) / 1000.0)
+                        continue
+                    continue  # transport-shaped: try the next candidate
+            if time.monotonic() >= deadline:
+                raise last_err if last_err is not None else LookupError(
+                    "no serving members")
+            time.sleep(max(delay, hint_s))
+            delay = min(delay * 2, 0.25)
+
+    def generate(self, prompt: List[int], max_tokens: int = 16,
+                 **kw) -> List[int]:
+        with self.open(prompt, max_tokens, **kw) as ts:
+            return list(ts)
+
+    # ---- resume (redirect following) ----
+
+    def _resume(self, sid: str, have: int, *, hint: Optional[str],
+                last_addr: Optional[str]):
+        """Find the session's new home and re-attach: the E-frame's
+        forwarding hint first, then the old server's Gen/Locate, then
+        the sticky candidate walk — following E_SESSION_MOVED chains,
+        backing off on E_MIGRATING, bounded by the op deadline. Returns
+        (TokenStream, addr)."""
+        deadline = time.monotonic() + self._deadline_s
+        delay = 0.01
+        last_err: Optional[Exception] = None
+        probed_locate = False
+        while True:
+            queue: List[str] = []
+            if hint:
+                queue.append(hint)
+            if not probed_locate and last_addr and last_addr != hint:
+                probed_locate = True
+                try:
+                    dest = self._client(last_addr).locate(sid)
+                    if dest:
+                        queue.append(dest)
+                except (native.RpcError, RuntimeError, OSError):
+                    pass  # the old server may already be gone
+            try:
+                self.router.refresh()
+                queue.extend(a for a in self.router.candidates(sid)
+                             if a not in queue)
+            except (LookupError, OSError, native.RpcError):
+                pass
+            tried = set()
+            migrating = False
+            hint_s = 0.0
+            while queue:
+                addr = queue.pop(0)
+                if addr in tried:
+                    continue
+                tried.add(addr)
+                try:
+                    ts = self._client(addr).resume(sid, have)
+                    return ts, addr
+                except native.RpcError as e:
+                    last_err = e
+                    dest = e.moved_to
+                    if dest and dest not in tried:
+                        queue.insert(0, dest)  # follow the chain first
+                        continue
+                    if e.code in (E_MIGRATING, E_MOVED) or e.overloaded \
+                            or e.draining:
+                        migrating = True
+                        hint_s = max(hint_s,
+                                     (e.retry_after_ms or 0) / 1000.0)
+                    continue  # E_NO_SUCH / transport: next candidate
+            if time.monotonic() >= deadline:
+                raise last_err if last_err is not None else native.RpcError(
+                    E_NO_SUCH, f"session {sid} not found in the fleet")
+            if not migrating and last_err is not None \
+                    and getattr(last_err, "code", None) == E_NO_SUCH \
+                    and hint is None:
+                # Every member disowns it with stable membership: gone.
+                raise last_err
+            hint = None  # a stale hint must not pin the loop
+            time.sleep(max(delay, hint_s))
+            delay = min(delay * 2, 0.25)
+
+    def close(self) -> None:
+        with self._mu:
+            clients, self._clients = self._clients, {}
+        for c in clients.values():
+            c.close()
+
+    def __enter__(self) -> "ServingFleetClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -52,11 +52,14 @@ class ServingServer:
                  max_batch: int = 4, max_len: int = 64, dim: int = 32,
                  ttl_s: float = 30.0, tenant_max_sessions: int = 0,
                  stall_timeout_s: float = 2.0, eos_id: int = 0,
-                 stream_window: int = 256 << 10):
+                 stream_window: int = 256 << 10,
+                 kv_arena_bytes: int = 8 << 20,
+                 publish_kv: bool = False):
         self.manager = SessionManager(
             max_len=max_len, dim=dim, ttl_s=ttl_s,
             tenant_max_sessions=tenant_max_sessions,
-            stall_timeout_s=stall_timeout_s)
+            stall_timeout_s=stall_timeout_s,
+            kv_arena_bytes=kv_arena_bytes, publish_kv=publish_kv)
         self.engine = DecodeEngine(self.manager, params,
                                    max_batch=max_batch, eos_id=eos_id)
         self.stream_window = stream_window
@@ -94,6 +97,11 @@ class ServingServer:
                 # session must shed at its first step boundary.
                 deadline_ms = int(deadline_ms)
             priority = int(doc.get("priority", native.PRIORITY_BULK))
+            # Caller-chosen session id (the serving fleet's sticky
+            # routing key); None lets the manager mint one.
+            sid = doc.get("session")
+            if sid is not None:
+                sid = str(sid)[:128] or None
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             raise native.RpcError(2004, f"bad Gen/Open request: {e}")
         stream = native.accept_stream(self.stream_window)
@@ -106,16 +114,23 @@ class ServingServer:
         # lane is the request's declared DATA priority, BULK by default.
         tenant, _control_prio = _ambient_tenant_priority()
         try:
-            sess = self.manager.open(
+            sess = self._admit_open(
                 prompt, max_tokens, StreamSink(stream), tenant=tenant,
                 priority=priority,
                 deadline_s=(deadline_ms / 1000.0
-                            if deadline_ms is not None else None))
+                            if deadline_ms is not None else None),
+                sid=sid)
         except Exception:
             stream.close()  # any admission failure: never leak the stream
             raise
         self.engine.notify()
         return json.dumps({"session": sess.id}).encode(), b""
+
+    def _admit_open(self, prompt, max_tokens, sink, **kw):
+        """The admission seam the serving fleet overrides (drain gate,
+        prefill-role marking); the single-server default is a plain
+        manager open."""
+        return self.manager.open(prompt, max_tokens, sink, **kw)
 
     # ---- lifecycle ----
 

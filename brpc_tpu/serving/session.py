@@ -37,6 +37,7 @@ from brpc_tpu.runtime.tensor import TensorArena
 # Session states.
 QUEUED = "queued"    # admitted, waiting for a batch lane
 ACTIVE = "active"    # in the running batch
+FROZEN = "frozen"    # mid-migration: decode paused, KV exportable
 DONE = "done"        # generation finished (EOS / budget), sink closed
 SHED = "shed"        # evicted: deadline, TTL, stalled reader, or quota
 
@@ -63,7 +64,7 @@ class StreamSink:
         except native.StreamClosed:
             return "dead"
 
-    def close(self, error: str = "") -> None:
+    def close(self, error: str = "", code: int = 0) -> None:
         if error:
             # Best-effort human-readable reason as a data frame — a PROBE
             # only (close runs on the engine thread; a bounded wait here
@@ -78,7 +79,10 @@ class StreamSink:
             # itself carries an error code on the credit-exempt CLOSE
             # frame, so the client's reads never mistake a shed for a
             # completed generation even when the E-frame didn't fit.
-            self.stream.close(native.TRPC_ELIMIT)
+            # A migration close rides E_SESSION_MOVED (the fleet client
+            # keys its resume on the CODE); every other shed stays the
+            # overload-shaped ELIMIT.
+            self.stream.close(code or native.TRPC_ELIMIT)
         else:
             self.stream.close()
 
@@ -96,7 +100,7 @@ class ProgressiveSink:
         ok = native.progressive_write(self.progressive_id, frame + b"\n")
         return "ok" if ok else "dead"
 
-    def close(self, error: str = "") -> None:
+    def close(self, error: str = "", code: int = 0) -> None:
         if error:
             native.progressive_write(self.progressive_id,
                                      FRAME_ERROR + error.encode() + b"\n")
@@ -109,13 +113,15 @@ class CallableSink:
     def __init__(self, fn: Callable[[bytes], None]):
         self.fn = fn
         self.closed_with: Optional[str] = None
+        self.closed_code: int = 0
 
     def emit(self, frame: bytes) -> str:
         self.fn(frame)
         return "ok"
 
-    def close(self, error: str = "") -> None:
+    def close(self, error: str = "", code: int = 0) -> None:
         self.closed_with = error
+        self.closed_code = code
 
 
 def _native_available() -> bool:
@@ -187,14 +193,23 @@ def serving_metrics():
                 "token": obs.latency("serving_token_emit"),
                 "tokens": obs.counter("serving_tokens"),
                 "shed": obs.counter("serving_shed"),
+                # Fleet plane: sessions shipped out/in over the tensor
+                # wire, and cold-KV page-out/fault-in round trips.
+                "migrated_out": obs.counter("serving_migrated_out"),
+                "migrated_in": obs.counter("serving_migrated_in"),
+                "spill_out": obs.counter("serving_kv_spill_out"),
+                "spill_in": obs.counter("serving_kv_spill_in"),
             }
-            # serving_sessions / serving_kv_bytes gauges are registered
-            # (and re-pointed per manager) by SessionManager itself.
+            # serving_sessions / serving_kv_bytes / serving_kv_spilled_
+            # bytes gauges are registered (and re-pointed per manager) by
+            # SessionManager itself.
         else:
             from brpc_tpu.observability.metrics import NullSeries
 
             _metrics_cache = {k: NullSeries()
-                              for k in ("ttft", "token", "tokens", "shed")}
+                              for k in ("ttft", "token", "tokens", "shed",
+                                        "migrated_out", "migrated_in",
+                                        "spill_out", "spill_in")}
     return _metrics_cache
 
 
@@ -230,11 +245,25 @@ class Session:
         self.token = 0          # last generated token (next step's input)
         self.emitted = 0
         self.ttft_s: Optional[float] = None
+        # Every generated token id, in order — the resume-replay source:
+        # a migrated session re-emits out_tokens[have:] on its new server
+        # so the client's stream is prefix-exact across the move (no torn
+        # or duplicated token, whatever was in flight when the old stream
+        # closed).
+        self.out_tokens: List[int] = []
+        # Prefill/decode disaggregation: a prefill-role session freezes
+        # for handoff the moment its first token is computed instead of
+        # streaming it (the decode server replays + continues).
+        self.prefill_handoff = False
+        # KV paging: True while the planes live in the host spill store
+        # (kv_k/kv_v are None, kv_off invalid) — faulted back on admit.
+        self.paged = False
         # Slow-reader pending buffer (engine-owned).
         self.pending: List[bytes] = []
         self.pending_bytes = 0
         self.stalled_since: Optional[float] = None
         self.shed_reason = ""
+        self.shed_code = 0
 
     def age_s(self) -> float:
         return time.monotonic() - self.opened_at
@@ -288,6 +317,13 @@ class SessionManager:
         self._kv_bytes = 0
         self._shed_total = 0
         self._done_total = 0
+        # Host-side KV spill store: {sid: (k_rows, v_rows)} detached
+        # numpy copies of the first `pos` rows (rows >= pos are zero by
+        # construction — the engine writes row pos then advances — so
+        # paging [:pos] is lossless). Cold sessions page out here under
+        # arena pressure and fault back in on their next admission.
+        self._spill: Dict[str, tuple] = {}
+        self._spilled_bytes = 0
         self._m = serving_metrics()
         if self._native:
             from brpc_tpu.observability import metrics as obs
@@ -295,6 +331,8 @@ class SessionManager:
             obs.repointable_gauge("serving_sessions", self._live_count)
             obs.repointable_gauge("serving_kv_bytes",
                                   lambda: self._kv_bytes)
+            obs.repointable_gauge("serving_kv_spilled_bytes",
+                                  lambda: self._spilled_bytes)
             # Keep ONE stable bound-method object: the guarded clear at
             # shutdown compares identity against the registered provider.
             self._sessionz_fn = self.sessionz_json
@@ -304,9 +342,17 @@ class SessionManager:
 
     def open(self, prompt: List[int], max_tokens: int, sink, *,
              tenant: str = "", priority: int = native.PRIORITY_BULK,
-             deadline_s: Optional[float] = None) -> Session:
+             deadline_s: Optional[float] = None,
+             sid: Optional[str] = None,
+             prefill_handoff: bool = False) -> Session:
         """Admit a session (or shed with ELIMIT on tenant quota / arena
-        exhaustion — carrying a retry hint like every PR 9 shed)."""
+        exhaustion — carrying a retry hint like every PR 9 shed).
+
+        ``sid`` lets the caller pick the session id (the serving fleet's
+        sticky routing key — the SAME id must resolve on whichever server
+        the session migrates to); a live duplicate answers E_EXISTS.
+        Under arena pressure, cold sessions page out to the host spill
+        store before the open is shed."""
         if not prompt:
             raise native.RpcError(2004, "empty prompt")
         if max_tokens < 1:
@@ -320,6 +366,14 @@ class SessionManager:
                       f"exceeds the KV window {self.max_len}")
         per_plane = self.max_len * self.dim * 4
         with self._mu:
+            if sid is not None:
+                dup = self._sessions.get(sid)
+                if dup is not None and dup.state in (QUEUED, ACTIVE,
+                                                     FROZEN):
+                    from brpc_tpu.runtime.param_server import E_EXISTS
+
+                    raise native.RpcError(
+                        E_EXISTS, f"session {sid} already live here")
             if self.tenant_max_sessions > 0:
                 live = sum(1 for s in self._sessions.values()
                            if s.tenant == tenant
@@ -331,15 +385,15 @@ class SessionManager:
                         native.TRPC_ELIMIT,
                         f"tenant {tenant or '(none)'} over session quota "
                         f"{self.tenant_max_sessions} (retry_after_ms=50)")
-            try:
-                off = self.arena.alloc(2 * per_plane)
-            except MemoryError:
+            off = self._alloc_kv_locked(2 * per_plane)
+            if off is None:
                 self._shed_total += 1
                 self._m["shed"].add(1)
                 raise native.RpcError(
                     native.TRPC_ELIMIT,
-                    "KV arena exhausted (retry_after_ms=100)") from None
-            sid = f"s{next(self._ids)}"
+                    "KV arena exhausted (retry_after_ms=100)")
+            if sid is None:
+                sid = f"s{next(self._ids)}"
             kv_k = self.arena.view(off, per_plane).view(np.float32).reshape(
                 self.max_len, self.dim)
             kv_v = self.arena.view(off + per_plane, per_plane).view(
@@ -348,6 +402,10 @@ class SessionManager:
             kv_v[:] = 0.0
             sess = Session(sid, prompt, max_tokens, tenant, priority,
                            deadline_s, sink, off, 2 * per_plane, kv_k, kv_v)
+            # Set BEFORE the session becomes visible: a running engine may
+            # admit it the moment it lands in the table, and the handoff
+            # flag must already be there.
+            sess.prefill_handoff = prefill_handoff
             self._sessions[sid] = sess
             self._kv_bytes += 2 * per_plane
             # Publishable from birth (version 0 = no rows filled), INSIDE
@@ -356,6 +414,87 @@ class SessionManager:
             # reallocatable) range under this session's name forever.
             self.publish_kv(sess)
         return sess
+
+    # ---- KV paging (the memory-pressure valve) ----
+
+    def _alloc_kv_locked(self, nbytes: int) -> Optional[int]:
+        """Arena alloc that, under pressure, pages COLD sessions' KV out
+        to the host spill store (oldest-progress first) and retries —
+        an open/fault sheds only once nothing cold is left to evict.
+        Caller holds _mu."""
+        while True:
+            try:
+                return self.arena.alloc(nbytes)
+            except MemoryError:
+                pass
+            # Cold = waiting for a lane (QUEUED, incl. parked imports)
+            # and not already paged: ACTIVE sessions are mid-decode on an
+            # engine lane and FROZEN ones are mid-export — neither can
+            # lose its planes here.
+            cold = [s for s in self._sessions.values()
+                    if s.state == QUEUED and s.lane < 0
+                    and not s.paged and s.kv_k is not None]
+            if not cold:
+                return None
+            cold.sort(key=lambda s: s.last_progress)
+            self._page_out_locked(cold[0])
+
+    def _page_out_locked(self, sess: Session) -> None:
+        """Move ``sess``'s KV planes to the host spill store and free the
+        arena range. Only the first ``pos`` rows are captured (later rows
+        are zero by construction), detached copies so the freed range's
+        reuse cannot alias them."""
+        if self.oneside is not None:
+            self.oneside.unpublish(f"kv:{sess.id}:k")
+            self.oneside.unpublish(f"kv:{sess.id}:v")
+        k_rows = np.array(sess.kv_k[:sess.pos])
+        v_rows = np.array(sess.kv_v[:sess.pos])
+        self._spill[sess.id] = (k_rows, v_rows)
+        self._spilled_bytes += k_rows.nbytes + v_rows.nbytes
+        self._kv_bytes -= sess.kv_nbytes
+        sess.kv_k = sess.kv_v = None
+        self.arena.free(sess.kv_off)
+        sess.kv_off = -1
+        sess.paged = True
+        self._m["spill_out"].add(1)
+
+    def page_out(self, sess: Session) -> bool:
+        """Explicitly page one cold session out (the pressure path does
+        this automatically); False when it isn't pageable right now."""
+        with self._mu:
+            if (sess.state != QUEUED or sess.lane >= 0 or sess.paged
+                    or sess.kv_k is None):
+                return False
+            self._page_out_locked(sess)
+            return True
+
+    def fault_in(self, sess: Session) -> bool:
+        """Bring a paged session's KV back into the arena (the admission
+        path calls this before activating it); False when the arena stays
+        exhausted even after paging colder sessions out."""
+        per_plane = self.max_len * self.dim * 4
+        with self._mu:
+            if not sess.paged:
+                return True
+            off = self._alloc_kv_locked(2 * per_plane)
+            if off is None:
+                return False
+            k_rows, v_rows = self._spill.pop(sess.id)
+            self._spilled_bytes -= k_rows.nbytes + v_rows.nbytes
+            sess.kv_off = off
+            sess.kv_k = self.arena.view(off, per_plane).view(
+                np.float32).reshape(self.max_len, self.dim)
+            sess.kv_v = self.arena.view(off + per_plane, per_plane).view(
+                np.float32).reshape(self.max_len, self.dim)
+            sess.kv_k[:] = 0.0
+            sess.kv_v[:] = 0.0
+            sess.kv_k[:sess.pos] = k_rows
+            sess.kv_v[:sess.pos] = v_rows
+            self._kv_bytes += sess.kv_nbytes
+            sess.paged = False
+            self._m["spill_in"].add(1)
+            self.publish_kv(sess)
+            return True
 
     def get(self, sid: str) -> Optional[Session]:
         with self._mu:
@@ -377,19 +516,23 @@ class SessionManager:
             sess.last_progress = time.monotonic()
             return True
 
-    def finish(self, sess: Session, *, shed_reason: str = "") -> None:
+    def finish(self, sess: Session, *, shed_reason: str = "",
+               shed_code: int = 0) -> None:
         """Terminal transition (engine thread or Close RPC): close the
         sink, account, and release the KV range — UNLESS the session
         still sits on an engine lane: a concurrent decode step may be
         mid-write into the KV views, so laned sessions keep their range
         until the engine's step-boundary sweep calls release_kv (writing
         into a terminal session's still-held range is harmless; writing
-        into a freed-and-reallocated one is not). Idempotent."""
+        into a freed-and-reallocated one is not). ``shed_code`` rides the
+        sink's error-coded close (E_SESSION_MOVED for a migration retire;
+        the ELIMIT default otherwise). Idempotent."""
         with self._mu:
             if sess.state in (DONE, SHED):
                 return
             sess.state = SHED if shed_reason else DONE
             sess.shed_reason = shed_reason
+            sess.shed_code = shed_code
             if shed_reason:
                 self._shed_total += 1
                 self._m["shed"].add(1)
@@ -398,11 +541,24 @@ class SessionManager:
             if sess.lane < 0:
                 self._release_kv_locked(sess)
         try:
-            sess.sink.close(shed_reason)
+            if sess.sink is not None:
+                sess.sink.close(shed_reason, shed_code)
+        except TypeError:
+            try:  # a custom sink without the code parameter
+                sess.sink.close(shed_reason)
+            except Exception:  # noqa: BLE001
+                pass
         except Exception:  # noqa: BLE001 — a dead sink is already closed
             pass
 
     def _release_kv_locked(self, sess: Session) -> None:
+        if sess.paged:
+            # The planes live in the spill store, not the arena.
+            rows = self._spill.pop(sess.id, None)
+            if rows is not None:
+                self._spilled_bytes -= rows[0].nbytes + rows[1].nbytes
+            sess.paged = False
+            return
         if sess.kv_k is None:
             return
         if self.oneside is not None:
@@ -422,6 +578,164 @@ class SessionManager:
         the one place that knows no step is mid-write)."""
         with self._mu:
             self._release_kv_locked(sess)
+
+    # ---- live migration (the serving fleet's freeze/ship/resume) ----
+
+    def freeze(self, sess: Session) -> bool:
+        """QUEUED/ACTIVE -> FROZEN: decode pauses for this session (the
+        engine frees its lane at the next step boundary WITHOUT releasing
+        the KV) so its state can be exported. False when the session is
+        already terminal/frozen."""
+        with self._mu:
+            if sess.state not in (QUEUED, ACTIVE):
+                return False
+            sess.state = FROZEN
+            sess.last_progress = time.monotonic()
+            return True
+
+    def unfreeze(self, sess: Session) -> None:
+        """FROZEN -> live: the ship failed — decode resumes locally
+        (nothing was lost: export is a copy). A session still holding
+        its engine lane (the freeze never reached a step boundary, e.g.
+        a stalled engine timed the exporter out) goes back to ACTIVE on
+        that SAME lane — re-queueing it would let admission hand it a
+        second lane while the first still references it (double-decode).
+        The lane check shares _mu with park_frozen_lane, so the engine's
+        sweep and this transition serialize."""
+        with self._mu:
+            if sess.state == FROZEN:
+                sess.state = ACTIVE if sess.lane >= 0 else QUEUED
+
+    def park_frozen_lane(self, sess: Session) -> bool:
+        """The engine's sweep-side half of the freeze handshake: clear a
+        FROZEN session's lane under _mu (True = the engine should free
+        the lane slot; lane == -1 then signals the exporter it is safe
+        to read). False when an unfreeze won the race — the session is
+        ACTIVE again and keeps its lane."""
+        with self._mu:
+            if sess.state != FROZEN:
+                return False
+            sess.lane = -1
+            return True
+
+    def exportable(self, sess: Session) -> bool:
+        """True once a frozen session is off its engine lane — the one
+        point where no decode step can be mid-write into its planes."""
+        return sess.state == FROZEN and sess.lane < 0
+
+    def export_session(self, sess: Session):
+        """-> (manifest dict, (2, pos, dim) fp32 KV rows) for a FROZEN,
+        off-lane session: everything the destination needs to resume the
+        EXACT trajectory — prompt, decode position, last token, the full
+        emitted-token list (resume replay), tenant/priority/deadline, and
+        the filled KV rows (version == pos, the published-KV contract)."""
+        if not self.exportable(sess):
+            raise native.RpcError(
+                2004, f"session {sess.id} not exportable "
+                      f"(state={sess.state}, lane={sess.lane})")
+        with self._mu:
+            if sess.paged:
+                k_rows, v_rows = self._spill[sess.id]
+                k_rows = np.array(k_rows)
+                v_rows = np.array(v_rows)
+            else:
+                k_rows = np.array(sess.kv_k[:sess.pos])
+                v_rows = np.array(sess.kv_v[:sess.pos])
+            manifest = {
+                "session": sess.id,
+                "prompt": list(sess.prompt),
+                "max_tokens": sess.max_tokens,
+                "tenant": sess.tenant,
+                "priority": sess.priority,
+                "pos": sess.pos,
+                "token": sess.token,
+                "emitted": sess.emitted,
+                "out_tokens": list(sess.out_tokens),
+                "dim": self.dim,
+            }
+            if sess.deadline_at is not None:
+                manifest["deadline_s"] = max(
+                    0.0, sess.deadline_at - time.monotonic())
+        kv = np.stack([k_rows, v_rows]) if sess.pos else np.zeros(
+            (2, 0, self.dim), np.float32)
+        return manifest, kv
+
+    def import_session(self, manifest: dict, kv) -> Session:
+        """Install a migrated session (the receiving half of export):
+        the session arrives PARKED — sink=None, skipped by admission —
+        until the client's Gen/Resume attaches a stream. Raises ELIMIT
+        when the arena stays exhausted (the source keeps the session)."""
+        sid = str(manifest["session"])
+        prompt = [int(t) for t in manifest["prompt"]]
+        pos = int(manifest["pos"])
+        dim = int(manifest["dim"])
+        if dim != self.dim:
+            raise native.RpcError(
+                2004, f"KV dim mismatch: session {sid} has {dim}, "
+                      f"this server runs {self.dim}")
+        if len(prompt) + int(manifest["max_tokens"]) > self.max_len:
+            raise native.RpcError(
+                2004, f"session {sid} exceeds this server's KV window "
+                      f"{self.max_len}")
+        kv = np.asarray(kv, dtype=np.float32).reshape(2, pos, dim)
+        per_plane = self.max_len * self.dim * 4
+        with self._mu:
+            live = self._sessions.get(sid)
+            if live is not None and live.state in (QUEUED, ACTIVE, FROZEN):
+                from brpc_tpu.runtime.param_server import E_EXISTS
+
+                raise native.RpcError(
+                    E_EXISTS, f"session {sid} already live here")
+            off = self._alloc_kv_locked(2 * per_plane)
+            if off is None:
+                raise native.RpcError(
+                    native.TRPC_ELIMIT,
+                    "KV arena exhausted (retry_after_ms=100)")
+            kv_k = self.arena.view(off, per_plane).view(np.float32).reshape(
+                self.max_len, self.dim)
+            kv_v = self.arena.view(off + per_plane, per_plane).view(
+                np.float32).reshape(self.max_len, self.dim)
+            kv_k[:] = 0.0
+            kv_v[:] = 0.0
+            kv_k[:pos] = kv[0]
+            kv_v[:pos] = kv[1]
+            sess = Session(sid, prompt, int(manifest["max_tokens"]),
+                           str(manifest.get("tenant", "")),
+                           int(manifest.get("priority",
+                                            native.PRIORITY_BULK)),
+                           manifest.get("deadline_s"), None, off,
+                           2 * per_plane, kv_k, kv_v)
+            sess.pos = pos
+            sess.token = int(manifest.get("token", 0))
+            sess.emitted = int(manifest.get("emitted", 0))
+            sess.out_tokens = [int(t) for t in
+                               manifest.get("out_tokens", [])]
+            self._sessions[sid] = sess
+            self._kv_bytes += 2 * per_plane
+            self.publish_kv(sess)
+        self._m["migrated_in"].add(1)
+        return sess
+
+    def attach_sink(self, sess: Session, sink, have: int = 0) -> int:
+        """Un-park an imported session: attach the client's new stream
+        and queue ``out_tokens[have:]`` for replay (``have`` = tokens the
+        client already holds — the prefix-exactness contract: nothing is
+        re-sent that landed, nothing in flight at the old server is
+        lost). Returns the number of frames queued for replay."""
+        have = max(0, min(int(have), len(sess.out_tokens)))
+        with self._mu:
+            if sess.state != QUEUED or sess.sink is not None:
+                raise native.RpcError(
+                    2004, f"session {sess.id} not awaiting resume "
+                          f"(state={sess.state})")
+            replay = sess.out_tokens[have:]
+            for tok in replay:
+                frame = FRAME_TOKEN + str(tok).encode()
+                sess.pending.append(frame)
+                sess.pending_bytes += len(frame)
+            sess.sink = sink
+            sess.last_progress = time.monotonic()
+        return len(replay)
 
     # ---- one-sided KV publication (publish_kv=True) ----
 
@@ -471,7 +785,10 @@ class SessionManager:
         shed, drop = [], []
         with self._mu:
             for sess in self._sessions.values():
-                if sess.state in (QUEUED, ACTIVE):
+                if sess.state in (QUEUED, ACTIVE, FROZEN):
+                    # FROZEN counts as live: a migration that stalls past
+                    # the TTL sheds like any idle session (finish releases
+                    # the KV) instead of leaking the frozen range.
                     if sess.expired(now):
                         shed.append(sess)
                     elif now - sess.last_progress > self.ttl_s:
@@ -491,12 +808,12 @@ class SessionManager:
     def _live_count(self) -> int:
         with self._mu:
             return sum(1 for s in self._sessions.values()
-                       if s.state in (QUEUED, ACTIVE))
+                       if s.state in (QUEUED, ACTIVE, FROZEN))
 
     def live(self) -> List[Session]:
         with self._mu:
             return [s for s in self._sessions.values()
-                    if s.state in (QUEUED, ACTIVE)]
+                    if s.state in (QUEUED, ACTIVE, FROZEN)]
 
     def sessionz_doc(self) -> dict:
         m = self._m
@@ -508,14 +825,17 @@ class SessionManager:
                                                   if s.kv_k is not None
                                                   else 0),
                 "age_s": int(s.age_s()), "pending": s.pending_bytes,
+                "paged": s.paged,
             } for s in self._sessions.values()]
             active = sum(1 for s in self._sessions.values()
-                         if s.state in (QUEUED, ACTIVE))
+                         if s.state in (QUEUED, ACTIVE, FROZEN))
             kv_bytes = self._kv_bytes
+            spilled = self._spilled_bytes
             shed_total = self._shed_total
         return {
             "active": active,
             "kv_bytes": kv_bytes,
+            "kv_spilled_bytes": spilled,
             "tokens_per_s": m["token"].qps(),
             "ttft_p99_us": m["ttft"].p99(),
             "tokens_total": m["tokens"].value(),

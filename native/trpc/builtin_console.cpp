@@ -494,6 +494,12 @@ struct ShardScrape {
   int64_t codec_logical = 0;   // tensor_codec_bytes_logical
   int64_t codec_wire = 0;      // tensor_codec_bytes_wire
   int64_t version_lag_max = 0; // max over param_server_version_lag_*
+  // Serving-fleet columns (folded from the serving_* recorders every
+  // ServingServer already exposes — the generic exposition fold, no
+  // per-page special-casing).
+  double serving_tokens_s = 0;       // serving_token_emit_qps
+  int64_t serving_sessions = 0;      // serving_sessions gauge
+  int64_t serving_ttft_p99_us = 0;   // serving_ttft_latency_99
   int rpcz_on = -1;            // -1 = unknown (flags page unreadable)
   int64_t rpcz_sample_n = 0;
 };
@@ -543,6 +549,13 @@ void fleetz_fold_vars(const std::string& text, ShardScrape* s) {
     } else if (name.rfind("param_server_version_lag_", 0) == 0) {
       s->version_lag_max =
           std::max<int64_t>(s->version_lag_max, strtoll(val, nullptr, 10));
+    } else if (name == "serving_token_emit_qps") {
+      // One recorder sample per emitted token: its qps IS tokens/s.
+      s->serving_tokens_s = strtod(val, nullptr);
+    } else if (name == "serving_sessions") {
+      s->serving_sessions = strtoll(val, nullptr, 10);
+    } else if (name == "serving_ttft_latency_99") {
+      s->serving_ttft_p99_us = strtoll(val, nullptr, 10);
     }
   }
 }
@@ -641,8 +654,9 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     }
   }
   // Rollups.
-  double qps_total = 0;
+  double qps_total = 0, serving_tokens_total = 0;
   int64_t p99_max = 0, lag_max = 0, logical = 0, wire = 0;
+  int64_t serving_sessions_total = 0, serving_ttft_max = 0;
   int worst = 0;
   size_t reachable = 0;
   std::vector<const ShardScrape*> rpcz_off;
@@ -652,6 +666,9 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     lag_max = std::max(lag_max, s.version_lag_max);
     logical += s.codec_logical;
     wire += s.codec_wire;
+    serving_tokens_total += s.serving_tokens_s;
+    serving_sessions_total += s.serving_sessions;
+    serving_ttft_max = std::max(serving_ttft_max, s.serving_ttft_p99_us);
     worst = std::max(worst, health_rank(s.health));
     if (s.reachable) ++reachable;
     if (s.rpcz_on == 0) rpcz_off.push_back(&s);
@@ -679,6 +696,9 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
       e.set("codec_bytes_logical", s.codec_logical);
       e.set("codec_bytes_wire", s.codec_wire);
       e.set("version_lag_max", s.version_lag_max);
+      e.set("serving_tokens_s", s.serving_tokens_s);
+      e.set("serving_sessions", s.serving_sessions);
+      e.set("serving_ttft_p99_us", s.serving_ttft_p99_us);
       e.set("rpcz_enabled", int64_t{s.rpcz_on});
       e.set("rpcz_sample_1_in_n", s.rpcz_sample_n);
       arr.push_back(std::move(e));
@@ -693,6 +713,9 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     roll.set("health_worst", health_worst);
     roll.set("codec_ratio", codec_ratio);
     roll.set("version_lag_max", lag_max);
+    roll.set("serving_tokens_s_total", serving_tokens_total);
+    roll.set("serving_sessions_total", serving_sessions_total);
+    roll.set("serving_ttft_p99_max_us", serving_ttft_max);
     tbutil::JsonValue off = tbutil::JsonValue::Array();
     for (const auto* s : rpcz_off) off.push_back(s->addr);
     roll.set("rpcz_off", std::move(off));
@@ -713,13 +736,21 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
   b += line;
   snprintf(line, sizeof(line),
            "rollup: health=%s qps_total=%.0f p99_max=%lldus "
-           "codec_ratio=%.2f version_lag_max=%lld\n\n",
+           "codec_ratio=%.2f version_lag_max=%lld\n",
            health_worst, qps_total, static_cast<long long>(p99_max),
            codec_ratio, static_cast<long long>(lag_max));
   b += line;
-  snprintf(line, sizeof(line), "%-21s %-8s %-11s %9s %9s %7s %5s %s\n",
+  snprintf(line, sizeof(line),
+           "serving: tokens_s=%.0f live_sessions=%lld "
+           "ttft_p99_max=%lldus\n\n",
+           serving_tokens_total,
+           static_cast<long long>(serving_sessions_total),
+           static_cast<long long>(serving_ttft_max));
+  b += line;
+  snprintf(line, sizeof(line),
+           "%-21s %-8s %-11s %9s %9s %7s %5s %7s %5s %s\n",
            "shard", "tag", "health", "qps", "p99_us", "lag", "codec",
-           "rpcz");
+           "tok/s", "sess", "rpcz");
   b += line;
   for (const auto& s : shards) {
     const double ratio =
@@ -733,10 +764,12 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
                                                             s.rpcz_sample_n)
                                                : "on");
     snprintf(line, sizeof(line),
-             "%-21s %-8s %-11s %9.0f %9lld %7lld %5.2f %s\n", s.addr.c_str(),
-             s.tag.c_str(), s.health.c_str(), s.qps,
+             "%-21s %-8s %-11s %9.0f %9lld %7lld %5.2f %7.0f %5lld %s\n",
+             s.addr.c_str(), s.tag.c_str(), s.health.c_str(), s.qps,
              static_cast<long long>(s.p99_us),
-             static_cast<long long>(s.version_lag_max), ratio, rpcz.c_str());
+             static_cast<long long>(s.version_lag_max), ratio,
+             s.serving_tokens_s,
+             static_cast<long long>(s.serving_sessions), rpcz.c_str());
     b += line;
     if (!s.reason.empty() && s.health != "ok") {
       b += "    reason: " + s.reason + "\n";

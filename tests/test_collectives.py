@@ -252,6 +252,77 @@ def test_pure_allgather_raw_exact_quant_agrees():
     assert np.abs(qouts[0][1] - xs[1]).max() < np.abs(xs[1]).max() / 64
 
 
+def test_pure_reduce_scatter_owned_chunk_exact():
+    """The standalone verb (ISSUE 14 satellite): every member's span is
+    its owned chunk's ``chunk_spans`` slot and the raw values equal the
+    ring-order reference byte-exactly; quantized members stay within the
+    per-hop bound."""
+    n, size = 3, 10007
+    rng = np.random.RandomState(5)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(n)]
+    spans = ring.chunk_spans(size, n)
+
+    def run(codec_name):
+        boxes = [core.Mailbox() for _ in range(n)]
+
+        def member(r):
+            link = _QueueLink(boxes, r)
+            return core.ring_reduce_scatter(r, n, xs[r],
+                                            quant.ChunkCodec(), link,
+                                            "rs", codec_name,
+                                            frag_elems=777)
+        return _run_members(n, member)
+
+    outs = run(None)
+    for r in range(n):
+        (off, ln), vals = outs[r]
+        j = ring.owned_chunk(r, n)
+        assert (off, ln) == spans[j]
+        order = ring.reduce_order(j, n)
+        ref = xs[order[0]][off:off + ln].copy()
+        for q in order[1:]:
+            ref = ref + xs[q][off:off + ln]
+        assert np.array_equal(vals, ref), f"rank {r} drifted"
+    qouts = run("int8")
+    fp32 = np.sum(np.stack(xs), axis=0, dtype=np.float32)
+    for r in range(n):
+        (off, ln), vals = qouts[r]
+        scale = np.abs(fp32[off:off + ln]).max() / 127.0
+        assert np.abs(vals - fp32[off:off + ln]).max() < scale * n
+
+
+def test_pure_broadcast_identical_everywhere():
+    """tree_broadcast: non-roots pass None (fragment-0 metadata carries
+    the shape), every member returns the root's array — bitwise
+    identical across members raw AND quantized (the root adopts its own
+    dequantized encode)."""
+    n = 3
+    rng = np.random.RandomState(6)
+    x = rng.randn(120, 7).astype(np.float32)  # multi-frag, 2-D shape
+
+    def run(codec_name, root):
+        boxes = [core.Mailbox() for _ in range(n)]
+
+        def member(r):
+            link = _QueueLink(boxes, r)
+            return core.tree_broadcast(r, n, x if r == root else None,
+                                       quant.ChunkCodec(), link, "bc",
+                                       codec_name, root=root,
+                                       frag_elems=100)
+        return _run_members(n, member)
+
+    outs = run(None, root=1)
+    for r in range(n):
+        assert outs[r].shape == x.shape
+        assert np.array_equal(outs[r], x), f"raw broadcast drift at {r}"
+    qouts = run("int8", root=0)
+    for r in range(1, n):
+        assert np.array_equal(qouts[r], qouts[0]), \
+            "quantized broadcast members disagree"
+    scale = np.abs(x).max() / 127.0
+    assert np.abs(qouts[0] - x).max() <= scale
+
+
 def test_ef_across_hops_beats_naive_linear_compounding():
     """The EQuARX discipline pinned: accumulated quantized-allreduce
     sums track the fp32 reduction within ~one quant step with EF on,
@@ -530,6 +601,61 @@ def test_wire_tree_small_tensor_exact(coll_env):
         ref = xs[0] + xs[1] + xs[2]
         for r in range(3):
             np.testing.assert_array_equal(out[r], ref)
+    finally:
+        for g in groups:
+            g.close()
+
+
+def test_wire_reduce_scatter_matches_reference(coll_env):
+    """The standalone reduce_scatter verb over the live wire: every
+    member's owned chunk equals the ring-order reference byte-exactly;
+    the collective_reduce_scatter recorder moves."""
+    from brpc_tpu.collectives.group import collective_metrics
+
+    size = 90000
+    rng = np.random.RandomState(11)
+    xs = [rng.randn(size).astype(np.float32) for _ in range(3)]
+    spans = ring.chunk_spans(size, 3)
+    m = collective_metrics()
+    ops0 = m["ops"].value()
+    groups = _mk_groups(coll_env, "rs_wire", 3)
+    try:
+        out, errs = _member_threads(
+            groups, lambda g: g.reduce_scatter("rs", xs[g.rank]))
+        assert not errs, errs
+        for r in range(3):
+            (off, ln), vals = out[r]
+            j = ring.owned_chunk(r, 3)
+            assert (off, ln) == spans[j]
+            order = ring.reduce_order(j, 3)
+            ref = xs[order[0]][off:off + ln].copy()
+            for q in order[1:]:
+                ref = ref + xs[q][off:off + ln]
+            np.testing.assert_array_equal(vals, ref)
+    finally:
+        for g in groups:
+            g.close()
+    assert m["ops"].value() >= ops0 + 3
+
+
+def test_wire_broadcast_identical_everywhere(coll_env):
+    """The standalone broadcast verb over the live wire, quantized
+    group: every member (root included) returns the bitwise-identical
+    array; non-roots pass no input at all."""
+    rng = np.random.RandomState(12)
+    x = rng.randn(70000).astype(np.float32)
+    groups = _mk_groups(coll_env, "bc_wire", 3, codec="int8")
+    try:
+        out, errs = _member_threads(
+            groups,
+            lambda g: g.broadcast("bc", x if g.rank == 0 else None,
+                                  root=0))
+        assert not errs, errs
+        for r in range(1, 3):
+            assert np.array_equal(out[r], out[0]), \
+                "broadcast members disagree"
+        scale = np.abs(x).max() / 127.0
+        assert np.abs(out[0] - x).max() <= scale
     finally:
         for g in groups:
             g.close()

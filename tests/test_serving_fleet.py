@@ -1,0 +1,713 @@
+"""Serving fleet (ISSUE 14 acceptance surface): session routing,
+prefill/decode disaggregation, live KV migration, KV paging.
+
+Pure half (tier-1, no native lib):
+  * routing determinism — the SAME session id resolves to the SAME
+    server on independent router instances (ketama over the membership
+    list alone), with a deterministic clockwise spill walk;
+  * the E_DRAINING / E_SESSION_MOVED error classification (codes, not
+    message strings);
+  * freeze/export/import/attach round trip: a session migrated between
+    two PURE SessionManagers (host arena) resumes token-for-token
+    identical to an unmigrated control — the engine-level core of the
+    live-drain acceptance criterion;
+  * the prefill-handoff freeze point (first token computed, never
+    streamed; replayed by the importing engine);
+  * KV page-out/fault-in bit-exactness + the automatic page-out-under-
+    pressure path;
+  * the /fleetz serving-column fold + rollup (the Python twin's pure
+    half).
+
+Native half (skips without libbrpc_tpu.so), under an ARMED watchdog:
+  * a LIVE drain: sessions streaming from server A migrate to B over
+    the tensor wire mid-stream; the client's streams resume with
+    token-for-token parity vs the serial reference — never a torn or
+    duplicated token, bounded gap;
+  * routing determinism against a live registry + opens landing on
+    their ketama owner;
+  * a draining server sheds opens with E_DRAINING and the fleet client
+    spills to the survivor;
+  * prefill/decode disaggregation: the prompt runs on the prefill
+    member (BULK), the KV hands off over the same transfer path, every
+    token streams from the decode member;
+  * the one-sided KV consumer: with publish_kv=True the destination
+    memory-reads the source's published planes (PR 11's pages get their
+    consumer), bytes-fallback still correct;
+  * /fleetz serving columns live (native page + FleetObserver twin in
+    parity).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from brpc_tpu.models.decoder import decode_serial, init_decoder
+from brpc_tpu.runtime import native
+from brpc_tpu.serving import (DONE, FROZEN, QUEUED, SHED, CallableSink,
+                              DecodeEngine, ServingRouter, SessionManager,
+                              SessionShed)
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+MAX_LEN = 64
+
+
+def pure_manager(**kw):
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("kv_arena_bytes", 1 << 20)
+    return SessionManager(**kw)
+
+
+class TokenCollector:
+    def __init__(self):
+        self.tokens = []
+        self.sink = CallableSink(self._on)
+
+    def _on(self, frame: bytes):
+        if frame.startswith(b"T"):
+            self.tokens.append(int(frame[1:]))
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 pure half.
+# ---------------------------------------------------------------------------
+
+def test_router_determinism_across_instances():
+    """The acceptance pin: same session id -> same server, on router
+    instances that share NOTHING but the membership list."""
+    members = [f"10.0.0.{i}:7{i:03d}" for i in range(1, 6)]
+    r1 = ServingRouter(members=list(members))
+    r2 = ServingRouter(members=list(reversed(members)))  # order-immune
+    owners = set()
+    for i in range(200):
+        sid = f"sess-{i}"
+        assert r1.route(sid) == r2.route(sid)
+        assert r1.candidates(sid) == r2.candidates(sid)
+        owners.add(r1.route(sid))
+    # Ketama spreads 200 ids over 5 members: every member owns some.
+    assert owners == set(members)
+
+
+def test_router_spill_walk_and_penalty():
+    members = ["a:1", "b:2", "c:3"]
+    r = ServingRouter(members=members)
+    sid = "sticky-session"
+    walk = r.candidates(sid)
+    assert walk[0] == r.route(sid)
+    assert sorted(walk) == sorted(members), "walk visits every member"
+    # A penalized owner drops to the BACK (never disappears).
+    r.penalize(walk[0], for_s=30)
+    walk2 = r.candidates(sid)
+    assert walk2[-1] == walk[0] and sorted(walk2) == sorted(members)
+    assert r.route(sid) == walk[0], "route() stays pure placement"
+    # Expired penalties restore the pure walk.
+    r.penalize(walk[1], for_s=0.01)
+    time.sleep(0.03)
+    assert r.candidates(sid) == walk2
+
+
+def test_error_classification_draining_and_moved():
+    e = native.RpcError(native.E_DRAINING,
+                        "server 1.2.3.4:5 draining (retry_after_ms=100)")
+    assert e.draining and not e.overloaded
+    assert e.retry_after_ms == 100 and e.moved_to is None
+    m = native.RpcError(native.E_SESSION_MOVED,
+                        "session s7 moved:10.0.0.2:7002")
+    assert m.moved_to == "10.0.0.2:7002" and not m.draining
+    # Classification keys on the CODE: the same text under another code
+    # never reads as a session move.
+    other = native.RpcError(2041, "parameter x moved:10.0.0.2:7002")
+    assert other.moved_to is None
+    shed = SessionShed("moved:10.0.0.9:7009", code=native.E_SESSION_MOVED)
+    assert shed.moved == "10.0.0.9:7009"
+    assert SessionShed("slow reader").moved is None
+
+
+def _run_to_done(engine, *sessions, steps=60):
+    for _ in range(steps):
+        engine.step()
+        if all(s.state in (DONE, SHED) for s in sessions):
+            break
+
+
+def test_migration_round_trip_token_parity():
+    """Freeze/export/ship(import)/resume between two pure managers ==
+    the unmigrated trajectory, token for token (the engine-level core of
+    the live-drain acceptance criterion)."""
+    n_tok = 12
+    ref = decode_serial(PARAMS, [3, 7, 11], n_tok, MAX_LEN)
+    src = pure_manager()
+    esrc = DecodeEngine(src, PARAMS, max_batch=2)
+    got = []
+    sink = CallableSink(lambda f: got.append(int(f[1:]))
+                        if f.startswith(b"T") else None)
+    sess = src.open([3, 7, 11], n_tok, sink, sid="mig-1")
+    for _ in range(6):
+        esrc.step()
+    assert 0 < len(got) < n_tok, "migrate MID-stream"
+    assert src.freeze(sess)
+    esrc.step()  # lane sweep: frees the lane, keeps the KV
+    assert src.exportable(sess)
+    manifest, kv = src.export_session(sess)
+    assert manifest["pos"] == sess.pos and kv.shape == (2, sess.pos, 32)
+    src.finish(sess, shed_reason="moved:dst",
+               shed_code=native.E_SESSION_MOVED)
+    assert sess.shed_code == native.E_SESSION_MOVED
+    assert sink.closed_code == native.E_SESSION_MOVED
+
+    dst = pure_manager()
+    edst = DecodeEngine(dst, PARAMS, max_batch=2)
+    sess2 = dst.import_session(manifest, kv)
+    assert sess2.id == "mig-1" and sess2.state == QUEUED
+    edst.step()
+    assert sess2.lane == -1, "PARKED: never admitted before a sink attaches"
+    have = len(got)
+    replayed = dst.attach_sink(
+        sess2, CallableSink(lambda f: got.append(int(f[1:]))
+                            if f.startswith(b"T") else None), have)
+    assert replayed == 0, "client had every token: nothing to replay"
+    _run_to_done(edst, sess2)
+    assert sess2.state == DONE
+    assert got == ref, (got, ref)
+
+
+def test_migration_replays_tokens_the_client_missed():
+    """Tokens generated before the move but NOT received (lost with the
+    old stream) are replayed at resume: prefix-exact, no dup, no tear."""
+    n_tok = 10
+    ref = decode_serial(PARAMS, [5, 2], n_tok, MAX_LEN)
+    src = pure_manager()
+    esrc = DecodeEngine(src, PARAMS, max_batch=2)
+    got = []
+    sess = src.open([5, 2], n_tok, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None))
+    for _ in range(5):
+        esrc.step()
+    src.freeze(sess)
+    esrc.step()
+    manifest, kv = src.export_session(sess)
+    # The client "lost" its last 2 tokens in flight.
+    have = max(0, len(got) - 2)
+    client_view = got[:have]
+    dst = pure_manager()
+    edst = DecodeEngine(dst, PARAMS, max_batch=2)
+    sess2 = dst.import_session(manifest, kv)
+    replayed = dst.attach_sink(sess2, CallableSink(
+        lambda f: client_view.append(int(f[1:]))
+        if f.startswith(b"T") else None), have)
+    assert replayed == len(got) - have
+    _run_to_done(edst, sess2)
+    assert client_view == ref
+
+
+def test_prefill_handoff_freezes_at_first_token():
+    """A prefill-marked session freezes the step its first token is
+    computed — recorded for replay, never streamed — and the importing
+    decode engine emits EVERY token including that one."""
+    n_tok = 8
+    ref = decode_serial(PARAMS, [9, 4, 1], n_tok, MAX_LEN)
+    pre = pure_manager()
+    epre = DecodeEngine(pre, PARAMS, max_batch=2)
+    frozen = []
+    epre.on_session_frozen = frozen.append
+    col = TokenCollector()
+    sess = pre.open([9, 4, 1], n_tok, col.sink, prefill_handoff=True)
+    for _ in range(10):
+        epre.step()
+        if frozen:
+            break
+    assert frozen == [sess] and sess.state == FROZEN
+    assert col.tokens == [], "prefill must not stream"
+    assert sess.emitted == 1 and sess.out_tokens == [ref[0]]
+    assert sess.pos == len(sess.prompt)
+    assert pre.exportable(sess)
+    manifest, kv = pre.export_session(sess)
+    pre.finish(sess, shed_reason="moved:decode",
+               shed_code=native.E_SESSION_MOVED)
+    dec = pure_manager()
+    edec = DecodeEngine(dec, PARAMS, max_batch=2)
+    sess2 = dec.import_session(manifest, kv)
+    out = []
+    replayed = dec.attach_sink(dec.get(sess2.id), CallableSink(
+        lambda f: out.append(int(f[1:])) if f.startswith(b"T") else None),
+        have=0)
+    assert replayed == 1, "the handoff token replays first"
+    _run_to_done(edec, sess2)
+    assert out == ref
+
+
+def test_prefill_handoff_respects_eos_on_first_token():
+    """The EOS clamp applies AT the handoff point: a session whose
+    first generated token is eos_id ships with max_tokens clamped, so
+    the decode member replays that one token and stops — exactly the
+    colocated trajectory (review finding pinned)."""
+    ref = decode_serial(PARAMS, [3, 7, 11], 8, MAX_LEN)
+    eos = ref[0]  # make the very first generated token the EOS
+    colocated = decode_serial(PARAMS, [3, 7, 11], 8, MAX_LEN, eos_id=eos)
+    pre = pure_manager()
+    epre = DecodeEngine(pre, PARAMS, max_batch=2, eos_id=eos)
+    frozen = []
+    epre.on_session_frozen = frozen.append
+    sess = pre.open([3, 7, 11], 8, TokenCollector().sink,
+                    prefill_handoff=True)
+    for _ in range(10):
+        epre.step()
+        if frozen:
+            break
+    assert sess.out_tokens == [eos]
+    assert sess.max_tokens == 1, "EOS must clamp the budget at handoff"
+    manifest, kv = pre.export_session(sess)
+    dec = pure_manager()
+    edec = DecodeEngine(dec, PARAMS, max_batch=2, eos_id=eos)
+    sess2 = dec.import_session(manifest, kv)
+    out = []
+    dec.attach_sink(sess2, CallableSink(
+        lambda f: out.append(int(f[1:])) if f.startswith(b"T") else None),
+        have=0)
+    _run_to_done(edec, sess2)
+    assert out == [eos] == colocated[:1]
+    assert sess2.state == DONE
+
+
+def test_preference_limit_counts_override_head():
+    from brpc_tpu.fleet.shard_map import ShardMap
+
+    members = ["a:1", "b:2", "c:3"]
+    name = "pinned-key"
+    m = ShardMap(members, overrides={name: "c:3"})
+    assert m.preference(name)[0] == "c:3"
+    assert m.preference(name, limit=1) == ["c:3"], \
+        "a live override head must count toward the limit"
+    assert len(m.preference(name, limit=2)) == 2
+
+
+def test_prefill_local_fallback_loses_nothing():
+    """No decode member reachable: the frozen prefill session resumes
+    locally and the client still receives every token exactly once (the
+    recorded-but-unstreamed first token is queued before unfreeze)."""
+    from brpc_tpu.serving.session import FRAME_TOKEN
+
+    n_tok = 6
+    ref = decode_serial(PARAMS, [3, 7], n_tok, MAX_LEN)
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2)
+    frozen = []
+    eng.on_session_frozen = frozen.append
+    col = TokenCollector()
+    sess = mgr.open([3, 7], n_tok, col.sink, prefill_handoff=True)
+    for _ in range(10):
+        eng.step()
+        if frozen:
+            break
+    assert sess.state == FROZEN and col.tokens == []
+    # The fleet server's _resume_local, inlined (pure mode).
+    frame = FRAME_TOKEN + str(sess.out_tokens[-1]).encode()
+    sess.pending.append(frame)
+    sess.pending_bytes += len(frame)
+    sess.prefill_handoff = False
+    mgr.unfreeze(sess)
+    _run_to_done(eng, sess)
+    assert sess.state == DONE and col.tokens == ref
+
+
+def test_kv_page_out_fault_in_bit_exact():
+    """The PR 10 leftover: cold KV pages out to the host spill store and
+    faults back BIT-exact; arena bytes and the spill gauge account."""
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=1)
+    a = mgr.open([3, 7, 11], 8, TokenCollector().sink)
+    for _ in range(4):
+        eng.step()  # fill some KV rows with real decode state
+    # Only off-lane sessions page; take it off its lane via freeze/sweep,
+    # then back to QUEUED.
+    mgr.freeze(a)
+    eng.step()
+    mgr.unfreeze(a)
+    k_before = np.array(a.kv_k)
+    v_before = np.array(a.kv_v)
+    kv_bytes_before = mgr.sessionz_doc()["kv_bytes"]
+    assert mgr.page_out(a)
+    assert a.paged and a.kv_k is None
+    doc = mgr.sessionz_doc()
+    assert doc["kv_bytes"] == kv_bytes_before - a.kv_nbytes
+    assert doc["kv_spilled_bytes"] == 2 * a.pos * mgr.dim * 4
+    assert mgr.fault_in(a)
+    assert not a.paged and doc["kv_spilled_bytes"] > 0
+    assert np.array_equal(np.array(a.kv_k), k_before)
+    assert np.array_equal(np.array(a.kv_v), v_before)
+    assert mgr.sessionz_doc()["kv_spilled_bytes"] == 0
+
+
+def test_open_pages_out_cold_sessions_under_pressure():
+    """An arena sized for exactly two sessions admits a third by paging
+    the coldest QUEUED session out instead of shedding the open."""
+    per_session = 2 * MAX_LEN * 32 * 4
+    mgr = pure_manager(kv_arena_bytes=2 * per_session)
+    s1 = mgr.open([1], 4, TokenCollector().sink)
+    s2 = mgr.open([2], 4, TokenCollector().sink)
+    s3 = mgr.open([3], 4, TokenCollector().sink)  # would shed without paging
+    assert s3.kv_k is not None
+    assert s1.paged, "the coldest (oldest-progress) session paged out"
+    assert not s2.paged
+    # The paged session faults back in when s3's range frees.
+    mgr.finish(s3)
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4)
+    eng.step()
+    assert not s1.paged, "admission faulted the paged session back in"
+    assert s1.state == "active"
+
+
+def test_paged_session_migrates_via_bytes():
+    """A paged-out session exports from the spill store (no arena
+    planes) and imports correctly — the bytes path of migration."""
+    src = pure_manager()
+    esrc = DecodeEngine(src, PARAMS, max_batch=1)
+    n_tok = 8
+    ref = decode_serial(PARAMS, [5, 2], n_tok, MAX_LEN)
+    got = []
+    sess = src.open([5, 2], n_tok, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None))
+    for _ in range(4):
+        esrc.step()
+    src.freeze(sess)
+    esrc.step()
+    with src._mu:
+        src._page_out_locked(sess)  # frozen sessions page only explicitly
+    manifest, kv = src.export_session(sess)
+    assert kv.shape[1] == sess.pos
+    dst = pure_manager()
+    edst = DecodeEngine(dst, PARAMS, max_batch=1)
+    sess2 = dst.import_session(manifest, kv)
+    dst.attach_sink(sess2, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None),
+        have=len(got))
+    _run_to_done(edst, sess2)
+    assert got == ref
+
+
+def test_fleetz_serving_fold_and_rollup_pure():
+    """The Python twin's fold + rollup grow the serving columns (kept in
+    parity with the native /fleetz page by the live test below)."""
+    from brpc_tpu.observability.fleet_view import fold_vars, rollup
+
+    vars_text = ("serving_token_emit_qps : 1234\n"
+                 "serving_sessions : 7\n"
+                 "serving_ttft_latency_99 : 4500\n"
+                 "rpc_server_echo_qps : 10\n")
+    fold = fold_vars(vars_text)
+    assert fold["serving_tokens_s"] == 1234.0
+    assert fold["serving_sessions"] == 7
+    assert fold["serving_ttft_p99_us"] == 4500
+    rows = [dict(fold, addr="a:1", reachable=True, health="ok"),
+            {"addr": "b:2", "reachable": True, "health": "ok",
+             "serving_tokens_s": 766.0, "serving_sessions": 3,
+             "serving_ttft_p99_us": 9000}]
+    roll = rollup(rows)
+    assert roll["serving_tokens_s_total"] == 2000.0
+    assert roll["serving_sessions_total"] == 10
+    assert roll["serving_ttft_p99_max_us"] == 9000
+
+
+# ---------------------------------------------------------------------------
+# Native half: the live fleet, under an armed watchdog.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.observability import health
+    dump_dir = tmp_path_factory.mktemp("serving_fleet_dumps")
+    health.start_watchdog(str(dump_dir))
+    yield {"health": health}
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after serving-fleet tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def _hub():
+    from brpc_tpu.fleet import RegistryHub
+    hub = RegistryHub()
+    hub.start()
+    return hub
+
+
+def _member(hub, tag, role="both", **kw):
+    from brpc_tpu.serving import FleetServingServer
+    srv = FleetServingServer(hub.hostport, PARAMS, tag=tag, role=role,
+                             max_len=MAX_LEN, reg_ttl_s=3, **kw)
+    srv.start()
+    return srv
+
+
+def _cleanup(hub, *servers):
+    from brpc_tpu.fleet import clear_registry
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+    clear_registry()
+    hub.stop()
+
+
+def _keys_owned_by(client, addr, n, prefix):
+    """Session keys whose sticky owner is `addr` under the live map."""
+    client.router.refresh()
+    keys, i = [], 0
+    while len(keys) < n:
+        k = f"{prefix}-{i}"
+        if client.router.route(k) == addr:
+            keys.append(k)
+        i += 1
+        assert i < 10000
+    return keys
+
+
+def test_live_routing_determinism_and_sticky_opens(fleet_env):
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "rt", max_batch=4)
+    b = _member(hub, "rt", max_batch=4)
+    try:
+        c1 = ServingFleetClient(hub.hostport, tag="rt")
+        c2 = ServingFleetClient(hub.hostport, tag="rt")
+        c1.router.refresh()
+        c2.router.refresh()
+        assert sorted(c1.router.members()) == sorted([a.addr, b.addr])
+        for i in range(50):
+            sid = f"det-{i}"
+            assert c1.router.route(sid) == c2.router.route(sid)
+        # Opens land on their ketama owner.
+        for srv in (a, b):
+            key = _keys_owned_by(c1, srv.addr, 1, f"on-{srv.addr}")[0]
+            toks = c1.generate([3, 7], 6, session_key=key)
+            assert toks == decode_serial(PARAMS, [3, 7], 6, MAX_LEN)
+            assert srv.manager.get(key) is not None, \
+                f"session {key} did not land on its owner {srv.addr}"
+        c1.close()
+        c2.close()
+    finally:
+        _cleanup(hub, a, b)
+
+
+def test_live_drain_migration_token_parity(fleet_env):
+    """THE acceptance drive: mid-stream sessions on a draining server
+    migrate over the tensor wire and their streams resume with
+    token-for-token parity vs the serial reference — no torn/duplicated
+    token, bounded gap."""
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "dr", max_batch=4)
+    b = _member(hub, "dr", max_batch=4)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="dr")
+        warm = c.generate([1], 2)  # absorb the jit compile
+        assert len(warm) == 2
+        n_tok = 30
+        prompts = {"k0": [3, 7, 11], "k1": [5, 2]}
+        keys = _keys_owned_by(c, a.addr, 2, "drain")
+        key_prompt = dict(zip(keys, prompts.values()))
+        refs = {k: decode_serial(PARAMS, p, n_tok, MAX_LEN)
+                for k, p in key_prompt.items()}
+        streams = {k: c.open(p, n_tok, session_key=k)
+                   for k, p in key_prompt.items()}
+        # A few tokens pre-drain so the migration is genuinely live.
+        for k, ts in streams.items():
+            while len(ts.tokens) < 3:
+                ts.read_token(timeout_ms=5000)
+        for k in keys:
+            assert a.manager.get(k) is not None
+        results = {}
+
+        def drain_reader(k, ts):
+            results[k] = list(ts)
+
+        readers = [threading.Thread(target=drain_reader, args=(k, ts))
+                   for k, ts in streams.items()]
+        for t in readers:
+            t.start()
+        moved = a.drain()
+        for t in readers:
+            t.join(timeout=60)
+            assert not t.is_alive(), "stream reader hung after drain"
+        assert moved == 2, f"expected both sessions to migrate, got {moved}"
+        for k, ts in streams.items():
+            full = ts.tokens
+            assert full == refs[k], (
+                f"stream {k} tore across the migration:\n got {full}\n "
+                f"ref {refs[k]}")
+            assert ts.resumes >= 1, "the stream must have followed a move"
+            assert ts.last_gap_s is not None and ts.last_gap_s < 15
+            assert b.manager.get(k) is not None, "session lives on B"
+            sa = a.manager.get(k)
+            assert sa is not None and sa.state == SHED
+            assert sa.shed_reason == f"moved:{b.addr}"
+        for ts in streams.values():
+            ts.close()
+        c.close()
+    finally:
+        _cleanup(hub, a, b)
+
+
+def test_draining_server_sheds_opens_with_code(fleet_env):
+    from brpc_tpu.serving import ServingClient, ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "dg", max_batch=2)
+    b = _member(hub, "dg", max_batch=2)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="dg")
+        c.router.refresh()
+        a._draining = True  # gate only: keep membership for the probe
+        # Direct open at the draining member: E_DRAINING, classified.
+        direct = ServingClient(a.addr)
+        with pytest.raises(native.RpcError) as ei:
+            direct.open([1], 2)
+        assert ei.value.draining and ei.value.retry_after_ms is not None
+        direct.close()
+        # The fleet client spills to the survivor, whatever the owner.
+        key = _keys_owned_by(c, a.addr, 1, "spill")[0]
+        toks = c.generate([3, 7], 6, session_key=key)
+        assert toks == decode_serial(PARAMS, [3, 7], 6, MAX_LEN)
+        assert b.manager.get(key) is not None
+        c.close()
+    finally:
+        _cleanup(hub, a, b)
+
+
+def test_prefill_decode_split_live(fleet_env):
+    """Disaggregation: the open lands on the prefill member (BULK), the
+    KV hands off over the migration path, every token streams from the
+    decode member — token-for-token the colocated trajectory."""
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    pre = _member(hub, "pd", role="prefill", max_batch=4)
+    dec = _member(hub, "pd", role="decode", max_batch=4)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="pd")
+        n_tok = 12
+        ref = decode_serial(PARAMS, [9, 4, 1], n_tok, MAX_LEN)
+        ts = c.open([9, 4, 1], n_tok, session_key="split-1")
+        toks = list(ts)
+        assert toks == ref, (toks, ref)
+        assert ts.resumes == 1, "the stream followed the prefill handoff"
+        assert ts.addr == dec.addr
+        # The prefill member froze at first-token time and never
+        # streamed; the decode member served the whole token budget.
+        sp = pre.manager.get("split-1")
+        assert sp is not None and sp.state == SHED
+        assert sp.shed_reason == f"moved:{dec.addr}"
+        sd = dec.manager.get("split-1")
+        assert sd is not None and sd.state == DONE
+        assert sd.emitted == n_tok
+        ts.close()
+        c.close()
+    finally:
+        _cleanup(hub, pre, dec)
+
+
+def test_oneside_kv_consumer_and_bytes_fallback(fleet_env):
+    """publish_kv=True: the destination reads the source's published KV
+    planes memory-semantics (the PR 11 consumer); with publishing off,
+    the same migration rides the tensor-wire bytes path — both resume
+    bit-parity streams."""
+    from brpc_tpu.serving import ServingFleetClient
+    for publish in (True, False):
+        hub = _hub()
+        a = _member(hub, "os", max_batch=4, publish_kv=publish)
+        b = _member(hub, "os", max_batch=4)
+        try:
+            oneside_installs = []
+            orig = type(b)._read_kv_oneside
+
+            def spy(self, manifest, _orig=orig, _log=oneside_installs):
+                kv = _orig(self, manifest)
+                _log.append(manifest["session"])
+                return kv
+
+            b._read_kv_oneside = spy.__get__(b)
+            c = ServingFleetClient(hub.hostport, tag="os")
+            n_tok = 16
+            key = _keys_owned_by(c, a.addr, 1, f"os-{publish}")[0]
+            prompt = [3, 7, 11]
+            ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+            ts = c.open(prompt, n_tok, session_key=key)
+            while len(ts.tokens) < 3:
+                ts.read_token(timeout_ms=5000)
+            sess = a.manager.get(key)
+            assert sess is not None
+            assert a.migrate_session(sess, b.addr)
+            rest = list(ts)
+            assert ts.tokens == ref
+            assert rest, "tokens kept flowing after the move"
+            if publish:
+                assert oneside_installs == [key], \
+                    "published KV pages must serve the migration read"
+            else:
+                assert oneside_installs == []
+            ts.close()
+            c.close()
+        finally:
+            _cleanup(hub, a, b)
+
+
+def test_fleetz_serving_columns_native_and_twin(fleet_env):
+    """The satellite pin: /fleetz (native page) and FleetObserver (the
+    Python twin) both grow the serving columns, fed by the GENERIC
+    exposition fold."""
+    from brpc_tpu.observability.fleet_view import FleetObserver
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "fz", max_batch=2)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="fz")
+        toks = c.generate([3, 7, 11], 8)
+        assert len(toks) == 8
+        # Native page, JSON form. tbvar latency percentiles roll into
+        # per-second windows: re-scrape (bounded) until the TTFT sample
+        # lands rather than racing the window edge.
+        deadline = time.monotonic() + 8
+        while True:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://{a.addr}/fleetz?format=json&tag=fz",
+                timeout=5).read().decode())
+            row = next(r for r in doc["shards"] if r["addr"] == a.addr)
+            if row["serving_ttft_p99_us"] > 0 \
+                    or time.monotonic() >= deadline:
+                break
+            time.sleep(0.3)
+        assert "serving_tokens_s" in row and "serving_sessions" in row
+        assert row["serving_ttft_p99_us"] > 0
+        roll = doc["rollup"]
+        assert roll["serving_ttft_p99_max_us"] == row["serving_ttft_p99_us"]
+        assert "serving_tokens_s_total" in roll
+        assert "serving_sessions_total" in roll
+        # Text form carries the serving rollup line + columns.
+        text = urllib.request.urlopen(
+            f"http://{a.addr}/fleetz?tag=fz", timeout=5).read().decode()
+        assert "serving: tokens_s=" in text and "tok/s" in text
+        # The Python twin folds the SAME columns from the same vars
+        # (values are live sliding-window stats, so the twin's scrape —
+        # moments later — pins presence + the rollup SHAPE, not
+        # bit-equality with the earlier native scrape).
+        obs_view = FleetObserver(hub.hostport, tag="fz")
+        fz = obs_view.fleetz()
+        trow = next(r for r in fz["shards"] if r["addr"] == a.addr)
+        assert trow["serving_ttft_p99_us"] > 0
+        assert fz["rollup"]["serving_ttft_p99_max_us"] == \
+            trow["serving_ttft_p99_us"]
+        assert fz["rollup"]["serving_sessions_total"] == \
+            trow["serving_sessions"]
+        prom = obs_view.fleet_prometheus()
+        assert "fleet_serving_tokens_s_total" in prom
+        assert "fleet_serving_ttft_p99_max_us" in prom
+        c.close()
+    finally:
+        _cleanup(hub, a)
