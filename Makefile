@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke train-smoke collectives-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke spec-smoke train-smoke collectives-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -48,6 +48,16 @@ serve-smoke:
 # The pure halves run even without the native library.
 serving-fleet-smoke:
 	python -m pytest tests/test_serving_fleet.py -q
+	python -m tools.tpulint
+
+# Fast local gate for speculative decoding (the serve-smoke analog):
+# the verify-window bitwise-parity pin, spec==plain engine parity
+# (n-gram + model drafts, adversarial k-clamp), migration/prefill
+# parity with speculation on both ends, then lint. The native halves
+# (streamed A/B, live drain, /fleetz accept columns) skip cleanly
+# without the lib.
+spec-smoke:
+	python -m pytest tests/test_spec_decode.py -q
 	python -m tools.tpulint
 
 # Fast local gate for the overlapped training step (the obs-smoke

@@ -634,9 +634,11 @@ sys.path.insert(0, sys.argv[1])
 import jax
 from brpc_tpu.models.decoder import init_decoder
 from brpc_tpu.serving import FleetServingServer
+spec_k = int(sys.argv[6]) if len(sys.argv) > 6 else 0
 srv = FleetServingServer(sys.argv[2], init_decoder(jax.random.PRNGKey(0)),
                          tag=sys.argv[3], role=sys.argv[4],
-                         max_batch=int(sys.argv[5]), reg_ttl_s=3)
+                         max_batch=int(sys.argv[5]), reg_ttl_s=3,
+                         spec_k=spec_k)
 srv.start()
 print("READY", srv.addr, flush=True)
 sys.stdin.readline()  # parent closes stdin to stop
@@ -846,6 +848,200 @@ print(json.dumps(row))
 """
 
 
+_SERVING_SPEC_CHILD = """
+import json, subprocess, sys, threading, time
+sys.path.insert(0, {root!r})
+import jax
+from brpc_tpu.runtime import native
+try:
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
+from brpc_tpu.models.decoder import init_decoder
+from brpc_tpu.serving import ServingClient, ServingServer
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+SPEC_K = {spec_k}
+REPS = {reps}
+DRIVE_S = {drive_s}
+MEMBER = {member!r}
+ROOT = {root!r}
+FLEET = {fleet}
+
+# Acceptance-friendly = long prompt (the window ingests known rows k+1
+# per dispatch) + whatever the n-gram draft catches in generation;
+# adversarial = short prompt, generation-dominated, low lookup hit rate
+# — the k-adaptation clamp's regime.
+FRIENDLY = (list(range(1, 41)), 16)
+ADVERSARIAL = ([3, 7, 5], 24)
+
+def pctl(xs, q):
+    xs = sorted(xs)
+    return xs[max(0, int(len(xs) * q) - 1)] if xs else 0.0
+
+def drive(client, srv, spec_k, prompt, n_tok, secs):
+    # In-process toggle for the single server; Gen/Spec for fleet
+    # members (the same engine attribute, over the wire).
+    set_spec(client, srv, spec_k)
+    t0 = time.monotonic()
+    tokens = 0
+    gaps = []
+    i = 0
+    while time.monotonic() - t0 < secs:
+        if srv is not None:
+            ts = client.open(prompt, n_tok)
+        else:
+            ts = client.open(prompt, n_tok,
+                             session_key="sp%d-%d" % (spec_k, i))
+        last = None
+        for _tok in ts:
+            now = time.monotonic()
+            if last is not None:
+                gaps.append((now - last) * 1e3)
+            last = now
+        tokens += len(ts.tokens)
+        ts.close()
+        i += 1
+    window = time.monotonic() - t0
+    return tokens / window, pctl(gaps, 0.50)
+
+def set_spec(client, srv, spec_k):
+    if srv is not None:
+        srv.engine.spec_k = spec_k
+    else:
+        for addr in client._spec_addrs:
+            ch = native.Channel(addr, timeout_ms=5000, max_retry=0)
+            ch.call("Gen/Spec", json.dumps({{"spec_k": spec_k}}).encode())
+            ch.close()
+
+def warm(client, srv, tag):
+    # Absorb EVERY jit compile outside the timings: both modes, both
+    # workloads, full budgets (the adapted k sweeps the whole window-
+    # width program set) — in EVERY engine process: fleet warm keys are
+    # picked per member via the router so neither engine compiles inside
+    # a timed drive.
+    keys = [None]
+    if srv is None:
+        client.router.refresh()
+        keys = []
+        for addr in client._spec_addrs:
+            i = 0
+            while client.router.route("w%s-%d" % (tag, i)) != addr:
+                i += 1
+            keys.append("w%s-%d" % (tag, i))
+    for k in (SPEC_K, 0):
+        set_spec(client, srv, k)
+        for prompt, n_tok in (FRIENDLY, ADVERSARIAL):
+            for key in keys:
+                if key is None:
+                    client.generate(prompt, n_tok)
+                else:
+                    # Terminal sessions may reuse their id: the same
+                    # member-targeted key warms every mode/workload.
+                    client.generate(prompt, n_tok, session_key=key)
+
+def ab_rows(client, srv):
+    out = {{}}
+    for name, (prompt, n_tok) in (("friendly", FRIENDLY),
+                                  ("adversarial", ADVERSARIAL)):
+        ratios, on_tps, off_tps, on_p50, off_p50 = [], [], [], [], []
+        for _rep in range(REPS):
+            off, offp = drive(client, srv, 0, prompt, n_tok, DRIVE_S)
+            on, onp = drive(client, srv, SPEC_K, prompt, n_tok, DRIVE_S)
+            ratios.append(on / max(off, 1e-9))
+            on_tps.append(on); off_tps.append(off)
+            on_p50.append(onp); off_p50.append(offp)
+        ratios.sort()
+        out[name] = {{
+            "tokens_s_on": round(pctl(on_tps, 0.5), 1),
+            "tokens_s_off": round(pctl(off_tps, 0.5), 1),
+            "tokens_s_x": round(ratios[len(ratios) // 2], 2),
+            "tokens_s_x_samples": [round(r, 2) for r in ratios],
+            "token_p50_ms_on": round(pctl(on_p50, 0.5), 2),
+            "token_p50_ms_off": round(pctl(off_p50, 0.5), 2),
+        }}
+    return out
+
+# Single-server A/B (interleaved off/on pairs, median-of-ratios).
+srv = ServingServer(PARAMS, max_batch=4, spec_k=SPEC_K, draft="ngram")
+port = srv.start()
+c = ServingClient("127.0.0.1:%d" % port)
+warm(c, srv, "s")
+row = {{"spec_k": SPEC_K, "reps": REPS, "single": ab_rows(c, srv)}}
+accept = srv.manager.sessionz_doc()
+row["single"]["accept_pct"] = accept["spec_accept_pct"]
+c.close()
+srv.stop()
+
+if FLEET:
+    # Fleet-size-2 drive: one member PROCESS each (the PR 6 in-process
+    # contention finding), spec toggled per rep via Gen/Spec.
+    from brpc_tpu.fleet import RegistryHub, clear_registry
+    from brpc_tpu.serving import ServingFleetClient
+    hub = RegistryHub()
+    hub.start()
+    procs = []
+    for _ in range(2):
+        p = subprocess.Popen([sys.executable, "-c", MEMBER, ROOT,
+                              hub.hostport, "spec2", "both", "4",
+                              str(SPEC_K)], stdin=subprocess.PIPE,
+                             stdout=subprocess.PIPE, text=True)
+        line = p.stdout.readline().strip()
+        assert line.startswith("READY"), line
+        procs.append((p, line.split()[1]))
+    try:
+        fc = ServingFleetClient(hub.hostport, tag="spec2")
+        fc._spec_addrs = [addr for _p, addr in procs]
+        warm(fc, None, "f")
+        row["fleet_2"] = ab_rows(fc, None)
+        fc.close()
+    finally:
+        for p, _addr in procs:
+            try:
+                p.stdin.close()
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+        clear_registry()
+        hub.stop()
+print(json.dumps(row))
+"""
+
+
+def serving_spec_point(spec_k=4, reps=5, drive_s=1.0, fleet=True,
+                       wedge_log=None):
+    """Speculative decoding A/B (ISSUE 15 acceptance row): interleaved
+    spec-on/off tokens/s + per-token p50 on the acceptance-friendly
+    (long-prompt) and adversarial (short-prompt, low-acceptance)
+    workloads, single server + a fleet-size-2 drive — median-of-ratios
+    over the pairs, one wedge-guarded child."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _SERVING_SPEC_CHILD.format(root=root, dump_dir=_dump_dir(),
+                                      member=_FLEET_MEMBER, spec_k=spec_k,
+                                      reps=reps, drive_s=drive_s,
+                                      fleet="True" if fleet else "False")
+    timeout = 240 + reps * drive_s * (16 if fleet else 8)
+    row = _run_guarded_child("serving_spec", code, timeout, wedge_log)
+    if not row.get("wedged"):
+        s = row["single"]
+        msg = (f"# serving_spec: friendly "
+               f"{s['friendly']['tokens_s_off']} -> "
+               f"{s['friendly']['tokens_s_on']} tok/s "
+               f"({s['friendly']['tokens_s_x']}x), adversarial "
+               f"{s['adversarial']['tokens_s_off']} -> "
+               f"{s['adversarial']['tokens_s_on']} tok/s "
+               f"({s['adversarial']['tokens_s_x']}x), "
+               f"accept {s['accept_pct']}%")
+        if "fleet_2" in row:
+            msg += (f"; fleet-2 friendly "
+                    f"{row['fleet_2']['friendly']['tokens_s_x']}x / "
+                    f"adversarial "
+                    f"{row['fleet_2']['adversarial']['tokens_s_x']}x")
+        print(msg, file=sys.stderr)
+    return row
+
+
 def _run_guarded_child(name, code, timeout, wedge_log=None):
     """The serving/overload child-runner shape: one subprocess under a
     hard timeout; a wedge records dump files instead of hanging the
@@ -1043,6 +1239,13 @@ def main() -> None:
         sweep["serving_fleet"] = serving_fleet_point(wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# serving_fleet skipped: {e}", file=sys.stderr)
+    # Speculative-decoding A/B (ISSUE 15): spec-on/off tokens/s +
+    # per-token p50 on acceptance-friendly and adversarial workloads,
+    # single server + fleet-size-2.
+    try:
+        sweep["serving_spec"] = serving_spec_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# serving_spec skipped: {e}", file=sys.stderr)
     try:
         sweep["serving_fleet_drain"] = serving_drain_point(
             wedge_log=wedges)
@@ -2192,6 +2395,15 @@ def smoke() -> None:
                                     timeout=240))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["allreduce_GBps_2s"] = {"error": str(e)}
+    # Guarded spec-decode mini-row: one single-server spec-on/off pair
+    # per workload (no fleet) — if the verify window, the acceptance
+    # walk, or the k-adaptation regresses the serving hot path, the
+    # smoke run shows it before the full sweep would.
+    try:
+        out["serving_spec"] = serving_spec_point(
+            reps=1, drive_s=0.6, fleet=False, wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["serving_spec"] = {"error": str(e)}
     # Guarded serving-fleet mini-row: one 2-member drain-migration drive
     # (2 mid-stream sessions) — if session routing, the KV ship path, or
     # the resume replay breaks token parity, the smoke run shows it

@@ -14,11 +14,22 @@ The per-lane KV cache rows live OUTSIDE the model, in TensorArena pages
 keyed by session (brpc_tpu/serving/session.py) — the model consumes a
 stacked view and returns just the new (k, v) row per lane for the engine
 to write back.
+
+Speculative decoding (ISSUE 15) generalizes the single-position step to a
+fixed-shape window: ``verify_step`` scores (max_batch, k+1) positions in
+ONE dispatch — each position runs the EXACT ``decode_step`` math (the
+shared ``_attend`` body, causality enforced by writing the window's rows
+in order), so the greedy argmax at every position is the token the
+sequential path would have produced and acceptance stays bit-lossless.
+Two draft proposers feed it: ``draft_propose`` runs a (usually smaller)
+decoder configuration with its own KV plane through the same windowed
+dispatch, and ``ngram_propose`` is the model-free prompt-lookup fallback
+(propose whatever followed the last n-gram's previous occurrence).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,20 +58,16 @@ def init_decoder(rng: jax.Array, vocab: int = 64, dim: int = 32,
         wo=jax.random.normal(ks[4], (dim, dim), jnp.float32) * s)
 
 
-@jax.jit
-def decode_step(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
-                lengths: jax.Array, tokens: jax.Array
-                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One batched decode step.
-
-    kv_k/kv_v: (B, L, D) — each lane's cache with rows [0, lengths[b])
-    valid. tokens: (B,) the input token per lane. Returns
-    (next_tokens (B,), k_new (B, D), v_new (B, D)): the engine writes
-    k_new/v_new into row lengths[b] of the lane's arena-backed cache and
-    advances the length. Inactive lanes are simply ignored by the caller
-    (their outputs are well-defined garbage; fixed shapes keep this one
-    compiled program for every batch composition).
-    """
+def _attend(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
+            lengths: jax.Array, tokens: jax.Array):
+    """ONE position of greedy decode for every lane — the single home of
+    the step math. ``decode_step`` runs it once; ``verify_step`` and
+    ``draft_propose`` unroll it over a window, threading the functionally
+    updated caches through so later positions attend earlier ones (the
+    in-window causal discipline: writes happen in position order, and the
+    length mask admits exactly the rows written so far). Sharing the body
+    is what makes the speculative path's argmax at each position the
+    bit-identical twin of the sequential path's."""
     x = params.embed[tokens] + params.pos[lengths]  # (B, D)
     q = x @ params.wq
     k_new = x @ params.wk
@@ -80,7 +87,107 @@ def decode_step(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
     # input forever) — the attention context + position drive the output.
     out = ctx @ params.wo + 0.5 * params.pos[lengths]
     logits = out @ params.embed.T
-    return jnp.argmax(logits, axis=-1), k_new, v_new
+    return jnp.argmax(logits, axis=-1), k_new, v_new, kv_k, kv_v
+
+
+@jax.jit
+def decode_step(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
+                lengths: jax.Array, tokens: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One batched decode step.
+
+    kv_k/kv_v: (B, L, D) — each lane's cache with rows [0, lengths[b])
+    valid. tokens: (B,) the input token per lane. Returns
+    (next_tokens (B,), k_new (B, D), v_new (B, D)): the engine writes
+    k_new/v_new into row lengths[b] of the lane's arena-backed cache and
+    advances the length. Inactive lanes are simply ignored by the caller
+    (their outputs are well-defined garbage; fixed shapes keep this one
+    compiled program for every batch composition).
+    """
+    nxt, k_new, v_new, _, _ = _attend(params, kv_k, kv_v, lengths, tokens)
+    return nxt, k_new, v_new
+
+
+@jax.jit
+def verify_step(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
+                lengths: jax.Array, window: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Score a (B, W) window of input tokens in ONE dispatch: position j
+    of lane b consumes ``window[b, j]`` at cache row ``lengths[b] + j``
+    and produces the greedy argmax ``y[b, j]`` — exactly what W calls of
+    ``decode_step`` would have produced (the unrolled loop runs the same
+    ``_attend`` body per position over the functionally threaded caches,
+    so causal masking inside the window is by construction). Returns
+    (y (B, W), k_rows (B, W, D), v_rows (B, W, D)); the caller commits
+    only the rows whose inputs it accepts (rejection is a pointer rewind
+    — nothing here ever touches the caller's numpy planes). One compiled
+    program per (B, W), the fixed-lane discipline extended to the window
+    axis."""
+    outs, ks, vs = [], [], []
+    for j in range(window.shape[1]):
+        nxt, k_new, v_new, kv_k, kv_v = _attend(
+            params, kv_k, kv_v, lengths + j, window[:, j])
+        outs.append(nxt)
+        ks.append(k_new)
+        vs.append(v_new)
+    return (jnp.stack(outs, axis=1), jnp.stack(ks, axis=1),
+            jnp.stack(vs, axis=1))
+
+
+@jax.jit
+def draft_propose(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
+                  lengths: jax.Array, window: jax.Array,
+                  n_known: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The draft model's ingest-and-propose window: position j consumes
+    ``window[b, j]`` while ``j < n_known[b]`` (committed tokens the draft
+    plane hasn't seen yet — catch-up and prompt ingestion ride the same
+    dispatch) and its OWN previous argmax afterwards (autoregressive
+    proposal). Returns (y, k_rows, v_rows) like :func:`verify_step`; the
+    proposals for the target are ``y[b, n_known[b]-1 :]``. One program
+    per (B, W) — the draft's whole per-step work is one dispatch instead
+    of k sequential ones, which is where the draft stays cheap."""
+    outs, ks, vs = [], [], []
+    prev = window[:, 0]
+    for j in range(window.shape[1]):
+        inp = jnp.where(j < n_known, window[:, j], prev)
+        nxt, k_new, v_new, kv_k, kv_v = _attend(
+            params, kv_k, kv_v, lengths + j, inp)
+        outs.append(nxt)
+        ks.append(k_new)
+        vs.append(v_new)
+        prev = nxt
+    return (jnp.stack(outs, axis=1), jnp.stack(ks, axis=1),
+            jnp.stack(vs, axis=1))
+
+
+def emit_done(token: int, emitted: int, max_tokens: int,
+              eos_id: int) -> bool:
+    """The single home of the greedy stop clamp: True once generation
+    must stop AFTER counting ``token`` as the ``emitted``-th emission
+    (1-based) — the token is EOS, or the budget is spent. Shared by
+    ``decode_serial``, the engine's emit path and the speculative
+    acceptance walk so the three can never drift (the ``parse_moved``
+    precedent)."""
+    return token == eos_id or emitted >= max_tokens
+
+
+def ngram_propose(seq: Sequence[int], k: int, max_n: int = 3) -> List[int]:
+    """Model-free prompt-lookup draft: find the most recent EARLIER
+    occurrence of the sequence's trailing n-gram (longest n first) and
+    propose the tokens that followed it — up to ``k`` of them. Costs a
+    list scan, no model, no state; returns [] when nothing repeats (the
+    engine then runs a plain-width step for that lane)."""
+    n_seq = len(seq)
+    if k <= 0 or n_seq < 2:
+        return []
+    for n in range(min(max_n, n_seq - 1), 0, -1):
+        tail = list(seq[n_seq - n:])
+        # Scan right-to-left for the previous occurrence of the tail.
+        for i in range(n_seq - n - 1, -1, -1):
+            if list(seq[i:i + n]) == tail:
+                return [int(t) for t in seq[i + n:i + n + k]]
+    return []
 
 
 def decode_serial(params: DecoderParams, prompt, max_tokens: int,
@@ -106,6 +213,6 @@ def decode_serial(params: DecoderParams, prompt, max_tokens: int,
             continue  # prefill: consume the prompt, emit nothing
         token = int(np.asarray(nxt)[0])
         out.append(token)
-        if token == eos_id or len(out) >= max_tokens:
+        if emit_done(token, len(out), max_tokens, eos_id):
             break
     return out

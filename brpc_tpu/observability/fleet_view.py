@@ -297,7 +297,8 @@ def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
     out = {"qps": 0.0, "p99_us": 0, "codec_bytes_logical": 0,
            "codec_bytes_wire": 0, "version_lag_max": 0,
            "serving_tokens_s": 0.0, "serving_sessions": 0,
-           "serving_ttft_p99_us": 0}
+           "serving_ttft_p99_us": 0, "serving_spec_proposed": 0,
+           "serving_spec_accepted": 0}
     for name, value in pairs:
         try:
             if name.startswith("rpc_server_"):
@@ -319,8 +320,18 @@ def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
                 out["serving_sessions"] = int(float(value))
             elif name == "serving_ttft_latency_99":
                 out["serving_ttft_p99_us"] = int(float(value))
+            elif name == "serving_spec_proposed":
+                out["serving_spec_proposed"] = int(float(value))
+            elif name == "serving_spec_accepted":
+                out["serving_spec_accepted"] = int(float(value))
         except ValueError:
             continue  # non-numeric var under a matched prefix
+    # The accept-rate column: cumulative accepted/proposed (0 when the
+    # member never speculated — spec off reads as 0%, not a gap).
+    prop = out["serving_spec_proposed"]
+    out["serving_spec_accept_pct"] = (
+        round(100.0 * out["serving_spec_accepted"] / prop, 1)
+        if prop else 0.0)
     return out
 
 
@@ -385,12 +396,19 @@ def rollup(shards: List[dict]) -> dict:
                 default=0),
             "rpcz_off": sorted(s["addr"] for s in shards
                                if s.get("rpcz_enabled") == 0)}
+    spec_prop = spec_acc = 0
     for s in shards:
         worst = max(worst, HEALTH_RANK.get(s.get("health"), 3))
         logical += s.get("codec_bytes_logical", 0)
         wire += s.get("codec_bytes_wire", 0)
+        spec_prop += s.get("serving_spec_proposed", 0)
+        spec_acc += s.get("serving_spec_accepted", 0)
     roll["health_worst"] = _RANK_NAMES[worst] if shards else "empty"
     roll["codec_ratio"] = (logical / wire) if wire > 0 else 0.0
+    # Fleet accept rate = aggregate accepted/proposed, NOT a mean of
+    # per-shard percentages (a near-idle shard must not swing it).
+    roll["serving_spec_accept_pct"] = (
+        round(100.0 * spec_acc / spec_prop, 1) if spec_prop else 0.0)
     return roll
 
 
@@ -613,6 +631,8 @@ class FleetObserver:
             f"{roll['serving_sessions_total']}",
             f"fleet_serving_ttft_p99_max_us "
             f"{roll['serving_ttft_p99_max_us']}",
+            f"fleet_serving_spec_accept_pct "
+            f"{roll.get('serving_spec_accept_pct', 0.0):.1f}",
         ])
 
     def publish_rollup_gauges(self) -> None:
@@ -660,4 +680,6 @@ class FleetObserver:
                               reader("serving_sessions_total"))
         obs.repointable_gauge("fleet_serving_ttft_p99_max_us",
                               reader("serving_ttft_p99_max_us"))
+        obs.repointable_gauge("fleet_serving_spec_accept_pct",
+                              reader("serving_spec_accept_pct"))
         self._gauges_published = True

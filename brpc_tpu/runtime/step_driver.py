@@ -438,11 +438,13 @@ class CollectiveStepDriver:
     layer k+1's backward AND behind each other. ``overlap=False`` runs
     the same nodes serially — the A/B baseline.
 
-    The optimizer math is the ParameterServer CPU path's exactly
-    (copy-on-write numpy momentum step), so a collective-trained
-    trajectory is comparable to the parameter-server one; ``ef=False``
-    on the group is the naive-requantizer negative control the
-    convergence tests pin.
+    The optimizer is ONE jitted ``fused_momentum_update`` call per layer
+    over the reduced buffer (the PR 13 leftover retired): the auto-routed
+    Pallas kernel on TPU, the identical jnp reference elsewhere —
+    trajectory parity with the explicit momentum formula is pinned, and
+    the copy-on-write discipline (handed-out arrays stay immutable) is
+    unchanged; ``ef=False`` on the group is the naive-requantizer
+    negative control the convergence tests pin.
 
     Failure: a hop failure (member left, timeout) cancels exactly that
     layer's ``opt:k`` while every other layer completes (partial
@@ -491,6 +493,7 @@ class CollectiveStepDriver:
         from brpc_tpu.observability import tracing
 
         import jax
+        import jax.numpy as jnp
         import numpy as np
 
         t0 = time.monotonic()
@@ -532,13 +535,23 @@ class CollectiveStepDriver:
 
         def make_opt(name):
             def fn(done):
-                # The ParameterServer CPU update exactly: copy-on-write
-                # numpy momentum step (handed-out arrays stay immutable).
-                g = reduced[name]
-                m2 = self.momentum * self._momenta[name] + g
-                p2 = self._params[name] - self.lr * m2
-                self._momenta[name] = m2
-                self._params[name] = p2
+                # ONE jitted fused-momentum-update call over the reduced
+                # buffer (the PR 13 leftover): ops/fused_momentum_update
+                # auto-routes — the Pallas kernel on TPU (one HBM round
+                # trip for the whole (p, m, g) -> (p', m') update), the
+                # identical jnp math elsewhere. Copy-on-write discipline
+                # preserved: handed-out arrays stay immutable, the
+                # detached results replace them.
+                from brpc_tpu.ops.fused_update import fused_momentum_update
+
+                p2, m2 = fused_momentum_update(
+                    jnp.asarray(self._params[name]),
+                    jnp.asarray(self._momenta[name]),
+                    jnp.asarray(reduced[name]),
+                    lr=self.lr, beta=self.momentum)
+                p2, m2 = jax.block_until_ready((p2, m2))
+                self._momenta[name] = np.asarray(m2)
+                self._params[name] = np.asarray(p2)
                 return None
             return fn
 

@@ -10,7 +10,10 @@ other session's completion.
              /sessionz document, serving_* recorders
   engine   — DecodeEngine: the batched step loop over
              models/decoder.decode_step, try-write token emission with
-             bounded pending buffers (slow-reader isolation), rpcz spans
+             bounded pending buffers (slow-reader isolation), rpcz spans;
+             spec_k > 0 switches it to draft->verify->commit speculative
+             steps (models/decoder.verify_step windows — lossless
+             multi-token emission, per-session k adaptation)
   server   — ServingServer: Gen/Open + Gen/Close over tstd (stream
              handshake in the RPC), the /gen HTTP chunked fallback
   client   — ServingClient/TokenStream: HIGH-stamped session control,

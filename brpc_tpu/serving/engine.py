@@ -22,6 +22,27 @@ sheds at a step boundary, never mid-write); admission prefers HIGH-
 priority sessions over BULK when lanes are scarce. Each decode step runs
 inside an rpcz span (head-sampled like every root) with admit/model/emit
 stage annotations.
+
+Speculative decoding (ISSUE 15): with ``spec_k > 0`` the step loop goes
+draft -> verify -> commit — a proposer fills a fixed-shape (max_batch,
+W<=spec_k+1) window per step (remaining PROMPT tokens first: known
+inputs need no verification, so prefill ingests up to W rows per
+dispatch; then draft proposals — the model-free n-gram prompt-lookup or
+a smaller draft decoder with its own engine-owned KV plane), ONE
+``verify_step`` dispatch scores every position with the exact
+``decode_step`` math, and the commit walk accepts the longest prefix
+where each proposal equals the previous position's target argmax, plus
+the target's own token at the first mismatch. Output is therefore
+BIT-IDENTICAL to non-speculative greedy decoding — the batched==serial
+parity pin extends unchanged — while accepted steps emit several tokens
+through the same bounded pending buffers (EOS + max_tokens clamped
+mid-window via the shared ``emit_done`` helper). Rejection is a pointer
+rewind: only accepted rows are ever written back to the session's KV
+planes (paging, export and one-sided publication see committed rows
+only), and the draft plane rewinds the same way. Per-session ``spec_k``
+adapts on an acceptance-rate EMA (floor 1; all-prompt windows don't
+count); ``engine.spec_k = 0`` is the live kill switch — the verbatim
+single-token path — and the bench's A/B toggle (Gen/Spec).
 """
 
 from __future__ import annotations
@@ -34,7 +55,9 @@ from typing import List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from brpc_tpu.models.decoder import DecoderParams, decode_step, init_decoder
+from brpc_tpu.models.decoder import (DecoderParams, decode_step,
+                                     draft_propose, emit_done, init_decoder,
+                                     ngram_propose, verify_step)
 from brpc_tpu.serving.session import (ACTIVE, DONE, FRAME_TOKEN, FROZEN,
                                       QUEUED, SHED, Session, SessionManager,
                                       serving_metrics)
@@ -48,7 +71,10 @@ class DecodeEngine:
     def __init__(self, manager: SessionManager,
                  params: Optional[DecoderParams] = None, *,
                  max_batch: int = 4, eos_id: int = 0,
-                 step_idle_s: float = 0.02):
+                 step_idle_s: float = 0.02, spec_k: int = 0,
+                 draft: str = "ngram",
+                 draft_params: Optional[DecoderParams] = None,
+                 draft_dim: int = 16, spec_ema_alpha: float = 0.3):
         import jax
 
         self.manager = manager
@@ -57,6 +83,30 @@ class DecodeEngine:
         self.max_batch = max_batch
         self.eos_id = eos_id
         self.step_idle_s = step_idle_s
+        # Speculative decoding config. spec_k is a PLAIN attribute read
+        # once per step: setting it live (Gen/Spec, tests, bench A/B)
+        # takes effect at the next step boundary, and 0 is the kill
+        # switch — the verbatim single-token path.
+        if draft not in ("ngram", "model"):
+            raise ValueError(f"unknown draft proposer {draft!r}")
+        self.spec_k = int(spec_k)
+        self.draft = draft
+        self.spec_ema_alpha = spec_ema_alpha
+        self._draft_params: Optional[DecoderParams] = None
+        if draft == "model":
+            self._draft_params = draft_params if draft_params is not None \
+                else init_decoder(jax.random.PRNGKey(1), dim=draft_dim)
+            ddim = self._draft_params.embed.shape[1]
+            # The draft's KV planes are ENGINE-owned, keyed by lane +
+            # session id — spec state is ephemeral by construction:
+            # freeze/migration/paging never ship it, an importing engine
+            # simply rebuilds by catch-up ingestion, and a rejected run
+            # is discarded by the pointer rewind below.
+            self._draft_kv_k = np.zeros((max_batch, manager.max_len, ddim),
+                                        np.float32)
+            self._draft_kv_v = np.zeros_like(self._draft_kv_k)
+        self._draft_sid: List[Optional[str]] = [None] * max_batch
+        self._draft_pos = [0] * max_batch
         self.steps = 0
         # Serving-fleet hook: called (engine thread, must only enqueue)
         # when a prefill-role session freezes at its handoff point — the
@@ -248,6 +298,18 @@ class DecodeEngine:
         if not decodable:
             self._drain_finished(now)
             return False
+        if self.spec_k > 0:
+            self._step_spec(decodable)
+        else:
+            self._step_plain(decodable)
+        self._drain_finished(time.monotonic())
+        return True
+
+    def _step_plain(self, decodable: List[Session]) -> None:
+        """The reference single-token step (spec_k == 0, the kill
+        switch): one ``decode_step`` dispatch, one emission per lane."""
+        trace_span, stage, annotate = (self._trace_span, self._stage,
+                                       self._annotate)
         with trace_span("decode_step"):
             annotate(f"batch={len(decodable)}")
             with stage("model"):
@@ -305,33 +367,266 @@ class DecodeEngine:
                         # stops exactly where colocated decode would.
                         sess.out_tokens.append(sess.token)
                         sess.emitted += 1
-                        if sess.token == self.eos_id:
+                        if emit_done(sess.token, sess.emitted,
+                                     sess.max_tokens, self.eos_id):
                             sess.max_tokens = sess.emitted
                         handoffs.append(sess)
                         continue
                     if not self._emit(sess, sess.token, now):
                         self._retire(sess, shed_reason=sess.shed_reason)
                         continue
-                    if sess.token == self.eos_id:
+                    if emit_done(sess.token, sess.emitted,
+                                 sess.max_tokens, self.eos_id):
                         sess.max_tokens = sess.emitted  # EOS: stop decoding
                 # Commit every slot kv_begin_step write-locked — including
                 # sessions the loop skipped (their bytes are unchanged;
                 # the republish just restores an even seq).
                 for sess in decodable:
                     self.manager.publish_kv(sess)
-                # Freeze prefill-complete sessions AFTER the commit above
-                # so the exporter (lane == -1 is its go signal) only ever
-                # reads a fully published position.
-                for sess in handoffs:
-                    if 0 <= sess.lane < len(self._lanes):
-                        self._lanes[sess.lane] = None
-                    sess.lane = -1
-                    if self.manager.freeze(sess) \
-                            and self.on_session_frozen is not None:
-                        self.on_session_frozen(sess)
+                self._freeze_handoffs(handoffs)
             self.steps += 1
-        self._drain_finished(now)
-        return True
+
+    def _freeze_handoffs(self, handoffs: List[Session]) -> None:
+        """Freeze prefill-complete sessions AFTER their KV publish
+        commits, so the exporter (lane == -1 is its go signal) only ever
+        reads a fully published position."""
+        for sess in handoffs:
+            if 0 <= sess.lane < len(self._lanes):
+                self._lanes[sess.lane] = None
+            sess.lane = -1
+            if self.manager.freeze(sess) \
+                    and self.on_session_frozen is not None:
+                self.on_session_frozen(sess)
+
+    # ---- the speculative step (spec_k > 0) ----
+
+    def _reset_draft_lane(self, i: int, sess: Session) -> None:
+        """(Re)bind lane ``i``'s engine-owned draft state to ``sess`` —
+        the lane changed hands (admission, migration import, unfreeze):
+        whatever draft run was in flight is discarded and the plane
+        rebuilds by catch-up ingestion from the committed sequence."""
+        self._draft_sid[i] = sess.id
+        self._draft_pos[i] = 0
+        if self._draft_params is not None:
+            self._draft_kv_k[i] = 0.0
+            self._draft_kv_v[i] = 0.0
+
+    def _fill_windows(self, decodable: List[Session], W: int):
+        """Build the (B, W) verify window: per lane, remaining COMMITTED
+        inputs first (prompt tokens and the pending last emission — known
+        values need no verification, so prefill ingests up to W rows per
+        dispatch), then up to the lane's adapted ``spec_k`` draft
+        proposals. Returns (window, n_known, n_prop, seqs)."""
+        B = self.max_batch
+        window = np.zeros((B, W), np.int32)
+        n_known = np.zeros((B,), np.int32)
+        n_prop = np.zeros((B,), np.int32)
+        d_ingested = np.zeros((B,), np.int32)
+        seqs = {}
+        model_lanes = []
+        for sess in decodable:
+            i = sess.lane
+            seq = sess.prompt + sess.out_tokens
+            seqs[sess.id] = seq
+            t_known = min(W, len(seq) - sess.pos)
+            window[i, :t_known] = seq[sess.pos:sess.pos + t_known]
+            n_known[i] = t_known
+            if self._draft_sid[i] != sess.id:
+                self._reset_draft_lane(i, sess)
+            if self._draft_params is not None:
+                model_lanes.append(sess)
+                continue
+            want = min(max(1, sess.spec_k or self.spec_k), W - t_known)
+            if want > 0:
+                props = ngram_propose(seq[:sess.pos + t_known], want)
+                window[i, t_known:t_known + len(props)] = props
+                n_prop[i] = len(props)
+        if model_lanes:
+            self._model_draft(model_lanes, window, n_known, n_prop,
+                              d_ingested, seqs, W)
+        return window, n_known, n_prop, d_ingested, seqs
+
+    def _model_draft(self, lanes, window, n_known, n_prop, d_ingested,
+                     seqs, W: int) -> None:
+        """One ``draft_propose`` dispatch over every model-draft lane:
+        the draft ingests committed tokens its plane hasn't seen (prompt
+        rows, post-import catch-up, last step's correction) and proposes
+        autoregressively past them. Proposals are usable only when the
+        draft's ingest frontier reaches the target's (the steady-state
+        lag is 0 or 1 rows; a cold plane spends a few windows catching
+        up and the lane decodes plain-width meanwhile)."""
+        L = self.manager.max_len
+        B = self.max_batch
+        d_window = np.zeros((B, W), np.int32)
+        d_known = np.zeros((B,), np.int32)
+        d_lengths = np.zeros((B,), np.int32)
+        for sess in lanes:
+            i = sess.lane
+            seq = seqs[sess.id]
+            start = self._draft_pos[i]
+            m = min(W, len(seq) - start)
+            d_window[i, :m] = seq[start:start + m]
+            d_known[i] = m
+            d_lengths[i] = start
+        d_y, d_k, d_v = draft_propose(
+            self._draft_params, jnp.asarray(self._draft_kv_k),
+            jnp.asarray(self._draft_kv_v), jnp.asarray(d_lengths),
+            jnp.asarray(d_window), jnp.asarray(d_known))
+        d_y = np.asarray(d_y)
+        d_k = np.asarray(d_k)
+        d_v = np.asarray(d_v)
+        for sess in lanes:
+            i = sess.lane
+            start = self._draft_pos[i]
+            m = int(d_known[i])
+            d_ingested[i] = m
+            rows = min(W, L - start)
+            self._draft_kv_k[i, start:start + rows] = d_k[i, :rows]
+            self._draft_kv_v[i, start:start + rows] = d_v[i, :rows]
+            t_known = int(n_known[i])
+            want = min(max(1, sess.spec_k or self.spec_k), W - t_known)
+            # Aligned iff the draft's first proposal predicts exactly the
+            # row after the target's last known input.
+            if start + m != sess.pos + t_known:
+                continue  # catch-up window: nothing proposable yet
+            k_eff = min(want, W - m)
+            if k_eff <= 0:
+                continue
+            props = d_y[i, m - 1:m - 1 + k_eff]
+            window[i, t_known:t_known + k_eff] = props
+            n_prop[i] = k_eff
+
+    def _step_spec(self, decodable: List[Session]) -> None:
+        """Draft -> verify -> commit. One fixed-shape ``verify_step``
+        dispatch scores the whole window; the commit walk accepts the
+        longest prefix where every proposal equals the previous
+        position's target argmax (plus the target's token at the first
+        mismatch), writes ONLY accepted rows back into the session's
+        arena planes (rejection = pointer rewind; paging/export/oneside
+        never see a draft row), and pushes each accepted emission through
+        the bounded pending buffers with the EOS/max_tokens clamp applied
+        mid-window. The window width is 1 + the widest per-lane need this
+        step, so adaptation shrinks the dispatch, not just the fill."""
+        trace_span, stage, annotate = (self._trace_span, self._stage,
+                                       self._annotate)
+        B = self.max_batch
+        L = self.manager.max_len
+        D = self.manager.dim
+        spec_max = self.spec_k
+        need = 1
+        for sess in decodable:
+            known = len(sess.prompt) + len(sess.out_tokens) - sess.pos
+            if known > 1:  # prefill: the whole window is known inputs
+                need = max(need, min(spec_max, known - 1))
+            else:
+                need = max(need, max(1, min(spec_max,
+                                            sess.spec_k or spec_max)))
+        W = 1 + need
+        with trace_span("decode_step"):
+            annotate(f"batch={len(decodable)} spec_w={W}")
+            with stage("draft"):
+                kv_k = np.zeros((B, L, D), np.float32)
+                kv_v = np.zeros((B, L, D), np.float32)
+                lengths = np.zeros((B,), np.int32)
+                for sess in decodable:
+                    i = sess.lane
+                    kv_k[i] = sess.kv_k
+                    kv_v[i] = sess.kv_v
+                    lengths[i] = sess.pos
+                window, n_known, n_prop, d_ingested, seqs = \
+                    self._fill_windows(decodable, W)
+            with stage("verify"):
+                y, k_rows, v_rows = verify_step(
+                    self.params, jnp.asarray(kv_k), jnp.asarray(kv_v),
+                    jnp.asarray(lengths), jnp.asarray(window))
+                y = np.asarray(y)
+                k_rows = np.asarray(k_rows)
+                v_rows = np.asarray(v_rows)
+            with stage("emit"):
+                now = time.monotonic()
+                self.manager.kv_begin_step(decodable)
+                handoffs = []
+                proposed = accepted = 0
+                for sess in decodable:
+                    if sess.state != ACTIVE:
+                        continue  # finished externally mid-step: swept
+                    i = sess.lane
+                    t_known = int(n_known[i])
+                    props = int(n_prop[i])
+                    d_start, d_m = self._draft_pos[i], int(d_ingested[i])
+                    ncommit = 0
+                    compared = 0  # proposals the walk actually evaluated
+                    shed = False
+                    for j in range(W):
+                        if j >= t_known:
+                            if j >= t_known + props:
+                                break  # window tail: padding, never valid
+                            compared += 1
+                            if int(window[i, j]) != int(y[i, j - 1]):
+                                break  # draft != target argmax: rewind
+                        r = sess.pos + j
+                        sess.kv_k[r] = k_rows[i, j]
+                        sess.kv_v[r] = v_rows[i, j]
+                        ncommit = j + 1
+                        if r < len(sess.prompt) - 1:
+                            continue  # pure prefill row: nothing to emit
+                        tok = int(y[i, j])
+                        sess.token = tok
+                        if sess.prefill_handoff and sess.emitted == 0:
+                            # The disaggregation handoff point is still
+                            # "first token computed": record it, clamp,
+                            # freeze — the decode member continues, so no
+                            # further window position may commit here.
+                            sess.out_tokens.append(tok)
+                            sess.emitted = 1
+                            if emit_done(tok, 1, sess.max_tokens,
+                                         self.eos_id):
+                                sess.max_tokens = 1
+                            handoffs.append(sess)
+                            break
+                        if not self._emit(sess, tok, now):
+                            shed = True
+                            break
+                        if emit_done(tok, sess.emitted, sess.max_tokens,
+                                     self.eos_id):
+                            sess.max_tokens = sess.emitted
+                            break
+                    sess.pos += ncommit
+                    sess.last_progress = now
+                    acc = max(0, ncommit - t_known)
+                    # Account only proposals the walk COMPARED: a break
+                    # at a known position (EOS/budget spent, handoff,
+                    # shed) leaves the rest unevaluated — counting them
+                    # as rejections would bias the accept rate and drag
+                    # the k-adaptation EMA down on every session's last
+                    # step.
+                    if compared > 0:
+                        proposed += compared
+                        accepted += acc
+                        a = self.spec_ema_alpha
+                        sess.spec_ema = ((1.0 - a) * sess.spec_ema
+                                         + a * (acc / compared))
+                        sess.spec_k = max(1, min(
+                            spec_max, int(round(sess.spec_ema * spec_max))))
+                    # Draft plane pointer rewinds with the acceptance:
+                    # rows past the last committed input are garbage and
+                    # will be rewritten from the committed sequence.
+                    if self._draft_params is not None and d_m > 0:
+                        self._draft_pos[i] = min(d_start + d_m + acc,
+                                                 sess.pos)
+                    if shed:
+                        self._retire(sess, shed_reason=sess.shed_reason)
+                for sess in decodable:
+                    self.manager.publish_kv(sess)
+                self._freeze_handoffs(handoffs)
+                if proposed:
+                    self._m["spec_proposed"].add(proposed)
+                    self._m["spec_accepted"].add(accepted)
+                    self._m["spec_accept"].record_us(
+                        int(round(100.0 * accepted / proposed)))
+                    self.manager.note_spec(proposed, accepted)
+                self._m["spec_steps"].add(1)
+            self.steps += 1
 
     def _drain_finished(self, now: float) -> None:
         """Close finished sessions once their pending tail drains — a

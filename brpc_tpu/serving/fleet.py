@@ -142,6 +142,7 @@ class FleetServingServer(ServingServer):
         if self._reg is not None:
             self._reg.stop()
             self._reg = None
+        self._decode_ring.close()
         with self._chan_mu:
             chans, self._chans = self._chans, {}
             readers, self._readers = self._readers, {}

@@ -8,10 +8,14 @@ by, keyed by SESSION ID. Every router instance — every client process,
 every prefill server picking a handoff destination — derives the
 IDENTICAL owner and the IDENTICAL clockwise spill chain from the
 membership list alone, with no coordination RPC (the determinism the
-acceptance test pins). Load-awareness is a local penalty box: an
-ELIMIT/E_DRAINING answer benches that member for the server's
-retry_after hint, so spill traffic walks the ring instead of hammering
-the shedding owner.
+acceptance test pins). Load-awareness is two local signals layered onto
+that walk: the penalty box (an ELIMIT/E_DRAINING answer benches that
+member for the server's retry_after hint — always the override), and —
+with ``load_aware=True`` — a background scrape of each member's /vars
+exposition through the SAME fold the /fleetz plane uses (live sessions
++ tokens/s, bounded TTL, never on the open path), which orders the
+SPILL half of the walk lightest-first. The sticky owner stays first
+either way: load bias redirects overflow, not placement.
 
 :class:`ServingFleetClient` is one client to the whole fleet: ``open``
 routes sticky-by-session-id with spill, prefers prefill members when the
@@ -54,7 +58,8 @@ class ServingRouter:
 
     def __init__(self, registry_hostport: Optional[str] = None,
                  tag: str = "serving",
-                 members: Optional[List[str]] = None):
+                 members: Optional[List[str]] = None,
+                 load_aware: bool = False, load_ttl_s: float = 1.0):
         if registry_hostport is None and members is None:
             raise ValueError("need a registry hostport or a member list")
         self._registry = registry_hostport
@@ -65,6 +70,86 @@ class ServingRouter:
         self._last_refresh = 0.0
         if members is not None:
             self._map = ShardMap(members)
+        # Load-aware spill (the PR 14 leftover): a background scraper
+        # folds each member's /vars through the /fleetz fold into
+        # (live sessions, tokens/s) rollups with a bounded TTL. The open
+        # path only ever READS the cache — routing never blocks on a
+        # scrape, and a member that stops answering simply ages out to
+        # "unknown" (ring order, like a fresh joiner).
+        self.load_ttl_s = load_ttl_s
+        self._load: Dict[str, tuple] = {}  # addr -> (sessions, tokens_s)
+        self._load_at: Dict[str, float] = {}
+        self._load_stop = threading.Event()
+        self._load_thread: Optional[threading.Thread] = None
+        if load_aware:
+            self._load_thread = threading.Thread(
+                target=self._load_loop, daemon=True, name="router-load")
+            self._load_thread.start()
+
+    def close(self) -> None:
+        """Stop the load scraper (no-op without load_aware)."""
+        self._load_stop.set()
+        if self._load_thread is not None:
+            self._load_thread.join(timeout=5)
+            self._load_thread = None
+
+    # ---- load scraping (reused /fleetz fold, background only) ----
+
+    def _fetch_vars(self, addr: str) -> Optional[str]:
+        """One member's /vars page (every member's tstd port also speaks
+        HTTP — the FleetObserver scrape path); None on any failure."""
+        import urllib.request
+        try:
+            with urllib.request.urlopen(f"http://{addr}/vars",
+                                        timeout=1.0) as resp:
+                return resp.read().decode(errors="replace")
+        except Exception:  # noqa: BLE001 — dead member: no load data
+            return None
+
+    def ingest_load(self, addr: str, vars_text: str) -> None:
+        """Fold one member's /vars dump into the load cache — the SAME
+        generic fold /fleetz and the FleetObserver twin ride, so the
+        router's view of "busy" is the observability plane's."""
+        from brpc_tpu.observability.fleet_view import fold_vars
+
+        fold = fold_vars(vars_text)
+        with self._mu:
+            self._load[addr] = (fold["serving_sessions"],
+                                fold["serving_tokens_s"])
+            self._load_at[addr] = time.monotonic()
+
+    def scrape_loads(self) -> None:
+        """One scrape pass over members whose cached load is stale."""
+        now = time.monotonic()
+        with self._mu:
+            members = list(self._map.shards) if self._map is not None \
+                else []
+            stale = [a for a in members
+                     if now - self._load_at.get(a, 0.0) >= self.load_ttl_s]
+        for addr in stale:
+            text = self._fetch_vars(addr)
+            if text is not None:
+                self.ingest_load(addr, text)
+
+    def _load_loop(self) -> None:
+        while not self._load_stop.wait(self.load_ttl_s / 2):
+            try:
+                self.refresh()
+                self.scrape_loads()
+            except Exception:  # noqa: BLE001 — scrape must never die
+                pass
+
+    def _load_key(self, addr: str, ring_index: int, now: float):
+        """Sort key for the spill half: (sessions, tokens/s) ascending —
+        lightest member first — with the ring position as the stable tie
+        break so routing stays deterministic for a given load snapshot.
+        Expired/absent data reads as zero load (a fresh joiner SHOULD
+        attract spill)."""
+        if now - self._load_at.get(addr, -1e9) <= 3 * self.load_ttl_s:
+            sessions, tokens_s = self._load.get(addr, (0, 0.0))
+        else:
+            sessions, tokens_s = 0, 0.0
+        return (sessions, tokens_s, ring_index)
 
     def refresh(self, force: bool = False) -> None:
         if self._registry is None:
@@ -96,10 +181,12 @@ class ServingRouter:
             return self._map.owner(session_id)
 
     def candidates(self, session_id: str) -> List[str]:
-        """The spill walk: owner first, then the ring clockwise —
-        currently-penalized members moved to the back (stable order
-        within each half, so routing stays deterministic given the same
-        membership and penalty state)."""
+        """The spill walk: the sticky owner first, then the ring
+        clockwise with the SPILL half reordered lightest-first from the
+        cached load rollups (no cache = pure ring order), and
+        currently-penalized members moved to the back regardless of load
+        (the penalty box stays the override). Deterministic given the
+        same membership, penalty and load-snapshot state."""
         with self._mu:
             if self._map is None or not len(self._map):
                 raise LookupError("no serving members")
@@ -109,8 +196,12 @@ class ServingRouter:
                          if self._penalty[a] <= now]:
                 del self._penalty[addr]
             benched = self._penalty
-            return ([a for a in pref if a not in benched]
-                    + [a for a in pref if a in benched])
+            spill = sorted(
+                ((a, i) for i, a in enumerate(pref[1:], 1)),
+                key=lambda ai: self._load_key(ai[0], ai[1], now))
+            walk = pref[:1] + [a for a, _ in spill]
+            return ([a for a in walk if a not in benched]
+                    + [a for a in walk if a in benched])
 
     def penalize(self, addr: str, for_s: float = 0.1) -> None:
         with self._mu:
@@ -213,14 +304,16 @@ class ServingFleetClient:
     def __init__(self, registry_hostport: str, *, tag: str = "serving",
                  tenant: str = "", timeout_ms: int = 5000,
                  prefer_prefill: bool = True,
-                 op_deadline_s: float = 15.0):
+                 op_deadline_s: float = 15.0,
+                 load_aware: bool = False):
         self._registry = registry_hostport
         self.tag = tag
         self.tenant = tenant
         self._timeout_ms = timeout_ms
         self._prefer_prefill = prefer_prefill
         self._deadline_s = op_deadline_s
-        self.router = ServingRouter(registry_hostport, tag=tag)
+        self.router = ServingRouter(registry_hostport, tag=tag,
+                                    load_aware=load_aware)
         # Disaggregated fleets register prefill-only members under
         # "<tag>-prefill": session opens go there (throughput plane) and
         # the decode ring serves the resumes.
@@ -367,6 +460,8 @@ class ServingFleetClient:
             delay = min(delay * 2, 0.25)
 
     def close(self) -> None:
+        self.router.close()
+        self.prefill_router.close()
         with self._mu:
             clients, self._clients = self._clients, {}
         for c in clients.values():

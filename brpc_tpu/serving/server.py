@@ -54,14 +54,18 @@ class ServingServer:
                  stall_timeout_s: float = 2.0, eos_id: int = 0,
                  stream_window: int = 256 << 10,
                  kv_arena_bytes: int = 8 << 20,
-                 publish_kv: bool = False):
+                 publish_kv: bool = False, spec_k: int = 0,
+                 draft: str = "ngram",
+                 draft_params: Optional[DecoderParams] = None):
         self.manager = SessionManager(
             max_len=max_len, dim=dim, ttl_s=ttl_s,
             tenant_max_sessions=tenant_max_sessions,
             stall_timeout_s=stall_timeout_s,
             kv_arena_bytes=kv_arena_bytes, publish_kv=publish_kv)
         self.engine = DecodeEngine(self.manager, params,
-                                   max_batch=max_batch, eos_id=eos_id)
+                                   max_batch=max_batch, eos_id=eos_id,
+                                   spec_k=spec_k, draft=draft,
+                                   draft_params=draft_params)
         self.stream_window = stream_window
         self.server = native.Server()
         self.server.add_service("Gen", self._handle)
@@ -80,6 +84,20 @@ class ServingServer:
             doc = json.loads(request.decode() or "{}")
             ok = self.manager.close(str(doc.get("session", "")))
             return json.dumps({"closed": bool(ok)}).encode(), b""
+        if method == "Spec":
+            # Live speculative-decoding toggle (admin/bench A/B): set
+            # spec_k for the NEXT step boundary onwards; 0 is the kill
+            # switch (the verbatim single-token path). Answers the
+            # previous value so a driver can restore it.
+            doc = json.loads(request.decode() or "{}")
+            old = self.engine.spec_k
+            if "spec_k" in doc:
+                k = int(doc["spec_k"])
+                if k < 0 or k > 16:
+                    raise native.RpcError(2004, f"spec_k {k} out of range")
+                self.engine.spec_k = k
+            return json.dumps({"spec_k": self.engine.spec_k,
+                               "was": old}).encode(), b""
         raise native.RpcError(1004, f"no such method: Gen/{method}")
 
     def _open(self, request: bytes):

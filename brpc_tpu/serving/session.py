@@ -199,6 +199,14 @@ def serving_metrics():
                 "migrated_in": obs.counter("serving_migrated_in"),
                 "spill_out": obs.counter("serving_kv_spill_out"),
                 "spill_in": obs.counter("serving_kv_spill_in"),
+                # Speculative decoding (ISSUE 15): per-step acceptance
+                # percentage samples + cumulative proposal accounting
+                # (accepted/proposed is the fleet fold's accept-rate
+                # column) + speculative steps taken.
+                "spec_accept": obs.latency("serving_spec_accept"),
+                "spec_proposed": obs.counter("serving_spec_proposed"),
+                "spec_accepted": obs.counter("serving_spec_accepted"),
+                "spec_steps": obs.counter("serving_spec_steps"),
             }
             # serving_sessions / serving_kv_bytes / serving_kv_spilled_
             # bytes gauges are registered (and re-pointed per manager) by
@@ -209,7 +217,9 @@ def serving_metrics():
             _metrics_cache = {k: NullSeries()
                               for k in ("ttft", "token", "tokens", "shed",
                                         "migrated_out", "migrated_in",
-                                        "spill_out", "spill_in")}
+                                        "spill_out", "spill_in",
+                                        "spec_accept", "spec_proposed",
+                                        "spec_accepted", "spec_steps")}
     return _metrics_cache
 
 
@@ -258,6 +268,13 @@ class Session:
         # KV paging: True while the planes live in the host spill store
         # (kv_k/kv_v are None, kv_off invalid) — faulted back on admit.
         self.paged = False
+        # Speculative decoding (engine-adapted, EPHEMERAL: never
+        # exported — an imported session restarts from the optimistic
+        # default): spec_k == 0 means "engine default" until the first
+        # proposal round adapts it; spec_ema is the acceptance-rate EMA
+        # that drives the adaptation (floor k=1 under mismatch).
+        self.spec_k = 0
+        self.spec_ema = 1.0
         # Slow-reader pending buffer (engine-owned).
         self.pending: List[bytes] = []
         self.pending_bytes = 0
@@ -317,6 +334,11 @@ class SessionManager:
         self._kv_bytes = 0
         self._shed_total = 0
         self._done_total = 0
+        # Speculative-decode accounting mirror (the engine's per-step
+        # proposal/acceptance totals — /sessionz renders the accept rate
+        # without reaching into native counters).
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         # Host-side KV spill store: {sid: (k_rows, v_rows)} detached
         # numpy copies of the first `pos` rows (rows >= pos are zero by
         # construction — the engine writes row pos then advances — so
@@ -499,6 +521,13 @@ class SessionManager:
     def get(self, sid: str) -> Optional[Session]:
         with self._mu:
             return self._sessions.get(sid)
+
+    def note_spec(self, proposed: int, accepted: int) -> None:
+        """Engine hook: account one speculative step's draft proposals
+        vs acceptances (the /sessionz accept-rate source)."""
+        with self._mu:
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
 
     def activate(self, sess: Session, lane: int) -> bool:
         """Atomic QUEUED -> ACTIVE(+lane) transition for the engine's
@@ -825,13 +854,15 @@ class SessionManager:
                                                   if s.kv_k is not None
                                                   else 0),
                 "age_s": int(s.age_s()), "pending": s.pending_bytes,
-                "paged": s.paged,
+                "paged": s.paged, "spec_k": s.spec_k,
             } for s in self._sessions.values()]
             active = sum(1 for s in self._sessions.values()
                          if s.state in (QUEUED, ACTIVE, FROZEN))
             kv_bytes = self._kv_bytes
             spilled = self._spilled_bytes
             shed_total = self._shed_total
+            spec_prop = self._spec_proposed
+            spec_acc = self._spec_accepted
         return {
             "active": active,
             "kv_bytes": kv_bytes,
@@ -840,6 +871,10 @@ class SessionManager:
             "ttft_p99_us": m["ttft"].p99(),
             "tokens_total": m["tokens"].value(),
             "shed_total": shed_total,
+            "spec_proposed": spec_prop,
+            "spec_accepted": spec_acc,
+            "spec_accept_pct": (round(100.0 * spec_acc / spec_prop, 1)
+                                if spec_prop else 0.0),
             "sessions": sessions,
         }
 

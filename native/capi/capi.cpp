@@ -1782,13 +1782,24 @@ void sessionz_page(const HttpRequest& req, HttpResponse* resp) {
   char line[320];
   snprintf(line, sizeof(line),
            "active sessions: %lld\nkv bytes: %lld\ntokens/s: %lld\n"
-           "ttft p99 (us): %lld\ntokens total: %lld\nshed total: %lld\n\n",
+           "ttft p99 (us): %lld\ntokens total: %lld\nshed total: %lld\n",
            static_cast<long long>(top_int("active")),
            static_cast<long long>(top_int("kv_bytes")),
            static_cast<long long>(top_int("tokens_per_s")),
            static_cast<long long>(top_int("ttft_p99_us")),
            static_cast<long long>(top_int("tokens_total")),
            static_cast<long long>(top_int("shed_total")));
+  b += line;
+  // Speculative decoding: cumulative accepted/proposed (0/0 = spec off).
+  const int64_t spec_prop = top_int("spec_proposed");
+  const int64_t spec_acc = top_int("spec_accepted");
+  snprintf(line, sizeof(line),
+           "spec accept: %.1f%% (%lld/%lld proposed)\n\n",
+           spec_prop > 0 ? 100.0 * static_cast<double>(spec_acc) /
+                               static_cast<double>(spec_prop)
+                         : 0.0,
+           static_cast<long long>(spec_acc),
+           static_cast<long long>(spec_prop));
   b += line;
   const tbutil::JsonValue* sessions = parsed->find("sessions");
   if (sessions == nullptr || sessions->size() == 0) {

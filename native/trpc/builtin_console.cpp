@@ -500,6 +500,10 @@ struct ShardScrape {
   double serving_tokens_s = 0;       // serving_token_emit_qps
   int64_t serving_sessions = 0;      // serving_sessions gauge
   int64_t serving_ttft_p99_us = 0;   // serving_ttft_latency_99
+  // Speculative-decode accept rate: cumulative accepted/proposed
+  // counters (spec-off members read 0/0 = 0%).
+  int64_t spec_proposed = 0;         // serving_spec_proposed
+  int64_t spec_accepted = 0;         // serving_spec_accepted
   int rpcz_on = -1;            // -1 = unknown (flags page unreadable)
   int64_t rpcz_sample_n = 0;
 };
@@ -556,8 +560,21 @@ void fleetz_fold_vars(const std::string& text, ShardScrape* s) {
       s->serving_sessions = strtoll(val, nullptr, 10);
     } else if (name == "serving_ttft_latency_99") {
       s->serving_ttft_p99_us = strtoll(val, nullptr, 10);
+    } else if (name == "serving_spec_proposed") {
+      s->spec_proposed = strtoll(val, nullptr, 10);
+    } else if (name == "serving_spec_accepted") {
+      s->spec_accepted = strtoll(val, nullptr, 10);
     }
   }
+}
+
+// Accept rate in percent from cumulative counters; 0 when the member
+// never speculated.
+double spec_accept_pct(int64_t accepted, int64_t proposed) {
+  return proposed > 0
+             ? 100.0 * static_cast<double>(accepted) /
+                   static_cast<double>(proposed)
+             : 0.0;
 }
 
 // Fold the member's /flags page ("name = value[ (default D)]  # help").
@@ -657,6 +674,7 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
   double qps_total = 0, serving_tokens_total = 0;
   int64_t p99_max = 0, lag_max = 0, logical = 0, wire = 0;
   int64_t serving_sessions_total = 0, serving_ttft_max = 0;
+  int64_t spec_proposed_total = 0, spec_accepted_total = 0;
   int worst = 0;
   size_t reachable = 0;
   std::vector<const ShardScrape*> rpcz_off;
@@ -669,6 +687,8 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     serving_tokens_total += s.serving_tokens_s;
     serving_sessions_total += s.serving_sessions;
     serving_ttft_max = std::max(serving_ttft_max, s.serving_ttft_p99_us);
+    spec_proposed_total += s.spec_proposed;
+    spec_accepted_total += s.spec_accepted;
     worst = std::max(worst, health_rank(s.health));
     if (s.reachable) ++reachable;
     if (s.rpcz_on == 0) rpcz_off.push_back(&s);
@@ -699,6 +719,10 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
       e.set("serving_tokens_s", s.serving_tokens_s);
       e.set("serving_sessions", s.serving_sessions);
       e.set("serving_ttft_p99_us", s.serving_ttft_p99_us);
+      e.set("serving_spec_proposed", s.spec_proposed);
+      e.set("serving_spec_accepted", s.spec_accepted);
+      e.set("serving_spec_accept_pct",
+            spec_accept_pct(s.spec_accepted, s.spec_proposed));
       e.set("rpcz_enabled", int64_t{s.rpcz_on});
       e.set("rpcz_sample_1_in_n", s.rpcz_sample_n);
       arr.push_back(std::move(e));
@@ -716,6 +740,10 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     roll.set("serving_tokens_s_total", serving_tokens_total);
     roll.set("serving_sessions_total", serving_sessions_total);
     roll.set("serving_ttft_p99_max_us", serving_ttft_max);
+    // Aggregate accepted/proposed, NOT a mean of per-shard percentages
+    // (a near-idle shard must not swing the fleet rate).
+    roll.set("serving_spec_accept_pct",
+             spec_accept_pct(spec_accepted_total, spec_proposed_total));
     tbutil::JsonValue off = tbutil::JsonValue::Array();
     for (const auto* s : rpcz_off) off.push_back(s->addr);
     roll.set("rpcz_off", std::move(off));
@@ -742,15 +770,16 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
   b += line;
   snprintf(line, sizeof(line),
            "serving: tokens_s=%.0f live_sessions=%lld "
-           "ttft_p99_max=%lldus\n\n",
+           "ttft_p99_max=%lldus spec_accept=%.1f%%\n\n",
            serving_tokens_total,
            static_cast<long long>(serving_sessions_total),
-           static_cast<long long>(serving_ttft_max));
+           static_cast<long long>(serving_ttft_max),
+           spec_accept_pct(spec_accepted_total, spec_proposed_total));
   b += line;
   snprintf(line, sizeof(line),
-           "%-21s %-8s %-11s %9s %9s %7s %5s %7s %5s %s\n",
+           "%-21s %-8s %-11s %9s %9s %7s %5s %7s %5s %6s %s\n",
            "shard", "tag", "health", "qps", "p99_us", "lag", "codec",
-           "tok/s", "sess", "rpcz");
+           "tok/s", "sess", "spec%", "rpcz");
   b += line;
   for (const auto& s : shards) {
     const double ratio =
@@ -764,12 +793,15 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
                                                             s.rpcz_sample_n)
                                                : "on");
     snprintf(line, sizeof(line),
-             "%-21s %-8s %-11s %9.0f %9lld %7lld %5.2f %7.0f %5lld %s\n",
+             "%-21s %-8s %-11s %9.0f %9lld %7lld %5.2f %7.0f %5lld "
+             "%6.1f %s\n",
              s.addr.c_str(), s.tag.c_str(), s.health.c_str(), s.qps,
              static_cast<long long>(s.p99_us),
              static_cast<long long>(s.version_lag_max), ratio,
              s.serving_tokens_s,
-             static_cast<long long>(s.serving_sessions), rpcz.c_str());
+             static_cast<long long>(s.serving_sessions),
+             spec_accept_pct(s.spec_accepted, s.spec_proposed),
+             rpcz.c_str());
     b += line;
     if (!s.reason.empty() && s.health != "ok") {
       b += "    reason: " + s.reason + "\n";

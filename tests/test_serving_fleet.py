@@ -397,19 +397,87 @@ def test_fleetz_serving_fold_and_rollup_pure():
     vars_text = ("serving_token_emit_qps : 1234\n"
                  "serving_sessions : 7\n"
                  "serving_ttft_latency_99 : 4500\n"
+                 "serving_spec_proposed : 200\n"
+                 "serving_spec_accepted : 150\n"
                  "rpc_server_echo_qps : 10\n")
     fold = fold_vars(vars_text)
     assert fold["serving_tokens_s"] == 1234.0
     assert fold["serving_sessions"] == 7
     assert fold["serving_ttft_p99_us"] == 4500
+    assert fold["serving_spec_accept_pct"] == 75.0
     rows = [dict(fold, addr="a:1", reachable=True, health="ok"),
             {"addr": "b:2", "reachable": True, "health": "ok",
              "serving_tokens_s": 766.0, "serving_sessions": 3,
-             "serving_ttft_p99_us": 9000}]
+             "serving_ttft_p99_us": 9000, "serving_spec_proposed": 100,
+             "serving_spec_accepted": 0}]
     roll = rollup(rows)
     assert roll["serving_tokens_s_total"] == 2000.0
     assert roll["serving_sessions_total"] == 10
     assert roll["serving_ttft_p99_max_us"] == 9000
+    # Fleet accept rate aggregates counters (150/300), never averages
+    # per-shard percentages (which would read 37.5).
+    assert roll["serving_spec_accept_pct"] == 50.0
+
+
+def test_router_load_bias_reorders_spill_only():
+    """The PR 14 leftover: cached member load (the /fleetz fold over
+    /vars) reorders the SPILL half of the walk lightest-first; the
+    sticky owner stays first, and the penalty box stays the override."""
+    members = ["a:1", "b:2", "c:3", "d:4"]
+    r = ServingRouter(members=members)
+    sid = "load-sess"
+    base = r.candidates(sid)
+    owner, spill = base[0], base[1:]
+    # Load in: make the FIRST spill candidate the busiest, the LAST the
+    # idlest — through the same /vars text the fleet plane folds.
+    def vars_text(sessions, tokens_s):
+        return (f"serving_sessions : {sessions}\n"
+                f"serving_token_emit_qps : {tokens_s}\n")
+    r.ingest_load(spill[0], vars_text(9, 900))
+    for addr in spill[1:]:
+        r.ingest_load(addr, vars_text(1, 10))
+    r.ingest_load(spill[-1], vars_text(0, 0))
+    walk = r.candidates(sid)
+    assert walk[0] == owner, "load bias must never move the sticky owner"
+    assert walk[-1] == spill[0], "the busiest member spills last"
+    assert walk[1] == spill[-1], "the idlest member spills first"
+    assert sorted(walk) == sorted(members)
+    # Equal load everywhere == the pure ring walk (deterministic across
+    # instances stays intact: no data, no reorder).
+    r2 = ServingRouter(members=list(members))
+    assert r2.candidates(sid) == base
+    # The penalty box overrides load: the idlest member, benched, drops
+    # to the back anyway.
+    r.penalize(spill[-1], for_s=30)
+    walk3 = r.candidates(sid)
+    assert walk3[-1] == spill[-1]
+    r.close()
+    r2.close()
+
+
+def test_router_load_scrape_pass_uses_fetch_seam():
+    """scrape_loads() fills the cache through _fetch_vars (the seam the
+    background thread rides) and expired data ages back to neutral."""
+    members = ["a:1", "b:2"]
+    r = ServingRouter(members=members, load_ttl_s=0.05)
+    fetched = []
+
+    def fake_fetch(addr):
+        fetched.append(addr)
+        return ("serving_sessions : 5\n" if addr == "a:1"
+                else "serving_sessions : 0\n")
+
+    r._fetch_vars = fake_fetch
+    r.scrape_loads()
+    assert sorted(fetched) == members
+    now = time.monotonic()
+    assert r._load_key("a:1", 0, now)[0] == 5
+    assert r._load_key("b:2", 0, now)[0] == 0
+    # Stale data reads as neutral (fresh joiners attract spill; dead
+    # members stop repelling it).
+    time.sleep(0.2)
+    assert r._load_key("a:1", 0, time.monotonic())[0] == 0
+    r.close()
 
 
 # ---------------------------------------------------------------------------

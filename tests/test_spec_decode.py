@@ -1,0 +1,614 @@
+"""Speculative decoding in the continuous batch (ISSUE 15 acceptance
+surface).
+
+Pure half (tier-1, no native lib):
+  * ``verify_step`` over a (B, W) window is BITWISE the sequential
+    ``decode_step`` path — same argmax tokens AND same KV rows;
+  * spec == plain token-for-token parity: single, batched (staggered
+    admission), n-gram and model drafts, every k, EOS mid-window;
+  * adversarial low-acceptance text: parity holds AND the per-session k
+    adapts down to the floor of 1 (the EMA clamp);
+  * acceptance-friendly (self-speculation) drives k to the max and
+    multi-token steps actually happen;
+  * the live kill switch: toggling ``engine.spec_k`` mid-generation
+    never perturbs the token sequence (spec_k=0 is the verbatim
+    single-token path);
+  * draft rows never reach committed state: session KV planes beyond
+    ``pos`` stay zero through rejections, and a spy oneside window sees
+    publishes ONLY at the accepted position;
+  * migration export/import mid-speculation: parity with spec on both
+    ends, spec state ephemeral (the importing engine rebuilds by
+    catch-up); prefill-handoff parity with spec on both ends, incl. the
+    EOS-on-first-token clamp;
+  * the shared ``emit_done`` clamp helper + ``ngram_propose`` units;
+  * /sessionz spec accounting (accept rate, per-session spec_k).
+
+Native half (skips cleanly without libbrpc_tpu.so), under an ARMED
+watchdog: streamed spec==serial parity over the wire + the Gen/Spec
+A/B toggle; a LIVE drain migration with speculation on both ends
+(token-for-token vs serial); a prefill->decode split with speculation
+on both ends; /fleetz accept-rate columns (native page + FleetObserver
+twin) fed by the serving_spec_* counters through the generic fold.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from brpc_tpu.models.decoder import (decode_serial, decode_step, emit_done,
+                                     init_decoder, ngram_propose,
+                                     verify_step)
+from brpc_tpu.runtime import native
+from brpc_tpu.serving import (DONE, FROZEN, SHED, CallableSink,
+                              DecodeEngine, SessionManager)
+
+import jax.numpy as jnp
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+MAX_LEN = 64
+
+# Prompts whose greedy continuations exercise both phases: short ones
+# (generation-dominated, low n-gram acceptance = adversarial) and a long
+# one (prefill-window-dominated).
+SHORT_PROMPTS = [[3, 7, 11], [5, 2], [9, 4, 1]]
+LONG_PROMPT = list(range(1, 41))
+
+
+def pure_manager(**kw):
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("kv_arena_bytes", 1 << 20)
+    return SessionManager(**kw)
+
+
+def collector():
+    toks = []
+    sink = CallableSink(lambda f: toks.append(int(f[1:]))
+                        if f.startswith(b"T") else None)
+    return toks, sink
+
+
+def run_engine(engine, sessions, steps=300):
+    for _ in range(steps):
+        progressed = engine.step()
+        if not progressed and all(s.state in (DONE, SHED)
+                                  for s in sessions):
+            return
+    raise AssertionError(
+        f"engine did not finish: {[s.state for s in sessions]}")
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 pure half: the verify math.
+# ---------------------------------------------------------------------------
+
+def test_verify_step_bitwise_matches_sequential_decode():
+    """The lossless core: every window position's argmax AND KV row is
+    bit-identical to what the sequential decode_step path produces."""
+    L, D = MAX_LEN, 32
+    # Sequential reference, recording consumed inputs and outputs.
+    kv_k = np.zeros((1, L, D), np.float32)
+    kv_v = np.zeros((1, L, D), np.float32)
+    prompt, pos, tok = [3, 7, 11], 0, None
+    inputs, outs = [], []
+    for _ in range(24):
+        inp = prompt[pos] if pos < len(prompt) else tok
+        nxt, kn, vn = decode_step(
+            PARAMS, jnp.asarray(kv_k), jnp.asarray(kv_v),
+            jnp.asarray([pos], jnp.int32), jnp.asarray([inp], jnp.int32))
+        kv_k[0, pos] = np.asarray(kn[0])
+        kv_v[0, pos] = np.asarray(vn[0])
+        inputs.append(inp)
+        outs.append(int(np.asarray(nxt)[0]))
+        tok = outs[-1]
+        pos += 1
+    # Same input sequence through verify_step windows of 4, lane 2 of 4.
+    B, W = 4, 4
+    wk = np.zeros((B, L, D), np.float32)
+    wv = np.zeros((B, L, D), np.float32)
+    wouts, p = [], 0
+    while p < len(inputs):
+        w = inputs[p:p + W]
+        win = np.zeros((B, W), np.int32)
+        win[2, :len(w)] = w
+        lengths = np.zeros((B,), np.int32)
+        lengths[2] = p
+        y, kr, vr = verify_step(PARAMS, jnp.asarray(wk), jnp.asarray(wv),
+                                jnp.asarray(lengths), jnp.asarray(win))
+        y, kr, vr = np.asarray(y), np.asarray(kr), np.asarray(vr)
+        for j in range(len(w)):
+            wouts.append(int(y[2, j]))
+            assert np.array_equal(kr[2, j], kv_k[0, p + j]), \
+                f"KV k-row {p + j} diverged from the sequential path"
+            assert np.array_equal(vr[2, j], kv_v[0, p + j])
+            wk[2, p + j] = kr[2, j]
+            wv[2, p + j] = vr[2, j]
+        p += len(w)
+    assert wouts == outs, "window argmax diverged from sequential argmax"
+
+
+def test_emit_done_clamp_semantics():
+    assert emit_done(0, 1, 8, eos_id=0), "EOS stops"
+    assert emit_done(5, 8, 8, eos_id=0), "budget stops"
+    assert not emit_done(5, 7, 8, eos_id=0)
+    assert not emit_done(0, 1, 8, eos_id=-1), "eos disabled"
+
+
+def test_ngram_propose_prompt_lookup():
+    # The trailing bigram (7, 11) occurred earlier: propose its sequel.
+    assert ngram_propose([3, 7, 11, 9, 7, 11], 3) == [9, 7, 11]
+    # Longest n wins; k truncates.
+    assert ngram_propose([1, 2, 3, 1, 2, 3], 2) == [1, 2]
+    # Nothing repeats: nothing proposed.
+    assert ngram_propose([1, 2, 3, 4], 2) == []
+    assert ngram_propose([5], 2) == []
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 pure half: engine parity.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("draft", ["ngram", "model"])
+@pytest.mark.parametrize("spec_k", [1, 3, 4])
+def test_spec_engine_parity_batched(draft, spec_k):
+    """spec == plain, token for token: staggered admissions, mixed short
+    (generation-heavy) + long (prefill-heavy) prompts, both drafts."""
+    n_tok = 14
+    prompts = SHORT_PROMPTS + [LONG_PROMPT]
+    refs = [decode_serial(PARAMS, p, n_tok, MAX_LEN) for p in prompts]
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4, spec_k=spec_k,
+                       draft=draft)
+    outs, sessions = [], []
+    for p in prompts:
+        toks, sink = collector()
+        outs.append(toks)
+        sessions.append(mgr.open(p, n_tok, sink))
+        eng.step()  # stagger: later sessions join a running batch
+    run_engine(eng, sessions)
+    assert outs == refs, (outs, refs)
+
+
+def test_spec_engine_parity_single_with_eos():
+    """EOS mid-window clamps exactly where serial does — whatever k."""
+    ref = decode_serial(PARAMS, [3, 7, 11], 16, MAX_LEN, eos_id=0)
+    eos_ref = decode_serial(PARAMS, [3, 7, 11], 16, MAX_LEN,
+                            eos_id=ref[2])  # force an early EOS
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2, eos_id=ref[2], spec_k=4,
+                       draft="model", draft_params=PARAMS)
+    toks, sink = collector()
+    sess = mgr.open([3, 7, 11], 16, sink)
+    run_engine(eng, [sess])
+    assert toks == eos_ref
+    assert len(toks) < len(ref), "the EOS clamp must have fired early"
+    assert sess.state == DONE
+
+
+def test_spec_adversarial_clamps_k_to_one_and_keeps_parity():
+    """A draft that is ~never right (random small model): output stays
+    bit-identical AND the per-session k adapts down to the floor of 1
+    under sustained mismatch."""
+    n_tok = 24
+    refs = [decode_serial(PARAMS, p, n_tok, MAX_LEN)
+            for p in SHORT_PROMPTS]
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4, spec_k=4, draft="model")
+    outs, sessions = [], []
+    for p in SHORT_PROMPTS:
+        toks, sink = collector()
+        outs.append(toks)
+        sessions.append(mgr.open(p, n_tok, sink))
+    run_engine(eng, sessions)
+    assert outs == refs
+    assert all(s.spec_k == 1 for s in sessions), \
+        [s.spec_k for s in sessions]
+    doc = mgr.sessionz_doc()
+    assert doc["spec_proposed"] > 0
+    assert doc["spec_accept_pct"] < 30.0, doc["spec_accept_pct"]
+
+
+def test_spec_acceptance_drives_k_up_and_multi_token_steps():
+    """Self-speculation (draft == target) is the acceptance-friendly
+    extreme: k rises to the max, and whole windows commit per step."""
+    n_tok = 20
+    refs = [decode_serial(PARAMS, p, n_tok, MAX_LEN, eos_id=-1)
+            for p in SHORT_PROMPTS[:2]]
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2, eos_id=-1, spec_k=4,
+                       draft="model", draft_params=PARAMS)
+    outs, sessions = [], []
+    for p in SHORT_PROMPTS[:2]:
+        toks, sink = collector()
+        outs.append(toks)
+        sessions.append(mgr.open(p, n_tok, sink))
+    run_engine(eng, sessions)
+    assert outs == refs
+    # 2 sessions x (prompt + 20 tokens) in far fewer steps than tokens.
+    assert eng.steps < n_tok, f"no multi-token steps happened: {eng.steps}"
+    doc = mgr.sessionz_doc()
+    assert doc["spec_accept_pct"] > 60.0, doc["spec_accept_pct"]
+    # End-of-budget partial windows nudge the EMA below 1.0; the k
+    # adaptation must still sit at/near the max, never the floor.
+    assert all(s.spec_k >= 3 for s in sessions), \
+        [s.spec_k for s in sessions]
+
+
+def test_spec_kill_switch_toggles_live_without_perturbing_output():
+    """spec_k is read at step boundaries: flipping it mid-generation
+    (the Gen/Spec admin path drives exactly this attribute) changes the
+    cost model, never the tokens."""
+    n_tok = 18
+    ref = decode_serial(PARAMS, [5, 2], n_tok, MAX_LEN)
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2, spec_k=3)
+    toks, sink = collector()
+    sess = mgr.open([5, 2], n_tok, sink)
+    for flip in range(40):
+        eng.step()
+        eng.spec_k = 0 if flip % 2 else 3  # toggle every boundary
+        if sess.state == DONE:
+            break
+    run_engine(eng, [sess])
+    assert toks == ref
+
+
+def test_spec_never_exposes_draft_rows():
+    """Only ACCEPTED rows reach the session's planes: rows >= pos stay
+    zero through rejections, and a spy oneside window observes publishes
+    at the accepted position only (paging captures [:pos] by the same
+    invariant)."""
+    published = []
+
+    class SpyWindow:
+        def publish(self, name, off, nbytes, version, own=True):
+            published.append((name, version))
+
+        def begin_rewrite(self, name):
+            pass
+
+        def unpublish(self, name):
+            pass
+
+    n_tok = 16
+    mgr = pure_manager()
+    mgr.oneside = SpyWindow()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2, spec_k=4, draft="model")
+    toks, sink = collector()
+    sess = mgr.open([3, 7, 11], n_tok, sink)
+    pos_log = []
+    for _ in range(200):
+        eng.step()
+        pos_log.append(sess.pos)
+        if sess.kv_k is not None:
+            tail_k = np.asarray(sess.kv_k[sess.pos:])
+            tail_v = np.asarray(sess.kv_v[sess.pos:])
+            assert not tail_k.any() and not tail_v.any(), \
+                f"draft rows leaked past pos={sess.pos}"
+        if sess.state in (DONE, SHED):
+            break
+    assert sess.state == DONE
+    assert toks == decode_serial(PARAMS, [3, 7, 11], n_tok, MAX_LEN)
+    # Every publish carried the committed row count of its moment —
+    # versions only ever (re)publish at accepted positions.
+    versions = [v for _name, v in published]
+    assert versions, "publish_kv never ran"
+    assert all(v in pos_log or v == 0 for v in versions), \
+        (versions, pos_log)
+
+
+def test_spec_migration_round_trip_parity_and_ephemeral_state():
+    """Freeze/export/import mid-speculation with spec ON BOTH ENDS:
+    the resumed trajectory is token-for-token the serial one, and spec
+    state is ephemeral — the importing engine starts from the optimistic
+    default and rebuilds its draft plane by catch-up."""
+    n_tok = 16
+    ref = decode_serial(PARAMS, [3, 7, 11], n_tok, MAX_LEN)
+    src = pure_manager()
+    esrc = DecodeEngine(src, PARAMS, max_batch=2, spec_k=3,
+                        draft="model", draft_params=PARAMS)
+    got = []
+    sink = CallableSink(lambda f: got.append(int(f[1:]))
+                        if f.startswith(b"T") else None)
+    sess = src.open([3, 7, 11], n_tok, sink, sid="smig-1")
+    for _ in range(3):
+        esrc.step()
+    assert 0 < len(got) < n_tok, "migrate MID-stream"
+    assert src.freeze(sess)
+    esrc.step()  # lane sweep frees the lane, keeps KV
+    manifest, kv = src.export_session(sess)
+    assert kv.shape == (2, sess.pos, 32), \
+        "export ships exactly the committed rows"
+    src.finish(sess, shed_reason="moved:dst",
+               shed_code=native.E_SESSION_MOVED)
+
+    dst = pure_manager()
+    edst = DecodeEngine(dst, PARAMS, max_batch=2, spec_k=3,
+                        draft="model", draft_params=PARAMS)
+    sess2 = dst.import_session(manifest, kv)
+    assert sess2.spec_k == 0 and sess2.spec_ema == 1.0, \
+        "spec state must arrive fresh (ephemeral)"
+    dst.attach_sink(sess2, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None),
+        have=len(got))
+    run_engine(edst, [sess2])
+    assert got == ref, (got, ref)
+
+
+def test_spec_prefill_handoff_parity_and_eos_clamp():
+    """Prefill role with speculation: the session still freezes at
+    first-token time (never streams, one recorded token, EOS clamped via
+    the shared helper), and a spec-on decode engine continues to the
+    exact colocated trajectory."""
+    n_tok = 10
+    for eos in (0, decode_serial(PARAMS, [9, 4, 1], n_tok, MAX_LEN)[0]):
+        ref = decode_serial(PARAMS, [9, 4, 1], n_tok, MAX_LEN, eos_id=eos)
+        pre = pure_manager()
+        epre = DecodeEngine(pre, PARAMS, max_batch=2, eos_id=eos,
+                            spec_k=3)
+        frozen = []
+        epre.on_session_frozen = frozen.append
+        toks, sink = collector()
+        sess = pre.open([9, 4, 1], n_tok, sink, prefill_handoff=True)
+        for _ in range(10):
+            epre.step()
+            if frozen:
+                break
+        assert frozen == [sess] and sess.state == FROZEN
+        assert toks == [], "prefill must not stream"
+        assert sess.emitted == 1 and sess.out_tokens == [ref[0]]
+        assert sess.pos == len(sess.prompt), \
+            "the handoff point is still first-token time under spec"
+        if ref[0] == eos:
+            assert sess.max_tokens == 1, "EOS clamps at the handoff"
+        manifest, kv = pre.export_session(sess)
+        dec = pure_manager()
+        edec = DecodeEngine(dec, PARAMS, max_batch=2, eos_id=eos,
+                            spec_k=3)
+        sess2 = dec.import_session(manifest, kv)
+        out = []
+        replayed = dec.attach_sink(sess2, CallableSink(
+            lambda f: out.append(int(f[1:]))
+            if f.startswith(b"T") else None), have=0)
+        assert replayed == 1
+        run_engine(edec, [sess2])
+        assert out == ref, (eos, out, ref)
+
+
+def test_sessionz_spec_columns_pure():
+    mgr = pure_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2, spec_k=2, draft="model")
+    toks, sink = collector()
+    sess = mgr.open([3, 7], 8, sink)
+    run_engine(eng, [sess])
+    doc = mgr.sessionz_doc()
+    assert doc["spec_proposed"] > 0
+    assert 0.0 <= doc["spec_accept_pct"] <= 100.0
+    assert all("spec_k" in row for row in doc["sessions"])
+
+
+def test_fused_opt_matches_momentum_formula():
+    """The satellite pin: the fused-momentum-update call the collective
+    step driver's opt:k now rides matches the explicit numpy momentum
+    formula (the previous inline math) on 1D and 2D buffers."""
+    from brpc_tpu.ops.fused_update import fused_momentum_update
+
+    rng = np.random.default_rng(7)
+    for shape in ((64,), (48, 96)):
+        p = rng.standard_normal(shape).astype(np.float32)
+        m = rng.standard_normal(shape).astype(np.float32)
+        g = rng.standard_normal(shape).astype(np.float32)
+        p2, m2 = fused_momentum_update(jnp.asarray(p), jnp.asarray(m),
+                                       jnp.asarray(g), lr=0.01, beta=0.9)
+        m_ref = np.float32(0.9) * m + g
+        p_ref = p - np.float32(0.01) * m_ref
+        np.testing.assert_allclose(np.asarray(m2), m_ref, rtol=1e-6,
+                                   atol=1e-7)
+        np.testing.assert_allclose(np.asarray(p2), p_ref, rtol=1e-6,
+                                   atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Native half: speculation over the wire, under an armed watchdog.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.observability import health
+    dump_dir = tmp_path_factory.mktemp("spec_dumps")
+    health.start_watchdog(str(dump_dir))
+    yield {"health": health}
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after spec tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def _hub():
+    from brpc_tpu.fleet import RegistryHub
+    hub = RegistryHub()
+    hub.start()
+    return hub
+
+
+def _member(hub, tag, role="both", **kw):
+    from brpc_tpu.serving import FleetServingServer
+    srv = FleetServingServer(hub.hostport, PARAMS, tag=tag, role=role,
+                             max_len=MAX_LEN, reg_ttl_s=3, **kw)
+    srv.start()
+    return srv
+
+
+def _cleanup(hub, *servers):
+    from brpc_tpu.fleet import clear_registry
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+    clear_registry()
+    hub.stop()
+
+
+def _keys_owned_by(client, addr, n, prefix):
+    client.router.refresh()
+    keys, i = [], 0
+    while len(keys) < n:
+        k = f"{prefix}-{i}"
+        if client.router.route(k) == addr:
+            keys.append(k)
+        i += 1
+        assert i < 10000
+    return keys
+
+
+def test_spec_streamed_parity_and_ab_toggle(spec_env):
+    """Streamed spec decoding over the wire == serial, and Gen/Spec is
+    the live A/B switch (answers the previous value)."""
+    from brpc_tpu.serving import ServingClient, ServingServer
+    srv = ServingServer(PARAMS, max_len=MAX_LEN, max_batch=4, spec_k=3)
+    port = srv.start()
+    try:
+        c = ServingClient(f"127.0.0.1:{port}", tenant="spec")
+        n_tok = 24
+        for prompt in ([3, 7, 11], LONG_PROMPT):
+            toks = c.generate(prompt, n_tok)
+            assert toks == decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+        assert srv.manager.sessionz_doc()["spec_proposed"] > 0
+        # The A/B toggle: off, verify the single-token path, back on.
+        resp, _ = c.channel.call("Gen/Spec", json.dumps(
+            {"spec_k": 0}).encode())
+        assert json.loads(resp.decode()) == {"spec_k": 0, "was": 3}
+        toks = c.generate([5, 2], 12)
+        assert toks == decode_serial(PARAMS, [5, 2], 12, MAX_LEN)
+        resp, _ = c.channel.call("Gen/Spec", json.dumps(
+            {"spec_k": 3}).encode())
+        assert json.loads(resp.decode())["was"] == 0
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_spec_live_drain_migration_parity(spec_env):
+    """The acceptance drive with speculation on BOTH ends: mid-stream
+    sessions on a draining spec-on member migrate and resume on a
+    spec-on survivor with token-for-token parity."""
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "sdr", max_batch=4, spec_k=3)
+    b = _member(hub, "sdr", max_batch=4, spec_k=3)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="sdr")
+        warm = c.generate([1], 2)
+        assert len(warm) == 2
+        n_tok = 30
+        keys = _keys_owned_by(c, a.addr, 2, "sdrain")
+        key_prompt = dict(zip(keys, ([3, 7, 11], [5, 2])))
+        refs = {k: decode_serial(PARAMS, p, n_tok, MAX_LEN)
+                for k, p in key_prompt.items()}
+        streams = {k: c.open(p, n_tok, session_key=k)
+                   for k, p in key_prompt.items()}
+        for ts in streams.values():
+            while len(ts.tokens) < 3:
+                ts.read_token(timeout_ms=5000)
+        results = {}
+
+        def reader(k, ts):
+            results[k] = list(ts)
+
+        threads = [threading.Thread(target=reader, args=(k, ts))
+                   for k, ts in streams.items()]
+        for t in threads:
+            t.start()
+        moved = a.drain()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "stream reader hung after drain"
+        assert moved == 2, f"expected both sessions to migrate, got {moved}"
+        for k, ts in streams.items():
+            assert ts.tokens == refs[k], (
+                f"stream {k} tore across the spec-on migration:\n "
+                f"got {ts.tokens}\n ref {refs[k]}")
+            assert ts.resumes >= 1
+            assert b.manager.get(k) is not None
+        for ts in streams.values():
+            ts.close()
+        c.close()
+    finally:
+        _cleanup(hub, a, b)
+
+
+def test_spec_prefill_decode_split_parity(spec_env):
+    """Disaggregation with speculation on both ends: the prompt runs on
+    the spec-on prefill member (multi-row windows), the handoff rides
+    the usual path, the spec-on decode member streams the colocated
+    trajectory."""
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    pre = _member(hub, "spd", role="prefill", max_batch=4, spec_k=3)
+    dec = _member(hub, "spd", role="decode", max_batch=4, spec_k=3)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="spd")
+        n_tok = 12
+        ref = decode_serial(PARAMS, LONG_PROMPT, n_tok, MAX_LEN)
+        ts = c.open(LONG_PROMPT, n_tok, session_key="sp-split-1")
+        toks = list(ts)
+        assert toks == ref, (toks, ref)
+        assert ts.resumes == 1 and ts.addr == dec.addr
+        sd = dec.manager.get("sp-split-1")
+        assert sd is not None and sd.state == DONE
+        ts.close()
+        c.close()
+    finally:
+        _cleanup(hub, pre, dec)
+
+
+def test_fleetz_spec_accept_columns_native_and_twin(spec_env):
+    """/fleetz (native page) and the FleetObserver twin both carry the
+    accept-rate column, folded from the serving_spec_* counters through
+    the generic fold; /sessionz renders the accept line."""
+    from brpc_tpu.observability.fleet_view import FleetObserver
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "sfz", max_batch=2, spec_k=3)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="sfz")
+        toks = c.generate([3, 7, 11], 12)
+        assert len(toks) == 12
+        # Counters are cumulative (no per-second window): one scrape.
+        doc = json.loads(urllib.request.urlopen(
+            f"http://{a.addr}/fleetz?format=json&tag=sfz",
+            timeout=5).read().decode())
+        row = next(r for r in doc["shards"] if r["addr"] == a.addr)
+        assert row["serving_spec_proposed"] > 0
+        assert 0.0 <= row["serving_spec_accept_pct"] <= 100.0
+        roll = doc["rollup"]
+        assert roll["serving_spec_accept_pct"] == \
+            row["serving_spec_accept_pct"]
+        text = urllib.request.urlopen(
+            f"http://{a.addr}/fleetz?tag=sfz", timeout=5).read().decode()
+        assert "spec_accept=" in text and "spec%" in text
+        # The twin folds the same columns from the same vars.
+        obs_view = FleetObserver(hub.hostport, tag="sfz")
+        fz = obs_view.fleetz()
+        trow = next(r for r in fz["shards"] if r["addr"] == a.addr)
+        assert trow["serving_spec_proposed"] > 0
+        assert fz["rollup"]["serving_spec_accept_pct"] == \
+            trow["serving_spec_accept_pct"]
+        prom = obs_view.fleet_prometheus()
+        assert "fleet_serving_spec_accept_pct" in prom
+        # /sessionz text renders the accept line.
+        sz = urllib.request.urlopen(
+            f"http://{a.addr}/sessionz", timeout=5).read().decode()
+        assert "spec accept:" in sz
+        c.close()
+    finally:
+        _cleanup(hub, a)
