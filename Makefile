@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke spec-smoke train-smoke collectives-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan tsan-test asan-test contract-check lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke spec-smoke train-smoke collectives-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -18,6 +18,16 @@ lint:
 lint-sarif:
 	python -m tools.tpulint --format sarif > tpulint.sarif
 
+# The contract half of lint on its own: the cross-language locks
+# (wire_contract.lock incl. __capi__/__meta_keys__/__codes__,
+# error_codes.lock, sanitizer_suppressions.lock) plus the negotiation /
+# state-machine / arena-alias dataflow rules. `make lint` already runs
+# all of it; this target exists so the smoke gates can name the contract
+# guarantee explicitly and so CI logs show WHICH half failed.
+contract-check:
+	python -m tools.tpulint --no-baseline brpc_tpu examples
+	python -m tools.tpulint
+
 # ~10s perf sanity sweep: one subprocess-guarded 64B echo sample + a
 # 4x1MB pipelined pull point. Every sample runs under a hard timeout, so
 # a transport wedge records {"wedged": true} instead of hanging the
@@ -31,7 +41,7 @@ bench-smoke:
 # native library; the live-fleet halves skip cleanly there.
 obs-smoke:
 	python -m pytest tests/test_fleet_view.py -q
-	python -m tools.tpulint
+	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for the serving plane (the obs-smoke analog): the
 # session/scheduler units + the live streamed-decode tests, then lint.
@@ -39,7 +49,7 @@ obs-smoke:
 # skip cleanly there.
 serve-smoke:
 	python -m pytest tests/test_serving.py -q
-	python -m tools.tpulint
+	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for the serving FLEET plane (the serve-smoke analog
 # one level up): routing determinism, migration/paging round trips, and
@@ -48,7 +58,7 @@ serve-smoke:
 # The pure halves run even without the native library.
 serving-fleet-smoke:
 	python -m pytest tests/test_serving_fleet.py -q
-	python -m tools.tpulint
+	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for speculative decoding (the serve-smoke analog):
 # the verify-window bitwise-parity pin, spec==plain engine parity
@@ -58,7 +68,7 @@ serving-fleet-smoke:
 # without the lib.
 spec-smoke:
 	python -m pytest tests/test_spec_decode.py -q
-	python -m tools.tpulint
+	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for the overlapped training step (the obs-smoke
 # analog): the pure scheduler units (topology, failure propagation,
@@ -67,7 +77,7 @@ spec-smoke:
 # then lint. The native halves skip cleanly without the lib.
 train-smoke:
 	python -m pytest tests/test_step_overlap.py -q
-	python -m tools.tpulint
+	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for the fleet-collectives plane (the obs-smoke
 # analog): the pure schedule/codec/EF/salvage units plus — with the
@@ -76,7 +86,7 @@ train-smoke:
 # without the lib.
 collectives-smoke:
 	python -m pytest tests/test_collectives.py -q
-	python -m tools.tpulint
+	$(MAKE) --no-print-directory contract-check
 
 # Slow-marked tests (the watchdog soak) are excluded here, same as
 # tier-1; run them explicitly with `make soak`.
@@ -114,6 +124,34 @@ test-asan: asan
 
 test-tsan: tsan
 	cd native/build-tsan && ctest -j1 --output-on-failure
+
+# Preset-driven sanitizer gates (the -DTPU_SANITIZE=thread|address path
+# through native/CMakeLists.txt) with the pinned suppression files
+# applied. Skips cleanly — exit 0 with a SKIPPED line — where the native
+# toolchain is absent (tier-1 CI guarantees CPython only), same contract
+# as the smoke targets' native halves.
+tsan-test:
+	@if ! command -v cmake >/dev/null 2>&1; then \
+	  echo "tsan-test: SKIPPED (cmake not found; tier-1 is CPython-only)"; \
+	else \
+	  cmake -S native -B native/build-tsan -DTPU_SANITIZE=thread >/dev/null && \
+	  cmake --build native/build-tsan -j && \
+	  cd native/build-tsan && \
+	  TSAN_OPTIONS="suppressions=$(CURDIR)/native/sanitizers/tsan.supp" \
+	    ctest -j1 --output-on-failure; \
+	fi
+
+asan-test:
+	@if ! command -v cmake >/dev/null 2>&1; then \
+	  echo "asan-test: SKIPPED (cmake not found; tier-1 is CPython-only)"; \
+	else \
+	  cmake -S native -B native/build-asan -DTPU_SANITIZE=address >/dev/null && \
+	  cmake --build native/build-asan -j && \
+	  cd native/build-asan && \
+	  ASAN_OPTIONS="suppressions=$(CURDIR)/native/sanitizers/asan.supp" \
+	  LSAN_OPTIONS="suppressions=$(CURDIR)/native/sanitizers/lsan.supp" \
+	    ctest -j1 --output-on-failure; \
+	fi
 
 clean:
 	rm -rf $(BUILD_DIR) native/build-asan native/build-tsan

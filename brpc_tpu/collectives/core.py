@@ -265,6 +265,9 @@ def tree_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
         return flat.copy().reshape(np.shape(x))
     root = ring_mod.tree_root(n)
     if rank != root:
+        # codec_name is the caller's per-peer negotiated pick
+        # (group._codec_for); this free function never chooses a
+        # codec itself.  tpulint: allow(negotiation)
         meta, blob = codec.encode(f"{name}#leaf", flat, codec_name)
         link.send(root, "tr", rank, 0, meta, blob)
         _idx, rmeta, rblob = link.recv("trb", 0)
@@ -273,6 +276,8 @@ def tree_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
     for src in ring_mod.tree_gather_srcs(n):
         _idx, rmeta, rblob = link.recv("tr", src)
         acc += codec.decode(rmeta, rblob)
+    # Same as the leaf leg: the root echoes the caller-negotiated
+    # codec_name.  tpulint: allow(negotiation)
     meta, blob = codec.encode(f"{name}#root", acc, codec_name)
     for dst in ring_mod.tree_gather_srcs(n):
         link.send(dst, "trb", 0, 0, meta, blob)

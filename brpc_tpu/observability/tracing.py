@@ -159,7 +159,7 @@ def trace_span(name: str, *, server_side: bool = False
     try:
         yield handle
     except BaseException:
-        handle.error_code = handle.error_code or 2004
+        handle.error_code = handle.error_code or native.TRPC_EINTERNAL
         raise
     finally:
         end_us = L.tbrpc_now_us()

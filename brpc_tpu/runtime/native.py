@@ -36,11 +36,32 @@ PRIORITY_HIGH = 0
 PRIORITY_NORMAL = 1
 PRIORITY_BULK = 2
 
-# Overload answers (trpc/errno.h): the server's admission shed and the
-# client-side write-queue backpressure — retriable WITH BACKOFF, never
-# hot-retried (see RpcError.retry_after_ms and the fleet retry layer).
-TRPC_ELIMIT = 1011
-TRPC_EOVERCROWDED = 2006
+# Transport/framework error codes — the Python mirror of native/trpc/
+# errno.h, name-for-name and value-for-value (tpulint's error-code rule
+# pins the parity against tools/tpulint/error_codes.lock, so the two
+# registries cannot drift apart silently). Clients and handlers key on
+# THESE names; a raw integer comparison where one of these exists is a
+# lint finding — the bare-literal collision class that once let a
+# structural code land on top of TRPC_ECONNECT.
+TRPC_ENOSERVICE = 1001      # no such service
+TRPC_ENOMETHOD = 1002       # no such method
+TRPC_EREQUEST = 1003        # malformed request
+TRPC_ERESPONSE = 1005       # malformed response
+TRPC_ERPCTIMEDOUT = 1008    # RPC deadline exceeded
+TRPC_EBACKUPREQUEST = 1009  # internal: backup-request timer fired
+TRPC_ELIMIT = 1011          # concurrency limit rejected the request
+TRPC_ECANCELED = 1012       # RPC canceled by caller
+TRPC_ENODATA = 1013         # no server available from LB/naming
+TRPC_EEOF = 2001            # peer closed the connection
+TRPC_EFAILEDSOCKET = 2002   # the socket was SetFailed while in use
+TRPC_EINTERNAL = 2004       # server internal error
+TRPC_EOVERCROWDED = 2006    # write queue over the in-flight cap
+TRPC_ECONNECT = 2007        # connect failed
+
+# The connection-killed subset: a stamped frame a pre-negotiation parser
+# rejects surfaces client-side as one of these (the QoS self-heal keys
+# on this tuple — see ParameterClient._qos_failed).
+TRANSPORT_DEAD = (TRPC_EEOF, TRPC_EFAILEDSOCKET, TRPC_ECONNECT)
 
 # Structural app-error codes, continuing the 2040+ range (param_server.py
 # holds E_NO_SUCH 2040..E_EXISTS 2043, tensor.py E_UNDECODABLE 2044,
@@ -520,10 +541,10 @@ class Server:
                         pp[0] = buf
                         pl[0] = len(data)
             except RpcError as e:
-                error_code[0] = e.code if e.code != 0 else 2004
+                error_code[0] = e.code if e.code != 0 else TRPC_EINTERNAL
                 fill_err_text(err_text, err_text_cap, e.text)
             except Exception as e:  # noqa: BLE001 — handler bug => EINTERNAL
-                error_code[0] = 2004
+                error_code[0] = TRPC_EINTERNAL
                 fill_err_text(err_text, err_text_cap,
                               f"{type(e).__name__}: {e}")
 
@@ -735,7 +756,7 @@ def open_stream(channel: Channel, service_method: str,
         max_buf_size, ctypes.byref(resp), ctypes.byref(resp_len),
         errbuf, len(errbuf))
     if sid <= 0:
-        raise RpcError(int(-sid) if sid < 0 else 2004,
+        raise RpcError(int(-sid) if sid < 0 else TRPC_EINTERNAL,
                        errbuf.value.decode(errors="replace"))
     try:
         body = (ctypes.string_at(resp, resp_len.value)

@@ -449,7 +449,8 @@ class ParameterServer:
             return out
         if method == "Push":
             if att is None:
-                raise native.RpcError(2002, "push without gradient")
+                raise native.RpcError(native.TRPC_EREQUEST,
+                                      "push without gradient")
             t0 = time.monotonic()
             self._update_sem.acquire()
             try:
@@ -742,11 +743,13 @@ class ParameterServer:
         name = req["name"]
         version = int(req.get("version", 0))
         if att is None:
-            raise native.RpcError(1003, "install without tensor payload")
+            raise native.RpcError(native.TRPC_EREQUEST,
+                                  "install without tensor payload")
         if att.ndim < 1 or att.shape[0] != 2:
             raise native.RpcError(
-                1003, f"install expects stacked [param, momentum], "
-                      f"got shape {tuple(att.shape)}")
+                native.TRPC_EREQUEST,
+                f"install expects stacked [param, momentum], "
+                f"got shape {tuple(att.shape)}")
         # Detach from the sender's arena pages BEFORE the handler returns.
         param = np.array(att[0])
         mom = np.array(att[1])
@@ -1003,7 +1006,7 @@ class ParameterClient:
         its now-unstamped call. Genuine transport failures re-advertise
         and keep their error, costing one Meta RPC on an already-failing
         path — the _codec_pull_failed discipline."""
-        if not self._srv_qos or e.code not in (2001, 2002, 2007):
+        if not self._srv_qos or e.code not in native.TRANSPORT_DEAD:
             return False
         self._srv_qos = None
         try:

@@ -281,7 +281,8 @@ class OverlappedStepDriver:
                 err = push_failed.get(name)
                 if err is None:
                     err = native.RpcError(
-                        2001, f"push reply for {name} never arrived")
+                        native.TRPC_EEOF,
+                        f"push reply for {name} never arrived")
                 raise err
             return fn
 
@@ -407,7 +408,7 @@ class OverlappedStepDriver:
                 cause = e
                 break
         if cause is None:
-            cause = native.RpcError(2001, str(next(iter(
+            cause = native.RpcError(native.TRPC_EEOF, str(next(iter(
                 wire_fail.values()))))
         unpushed = [n for n in names if n not in step_versions]
         err = PartialPushError(cause, dict(step_versions), unpushed)

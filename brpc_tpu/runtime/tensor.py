@@ -1140,7 +1140,8 @@ class TensorChannel:
             self.arena.handle if att_len else None, att_off, att_len,
             cb, None)
         if not h:
-            raise RpcError(2004, f"async submit of {service_method} failed")
+            raise RpcError(native.TRPC_EINTERNAL,
+                           f"async submit of {service_method} failed")
         return TensorFuture(L, h, service_method, done_cb=cb)
 
     def call(self, service_method: str, array=None, request: bytes = b""
@@ -1339,10 +1340,11 @@ def add_tensor_service(server: native.Server, name: str,
                 resp[0] = buf
                 resp_len[0] = len(r)
         except RpcError as e:
-            error_code[0] = e.code if e.code != 0 else 2004
+            error_code[0] = e.code if e.code != 0 \
+                else native.TRPC_EINTERNAL
             fill_err_text(err_text, err_text_cap, e.text)
         except Exception as e:  # noqa: BLE001 — handler bug => EINTERNAL
-            error_code[0] = 2004
+            error_code[0] = native.TRPC_EINTERNAL
             fill_err_text(err_text, err_text_cap, f"{type(e).__name__}: {e}")
         finally:
             # Handler + response staging: what the client's tensor_pull

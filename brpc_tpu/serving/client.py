@@ -138,6 +138,9 @@ class ServingClient:
             req["priority"] = priority
         if session is not None:
             req["session"] = session
+        # Gen/* is QoS-native by construction (the protocol postdates
+        # QoS); there is no pre-QoS Gen server to negotiate with.
+        # tpulint: allow(negotiation)
         with native.qos(native.PRIORITY_HIGH, self.tenant):
             stream, body = native.open_stream(
                 self.channel, "Gen/Open", json.dumps(req).encode(),
@@ -153,6 +156,8 @@ class ServingClient:
         E_SESSION_MOVED with ``.moved_to`` when it moved again (follow
         it), E_NO_SUCH when this server never had it."""
         req = {"session": session_id, "have": int(have)}
+        # Gen/* is QoS-native by construction (see open()).
+        # tpulint: allow(negotiation)
         with native.qos(native.PRIORITY_HIGH, self.tenant):
             stream, _body = native.open_stream(
                 self.channel, "Gen/Resume", json.dumps(req).encode(),
@@ -176,6 +181,8 @@ class ServingClient:
 
     def _close_session(self, session_id: str) -> None:
         try:
+            # Gen/* is QoS-native by construction (see open()).
+            # tpulint: allow(negotiation)
             with native.qos(native.PRIORITY_HIGH, self.tenant):
                 self.channel.call("Gen/Close", json.dumps(
                     {"session": session_id}).encode())

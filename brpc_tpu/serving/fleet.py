@@ -202,7 +202,8 @@ class FleetServingServer(ServingServer):
             sid = str(doc.get("session", ""))
             have = int(doc.get("have", 0))
         except (ValueError, TypeError, json.JSONDecodeError) as e:
-            raise native.RpcError(2004, f"bad Gen/Resume request: {e}")
+            raise native.RpcError(native.TRPC_EREQUEST,
+                                  f"bad Gen/Resume request: {e}")
         sess = self.manager.get(sid)
         if sess is None or sess.state not in (QUEUED, FROZEN):
             dest = self.forwarded_to(sid)
@@ -224,7 +225,8 @@ class FleetServingServer(ServingServer):
         stream = native.accept_stream(self.stream_window)
         if stream is None:
             raise native.RpcError(
-                2004, "Gen/Resume requires a stream (use open_stream)")
+                native.TRPC_EREQUEST,
+                "Gen/Resume requires a stream (use open_stream)")
         from brpc_tpu.serving.session import StreamSink
 
         try:
@@ -311,6 +313,9 @@ class FleetServingServer(ServingServer):
         # is the latency path — the client is waiting out the gap (HIGH).
         prio = native.PRIORITY_BULK if self.role == "prefill" \
             else native.PRIORITY_HIGH
+        # Migration peers are serving fleet members (same build,
+        # Gen-era): QoS-native by construction, nothing to
+        # advertise.  tpulint: allow(negotiation)
         return native.qos(prio, sess.tenant)
 
     def _wait_exportable(self, sess, timeout_s: float = 5.0) -> bool:

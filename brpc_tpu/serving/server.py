@@ -94,11 +94,13 @@ class ServingServer:
             if "spec_k" in doc:
                 k = int(doc["spec_k"])
                 if k < 0 or k > 16:
-                    raise native.RpcError(2004, f"spec_k {k} out of range")
+                    raise native.RpcError(native.TRPC_EINTERNAL,
+                                          f"spec_k {k} out of range")
                 self.engine.spec_k = k
             return json.dumps({"spec_k": self.engine.spec_k,
                                "was": old}).encode(), b""
-        raise native.RpcError(1004, f"no such method: Gen/{method}")
+        raise native.RpcError(native.TRPC_ENOMETHOD,
+                              f"no such method: Gen/{method}")
 
     def _open(self, request: bytes):
         # Parse and validate EVERYTHING before accepting the stream: an
@@ -121,11 +123,13 @@ class ServingServer:
             if sid is not None:
                 sid = str(sid)[:128] or None
         except (ValueError, TypeError, json.JSONDecodeError) as e:
-            raise native.RpcError(2004, f"bad Gen/Open request: {e}")
+            raise native.RpcError(native.TRPC_EREQUEST,
+                                  f"bad Gen/Open request: {e}")
         stream = native.accept_stream(self.stream_window)
         if stream is None:
             raise native.RpcError(
-                2004, "Gen/Open requires a stream (use open_stream; "
+                native.TRPC_EREQUEST,
+                "Gen/Open requires a stream (use open_stream; "
                       "plain-HTTP clients use /gen)")
         # Tenant from the QoS meta the control RPC carried (it is stamped
         # HIGH — control stays admittable under bulk load); the SESSION's

@@ -376,16 +376,18 @@ class SessionManager:
         Under arena pressure, cold sessions page out to the host spill
         store before the open is shed."""
         if not prompt:
-            raise native.RpcError(2004, "empty prompt")
+            raise native.RpcError(native.TRPC_EREQUEST, "empty prompt")
         if max_tokens < 1:
             # A zero-budget session would be admitted to a lane but never
             # decode and never satisfy the retire condition — pinned until
             # the TTL sweep, a client-triggerable lane exhaustion.
-            raise native.RpcError(2004, "max_tokens must be >= 1")
+            raise native.RpcError(native.TRPC_EREQUEST,
+                                  "max_tokens must be >= 1")
         if len(prompt) + max_tokens > self.max_len:
             raise native.RpcError(
-                2004, f"prompt+max_tokens {len(prompt)}+{max_tokens} "
-                      f"exceeds the KV window {self.max_len}")
+                native.TRPC_EREQUEST,
+                f"prompt+max_tokens {len(prompt)}+{max_tokens} "
+                f"exceeds the KV window {self.max_len}")
         per_plane = self.max_len * self.dim * 4
         with self._mu:
             if sid is not None:
@@ -660,8 +662,9 @@ class SessionManager:
         the filled KV rows (version == pos, the published-KV contract)."""
         if not self.exportable(sess):
             raise native.RpcError(
-                2004, f"session {sess.id} not exportable "
-                      f"(state={sess.state}, lane={sess.lane})")
+                native.TRPC_EINTERNAL,
+                f"session {sess.id} not exportable "
+                f"(state={sess.state}, lane={sess.lane})")
         with self._mu:
             if sess.paged:
                 k_rows, v_rows = self._spill[sess.id]
@@ -700,12 +703,14 @@ class SessionManager:
         dim = int(manifest["dim"])
         if dim != self.dim:
             raise native.RpcError(
-                2004, f"KV dim mismatch: session {sid} has {dim}, "
-                      f"this server runs {self.dim}")
+                native.TRPC_EINTERNAL,
+                f"KV dim mismatch: session {sid} has {dim}, "
+                f"this server runs {self.dim}")
         if len(prompt) + int(manifest["max_tokens"]) > self.max_len:
             raise native.RpcError(
-                2004, f"session {sid} exceeds this server's KV window "
-                      f"{self.max_len}")
+                native.TRPC_EINTERNAL,
+                f"session {sid} exceeds this server's KV window "
+                f"{self.max_len}")
         kv = np.asarray(kv, dtype=np.float32).reshape(2, pos, dim)
         per_plane = self.max_len * self.dim * 4
         with self._mu:
@@ -755,8 +760,9 @@ class SessionManager:
         with self._mu:
             if sess.state != QUEUED or sess.sink is not None:
                 raise native.RpcError(
-                    2004, f"session {sess.id} not awaiting resume "
-                          f"(state={sess.state})")
+                    native.TRPC_EINTERNAL,
+                    f"session {sess.id} not awaiting resume "
+                    f"(state={sess.state})")
             replay = sess.out_tokens[have:]
             for tok in replay:
                 frame = FRAME_TOKEN + str(tok).encode()

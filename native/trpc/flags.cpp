@@ -37,6 +37,7 @@ std::atomic<int64_t>* FlagRegistry::DefineInt(const std::string& name,
                                               int64_t default_value,
                                               const std::string& help,
                                               Validator validator) {
+  // Bounded map insert; Define* runs at static init, before any fiber exists.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(_mu);
   auto it = _flags.find(name);
   if (it != _flags.end()) return it->second.value;
@@ -52,6 +53,7 @@ std::atomic<int64_t>* FlagRegistry::DefineInt(const std::string& name,
 void FlagRegistry::DefineLinked(const std::string& name, int64_t default_value,
                                 const std::string& help, Getter getter,
                                 Validator set_and_validate) {
+  // Same static-init discipline as DefineInt.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(_mu);
   if (_flags.count(name) != 0) return;
   Entry e;
@@ -64,6 +66,7 @@ void FlagRegistry::DefineLinked(const std::string& name, int64_t default_value,
 }
 
 bool FlagRegistry::Get(const std::string& name, std::string* value) const {
+  // Bounded map lookup serving the /flagz scrape; never parks under the lock.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(_mu);
   auto it = _flags.find(name);
   if (it == _flags.end()) return false;
@@ -74,6 +77,7 @@ bool FlagRegistry::Get(const std::string& name, std::string* value) const {
 }
 
 bool FlagRegistry::Set(const std::string& name, const std::string& value) {
+  // Bounded lookup + atomic store; the validator is a plain predicate (no RPC/IO).  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(_mu);
   auto it = _flags.find(name);
   if (it == _flags.end()) return false;
@@ -90,6 +94,7 @@ bool FlagRegistry::Set(const std::string& name, const std::string& value) {
 }
 
 void FlagRegistry::List(std::map<std::string, Info>* out) const {
+  // Bounded map walk into a caller-owned map; never parks.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(_mu);
   for (const auto& [name, e] : _flags) {
     (*out)[name] =

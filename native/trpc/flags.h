@@ -56,6 +56,7 @@ class FlagRegistry {
     Validator validator;
     Getter getter;  // non-null: external storage is the source of truth
   };
+  // Guards bounded map ops only — every critical section in flags.cpp is a lookup/insert, no park.  tpulint: allow(fiber-blocking)
   mutable std::mutex _mu;
   std::map<std::string, Entry> _flags;
 };

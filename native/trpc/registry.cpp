@@ -19,6 +19,7 @@ struct Entry {
   int64_t expire_us = 0;
 };
 
+// Guards bounded map ops only; the fiber-parking watch wait (butex_wait below) runs OUTSIDE this lock.  tpulint: allow(fiber-blocking)
 std::mutex g_mu;
 std::map<std::string, Entry> g_table;  // addr -> entry
 
@@ -104,6 +105,7 @@ void register_handler(const HttpRequest& req, HttpResponse* resp) {
   }
   e.expire_us = tbutil::gettimeofday_us() + ttl_s * 1000000;
   {
+    // Bounded insert + lazy prune (map walk); no park under the lock.  tpulint: allow(fiber-blocking)
     std::lock_guard<std::mutex> lk(g_mu);
     // Renewals always land; new entries respect the cap (prune first so a
     // full table of stale entries doesn't lock out live servers).
@@ -137,6 +139,7 @@ void deregister_handler(const HttpRequest& req, HttpResponse* resp) {
   const std::string addr = addr_v != nullptr ? addr_v->as_string() : "";
   size_t erased = 0;
   {
+    // Bounded erase + butex wake (wake never parks).  tpulint: allow(fiber-blocking)
     std::lock_guard<std::mutex> lk(g_mu);
     erased = g_table.erase(addr);
     if (erased != 0) bump_version();
@@ -165,6 +168,7 @@ void list_handler(const HttpRequest& req, HttpResponse* resp) {
     // the earliest TTL so a crashed backend's disappearance is DELIVERED
     // at expiry, not at the watch timeout.
     {
+      // Bounded TTL scan; the butex_wait it feeds happens after release.  tpulint: allow(fiber-blocking)
       std::lock_guard<std::mutex> lk(g_mu);
       for (const auto& [addr, e] : g_table) {
         deadline_us = std::min(deadline_us, e.expire_us);
@@ -184,6 +188,7 @@ void list_handler(const HttpRequest& req, HttpResponse* resp) {
   tbutil::JsonValue servers = tbutil::JsonValue::Array();
   int version = 0;
   {
+    // Bounded snapshot walk; JSON rendering happens after release.  tpulint: allow(fiber-blocking)
     std::lock_guard<std::mutex> lk(g_mu);
     prune_locked(tbutil::gettimeofday_us());
     version = current_version();
@@ -214,12 +219,14 @@ void RegistryService::Install() {
 }
 
 size_t RegistryService::live_count() {
+  // Bounded prune + size read.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(g_mu);
   prune_locked(tbutil::gettimeofday_us());
   return g_table.size();
 }
 
 void RegistryService::clear() {
+  // Bounded clear (tests only).  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(g_mu);
   g_table.clear();
 }

@@ -13,6 +13,7 @@ namespace trpc {
 
 namespace {
 
+// Guards a small rule vector + one int; every critical section below is a bounded scan, no park.  tpulint: allow(fiber-blocking)
 std::mutex g_mu;
 std::vector<TrackMeServer::BugRule> g_bugs;
 int g_reporting_interval = 0;
@@ -37,6 +38,7 @@ void trackme_handler(const HttpRequest& req, HttpResponse* resp) {
   std::string text;
   int interval = 0;
   {
+    // Bounded rule scan; the JSON response renders after release.  tpulint: allow(fiber-blocking)
     std::lock_guard<std::mutex> lk(g_mu);
     for (const TrackMeServer::BugRule& b : g_bugs) {
       if (version >= b.min_version && version <= b.max_version &&
@@ -64,21 +66,25 @@ void TrackMeServer::Install() {
 
 void TrackMeServer::AddBugRange(int64_t min_version, int64_t max_version,
                                 int severity, const std::string& error_text) {
+  // Bounded push_back.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(g_mu);
   g_bugs.push_back({min_version, max_version, severity, error_text});
 }
 
 void TrackMeServer::ReplaceBugs(std::vector<BugRule> rules) {
+  // Bounded swap.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(g_mu);
   g_bugs.swap(rules);
 }
 
 void TrackMeServer::SetReportingInterval(int seconds) {
+  // Scalar store.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(g_mu);
   g_reporting_interval = seconds;
 }
 
 void TrackMeServer::ClearBugs() {
+  // Bounded clear.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(g_mu);
   g_bugs.clear();
   g_reporting_interval = 0;
@@ -148,7 +154,9 @@ int TrackMePinger::Start(const std::string& trackme_hostport,
 
 void SetTrackMeAddress(const std::string& hostport,
                        const std::string& self_addr) {
+  // Serializes rare operator retargets; Stop() joins a pthread timer (this is an operator/control call, not a fiber handler).  tpulint: allow(fiber-blocking)
   static std::mutex mu;  // serialize concurrent retargets
+  // See the mutex declaration above.  tpulint: allow(fiber-blocking)
   std::lock_guard<std::mutex> lk(mu);
   static TrackMePinger* pinger = new TrackMePinger;  // immortal
   pinger->Stop();

@@ -1,0 +1,14 @@
+"""arena-alias positives: device_put over arrays that still view the RX
+arena (via a tainted name, and inline through a reshape)."""
+
+import jax
+import numpy as np
+
+
+def ingest(buf):
+    arr = np.frombuffer(buf, dtype=np.float32)
+    return jax.device_put(arr)
+
+
+def ingest_inline(buf):
+    return jax.device_put(np.frombuffer(buf, dtype=np.uint8).reshape(4, 4))

@@ -1,0 +1,22 @@
+"""arena-alias negatives: detach before device_put (np.array / .copy()),
+and device_put over an array that never viewed the wire."""
+
+import jax
+import numpy as np
+
+
+def ingest(buf):
+    arr = np.frombuffer(buf, dtype=np.float32)
+    detached = np.array(arr)
+    return jax.device_put(detached)
+
+
+def ingest_copy(buf):
+    view = np.frombuffer(buf, dtype=np.float32)
+    view = view.copy()
+    return jax.device_put(view)
+
+
+def ingest_fresh(shape):
+    host = np.zeros(shape, dtype=np.float32)
+    return jax.device_put(host)

@@ -1,0 +1,25 @@
+"""error-code positives: a value collision inside the structural band, a
+transport code squatting the band, a structural code outside it, and raw
+integer literals where named constants exist (the PR 6 shapes)."""
+
+
+class RpcError(Exception):
+    pass
+
+
+TRPC_FIXTURE_EBAND = 2044
+E_FIXTURE_ONE = 2050
+E_FIXTURE_CLASH = 2050
+E_FIXTURE_STRAY = 1008
+
+
+def route(reply):
+    if reply.code == 2050:
+        return "one"
+    if reply.error_code in (1008, 2050):
+        return "retry"
+    return "other"
+
+
+def fail():
+    raise RpcError(2044, "fixture failure")

@@ -1,0 +1,14 @@
+"""error-code negatives: named constants compared by name, and integers
+that merely look numeric (no code-ish expression beside them)."""
+
+
+TRPC_FIXTURE_EOK = 1099
+E_FIXTURE_GOOD = 2055
+
+
+def route(reply, serial):
+    if reply.code == E_FIXTURE_GOOD:
+        return "good"
+    if serial == 2050:  # a serial number, not an error code: stays silent
+        return "wrap"
+    return "other"

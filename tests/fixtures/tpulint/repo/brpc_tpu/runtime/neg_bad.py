@@ -1,0 +1,15 @@
+"""negotiation positives (the PR 9 shape): wire stamps whose enclosing
+function never reads the advertisement and carries no self-heal hook."""
+
+
+def push_unguarded(native, host, payload):
+    native.qos(2, "fixture-tenant")
+    return native.call(host, "/trpc.ParamService/Push", payload)
+
+
+def encode_unguarded(codec_mod, host, grads):
+    return codec_mod.encode(host, grads)
+
+
+def pull_unguarded(native, host):
+    return native.call(host, "/trpc.ParamService/PullQ", b"")

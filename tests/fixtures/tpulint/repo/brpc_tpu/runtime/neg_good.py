@@ -1,0 +1,37 @@
+"""negotiation negatives: every stamp rides behind its advertisement (or
+its self-heal hook), plus the server-side Meta builder whose key set the
+wire lock's __meta_keys__ section pins."""
+
+
+class FixtureChannel:
+    def push_guarded(self, native, host, payload):
+        if self._srv_qos and host not in self._qos_failed:
+            native.qos(2, "fixture-tenant")
+        return native.call(host, "/trpc.ParamService/Push", payload)
+
+    def encode_guarded(self, codec_mod, host, grads):
+        if self.negotiated_codec(host):
+            return codec_mod.encode(host, grads)
+        return grads
+
+    def pull_guarded(self, native, host):
+        if not self._srv_pushq:
+            return None
+        return native.call(host, "/trpc.ParamService/PullQ", b"")
+
+    def oneside_guarded(self, native, host):
+        if self._srv_oneside:
+            return native.call(host, "/trpc.Window/Oneside", b"")
+        return None
+
+    def advertise(self):
+        doc = {
+            "epoch": self._epoch,
+            "params": sorted(self._params),
+            "qos": 1,
+            "codecs": ["q8", "q4"],
+            "pushq": 1,
+        }
+        if self._oneside_ok:
+            doc["oneside"] = 1
+        return doc

@@ -1,0 +1,23 @@
+"""state-machine positives: the PR 14 double-lane race (state/lane writes
+outside the manager lock), a resurrect-after-shed transition, and a
+migration handshake with Commit before Retire."""
+
+QUEUED, ACTIVE, FROZEN, DONE, SHED = \
+    "queued", "active", "frozen", "done", "shed"
+
+
+class FixtureManager:
+    def admit_racy(self, sess):
+        sess.state = ACTIVE
+        sess.lane = 3
+
+    def resurrect(self, sess):
+        with self._mu:
+            if sess.state == SHED:
+                sess.state = ACTIVE
+
+    def migrate_backwards(self, client, sid):
+        client.call("/trpc.Session/Handoff", sid)
+        client.call("/trpc.Session/Install", sid)
+        client.call("/trpc.Session/Commit", sid)
+        client.call("/trpc.Session/Retire", sid)

@@ -1,0 +1,31 @@
+"""state-machine negatives: writes under the manager lock along legal
+edges, __init__ construction, and the handshake legs in order."""
+
+QUEUED, ACTIVE, FROZEN = "queued", "active", "frozen"
+
+
+class FixtureSession:
+    def __init__(self):
+        self.state = QUEUED
+        self.lane = -1
+
+
+class FixtureManager:
+    def admit(self, sess):
+        with self._mu:
+            if sess.state != QUEUED:
+                return False
+            sess.state = ACTIVE
+            sess.lane = 1
+            return True
+
+    def freeze(self, sess):
+        with self._mu:
+            if sess.state == ACTIVE:
+                sess.state = FROZEN
+
+    def migrate(self, peer, sid):
+        peer.handoff(sid)
+        peer.install(sid)
+        peer.retire(sid)
+        peer.commit(sid)

@@ -358,6 +358,204 @@ def test_py_blocking_negative(fixture_findings):
     assert not [f for f in fixture_findings if "py_good.py" in f.path]
 
 
+# ---- rule class 7: error-code (the cross-language registry) ----
+
+def test_error_code_positive(fixture_findings):
+    msgs = " | ".join(
+        f.message for f in _of(fixture_findings, "error-code", "ec_bad.py"))
+    assert "E_FIXTURE_CLASH = 2050 collides with E_FIXTURE_ONE" in msgs
+    assert "squats the structural" in msgs       # TRPC_* inside the band
+    assert "outside the reserved" in msgs        # E_* below the band
+    assert "raw error code 2050 compared" in msgs
+    assert "raw error code 1008 compared" in msgs  # membership tuples too
+    assert "RpcError raised with raw code 2044" in msgs
+
+
+def test_error_code_negative(fixture_findings):
+    # named-constant comparisons and non-code integers (a serial number
+    # that happens to equal a code value) stay silent
+    assert not [f for f in fixture_findings if "ec_good.py" in f.path]
+
+
+def test_error_code_lock_drift_injected(tmp_path):
+    """The acceptance shape: a code renumbered/added/removed against an
+    injected error_codes.lock must fail verification."""
+    tree = tmp_path / "brpc_tpu" / "runtime"
+    tree.mkdir(parents=True)
+    (tree / "codes.py").write_text(
+        "E_FIXTURE_DRIFT = 2060\nE_FIXTURE_NEW = 2063\n")
+    lockdir = tmp_path / "tools" / "tpulint"
+    lockdir.mkdir(parents=True)
+    (lockdir / "error_codes.lock").write_text(json.dumps(
+        {"version": 1, "codes": {"E_FIXTURE_DRIFT": 2061,
+                                 "E_FIXTURE_REMOVED": 2062}}))
+    msgs = " | ".join(f.message for f in run_lint(str(tmp_path))
+                      if f.rule == "error-code")
+    assert "E_FIXTURE_DRIFT drifted: lock says 2061, source says 2060" \
+        in msgs
+    assert "E_FIXTURE_NEW = 2063 is not in error_codes.lock" in msgs
+    assert "E_FIXTURE_REMOVED" in msgs and "still in error_codes.lock" \
+        in msgs
+
+
+def test_error_code_wire_codes_section_coherence(tmp_path):
+    """wire_contract.lock __codes__ must agree with error_codes.lock."""
+    tree = tmp_path / "brpc_tpu" / "runtime"
+    tree.mkdir(parents=True)
+    (tree / "codes.py").write_text("E_FIXTURE_DRIFT = 2060\n")
+    lockdir = tmp_path / "tools" / "tpulint"
+    lockdir.mkdir(parents=True)
+    (lockdir / "error_codes.lock").write_text(json.dumps(
+        {"version": 1, "codes": {"E_FIXTURE_DRIFT": 2060}}))
+    (lockdir / "wire_contract.lock").write_text(json.dumps(
+        {"__codes__": {"E_FIXTURE_DRIFT": 2061}}))
+    msgs = " | ".join(f.message for f in run_lint(str(tmp_path))
+                      if f.rule == "error-code")
+    assert "__codes__ disagrees with error_codes.lock" in msgs
+
+
+# ---- rule class 8: negotiation (stamp rides behind the advertisement) ----
+
+def test_negotiation_positive(fixture_findings):
+    hits = _of(fixture_findings, "negotiation", "neg_bad.py")
+    msgs = " | ".join(f.message for f in hits)
+    # the PR 9 shape: a qos stamp in a function with no advertisement read
+    assert "QoS priority/tenant wire fields" in msgs
+    assert "quantized tensor codec framing" in msgs
+    assert "grouped PushQ/PullQ methods" in msgs
+    assert all("advertisement" in f.message for f in hits)
+    assert all("self-heal" in f.hint for f in hits)
+
+
+def test_negotiation_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "neg_good.py" in f.path]
+    # the fixture Meta builder matches the lock's __meta_keys__ section
+    assert not _of(fixture_findings, "negotiation", "wire_contract.lock")
+
+
+def test_negotiation_meta_key_lock_drift_injected(tmp_path):
+    tree = tmp_path / "brpc_tpu" / "runtime"
+    tree.mkdir(parents=True)
+    (tree / "meta.py").write_text(
+        'def advertise(self):\n'
+        '    doc = {"epoch": 1, "qos": 1}\n'
+        '    doc["fixture_new"] = 1\n'
+        '    return doc\n')
+    lockdir = tmp_path / "tools" / "tpulint"
+    lockdir.mkdir(parents=True)
+    (lockdir / "wire_contract.lock").write_text(json.dumps(
+        {"__meta_keys__": ["epoch", "qos", "vanished_key"]}))
+    msgs = " | ".join(f.message for f in run_lint(str(tmp_path))
+                      if f.rule == "negotiation")
+    assert '"fixture_new" is not in the wire lock' in msgs
+    assert '"vanished_key" vanished' in msgs
+
+
+# ---- rule class 9: state-machine (lifecycle, lock scope, handshake) ----
+
+def test_state_machine_positive(fixture_findings):
+    msgs = " | ".join(
+        f.message for f in _of(fixture_findings, "state-machine",
+                               "sm_bad.py"))
+    # the PR 14 double-lane race shape: unlocked state AND lane writes
+    assert "session .state written outside" in msgs
+    assert "session .lane written outside" in msgs
+    # the PR 10 resurrect shape: SHED is terminal
+    assert "illegal session transition SHED -> ACTIVE" in msgs
+    # handshake inversion: writes must not open before reads move
+    assert "migration handshake leg Retire after Commit" in msgs
+
+
+def test_state_machine_negative(fixture_findings):
+    # locked writes along legal edges, __init__ construction, and the
+    # handshake legs in Handoff -> Install -> Retire -> Commit order
+    assert not [f for f in fixture_findings if "sm_good.py" in f.path]
+
+
+# ---- rule class 10: arena-alias (device_put over wire views) ----
+
+def test_arena_alias_positive(fixture_findings):
+    hits = _of(fixture_findings, "arena-alias", "aa_bad.py")
+    assert len(hits) == 2  # tainted name + inline reshape chain
+    assert all("alias" in f.message for f in hits)
+    assert all("tensor.py" in f.hint for f in hits)
+
+
+def test_arena_alias_negative(fixture_findings):
+    assert not [f for f in fixture_findings if "aa_good.py" in f.path]
+
+
+# ---- rule class 11: sanitizer-clean (suppression files vs the lock) ----
+
+def test_sanitizer_clean_positive(fixture_findings):
+    unpinned = _of(fixture_findings, "sanitizer-clean", "fixture.supp")
+    assert len(unpinned) == 1
+    assert "race:fixture_unpinned_symbol" in unpinned[0].message
+    assert unpinned[0].line == 3, "points at the entry, not the file"
+    stale = _of(fixture_findings, "sanitizer-clean",
+                "sanitizer_suppressions.lock")
+    assert len(stale) == 1
+    assert "leak:fixture_stale_symbol" in stale[0].message
+    # the pinned entry stays silent
+    assert not any("fixture_pinned_symbol" in f.message
+                   for f in unpinned + stale)
+
+
+def test_sanitizer_clean_real_repo_lock_is_current():
+    from tools.tpulint.rules_sanitize import collect_suppressions
+    with open(os.path.join(ROOT, "tools", "tpulint",
+                           "sanitizer_suppressions.lock")) as fh:
+        locked = json.load(fh)["suppressions"]
+    assert collect_suppressions(ROOT) == locked
+    assert "native/sanitizers/tsan.supp" in locked
+
+
+# ---- the contract-lock sections beside __capi__ ----
+
+def test_meta_keys_and_codes_parsers_pin():
+    """parse_meta_keys / snapshot_codes over the fixture tree produce the
+    exact sections the fixture lock carries — the parser contract, not
+    just silence."""
+    from tools.tpulint.core import LintContext, collect_files
+    from tools.tpulint.rules_codes import snapshot_codes
+    from tools.tpulint.rules_negotiation import parse_meta_keys
+
+    ctx = LintContext(root=FIXTURE_REPO,
+                      files=collect_files(FIXTURE_REPO))
+    keys = parse_meta_keys(ctx)
+    assert keys == ["codecs", "epoch", "oneside", "params", "pushq", "qos"]
+    codes = snapshot_codes(ctx)
+    assert codes["E_FIXTURE_ONE"] == 2050
+    assert codes["TRPC_FIXTURE_EBAND"] == 2044
+    with open(os.path.join(FIXTURE_REPO, "tools", "tpulint",
+                           "wire_contract.lock")) as fh:
+        lock = json.load(fh)
+    assert lock["__meta_keys__"] == keys
+    assert lock["__codes__"] == codes
+
+
+def test_real_repo_lock_sections_are_current():
+    """The committed locks describe the registry as it IS: a Meta key or
+    error code added without a lock regen fails here (and in
+    test_real_repo_is_lint_clean)."""
+    from tools.tpulint.core import LintContext, collect_files
+    from tools.tpulint.rules_codes import snapshot_codes
+    from tools.tpulint.rules_negotiation import parse_meta_keys
+
+    with open(os.path.join(ROOT, "tools", "tpulint",
+                           "wire_contract.lock")) as fh:
+        wire = json.load(fh)
+    with open(os.path.join(ROOT, "tools", "tpulint",
+                           "error_codes.lock")) as fh:
+        codes = json.load(fh)["codes"]
+    assert wire["__codes__"] == codes
+    assert {"codecs", "epoch", "oneside", "params", "pushq",
+            "qos"} <= set(wire["__meta_keys__"])
+    ctx = LintContext(root=ROOT, files=collect_files(ROOT))
+    assert snapshot_codes(ctx) == codes
+    assert parse_meta_keys(ctx) == wire["__meta_keys__"]
+
+
 # ---- suppressions ----
 
 def test_suppression_same_line_and_previous_line(fixture_findings):
@@ -429,7 +627,9 @@ def test_reporters_shapes(fixture_findings):
     assert len(run["results"]) == len(findings)
     rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
     assert {"fiber-blocking", "lock-order", "iobuf-ownership",
-            "wire-contract", "metric-name", "py-blocking"} <= rule_ids
+            "wire-contract", "metric-name", "py-blocking",
+            "error-code", "negotiation", "state-machine", "arena-alias",
+            "sanitizer-clean"} <= rule_ids
 
 
 def test_cli_exit_codes():

@@ -14,6 +14,9 @@ from tools.tpulint import baseline as baseline_mod
 from tools.tpulint.core import DEFAULT_PATHS, LintContext, all_rules, \
     collect_files, run_lint
 from tools.tpulint.report import RENDERERS
+from tools.tpulint.rules_codes import CODES_LOCK_RELPATH, snapshot_codes
+from tools.tpulint.rules_sanitize import SANITIZER_LOCK_RELPATH, \
+    snapshot_suppressions
 from tools.tpulint.rules_wire import LOCK_RELPATH, snapshot_lock
 
 
@@ -40,7 +43,14 @@ def main(argv=None) -> int:
                          "exit 0")
     ap.add_argument("--write-wire-lock", action="store_true",
                     help="snapshot .tidl schemas + the capi extern-C "
-                         f"surface into {LOCK_RELPATH} and exit 0")
+                         "surface + the Meta-key/error-code contract "
+                         f"sections into {LOCK_RELPATH} and exit 0")
+    ap.add_argument("--write-codes-lock", action="store_true",
+                    help="snapshot the cross-language error-code registry "
+                         f"into {CODES_LOCK_RELPATH} and exit 0")
+    ap.add_argument("--write-sanitizer-lock", action="store_true",
+                    help="pin the native/sanitizers/*.supp entries into "
+                         f"{SANITIZER_LOCK_RELPATH} and exit 0")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -56,14 +66,22 @@ def main(argv=None) -> int:
         root = cand if os.path.isdir(os.path.join(cand, "native")) \
             else os.getcwd()
 
-    if args.write_wire_lock:
+    if args.write_wire_lock or args.write_codes_lock:
         ctx = LintContext(root=root, files=collect_files(
             root, tuple(args.paths or DEFAULT_PATHS)))
-        lock_path = os.path.join(root, LOCK_RELPATH)
-        with open(lock_path, "w", encoding="utf-8") as fh:
-            json.dump(snapshot_lock(ctx), fh, indent=1, sort_keys=True)
-            fh.write("\n")
-        print(f"tpulint: wrote {LOCK_RELPATH}")
+        if args.write_wire_lock:
+            _dump(os.path.join(root, LOCK_RELPATH), snapshot_lock(ctx))
+            print(f"tpulint: wrote {LOCK_RELPATH}")
+        if args.write_codes_lock:
+            _dump(os.path.join(root, CODES_LOCK_RELPATH),
+                  {"version": 1, "codes": snapshot_codes(ctx)})
+            print(f"tpulint: wrote {CODES_LOCK_RELPATH}")
+        return 0
+
+    if args.write_sanitizer_lock:
+        _dump(os.path.join(root, SANITIZER_LOCK_RELPATH),
+              snapshot_suppressions(root))
+        print(f"tpulint: wrote {SANITIZER_LOCK_RELPATH}")
         return 0
 
     findings = run_lint(root, tuple(args.paths or DEFAULT_PATHS))
@@ -90,6 +108,12 @@ def main(argv=None) -> int:
 
     sys.stdout.write(RENDERERS[args.format](findings))
     return 1 if findings else 0
+
+
+def _dump(path: str, doc) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
 
 
 if __name__ == "__main__":

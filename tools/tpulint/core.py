@@ -223,9 +223,12 @@ def _maybe_add(root: str, rel: str, files: list[SourceFile],
 
 def all_rules():
     """The rule registry (imported lazily to avoid import cycles)."""
-    from tools.tpulint import rules_cpp, rules_metrics, rules_py, rules_wire
+    from tools.tpulint import (rules_codes, rules_cpp, rules_metrics,
+                               rules_negotiation, rules_py, rules_sanitize,
+                               rules_state, rules_wire)
     return (rules_cpp.RULES + rules_wire.RULES + rules_metrics.RULES
-            + rules_py.RULES)
+            + rules_py.RULES + rules_codes.RULES + rules_negotiation.RULES
+            + rules_state.RULES + rules_sanitize.RULES)
 
 
 def run_lint(root: str, paths: tuple[str, ...] | None = None,

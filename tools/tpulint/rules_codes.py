@@ -1,0 +1,284 @@
+"""error-code: the cross-language error-code registry.
+
+The framework's failure surface is a SINGLE integer namespace spoken by
+two languages: native/trpc/errno.h (TRPC_* transport/framework codes) and
+the Python side's structural 2040+ range (E_NO_SUCH .. E_SESSION_MOVED),
+mirrored name-for-name in brpc_tpu/runtime/native.py.  Nothing at runtime
+checks the two sides agree — a collision surfaces as a WRONG control-flow
+decision, not a crash (the PR 6 class: a structural code landing on
+TRPC_ECONNECT made the QoS self-heal eat a routing signal).  Five checks
+under one rule id:
+
+  * collision — two different names carrying the same value (any mix of
+    languages); the value routes behaviour, so the later definition
+    silently hijacks the earlier one's handlers;
+  * parity — the same name defined with different values in different
+    files (the C++ enum and its Python mirror drifting apart);
+  * range discipline — E_* structural codes must live in [2040, 2100);
+    TRPC_* transport codes must stay OUT of that reserved band;
+  * lock drift — the registry against tools/tpulint/error_codes.lock
+    (and the wire lock's __codes__ section against the same truth):
+    adding/renumbering a code without a lock regen is a finding, so the
+    diff that changes wire-visible behaviour always shows the lock;
+  * raw literals — an integer compared against a `.code`/error-code
+    expression, or passed as an RpcError code, where a named constant
+    exists: the exact spelling that let the PR 6 collision land unseen.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+from tools.tpulint.core import Finding, LintContext
+
+CODES_LOCK_RELPATH = "tools/tpulint/error_codes.lock"
+WIRE_LOCK_RELPATH = "tools/tpulint/wire_contract.lock"
+
+# Definition sites.  Python: module-level NAME = <int>.  C++: enumerator
+# NAME = <int> (errno.h and any future enum).  Only registry-shaped names
+# count — TRPC_* and E_* — and only plausible code values; PRIORITY_HIGH=0
+# and friends must not join the namespace.
+_PY_DEF_RE = re.compile(
+    r"^(TRPC_[A-Z0-9_]+|E_[A-Z][A-Z0-9_]*)\s*=\s*(\d+)\s*$")
+_CPP_DEF_RE = re.compile(r"\b(TRPC_[A-Z0-9_]+)\s*=\s*(\d+)")
+
+# The structural range reserved for application-level codes (errno.h stops
+# at 2007; HTTP-ish 1000s belong to the framework).
+STRUCT_LO, STRUCT_HI = 2040, 2100
+
+# Python expressions that read as "this is an error code" on the other
+# side of a comparison against a bare literal.
+_CODEISH_ATTRS = {"code", "error_code", "status"}
+
+
+def collect_definitions(ctx: LintContext):
+    """[(name, value, path, lineno)] across both languages."""
+    defs = []
+    for src in ctx.select(under=("brpc_tpu/",), ext={".py"}):
+        for lineno, line in enumerate(src.code_lines(), 1):
+            m = _PY_DEF_RE.match(line)
+            if m and 1000 <= int(m.group(2)) < 3000:
+                defs.append((m.group(1), int(m.group(2)), src.path, lineno))
+    for src in ctx.select(under=("native/",), ext={".h", ".hpp", ".cpp", ".cc"},
+                          exclude_under=("native/test/",)):
+        for lineno, line in enumerate(src.code_lines(), 1):
+            for m in _CPP_DEF_RE.finditer(line):
+                if 1000 <= int(m.group(2)) < 3000:
+                    defs.append((m.group(1), int(m.group(2)),
+                                 src.path, lineno))
+    return defs
+
+
+def snapshot_codes(ctx: LintContext) -> dict:
+    """{name: value} — the error_codes.lock body (sorted on write)."""
+    out: dict[str, int] = {}
+    for name, value, _path, _ln in collect_definitions(ctx):
+        out.setdefault(name, value)
+    return out
+
+
+class ErrorCodeRule:
+    id = "error-code"
+    description = ("error-code collision/parity/range violation, drift "
+                   "against error_codes.lock, or a raw integer used where "
+                   "a named code constant exists")
+
+    def run(self, ctx: LintContext):
+        findings: list[Finding] = []
+        defs = collect_definitions(ctx)
+        registry: dict[str, int] = {}
+        by_value: dict[int, str] = {}
+        for name, value, path, lineno in defs:
+            known = registry.get(name)
+            if known is None:
+                registry[name] = value
+            elif known != value:
+                findings.append(Finding(
+                    rule=self.id, path=path, line=lineno,
+                    message=f"{name} redefined as {value} but is {known} "
+                            "elsewhere; the two languages route on "
+                            "different integers",
+                    hint="one registry: native/trpc/errno.h and its "
+                         "native.py mirror must agree value-for-value"))
+                continue
+            holder = by_value.get(value)
+            if holder is None:
+                by_value[value] = name
+            elif holder != name:
+                findings.append(Finding(
+                    rule=self.id, path=path, line=lineno,
+                    message=f"{name} = {value} collides with {holder}; "
+                            "handlers keyed on the value cannot tell "
+                            "them apart",
+                    hint="pick the next free value (structural codes: "
+                         f"[{STRUCT_LO}, {STRUCT_HI}) ascending) and "
+                         "regen the lock"))
+        for name, value, path, lineno in defs:
+            if name.startswith("E_") and not STRUCT_LO <= value < STRUCT_HI:
+                findings.append(Finding(
+                    rule=self.id, path=path, line=lineno,
+                    message=f"structural code {name} = {value} is outside "
+                            f"the reserved [{STRUCT_LO}, {STRUCT_HI}) band",
+                    hint="the band exists so structural codes can never "
+                         "collide with transport codes; renumber into it"))
+            elif name.startswith("TRPC_") and STRUCT_LO <= value < STRUCT_HI:
+                findings.append(Finding(
+                    rule=self.id, path=path, line=lineno,
+                    message=f"transport code {name} = {value} squats the "
+                            f"structural [{STRUCT_LO}, {STRUCT_HI}) band",
+                    hint="transport codes stay below the band; structural "
+                         "codes own it"))
+        findings.extend(self._check_lock(ctx, registry))
+        findings.extend(self._check_raw_py(ctx, registry))
+        findings.extend(self._check_raw_cpp(ctx, registry))
+        return findings
+
+    # -- drift against the committed locks ----------------------------------
+    def _check_lock(self, ctx, registry):
+        out = []
+        lock = _load_json(os.path.join(ctx.root, CODES_LOCK_RELPATH))
+        if lock is None:
+            return out  # no lock yet: --write-codes-lock creates one
+        locked = {str(k): int(v) for k, v in lock.get("codes", {}).items()}
+        def_site = {}
+        for name, _value, path, lineno in collect_definitions(ctx):
+            def_site.setdefault(name, (path, lineno))
+        for name, value in sorted(registry.items()):
+            path, lineno = def_site[name]
+            if name not in locked:
+                out.append(Finding(
+                    rule=self.id, path=path, line=lineno,
+                    message=f"{name} = {value} is not in error_codes.lock",
+                    hint="new codes regen the lock IN THE SAME change "
+                         "(python -m tools.tpulint --write-codes-lock) so "
+                         "review sees the namespace grow"))
+            elif locked[name] != value:
+                out.append(Finding(
+                    rule=self.id, path=path, line=lineno,
+                    message=f"{name} drifted: lock says {locked[name]}, "
+                            f"source says {value}",
+                    hint="renumbering a code breaks every peer still "
+                         "speaking the old value; keep it, or regen the "
+                         "lock in a change that proves no peer keys on it"))
+        for name in sorted(set(locked) - set(registry)):
+            out.append(Finding(
+                rule=self.id, path=CODES_LOCK_RELPATH, line=1,
+                message=f"{name} was removed from the source but is still "
+                        "in error_codes.lock",
+                hint="codes retire, they do not vanish: keep the constant "
+                     "(commented retired) or regen the lock deliberately"))
+        # The wire lock's __codes__ section mirrors this registry so the
+        # wire-contract reviewers see code changes too.
+        wire = _load_json(os.path.join(ctx.root, WIRE_LOCK_RELPATH))
+        if wire is not None and "__codes__" in wire:
+            wire_codes = {str(k): int(v)
+                          for k, v in wire["__codes__"].items()}
+            if wire_codes != {k: int(v) for k, v in locked.items()}:
+                out.append(Finding(
+                    rule=self.id, path=WIRE_LOCK_RELPATH, line=1,
+                    message="wire_contract.lock __codes__ disagrees with "
+                            "error_codes.lock",
+                    hint="regen both locks together: --write-codes-lock "
+                         "then --write-wire-lock"))
+        return out
+
+    # -- raw integer literals where a name exists ---------------------------
+    def _check_raw_py(self, ctx, registry):
+        out = []
+        names = {v: k for k, v in sorted(registry.items(), reverse=True)}
+        if not names:
+            return out
+        for src in ctx.select(under=("brpc_tpu/", "examples/"), ext={".py"}):
+            try:
+                tree = ast.parse(src.text)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Compare):
+                    out.extend(self._raw_compare(src, node, names))
+                elif isinstance(node, ast.Call):
+                    out.extend(self._raw_rpcerror(src, node, names))
+        return out
+
+    def _raw_compare(self, src, node, names):
+        sides = [node.left] + list(node.comparators)
+        literals = []
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, int) \
+                    and s.value in names:
+                literals.append(s)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                literals.extend(
+                    e for e in s.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int) and e.value in names)
+        if not literals:
+            return []
+        if not any(_looks_codeish(s) for s in sides):
+            return []  # `len(x) == 2001` is not an error-code comparison
+        return [Finding(
+            rule=self.id, path=src.path, line=lit.lineno,
+            message=f"raw error code {lit.value} compared where "
+                    f"{names[lit.value]} exists",
+            hint="compare against the named constant; bare integers are "
+                 "how the PR 6 collision went unreviewed")
+            for lit in literals]
+
+    def _raw_rpcerror(self, src, node, names):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else ""
+        if name != "RpcError" or not node.args:
+            return []
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, int) \
+                and first.value in names:
+            return [Finding(
+                rule=self.id, path=src.path, line=first.lineno,
+                message=f"RpcError raised with raw code {first.value} "
+                        f"({names[first.value]} exists)",
+                hint="raise with the named constant so grep finds every "
+                     "producer of the code")]
+        return []
+
+    def _check_raw_cpp(self, ctx, registry):
+        out = []
+        names = {v: k for k, v in sorted(registry.items(), reverse=True)}
+        pat = re.compile(r"(?:[=!]=\s*|\breturn\s+)(\d{4})\b")
+        for src in ctx.select(under=("native/",), ext={".cpp", ".cc", ".h"},
+                              exclude_under=("native/test/",)):
+            if src.path.endswith("errno.h"):
+                continue  # the registry itself
+            for lineno, line in enumerate(src.code_lines(), 1):
+                for m in pat.finditer(line):
+                    v = int(m.group(1))
+                    if v in names:
+                        out.append(Finding(
+                            rule=self.id, path=src.path, line=lineno,
+                            message=f"raw error code {v} used where "
+                                    f"{names[v]} exists",
+                            hint="include trpc/errno.h and use the name"))
+        return out
+
+
+def _looks_codeish(node) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in _CODEISH_ATTRS
+    if isinstance(node, ast.Name):
+        return node.id in _CODEISH_ATTRS or node.id.endswith("_code")
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_looks_codeish(e) for e in node.elts)
+    return False
+
+
+def _load_json(path):
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+RULES = [ErrorCodeRule()]

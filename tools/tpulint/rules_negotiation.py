@@ -1,0 +1,215 @@
+"""negotiation: wire stamps ride ONLY behind their Meta advertisement.
+
+The compatibility story for every wire-format extension (quantized
+codecs, QoS priority/tenant fields, grouped PushQ/PullQ methods, the
+one-sided window) is the SAME pattern: the server advertises the
+capability under a Meta key, the client stamps the extension onto the
+wire only after reading the advertisement, and a `_*_failed` self-heal
+drops the cached advertisement when the server rolls back underneath us.
+PR 9 shipped a stamp site that skipped the check ("initially missed" in
+review) — an upgraded client sending a meta a pre-QoS parser kills the
+connection over.  This rule makes the pattern machine-checked:
+
+  * every advertisement lives in ONE table below (key, stamp shape,
+    guard spellings).  A wire-stamping call site whose enclosing
+    function mentions none of the capability's guards — no advertisement
+    read, no self-heal hook — is a finding.  Deliberate exceptions
+    (a protocol born after the capability, so every peer speaks it)
+    carry a `tpulint: allow(negotiation)` with the reason;
+  * the advertisement key set itself is pinned in wire_contract.lock
+    (`__meta_keys__`): adding a Meta key without a lock regen is a
+    finding, so a new capability cannot ship without the reviewer seeing
+    the negotiation surface grow.
+
+"Dataflow-lite": the dominance check is lexical (the guard identifier
+must appear in the outermost enclosing function), not a real CFG — cheap,
+dependency-free, and exact enough that every historical violation in
+CHANGES.md would have been caught.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+from tools.tpulint.core import Finding, LintContext
+
+WIRE_LOCK_RELPATH = "tools/tpulint/wire_contract.lock"
+
+# The advertisement registry: Meta key -> how its stamp sites look and
+# which spellings count as "the advertisement was consulted".  Guards are
+# substring-matched against the outermost enclosing function's source, so
+# both the cached-flag read (self._srv_qos) and the self-heal hook
+# (_qos_failed) — and the per-peer capability map (.get("qos")) — qualify.
+ADVERTISEMENTS = {
+    "qos": {
+        "guards": ("_srv_qos", "_qos_failed", '.get("qos")'),
+        "what": "QoS priority/tenant wire fields",
+    },
+    "codecs": {
+        # "in self._codecs" is the SERVER-side check: a server encodes
+        # only codecs it itself advertises (reply-side of the pattern).
+        "guards": ("_srv_codecs", "negotiated_codec", "_codec_for",
+                   "_oneside_codec", "codec_mod.choose", "choose(",
+                   "in self._codecs"),
+        "what": "quantized tensor codec framing",
+    },
+    "pushq": {
+        "guards": ("_srv_pushq", "_pushq_failed", "negotiated_codec",
+                   "_codec_pull_failed"),
+        "what": "grouped PushQ/PullQ methods",
+    },
+    "oneside": {
+        "guards": ("_srv_oneside",),
+        "what": "one-sided window descriptor RPC",
+    },
+}
+
+_METHOD_CAPS = (("/PushQ", "pushq"), ("/PullQ", "pushq"),
+                ("/Oneside", "oneside"))
+
+# Server-side Meta builder (param_server.py): the literal dict plus any
+# later doc["key"] = ... additions inside the handler.
+_DOC_ASSIGN_RE = re.compile(r"doc\[\s*\"(\w+)\"\s*\]\s*=")
+_DOC_DICT_RE = re.compile(r"\bdoc\s*=\s*\{")
+_KEY_RE = re.compile(r"\"(\w+)\"\s*:")
+
+
+def parse_meta_keys(ctx: LintContext) -> list[str]:
+    """Sorted advertisement keys from the server's Meta document builder."""
+    keys: set[str] = set()
+    for src in ctx.select(under=("brpc_tpu/runtime/",), ext={".py"}):
+        text = "\n".join(src.code_lines())
+        for m in _DOC_ASSIGN_RE.finditer(text):
+            keys.add(m.group(1))
+        for m in _DOC_DICT_RE.finditer(text):
+            depth, i = 0, m.end() - 1
+            while i < len(text):
+                if text[i] == "{":
+                    depth += 1
+                elif text[i] == "}":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            keys.update(_KEY_RE.findall(text[m.end() - 1:i + 1]))
+    return sorted(keys)
+
+
+class NegotiationRule:
+    id = "negotiation"
+    description = ("wire-stamping call site not dominated by its Meta "
+                   "advertisement check / self-heal, or an advertisement "
+                   "key missing from the wire lock")
+
+    def run(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for src in ctx.select(under=("brpc_tpu/",), ext={".py"}):
+            try:
+                tree = ast.parse(src.text)
+            except SyntaxError:
+                continue
+            enclosing = _outermost_functions(tree)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cap = _classify_stamp(node)
+                if cap is None:
+                    continue
+                fn = _owner(enclosing, node)
+                if fn is not None and _has_guard(src, fn, cap):
+                    continue
+                meta = ADVERTISEMENTS[cap]
+                findings.append(Finding(
+                    rule=self.id, path=src.path, line=node.lineno,
+                    message=f"{meta['what']} stamped without consulting "
+                            f"the \"{cap}\" advertisement",
+                    hint="gate on the Meta advertisement (or its _*_failed"
+                         " self-heal); a peer that never advertised the "
+                         "capability cannot parse the stamp — or justify "
+                         "with tpulint: allow(negotiation)"))
+        findings.extend(self._check_meta_lock(ctx))
+        return findings
+
+    def _check_meta_lock(self, ctx):
+        path = os.path.join(ctx.root, WIRE_LOCK_RELPATH)
+        if not os.path.exists(path):
+            return []
+        with open(path, "r", encoding="utf-8") as fh:
+            lock = json.load(fh)
+        locked = lock.get("__meta_keys__")
+        if locked is None:
+            return []  # pre-section lock: --write-wire-lock adds it
+        current = parse_meta_keys(ctx)
+        out = []
+        for key in sorted(set(current) - set(locked)):
+            out.append(Finding(
+                rule=self.id, path=WIRE_LOCK_RELPATH, line=1,
+                message=f"Meta advertisement key \"{key}\" is not in the "
+                        "wire lock __meta_keys__ section",
+                hint="a new advertisement is a new negotiation surface; "
+                     "regen the lock (--write-wire-lock) in the same "
+                     "change so review sees it"))
+        for key in sorted(set(locked) - set(current)):
+            out.append(Finding(
+                rule=self.id, path=WIRE_LOCK_RELPATH, line=1,
+                message=f"Meta advertisement key \"{key}\" vanished from "
+                        "the server but is still in the wire lock",
+                hint="clients still probe for it; retire the key "
+                     "deliberately (keep advertising 0) or regen the lock"))
+        return out
+
+
+def _classify_stamp(node: ast.Call):
+    """Which advertisement (if any) a call stamps onto the wire."""
+    fn = node.func
+    # native.qos(priority, tenant): the QoS meta fields.
+    if isinstance(fn, ast.Attribute) and fn.attr == "qos" \
+            and isinstance(fn.value, ast.Name) and fn.value.id == "native":
+        return "qos"
+    # codec_mod.encode(host, codec): quantized wire framing.
+    if isinstance(fn, ast.Attribute) and fn.attr == "encode" \
+            and isinstance(fn.value, ast.Name) and "codec" in fn.value.id:
+        return "codecs"
+    # Negotiated method names riding as string arguments.
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            for marker, cap in _METHOD_CAPS:
+                if marker in arg.value:
+                    return cap
+    return None
+
+
+def _outermost_functions(tree):
+    """[(fn_node, set-of-contained-linenos)] for top-nesting functions."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+    # Keep only functions not nested inside another collected function.
+    spans = [(f, f.lineno, max(f.end_lineno or f.lineno, f.lineno))
+             for f in out]
+    outer = []
+    for f, lo, hi in spans:
+        if not any(o is not f and olo <= lo and hi <= ohi
+                   for o, olo, ohi in spans):
+            outer.append((f, lo, hi))
+    return outer
+
+
+def _owner(enclosing, node):
+    for f, lo, hi in enclosing:
+        if lo <= node.lineno <= hi:
+            return (f, lo, hi)
+    return None
+
+
+def _has_guard(src, fn, cap) -> bool:
+    _f, lo, hi = fn
+    body = "\n".join(src.code_lines()[lo - 1:hi])
+    return any(g in body for g in ADVERTISEMENTS[cap]["guards"])
+
+
+RULES = [NegotiationRule()]

@@ -1,0 +1,97 @@
+"""sanitizer-clean: suppression files cannot grow silently.
+
+The native tree builds under TSan/ASan (native/CMakeLists.txt,
+-DTPU_SANITIZE=thread|address; `make tsan-test` / `make asan-test`), and
+the suppression files under native/sanitizers/ carry the KNOWN-benign
+patterns (TLS-cache reads the fiber annotations cannot express, glibc
+dl_open leaks).  A suppression is a standing claim that a report is a
+false positive — adding one must be a reviewed decision, not a quiet way
+to turn a red build green.  So the suppression entries are pinned in
+tools/tpulint/sanitizer_suppressions.lock: an entry in a .supp file that
+is not in the lock (or a lock entry whose .supp file dropped it) is a
+finding until `--write-sanitizer-lock` regenerates the pin IN THE SAME
+change, where review can see the suppression surface grow.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from tools.tpulint.core import Finding, LintContext
+
+SUPP_DIR = "native/sanitizers"
+SANITIZER_LOCK_RELPATH = "tools/tpulint/sanitizer_suppressions.lock"
+
+
+def collect_suppressions(root: str) -> dict[str, list[str]]:
+    """{relpath: [entries]} — comment/blank lines are not entries."""
+    out: dict[str, list[str]] = {}
+    for path in sorted(glob.glob(os.path.join(root, SUPP_DIR, "*.supp"))):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        entries = []
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    entries.append(line)
+        out[rel] = entries
+    return out
+
+
+def snapshot_suppressions(root: str) -> dict:
+    return {"version": 1, "suppressions": collect_suppressions(root)}
+
+
+class SanitizerCleanRule:
+    id = "sanitizer-clean"
+    description = ("sanitizer suppression entry added or removed without "
+                   "regenerating sanitizer_suppressions.lock")
+
+    def run(self, ctx: LintContext):
+        lock_path = os.path.join(ctx.root, SANITIZER_LOCK_RELPATH)
+        if not os.path.exists(lock_path):
+            return []  # no lock yet: --write-sanitizer-lock creates one
+        with open(lock_path, "r", encoding="utf-8") as fh:
+            locked = json.load(fh).get("suppressions", {})
+        current = collect_suppressions(ctx.root)
+        findings = []
+        for rel in sorted(set(current) | set(locked)):
+            have = current.get(rel, [])
+            want = locked.get(rel, [])
+            for entry in have:
+                if entry not in want:
+                    findings.append(Finding(
+                        rule=self.id, path=rel,
+                        line=self._line_of(ctx.root, rel, entry),
+                        message=f"suppression \"{entry}\" is not in "
+                                "sanitizer_suppressions.lock",
+                        hint="a new suppression hides a sanitizer report "
+                             "forever; justify it in the change that runs "
+                             "--write-sanitizer-lock", snippet=entry))
+            for entry in want:
+                if entry not in have:
+                    findings.append(Finding(
+                        rule=self.id, path=SANITIZER_LOCK_RELPATH, line=1,
+                        message=f"lock entry \"{entry}\" no longer exists "
+                                f"in {rel}",
+                        hint="good news if the report was fixed — regen "
+                             "the lock so the pin shrinks with reality",
+                        snippet=entry))
+        return findings
+
+    @staticmethod
+    def _line_of(root, rel, entry):
+        try:
+            with open(os.path.join(root, rel), "r",
+                      encoding="utf-8") as fh:
+                for i, line in enumerate(fh, 1):
+                    if line.strip() == entry:
+                        return i
+        except OSError:
+            pass
+        return 1
+
+
+RULES = [SanitizerCleanRule()]

@@ -1,0 +1,370 @@
+"""state-machine / arena-alias: the serving plane's hand-proved invariants.
+
+Three checks that each cost a hand-fixed bug before they were rules:
+
+  * lock scope — `sess.state` / `sess.lane` writes must sit inside a
+    `with ..._mu:` block (the manager lock).  PR 10's resurrect-after-shed
+    and PR 14's double-lane race were both a state write that LOOKED
+    guarded but raced the admission path; the engine's step-boundary lane
+    sweeps are the deliberate exception and carry allow() annotations
+    explaining the single-owner discipline;
+  * transition table — the session lifecycle is a real state machine
+    (QUEUED/ACTIVE/FROZEN/DONE/SHED) declared below; when a write's
+    from-state is lexically inferable (an enclosing `if s.state == X:` or
+    a preceding `if s.state != X: return` guard), the (from, to) edge
+    must be legal.  DONE and SHED are terminal: writing past them is the
+    resurrect bug class;
+  * migration handshake order — Handoff -> Install -> Retire -> Commit
+    (reads move before writes, so reads and writes can never disagree
+    about where a tensor lives).  Within one function the legs must
+    appear in that order; a Commit that precedes its Retire re-opens the
+    very race the handshake exists to close.
+
+arena-alias (separate rule id): `jax.device_put` over an array that still
+VIEWS wire/arena pages.  On the CPU backend XLA zero-copy aliases 64-byte-
+aligned host buffers, so the "copy" keeps reading pages the arena is
+about to recycle — the hazard fixed independently in PRs 3, 6, 7 and 11.
+Detached spellings (np.array(...), np.ascontiguousarray(...), .copy())
+and the blessed helpers in brpc_tpu/runtime/tensor.py (which own the
+alias-vs-copy decision and the alignment dance) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpulint.core import Finding, LintContext
+
+STATES = {"QUEUED", "ACTIVE", "FROZEN", "DONE", "SHED"}
+
+# Legal lifecycle edges (serving/session.py is the reference):
+#   QUEUED -> ACTIVE   admission hands the session a batch lane
+#   live   -> FROZEN   migration freeze (decode pauses, KV exportable)
+#   FROZEN -> ACTIVE   unfreeze with its lane intact (failed ship)
+#   FROZEN -> QUEUED   unfreeze after the lane was swept
+#   live   -> DONE     generation finished
+#   live   -> SHED     evicted (deadline / TTL / stalled reader / quota)
+# DONE and SHED are terminal.
+TRANSITIONS = {
+    "QUEUED": {"ACTIVE", "FROZEN", "DONE", "SHED"},
+    "ACTIVE": {"FROZEN", "DONE", "SHED"},
+    "FROZEN": {"ACTIVE", "QUEUED", "DONE", "SHED"},
+    "DONE": set(),
+    "SHED": set(),
+}
+
+_GUARDED_ATTRS = {"state", "lane"}
+
+# Migration handshake legs in call order.  Both spellings count: the
+# method string on the wire and the typed client verbs.
+_LEGS = {"handoff": 0, "install": 1, "retire": 2, "commit": 3}
+_LEG_NAMES = ["Handoff", "Install", "Retire", "Commit"]
+
+_DETACH_CALLS = {"array", "ascontiguousarray", "copy", "asarray"}
+
+
+class SessionStateRule:
+    id = "state-machine"
+    description = ("session state/lane write outside the _mu lock scope, "
+                   "an illegal lifecycle transition, or migration "
+                   "handshake legs out of Handoff/Install/Retire/Commit "
+                   "order")
+
+    def run(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for src in ctx.select(under=("brpc_tpu/serving/", "brpc_tpu/fleet/"),
+                              ext={".py"}):
+            try:
+                tree = ast.parse(src.text)
+            except SyntaxError:
+                continue
+            parents = _parent_map(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    findings.extend(
+                        self._check_write(src, node, parents))
+        for src in ctx.select(under=("brpc_tpu/",), ext={".py"}):
+            try:
+                tree = ast.parse(src.text)
+            except SyntaxError:
+                continue
+            findings.extend(self._check_handshake(src, tree))
+        return findings
+
+    # -- lock scope + transition legality -----------------------------------
+    def _check_write(self, src, node, parents):
+        targets = [t for t in node.targets
+                   if isinstance(t, ast.Attribute)
+                   and t.attr in _GUARDED_ATTRS]
+        if not targets:
+            return []
+        chain = _ancestors(parents, node)
+        if any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and a.name == "__init__" for a in chain):
+            return []  # construction: no lock exists yet, no reader either
+        out = []
+        if not any(isinstance(a, ast.With) and _with_takes_mu(a)
+                   for a in chain):
+            attr = targets[0].attr
+            out.append(Finding(
+                rule=self.id, path=src.path, line=node.lineno,
+                message=f"session .{attr} written outside a "
+                        "`with ..._mu:` scope",
+                hint="admission/finish/freeze race this write; take the "
+                     "manager lock, or justify the single-owner "
+                     "discipline with tpulint: allow(state-machine)"))
+        for t in targets:
+            if t.attr != "state":
+                continue
+            to_states = _target_states(node.value)
+            froms = _inferred_from_states(parents, node)
+            for frm in froms:
+                for to in to_states:
+                    if to not in TRANSITIONS.get(frm, STATES):
+                        out.append(Finding(
+                            rule=self.id, path=src.path, line=node.lineno,
+                            message=f"illegal session transition "
+                                    f"{frm} -> {to}",
+                            hint="DONE/SHED are terminal and the lane "
+                                 "handshake fixes the rest; see the "
+                                 "TRANSITIONS table in "
+                                 "tools/tpulint/rules_state.py"))
+        return out
+
+    # -- migration handshake ordering ---------------------------------------
+    def _check_handshake(self, src, tree):
+        out = []
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            legs = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _innermost_fn(funcs, node) is not fn:
+                    continue  # a nested closure owns its own sequence
+                leg = _leg_of(node)
+                if leg is not None:
+                    legs.append((node.lineno, leg))
+            legs.sort()
+            high = -1
+            for lineno, leg in legs:
+                if leg < high:
+                    out.append(Finding(
+                        rule=self.id, path=src.path, line=lineno,
+                        message=f"migration handshake leg "
+                                f"{_LEG_NAMES[leg]} after "
+                                f"{_LEG_NAMES[high]}; order is "
+                                "Handoff -> Install -> Retire -> Commit",
+                        hint="reads move before writes: Install serves "
+                             "reads at the same version BEFORE Retire "
+                             "forwards, and Commit opens writes last"))
+                high = max(high, leg)
+        return out
+
+
+class ArenaAliasRule:
+    id = "arena-alias"
+    description = ("jax.device_put over a buffer that still views "
+                   "wire/arena pages (no detach between frombuffer and "
+                   "device_put)")
+
+    def run(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for src in ctx.select(under=("brpc_tpu/", "examples/"), ext={".py"}):
+            if src.path.endswith("runtime/tensor.py"):
+                # The blessed helpers live here and own the alias-vs-copy
+                # decision (alignment checks, H2D-detach paths).
+                continue
+            try:
+                tree = ast.parse(src.text)
+            except SyntaxError:
+                continue
+            for fn in [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]:
+                findings.extend(self._check_fn(src, fn))
+        return findings
+
+    def _check_fn(self, src, fn):
+        tainted: set[str] = set()
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _is_view_expr(node.value, tainted):
+                    tainted.add(name)
+                else:
+                    tainted.discard(name)
+            elif isinstance(node, ast.Call) and _is_device_put(node):
+                for arg in node.args[:1]:
+                    if _is_view_expr(arg, tainted) or (
+                            isinstance(arg, ast.Name)
+                            and arg.id in tainted):
+                        out.append(Finding(
+                            rule=self.id, path=src.path, line=node.lineno,
+                            message="device_put over an arena/wire view: "
+                                    "XLA may alias the pages instead of "
+                                    "copying",
+                            hint="detach first (np.array(...)) or go "
+                                 "through _device_put_from_view / "
+                                 "consume_* in brpc_tpu/runtime/tensor.py"
+                                 " which own the alias decision"))
+        return out
+
+
+def _is_view_expr(node, tainted) -> bool:
+    """Does this expression still view somebody else's pages?"""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else ""
+        if name in ("frombuffer", "memoryview"):
+            return True
+        if name in _DETACH_CALLS:
+            return False  # np.array(np.frombuffer(...)) detaches
+        if name in ("reshape", "view", "astype"):
+            return any(_is_view_expr(a, tainted) for a in node.args) or (
+                isinstance(fn, ast.Attribute)
+                and _is_view_expr(fn.value, tainted))
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Subscript):
+        return _is_view_expr(node.value, tainted)
+    return False
+
+
+def _is_device_put(node: ast.Call) -> bool:
+    fn = node.func
+    return isinstance(fn, ast.Attribute) and fn.attr == "device_put" \
+        and isinstance(fn.value, ast.Name) and fn.value.id == "jax"
+
+
+def _leg_of(node: ast.Call):
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            for name, idx in _LEGS.items():
+                if f"/{name.capitalize()}" in arg.value:
+                    return idx
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LEGS \
+            and isinstance(fn.value, (ast.Name, ast.Attribute)):
+        return _LEGS[fn.attr]
+    return None
+
+
+def _innermost_fn(funcs, node):
+    best, best_span = None, None
+    for f in funcs:
+        lo, hi = f.lineno, f.end_lineno or f.lineno
+        if lo <= node.lineno <= hi:
+            span = hi - lo
+            if best_span is None or span < best_span:
+                best, best_span = f, span
+    return best
+
+
+def _parent_map(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _ancestors(parents, node):
+    out = []
+    cur = parents.get(node)
+    while cur is not None:
+        out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def _with_takes_mu(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute) and "_mu" in expr.attr:
+            return True
+        if isinstance(expr, ast.Name) and "_mu" in expr.id:
+            return True
+        if isinstance(expr, ast.Call) and "_mu" in ast.dump(expr.func):
+            return True  # e.g. with self._mu_for(sess):
+    return False
+
+
+def _target_states(value):
+    if isinstance(value, ast.Name) and value.id in STATES:
+        return {value.id}
+    if isinstance(value, ast.IfExp):
+        return _target_states(value.body) | _target_states(value.orelse)
+    return set()
+
+
+def _inferred_from_states(parents, node):
+    """Lexically provable from-states for a `.state =` write, else {}."""
+    froms: set[str] = set()
+    # (a) enclosing `if s.state == X:` / `if s.state in (X, Y):`
+    for anc in _ancestors(parents, node):
+        if isinstance(anc, ast.If):
+            got = _eq_states(anc.test)
+            if got:
+                froms |= got
+    if froms:
+        return froms
+    # (b) a preceding sibling early-out: `if s.state != X: return/raise`
+    parent = parents.get(node)
+    body = getattr(parent, "body", None)
+    if not body or node not in body:
+        return froms
+    for stmt in body[:body.index(node)]:
+        if isinstance(stmt, ast.If) and stmt.body and \
+                isinstance(stmt.body[-1], (ast.Return, ast.Raise,
+                                           ast.Continue)):
+            got = _neq_states(stmt.test)
+            if got:
+                froms |= got
+    return froms
+
+
+def _eq_states(test):
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            _is_state_attr(test.left):
+        op, right = test.ops[0], test.comparators[0]
+        if isinstance(op, ast.Eq):
+            return _const_states(right)
+        if isinstance(op, ast.In):
+            return _const_states(right)
+    return set()
+
+
+def _neq_states(test):
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            _is_state_attr(test.left):
+        op, right = test.ops[0], test.comparators[0]
+        if isinstance(op, ast.NotEq):
+            return _const_states(right)
+        if isinstance(op, ast.NotIn):
+            return _const_states(right)
+    return set()
+
+
+def _is_state_attr(node) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "state"
+
+
+def _const_states(node):
+    if isinstance(node, ast.Name) and node.id in STATES:
+        return {node.id}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Name) and e.id in STATES:
+                out.add(e.id)
+        return out
+    return set()
+
+
+RULES = [SessionStateRule(), ArenaAliasRule()]

@@ -336,6 +336,14 @@ def snapshot_lock(ctx: LintContext) -> dict:
             lock.setdefault(src.path, {})["__capi__"] = {
                 sym: sig for sym, (sig, _ln) in sorted(parse_capi(src).items())
             }
+    # Contract sections beside __capi__ (top-level reserved keys, so no
+    # path entry can shadow them): the Meta advertisement key set and the
+    # error-code registry — the other two cross-language surfaces a wire
+    # change can move.  Checked by rules_negotiation / rules_codes.
+    from tools.tpulint.rules_codes import snapshot_codes
+    from tools.tpulint.rules_negotiation import parse_meta_keys
+    lock["__meta_keys__"] = parse_meta_keys(ctx)
+    lock["__codes__"] = snapshot_codes(ctx)
     return lock
 
 
