@@ -1,6 +1,6 @@
 BUILD_DIR := native/build
 
-.PHONY: native test soak asan tsan test-asan test-tsan tsan-test asan-test contract-check lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke spec-smoke train-smoke collectives-smoke clean
+.PHONY: native test soak asan tsan test-asan test-tsan tsan-test asan-test contract-check lint lint-sarif bench-smoke obs-smoke serve-smoke serving-fleet-smoke spec-smoke paged-smoke train-smoke collectives-smoke clean
 
 native:
 	cmake -S native -B $(BUILD_DIR) -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
@@ -68,6 +68,16 @@ serving-fleet-smoke:
 # without the lib.
 spec-smoke:
 	python -m pytest tests/test_spec_decode.py -q
+	$(MAKE) --no-print-directory contract-check
+
+# Fast local gate for the paged KV plane (the spec-smoke analog): the
+# block pool accounting units, paged==monolithic token-parity pins
+# (single/batched/spec-on, across spill and migration), CoW shared-
+# prefix behavior, then lint (incl. the block-account rule). The native
+# halves (armed-watchdog server drives, /fleetz prefix-hit columns,
+# slim-migration byte pins) skip cleanly without the lib.
+paged-smoke:
+	python -m pytest tests/test_paged_kv.py -q
 	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for the overlapped training step (the obs-smoke

@@ -1042,6 +1042,177 @@ def serving_spec_point(spec_k=4, reps=5, drive_s=1.0, fleet=True,
     return row
 
 
+_SERVING_PAGED_CHILD = """
+import json, sys, time
+sys.path.insert(0, {root!r})
+import jax
+from brpc_tpu.runtime import native
+try:
+    from brpc_tpu.observability import health
+    health.start_watchdog({dump_dir!r})
+except Exception:
+    pass
+from brpc_tpu.models.decoder import init_decoder
+from brpc_tpu.serving import (CallableSink, DecodeEngine, ServingClient,
+                              ServingServer, SessionManager,
+                              serving_metrics)
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+REPS = {reps}
+DRIVE_S = {drive_s}
+N_TOK = {n_tok}
+STREAMS = {streams}
+MAX_LEN = 128
+R = 8
+ARENA = 1 << 20  # small on purpose: density = opens until first spill
+
+# 47 tokens = 5 full R=8 blocks (prefix-cacheable) + a 7-row tail that
+# shares its block with the first generated token (the CoW seam).
+SHARED = list(range(1, 48))
+
+def distinct(i):
+    # Unique-per-session FIRST block (two base-63 digit tokens encode i)
+    # so no two "distinct" prompts ever share a prefix block.
+    p = [i % 63 + 1, i // 63 % 63 + 1]
+    return p + [(i * 7 + j) % 63 + 1 for j in range(45)]
+
+def pctl(xs, q):
+    xs = sorted(xs)
+    return xs[max(0, int(len(xs) * q) - 1)] if xs else 0.0
+
+def density(paged, pick, seed_cache):
+    # Admissions until the arena's first spill/shed: every admitted
+    # session holds live KV residency (mono: the full (2, max_len, dim)
+    # plane; paged: its block table). seed_cache runs ONE session
+    # through the engine first so the shared prompt's full blocks are
+    # committed into the prefix cache — later opens hit it at open().
+    mgr = SessionManager(max_len=MAX_LEN, kv_arena_bytes=ARENA,
+                         paged=paged, block_rows=R)
+    if seed_cache:
+        eng = DecodeEngine(mgr, PARAMS, max_batch=1)
+        got = []
+        mgr.open(pick(0), 2, CallableSink(got.append), sid="seed")
+        for _ in range(MAX_LEN):
+            if not eng.step():
+                break
+    n = 0
+    spill0 = serving_metrics()["spill_out"].value()
+    try:
+        while n < 4096:
+            mgr.open(pick(n), 4, CallableSink(lambda _b: None),
+                     sid="d%d" % n)
+            # Admission under pressure pages a COLD session out rather
+            # than shedding: the first page-out (spill_out is a process-
+            # cumulative counter, hence the delta) marks the arena's
+            # resident capacity in both modes.
+            if serving_metrics()["spill_out"].value() > spill0:
+                break
+            n += 1
+    except native.RpcError as e:
+        assert e.code == native.TRPC_ELIMIT, e
+    doc = mgr.sessionz_doc()
+    row = {{"live_sessions": n,
+            "sessions_per_gb": round(n * (1 << 30) / ARENA),
+            "kv_bytes": doc["kv_bytes"]}}
+    if paged:
+        row["blocks_shared"] = doc.get("kv_blocks_shared", 0)
+        row["prefix_hit_pct"] = doc.get("prefix_hit_pct", 0.0)
+    mgr.shutdown()
+    return row
+
+def drive(client, pick, secs):
+    t0 = time.monotonic()
+    tokens = 0
+    i = 0
+    while time.monotonic() - t0 < secs:
+        streams = [client.open(pick(i + k), N_TOK)
+                   for k in range(STREAMS)]
+        i += STREAMS
+        for ts in streams:
+            for _tok in ts:
+                pass
+            tokens += len(ts.tokens)
+            ts.close()
+    return tokens / (time.monotonic() - t0)
+
+row = {{"reps": REPS, "block_rows": R, "density": {{}}}}
+for name, pick, seed in (("shared", lambda i: SHARED, True),
+                         ("distinct", distinct, False)):
+    per = {{}}
+    for mode in ("paged", "mono"):
+        per[mode] = density(mode == "paged", pick, seed)
+    per["density_x"] = round(
+        per["paged"]["live_sessions"]
+        / max(per["mono"]["live_sessions"], 1), 2)
+    row["density"][name] = per
+
+# Throughput A/B: matched concurrency on two live servers (default-size
+# arenas — no paging pressure; this half isolates the gather/CoW cost),
+# interleaved mono/paged drives, median-of-ratios.
+srv_m = ServingServer(PARAMS, max_batch=STREAMS, max_len=MAX_LEN)
+srv_p = ServingServer(PARAMS, max_batch=STREAMS, max_len=MAX_LEN,
+                      paged=True, block_rows=R)
+cm = ServingClient("127.0.0.1:%d" % srv_m.start())
+cp = ServingClient("127.0.0.1:%d" % srv_p.start())
+# Paged is a drop-in: same tokens for the same prompt, pinned in-child.
+assert cm.generate(SHARED, 12) == cp.generate(SHARED, 12)
+for c in (cm, cp):
+    # Absorb the jit compiles (every batch width up to STREAMS) and, on
+    # the paged server, populate the prefix cache outside the timings.
+    for pick in (lambda i: SHARED, distinct):
+        drive(c, pick, 0.4)
+row["throughput"] = {{}}
+for name, pick in (("shared", lambda i: SHARED), ("distinct", distinct)):
+    ratios, mono_tps, paged_tps = [], [], []
+    for _rep in range(REPS):
+        m = drive(cm, pick, DRIVE_S)
+        p = drive(cp, pick, DRIVE_S)
+        ratios.append(p / max(m, 1e-9))
+        mono_tps.append(m)
+        paged_tps.append(p)
+    ratios.sort()
+    row["throughput"][name] = {{
+        "tokens_s_mono": round(pctl(mono_tps, 0.5), 1),
+        "tokens_s_paged": round(pctl(paged_tps, 0.5), 1),
+        "tokens_s_x": round(ratios[len(ratios) // 2], 2),
+        "tokens_s_x_samples": [round(r, 2) for r in ratios],
+    }}
+doc = srv_p.manager.sessionz_doc()
+row["throughput"]["prefix_hit_pct"] = doc.get("prefix_hit_pct", 0.0)
+cm.close()
+cp.close()
+srv_m.stop()
+srv_p.stop()
+print(json.dumps(row))
+"""
+
+
+def serving_paged_point(reps=5, drive_s=1.0, n_tok=16, streams=4,
+                        wedge_log=None):
+    """Paged-KV A/B (ISSUE 18 acceptance row): live-sessions-per-GB at
+    a fixed 1 MiB arena (opens until first spill) and matched-
+    concurrency tokens/s, paged vs monolithic on shared-prompt and
+    distinct-prompt workloads — median-of-ratios over interleaved
+    pairs, one wedge-guarded child."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = _SERVING_PAGED_CHILD.format(root=root, dump_dir=_dump_dir(),
+                                       reps=reps, drive_s=drive_s,
+                                       n_tok=n_tok, streams=streams)
+    timeout = 240 + reps * drive_s * 8
+    row = _run_guarded_child("serving_paged", code, timeout, wedge_log)
+    if not row.get("wedged"):
+        d, t = row["density"], row["throughput"]
+        print(f"# serving_paged: density shared "
+              f"{d['shared']['mono']['live_sessions']} -> "
+              f"{d['shared']['paged']['live_sessions']} live/MiB "
+              f"({d['shared']['density_x']}x), distinct "
+              f"{d['distinct']['density_x']}x; tokens/s shared "
+              f"{t['shared']['tokens_s_x']}x / distinct "
+              f"{t['distinct']['tokens_s_x']}x "
+              f"(prefix hit {t['prefix_hit_pct']}%)", file=sys.stderr)
+    return row
+
+
 def _run_guarded_child(name, code, timeout, wedge_log=None):
     """The serving/overload child-runner shape: one subprocess under a
     hard timeout; a wedge records dump files instead of hanging the
@@ -1246,6 +1417,13 @@ def main() -> None:
         sweep["serving_spec"] = serving_spec_point(wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# serving_spec skipped: {e}", file=sys.stderr)
+    # Paged-KV A/B (ISSUE 18): live-sessions-per-GB at a fixed arena +
+    # matched-concurrency tokens/s, paged vs monolithic, shared and
+    # distinct prompts.
+    try:
+        sweep["serving_paged"] = serving_paged_point(wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# serving_paged skipped: {e}", file=sys.stderr)
     try:
         sweep["serving_fleet_drain"] = serving_drain_point(
             wedge_log=wedges)
@@ -2404,6 +2582,15 @@ def smoke() -> None:
             reps=1, drive_s=0.6, fleet=False, wedge_log=wedges)
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["serving_spec"] = {"error": str(e)}
+    # Guarded paged-KV mini-row: one short density + throughput A/B —
+    # if the block pool, the prefix cache, or the paged gather regresses
+    # admission density or the decode hot path, the smoke run shows it
+    # before the full sweep would.
+    try:
+        out["serving_paged"] = serving_paged_point(
+            reps=1, drive_s=0.5, wedge_log=wedges)
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["serving_paged"] = {"error": str(e)}
     # Guarded serving-fleet mini-row: one 2-member drain-migration drive
     # (2 mid-stream sessions) — if session routing, the KV ship path, or
     # the resume replay breaks token parity, the smoke run shows it

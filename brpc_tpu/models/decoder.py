@@ -25,6 +25,19 @@ Two draft proposers feed it: ``draft_propose`` runs a (usually smaller)
 decoder configuration with its own KV plane through the same windowed
 dispatch, and ``ngram_propose`` is the model-free prompt-lookup fallback
 (propose whatever followed the last n-gram's previous occurrence).
+
+Paged KV (ISSUE 18): the caches may arrive BLOCK-INDEXED instead of as
+dense per-lane planes — a fixed-capacity pool ``(n_blocks, block_rows,
+dim)`` plus per-lane block tables ``(B, max_len // block_rows)``.
+``_attend`` gathers the pool through the tables into the same dense
+(B, L, D) lanes at entry, so decode, verify AND draft ride one body
+change: every downstream line (functional row set, length mask, softmax)
+is byte-for-byte the code the monolithic path runs, which is what keeps
+the paged path's greedy argmax bit-identical to the monolithic one.
+Rows beyond a lane's length gather garbage from whatever blocks the
+padding table entries name; the length mask scores them -1e30 and fp32
+softmax underflows that to an exact 0.0 weight, so they never perturb
+the output.
 """
 
 from __future__ import annotations
@@ -58,7 +71,21 @@ def init_decoder(rng: jax.Array, vocab: int = 64, dim: int = 32,
         wo=jax.random.normal(ks[4], (dim, dim), jnp.float32) * s)
 
 
-def _attend(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
+def _gather_lanes(kv):
+    """The block-indexed gather (ISSUE 18): a ``(pool, tables)`` pair —
+    pool ``(n_blocks, block_rows, dim)``, tables ``(B, T)`` int32 —
+    materializes as the dense ``(B, T * block_rows, dim)`` lanes every
+    line below already consumes; dense lanes pass through untouched.
+    After the first position of a windowed unroll the threaded caches
+    are dense, so the gather happens exactly once per dispatch."""
+    if isinstance(kv, tuple):
+        pool, tables = kv
+        B = tables.shape[0]
+        return pool[tables].reshape(B, -1, pool.shape[-1])
+    return kv
+
+
+def _attend(params: DecoderParams, kv_k, kv_v,
             lengths: jax.Array, tokens: jax.Array):
     """ONE position of greedy decode for every lane — the single home of
     the step math. ``decode_step`` runs it once; ``verify_step`` and
@@ -67,7 +94,11 @@ def _attend(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
     in-window causal discipline: writes happen in position order, and the
     length mask admits exactly the rows written so far). Sharing the body
     is what makes the speculative path's argmax at each position the
-    bit-identical twin of the sequential path's."""
+    bit-identical twin of the sequential path's — and, with the paged
+    gather up front, what makes the block-pool path the bit-identical
+    twin of both."""
+    kv_k = _gather_lanes(kv_k)
+    kv_v = _gather_lanes(kv_v)
     x = params.embed[tokens] + params.pos[lengths]  # (B, D)
     q = x @ params.wq
     k_new = x @ params.wk
@@ -123,6 +154,48 @@ def verify_step(params: DecoderParams, kv_k: jax.Array, kv_v: jax.Array,
     — nothing here ever touches the caller's numpy planes). One compiled
     program per (B, W), the fixed-lane discipline extended to the window
     axis."""
+    outs, ks, vs = [], [], []
+    for j in range(window.shape[1]):
+        nxt, k_new, v_new, kv_k, kv_v = _attend(
+            params, kv_k, kv_v, lengths + j, window[:, j])
+        outs.append(nxt)
+        ks.append(k_new)
+        vs.append(v_new)
+    return (jnp.stack(outs, axis=1), jnp.stack(ks, axis=1),
+            jnp.stack(vs, axis=1))
+
+
+@jax.jit
+def decode_step_paged(params: DecoderParams, pool_k: jax.Array,
+                      pool_v: jax.Array, tables: jax.Array,
+                      lengths: jax.Array, tokens: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`decode_step` over a block pool: ``pool_k``/``pool_v`` are
+    the host's fixed-capacity KV block pools ``(n_blocks, block_rows,
+    dim)`` and ``tables`` ``(B, max_len // block_rows)`` maps each lane's
+    logical rows onto pool blocks. One program per (pool, table) shape —
+    the pool capacity is fixed at manager construction, so admission and
+    retirement never recompile (the fixed-lane discipline extended to
+    the pool axis). Same returns as ``decode_step``; the engine scatters
+    k_new/v_new into the lane's CURRENT tail block (copy-on-write may
+    have swapped the block id since the gather — the host owns that)."""
+    nxt, k_new, v_new, _, _ = _attend(params, (pool_k, tables),
+                                      (pool_v, tables), lengths, tokens)
+    return nxt, k_new, v_new
+
+
+@jax.jit
+def verify_step_paged(params: DecoderParams, pool_k: jax.Array,
+                      pool_v: jax.Array, tables: jax.Array,
+                      lengths: jax.Array, window: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`verify_step` over a block pool: the gather happens in the
+    window's FIRST ``_attend`` position (which returns dense threaded
+    caches for the rest of the unroll), so the speculative path pays one
+    gather per dispatch, not per position. Bit-identical outputs to the
+    dense ``verify_step`` over the same logical rows."""
+    kv_k = (pool_k, tables)
+    kv_v = (pool_v, tables)
     outs, ks, vs = [], [], []
     for j in range(window.shape[1]):
         nxt, k_new, v_new, kv_k, kv_v = _attend(

@@ -298,7 +298,8 @@ def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
            "codec_bytes_wire": 0, "version_lag_max": 0,
            "serving_tokens_s": 0.0, "serving_sessions": 0,
            "serving_ttft_p99_us": 0, "serving_spec_proposed": 0,
-           "serving_spec_accepted": 0}
+           "serving_spec_accepted": 0, "serving_prefix_hits": 0,
+           "serving_prefix_misses": 0}
     for name, value in pairs:
         try:
             if name.startswith("rpc_server_"):
@@ -324,6 +325,10 @@ def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
                 out["serving_spec_proposed"] = int(float(value))
             elif name == "serving_spec_accepted":
                 out["serving_spec_accepted"] = int(float(value))
+            elif name == "serving_prefix_hits":
+                out["serving_prefix_hits"] = int(float(value))
+            elif name == "serving_prefix_misses":
+                out["serving_prefix_misses"] = int(float(value))
         except ValueError:
             continue  # non-numeric var under a matched prefix
     # The accept-rate column: cumulative accepted/proposed (0 when the
@@ -332,6 +337,12 @@ def _fold_series(pairs: Iterable[Tuple[str, str]]) -> dict:
     out["serving_spec_accept_pct"] = (
         round(100.0 * out["serving_spec_accepted"] / prop, 1)
         if prop else 0.0)
+    # Prefix-cache hit rate, same discipline: aggregate hits/lookups
+    # (monolithic members never look up — 0%, not a gap).
+    lookups = out["serving_prefix_hits"] + out["serving_prefix_misses"]
+    out["serving_prefix_hit_pct"] = (
+        round(100.0 * out["serving_prefix_hits"] / lookups, 1)
+        if lookups else 0.0)
     return out
 
 
@@ -397,18 +408,25 @@ def rollup(shards: List[dict]) -> dict:
             "rpcz_off": sorted(s["addr"] for s in shards
                                if s.get("rpcz_enabled") == 0)}
     spec_prop = spec_acc = 0
+    pfx_hits = pfx_misses = 0
     for s in shards:
         worst = max(worst, HEALTH_RANK.get(s.get("health"), 3))
         logical += s.get("codec_bytes_logical", 0)
         wire += s.get("codec_bytes_wire", 0)
         spec_prop += s.get("serving_spec_proposed", 0)
         spec_acc += s.get("serving_spec_accepted", 0)
+        pfx_hits += s.get("serving_prefix_hits", 0)
+        pfx_misses += s.get("serving_prefix_misses", 0)
     roll["health_worst"] = _RANK_NAMES[worst] if shards else "empty"
     roll["codec_ratio"] = (logical / wire) if wire > 0 else 0.0
     # Fleet accept rate = aggregate accepted/proposed, NOT a mean of
     # per-shard percentages (a near-idle shard must not swing it).
     roll["serving_spec_accept_pct"] = (
         round(100.0 * spec_acc / spec_prop, 1) if spec_prop else 0.0)
+    # Fleet prefix-cache hit rate: aggregate hits/lookups, same rule.
+    lookups = pfx_hits + pfx_misses
+    roll["serving_prefix_hit_pct"] = (
+        round(100.0 * pfx_hits / lookups, 1) if lookups else 0.0)
     return roll
 
 
@@ -633,6 +651,8 @@ class FleetObserver:
             f"{roll['serving_ttft_p99_max_us']}",
             f"fleet_serving_spec_accept_pct "
             f"{roll.get('serving_spec_accept_pct', 0.0):.1f}",
+            f"fleet_serving_prefix_hit_pct "
+            f"{roll.get('serving_prefix_hit_pct', 0.0):.1f}",
         ])
 
     def publish_rollup_gauges(self) -> None:
@@ -682,4 +702,6 @@ class FleetObserver:
                               reader("serving_ttft_p99_max_us"))
         obs.repointable_gauge("fleet_serving_spec_accept_pct",
                               reader("serving_spec_accept_pct"))
+        obs.repointable_gauge("fleet_serving_prefix_hit_pct",
+                              reader("serving_prefix_hit_pct"))
         self._gauges_published = True

@@ -56,8 +56,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from brpc_tpu.models.decoder import (DecoderParams, decode_step,
-                                     draft_propose, emit_done, init_decoder,
-                                     ngram_propose, verify_step)
+                                     decode_step_paged, draft_propose,
+                                     emit_done, init_decoder, ngram_propose,
+                                     verify_step, verify_step_paged)
 from brpc_tpu.serving.session import (ACTIVE, DONE, FRAME_TOKEN, FROZEN,
                                       QUEUED, SHED, Session, SessionManager,
                                       serving_metrics)
@@ -324,21 +325,39 @@ class DecodeEngine:
                 B = self.max_batch
                 L = self.manager.max_len
                 D = self.manager.dim
-                kv_k = np.zeros((B, L, D), np.float32)
-                kv_v = np.zeros((B, L, D), np.float32)
+                mgr = self.manager
                 lengths = np.zeros((B,), np.int32)
                 tokens = np.zeros((B,), np.int32)
                 for sess in decodable:
                     i = sess.lane
-                    kv_k[i] = sess.kv_k
-                    kv_v[i] = sess.kv_v
                     lengths[i] = sess.pos
                     tokens[i] = (sess.prompt[sess.pos]
                                  if sess.pos < len(sess.prompt)
                                  else sess.token)
-                nxt, k_new, v_new = decode_step(
-                    self.params, jnp.asarray(kv_k), jnp.asarray(kv_v),
-                    jnp.asarray(lengths), jnp.asarray(tokens))
+                if mgr.paged:
+                    # Block-indexed dispatch: only the blocks this
+                    # batch's tables reference cross into jit (compact
+                    # dedup'd copies remapped to a fixed slot count — one
+                    # compiled program, transfer cost independent of
+                    # arena capacity).
+                    tables = np.zeros((B, L // mgr.block_rows), np.int32)
+                    for sess in decodable:
+                        tables[sess.lane] = mgr.padded_table(sess)
+                    pool_k, pool_v, tables = mgr.dispatch_pool(tables)
+                    nxt, k_new, v_new = decode_step_paged(
+                        self.params, jnp.asarray(pool_k),
+                        jnp.asarray(pool_v), jnp.asarray(tables),
+                        jnp.asarray(lengths), jnp.asarray(tokens))
+                else:
+                    kv_k = np.zeros((B, L, D), np.float32)
+                    kv_v = np.zeros((B, L, D), np.float32)
+                    for sess in decodable:
+                        i = sess.lane
+                        kv_k[i] = sess.kv_k
+                        kv_v[i] = sess.kv_v
+                    nxt, k_new, v_new = decode_step(
+                        self.params, jnp.asarray(kv_k), jnp.asarray(kv_v),
+                        jnp.asarray(lengths), jnp.asarray(tokens))
                 nxt = np.asarray(nxt)
                 k_new = np.asarray(k_new)
                 v_new = np.asarray(v_new)
@@ -355,8 +374,18 @@ class DecodeEngine:
                     if sess.state != ACTIVE:
                         continue  # finished externally mid-step: swept
                     i = sess.lane  # at the next boundary
-                    sess.kv_k[sess.pos] = k_new[i]
-                    sess.kv_v[sess.pos] = v_new[i]
+                    if mgr.paged:
+                        # Table-routed row write (lazy block growth +
+                        # CoW); False = pool truly exhausted — shed THIS
+                        # session, everyone else keeps decoding.
+                        if not mgr.kv_write_row(sess, sess.pos,
+                                                k_new[i], v_new[i]):
+                            self._retire(sess,
+                                         shed_reason="kv blocks exhausted")
+                            continue
+                    else:
+                        sess.kv_k[sess.pos] = k_new[i]
+                        sess.kv_v[sess.pos] = v_new[i]
                     sess.pos += 1
                     sess.last_progress = now
                     if sess.pos < len(sess.prompt):
@@ -535,21 +564,34 @@ class DecodeEngine:
         W = 1 + need
         with trace_span("decode_step"):
             annotate(f"batch={len(decodable)} spec_w={W}")
+            mgr = self.manager
             with stage("draft"):
-                kv_k = np.zeros((B, L, D), np.float32)
-                kv_v = np.zeros((B, L, D), np.float32)
                 lengths = np.zeros((B,), np.int32)
                 for sess in decodable:
-                    i = sess.lane
-                    kv_k[i] = sess.kv_k
-                    kv_v[i] = sess.kv_v
-                    lengths[i] = sess.pos
+                    lengths[sess.lane] = sess.pos
+                if not mgr.paged:
+                    kv_k = np.zeros((B, L, D), np.float32)
+                    kv_v = np.zeros((B, L, D), np.float32)
+                    for sess in decodable:
+                        i = sess.lane
+                        kv_k[i] = sess.kv_k
+                        kv_v[i] = sess.kv_v
                 window, n_known, n_prop, d_ingested, seqs = \
                     self._fill_windows(decodable, W)
             with stage("verify"):
-                y, k_rows, v_rows = verify_step(
-                    self.params, jnp.asarray(kv_k), jnp.asarray(kv_v),
-                    jnp.asarray(lengths), jnp.asarray(window))
+                if mgr.paged:
+                    tables = np.zeros((B, L // mgr.block_rows), np.int32)
+                    for sess in decodable:
+                        tables[sess.lane] = mgr.padded_table(sess)
+                    pool_k, pool_v, tables = mgr.dispatch_pool(tables)
+                    y, k_rows, v_rows = verify_step_paged(
+                        self.params, jnp.asarray(pool_k),
+                        jnp.asarray(pool_v), jnp.asarray(tables),
+                        jnp.asarray(lengths), jnp.asarray(window))
+                else:
+                    y, k_rows, v_rows = verify_step(
+                        self.params, jnp.asarray(kv_k), jnp.asarray(kv_v),
+                        jnp.asarray(lengths), jnp.asarray(window))
                 y = np.asarray(y)
                 k_rows = np.asarray(k_rows)
                 v_rows = np.asarray(v_rows)
@@ -576,8 +618,15 @@ class DecodeEngine:
                             if int(window[i, j]) != int(y[i, j - 1]):
                                 break  # draft != target argmax: rewind
                         r = sess.pos + j
-                        sess.kv_k[r] = k_rows[i, j]
-                        sess.kv_v[r] = v_rows[i, j]
+                        if mgr.paged:
+                            if not mgr.kv_write_row(sess, r, k_rows[i, j],
+                                                    v_rows[i, j]):
+                                sess.shed_reason = "kv blocks exhausted"
+                                shed = True
+                                break  # rows before j stay committed
+                        else:
+                            sess.kv_k[r] = k_rows[i, j]
+                            sess.kv_v[r] = v_rows[i, j]
                         ncommit = j + 1
                         if r < len(sess.prompt) - 1:
                             continue  # pure prefill row: nothing to emit

@@ -241,6 +241,15 @@ class FleetServingServer(ServingServer):
     # ---- MigrateService (the receiving half) ----
 
     def _migrate_handle(self, method: str, request: bytes, att):
+        if method == "Probe":
+            # Migration pre-flight (paged KV): the source sends the
+            # manifest's block digests; we answer the slot indices OUR
+            # prefix cache misses — the source then ships only those.
+            doc = json.loads(request.decode() or "{}")
+            need = self.manager.probe_prefix(
+                list(doc.get("blocks", [])),
+                int(doc.get("block_rows", 0)))
+            return json.dumps({"need": need}).encode(), None
         if method != "Install":
             raise native.RpcError(E_NO_SUCH,
                                   f"no such method: MigrateService/{method}")
@@ -278,6 +287,42 @@ class FleetServingServer(ServingServer):
                     E_NO_SUCH, "oneside window unmappable (off-host?)")
             with self._chan_mu:
                 self._readers[key] = reader
+        if manifest.get("blocks") is not None:
+            # Paged source: per-block slots "kv:<sid>:k:<j>" (version =
+            # rows filled in block j). Digest-bearing slots short-circuit
+            # through OUR prefix cache — a shared-prefix migration reads
+            # almost nothing off the source.
+            r = int(manifest["block_rows"])
+            k = np.zeros((pos, dim), np.float32)
+            v = np.zeros((pos, dim), np.float32)
+            for j, d in enumerate(manifest["blocks"]):
+                lo, hi = j * r, min(pos, j * r + r)
+                if d is not None:
+                    local = self.manager.prefix_rows(d)
+                    if local is not None:
+                        k[lo:hi] = local[0][:hi - lo]
+                        v[lo:hi] = local[1][:hi - lo]
+                        continue
+                try:
+                    vk, kb = reader.read_np(f"kv:{sid}:k:{j}")
+                    vv, vb = reader.read_np(f"kv:{sid}:v:{j}")
+                except OnesideGone:
+                    with self._chan_mu:
+                        self._readers.pop(key, None)
+                    reader.close()
+                    raise native.RpcError(E_NO_SUCH, "oneside window gone")
+                except OnesideMiss as e:
+                    raise native.RpcError(E_NO_SUCH, f"oneside miss: {e}")
+                want = hi - lo
+                if vk != want or vv != want:
+                    raise native.RpcError(
+                        E_NO_SUCH, f"oneside block {j} version skew: "
+                                   f"k={vk} v={vv} want={want}")
+                k[lo:hi] = np.array(
+                    kb.view(np.float32).reshape(-1, dim)[:want])
+                v[lo:hi] = np.array(
+                    vb.view(np.float32).reshape(-1, dim)[:want])
+            return np.stack([k, v])
         try:
             vk, k_plane = reader.read_np(f"kv:{sid}:k")
             vv, v_plane = reader.read_np(f"kv:{sid}:v")
@@ -356,6 +401,59 @@ class FleetServingServer(ServingServer):
                 return False  # any one-sided miss: ship the bytes
             raise
 
+    def _slim_ship(self, dest: str, manifest: dict, kv: np.ndarray):
+        """Minimal-move bytes ship (paged KV): probe the destination's
+        prefix cache with the manifest's block digests and keep only the
+        rows it misses (``kv_blocks`` names the slots shipped). Any probe
+        failure — mono peer, old peer, dead link — falls back to the full
+        payload. Accounts every shipped KV byte in
+        ``serving_migrated_kv_bytes`` (both modes: the A/B counter)."""
+        blocks = manifest.get("blocks")
+        if not blocks or not any(d is not None for d in blocks):
+            self._m["migrated_kv_bytes"].add(int(kv.nbytes))
+            return manifest, kv
+        try:
+            reply, _ = self._chan(dest).call(
+                "MigrateService/Probe",
+                request=json.dumps(
+                    {"blocks": blocks,
+                     "block_rows": manifest.get("block_rows", 0)}).encode())
+            need = sorted(int(j) for j in json.loads(reply.decode())["need"])
+        except (native.RpcError, RuntimeError, OSError,
+                ValueError, KeyError):
+            self._m["migrated_kv_bytes"].add(int(kv.nbytes))
+            return manifest, kv
+        if len(need) >= len(blocks):
+            self._m["migrated_kv_bytes"].add(int(kv.nbytes))
+            return manifest, kv
+        r = int(manifest["block_rows"])
+        pos = int(manifest["pos"])
+        if need:
+            slim = np.concatenate(
+                [kv[:, j * r:min(pos, j * r + r), :] for j in need], axis=1)
+        else:
+            slim = kv[:, :0, :]
+        slim = np.ascontiguousarray(slim)
+        self._m["migrated_kv_bytes"].add(int(slim.nbytes))
+        return dict(manifest, kv_blocks=need), slim
+
+    def _ship_bytes(self, dest: str, manifest: dict, kv: np.ndarray) -> None:
+        """Bytes-path Install with the missed-blocks-only optimization;
+        a destination whose cache raced an eviction between Probe and
+        Install answers E_NO_SUCH — retry once with the full payload."""
+        slim_m, slim_kv = self._slim_ship(dest, manifest, kv)
+        try:
+            self._chan(dest).push_device(
+                "MigrateService/Install", slim_kv,
+                request=json.dumps(slim_m).encode())
+        except native.RpcError as e:
+            if slim_m is manifest or e.code != E_NO_SUCH:
+                raise
+            self._m["migrated_kv_bytes"].add(int(kv.nbytes))
+            self._chan(dest).push_device(
+                "MigrateService/Install", kv,
+                request=json.dumps(manifest).encode())
+
     def migrate_session(self, sess, dest: str) -> bool:
         """Freeze/ship/retire ONE session to ``dest``; False (and the
         session resumes locally) when the ship fails."""
@@ -367,9 +465,7 @@ class FleetServingServer(ServingServer):
             manifest, kv = self.manager.export_session(sess)
             with self._ship_qos(sess):
                 if sess.paged or not self._install_oneside(manifest, dest):
-                    self._chan(dest).push_device(
-                        "MigrateService/Install", kv,
-                        request=json.dumps(manifest).encode())
+                    self._ship_bytes(dest, manifest, kv)
         except (native.RpcError, RuntimeError, OSError):
             self._resume_local(sess)
             return False
@@ -421,9 +517,7 @@ class FleetServingServer(ServingServer):
                 with self._ship_qos(sess):
                     if sess.paged or not self._install_oneside(manifest,
                                                                dest):
-                        self._chan(dest).push_device(
-                            "MigrateService/Install", kv,
-                            request=json.dumps(manifest).encode())
+                        self._ship_bytes(dest, manifest, kv)
             except native.RpcError as e:
                 if e.overloaded:
                     self._pacer.note(e)
@@ -514,8 +608,14 @@ class FleetServingServer(ServingServer):
                             retired_or_failed.add(sess.id)
                             moved += 1
                             continue
-                        win.submit("MigrateService/Install", array=kv,
-                                   request=json.dumps(manifest).encode(),
+                        # Pipelined drain rides the same missed-blocks
+                        # discipline; a Probe/Install cache race here
+                        # surfaces as a failed submit and the session
+                        # resumes locally (the sweep below).
+                        slim_m, slim_kv = self._slim_ship(dest, manifest,
+                                                          kv)
+                        win.submit("MigrateService/Install", array=slim_kv,
+                                   request=json.dumps(slim_m).encode(),
                                    tag=sess)
         except (native.RpcError, RuntimeError, OSError):
             pass  # fall through: un-retired sessions resume locally

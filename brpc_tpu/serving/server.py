@@ -56,12 +56,14 @@ class ServingServer:
                  kv_arena_bytes: int = 8 << 20,
                  publish_kv: bool = False, spec_k: int = 0,
                  draft: str = "ngram",
-                 draft_params: Optional[DecoderParams] = None):
+                 draft_params: Optional[DecoderParams] = None,
+                 paged: bool = False, block_rows: int = 8):
         self.manager = SessionManager(
             max_len=max_len, dim=dim, ttl_s=ttl_s,
             tenant_max_sessions=tenant_max_sessions,
             stall_timeout_s=stall_timeout_s,
-            kv_arena_bytes=kv_arena_bytes, publish_kv=publish_kv)
+            kv_arena_bytes=kv_arena_bytes, publish_kv=publish_kv,
+            paged=paged, block_rows=block_rows)
         self.engine = DecodeEngine(self.manager, params,
                                    max_batch=max_batch, eos_id=eos_id,
                                    spec_k=spec_k, draft=draft,

@@ -19,14 +19,37 @@ Slow-reader isolation: the engine only ever try-writes (timeout 0). A
 stalled reader's tokens queue in the session's bounded pending buffer;
 when the buffer overflows or stalls past `stall_timeout_s`, THAT session
 is shed — no other session's emission ever waits on it.
+
+Paged KV (ISSUE 18, ``paged=True``): the monolithic per-session
+(2, max_len, dim) planes are replaced by a fixed-capacity KV BLOCK POOL
+(block = ``block_rows`` rows of both planes, carved from the same arena)
+plus a per-session block table. Admission keys on FREE BLOCKS, not the
+``len(prompt)+max_tokens`` worst case; a session's table grows lazily as
+decode advances. On top of the pool sits a COPY-ON-WRITE shared-prefix
+cache: every full block of committed PROMPT tokens is keyed by a rolling
+content digest, an open whose prompt prefix matches cached blocks simply
+references them (refcounted — a popular system prompt costs one block
+set per host, and the opener skips recomputing those prefill rows), and
+a write into a block with other referents faults a private copy first.
+Everything that was plane-granular goes block-granular: TTL/pressure
+eviction reclaims cold zero-ref cached blocks, host spill/fault-in moves
+block sets (``serving_kv_spill_*`` count blocks), one-sided publication
+exposes per-block slots ``kv:<sid>:k:<j>`` under the same
+seqlock/version discipline, and migration manifests carry the block
+digests so a destination requests only the blocks its own cache misses.
+Block-table and refcount writes happen ONLY under ``_mu`` (the
+``block-account`` lint rule pins this — a CoW fault racing a release is
+the double-free shape).
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -207,6 +230,14 @@ def serving_metrics():
                 "spec_proposed": obs.counter("serving_spec_proposed"),
                 "spec_accepted": obs.counter("serving_spec_accepted"),
                 "spec_steps": obs.counter("serving_spec_steps"),
+                # Paged KV (ISSUE 18): shared-prefix cache hit/miss per
+                # PROMPT BLOCK looked up at open/import (aggregate
+                # hits/lookups is the fleet fold's hit-rate column), and
+                # KV bytes actually shipped by migrations (the
+                # missed-blocks-only discipline's acceptance counter).
+                "prefix_hits": obs.counter("serving_prefix_hits"),
+                "prefix_misses": obs.counter("serving_prefix_misses"),
+                "migrated_kv_bytes": obs.counter("serving_migrated_kv_bytes"),
             }
             # serving_sessions / serving_kv_bytes / serving_kv_spilled_
             # bytes gauges are registered (and re-pointed per manager) by
@@ -219,7 +250,9 @@ def serving_metrics():
                                         "migrated_out", "migrated_in",
                                         "spill_out", "spill_in",
                                         "spec_accept", "spec_proposed",
-                                        "spec_accepted", "spec_steps")}
+                                        "spec_accepted", "spec_steps",
+                                        "prefix_hits", "prefix_misses",
+                                        "migrated_kv_bytes")}
     return _metrics_cache
 
 
@@ -268,6 +301,15 @@ class Session:
         # KV paging: True while the planes live in the host spill store
         # (kv_k/kv_v are None, kv_off invalid) — faulted back on admit.
         self.paged = False
+        # Paged-KV mode (manager.paged): the session's logical rows map
+        # onto pool blocks through this table; kv_k/kv_v stay None and
+        # kv_nbytes tracks len(block_table) * block bytes. The rolling
+        # content digest per FULL block of prompt tokens is precomputed
+        # at open (the prefix-cache key; also the migration manifest's
+        # block identity). Table writes happen under the manager's _mu
+        # only (the block-account lint rule).
+        self.block_table: List[int] = []
+        self.prompt_digests: List[str] = []
         # Speculative decoding (engine-adapted, EPHEMERAL: never
         # exported — an imported session restarts from the optimistic
         # default): spec_k == 0 means "engine default" until the first
@@ -303,7 +345,8 @@ class SessionManager:
                  tenant_max_sessions: int = 0,
                  stall_timeout_s: float = 2.0,
                  max_pending_bytes: int = 32 << 10,
-                 publish_kv: bool = False):
+                 publish_kv: bool = False,
+                 paged: bool = False, block_rows: int = 8):
         self.max_len = max_len
         self.dim = dim
         self.ttl_s = ttl_s
@@ -322,12 +365,72 @@ class SessionManager:
         # the session keeps its range) under "kv:<sid>:k"/":v" with
         # version = rows filled, seqlock-write-locked across each decode
         # step, so a migration/prefill reader in another process can pull
-        # a session's cache without a serving RPC.
+        # a session's cache without a serving RPC. Paged mode publishes
+        # per-BLOCK slots "kv:<sid>:k:<j>" instead (version = rows
+        # filled in block j) under the same discipline.
         self.oneside = None
         if publish_kv and self._native:
             from brpc_tpu.runtime.tensor import OnesideWindow
 
             self.oneside = OnesideWindow(self.arena)
+        # ---- paged-KV block pool (ISSUE 18) ----
+        self.paged = bool(paged)
+        self.block_rows = 0
+        self._pool_cap = 0
+        if self.paged:
+            # block_rows must divide max_len (the table axis is
+            # max_len // block_rows); shrink to the largest divisor so
+            # odd windows still work.
+            r = max(1, min(int(block_rows), max_len))
+            while max_len % r:
+                r -= 1
+            self.block_rows = r
+            self._blk_plane = r * dim * 4       # one plane's bytes/block
+            self._block_nbytes = 2 * self._blk_plane
+            # Carve BOTH pool planes as two contiguous arena ranges (the
+            # oneside directory above already took its slice): largest
+            # capacity that fits, probed downward — the capacity is
+            # fixed for the manager's lifetime, which is what keeps the
+            # paged decode dispatch one compiled program.
+            cap = max(1, kv_arena_bytes // self._block_nbytes)
+            while cap > 0:
+                try:
+                    self._pool_k_off = self.arena.alloc(
+                        cap * self._blk_plane)
+                except MemoryError:
+                    cap -= max(1, cap // 16)
+                    continue
+                try:
+                    self._pool_v_off = self.arena.alloc(
+                        cap * self._blk_plane)
+                    break
+                except MemoryError:
+                    self.arena.free(self._pool_k_off)
+                    cap -= max(1, cap // 16)
+            if cap <= 0:
+                raise MemoryError(
+                    f"kv_arena_bytes {kv_arena_bytes} too small for one "
+                    f"{self._block_nbytes}-byte KV block")
+            self._pool_cap = cap
+            self._pool_k = self.arena.view(
+                self._pool_k_off, cap * self._blk_plane).view(
+                np.float32).reshape(cap, r, dim)
+            self._pool_v = self.arena.view(
+                self._pool_v_off, cap * self._blk_plane).view(
+                np.float32).reshape(cap, r, dim)
+            self._free_blocks: List[int] = list(range(cap - 1, -1, -1))
+            self._block_refs = [0] * cap
+            self._block_digest: List[Optional[str]] = [None] * cap
+            self._block_fill = [0] * cap
+            # digest -> block id; insertion order approximates LRU for
+            # the zero-ref reclaim walk. Entries may be live-shared
+            # (refs >= 1) or warm (refs == 0, reclaimable under
+            # pressure / TTL).
+            self._prefix_cache: "OrderedDict[str, int]" = OrderedDict()
+            self._cache_touched: Dict[int, float] = {}
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._cow_faults = 0
         self._mu = threading.Lock()
         self._sessions: Dict[str, Session] = {}
         self._ids = itertools.count(1)
@@ -355,6 +458,12 @@ class SessionManager:
                                   lambda: self._kv_bytes)
             obs.repointable_gauge("serving_kv_spilled_bytes",
                                   lambda: self._spilled_bytes)
+            # Paged-pool occupancy (0 in monolithic mode — registered
+            # unconditionally so re-pointing stays last-manager-wins).
+            obs.repointable_gauge("serving_kv_blocks_free",
+                                  self._blocks_free)
+            obs.repointable_gauge("serving_kv_blocks_shared",
+                                  self._blocks_shared)
             # Keep ONE stable bound-method object: the guarded clear at
             # shutdown compares identity against the registered provider.
             self._sessionz_fn = self.sessionz_json
@@ -409,6 +518,19 @@ class SessionManager:
                         native.TRPC_ELIMIT,
                         f"tenant {tenant or '(none)'} over session quota "
                         f"{self.tenant_max_sessions} (retry_after_ms=50)")
+            if self.paged:
+                if sid is None:
+                    sid = f"s{next(self._ids)}"
+                sess = Session(sid, prompt, max_tokens, tenant, priority,
+                               deadline_s, sink, -1, 0, None, None)
+                sess.prefill_handoff = prefill_handoff
+                # Admission keys on FREE BLOCKS (prompt + first generated
+                # row), not len(prompt)+max_tokens worst case — raises
+                # ELIMIT itself on true pool exhaustion.
+                self._admit_paged_locked(sess)
+                self._sessions[sid] = sess
+                self.publish_kv(sess)
+                return sess
             off = self._alloc_kv_locked(2 * per_plane)
             if off is None:
                 self._shed_total += 1
@@ -486,8 +608,14 @@ class SessionManager:
         """Explicitly page one cold session out (the pressure path does
         this automatically); False when it isn't pageable right now."""
         with self._mu:
-            if (sess.state != QUEUED or sess.lane >= 0 or sess.paged
-                    or sess.kv_k is None):
+            if sess.state != QUEUED or sess.lane >= 0 or sess.paged:
+                return False
+            if self.paged:
+                if not sess.block_table:
+                    return False
+                self._page_out_paged_locked(sess)
+                return True
+            if sess.kv_k is None:
                 return False
             self._page_out_locked(sess)
             return True
@@ -496,6 +624,8 @@ class SessionManager:
         """Bring a paged session's KV back into the arena (the admission
         path calls this before activating it); False when the arena stays
         exhausted even after paging colder sessions out."""
+        if self.paged:
+            return self._fault_in_paged(sess)
         per_plane = self.max_len * self.dim * 4
         with self._mu:
             if not sess.paged:
@@ -519,6 +649,351 @@ class SessionManager:
             self._m["spill_in"].add(1)
             self.publish_kv(sess)
             return True
+
+    # ---- paged-KV block pool + shared-prefix cache (ISSUE 18) ----
+    #
+    # Invariant: _free_blocks / _block_refs / _block_digest /
+    # _prefix_cache and every Session.block_table write happen under _mu
+    # (the block-account lint rule). A block is accounted in _kv_bytes
+    # exactly while it is OFF the free list — warm cached blocks
+    # (refs == 0, digest set) still hold memory and stay counted.
+
+    def _prefix_digests(self, prompt: List[int]) -> List[str]:
+        """Rolling content digest per FULL block of prompt tokens: block
+        j's digest commits to tokens [0, (j+1)*block_rows) — equal
+        digests mean equal committed-prefix content, and (the decoder
+        being deterministic) bit-equal KV rows."""
+        r = self.block_rows
+        out: List[str] = []
+        prev = ""
+        for j in range(len(prompt) // r):
+            blk = ",".join(str(int(t)) for t in prompt[j * r:(j + 1) * r])
+            prev = hashlib.sha1(
+                f"{prev}|{blk}".encode()).hexdigest()[:16]
+            out.append(prev)
+        return out
+
+    def _blocks_free(self) -> int:
+        """Free-list blocks plus warm cached ones (reclaimable on
+        demand) — the admission headroom gauge."""
+        if not self.paged:
+            return 0
+        with self._mu:
+            return len(self._free_blocks) + sum(
+                1 for bid in self._prefix_cache.values()
+                if self._block_refs[bid] == 0)
+
+    def _blocks_shared(self) -> int:
+        if not self.paged:
+            return 0
+        with self._mu:
+            return sum(1 for n in self._block_refs if n >= 2)
+
+    def _alloc_block_locked(self) -> Optional[int]:
+        """One block off the free list; under pressure reclaims warm
+        cached blocks (oldest cache entry first), then pages a cold
+        session's block set out to the host spill store. None only when
+        nothing is reclaimable. Caller holds _mu."""
+        while True:
+            if self._free_blocks:
+                bid = self._free_blocks.pop()
+                self._block_refs[bid] = 1
+                self._block_digest[bid] = None
+                self._block_fill[bid] = 0
+                self._kv_bytes += self._block_nbytes
+                return bid
+            stale = next((d for d, b in self._prefix_cache.items()
+                          if self._block_refs[b] == 0), None)
+            if stale is not None:
+                self._cache_drop_locked(stale)
+                continue
+            cold = [s for s in self._sessions.values()
+                    if s.state == QUEUED and s.lane < 0
+                    and not s.paged and s.block_table]
+            if not cold:
+                return None
+            cold.sort(key=lambda s: s.last_progress)
+            self._page_out_paged_locked(cold[0])
+
+    def _incref_block_locked(self, bid: int) -> None:
+        self._block_refs[bid] += 1
+        d = self._block_digest[bid]
+        if d is not None:
+            self._prefix_cache.move_to_end(d)
+            self._cache_touched[d] = time.monotonic()
+
+    def _decref_block_locked(self, bid: int) -> None:
+        self._block_refs[bid] -= 1
+        if self._block_refs[bid] <= 0 and self._block_digest[bid] is None:
+            # Zero-ref CACHED blocks stay resident (warm prefix cache,
+            # reclaimed under pressure or by the TTL sweep).
+            self._block_refs[bid] = 0
+            self._free_blocks.append(bid)
+            self._kv_bytes -= self._block_nbytes
+
+    def _cache_insert_locked(self, sess: Session, j: int) -> None:
+        bid = sess.block_table[j]
+        if self._block_digest[bid] is not None:
+            return
+        d = sess.prompt_digests[j]
+        if d in self._prefix_cache:
+            return  # identical content already cached under another block
+        self._prefix_cache[d] = bid
+        self._block_digest[bid] = d
+        self._cache_touched[d] = time.monotonic()
+
+    def _cache_drop_locked(self, d: str) -> int:
+        bid = self._prefix_cache.pop(d)
+        self._block_digest[bid] = None
+        self._cache_touched.pop(d, None)
+        if self._block_refs[bid] == 0:
+            self._free_blocks.append(bid)
+            self._kv_bytes -= self._block_nbytes
+        return bid
+
+    def _admit_paged_locked(self, sess: Session) -> None:
+        """Build a new session's block table: reference cached full
+        prompt blocks (the shared-prefix hit — those prefill rows are
+        skipped outright via sess.pos), allocate private blocks for the
+        rest of the prompt + the first generated row. Later rows
+        allocate lazily in kv_write_row. Raises ELIMIT on exhaustion."""
+        r = self.block_rows
+        prompt = sess.prompt
+        digests = self._prefix_digests(prompt)
+        sess.prompt_digests = digests
+        nhit = 0
+        for d in digests:
+            bid = self._prefix_cache.get(d)
+            if bid is None or self._block_fill[bid] != r:
+                break
+            nhit += 1
+        self._prefix_hits += nhit
+        self._prefix_misses += len(digests) - nhit
+        self._m["prefix_hits"].add(nhit)
+        self._m["prefix_misses"].add(len(digests) - nhit)
+        need_total = -(-(len(prompt) + 1) // r)
+        table: List[int] = []
+        for j in range(nhit):
+            bid = self._prefix_cache[digests[j]]
+            self._incref_block_locked(bid)
+            table.append(bid)
+        for j in range(nhit, need_total):
+            bid = self._alloc_block_locked()
+            if bid is None:
+                for b in table:
+                    self._decref_block_locked(b)
+                self._shed_total += 1
+                self._m["shed"].add(1)
+                raise native.RpcError(
+                    native.TRPC_ELIMIT,
+                    "KV blocks exhausted (retry_after_ms=100)")
+            self._pool_k[bid, :] = 0.0
+            self._pool_v[bid, :] = 0.0
+            table.append(bid)
+        sess.block_table = table
+        sess.kv_nbytes = len(table) * self._block_nbytes
+        # Prefill skip: rows [0, nhit*r) are bit-identical to the cached
+        # blocks' contents (same tokens, same params, deterministic
+        # decoder) — decode resumes there. NEVER past len(prompt)-1: the
+        # final prompt row's ingestion computes the first token, so a
+        # fully block-aligned prompt re-ingests its last row — which
+        # lands IN a shared block and CoW-faults a private copy.
+        sess.pos = min(nhit * r, len(prompt) - 1)
+
+    def _ensure_writable_locked(self, sess: Session, j: int) -> bool:
+        """Make block-table slot ``j`` privately writable: grow the
+        table with fresh zeroed blocks, and copy-on-write when the slot's
+        block is shared (refs > 1) OR cached (a write would invalidate
+        the digest under readers — the copy leaves the cached original
+        warm). False on pool exhaustion."""
+        bt = sess.block_table
+        while len(bt) <= j:
+            bid = self._alloc_block_locked()
+            if bid is None:
+                return False
+            self._pool_k[bid, :] = 0.0
+            self._pool_v[bid, :] = 0.0
+            bt.append(bid)
+            sess.kv_nbytes = len(bt) * self._block_nbytes
+        bid = bt[j]
+        if self._block_refs[bid] > 1 or self._block_digest[bid] is not None:
+            nb = self._alloc_block_locked()
+            if nb is None:
+                return False
+            self._pool_k[nb, :] = self._pool_k[bid]
+            self._pool_v[nb, :] = self._pool_v[bid]
+            self._block_fill[nb] = self._block_fill[bid]
+            self._decref_block_locked(bid)
+            bt[j] = nb
+            self._cow_faults += 1
+        return True
+
+    def kv_write_row(self, sess: Session, row: int, k_row, v_row) -> bool:
+        """Engine-thread row write through the block table (the paged
+        twin of ``sess.kv_k[row] = k_row``). False = pool exhausted (the
+        engine sheds the session at the step boundary). Completing a
+        full PROMPT block inserts it into the shared-prefix cache."""
+        r = self.block_rows
+        j, o = divmod(row, r)
+        bt = sess.block_table
+        # Unlocked fast path: a private (refs == 1, uncached) block can
+        # only be re-shared through the prefix cache, and only THIS
+        # engine thread inserts this session's blocks there — no open()
+        # can incref it concurrently.
+        if (j >= len(bt) or self._block_refs[bt[j]] > 1
+                or self._block_digest[bt[j]] is not None):
+            with self._mu:
+                if not self._ensure_writable_locked(sess, j):
+                    return False
+        bid = bt[j]
+        self._pool_k[bid, o] = k_row
+        self._pool_v[bid, o] = v_row
+        if o + 1 > self._block_fill[bid]:
+            self._block_fill[bid] = o + 1
+        if o + 1 == r and j < len(sess.prompt_digests):
+            with self._mu:
+                self._cache_insert_locked(sess, j)
+        return True
+
+    def pool_arrays(self):
+        """Detached (capacity, block_rows, dim) fp32 copies of both pool
+        planes for the jit dispatch — never hand an arena view to
+        jnp/device_put (the arena-alias rule)."""
+        return np.array(self._pool_k), np.array(self._pool_v)
+
+    def dispatch_pool(self, tables: np.ndarray):
+        """Compact per-step dispatch copies: only the blocks ``tables``
+        references (dedup'd, remapped, padded to the fixed
+        batch*table-width slot count so ONE program stays compiled) —
+        the step's device transfer tracks the batch's KV, not the
+        arena's capacity, which is the whole point of paging. Advanced
+        indexing detaches the copies (the arena-alias rule); no lock:
+        blocks referenced by in-flight lanes cannot be freed mid-step,
+        and the engine thread is the only row writer."""
+        uniq, inv = np.unique(tables, return_inverse=True)
+        slots = tables.size
+        sub_k = np.zeros((slots, self.block_rows, self.dim), np.float32)
+        sub_v = np.zeros_like(sub_k)
+        sub_k[:len(uniq)] = self._pool_k[uniq]
+        sub_v[:len(uniq)] = self._pool_v[uniq]
+        return sub_k, sub_v, inv.reshape(tables.shape).astype(np.int32)
+
+    def padded_table(self, sess: Session) -> List[int]:
+        """Block table padded to the fixed width max_len//block_rows
+        (keeps the compiled dispatch shape-stable). Padding entries
+        gather garbage rows that the attention mask scores -1e30 —
+        exact-zero weight under fp32 softmax, bit-parity preserved."""
+        width = self.max_len // self.block_rows
+        return sess.block_table + [0] * (width - len(sess.block_table))
+
+    def _gather_rows_locked(self, sess: Session):
+        """Detached (pos, dim) fp32 row copies assembled from the block
+        table (spill/export source)."""
+        r = self.block_rows
+        k = np.zeros((sess.pos, self.dim), np.float32)
+        v = np.zeros_like(k)
+        for j, bid in enumerate(sess.block_table):
+            lo = j * r
+            if lo >= sess.pos:
+                break
+            hi = min(sess.pos, lo + r)
+            k[lo:hi] = self._pool_k[bid, :hi - lo]
+            v[lo:hi] = self._pool_v[bid, :hi - lo]
+        return k, v
+
+    def _page_out_paged_locked(self, sess: Session) -> None:
+        """Block-granular spill: gather filled rows to the host store,
+        decref every table block (shared ones just drop a referent —
+        their bytes stay for the other sessions)."""
+        if self.oneside is not None:
+            for j in range(len(sess.block_table)):
+                self.oneside.unpublish(f"kv:{sess.id}:k:{j}")
+                self.oneside.unpublish(f"kv:{sess.id}:v:{j}")
+        k_rows, v_rows = self._gather_rows_locked(sess)
+        self._spill[sess.id] = (k_rows, v_rows)
+        self._spilled_bytes += k_rows.nbytes + v_rows.nbytes
+        nblocks = len(sess.block_table)
+        for bid in sess.block_table:
+            self._decref_block_locked(bid)
+        sess.block_table = []
+        sess.kv_nbytes = 0
+        sess.paged = True
+        self._m["spill_out"].add(nblocks)
+
+    def _fault_in_paged(self, sess: Session) -> bool:
+        """Rebuild a spilled session's block table: full prompt blocks
+        below pos re-reference the prefix cache when still resident
+        (bit-identical by digest), everything else gets a private block
+        restored from the spill rows. All-or-nothing."""
+        r = self.block_rows
+        with self._mu:
+            if not sess.paged:
+                return True
+            k_rows, v_rows = self._spill[sess.id]
+            need = -(-sess.pos // r) if sess.pos else 0
+            table: List[int] = []
+            ok = True
+            for j in range(need):
+                d = (sess.prompt_digests[j]
+                     if (j < len(sess.prompt_digests)
+                         and (j + 1) * r <= sess.pos) else None)
+                bid = self._prefix_cache.get(d) if d is not None else None
+                if bid is not None and self._block_fill[bid] == r:
+                    self._incref_block_locked(bid)
+                    table.append(bid)
+                    continue
+                bid = self._alloc_block_locked()
+                if bid is None:
+                    ok = False
+                    break
+                lo = j * r
+                hi = min(sess.pos, lo + r)
+                self._pool_k[bid, :] = 0.0
+                self._pool_v[bid, :] = 0.0
+                self._pool_k[bid, :hi - lo] = k_rows[lo:hi]
+                self._pool_v[bid, :hi - lo] = v_rows[lo:hi]
+                self._block_fill[bid] = hi - lo
+                table.append(bid)
+            if not ok:
+                for bid in table:
+                    self._decref_block_locked(bid)
+                return False
+            self._spill.pop(sess.id)
+            self._spilled_bytes -= k_rows.nbytes + v_rows.nbytes
+            sess.block_table = table
+            sess.kv_nbytes = len(table) * self._block_nbytes
+            sess.paged = False
+            self._m["spill_in"].add(len(table))
+            self.publish_kv(sess)
+            return True
+
+    def probe_prefix(self, blocks: List[Optional[str]],
+                     block_rows: int = 0) -> List[int]:
+        """Migration pre-flight: which of the manifest's block slots
+        does THIS manager need shipped? Digest-bearing slots resolve
+        against the prefix cache; digest-less slots (partial / generated
+        rows) always ship. Mismatched block geometry needs everything."""
+        if (not self.paged
+                or (block_rows and block_rows != self.block_rows)):
+            return list(range(len(blocks)))
+        need: List[int] = []
+        with self._mu:
+            for j, d in enumerate(blocks):
+                bid = self._prefix_cache.get(d) if d is not None else None
+                if bid is None or self._block_fill[bid] != self.block_rows:
+                    need.append(j)
+        return need
+
+    def prefix_rows(self, digest: str):
+        """Detached (block_rows, dim) k/v copies of a cached full block,
+        or None — the oneside fault-in path's local-cache short-circuit."""
+        if not self.paged:
+            return None
+        with self._mu:
+            bid = self._prefix_cache.get(digest)
+            if bid is None or self._block_fill[bid] != self.block_rows:
+                return None
+            return np.array(self._pool_k[bid]), np.array(self._pool_v[bid])
 
     def get(self, sid: str) -> Optional[Session]:
         with self._mu:
@@ -584,11 +1059,23 @@ class SessionManager:
 
     def _release_kv_locked(self, sess: Session) -> None:
         if sess.paged:
-            # The planes live in the spill store, not the arena.
+            # The rows live in the spill store, not the arena/pool.
             rows = self._spill.pop(sess.id, None)
             if rows is not None:
                 self._spilled_bytes -= rows[0].nbytes + rows[1].nbytes
             sess.paged = False
+            return
+        if self.paged:
+            if not sess.block_table:
+                return
+            if self.oneside is not None:
+                for j in range(len(sess.block_table)):
+                    self.oneside.unpublish(f"kv:{sess.id}:k:{j}")
+                    self.oneside.unpublish(f"kv:{sess.id}:v:{j}")
+            for bid in sess.block_table:
+                self._decref_block_locked(bid)
+            sess.block_table = []
+            sess.kv_nbytes = 0
             return
         if sess.kv_k is None:
             return
@@ -670,6 +1157,8 @@ class SessionManager:
                 k_rows, v_rows = self._spill[sess.id]
                 k_rows = np.array(k_rows)
                 v_rows = np.array(v_rows)
+            elif self.paged:
+                k_rows, v_rows = self._gather_rows_locked(sess)
             else:
                 k_rows = np.array(sess.kv_k[:sess.pos])
                 v_rows = np.array(sess.kv_v[:sess.pos])
@@ -688,6 +1177,18 @@ class SessionManager:
             if sess.deadline_at is not None:
                 manifest["deadline_s"] = max(
                     0.0, sess.deadline_at - time.monotonic())
+            if self.paged:
+                # Block identity rides the manifest: a paged destination
+                # probes these digests against its own prefix cache and
+                # requests ONLY the slots it misses (None = partial or
+                # generated-row block, always shipped).
+                r = self.block_rows
+                manifest["block_rows"] = r
+                manifest["blocks"] = [
+                    (sess.prompt_digests[j]
+                     if (j < len(sess.prompt_digests)
+                         and (j + 1) * r <= sess.pos) else None)
+                    for j in range(-(-sess.pos // r) if sess.pos else 0)]
         kv = np.stack([k_rows, v_rows]) if sess.pos else np.zeros(
             (2, 0, self.dim), np.float32)
         return manifest, kv
@@ -711,6 +1212,15 @@ class SessionManager:
                 native.TRPC_EINTERNAL,
                 f"session {sid} exceeds this server's KV window "
                 f"{self.max_len}")
+        if self.paged:
+            sess = self._install_paged(manifest, sid, prompt, pos, kv)
+            self._m["migrated_in"].add(1)
+            return sess
+        if manifest.get("kv_blocks") is not None:
+            raise native.RpcError(
+                native.TRPC_EINTERNAL,
+                f"session {sid} shipped a partial block payload to a "
+                "monolithic server")
         kv = np.asarray(kv, dtype=np.float32).reshape(2, pos, dim)
         per_plane = self.max_len * self.dim * 4
         with self._mu:
@@ -750,6 +1260,112 @@ class SessionManager:
         self._m["migrated_in"].add(1)
         return sess
 
+    def _install_paged(self, manifest: dict, sid: str, prompt: List[int],
+                       pos: int, kv) -> Session:
+        """Paged half of import_session: block slots resolve against the
+        LOCAL prefix cache first (the migration only had to ship the
+        misses); a slot that is neither shipped nor cached raises
+        E_NO_SUCH so the source falls back to a full-plane ship."""
+        from brpc_tpu.runtime.param_server import E_EXISTS, E_NO_SUCH
+
+        r = self.block_rows
+        nblocks = -(-pos // r) if pos else 0
+        # Digests are derived LOCALLY (same rolling hash over the same
+        # prompt tokens) — manifest digests are advisory; a mismatched
+        # source geometry simply cache-misses into the shipped rows.
+        digests = self._prefix_digests(prompt)
+        kv_blocks = manifest.get("kv_blocks")
+        src_r = int(manifest.get("block_rows", r) or r)
+        if kv_blocks is not None and src_r != r:
+            # Mismatched geometry forces a full ship (probe_prefix needs
+            # every slot); the rows are contiguous either way.
+            kv_blocks = None
+        kv = np.asarray(kv, dtype=np.float32)
+        src: Dict[int, tuple] = {}
+        if kv_blocks is None:
+            kv = kv.reshape(2, pos, self.dim)
+            for j in range(nblocks):
+                lo, hi = j * r, min(pos, j * r + r)
+                src[j] = (kv[0, lo:hi], kv[1, lo:hi])
+        else:
+            kv = kv.reshape(2, -1, self.dim)
+            off = 0
+            for j in sorted(int(x) for x in kv_blocks):
+                lo, hi = j * r, min(pos, j * r + r)
+                src[j] = (kv[0, off:off + hi - lo],
+                          kv[1, off:off + hi - lo])
+                off += hi - lo
+        with self._mu:
+            live = self._sessions.get(sid)
+            if live is not None and live.state in (QUEUED, ACTIVE, FROZEN):
+                raise native.RpcError(
+                    E_EXISTS, f"session {sid} already live here")
+            table: List[int] = []
+            hits = misses = 0
+            try:
+                for j in range(nblocks):
+                    d = (digests[j] if (j < len(digests)
+                                        and (j + 1) * r <= pos) else None)
+                    bid = (self._prefix_cache.get(d)
+                           if d is not None else None)
+                    if bid is not None and self._block_fill[bid] == r:
+                        self._incref_block_locked(bid)
+                        table.append(bid)
+                        hits += 1
+                        continue
+                    if d is not None:
+                        misses += 1
+                    rows = src.get(j)
+                    if rows is None:
+                        raise native.RpcError(
+                            E_NO_SUCH,
+                            f"block {j} of session {sid} neither shipped "
+                            "nor cached here")
+                    bid = self._alloc_block_locked()
+                    if bid is None:
+                        raise native.RpcError(
+                            native.TRPC_ELIMIT,
+                            "KV blocks exhausted (retry_after_ms=100)")
+                    n = rows[0].shape[0]
+                    self._pool_k[bid, :] = 0.0
+                    self._pool_v[bid, :] = 0.0
+                    self._pool_k[bid, :n] = rows[0]
+                    self._pool_v[bid, :n] = rows[1]
+                    self._block_fill[bid] = n
+                    table.append(bid)
+                    if (d is not None and n == r
+                            and d not in self._prefix_cache):
+                        # A freshly shipped full prompt block seeds the
+                        # local cache — the NEXT migration/open of this
+                        # prefix ships nothing.
+                        self._prefix_cache[d] = bid
+                        self._block_digest[bid] = d
+                        self._cache_touched[d] = time.monotonic()
+            except Exception:
+                for b in table:
+                    self._decref_block_locked(b)
+                raise
+            self._prefix_hits += hits
+            self._prefix_misses += misses
+            self._m["prefix_hits"].add(hits)
+            self._m["prefix_misses"].add(misses)
+            sess = Session(sid, prompt, int(manifest["max_tokens"]),
+                           str(manifest.get("tenant", "")),
+                           int(manifest.get("priority",
+                                            native.PRIORITY_BULK)),
+                           manifest.get("deadline_s"), None, -1,
+                           len(table) * self._block_nbytes, None, None)
+            sess.block_table = table
+            sess.prompt_digests = digests
+            sess.pos = pos
+            sess.token = int(manifest.get("token", 0))
+            sess.emitted = int(manifest.get("emitted", 0))
+            sess.out_tokens = [int(t) for t in
+                               manifest.get("out_tokens", [])]
+            self._sessions[sid] = sess
+            self.publish_kv(sess)
+        return sess
+
     def attach_sink(self, sess: Session, sink, have: int = 0) -> int:
         """Un-park an imported session: attach the client's new stream
         and queue ``out_tokens[have:]`` for replay (``have`` = tokens the
@@ -783,7 +1399,19 @@ class SessionManager:
         if self.oneside is None:
             return
         for sess in sessions:
-            if sess.kv_k is not None:
+            if self.paged:
+                bt = sess.block_table
+                if not bt:
+                    continue
+                # A step writes at pos (spec: pos..pos+W-1) — lock every
+                # published slot from the write frontier on. Blocks the
+                # step grows lazily are published only AFTER it commits,
+                # so they need no seqlock here.
+                j0 = min(sess.pos // self.block_rows, len(bt) - 1)
+                for j in range(j0, len(bt)):
+                    self.oneside.begin_rewrite(f"kv:{sess.id}:k:{j}")
+                    self.oneside.begin_rewrite(f"kv:{sess.id}:v:{j}")
+            elif sess.kv_k is not None:
                 self.oneside.begin_rewrite(f"kv:{sess.id}:k")
                 self.oneside.begin_rewrite(f"kv:{sess.id}:v")
 
@@ -792,7 +1420,25 @@ class SessionManager:
         Not-owned publication: the session keeps its range (released via
         the engine's lane sweep, which unpublishes first). No-op without
         a window or once the KV is released."""
-        if self.oneside is None or sess.kv_k is None:
+        if self.oneside is None:
+            return
+        if self.paged:
+            if not sess.block_table:
+                return
+            r, pbp = self.block_rows, self._blk_plane
+            try:
+                for j, bid in enumerate(sess.block_table):
+                    ver = min(r, max(0, sess.pos - j * r))
+                    self.oneside.publish(
+                        f"kv:{sess.id}:k:{j}",
+                        self._pool_k_off + bid * pbp, pbp, ver, own=False)
+                    self.oneside.publish(
+                        f"kv:{sess.id}:v:{j}",
+                        self._pool_v_off + bid * pbp, pbp, ver, own=False)
+            except (ValueError, RuntimeError):
+                pass  # directory full: not publishable
+            return
+        if sess.kv_k is None:
             return
         per_plane = self.max_len * self.dim * 4
         try:
@@ -819,6 +1465,15 @@ class SessionManager:
         now = time.monotonic() if now is None else now
         shed, drop = [], []
         with self._mu:
+            if self.paged:
+                # Block-granular TTL: warm cached blocks (zero-ref) that
+                # nobody touched for ttl_s go back to the free list.
+                stale = [d for d, bid in self._prefix_cache.items()
+                         if self._block_refs[bid] == 0
+                         and now - self._cache_touched.get(d, now)
+                         > self.ttl_s]
+                for d in stale:
+                    self._cache_drop_locked(d)
             for sess in self._sessions.values():
                 if sess.state in (QUEUED, ACTIVE, FROZEN):
                     # FROZEN counts as live: a migration that stalls past
@@ -856,11 +1511,13 @@ class SessionManager:
             sessions = [{
                 "id": s.id, "tenant": s.tenant or "(none)",
                 "priority": s.priority, "state": s.state,
-                "tokens": s.emitted, "kv_bytes": (s.kv_nbytes
-                                                  if s.kv_k is not None
-                                                  else 0),
+                "tokens": s.emitted,
+                "kv_bytes": (s.kv_nbytes
+                             if (s.kv_k is not None or s.block_table)
+                             else 0),
                 "age_s": int(s.age_s()), "pending": s.pending_bytes,
                 "paged": s.paged, "spec_k": s.spec_k,
+                "blocks": len(s.block_table),
             } for s in self._sessions.values()]
             active = sum(1 for s in self._sessions.values()
                          if s.state in (QUEUED, ACTIVE, FROZEN))
@@ -869,6 +1526,18 @@ class SessionManager:
             shed_total = self._shed_total
             spec_prop = self._spec_proposed
             spec_acc = self._spec_accepted
+            pfx_hits = self._prefix_hits
+            pfx_misses = self._prefix_misses
+            cow = self._cow_faults
+            if self.paged:
+                blocks_free = len(self._free_blocks) + sum(
+                    1 for bid in self._prefix_cache.values()
+                    if self._block_refs[bid] == 0)
+                blocks_shared = sum(1 for n in self._block_refs if n >= 2)
+                blocks_cached = len(self._prefix_cache)
+            else:
+                blocks_free = blocks_shared = blocks_cached = 0
+        lookups = pfx_hits + pfx_misses
         return {
             "active": active,
             "kv_bytes": kv_bytes,
@@ -881,6 +1550,18 @@ class SessionManager:
             "spec_accepted": spec_acc,
             "spec_accept_pct": (round(100.0 * spec_acc / spec_prop, 1)
                                 if spec_prop else 0.0),
+            # Paged KV: the aggregate-ratio hit rate (never a mean of
+            # percentages) + pool occupancy for the native page.
+            "paged_mode": self.paged,
+            "block_rows": self.block_rows,
+            "kv_blocks_free": blocks_free,
+            "kv_blocks_shared": blocks_shared,
+            "kv_blocks_cached": blocks_cached,
+            "prefix_hits": pfx_hits,
+            "prefix_misses": pfx_misses,
+            "prefix_hit_pct": (round(100.0 * pfx_hits / lookups, 1)
+                               if lookups else 0.0),
+            "cow_faults": cow,
             "sessions": sessions,
         }
 

@@ -1794,12 +1794,30 @@ void sessionz_page(const HttpRequest& req, HttpResponse* resp) {
   const int64_t spec_prop = top_int("spec_proposed");
   const int64_t spec_acc = top_int("spec_accepted");
   snprintf(line, sizeof(line),
-           "spec accept: %.1f%% (%lld/%lld proposed)\n\n",
+           "spec accept: %.1f%% (%lld/%lld proposed)\n",
            spec_prop > 0 ? 100.0 * static_cast<double>(spec_acc) /
                                static_cast<double>(spec_prop)
                          : 0.0,
            static_cast<long long>(spec_acc),
            static_cast<long long>(spec_prop));
+  b += line;
+  // Paged KV: prefix-cache hit rate (aggregate hits/lookups — 0/0 =
+  // monolithic mode) + block-pool occupancy.
+  const int64_t pfx_hits = top_int("prefix_hits");
+  const int64_t pfx_miss = top_int("prefix_misses");
+  const int64_t lookups = pfx_hits + pfx_miss;
+  snprintf(line, sizeof(line),
+           "prefix hit: %.1f%% (%lld/%lld lookups), blocks "
+           "free/shared/cached: %lld/%lld/%lld, cow faults: %lld\n\n",
+           lookups > 0 ? 100.0 * static_cast<double>(pfx_hits) /
+                             static_cast<double>(lookups)
+                       : 0.0,
+           static_cast<long long>(pfx_hits),
+           static_cast<long long>(lookups),
+           static_cast<long long>(top_int("kv_blocks_free")),
+           static_cast<long long>(top_int("kv_blocks_shared")),
+           static_cast<long long>(top_int("kv_blocks_cached")),
+           static_cast<long long>(top_int("cow_faults")));
   b += line;
   const tbutil::JsonValue* sessions = parsed->find("sessions");
   if (sessions == nullptr || sessions->size() == 0) {

@@ -504,6 +504,10 @@ struct ShardScrape {
   // counters (spec-off members read 0/0 = 0%).
   int64_t spec_proposed = 0;         // serving_spec_proposed
   int64_t spec_accepted = 0;         // serving_spec_accepted
+  // Paged-KV shared-prefix cache hit rate: cumulative lookup counters
+  // (monolithic-mode members read 0/0 = 0%).
+  int64_t prefix_hits = 0;           // serving_prefix_hits
+  int64_t prefix_misses = 0;         // serving_prefix_misses
   int rpcz_on = -1;            // -1 = unknown (flags page unreadable)
   int64_t rpcz_sample_n = 0;
 };
@@ -564,6 +568,10 @@ void fleetz_fold_vars(const std::string& text, ShardScrape* s) {
       s->spec_proposed = strtoll(val, nullptr, 10);
     } else if (name == "serving_spec_accepted") {
       s->spec_accepted = strtoll(val, nullptr, 10);
+    } else if (name == "serving_prefix_hits") {
+      s->prefix_hits = strtoll(val, nullptr, 10);
+    } else if (name == "serving_prefix_misses") {
+      s->prefix_misses = strtoll(val, nullptr, 10);
     }
   }
 }
@@ -574,6 +582,16 @@ double spec_accept_pct(int64_t accepted, int64_t proposed) {
   return proposed > 0
              ? 100.0 * static_cast<double>(accepted) /
                    static_cast<double>(proposed)
+             : 0.0;
+}
+
+// Prefix-cache hit rate in percent; 0 when the member never looked up
+// (monolithic mode, or no opens yet).
+double prefix_hit_pct(int64_t hits, int64_t misses) {
+  const int64_t lookups = hits + misses;
+  return lookups > 0
+             ? 100.0 * static_cast<double>(hits) /
+                   static_cast<double>(lookups)
              : 0.0;
 }
 
@@ -675,6 +693,7 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
   int64_t p99_max = 0, lag_max = 0, logical = 0, wire = 0;
   int64_t serving_sessions_total = 0, serving_ttft_max = 0;
   int64_t spec_proposed_total = 0, spec_accepted_total = 0;
+  int64_t prefix_hits_total = 0, prefix_misses_total = 0;
   int worst = 0;
   size_t reachable = 0;
   std::vector<const ShardScrape*> rpcz_off;
@@ -689,6 +708,8 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     serving_ttft_max = std::max(serving_ttft_max, s.serving_ttft_p99_us);
     spec_proposed_total += s.spec_proposed;
     spec_accepted_total += s.spec_accepted;
+    prefix_hits_total += s.prefix_hits;
+    prefix_misses_total += s.prefix_misses;
     worst = std::max(worst, health_rank(s.health));
     if (s.reachable) ++reachable;
     if (s.rpcz_on == 0) rpcz_off.push_back(&s);
@@ -723,6 +744,10 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
       e.set("serving_spec_accepted", s.spec_accepted);
       e.set("serving_spec_accept_pct",
             spec_accept_pct(s.spec_accepted, s.spec_proposed));
+      e.set("serving_prefix_hits", s.prefix_hits);
+      e.set("serving_prefix_misses", s.prefix_misses);
+      e.set("serving_prefix_hit_pct",
+            prefix_hit_pct(s.prefix_hits, s.prefix_misses));
       e.set("rpcz_enabled", int64_t{s.rpcz_on});
       e.set("rpcz_sample_1_in_n", s.rpcz_sample_n);
       arr.push_back(std::move(e));
@@ -744,6 +769,8 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
     // (a near-idle shard must not swing the fleet rate).
     roll.set("serving_spec_accept_pct",
              spec_accept_pct(spec_accepted_total, spec_proposed_total));
+    roll.set("serving_prefix_hit_pct",
+             prefix_hit_pct(prefix_hits_total, prefix_misses_total));
     tbutil::JsonValue off = tbutil::JsonValue::Array();
     for (const auto* s : rpcz_off) off.push_back(s->addr);
     roll.set("rpcz_off", std::move(off));
@@ -770,16 +797,18 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
   b += line;
   snprintf(line, sizeof(line),
            "serving: tokens_s=%.0f live_sessions=%lld "
-           "ttft_p99_max=%lldus spec_accept=%.1f%%\n\n",
+           "ttft_p99_max=%lldus spec_accept=%.1f%% "
+           "prefix_hit=%.1f%%\n\n",
            serving_tokens_total,
            static_cast<long long>(serving_sessions_total),
            static_cast<long long>(serving_ttft_max),
-           spec_accept_pct(spec_accepted_total, spec_proposed_total));
+           spec_accept_pct(spec_accepted_total, spec_proposed_total),
+           prefix_hit_pct(prefix_hits_total, prefix_misses_total));
   b += line;
   snprintf(line, sizeof(line),
-           "%-21s %-8s %-11s %9s %9s %7s %5s %7s %5s %6s %s\n",
+           "%-21s %-8s %-11s %9s %9s %7s %5s %7s %5s %6s %6s %s\n",
            "shard", "tag", "health", "qps", "p99_us", "lag", "codec",
-           "tok/s", "sess", "spec%", "rpcz");
+           "tok/s", "sess", "spec%", "pfx%", "rpcz");
   b += line;
   for (const auto& s : shards) {
     const double ratio =
@@ -794,13 +823,14 @@ void fleetz_page(const HttpRequest& req, HttpResponse* resp) {
                                                : "on");
     snprintf(line, sizeof(line),
              "%-21s %-8s %-11s %9.0f %9lld %7lld %5.2f %7.0f %5lld "
-             "%6.1f %s\n",
+             "%6.1f %6.1f %s\n",
              s.addr.c_str(), s.tag.c_str(), s.health.c_str(), s.qps,
              static_cast<long long>(s.p99_us),
              static_cast<long long>(s.version_lag_max), ratio,
              s.serving_tokens_s,
              static_cast<long long>(s.serving_sessions),
              spec_accept_pct(s.spec_accepted, s.spec_proposed),
+             prefix_hit_pct(s.prefix_hits, s.prefix_misses),
              rpcz.c_str());
     b += line;
     if (!s.reason.empty() && s.health != "ok") {

@@ -1,0 +1,20 @@
+"""block-account positives: free-list / refcount / block-table / prefix-
+cache mutations that race the manager lock (the paged-KV bug class the
+rule exists for)."""
+
+
+class FixtureManager:
+    def alloc_racy(self):
+        bid = self._free_blocks.pop()
+        self._block_refs[bid] = 1
+        return bid
+
+    def repoint_racy(self, sess, j, nb):
+        sess.block_table[j] = nb
+
+    def cache_racy(self, digest, bid):
+        self._prefix_cache[digest] = bid
+
+    def alias_racy(self, sess):
+        table = sess.block_table
+        table.append(7)

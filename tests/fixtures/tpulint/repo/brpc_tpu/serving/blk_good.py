@@ -1,0 +1,28 @@
+"""block-account negatives: mutations under the manager lock, __init__
+construction, the _locked-suffix caller-holds-lock convention, and plain
+reads."""
+
+
+class FixtureManager:
+    def __init__(self):
+        self._free_blocks = [2, 1, 0]
+        self._block_refs = [0, 0, 0]
+        self._prefix_cache = {}
+
+    def alloc(self):
+        with self._mu:
+            return self._alloc_block_locked()
+
+    def _alloc_block_locked(self):
+        bid = self._free_blocks.pop()
+        self._block_refs[bid] = 1
+        return bid
+
+    def release(self, sess):
+        with self._mu:
+            for bid in sess.block_table:
+                self._block_refs[bid] -= 1
+            sess.block_table = []
+
+    def occupancy(self, sess):
+        return len(sess.block_table), len(self._free_blocks)

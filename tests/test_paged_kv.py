@@ -1,0 +1,577 @@
+"""Paged KV block pool + copy-on-write shared-prefix cache (ISSUE 18).
+
+Pure half (tier-1, no native lib): pool allocation/refcount units, the
+shared-prefix cache's hit accounting and prefill skip, CoW under live
+decode, the paged-vs-serial token parity pin (spec on AND off — one
+compiled ``_attend`` body serves both), block-granular spill/fault-in
+bit-exactness, warm-block TTL eviction, and the migration manifest's
+block-digest / partial-``kv_blocks`` install paths — all against the
+EXACT step logic the native path runs.  (``decode_serial`` is the common
+reference: test_serving pins monolithic == serial, so paged == serial
+is paged == monolithic, token for token.)
+
+Native half (skips cleanly without libbrpc_tpu.so, ARMED stall
+watchdog): a ``paged=True`` ServingServer streaming wire parity +
+/sessionz + /vars surfaces; oneside per-block publish/read parity on a
+migration; and the missed-blocks-only ship asserted in BYTES via the
+``serving_migrated_kv_bytes`` counter (the second migration of a
+shared-prefix session ships measurably less).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from brpc_tpu.models.decoder import decode_serial, init_decoder
+from brpc_tpu.runtime import native
+from brpc_tpu.serving import (DONE, QUEUED, CallableSink, DecodeEngine,
+                              SessionManager)
+
+PARAMS = init_decoder(jax.random.PRNGKey(0))
+MAX_LEN = 64
+R = 8                       # block_rows used throughout
+BLOCK_NBYTES = 2 * R * 32 * 4
+
+
+def paged_manager(**kw):
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("kv_arena_bytes", 1 << 20)
+    kw.setdefault("paged", True)
+    kw.setdefault("block_rows", R)
+    return SessionManager(**kw)
+
+
+class TokenCollector:
+    def __init__(self):
+        self.tokens = []
+        self.sink = CallableSink(self._on)
+
+    def _on(self, frame: bytes):
+        if frame.startswith(b"T"):
+            self.tokens.append(int(frame[1:]))
+
+
+def _run_to_done(engine, *sessions, steps=80):
+    for _ in range(steps):
+        engine.step()
+        if all(s.state == DONE for s in sessions):
+            return
+    raise AssertionError(
+        f"sessions never finished: {[s.state for s in sessions]}")
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 pure half.
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_account_and_release():
+    """Admission carves ceil((len(prompt)+1)/R) blocks; kv_bytes counts
+    blocks off the free list; release returns uncached blocks whole."""
+    mgr = paged_manager()
+    cap = mgr._pool_cap
+    assert cap >= 64 and mgr.block_rows == R
+    sess = mgr.open(list(range(1, 11)), 4, TokenCollector().sink)
+    assert len(sess.block_table) == 2          # ceil(11/8)
+    assert sess.kv_nbytes == 2 * BLOCK_NBYTES
+    doc = mgr.sessionz_doc()
+    assert doc["paged_mode"] and doc["block_rows"] == R
+    assert doc["kv_bytes"] == 2 * BLOCK_NBYTES
+    assert mgr._blocks_free() == cap - 2
+    mgr.finish(sess)
+    assert mgr.sessionz_doc()["kv_bytes"] == 0
+    assert mgr._blocks_free() == cap
+
+
+def test_block_rows_shrinks_to_max_len_divisor():
+    mgr = SessionManager(max_len=48, kv_arena_bytes=1 << 20,
+                         paged=True, block_rows=10)
+    assert mgr.block_rows == 8, "10 does not divide 48; 8 does"
+
+
+def test_shared_prefix_hits_sharing_and_parity():
+    """Second/third sessions with the same prompt reference the cached
+    prompt blocks (hit counters, shared gauge, prefill skip) and still
+    decode the EXACT serial trajectory."""
+    mgr = paged_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4)
+    prompt = list(range(2, 22))               # 20 tokens: 2 full blocks
+    n_tok = 6
+    ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+    c1 = TokenCollector()
+    s1 = mgr.open(prompt, n_tok, c1.sink)
+    _run_to_done(eng, s1)
+    assert c1.tokens == ref
+    doc = mgr.sessionz_doc()
+    assert doc["prefix_misses"] == 2 and doc["prefix_hits"] == 0
+    assert doc["kv_blocks_cached"] == 2, "full prompt blocks stay warm"
+    c2, c3 = TokenCollector(), TokenCollector()
+    s2 = mgr.open(prompt, n_tok, c2.sink)
+    assert s2.pos == 2 * R, "prefill skipped the cached full blocks"
+    s3 = mgr.open(prompt, n_tok, c3.sink)
+    assert s2.block_table[:2] == s3.block_table[:2], "shared blocks"
+    doc = mgr.sessionz_doc()
+    assert doc["prefix_hits"] == 4 and doc["prefix_hit_pct"] == 66.7
+    assert doc["kv_blocks_shared"] == 2
+    _run_to_done(eng, s2, s3)
+    assert c2.tokens == ref and c3.tokens == ref
+
+
+@pytest.mark.parametrize("spec_k", [0, 3])
+def test_paged_parity_with_serial_spec_on_and_off(spec_k):
+    """THE tentpole pin: the block-indexed gather decodes token-for-token
+    identical to serial, with speculation off AND on, including a
+    block-aligned prompt (whose last row re-ingests into a shared block
+    on a cache hit) and concurrent same-prefix sessions."""
+    mgr = paged_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=4, spec_k=spec_k)
+    prompts = [[3, 7, 11], [5, 2], list(range(1, 17)),  # 16 = aligned
+               list(range(2, 22))]
+    n_tok = 10
+    refs = [decode_serial(PARAMS, p, n_tok, MAX_LEN) for p in prompts]
+    cols = [TokenCollector() for _ in prompts]
+    sessions = [mgr.open(p, n_tok, c.sink)
+                for p, c in zip(prompts, cols)]
+    _run_to_done(eng, *sessions)
+    for p, c, r in zip(prompts, cols, refs):
+        assert c.tokens == r, f"prompt {p}: {c.tokens} != {r}"
+    # Same prompts again: every full prompt block is a cache hit now.
+    cols2 = [TokenCollector() for _ in prompts]
+    sessions2 = [mgr.open(p, n_tok, c.sink)
+                 for p, c in zip(prompts, cols2)]
+    _run_to_done(eng, *sessions2)
+    for p, c, r in zip(prompts, cols2, refs):
+        assert c.tokens == r, f"cache-hit prompt {p}: {c.tokens} != {r}"
+    assert mgr.sessionz_doc()["prefix_hits"] >= 3
+
+
+def test_cow_fires_on_block_aligned_cache_hit_and_preserves_cache():
+    """A fully block-aligned prompt re-ingests its final row INTO the
+    shared block — the natural CoW trigger. The private copy absorbs the
+    write; the cached original stays warm and byte-identical."""
+    mgr = paged_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=2)
+    prompt = list(range(1, 17))               # exactly 2 blocks
+    ref = decode_serial(PARAMS, prompt, 6, MAX_LEN)
+    c1 = TokenCollector()
+    s1 = mgr.open(prompt, 6, c1.sink)
+    _run_to_done(eng, s1)
+    with mgr._mu:
+        cached_bid = mgr._prefix_cache[s1.prompt_digests[1]]
+        before = np.array(mgr._pool_k[cached_bid])
+    c2 = TokenCollector()
+    s2 = mgr.open(prompt, 6, c2.sink)
+    assert s2.pos == len(prompt) - 1, "never skip the final prompt row"
+    assert s2.block_table[1] == cached_bid
+    eng.step()  # re-ingests row 15 into the shared block: CoW fires
+    assert s2.block_table[1] != cached_bid, "CoW repointed the slot"
+    _run_to_done(eng, s2)
+    assert c2.tokens == ref and c1.tokens == ref
+    assert mgr.sessionz_doc()["cow_faults"] >= 1
+    with mgr._mu:
+        assert mgr._prefix_cache[s1.prompt_digests[1]] == cached_bid
+        assert np.array_equal(np.array(mgr._pool_k[cached_bid]), before)
+
+
+def test_block_spill_and_fault_in_bit_exact():
+    """Block-granular page-out gathers to the host store and faults
+    back bit-exact; the spill gauges move in block counts."""
+    mgr = paged_manager()
+    eng = DecodeEngine(mgr, PARAMS, max_batch=1)
+    sess = mgr.open([3, 7, 11], 8, TokenCollector().sink)
+    for _ in range(4):
+        eng.step()
+    mgr.freeze(sess)
+    eng.step()                                # lane sweep
+    mgr.unfreeze(sess)
+    with mgr._mu:
+        k_before, v_before = mgr._gather_rows_locked(sess)
+    assert mgr.page_out(sess)
+    assert sess.paged and sess.block_table == []
+    doc = mgr.sessionz_doc()
+    assert doc["kv_bytes"] == 0
+    assert doc["kv_spilled_bytes"] == 2 * sess.pos * mgr.dim * 4
+    assert mgr.fault_in(sess)
+    assert not sess.paged and sess.block_table
+    with mgr._mu:
+        k_after, v_after = mgr._gather_rows_locked(sess)
+    assert np.array_equal(k_after, k_before)
+    assert np.array_equal(v_after, v_before)
+    assert mgr.sessionz_doc()["kv_spilled_bytes"] == 0
+
+
+def test_pool_pressure_pages_cold_session_then_elimit():
+    """A tiny pool admits past its capacity by paging the coldest QUEUED
+    session's blocks out; when even that cannot cover the request, the
+    open sheds with ELIMIT + a retry hint."""
+    mgr = paged_manager(kv_arena_bytes=2 * BLOCK_NBYTES)
+    assert mgr._pool_cap == 2
+    s1 = mgr.open(list(range(1, 11)), 4, TokenCollector().sink)  # 2 blocks
+    assert len(s1.block_table) == 2
+    with pytest.raises(native.RpcError) as ei:
+        mgr.open(list(range(1, 21)), 4, TokenCollector().sink)   # needs 3
+    assert ei.value.code == native.TRPC_ELIMIT
+    assert "retry_after_ms" in str(ei.value)
+    assert s1.paged, "pressure paged the cold session before giving up"
+    s2 = mgr.open([5, 2], 4, TokenCollector().sink)  # 1 block: fits now
+    assert len(s2.block_table) == 1
+
+
+def test_ttl_evicts_warm_cached_blocks():
+    mgr = paged_manager(ttl_s=0.05)
+    eng = DecodeEngine(mgr, PARAMS, max_batch=1)
+    s1 = mgr.open(list(range(2, 22)), 4, TokenCollector().sink)
+    _run_to_done(eng, s1)
+    doc = mgr.sessionz_doc()
+    assert doc["kv_blocks_cached"] == 2 and doc["kv_bytes"] > 0
+    time.sleep(0.12)
+    mgr.evict_expired()
+    doc = mgr.sessionz_doc()
+    assert doc["kv_blocks_cached"] == 0
+    assert doc["kv_bytes"] == 0, "warm blocks returned to the free list"
+
+
+def test_migration_round_trip_paged_token_parity():
+    """Freeze/export/import/resume between two PAGED managers == the
+    unmigrated trajectory; the manifest carries block digests for full
+    prompt blocks and None for partial/generated slots."""
+    n_tok = 12
+    prompt = list(range(2, 22))
+    ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+    src = paged_manager()
+    esrc = DecodeEngine(src, PARAMS, max_batch=2)
+    got = []
+    sink = CallableSink(lambda f: got.append(int(f[1:]))
+                        if f.startswith(b"T") else None)
+    sess = src.open(prompt, n_tok, sink, sid="pg-mig-1")
+    for _ in range(40):
+        esrc.step()
+        if len(got) >= 3:
+            break
+    assert 0 < len(got) < n_tok, "migrate MID-stream"
+    assert src.freeze(sess)
+    esrc.step()
+    assert src.exportable(sess)
+    manifest, kv = src.export_session(sess)
+    assert manifest["block_rows"] == R
+    nfull = len(prompt) // R
+    assert len(manifest["blocks"]) == -(-sess.pos // R)
+    assert all(d is not None for d in manifest["blocks"][:nfull])
+    assert all(d is None for d in manifest["blocks"][nfull:])
+    src.finish(sess, shed_reason="moved:dst",
+               shed_code=native.E_SESSION_MOVED)
+    dst = paged_manager()
+    edst = DecodeEngine(dst, PARAMS, max_batch=2)
+    sess2 = dst.import_session(manifest, kv)
+    assert sess2.id == "pg-mig-1" and sess2.state == QUEUED
+    dst.attach_sink(sess2, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None),
+        have=len(got))
+    _run_to_done(edst, sess2)
+    assert got == ref, (got, ref)
+    # The install seeded dst's prefix cache: a local open now hits.
+    s3 = dst.open(prompt, 4, TokenCollector().sink)
+    assert s3.pos == nfull * R
+    assert dst.sessionz_doc()["prefix_hits"] >= nfull
+
+
+def test_partial_kv_blocks_payload_installs_bit_exact():
+    """The missed-blocks-only ship: a destination whose cache already
+    holds the prefix installs from a payload carrying ONLY the missed
+    slots — resumed trajectory and gathered rows both exact."""
+    prompt = list(range(2, 22))
+    n_tok = 12
+    ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+    src = paged_manager()
+    esrc = DecodeEngine(src, PARAMS, max_batch=1)
+    got = []
+    sess = src.open(prompt, n_tok, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None),
+        sid="pg-slim-1")
+    for _ in range(40):
+        esrc.step()
+        if len(got) >= 3:
+            break
+    assert 0 < len(got) < n_tok, "export MID-stream"
+    src.freeze(sess)
+    esrc.step()
+    manifest, kv = src.export_session(sess)
+    # Warm the destination's cache with the same prefix.
+    dst = paged_manager()
+    edst = DecodeEngine(dst, PARAMS, max_batch=1)
+    warm = dst.open(prompt, 4, TokenCollector().sink)
+    _run_to_done(edst, warm)
+    need = dst.probe_prefix(manifest["blocks"], manifest["block_rows"])
+    nfull = len(prompt) // R
+    assert need == list(range(nfull, len(manifest["blocks"]))), \
+        "cached full-prefix slots must not be requested"
+    # Mismatched geometry always requests everything.
+    assert dst.probe_prefix(manifest["blocks"], R // 2) == \
+        list(range(len(manifest["blocks"])))
+    pos = manifest["pos"]
+    slim = np.ascontiguousarray(np.concatenate(
+        [kv[:, j * R:min(pos, j * R + R), :] for j in need], axis=1))
+    assert slim.nbytes < kv.nbytes
+    sess2 = dst.import_session(dict(manifest, kv_blocks=need), slim)
+    with dst._mu:
+        k2, v2 = dst._gather_rows_locked(sess2)
+    assert np.array_equal(k2, kv[0]) and np.array_equal(v2, kv[1])
+    src.finish(sess, shed_reason="moved:dst",
+               shed_code=native.E_SESSION_MOVED)
+    dst.attach_sink(sess2, CallableSink(
+        lambda f: got.append(int(f[1:])) if f.startswith(b"T") else None),
+        have=len(got))
+    _run_to_done(edst, sess2)
+    assert got == ref
+
+
+def test_partial_payload_to_monolithic_server_rejected():
+    """A mono destination cannot resolve kv_blocks slots: EINTERNAL, so
+    the source's full-ship fallback (not silent corruption) handles it."""
+    mono = SessionManager(max_len=MAX_LEN, kv_arena_bytes=1 << 20)
+    manifest = {"session": "x-1", "prompt": [1, 2, 3], "max_tokens": 4,
+                "pos": 3, "dim": 32, "kv_blocks": [0],
+                "block_rows": R}
+    with pytest.raises(native.RpcError) as ei:
+        mono.import_session(manifest, np.zeros((2, 3, 32), np.float32))
+    assert ei.value.code == native.TRPC_EINTERNAL
+    assert "partial block payload" in str(ei.value)
+
+
+def test_missing_block_neither_shipped_nor_cached_is_no_such():
+    """An Install whose payload omits a slot the destination does not
+    hold answers E_NO_SUCH (the source retries with the full payload) —
+    and rolls back every block it had provisionally taken."""
+    from brpc_tpu.runtime.param_server import E_NO_SUCH
+    dst = paged_manager()
+    free_before = dst._blocks_free()
+    manifest = {"session": "x-2", "prompt": list(range(1, 17)),
+                "max_tokens": 4, "pos": 17, "dim": 32,
+                "block_rows": R, "kv_blocks": [2],
+                "blocks": ["deadbeefdeadbeef", "feedfacefeedface", None]}
+    slim = np.zeros((2, 1, 32), np.float32)
+    with pytest.raises(native.RpcError) as ei:
+        dst.import_session(manifest, slim)
+    assert ei.value.code == E_NO_SUCH
+    assert dst._blocks_free() == free_before, "rollback leaked blocks"
+
+
+# ---------------------------------------------------------------------------
+# Native half: the wire, oneside, and the byte-count acceptance pin.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def paged_env(tmp_path_factory):
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.observability import health
+    dump_dir = tmp_path_factory.mktemp("paged_dumps")
+    health.start_watchdog(str(dump_dir))
+    yield {"health": health}
+    deadline = time.monotonic() + 10
+    while health.state() == "stalled" and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert health.state() != "stalled", (
+        f"scheduler stalled after paged-kv tests; dump: "
+        f"{health.last_dump_path()}")
+
+
+def test_native_paged_serving_parity_and_surfaces(paged_env):
+    """A paged=True server streams serial-exact tokens over the wire;
+    /sessionz (text + json) and /vars grow the pool/prefix surfaces."""
+    from brpc_tpu.observability import metrics as obs
+    from brpc_tpu.serving import ServingClient, ServingServer
+    srv = ServingServer(PARAMS, max_len=MAX_LEN, max_batch=4, paged=True,
+                        block_rows=R)
+    port = srv.start()
+    try:
+        prompt = list(range(2, 22))
+        n_tok = 8
+        ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+        c = ServingClient(f"127.0.0.1:{port}", tenant="pg")
+        assert c.generate(prompt, n_tok) == ref
+        assert c.generate(prompt, n_tok) == ref, "cache-hit replay parity"
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/sessionz?format=json",
+            timeout=5).read().decode())
+        assert doc["paged_mode"] and doc["block_rows"] == R
+        assert doc["prefix_hits"] >= 2 and doc["prefix_hit_pct"] > 0
+        assert doc["kv_blocks_free"] > 0
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/sessionz",
+            timeout=5).read().decode()
+        assert "prefix hit:" in text and "blocks free/shared/cached:" in text
+        vars_text = obs.dump_vars("serving_")
+        assert "serving_prefix_hits" in vars_text
+        assert "serving_kv_blocks_free" in vars_text
+        c.close()
+    finally:
+        srv.stop()
+
+
+def _hub():
+    from brpc_tpu.fleet import RegistryHub
+    hub = RegistryHub()
+    hub.start()
+    return hub
+
+
+def _member(hub, tag, **kw):
+    from brpc_tpu.serving import FleetServingServer
+    srv = FleetServingServer(hub.hostport, PARAMS, tag=tag, role="both",
+                             max_len=MAX_LEN, reg_ttl_s=3, paged=True,
+                             block_rows=R, **kw)
+    srv.start()
+    return srv
+
+
+def _cleanup(hub, *servers):
+    from brpc_tpu.fleet import clear_registry
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+    clear_registry()
+    hub.stop()
+
+
+def _keys_owned_by(client, addr, n, prefix):
+    client.router.refresh()
+    keys, i = [], 0
+    while len(keys) < n:
+        k = f"{prefix}-{i}"
+        if client.router.route(k) == addr:
+            keys.append(k)
+        i += 1
+        assert i < 10000
+    return keys
+
+
+def _open_and_migrate(client, a, b, key, prompt, n_tok):
+    """Open on `a`, read a few tokens, migrate to `b`; returns the live
+    stream (the caller drains the rest for parity)."""
+    ts = client.open(prompt, n_tok, session_key=key)
+    while len(ts.tokens) < 3:
+        ts.read_token(timeout_ms=5000)
+    sess = a.manager.get(key)
+    assert sess is not None
+    assert a.migrate_session(sess, b.addr)
+    return ts
+
+
+def test_native_oneside_per_block_publish_read_parity(paged_env):
+    """publish_kv=True between paged members: the destination assembles
+    the migrated KV from per-block oneside slots (+ its own prefix
+    cache) — stream parity pins the read path bit-exact."""
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "pgo", max_batch=4, publish_kv=True)
+    b = _member(hub, "pgo", max_batch=4, publish_kv=True)
+    try:
+        oneside_installs = []
+        orig = type(b)._read_kv_oneside
+
+        def spy(self, manifest, _orig=orig, _log=oneside_installs):
+            kv = _orig(self, manifest)
+            _log.append(manifest.get("blocks"))
+            return kv
+
+        b._read_kv_oneside = spy.__get__(b)
+        c = ServingFleetClient(hub.hostport, tag="pgo")
+        prompt = list(range(2, 22))
+        n_tok = 16
+        ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+        key = _keys_owned_by(c, a.addr, 1, "pgo")[0]
+        ts = _open_and_migrate(c, a, b, key, prompt, n_tok)
+        rest = list(ts)
+        assert ts.tokens == ref
+        assert rest, "tokens kept flowing after the move"
+        assert len(oneside_installs) == 1, \
+            "published per-block KV must serve the migration read"
+        assert oneside_installs[0], "manifest carried the block slots"
+        ts.close()
+        c.close()
+    finally:
+        _cleanup(hub, a, b)
+
+
+def test_native_migration_ships_only_missed_blocks(paged_env):
+    """THE byte-count acceptance pin: after a first migration seeds the
+    destination's prefix cache, a second same-prefix migration ships
+    measurably fewer KV bytes (serving_migrated_kv_bytes counts exactly
+    what rode the wire)."""
+    from brpc_tpu.serving import ServingFleetClient
+    from brpc_tpu.serving.session import serving_metrics
+    hub = _hub()
+    # publish_kv=False: migrations take the bytes path, whose _slim_ship
+    # probe is the object under test.
+    a = _member(hub, "pgb", max_batch=4)
+    b = _member(hub, "pgb", max_batch=4)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="pgb")
+        prompt = list(range(3, 43))           # 40 tokens: 5 full blocks
+        n_tok = 16
+        ref = decode_serial(PARAMS, prompt, n_tok, MAX_LEN)
+        counter = serving_metrics()["migrated_kv_bytes"]
+        k1, k2 = _keys_owned_by(c, a.addr, 2, "pgb")
+        before = counter.value()
+        ts1 = _open_and_migrate(c, a, b, k1, prompt, n_tok)
+        full_bytes = counter.value() - before
+        # 3 tokens read => pos >= len(prompt)+2 (the first token rides
+        # the final prompt row's ingestion).
+        assert full_bytes >= 2 * (len(prompt) + 2) * 32 * 4, \
+            "first ship carries the whole trajectory"
+        assert list(ts1) and ts1.tokens == ref
+        before = counter.value()
+        ts2 = _open_and_migrate(c, a, b, k2, prompt, n_tok)
+        slim_bytes = counter.value() - before
+        assert list(ts2) and ts2.tokens == ref
+        # 5 shared prompt blocks (2 planes x 40 rows x dim x fp32 =
+        # 10240 bytes) stayed home; even at max pos skew the slim ship
+        # is strictly smaller.
+        assert slim_bytes < full_bytes, (slim_bytes, full_bytes)
+        assert slim_bytes <= full_bytes - 2 * len(prompt) * 32 * 4 \
+            + 2 * n_tok * 32 * 4, (slim_bytes, full_bytes)
+        ts1.close(); ts2.close()
+        c.close()
+    finally:
+        _cleanup(hub, a, b)
+
+
+def test_native_fleetz_prefix_hit_columns(paged_env):
+    """/fleetz (native page) and the Python twin both fold the prefix
+    hit rate from the aggregate hit/miss counters."""
+    from brpc_tpu.observability.fleet_view import FleetObserver
+    from brpc_tpu.serving import ServingFleetClient
+    hub = _hub()
+    a = _member(hub, "pgz", max_batch=2)
+    try:
+        c = ServingFleetClient(hub.hostport, tag="pgz")
+        prompt = list(range(2, 22))
+        assert len(c.generate(prompt, 6)) == 6
+        assert len(c.generate(prompt, 6)) == 6  # the hit
+        doc = json.loads(urllib.request.urlopen(
+            f"http://{a.addr}/fleetz?format=json&tag=pgz",
+            timeout=5).read().decode())
+        row = next(r for r in doc["shards"] if r["addr"] == a.addr)
+        assert row["serving_prefix_hits"] >= 2
+        assert row["serving_prefix_hit_pct"] > 0
+        assert doc["rollup"]["serving_prefix_hit_pct"] > 0
+        text = urllib.request.urlopen(
+            f"http://{a.addr}/fleetz?tag=pgz", timeout=5).read().decode()
+        assert "prefix_hit=" in text and "pfx%" in text
+        obs_view = FleetObserver(hub.hostport, tag="pgz")
+        fz = obs_view.fleetz()
+        trow = next(r for r in fz["shards"] if r["addr"] == a.addr)
+        assert trow["serving_prefix_hits"] >= 2
+        assert fz["rollup"]["serving_prefix_hit_pct"] > 0
+        prom = obs_view.fleet_prometheus()
+        assert "fleet_serving_prefix_hit_pct" in prom
+        c.close()
+    finally:
+        _cleanup(hub, a)

@@ -472,6 +472,25 @@ def test_state_machine_negative(fixture_findings):
     assert not [f for f in fixture_findings if "sm_good.py" in f.path]
 
 
+# ---- rule class 9b: block-account (paged-KV accounting lock scope) ----
+
+def test_block_account_positive(fixture_findings):
+    hits = _of(fixture_findings, "block-account", "blk_bad.py")
+    msgs = " | ".join(f.message for f in hits)
+    assert "_free_blocks" in msgs          # mutating call on the free list
+    assert "_block_refs" in msgs           # refcount subscript write
+    assert "block_table" in msgs           # table repoint
+    assert "_prefix_cache" in msgs         # cache insert
+    assert "aliases a block structure" in msgs  # write through a local alias
+    assert len(hits) == 5
+    assert all("manager lock" in f.hint for f in hits)
+
+
+def test_block_account_negative(fixture_findings):
+    # under-lock mutations, __init__, the _locked suffix, and reads
+    assert not [f for f in fixture_findings if "blk_good.py" in f.path]
+
+
 # ---- rule class 10: arena-alias (device_put over wire views) ----
 
 def test_arena_alias_positive(fixture_findings):
@@ -628,8 +647,8 @@ def test_reporters_shapes(fixture_findings):
     rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
     assert {"fiber-blocking", "lock-order", "iobuf-ownership",
             "wire-contract", "metric-name", "py-blocking",
-            "error-code", "negotiation", "state-machine", "arena-alias",
-            "sanitizer-clean"} <= rule_ids
+            "error-code", "negotiation", "state-machine", "block-account",
+            "arena-alias", "sanitizer-clean"} <= rule_ids
 
 
 def test_cli_exit_codes():

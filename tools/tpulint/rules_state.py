@@ -20,6 +20,14 @@ Three checks that each cost a hand-fixed bug before they were rules:
     appear in that order; a Commit that precedes its Retire re-opens the
     very race the handshake exists to close.
 
+block-account (separate rule id): the paged-KV pool's accounting — the
+free list, per-block refcounts/digests, the shared-prefix cache, and every
+``Session.block_table`` — is guarded by the same manager lock.  A mutation
+outside a `with ..._mu:` scope (double-free, refcount skew, a table
+repoint racing CoW) is exactly the bug class that breaks the "equal
+digest => bit-equal rows" invariant.  ``__init__`` and ``*_locked``
+helpers (the repo's caller-holds-lock suffix convention) are exempt.
+
 arena-alias (separate rule id): `jax.device_put` over an array that still
 VIEWS wire/arena pages.  On the CPU backend XLA zero-copy aliases 64-byte-
 aligned host buffers, so the "copy" keeps reading pages the arena is
@@ -161,6 +169,105 @@ class SessionStateRule:
                              "forwards, and Commit opens writes last"))
                 high = max(high, leg)
         return out
+
+
+_BLOCK_ATTRS = {"block_table", "_block_refs", "_free_blocks",
+                "_prefix_cache", "_block_digest"}
+
+_MUTATORS = {"append", "appendleft", "extend", "insert", "remove", "pop",
+             "popitem", "clear", "update", "setdefault", "move_to_end",
+             "sort", "reverse"}
+
+
+class BlockAccountRule:
+    id = "block-account"
+    description = ("paged-KV block accounting (block_table / _block_refs / "
+                   "_free_blocks / _prefix_cache / _block_digest) mutated "
+                   "outside the manager lock")
+
+    def run(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for src in ctx.select(under=("brpc_tpu/serving/", "brpc_tpu/fleet/"),
+                              ext={".py"}):
+            try:
+                tree = ast.parse(src.text)
+            except SyntaxError:
+                continue
+            parents = _parent_map(tree)
+            funcs = [n for n in ast.walk(tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for fn in funcs:
+                if fn.name == "__init__" or fn.name.endswith("_locked"):
+                    # Construction has no concurrent reader; the _locked
+                    # suffix is the repo's caller-holds-_mu convention
+                    # (enforced at the call sites, which DO take the lock).
+                    continue
+                findings.extend(self._check_fn(src, fn, funcs, parents))
+        return findings
+
+    def _check_fn(self, src, fn, funcs, parents):
+        out = []
+        tainted: set[str] = set()  # locals aliasing a guarded structure
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AugAssign, ast.Call)):
+                continue
+            if _innermost_fn(funcs, node) is not fn:
+                continue  # nested defs are their own (exempt or not) scope
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_block_attr(node.value):
+                tainted.add(node.targets[0].id)
+                continue
+            hit = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    hit = hit or _block_write_target(t, tainted)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                        and (_is_block_attr(f.value)
+                             or (isinstance(f.value, ast.Name)
+                                 and f.value.id in tainted)):
+                    hit = _block_name(f.value, tainted)
+            if hit is None:
+                continue
+            chain = _ancestors(parents, node)
+            if any(isinstance(a, ast.With) and _with_takes_mu(a)
+                   for a in chain):
+                continue
+            out.append(Finding(
+                rule=self.id, path=src.path, line=node.lineno,
+                message=f"block accounting ({hit}) mutated outside a "
+                        "`with ..._mu:` scope",
+                hint="free-list/refcount/table writes race admission, "
+                     "CoW and eviction; take the manager lock, or move "
+                     "the write into a *_locked helper whose call sites "
+                     "hold it"))
+        return out
+
+
+def _is_block_attr(node) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr in _BLOCK_ATTRS
+
+
+def _block_write_target(t, tainted):
+    """Name of the guarded structure a write target mutates, else None."""
+    if _is_block_attr(t):
+        return t.attr
+    if isinstance(t, ast.Subscript):
+        return _block_name(t.value, tainted)
+    return None
+
+
+def _block_name(node, tainted):
+    if _is_block_attr(node):
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in tainted:
+        return f"{node.id} (aliases a block structure)"
+    return None
 
 
 class ArenaAliasRule:
@@ -367,4 +474,4 @@ def _const_states(node):
     return set()
 
 
-RULES = [SessionStateRule(), ArenaAliasRule()]
+RULES = [SessionStateRule(), BlockAccountRule(), ArenaAliasRule()]
