@@ -84,9 +84,14 @@ paged-smoke:
 # analog): the pure scheduler units (topology, failure propagation,
 # serial==overlapped equivalence) plus — with the native lib present —
 # the overlapped-vs-serial parity drive over a live ParameterServer,
-# then lint. The native halves skip cleanly without the lib.
+# then lint. The native halves skip cleanly without the lib. The
+# parallelism-regime halves ride along: the 1F1B schedule math +
+# graph-builder units, thread-pipe PP trajectory parity, and the
+# tensor-parallel layer wrappers (all tier-1-pure; the WirePipe native
+# test skips cleanly without the lib).
 train-smoke:
-	python -m pytest tests/test_step_overlap.py -q
+	python -m pytest tests/test_step_overlap.py tests/test_pp_sched.py \
+		tests/test_tp_layers.py -q
 	$(MAKE) --no-print-directory contract-check
 
 # Fast local gate for the fleet-collectives plane (the obs-smoke

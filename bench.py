@@ -1487,6 +1487,19 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - report, don't fail the bench
         print(f"# collective converge point skipped: {e}", file=sys.stderr)
 
+    # Parallelism-regime rows (ISSUE 20): steps/s for DP / PP / TP /
+    # PPxDP on the wire-bound config, serial-vs-overlap pairs, the T3
+    # track-and-trigger exposed-wait A/B, and the live DP -> PP
+    # ownership switch under push load.
+    try:
+        sweep.update(train_regime_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# train regime point skipped: {e}", file=sys.stderr)
+    try:
+        sweep.update(regime_switch_point())
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(f"# regime switch point skipped: {e}", file=sys.stderr)
+
     # Tensor bridge rows (the chartered workload): jax/numpy arrays riding
     # the framework through TensorArena by-reference attachments.
     try:
@@ -2494,6 +2507,385 @@ def collective_converge_point(n=2, steps=6, timeout=600):
     return {"collective_converge": row}
 
 
+# Parallelism-regime rows (ISSUE 20): steps/s per regime on the SAME
+# model config — DP (ring-allreduce driver), PP (1F1B stages over
+# WirePipe), TP (column/row-sharded layers over the collective verbs),
+# PP x DP (stage pipes + per-stage DP rings) — one member PROCESS per
+# rank, serial-vs-overlap interleaved pairs where the regime has a
+# schedule to overlap, plus the T3 track-and-trigger A/B (per-chunk
+# optimizer trigger vs op-completion fusion: exposed wire wait). Wire-
+# bound config: every link paced to emu_gbps (the collective_point
+# discipline — loopback shm moves bytes at memcpy speed, which no
+# cross-host link does). argv: hub regime rank n steps reps emu
+_REGIME_MEMBER = r"""
+import json, sys, tempfile, time
+sys.path.insert(0, ROOT)
+sys.setswitchinterval(0.0005)
+import numpy as np
+from brpc_tpu.observability import health
+
+hub, regime, rank, n, steps, reps, emu = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), float(sys.argv[7]))
+health.start_watchdog(tempfile.mkdtemp(prefix="regime_dumps_"))
+SIZES = [128, 512, 512, 128]
+BATCH = 16
+MICRO = 4
+
+from brpc_tpu.models.tensor_service import LayeredMLP
+
+_full = LayeredMLP(SIZES, seed=0)
+
+
+def group(tag, expect):
+    from brpc_tpu.collectives.group import CollectiveGroup
+    kw = dict(window=8, op_timeout_s=120.0)
+    if emu > 0:
+        kw["emulate_wire_gbps"] = emu
+    g = CollectiveGroup(hub, tag=tag, **kw)
+    g.sync(expect=expect, timeout_s=60)
+    return g
+
+
+def timed(step_fn):
+    step_fn()  # warmup: channels + jit
+    t0 = time.monotonic()
+    for _ in range(steps):
+        step_fn()
+    return steps / (time.monotonic() - t0)
+
+
+out = {}
+if regime == "dp":
+    from brpc_tpu.runtime.step_driver import CollectiveStepDriver
+    x, y = _full.data(BATCH, seed=1 + rank)
+    out = {"overlap": [], "serial": []}
+    for rep in range(reps):
+        for mode in ("overlap", "serial"):  # interleaved pair
+            g = group("dp_%s%d" % (mode, rep), n)
+            d = CollectiveStepDriver(g, LayeredMLP(SIZES, seed=0),
+                                     overlap=(mode == "overlap"))
+            d.prime()
+            out[mode].append(timed(lambda: d.step(x, y)))
+            g.close()
+        for mode in ("op", "track"):  # T3 A/B, same discipline
+            g = group("t3_%s%d" % (mode, rep), n)
+            d = CollectiveStepDriver(g, LayeredMLP(SIZES, seed=0),
+                                     overlap=True,
+                                     track=(mode == "track"))
+            d.prime()
+            d.step(x, y)
+            stall, join, wall = [], [], []
+            for _ in range(steps):
+                d.step(x, y)
+                tr = d.last_trace
+                stall.append(tr.exposed_stall_s)
+                join.append(tr.exposed_join_s)
+                wall.append(tr.wall_s)
+            for key, xs in (("stall", stall), ("join", join),
+                            ("wall", wall)):
+                xs.sort()
+                out.setdefault("%s_%s_ms" % (mode, key), []).append(
+                    xs[len(xs) // 2] * 1e3)
+            g.close()
+elif regime == "tp":
+    from brpc_tpu.models.tp_layers import TPShardedMLP
+    params = {k: np.asarray(v, np.float32)
+              for k, v in _full.init_params().items()}
+    x, y = _full.data(BATCH, seed=1)
+    x, y = np.asarray(x), np.asarray(y)
+    out = {"tp": []}
+    for rep in range(reps):
+        g = group("tp%d" % rep, n)
+        tp = TPShardedMLP(SIZES, g, params)
+        out["tp"].append(timed(lambda: tp.train_step(x, y)))
+        g.close()
+elif regime in ("pp", "ppdp"):
+    from brpc_tpu.models.pipeline import StagedMLP
+    from brpc_tpu.runtime.pp_sched import PipelineStageDriver, WirePipe
+    dp = 2 if regime == "ppdp" else 1
+    stages = n // dp
+    stage, replica = rank % stages, rank // stages
+    x, y = _full.data(BATCH, seed=1 + replica)
+    x, y = np.asarray(x), np.asarray(y)
+    kw = {}
+    if stage == 0:
+        kw["x"] = x
+    if stage == stages - 1:
+        kw["y"] = y
+    out = {"overlap": [], "serial": []}
+    for rep in range(reps):
+        for mode in ("overlap", "serial"):  # interleaved pair
+            pipe = WirePipe(hub, stage, stages,
+                            tag="%s_%s%d_r%d" % (regime, mode, rep,
+                                                 replica),
+                            emulate_wire_gbps=emu if emu > 0 else None)
+            pipe.sync(timeout_s=60)
+            dpg = group("%sg_%s%d_s%d" % (regime, mode, rep, stage),
+                        dp) if dp > 1 else None
+            drv = PipelineStageDriver(
+                stage, stages, StagedMLP(SIZES, stage, stages, seed=0),
+                pipe, microbatches=MICRO, overlap=(mode == "overlap"),
+                dp_group=dpg)
+            out[mode].append(timed(lambda: drv.step(**kw)))
+            if dpg is not None:
+                dpg.close()
+            pipe.close()
+print(json.dumps({"rank": rank, "rows": out}), flush=True)
+"""
+
+_REGIME_CHILD = r"""
+import json, statistics, subprocess, sys, tempfile
+sys.path.insert(0, ROOT)
+from brpc_tpu.fleet import RegistryHub
+from brpc_tpu.observability import health
+
+regime, n, steps, reps, emu = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]), int(sys.argv[4]),
+                               float(sys.argv[5]))
+health.start_watchdog(tempfile.mkdtemp(prefix="regime_dumps_"))
+MEMBER = "ROOT = %r\n%s" % (ROOT, MEMBER_SRC)
+hub = RegistryHub()
+hub.start()
+try:
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", MEMBER, hub.hostport, regime, str(r),
+         str(n), str(steps), str(reps), str(emu)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for r in range(n)]
+    docs = []
+    try:
+        for p in procs:
+            so, se = p.communicate(timeout=540)
+            if p.returncode != 0 or not so.strip():
+                sys.stderr.write(se[-1500:])
+                raise RuntimeError("regime member failed")
+            docs.append(json.loads(so.strip().splitlines()[-1]))
+    finally:
+        for p in procs:  # never orphan ring/pipe mates
+            if p.poll() is None:
+                p.kill()
+    rows = [d for d in docs if d["rank"] == 0][0]["rows"]
+    row = {"members": n, "steps": steps, "reps": reps}
+    if emu > 0:
+        row["emulated_wire_gbps"] = emu
+    if "overlap" in rows:
+        ratios = sorted(o / s for o, s in zip(rows["overlap"],
+                                              rows["serial"]))
+        row.update({
+            "overlap_sps": round(statistics.median(rows["overlap"]), 2),
+            "serial_sps": round(statistics.median(rows["serial"]), 2),
+            "overlap_vs_serial": round(statistics.median(ratios), 2),
+            "overlap_vs_serial_samples": [round(r, 2) for r in ratios]})
+    if "tp" in rows:
+        row["sps"] = round(statistics.median(rows["tp"]), 2)
+    if "op_stall_ms" in rows:
+        # The T3 delta: the per-chunk trigger removes the mid-step
+        # op-completion STALLS (compute waiting on whole-tensor
+        # reductions before each opt node); the join tail and wall are
+        # published beside it — the honest full picture.
+        # Stall as a DELTA, not a ratio: track-mode stall is ~0 by
+        # construction (no compute node ever waits on the wire), so a
+        # ratio just divides by noise.
+        cuts = sorted(o - t for o, t in zip(rows["op_stall_ms"],
+                                            rows["track_stall_ms"]))
+        walls = sorted(o / t for o, t in zip(rows["op_wall_ms"],
+                                             rows["track_wall_ms"]))
+        row["t3"] = {
+            "op_stall_ms": round(statistics.median(rows["op_stall_ms"]),
+                                 2),
+            "track_stall_ms": round(
+                statistics.median(rows["track_stall_ms"]), 2),
+            "op_join_ms": round(statistics.median(rows["op_join_ms"]),
+                                2),
+            "track_join_ms": round(
+                statistics.median(rows["track_join_ms"]), 2),
+            "op_wall_ms": round(statistics.median(rows["op_wall_ms"]),
+                                2),
+            "track_wall_ms": round(
+                statistics.median(rows["track_wall_ms"]), 2),
+            "stall_cut_ms": round(statistics.median(cuts), 2),
+            "op_vs_track_wall": round(statistics.median(walls), 2),
+            "op_vs_track_wall_samples": [round(r, 2) for r in walls]}
+    print(json.dumps(row))
+finally:
+    hub.stop()
+"""
+
+
+def train_regime_point(steps=4, reps=3, emu_gbps=0.125, timeout=600,
+                       regimes=(("dp", 2), ("pp", 2), ("tp", 2),
+                                ("ppdp", 4))):
+    """steps/s per parallelism regime on one wire-bound model config,
+    serial-vs-overlap pairs where the regime schedules a graph, plus the
+    T3 exposed-wait A/B inside the DP row."""
+    out = {}
+    for regime, n in regimes:
+        code = ("ROOT = %r\nMEMBER_SRC = %r\n%s"
+                % (os.path.dirname(os.path.abspath(__file__)),
+                   _REGIME_MEMBER, _REGIME_CHILD))
+        argv = [sys.executable, "-c", code, regime, str(n), str(steps),
+                str(reps), str(emu_gbps)]
+        for attempt in (0, 1):  # host-pressure retry, see collective_point
+            proc = subprocess.run(  # tpulint: allow(py-blocking)
+                argv, capture_output=True, timeout=timeout, text=True)
+            if proc.returncode == 0 and proc.stdout.strip():
+                break
+            sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"regime child {regime} failed rc={proc.returncode}")
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        key = {"dp": "dp2", "pp": "pp2", "tp": "tp2",
+               "ppdp": "pp2xdp2"}[regime]
+        t3 = row.pop("t3", None)
+        out.setdefault("train_steps_regime", {})[key] = row
+        if t3 is not None:
+            out["t3_track"] = t3
+        msg = ", ".join(f"{k}={v}" for k, v in row.items()
+                        if k.endswith("sps") or k == "overlap_vs_serial")
+        print(f"# regime {key}: {msg}", file=sys.stderr)
+        if t3 is not None:
+            print(f"# t3 track-and-trigger: mid-step stall "
+                  f"{t3['op_stall_ms']}ms -> {t3['track_stall_ms']}ms, "
+                  f"join {t3['op_join_ms']}ms -> {t3['track_join_ms']}ms"
+                  f", wall {t3['op_wall_ms']}ms -> {t3['track_wall_ms']}"
+                  f"ms ({t3['op_vs_track_wall']}x, samples "
+                  f"{t3['op_vs_track_wall_samples']})", file=sys.stderr)
+    return out
+
+
+# Live regime-switch row (ISSUE 20 crown): DP placement -> stage-aligned
+# PP placement over real fleet shards via Migrator.switch_regime, with a
+# trainer pushing throughout. Reports steps lost (pushes that FAILED —
+# the redirect-following client should lose none), the switch duration,
+# the per-step latency around it, and post-switch trajectory parity vs
+# a local replay of the same grad sequence through the server's own
+# update formula. argv: n_tensors size steps_pre steps_post
+_REGIME_SWITCH_CHILD = r"""
+import json, sys, tempfile, threading, time
+sys.path.insert(0, ROOT)
+import numpy as np
+from brpc_tpu.fleet import (FleetClient, FleetServer, Migrator,
+                            RegistryHub)
+from brpc_tpu.fleet.migrator import regime_assignment
+from brpc_tpu.observability import health
+
+n_t, size, pre, post = (int(sys.argv[1]), int(sys.argv[2]),
+                        int(sys.argv[3]), int(sys.argv[4]))
+health.start_watchdog(tempfile.mkdtemp(prefix="rswitch_dumps_"))
+LR, MU = 0.01, 0.9
+names = ["layer%02d" % i for i in range(n_t)]
+rng = np.random.default_rng(11)
+p0 = {k: rng.standard_normal(size).astype(np.float32) for k in names}
+grads = [{k: rng.standard_normal(size).astype(np.float32)
+          for k in names} for _ in range(pre + post)]
+
+hub = RegistryHub()
+hub.start()
+shards = []
+try:
+    for i in range(2):
+        s = FleetServer(hub.hostport, tag="rswitch",
+                        shard_name="rswitch_s%d" % i, ttl_s=2)
+        s.start()
+        shards.append(s)
+    fc = FleetClient(hub.hostport, tag="rswitch", op_deadline_s=30.0)
+    mig = Migrator(hub.hostport, tag="rswitch", window=4)
+    for k in names:
+        fc.install(k, p0[k])
+
+    step_ms, lost = [], 0
+    def train_step(s):
+        global lost
+        t0 = time.monotonic()
+        for k in names:
+            try:
+                fc.push_grad(k, grads[s][k])
+            except Exception:
+                lost += 1
+                return
+        step_ms.append((time.monotonic() - t0) * 1e3)
+
+    for s in range(pre):
+        train_step(s)
+
+    sw = {}
+    def do_switch():
+        asg = regime_assignment(names, [shards[0].addr, shards[1].addr])
+        t0 = time.monotonic()
+        sw["moved"] = mig.switch_regime(asg)
+        sw["ms"] = (time.monotonic() - t0) * 1e3
+        sw["asg"] = asg
+    t = threading.Thread(target=do_switch)
+    t.start()
+    for s in range(pre, pre + post):
+        train_step(s)
+    t.join()
+
+    # Post-switch placement equals the assignment; parity vs a local
+    # replay of every push that LANDED through the server formula.
+    meta = fc.meta()
+    placed = all(meta[k]["shard"] == sw["asg"][k] for k in names)
+    applied = len(step_ms)
+    m = {k: np.zeros(size, np.float32) for k in names}
+    p = {k: p0[k].copy() for k in names}
+    for s in range(applied):
+        for k in names:
+            m[k] = MU * m[k] + grads[s][k]
+            p[k] = p[k] - LR * m[k]
+    delta = 0.0
+    for k in names:
+        _ver, arr = fc.pull(k)
+        delta = max(delta, float(np.abs(np.asarray(arr) - p[k]).max()))
+    pre_ms = sorted(step_ms[:pre])
+    post_ms = sorted(step_ms[pre:])
+    print(json.dumps({
+        "tensors": n_t, "tensor_bytes": size * 4,
+        "steps": pre + post, "steps_lost": lost,
+        "switch_ms": round(sw["ms"], 1), "moved": sw["moved"],
+        "placement_converged": bool(placed),
+        "step_ms_before": round(pre_ms[len(pre_ms) // 2], 1),
+        "step_ms_during_after": round(post_ms[len(post_ms) // 2], 1),
+        "parity_max_delta": delta,
+        "parity_ok": bool(delta < 1e-4)}))
+    mig.stop()
+    fc.close()
+finally:
+    for s in shards:
+        s.stop()
+    hub.stop()
+"""
+
+
+def regime_switch_point(n_tensors=8, nbytes=256 << 10, steps_pre=4,
+                        steps_post=8, timeout=300):
+    """Live DP -> PP ownership switch under push load: steps lost,
+    switch duration, per-step latency impact, post-switch parity."""
+    code = "ROOT = %r\n%s" % (
+        os.path.dirname(os.path.abspath(__file__)),
+        _REGIME_SWITCH_CHILD)
+    argv = [sys.executable, "-c", code, str(n_tensors),
+            str(nbytes // 4), str(steps_pre), str(steps_post)]
+    for attempt in (0, 1):  # host-pressure retry, see collective_point
+        proc = subprocess.run(  # tpulint: allow(py-blocking)
+            argv, capture_output=True, timeout=timeout, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            break
+        sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        raise RuntimeError(
+            f"regime switch child failed rc={proc.returncode}")
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    print(f"# regime_switch: {row['moved']} tensors moved in "
+          f"{row['switch_ms']}ms, {row['steps_lost']} steps lost, "
+          f"step {row['step_ms_before']}ms -> "
+          f"{row['step_ms_during_after']}ms, parity delta "
+          f"{row['parity_max_delta']:.2e} (ok={row['parity_ok']})",
+          file=sys.stderr)
+    return {"regime_switch": row}
+
+
 def smoke() -> None:
     """`make bench-smoke`: a <=10s-scale sanity sweep — one subprocess-
     guarded 64B echo sample plus a 4x1MB pipelined pull point — usable as
@@ -2573,6 +2965,16 @@ def smoke() -> None:
                                     timeout=240))
     except Exception as e:  # noqa: BLE001 - record, don't hang/crash
         out["allreduce_GBps_2s"] = {"error": str(e)}
+    # Guarded regime mini-row: one 2-stage 1F1B overlap-vs-serial pair
+    # over the real wire pipe — if the stage graph, the pipe transport,
+    # or the microbatch grad math breaks, the smoke run shows it before
+    # the full sweep would.
+    try:
+        out.update(train_regime_point(steps=2, reps=1, emu_gbps=0.0,
+                                      timeout=240,
+                                      regimes=(("pp", 2),)))
+    except Exception as e:  # noqa: BLE001 - record, don't hang/crash
+        out["train_steps_regime"] = {"error": str(e)}
     # Guarded spec-decode mini-row: one single-server spec-on/off pair
     # per workload (no fleet) — if the verify window, the acceptance
     # walk, or the k-adaptation regresses the serving hot path, the

@@ -92,14 +92,30 @@ def _detach_u8(blob) -> np.ndarray:
 
 def ring_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
                    link, name: str, codec_name=None,
-                   frag_elems: int = DEFAULT_FRAG_ELEMS) -> np.ndarray:
+                   frag_elems: int = DEFAULT_FRAG_ELEMS,
+                   on_chunk=None) -> np.ndarray:
     """Sum ``x`` across the ring -> fp32 array shaped like ``x``;
     every member returns the IDENTICAL values (the owner of a chunk
     adopts the dequantized form it broadcast, so quantization cannot
-    make members disagree)."""
+    make members disagree).
+
+    ``on_chunk(idx, (offset, length), values)`` — the T3 track-and-
+    trigger hook (ISSUE 20, arXiv 2401.16677): fires on the CALLER's
+    thread the moment chunk ``idx`` reaches its FINAL value, while later
+    chunks are still on the wire. Finality points: the owned chunk fires
+    inside allgather step 0 AFTER the dequantized adoption (firing right
+    after reduce-scatter would hand the trigger a value quantization is
+    about to replace — members would disagree); every other chunk fires
+    as its allgather hop decodes. ``values`` is a detached fp32 copy of
+    the final span; a trigger exception aborts the op like any link
+    failure. The raw SUM is what lands — averaging is the trigger's job,
+    exactly as it is the caller's on the returned array."""
     flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
     if n == 1:
-        return flat.copy().reshape(np.shape(x))
+        out = flat.copy()
+        if on_chunk is not None and out.size:
+            on_chunk(0, (0, out.size), out.copy())
+        return out.reshape(np.shape(x))
     acc = flat.copy()
     spans = ring_mod.chunk_spans(acc.size, n)
     succ = (rank + 1) % n
@@ -149,6 +165,9 @@ def ring_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
                     if fl:
                         acc[ooff + fo:ooff + fo + fl] = codec.decode(
                             meta, blob)
+                if on_chunk is not None and oln:
+                    on_chunk(own, (ooff, oln),
+                             acc[ooff:ooff + oln].copy())
             else:
                 send_frags = fwd  # type: ignore[assignment]
             for f, (meta, blob) in enumerate(send_frags):
@@ -167,6 +186,8 @@ def ring_allreduce(rank: int, n: int, x: np.ndarray, codec: ChunkCodec,
             e.done = _salvage(acc, spans, done)
             raise
         done.add(recv_idx)
+        if on_chunk is not None and rln:
+            on_chunk(recv_idx, (roff, rln), acc[roff:roff + rln].copy())
     return acc.reshape(np.shape(x))
 
 

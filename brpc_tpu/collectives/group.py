@@ -203,11 +203,44 @@ class _RpcLink:
                 if shed == tag:
                     return
 
+    _DRAIN_GRACE_S = 2.5  # stall time before draining our own window
+
     def recv(self, phase: str, step: int,
              frag: int = 0) -> Tuple[int, dict, np.ndarray]:
-        return self.g._mailbox.take(
-            (self.op, self.seq, phase, int(step), int(frag)),
-            self.deadline, abort_event=self.g._left)
+        # A fragment we SENT can fail while we sit here — the window is
+        # async and submit only drains when full, so without this the
+        # error (shed, dead peer, mismatched ring) stays invisible and
+        # both sides of the ring stall until op timeout. A recv that
+        # waits past the grace drains its own outbound window: failures
+        # surface now (shed fragments redeliver paced, anything else
+        # aborts the op promptly), and a healthy-but-slow wire just pays
+        # one flush on an already-stalled path.
+        key = (self.op, self.seq, phase, int(step), int(frag))
+        while True:
+            slice_dl = min(time.monotonic() + self._DRAIN_GRACE_S,
+                           self.deadline)
+            try:
+                return self.g._mailbox.take(key, slice_dl,
+                                            abort_event=self.g._left)
+            except core.CollectiveTimeout:
+                if time.monotonic() >= self.deadline:
+                    raise
+            self._drain_stalled()
+
+    def _drain_stalled(self) -> None:
+        for addr, win in list(self._wins.items()):
+            while win.inflight():
+                try:
+                    win.flush()
+                except native.RpcError as e:
+                    if not e.overloaded:
+                        raise self.g._map_rpc_error(e, "drain", -1)
+                    shed = getattr(e, "pipeline_tag", None)
+                    if shed is not None and \
+                            shed in self._inflight.get(addr, {}):
+                        self._resend_paced(addr, shed, e)
+                    else:
+                        self.g.pacer.note(e)
 
     def close(self, ok: bool) -> None:
         try:
@@ -292,6 +325,7 @@ class CollectiveGroup:
 
         self._mailbox = core.Mailbox()
         self._mu = threading.Lock()
+        self._presync: List[tuple] = []  # chunks held before sync()
         self._members: Tuple[str, ...] = ()
         self._epoch: Optional[int] = None
         self.rank: Optional[int] = None
@@ -363,6 +397,9 @@ class CollectiveGroup:
             self.rank = members.index(self.addr)
             self._left.clear()
             self.left_members = []
+            held, self._presync = self._presync, []
+        if held:
+            self._replay_presync(held)
         return self.rank
 
     @property
@@ -473,6 +510,17 @@ class CollectiveGroup:
             man = groupwire.parse_group(request)
             with self._mu:
                 epoch = self._epoch
+                hold = epoch is None
+            if hold:
+                # Pre-sync: this member registered (so peers can resolve
+                # it) but hasn't frozen its ring yet — a faster peer's
+                # first send can land in that window at every phase/ring
+                # boundary. Rejecting would deadlock the ring until op
+                # timeout (the sender's window never drains the error
+                # while it blocks in recv), so HOLD the chunk and let
+                # sync() replay it against the epoch it freezes.
+                self._stash_presync(man, att)
+                return b"ok", None
             if man.get("ep") != epoch:
                 raise native.RpcError(
                     E_COLL_EPOCH,
@@ -499,6 +547,37 @@ class CollectiveGroup:
             return b"ok", None
         raise native.RpcError(E_NO_SUCH, f"no such method: {method}")
 
+    _PRESYNC_MAX = 256  # held chunks, bounded (oldest dropped)
+
+    def _stash_presync(self, man: dict, att) -> None:
+        payload = att
+        if payload is not None and not isinstance(payload, np.ndarray):
+            payload = np.asarray(payload)
+        # Detach NOW: the attachment view dies with the handler.
+        blob = np.array(payload) if payload is not None else None
+        with self._mu:
+            self._presync.append((man, blob))
+            while len(self._presync) > self._PRESYNC_MAX:
+                self._presync.pop(0)
+
+    def _replay_presync(self, held: list) -> None:
+        """Deposit held pre-sync chunks whose stamp matches the epoch
+        sync() just froze; drop the rest (they keyed a ring this member
+        never joined — deciding that is exactly what the hold deferred)."""
+        for man, blob in held:
+            if man.get("ep") != self._epoch:
+                continue
+            try:
+                pairs = list(groupwire.split_group(man, blob))
+            except ValueError:
+                continue  # undecodable held chunk: op-level abort covers it
+            key = (man["op"], int(man["seq"]), man["ph"],
+                   int(man["step"]), int(man.get("frag", 0)))
+            for entry, run in pairs:
+                self._mailbox.deposit(
+                    key, (int(entry.get("idx", 0)), entry,
+                          run if run is not None else np.empty(0, np.uint8)))
+
     # ---- the collectives ----
 
     def _next_seq(self, name: str) -> int:
@@ -521,12 +600,18 @@ class CollectiveGroup:
         return members
 
     def allreduce(self, name: str, array, timeout_s: Optional[float] = None,
-                  algo: str = "auto") -> np.ndarray:
+                  algo: str = "auto", on_chunk=None) -> np.ndarray:
         """Sum ``array`` across the frozen ring -> fp32 ndarray; every
         member returns identical values. ``algo``: ``"ring"``,
         ``"tree"``, or ``"auto"`` (tree at or below ``tree_max_bytes``).
         All members must call with the same ``name`` in the same order
-        (the sequence number that pairs the ops derives from it)."""
+        (the sequence number that pairs the ops derives from it).
+
+        ``on_chunk(idx, (offset, length), values)`` — per-chunk finality
+        trigger over the flattened array (:func:`core.ring_allreduce`'s
+        T3 hook). Only the ring schedule has sub-array finality; the
+        tree (and n==1) path fires the trigger ONCE with the whole span
+        at completion, so callers get a uniform contract either way."""
         members = self._pre_op(name)
         n = len(members)
         host = np.ascontiguousarray(np.asarray(array), dtype=np.float32)
@@ -561,11 +646,15 @@ class CollectiveGroup:
                     out = core.tree_allreduce(self.rank, n, host,
                                               self.chunk_codec, link,
                                               name, codec_name)
+                    if on_chunk is not None and out.size:
+                        on_chunk(0, (0, out.size),
+                                 out.reshape(-1).copy())
                 elif algo == "ring":
                     out = core.ring_allreduce(self.rank, n, host,
                                               self.chunk_codec, link,
                                               name, codec_name,
-                                              frag_elems=self.frag_elems)
+                                              frag_elems=self.frag_elems,
+                                              on_chunk=on_chunk)
                 else:
                     raise ValueError(f"unknown algo {algo!r}")
                 ok = True

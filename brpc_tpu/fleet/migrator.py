@@ -86,6 +86,23 @@ class ReshardPlan:
         return sum(m.nbytes for m in self.moves)
 
 
+def regime_assignment(names: List[str],
+                      stage_owners: List[str]) -> Dict[str, str]:
+    """The stage-aligned override map for a parallelism-regime switch
+    (ISSUE 20): pipeline stage ``s`` owns the contiguous layer slice
+    ``pp_sched.stage_layers`` assigns it, so every name the stage's
+    driver pulls lives on that stage's parameter server — the map
+    ``Migrator.switch_regime`` converges placement onto."""
+    from brpc_tpu.runtime.pp_sched import stage_layers
+
+    spans = stage_layers(len(names), len(stage_owners))
+    out: Dict[str, str] = {}
+    for s, (lo, hi) in enumerate(spans):
+        for n in names[lo:hi]:
+            out[n] = stage_owners[s]
+    return out
+
+
 def plan_reshard(placement: Dict[str, dict], target: ShardMap) -> ReshardPlan:
     """Minimal movement set from OBSERVED placement.
 
@@ -273,6 +290,32 @@ class Migrator:
             self._resharding = 0
             self._moving = self.stuck_moves
         return moved
+
+    def switch_regime(self, assignment: Dict[str, str],
+                      index: Optional[int] = None,
+                      addrs: Optional[List[str]] = None) -> int:
+        """Live parallelism-regime switch (ISSUE 20): repoint ownership
+        to a name->addr map (``regime_assignment`` builds the
+        stage-aligned one) and converge placement onto it. Returns
+        tensors moved.
+
+        Deliberately NOT a new redistribution protocol: the map becomes
+        this Migrator's standing overrides (later watch-triggered
+        reshards keep honoring it — a member bounce mid-regime must not
+        silently revert to ketama placement), and the move itself is an
+        ordinary ``reshard`` pass — minimal owner-diff plan, per-link
+        ``PipelineWindow`` streams, the two-phase
+        Handoff/Install/Retire/Commit the ParameterServer enforces. A
+        Handoff ships the stacked ``[param, momentum]`` pair at its
+        version, so optimizer state rides the switch for free and the
+        post-switch trajectory stays on the pre-switch one (parity is
+        pinned in the bench's regime_switch row). Training steps lost =
+        however many steps the caller pauses around this call — the
+        freeze is per tensor inside the stream, so pushes racing the
+        switch fail fast with "frozen"/"moved:<dst>" rather than
+        landing on a stale owner."""
+        self._overrides = dict(assignment)
+        return self.reshard(index, addrs)
 
     def _observe_and_plan(self, probe: List[str],
                           target: ShardMap) -> ReshardPlan:

@@ -1,0 +1,154 @@
+"""Pipeline-stage harness: a contiguous slice of the LayeredMLP stack
+(ISSUE 20).
+
+``StagedMLP`` is the model half of the pipeline regime: stage ``s`` owns
+layers ``stage_layers(L, S)[s]`` of the SAME stack ``LayeredMLP`` trains
+whole, reusing the same jitted per-layer kernels — so PP trajectory
+parity against the single-process baseline is a statement about the
+schedule and the wire, not about reimplemented math. The only new
+arithmetic is at stage boundaries: the backward recurrence
+``delta_prev = (delta @ W.T) * (z_prev > 0)`` splits across the link —
+the upstream stage ships the unmasked ``delta @ W.T`` (it does not hold
+``z_prev``), and the downstream stage applies its own relu mask. Same
+fp32 ops in the same order, two jits instead of one.
+
+Gradient scaling: each microbatch's loss is a mean over ITS rows, so
+averaging the per-microbatch grads (the driver divides the accumulated
+sum by M) equals the full-batch gradient exactly in real arithmetic —
+in fp32 the partial-sum reassociation leaves ~1e-6-relative noise, the
+documented parity tolerance.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from brpc_tpu.models.tensor_service import (LayeredMLP, _delta_prev,
+                                            _fwd_jit, _grad_w, _loss_jit)
+from brpc_tpu.runtime.pp_sched import stage_layers
+
+
+@jax.jit
+def _delta_in(delta: jax.Array, w: jax.Array) -> jax.Array:
+    # The boundary ship: dL/d(a_in) WITHOUT the relu mask — the mask
+    # belongs to the downstream stage's own z (it holds z, we don't).
+    return jnp.dot(delta, w.T)
+
+
+@jax.jit
+def _mask_delta(grad_in: jax.Array, z: jax.Array) -> jax.Array:
+    return grad_in * (z > 0)
+
+
+class StagedMLP:
+    """One stage's slice of ``LayeredMLP(sizes, seed=seed)``.
+
+    Implements the :class:`~brpc_tpu.runtime.pp_sched.PipelineStageDriver`
+    harness contract: ``names`` / ``params()`` / ``set_param`` /
+    ``set_batch`` / ``fwd`` / ``bwd`` / ``take_grads`` / ``take_loss``.
+    Parameters are held as fp32 numpy masters (the driver's momentum
+    update is numpy); jax arrays are minted per call, exactly like the
+    collective DP driver's prime/step discipline.
+    """
+
+    def __init__(self, sizes, stage: int, stages: int, seed: int = 0):
+        full = LayeredMLP(sizes, seed=seed)
+        self.sizes = list(sizes)
+        self.stage = stage
+        self.stages = stages
+        lo, hi = stage_layers(len(full.names), stages)[stage]
+        self._lo, self._hi = lo, hi
+        self.names: List[str] = full.names[lo:hi]
+        self._n_layers = len(full.names)
+        init = full.init_params()
+        self._params: Dict[str, np.ndarray] = {
+            n: np.asarray(init[n], np.float32) for n in self.names}
+        self._ctx: Dict[int, dict] = {}
+        self._x_mb: List[np.ndarray] = []
+        self._y_mb: List[np.ndarray] = []
+        self._gsum: Dict[str, np.ndarray] = {}
+        self._loss_sum = 0.0
+
+    # -- driver contract: parameters --
+
+    def params(self) -> Dict[str, np.ndarray]:
+        return self._params
+
+    def set_param(self, name: str, arr) -> None:
+        self._params[name] = np.asarray(arr, np.float32)
+
+    # -- driver contract: data --
+
+    def set_batch(self, x=None, y=None, microbatches: int = 1) -> None:
+        if x is not None:
+            if x.shape[0] % microbatches:
+                raise ValueError(
+                    f"batch {x.shape[0]} not divisible by "
+                    f"{microbatches} microbatches")
+            self._x_mb = list(np.split(x, microbatches))
+        if y is not None:
+            if y.shape[0] % microbatches:
+                raise ValueError(
+                    f"batch {y.shape[0]} not divisible by "
+                    f"{microbatches} microbatches")
+            self._y_mb = list(np.split(y, microbatches))
+
+    # -- driver contract: compute --
+
+    def fwd(self, mb: int, a_in) -> Optional[np.ndarray]:
+        a = jnp.asarray(self._x_mb[mb] if self.stage == 0 else a_in)
+        acts, zs = [a], []
+        for k, name in enumerate(self.names):
+            gk = self._lo + k
+            a, z = _fwd_jit(a, jnp.asarray(self._params[name]),
+                            last=(gk == self._n_layers - 1))
+            zs.append(z)
+            acts.append(a)
+        ctx = {"acts": acts, "zs": zs}
+        if self.stage == self.stages - 1:
+            loss, delta = _loss_jit(a, jnp.asarray(self._y_mb[mb]))
+            ctx["delta"] = delta
+            self._loss_sum += float(loss)
+            out = None
+        else:
+            out = np.asarray(a)
+        self._ctx[mb] = ctx
+        return out
+
+    def bwd(self, mb: int, grad_in) -> Optional[np.ndarray]:
+        ctx = self._ctx.pop(mb)
+        if self.stage == self.stages - 1:
+            delta = ctx["delta"]
+        else:
+            # Our top layer is never the global head, so it carries a
+            # relu whose mask we apply to the shipped boundary grad.
+            delta = _mask_delta(jnp.asarray(grad_in), ctx["zs"][-1])
+        for k in range(len(self.names) - 1, -1, -1):
+            name = self.names[k]
+            g = np.asarray(_grad_w(ctx["acts"][k], delta))
+            if name in self._gsum:
+                self._gsum[name] = self._gsum[name] + g
+            else:
+                self._gsum[name] = g
+            if k > 0:
+                delta = _delta_prev(delta,
+                                    jnp.asarray(self._params[name]),
+                                    ctx["zs"][k - 1])
+        if self.stage > 0:
+            return np.asarray(_delta_in(
+                delta, jnp.asarray(self._params[self.names[0]])))
+        return None
+
+    # -- driver contract: step results --
+
+    def take_grads(self) -> Dict[str, np.ndarray]:
+        out, self._gsum = self._gsum, {}
+        return out
+
+    def take_loss(self) -> float:
+        out, self._loss_sum = self._loss_sum, 0.0
+        return out

@@ -453,11 +453,30 @@ class CollectiveStepDriver:
     :class:`~brpc_tpu.collectives.core.CollectiveAborted` with the full
     graph post-mortem on ``.step_failure`` — the caller re-``sync()``\\ s
     the group and resumes on the surviving ring.
+
+    ``track=True`` — T3 track-and-trigger (ISSUE 20, arXiv 2401.16677):
+    instead of an ``opt:k`` node that waits for layer k's WHOLE
+    allreduce, the momentum update rides the collective's per-chunk
+    finality hook (``on_chunk``) — each reduced span is applied the
+    moment it lands, while later chunks of the same layer are still on
+    the wire, so by op completion the optimizer is already done and the
+    op-completion ``opt:k`` nodes vanish from the graph. The per-chunk
+    update is deliberately NUMPY (the param-server formula ``m' =
+    beta*m + g; p' = p - lr*m'``), not the jitted fused kernel: the
+    trigger fires on a WIRE lane, and jax dispatch off the caller's
+    thread is the PR 6 contention class (now a tpulint finding —
+    ``regime-graph``). Trajectory: chunkwise-numpy == whole-array-numpy
+    exactly (elementwise math over a partition); numpy-vs-fused parity
+    carries the usual fp32 tolerance, pinned in tests. The delta shows
+    in ``RunTrace``: the compute lane no longer stalls on, or joins
+    behind, tail-layer optimizer waits (``exposed_stall_s`` /
+    ``exposed_join_s``).
     """
 
     def __init__(self, group, harness, overlap: bool = True,
                  wire_lanes: int = 2, lr: float = 0.01,
-                 momentum: float = 0.9, average: bool = True):
+                 momentum: float = 0.9, average: bool = True,
+                 track: bool = False):
         self.group = group
         self.harness = harness
         self.overlap = overlap
@@ -465,11 +484,15 @@ class CollectiveStepDriver:
         self.lr = lr
         self.momentum = momentum
         self.average = average
+        self.track = track
         self._params: Dict[str, object] = {}   # numpy fp32 masters
         self._momenta: Dict[str, object] = {}
         self._m = _metrics()
         self.last_stats: Optional[dict] = None
         self.last_trace = None
+        # track mode: {name: [(chunk_idx, (offset, length)), ...]} of the
+        # last step, in firing order — the tests' view of the trigger.
+        self.last_chunk_log: Dict[str, list] = {}
         self.totals = {"steps": 0, "wall_ms": 0.0, "compute_ms": 0.0,
                        "wire_busy_ms": 0.0, "exposed_comm_ms": 0.0,
                        "overlapped_comm_ms": 0.0}
@@ -534,6 +557,41 @@ class CollectiveStepDriver:
                 return None
             return fn
 
+        def make_allreduce_tracked(name):
+            def fn(done):
+                g = np.asarray(grads[name])  # D2H on the wire lane
+                shape = np.shape(self._params[name])
+                # Copy-on-write: update fresh flats, install when the op
+                # lands — handed-out arrays stay immutable, and a failed
+                # op leaves params/momenta untouched.
+                pf = np.array(self._params[name],
+                              dtype=np.float32).reshape(-1)
+                mf = np.array(self._momenta[name],
+                              dtype=np.float32).reshape(-1)
+                chunk_log = self.last_chunk_log.setdefault(name, [])
+                chunk_log.clear()
+                inv = np.float32(1.0 / world)
+
+                def on_chunk(idx, span, vals):
+                    # Numpy on purpose — this runs on a WIRE lane (see
+                    # class docstring / the regime-graph lint rule).
+                    off, ln = span
+                    gc = vals * inv if self.average else vals
+                    mf[off:off + ln] = (np.float32(self.momentum)
+                                        * mf[off:off + ln] + gc)
+                    pf[off:off + ln] -= np.float32(self.lr) \
+                        * mf[off:off + ln]
+                    chunk_log.append((idx, span))
+
+                red = self.group.allreduce(name, g, on_chunk=on_chunk)
+                if self.average:
+                    red /= np.float32(world)
+                reduced[name] = red
+                self._momenta[name] = mf.reshape(shape)
+                self._params[name] = pf.reshape(shape)
+                return None
+            return fn
+
         def make_opt(name):
             def fn(done):
                 # ONE jitted fused-momentum-update call over the reduced
@@ -563,16 +621,19 @@ class CollectiveStepDriver:
             prev = graph.add(f"bwd:{name}",
                              traced(f"step/bwd:{name}", make_bwd(name)),
                              deps=(prev,), lane=COMPUTE)
+        mk_ar = make_allreduce_tracked if self.track else make_allreduce
         for k, name in enumerate(rev):
             graph.add(f"allreduce:{name}",
-                      traced(f"step/allreduce:{name}",
-                             make_allreduce(name)),
+                      traced(f"step/allreduce:{name}", mk_ar(name)),
                       deps=(f"bwd:{name}",),
                       lane=f"wire:ar{k % self.wire_lanes}")
-        for name in rev:
-            graph.add(f"opt:{name}",
-                      traced(f"step/opt:{name}", make_opt(name)),
-                      deps=(f"allreduce:{name}",), lane=COMPUTE)
+        if not self.track:
+            # Track mode has no opt nodes: the momentum update already
+            # happened per chunk inside each allreduce as spans landed.
+            for name in rev:
+                graph.add(f"opt:{name}",
+                          traced(f"step/opt:{name}", make_opt(name)),
+                          deps=(f"allreduce:{name}",), lane=COMPUTE)
 
         with tracing.trace_span("train_step"):
             tid, sid = tracing.current_trace()

@@ -153,6 +153,17 @@ class RunTrace:
     join) — the step's EXPOSED communication. Overlapped communication
     is ``wire_busy_s - exposed_wait_s`` clamped at zero: wire time that
     ran in compute's shadow.
+
+    ``exposed_wait_s`` further splits into ``exposed_stall_s`` (mid-step:
+    the compute lane parked in ``cond.wait`` with nothing ready) plus
+    ``exposed_join_s`` (the end-of-step barrier: wire work still draining
+    after the last compute node). The split matters because they have
+    different cures — a stall means a dependency chain is too eager, a
+    join tail means the LAST wire ops have nothing left to hide behind;
+    track-and-trigger fusion (ISSUE 20) attacks exactly the join tail,
+    and only this split makes its delta attributable. ``lane_join_s``
+    attributes the join tail per wire lane: how far past the barrier each
+    lane's last node ended.
     """
 
     def __init__(self, overlap: bool):
@@ -161,6 +172,9 @@ class RunTrace:
         self.wire_busy_s = 0.0
         self.lane_busy_s: Dict[str, float] = {}
         self.exposed_wait_s = 0.0
+        self.exposed_stall_s = 0.0
+        self.exposed_join_s = 0.0
+        self.lane_join_s: Dict[str, float] = {}
         self.compute_busy_s = 0.0
         self.wall_s = 0.0
 
@@ -282,7 +296,9 @@ def run_graph(graph: StepGraph, overlap: bool = True,
                     t0 = time.monotonic()
                     cond.wait()
                     if count_wait:
-                        trace.exposed_wait_s += time.monotonic() - t0
+                        dt = time.monotonic() - t0
+                        trace.exposed_wait_s += dt
+                        trace.exposed_stall_s += dt
                     node = (None if aborted[0]
                             else _pop_ready_locked(lane))
             t0 = time.monotonic()
@@ -348,7 +364,12 @@ def run_graph(graph: StepGraph, overlap: bool = True,
     t_join = time.monotonic()
     for t in wire_threads:
         t.join()
-    trace.exposed_wait_s += time.monotonic() - t_join
+    trace.exposed_join_s = time.monotonic() - t_join
+    trace.exposed_wait_s += trace.exposed_join_s
+    for ln in wire_lanes:
+        ends = [e for (_n, lane, _s, e) in trace.events if lane == ln]
+        trace.lane_join_s[ln] = max(0.0, (max(ends) if ends else t_join)
+                                    - t_join)
     trace.wall_s = time.monotonic() - t_start
     if failed:
         raise StepFailure(failed, sorted(cancelled),
@@ -383,8 +404,11 @@ def _run_serial(graph: StepGraph, trace: RunTrace) -> Dict[str, object]:
                 trace.lane_busy_s.get(node.lane, 0.0) + (t1 - t0))
         else:
             trace.compute_busy_s += t1 - t0
-    # Serial mode hides nothing: every wire second is exposed step time.
+    # Serial mode hides nothing: every wire second is exposed step time,
+    # all of it inline stall (there is no join barrier to attribute).
     trace.exposed_wait_s = trace.wire_busy_s
+    trace.exposed_stall_s = trace.wire_busy_s
+    trace.exposed_join_s = 0.0
     if failed:
         raise StepFailure(failed, cancelled, done)
     return done

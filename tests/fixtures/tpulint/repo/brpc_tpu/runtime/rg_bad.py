@@ -1,0 +1,65 @@
+"""regime-graph fixture: jax dispatch scheduled onto a WIRE lane."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from brpc_tpu.runtime.step_sched import COMPUTE, WIRE, StepGraph
+
+LANE_OPT = "wire:opt"
+
+
+def build(group, params, momenta, grads, lr):
+    graph = StepGraph()
+
+    def make_allreduce(name):
+        def fn(done):
+            red = group.allreduce(name, np.asarray(grads[name]))
+            grads[name] = red
+            return None
+        return fn
+
+    def make_opt(name):
+        def fn(done):
+            # BAD: jitted update dispatched from a wire-lane node.
+            m2 = jnp.asarray(momenta[name]) * 0.9 + jnp.asarray(
+                grads[name])
+            p2 = jnp.asarray(params[name]) - lr * m2
+            params[name] = jax.block_until_ready(p2)
+            return None
+        return fn
+
+    for name in params:
+        graph.add(f"allreduce:{name}", make_allreduce(name),
+                  lane=WIRE)
+        # direct constant lane string
+        graph.add(f"opt:{name}", make_opt(name),
+                  deps=(f"allreduce:{name}",), lane="wire:opt0")
+        # lane via module-level constant
+        graph.add(f"opt2:{name}", make_opt(name),
+                  deps=(f"allreduce:{name}",), lane=LANE_OPT)
+    return graph
+
+
+def build_selector(group, params, grads, track):
+    graph = StepGraph()
+
+    def make_plain(name):
+        def fn(done):
+            grads[name] = group.allreduce(name, np.asarray(grads[name]))
+            return None
+        return fn
+
+    def make_jitted(name):
+        def fn(done):
+            grads[name] = jax.block_until_ready(
+                jnp.asarray(grads[name]) * 0.5)
+            return None
+        return fn
+
+    mk = make_jitted if track else make_plain
+    for name in params:
+        # BAD through the selector assignment: one branch dispatches.
+        graph.add(f"ar:{name}", mk(name), lane=f"wire:ar{len(name)}")
+    graph.add("fwd", make_jitted("fwd"), lane=COMPUTE)  # compute: fine
+    return graph

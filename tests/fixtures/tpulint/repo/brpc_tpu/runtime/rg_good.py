@@ -1,0 +1,59 @@
+"""regime-graph fixture, clean twin: wire lanes stay numpy; the jax
+dispatch rides the COMPUTE lane (a dependent node), which runs on the
+caller's thread — the step_sched contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from brpc_tpu.runtime.step_sched import COMPUTE, WIRE, StepGraph
+
+
+def build(group, params, momenta, grads, lr):
+    graph = StepGraph()
+
+    def make_allreduce(name):
+        def fn(done):
+            # numpy-only on the wire lane: D2H + the collective wait.
+            red = group.allreduce(name, np.asarray(grads[name]))
+            grads[name] = red / np.float32(group.world)
+            return None
+        return fn
+
+    def make_tracked(name):
+        def fn(done):
+            pf = np.array(params[name], dtype=np.float32)
+            mf = np.array(momenta[name], dtype=np.float32)
+
+            def on_chunk(idx, span, vals):
+                off, ln = span
+                mf[off:off + ln] = 0.9 * mf[off:off + ln] + vals
+                pf[off:off + ln] -= lr * mf[off:off + ln]
+
+            group.allreduce(name, np.asarray(grads[name]),
+                            on_chunk=on_chunk)
+            params[name], momenta[name] = pf, mf
+            return None
+        return fn
+
+    def make_opt(name):
+        def fn(done):
+            # jitted update on COMPUTE: dispatch stays on the caller's
+            # thread.
+            m2 = jnp.asarray(momenta[name]) * 0.9 + jnp.asarray(
+                grads[name])
+            p2 = jnp.asarray(params[name]) - lr * m2
+            params[name] = jax.block_until_ready(p2)
+            return None
+        return fn
+
+    for name in params:
+        graph.add(f"allreduce:{name}", make_allreduce(name), lane=WIRE)
+        graph.add(f"track:{name}", make_tracked(name),
+                  lane=f"wire:t{len(name)}")
+        graph.add(f"opt:{name}", make_opt(name),
+                  deps=(f"allreduce:{name}",), lane=COMPUTE)
+        # suppressed: a justified wire-lane dispatch keeps its allow.
+        graph.add(f"optx:{name}", make_opt(name),  # tpulint: allow(regime-graph)
+                  deps=(f"allreduce:{name}",), lane=WIRE)
+    return graph

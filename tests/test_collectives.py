@@ -991,3 +991,60 @@ def test_collective_driver_member_death_partial_salvage(coll_env):
     finally:
         for g in groups[:1]:
             g.close()
+
+
+def test_presync_chunk_held_and_replayed_at_sync(coll_env):
+    """A chunk landing between registration and sync() — the faster
+    peer's first send at every phase/ring boundary — must be HELD and
+    replayed against the epoch sync() freezes, not rejected: the
+    sender's async window only surfaces errors on its next drain, which
+    never comes while it blocks in recv, so a rejection deadlocks both
+    sides of the ring until op timeout. Chunks stamped with a ring this
+    member never joins stay dropped, and post-sync mismatches still
+    answer E_COLL_EPOCH (the mis-reduce guard is untouched)."""
+    import zlib
+
+    from brpc_tpu.collectives import core
+    from brpc_tpu.collectives.group import CollectiveGroup
+    from brpc_tpu.runtime import groupwire
+    from brpc_tpu.runtime import native
+
+    g = CollectiveGroup(coll_env["hub"].hostport, tag="presync")
+    try:
+        assert g.epoch is None
+        ep = zlib.crc32("|".join([g.addr]).encode())  # what sync freezes
+        payload = np.arange(16, dtype=np.float32)
+
+        def chunk(epoch_stamp, step):
+            man, concat = groupwire.pack_group(
+                [{"idx": 0}], [payload.view(np.uint8)],
+                extra={"op": "t", "seq": 0, "ph": "rs", "step": step,
+                       "frag": 0, "ep": epoch_stamp, "src": 1})
+            return man, concat
+
+        # Pre-sync: both a matching and a foreign-ring chunk are held.
+        for stamp, step in [(ep, 0), (12345, 1)]:
+            man, concat = chunk(stamp, step)
+            resp, _ = g._handle("Chunk", man, concat)
+            assert resp == b"ok"
+
+        assert g.sync(expect=1, timeout_s=20) == 0
+        assert g.epoch == ep
+
+        # The matching chunk was replayed into the mailbox...
+        idx, _entry, blob = g._mailbox.take(
+            ("t", 0, "rs", 0, 0), time.monotonic() + 5)
+        assert idx == 0
+        np.testing.assert_array_equal(
+            blob.view(np.float32), payload)
+        # ...the foreign-ring chunk was dropped.
+        with pytest.raises(core.CollectiveTimeout):
+            g._mailbox.take(("t", 0, "rs", 1, 0), time.monotonic() + 0.3)
+
+        # Post-sync, a mismatched stamp still answers E_COLL_EPOCH.
+        man, concat = chunk(99999, 2)
+        with pytest.raises(native.RpcError) as ei:
+            g._handle("Chunk", man, concat)
+        assert ei.value.code == core.E_COLL_EPOCH
+    finally:
+        g.close()

@@ -479,3 +479,105 @@ def test_kill_shard_mid_pull_recovers(fleet_env):
                 s.stop()
             except Exception:  # noqa: BLE001 — victim already dead
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Parallelism-regime switch (ISSUE 20).
+# ---------------------------------------------------------------------------
+
+def test_regime_assignment_is_stage_aligned():
+    from brpc_tpu.fleet.migrator import regime_assignment
+
+    names = [f"layer{k:02d}" for k in range(5)]
+    a, b = "10.0.0.1:8000", "10.0.0.2:8000"
+    # stage_layers(5, 2) front-loads the remainder: (0, 3), (3, 5).
+    assert regime_assignment(names, [a, b]) == {
+        "layer00": a, "layer01": a, "layer02": a,
+        "layer03": b, "layer04": b}
+    assert set(regime_assignment(names, [a]).values()) == {a}
+
+
+def test_plan_reshard_regime_switch_is_exact_owner_diff():
+    """DP -> PP repointing is NOT a new protocol: regime_assignment
+    becomes overrides on an otherwise-ordinary target map, and the plan
+    is exactly the owner diff — names already on their stage's shard
+    don't move, nothing is repaired or retired."""
+    from brpc_tpu.fleet.migrator import regime_assignment
+
+    addrs = _addrs(4)
+    names = [f"layer{k:02d}" for k in range(12)]
+    ketama = ShardMap(addrs, epoch=3)
+    entry = {"shape": [64], "dtype": "float32", "version": 1}
+    placement = {a: {} for a in addrs}
+    for n in names:
+        placement[ketama.owner(n)][n] = dict(entry)
+    asg = regime_assignment(names, [addrs[0], addrs[1]])
+    plan = plan_reshard(placement, ShardMap(addrs, epoch=4,
+                                            overrides=asg))
+    expected = {n for n in names if ketama.owner(n) != asg[n]}
+    assert expected, "pick sizes so the switch actually moves something"
+    assert {m.name for m in plan.moves} == expected
+    for m in plan.moves:
+        assert m.src == ketama.owner(m.name) and m.dst == asg[m.name]
+    assert not plan.repairs and not plan.stale
+
+
+def test_switch_regime_live_momentum_continuity(fleet_env):
+    """Live DP -> PP ownership switch over real shards: placement
+    converges onto the stage assignment, a second pass moves nothing
+    (the overrides are standing), versions never regress, and a
+    post-switch push continues the PRE-switch optimizer trajectory —
+    the Handoff shipped [param, momentum] stacked, so momentum rode
+    the move."""
+    from brpc_tpu.fleet import FleetClient, Migrator
+    from brpc_tpu.fleet.migrator import regime_assignment
+
+    lr, mu, size = 0.01, 0.9, 512
+    names = [f"layer{k:02d}" for k in range(8)]
+    shards = _fleet(fleet_env, "regime", 2)
+    fc = FleetClient(fleet_env["hub"].hostport, tag="regime",
+                     op_deadline_s=20.0)
+    mig = Migrator(fleet_env["hub"].hostport, tag="regime", window=4)
+    try:
+        rng = np.random.default_rng(7)
+        p = {n: rng.standard_normal(size).astype(np.float32)
+             for n in names}
+        g1 = {n: rng.standard_normal(size).astype(np.float32)
+              for n in names}
+        g2 = {n: rng.standard_normal(size).astype(np.float32)
+              for n in names}
+        for n in names:
+            fc.install(n, p[n])
+            fc.push_grad(n, g1[n])
+        # Predicted post-push state (the server's own formula).
+        m = {n: g1[n].copy() for n in names}  # momentum started at 0
+        p = {n: p[n] - lr * m[n] for n in names}
+        pre_versions = {k: v["version"] for k, v in fc.meta().items()}
+
+        asg = regime_assignment(names, [shards[0].addr, shards[1].addr])
+        moved = mig.switch_regime(asg)
+        ketama_owner = {k: v["shard"] for k, v in fc.meta().items()}
+        assert moved >= 1, "a 2-shard ketama map never matches stages?"
+        assert ketama_owner == asg, "placement must equal the assignment"
+        assert mig.switch_regime(asg) == 0, (
+            "standing overrides: an immediate second pass is a no-op")
+
+        # Versions monotonic across the move; momentum continuity via
+        # one more push routed through the E_MOVED forwarding chain.
+        for n in names:
+            ver, arr = fc.pull(n)
+            assert ver >= pre_versions[n]
+            np.testing.assert_allclose(np.asarray(arr), p[n],
+                                       rtol=1e-5, atol=1e-7)
+            fc.push_grad(n, g2[n])
+            m[n] = mu * m[n] + g2[n]
+            p[n] = p[n] - lr * m[n]
+            ver2, arr2 = fc.pull(n)
+            assert ver2 > ver
+            np.testing.assert_allclose(np.asarray(arr2), p[n],
+                                       rtol=1e-5, atol=1e-7)
+    finally:
+        mig.stop()
+        fc.close()
+        for s in shards:
+            s.stop()

@@ -1,0 +1,425 @@
+"""Pipeline + tensor-parallel regimes and the T3 track-and-trigger hook
+(ISSUE 20 acceptance surface).
+
+Tier-1 pure (no native lib needed):
+  * the 1F1B closed-form bubble count equals the slot simulator at every
+    (stages, microbatches) shape;
+  * a stage graph's dependency order equals the serial schedule
+    (``overlap=False`` runs exactly ``stage_node_order``);
+  * a stage op failure cancels exactly its transitive dependents;
+  * 2-stage PP over ``MemoryPipe`` trains to trajectory parity with the
+    single-process ``LayeredMLP`` baseline (documented fp32 tolerance:
+    per-microbatch partial sums reassociate — ~1e-5 relative);
+  * the RunTrace exposed-wait split: ``exposed_wait_s`` == stall + join,
+    join attributable per wire lane, zero join in serial mode;
+  * T3 per-chunk finality over the pure LocalRing: spans partition the
+    array, values equal the final reduced spans, the tracked
+    CollectiveStepDriver matches the op-completion driver's trajectory.
+
+Native half (skips cleanly without libbrpc_tpu.so): 2 stages over
+``WirePipe`` — registry discovery, typed-tensor shipping — reproduce the
+MemoryPipe trajectory exactly (the wire ships fp32 verbatim).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu.runtime import pp_sched
+from brpc_tpu.runtime.pp_sched import (MemoryPipe, PipelineStageDriver,
+                                       PipeTimeout, bubble_fraction,
+                                       bubble_slots, build_stage_graph,
+                                       simulate_slots, stage_layers,
+                                       stage_node_order, stage_schedule,
+                                       warmup_count)
+from brpc_tpu.runtime.step_sched import (COMPUTE, StepFailure, StepGraph,
+                                         WIRE, run_graph)
+
+SIZES = [32, 48, 40, 24, 16]
+LR, MU = 0.01, 0.9
+
+
+# ---------------------------------------------------------------------------
+# Schedule math.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("s,m", [(1, 1), (1, 4), (2, 1), (2, 2), (2, 4),
+                                 (2, 8), (3, 1), (3, 3), (3, 6), (4, 2),
+                                 (4, 4), (4, 8), (5, 10), (6, 6)])
+def test_bubble_closed_form_matches_simulator(s, m):
+    """The closed form is pinned against ground truth, not derived twice:
+    the simulator executes every stage's 1F1B order under the real
+    cross-stage deps and counts idle slots."""
+    sim = simulate_slots(s, m)
+    assert sim["makespan"] == 2 * (m + s - 1)
+    assert sim["total_idle"] == bubble_slots(s, m)
+    # Every stage idles the same 2*(S-1) slots, so the per-stage idle
+    # fraction is the closed-form bubble fraction.
+    for idle in sim["idle"]:
+        assert idle == 2 * (s - 1)
+        assert idle / sim["makespan"] == pytest.approx(
+            bubble_fraction(s, m))
+
+
+def test_stage_schedule_is_1f1b():
+    s, m = 4, 8
+    for stage in range(s):
+        sched = stage_schedule(stage, s, m)
+        assert len(sched) == 2 * m
+        assert [x for x in sched if x[0] == "fwd"] == [
+            ("fwd", i) for i in range(m)]
+        assert [x for x in sched if x[0] == "bwd"] == [
+            ("bwd", i) for i in range(m)]
+        w = warmup_count(stage, s, m)
+        assert sched[:w] == [("fwd", i) for i in range(w)]
+        # 1F1B's memory property: live activations (forwards whose
+        # backward hasn't run) never exceed warmup + 1.
+        live = 0
+        for kind, _mb in sched:
+            live += 1 if kind == "fwd" else -1
+            assert live <= w + 1
+    # Last stage: zero warmup, strict alternation.
+    assert stage_schedule(s - 1, s, m)[:4] == [
+        ("fwd", 0), ("bwd", 0), ("fwd", 1), ("bwd", 1)]
+
+
+def test_stage_layers_balanced_contiguous():
+    assert stage_layers(4, 2) == [(0, 2), (2, 4)]
+    assert stage_layers(5, 2) == [(0, 3), (3, 5)]
+    assert stage_layers(7, 3) == [(0, 3), (3, 5), (5, 7)]
+    with pytest.raises(ValueError):
+        stage_layers(2, 3)
+
+
+# ---------------------------------------------------------------------------
+# Graph builder: serial order, failure semantics.
+# ---------------------------------------------------------------------------
+
+def _stub_graph(stage, stages, m, fail=None):
+    """A stage graph over no-op callbacks; ``fail`` names a compute op
+    ('fwd:1') that raises."""
+    calls = []
+
+    def mk(kind):
+        def fn(mb, _arg=None):
+            name = f"{kind}:{mb}"
+            calls.append(name)
+            if name == fail:
+                raise RuntimeError(f"boom in {name}")
+            return np.zeros(2, np.float32)
+        return fn
+
+    g = build_stage_graph(
+        stage, stages, m,
+        fwd=mk("fwd"), bwd=mk("bwd"),
+        send_act=lambda mb, a: calls.append(f"send_act:{mb}"),
+        recv_act=lambda mb: np.zeros(2, np.float32),
+        send_grad=lambda mb, a: calls.append(f"send_grad:{mb}"),
+        recv_grad=lambda mb: np.zeros(2, np.float32))
+    return g, calls
+
+
+@pytest.mark.parametrize("stage,stages", [(0, 2), (1, 2), (1, 3)])
+def test_serial_order_is_stage_node_order(stage, stages):
+    m = 4
+    g, _calls = _stub_graph(stage, stages, m)
+    want = stage_node_order(stage, stages, m)
+    assert g.serial_order() == want
+    _results, trace = run_graph(g, overlap=False)
+    assert trace.order() == want
+
+
+def test_stage_failure_cancels_exactly_transitive_dependents():
+    stage, stages, m = 0, 2, 3
+    g, _calls = _stub_graph(stage, stages, m, fail="fwd:1")
+    with pytest.raises(StepFailure) as ei:
+        run_graph(g, overlap=True)
+    sf = ei.value
+    assert set(sf.failed) == {"fwd:1"}
+    # Expected cancels = transitive dependents of fwd:1 in the graph.
+    deps = {n.name: set(n.deps) for n in g.nodes()}
+    expect = set()
+    frontier = {"fwd:1"}
+    while frontier:
+        frontier = {n for n, d in deps.items()
+                    if d & (frontier | expect)} - expect - {"fwd:1"}
+        expect |= frontier
+    assert set(sf.cancelled) == expect
+    # Every other branch completed (salvage): recvs + the pre-failure
+    # compute ops.
+    assert set(sf.done) == set(deps) - expect - {"fwd:1"}
+
+
+# ---------------------------------------------------------------------------
+# PP trajectory parity over MemoryPipe.
+# ---------------------------------------------------------------------------
+
+def _baseline_steps(params, x, y, steps):
+    """Single-process full-batch LayeredMLP + the same numpy momentum
+    formula the stage driver applies."""
+    import jax.numpy as jnp
+
+    from brpc_tpu.models.tensor_service import LayeredMLP
+
+    full = LayeredMLP(SIZES, seed=0)
+    mom = {n: np.zeros_like(v) for n, v in params.items()}
+    losses = []
+    for _ in range(steps):
+        gs, loss = full.grads({n: jnp.asarray(v)
+                               for n, v in params.items()},
+                              jnp.asarray(x), jnp.asarray(y))
+        losses.append(loss)
+        for n in params:
+            mom[n] = MU * mom[n] + np.asarray(gs[n], np.float32)
+            params[n] = params[n] - LR * mom[n]
+    return losses
+
+
+def _run_pp(pipe_ports, microbatches, x, y, steps, overlap=True):
+    """Drive S stages on S threads; returns (drivers, last-stage losses)."""
+    from brpc_tpu.models.pipeline import StagedMLP
+
+    stages = len(pipe_ports)
+    drivers = [PipelineStageDriver(
+        s, stages, StagedMLP(SIZES, s, stages, seed=0), pipe_ports[s],
+        microbatches=microbatches, lr=LR, momentum=MU, overlap=overlap)
+        for s in range(stages)]
+    losses, errs = [], []
+
+    def run_stage(s):
+        try:
+            for _ in range(steps):
+                out = drivers[s].step(x=x if s == 0 else None,
+                                      y=y if s == stages - 1 else None)
+                if s == stages - 1:
+                    losses.append(out)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append((s, e))
+
+    threads = [threading.Thread(target=run_stage, args=(s,))
+               for s in range(stages)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return drivers, losses
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_pp_two_stage_trajectory_parity(overlap):
+    """PP(2) x M(4) over MemoryPipe == single-process baseline. Loss and
+    parameter tolerance documents the ONLY difference: microbatch
+    partial-sum reassociation in fp32 (mean-of-microbatch-grads equals
+    the full-batch grad exactly in real arithmetic)."""
+    from brpc_tpu.models.tensor_service import LayeredMLP
+
+    full = LayeredMLP(SIZES, seed=0)
+    params = {n: np.asarray(v, np.float32)
+              for n, v in full.init_params().items()}
+    x, y = full.data(16, seed=1)
+    x, y = np.asarray(x), np.asarray(y)
+
+    pipe = MemoryPipe(2)
+    drivers, pp_losses = _run_pp([pipe.port(0), pipe.port(1)], 4,
+                                 x, y, steps=4, overlap=overlap)
+    base_losses = _baseline_steps(params, x, y, steps=4)
+    np.testing.assert_allclose(pp_losses, base_losses, rtol=2e-5)
+    merged = {}
+    for d in drivers:
+        merged.update(d.harness.params())
+    assert sorted(merged) == sorted(params)
+    for n in params:
+        np.testing.assert_allclose(merged[n], params[n],
+                                   rtol=2e-5, atol=1e-6)
+    # The bubble is REAL and measured: theory fraction for (2, 4).
+    st = drivers[0].last_stats
+    assert st["bubble_frac_theory"] == pytest.approx(
+        bubble_fraction(2, 4))
+    assert st["bubble_s"] >= 0.0
+
+
+def test_memory_pipe_recv_times_out():
+    pipe = MemoryPipe(2, timeout_s=0.05)
+    with pytest.raises(PipeTimeout):
+        pipe.port(1).recv_act(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# RunTrace exposed-wait split (the satellite).
+# ---------------------------------------------------------------------------
+
+def _split_graph():
+    g = StepGraph()
+    g.add("c1", lambda done: time.sleep(0.02), lane=COMPUTE)
+    # Wire op that outlives all compute: a pure join tail.
+    g.add("w1", lambda done: time.sleep(0.06), deps=("c1",), lane=WIRE)
+    # Second lane: finishes inside the join window too.
+    g.add("w2", lambda done: time.sleep(0.02), deps=("c1",),
+          lane="wire:b")
+    return g
+
+
+def test_exposed_wait_splits_into_stall_plus_join():
+    _r, tr = run_graph(_split_graph(), overlap=True)
+    assert tr.exposed_wait_s == pytest.approx(
+        tr.exposed_stall_s + tr.exposed_join_s, abs=1e-9)
+    # Both wire ops drain AFTER the last compute node: the join tail is
+    # the dominant term and is attributed per lane, longest lane last.
+    assert tr.exposed_join_s > 0.04
+    assert set(tr.lane_join_s) == {WIRE, "wire:b"}
+    assert tr.lane_join_s[WIRE] >= tr.lane_join_s["wire:b"] >= 0.0
+    assert tr.lane_join_s[WIRE] == pytest.approx(tr.exposed_join_s,
+                                                 rel=0.5)
+
+
+def test_serial_mode_has_no_join_tail():
+    _r, tr = run_graph(_split_graph(), overlap=False)
+    assert tr.exposed_join_s == 0.0
+    assert tr.exposed_wait_s == tr.exposed_stall_s == tr.wire_busy_s
+
+
+# ---------------------------------------------------------------------------
+# T3 track-and-trigger (pure LocalRing).
+# ---------------------------------------------------------------------------
+
+def _on_threads(n, fn):
+    out, errs = {}, []
+
+    def worker(r):
+        try:
+            out[r] = fn(r)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    return out
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_on_chunk_fires_per_final_span(world):
+    """The finality contract: every chunk fires exactly once, the spans
+    partition the flattened array, and each fired value equals the FINAL
+    reduced span (raw sum — averaging is the trigger's job), i.e. the
+    trigger never sees a value a later hop would replace."""
+    from brpc_tpu.models.tp_layers import LocalRing
+
+    ring = LocalRing(world)
+    arrs = [np.arange(97, dtype=np.float32) * (r + 1)
+            for r in range(world)]
+    fired = {r: [] for r in range(world)}
+
+    def member(r):
+        def on_chunk(idx, span, vals):
+            fired[r].append((idx, span, vals))
+        return ring.member(r).allreduce("t3", arrs[r], on_chunk=on_chunk)
+
+    outs = _on_threads(world, member)
+    want = sum(arrs)
+    for r in range(world):
+        np.testing.assert_array_equal(outs[r], want)
+        assert sorted(i for i, _s, _v in fired[r]) == list(range(world))
+        covered = 0
+        for _i, (off, ln), vals in sorted(fired[r],
+                                          key=lambda f: f[1][0]):
+            assert off == covered
+            covered += ln
+            np.testing.assert_array_equal(vals, want[off:off + ln])
+        assert covered == want.size
+
+
+def test_track_mode_matches_op_completion_trajectory():
+    """CollectiveStepDriver(track=True): the per-chunk numpy momentum
+    trigger lands the SAME trajectory as the op-completion fused-update
+    path (fp32 tolerance: numpy vs the jitted kernel), members stay
+    bit-identical, and the chunk log proves per-span firing."""
+    from brpc_tpu.models.tensor_service import LayeredMLP
+    from brpc_tpu.models.tp_layers import LocalRing
+    from brpc_tpu.runtime.step_driver import CollectiveStepDriver
+
+    full = LayeredMLP(SIZES, seed=0)
+    x, y = full.data(16, seed=1)
+    x, y = np.asarray(x), np.asarray(y)
+    xs, ys = np.split(x, 2), np.split(y, 2)
+
+    def run(track):
+        ring = LocalRing(2)
+        drivers = [CollectiveStepDriver(
+            ring.member(r), LayeredMLP(SIZES, seed=0), overlap=True,
+            track=track, lr=LR, momentum=MU) for r in range(2)]
+        for d in drivers:
+            d.prime()
+        losses = _on_threads(2, lambda r: [
+            drivers[r].step(xs[r], ys[r]) for _ in range(3)])
+        return drivers, losses
+
+    d_op, l_op = run(False)
+    d_tr, l_tr = run(True)
+    # Loss is computed on the member's OWN shard: compare per member
+    # across modes (params, below, are what members must agree on).
+    np.testing.assert_allclose(l_tr[0], l_op[0], rtol=2e-5)
+    np.testing.assert_allclose(l_tr[1], l_op[1], rtol=2e-5)
+    for n, p in d_op[0].params().items():
+        np.testing.assert_allclose(d_tr[0].params()[n], p,
+                                   rtol=2e-5, atol=1e-7)
+        np.testing.assert_array_equal(d_tr[0].params()[n],
+                                      d_tr[1].params()[n])
+    # Chunk log: world spans per layer, partitioning the parameter.
+    for n, log in d_tr[0].last_chunk_log.items():
+        assert len(log) == 2
+        size = d_tr[0].params()[n].size
+        assert sum(ln for _i, (_o, ln) in log) == size
+    # Track mode removed the op-completion opt nodes from the graph.
+    assert not [e for e in d_tr[0].last_trace.events
+                if e[0].startswith("opt:")]
+    assert [e for e in d_op[0].last_trace.events
+            if e[0].startswith("opt:")]
+
+
+# ---------------------------------------------------------------------------
+# Native: WirePipe end to end.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pp_hub():
+    from conftest import require_native_lib
+    require_native_lib()
+    from brpc_tpu.fleet import RegistryHub, clear_registry
+    hub = RegistryHub()
+    hub.start()
+    yield hub
+    clear_registry()
+    hub.stop()
+
+
+def test_wire_pipe_two_stage_matches_memory_pipe(pp_hub):
+    """The fleet-real transport changes NOTHING about the math: 2 stages
+    over WirePipe (registry discovery + typed tensors) reproduce the
+    MemoryPipe losses bit for bit — the wire ships fp32 verbatim."""
+    from brpc_tpu.models.tensor_service import LayeredMLP
+    from brpc_tpu.runtime.pp_sched import WirePipe
+
+    full = LayeredMLP(SIZES, seed=0)
+    x, y = full.data(16, seed=1)
+    x, y = np.asarray(x), np.asarray(y)
+
+    pipe = MemoryPipe(2)
+    _d, mem_losses = _run_pp([pipe.port(0), pipe.port(1)], 4, x, y,
+                             steps=3)
+
+    pipes = [WirePipe(pp_hub.hostport, s, 2, tag="pp_t1")
+             for s in range(2)]
+    try:
+        _on_threads(2, lambda s: pipes[s].sync(timeout_s=15.0))
+        _d, wire_losses = _run_pp(pipes, 4, x, y, steps=3)
+    finally:
+        for p in pipes:
+            p.close()
+    assert wire_losses == mem_losses

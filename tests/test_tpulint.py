@@ -662,3 +662,51 @@ def test_cli_exit_codes():
         [sys.executable, "-m", "tools.tpulint", "--root", ROOT],
         capture_output=True, text=True, env=env, cwd=ROOT)
     assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+# ---- rule class: regime-graph (jax dispatch on a wire lane) ----
+
+def test_regime_graph_positive(fixture_findings):
+    """rg_bad schedules a jitted update onto wire lanes three ways: a
+    constant lane string, a module-level lane constant, and through a
+    `mk = jitted if flag else plain` selector onto an f-string lane —
+    each .add site is one finding."""
+    hits = _of(fixture_findings, "regime-graph", "rg_bad.py")
+    assert sorted(f.line for f in hits) == [36, 39, 63]
+    assert all("wire-lane" in f.message for f in hits)
+    assert all("COMPUTE" in f.hint for f in hits)
+
+
+def test_regime_graph_negative(fixture_findings):
+    """rg_good stays silent: numpy-only wire nodes (including the
+    on_chunk tracked-momentum shape), the jitted update on COMPUTE, and
+    one justified wire-lane dispatch under an allow comment."""
+    assert not _of(fixture_findings, "regime-graph", "rg_good.py")
+
+
+def test_regime_graph_scope_does_not_cross_contaminate(tmp_path):
+    """Two scopes each defining `make_opt` — one clean, one
+    dispatching — must resolve lane bodies within their OWN scope (the
+    real repo's two driver classes share helper names)."""
+    repo = tmp_path / "brpc_tpu" / "runtime"
+    repo.mkdir(parents=True)
+    (repo / "two.py").write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "from brpc_tpu.runtime.step_sched import StepGraph, WIRE\n"
+        "\n"
+        "def clean(g, x):\n"
+        "    def make_opt(n):\n"
+        "        def fn(done):\n"
+        "            return np.sum(x[n])\n"
+        "        return fn\n"
+        "    g.add('a', make_opt('a'), lane=WIRE)\n"
+        "\n"
+        "def dirty(g, x):\n"
+        "    def make_opt(n):\n"
+        "        def fn(done):\n"
+        "            return jax.block_until_ready(x[n])\n"
+        "        return fn\n"
+        "    g.add('b', make_opt('b'), lane=WIRE)\n")
+    hits = [f for f in run_lint(str(tmp_path)) if f.rule == "regime-graph"]
+    assert [f.line for f in hits] == [17]

@@ -108,4 +108,181 @@ class PyBlockingRule:
         return findings
 
 
-RULES = [PyBlockingRule()]
+# ---------------------------------------------------------------------------
+# regime-graph: jax dispatch scheduled onto a step_sched WIRE lane.
+# ---------------------------------------------------------------------------
+
+# jax dispatch is single-threaded through one lock (PR 6 measured ~5x
+# contention when handlers dispatch off the caller's thread); step_sched
+# encodes that as a contract — COMPUTE lane runs on the caller's thread,
+# wire lanes are extra threads for ops that WAIT, not ops that dispatch.
+# A wire-lane node whose body dispatches jax work re-creates exactly the
+# contention the lane split exists to prevent (the per-chunk optimizer
+# triggers of ISSUE 20 are the tempting case: the fused jitted update
+# belongs on COMPUTE, the wire-lane trigger must stay numpy).
+
+_JAX_ROOTS = ("jax",)
+_JIT_OP_MODULES = ("brpc_tpu.ops",)  # jitted-kernel homes: calls dispatch
+
+
+def _jax_aliases(tree: ast.AST) -> set:
+    """Names that, when called or attribute-accessed, mean jax dispatch:
+    jax module aliases (``import jax``, ``import jax.numpy as jnp``,
+    ``from jax import ...``) and names imported from the jitted-kernel
+    modules — collected at ANY nesting depth (the drivers import jax
+    inside functions)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] in _JAX_ROOTS:
+                    out.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if (mod.split(".")[0] in _JAX_ROOTS
+                    or any(mod == m or mod.startswith(m + ".")
+                           for m in _JIT_OP_MODULES)):
+                for a in node.names:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _dispatches(fn_node: ast.AST, aliases: set) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "block_until_ready":
+                return True
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in aliases:
+                return True
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in aliases:
+                return True
+    return False
+
+
+def _lane_is_wire(kw_value: ast.AST, str_consts: dict) -> bool:
+    v = kw_value
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return v.value.startswith("wire")
+    if isinstance(v, ast.JoinedStr) and v.values:
+        head = v.values[0]
+        return (isinstance(head, ast.Constant)
+                and isinstance(head.value, str)
+                and head.value.startswith("wire"))
+    if isinstance(v, ast.Name):
+        if v.id == "WIRE":
+            return True
+        resolved = str_consts.get(v.id)
+        return isinstance(resolved, str) and resolved.startswith("wire")
+    return False
+
+
+class RegimeGraphRule:
+    id = "regime-graph"
+    description = ("step_sched node on a wire lane dispatches jax work "
+                   "off the caller's thread (single-lock dispatch "
+                   "contention)")
+
+    def run(self, ctx: LintContext):
+        findings = []
+        for src in ctx.select(under=HANDLER_TREES, ext={".py"}):
+            try:
+                tree = ast.parse(src.text, filename=src.path)
+            except SyntaxError:
+                continue  # py-blocking already reports unparseable files
+            findings.extend(self._scan(src, tree))
+        return findings
+
+    def _scan(self, src, tree):
+        aliases = _jax_aliases(tree)
+        if not aliases:
+            return []
+        findings = []
+        self._scope(src, tree.body, {}, {}, {}, aliases, findings)
+        return findings
+
+    def _scope(self, src, body, funcs, assigns, str_consts, aliases,
+               findings):
+        """One lexical scope: names resolve to THIS scope's defs (plus
+        inherited ones, shadowed) — two classes each defining a
+        ``make_opt`` must not contaminate each other's lanes."""
+        # Collect this scope's own defs/assigns and the .add calls made
+        # at this level — stopping at nested function/class boundaries.
+        local_funcs: dict = {}
+        local_assigns: dict = {}
+        local_strs: dict = {}
+        add_calls = []
+        nested = []
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                if not isinstance(node, ast.ClassDef):
+                    local_funcs.setdefault(node.name, []).append(node)
+                nested.append(node)
+                continue  # its body is a child scope
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                if isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, str):
+                    local_strs[tgt] = node.value.value
+                else:
+                    # name -> names in the value expr: one-hop selector
+                    # resolution (`mk = tracked if t else plain`).
+                    local_assigns[tgt] = {
+                        n.id for n in ast.walk(node.value)
+                        if isinstance(n, ast.Name)}
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add" \
+                    and len(node.args) >= 2:
+                add_calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        funcs = {**funcs, **local_funcs}
+        assigns = {**assigns, **local_assigns}
+        str_consts = {**str_consts, **local_strs}
+        for node in add_calls:
+            lane_kw = next((k for k in node.keywords if k.arg == "lane"),
+                           None)
+            if lane_kw is None or \
+                    not _lane_is_wire(lane_kw.value, str_consts):
+                continue
+            fn_arg = node.args[1]
+            if isinstance(fn_arg, ast.Lambda) and \
+                    _dispatches(fn_arg, aliases):
+                findings.append(self._finding(src, node))
+                continue
+            names = {n.id for n in ast.walk(fn_arg)
+                     if isinstance(n, ast.Name)}
+            seen = set()
+            while names:
+                name = names.pop()
+                if name in seen:
+                    continue
+                seen.add(name)
+                names |= assigns.get(name, set()) - seen
+                if any(_dispatches(f, aliases)
+                       for f in funcs.get(name, ())):
+                    findings.append(self._finding(src, node))
+                    break
+        for child in nested:
+            self._scope(src, child.body, funcs, assigns, str_consts,
+                        aliases, findings)
+
+    def _finding(self, src, node):
+        return Finding(
+            rule=self.id, path=src.path, line=node.lineno,
+            message="wire-lane step_sched node dispatches jax work off "
+                    "the caller's thread — jax dispatch serializes on "
+                    "one lock, so this stalls the compute lane it was "
+                    "meant to overlap",
+            hint="run the dispatching piece on the COMPUTE lane (a "
+                 "dependent node), keep the wire-lane body numpy, or "
+                 "justify with `# tpulint: allow(regime-graph)`")
+
+
+RULES = [PyBlockingRule(), RegimeGraphRule()]
